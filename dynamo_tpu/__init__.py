@@ -1,0 +1,21 @@
+"""dynamo-tpu: a TPU-native distributed LLM inference serving framework.
+
+A ground-up rebuild of the capabilities of NVIDIA Dynamo (reference:
+/root/reference) designed for TPU hardware: the compute path is JAX/XLA/Pallas
+over `jax.sharding.Mesh`, the serving runtime is asyncio + a built-in TCP
+control/request plane, and KV movement rides XLA collectives / host DMA
+instead of NIXL.
+
+Layer map (mirrors reference SURVEY.md §1):
+  runtime/   — distributed runtime: discovery, component model, request plane
+  llm/       — serving pipeline: protocols, preprocessor, HTTP frontend,
+               KV router, block manager, mocker engine
+  engine/    — the JAX inference engine: continuous batching, paged KV
+  models/    — model zoo (functional JAX, param pytrees)
+  ops/       — Pallas TPU kernels (ragged paged attention, block copy)
+  parallel/  — mesh construction, shardings (tp/dp/pp/ep/sp)
+  planner/   — SLA planner: load prediction, perf interpolation, autoscale
+  frontend/  — `python -m dynamo_tpu.frontend` OpenAI entrypoint
+"""
+
+__version__ = "0.1.0"

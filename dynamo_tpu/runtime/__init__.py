@@ -1,0 +1,72 @@
+"""dynamo_tpu.runtime — the distributed runtime (reference: lib/runtime).
+
+Public surface mirrors the reference's `dynamo.runtime` Python package:
+DistributedRuntime, Namespace/Component/Endpoint, Context, AsyncEngine,
+PushRouter/RouterMode, discovery client/server, config, logging.
+"""
+
+from .config import RuntimeConfig, discovery_address
+from .component import (
+    Client,
+    Component,
+    DistributedRuntime,
+    Endpoint,
+    Instance,
+    Namespace,
+    ServedEndpoint,
+    INSTANCE_ROOT,
+    MODEL_ROOT,
+)
+from .discovery import DiscoveryClient, DiscoveryServer, Lease, Watch, WatchEvent
+from .engine import AsyncEngine, Context, FnEngine, ResponseStream, collect
+from .logging import (
+    DistributedTraceContext,
+    current_trace,
+    init_logging,
+    parse_traceparent,
+    set_trace,
+)
+from .push_router import PushRouter, RouterMode
+from .request_plane import (
+    EndpointStats,
+    EngineError,
+    RequestPlaneClient,
+    RequestPlaneServer,
+    StreamLost,
+)
+
+__all__ = [
+    "AsyncEngine",
+    "Client",
+    "Component",
+    "Context",
+    "DiscoveryClient",
+    "DiscoveryServer",
+    "DistributedRuntime",
+    "DistributedTraceContext",
+    "Endpoint",
+    "EndpointStats",
+    "EngineError",
+    "FnEngine",
+    "Instance",
+    "INSTANCE_ROOT",
+    "Lease",
+    "MODEL_ROOT",
+    "Namespace",
+    "PushRouter",
+    "RequestPlaneClient",
+    "RequestPlaneServer",
+    "ResponseStream",
+    "RouterMode",
+    "RuntimeConfig",
+    "ServedEndpoint",
+    "StreamLost",
+    "Watch",
+    "WatchEvent",
+    "collect",
+    "current_trace",
+    "discovery_address",
+    "init_logging",
+    "parse_traceparent",
+    "set_trace",
+]

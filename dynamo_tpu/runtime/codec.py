@@ -1,0 +1,69 @@
+"""Two-part wire codec for the request/response planes.
+
+Mirrors the reference's TwoPartCodec
+(lib/runtime/src/pipeline/network/codec/two_part.rs): every message is a
+control header (msgpack map) plus an opaque payload, length-prefixed so it
+can be streamed over a raw TCP connection.
+
+Frame layout (little-endian):
+    u32 magic 0xD7A0C0DE | u32 header_len | u32 payload_len | header | payload
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+from typing import Any, Optional, Tuple
+
+import msgpack
+
+MAGIC = 0xD7A0C0DE
+_HDR = struct.Struct("<III")
+MAX_FRAME = 1 << 30  # 1 GiB sanity bound
+
+
+def encode_frame(control: dict, payload: bytes = b"") -> bytes:
+    header = msgpack.packb(control, use_bin_type=True)
+    return _HDR.pack(MAGIC, len(header), len(payload)) + header + payload
+
+
+def decode_frame(buf: bytes) -> Tuple[dict, bytes]:
+    magic, hlen, plen = _HDR.unpack_from(buf, 0)
+    if magic != MAGIC:
+        raise ValueError(f"bad frame magic {magic:#x}")
+    off = _HDR.size
+    header = msgpack.unpackb(buf[off : off + hlen], raw=False)
+    payload = bytes(buf[off + hlen : off + hlen + plen])
+    return header, payload
+
+
+async def read_frame(reader: asyncio.StreamReader) -> Optional[Tuple[dict, bytes]]:
+    """Read one frame; returns None on clean EOF at a frame boundary."""
+    try:
+        head = await reader.readexactly(_HDR.size)
+    except (asyncio.IncompleteReadError, ConnectionResetError):
+        return None
+    magic, hlen, plen = _HDR.unpack(head)
+    if magic != MAGIC:
+        raise ValueError(f"bad frame magic {magic:#x}")
+    if hlen + plen > MAX_FRAME:
+        raise ValueError(f"frame too large: {hlen + plen}")
+    body = await reader.readexactly(hlen + plen)
+    header = msgpack.unpackb(body[:hlen], raw=False)
+    return header, body[hlen:]
+
+
+async def write_frame(
+    writer: asyncio.StreamWriter, control: dict, payload: bytes = b""
+):
+    writer.write(encode_frame(control, payload))
+    await writer.drain()
+
+
+def pack(obj: Any) -> bytes:
+    """Payload serializer used across the request plane."""
+    return msgpack.packb(obj, use_bin_type=True)
+
+
+def unpack(data: bytes) -> Any:
+    return msgpack.unpackb(data, raw=False)

@@ -1,0 +1,332 @@
+"""Component model + DistributedRuntime.
+
+Mirrors reference lib/runtime: `Runtime` (lib.rs:70),
+`DistributedRuntime::new` (distributed.rs:42), `Namespace` (component.rs:520)
+→ `Component` (:120) → `Endpoint` (:358), live `Instance` records (:98)
+written to discovery under the process's primary lease, and
+`Client`/`InstanceSource` (component/client.rs:40,52) that watch instances.
+
+Discovery layout:
+  v1/instances/{namespace}/{component}/{endpoint}/{instance_id} -> Instance json
+  v1/mdc/{namespace}/{component}/{model-slug}                   -> ModelDeploymentCard
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import secrets
+import socket
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Callable, Dict, List, Optional
+
+from . import codec
+from .config import RuntimeConfig, discovery_address
+from .discovery import DiscoveryClient, DiscoveryServer, Lease, Watch
+from .engine import Context
+from .request_plane import (
+    EndpointStats,
+    Handler,
+    RequestPlaneClient,
+    RequestPlaneServer,
+)
+
+logger = logging.getLogger(__name__)
+
+INSTANCE_ROOT = "v1/instances/"
+MODEL_ROOT = "v1/mdc/"
+
+
+@dataclass
+class Instance:
+    """A live endpoint instance (reference Instance component.rs:98)."""
+
+    namespace: str
+    component: str
+    endpoint: str
+    instance_id: int
+    address: str  # host:port of the worker's request-plane server
+    subject: str  # routing subject within that server
+
+    @property
+    def path(self) -> str:
+        return (
+            f"{INSTANCE_ROOT}{self.namespace}/{self.component}/"
+            f"{self.endpoint}/{self.instance_id:x}"
+        )
+
+    def to_json(self) -> bytes:
+        return json.dumps(self.__dict__).encode()
+
+    @classmethod
+    def from_json(cls, raw: bytes) -> "Instance":
+        return cls(**json.loads(raw))
+
+
+class DistributedRuntime:
+    """Process-wide distributed runtime: discovery client + primary lease +
+    request-plane server/client (reference DistributedRuntime distributed.rs:42).
+
+    `static_mode=True` skips discovery entirely (reference's etcd=None static
+    mode) — endpoints are addressed directly by host:port.
+    """
+
+    def __init__(self, config: Optional[RuntimeConfig] = None, static_mode: bool = False):
+        self.config = config or RuntimeConfig.from_settings()
+        self.static_mode = static_mode
+        self.instance_id = int.from_bytes(os.urandom(8), "big") >> 1
+        self.discovery: Optional[DiscoveryClient] = None
+        self.primary_lease: Optional[Lease] = None
+        self._embedded_discovery: Optional[DiscoveryServer] = None
+        self.server = RequestPlaneServer(host=self.config.request_plane_host)
+        self.client = RequestPlaneClient()
+        self._server_started = False
+        self._namespaces: Dict[str, Namespace] = {}
+        self._shutdown = asyncio.Event()
+        self.etcd_root = ""  # prefix for multi-tenant stores (unused for now)
+
+    @classmethod
+    async def create(
+        cls,
+        config: Optional[RuntimeConfig] = None,
+        static_mode: bool = False,
+        embed_discovery: bool = False,
+    ) -> "DistributedRuntime":
+        """Connect to (or embed) the discovery service and grant the primary
+        lease. With embed_discovery, this process hosts the control plane —
+        typically the frontend does this when no external one is running."""
+        drt = cls(config, static_mode)
+        if not static_mode:
+            host, port = discovery_address(drt.config)
+            if embed_discovery:
+                drt._embedded_discovery = DiscoveryServer(host="0.0.0.0", port=port)
+                try:
+                    await drt._embedded_discovery.start()
+                except OSError:
+                    drt._embedded_discovery = None  # someone else already runs it
+            drt.discovery = await DiscoveryClient.connect(host, port)
+            drt.primary_lease = await drt.discovery.grant_lease(ttl=10.0)
+        return drt
+
+    async def ensure_server(self) -> str:
+        """Start the request-plane server on first use; returns host:port."""
+        if not self._server_started:
+            await self.server.start()
+            self._server_started = True
+        host = self.server.host
+        if host in ("0.0.0.0", "::"):
+            host = socket.gethostbyname(socket.gethostname())
+        return f"{host}:{self.server.port}"
+
+    def namespace(self, name: str) -> "Namespace":
+        ns = self._namespaces.get(name)
+        if ns is None:
+            ns = Namespace(self, name)
+            self._namespaces[name] = ns
+        return ns
+
+    def shutdown(self):
+        self._shutdown.set()
+
+    async def wait_for_shutdown(self):
+        await self._shutdown.wait()
+
+    async def close(self):
+        self._shutdown.set()
+        if self.primary_lease is not None:
+            await self.primary_lease.revoke()
+        await self.client.close()
+        await self.server.stop()
+        if self.discovery is not None:
+            await self.discovery.close()
+        if self._embedded_discovery is not None:
+            await self._embedded_discovery.stop()
+
+
+class Namespace:
+    """Logical grouping of components (reference component.rs:520)."""
+
+    def __init__(self, drt: DistributedRuntime, name: str):
+        self.drt = drt
+        self.name = name
+
+    def component(self, name: str) -> "Component":
+        return Component(self.drt, self.name, name)
+
+
+class Component:
+    """A deployable service unit within a namespace (reference component.rs:120)."""
+
+    def __init__(self, drt: DistributedRuntime, namespace: str, name: str):
+        self.drt = drt
+        self.namespace = namespace
+        self.name = name
+
+    def endpoint(self, name: str) -> "Endpoint":
+        return Endpoint(self, name)
+
+    async def create_service(self):
+        """No-op placeholder for service-level registration; instances are
+        registered per-endpoint at serve time (matches reference semantics
+        where the NATS service is created lazily)."""
+        return self
+
+    @property
+    def path(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+class Endpoint:
+    """A named, servable function on a component (reference component.rs:358)."""
+
+    def __init__(self, component: Component, name: str):
+        self.component = component
+        self.name = name
+
+    @property
+    def drt(self) -> DistributedRuntime:
+        return self.component.drt
+
+    @property
+    def subject(self) -> str:
+        return f"{self.component.namespace}.{self.component.name}.{self.name}"
+
+    @property
+    def path(self) -> str:
+        return f"{self.component.path}/{self.name}"
+
+    async def serve_endpoint(
+        self,
+        handler: Handler,
+        *,
+        metrics_labels: Optional[dict] = None,
+        graceful: bool = True,
+    ) -> "ServedEndpoint":
+        """Register the handler on the process request-plane server and write
+        the Instance record under the primary lease
+        (reference serve_endpoint bindings lib.rs:641 → Ingress)."""
+        drt = self.drt
+        address = await drt.ensure_server()
+        stats = drt.server.register(self.subject, handler)
+        instance = Instance(
+            namespace=self.component.namespace,
+            component=self.component.name,
+            endpoint=self.name,
+            instance_id=drt.instance_id,
+            address=address,
+            subject=self.subject,
+        )
+        if drt.discovery is not None:
+            await drt.discovery.put(instance.path, instance.to_json(), drt.primary_lease)
+        logger.info("serving endpoint %s at %s (instance %x)", self.subject, address, instance.instance_id)
+        return ServedEndpoint(self, instance, stats)
+
+    async def client(self) -> "Client":
+        client = Client(self)
+        await client.start()
+        return client
+
+    def instance_prefix(self) -> str:
+        return f"{INSTANCE_ROOT}{self.component.namespace}/{self.component.name}/{self.name}/"
+
+
+class ServedEndpoint:
+    def __init__(self, endpoint: Endpoint, instance: Instance, stats: EndpointStats):
+        self.endpoint = endpoint
+        self.instance = instance
+        self.stats = stats
+
+    async def remove(self):
+        drt = self.endpoint.drt
+        drt.server.unregister(self.endpoint.subject)
+        if drt.discovery is not None:
+            await drt.discovery.delete(self.instance.path)
+
+
+class Client:
+    """Endpoint client with a live instance list
+    (reference Client/InstanceSource component/client.rs:40,52).
+
+    Watches the discovery prefix for this endpoint; `instances` is kept
+    current as workers come and go.
+    """
+
+    def __init__(self, endpoint: Endpoint):
+        self.endpoint = endpoint
+        self.instances: Dict[int, Instance] = {}
+        self._watch: Optional[Watch] = None
+        self._watch_task: Optional[asyncio.Task] = None
+        self._instances_event = asyncio.Event()
+        self._default_router = None  # lazy PushRouter for .generate()
+
+    async def start(self):
+        drt = self.endpoint.drt
+        if drt.discovery is None:
+            return
+        self._watch = await drt.discovery.watch_prefix(self.endpoint.instance_prefix())
+        for item in self._watch.snapshot:
+            inst = Instance.from_json(item["value"])
+            self.instances[inst.instance_id] = inst
+        if self.instances:
+            self._instances_event.set()
+        self._watch_task = asyncio.create_task(self._watch_loop())
+
+    async def _watch_loop(self):
+        assert self._watch is not None
+        async for event in self._watch:
+            if event.type == "put":
+                inst = Instance.from_json(event.value)
+                self.instances[inst.instance_id] = inst
+                self._instances_event.set()
+            elif event.type == "delete":
+                iid = int(event.key.rsplit("/", 1)[-1], 16)
+                self.instances.pop(iid, None)
+                if not self.instances:
+                    self._instances_event.clear()
+
+    def instance_ids(self) -> List[int]:
+        return sorted(self.instances.keys())
+
+    async def wait_for_instances(self, timeout: float = 30.0) -> List[int]:
+        """Block until at least one instance is live (reference
+        wait_for_instances semantics used by workers at startup)."""
+        await asyncio.wait_for(self._instances_event.wait(), timeout)
+        return self.instance_ids()
+
+    def add_static_instance(self, address: str, subject: Optional[str] = None, instance_id: int = 0):
+        """Static mode: seed a fixed instance without discovery."""
+        inst = Instance(
+            namespace=self.endpoint.component.namespace,
+            component=self.endpoint.component.name,
+            endpoint=self.endpoint.name,
+            instance_id=instance_id,
+            address=address,
+            subject=subject or self.endpoint.subject,
+        )
+        self.instances[inst.instance_id] = inst
+        self._instances_event.set()
+
+    async def direct(self, request: Any, instance_id: int, context: Optional[Context] = None):
+        """Send to a specific instance (reference RouterMode::Direct)."""
+        inst = self.instances.get(instance_id)
+        if inst is None:
+            from .request_plane import StreamLost
+
+            raise StreamLost(f"instance {instance_id:x} not found for {self.endpoint.subject}")
+        return await self.endpoint.drt.client.call(inst.address, inst.subject, request, context)
+
+    async def generate(self, request: Any, context: Optional[Context] = None):
+        """Round-robin convenience (full routing lives in PushRouter)."""
+        from .push_router import PushRouter, RouterMode
+
+        if self._default_router is None:
+            self._default_router = PushRouter(self, RouterMode.ROUND_ROBIN)
+        return await self._default_router.generate(request, context)
+
+    async def close(self):
+        if self._watch_task:
+            self._watch_task.cancel()
+        if self._watch:
+            await self._watch.cancel()

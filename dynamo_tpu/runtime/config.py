@@ -1,0 +1,106 @@
+"""Layered runtime configuration.
+
+Mirrors the reference's figment-based config (lib/runtime/src/config.rs:72):
+defaults <- optional config file (TOML/JSON/YAML) <- `DYN_*` environment
+variables. Env takes precedence, like figment's profile layering.
+
+Recognised env prefixes (parity with reference config.rs:214-260):
+  DYN_RUNTIME_*   — runtime knobs (worker threads, shutdown timeouts)
+  DYN_SYSTEM_*    — system status server (enabled, port)
+  DYN_COMPUTE_*   — compute pool sizing
+  DYN_HEALTH_CHECK_* — canary health checks
+  DYN_DISCOVERY_* — built-in discovery service address
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from pathlib import Path
+from typing import Any, Optional
+
+
+def _env(name: str, default: Any = None, cast=str):
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    if cast is bool:
+        return raw.lower() in ("1", "true", "yes", "on")
+    return cast(raw)
+
+
+@dataclasses.dataclass
+class RuntimeConfig:
+    """Process-local runtime configuration (reference: RuntimeConfig config.rs:72)."""
+
+    # asyncio / compute pool
+    num_worker_threads: int = 0  # 0 = library default
+    max_blocking_threads: int = 4
+    # graceful shutdown
+    graceful_shutdown_timeout: float = 30.0
+    # system status server (reference: DYN_SYSTEM_ENABLED/DYN_SYSTEM_PORT)
+    system_enabled: bool = False
+    system_host: str = "0.0.0.0"
+    system_port: int = 0  # 0 = ephemeral
+    # health checks (reference: config.rs:155-167)
+    health_check_enabled: bool = False
+    health_check_idle_timeout: float = 60.0
+    health_check_request_timeout: float = 10.0
+    # built-in discovery service ("etcd" role)
+    discovery_endpoint: str = "tcp://127.0.0.1:2379"
+    # request-plane bind host for TCP response/request streams
+    request_plane_host: str = "127.0.0.1"
+
+    @classmethod
+    def from_settings(cls, config_path: Optional[str] = None) -> "RuntimeConfig":
+        """Layered load: defaults <- file <- env (reference figment() config.rs:214)."""
+        cfg = cls()
+        path = config_path or os.environ.get("DYN_RUNTIME_CONFIG")
+        if path and Path(path).exists():
+            text = Path(path).read_text()
+            data: dict
+            if path.endswith((".yaml", ".yml")):
+                import yaml
+
+                data = yaml.safe_load(text) or {}
+            else:
+                data = json.loads(text)
+            for field in dataclasses.fields(cls):
+                if field.name in data:
+                    setattr(cfg, field.name, data[field.name])
+        # env layer
+        cfg.num_worker_threads = _env(
+            "DYN_RUNTIME_NUM_WORKER_THREADS", cfg.num_worker_threads, int
+        )
+        cfg.max_blocking_threads = _env(
+            "DYN_RUNTIME_MAX_BLOCKING_THREADS", cfg.max_blocking_threads, int
+        )
+        cfg.graceful_shutdown_timeout = _env(
+            "DYN_RUNTIME_GRACEFUL_SHUTDOWN_TIMEOUT", cfg.graceful_shutdown_timeout, float
+        )
+        cfg.system_enabled = _env("DYN_SYSTEM_ENABLED", cfg.system_enabled, bool)
+        cfg.system_host = _env("DYN_SYSTEM_HOST", cfg.system_host)
+        cfg.system_port = _env("DYN_SYSTEM_PORT", cfg.system_port, int)
+        cfg.health_check_enabled = _env(
+            "DYN_HEALTH_CHECK_ENABLED", cfg.health_check_enabled, bool
+        )
+        cfg.health_check_idle_timeout = _env(
+            "DYN_HEALTH_CHECK_IDLE_TIMEOUT", cfg.health_check_idle_timeout, float
+        )
+        cfg.health_check_request_timeout = _env(
+            "DYN_HEALTH_CHECK_REQUEST_TIMEOUT", cfg.health_check_request_timeout, float
+        )
+        cfg.discovery_endpoint = _env("DYN_DISCOVERY_ENDPOINT", cfg.discovery_endpoint)
+        cfg.request_plane_host = _env("DYN_REQUEST_PLANE_HOST", cfg.request_plane_host)
+        return cfg
+
+
+def discovery_address(cfg: Optional[RuntimeConfig] = None) -> tuple[str, int]:
+    """Parse the discovery endpoint into (host, port)."""
+    cfg = cfg or RuntimeConfig.from_settings()
+    ep = cfg.discovery_endpoint
+    if "://" in ep:
+        ep = ep.split("://", 1)[1]
+    host, _, port = ep.rpartition(":")
+    return host or "127.0.0.1", int(port)

@@ -1,0 +1,122 @@
+"""Streaming engine abstraction + cancellation contexts.
+
+Mirrors reference lib/runtime/src/engine.rs: `AsyncEngine` (:201) is the
+universal request→response-stream interface every layer speaks;
+`AsyncEngineContext` (:112) carries id + cancellation ("kill switch")
+down the pipeline; `ResponseStream` (:213) pairs a stream with its context.
+
+In dynamo-tpu an engine is any object with
+    async def generate(request, context) -> AsyncIterator[response]
+Operators (preprocessor, backend, migration, router) wrap engines; the
+outermost stream is consumed by the HTTP frontend.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import secrets
+from typing import Any, AsyncIterator, Awaitable, Callable, Optional, Protocol, runtime_checkable
+
+
+class Context:
+    """Cancellation context propagated through the pipeline
+    (reference AsyncEngineContext engine.rs:112).
+
+    `stop_generating` = graceful: finish the current token, emit a final
+    usage chunk. `kill` = hard: stop streaming immediately. Child contexts
+    form a cancellation tree like the reference's token hierarchy.
+    """
+
+    def __init__(self, id: Optional[str] = None, parent: Optional["Context"] = None):
+        self._id = id or secrets.token_hex(8)
+        self._stopped = asyncio.Event()
+        self._killed = asyncio.Event()
+        self._parent = parent
+        self._children: list[Context] = []
+        if parent is not None:
+            parent._children.append(self)
+
+    @property
+    def id(self) -> str:
+        return self._id
+
+    def is_stopped(self) -> bool:
+        return self._stopped.is_set() or (self._parent is not None and self._parent.is_stopped())
+
+    def is_killed(self) -> bool:
+        return self._killed.is_set() or (self._parent is not None and self._parent.is_killed())
+
+    def stop_generating(self):
+        self._stopped.set()
+        for child in self._children:
+            child.stop_generating()
+
+    def kill(self):
+        self._killed.set()
+        self._stopped.set()
+        for child in self._children:
+            child.kill()
+
+    async def stopped(self):
+        """Wait until stop is requested."""
+        await self._wait_event(lambda c: c._stopped)
+
+    async def killed(self):
+        """Wait until hard kill is requested."""
+        await self._wait_event(lambda c: c._killed)
+
+    async def _wait_event(self, get_event):
+        if self._parent is None:
+            await get_event(self).wait()
+            return
+        parent_task = asyncio.create_task(self._parent._wait_event(get_event))
+        own_task = asyncio.create_task(get_event(self).wait())
+        done, pending = await asyncio.wait(
+            [parent_task, own_task], return_when=asyncio.FIRST_COMPLETED
+        )
+        for task in pending:
+            task.cancel()
+
+    def child(self, id: Optional[str] = None) -> "Context":
+        return Context(id=id or self._id, parent=self)
+
+
+@runtime_checkable
+class AsyncEngine(Protocol):
+    """The universal streaming engine interface (reference engine.rs:201)."""
+
+    def generate(self, request: Any, context: Context) -> AsyncIterator[Any]:
+        ...
+
+
+class FnEngine:
+    """Adapt a plain async-generator function into an AsyncEngine."""
+
+    def __init__(self, fn: Callable[[Any, Context], AsyncIterator[Any]], name: str = "fn"):
+        self._fn = fn
+        self.name = name
+
+    def generate(self, request: Any, context: Context) -> AsyncIterator[Any]:
+        return self._fn(request, context)
+
+
+class ResponseStream:
+    """An async response stream bound to its engine context
+    (reference ResponseStream engine.rs:213)."""
+
+    def __init__(self, stream: AsyncIterator[Any], context: Context):
+        self._stream = stream
+        self.context = context
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self):
+        if self.context.is_killed():
+            raise StopAsyncIteration
+        return await self._stream.__anext__()
+
+
+async def collect(stream: AsyncIterator[Any]) -> list:
+    """Drain a stream into a list (test helper)."""
+    return [item async for item in stream]

@@ -1,0 +1,77 @@
+"""Client-side request routing across endpoint instances.
+
+Mirrors reference PushRouter with RouterMode {RoundRobin, Random, Direct, KV}
+(lib/runtime/src/pipeline/network/egress/push_router.rs:71). The KV mode is
+implemented by KvPushRouter in llm/kv_router (it picks an instance by cache
+overlap, then delegates here via `direct`).
+"""
+
+from __future__ import annotations
+
+import enum
+import random
+from typing import Any, AsyncIterator, Optional
+
+from .component import Client
+from .engine import Context
+from .request_plane import StreamLost
+
+
+class RouterMode(str, enum.Enum):
+    ROUND_ROBIN = "round-robin"
+    RANDOM = "random"
+    DIRECT = "direct"
+    KV = "kv"
+
+
+class PushRouter:
+    """Route requests over the live instances of an endpoint client
+    (reference push_router.rs:71)."""
+
+    def __init__(
+        self,
+        client: Client,
+        mode: RouterMode = RouterMode.ROUND_ROBIN,
+        direct_instance: Optional[int] = None,
+    ):
+        self.client = client
+        self.mode = mode
+        self.direct_instance = direct_instance
+        self._rr_index = 0
+
+    def _pick(self, exclude: set) -> int:
+        ids = [i for i in self.client.instance_ids() if i not in exclude]
+        if not ids:
+            raise StreamLost(f"no instances for {self.client.endpoint.subject}")
+        if self.mode == RouterMode.RANDOM:
+            return random.choice(ids)
+        if self.mode == RouterMode.DIRECT:
+            if self.direct_instance is None:
+                raise ValueError("direct mode requires an instance id")
+            return self.direct_instance
+        # round-robin default
+        inst = ids[self._rr_index % len(ids)]
+        self._rr_index += 1
+        return inst
+
+    async def generate(
+        self, request: Any, context: Optional[Context] = None
+    ) -> AsyncIterator[Any]:
+        """Pick an instance and issue the request. On connect failure, retry
+        the remaining instances once each before giving up. Failed instances
+        are only skipped within this call — discovery (lease expiry) is the
+        authority on permanent removal."""
+        tried: set = set()
+        last_err: Optional[Exception] = None
+        for _ in range(max(1, len(self.client.instance_ids()))):
+            try:
+                instance_id = self._pick(exclude=tried)
+            except StreamLost:
+                break
+            try:
+                return await self.client.direct(request, instance_id, context)
+            except StreamLost as e:
+                last_err = e
+                tried.add(instance_id)
+                continue
+        raise last_err or StreamLost("no instances available")

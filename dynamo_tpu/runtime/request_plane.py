@@ -1,0 +1,347 @@
+"""TCP request plane: how requests reach workers and responses stream back.
+
+Reference design: request goes over NATS to the instance's subject, the
+response streams back over a direct TCP connection to the caller's
+TcpStreamServer (addressed_router.rs:52-142, push_endpoint.rs:36).
+
+dynamo-tpu collapses both hops into one direct TCP connection: each worker
+process runs ONE `RequestPlaneServer` exposing all of its endpoints,
+registered in discovery as `host:port` + subject. Callers hold pooled
+connections and multiplex many in-flight streams on each. This removes the
+broker round-trip from the token hot path — on TPU pods, hosts talk
+directly over DCN anyway.
+
+Wire protocol (two-part frames, codec.py):
+  request :  {t:"req", stream:<id>, subject:<str>, traceparent?:<str>}  + payload
+  cancel  :  {t:"cancel", stream:<id>, kill:<bool>}
+  response:  {t:"data", stream:<id>} + payload        (one per stream item)
+             {t:"done", stream:<id>}                  (clean end)
+             {t:"err",  stream:<id>, error:<str>}     (terminal error)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+from typing import Any, AsyncIterator, Awaitable, Callable, Dict, Optional, Tuple
+
+from . import codec
+from .engine import Context
+from .logging import DistributedTraceContext, current_trace, parse_traceparent, set_trace
+
+logger = logging.getLogger(__name__)
+
+Handler = Callable[[Any, Context], AsyncIterator[Any]]
+
+
+class EndpointStats:
+    """Per-endpoint counters, scraped by metrics + KV-router metrics
+    aggregation (reference: NATS $SRV.STATS scraping, transports/nats.rs:107)."""
+
+    def __init__(self):
+        self.requests_total = 0
+        self.requests_active = 0
+        self.errors_total = 0
+        self.data = {}  # engine-published stats blob (ForwardPassMetrics)
+
+    def snapshot(self) -> dict:
+        return {
+            "requests_total": self.requests_total,
+            "requests_active": self.requests_active,
+            "errors_total": self.errors_total,
+            "data": self.data,
+        }
+
+
+class RequestPlaneServer:
+    """Per-process TCP server hosting all served endpoints
+    (reference: Ingress/PushEndpoint push_endpoint.rs:36)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host, self.port = host, port
+        self._handlers: Dict[str, Handler] = {}
+        self._stats: Dict[str, EndpointStats] = {}
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._active: Dict[Tuple[asyncio.StreamWriter, int], Context] = {}
+        self._connections: set = set()
+
+    def register(self, subject: str, handler: Handler) -> EndpointStats:
+        self._handlers[subject] = handler
+        self._stats[subject] = EndpointStats()
+        return self._stats[subject]
+
+    def unregister(self, subject: str):
+        self._handlers.pop(subject, None)
+        self._stats.pop(subject, None)
+
+    def stats(self, subject: str) -> Optional[EndpointStats]:
+        return self._stats.get(subject)
+
+    def all_stats(self) -> Dict[str, dict]:
+        return {s: st.snapshot() for s, st in self._stats.items()}
+
+    async def start(self) -> Tuple[str, int]:
+        self._server = await asyncio.start_server(self._serve_conn, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self.host, self.port
+
+    async def stop(self):
+        for ctx in self._active.values():
+            ctx.kill()
+        if self._server:
+            self._server.close()
+        for writer in list(self._connections):
+            writer.close()
+        if self._server:
+            await self._server.wait_closed()
+
+    async def _serve_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        write_lock = asyncio.Lock()
+        tasks: Dict[int, asyncio.Task] = {}
+        self._connections.add(writer)
+        try:
+            while True:
+                frame = await codec.read_frame(reader)
+                if frame is None:
+                    break
+                control, payload = frame
+                t = control.get("t")
+                if t == "req":
+                    stream_id = control["stream"]
+                    task = asyncio.create_task(
+                        self._run_stream(control, payload, writer, write_lock)
+                    )
+                    tasks[stream_id] = task
+                    task.add_done_callback(lambda _, sid=stream_id: tasks.pop(sid, None))
+                elif t == "cancel":
+                    ctx = self._active.get((writer, control["stream"]))
+                    if ctx is not None:
+                        if control.get("kill"):
+                            ctx.kill()
+                        else:
+                            ctx.stop_generating()
+                elif t == "ping":
+                    async with write_lock:
+                        await codec.write_frame(writer, {"t": "pong"})
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        except ValueError as e:
+            logger.warning("dropping connection speaking a bad protocol: %s", e)
+        finally:
+            for task in tasks.values():
+                task.cancel()
+            for (w, sid), ctx in list(self._active.items()):
+                if w is writer:
+                    ctx.kill()
+                    self._active.pop((w, sid), None)
+            self._connections.discard(writer)
+            writer.close()
+
+    async def _run_stream(
+        self,
+        control: dict,
+        payload: bytes,
+        writer: asyncio.StreamWriter,
+        write_lock: asyncio.Lock,
+    ):
+        stream_id = control["stream"]
+        subject = control.get("subject", "")
+        handler = self._handlers.get(subject)
+        stats = self._stats.get(subject)
+
+        async def send(ctrl: dict, pl: bytes = b""):
+            ctrl["stream"] = stream_id
+            async with write_lock:
+                await codec.write_frame(writer, ctrl, pl)
+
+        if handler is None:
+            await send({"t": "err", "error": f"no such endpoint: {subject}"})
+            return
+
+        ctx = Context(id=control.get("ctx_id"))
+        self._active[(writer, stream_id)] = ctx
+        tp = control.get("traceparent")
+        if tp:
+            parsed = parse_traceparent(tp)
+            if parsed:
+                set_trace(parsed.child())
+        if stats:
+            stats.requests_total += 1
+            stats.requests_active += 1
+        try:
+            request = codec.unpack(payload)
+            async for item in handler(request, ctx):
+                if ctx.is_killed():
+                    break
+                await send({"t": "data"}, codec.pack(item))
+            await send({"t": "done"})
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — stream errors go to the caller
+            logger.exception("handler error on %s", subject)
+            if stats:
+                stats.errors_total += 1
+            try:
+                await send({"t": "err", "error": f"{type(e).__name__}: {e}"})
+            except (ConnectionError, RuntimeError):
+                pass
+        finally:
+            if stats:
+                stats.requests_active -= 1
+            self._active.pop((writer, stream_id), None)
+
+
+class EngineError(RuntimeError):
+    """Terminal error surfaced from a remote engine stream."""
+
+
+class StreamLost(EngineError):
+    """Connection to the worker died mid-stream — the trigger for request
+    migration (reference migration.rs)."""
+
+
+class _Connection:
+    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        self.reader = reader
+        self.writer = writer
+        self.write_lock = asyncio.Lock()
+        self.streams: Dict[int, asyncio.Queue] = {}
+        self.recv_task: Optional[asyncio.Task] = None
+        self.closed = False
+
+    async def recv_loop(self):
+        try:
+            while True:
+                frame = await codec.read_frame(self.reader)
+                if frame is None:
+                    break
+                control, payload = frame
+                q = self.streams.get(control.get("stream"))
+                if q is not None:
+                    q.put_nowait((control, payload))
+        except (ConnectionError, asyncio.CancelledError):
+            pass
+        finally:
+            self.closed = True
+            for q in self.streams.values():
+                q.put_nowait(({"t": "lost"}, b""))
+            self.writer.close()
+
+
+class RequestPlaneClient:
+    """Caller side: pooled connections to worker request-plane servers,
+    many concurrent streams multiplexed per connection
+    (reference AddressedPushRouter addressed_router.rs:52)."""
+
+    def __init__(self):
+        self._conns: Dict[str, _Connection] = {}
+        self._stream_ids = itertools.count(1)
+        self._conn_locks: Dict[str, asyncio.Lock] = {}
+
+    async def _get_conn(self, address: str) -> _Connection:
+        conn = self._conns.get(address)
+        if conn is not None and not conn.closed:
+            return conn
+        lock = self._conn_locks.setdefault(address, asyncio.Lock())
+        async with lock:
+            conn = self._conns.get(address)
+            if conn is not None and not conn.closed:
+                return conn
+            host, _, port = address.rpartition(":")
+            reader, writer = await asyncio.open_connection(host, int(port))
+            conn = _Connection(reader, writer)
+            conn.recv_task = asyncio.create_task(conn.recv_loop())
+            self._conns[address] = conn
+            return conn
+
+    async def close(self):
+        for conn in self._conns.values():
+            if conn.recv_task:
+                conn.recv_task.cancel()
+            conn.writer.close()
+        self._conns.clear()
+
+    async def call(
+        self,
+        address: str,
+        subject: str,
+        request: Any,
+        context: Optional[Context] = None,
+    ) -> AsyncIterator[Any]:
+        """Issue a request; returns the async response stream. Cancelling the
+        context sends a cancel frame to the worker."""
+        ctx = context or Context()
+        try:
+            conn = await self._get_conn(address)
+        except OSError as e:
+            raise StreamLost(f"cannot connect to {address}: {e}") from e
+        stream_id = next(self._stream_ids)
+        queue: asyncio.Queue = asyncio.Queue()
+        conn.streams[stream_id] = queue
+
+        control = {"t": "req", "stream": stream_id, "subject": subject, "ctx_id": ctx.id}
+        trace = current_trace()
+        if trace is not None:
+            control["traceparent"] = trace.traceparent()
+        try:
+            async with conn.write_lock:
+                await codec.write_frame(conn.writer, control, codec.pack(request))
+        except (ConnectionError, OSError) as e:
+            conn.streams.pop(stream_id, None)
+            raise StreamLost(f"send to {address} failed: {e}") from e
+
+        return self._stream(conn, stream_id, queue, ctx)
+
+    async def _stream(
+        self, conn: _Connection, stream_id: int, queue: asyncio.Queue, ctx: Context
+    ) -> AsyncIterator[Any]:
+        cancel_sent = False
+        kill_task = asyncio.create_task(ctx.killed())
+        stop_task = asyncio.create_task(ctx.stopped())
+        get_task: Optional[asyncio.Task] = None
+        try:
+            while True:
+                get_task = asyncio.create_task(queue.get())
+                waiters = {get_task, kill_task}
+                if not cancel_sent:
+                    waiters.add(stop_task)
+                done, _pending = await asyncio.wait(
+                    waiters, return_when=asyncio.FIRST_COMPLETED
+                )
+                if kill_task in done:
+                    await self._send_cancel(conn, stream_id, kill=True)
+                    return
+                if stop_task in done and not cancel_sent:
+                    # graceful stop: tell the worker, then keep draining so the
+                    # engine can emit its final (usage) chunk
+                    cancel_sent = True
+                    await self._send_cancel(conn, stream_id, kill=False)
+                if get_task not in done:
+                    get_task.cancel()
+                    continue
+                control, payload = get_task.result()
+                get_task = None
+                t = control.get("t")
+                if t == "data":
+                    yield codec.unpack(payload)
+                elif t == "done":
+                    return
+                elif t == "err":
+                    raise EngineError(control.get("error", "engine error"))
+                elif t == "lost":
+                    raise StreamLost("connection to worker lost mid-stream")
+        finally:
+            for task in (kill_task, stop_task, get_task):
+                if task is not None:
+                    task.cancel()
+            conn.streams.pop(stream_id, None)
+
+    async def _send_cancel(self, conn: _Connection, stream_id: int, kill: bool):
+        try:
+            async with conn.write_lock:
+                await codec.write_frame(
+                    conn.writer, {"t": "cancel", "stream": stream_id, "kill": kill}
+                )
+        except (ConnectionError, OSError):
+            pass
