@@ -1,0 +1,77 @@
+"""OpenAI frontend: `python -m dynamo_tpu.frontend`.
+
+Mirrors reference components/frontend (main.py) + lib/llm entrypoint
+(input.rs:109 run_input / http.rs): starts (or embeds) the discovery
+service, watches model cards, serves the OpenAI HTTP API with the chosen
+router mode.
+"""
+
+import argparse
+import asyncio
+import logging
+
+from dynamo_tpu.llm.discovery import ModelManager, ModelWatcher
+from dynamo_tpu.llm.http import HttpService
+from dynamo_tpu.runtime import (
+    DistributedRuntime,
+    RouterMode,
+    RuntimeConfig,
+    init_logging,
+)
+
+logger = logging.getLogger("dynamo_tpu.frontend")
+
+
+def parse_args():
+    ap = argparse.ArgumentParser(description="dynamo-tpu OpenAI frontend")
+    ap.add_argument("--http-host", default="0.0.0.0")
+    ap.add_argument("--http-port", type=int, default=8000)
+    ap.add_argument(
+        "--router-mode",
+        choices=["round-robin", "random", "kv"],
+        default="round-robin",
+    )
+    ap.add_argument("--discovery", default=None, help="tcp://host:port of discovery")
+    ap.add_argument(
+        "--embed-discovery",
+        action="store_true",
+        help="host the discovery service inside this process",
+    )
+    ap.add_argument("--kv-overlap-score-weight", type=float, default=1.0)
+    ap.add_argument("--router-temperature", type=float, default=0.0)
+    return ap.parse_args()
+
+
+async def main():
+    init_logging()
+    args = parse_args()
+    cfg = RuntimeConfig.from_settings()
+    if args.discovery:
+        cfg.discovery_endpoint = args.discovery
+    drt = await DistributedRuntime.create(cfg, embed_discovery=args.embed_discovery)
+
+    manager = ModelManager()
+    router_mode = RouterMode(args.router_mode)
+
+    kv_router_factory = None
+    if router_mode == RouterMode.KV:
+        from dynamo_tpu.llm.kv_router import KvRouterConfig, make_kv_router_factory
+
+        kv_router_factory = make_kv_router_factory(
+            KvRouterConfig(
+                overlap_score_weight=args.kv_overlap_score_weight,
+                router_temperature=args.router_temperature,
+            )
+        )
+
+    watcher = ModelWatcher(drt, manager, router_mode, kv_router_factory)
+    await watcher.start()
+
+    service = HttpService(manager, host=args.http_host, port=args.http_port)
+    await service.start()
+    logger.info("frontend ready on :%d (router=%s)", service.port, router_mode.value)
+    await drt.wait_for_shutdown()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

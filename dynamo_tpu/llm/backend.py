@@ -1,0 +1,103 @@
+"""Backend operator: incremental detokenization + stop-condition handling.
+
+Mirrors reference lib/llm/src/backend.rs (Backend :55, Decoder :282): sits
+between the preprocessor and the network/router, turning the engine's token
+stream into text deltas and enforcing stop strings that the engine can't see
+(engines enforce token-level stops; string stops need detok state).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, AsyncIterator, Dict, List, Optional
+
+from ..runtime.engine import AsyncEngine, Context
+from .protocols import Annotated, LLMEngineOutput, PreprocessedRequest
+from .tokenizers import Tokenizer
+
+logger = logging.getLogger(__name__)
+
+
+class Decoder:
+    """Per-request incremental decode state (reference Decoder backend.rs:282)."""
+
+    def __init__(self, tokenizer: Tokenizer, stop_strings: Optional[List[str]] = None):
+        self._stream = tokenizer.decode_stream()
+        self._stop_strings = stop_strings or []
+        self._pending = ""  # text withheld because it may begin a stop string
+
+    def _holdback_len(self, text: str) -> int:
+        """Length of the longest suffix of `text` that is a proper prefix of
+        any stop string (must be withheld until disambiguated)."""
+        best = 0
+        for s in self._stop_strings:
+            for k in range(min(len(s) - 1, len(text)), 0, -1):
+                if text.endswith(s[:k]):
+                    best = max(best, k)
+                    break
+        return best
+
+    def step(self, token_id: int) -> tuple[Optional[str], bool]:
+        """Returns (text_delta, hit_stop_string). On a stop hit, the delta is
+        trimmed up to the stop string start; partial stop-string matches are
+        never leaked."""
+        delta = self._stream.step(token_id)
+        if delta is None:
+            return None, False
+        if not self._stop_strings:
+            return delta, False
+        window = self._pending + delta
+        for s in self._stop_strings:
+            idx = window.find(s)
+            if idx != -1:
+                self._pending = ""
+                return (window[:idx] or None), True
+        hold = self._holdback_len(window)
+        emit = window[: len(window) - hold] if hold else window
+        self._pending = window[len(window) - hold :] if hold else ""
+        return (emit or None), False
+
+
+class Backend:
+    """Wrap a downstream engine (router hop) with detokenization
+    (reference Backend.fwd/bwd backend.rs:55)."""
+
+    def __init__(self, inner: AsyncEngine, tokenizer: Tokenizer):
+        self.inner = inner
+        self.tokenizer = tokenizer
+
+    async def generate(
+        self, request: PreprocessedRequest, context: Context
+    ) -> AsyncIterator[Annotated]:
+        stop_strings = request.stop_conditions.get("stop") or []
+        decoder = Decoder(self.tokenizer, stop_strings)
+        stream = self.inner.generate(request, context)
+        stopped = False
+        async for item in stream:
+            ann = item if isinstance(item, Annotated) else Annotated.from_dict(item)
+            if ann.data is None:
+                yield ann  # pure annotation/error event passes through
+                continue
+            out = (
+                ann.data
+                if isinstance(ann.data, LLMEngineOutput)
+                else LLMEngineOutput.from_dict(ann.data)
+            )
+            text_parts: List[str] = []
+            for tok in out.token_ids:
+                delta, hit = decoder.step(tok)
+                if delta:
+                    text_parts.append(delta)
+                if hit:
+                    stopped = True
+                    break
+            if out.text is None:
+                out.text = "".join(text_parts) if text_parts else None
+            if stopped and out.finish_reason is None:
+                out.finish_reason = "stop"
+            yield Annotated(data=out, id=ann.id, event=ann.event, comment=ann.comment)
+            if stopped:
+                context.stop_generating()
+                return
+            if out.finish_reason is not None:
+                return

@@ -1,0 +1,3 @@
+from .service import HttpService
+
+__all__ = ["HttpService"]

@@ -1,0 +1,88 @@
+"""HTTP frontend Prometheus metrics.
+
+Mirrors reference lib/llm/src/http/service/metrics.rs: request counters,
+in-flight gauge, duration + TTFT + output-token histograms, disconnects —
+labeled by model and endpoint type, exported at /metrics.
+"""
+
+from __future__ import annotations
+
+import time
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
+
+
+class HttpMetrics:
+    def __init__(self, registry: CollectorRegistry | None = None):
+        self.registry = registry or CollectorRegistry()
+        ns = "dynamo_frontend"
+        self.requests_total = Counter(
+            f"{ns}_requests_total",
+            "Total HTTP LLM requests",
+            ["model", "endpoint", "status"],
+            registry=self.registry,
+        )
+        self.inflight = Gauge(
+            f"{ns}_inflight_requests",
+            "Requests currently being processed",
+            ["model", "endpoint"],
+            registry=self.registry,
+        )
+        self.request_duration = Histogram(
+            f"{ns}_request_duration_seconds",
+            "End-to-end request duration",
+            ["model", "endpoint"],
+            registry=self.registry,
+            buckets=(0.05, 0.1, 0.25, 0.5, 1, 2, 4, 8, 16, 32, 64, 128),
+        )
+        self.ttft = Histogram(
+            f"{ns}_time_to_first_token_seconds",
+            "Time to first token",
+            ["model"],
+            registry=self.registry,
+            buckets=(0.01, 0.025, 0.05, 0.1, 0.2, 0.4, 0.8, 1.6, 3.2, 6.4, 12.8),
+        )
+        self.output_tokens = Counter(
+            f"{ns}_output_tokens_total",
+            "Total generated tokens",
+            ["model"],
+            registry=self.registry,
+        )
+        self.disconnects = Counter(
+            f"{ns}_client_disconnects_total",
+            "Client disconnects mid-stream",
+            ["model"],
+            registry=self.registry,
+        )
+
+    def request_start(self, model: str, endpoint: str):
+        self.inflight.labels(model, endpoint).inc()
+
+    def request_end(
+        self,
+        model: str,
+        endpoint: str,
+        t0: float,
+        error: bool = False,
+        output_tokens: int = 0,
+    ):
+        self.inflight.labels(model, endpoint).dec()
+        self.requests_total.labels(model, endpoint, "error" if error else "success").inc()
+        self.request_duration.labels(model, endpoint).observe(time.monotonic() - t0)
+        if output_tokens:
+            self.output_tokens.labels(model).inc(output_tokens)
+
+    def observe_ttft(self, model: str, seconds: float):
+        self.ttft.labels(model).observe(seconds)
+
+    def client_disconnect(self, model: str):
+        self.disconnects.labels(model).inc()
+
+    def render(self) -> bytes:
+        return generate_latest(self.registry)

@@ -1,0 +1,354 @@
+"""OpenAI-compatible HTTP service.
+
+Mirrors reference lib/llm/src/http/service/: route assembly
+(service_v2.rs:319-339), chat/completions handlers (openai.rs), SSE
+streaming with client-disconnect detection (disconnect.rs), Prometheus
+metrics (metrics.rs), and the clear-kv-blocks admin route.
+
+aiohttp replaces axum; a dropped client cancels the pipeline context
+(kill), which propagates over the request plane to the worker.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+from typing import Any, AsyncIterator, Optional
+
+from aiohttp import web
+
+from ...runtime.engine import Context
+from ..discovery import ModelManager
+from ..preprocessor import ChatDeltaGenerator, CompletionDeltaGenerator
+from ..protocols import (
+    Annotated,
+    ChatCompletionRequest,
+    ChatCompletionResponse,
+    ChatMessage,
+    Choice,
+    CompletionChoice,
+    CompletionRequest,
+    CompletionResponse,
+    LLMEngineOutput,
+    ModelInfo,
+    ModelList,
+    Usage,
+)
+from .metrics import HttpMetrics
+
+logger = logging.getLogger(__name__)
+
+
+def _sse(data: str) -> bytes:
+    return f"data: {data}\n\n".encode()
+
+
+class HttpService:
+    """The frontend HTTP server (reference HttpService service_v2.rs)."""
+
+    def __init__(
+        self,
+        manager: ModelManager,
+        host: str = "0.0.0.0",
+        port: int = 8000,
+        enable_responses: bool = True,
+    ):
+        self.manager = manager
+        self.host, self.port = host, port
+        self.metrics = HttpMetrics()
+        self.app = web.Application(client_max_size=64 * 1024 * 1024)
+        self._runner: Optional[web.AppRunner] = None
+        self._setup_routes()
+
+    def _setup_routes(self):
+        # reference route assembly: service_v2.rs:319-339
+        self.app.router.add_post("/v1/chat/completions", self.chat_completions)
+        self.app.router.add_post("/v1/completions", self.completions)
+        self.app.router.add_get("/v1/models", self.list_models)
+        self.app.router.add_get("/health", self.health)
+        self.app.router.add_get("/live", self.live)
+        self.app.router.add_get("/metrics", self.prometheus)
+
+    async def start(self) -> int:
+        self._runner = web.AppRunner(self.app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        for s in site._server.sockets:  # resolve ephemeral port
+            self.port = s.getsockname()[1]
+            break
+        logger.info("HTTP service listening on %s:%d", self.host, self.port)
+        return self.port
+
+    async def stop(self):
+        if self._runner:
+            await self._runner.cleanup()
+
+    # ------------------------------------------------------------------ #
+    # handlers
+    # ------------------------------------------------------------------ #
+
+    async def health(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "healthy", "models": self.manager.names()})
+
+    async def live(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "live"})
+
+    async def prometheus(self, request: web.Request) -> web.Response:
+        return web.Response(
+            body=self.metrics.render(), content_type="text/plain", charset="utf-8"
+        )
+
+    async def list_models(self, request: web.Request) -> web.Response:
+        models = ModelList(data=[ModelInfo(id=name) for name in self.manager.names()])
+        return web.json_response(models.model_dump())
+
+    def _error(self, status: int, message: str, err_type: str = "invalid_request_error"):
+        return web.json_response(
+            {"error": {"message": message, "type": err_type, "code": status}},
+            status=status,
+        )
+
+    async def chat_completions(self, request: web.Request) -> web.StreamResponse:
+        t0 = time.monotonic()
+        try:
+            body = await request.json()
+            req = ChatCompletionRequest.model_validate(body)
+        except Exception as e:  # noqa: BLE001
+            return self._error(400, f"invalid request: {e}")
+        pipeline = self.manager.get(req.model)
+        if pipeline is None:
+            return self._error(404, f"model {req.model!r} not found", "model_not_found")
+        self.metrics.request_start(req.model, "chat")
+        ctx = Context()
+        try:
+            pre = pipeline.preprocessor.preprocess_chat(req)
+        except ValueError as e:
+            self.metrics.request_end(req.model, "chat", t0, error=True)
+            return self._error(400, str(e))
+        gen = ChatDeltaGenerator(
+            req.model,
+            pre.request_id,
+            include_usage=bool(req.stream_options and req.stream_options.include_usage),
+        )
+        gen.prompt_tokens = len(pre.token_ids)
+        stream = pipeline.generate_preprocessed(pre, ctx)
+        try:
+            if req.stream:
+                return await self._stream_chat(request, req, stream, gen, ctx, t0)
+            return await self._unary_chat(req, stream, gen, ctx, t0)
+        finally:
+            ctx.stop_generating()
+
+    async def _stream_chat(
+        self, http_req, req, stream: AsyncIterator[Annotated], gen, ctx: Context, t0
+    ) -> web.StreamResponse:
+        resp = web.StreamResponse(
+            status=200,
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                "Connection": "keep-alive",
+            },
+        )
+        await resp.prepare(http_req)
+        first_token_at: Optional[float] = None
+        error = False
+        try:
+            finish_sent = False
+            async for ann in stream:
+                if ann.is_error():
+                    error = True
+                    msg = (ann.comment or ["engine error"])[0]
+                    await resp.write(_sse(json.dumps({"error": {"message": msg}})))
+                    break
+                if ann.event is not None:
+                    # annotation event (kv-hit-rate etc.): SSE comment line
+                    await resp.write(f": {ann.event} {ann.comment}\n\n".encode())
+                    continue
+                out: LLMEngineOutput = ann.data
+                if first_token_at is None and out.token_ids:
+                    first_token_at = time.monotonic()
+                    self.metrics.observe_ttft(req.model, first_token_at - t0)
+                if out.text:
+                    await resp.write(
+                        _sse(gen.text_chunk(out.text, len(out.token_ids)).model_dump_json(exclude_none=True))
+                    )
+                elif out.token_ids:
+                    gen.completion_tokens += len(out.token_ids)
+                if out.finish_reason:
+                    await resp.write(
+                        _sse(gen.finish_chunk(out.finish_reason).model_dump_json(exclude_none=True))
+                    )
+                    finish_sent = True
+                    break
+            if not error and not finish_sent:
+                await resp.write(_sse(gen.finish_chunk("stop").model_dump_json(exclude_none=True)))
+            if not error and gen.include_usage:
+                await resp.write(_sse(gen.usage_chunk().model_dump_json(exclude_none=True)))
+            await resp.write(b"data: [DONE]\n\n")
+        except (ConnectionResetError, asyncio.CancelledError):
+            # client went away: hard-cancel the pipeline (reference disconnect.rs)
+            ctx.kill()
+            self.metrics.client_disconnect(req.model)
+            raise
+        finally:
+            self.metrics.request_end(
+                req.model, "chat", t0, error=error, output_tokens=gen.completion_tokens
+            )
+        return resp
+
+    async def _unary_chat(
+        self, req, stream: AsyncIterator[Annotated], gen, ctx: Context, t0
+    ) -> web.Response:
+        texts: list[str] = []
+        finish = "stop"
+        n_out = 0
+        error_msg = None
+        first_token_at = None
+        async for ann in stream:
+            if ann.is_error():
+                error_msg = (ann.comment or ["engine error"])[0]
+                break
+            if ann.event is not None:
+                continue
+            out: LLMEngineOutput = ann.data
+            if first_token_at is None and out.token_ids:
+                first_token_at = time.monotonic()
+                self.metrics.observe_ttft(req.model, first_token_at - t0)
+            n_out += len(out.token_ids)
+            if out.text:
+                texts.append(out.text)
+            if out.finish_reason:
+                finish = "stop" if out.finish_reason == "eos" else out.finish_reason
+                break
+        self.metrics.request_end(req.model, "chat", t0, error=bool(error_msg), output_tokens=n_out)
+        if error_msg:
+            return self._error(500, error_msg, "engine_error")
+        response = ChatCompletionResponse(
+            id=gen.id,
+            model=req.model,
+            choices=[
+                Choice(
+                    index=0,
+                    message=ChatMessage(role="assistant", content="".join(texts)),
+                    finish_reason=finish,
+                )
+            ],
+            usage=Usage(
+                prompt_tokens=gen.prompt_tokens,
+                completion_tokens=n_out,
+                total_tokens=gen.prompt_tokens + n_out,
+            ),
+        )
+        return web.json_response(response.model_dump(exclude_none=True))
+
+    async def completions(self, request: web.Request) -> web.StreamResponse:
+        t0 = time.monotonic()
+        try:
+            body = await request.json()
+            req = CompletionRequest.model_validate(body)
+        except Exception as e:  # noqa: BLE001
+            return self._error(400, f"invalid request: {e}")
+        pipeline = self.manager.get(req.model)
+        if pipeline is None:
+            return self._error(404, f"model {req.model!r} not found", "model_not_found")
+        self.metrics.request_start(req.model, "completions")
+        ctx = Context()
+        try:
+            pre = pipeline.preprocessor.preprocess_completion(req)
+        except ValueError as e:
+            self.metrics.request_end(req.model, "completions", t0, error=True)
+            return self._error(400, str(e))
+        gen = CompletionDeltaGenerator(req.model, pre.request_id)
+        gen.prompt_tokens = len(pre.token_ids)
+        stream = pipeline.generate_preprocessed(pre, ctx)
+        try:
+            if req.stream:
+                return await self._stream_completion(request, req, stream, gen, ctx, t0)
+            return await self._unary_completion(req, stream, gen, ctx, t0)
+        finally:
+            ctx.stop_generating()
+
+    async def _stream_completion(
+        self, http_req, req, stream, gen, ctx: Context, t0
+    ) -> web.StreamResponse:
+        resp = web.StreamResponse(
+            status=200, headers={"Content-Type": "text/event-stream"}
+        )
+        await resp.prepare(http_req)
+        error = False
+        first = True
+        try:
+            finish_sent = False
+            async for ann in stream:
+                if ann.is_error():
+                    error = True
+                    msg = (ann.comment or ["engine error"])[0]
+                    await resp.write(_sse(json.dumps({"error": {"message": msg}})))
+                    break
+                if ann.event is not None:
+                    continue
+                out: LLMEngineOutput = ann.data
+                if first and out.token_ids:
+                    first = False
+                    self.metrics.observe_ttft(req.model, time.monotonic() - t0)
+                if out.text:
+                    await resp.write(
+                        _sse(gen.text_chunk(out.text, len(out.token_ids)).model_dump_json(exclude_none=True))
+                    )
+                if out.finish_reason:
+                    await resp.write(
+                        _sse(gen.finish_chunk(out.finish_reason).model_dump_json(exclude_none=True))
+                    )
+                    finish_sent = True
+                    break
+            if not error and not finish_sent:
+                await resp.write(_sse(gen.finish_chunk("stop").model_dump_json(exclude_none=True)))
+            await resp.write(b"data: [DONE]\n\n")
+        except (ConnectionResetError, asyncio.CancelledError):
+            ctx.kill()
+            self.metrics.client_disconnect(req.model)
+            raise
+        finally:
+            self.metrics.request_end(
+                req.model, "completions", t0, error=error, output_tokens=gen.completion_tokens
+            )
+        return resp
+
+    async def _unary_completion(self, req, stream, gen, ctx: Context, t0) -> web.Response:
+        texts: list[str] = []
+        finish = "stop"
+        n_out = 0
+        error_msg = None
+        async for ann in stream:
+            if ann.is_error():
+                error_msg = (ann.comment or ["engine error"])[0]
+                break
+            if ann.event is not None:
+                continue
+            out: LLMEngineOutput = ann.data
+            n_out += len(out.token_ids)
+            if out.text:
+                texts.append(out.text)
+            if out.finish_reason:
+                finish = "stop" if out.finish_reason == "eos" else out.finish_reason
+                break
+        self.metrics.request_end(req.model, "completions", t0, error=bool(error_msg), output_tokens=n_out)
+        if error_msg:
+            return self._error(500, error_msg, "engine_error")
+        response = CompletionResponse(
+            id=gen.id,
+            model=req.model,
+            choices=[
+                CompletionChoice(index=0, text="".join(texts), finish_reason=finish)
+            ],
+            usage=Usage(
+                prompt_tokens=gen.prompt_tokens,
+                completion_tokens=n_out,
+                total_tokens=gen.prompt_tokens + n_out,
+            ),
+        )
+        return web.json_response(response.model_dump(exclude_none=True))

@@ -1,0 +1,87 @@
+"""Request migration: resume in-flight requests on surviving workers.
+
+Mirrors reference lib/llm/src/migration.rs (Migration :26, RetryManager
+:82-158): when a worker dies mid-stream (StreamLost), re-issue the request —
+minus the tokens already produced — to another worker, up to
+`migration_limit` times. The client sees one uninterrupted stream.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, AsyncIterator, Optional
+
+from ..runtime.engine import AsyncEngine, Context
+from ..runtime.request_plane import StreamLost
+from .protocols import Annotated, LLMEngineOutput, PreprocessedRequest
+
+logger = logging.getLogger(__name__)
+
+
+class Migration:
+    """Operator wrapping the network hop with retry-on-stream-death
+    (reference Migration migration.rs:26)."""
+
+    def __init__(self, inner: AsyncEngine, migration_limit: int = 3):
+        self.inner = inner
+        self.migration_limit = migration_limit
+
+    async def generate(
+        self, request: PreprocessedRequest, context: Context
+    ) -> AsyncIterator[Annotated]:
+        manager = RetryManager(self.inner, request, self.migration_limit)
+        async for item in manager.run(context):
+            yield item
+
+
+class RetryManager:
+    """Tracks emitted tokens; on StreamLost builds the retry request with the
+    produced tokens appended to the prompt (reference RetryManager
+    migration.rs:82,99,130)."""
+
+    def __init__(self, engine: AsyncEngine, request: PreprocessedRequest, limit: int):
+        self.engine = engine
+        self.request = request
+        self.retries_left = limit
+        self.emitted_tokens: list[int] = []
+
+    def _retry_request(self) -> PreprocessedRequest:
+        req = PreprocessedRequest.from_dict(self.request.to_dict())
+        req.token_ids = list(self.request.token_ids) + self.emitted_tokens
+        stop = dict(req.stop_conditions)
+        if stop.get("max_tokens") is not None:
+            stop["max_tokens"] = max(1, stop["max_tokens"] - len(self.emitted_tokens))
+        req.stop_conditions = stop
+        return req
+
+    async def run(self, context: Context) -> AsyncIterator[Annotated]:
+        request = self.request
+        while True:
+            try:
+                stream = self.engine.generate(request, context)
+                async for item in stream:
+                    ann = item if isinstance(item, Annotated) else Annotated.from_dict(item)
+                    if ann.data is not None:
+                        data = (
+                            ann.data.to_dict()
+                            if isinstance(ann.data, LLMEngineOutput)
+                            else ann.data
+                        )
+                        self.emitted_tokens.extend(data.get("token_ids", []))
+                    yield ann
+                return
+            except StreamLost as e:
+                if context.is_stopped() or context.is_killed():
+                    return
+                if self.retries_left <= 0:
+                    logger.error("stream lost and migration budget exhausted: %s", e)
+                    yield Annotated.from_error(f"stream lost, migration exhausted: {e}")
+                    return
+                self.retries_left -= 1
+                request = self._retry_request()
+                logger.warning(
+                    "migrating request %s (%d tokens emitted, %d retries left)",
+                    self.request.request_id,
+                    len(self.emitted_tokens),
+                    self.retries_left,
+                )

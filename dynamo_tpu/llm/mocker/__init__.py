@@ -1,0 +1,4 @@
+from .engine import MockEngine, MockEngineArgs
+from .kv_manager import KvManager
+
+__all__ = ["MockEngine", "MockEngineArgs", "KvManager"]

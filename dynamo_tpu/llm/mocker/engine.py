@@ -1,0 +1,276 @@
+"""Mock engine: a fake TPU engine with real scheduling + KV accounting.
+
+Mirrors reference lib/llm/src/mocker/: `MockVllmEngine` (engine.rs:48),
+`Scheduler` (scheduler.rs:240) with continuous batching, chunked prefill,
+prefix caching, and watermark eviction; `MockEngineArgs` (protocols.rs:67).
+
+The mocker emits REAL KV events and realistic timing (scaled by
+`speedup_ratio`), so the KV router, disaggregation flow, migration and
+planner can all be exercised on CPU-only CI (SURVEY.md §4 takeaway).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Callable, Dict, List, Optional
+
+from ...runtime.engine import Context
+from ..protocols import Annotated, LLMEngineOutput, PreprocessedRequest
+from ..tokens import DEFAULT_BLOCK_SIZE, TokenBlockSequence, compute_seq_hashes
+from .kv_manager import KvEvent, KvManager
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class MockEngineArgs:
+    """Reference MockEngineArgs protocols.rs:67."""
+
+    model_name: str = "mock-model"
+    num_gpu_blocks: int = 4096
+    block_size: int = DEFAULT_BLOCK_SIZE
+    max_num_seqs: int = 256
+    max_num_batched_tokens: int = 8192
+    enable_prefix_caching: bool = True
+    enable_chunked_prefill: bool = True
+    speedup_ratio: float = 1.0
+    # synthetic timing model (seconds)
+    prefill_time_per_token: float = 25e-6
+    decode_time_per_step: float = 8e-3
+    decode_time_per_seq: float = 60e-6
+    vocab_size: int = 32000
+
+
+@dataclass
+class _MockRequest:
+    request_id: str
+    prompt: List[int]
+    max_tokens: int
+    eos_token_ids: List[int]
+    ignore_eos: bool
+    queue: asyncio.Queue
+    context: Context
+    seq: TokenBlockSequence = None  # type: ignore[assignment]
+    prefill_pos: int = 0  # tokens prefilled so far
+    generated: int = 0
+    held_hashes: List[int] = field(default_factory=list)
+    done: bool = False
+    decode_only: bool = False  # disagg: KV assumed transferred in
+
+
+class MockEngine:
+    """Continuous-batching mock engine (reference MockVllmEngine engine.rs:48).
+
+    `generate(request, context)` returns an async stream of Annotated
+    LLMEngineOutput; a background step loop does prefill (chunked) and
+    decode with synthetic timing.
+    """
+
+    def __init__(
+        self,
+        args: Optional[MockEngineArgs] = None,
+        event_sink: Optional[Callable[[KvEvent], None]] = None,
+    ):
+        self.args = args or MockEngineArgs()
+        self.kv = KvManager(
+            self.args.num_gpu_blocks, self.args.block_size, event_sink=event_sink
+        )
+        self._waiting: List[_MockRequest] = []
+        self._running: List[_MockRequest] = []
+        self._step_task: Optional[asyncio.Task] = None
+        self._wake = asyncio.Event()
+        self._closed = False
+        self.num_requests = 0
+
+    # -- lifecycle ---------------------------------------------------------- #
+
+    def start(self):
+        if self._step_task is None:
+            self._step_task = asyncio.create_task(self._step_loop())
+
+    async def close(self):
+        self._closed = True
+        self._wake.set()
+        if self._step_task:
+            self._step_task.cancel()
+
+    # -- public engine interface -------------------------------------------- #
+
+    async def generate(
+        self, request: Any, context: Context
+    ) -> AsyncIterator[dict]:
+        self.start()
+        req = (
+            request
+            if isinstance(request, PreprocessedRequest)
+            else PreprocessedRequest.from_dict(request)
+        )
+        stop = req.stop_conditions or {}
+        disagg = req.disagg_params or {}
+        mreq = _MockRequest(
+            request_id=req.request_id or f"mock-{self.num_requests}",
+            prompt=list(req.token_ids),
+            max_tokens=int(stop.get("max_tokens") or 128),
+            eos_token_ids=list(req.eos_token_ids or []),
+            ignore_eos=bool(stop.get("ignore_eos")),
+            queue=asyncio.Queue(),
+            context=context,
+            decode_only=bool(disagg.get("remote_prefill_done")),
+        )
+        mreq.seq = TokenBlockSequence(mreq.prompt, self.args.block_size)
+        self.num_requests += 1
+        self._waiting.append(mreq)
+        self._wake.set()
+        try:
+            while True:
+                item = await mreq.queue.get()
+                if item is None:
+                    return
+                yield item
+        finally:
+            mreq.done = True
+            self._wake.set()
+
+    # -- stats (ForwardPassMetrics role) ------------------------------------ #
+
+    def stats(self) -> dict:
+        return {
+            "num_waiting_reqs": len(self._waiting),
+            "num_running_reqs": len(self._running),
+            "gpu_cache_usage_perc": self.kv.usage_perc(),
+            "kv_active_blocks": self.kv.active_blocks,
+            "kv_total_blocks": self.kv.num_blocks,
+            "request_total_slots": self.args.max_num_seqs,
+        }
+
+    # -- scheduler ---------------------------------------------------------- #
+
+    async def _step_loop(self):
+        """One iteration = admit + chunked prefill + decode all running
+        (reference Scheduler::step scheduler.rs:240)."""
+        while not self._closed:
+            if not self._waiting and not self._running:
+                self._wake.clear()
+                await self._wake.wait()
+                continue
+            t_step0 = time.monotonic()
+            prefill_tokens = self._do_admission_and_prefill()
+            decoded = self._do_decode()
+            # synthetic step latency
+            a = self.args
+            step_time = (
+                prefill_tokens * a.prefill_time_per_token
+                + (a.decode_time_per_step + decoded * a.decode_time_per_seq if decoded else 0.0)
+            ) / max(a.speedup_ratio, 1e-9)
+            elapsed = time.monotonic() - t_step0
+            await asyncio.sleep(max(step_time - elapsed, 0.0001))
+
+    def _do_admission_and_prefill(self) -> int:
+        """Admit waiting requests (prefix-cache aware) and advance chunked
+        prefill; returns prefill tokens processed this step."""
+        a = self.args
+        budget = a.max_num_batched_tokens
+        processed = 0
+        # admit
+        still_waiting: List[_MockRequest] = []
+        for req in self._waiting:
+            if req.done or req.context.is_stopped():
+                self._finish(req, "cancelled", emit=not req.done)
+                continue
+            if len(self._running) >= a.max_num_seqs:
+                still_waiting.append(req)
+                continue
+            hashes = req.seq.block_hashes()
+            if a.enable_prefix_caching:
+                cached = self.kv.cached_prefix_blocks(hashes)
+            else:
+                cached = 0
+            if not self.kv.can_allocate(hashes, extra_blocks=1):
+                still_waiting.append(req)
+                continue
+            token_blocks = [b.tokens for b in req.seq.blocks]
+            self.kv.acquire(hashes, token_blocks=token_blocks)
+            req.held_hashes = list(hashes)
+            req.prefill_pos = cached * a.block_size if not req.decode_only else len(req.prompt)
+            self._running.append(req)
+        self._waiting = still_waiting
+        # chunked prefill over running requests
+        for req in self._running:
+            if req.prefill_pos >= len(req.prompt):
+                continue
+            remaining = len(req.prompt) - req.prefill_pos
+            chunk = min(remaining, budget - processed) if a.enable_chunked_prefill else remaining
+            if chunk <= 0:
+                continue
+            req.prefill_pos += chunk
+            processed += chunk
+        return processed
+
+    def _do_decode(self) -> int:
+        """One decode token for every prefilled running request."""
+        a = self.args
+        decoded = 0
+        finished: List[_MockRequest] = []
+        for req in self._running:
+            if req.done or req.context.is_stopped():
+                finished.append(req)
+                continue
+            if req.prefill_pos < len(req.prompt):
+                continue  # still prefilling
+            token = self._next_token(req)
+            req.seq.append(token)
+            req.generated += 1
+            decoded += 1
+            # block accounting for newly completed generation blocks
+            hashes = req.seq.block_hashes()
+            if len(hashes) > len(req.held_hashes):
+                new = hashes[len(req.held_hashes) :]
+                tokens_new = [b.tokens for b in req.seq.blocks[len(req.held_hashes) :]]
+                self.kv.acquire(
+                    new,
+                    token_blocks=tokens_new,
+                    parent_of_first=req.held_hashes[-1] if req.held_hashes else None,
+                )
+                req.held_hashes.extend(new)
+            finish = None
+            if not req.ignore_eos and token in req.eos_token_ids:
+                finish = "eos"
+            elif req.generated >= req.max_tokens:
+                finish = "length"
+            out = LLMEngineOutput(token_ids=[token], finish_reason=finish).to_dict()
+            req.queue.put_nowait(Annotated(data=out).to_dict())
+            if finish:
+                finished.append(req)
+        for req in finished:
+            self._finish(req, None)
+        return decoded
+
+    def _next_token(self, req: _MockRequest) -> int:
+        """Deterministic pseudo-token stream derived from the prompt. Tokens
+        land in the byte-tokenizer's printable range (ids 35..126 ≈ ASCII)
+        so mock responses detokenize to visible text."""
+        h = hashlib.blake2b(
+            f"{req.request_id}:{req.generated}".encode()
+            + bytes(str(req.prompt[:8]), "ascii"),
+            digest_size=4,
+        ).digest()
+        tok = 35 + int.from_bytes(h, "little") % 92
+        while tok in req.eos_token_ids:
+            tok = 35 + (tok + 1 - 35) % 92
+        return tok
+
+    def _finish(self, req: _MockRequest, reason: Optional[str], emit: bool = True):
+        if req in self._running:
+            self._running.remove(req)
+        if req.held_hashes:
+            self.kv.release(req.held_hashes)
+            req.held_hashes = []
+        if emit and reason and not req.done:
+            out = LLMEngineOutput(token_ids=[], finish_reason=reason).to_dict()
+            req.queue.put_nowait(Annotated(data=out).to_dict())
+        if not req.done:
+            req.queue.put_nowait(None)

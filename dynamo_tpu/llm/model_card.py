@@ -1,0 +1,84 @@
+"""ModelDeploymentCard (MDC) — what a model IS for the serving plane.
+
+Mirrors reference lib/llm/src/model_card.rs:93: name, tokenizer, prompt
+formatter/chat template, context length, kv block size, migration limit,
+runtime config. Cards are published to discovery under `v1/mdc/...` by
+workers (`register_llm`) and watched by frontends (ModelWatcher).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ..runtime.component import MODEL_ROOT, Endpoint
+
+
+class ModelInput:
+    TOKENS = "tokens"  # worker takes PreprocessedRequest (frontend tokenizes)
+    TEXT = "text"  # worker takes raw OpenAI request
+
+
+class ModelType:
+    CHAT = "chat"
+    COMPLETIONS = "completions"
+    EMBEDDINGS = "embeddings"
+    CHAT_AND_COMPLETIONS = "chat+completions"
+
+
+@dataclass
+class ModelDeploymentCard:
+    """Reference model_card.rs:93 — stored as JSON in discovery."""
+
+    name: str
+    tokenizer: str = "byte"  # spec for tokenizers.load_tokenizer
+    model_input: str = ModelInput.TOKENS
+    model_type: str = ModelType.CHAT_AND_COMPLETIONS
+    context_length: int = 8192
+    kv_cache_block_size: int = 64
+    migration_limit: int = 3
+    chat_template: Optional[str] = None  # jinja2 source; None = default
+    runtime_config: Dict[str, Any] = field(default_factory=dict)
+    checksum: Optional[str] = None
+
+    def to_json(self) -> bytes:
+        return json.dumps(self.__dict__).encode()
+
+    @classmethod
+    def from_json(cls, raw: bytes) -> "ModelDeploymentCard":
+        d = json.loads(raw)
+        known = cls.__dataclass_fields__.keys()
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+    def slug(self) -> str:
+        return self.name.replace("/", "--")
+
+
+def mdc_key(endpoint: Endpoint, card: ModelDeploymentCard) -> str:
+    """Discovery key for a card published by an endpoint's worker
+    (reference MODEL_ROOT_PATH v1/mdc/)."""
+    return (
+        f"{MODEL_ROOT}{endpoint.component.namespace}/"
+        f"{endpoint.component.name}/{endpoint.name}/{card.slug()}"
+    )
+
+
+async def register_llm(
+    endpoint: Endpoint,
+    card: ModelDeploymentCard,
+) -> str:
+    """Publish the model card under the worker's primary lease
+    (reference register_llm bindings lib.rs:211). Returns the key."""
+    drt = endpoint.drt
+    key = mdc_key(endpoint, card)
+    payload = dict(json.loads(card.to_json()))
+    payload["endpoint"] = {
+        "namespace": endpoint.component.namespace,
+        "component": endpoint.component.name,
+        "endpoint": endpoint.name,
+        "instance_id": drt.instance_id,
+    }
+    if drt.discovery is not None:
+        await drt.discovery.put(key, json.dumps(payload).encode(), drt.primary_lease)
+    return key

@@ -1,0 +1,266 @@
+"""OpenAI request preprocessing: chat template + tokenize → PreprocessedRequest,
+and the response path assembling OpenAI deltas from engine output.
+
+Mirrors reference lib/llm/src/preprocessor.rs (OpenAIPreprocessor :96,
+preprocess_request :153, apply_template :217) and the DeltaGenerator on the
+backward path. Template rendering uses jinja2 (reference uses minijinja with
+HF chat-template semantics).
+"""
+
+from __future__ import annotations
+
+import logging
+import secrets
+import time
+from typing import Any, AsyncIterator, Dict, List, Optional, Union
+
+import jinja2
+
+from ..runtime.engine import Context
+from .model_card import ModelDeploymentCard
+from .protocols import (
+    Annotated,
+    ChatCompletionChunk,
+    ChatCompletionRequest,
+    ChoiceDelta,
+    CompletionChoice,
+    CompletionChunk,
+    CompletionRequest,
+    PreprocessedRequest,
+    Usage,
+)
+from .protocols.openai import StreamChoice
+from .tokenizers import Tokenizer
+
+logger = logging.getLogger(__name__)
+
+# Default template: ChatML-style, the shape most instruct models use.
+DEFAULT_CHAT_TEMPLATE = """\
+{%- for message in messages -%}
+<|im_start|>{{ message.role }}
+{{ message.content }}<|im_end|>
+{% endfor -%}
+{%- if add_generation_prompt -%}
+<|im_start|>assistant
+{% endif -%}"""
+
+
+def _content_to_text(content: Union[str, List[Dict[str, Any]], None]) -> str:
+    if content is None:
+        return ""
+    if isinstance(content, str):
+        return content
+    # multimodal content parts: concatenate text parts
+    return "".join(
+        part.get("text", "") for part in content if part.get("type") == "text"
+    )
+
+
+class OpenAIPreprocessor:
+    """Forward: OpenAI request → PreprocessedRequest (template+tokenize).
+    Backward: engine outputs → OpenAI SSE chunks (reference preprocessor.rs:96)."""
+
+    def __init__(self, card: ModelDeploymentCard, tokenizer: Tokenizer):
+        self.card = card
+        self.tokenizer = tokenizer
+        env = jinja2.Environment(keep_trailing_newline=True)
+        env.globals["raise_exception"] = self._raise_template_error
+        self._template = env.from_string(card.chat_template or DEFAULT_CHAT_TEMPLATE)
+
+    @staticmethod
+    def _raise_template_error(msg: str):
+        raise jinja2.TemplateError(msg)
+
+    # ------------------------------------------------------------------ #
+    # forward path
+    # ------------------------------------------------------------------ #
+
+    def apply_template(self, request: ChatCompletionRequest) -> str:
+        """Render the chat template (reference apply_template :217)."""
+        messages = [
+            {
+                "role": m.role,
+                "content": _content_to_text(m.content),
+                **({"name": m.name} if m.name else {}),
+            }
+            for m in request.messages
+        ]
+        args = dict(request.chat_template_args or {})
+        args.setdefault("add_generation_prompt", True)
+        return self._template.render(
+            messages=messages, tools=request.tools, **args
+        )
+
+    def preprocess_chat(self, request: ChatCompletionRequest) -> PreprocessedRequest:
+        prompt = self.apply_template(request)
+        token_ids = self.tokenizer.encode(prompt)
+        return self._build_common(request, token_ids)
+
+    def preprocess_completion(self, request: CompletionRequest) -> PreprocessedRequest:
+        prompt = request.prompt
+        if isinstance(prompt, str):
+            token_ids = self.tokenizer.encode(prompt)
+        elif prompt and isinstance(prompt[0], int):
+            token_ids = list(prompt)  # pre-tokenized
+        else:
+            raise ValueError("batch prompts must be fanned out before preprocessing")
+        return self._build_common(request, token_ids)
+
+    def _build_common(self, request, token_ids: List[int]) -> PreprocessedRequest:
+        """Apply sampling defaults + stop conditions (reference
+        preprocess_request :153)."""
+        if len(token_ids) >= self.card.context_length:
+            raise ValueError(
+                f"prompt ({len(token_ids)} tokens) exceeds the model context "
+                f"length ({self.card.context_length})"
+            )
+        stop = request.stop
+        if isinstance(stop, str):
+            stop = [stop]
+        max_tokens = getattr(request, "max_completion_tokens", None) or request.max_tokens
+        if max_tokens is None:
+            max_tokens = self.card.context_length - len(token_ids)
+        max_tokens = min(max_tokens, self.card.context_length - len(token_ids))
+
+        sampling: Dict[str, Any] = {}
+        for key in (
+            "temperature",
+            "top_p",
+            "top_k",
+            "frequency_penalty",
+            "presence_penalty",
+            "repetition_penalty",
+            "seed",
+            "n",
+        ):
+            v = getattr(request, key, None)
+            if v is not None:
+                sampling[key] = v
+        nvext = getattr(request, "nvext", None)
+        ignore_eos = bool(nvext.ignore_eos) if nvext and nvext.ignore_eos else False
+        annotations = list(nvext.annotations) if nvext and nvext.annotations else []
+        router = dict(nvext.router_config_override) if nvext and nvext.router_config_override else {}
+
+        stop_conditions: Dict[str, Any] = {"max_tokens": max_tokens}
+        if stop:
+            stop_conditions["stop"] = stop
+        if getattr(request, "min_tokens", None):
+            stop_conditions["min_tokens"] = request.min_tokens
+        if ignore_eos:
+            stop_conditions["ignore_eos"] = True
+
+        return PreprocessedRequest(
+            token_ids=token_ids,
+            model=request.model,
+            sampling_options=sampling,
+            stop_conditions=stop_conditions,
+            eos_token_ids=list(self.tokenizer.eos_token_ids),
+            annotations=annotations,
+            router=router,
+            request_id=secrets.token_hex(8),
+        )
+
+
+# ---------------------------------------------------------------------- #
+# backward path — delta generators
+# ---------------------------------------------------------------------- #
+
+
+class ChatDeltaGenerator:
+    """Assemble OpenAI chat.completion.chunk SSE events from detokenized
+    engine deltas (reference DeltaGenerator protocols/openai/chat_completions/
+    delta.rs)."""
+
+    def __init__(self, model: str, request_id: Optional[str] = None, include_usage: bool = True):
+        self.id = f"chatcmpl-{request_id or secrets.token_hex(12)}"
+        self.model = model
+        self.created = int(time.time())
+        self.include_usage = include_usage
+        self.prompt_tokens = 0
+        self.completion_tokens = 0
+        self._first = True
+
+    def role_chunk(self) -> ChatCompletionChunk:
+        return ChatCompletionChunk(
+            id=self.id,
+            model=self.model,
+            created=self.created,
+            choices=[StreamChoice(index=0, delta=ChoiceDelta(role="assistant", content=""))],
+        )
+
+    def text_chunk(self, text: str, n_tokens: int = 1) -> ChatCompletionChunk:
+        self.completion_tokens += n_tokens
+        delta = ChoiceDelta(content=text)
+        if self._first:
+            delta.role = "assistant"
+            self._first = False
+        return ChatCompletionChunk(
+            id=self.id,
+            model=self.model,
+            created=self.created,
+            choices=[StreamChoice(index=0, delta=delta)],
+        )
+
+    def finish_chunk(self, reason: str) -> ChatCompletionChunk:
+        reason = "stop" if reason == "eos" else reason
+        return ChatCompletionChunk(
+            id=self.id,
+            model=self.model,
+            created=self.created,
+            choices=[StreamChoice(index=0, delta=ChoiceDelta(), finish_reason=reason)],
+        )
+
+    def usage_chunk(self) -> ChatCompletionChunk:
+        return ChatCompletionChunk(
+            id=self.id,
+            model=self.model,
+            created=self.created,
+            choices=[],
+            usage=Usage(
+                prompt_tokens=self.prompt_tokens,
+                completion_tokens=self.completion_tokens,
+                total_tokens=self.prompt_tokens + self.completion_tokens,
+            ),
+        )
+
+
+class CompletionDeltaGenerator:
+    """text_completion chunks (reference completions delta path)."""
+
+    def __init__(self, model: str, request_id: Optional[str] = None):
+        self.id = f"cmpl-{request_id or secrets.token_hex(12)}"
+        self.model = model
+        self.created = int(time.time())
+        self.prompt_tokens = 0
+        self.completion_tokens = 0
+
+    def text_chunk(self, text: str, n_tokens: int = 1) -> CompletionChunk:
+        self.completion_tokens += n_tokens
+        return CompletionChunk(
+            id=self.id,
+            model=self.model,
+            created=self.created,
+            choices=[CompletionChoice(index=0, text=text)],
+        )
+
+    def finish_chunk(self, reason: str) -> CompletionChunk:
+        reason = "stop" if reason == "eos" else reason
+        return CompletionChunk(
+            id=self.id,
+            model=self.model,
+            created=self.created,
+            choices=[CompletionChoice(index=0, text="", finish_reason=reason)],
+        )
+
+    def usage_chunk(self) -> CompletionChunk:
+        return CompletionChunk(
+            id=self.id,
+            model=self.model,
+            created=self.created,
+            choices=[],
+            usage=Usage(
+                prompt_tokens=self.prompt_tokens,
+                completion_tokens=self.completion_tokens,
+                total_tokens=self.prompt_tokens + self.completion_tokens,
+            ),
+        )

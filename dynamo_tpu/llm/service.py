@@ -1,0 +1,88 @@
+"""Serving-pipeline assembly.
+
+Mirrors reference lib/llm/src/entrypoint/input/common.rs:259-310
+(build_routed_pipeline): the canonical chain
+
+    OpenAIPreprocessor.fwd → Backend.fwd → Migration.fwd →
+      ServiceBackend(PushRouter | KvPushRouter)   [network hop]
+    → Migration.bwd → Backend.bwd → Preprocessor.bwd → frontend
+
+Here each operator is an AsyncEngine wrapping the next, so forward+backward
+are one async-generator pass.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, AsyncIterator, Optional
+
+from ..runtime.component import Client
+from ..runtime.engine import AsyncEngine, Context
+from ..runtime.push_router import PushRouter, RouterMode
+from .backend import Backend
+from .migration import Migration
+from .model_card import ModelDeploymentCard
+from .preprocessor import OpenAIPreprocessor
+from .protocols import Annotated, PreprocessedRequest
+from .tokenizers import Tokenizer, load_tokenizer
+
+logger = logging.getLogger(__name__)
+
+
+class ServiceBackend:
+    """The network hop: adapt a PushRouter (or KvPushRouter) into an
+    AsyncEngine over PreprocessedRequest dicts
+    (reference ServiceBackend in pipeline nodes)."""
+
+    def __init__(self, router):
+        self.router = router
+
+    async def generate(
+        self, request: PreprocessedRequest, context: Context
+    ) -> AsyncIterator[Any]:
+        payload = request.to_dict() if isinstance(request, PreprocessedRequest) else request
+        stream = await self.router.generate(payload, context)
+        async for item in stream:
+            yield item
+
+
+class ModelPipeline:
+    """A ready-to-serve model: preprocessor + backend + migration + router.
+    Entry points: chat / completion streaming generators consumed by the
+    HTTP service."""
+
+    def __init__(
+        self,
+        card: ModelDeploymentCard,
+        tokenizer: Tokenizer,
+        engine: AsyncEngine,
+    ):
+        self.card = card
+        self.tokenizer = tokenizer
+        self.preprocessor = OpenAIPreprocessor(card, tokenizer)
+        self.engine = engine  # Backend(Migration(ServiceBackend(router)))
+
+    def generate_preprocessed(
+        self, request: PreprocessedRequest, context: Context
+    ) -> AsyncIterator[Annotated]:
+        return self.engine.generate(request, context)
+
+
+def build_routed_pipeline(
+    card: ModelDeploymentCard,
+    client: Client,
+    router_mode: RouterMode = RouterMode.ROUND_ROBIN,
+    kv_router=None,
+    busy_threshold: Optional[float] = None,
+) -> ModelPipeline:
+    """Assemble the canonical chain for one model
+    (reference common.rs:259-310)."""
+    tokenizer = load_tokenizer(card.tokenizer)
+    if router_mode == RouterMode.KV and kv_router is not None:
+        router = kv_router
+    else:
+        router = PushRouter(client, router_mode)
+    service = ServiceBackend(router)
+    migration = Migration(service, migration_limit=card.migration_limit)
+    backend = Backend(migration, tokenizer)
+    return ModelPipeline(card, tokenizer, backend)

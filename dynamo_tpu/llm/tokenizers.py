@@ -1,0 +1,173 @@
+"""Tokenizer abstraction with incremental decode.
+
+Mirrors reference lib/llm/src/tokenizers.rs: a `Tokenizer` trait with
+encode/decode plus a `DecodeStream` for incremental, UTF-8-safe streaming
+detokenization (the reference wraps HF `tokenizers`' DecodeStream).
+
+Backends:
+  * HfTokenizer — HF `tokenizers` json file (tokenizer.json)
+  * ByteTokenizer — self-contained byte-level tokenizer (id = byte + offset)
+    with BOS/EOS/PAD specials; used for tests and weight-free benchmarks
+    (this image has no HF hub access).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import List, Optional, Protocol, Sequence
+
+
+class Tokenizer(Protocol):
+    def encode(self, text: str) -> List[int]: ...
+    def decode(self, ids: Sequence[int], skip_special_tokens: bool = True) -> str: ...
+    def decode_stream(self, skip_special_tokens: bool = True) -> "DecodeStream": ...
+    @property
+    def vocab_size(self) -> int: ...
+    @property
+    def eos_token_ids(self) -> List[int]: ...
+    @property
+    def bos_token_id(self) -> Optional[int]: ...
+
+
+class DecodeStream:
+    """Incremental detokenizer: feed token ids one at a time, get text deltas
+    that are valid UTF-8 and stable (reference DecodeStream in tokenizers.rs).
+
+    Implementation: keep a window of undecoded ids; a delta is emitted when
+    decoding the window extends the previously yielded text and ends outside
+    a UTF-8 replacement char (pending multi-byte sequences stay buffered).
+    """
+
+    def __init__(self, tokenizer: "Tokenizer", skip_special_tokens: bool = True):
+        self._tok = tokenizer
+        self._skip = skip_special_tokens
+        self._ids: List[int] = []
+        self._prefix_text = ""
+        self._prefix_index = 0
+
+    def step(self, token_id: int) -> Optional[str]:
+        self._ids.append(token_id)
+        text = self._tok.decode(self._ids[self._prefix_index :], self._skip)
+        if text.endswith("�"):
+            return None  # mid multi-byte sequence; wait for more tokens
+        if len(text) <= len(self._prefix_text):
+            # no new visible text yet (e.g. special token skipped)
+            if text == self._prefix_text:
+                return None
+        delta = text[len(self._prefix_text) :]
+        # slide the window at whitespace boundaries to bound cost
+        if len(self._ids) - self._prefix_index > 16 and delta:
+            self._prefix_index = len(self._ids)
+            self._prefix_text = ""
+        else:
+            self._prefix_text = text
+        return delta or None
+
+
+class ByteTokenizer:
+    """Byte-level tokenizer: token id = byte value + 3 specials.
+
+    ids: 0=PAD, 1=BOS, 2=EOS, 3..258 = bytes 0..255. Deterministic, needs no
+    assets; round-trips arbitrary UTF-8. Vocab padded to 32000 by default so
+    model shapes look realistic.
+    """
+
+    PAD, BOS, EOS = 0, 1, 2
+    OFFSET = 3
+
+    def __init__(self, vocab_size: int = 32000):
+        self._vocab_size = max(vocab_size, 256 + self.OFFSET)
+
+    def encode(self, text: str) -> List[int]:
+        return [b + self.OFFSET for b in text.encode("utf-8")]
+
+    def decode(self, ids: Sequence[int], skip_special_tokens: bool = True) -> str:
+        data = bytes(
+            i - self.OFFSET
+            for i in ids
+            if self.OFFSET <= i < self.OFFSET + 256
+        )
+        return data.decode("utf-8", errors="replace")
+
+    def decode_stream(self, skip_special_tokens: bool = True) -> DecodeStream:
+        return DecodeStream(self, skip_special_tokens)
+
+    @property
+    def vocab_size(self) -> int:
+        return self._vocab_size
+
+    @property
+    def eos_token_ids(self) -> List[int]:
+        return [self.EOS]
+
+    @property
+    def bos_token_id(self) -> Optional[int]:
+        return self.BOS
+
+
+class HfTokenizer:
+    """HF `tokenizers`-backed tokenizer loaded from a tokenizer.json
+    (reference tokenizers/hf.rs)."""
+
+    def __init__(self, path: str):
+        from tokenizers import Tokenizer as _HfTok
+
+        self._tok = _HfTok.from_file(path)
+        self._eos_ids = self._find_eos(path)
+
+    def _find_eos(self, path: str) -> List[int]:
+        # check sibling config files for eos ids (generation_config/config.json)
+        eos: List[int] = []
+        folder = Path(path).parent
+        for name in ("generation_config.json", "config.json"):
+            p = folder / name
+            if p.exists():
+                try:
+                    cfg = json.loads(p.read_text())
+                except json.JSONDecodeError:
+                    continue
+                v = cfg.get("eos_token_id")
+                if isinstance(v, int):
+                    eos.append(v)
+                elif isinstance(v, list):
+                    eos.extend(int(x) for x in v)
+                if eos:
+                    break
+        return eos
+
+    def encode(self, text: str) -> List[int]:
+        return self._tok.encode(text, add_special_tokens=False).ids
+
+    def decode(self, ids: Sequence[int], skip_special_tokens: bool = True) -> str:
+        return self._tok.decode(list(ids), skip_special_tokens=skip_special_tokens)
+
+    def decode_stream(self, skip_special_tokens: bool = True) -> DecodeStream:
+        return DecodeStream(self, skip_special_tokens)
+
+    @property
+    def vocab_size(self) -> int:
+        return self._tok.get_vocab_size()
+
+    @property
+    def eos_token_ids(self) -> List[int]:
+        return list(self._eos_ids)
+
+    @property
+    def bos_token_id(self) -> Optional[int]:
+        return None
+
+
+def load_tokenizer(spec: str) -> Tokenizer:
+    """Resolve a tokenizer spec: 'byte' | 'byte:<vocab>' | path to
+    tokenizer.json | model folder containing one."""
+    if spec == "byte":
+        return ByteTokenizer()
+    if spec.startswith("byte:"):
+        return ByteTokenizer(int(spec.split(":", 1)[1]))
+    p = Path(spec)
+    if p.is_dir():
+        p = p / "tokenizer.json"
+    if p.exists():
+        return HfTokenizer(str(p))
+    raise FileNotFoundError(f"no tokenizer at {spec!r} (use 'byte' for the builtin)")

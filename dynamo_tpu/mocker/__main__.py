@@ -1,0 +1,94 @@
+"""Mocker worker: `python -m dynamo_tpu.mocker` — a fake engine worker.
+
+Mirrors reference components/backends/mocker (main.py): registers a model
+card + generate endpoint backed by the block-accounting MockEngine, so
+multi-worker routing/disagg/migration can run without TPUs.
+"""
+
+import argparse
+import asyncio
+import logging
+
+from dynamo_tpu.llm.mocker import MockEngine, MockEngineArgs
+from dynamo_tpu.llm.model_card import ModelDeploymentCard, register_llm
+from dynamo_tpu.runtime import DistributedRuntime, RuntimeConfig, init_logging
+
+logger = logging.getLogger("dynamo_tpu.mocker")
+
+
+def parse_args():
+    ap = argparse.ArgumentParser(description="dynamo-tpu mocker worker")
+    ap.add_argument("--model-name", default="mock-model")
+    ap.add_argument("--namespace", default="dynamo")
+    ap.add_argument("--component", default="mocker")
+    ap.add_argument("--endpoint", default="generate")
+    ap.add_argument("--discovery", default=None, help="tcp://host:port of discovery")
+    ap.add_argument("--block-size", type=int, default=64)
+    ap.add_argument("--num-gpu-blocks", type=int, default=4096)
+    ap.add_argument("--max-num-seqs", type=int, default=256)
+    ap.add_argument("--max-num-batched-tokens", type=int, default=8192)
+    ap.add_argument("--speedup-ratio", type=float, default=10.0)
+    ap.add_argument("--no-prefix-caching", action="store_true")
+    ap.add_argument("--migration-limit", type=int, default=3)
+    ap.add_argument("--kv-events", action="store_true", help="publish KV events")
+    return ap.parse_args()
+
+
+async def main():
+    init_logging()
+    args = parse_args()
+    cfg = RuntimeConfig.from_settings()
+    if args.discovery:
+        cfg.discovery_endpoint = args.discovery
+    drt = await DistributedRuntime.create(cfg)
+
+    engine_args = MockEngineArgs(
+        model_name=args.model_name,
+        num_gpu_blocks=args.num_gpu_blocks,
+        block_size=args.block_size,
+        max_num_seqs=args.max_num_seqs,
+        max_num_batched_tokens=args.max_num_batched_tokens,
+        enable_prefix_caching=not args.no_prefix_caching,
+        speedup_ratio=args.speedup_ratio,
+    )
+
+    endpoint = (
+        drt.namespace(args.namespace).component(args.component).endpoint(args.endpoint)
+    )
+
+    publisher = None
+    if args.kv_events:
+        from dynamo_tpu.llm.kv_router.publisher import KvEventPublisher
+
+        publisher = KvEventPublisher(drt, endpoint, drt.instance_id)
+        await publisher.start()
+
+    engine = MockEngine(
+        engine_args, event_sink=publisher.publish_threadsafe if publisher else None
+    )
+
+    card = ModelDeploymentCard(
+        name=args.model_name,
+        tokenizer="byte",
+        kv_cache_block_size=args.block_size,
+        migration_limit=args.migration_limit,
+    )
+    await register_llm(endpoint, card)
+
+    # metrics publishing for the KV router's scheduler
+    async def stats_loop():
+        while True:
+            stats = drt.server.stats(endpoint.subject)
+            if stats is not None:
+                stats.data = engine.stats()
+            await asyncio.sleep(0.5)
+
+    asyncio.create_task(stats_loop())
+
+    logger.info("mocker worker up: model=%s instance=%x", args.model_name, drt.instance_id)
+    await endpoint.serve_endpoint(engine.generate)
+    await drt.wait_for_shutdown()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,210 @@
+"""Tests for the LLM pipeline pieces: hashing, detok, preprocessor,
+stop strings, migration (mirrors reference migration.rs test cases)."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.llm.backend import Backend, Decoder
+from dynamo_tpu.llm.migration import Migration
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+from dynamo_tpu.llm.protocols import (
+    Annotated,
+    ChatCompletionRequest,
+    ChatMessage,
+    CompletionRequest,
+    LLMEngineOutput,
+    PreprocessedRequest,
+)
+from dynamo_tpu.llm.tokenizers import ByteTokenizer
+from dynamo_tpu.llm.tokens import (
+    TokenBlockSequence,
+    compute_block_hash,
+    compute_seq_hashes,
+)
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.request_plane import StreamLost
+
+
+def test_block_hash_chaining():
+    toks = list(range(256))
+    h4 = compute_seq_hashes(toks, block_size=64)
+    assert len(h4) == 4
+    # chained: changing an early token changes all subsequent hashes
+    toks2 = [999] + toks[1:]
+    h4b = compute_seq_hashes(toks2, block_size=64)
+    assert h4[0] != h4b[0] and h4[3] != h4b[3]
+    # same prefix -> same hashes
+    assert compute_seq_hashes(toks[:128], block_size=64) == h4[:2]
+    # partial block not hashed
+    assert len(compute_seq_hashes(toks[:100], block_size=64)) == 1
+
+
+def test_token_block_sequence_incremental():
+    seq = TokenBlockSequence(block_size=4)
+    for t in range(10):
+        seq.append(t)
+    assert len(seq.blocks) == 2
+    assert seq.partial_tokens == [8, 9]
+    assert seq.block_hashes() == compute_seq_hashes(list(range(10)), block_size=4)
+    assert len(seq) == 10
+    seq.truncate(5)
+    assert len(seq) == 5 and len(seq.blocks) == 1
+
+
+def test_byte_tokenizer_roundtrip_and_stream():
+    tok = ByteTokenizer()
+    text = "héllo wörld — 日本語!"
+    ids = tok.encode(text)
+    assert tok.decode(ids) == text
+    # incremental decode yields the same text, with multi-byte chars held back
+    stream = tok.decode_stream()
+    out = ""
+    for i in ids:
+        delta = stream.step(i)
+        if delta:
+            out += delta
+    assert out == text
+
+
+def test_preprocessor_chat_template_and_limits():
+    card = ModelDeploymentCard(name="m", tokenizer="byte", context_length=128)
+    tok = ByteTokenizer()
+    pre = OpenAIPreprocessor(card, tok)
+    req = ChatCompletionRequest(
+        model="m",
+        messages=[
+            ChatMessage(role="system", content="be brief"),
+            ChatMessage(role="user", content="hi"),
+        ],
+        max_tokens=10,
+        temperature=0.5,
+        stop=["END"],
+    )
+    out = pre.preprocess_chat(req)
+    rendered = pre.apply_template(req)
+    assert "be brief" in rendered and rendered.rstrip().endswith("<|im_start|>assistant")
+    assert out.token_ids == tok.encode(rendered)
+    assert out.stop_conditions["max_tokens"] == 10
+    assert out.stop_conditions["stop"] == ["END"]
+    assert out.sampling_options["temperature"] == 0.5
+    assert out.eos_token_ids == [ByteTokenizer.EOS]
+
+    # context overflow -> ValueError
+    big = ChatCompletionRequest(
+        model="m", messages=[ChatMessage(role="user", content="x" * 500)]
+    )
+    with pytest.raises(ValueError):
+        pre.preprocess_chat(big)
+
+    # completion with pre-tokenized prompt
+    creq = CompletionRequest(model="m", prompt=[5, 6, 7], max_tokens=3)
+    cout = pre.preprocess_completion(creq)
+    assert cout.token_ids == [5, 6, 7]
+
+
+def test_decoder_stop_strings():
+    tok = ByteTokenizer()
+    dec = Decoder(tok, stop_strings=["STOP"])
+    text = "hello STOP world"
+    emitted = ""
+    hit = False
+    for i in tok.encode(text):
+        delta, h = dec.step(i)
+        if delta:
+            emitted += delta
+        if h:
+            hit = True
+            break
+    assert hit
+    assert "STOP" not in emitted
+    assert emitted.startswith("hello")
+
+
+class _ScriptedEngine:
+    """Engine that emits n tokens then dies with StreamLost, a set number of
+    times (reference MockMigrationEngine migration.rs:242)."""
+
+    def __init__(self, tokens_before_death: list, vocab_offset: int = 100):
+        self.plan = tokens_before_death  # e.g. [3, 2, None] -> die@3, die@2, complete
+        self.call = 0
+        self.requests: list = []
+
+    async def generate(self, request, context):
+        self.requests.append(request)
+        plan = self.plan[self.call]
+        self.call += 1
+        start = len(request.token_ids)
+        if plan is None:
+            for i in range(5):
+                yield Annotated(
+                    data=LLMEngineOutput(
+                        token_ids=[start + i],
+                        finish_reason="length" if i == 4 else None,
+                    ).to_dict()
+                ).to_dict()
+            return
+        for i in range(plan):
+            yield Annotated(data=LLMEngineOutput(token_ids=[start + i]).to_dict()).to_dict()
+        raise StreamLost("scripted death")
+
+
+def test_migration_resumes_with_emitted_tokens():
+    async def main():
+        eng = _ScriptedEngine([2, None])
+        mig = Migration(eng, migration_limit=3)
+        req = PreprocessedRequest(token_ids=[1, 2, 3], stop_conditions={"max_tokens": 10})
+        ctx = Context()
+        outs = []
+        async for ann in mig.generate(req, ctx):
+            if ann.data:
+                outs.extend(ann.data["token_ids"])
+        # first attempt: prompt len 3 -> tokens 3,4 then death
+        # retry: prompt = [1,2,3,3,4] len 5 -> tokens 5..9
+        assert outs == [3, 4, 5, 6, 7, 8, 9]
+        assert eng.requests[1].token_ids == [1, 2, 3, 3, 4]
+        assert eng.requests[1].stop_conditions["max_tokens"] == 8
+        assert eng.call == 2
+
+    asyncio.run(main())
+
+
+def test_migration_exhaustion_yields_error():
+    async def main():
+        eng = _ScriptedEngine([1, 1, 1])
+        mig = Migration(eng, migration_limit=2)
+        req = PreprocessedRequest(token_ids=[1], stop_conditions={"max_tokens": 10})
+        events = []
+        async for ann in mig.generate(req, Context()):
+            events.append(ann)
+        assert events[-1].is_error()
+        assert eng.call == 3  # initial + 2 retries
+
+    asyncio.run(main())
+
+
+def test_backend_detokenizes_and_enforces_stop():
+    async def main():
+        tok = ByteTokenizer()
+
+        class TextEngine:
+            async def generate(self, request, context):
+                for t in tok.encode("abcSTOPdef"):
+                    yield Annotated(data=LLMEngineOutput(token_ids=[t]).to_dict()).to_dict()
+
+        backend = Backend(TextEngine(), tok)
+        req = PreprocessedRequest(token_ids=[1], stop_conditions={"stop": ["STOP"]})
+        ctx = Context()
+        text = ""
+        finish = None
+        async for ann in backend.generate(req, ctx):
+            if ann.data and ann.data.text:
+                text += ann.data.text
+            if ann.data and ann.data.finish_reason:
+                finish = ann.data.finish_reason
+        assert text == "abc"
+        assert finish == "stop"
+        assert ctx.is_stopped()
+
+    asyncio.run(main())

@@ -1,0 +1,130 @@
+"""Mock engine tests: block accounting, prefix caching, eviction, scheduling
+(mirrors reference mocker/kv_manager.rs:298-430 test coverage)."""
+
+import asyncio
+
+from dynamo_tpu.llm.mocker import KvManager, MockEngine, MockEngineArgs
+from dynamo_tpu.llm.mocker.kv_manager import KvEvent
+from dynamo_tpu.llm.protocols import PreprocessedRequest
+from dynamo_tpu.llm.tokens import compute_seq_hashes
+from dynamo_tpu.runtime.engine import Context
+
+
+def test_kv_manager_acquire_release_evict():
+    events = []
+    kv = KvManager(num_blocks=4, block_size=4, event_sink=events.append)
+    h1 = compute_seq_hashes([1, 2, 3, 4, 5, 6, 7, 8], block_size=4)  # 2 blocks
+    assert kv.acquire(h1)
+    assert kv.used_blocks == 2 and kv.active_blocks == 2
+    assert events[0].event_type == "stored" and events[0].block_hashes == h1
+
+    # same prefix -> no new blocks stored
+    h2 = compute_seq_hashes([1, 2, 3, 4], block_size=4)
+    assert kv.acquire(h2)
+    assert kv.used_blocks == 2
+    assert len([e for e in events if e.event_type == "stored"]) == 1
+
+    kv.release(h1)
+    kv.release(h2)
+    assert kv.active_blocks == 0
+    assert kv.used_blocks == 2  # cached, not evicted
+    assert kv.cached_prefix_blocks(h1) == 2
+
+    # fill beyond capacity -> LRU eviction of the cached blocks
+    h3 = compute_seq_hashes(list(range(100, 116)), block_size=4)  # 4 blocks
+    assert kv.acquire(h3)
+    assert kv.used_blocks == 4
+    removed = [e for e in events if e.event_type == "removed"]
+    assert len(removed) == 2  # both old cached blocks evicted
+    assert kv.cached_prefix_blocks(h1) == 0
+
+
+def test_kv_manager_rejects_over_capacity():
+    kv = KvManager(num_blocks=2, block_size=4)
+    h = compute_seq_hashes(list(range(12)), block_size=4)  # 3 blocks
+    assert not kv.acquire(h)
+    assert kv.used_blocks == 0
+
+
+def _req(tokens, max_tokens=8, rid="r0"):
+    return PreprocessedRequest(
+        token_ids=tokens,
+        stop_conditions={"max_tokens": max_tokens},
+        eos_token_ids=[2],
+        request_id=rid,
+    ).to_dict()
+
+
+def test_mock_engine_generates_and_reuses_prefix():
+    async def main():
+        events = []
+        args = MockEngineArgs(
+            num_gpu_blocks=64,
+            block_size=4,
+            speedup_ratio=1000.0,
+        )
+        eng = MockEngine(args, event_sink=events.append)
+        ctx = Context()
+        prompt = list(range(10, 26))  # 4 full blocks
+
+        toks = []
+        async for item in eng.generate(_req(prompt, 6, "a"), ctx):
+            data = item.get("data")
+            if data:
+                toks.extend(data["token_ids"])
+        assert len(toks) == 6
+        stored = [e for e in events if e.event_type == "stored"]
+        assert stored, "prefill must emit stored events"
+
+        # deterministic: same request id + prompt -> same tokens
+        toks2 = []
+        async for item in eng.generate(_req(prompt, 6, "a"), Context()):
+            data = item.get("data")
+            if data:
+                toks2.extend(data["token_ids"])
+        assert toks2 == toks
+
+        # prefix reuse: cached prefix means no new stored events for prompt blocks
+        hashes = compute_seq_hashes(prompt, 4)
+        assert eng.kv.cached_prefix_blocks(hashes) == len(hashes)
+        await eng.close()
+
+    asyncio.run(main())
+
+
+def test_mock_engine_cancellation():
+    async def main():
+        eng = MockEngine(MockEngineArgs(num_gpu_blocks=64, block_size=4, speedup_ratio=50.0))
+        ctx = Context()
+        got = 0
+        async for item in eng.generate(_req(list(range(8)), 1000, "c"), ctx):
+            if item.get("data"):
+                got += 1
+                if got == 3:
+                    ctx.stop_generating()
+        assert 3 <= got < 1000
+        # blocks released after cancel
+        await asyncio.sleep(0.05)
+        assert eng.kv.active_blocks == 0
+        await eng.close()
+
+    asyncio.run(main())
+
+
+def test_mock_engine_concurrent_batching():
+    async def main():
+        eng = MockEngine(MockEngineArgs(num_gpu_blocks=256, block_size=4, speedup_ratio=1000.0))
+
+        async def one(rid):
+            toks = []
+            async for item in eng.generate(_req(list(range(8)), 5, rid), Context()):
+                if item.get("data"):
+                    toks.extend(item["data"]["token_ids"])
+            return toks
+
+        results = await asyncio.gather(*[one(f"r{i}") for i in range(16)])
+        assert all(len(r) == 5 for r in results)
+        assert eng.num_requests == 16
+        await eng.close()
+
+    asyncio.run(main())

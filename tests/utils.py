@@ -1,0 +1,82 @@
+"""Test helpers: ManagedProcess fixture-style process supervision
+(mirrors reference tests/utils/managed_process.py)."""
+
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class ManagedProcess:
+    """Spawn a real child process with PYTHONPATH set, wait for readiness,
+    kill on exit (SIGKILL for fault-injection tests)."""
+
+    def __init__(self, args, name="proc", env=None, cpu_only=True):
+        self.args = [sys.executable, *args]
+        self.name = name
+        full_env = dict(os.environ)
+        full_env["PYTHONPATH"] = str(REPO)
+        if cpu_only:
+            full_env["JAX_PLATFORMS"] = "cpu"
+        if env:
+            full_env.update(env)
+        self.env = full_env
+        self.proc: subprocess.Popen | None = None
+        self.logfile = None
+
+    def start(self, logpath: str | None = None):
+        self.logfile = open(logpath or f"/tmp/{self.name}.log", "wb")
+        self.proc = subprocess.Popen(
+            self.args, env=self.env, stdout=self.logfile, stderr=subprocess.STDOUT
+        )
+        return self
+
+    def wait_port(self, port: int, timeout: float = 30.0):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self.proc and self.proc.poll() is not None:
+                raise RuntimeError(
+                    f"{self.name} exited early rc={self.proc.returncode}; "
+                    f"log: {self.logfile.name}"
+                )
+            try:
+                with socket.create_connection(("127.0.0.1", port), timeout=0.5):
+                    return self
+            except OSError:
+                time.sleep(0.15)
+        raise TimeoutError(f"{self.name}: port {port} not up in {timeout}s")
+
+    def sigkill(self):
+        if self.proc:
+            self.proc.send_signal(signal.SIGKILL)
+            self.proc.wait()
+
+    def stop(self):
+        if self.proc and self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait()
+        if self.logfile:
+            self.logfile.close()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
