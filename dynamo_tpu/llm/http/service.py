@@ -165,8 +165,12 @@ class HttpService:
                     await resp.write(_sse(json.dumps({"error": {"message": msg}})))
                     break
                 if ann.event is not None:
-                    # annotation event (kv-hit-rate etc.): SSE comment line
-                    await resp.write(f": {ann.event} {ann.comment}\n\n".encode())
+                    # annotation (kv-hit-rate, worker id): SSE comment line —
+                    # spec-compliant clients ignore it, harness tests parse it
+                    # (reference Annotated SSE events)
+                    await resp.write(
+                        f": {ann.event} {json.dumps(ann.comment)}\n\n".encode()
+                    )
                     continue
                 out: LLMEngineOutput = ann.data
                 if first_token_at is None and out.token_ids:
@@ -290,6 +294,9 @@ class HttpService:
                     await resp.write(_sse(json.dumps({"error": {"message": msg}})))
                     break
                 if ann.event is not None:
+                    await resp.write(
+                        f": {ann.event} {json.dumps(ann.comment)}\n\n".encode()
+                    )
                     continue
                 out: LLMEngineOutput = ann.data
                 if first and out.token_ids:

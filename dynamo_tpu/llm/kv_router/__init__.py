@@ -1,0 +1,181 @@
+"""KV-cache-aware routing (reference lib/llm/src/kv_router/).
+
+`KvPushRouter` = the RouterMode::KV network hop: score workers by cached
+prefix overlap (KvIndexer), pick via the scheduler cost + softmax
+(KvScheduler), then send direct to the chosen instance
+(reference KvRouter kv_router.rs:202, find_best_match :318).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, AsyncIterator, Dict, Optional
+
+from ...runtime import codec
+from ...runtime.component import Client, DistributedRuntime
+from ...runtime.engine import Context
+from ...runtime.request_plane import StreamLost
+from ..model_card import ModelDeploymentCard
+from ..tokens import compute_seq_hashes
+from .indexer import ApproxKvIndexer, KvIndexer, OverlapScores, RadixTree
+from .publisher import KvEventPublisher, WorkerMetricsPublisher, METRICS_TOPIC_FMT
+from .scheduler import KvRouterConfig, KvScheduler, WorkerLoad, softmax_sample
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "ApproxKvIndexer",
+    "KvEventPublisher",
+    "KvIndexer",
+    "KvPushRouter",
+    "KvRouterConfig",
+    "KvScheduler",
+    "OverlapScores",
+    "RadixTree",
+    "WorkerLoad",
+    "WorkerMetricsPublisher",
+    "make_kv_router_factory",
+    "softmax_sample",
+]
+
+
+class KvPushRouter:
+    """The KV routing hop (reference KvPushRouter in bindings / KvRouter
+    kv_router.rs:202)."""
+
+    def __init__(
+        self,
+        drt: DistributedRuntime,
+        client: Client,
+        config: Optional[KvRouterConfig] = None,
+        block_size: int = 64,
+    ):
+        self.drt = drt
+        self.client = client
+        self.config = config or KvRouterConfig(block_size=block_size)
+        # the model card's kv block size is authoritative: hashes must match
+        # what the worker's engine emits (SURVEY.md hard part (c))
+        self.block_size = block_size
+        self.config.block_size = block_size
+        ns = client.endpoint.component.namespace
+        comp = client.endpoint.component.name
+        if self.config.use_kv_events:
+            self.indexer = KvIndexer(drt, ns, comp, self.block_size)
+        else:
+            self.indexer = ApproxKvIndexer(self.block_size)
+        self.scheduler = KvScheduler(self.config)
+        self._metrics_sub = None
+        self._metrics_task: Optional[asyncio.Task] = None
+        self._known_workers: set[int] = set()
+
+    async def start(self):
+        if isinstance(self.indexer, KvIndexer):
+            await self.indexer.start()
+        ns = self.client.endpoint.component.namespace
+        comp = self.client.endpoint.component.name
+        if self.drt.discovery is not None:
+            self._metrics_sub = await self.drt.discovery.subscribe(
+                METRICS_TOPIC_FMT.format(namespace=ns, component=comp)
+            )
+            self._metrics_task = asyncio.create_task(self._metrics_loop())
+
+    async def _metrics_loop(self):
+        async for payload in self._metrics_sub:
+            try:
+                msg = codec.unpack(payload)
+                self.scheduler.update_load(msg["worker_id"], msg.get("stats", {}))
+            except Exception:  # noqa: BLE001
+                logger.exception("bad metrics message")
+
+    def _prune_dead_workers(self, live: list[int]):
+        live_set = set(live)
+        dead = self._known_workers - live_set
+        for w in dead:
+            self.indexer.remove_worker(w)
+            self.scheduler.remove_worker(w)
+        self._known_workers = live_set
+
+    def find_best_match(self, token_ids: list[int], router_override: Optional[dict] = None) -> tuple[int, int]:
+        """Returns (worker_id, overlap_blocks) — reference find_best_match
+        kv_router.rs:318."""
+        live = self.client.instance_ids()
+        if not live:
+            raise StreamLost(f"no instances for {self.client.endpoint.subject}")
+        self._prune_dead_workers(live)
+        scores = self.indexer.find_matches_for_tokens(token_ids)
+        request_blocks = len(token_ids) // self.block_size
+        cfg = self.config
+        if router_override:
+            cfg = KvRouterConfig(
+                overlap_score_weight=router_override.get(
+                    "overlap_score_weight", cfg.overlap_score_weight
+                ),
+                router_temperature=router_override.get(
+                    "router_temperature", cfg.router_temperature
+                ),
+                block_size=cfg.block_size,
+            )
+        saved = self.scheduler.config
+        self.scheduler.config = cfg
+        try:
+            worker = self.scheduler.schedule(request_blocks, scores.scores, live)
+        finally:
+            self.scheduler.config = saved
+        return worker, scores.scores.get(worker, 0)
+
+    async def generate(
+        self, request: Dict[str, Any], context: Optional[Context] = None
+    ) -> AsyncIterator[Any]:
+        token_ids = request.get("token_ids", [])
+        request_id = request.get("request_id") or ""
+        pinned = request.get("router", {}).get("backend_instance_id")
+        if pinned is not None:
+            worker, overlap = int(pinned), 0
+        else:
+            worker, overlap = self.find_best_match(
+                token_ids, request.get("router") or None
+            )
+        request = dict(request)
+        request["estimated_prefix_hit_num_blocks"] = overlap
+        blocks = max(len(token_ids) // self.block_size, 1)
+        self.scheduler.add_request(request_id, worker, blocks)
+        if isinstance(self.indexer, ApproxKvIndexer):
+            self.indexer.process_routing_decision_for_request(token_ids, worker)
+        try:
+            inner = await self.client.direct(request, worker, context)
+        except StreamLost:
+            self.scheduler.mark_free(request_id)
+            raise
+        return self._wrap(inner, request_id)
+
+    async def _wrap(self, stream: AsyncIterator[Any], request_id: str):
+        try:
+            async for item in stream:
+                yield item
+        finally:
+            self.scheduler.mark_free(request_id)
+
+    async def close(self):
+        if self._metrics_task:
+            self._metrics_task.cancel()
+        if self._metrics_sub:
+            await self._metrics_sub.cancel()
+        if isinstance(self.indexer, KvIndexer):
+            await self.indexer.close()
+
+
+def make_kv_router_factory(config: KvRouterConfig):
+    """Factory used by the ModelWatcher when --router-mode kv."""
+
+    async def factory(drt: DistributedRuntime, card: ModelDeploymentCard, client: Client):
+        import dataclasses
+
+        per_model = dataclasses.replace(config, block_size=card.kv_cache_block_size)
+        router = KvPushRouter(
+            drt, client, per_model, block_size=card.kv_cache_block_size
+        )
+        await router.start()
+        return router
+
+    return factory

@@ -1,0 +1,199 @@
+"""KV block index: which worker holds which cached blocks.
+
+Mirrors reference lib/llm/src/kv_router/indexer.rs (RadixTree :224,
+find_matches :276, apply_event :336). Because block hashes are CHAINED
+sequence hashes (tokens.py), a hash is globally unique to its exact prefix —
+so the radix tree collapses to a flat hash→workers map, with per-worker
+continuity enforced during the match walk (a worker that evicted an early
+block stops matching at the gap). This is O(1) per block with no tree
+rebalancing — cheaper than the reference's pointer tree for the same
+semantics.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from collections import defaultdict
+from typing import Dict, List, Optional, Set
+
+from ...runtime.component import DistributedRuntime
+from ..tokens import compute_seq_hashes
+
+logger = logging.getLogger(__name__)
+
+
+class OverlapScores:
+    """Per-worker count of matched prefix blocks (reference indexer.rs
+    OverlapScores)."""
+
+    def __init__(self):
+        self.scores: Dict[int, int] = {}
+        self.frequencies: List[int] = []  # workers matching at each depth
+
+    def __repr__(self):
+        return f"OverlapScores({self.scores})"
+
+
+class RadixTree:
+    """Flat chained-hash index with match-walk semantics
+    (reference RadixTree indexer.rs:224)."""
+
+    def __init__(self):
+        self._blocks: Dict[int, Set[int]] = defaultdict(set)  # hash -> workers
+        self._worker_blocks: Dict[int, Set[int]] = defaultdict(set)  # worker -> hashes
+
+    def apply_stored(self, worker_id: int, block_hashes: List[int]):
+        for h in block_hashes:
+            self._blocks[h].add(worker_id)
+            self._worker_blocks[worker_id].add(h)
+
+    def apply_removed(self, worker_id: int, block_hashes: List[int]):
+        for h in block_hashes:
+            workers = self._blocks.get(h)
+            if workers:
+                workers.discard(worker_id)
+                if not workers:
+                    self._blocks.pop(h, None)
+            self._worker_blocks[worker_id].discard(h)
+
+    def remove_worker(self, worker_id: int):
+        """Worker died: drop all its blocks (reference remove_worker)."""
+        for h in self._worker_blocks.pop(worker_id, set()):
+            workers = self._blocks.get(h)
+            if workers:
+                workers.discard(worker_id)
+                if not workers:
+                    self._blocks.pop(h, None)
+
+    def clear_all_blocks(self, worker_id: int):
+        self.remove_worker(worker_id)
+
+    def find_matches(self, seq_hashes: List[int], early_exit: bool = False) -> OverlapScores:
+        """Walk the prefix; a worker scores i+1 if it holds blocks 0..i
+        contiguously (reference find_matches indexer.rs:276)."""
+        result = OverlapScores()
+        active: Optional[Set[int]] = None
+        for depth, h in enumerate(seq_hashes):
+            holders = self._blocks.get(h)
+            if not holders:
+                break
+            active = set(holders) if active is None else (active & holders)
+            if not active:
+                break
+            result.frequencies.append(len(active))
+            for w in active:
+                result.scores[w] = depth + 1
+            if early_exit and len(active) == 1:
+                break
+        return result
+
+    @property
+    def num_blocks(self) -> int:
+        return len(self._blocks)
+
+    def worker_block_count(self, worker_id: int) -> int:
+        return len(self._worker_blocks.get(worker_id, ()))
+
+    def workers(self) -> List[int]:
+        return list(self._worker_blocks.keys())
+
+    def dump(self) -> dict:
+        """Snapshot for replica sync / persistence (reference snapshots to
+        the object store)."""
+        return {
+            str(w): sorted(hs) for w, hs in self._worker_blocks.items() if hs
+        }
+
+    def load(self, snapshot: dict):
+        for w_str, hashes in snapshot.items():
+            self.apply_stored(int(w_str), list(hashes))
+
+
+EVENT_TOPIC_FMT = "kv_events/{namespace}/{component}"
+
+
+class KvIndexer:
+    """Event-driven index: subscribes to the component's KV-event topic and
+    applies stored/removed events to the RadixTree
+    (reference KvIndexer indexer.rs + subscriber.rs)."""
+
+    def __init__(self, drt: DistributedRuntime, namespace: str, component: str, block_size: int = 64):
+        self.drt = drt
+        self.block_size = block_size
+        self.topic = EVENT_TOPIC_FMT.format(namespace=namespace, component=component)
+        self.tree = RadixTree()
+        self._task: Optional[asyncio.Task] = None
+        self._sub = None
+        self.events_applied = 0
+
+    async def start(self):
+        assert self.drt.discovery is not None
+        self._sub = await self.drt.discovery.subscribe(self.topic)
+        self._task = asyncio.create_task(self._loop())
+
+    async def _loop(self):
+        from ...runtime import codec
+
+        async for payload in self._sub:
+            try:
+                msg = codec.unpack(payload)
+                worker_id = msg["worker_id"]
+                for ev in msg.get("events", []):
+                    if ev.get("event_type") == "stored":
+                        self.tree.apply_stored(worker_id, ev["block_hashes"])
+                    elif ev.get("event_type") == "removed":
+                        self.tree.apply_removed(worker_id, ev["block_hashes"])
+                    elif ev.get("event_type") == "cleared":
+                        self.tree.clear_all_blocks(worker_id)
+                    self.events_applied += 1
+            except Exception:  # noqa: BLE001 — indexer must survive bad events
+                logger.exception("bad kv event")
+
+    def find_matches_for_tokens(self, token_ids: List[int]) -> OverlapScores:
+        return self.tree.find_matches(compute_seq_hashes(token_ids, self.block_size))
+
+    def remove_worker(self, worker_id: int):
+        self.tree.remove_worker(worker_id)
+
+    async def close(self):
+        if self._task:
+            self._task.cancel()
+        if self._sub:
+            await self._sub.cancel()
+
+
+class ApproxKvIndexer:
+    """Indexer that needs no engine events: assumes a routed request's prefix
+    becomes cached on the chosen worker for a TTL
+    (reference ApproxKvIndexer approx.rs)."""
+
+    def __init__(self, block_size: int = 64, ttl: float = 120.0):
+        self.block_size = block_size
+        self.ttl = ttl
+        self.tree = RadixTree()
+        self._expiry: List[tuple] = []  # (deadline, worker_id, hashes)
+
+    def process_routing_decision_for_request(self, token_ids: List[int], worker_id: int):
+        import time
+
+        hashes = compute_seq_hashes(token_ids, self.block_size)
+        self.tree.apply_stored(worker_id, hashes)
+        self._expiry.append((time.monotonic() + self.ttl, worker_id, hashes))
+        self._expire()
+
+    def _expire(self):
+        import time
+
+        now = time.monotonic()
+        while self._expiry and self._expiry[0][0] < now:
+            _, worker_id, hashes = self._expiry.pop(0)
+            self.tree.apply_removed(worker_id, hashes)
+
+    def find_matches_for_tokens(self, token_ids: List[int]) -> OverlapScores:
+        self._expire()
+        return self.tree.find_matches(compute_seq_hashes(token_ids, self.block_size))
+
+    def remove_worker(self, worker_id: int):
+        self.tree.remove_worker(worker_id)

@@ -64,8 +64,13 @@ async def main():
         await publisher.start()
 
     engine = MockEngine(
-        engine_args, event_sink=publisher.publish_threadsafe if publisher else None
+        engine_args, event_sink=publisher.publish if publisher else None
     )
+
+    from dynamo_tpu.llm.kv_router.publisher import WorkerMetricsPublisher
+
+    metrics_pub = WorkerMetricsPublisher(drt, endpoint, drt.instance_id, engine.stats)
+    await metrics_pub.start()
 
     card = ModelDeploymentCard(
         name=args.model_name,
@@ -85,8 +90,22 @@ async def main():
 
     asyncio.create_task(stats_loop())
 
+    async def handler(request, context):
+        # nvext annotation support: announce which worker serves the request
+        # (reference annotations e.g. worker_id / kv_hit_rate)
+        if "worker_instance_id" in (request.get("annotations") or []):
+            yield {
+                "event": "worker_instance_id",
+                "comment": [f"{drt.instance_id:x}"],
+            }
+        if "kv_hit_rate" in (request.get("annotations") or []):
+            hit = request.get("estimated_prefix_hit_num_blocks") or 0
+            yield {"event": "kv_hit_rate", "comment": [str(hit)]}
+        async for item in engine.generate(request, context):
+            yield item
+
     logger.info("mocker worker up: model=%s instance=%x", args.model_name, drt.instance_id)
-    await endpoint.serve_endpoint(engine.generate)
+    await endpoint.serve_endpoint(handler)
     await drt.wait_for_shutdown()
 
 

@@ -77,6 +77,8 @@ class DiscoveryServer:
         self._server: Optional[asyncio.base_events.Server] = None
         self._reaper: Optional[asyncio.Task] = None
         self._connections: set[asyncio.StreamWriter] = set()
+        self._subs: Dict[str, List[_Watcher]] = {}  # topic -> subscribers
+        self._subs_by_id: Dict[int, _Watcher] = {}
 
     # -- lifecycle ---------------------------------------------------------- #
 
@@ -163,6 +165,12 @@ class DiscoveryServer:
                 except (ConnectionError, RuntimeError):
                     self._watchers.pop(w.watch_id, None)
 
+    def _drop_sub(self, sub: _Watcher):
+        self._subs_by_id.pop(sub.watch_id, None)
+        lst = self._subs.get(sub.prefix)
+        if lst and sub in lst:
+            lst.remove(sub)
+
     # -- connection handling ------------------------------------------------ #
 
     async def _serve_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
@@ -185,7 +193,12 @@ class DiscoveryServer:
             logger.warning("dropping connection speaking a bad protocol: %s", e)
         finally:
             for wid in conn_watches:
-                self._watchers.pop(wid, None)
+                if wid < 0:
+                    sub = self._subs_by_id.get(-wid)
+                    if sub:
+                        self._drop_sub(sub)
+                else:
+                    self._watchers.pop(wid, None)
             # Leases survive connection loss until TTL expiry (like etcd):
             # a client that reconnects fast enough keeps its registration.
             self._connections.discard(writer)
@@ -254,6 +267,32 @@ class DiscoveryServer:
         if op == "unwatch":
             self._watchers.pop(control["watch_id"], None)
             return {"ok": True}, b""
+        if op == "publish":
+            # NATS-core-role pub/sub: fan out to live topic subscribers, no
+            # persistence (KV events, metrics broadcast)
+            topic = control["topic"]
+            for sub in list(self._subs.get(topic, [])):
+                try:
+                    await codec.write_frame(
+                        sub.writer,
+                        {"push": "msg", "sub_id": sub.watch_id, "topic": topic},
+                        payload,
+                    )
+                except (ConnectionError, RuntimeError):
+                    self._drop_sub(sub)
+            return {"ok": True}, b""
+        if op == "subscribe":
+            wid = next(self._watch_ids)
+            sub = _Watcher(wid, control["topic"], writer)
+            self._subs.setdefault(control["topic"], []).append(sub)
+            self._subs_by_id[wid] = sub
+            conn_watches.append(-wid)  # negative marks a topic sub
+            return {"ok": True, "sub_id": wid}, b""
+        if op == "unsubscribe":
+            sub = self._subs_by_id.get(control["sub_id"])
+            if sub:
+                self._drop_sub(sub)
+            return {"ok": True}, b""
         if op == "status":
             return {
                 "ok": True,
@@ -307,6 +346,41 @@ class Watch:
         self._queue.put_nowait(None)
 
 
+class Subscription:
+    """A live topic subscription (NATS-core role): async stream of payloads."""
+
+    def __init__(self, sub_id: int, topic: str, client: "DiscoveryClient"):
+        self.sub_id = sub_id
+        self.topic = topic
+        self._queue: asyncio.Queue[Optional[bytes]] = asyncio.Queue()
+        self._client = client
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self) -> bytes:
+        item = await self._queue.get()
+        if item is None:
+            raise StopAsyncIteration
+        return item
+
+    async def get(self, timeout: Optional[float] = None) -> Optional[bytes]:
+        if timeout is None:
+            return await self._queue.get()
+        try:
+            return await asyncio.wait_for(self._queue.get(), timeout)
+        except asyncio.TimeoutError:
+            return None
+
+    async def cancel(self):
+        self._client._subs.pop(self.sub_id, None)
+        try:
+            await self._client._call({"op": "unsubscribe", "sub_id": self.sub_id})
+        except ConnectionError:
+            pass
+        self._queue.put_nowait(None)
+
+
 class Lease:
     """Client-side lease handle with a background keepalive task
     (reference: Lease etcd.rs:43 — primary lease keeps instances alive)."""
@@ -355,6 +429,7 @@ class DiscoveryClient:
         self._req_ids = itertools.count(1)
         self._pending: Dict[int, asyncio.Future] = {}
         self._watches: Dict[int, Watch] = {}
+        self._subs: Dict[int, Subscription] = {}
         self._recv_task: Optional[asyncio.Task] = None
         self._lock = asyncio.Lock()
 
@@ -395,6 +470,11 @@ class DiscoveryClient:
                             WatchEvent(control["type"], control["key"], payload)
                         )
                     continue
+                if control.get("push") == "msg":
+                    sub = self._subs.get(control["sub_id"])
+                    if sub:
+                        sub._queue.put_nowait(payload)
+                    continue
                 fut = self._pending.pop(control.get("req_id"), None)
                 if fut and not fut.done():
                     fut.set_result((control, payload))
@@ -407,6 +487,8 @@ class DiscoveryClient:
             self._pending.clear()
             for watch in self._watches.values():
                 watch._queue.put_nowait(None)
+            for sub in self._subs.values():
+                sub._queue.put_nowait(None)
 
     async def _call(self, control: dict, payload: bytes = b"") -> Tuple[dict, bytes]:
         if self._writer is None or self._writer.is_closing():
@@ -483,6 +565,16 @@ class DiscoveryClient:
             await self._call({"op": "unwatch", "watch_id": watch_id})
         except ConnectionError:
             pass
+
+    async def publish(self, topic: str, payload: bytes):
+        """Fire-and-forget topic publish (NATS-core role)."""
+        await self._call({"op": "publish", "topic": topic}, payload)
+
+    async def subscribe(self, topic: str) -> Subscription:
+        resp, _ = await self._call({"op": "subscribe", "topic": topic})
+        sub = Subscription(resp["sub_id"], topic, self)
+        self._subs[sub.sub_id] = sub
+        return sub
 
     async def lock(self, name: str, lease: Lease, retries: int = 100, delay: float = 0.05) -> bool:
         """Simple distributed lock: atomic-create a lock key under a lease

@@ -1,0 +1,212 @@
+"""KV router tests: radix index, scheduler cost + softmax, end-to-end
+KV-aware routing over real processes (mirrors reference
+kv_router/indexer.rs:1321-1584, scheduler.rs:576-610, and
+tests/router/test_router_e2e_with_mockers.py)."""
+
+import asyncio
+import collections
+import json
+import time
+
+import httpx
+import pytest
+
+from dynamo_tpu.llm.kv_router import (
+    ApproxKvIndexer,
+    KvRouterConfig,
+    KvScheduler,
+    RadixTree,
+    softmax_sample,
+)
+from dynamo_tpu.llm.tokens import compute_seq_hashes
+
+from .utils import ManagedProcess, free_port
+
+
+def test_radix_tree_match_and_removal():
+    tree = RadixTree()
+    toks = list(range(64 * 4))
+    hashes = compute_seq_hashes(toks, 64)
+    tree.apply_stored(1, hashes)
+    tree.apply_stored(2, hashes[:2])
+
+    scores = tree.find_matches(hashes)
+    assert scores.scores == {1: 4, 2: 2}
+    assert scores.frequencies == [2, 2, 1, 1]
+
+    # different suffix, same 2-block prefix
+    other = toks[:128] + list(range(900, 964))
+    scores2 = tree.find_matches(compute_seq_hashes(other, 64))
+    assert scores2.scores == {1: 2, 2: 2}
+
+    # removal breaks continuity: worker 1 evicts block 0 -> no matches at all
+    tree.apply_removed(1, [hashes[0]])
+    scores3 = tree.find_matches(hashes)
+    assert scores3.scores == {2: 2}
+
+    tree.remove_worker(2)
+    assert tree.find_matches(hashes).scores == {}
+
+
+def test_radix_tree_dump_load():
+    tree = RadixTree()
+    hashes = compute_seq_hashes(list(range(128)), 64)
+    tree.apply_stored(7, hashes)
+    snap = tree.dump()
+    tree2 = RadixTree()
+    tree2.load(snap)
+    assert tree2.find_matches(hashes).scores == {7: 2}
+
+
+def test_softmax_sample_temperature_zero_argmin():
+    costs = {1: 5.0, 2: 1.0, 3: 9.0}
+    assert all(softmax_sample(costs, 0.0) == 2 for _ in range(20))
+
+
+def test_softmax_sample_temperature_spreads():
+    costs = {1: 1.0, 2: 1.2}
+    picks = collections.Counter(softmax_sample(costs, 2.0) for _ in range(500))
+    assert picks[1] > 0 and picks[2] > 0  # both get traffic at high temp
+
+
+def test_scheduler_prefers_overlap_and_balances_load():
+    sched = KvScheduler(KvRouterConfig(overlap_score_weight=1.0, router_temperature=0.0))
+    live = [1, 2]
+    # worker 1 has 8 of 10 blocks cached -> lower cost
+    w = sched.schedule(10, {1: 8, 2: 0}, live)
+    assert w == 1
+    # but if worker 1 is drowning in decode blocks, worker 2 wins
+    sched.update_load(1, {"kv_active_blocks": 1000, "kv_total_blocks": 1024})
+    sched.update_load(2, {"kv_active_blocks": 0, "kv_total_blocks": 1024})
+    w = sched.schedule(10, {1: 8, 2: 0}, live)
+    assert w == 2
+    # potential-block tracking: scheduling bumps the chosen worker's cost
+    sched2 = KvScheduler(KvRouterConfig())
+    for i in range(4):
+        w = sched2.schedule(10, {}, live)
+        sched2.add_request(f"r{i}", w, 10)
+    assert sched2._potential_blocks.get(1, 0) > 0 and sched2._potential_blocks.get(2, 0) > 0
+    sched2.mark_free("r0")
+    sched2.mark_free("r1")
+    sched2.mark_free("r2")
+    sched2.mark_free("r3")
+    assert all(v == 0 for v in sched2._potential_blocks.values())
+
+
+def test_approx_indexer_ttl():
+    idx = ApproxKvIndexer(block_size=4, ttl=0.2)
+    toks = list(range(16))
+    idx.process_routing_decision_for_request(toks, 5)
+    assert idx.find_matches_for_tokens(toks).scores == {5: 4}
+    time.sleep(0.25)
+    assert idx.find_matches_for_tokens(toks).scores == {}
+
+
+@pytest.fixture(scope="module")
+def kv_cluster():
+    """Frontend in KV router mode + 2 mockers publishing KV events."""
+    http_port = free_port()
+    disc = f"tcp://127.0.0.1:{free_port()}"
+    fe = ManagedProcess(
+        [
+            "-m",
+            "dynamo_tpu.frontend",
+            "--http-port",
+            str(http_port),
+            "--embed-discovery",
+            "--discovery",
+            disc,
+            "--router-mode",
+            "kv",
+        ],
+        name="kv_fe",
+    ).start("/tmp/kv_fe.log")
+    fe.wait_port(http_port)
+    workers = [
+        ManagedProcess(
+            [
+                "-m",
+                "dynamo_tpu.mocker",
+                "--model-name",
+                "kv-model",
+                "--discovery",
+                disc,
+                "--speedup-ratio",
+                "100",
+                "--block-size",
+                "16",
+                "--kv-events",
+            ],
+            name=f"kv_mocker{i}",
+        ).start(f"/tmp/kv_mocker{i}.log")
+        for i in range(2)
+    ]
+    base = f"http://127.0.0.1:{http_port}"
+    deadline = time.time() + 20
+    with httpx.Client() as client:
+        while time.time() < deadline:
+            if client.get(f"{base}/v1/models").json()["data"]:
+                break
+            time.sleep(0.25)
+        else:
+            raise TimeoutError("model never registered")
+    yield base
+    for w in workers:
+        w.stop()
+    fe.stop()
+
+
+def _stream_worker_id(base, prompt, model="kv-model", endpoint="chat"):
+    """Issue a streaming request with the worker_instance_id annotation and
+    parse it from the SSE comment line."""
+    wid = None
+    if endpoint == "chat":
+        url = f"{base}/v1/chat/completions"
+        body = {
+            "model": model,
+            "messages": [{"role": "user", "content": prompt}],
+            "max_tokens": 3,
+            "stream": True,
+            "nvext": {"annotations": ["worker_instance_id"]},
+        }
+    else:
+        url = f"{base}/v1/completions"
+        body = {
+            "model": model,
+            "prompt": prompt,
+            "max_tokens": 3,
+            "stream": True,
+            "nvext": {"annotations": ["worker_instance_id"]},
+        }
+    with httpx.Client(timeout=30) as client:
+        with client.stream("POST", url, json=body) as r:
+            assert r.status_code == 200
+            for line in r.iter_lines():
+                if line.startswith(": worker_instance_id"):
+                    wid = json.loads(line.split(" ", 2)[2])[0]
+                if line.strip() == "data: [DONE]":
+                    break
+    return wid
+
+
+def test_kv_routing_e2e_prefix_affinity(kv_cluster):
+    """Same long prompt repeatedly -> requests stick to the worker holding
+    the cached prefix; distinct prompts spread across workers."""
+    base = kv_cluster
+    long_prefix = "tell me a story about " + "x" * 600  # many blocks @16
+
+    first = _stream_worker_id(base, long_prefix)
+    assert first is not None
+    time.sleep(0.8)  # let KV events reach the router's indexer
+    repeats = [_stream_worker_id(base, long_prefix) for _ in range(4)]
+    assert all(w == first for w in repeats), f"affinity broken: {first} vs {repeats}"
+
+    # distinct raw-completion prompts (no shared chat-template prefix blocks)
+    # must not all pile onto the warm worker: tie-break spreads them
+    others = {
+        _stream_worker_id(
+            base, f"{i} totally distinct prompt " + chr(65 + i) * 300, endpoint="completions"
+        )
+        for i in range(8)
+    }
+    assert len(others) == 2, f"expected both workers used, got {others}"
