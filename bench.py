@@ -1,0 +1,150 @@
+"""Headline benchmark: steady-state decode throughput of the JAX engine.
+
+Runs on whatever `jax.devices()` provides (the real TPU chip under axon;
+CPU with --smoke). Prints ONE JSON line:
+
+    {"metric": ..., "value": N, "unit": "tok/s", "vs_baseline": N}
+
+vs_baseline: the reference publishes no absolute end-to-end tables
+(BASELINE.md); the closest per-accelerator number it documents is the SLA
+profiler example decode rate of 51.22 tok/s/GPU at TP4 on H100-class
+(docs/benchmarks/pre_deployment_profiling.md:56) => 204.9 tok/s per 4-GPU
+worker. We report batched decode tok/s on ONE v5e chip divided by that
+per-GPU figure so the ratio reads "v5e-chip decode throughput vs H100-GPU
+decode throughput on the reference's own example".
+"""
+
+import argparse
+import json
+import sys
+import time
+
+H100_DECODE_TOKS_PER_GPU = 51.22  # reference pre_deployment_profiling.md:56
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true", help="tiny model on CPU")
+    ap.add_argument("--model", default=None)
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--isl", type=int, default=128, help="input seq len")
+    ap.add_argument("--osl", type=int, default=128, help="output seq len")
+    ap.add_argument("--steps", type=int, default=None, help="decode steps to time")
+    args = ap.parse_args()
+
+    if args.smoke:
+        import os
+
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dynamo_tpu.engine.kv_cache import alloc_kv_arrays
+    from dynamo_tpu.engine.sampling import SamplingParams, sample
+    from dynamo_tpu.models import llama
+
+    model = args.model or ("tiny" if args.smoke else "llama3-3b")
+    cfgs = {
+        "tiny": llama.LlamaConfig.tiny,
+        "llama3-3b": llama.LlamaConfig.llama3_2_3b,
+        "llama3-8b": llama.LlamaConfig.llama3_8b,
+    }
+    cfg = cfgs[model]()
+
+    B = args.batch
+    PAGE = 64
+    max_len = args.isl + args.osl
+    pages_per_seq = (max_len + PAGE - 1) // PAGE
+    num_pages = B * pages_per_seq + 1
+    dev = jax.devices()[0]
+    print(f"# bench: model={model} device={dev.platform} B={B} isl={args.isl} osl={args.osl}", file=sys.stderr)
+
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    kv_k, kv_v = alloc_kv_arrays(
+        cfg.num_layers, num_pages, PAGE, cfg.num_kv_heads, cfg.head_dim, cfg.dtype
+    )
+
+    # page tables: disjoint pages per slot (page 0 reserved scratch)
+    pt = np.zeros((B, pages_per_seq), np.int32)
+    for b in range(B):
+        pt[b] = 1 + b * pages_per_seq + np.arange(pages_per_seq)
+    pt = pt % num_pages
+    page_tables = jnp.asarray(pt)
+
+    # ---- prefill all slots (measures TTFT-ish per-seq prefill rate) ----
+    from dynamo_tpu.models.llama import prefill_forward
+
+    prefill = jax.jit(
+        lambda p, kk, kv, t, pos, tab, cl, li: prefill_forward(
+            p, cfg, t, pos, kk, kv, tab, cl, li
+        ),
+        donate_argnums=(1, 2),
+    )
+    rng = np.random.RandomState(0)
+    t_prefill0 = time.perf_counter()
+    for b in range(B):
+        toks = jnp.asarray(rng.randint(3, cfg.vocab_size - 1, size=args.isl), jnp.int32)
+        pos = jnp.arange(args.isl, dtype=jnp.int32)
+        logits, kv_k, kv_v = prefill(
+            params, kv_k, kv_v, toks, pos, page_tables[b], jnp.asarray(0, jnp.int32),
+            jnp.asarray(args.isl - 1, jnp.int32),
+        )
+        if b == 0:
+            logits.block_until_ready()
+            t_first = time.perf_counter() - t_prefill0
+    logits.block_until_ready()
+    t_prefill = time.perf_counter() - t_prefill0
+
+    # ---- decode loop ----
+    def _decode(params, kv_k, kv_v, tokens, positions, page_tables, seq_lens, samp, key):
+        lg, kv_k, kv_v = llama.decode_forward(
+            params, cfg, tokens, positions, kv_k, kv_v, page_tables, seq_lens
+        )
+        return sample(lg, samp, key), kv_k, kv_v
+
+    decode_step = jax.jit(_decode, donate_argnums=(1, 2))
+
+    tokens = jnp.zeros((B,), jnp.int32)
+    positions = jnp.full((B,), args.isl, jnp.int32)
+    seq_lens = jnp.full((B,), args.isl + 1, jnp.int32)
+    samp = SamplingParams.full(B, temperature=0.0)
+    key = jax.random.PRNGKey(7)
+
+    # warmup/compile
+    tokens, kv_k, kv_v = decode_step(
+        params, kv_k, kv_v, tokens, positions, page_tables, seq_lens, samp, key
+    )
+    tokens.block_until_ready()
+
+    n_steps = args.steps or (args.osl - 1)
+    t0 = time.perf_counter()
+    for i in range(n_steps):
+        positions = positions + 1
+        seq_lens = seq_lens + 1
+        key = jax.random.fold_in(key, i)
+        tokens, kv_k, kv_v = decode_step(
+            params, kv_k, kv_v, tokens, positions, page_tables, seq_lens, samp, key
+        )
+    tokens.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    toks_per_sec = B * n_steps / dt
+    itl_ms = dt / n_steps * 1000
+    print(
+        f"# decode: {toks_per_sec:.1f} tok/s (ITL {itl_ms:.2f} ms @ batch {B}); "
+        f"prefill: {B * args.isl / t_prefill:.0f} tok/s, first-seq TTFT {t_first*1000:.1f} ms",
+        file=sys.stderr,
+    )
+    result = {
+        "metric": f"decode_throughput_{model}_bs{B}_isl{args.isl}",
+        "value": round(toks_per_sec, 1),
+        "unit": "tok/s",
+        "vs_baseline": round(toks_per_sec / H100_DECODE_TOKS_PER_GPU, 2),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,4 @@
+from .config import EngineConfig
+from .engine import JaxEngine
+
+__all__ = ["EngineConfig", "JaxEngine"]

@@ -1,0 +1,31 @@
+"""JAX engine configuration (vLLM-engine-args role for the TPU engine)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class EngineConfig:
+    model: str = "tiny"  # models/registry key or path
+    max_num_seqs: int = 64  # decode slot batch
+    page_size: int = 64  # tokens per KV page == router block size
+    num_pages: int = 2048  # HBM page pool size (auto if 0)
+    max_model_len: int = 8192
+    max_prefill_chunk: int = 1024  # chunked-prefill bucket cap
+    prefill_buckets: tuple = (128, 256, 512, 1024)
+    enable_prefix_caching: bool = True
+    # sampling defaults
+    default_temperature: float = 0.0
+    seed: int = 0
+    # parallelism (parallel/mesh.py)
+    tp_size: int = 1
+    dp_size: int = 1
+    # scheduling
+    max_queue: int = 4096
+    decode_batch_wait_s: float = 0.0  # wait to fill decode batch (0 = greedy)
+
+    @property
+    def max_pages_per_seq(self) -> int:
+        return (self.max_model_len + self.page_size - 1) // self.page_size

@@ -1,0 +1,564 @@
+"""JaxEngine: the TPU-native inference engine.
+
+The role vLLM plays under the reference (SURVEY.md §7 step 4), built the XLA
+way: everything on the token hot path is a pre-compiled static-shape program.
+
+  * decode: ONE jitted step for the whole slot batch [max_num_seqs] — paged
+    attention + on-device sampling; KV buffers donated so XLA updates in
+    place. Inactive slots write to a reserved scratch page and are masked.
+  * prefill: chunked + bucketed (compile once per bucket size); a chunk
+    attends to its own causal block plus already-written pages, enabling
+    prefix-cache hits and bounded step latency (the reference gets this from
+    vLLM's chunked prefill; here it is native).
+  * prefix cache: PageAllocator keys pages by the SAME chained block hashes
+    the KV router indexes (llm/tokens.py), and emits stored/removed events.
+  * host scheduler: admission by free pages + slots; continuous batching —
+    each loop iteration runs at most one prefill chunk, then one decode step
+    for all active slots.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, AsyncIterator, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..llm.mocker.kv_manager import KvEvent
+from ..llm.protocols import Annotated, LLMEngineOutput, PreprocessedRequest
+from ..llm.tokens import TokenBlockSequence, compute_seq_hashes
+from ..models import llama
+from ..runtime.engine import Context
+from .config import EngineConfig
+from .kv_cache import PageAllocator, alloc_kv_arrays
+from .sampling import SamplingParams, sample
+
+logger = logging.getLogger(__name__)
+
+SCRATCH_PAGE = 0  # physical page 0 is the dump target for masked lanes
+
+
+@dataclass
+class _Slot:
+    """One decode slot (host bookkeeping)."""
+
+    request_id: str
+    queue: asyncio.Queue
+    context: Context
+    prompt: List[int]
+    max_tokens: int
+    min_tokens: int
+    eos_ids: List[int]
+    ignore_eos: bool
+    stop_token_ids: List[int]
+    seq: TokenBlockSequence
+    pages: List[int] = field(default_factory=list)
+    committed_hashes: List[int] = field(default_factory=list)
+    prefill_pos: int = 0
+    generated: int = 0
+    last_token: int = 0
+    slot_idx: int = -1
+    done: bool = False
+
+
+class JaxEngine:
+    """Continuous-batching JAX engine with the MockEngine-compatible
+    `generate(request, context)` interface."""
+
+    def __init__(
+        self,
+        config: EngineConfig,
+        model_config: Optional[llama.LlamaConfig] = None,
+        params: Optional[dict] = None,
+        kv_sharding=None,
+        event_sink: Optional[Callable[[KvEvent], None]] = None,
+    ):
+        self.config = config
+        self.model_config = model_config or _resolve_model(config.model)
+        c = self.model_config
+        key = jax.random.PRNGKey(config.seed)
+        self.params = params if params is not None else llama.init_params(c, key)
+        # +1: physical page 0 is scratch
+        self.kv_k, self.kv_v = alloc_kv_arrays(
+            c.num_layers,
+            config.num_pages + 1,
+            config.page_size,
+            c.num_kv_heads,
+            c.head_dim,
+            dtype=c.dtype,
+            sharding=kv_sharding,
+        )
+        self.allocator = PageAllocator(
+            config.num_pages, config.page_size, event_sink=event_sink
+        )
+        # shift page ids by +1 so allocator page 0 -> physical page 1
+        B, P = config.max_num_seqs, config.max_pages_per_seq
+        self.page_tables = np.zeros((B, P), np.int32)
+        self.seq_lens = np.zeros((B,), np.int32)
+        self.tokens = np.zeros((B,), np.int32)
+        self.temps = np.zeros((B,), np.float32)
+        self.top_ks = np.zeros((B,), np.int32)
+        self.top_ps = np.ones((B,), np.float32)
+        self.slots: List[Optional[_Slot]] = [None] * B
+        self._free_slots = list(range(B - 1, -1, -1))
+        self._waiting: List[_Slot] = []
+        self._step_task: Optional[asyncio.Task] = None
+        self._wake = asyncio.Event()
+        self._closed = False
+        self._rng = jax.random.PRNGKey(config.seed + 1)
+        self._step_counter = 0
+        self.num_requests = 0
+        # all device calls run on this single thread so XLA compiles (which
+        # can take tens of seconds) never stall the asyncio event loop —
+        # heartbeats/leases/streams stay live during compilation
+        import concurrent.futures
+
+        self._device_exec = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="jax-step"
+        )
+        self._compile()
+
+    # ------------------------------------------------------------------ #
+    # compiled programs
+    # ------------------------------------------------------------------ #
+
+    def _compile(self):
+        c = self.model_config
+        cfg = self.config
+
+        @partial(jax.jit, donate_argnums=(1, 2))
+        def decode_step(params, kv_k, kv_v, tokens, positions, page_tables, seq_lens, samp, key):
+            logits, kv_k, kv_v = llama.decode_forward(
+                params, c, tokens, positions, kv_k, kv_v, page_tables, seq_lens
+            )
+            next_tokens = sample(logits, samp, key)
+            return next_tokens, kv_k, kv_v
+
+        self._decode_step = decode_step
+
+        @partial(jax.jit, donate_argnums=(1, 2), static_argnums=(8,))
+        def prefill_step(params, kv_k, kv_v, tokens, positions, page_table, ctx_len, last_idx, _bucket):
+            logits, kv_k, kv_v = llama.prefill_forward(
+                params, c, tokens, positions, kv_k, kv_v, page_table, ctx_len, last_idx
+            )
+            return logits, kv_k, kv_v
+
+        self._prefill_step = prefill_step
+
+        @jax.jit
+        def sample_one(logits, samp, key):
+            return sample(logits[None, :], samp, key)[0]
+
+        self._sample_one = sample_one
+
+    # ------------------------------------------------------------------ #
+    # lifecycle / interface (MockEngine-compatible)
+    # ------------------------------------------------------------------ #
+
+    def start(self):
+        if self._step_task is None:
+            self._step_task = asyncio.create_task(self._step_loop())
+
+    async def close(self):
+        self._closed = True
+        self._wake.set()
+        if self._step_task:
+            self._step_task.cancel()
+
+    async def generate(self, request: Any, context: Context) -> AsyncIterator[dict]:
+        self.start()
+        req = (
+            request
+            if isinstance(request, PreprocessedRequest)
+            else PreprocessedRequest.from_dict(request)
+        )
+        stop = req.stop_conditions or {}
+        sampling = req.sampling_options or {}
+        slot = _Slot(
+            request_id=req.request_id or f"jax-{self.num_requests}",
+            queue=asyncio.Queue(),
+            context=context,
+            prompt=list(req.token_ids),
+            max_tokens=int(stop.get("max_tokens") or 128),
+            min_tokens=int(stop.get("min_tokens") or 0),
+            eos_ids=list(req.eos_token_ids or []),
+            ignore_eos=bool(stop.get("ignore_eos")),
+            stop_token_ids=list(stop.get("stop_token_ids") or []),
+            seq=TokenBlockSequence(req.token_ids, self.config.page_size),
+        )
+        slot.temperature = float(sampling.get("temperature", self.config.default_temperature) or 0.0)
+        slot.top_k = int(sampling.get("top_k") or 0)
+        slot.top_p = float(sampling.get("top_p") or 1.0)
+        if len(slot.prompt) + slot.max_tokens > self.config.max_model_len:
+            slot.max_tokens = max(self.config.max_model_len - len(slot.prompt), 1)
+        self.num_requests += 1
+        self._waiting.append(slot)
+        self._wake.set()
+        try:
+            while True:
+                item = await slot.queue.get()
+                if item is None:
+                    return
+                yield item
+        finally:
+            slot.done = True
+            self._wake.set()
+
+    def stats(self) -> dict:
+        alloc_stats = self.allocator.stats()
+        running = sum(1 for s in self.slots if s is not None)
+        return {
+            "num_waiting_reqs": len(self._waiting),
+            "num_running_reqs": running,
+            "gpu_cache_usage_perc": self.allocator.active_pages / self.allocator.num_pages,
+            "request_total_slots": self.config.max_num_seqs,
+            **alloc_stats,
+        }
+
+    # ------------------------------------------------------------------ #
+    # step loop
+    # ------------------------------------------------------------------ #
+
+    async def _step_loop(self):
+        while not self._closed:
+            has_active = any(s is not None for s in self.slots)
+            if not self._waiting and not has_active:
+                self._wake.clear()
+                await self._wake.wait()
+                continue
+            try:
+                did_prefill = await self._admit_and_prefill()
+                did_decode = await self._decode_all()
+            except Exception as e:  # noqa: BLE001 — engine loop must not die silently
+                logger.exception("engine step failed; failing active requests")
+                self._fail_all(f"engine step failed: {type(e).__name__}: {e}")
+                await asyncio.sleep(0.1)
+                continue
+            # yield to the event loop so streams flush between steps
+            await asyncio.sleep(0)
+
+    # -- admission + chunked prefill ------------------------------------ #
+
+    async def _run_on_device(self, fn, *args):
+        return await asyncio.get_running_loop().run_in_executor(
+            self._device_exec, fn, *args
+        )
+
+    async def _admit_and_prefill(self) -> bool:
+        cfg = self.config
+        # admit waiting requests into free slots
+        still: List[_Slot] = []
+        for slot in self._waiting:
+            if slot.done or slot.context.is_stopped():
+                self._emit_finish(slot, "cancelled")
+                continue
+            if not self._free_slots:
+                still.append(slot)
+                continue
+            if not self._try_admit(slot):
+                still.append(slot)
+                continue
+        self._waiting = still
+
+        # run ONE prefill chunk for the first slot still prefilling
+        for slot in self.slots:
+            if slot is None or slot.prefill_pos >= len(slot.prompt):
+                continue
+            await self._prefill_chunk(slot)
+            return True
+        return False
+
+    def _try_admit(self, slot: _Slot) -> bool:
+        cfg = self.config
+        hashes = slot.seq.block_hashes()
+        cached_pages = (
+            self.allocator.acquire_cached(hashes) if cfg.enable_prefix_caching else []
+        )
+        n_cached = len(cached_pages)
+        total_pages_needed = (
+            len(slot.prompt) + slot.max_tokens + cfg.page_size - 1
+        ) // cfg.page_size
+        fresh_needed = max(total_pages_needed - n_cached, 0)
+        # allocate the prompt's remaining pages now; generation pages grow later
+        prompt_pages = (len(slot.prompt) + cfg.page_size - 1) // cfg.page_size
+        fresh_prompt = max(prompt_pages - n_cached, 0)
+        if not self.allocator.can_allocate(fresh_prompt + 1):
+            self.allocator.release(cached_pages, hashes[:n_cached])
+            return False
+        fresh = self.allocator.alloc_fresh(fresh_prompt)
+        if fresh is None:
+            self.allocator.release(cached_pages, hashes[:n_cached])
+            return False
+        idx = self._free_slots.pop()
+        slot.slot_idx = idx
+        slot.pages = cached_pages + fresh
+        slot.committed_hashes = hashes[:n_cached]
+        slot.prefill_pos = n_cached * cfg.page_size
+        # skip-ahead: if the whole prompt is cached, recompute the last token
+        # (need its logits) — back off one position
+        if slot.prefill_pos >= len(slot.prompt):
+            slot.prefill_pos = len(slot.prompt) - 1
+        self.slots[idx] = slot
+        # host state
+        self.page_tables[idx, :] = SCRATCH_PAGE
+        phys = [p + 1 for p in slot.pages]  # +1: scratch shift
+        self.page_tables[idx, : len(phys)] = phys
+        self.seq_lens[idx] = 0
+        self.temps[idx] = slot.temperature
+        self.top_ks[idx] = slot.top_k
+        self.top_ps[idx] = slot.top_p
+        return True
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.config.prefill_buckets:
+            if n <= b:
+                return b
+        return self.config.prefill_buckets[-1]
+
+    async def _prefill_chunk(self, slot: _Slot):
+        cfg = self.config
+        c = self.model_config
+        remaining = len(slot.prompt) - slot.prefill_pos
+        chunk = min(remaining, cfg.max_prefill_chunk)
+        bucket = self._bucket_for(chunk)
+        start = slot.prefill_pos
+        toks = slot.prompt[start : start + chunk]
+        positions = list(range(start, start + chunk))
+        # pad to bucket; pads write to the tail logical page -> scratch
+        pad = bucket - chunk
+        pad_pos = cfg.max_pages_per_seq * cfg.page_size - 1
+        toks = toks + [0] * pad
+        positions = positions + [pad_pos] * pad
+
+        def run_prefill():
+            table = jnp.asarray(self.page_tables[slot.slot_idx])
+            return self._prefill_step(
+                self.params,
+                self.kv_k,
+                self.kv_v,
+                jnp.asarray(np.array(toks, np.int32)),
+                jnp.asarray(np.array(positions, np.int32)),
+                table,
+                jnp.asarray(start, jnp.int32),
+                chunk - 1,
+                bucket,
+            )
+
+        logits, self.kv_k, self.kv_v = await self._run_on_device(run_prefill)
+        slot.prefill_pos += chunk
+        if slot.prefill_pos >= len(slot.prompt):
+            # prompt done: commit full prompt blocks to the prefix cache
+            self._commit_blocks(slot)
+            # sample the first token from the last real position's logits
+            self._rng, sub = jax.random.split(self._rng)
+            samp = SamplingParams(
+                temperature=jnp.asarray([slot.temperature], jnp.float32),
+                top_k=jnp.asarray([slot.top_k], jnp.int32),
+                top_p=jnp.asarray([slot.top_p], jnp.float32),
+            )
+            first = int(
+                await self._run_on_device(self._sample_one, logits, samp, sub)
+            )
+            self._emit_token(slot, first)
+            if not slot.done:
+                slot.last_token = first
+                slot.generated = 1
+                slot.seq.append(first)
+                self.tokens[slot.slot_idx] = first
+                self.seq_lens[slot.slot_idx] = len(slot.prompt) + 1
+                self._maybe_finish(slot, first)
+
+    def _commit_blocks(self, slot: _Slot):
+        """Bind filled prompt pages to their hashes -> prefix cache + events."""
+        hashes = slot.seq.block_hashes()
+        n_known = len(slot.committed_hashes)
+        prompt_full_blocks = len(slot.prompt) // self.config.page_size
+        new_hashes = hashes[n_known:prompt_full_blocks]
+        if new_hashes:
+            pages = slot.pages[n_known : n_known + len(new_hashes)]
+            token_blocks = [
+                b.tokens for b in slot.seq.blocks[n_known : n_known + len(new_hashes)]
+            ]
+            parent = slot.committed_hashes[-1] if slot.committed_hashes else None
+            self.allocator.commit_hashes(pages, new_hashes, token_blocks, parent)
+            slot.committed_hashes.extend(new_hashes)
+
+    # -- decode ---------------------------------------------------------- #
+
+    def _active_decode_indices(self) -> List[int]:
+        out = []
+        for i, slot in enumerate(self.slots):
+            if slot is None:
+                continue
+            if slot.prefill_pos >= len(slot.prompt) and slot.generated > 0:
+                out.append(i)
+        return out
+
+    async def _decode_all(self) -> bool:
+        active = self._active_decode_indices()
+        if not active:
+            return False
+        cfg = self.config
+        # grow pages for slots whose next write crosses a page boundary.
+        # seq_lens counts tokens INCLUDING the pending (last-sampled) token,
+        # whose KV is written this step at position seq_len - 1.
+        for i in active:
+            slot = self.slots[i]
+            pos = int(self.seq_lens[i]) - 1  # write position this step
+            needed_pages = pos // cfg.page_size + 1
+            while len(slot.pages) < needed_pages:
+                fresh = self.allocator.alloc_fresh(1)
+                if fresh is None:
+                    # out of pages: finish with length (simplest backpressure;
+                    # real preemption lands with the KVBM tiers)
+                    self._emit_finish(slot, "length")
+                    self._release_slot(slot)
+                    break
+                slot.pages.extend(fresh)
+                self.page_tables[i, len(slot.pages) - 1] = fresh[0] + 1
+
+        active = self._active_decode_indices()
+        if not active:
+            return False
+
+        B = cfg.max_num_seqs
+        positions = np.zeros((B,), np.int32)
+        mask = np.zeros((B,), bool)
+        for i in active:
+            positions[i] = self.seq_lens[i] - 1  # pending token's position
+            mask[i] = True
+        seq_lens_step = np.where(mask, self.seq_lens, 0).astype(np.int32)
+
+        self._rng, sub = jax.random.split(self._rng)
+
+        def run_decode():
+            samp = SamplingParams(
+                temperature=jnp.asarray(self.temps),
+                top_k=jnp.asarray(self.top_ks),
+                top_p=jnp.asarray(self.top_ps),
+            )
+            next_tokens, kv_k, kv_v = self._decode_step(
+                self.params,
+                self.kv_k,
+                self.kv_v,
+                jnp.asarray(self.tokens),
+                jnp.asarray(positions),
+                jnp.asarray(self.page_tables),
+                jnp.asarray(seq_lens_step),
+                samp,
+                sub,
+            )
+            return np.asarray(next_tokens), kv_k, kv_v
+
+        next_np, self.kv_k, self.kv_v = await self._run_on_device(run_decode)
+        self._step_counter += 1
+
+        for i in active:
+            slot = self.slots[i]
+            if slot is None:
+                continue
+            if slot.done or slot.context.is_stopped():
+                self._emit_finish(slot, "cancelled")
+                self._release_slot(slot)
+                continue
+            tok = int(next_np[i])
+            slot.seq.append(tok)
+            slot.generated += 1
+            slot.last_token = tok
+            self.tokens[i] = tok
+            self.seq_lens[i] += 1
+            self._emit_token(slot, tok)
+            self._maybe_finish(slot, tok)
+        return True
+
+    def _fail_all(self, message: str):
+        """A step raised: the batch state is unreliable. Error every live
+        request so callers can migrate/retry rather than hang."""
+        for slot in list(self.slots):
+            if slot is not None:
+                if not slot.done:
+                    slot.queue.put_nowait(Annotated.from_error(message).to_dict())
+                    slot.queue.put_nowait(None)
+                    slot.done = True
+                self._release_slot(slot)
+        for slot in self._waiting:
+            if not slot.done:
+                slot.queue.put_nowait(Annotated.from_error(message).to_dict())
+                slot.queue.put_nowait(None)
+                slot.done = True
+        self._waiting = []
+
+    # -- emission / teardown --------------------------------------------- #
+
+    def _emit_token(self, slot: _Slot, token: int):
+        if slot.done:
+            return
+        out = LLMEngineOutput(token_ids=[token]).to_dict()
+        slot.queue.put_nowait(Annotated(data=out).to_dict())
+
+    def _maybe_finish(self, slot: _Slot, token: int):
+        finish = None
+        if (
+            not slot.ignore_eos
+            and slot.generated >= slot.min_tokens
+            and (token in slot.eos_ids or token in slot.stop_token_ids)
+        ):
+            finish = "eos"
+        elif slot.generated >= slot.max_tokens:
+            finish = "length"
+        if finish:
+            self._emit_finish(slot, finish)
+            self._release_slot(slot)
+
+    def _emit_finish(self, slot: _Slot, reason: str):
+        if not slot.done:
+            out = LLMEngineOutput(token_ids=[], finish_reason=reason).to_dict()
+            slot.queue.put_nowait(Annotated(data=out).to_dict())
+            slot.queue.put_nowait(None)
+            slot.done = True
+
+    def _release_slot(self, slot: _Slot):
+        if slot.slot_idx >= 0 and self.slots[slot.slot_idx] is slot:
+            # commit any full generated blocks before release so decode KV is
+            # reusable (conversation prefix reuse)
+            self._commit_generated_blocks(slot)
+            self.allocator.release(slot.pages, slot.committed_hashes)
+            self.slots[slot.slot_idx] = None
+            self._free_slots.append(slot.slot_idx)
+            self.page_tables[slot.slot_idx, :] = SCRATCH_PAGE
+            self.seq_lens[slot.slot_idx] = 0
+            slot.slot_idx = -1
+
+    def _commit_generated_blocks(self, slot: _Slot):
+        hashes = slot.seq.block_hashes()
+        n_known = len(slot.committed_hashes)
+        full_blocks = len(slot.seq.blocks)
+        # only blocks whose pages exist
+        max_by_pages = min(full_blocks, len(slot.pages))
+        new_hashes = hashes[n_known:max_by_pages]
+        if new_hashes:
+            pages = slot.pages[n_known : n_known + len(new_hashes)]
+            token_blocks = [
+                b.tokens for b in slot.seq.blocks[n_known : n_known + len(new_hashes)]
+            ]
+            parent = slot.committed_hashes[-1] if slot.committed_hashes else None
+            self.allocator.commit_hashes(pages, new_hashes, token_blocks, parent)
+            slot.committed_hashes.extend(new_hashes)
+
+
+def _resolve_model(name: str) -> llama.LlamaConfig:
+    registry = {
+        "tiny": llama.LlamaConfig.tiny,
+        "llama3-3b": llama.LlamaConfig.llama3_2_3b,
+        "llama3-8b": llama.LlamaConfig.llama3_8b,
+        "llama3-70b": llama.LlamaConfig.llama3_70b,
+    }
+    if name in registry:
+        return registry[name]()
+    raise ValueError(f"unknown model {name!r}; known: {sorted(registry)}")

@@ -1,0 +1,169 @@
+"""Paged KV cache: device arrays + host-side page allocator with prefix reuse.
+
+The TPU analogue of vLLM's paged KV + the reference mocker's KvManager:
+  * device side: kv_k/kv_v [layers, num_pages, page_size, kv_heads, head_dim]
+    (sharded over the tp axis on the kv_heads dim)
+  * host side: free-list page allocator; pages keyed by chained block hash
+    for prefix reuse (same hashes the router indexes, llm/tokens.py), with
+    LRU eviction of unreferenced cached pages and KV stored/removed events.
+"""
+
+from __future__ import annotations
+
+import logging
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..llm.mocker.kv_manager import KvEvent
+
+logger = logging.getLogger(__name__)
+
+
+def alloc_kv_arrays(
+    num_layers: int,
+    num_pages: int,
+    page_size: int,
+    num_kv_heads: int,
+    head_dim: int,
+    dtype=jnp.bfloat16,
+    sharding=None,
+) -> Tuple[jax.Array, jax.Array]:
+    shape = (num_layers, num_pages, page_size, num_kv_heads, head_dim)
+    kv_k = jnp.zeros(shape, dtype)
+    kv_v = jnp.zeros(shape, dtype)
+    if sharding is not None:
+        kv_k = jax.device_put(kv_k, sharding)
+        kv_v = jax.device_put(kv_v, sharding)
+    return kv_k, kv_v
+
+
+@dataclass
+class _CachedPage:
+    page_id: int
+    seq_hash: int
+    ref_count: int = 0
+
+
+class PageAllocator:
+    """Host-side page pool with hash-keyed prefix cache
+    (engine counterpart of mocker KvManager; emits the same KV events)."""
+
+    def __init__(
+        self,
+        num_pages: int,
+        page_size: int,
+        event_sink: Optional[Callable[[KvEvent], None]] = None,
+    ):
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self.event_sink = event_sink
+        self._free: List[int] = list(range(num_pages - 1, -1, -1))
+        self._by_hash: Dict[int, _CachedPage] = {}
+        self._lru: OrderedDict[int, None] = OrderedDict()  # seq_hash -> None
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free) + len(self._lru)
+
+    @property
+    def used_pages(self) -> int:
+        return self.num_pages - len(self._free)
+
+    @property
+    def active_pages(self) -> int:
+        """Pages referenced by live sequences (excludes LRU-cached)."""
+        return self.used_pages - len(self._lru)
+
+    def cached_prefix(self, seq_hashes: List[int]) -> List[int]:
+        """Physical pages of the longest cached prefix."""
+        pages = []
+        for h in seq_hashes:
+            page = self._by_hash.get(h)
+            if page is None:
+                break
+            pages.append(page.page_id)
+        return pages
+
+    def can_allocate(self, n_new_pages: int) -> bool:
+        return n_new_pages <= self.free_pages
+
+    def acquire_cached(self, seq_hashes: List[int]) -> List[int]:
+        """Reference the cached prefix pages; returns physical page ids."""
+        out = []
+        for h in seq_hashes:
+            page = self._by_hash.get(h)
+            if page is None:
+                break
+            if page.ref_count == 0:
+                self._lru.pop(h, None)
+            page.ref_count += 1
+            out.append(page.page_id)
+        return out
+
+    def alloc_fresh(self, n: int) -> Optional[List[int]]:
+        """Allocate n un-hashed (in-flight) pages, evicting cached pages as
+        needed."""
+        while len(self._free) < n and self._lru:
+            self._evict_one()
+        if len(self._free) < n:
+            return None
+        return [self._free.pop() for _ in range(n)]
+
+    def commit_hashes(self, pages: List[int], seq_hashes: List[int], token_blocks=None, parent_hash=None):
+        """Bind freshly filled pages to their block hashes (after prefill or
+        after a generation block completes) -> emits `stored`."""
+        stored = []
+        for page_id, h in zip(pages, seq_hashes):
+            if h in self._by_hash:
+                continue  # already cached by a concurrent sequence
+            self._by_hash[h] = _CachedPage(page_id, h, ref_count=1)
+            stored.append(h)
+        if stored and self.event_sink:
+            self.event_sink(
+                KvEvent("stored", stored, parent_hash=parent_hash, token_blocks=token_blocks)
+            )
+
+    def release(self, pages: List[int], seq_hashes: List[int]):
+        """Release a sequence's pages. Hashed pages go to LRU cache;
+        un-hashed (partial) pages return to the free list."""
+        hashed_pages = {}
+        for h in seq_hashes:
+            p = self._by_hash.get(h)
+            if p is not None:
+                hashed_pages[p.page_id] = p
+        for page_id in pages:
+            page = hashed_pages.get(page_id)
+            if page is None:
+                self._free.append(page_id)
+            else:
+                page.ref_count -= 1
+                if page.ref_count <= 0:
+                    page.ref_count = 0
+                    self._lru[page.seq_hash] = None
+                    self._lru.move_to_end(page.seq_hash)
+
+    def _evict_one(self):
+        h, _ = self._lru.popitem(last=False)
+        page = self._by_hash.pop(h)
+        self._free.append(page.page_id)
+        if self.event_sink:
+            self.event_sink(KvEvent("removed", [h]))
+
+    def clear_cache(self) -> int:
+        n = 0
+        while self._lru:
+            self._evict_one()
+            n += 1
+        return n
+
+    def stats(self) -> dict:
+        return {
+            "kv_active_blocks": self.used_pages - len(self._lru),
+            "kv_total_blocks": self.num_pages,
+            "kv_cached_blocks": len(self._lru),
+        }

@@ -1,0 +1,95 @@
+"""On-device sampling: greedy / temperature / top-k / top-p, fully batched.
+
+TPU-first: sampling runs inside the jitted decode step (no logits transfer
+to host). Top-p is computed within a fixed top-K candidate set (K=64) so the
+whole thing is static-shaped and cheap even at 128k vocab.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+TOPK_CAP = 64
+
+
+class SamplingParams(NamedTuple):
+    """Per-slot device-resident sampling state."""
+
+    temperature: jax.Array  # [B] f32; <=0 means greedy
+    top_k: jax.Array  # [B] i32; 0 = disabled
+    top_p: jax.Array  # [B] f32; 1.0 = disabled
+
+    @classmethod
+    def full(cls, batch: int, temperature=0.0, top_k=0, top_p=1.0):
+        return cls(
+            temperature=jnp.full((batch,), temperature, jnp.float32),
+            top_k=jnp.full((batch,), top_k, jnp.int32),
+            top_p=jnp.full((batch,), top_p, jnp.float32),
+        )
+
+
+def sample(
+    logits: jax.Array,  # [B, V] f32
+    params: SamplingParams,
+    key: jax.Array,
+) -> jax.Array:
+    """Returns sampled token ids [B]."""
+    B, V = logits.shape
+    greedy_tokens = jnp.argmax(logits, axis=-1)
+
+    # candidate set: top TOPK_CAP logits per row
+    cand_logits, cand_idx = jax.lax.top_k(logits, min(TOPK_CAP, V))  # [B, K]
+    K = cand_logits.shape[1]
+
+    temp = jnp.maximum(params.temperature, 1e-6)[:, None]
+    scaled = cand_logits / temp
+
+    # top-k mask within candidates (top_k<=0 or >K -> disabled)
+    k_eff = jnp.where(
+        (params.top_k <= 0) | (params.top_k > K), K, params.top_k
+    )  # [B]
+    rank = jnp.arange(K)[None, :]
+    scaled = jnp.where(rank < k_eff[:, None], scaled, -jnp.inf)
+
+    # top-p (nucleus) within candidates: keep the smallest prefix of the
+    # sorted probs with cumulative mass >= top_p (candidates are sorted desc)
+    probs = jax.nn.softmax(scaled, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = (cum - probs) < params.top_p[:, None]  # always keeps the first
+    scaled = jnp.where(keep, scaled, -jnp.inf)
+
+    sampled_pos = jax.random.categorical(key, scaled, axis=-1)  # [B]
+    sampled_tokens = jnp.take_along_axis(cand_idx, sampled_pos[:, None], axis=1)[:, 0]
+
+    return jnp.where(params.temperature <= 0.0, greedy_tokens, sampled_tokens)
+
+
+def apply_logit_penalties(
+    logits: jax.Array,  # [B, V]
+    recent_tokens: jax.Array,  # [B, W] window of recent token ids (pad = -1)
+    presence_penalty: jax.Array,  # [B]
+    frequency_penalty: jax.Array,  # [B]
+    repetition_penalty: jax.Array,  # [B] 1.0 = off
+) -> jax.Array:
+    """OpenAI-style penalties over a recent-token window, batched on device."""
+    B, V = logits.shape
+    W = recent_tokens.shape[1]
+    valid = recent_tokens >= 0
+    safe = jnp.where(valid, recent_tokens, 0)
+    counts = jnp.zeros((B, V), jnp.float32).at[
+        jnp.arange(B)[:, None], safe
+    ].add(valid.astype(jnp.float32))
+    present = counts > 0
+    logits = logits - presence_penalty[:, None] * present
+    logits = logits - frequency_penalty[:, None] * counts
+    rep = repetition_penalty[:, None]
+    logits = jnp.where(
+        present & (rep != 1.0),
+        jnp.where(logits > 0, logits / rep, logits * rep),
+        logits,
+    )
+    return logits

@@ -1,0 +1,134 @@
+"""JAX engine worker: `python -m dynamo_tpu.jax_worker`.
+
+Mirrors the reference vLLM worker wiring (components/backends/vllm main.py:
+64,209 — create service, build engine, publish KV events + metrics,
+register_llm, serve_endpoint) with the native JAX engine underneath.
+"""
+
+import argparse
+import asyncio
+import logging
+
+from dynamo_tpu.engine import EngineConfig, JaxEngine
+from dynamo_tpu.llm.kv_router.publisher import KvEventPublisher, WorkerMetricsPublisher
+from dynamo_tpu.llm.model_card import ModelDeploymentCard, register_llm
+from dynamo_tpu.runtime import DistributedRuntime, RuntimeConfig, init_logging
+
+logger = logging.getLogger("dynamo_tpu.jax_worker")
+
+
+def parse_args():
+    ap = argparse.ArgumentParser(description="dynamo-tpu JAX engine worker")
+    ap.add_argument("--model", default="tiny", help="model registry key (tiny/llama3-8b/llama3-70b)")
+    ap.add_argument("--model-name", default=None, help="served model name (defaults to --model)")
+    ap.add_argument("--namespace", default="dynamo")
+    ap.add_argument("--component", default="backend")
+    ap.add_argument("--endpoint", default="generate")
+    ap.add_argument("--discovery", default=None)
+    ap.add_argument("--page-size", type=int, default=64)
+    ap.add_argument("--num-pages", type=int, default=2048)
+    ap.add_argument("--max-num-seqs", type=int, default=64)
+    ap.add_argument("--max-model-len", type=int, default=8192)
+    ap.add_argument("--tp-size", type=int, default=1)
+    ap.add_argument("--kv-events", action="store_true")
+    ap.add_argument("--migration-limit", type=int, default=3)
+    ap.add_argument("--context-length", type=int, default=None)
+    return ap.parse_args()
+
+
+async def main():
+    init_logging()
+    args = parse_args()
+
+    engine_cfg = EngineConfig(
+        model=args.model,
+        page_size=args.page_size,
+        num_pages=args.num_pages,
+        max_num_seqs=args.max_num_seqs,
+        max_model_len=args.max_model_len,
+        tp_size=args.tp_size,
+    )
+
+    kv_sharding = None
+    params = None
+    model_config = None
+    if args.tp_size > 1:
+        from dynamo_tpu.models import llama
+        from dynamo_tpu.parallel.mesh import (
+            LlamaShardings,
+            ParallelConfig,
+            build_mesh,
+            shard_params,
+        )
+        import jax
+
+        mesh = build_mesh(ParallelConfig(tp_size=args.tp_size))
+        shardings = LlamaShardings(mesh)
+        from dynamo_tpu.engine.engine import _resolve_model
+
+        model_config = _resolve_model(args.model)
+        params = llama.init_params(model_config, jax.random.PRNGKey(engine_cfg.seed))
+        params = shard_params(params, shardings)
+        kv_sharding = shardings.kv_sharding()
+
+    # build the engine BEFORE joining the control plane: param init can take
+    # tens of seconds and must not eat into the discovery lease
+    pending_events = []
+    engine = JaxEngine(
+        engine_cfg,
+        model_config=model_config,
+        params=params,
+        kv_sharding=kv_sharding,
+        event_sink=pending_events.append,
+    )
+
+    cfg = RuntimeConfig.from_settings()
+    if args.discovery:
+        cfg.discovery_endpoint = args.discovery
+    drt = await DistributedRuntime.create(cfg)
+    endpoint = (
+        drt.namespace(args.namespace).component(args.component).endpoint(args.endpoint)
+    )
+
+    publisher = None
+    if args.kv_events:
+        publisher = KvEventPublisher(drt, endpoint, drt.instance_id)
+        await publisher.start()
+        for ev in pending_events:
+            publisher.publish(ev)
+        engine.allocator.event_sink = publisher.publish
+    else:
+        engine.allocator.event_sink = None
+    pending_events.clear()
+
+    metrics_pub = WorkerMetricsPublisher(drt, endpoint, drt.instance_id, engine.stats)
+    await metrics_pub.start()
+
+    model_name = args.model_name or args.model
+    card = ModelDeploymentCard(
+        name=model_name,
+        tokenizer="byte",
+        kv_cache_block_size=args.page_size,
+        context_length=args.context_length or args.max_model_len,
+        migration_limit=args.migration_limit,
+    )
+    await register_llm(endpoint, card)
+
+    async def handler(request, context):
+        if "worker_instance_id" in (request.get("annotations") or []):
+            yield {"event": "worker_instance_id", "comment": [f"{drt.instance_id:x}"]}
+        async for item in engine.generate(request, context):
+            yield item
+
+    logger.info(
+        "jax worker up: model=%s tp=%d instance=%x",
+        model_name,
+        args.tp_size,
+        drt.instance_id,
+    )
+    await endpoint.serve_endpoint(handler)
+    await drt.wait_for_shutdown()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -79,6 +79,5 @@ async def register_llm(
         "endpoint": endpoint.name,
         "instance_id": drt.instance_id,
     }
-    if drt.discovery is not None:
-        await drt.discovery.put(key, json.dumps(payload).encode(), drt.primary_lease)
+    await drt.put_leased(key, json.dumps(payload).encode())
     return key

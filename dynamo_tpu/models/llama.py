@@ -1,0 +1,287 @@
+"""Llama-family model in functional JAX (param pytrees, no framework).
+
+The TPU engine's flagship dense architecture: RMSNorm, rotary embeddings,
+GQA attention over a PAGED KV cache, SwiGLU MLP. Equivalent role to the
+engine-side model implementations the reference delegates to vLLM/TRT-LLM
+(SURVEY.md §2.5: TP must be implemented natively here).
+
+Design notes (TPU-first):
+  * all matmuls bf16 on the MXU; accumulation f32 via preferred_element_type
+  * static shapes everywhere: prefill takes a fixed [chunk] token block,
+    decode takes the full [max_seqs] slot batch with masking
+  * KV cache is paged: [layers, pages, page_size, kv_heads, head_dim]; the
+    engine passes page tables; attention gathers pages (ops/paged_attention)
+  * tensor parallel: heads and MLP hidden sharded over the "tp" mesh axis
+    via NamedSharding on params + cache (parallel/sharding.py); XLA inserts
+    the all-reduces (scaling-book recipe), no manual collectives needed
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.paged_attention import paged_attention_decode, prefill_attention
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    head_dim: int = 128
+    rope_theta: float = 500000.0
+    rms_norm_eps: float = 1e-5
+    max_position: int = 8192
+    dtype: Any = jnp.bfloat16
+    tie_embeddings: bool = False
+
+    @classmethod
+    def llama3_8b(cls, **overrides):
+        return cls(
+            vocab_size=128256,
+            hidden_size=4096,
+            intermediate_size=14336,
+            num_layers=32,
+            num_heads=32,
+            num_kv_heads=8,
+            head_dim=128,
+            **overrides,
+        )
+
+    @classmethod
+    def llama3_70b(cls, **overrides):
+        return cls(
+            vocab_size=128256,
+            hidden_size=8192,
+            intermediate_size=28672,
+            num_layers=80,
+            num_heads=64,
+            num_kv_heads=8,
+            head_dim=128,
+            **overrides,
+        )
+
+    @classmethod
+    def llama3_2_3b(cls, **overrides):
+        """Llama-3.2-3B: the single-v5e-chip flagship (≈6.4GB bf16 params)."""
+        return cls(
+            vocab_size=128256,
+            hidden_size=3072,
+            intermediate_size=8192,
+            num_layers=28,
+            num_heads=24,
+            num_kv_heads=8,
+            head_dim=128,
+            tie_embeddings=True,
+            **overrides,
+        )
+
+    @classmethod
+    def tiny(cls, **overrides):
+        """CPU-test scale."""
+        kw = dict(
+            vocab_size=512,
+            hidden_size=64,
+            intermediate_size=128,
+            num_layers=2,
+            num_heads=4,
+            num_kv_heads=2,
+            head_dim=16,
+            max_position=512,
+        )
+        kw.update(overrides)
+        return cls(**kw)
+
+
+def init_params(config: LlamaConfig, key: jax.Array) -> Dict[str, Any]:
+    """Random-init parameter pytree (shape-compatible with HF llama weights;
+    the loader maps safetensors onto the same tree when weights exist)."""
+    c = config
+    k_embed, k_layers, k_out = jax.random.split(key, 3)
+    scale = 0.02
+
+    def dense(k, shape):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(c.dtype)
+
+    layers = []
+    keys = jax.random.split(k_layers, c.num_layers)
+    q_dim = c.num_heads * c.head_dim
+    kv_dim = c.num_kv_heads * c.head_dim
+    for lk in keys:
+        k1, k2, k3, k4, k5, k6, k7 = jax.random.split(lk, 7)
+        layers.append(
+            {
+                "attn_norm": jnp.ones((c.hidden_size,), c.dtype),
+                "wq": dense(k1, (c.hidden_size, q_dim)),
+                "wk": dense(k2, (c.hidden_size, kv_dim)),
+                "wv": dense(k3, (c.hidden_size, kv_dim)),
+                "wo": dense(k4, (q_dim, c.hidden_size)),
+                "mlp_norm": jnp.ones((c.hidden_size,), c.dtype),
+                "w_gate": dense(k5, (c.hidden_size, c.intermediate_size)),
+                "w_up": dense(k6, (c.hidden_size, c.intermediate_size)),
+                "w_down": dense(k7, (c.intermediate_size, c.hidden_size)),
+            }
+        )
+    params = {
+        "embed": dense(k_embed, (c.vocab_size, c.hidden_size)),
+        "layers": jax.tree.map(lambda *xs: jnp.stack(xs), *layers),
+        "final_norm": jnp.ones((c.hidden_size,), c.dtype),
+        "lm_head": None if c.tie_embeddings else dense(k_out, (c.hidden_size, c.vocab_size)),
+    }
+    return params
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    return (x32 * jax.lax.rsqrt(var + eps)).astype(x.dtype) * weight
+
+
+def rope_cos_sin(positions: jax.Array, head_dim: int, theta: float):
+    """positions [...,] -> cos/sin [..., head_dim//2] (f32)."""
+    inv_freq = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """x [..., heads, head_dim]; cos/sin broadcast over heads."""
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    cos = cos[..., None, :]
+    sin = sin[..., None, :]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _mlp(layer, x, c: LlamaConfig):
+    h = rms_norm(x, layer["mlp_norm"], c.rms_norm_eps)
+    gate = jnp.dot(h, layer["w_gate"], preferred_element_type=jnp.float32)
+    up = jnp.dot(h, layer["w_up"], preferred_element_type=jnp.float32)
+    act = (jax.nn.silu(gate) * up).astype(c.dtype)
+    return x + jnp.dot(act, layer["w_down"], preferred_element_type=jnp.float32).astype(c.dtype)
+
+
+def prefill_forward(
+    params: Dict[str, Any],
+    config: LlamaConfig,
+    tokens: jax.Array,  # [chunk]
+    positions: jax.Array,  # [chunk] absolute positions
+    kv_k: jax.Array,  # [L, pages, page_size, kv_heads, head_dim]
+    kv_v: jax.Array,
+    page_table: jax.Array,  # [max_pages] pages of THIS sequence
+    context_len: jax.Array,  # scalar: positions[<context_len] are valid history
+    last_idx: Optional[jax.Array] = None,  # index of the last REAL token in the
+    # (possibly padded) chunk; defaults to the final position
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Process one prompt chunk of a single sequence; returns
+    (logits_last [vocab], kv_k, kv_v) with the chunk's KV written into pages.
+
+    Chunked prefill: the chunk attends causally to itself AND to already-
+    written history via the page table (positions < chunk start).
+    """
+    c = config
+    x = params["embed"][tokens]  # [T, H]
+    cos, sin = rope_cos_sin(positions, c.head_dim, c.rope_theta)
+    page_size = kv_k.shape[2]
+
+    def body(x, kv_k, kv_v):
+        new_k_chunks = []
+        new_v_chunks = []
+        for li in range(c.num_layers):
+            layer = jax.tree.map(lambda p: p[li], params["layers"])
+            h = rms_norm(x, layer["attn_norm"], c.rms_norm_eps)
+            q = jnp.dot(h, layer["wq"], preferred_element_type=jnp.float32).astype(c.dtype)
+            k = jnp.dot(h, layer["wk"], preferred_element_type=jnp.float32).astype(c.dtype)
+            v = jnp.dot(h, layer["wv"], preferred_element_type=jnp.float32).astype(c.dtype)
+            q = q.reshape(-1, c.num_heads, c.head_dim)
+            k = k.reshape(-1, c.num_kv_heads, c.head_dim)
+            v = v.reshape(-1, c.num_kv_heads, c.head_dim)
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
+            # write chunk KV into the pages for this sequence
+            kv_k = _write_chunk(kv_k, li, k, positions, page_table, page_size)
+            kv_v = _write_chunk(kv_v, li, v, positions, page_table, page_size)
+            attn = prefill_attention(
+                q, k, v, kv_k[li], kv_v[li], positions, page_table, context_len
+            )
+            attn = attn.reshape(-1, c.num_heads * c.head_dim)
+            x = x + jnp.dot(attn, layer["wo"], preferred_element_type=jnp.float32).astype(c.dtype)
+            x = _mlp(layer, x, c)
+        return x, kv_k, kv_v
+
+    x, kv_k, kv_v = body(x, kv_k, kv_v)
+    x = rms_norm(x, params["final_norm"], c.rms_norm_eps)
+    last = x[-1] if last_idx is None else x[last_idx]
+    head = params["lm_head"] if params["lm_head"] is not None else params["embed"].T
+    logits = jnp.dot(last, head, preferred_element_type=jnp.float32)
+    return logits, kv_k, kv_v
+
+
+def _write_chunk(kv, layer_idx, vals, positions, page_table, page_size):
+    """Scatter chunk KV [T, kv_heads, head_dim] into paged cache at absolute
+    positions (page_table maps logical page -> physical page)."""
+    logical_pages = positions // page_size
+    phys_pages = page_table[logical_pages]
+    offs = positions % page_size
+    return kv.at[layer_idx, phys_pages, offs].set(vals)
+
+
+def decode_forward(
+    params: Dict[str, Any],
+    config: LlamaConfig,
+    tokens: jax.Array,  # [B] one new token per slot
+    positions: jax.Array,  # [B]
+    kv_k: jax.Array,
+    kv_v: jax.Array,
+    page_tables: jax.Array,  # [B, max_pages]
+    seq_lens: jax.Array,  # [B] lengths INCLUDING the new token
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One decode step for the whole slot batch; returns
+    (logits [B, vocab], kv_k, kv_v)."""
+    c = config
+    x = params["embed"][tokens]  # [B, H]
+    cos, sin = rope_cos_sin(positions, c.head_dim, c.rope_theta)
+    page_size = kv_k.shape[2]
+
+    for li in range(c.num_layers):
+        layer = jax.tree.map(lambda p: p[li], params["layers"])
+        h = rms_norm(x, layer["attn_norm"], c.rms_norm_eps)
+        q = jnp.dot(h, layer["wq"], preferred_element_type=jnp.float32).astype(c.dtype)
+        k = jnp.dot(h, layer["wk"], preferred_element_type=jnp.float32).astype(c.dtype)
+        v = jnp.dot(h, layer["wv"], preferred_element_type=jnp.float32).astype(c.dtype)
+        q = q.reshape(-1, c.num_heads, c.head_dim)
+        k = k.reshape(-1, c.num_kv_heads, c.head_dim)
+        v = v.reshape(-1, c.num_kv_heads, c.head_dim)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        # write each slot's new KV at its position
+        logical = positions // page_size
+        phys = jnp.take_along_axis(page_tables, logical[:, None], axis=1)[:, 0]
+        offs = positions % page_size
+        kv_k = kv_k.at[li, phys, offs].set(k[:, 0] if k.ndim == 4 else k)
+        kv_v = kv_v.at[li, phys, offs].set(v[:, 0] if v.ndim == 4 else v)
+        attn = paged_attention_decode(q, kv_k[li], kv_v[li], page_tables, seq_lens)
+        attn = attn.reshape(-1, c.num_heads * c.head_dim)
+        x = x + jnp.dot(attn, layer["wo"], preferred_element_type=jnp.float32).astype(c.dtype)
+        x = _mlp(layer, x, c)
+
+    x = rms_norm(x, params["final_norm"], c.rms_norm_eps)
+    head = params["lm_head"] if params["lm_head"] is not None else params["embed"].T
+    logits = jnp.dot(x, head, preferred_element_type=jnp.float32)
+    return logits, kv_k, kv_v
+
+
+def param_count(params) -> int:
+    return sum(x.size for x in jax.tree.leaves(params) if x is not None)

@@ -1,0 +1,87 @@
+"""Paged attention ops: XLA reference implementations.
+
+The role of the reference's engine attention kernels + block_copy.cu, done
+the TPU way: static-shaped gathers + einsums that XLA fuses well on the MXU,
+with a Pallas decode kernel (ops/pallas_paged_attention.py) swapped in on
+TPU for the HBM-bound gather.
+
+Layouts:
+  kv_k / kv_v (per layer): [num_pages, page_size, kv_heads, head_dim]
+  page_table: logical page index -> physical page id
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def prefill_attention(
+    q: jax.Array,  # [T, H, D] (current chunk, rope applied)
+    k_chunk: jax.Array,  # [T, KH, D] (unused: already written to pages)
+    v_chunk: jax.Array,
+    kv_k_layer: jax.Array,  # [pages, page_size, KH, D]
+    kv_v_layer: jax.Array,
+    positions: jax.Array,  # [T] absolute positions of the chunk
+    page_table: jax.Array,  # [max_pages]
+    context_len: jax.Array,  # scalar (history before this chunk)
+) -> jax.Array:
+    """Chunk attends to all earlier positions (history pages + itself,
+    causal). Returns [T, H, D]."""
+    page_size = kv_k_layer.shape[1]
+    S = page_table.shape[0] * page_size
+    ctx_k = kv_k_layer[page_table].reshape(S, *kv_k_layer.shape[2:])  # [S, KH, D]
+    ctx_v = kv_v_layer[page_table].reshape(S, *kv_v_layer.shape[2:])
+
+    T, H, D = q.shape
+    KH = ctx_k.shape[1]
+    G = H // KH
+    qg = q.reshape(T, KH, G, D)
+    scores = jnp.einsum(
+        "tkgd,skd->tkgs", qg, ctx_k, preferred_element_type=jnp.float32
+    ) / jnp.sqrt(jnp.asarray(D, jnp.float32))
+    # causal over absolute positions: key j valid iff j <= pos_t
+    key_pos = jnp.arange(S)
+    mask = key_pos[None, :] <= positions[:, None]  # [T, S]
+    scores = jnp.where(mask[:, None, None, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("tkgs,skd->tkgd", probs.astype(ctx_v.dtype), ctx_v)
+    return out.reshape(T, H, D)
+
+
+def paged_attention_decode(
+    q: jax.Array,  # [B, H, D]
+    kv_k_layer: jax.Array,  # [pages, page_size, KH, D]
+    kv_v_layer: jax.Array,
+    page_tables: jax.Array,  # [B, max_pages]
+    seq_lens: jax.Array,  # [B] (including current token)
+) -> jax.Array:
+    """One-token decode attention over paged KV. Returns [B, H, D].
+
+    XLA reference path: gathers each slot's pages ([B, S, KH, D]) and runs a
+    masked GQA softmax-attention einsum. The Pallas TPU kernel replaces the
+    materialized gather on real hardware.
+    """
+    B, H, D = q.shape
+    page_size = kv_k_layer.shape[1]
+    KH = kv_k_layer.shape[2]
+    S = page_tables.shape[1] * page_size
+    ctx_k = kv_k_layer[page_tables].reshape(B, S, KH, D)
+    ctx_v = kv_v_layer[page_tables].reshape(B, S, KH, D)
+
+    G = H // KH
+    qg = q.reshape(B, KH, G, D)
+    scores = jnp.einsum(
+        "bkgd,bskd->bkgs", qg, ctx_k, preferred_element_type=jnp.float32
+    ) / jnp.sqrt(jnp.asarray(D, jnp.float32))
+    key_pos = jnp.arange(S)
+    mask = key_pos[None, :] < seq_lens[:, None]  # [B, S]
+    scores = jnp.where(mask[:, None, None, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgs,bskd->bkgd", probs.astype(ctx_v.dtype), ctx_v)
+    return out.reshape(B, H, D)

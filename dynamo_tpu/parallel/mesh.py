@@ -1,0 +1,100 @@
+"""Device mesh construction + named shardings for the engine.
+
+The TPU-native replacement for the reference's engine-delegated TP/PP/EP
+flags (SURVEY.md §2.5): a `jax.sharding.Mesh` with axes
+
+    dp — data parallel (replica) axis
+    tp — tensor parallel axis (attention heads / MLP hidden / vocab)
+    ep — expert parallel axis for MoE (aliases tp by default)
+
+Params and KV cache carry NamedShardings; jit'd steps run under GSPMD and
+XLA inserts all-reduces over ICI (scaling-book recipe). No manual
+collectives on the inference path.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclass(frozen=True)
+class ParallelConfig:
+    tp_size: int = 1
+    dp_size: int = 1
+
+    @property
+    def world(self) -> int:
+        return self.tp_size * self.dp_size
+
+
+def build_mesh(parallel: ParallelConfig, devices=None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    n = parallel.world
+    if len(devices) < n:
+        raise ValueError(f"need {n} devices, have {len(devices)}")
+    grid = np.asarray(devices[:n]).reshape(parallel.dp_size, parallel.tp_size)
+    return Mesh(grid, axis_names=("dp", "tp"))
+
+
+@dataclass(frozen=True)
+class LlamaShardings:
+    """PartitionSpecs for the llama param tree + KV cache + activations.
+
+    Megatron-style TP: column-parallel wq/wk/wv/w_gate/w_up (output dim over
+    tp), row-parallel wo/w_down (input dim over tp) — one all-reduce per
+    block, inserted by XLA from these specs.
+    """
+
+    mesh: Mesh
+
+    def param_specs(self) -> dict:
+        return {
+            "embed": P(None, "tp"),  # hidden sharded
+            "layers": {
+                "attn_norm": P(None),
+                "wq": P(None, None, "tp"),  # [L, H, q_dim/tp]
+                "wk": P(None, None, "tp"),
+                "wv": P(None, None, "tp"),
+                "wo": P(None, "tp", None),  # row-parallel
+                "mlp_norm": P(None),
+                "w_gate": P(None, None, "tp"),
+                "w_up": P(None, None, "tp"),
+                "w_down": P(None, "tp", None),
+            },
+            "final_norm": P(None),
+            "lm_head": P(None, "tp"),  # vocab sharded on output
+        }
+
+    def param_shardings(self) -> dict:
+        return jax.tree.map(
+            lambda spec: NamedSharding(self.mesh, spec),
+            self.param_specs(),
+            is_leaf=lambda x: isinstance(x, P),
+        )
+
+    def kv_sharding(self) -> NamedSharding:
+        # [layers, pages, page_size, kv_heads, head_dim]: kv heads over tp
+        return NamedSharding(self.mesh, P(None, None, None, "tp", None))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+
+def shard_params(params: dict, shardings: LlamaShardings) -> dict:
+    """Place a param pytree onto the mesh (works for freshly-initialized or
+    loaded params)."""
+    shard_tree = shardings.param_shardings()
+
+    def place(x, s):
+        if x is None:
+            return None
+        return jax.device_put(x, s)
+
+    return jax.tree.map(
+        place, params, shard_tree, is_leaf=lambda x: x is None
+    )

@@ -84,6 +84,7 @@ class DistributedRuntime:
         self.client = RequestPlaneClient()
         self._server_started = False
         self._namespaces: Dict[str, Namespace] = {}
+        self._leased_keys: Dict[str, bytes] = {}
         self._shutdown = asyncio.Event()
         self.etcd_root = ""  # prefix for multi-tenant stores (unused for now)
 
@@ -108,7 +109,24 @@ class DistributedRuntime:
                     drt._embedded_discovery = None  # someone else already runs it
             drt.discovery = await DiscoveryClient.connect(host, port)
             drt.primary_lease = await drt.discovery.grant_lease(ttl=10.0)
+            drt.primary_lease.on_lost = drt._republish_leased_keys
         return drt
+
+    async def _republish_leased_keys(self, lease):
+        """The primary lease expired (event loop stalled past TTL, e.g. long
+        XLA compile) and was re-granted: restore every registration."""
+        for key, value in list(self._leased_keys.items()):
+            try:
+                await self.discovery.put(key, value, lease)
+            except (ConnectionError, RuntimeError):
+                logger.warning("failed to re-publish %s after lease re-grant", key)
+
+    async def put_leased(self, key: str, value: bytes):
+        """Put a key under the primary lease and remember it so it survives
+        lease re-grants."""
+        self._leased_keys[key] = value
+        if self.discovery is not None:
+            await self.discovery.put(key, value, self.primary_lease)
 
     async def ensure_server(self) -> str:
         """Start the request-plane server on first use; returns host:port."""
@@ -218,8 +236,7 @@ class Endpoint:
             address=address,
             subject=self.subject,
         )
-        if drt.discovery is not None:
-            await drt.discovery.put(instance.path, instance.to_json(), drt.primary_lease)
+        await drt.put_leased(instance.path, instance.to_json())
         logger.info("serving endpoint %s at %s (instance %x)", self.subject, address, instance.instance_id)
         return ServedEndpoint(self, instance, stats)
 
@@ -241,6 +258,7 @@ class ServedEndpoint:
     async def remove(self):
         drt = self.endpoint.drt
         drt.server.unregister(self.endpoint.subject)
+        drt._leased_keys.pop(self.instance.path, None)
         if drt.discovery is not None:
             await drt.discovery.delete(self.instance.path)
 

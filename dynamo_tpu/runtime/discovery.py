@@ -383,7 +383,11 @@ class Subscription:
 
 class Lease:
     """Client-side lease handle with a background keepalive task
-    (reference: Lease etcd.rs:43 — primary lease keeps instances alive)."""
+    (reference: Lease etcd.rs:43 — primary lease keeps instances alive).
+
+    If a keepalive discovers the lease expired server-side (e.g. the event
+    loop was blocked past the TTL by a long XLA compile), `on_lost` is
+    invoked so the owner can re-grant and re-publish its keys."""
 
     def __init__(self, lease_id: int, ttl: float, client: "DiscoveryClient"):
         self.lease_id = lease_id
@@ -391,6 +395,7 @@ class Lease:
         self._client = client
         self._task: Optional[asyncio.Task] = None
         self.alive = True
+        self.on_lost: Optional[Callable] = None  # async callback
 
     def start_keepalive(self):
         self._task = asyncio.create_task(self._keepalive_loop())
@@ -402,11 +407,30 @@ class Lease:
             try:
                 resp = await self._client._call({"op": "lease_keepalive", "lease_id": self.lease_id})
                 if not resp[0].get("ok"):
-                    logger.warning("lease %d lost: %s", self.lease_id, resp[0].get("error"))
+                    logger.warning(
+                        "lease %d lost (%s); attempting re-grant",
+                        self.lease_id,
+                        resp[0].get("error"),
+                    )
+                    if await self._regrant():
+                        continue
                     self.alive = False
             except ConnectionError:
                 logger.warning("lease %d keepalive connection lost", self.lease_id)
                 self.alive = False
+
+    async def _regrant(self) -> bool:
+        try:
+            resp, _ = await self._client._call({"op": "lease_grant", "ttl": self.ttl})
+            if not resp.get("ok"):
+                return False
+            self.lease_id = resp["lease_id"]
+            if self.on_lost is not None:
+                await self.on_lost(self)
+            logger.info("lease re-granted as %d; keys re-published", self.lease_id)
+            return True
+        except ConnectionError:
+            return False
 
     async def revoke(self):
         self.alive = False

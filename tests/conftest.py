@@ -6,8 +6,23 @@ code paths as real ICI meshes). Must be set before jax import.
 """
 
 import os
+import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the image exports JAX_PLATFORMS=axon (real TPU tunnel) globally — tests
+# must FORCE cpu, not setdefault, or they'd run on the one real chip
+os.environ["JAX_PLATFORMS"] = "cpu"
+# drop the axon plugin from the path: its import contacts the TPU relay and
+# can hang; CPU tests must be hermetic (subprocesses inherit the clean path)
+sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
+os.environ["PYTHONPATH"] = ":".join(
+    p for p in os.environ.get("PYTHONPATH", "").split(":") if p and ".axon_site" not in p
+)
+# the axon sitecustomize imports jax at interpreter startup, freezing
+# jax_platforms=axon before this file runs — update the LIVE config too
+if "jax" in sys.modules:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

@@ -28,9 +28,16 @@ class ManagedProcess:
         self.args = [sys.executable, *args]
         self.name = name
         full_env = dict(os.environ)
-        full_env["PYTHONPATH"] = str(REPO)
+        # prepend the repo; keep existing entries (/root/.axon_site carries
+        # the TPU plugin) EXCEPT in cpu_only mode, where the plugin must be
+        # absent (its import contacts the TPU relay and can hang)
+        prev = full_env.get("PYTHONPATH", "")
         if cpu_only:
             full_env["JAX_PLATFORMS"] = "cpu"
+            prev = ":".join(
+                p for p in prev.split(":") if p and ".axon_site" not in p
+            )
+        full_env["PYTHONPATH"] = f"{REPO}:{prev}" if prev else str(REPO)
         if env:
             full_env.update(env)
         self.env = full_env
