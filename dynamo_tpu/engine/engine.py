@@ -65,6 +65,8 @@ class _Slot:
     last_token: int = 0
     slot_idx: int = -1
     done: bool = False
+    return_kv: bool = False  # prefill role: ship KV pages with the 1st token
+    preloaded: Optional[tuple] = None  # decode role: (first_tok, k, v, n_tokens)
 
 
 class JaxEngine:
@@ -157,6 +159,22 @@ class JaxEngine:
 
         self._sample_one = sample_one
 
+        # disagg KV movement (host-staged; llm/disagg.py wire format)
+        @jax.jit
+        def extract_pages(kv_k, kv_v, page_ids):
+            return kv_k[:, page_ids], kv_v[:, page_ids]
+
+        self._extract_pages = extract_pages
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def inject_pages(kv_k, kv_v, page_ids, data_k, data_v):
+            return (
+                kv_k.at[:, page_ids].set(data_k),
+                kv_v.at[:, page_ids].set(data_v),
+            )
+
+        self._inject_pages = inject_pages
+
     # ------------------------------------------------------------------ #
     # lifecycle / interface (MockEngine-compatible)
     # ------------------------------------------------------------------ #
@@ -195,8 +213,60 @@ class JaxEngine:
         slot.temperature = float(sampling.get("temperature", self.config.default_temperature) or 0.0)
         slot.top_k = int(sampling.get("top_k") or 0)
         slot.top_p = float(sampling.get("top_p") or 1.0)
+        disagg = req.disagg_params or {}
+        slot.return_kv = bool(disagg.get("return_kv"))
         if len(slot.prompt) + slot.max_tokens > self.config.max_model_len:
             slot.max_tokens = max(self.config.max_model_len - len(slot.prompt), 1)
+        self.num_requests += 1
+        self._waiting.append(slot)
+        self._wake.set()
+        try:
+            while True:
+                item = await slot.queue.get()
+                if item is None:
+                    return
+                yield item
+        finally:
+            slot.done = True
+            self._wake.set()
+
+    async def generate_decode_from_kv(
+        self,
+        request: Any,
+        context: Context,
+        first_token: int,
+        kv_k_pages,
+        kv_v_pages,
+        n_tokens: int,
+    ) -> AsyncIterator[dict]:
+        """Disagg decode entry: continue decoding from remotely-prefilled KV
+        (reference decode-with-kv_transfer_params, handlers.py:258-270).
+        The first token was already produced by the prefill worker and is
+        NOT re-emitted here."""
+        self.start()
+        req = (
+            request
+            if isinstance(request, PreprocessedRequest)
+            else PreprocessedRequest.from_dict(request)
+        )
+        stop = req.stop_conditions or {}
+        sampling = req.sampling_options or {}
+        slot = _Slot(
+            request_id=(req.request_id or f"jax-{self.num_requests}") + "-d",
+            queue=asyncio.Queue(),
+            context=context,
+            prompt=list(req.token_ids),
+            max_tokens=int(stop.get("max_tokens") or 128),
+            min_tokens=int(stop.get("min_tokens") or 0),
+            eos_ids=list(req.eos_token_ids or []),
+            ignore_eos=bool(stop.get("ignore_eos")),
+            stop_token_ids=list(stop.get("stop_token_ids") or []),
+            seq=TokenBlockSequence(req.token_ids, self.config.page_size),
+        )
+        slot.temperature = float(sampling.get("temperature", self.config.default_temperature) or 0.0)
+        slot.top_k = int(sampling.get("top_k") or 0)
+        slot.top_p = float(sampling.get("top_p") or 1.0)
+        slot.preloaded = (first_token, kv_k_pages, kv_v_pages, n_tokens)
         self.num_requests += 1
         self._waiting.append(slot)
         self._wake.set()
@@ -266,6 +336,11 @@ class JaxEngine:
                 continue
         self._waiting = still
 
+        # inject one preloaded (disagg-transferred) slot per iteration
+        for slot in self.slots:
+            if slot is not None and slot.preloaded is not None:
+                await self._inject_preloaded(slot)
+                return True
         # run ONE prefill chunk for the first slot still prefilling
         for slot in self.slots:
             if slot is None or slot.prefill_pos >= len(slot.prompt):
@@ -276,6 +351,28 @@ class JaxEngine:
 
     def _try_admit(self, slot: _Slot) -> bool:
         cfg = self.config
+        if slot.preloaded is not None:
+            # disagg decode role: all prompt pages fresh; KV arrives by
+            # injection, not prefill
+            n_pages = (len(slot.prompt) + cfg.page_size - 1) // cfg.page_size
+            if not self.allocator.can_allocate(n_pages + 1):
+                return False
+            fresh = self.allocator.alloc_fresh(n_pages)
+            if fresh is None:
+                return False
+            idx = self._free_slots.pop()
+            slot.slot_idx = idx
+            slot.pages = fresh
+            slot.committed_hashes = []
+            slot.prefill_pos = len(slot.prompt)
+            self.slots[idx] = slot
+            self.page_tables[idx, :] = SCRATCH_PAGE
+            self.page_tables[idx, : len(fresh)] = [p + 1 for p in fresh]
+            self.seq_lens[idx] = 0
+            self.temps[idx] = slot.temperature
+            self.top_ks[idx] = slot.top_k
+            self.top_ps[idx] = slot.top_p
+            return True
         hashes = slot.seq.block_hashes()
         cached_pages = (
             self.allocator.acquire_cached(hashes) if cfg.enable_prefix_caching else []
@@ -365,6 +462,12 @@ class JaxEngine:
             first = int(
                 await self._run_on_device(self._sample_one, logits, samp, sub)
             )
+            if slot.return_kv:
+                # prefill role: ship the prompt KV with the first token and
+                # finish (reference: prefill returns kv_transfer_params,
+                # handlers.py:297-306; here the payload IS the transfer)
+                await self._emit_prefill_result(slot, first)
+                return
             self._emit_token(slot, first)
             if not slot.done:
                 slot.last_token = first
@@ -373,6 +476,61 @@ class JaxEngine:
                 self.tokens[slot.slot_idx] = first
                 self.seq_lens[slot.slot_idx] = len(slot.prompt) + 1
                 self._maybe_finish(slot, first)
+
+    async def _emit_prefill_result(self, slot: _Slot, first_token: int):
+        from ..llm.disagg import pack_kv_payload
+
+        cfg = self.config
+        n_prompt_pages = (len(slot.prompt) + cfg.page_size - 1) // cfg.page_size
+        page_ids = np.array(
+            [p + 1 for p in slot.pages[:n_prompt_pages]], np.int32
+        )  # +1 scratch shift
+
+        def run_extract():
+            k, v = self._extract_pages(self.kv_k, self.kv_v, jnp.asarray(page_ids))
+            return np.asarray(k), np.asarray(v)
+
+        k_np, v_np = await self._run_on_device(run_extract)
+        payload = pack_kv_payload(k_np, v_np, len(slot.prompt), cfg.page_size)
+        if not slot.done:
+            out = LLMEngineOutput(
+                token_ids=[first_token],
+                finish_reason="remote_prefill_done",
+                kv_transfer_params=payload,
+            ).to_dict()
+            slot.queue.put_nowait(Annotated(data=out).to_dict())
+            slot.queue.put_nowait(None)
+            slot.done = True
+        self._release_slot(slot)
+
+    async def _inject_preloaded(self, slot: _Slot):
+        """Decode role: write transferred KV pages into our cache and enter
+        the decode batch as if we had prefilled locally."""
+        first_token, k_np, v_np, n_tokens = slot.preloaded
+        slot.preloaded = None
+        cfg = self.config
+        page_ids = np.array([p + 1 for p in slot.pages], np.int32)
+
+        def run_inject():
+            kv_k, kv_v = self._inject_pages(
+                self.kv_k,
+                self.kv_v,
+                jnp.asarray(page_ids),
+                jnp.asarray(k_np),
+                jnp.asarray(v_np),
+            )
+            return kv_k, kv_v
+
+        self.kv_k, self.kv_v = await self._run_on_device(run_inject)
+        # transferred prompt KV is now reusable: publish it to the prefix cache
+        self._commit_blocks(slot)
+        slot.prefill_pos = len(slot.prompt)
+        slot.generated = 1
+        slot.last_token = first_token
+        slot.seq.append(first_token)
+        self.tokens[slot.slot_idx] = first_token
+        self.seq_lens[slot.slot_idx] = len(slot.prompt) + 1
+        self._maybe_finish(slot, first_token)
 
     def _commit_blocks(self, slot: _Slot):
         """Bind filled prompt pages to their hashes -> prefix cache + events."""

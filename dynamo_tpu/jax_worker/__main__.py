@@ -33,6 +33,13 @@ def parse_args():
     ap.add_argument("--kv-events", action="store_true")
     ap.add_argument("--migration-limit", type=int, default=3)
     ap.add_argument("--context-length", type=int, default=None)
+    # disaggregation (reference: --disaggregation-mode prefill|decode)
+    ap.add_argument(
+        "--role", choices=["aggregated", "prefill", "decode"], default="aggregated"
+    )
+    ap.add_argument("--prefill-component", default="prefill")
+    ap.add_argument("--disagg-threshold", type=int, default=64,
+                    help="remote prefill iff uncached prompt tokens exceed this")
     return ap.parse_args()
 
 
@@ -86,9 +93,8 @@ async def main():
     if args.discovery:
         cfg.discovery_endpoint = args.discovery
     drt = await DistributedRuntime.create(cfg)
-    endpoint = (
-        drt.namespace(args.namespace).component(args.component).endpoint(args.endpoint)
-    )
+    component = args.prefill_component if args.role == "prefill" else args.component
+    endpoint = drt.namespace(args.namespace).component(component).endpoint(args.endpoint)
 
     publisher = None
     if args.kv_events:
@@ -105,18 +111,45 @@ async def main():
     await metrics_pub.start()
 
     model_name = args.model_name or args.model
-    card = ModelDeploymentCard(
-        name=model_name,
-        tokenizer="byte",
-        kv_cache_block_size=args.page_size,
-        context_length=args.context_length or args.max_model_len,
-        migration_limit=args.migration_limit,
-    )
-    await register_llm(endpoint, card)
+    if args.role != "prefill":
+        # only decode/aggregated workers front the model (reference: the
+        # prefill pool is internal, reached by decode orchestration)
+        card = ModelDeploymentCard(
+            name=model_name,
+            tokenizer="byte",
+            kv_cache_block_size=args.page_size,
+            context_length=args.context_length or args.max_model_len,
+            migration_limit=args.migration_limit,
+        )
+        await register_llm(endpoint, card)
+
+    prefill_client = None
+    disagg_router = None
+    if args.role == "decode":
+        from dynamo_tpu.llm.disagg import DisaggConfig, DisaggregatedRouter
+
+        prefill_ep = (
+            drt.namespace(args.namespace)
+            .component(args.prefill_component)
+            .endpoint(args.endpoint)
+        )
+        prefill_client = await prefill_ep.client()
+        disagg_router = DisaggregatedRouter(
+            DisaggConfig(remote_prefill_threshold_tokens=args.disagg_threshold)
+        )
 
     async def handler(request, context):
         if "worker_instance_id" in (request.get("annotations") or []):
             yield {"event": "worker_instance_id", "comment": [f"{drt.instance_id:x}"]}
+        if args.role == "decode" and disagg_router is not None:
+            from dynamo_tpu.jax_worker.disagg_handler import maybe_remote_prefill
+
+            stream = maybe_remote_prefill(
+                engine, prefill_client, disagg_router, request, context
+            )
+            async for item in stream:
+                yield item
+            return
         async for item in engine.generate(request, context):
             yield item
 

@@ -1,0 +1,84 @@
+"""Decode-side disaggregation orchestration.
+
+Mirrors reference DecodeWorkerHandler.generate (vllm/handlers.py:164-270):
+the decode worker decides (conditional disagg), calls the prefill pool with
+max_tokens=1 + return_kv, receives the first token AND the prompt KV on the
+same response stream (direct prefill→decode TCP hop — our NIXL), injects,
+and continues decoding locally. Any prefill-path failure falls back to
+local prefill, so disagg is strictly an optimization.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, AsyncIterator
+
+from dynamo_tpu.llm.disagg import DisaggregatedRouter, unpack_kv_payload
+from dynamo_tpu.llm.protocols import Annotated, LLMEngineOutput
+from dynamo_tpu.llm.tokens import compute_seq_hashes
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.push_router import PushRouter, RouterMode
+from dynamo_tpu.runtime.request_plane import EngineError, StreamLost
+
+logger = logging.getLogger(__name__)
+
+
+async def maybe_remote_prefill(
+    engine,
+    prefill_client,
+    disagg_router: DisaggregatedRouter,
+    request: dict,
+    context: Context,
+) -> AsyncIterator[Any]:
+    prompt = request.get("token_ids") or []
+    page_size = engine.config.page_size
+    hashes = compute_seq_hashes(prompt, page_size)
+    cached_tokens = len(engine.allocator.cached_prefix(hashes)) * page_size
+    have_workers = bool(prefill_client and prefill_client.instance_ids())
+
+    want_annotation = "remote_prefill" in (request.get("annotations") or [])
+    if not disagg_router.prefill_remote(len(prompt), cached_tokens, have_workers):
+        if want_annotation:
+            yield {"event": "remote_prefill", "comment": ["false"]}
+        async for item in engine.generate(request, context):
+            yield item
+        return
+
+    # --- remote prefill (reference handlers.py:192-246) ---
+    prefill_req = dict(request)
+    stop = dict(prefill_req.get("stop_conditions") or {})
+    orig_max_tokens = int(stop.get("max_tokens") or 128)
+    stop["max_tokens"] = 1
+    prefill_req["stop_conditions"] = stop
+    prefill_req["disagg_params"] = {"return_kv": True}
+
+    first_token = None
+    kv_payload = None
+    try:
+        router = PushRouter(prefill_client, RouterMode.ROUND_ROBIN)
+        stream = await router.generate(prefill_req, context.child())
+        async for item in stream:
+            data = item.get("data") if isinstance(item, dict) else None
+            if data and data.get("kv_transfer_params"):
+                kv_payload = data["kv_transfer_params"]
+                if data.get("token_ids"):
+                    first_token = data["token_ids"][0]
+    except (StreamLost, EngineError) as e:
+        logger.warning("remote prefill failed (%s); falling back to local", e)
+
+    if kv_payload is None or first_token is None:
+        if want_annotation:
+            yield {"event": "remote_prefill", "comment": ["false"]}
+        async for item in engine.generate(request, context):
+            yield item
+        return
+
+    if want_annotation:
+        yield {"event": "remote_prefill", "comment": ["true"]}
+    kv_k, kv_v, n_tokens = unpack_kv_payload(kv_payload)
+    # emit the prefill-produced first token to the caller
+    yield Annotated(data=LLMEngineOutput(token_ids=[first_token]).to_dict()).to_dict()
+    async for item in engine.generate_decode_from_kv(
+        request, context, first_token, kv_k, kv_v, n_tokens
+    ):
+        yield item

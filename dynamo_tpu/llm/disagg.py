@@ -1,0 +1,91 @@
+"""Disaggregated prefill/decode serving.
+
+Mirrors reference disagg flow (SURVEY.md §3.3): the DECODE worker
+orchestrates — it decides per-request whether to prefill remotely
+(conditional disaggregation, disagg_router.rs:135,230), calls a prefill
+worker with max_tokens=1 + disagg params, and continues decoding locally
+from the transferred KV.
+
+TPU KV-transfer path (NIXL replacement, SURVEY §7 step 6): host-staged —
+the prefill worker's engine extracts the sequence's KV pages to host and
+returns them ON the response stream, which is already a direct prefill→
+decode TCP connection (our request plane), so the transfer is one hop with
+no extra rendezvous; descriptors ride the same frames. ICI/DCN direct
+device-to-device transfer is the planned fast path behind the same
+interface.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class DisaggConfig:
+    """Conditional-disagg thresholds (reference DisaggregatedRouter
+    disagg_router.rs:135)."""
+
+    enabled: bool = True
+    # remote prefill iff (prompt_len - prefix_hit_tokens) > threshold
+    remote_prefill_threshold_tokens: int = 64
+    # skip remote if the prefill pool is this backed up
+    max_prefill_queue: int = 64
+
+
+class DisaggregatedRouter:
+    """Decide local vs remote prefill (reference prefill_remote
+    disagg_router.rs:230)."""
+
+    def __init__(self, config: Optional[DisaggConfig] = None):
+        self.config = config or DisaggConfig()
+        self.prefill_queue_depth = 0  # updated from prefill worker metrics
+
+    def update_queue_depth(self, depth: int):
+        self.prefill_queue_depth = depth
+
+    def prefill_remote(self, prompt_len: int, prefix_hit_tokens: int, have_prefill_workers: bool) -> bool:
+        if not self.config.enabled or not have_prefill_workers:
+            return False
+        if self.prefill_queue_depth > self.config.max_prefill_queue:
+            return False
+        return (prompt_len - prefix_hit_tokens) > self.config.remote_prefill_threshold_tokens
+
+
+# ---------------------------------------------------------------------- #
+# KV wire format (the "NIXL descriptor + payload" role)
+# ---------------------------------------------------------------------- #
+
+
+def pack_kv_payload(
+    kv_k: np.ndarray, kv_v: np.ndarray, n_tokens: int, page_size: int
+) -> Dict[str, Any]:
+    """Serialize extracted KV pages [L, n_pages, page_size, KH, D] for the
+    response stream (msgpack-safe: raw bytes + shape/dtype header)."""
+    return {
+        "k": kv_k.tobytes(),
+        "v": kv_v.tobytes(),
+        "shape": list(kv_k.shape),
+        "dtype": str(kv_k.dtype),
+        "n_tokens": n_tokens,
+        "page_size": page_size,
+    }
+
+
+def unpack_kv_payload(payload: Dict[str, Any]) -> Tuple[np.ndarray, np.ndarray, int]:
+    dtype = payload["dtype"]
+    if dtype == "bfloat16":
+        import ml_dtypes
+
+        np_dtype = ml_dtypes.bfloat16
+    else:
+        np_dtype = np.dtype(dtype)
+    shape = tuple(payload["shape"])
+    kv_k = np.frombuffer(payload["k"], dtype=np_dtype).reshape(shape)
+    kv_v = np.frombuffer(payload["v"], dtype=np_dtype).reshape(shape)
+    return kv_k, kv_v, int(payload["n_tokens"])
