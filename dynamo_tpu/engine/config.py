@@ -25,6 +25,10 @@ class EngineConfig:
     # scheduling
     max_queue: int = 4096
     decode_batch_wait_s: float = 0.0  # wait to fill decode batch (0 = greedy)
+    # KVBM tiers (kvbm/manager.py); 0 disables a tier
+    kvbm_host_blocks: int = 0
+    kvbm_disk_blocks: int = 0
+    kvbm_disk_path: Optional[str] = None
 
     @property
     def max_pages_per_seq(self) -> int:

@@ -67,6 +67,7 @@ class _Slot:
     done: bool = False
     return_kv: bool = False  # prefill role: ship KV pages with the 1st token
     preloaded: Optional[tuple] = None  # decode role: (first_tok, k, v, n_tokens)
+    onboard: Optional[tuple] = None  # KVBM tier hit: (alloc_pages, hashes)
 
 
 class JaxEngine:
@@ -99,6 +100,24 @@ class JaxEngine:
         self.allocator = PageAllocator(
             config.num_pages, config.page_size, event_sink=event_sink
         )
+        # KVBM host/disk tiers (kvbm/): write-through offload of committed
+        # blocks, onboard at admission when the device prefix cache misses
+        self.kvbm = None
+        if config.kvbm_host_blocks > 0 or config.kvbm_disk_blocks > 0:
+            from ..kvbm import KvBlockManager, KvbmConfig, KvbmConnector
+
+            block_shape = (c.num_layers, config.page_size, c.num_kv_heads, c.head_dim)
+            np_dtype = np.dtype(jnp.zeros((), c.dtype).dtype)
+            manager = KvBlockManager(
+                KvbmConfig(
+                    host_blocks=config.kvbm_host_blocks,
+                    disk_blocks=config.kvbm_disk_blocks,
+                    disk_path=config.kvbm_disk_path,
+                ),
+                block_shape,
+                np_dtype,
+            )
+            self.kvbm = KvbmConnector(self, manager)
         # shift page ids by +1 so allocator page 0 -> physical page 1
         B, P = config.max_num_seqs, config.max_pages_per_seq
         self.page_tables = np.zeros((B, P), np.int32)
@@ -188,6 +207,8 @@ class JaxEngine:
         self._wake.set()
         if self._step_task:
             self._step_task.cancel()
+        if self.kvbm is not None and self.kvbm.manager.disk is not None:
+            self.kvbm.manager.disk.flush()  # persist G3 index for warm restart
 
     async def generate(self, request: Any, context: Context) -> AsyncIterator[dict]:
         self.start()
@@ -283,13 +304,16 @@ class JaxEngine:
     def stats(self) -> dict:
         alloc_stats = self.allocator.stats()
         running = sum(1 for s in self.slots if s is not None)
-        return {
+        out = {
             "num_waiting_reqs": len(self._waiting),
             "num_running_reqs": running,
             "gpu_cache_usage_perc": self.allocator.active_pages / self.allocator.num_pages,
             "request_total_slots": self.config.max_num_seqs,
             **alloc_stats,
         }
+        if self.kvbm is not None:
+            out.update(self.kvbm.stats())
+        return out
 
     # ------------------------------------------------------------------ #
     # step loop
@@ -341,6 +365,11 @@ class JaxEngine:
             if slot is not None and slot.preloaded is not None:
                 await self._inject_preloaded(slot)
                 return True
+        # inject one KVBM onboard (G2/G3 tier hit) per iteration
+        for slot in self.slots:
+            if slot is not None and slot.onboard is not None:
+                await self._inject_onboard(slot)
+                return True
         # run ONE prefill chunk for the first slot still prefilling
         for slot in self.slots:
             if slot is None or slot.prefill_pos >= len(slot.prompt):
@@ -378,10 +407,13 @@ class JaxEngine:
             self.allocator.acquire_cached(hashes) if cfg.enable_prefix_caching else []
         )
         n_cached = len(cached_pages)
-        total_pages_needed = (
-            len(slot.prompt) + slot.max_tokens + cfg.page_size - 1
-        ) // cfg.page_size
-        fresh_needed = max(total_pages_needed - n_cached, 0)
+        # KVBM: probe G2/G3 for the hashes the device cache missed; tier hits
+        # are injected before prefill (onboard), extending the cached prefix
+        onboard_hashes: List[int] = []
+        if self.kvbm is not None and cfg.enable_prefix_caching:
+            prompt_full_blocks = len(slot.prompt) // cfg.page_size
+            onboard_hashes = self.kvbm.probe(hashes[n_cached:prompt_full_blocks])
+        n_onboard = len(onboard_hashes)
         # allocate the prompt's remaining pages now; generation pages grow later
         prompt_pages = (len(slot.prompt) + cfg.page_size - 1) // cfg.page_size
         fresh_prompt = max(prompt_pages - n_cached, 0)
@@ -396,7 +428,9 @@ class JaxEngine:
         slot.slot_idx = idx
         slot.pages = cached_pages + fresh
         slot.committed_hashes = hashes[:n_cached]
-        slot.prefill_pos = n_cached * cfg.page_size
+        slot.prefill_pos = (n_cached + n_onboard) * cfg.page_size
+        if n_onboard:
+            slot.onboard = (fresh[:n_onboard], onboard_hashes)
         # skip-ahead: if the whole prompt is cached, recompute the last token
         # (need its logits) — back off one position
         if slot.prefill_pos >= len(slot.prompt):
@@ -532,6 +566,48 @@ class JaxEngine:
         self.seq_lens[slot.slot_idx] = len(slot.prompt) + 1
         self._maybe_finish(slot, first_token)
 
+    async def _inject_onboard(self, slot: _Slot):
+        """KVBM onboard: scatter G2/G3 blocks into the freshly allocated
+        device pages, then register them in the device prefix cache so
+        concurrent sequences share them."""
+        alloc_pages, hashes = slot.onboard
+        slot.onboard = None
+        try:
+            # tier reads (host memcpy / disk memmap) run off the event loop,
+            # serialized with offload stores on the same executor
+            k_np, v_np = await self._run_on_device(self.kvbm.load, hashes)
+        except KeyError as e:
+            # block evicted between probe and load: fall back to computing
+            # that part of the prompt (pages are already allocated)
+            logger.warning("KVBM onboard miss: %s; prefilling instead", e)
+            n_known = len(slot.committed_hashes)
+            slot.prefill_pos = n_known * self.config.page_size
+            return
+        # [n, layers, page, heads, dim] -> [layers, n, page, heads, dim]
+        k_np = k_np.swapaxes(0, 1)
+        v_np = v_np.swapaxes(0, 1)
+        phys = np.array([p + 1 for p in alloc_pages], np.int32)  # scratch shift
+
+        def run_inject():
+            kv_k, kv_v = self._inject_pages(
+                self.kv_k,
+                self.kv_v,
+                jnp.asarray(phys),
+                jnp.asarray(k_np),
+                jnp.asarray(v_np),
+            )
+            return kv_k, kv_v
+
+        self.kv_k, self.kv_v = await self._run_on_device(run_inject)
+        n_known = len(slot.committed_hashes)
+        token_blocks = [
+            b.tokens for b in slot.seq.blocks[n_known : n_known + len(hashes)]
+        ]
+        parent = slot.committed_hashes[-1] if slot.committed_hashes else None
+        self.allocator.commit_hashes(alloc_pages, hashes, token_blocks, parent)
+        slot.committed_hashes.extend(hashes)
+        # (whole-prompt clamp already applied at admission, _try_admit)
+
     def _commit_blocks(self, slot: _Slot):
         """Bind filled prompt pages to their hashes -> prefix cache + events."""
         hashes = slot.seq.block_hashes()
@@ -546,6 +622,8 @@ class JaxEngine:
             parent = slot.committed_hashes[-1] if slot.committed_hashes else None
             self.allocator.commit_hashes(pages, new_hashes, token_blocks, parent)
             slot.committed_hashes.extend(new_hashes)
+            if self.kvbm is not None:
+                self.kvbm.offload_commit(new_hashes, [p + 1 for p in pages])
 
     # -- decode ---------------------------------------------------------- #
 
@@ -708,6 +786,8 @@ class JaxEngine:
             parent = slot.committed_hashes[-1] if slot.committed_hashes else None
             self.allocator.commit_hashes(pages, new_hashes, token_blocks, parent)
             slot.committed_hashes.extend(new_hashes)
+            if self.kvbm is not None:
+                self.kvbm.offload_commit(new_hashes, [p + 1 for p in pages])
 
 
 def _resolve_model(name: str) -> llama.LlamaConfig:

@@ -31,6 +31,10 @@ def parse_args():
     ap.add_argument("--max-model-len", type=int, default=8192)
     ap.add_argument("--tp-size", type=int, default=1)
     ap.add_argument("--kv-events", action="store_true")
+    # KVBM tiers (kvbm/): host-RAM + disk KV block offload
+    ap.add_argument("--kvbm-host-blocks", type=int, default=0)
+    ap.add_argument("--kvbm-disk-blocks", type=int, default=0)
+    ap.add_argument("--kvbm-disk-path", default=None)
     ap.add_argument("--migration-limit", type=int, default=3)
     ap.add_argument("--context-length", type=int, default=None)
     # disaggregation (reference: --disaggregation-mode prefill|decode)
@@ -54,6 +58,9 @@ async def main():
         max_num_seqs=args.max_num_seqs,
         max_model_len=args.max_model_len,
         tp_size=args.tp_size,
+        kvbm_host_blocks=args.kvbm_host_blocks,
+        kvbm_disk_blocks=args.kvbm_disk_blocks,
+        kvbm_disk_path=args.kvbm_disk_path,
     )
 
     kv_sharding = None

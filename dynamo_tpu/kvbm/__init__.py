@@ -1,0 +1,26 @@
+"""KVBM — multi-tier KV block manager (TPU rebuild of reference
+lib/llm/src/block_manager, 21k LoC Rust: KvBlockManager block_manager.rs:99,
+OffloadManager offload.rs, Storage traits storage.rs:157).
+
+Tiers (reference CacheLevel, block_manager.rs:63):
+  G1  device HBM      — the engine's paged kv arrays (engine/kv_cache.py)
+  G2  host RAM        — preallocated numpy pool (pinned-host analogue)
+  G3  local disk      — np.memmap pool file
+
+Where the reference moves blocks with a CUDA kernel (block_copy.cu) + NIXL,
+the TPU path is: XLA gather (`extract_pages`) for device->host DMA and
+`inject_pages` scatter for host->device, both jitted; see
+engine/engine.py. Offload is write-through at block-commit time so G1
+eviction never needs a device read-back.
+"""
+
+from .storage import DiskTier, HostTier
+from .manager import KvbmConfig, KvBlockManager, KvbmConnector
+
+__all__ = [
+    "DiskTier",
+    "HostTier",
+    "KvbmConfig",
+    "KvBlockManager",
+    "KvbmConnector",
+]

@@ -1,0 +1,211 @@
+"""KvBlockManager: tier policy + the engine connector.
+
+Reference: lib/llm/src/block_manager.rs (KvBlockManager :99) and
+block_manager/offload.rs (OffloadManager). The reference offloads a block
+down the G1->G2->G3 chain when it is *registered* (hash bound); onboarding
+walks the chain upward on a prefix-cache lookup miss. We do the same:
+
+  * offload is WRITE-THROUGH at block-commit time: the engine's
+    `_commit_blocks` hands us (hashes, physical pages); we enqueue one XLA
+    gather (`extract_pages`) on the engine's serial device executor and copy
+    the result into the host pool. Because every later write to those pages
+    is itself a device op queued behind ours on the same executor, the
+    extract always reads the pre-eviction contents — no device read-back is
+    ever needed at eviction time (the reference needs its CUDA
+    block_copy.cu + bounce buffers for this; XLA gather + serialized
+    execution makes it free of synchronization hazards).
+  * onboard happens at admission: after the device prefix cache
+    (PageAllocator.acquire_cached) is consulted, the engine probes the
+    tiers for the NEXT hashes in the chain; hits are scatter-injected
+    (`inject_pages`) into freshly allocated device pages before prefill,
+    extending the cached prefix and skipping that prefill compute.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .storage import DiskTier, HostTier
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class KvbmConfig:
+    host_blocks: int = 0  # G2 capacity (0 disables the tier)
+    disk_blocks: int = 0  # G3 capacity (0 disables the tier)
+    disk_path: Optional[str] = None
+
+
+class KvBlockManager:
+    """Owns the G2/G3 tiers and the offload/onboard policy."""
+
+    def __init__(self, cfg: KvbmConfig, block_shape: tuple, dtype):
+        self.cfg = cfg
+        self.block_shape = tuple(block_shape)
+        self.dtype = dtype
+        if cfg.disk_blocks > 0 and not cfg.disk_path:
+            raise ValueError("kvbm_disk_blocks > 0 requires kvbm_disk_path")
+        self.host: Optional[HostTier] = (
+            HostTier(cfg.host_blocks, block_shape, dtype)
+            if cfg.host_blocks > 0
+            else None
+        )
+        self.disk: Optional[DiskTier] = (
+            DiskTier(cfg.disk_blocks, block_shape, dtype, cfg.disk_path)
+            if cfg.disk_blocks > 0
+            else None
+        )
+        self._lock = threading.Lock()  # store runs on the device-exec thread
+        self.offloaded_blocks = 0
+        self.onboarded_blocks = 0
+        self.disk_evictions = 0
+        self.dropped_blocks = 0
+
+    # -- store path (device executor thread) ----------------------------- #
+
+    def store(self, seq_hash: int, k: np.ndarray, v: np.ndarray):
+        """Insert one block at the top of the G2->G3 chain, cascading the
+        host tier's LRU eviction down to disk."""
+        with self._lock:
+            if self.host is not None:
+                evicted = self.host.put(seq_hash, k, v)
+                self.offloaded_blocks += 1
+                if evicted is not None:
+                    old_hash, old_k, old_v = evicted
+                    if self.disk is not None:
+                        if self.disk.put(old_hash, old_k, old_v) is not None:
+                            self.dropped_blocks += 1
+                        self.disk_evictions += 1
+                    else:
+                        self.dropped_blocks += 1
+            elif self.disk is not None:
+                if self.disk.put(seq_hash, k, v) is not None:
+                    self.dropped_blocks += 1
+                self.offloaded_blocks += 1
+
+    def has(self, seq_hash: int) -> bool:
+        with self._lock:
+            if self.host is not None and self.host.has(seq_hash):
+                return True
+            return self.disk is not None and self.disk.has(seq_hash)
+
+    # -- lookup path (event loop thread) --------------------------------- #
+
+    def match_prefix(self, hashes: Sequence[int]) -> List[int]:
+        """Longest leading run of `hashes` present in any tier."""
+        out: List[int] = []
+        for h in hashes:
+            if self.has(h):
+                out.append(h)
+            else:
+                break
+        return out
+
+    def load_blocks(
+        self, hashes: Sequence[int]
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Fetch blocks (host first, then disk, promoting disk hits to host)
+        stacked on a leading axis: [n, *block_shape]."""
+        ks, vs = [], []
+        with self._lock:
+            for h in hashes:
+                got = self.host.get(h) if self.host is not None else None
+                if got is None and self.disk is not None:
+                    got = self.disk.get(h)
+                    if got is not None and self.host is not None:
+                        evicted = self.host.put(h, got[0], got[1])
+                        if evicted is not None:
+                            old_hash, old_k, old_v = evicted
+                            if self.disk.put(old_hash, old_k, old_v) is not None:
+                                self.dropped_blocks += 1
+                            self.disk_evictions += 1
+                if got is None:
+                    raise KeyError(f"KVBM block {h} vanished between probe and load")
+                # copy: get() returns views into the tier pools, and a later
+                # promotion in this same loop may evict+overwrite those slots
+                ks.append(np.array(got[0]))
+                vs.append(np.array(got[1]))
+            self.onboarded_blocks += len(hashes)
+        return np.stack(ks), np.stack(vs)
+
+    def stats(self) -> dict:
+        out = {
+            "kvbm_offloaded_blocks": self.offloaded_blocks,
+            "kvbm_onboarded_blocks": self.onboarded_blocks,
+            "kvbm_disk_evictions": self.disk_evictions,
+            "kvbm_dropped_blocks": self.dropped_blocks,
+        }
+        if self.host is not None:
+            out.update({f"kvbm_{k}": v for k, v in self.host.stats().items()})
+        if self.disk is not None:
+            out.update({f"kvbm_{k}": v for k, v in self.disk.stats().items()})
+        return out
+
+
+class KvbmConnector:
+    """Engine-side glue (reference block_manager/connector/scheduler.rs:
+    the piece that integrates the pool with the engine's forward pass).
+
+    Holds a reference to the JaxEngine for its jitted extract/inject ops and
+    its serial device executor; see module docstring for the ordering
+    argument that makes write-through offload race-free.
+    """
+
+    def __init__(self, engine, manager: KvBlockManager):
+        self.engine = engine
+        self.manager = manager
+        self._pending = 0
+
+    # -- offload (called on the event loop right after block commit) ----- #
+
+    def offload_commit(self, seq_hashes: List[int], phys_pages: List[int]):
+        """Write-through: snapshot the just-committed device pages into G2.
+        Submitted to the engine's device executor so the gather is ordered
+        before any later page rewrite."""
+        todo = [
+            (h, p)
+            for h, p in zip(seq_hashes, phys_pages)
+            if not self.manager.has(h)
+        ]
+        if not todo:
+            return
+        eng = self.engine
+        hashes = [h for h, _ in todo]
+        pages = np.array([p for _, p in todo], np.int32)
+
+        def run_extract():
+            import jax.numpy as jnp
+
+            k, v = eng._extract_pages(eng.kv_k, eng.kv_v, jnp.asarray(pages))
+            # [layers, n, page, heads, dim] -> per-block [layers, page, heads, dim]
+            k_np = np.asarray(k).swapaxes(0, 1)
+            v_np = np.asarray(v).swapaxes(0, 1)
+            for i, h in enumerate(hashes):
+                self.manager.store(h, k_np[i], v_np[i])
+
+        self._pending += 1
+
+        def done(fut):
+            self._pending -= 1
+            exc = fut.exception()
+            if exc is not None:
+                logger.warning("KVBM offload failed: %s", exc)
+
+        eng._device_exec.submit(run_extract).add_done_callback(done)
+
+    # -- onboard (called at admission) ----------------------------------- #
+
+    def probe(self, hashes: Sequence[int]) -> List[int]:
+        return self.manager.match_prefix(hashes)
+
+    def load(self, hashes: Sequence[int]) -> Tuple[np.ndarray, np.ndarray]:
+        return self.manager.load_blocks(hashes)
+
+    def stats(self) -> dict:
+        return {**self.manager.stats(), "kvbm_pending_offloads": self._pending}

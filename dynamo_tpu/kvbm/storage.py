@@ -1,0 +1,168 @@
+"""KVBM storage tiers: host-RAM and disk block pools.
+
+Reference: lib/llm/src/block_manager/storage.rs (Storage traits :157,219,322)
+and layout.rs (fully-contiguous layout). Each tier is a fixed-capacity pool
+of KV blocks keyed by the chained block hash (llm/tokens.py — the SAME hash
+the router indexes), with LRU eviction of the whole pool (every block in a
+tier is an unreferenced cache copy; onboarding copies data out, so no
+pinning is needed).
+
+A block is one page of one sequence across all layers:
+    k, v: [num_layers, page_size, num_kv_heads, head_dim]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class HostTier:
+    """G2: preallocated host-RAM block pool (pinned-host analogue of
+    block_manager/storage/cuda.rs PinnedStorage)."""
+
+    name = "host"
+
+    def __init__(self, capacity: int, block_shape: tuple, dtype):
+        self.capacity = capacity
+        self.block_shape = tuple(block_shape)
+        self.dtype = dtype
+        self._k = np.zeros((capacity, *self.block_shape), dtype)
+        self._v = np.zeros((capacity, *self.block_shape), dtype)
+        self._free: List[int] = list(range(capacity - 1, -1, -1))
+        self._by_hash: Dict[int, int] = {}  # seq_hash -> slot
+        self._lru: "OrderedDict[int, None]" = OrderedDict()
+
+    def __len__(self) -> int:
+        return len(self._by_hash)
+
+    def has(self, seq_hash: int) -> bool:
+        return seq_hash in self._by_hash
+
+    def put(
+        self, seq_hash: int, k: np.ndarray, v: np.ndarray
+    ) -> Optional[Tuple[int, np.ndarray, np.ndarray]]:
+        """Store a block. Returns the evicted (hash, k, v) if the pool was
+        full (caller cascades it to the next tier), else None."""
+        if seq_hash in self._by_hash:
+            self._lru[seq_hash] = None
+            self._lru.move_to_end(seq_hash)
+            return None
+        evicted = None
+        if not self._free:
+            old_hash, _ = self._lru.popitem(last=False)
+            slot = self._by_hash.pop(old_hash)
+            evicted = (old_hash, self._k[slot].copy(), self._v[slot].copy())
+            self._free.append(slot)
+        slot = self._free.pop()
+        self._k[slot] = k
+        self._v[slot] = v
+        self._by_hash[seq_hash] = slot
+        self._lru[seq_hash] = None
+        return evicted
+
+    def get(self, seq_hash: int) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        slot = self._by_hash.get(seq_hash)
+        if slot is None:
+            return None
+        self._lru.move_to_end(seq_hash)
+        return self._k[slot], self._v[slot]
+
+    def stats(self) -> dict:
+        return {"host_blocks": len(self._by_hash), "host_capacity": self.capacity}
+
+
+class DiskTier:
+    """G3: np.memmap-backed block pool (block_manager/storage/disk.rs).
+
+    Two pool files (k.bin / v.bin) with fixed block slots — the reference's
+    fully-contiguous layout (layout.rs). The hash index lives in memory and
+    is persisted to index.json on flush() so a restarted worker can reuse
+    warm blocks (reference: G3 tiers persist KV for reuse, offload.rs).
+    """
+
+    name = "disk"
+
+    def __init__(self, capacity: int, block_shape: tuple, dtype, path: str):
+        self.capacity = capacity
+        self.block_shape = tuple(block_shape)
+        self.dtype = np.dtype(dtype)
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+        shape = (capacity, *self.block_shape)
+        self._by_hash: Dict[int, int] = {}
+        index_path = os.path.join(path, "index.json")
+        k_path = os.path.join(path, "k.bin")
+        mode = "w+"
+        if os.path.exists(index_path) and os.path.exists(k_path):
+            try:
+                with open(index_path) as f:
+                    saved = json.load(f)
+                expected_bytes = int(np.prod(shape)) * self.dtype.itemsize
+                if (
+                    tuple(saved.get("block_shape", ())) == self.block_shape
+                    and os.path.getsize(k_path) == expected_bytes
+                ):
+                    self._by_hash = {
+                        int(h): s
+                        for h, s in saved["index"].items()
+                        if 0 <= s < capacity
+                    }
+                    mode = "r+"  # warm restart: reuse persisted blocks
+            except (ValueError, KeyError, OSError):
+                self._by_hash = {}
+        self._k = np.memmap(k_path, dtype=self.dtype, mode=mode, shape=shape)
+        self._v = np.memmap(
+            os.path.join(path, "v.bin"), dtype=self.dtype, mode=mode, shape=shape
+        )
+        used = set(self._by_hash.values())
+        self._free: List[int] = [s for s in range(capacity - 1, -1, -1) if s not in used]
+        self._lru: "OrderedDict[int, None]" = OrderedDict(
+            (h, None) for h in self._by_hash
+        )
+
+    def __len__(self) -> int:
+        return len(self._by_hash)
+
+    def has(self, seq_hash: int) -> bool:
+        return seq_hash in self._by_hash
+
+    def put(self, seq_hash: int, k: np.ndarray, v: np.ndarray) -> Optional[int]:
+        """Store a block; disk is the last tier, so a full pool drops the
+        LRU block entirely. Returns the dropped hash, if any."""
+        if seq_hash in self._by_hash:
+            self._lru[seq_hash] = None
+            self._lru.move_to_end(seq_hash)
+            return None
+        dropped = None
+        if not self._free:
+            old_hash, _ = self._lru.popitem(last=False)
+            self._free.append(self._by_hash.pop(old_hash))
+            dropped = old_hash
+        slot = self._free.pop()
+        self._k[slot] = k
+        self._v[slot] = v
+        self._by_hash[seq_hash] = slot
+        self._lru[seq_hash] = None
+        return dropped
+
+    def get(self, seq_hash: int) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        slot = self._by_hash.get(seq_hash)
+        if slot is None:
+            return None
+        self._lru.move_to_end(seq_hash)
+        return np.asarray(self._k[slot]), np.asarray(self._v[slot])
+
+    def flush(self):
+        self._k.flush()
+        self._v.flush()
+        index = {str(h): s for h, s in self._by_hash.items()}
+        with open(os.path.join(self.path, "index.json"), "w") as f:
+            json.dump({"block_shape": self.block_shape, "index": index}, f)
+
+    def stats(self) -> dict:
+        return {"disk_blocks": len(self._by_hash), "disk_capacity": self.capacity}

@@ -1,0 +1,215 @@
+"""KVBM tier tests: storage units + engine-integrated offload/onboard.
+
+Oracle for the e2e case: greedy tokens after a G1 eviction + KVBM onboard
+must equal the tokens from the original (fully computed) run.
+"""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine import EngineConfig, JaxEngine
+from dynamo_tpu.kvbm import DiskTier, HostTier, KvBlockManager, KvbmConfig
+from dynamo_tpu.llm.protocols import PreprocessedRequest
+from dynamo_tpu.models import llama
+from dynamo_tpu.runtime.engine import Context
+
+CFG = llama.LlamaConfig.tiny(dtype=jnp.float32)
+PAGE = 8
+BLOCK_SHAPE = (2, 4, 2, 4)  # layers, page, heads, dim
+
+
+def _blk(seed):
+    r = np.random.RandomState(seed)
+    return (
+        r.randn(*BLOCK_SHAPE).astype(np.float32),
+        r.randn(*BLOCK_SHAPE).astype(np.float32),
+    )
+
+
+def test_host_tier_lru_eviction():
+    tier = HostTier(2, BLOCK_SHAPE, np.float32)
+    k1, v1 = _blk(1)
+    k2, v2 = _blk(2)
+    k3, v3 = _blk(3)
+    assert tier.put(100, k1, v1) is None
+    assert tier.put(200, k2, v2) is None
+    tier.get(100)  # touch: 200 becomes LRU
+    evicted = tier.put(300, k3, v3)
+    assert evicted is not None and evicted[0] == 200
+    np.testing.assert_array_equal(evicted[1], k2)
+    assert tier.has(100) and tier.has(300) and not tier.has(200)
+    got = tier.get(100)
+    np.testing.assert_array_equal(got[0], k1)
+    np.testing.assert_array_equal(got[1], v1)
+
+
+def test_disk_tier_roundtrip(tmp_path):
+    tier = DiskTier(2, BLOCK_SHAPE, np.float32, str(tmp_path / "g3"))
+    k1, v1 = _blk(1)
+    assert tier.put(7, k1, v1) is None
+    got = tier.get(7)
+    np.testing.assert_array_equal(got[0], k1)
+    np.testing.assert_array_equal(got[1], v1)
+    # capacity 2: third insert drops LRU
+    tier.put(8, *_blk(2))
+    tier.get(7)  # 8 becomes LRU
+    dropped = tier.put(9, *_blk(3))
+    assert dropped == 8
+    tier.flush()
+    assert (tmp_path / "g3" / "index.json").exists()
+
+
+def test_disk_tier_warm_restart(tmp_path):
+    """flush() + re-open must restore the index and block contents
+    (reference: G3 tiers persist KV blocks for reuse, offload.rs)."""
+    path = str(tmp_path / "g3")
+    tier = DiskTier(4, BLOCK_SHAPE, np.float32, path)
+    k1, v1 = _blk(11)
+    k2, v2 = _blk(12)
+    tier.put(111, k1, v1)
+    tier.put(222, k2, v2)
+    tier.flush()
+    reopened = DiskTier(4, BLOCK_SHAPE, np.float32, path)
+    assert reopened.has(111) and reopened.has(222)
+    got = reopened.get(111)
+    np.testing.assert_array_equal(got[0], k1)
+    np.testing.assert_array_equal(got[1], v1)
+    # capacity/shape mismatch -> cold start, no crash
+    cold = DiskTier(8, BLOCK_SHAPE, np.float32, path)
+    assert len(cold) == 0
+
+
+def test_manager_cascade_host_to_disk(tmp_path):
+    mgr = KvBlockManager(
+        KvbmConfig(host_blocks=2, disk_blocks=4, disk_path=str(tmp_path / "g3")),
+        BLOCK_SHAPE,
+        np.float32,
+    )
+    blocks = {h: _blk(h) for h in (1, 2, 3, 4)}
+    for h, (k, v) in blocks.items():
+        mgr.store(h, k, v)
+    # host holds the 2 most recent; older ones cascaded to disk
+    assert len(mgr.host) == 2
+    assert len(mgr.disk) == 2
+    assert mgr.disk_evictions == 2
+    assert mgr.match_prefix([1, 2, 3, 4]) == [1, 2, 3, 4]
+    assert mgr.match_prefix([1, 99, 3]) == [1]
+    # load from disk promotes back to host and keeps contents intact
+    k_np, v_np = mgr.load_blocks([1, 2])
+    np.testing.assert_array_equal(k_np[0], blocks[1][0])
+    np.testing.assert_array_equal(v_np[1], blocks[2][1])
+    assert mgr.onboarded_blocks == 2
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init_params(CFG, jax.random.PRNGKey(0))
+
+
+def _engine(params, tmp_path=None, host_blocks=0, disk_blocks=0, num_pages=16):
+    cfg = EngineConfig(
+        model="tiny",
+        max_num_seqs=2,
+        page_size=PAGE,
+        num_pages=num_pages,
+        max_model_len=128,
+        prefill_buckets=(16, 32),
+        max_prefill_chunk=32,
+        kvbm_host_blocks=host_blocks,
+        kvbm_disk_blocks=disk_blocks,
+        kvbm_disk_path=str(tmp_path / "g3") if tmp_path else None,
+    )
+    return JaxEngine(cfg, model_config=CFG, params=params)
+
+
+async def _gen(eng, prompt, n, rid):
+    req = PreprocessedRequest(
+        token_ids=prompt, stop_conditions={"max_tokens": n}, request_id=rid
+    ).to_dict()
+    toks = []
+    async for item in eng.generate(req, Context()):
+        if item.get("data"):
+            toks.extend(item["data"]["token_ids"])
+    return toks
+
+
+def test_engine_offload_and_onboard(params):
+    """Fill G1, evict via competing traffic, re-issue the first prompt:
+    the prefix must come back from the host tier (onboard), and greedy
+    tokens must match the original run exactly."""
+
+    async def main():
+        eng = _engine(params, host_blocks=32, num_pages=8)
+        base = list(range(10, 10 + 3 * PAGE))  # 3 full pages
+        first = await _gen(eng, base, 4, "a")
+        await _drain_offloads(eng)
+        assert eng.kvbm.manager.offloaded_blocks >= 3
+
+        # competing traffic evicts base's pages from the 8-page device pool
+        for i in range(4):
+            await _gen(eng, list(range(300 + 40 * i, 300 + 40 * i + 3 * PAGE)), 2, f"f{i}")
+        await _drain_offloads(eng)
+        assert len(eng.allocator.cached_prefix([h for h in _hashes(base)])) < 3, (
+            "device cache should have evicted at least part of the base prefix"
+        )
+
+        onboarded_before = eng.kvbm.manager.onboarded_blocks
+        again = await _gen(eng, base, 4, "b")
+        assert again == first
+        assert eng.kvbm.manager.onboarded_blocks > onboarded_before, (
+            "re-issued prompt must onboard from the host tier"
+        )
+        await eng.close()
+
+    asyncio.run(main())
+
+
+def test_engine_onboard_from_disk(params, tmp_path):
+    """Host tier of 2 blocks + disk tier: blocks cascade to disk and still
+    onboard correctly."""
+
+    async def main():
+        eng = _engine(params, tmp_path, host_blocks=2, disk_blocks=32, num_pages=8)
+        base = list(range(10, 10 + 3 * PAGE))
+        first = await _gen(eng, base, 4, "a")
+        await _drain_offloads(eng)
+        for i in range(4):
+            await _gen(eng, list(range(300 + 40 * i, 300 + 40 * i + 3 * PAGE)), 2, f"f{i}")
+        await _drain_offloads(eng)
+        assert len(eng.kvbm.manager.disk) > 0, "cascade to disk expected"
+        again = await _gen(eng, base, 4, "b")
+        assert again == first
+        assert eng.kvbm.manager.onboarded_blocks >= 3
+        await eng.close()
+
+    asyncio.run(main())
+
+
+def test_kvbm_disabled_by_default(params):
+    async def main():
+        eng = _engine(params)
+        assert eng.kvbm is None
+        toks = await _gen(eng, list(range(10, 26)), 2, "x")
+        assert len(toks) == 2
+        await eng.close()
+
+    asyncio.run(main())
+
+
+def _hashes(prompt):
+    from dynamo_tpu.llm.tokens import TokenBlockSequence
+
+    return TokenBlockSequence(prompt, PAGE).block_hashes()
+
+
+async def _drain_offloads(eng):
+    """Wait for queued write-through offloads on the device executor."""
+    for _ in range(100):
+        if eng.kvbm is None or eng.kvbm._pending == 0:
+            return
+        await asyncio.sleep(0.01)
+    raise TimeoutError("offloads did not drain")
