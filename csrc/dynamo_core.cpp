@@ -1,0 +1,193 @@
+// dynamo-tpu native core: chained block hashing + KV radix index.
+//
+// The host-side hot path of the KV router (reference lib/llm/src/tokens.rs
+// compute_hash_v2 :36 and kv_router/indexer.rs RadixTree :224 /
+// find_matches :276 — Rust there, C++ here). Exposed as a C ABI consumed
+// via ctypes from dynamo_tpu/native/__init__.py; semantics must match the
+// pure-Python fallback (llm/tokens.py, llm/kv_router/indexer.py) exactly —
+// parity-tested in tests/test_native_core.py.
+//
+// Hash scheme: xxh3_64(le_bytes(u32 tokens), seed=parent_hash); parent of
+// the first block is the salt hash. Chained hashes make every block hash a
+// unique prefix id, so the "radix tree" is a flat hash map with a
+// continuity walk at match time (same collapse the Python version does).
+
+#define XXH_INLINE_ALL
+#include "xxhash.h"
+
+#include <cstdint>
+#include <cstring>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------- hashing
+
+uint64_t dyn_block_hash(const uint32_t* tokens, uint64_t n, uint64_t parent) {
+  // tokens are already little-endian u32 in memory on every target we run on
+  return XXH3_64bits_withSeed(tokens, n * sizeof(uint32_t), parent);
+}
+
+// out must hold n / block_size entries; returns the number written
+uint64_t dyn_seq_hashes(const uint32_t* tokens, uint64_t n,
+                        uint64_t block_size, uint64_t salt, uint64_t* out) {
+  uint64_t parent = salt;
+  uint64_t written = 0;
+  for (uint64_t start = 0; start + block_size <= n; start += block_size) {
+    parent = XXH3_64bits_withSeed(tokens + start, block_size * sizeof(uint32_t),
+                                  parent);
+    out[written++] = parent;
+  }
+  return written;
+}
+
+// ------------------------------------------------------------------ index
+
+struct DynIndex {
+  // hash -> holder workers. Chained hashes are effectively unique per
+  // prefix, so holder sets are tiny (replicas of the same content).
+  std::unordered_map<uint64_t, std::vector<int64_t>> blocks;
+  std::unordered_map<int64_t, std::unordered_set<uint64_t>> worker_blocks;
+};
+
+void* dyn_index_new() { return new DynIndex(); }
+
+void dyn_index_free(void* p) { delete static_cast<DynIndex*>(p); }
+
+void dyn_index_apply_stored(void* p, int64_t worker, const uint64_t* hashes,
+                            uint64_t n) {
+  auto* idx = static_cast<DynIndex*>(p);
+  auto& wb = idx->worker_blocks[worker];
+  for (uint64_t i = 0; i < n; ++i) {
+    uint64_t h = hashes[i];
+    auto& holders = idx->blocks[h];
+    bool present = false;
+    for (int64_t w : holders)
+      if (w == worker) { present = true; break; }
+    if (!present) holders.push_back(worker);
+    wb.insert(h);
+  }
+}
+
+void dyn_index_apply_removed(void* p, int64_t worker, const uint64_t* hashes,
+                             uint64_t n) {
+  auto* idx = static_cast<DynIndex*>(p);
+  auto wb = idx->worker_blocks.find(worker);
+  for (uint64_t i = 0; i < n; ++i) {
+    uint64_t h = hashes[i];
+    auto it = idx->blocks.find(h);
+    if (it != idx->blocks.end()) {
+      auto& holders = it->second;
+      for (size_t j = 0; j < holders.size(); ++j) {
+        if (holders[j] == worker) {
+          holders[j] = holders.back();
+          holders.pop_back();
+          break;
+        }
+      }
+      if (holders.empty()) idx->blocks.erase(it);
+    }
+    if (wb != idx->worker_blocks.end()) wb->second.erase(h);
+  }
+}
+
+void dyn_index_remove_worker(void* p, int64_t worker) {
+  auto* idx = static_cast<DynIndex*>(p);
+  auto wb = idx->worker_blocks.find(worker);
+  if (wb == idx->worker_blocks.end()) return;
+  for (uint64_t h : wb->second) {
+    auto it = idx->blocks.find(h);
+    if (it == idx->blocks.end()) continue;
+    auto& holders = it->second;
+    for (size_t j = 0; j < holders.size(); ++j) {
+      if (holders[j] == worker) {
+        holders[j] = holders.back();
+        holders.pop_back();
+        break;
+      }
+    }
+    if (holders.empty()) idx->blocks.erase(it);
+  }
+  idx->worker_blocks.erase(wb);
+}
+
+uint64_t dyn_index_num_blocks(void* p) {
+  return static_cast<DynIndex*>(p)->blocks.size();
+}
+
+uint64_t dyn_index_worker_block_count(void* p, int64_t worker) {
+  auto* idx = static_cast<DynIndex*>(p);
+  auto it = idx->worker_blocks.find(worker);
+  return it == idx->worker_blocks.end() ? 0 : it->second.size();
+}
+
+// Match walk (reference find_matches indexer.rs:276): a worker scores d+1
+// iff it holds blocks 0..d contiguously; workers that drop out early keep
+// the score of the depth they last survived (matches the Python/Rust
+// OverlapScores map). Output: parallel arrays of worker ids and scores
+// (capacity max_workers) plus per-depth survivor counts (capacity n,
+// written count to *freq_n). Returns the number of scored workers.
+uint64_t dyn_index_find_matches(void* p, const uint64_t* hashes,
+                                uint64_t n, int early_exit,
+                                int64_t* out_workers, uint64_t* out_scores,
+                                uint64_t max_workers, uint64_t* out_freqs,
+                                uint64_t* freq_n) {
+  auto* idx = static_cast<DynIndex*>(p);
+  std::unordered_map<int64_t, uint64_t> scores;
+  std::vector<int64_t> active;
+  bool first = true;
+  uint64_t freqs = 0;
+  for (uint64_t depth = 0; depth < n; ++depth) {
+    auto it = idx->blocks.find(hashes[depth]);
+    if (it == idx->blocks.end() || it->second.empty()) break;
+    if (first) {
+      active = it->second;
+      first = false;
+    } else {
+      const auto& holders = it->second;
+      std::vector<int64_t> next;
+      next.reserve(active.size());
+      for (int64_t w : active)
+        for (int64_t h : holders)
+          if (w == h) { next.push_back(w); break; }
+      active.swap(next);
+    }
+    if (active.empty()) break;
+    out_freqs[freqs++] = active.size();
+    for (int64_t w : active) scores[w] = depth + 1;
+    if (early_exit && active.size() == 1) break;
+  }
+  *freq_n = freqs;
+  uint64_t i = 0;
+  for (const auto& kv : scores) {
+    if (i >= max_workers) break;
+    out_workers[i] = kv.first;
+    out_scores[i] = kv.second;
+    ++i;
+  }
+  return i;
+}
+
+// Snapshot support: write (worker, hash) pairs. First call with
+// out=nullptr to get the count.
+uint64_t dyn_index_dump(void* p, int64_t* out_workers, uint64_t* out_hashes,
+                        uint64_t cap) {
+  auto* idx = static_cast<DynIndex*>(p);
+  uint64_t total = 0;
+  for (const auto& kv : idx->worker_blocks) total += kv.second.size();
+  if (out_workers == nullptr || out_hashes == nullptr) return total;
+  uint64_t i = 0;
+  for (const auto& kv : idx->worker_blocks) {
+    for (uint64_t h : kv.second) {
+      if (i >= cap) return i;
+      out_workers[i] = kv.first;
+      out_hashes[i] = h;
+      ++i;
+    }
+  }
+  return i;
+}
+
+}  // extern "C"

@@ -207,8 +207,13 @@ class JaxEngine:
         self._wake.set()
         if self._step_task:
             self._step_task.cancel()
-        if self.kvbm is not None and self.kvbm.manager.disk is not None:
-            self.kvbm.manager.disk.flush()  # persist G3 index for warm restart
+        if self.kvbm is not None:
+            # drain in-flight write-through offloads, then persist G3 index
+            for _ in range(500):
+                if self.kvbm._pending == 0:
+                    break
+                await asyncio.sleep(0.01)
+            self.kvbm.manager.flush()
 
     async def generate(self, request: Any, context: Context) -> AsyncIterator[dict]:
         self.start()

@@ -134,6 +134,12 @@ class KvBlockManager:
             self.onboarded_blocks += len(hashes)
         return np.stack(ks), np.stack(vs)
 
+    def flush(self):
+        """Persist the disk tier's index (engine close / checkpoint)."""
+        with self._lock:
+            if self.disk is not None:
+                self.disk.flush()
+
     def stats(self) -> dict:
         out = {
             "kvbm_offloaded_blocks": self.offloaded_blocks,
@@ -161,6 +167,7 @@ class KvbmConnector:
         self.engine = engine
         self.manager = manager
         self._pending = 0
+        self._pending_lock = threading.Lock()  # bumped on loop, dropped on exec thread
 
     # -- offload (called on the event loop right after block commit) ----- #
 
@@ -189,10 +196,12 @@ class KvbmConnector:
             for i, h in enumerate(hashes):
                 self.manager.store(h, k_np[i], v_np[i])
 
-        self._pending += 1
+        with self._pending_lock:
+            self._pending += 1
 
         def done(fut):
-            self._pending -= 1
+            with self._pending_lock:
+                self._pending -= 1
             exc = fut.exception()
             if exc is not None:
                 logger.warning("KVBM offload failed: %s", exc)

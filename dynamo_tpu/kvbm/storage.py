@@ -21,21 +21,29 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 
-class HostTier:
-    """G2: preallocated host-RAM block pool (pinned-host analogue of
-    block_manager/storage/cuda.rs PinnedStorage)."""
+class _BlockPool:
+    """Shared slot-pool + LRU bookkeeping for both tiers. Subclasses supply
+    the backing arrays (`_k`/`_v`) and may pre-seed `_by_hash` before
+    calling `_init_pool`."""
 
-    name = "host"
+    name = "pool"
 
     def __init__(self, capacity: int, block_shape: tuple, dtype):
         self.capacity = capacity
         self.block_shape = tuple(block_shape)
-        self.dtype = dtype
-        self._k = np.zeros((capacity, *self.block_shape), dtype)
-        self._v = np.zeros((capacity, *self.block_shape), dtype)
-        self._free: List[int] = list(range(capacity - 1, -1, -1))
+        self.dtype = np.dtype(dtype)
         self._by_hash: Dict[int, int] = {}  # seq_hash -> slot
+        self._k: np.ndarray
+        self._v: np.ndarray
+        self._free: List[int] = []
         self._lru: "OrderedDict[int, None]" = OrderedDict()
+
+    def _init_pool(self):
+        """Build free list / LRU from whatever `_by_hash` holds (empty for a
+        cold start; the persisted index for a warm disk restart)."""
+        used = set(self._by_hash.values())
+        self._free = [s for s in range(self.capacity - 1, -1, -1) if s not in used]
+        self._lru = OrderedDict((h, None) for h in self._by_hash)
 
     def __len__(self) -> int:
         return len(self._by_hash)
@@ -45,9 +53,10 @@ class HostTier:
 
     def put(
         self, seq_hash: int, k: np.ndarray, v: np.ndarray
-    ) -> Optional[Tuple[int, np.ndarray, np.ndarray]]:
-        """Store a block. Returns the evicted (hash, k, v) if the pool was
-        full (caller cascades it to the next tier), else None."""
+    ) -> Optional[Tuple[int, Optional[np.ndarray], Optional[np.ndarray]]]:
+        """Store a block. If the pool was full, returns the evicted
+        (hash, k, v) — with data copies only when `evict_with_data` — so the
+        caller can cascade it to the next tier. Returns None otherwise."""
         if seq_hash in self._by_hash:
             self._lru[seq_hash] = None
             self._lru.move_to_end(seq_hash)
@@ -56,7 +65,10 @@ class HostTier:
         if not self._free:
             old_hash, _ = self._lru.popitem(last=False)
             slot = self._by_hash.pop(old_hash)
-            evicted = (old_hash, self._k[slot].copy(), self._v[slot].copy())
+            if self.evict_with_data:
+                evicted = (old_hash, self._k[slot].copy(), self._v[slot].copy())
+            else:
+                evicted = (old_hash, None, None)
             self._free.append(slot)
         slot = self._free.pop()
         self._k[slot] = k
@@ -66,6 +78,8 @@ class HostTier:
         return evicted
 
     def get(self, seq_hash: int) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        """Returns VIEWS into the pool; callers that hold the result across
+        further put()s must copy."""
         slot = self._by_hash.get(seq_hash)
         if slot is None:
             return None
@@ -73,39 +87,65 @@ class HostTier:
         return self._k[slot], self._v[slot]
 
     def stats(self) -> dict:
-        return {"host_blocks": len(self._by_hash), "host_capacity": self.capacity}
+        return {
+            f"{self.name}_blocks": len(self._by_hash),
+            f"{self.name}_capacity": self.capacity,
+        }
+
+    evict_with_data = True
 
 
-class DiskTier:
+class HostTier(_BlockPool):
+    """G2: preallocated host-RAM block pool (pinned-host analogue of
+    block_manager/storage/cuda.rs PinnedStorage). Evictions carry the block
+    data so the manager can cascade them to disk."""
+
+    name = "host"
+    evict_with_data = True
+
+    def __init__(self, capacity: int, block_shape: tuple, dtype):
+        super().__init__(capacity, block_shape, dtype)
+        self._k = np.zeros((capacity, *self.block_shape), self.dtype)
+        self._v = np.zeros((capacity, *self.block_shape), self.dtype)
+        self._init_pool()
+
+
+class DiskTier(_BlockPool):
     """G3: np.memmap-backed block pool (block_manager/storage/disk.rs).
 
     Two pool files (k.bin / v.bin) with fixed block slots — the reference's
-    fully-contiguous layout (layout.rs). The hash index lives in memory and
-    is persisted to index.json on flush() so a restarted worker can reuse
-    warm blocks (reference: G3 tiers persist KV for reuse, offload.rs).
+    fully-contiguous layout (layout.rs). The hash index is persisted to
+    index.json by flush() (the engine calls it on close) and loaded on init
+    when the pool files validate, so a restarted worker reuses warm blocks
+    (reference: G3 tiers persist KV for reuse, offload.rs). Disk is the last
+    tier: evictions drop the block, so they carry no data.
     """
 
     name = "disk"
+    evict_with_data = False
 
     def __init__(self, capacity: int, block_shape: tuple, dtype, path: str):
-        self.capacity = capacity
-        self.block_shape = tuple(block_shape)
-        self.dtype = np.dtype(dtype)
+        super().__init__(capacity, block_shape, dtype)
         self.path = path
         os.makedirs(path, exist_ok=True)
         shape = (capacity, *self.block_shape)
-        self._by_hash: Dict[int, int] = {}
         index_path = os.path.join(path, "index.json")
         k_path = os.path.join(path, "k.bin")
+        v_path = os.path.join(path, "v.bin")
+        expected_bytes = int(np.prod(shape)) * self.dtype.itemsize
         mode = "w+"
-        if os.path.exists(index_path) and os.path.exists(k_path):
+        if (
+            os.path.exists(index_path)
+            and os.path.exists(k_path)
+            and os.path.exists(v_path)
+        ):
             try:
                 with open(index_path) as f:
                     saved = json.load(f)
-                expected_bytes = int(np.prod(shape)) * self.dtype.itemsize
                 if (
                     tuple(saved.get("block_shape", ())) == self.block_shape
                     and os.path.getsize(k_path) == expected_bytes
+                    and os.path.getsize(v_path) == expected_bytes
                 ):
                     self._by_hash = {
                         int(h): s
@@ -116,53 +156,19 @@ class DiskTier:
             except (ValueError, KeyError, OSError):
                 self._by_hash = {}
         self._k = np.memmap(k_path, dtype=self.dtype, mode=mode, shape=shape)
-        self._v = np.memmap(
-            os.path.join(path, "v.bin"), dtype=self.dtype, mode=mode, shape=shape
-        )
-        used = set(self._by_hash.values())
-        self._free: List[int] = [s for s in range(capacity - 1, -1, -1) if s not in used]
-        self._lru: "OrderedDict[int, None]" = OrderedDict(
-            (h, None) for h in self._by_hash
-        )
-
-    def __len__(self) -> int:
-        return len(self._by_hash)
-
-    def has(self, seq_hash: int) -> bool:
-        return seq_hash in self._by_hash
+        self._v = np.memmap(v_path, dtype=self.dtype, mode=mode, shape=shape)
+        self._init_pool()
 
     def put(self, seq_hash: int, k: np.ndarray, v: np.ndarray) -> Optional[int]:
-        """Store a block; disk is the last tier, so a full pool drops the
-        LRU block entirely. Returns the dropped hash, if any."""
-        if seq_hash in self._by_hash:
-            self._lru[seq_hash] = None
-            self._lru.move_to_end(seq_hash)
-            return None
-        dropped = None
-        if not self._free:
-            old_hash, _ = self._lru.popitem(last=False)
-            self._free.append(self._by_hash.pop(old_hash))
-            dropped = old_hash
-        slot = self._free.pop()
-        self._k[slot] = k
-        self._v[slot] = v
-        self._by_hash[seq_hash] = slot
-        self._lru[seq_hash] = None
-        return dropped
-
-    def get(self, seq_hash: int) -> Optional[Tuple[np.ndarray, np.ndarray]]:
-        slot = self._by_hash.get(seq_hash)
-        if slot is None:
-            return None
-        self._lru.move_to_end(seq_hash)
-        return np.asarray(self._k[slot]), np.asarray(self._v[slot])
+        """Returns the dropped hash if the pool was full, else None."""
+        evicted = super().put(seq_hash, k, v)
+        return evicted[0] if evicted is not None else None
 
     def flush(self):
+        """Persist pool + index. NOT thread-safe on its own — call via
+        KvBlockManager.flush(), which holds the manager lock."""
         self._k.flush()
         self._v.flush()
         index = {str(h): s for h, s in self._by_hash.items()}
         with open(os.path.join(self.path, "index.json"), "w") as f:
             json.dump({"block_shape": self.block_shape, "index": index}, f)
-
-    def stats(self) -> dict:
-        return {"disk_blocks": len(self._by_hash), "disk_capacity": self.capacity}

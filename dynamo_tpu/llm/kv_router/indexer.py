@@ -120,10 +120,12 @@ class KvIndexer:
     (reference KvIndexer indexer.rs + subscriber.rs)."""
 
     def __init__(self, drt: DistributedRuntime, namespace: str, component: str, block_size: int = 64):
+        from ...native import make_radix_tree
+
         self.drt = drt
         self.block_size = block_size
         self.topic = EVENT_TOPIC_FMT.format(namespace=namespace, component=component)
-        self.tree = RadixTree()
+        self.tree = make_radix_tree()  # C++ index when built, else RadixTree
         self._task: Optional[asyncio.Task] = None
         self._sub = None
         self.events_applied = 0
@@ -170,9 +172,11 @@ class ApproxKvIndexer:
     (reference ApproxKvIndexer approx.rs)."""
 
     def __init__(self, block_size: int = 64, ttl: float = 120.0):
+        from ...native import make_radix_tree
+
         self.block_size = block_size
         self.ttl = ttl
-        self.tree = RadixTree()
+        self.tree = make_radix_tree()
         self._expiry: List[tuple] = []  # (deadline, worker_id, hashes)
 
     def process_routing_decision_for_request(self, token_ids: List[int], worker_id: int):

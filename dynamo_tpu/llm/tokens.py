@@ -22,6 +22,16 @@ import xxhash
 DEFAULT_BLOCK_SIZE = 64
 NULL_PARENT = 0
 
+# resolve the native C++ core ONCE at import (process startup): the first
+# _load() may run `make`, which must never happen on the serving path
+try:
+    from ..native import native_available as _native_available
+    from ..native import compute_seq_hashes as _native_seq_hashes
+
+    _NATIVE = _native_available()
+except Exception:  # noqa: BLE001 — any native failure falls back to Python
+    _NATIVE = False
+
 
 def salt_hash(salt: bytes = b"") -> int:
     """Per-model/per-tenant salt (reference SaltHash tokens.rs:30)."""
@@ -39,7 +49,12 @@ def compute_seq_hashes(
     block_size: int = DEFAULT_BLOCK_SIZE,
     salt: int = NULL_PARENT,
 ) -> List[int]:
-    """Sequence hashes of every COMPLETE block of `tokens`."""
+    """Sequence hashes of every COMPLETE block of `tokens`.
+
+    Dispatches to the native C++ core (csrc/dynamo_core.cpp) when built;
+    the fallback below is the semantic definition (parity-tested)."""
+    if _NATIVE and len(tokens) >= block_size:
+        return _native_seq_hashes(tokens, block_size, salt)
     hashes: List[int] = []
     parent = salt
     for start in range(0, len(tokens) - block_size + 1, block_size):

@@ -1,0 +1,122 @@
+"""Native C++ core (csrc/dynamo_core.cpp) parity vs the pure-Python
+implementations — same hashes, same match semantics, on randomized traffic.
+"""
+
+import random
+
+import pytest
+
+from dynamo_tpu import native
+from dynamo_tpu.llm import tokens as pytokens
+from dynamo_tpu.llm.kv_router.indexer import RadixTree
+
+pytestmark = pytest.mark.skipif(
+    not native.native_available(), reason="native core not built"
+)
+
+
+def _py_seq_hashes(toks, block_size, salt=0):
+    """Pure-python reference (bypasses the native dispatch in tokens.py)."""
+    hashes = []
+    parent = salt
+    for start in range(0, len(toks) - block_size + 1, block_size):
+        parent = pytokens.compute_block_hash(toks[start : start + block_size], parent)
+        hashes.append(parent)
+    return hashes
+
+
+def test_hash_parity_randomized():
+    rng = random.Random(0)
+    for trial in range(20):
+        n = rng.randint(0, 300)
+        toks = [rng.randint(0, 200_000) for _ in range(n)]
+        block = rng.choice([4, 16, 64])
+        salt = rng.choice([0, 0xDEADBEEF, 2**63 + 17])
+        assert native.compute_seq_hashes(toks, block, salt) == _py_seq_hashes(
+            toks, block, salt
+        ), f"trial {trial}"
+
+
+def test_single_block_hash_parity():
+    toks = list(range(64))
+    assert native.compute_block_hash(toks, 7) == pytokens.compute_block_hash(toks, 7)
+
+
+def _rand_ops(rng, n_workers=6, n_chains=8, n_ops=400):
+    """A randomized stored/removed/remove_worker event stream over a few
+    hash chains (chains shared across workers -> replica overlap)."""
+    chains = [
+        _py_seq_hashes([rng.randint(0, 9999) for _ in range(16 * 8)], 16)
+        for _ in range(n_chains)
+    ]
+    ops = []
+    for _ in range(n_ops):
+        kind = rng.random()
+        w = rng.randint(1, n_workers)
+        chain = rng.choice(chains)
+        k = rng.randint(1, len(chain))
+        if kind < 0.6:
+            ops.append(("stored", w, chain[:k]))
+        elif kind < 0.9:
+            # remove a suffix (engines evict leaves first) or random subset
+            ops.append(("removed", w, chain[k - 1 :]))
+        else:
+            ops.append(("remove_worker", w, None))
+    return chains, ops
+
+
+def test_index_parity_randomized():
+    rng = random.Random(42)
+    chains, ops = _rand_ops(rng)
+    nat = native.NativeRadixTree()
+    py = RadixTree()
+    for kind, w, hashes in ops:
+        if kind == "stored":
+            nat.apply_stored(w, hashes)
+            py.apply_stored(w, hashes)
+        elif kind == "removed":
+            nat.apply_removed(w, hashes)
+            py.apply_removed(w, hashes)
+        else:
+            nat.remove_worker(w)
+            py.remove_worker(w)
+    assert nat.num_blocks == py.num_blocks
+    for chain in chains:
+        for k in (1, 3, len(chain)):
+            a = nat.find_matches(chain[:k])
+            b = py.find_matches(chain[:k])
+            assert a.scores == b.scores, f"k={k}"
+            assert a.frequencies == b.frequencies
+        # early_exit parity
+        a = nat.find_matches(chain, early_exit=True)
+        b = py.find_matches(chain, early_exit=True)
+        assert a.scores == b.scores
+    for w in range(1, 7):
+        assert nat.worker_block_count(w) == py.worker_block_count(w)
+
+
+def test_dump_load_roundtrip():
+    rng = random.Random(7)
+    _, ops = _rand_ops(rng, n_ops=100)
+    nat = native.NativeRadixTree()
+    for kind, w, hashes in ops:
+        if kind == "stored":
+            nat.apply_stored(w, hashes)
+        elif kind == "removed":
+            nat.apply_removed(w, hashes)
+        else:
+            nat.remove_worker(w)
+    snap = nat.dump()
+    py = RadixTree()
+    py.load(snap)
+    assert py.dump() == snap
+    restored = native.NativeRadixTree()
+    restored.load(snap)
+    assert restored.dump() == snap
+
+
+def test_kv_indexer_uses_native_tree():
+    from dynamo_tpu.native import make_radix_tree
+
+    tree = make_radix_tree()
+    assert isinstance(tree, native.NativeRadixTree)
