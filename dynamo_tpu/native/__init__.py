@@ -36,16 +36,18 @@ def _load() -> Optional[ctypes.CDLL]:
     _load_attempted = True
     if os.environ.get("DYN_NATIVE", "1") == "0":
         return None
-    if not os.path.exists(_SO):
-        try:
-            subprocess.run(
-                ["make", "-C", _CSRC],
-                check=True,
-                capture_output=True,
-                timeout=120,
-            )
-        except Exception as e:  # noqa: BLE001 — fall back to pure Python
-            logger.info("native core build failed (%s); using pure Python", e)
+    # always invoke make: a no-op when the .so is fresh, a rebuild when
+    # csrc/ changed (a stale gitignored .so must not silently win)
+    try:
+        subprocess.run(
+            ["make", "-C", _CSRC],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+    except Exception as e:  # noqa: BLE001 — fall back to pure Python
+        logger.info("native core build failed (%s); using pure Python", e)
+        if not os.path.exists(_SO):
             return None
     try:
         lib = ctypes.CDLL(_SO)
@@ -88,9 +90,18 @@ def _as_u64_array(hashes: Sequence[int]) -> np.ndarray:
     return np.asarray([h & 0xFFFFFFFFFFFFFFFF for h in hashes], dtype=np.uint64)
 
 
+def _as_u32_tokens(tokens: Sequence[int]) -> np.ndarray:
+    """Match the pure-Python path's `tok & 0xFFFFFFFF` masking (tokens.py)
+    instead of letting numpy raise OverflowError on out-of-range ids."""
+    arr = np.asarray(tokens)
+    if arr.dtype == np.uint32:
+        return arr
+    return (np.asarray(arr, dtype=np.int64) & 0xFFFFFFFF).astype(np.uint32)
+
+
 def compute_block_hash(tokens: Sequence[int], parent_hash: int = 0) -> int:
     lib = _load()
-    toks = np.asarray(tokens, dtype=np.uint32)
+    toks = _as_u32_tokens(tokens)
     return int(
         lib.dyn_block_hash(
             toks.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
@@ -104,7 +115,7 @@ def compute_seq_hashes(
     tokens: Sequence[int], block_size: int = 64, salt: int = 0
 ) -> List[int]:
     lib = _load()
-    toks = np.asarray(tokens, dtype=np.uint32)
+    toks = _as_u32_tokens(tokens)
     out = np.empty(max(len(toks) // block_size, 1), dtype=np.uint64)
     n = lib.dyn_seq_hashes(
         toks.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),

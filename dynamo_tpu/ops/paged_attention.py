@@ -54,6 +54,24 @@ def prefill_attention(
     return out.reshape(T, H, D)
 
 
+def _use_pallas_decode() -> bool:
+    import os
+
+    mode = os.environ.get("DYNAMO_TPU_PAGED_ATTN", "auto")
+    if mode == "pallas":
+        return True
+    if mode == "xla":
+        return False
+    try:
+        # auto: single-chip TPU only. Under a tp>1 GSPMD mesh the KV cache is
+        # sharded over heads and a bare pallas_call has no partitioning rule —
+        # the XLA path partitions cleanly there. (shard_map-wrapped kernel is
+        # the multi-chip follow-up.)
+        return jax.default_backend() == "tpu" and jax.device_count() == 1
+    except Exception:
+        return False
+
+
 def paged_attention_decode(
     q: jax.Array,  # [B, H, D]
     kv_k_layer: jax.Array,  # [pages, page_size, KH, D]
@@ -63,10 +81,16 @@ def paged_attention_decode(
 ) -> jax.Array:
     """One-token decode attention over paged KV. Returns [B, H, D].
 
-    XLA reference path: gathers each slot's pages ([B, S, KH, D]) and runs a
-    masked GQA softmax-attention einsum. The Pallas TPU kernel replaces the
-    materialized gather on real hardware.
+    Dispatch: on TPU (or DYNAMO_TPU_PAGED_ATTN=pallas) the Pallas flash
+    kernel (ops/pallas_paged_attention.py) streams pages HBM→VMEM without
+    materializing the gather; elsewhere the XLA reference path below runs.
     """
+    if _use_pallas_decode():
+        from .pallas_paged_attention import paged_attention_decode_pallas
+
+        return paged_attention_decode_pallas(
+            q, kv_k_layer, kv_v_layer, page_tables, seq_lens
+        )
     B, H, D = q.shape
     page_size = kv_k_layer.shape[1]
     KH = kv_k_layer.shape[2]

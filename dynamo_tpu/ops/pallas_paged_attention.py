@@ -1,0 +1,224 @@
+"""Pallas TPU kernel: ragged paged-attention for single-token decode.
+
+Role of the reference's paged-attention CUDA kernels (inside vLLM) and of
+`block_copy.cu` (lib/llm/src/kernels/block_copy.cu:41) — done the TPU way:
+the KV cache stays in HBM, each grid step streams ONE slot's pages through
+a double-buffered VMEM window with async DMA, and a flash-style running
+softmax accumulates the output. This avoids the XLA fallback's materialized
+[B, S, KH, D] gather (which costs an extra HBM round-trip for the whole
+context).
+
+Layouts (match ops/paged_attention.py and engine/kv_cache.py):
+    q:           [B, H, D]
+    kv_{k,v}:    [num_pages, page_size, KH, D]   (one layer)
+    page_tables: [B, max_pages] int32  (logical -> physical page)
+    seq_lens:    [B] int32             (valid positions incl. current token)
+
+Design notes:
+  * grid = (B,); page_tables/seq_lens ride scalar-prefetch (SMEM) so DMA
+    source indices are known ahead of the body.
+  * pages are streamed in chunks of CHUNK = max(128, page_size) positions so
+    the score lane dimension is a full 128-lane register tile.
+  * physical page ids are clamped to the valid range: tail chunks may DMA a
+    garbage page, but masking (additive NEG) keeps them out of the softmax.
+  * all softmax state is f32; QK^T and PV ride the MXU in bf16 with f32
+    accumulation (preferred_element_type).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG = -1e30
+
+
+def _decode_kernel(
+    # scalar prefetch
+    pt_ref,  # [B, max_pages] int32 (SMEM)
+    sl_ref,  # [B] int32 (SMEM)
+    # inputs
+    q_ref,  # [1, H, D] VMEM block
+    kv_k_hbm,  # [num_pages, page_size, KH, D] (ANY/HBM)
+    kv_v_hbm,
+    # outputs
+    out_ref,  # [1, H, D] VMEM block
+    # scratch
+    k_buf,  # [2, CHUNK, KH, D] VMEM
+    v_buf,
+    k_sem,  # DMA sems [2, chunk_pages]
+    v_sem,
+    *,
+    page_size: int,
+    chunk_pages: int,
+    max_pages: int,
+    num_heads: int,
+    num_kv_heads: int,
+    head_dim: int,
+):
+    b = pl.program_id(0)
+    chunk = chunk_pages * page_size
+    num_phys = kv_k_hbm.shape[0]
+    kh, g, d = num_kv_heads, num_heads // num_kv_heads, head_dim
+
+    seq_len = jnp.maximum(sl_ref[b], 1)  # empty slots behave as len-1
+    n_chunks = pl.cdiv(seq_len, chunk)
+    max_chunks = pl.cdiv(max_pages, chunk_pages)
+
+    def start_chunk(ci, slot):
+        """Kick off DMAs for all pages of chunk ci into buffer `slot`."""
+        for p in range(chunk_pages):
+            lp = ci * chunk_pages + p
+            lp_safe = jnp.minimum(lp, max_pages - 1)
+            phys = jnp.minimum(pt_ref[b, lp_safe], num_phys - 1)
+            pltpu.make_async_copy(
+                kv_k_hbm.at[phys],
+                k_buf.at[slot, pl.ds(p * page_size, page_size)],
+                k_sem.at[slot, p],
+            ).start()
+            pltpu.make_async_copy(
+                kv_v_hbm.at[phys],
+                v_buf.at[slot, pl.ds(p * page_size, page_size)],
+                v_sem.at[slot, p],
+            ).start()
+
+    def wait_chunk(ci, slot):
+        for p in range(chunk_pages):
+            lp_safe = jnp.minimum(ci * chunk_pages + p, max_pages - 1)
+            phys = jnp.minimum(pt_ref[b, lp_safe], num_phys - 1)
+            pltpu.make_async_copy(
+                kv_k_hbm.at[phys],
+                k_buf.at[slot, pl.ds(p * page_size, page_size)],
+                k_sem.at[slot, p],
+            ).wait()
+            pltpu.make_async_copy(
+                kv_v_hbm.at[phys],
+                v_buf.at[slot, pl.ds(p * page_size, page_size)],
+                v_sem.at[slot, p],
+            ).wait()
+
+    start_chunk(0, 0)
+
+    # GQA as ONE matmul pair per chunk: q arrives pre-packed block-diagonal
+    # [KH*G, KH*D] (head h's G queries in column block h, built by XLA in
+    # the wrapper) so s = q_bd @ k_flat^T and pv = p @ v_flat each hit the
+    # MXU once instead of KH tiny per-head matmuls. acc accumulates the full
+    # [HG, KH*D] pv; the diagonal blocks are extracted once after the loop.
+    hg = kh * g
+    q_bd = q_ref[0]  # [HG, KH*D]
+
+    m0 = jnp.full((hg, 1), NEG, jnp.float32)
+    l0 = jnp.zeros((hg, 1), jnp.float32)
+    acc0 = jnp.zeros((hg, kh * d), jnp.float32)
+
+    def body(ci, carry):
+        m, l, acc = carry
+        slot = jax.lax.rem(ci, 2)
+
+        @pl.when(ci + 1 < n_chunks)
+        def _():
+            start_chunk(ci + 1, jax.lax.rem(ci + 1, 2))
+
+        wait_chunk(ci, slot)
+        k = k_buf[slot].reshape(chunk, kh * d)  # [CHUNK, KH*D]
+        v = v_buf[slot].reshape(chunk, kh * d)
+
+        pos = ci * chunk + jax.lax.broadcasted_iota(jnp.int32, (1, chunk), 1)
+        valid = pos < seq_len  # [1, CHUNK]
+
+        s = jax.lax.dot_general(
+            q_bd.astype(k.dtype),
+            k,
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [HG, CHUNK]
+        s = jnp.where(valid, s, NEG)
+        m_n = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_n)  # [HG, 1]
+        p = jnp.exp(s - m_n)  # [HG, CHUNK]
+        l_n = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv_all = jax.lax.dot_general(
+            p.astype(v.dtype),
+            v,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [HG, KH*D]
+        return m_n, l_n, acc * alpha + pv_all
+
+    m, l, acc = jax.lax.fori_loop(0, n_chunks, body, (m0, l0, acc0))
+    # extract head h's D-block from row block h of acc
+    row_head = jax.lax.broadcasted_iota(jnp.int32, (hg, kh, 1), 0) // g
+    col_head = jax.lax.broadcasted_iota(jnp.int32, (hg, kh, 1), 1)
+    diag = (row_head == col_head).astype(jnp.float32)  # [HG, KH, 1]
+    out = jnp.sum(acc.reshape(hg, kh, d) * diag, axis=1) / jnp.maximum(l, 1e-30)
+    out_ref[0] = out.astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_attention_decode_pallas(
+    q: jax.Array,  # [B, H, D]
+    kv_k_layer: jax.Array,  # [num_pages, page_size, KH, D]
+    kv_v_layer: jax.Array,
+    page_tables: jax.Array,  # [B, max_pages] int32
+    seq_lens: jax.Array,  # [B] int32
+    *,
+    interpret: bool = False,
+) -> jax.Array:
+    """Flash decode attention over paged KV; returns [B, H, D] (q.dtype)."""
+    B, H, D = q.shape
+    num_pages, page_size, KH, _ = kv_k_layer.shape
+    max_pages = page_tables.shape[1]
+    # chunk target: big enough to amortize per-iteration overhead, small
+    # enough that 2 double-buffered K+V chunks fit comfortably in VMEM
+    target = 512 if KH * D * page_size <= 131072 else 256
+    chunk_pages = max(1, target // page_size)
+    chunk_pages = min(chunk_pages, max_pages)
+
+    KHG = KH * (H // KH)
+    # pre-pack block-diagonal queries in XLA: q_bd[b, h*G+g, h*D:(h+1)*D] = q
+    scale = 1.0 / (D**0.5)
+    q_r = (q * scale).reshape(B, KH, H // KH, D)
+    eye = jnp.eye(KH, dtype=q.dtype)
+    q_bd = jnp.einsum("bkgd,kj->bkgjd", q_r, eye).reshape(B, KHG, KH * D)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((1, KHG, KH * D), lambda b, *_: (b, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, H, D), lambda b, *_: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, chunk_pages * page_size, KH, D), kv_k_layer.dtype),
+            pltpu.VMEM((2, chunk_pages * page_size, KH, D), kv_v_layer.dtype),
+            pltpu.SemaphoreType.DMA((2, chunk_pages)),
+            pltpu.SemaphoreType.DMA((2, chunk_pages)),
+        ],
+    )
+    kernel = functools.partial(
+        _decode_kernel,
+        page_size=page_size,
+        chunk_pages=chunk_pages,
+        max_pages=max_pages,
+        num_heads=H,
+        num_kv_heads=KH,
+        head_dim=D,
+    )
+    cost = pl.CostEstimate(
+        flops=4 * B * H * D * max_pages * page_size,
+        bytes_accessed=2 * B * max_pages * page_size * KH * D * 2,
+        transcendentals=B * H * max_pages * page_size,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
+        cost_estimate=cost,
+        interpret=interpret,
+    )(page_tables.astype(jnp.int32), seq_lens.astype(jnp.int32), q_bd, kv_k_layer, kv_v_layer)
