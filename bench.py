@@ -36,6 +36,17 @@ def main():
         import os
 
         os.environ["JAX_PLATFORMS"] = "cpu"
+        # axon sitecustomize imports jax at startup, freezing jax_platforms
+        # before the env var applies — update the live config too
+        if "jax" in sys.modules:
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+            # config.update is a silent no-op if a backend already
+            # initialized; a "smoke" run must never hit the real TPU
+            assert jax.devices()[0].platform == "cpu", (
+                f"--smoke needs CPU but backend is {jax.devices()[0].platform}"
+            )
 
     import jax
     import jax.numpy as jnp

@@ -184,6 +184,7 @@ def prefill_forward(
     context_len: jax.Array,  # scalar: positions[<context_len] are valid history
     last_idx: Optional[jax.Array] = None,  # index of the last REAL token in the
     # (possibly padded) chunk; defaults to the final position
+    mlp_fn=None,  # (layer, x, config) -> x; models/moe.py passes moe_mlp
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Process one prompt chunk of a single sequence; returns
     (logits_last [vocab], kv_k, kv_v) with the chunk's KV written into pages.
@@ -192,6 +193,7 @@ def prefill_forward(
     written history via the page table (positions < chunk start).
     """
     c = config
+    mlp_fn = mlp_fn or _mlp
     x = params["embed"][tokens]  # [T, H]
     cos, sin = rope_cos_sin(positions, c.head_dim, c.rope_theta)
     page_size = kv_k.shape[2]
@@ -218,7 +220,7 @@ def prefill_forward(
             )
             attn = attn.reshape(-1, c.num_heads * c.head_dim)
             x = x + jnp.dot(attn, layer["wo"], preferred_element_type=jnp.float32).astype(c.dtype)
-            x = _mlp(layer, x, c)
+            x = mlp_fn(layer, x, c)
         return x, kv_k, kv_v
 
     x, kv_k, kv_v = body(x, kv_k, kv_v)
@@ -247,10 +249,12 @@ def decode_forward(
     kv_v: jax.Array,
     page_tables: jax.Array,  # [B, max_pages]
     seq_lens: jax.Array,  # [B] lengths INCLUDING the new token
+    mlp_fn=None,  # (layer, x, config) -> x; models/moe.py passes moe_mlp
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One decode step for the whole slot batch; returns
     (logits [B, vocab], kv_k, kv_v)."""
     c = config
+    mlp_fn = mlp_fn or _mlp
     x = params["embed"][tokens]  # [B, H]
     cos, sin = rope_cos_sin(positions, c.head_dim, c.rope_theta)
     page_size = kv_k.shape[2]
@@ -275,7 +279,7 @@ def decode_forward(
         attn = paged_attention_decode(q, kv_k[li], kv_v[li], page_tables, seq_lens)
         attn = attn.reshape(-1, c.num_heads * c.head_dim)
         x = x + jnp.dot(attn, layer["wo"], preferred_element_type=jnp.float32).astype(c.dtype)
-        x = _mlp(layer, x, c)
+        x = mlp_fn(layer, x, c)
 
     x = rms_norm(x, params["final_norm"], c.rms_norm_eps)
     head = params["lm_head"] if params["lm_head"] is not None else params["embed"].T

@@ -43,12 +43,13 @@ def _decode_kernel(
     sl_ref,  # [B] int32 (SMEM)
     # inputs
     q_ref,  # [1, H, D] VMEM block
-    kv_k_hbm,  # [num_pages, page_size, KH, D] (ANY/HBM)
+    kv_k_hbm,  # [num_pages, page_size, KH*D] (ANY/HBM; flattened by wrapper —
+    # Mosaic can't shape-cast [C,KH,D]->[C,KH*D] in-register)
     kv_v_hbm,
     # outputs
     out_ref,  # [1, H, D] VMEM block
     # scratch
-    k_buf,  # [2, CHUNK, KH, D] VMEM
+    k_buf,  # [2, CHUNK, KH*D] VMEM
     v_buf,
     k_sem,  # DMA sems [2, chunk_pages]
     v_sem,
@@ -124,8 +125,8 @@ def _decode_kernel(
             start_chunk(ci + 1, jax.lax.rem(ci + 1, 2))
 
         wait_chunk(ci, slot)
-        k = k_buf[slot].reshape(chunk, kh * d)  # [CHUNK, KH*D]
-        v = v_buf[slot].reshape(chunk, kh * d)
+        k = k_buf[slot]  # [CHUNK, KH*D]
+        v = v_buf[slot]
 
         pos = ci * chunk + jax.lax.broadcasted_iota(jnp.int32, (1, chunk), 1)
         valid = pos < seq_len  # [1, CHUNK]
@@ -150,11 +151,14 @@ def _decode_kernel(
         return m_n, l_n, acc * alpha + pv_all
 
     m, l, acc = jax.lax.fori_loop(0, n_chunks, body, (m0, l0, acc0))
-    # extract head h's D-block from row block h of acc
-    row_head = jax.lax.broadcasted_iota(jnp.int32, (hg, kh, 1), 0) // g
-    col_head = jax.lax.broadcasted_iota(jnp.int32, (hg, kh, 1), 1)
-    diag = (row_head == col_head).astype(jnp.float32)  # [HG, KH, 1]
-    out = jnp.sum(acc.reshape(hg, kh, d) * diag, axis=1) / jnp.maximum(l, 1e-30)
+    # extract head h's D-block from row block h of acc: static slices per kv
+    # head (no [HG,KH*D]->[HG,KH,D] reshape — unsupported Mosaic shape cast)
+    row_head = jax.lax.broadcasted_iota(jnp.int32, (hg, 1), 0) // g
+    out = jnp.zeros((hg, d), jnp.float32)
+    for k0 in range(kh):
+        blk = jax.lax.slice(acc, (0, k0 * d), (hg, (k0 + 1) * d))
+        out = out + jnp.where(row_head == k0, blk, 0.0)
+    out = out / jnp.maximum(l, 1e-30)
     out_ref[0] = out.astype(out_ref.dtype)
 
 
@@ -185,6 +189,11 @@ def paged_attention_decode_pallas(
     eye = jnp.eye(KH, dtype=q.dtype)
     q_bd = jnp.einsum("bkgd,kj->bkgjd", q_r, eye).reshape(B, KHG, KH * D)
 
+    # flatten [pages, page_size, KH, D] -> [pages, page_size, KH*D] in XLA
+    # (contiguous bitcast) — Mosaic cannot merge minor dims in-register
+    kv_k_flat = kv_k_layer.reshape(num_pages, page_size, KH * D)
+    kv_v_flat = kv_v_layer.reshape(num_pages, page_size, KH * D)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B,),
@@ -195,8 +204,8 @@ def paged_attention_decode_pallas(
         ],
         out_specs=pl.BlockSpec((1, H, D), lambda b, *_: (b, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((2, chunk_pages * page_size, KH, D), kv_k_layer.dtype),
-            pltpu.VMEM((2, chunk_pages * page_size, KH, D), kv_v_layer.dtype),
+            pltpu.VMEM((2, chunk_pages * page_size, KH * D), kv_k_layer.dtype),
+            pltpu.VMEM((2, chunk_pages * page_size, KH * D), kv_v_layer.dtype),
             pltpu.SemaphoreType.DMA((2, chunk_pages)),
             pltpu.SemaphoreType.DMA((2, chunk_pages)),
         ],
@@ -221,4 +230,4 @@ def paged_attention_decode_pallas(
         out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
         cost_estimate=cost,
         interpret=interpret,
-    )(page_tables.astype(jnp.int32), seq_lens.astype(jnp.int32), q_bd, kv_k_layer, kv_v_layer)
+    )(page_tables.astype(jnp.int32), seq_lens.astype(jnp.int32), q_bd, kv_k_flat, kv_v_flat)

@@ -1,0 +1,116 @@
+"""Ring attention: sequence-parallel exact attention over a mesh axis.
+
+Long-context capability the reference lacks natively (SURVEY.md §2.5 row
+"Sequence/context parallel" — absent upstream, listed as the TPU-native
+extension): the sequence is sharded over the ``sp`` mesh axis, each device
+holds one contiguous chunk of Q/K/V, and K/V blocks rotate around the ring
+via ``ppermute`` while every device accumulates flash-style (running max /
+running sum) partial attention for its local queries. Peak memory per device
+is O(T/n) and the K/V transfer rides the ICI ring — the canonical TPU
+sequence-parallel layout (Ring Attention, Liu et al. 2023; see PAPERS.md).
+
+All shapes are static; the rotation loop is a ``lax.fori_loop`` so the whole
+ring compiles to a single XLA while-loop with collective-permute inside.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _ring_attention_local(
+    q: jax.Array,  # [Tl, H, D] local query chunk (rope applied)
+    k: jax.Array,  # [Tl, KH, D] local key chunk
+    v: jax.Array,  # [Tl, KH, D]
+    *,
+    axis_name: str,
+    num_chunks: int,
+    causal: bool,
+) -> jax.Array:
+    """Per-device body (runs under shard_map). Device r holds sequence chunk
+    r; K/V blocks travel r -> r+1 each step so after `num_chunks` steps every
+    device has seen every block."""
+    rank = jax.lax.axis_index(axis_name)
+    Tl, H, D = q.shape
+    KH = k.shape[1]
+    G = H // KH
+    qg = q.reshape(Tl, KH, G, D)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
+    local_pos = jnp.arange(Tl)
+
+    perm = [(i, (i + 1) % num_chunks) for i in range(num_chunks)]
+
+    o0 = jnp.zeros((Tl, KH, G, D), jnp.float32)
+    m0 = jnp.full((Tl, KH, G), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((Tl, KH, G), jnp.float32)
+
+    def step(i, carry):
+        o, m, l, k_blk, v_blk = carry
+        # which sequence chunk this K/V block is: blocks rotate forward, so at
+        # step i device `rank` holds the block that started at rank - i
+        src = (rank - i) % num_chunks
+        scores = (
+            jnp.einsum("tkgd,skd->tkgs", qg, k_blk, preferred_element_type=jnp.float32)
+            * scale
+        )
+        if causal:
+            q_pos = rank * Tl + local_pos
+            k_pos = src * Tl + local_pos
+            mask = k_pos[None, :] <= q_pos[:, None]  # [Tl, Tl]
+            scores = jnp.where(mask[:, None, None, :], scores, NEG_INF)
+        blk_max = jnp.max(scores, axis=-1)
+        new_m = jnp.maximum(m, blk_max)
+        # keep fully-masked blocks from poisoning the running max correction
+        safe_m = jnp.where(new_m == NEG_INF, 0.0, new_m)
+        corr = jnp.exp(jnp.where(m == NEG_INF, NEG_INF, m - safe_m))
+        p = jnp.exp(scores - safe_m[..., None])
+        p = jnp.where(scores == NEG_INF, 0.0, p)
+        o = o * corr[..., None] + jnp.einsum(
+            "tkgs,skd->tkgd", p, v_blk.astype(jnp.float32)
+        )
+        l = l * corr + jnp.sum(p, axis=-1)
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return (o, new_m, l, k_blk, v_blk)
+
+    o, m, l, _, _ = jax.lax.fori_loop(0, num_chunks, step, (o0, m0, l0, k, v))
+    out = o / jnp.where(l == 0.0, 1.0, l)[..., None]
+    return out.reshape(Tl, H, D).astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,  # [T, H, D] full sequence (sharded or to-be-sharded over sp)
+    k: jax.Array,  # [T, KH, D]
+    v: jax.Array,
+    mesh: Mesh,
+    axis_name: str = "sp",
+    causal: bool = True,
+) -> jax.Array:
+    """Exact (ring) attention with the sequence dim sharded over
+    ``axis_name``. T must divide evenly by the axis size. Returns [T, H, D]
+    with the same sharding as q."""
+    num_chunks = mesh.shape[axis_name]
+    if q.shape[0] % num_chunks:
+        raise ValueError(
+            f"seq len {q.shape[0]} not divisible by {axis_name}={num_chunks}"
+        )
+    spec = P(axis_name, None, None)
+    fn = jax.shard_map(
+        partial(
+            _ring_attention_local,
+            axis_name=axis_name,
+            num_chunks=num_chunks,
+            causal=causal,
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)

@@ -24,12 +24,19 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 @dataclass(frozen=True)
 class ParallelConfig:
+    """Mesh axis sizes. Axis order (outer→inner) is dp, pp, sp, ep, tp —
+    tp innermost so its all-reduces ride the fastest ICI dimension
+    (scaling-book layout recipe)."""
+
     tp_size: int = 1
     dp_size: int = 1
+    pp_size: int = 1  # pipeline stages
+    sp_size: int = 1  # sequence (ring-attention) axis
+    ep_size: int = 1  # expert axis for MoE
 
     @property
     def world(self) -> int:
-        return self.tp_size * self.dp_size
+        return self.tp_size * self.dp_size * self.pp_size * self.sp_size * self.ep_size
 
 
 def build_mesh(parallel: ParallelConfig, devices=None) -> Mesh:
@@ -37,8 +44,11 @@ def build_mesh(parallel: ParallelConfig, devices=None) -> Mesh:
     n = parallel.world
     if len(devices) < n:
         raise ValueError(f"need {n} devices, have {len(devices)}")
-    grid = np.asarray(devices[:n]).reshape(parallel.dp_size, parallel.tp_size)
-    return Mesh(grid, axis_names=("dp", "tp"))
+    p = parallel
+    grid = np.asarray(devices[:n]).reshape(
+        p.dp_size, p.pp_size, p.sp_size, p.ep_size, p.tp_size
+    )
+    return Mesh(grid, axis_names=("dp", "pp", "sp", "ep", "tp"))
 
 
 @dataclass(frozen=True)
@@ -85,7 +95,31 @@ class LlamaShardings:
         return NamedSharding(self.mesh, P())
 
 
-def shard_params(params: dict, shardings: LlamaShardings) -> dict:
+@dataclass(frozen=True)
+class MoeShardings(LlamaShardings):
+    """LlamaShardings with the MLP rows replaced by expert weights sharded
+    over the ``ep`` axis (wide-EP, SURVEY.md §2.5 row "Expert parallel");
+    models/moe.py constrains the dispatched [E, C, H] token tensor to
+    P("ep") so GSPMD inserts the all-to-all over ICI."""
+
+    def param_specs(self) -> dict:
+        specs = super().param_specs()
+        layers = dict(specs["layers"])
+        for k in ("w_gate", "w_up", "w_down"):
+            del layers[k]
+        layers.update(
+            {
+                "router": P(None, None, None),  # [L, H, E] replicated
+                "w_gate": P(None, "ep", None, "tp"),  # [L, E, H, I/tp]
+                "w_up": P(None, "ep", None, "tp"),
+                "w_down": P(None, "ep", "tp", None),
+            }
+        )
+        specs["layers"] = layers
+        return specs
+
+
+def shard_params(params: dict, shardings) -> dict:
     """Place a param pytree onto the mesh (works for freshly-initialized or
     loaded params)."""
     shard_tree = shardings.param_shardings()

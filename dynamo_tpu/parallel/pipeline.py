@@ -1,0 +1,108 @@
+"""Pipeline parallelism: GPipe-style microbatch pipelining over the ``pp``
+mesh axis.
+
+The reference passes PP flags through to its engines (SURVEY.md §2.5 row
+"Pipeline parallel (PP)" — delegated, engine_configs/); here it is native:
+layer stages are sharded over ``pp`` (leading stage axis on the stacked
+params), microbatches stream through under ``shard_map``, and activations
+hop stage→stage via ``ppermute`` each tick. The whole schedule compiles to
+one XLA while-loop; bubble overhead is (S-1)/(M+S-1) for S stages and M
+microbatches.
+
+``pipeline_apply`` is the generic scheduler: it takes a per-stage function
+``stage_fn(stage_params, x) -> x`` and works for any pytree-of-stacked
+params whose leaves carry a leading stage axis.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def stack_stages(params_layers: Any, num_stages: int) -> Any:
+    """Re-stack a layer-stacked param pytree [L, ...] into [S, L/S, ...] so
+    axis 0 can be sharded over ``pp``."""
+
+    def restack(x):
+        L = x.shape[0]
+        if L % num_stages:
+            raise ValueError(f"{L} layers not divisible by {num_stages} stages")
+        return x.reshape(num_stages, L // num_stages, *x.shape[1:])
+
+    return jax.tree.map(restack, params_layers)
+
+
+def _pipeline_local(
+    stage_params: Any,  # leaves [1, L/S, ...] — this device's stage
+    x_mb: jax.Array,  # [M, mb, ...] all microbatches (replicated)
+    *,
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    num_stages: int,
+    axis_name: str,
+) -> jax.Array:
+    rank = jax.lax.axis_index(axis_name)
+    local = jax.tree.map(lambda p: p[0], stage_params)
+    M = x_mb.shape[0]
+    mb_shape = x_mb.shape[1:]
+    ticks = M + num_stages - 1
+    fwd = [(i, i + 1) for i in range(num_stages - 1)]
+
+    def tick(carry, t):
+        recv, out_buf = carry
+        # stage 0 feeds itself from the microbatch queue; others from the wire
+        feed_idx = jnp.clip(t, 0, M - 1)
+        feed = jax.lax.dynamic_index_in_dim(x_mb, feed_idx, 0, keepdims=False)
+        cur = jnp.where(rank == 0, feed, recv)
+        out = stage_fn(local, cur)
+        # last stage owns microbatch t-(S-1) at tick t
+        done_idx = jnp.clip(t - (num_stages - 1), 0, M - 1)
+        take = (rank == num_stages - 1) & (t >= num_stages - 1)
+        slot = jax.lax.dynamic_index_in_dim(out_buf, done_idx, 0, keepdims=False)
+        out_buf = jax.lax.dynamic_update_index_in_dim(
+            out_buf, jnp.where(take, out, slot), done_idx, 0
+        )
+        recv = jax.lax.ppermute(out, axis_name, fwd) if fwd else out
+        return (recv, out_buf), None
+
+    recv0 = jnp.zeros(mb_shape, x_mb.dtype)
+    out_buf0 = jnp.zeros((M, *mb_shape), x_mb.dtype)
+    (recv, out_buf), _ = jax.lax.scan(
+        tick, (recv0, out_buf0), jnp.arange(ticks)
+    )
+    # only the last stage's buffer is real; broadcast it around the ring so
+    # the result is replicated over pp (one psum, off the per-tick path)
+    mask = (rank == num_stages - 1).astype(out_buf.dtype)
+    return jax.lax.psum(out_buf * mask, axis_name)
+
+
+def pipeline_apply(
+    stage_params: Any,  # pytree, leaves [S, L/S, ...] (see stack_stages)
+    x_mb: jax.Array,  # [M, mb, ...] microbatched input
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    mesh: Mesh,
+    axis_name: str = "pp",
+) -> jax.Array:
+    """Run M microbatches through S pipeline stages; returns [M, mb, ...]
+    outputs (replicated over pp)."""
+    num_stages = mesh.shape[axis_name]
+    param_specs = jax.tree.map(
+        lambda x: P(axis_name, *([None] * (x.ndim - 1))), stage_params
+    )
+    fn = jax.shard_map(
+        partial(
+            _pipeline_local,
+            stage_fn=stage_fn,
+            num_stages=num_stages,
+            axis_name=axis_name,
+        ),
+        mesh=mesh,
+        in_specs=(param_specs, P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return fn(stage_params, x_mb)
