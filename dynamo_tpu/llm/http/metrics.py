@@ -54,6 +54,19 @@ class HttpMetrics:
             ["model"],
             registry=self.registry,
         )
+        self.input_tokens = Counter(
+            f"{ns}_input_tokens_total",
+            "Total prompt tokens",
+            ["model"],
+            registry=self.registry,
+        )
+        self.itl = Histogram(
+            f"{ns}_inter_token_latency_seconds",
+            "Mean inter-token latency per request",
+            ["model"],
+            registry=self.registry,
+            buckets=(0.002, 0.005, 0.01, 0.02, 0.04, 0.08, 0.16, 0.32, 0.64, 1.28),
+        )
         self.disconnects = Counter(
             f"{ns}_client_disconnects_total",
             "Client disconnects mid-stream",
@@ -71,12 +84,24 @@ class HttpMetrics:
         t0: float,
         error: bool = False,
         output_tokens: int = 0,
+        input_tokens: int = 0,
+        first_token_at: float | None = None,
+        last_token_at: float | None = None,
     ):
         self.inflight.labels(model, endpoint).dec()
         self.requests_total.labels(model, endpoint, "error" if error else "success").inc()
-        self.request_duration.labels(model, endpoint).observe(time.monotonic() - t0)
+        now = time.monotonic()
+        self.request_duration.labels(model, endpoint).observe(now - t0)
         if output_tokens:
             self.output_tokens.labels(model).inc(output_tokens)
+        if input_tokens:
+            self.input_tokens.labels(model).inc(input_tokens)
+        # ITL over first→last token, not request end (post-stream work such
+        # as [DONE]/usage frames must not inflate the planner's signal)
+        if first_token_at is not None and last_token_at is not None and output_tokens > 1:
+            self.itl.labels(model).observe(
+                max(last_token_at - first_token_at, 0.0) / (output_tokens - 1)
+            )
 
     def observe_ttft(self, model: str, seconds: float):
         self.ttft.labels(model).observe(seconds)

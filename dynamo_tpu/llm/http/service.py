@@ -155,6 +155,7 @@ class HttpService:
         )
         await resp.prepare(http_req)
         first_token_at: Optional[float] = None
+        last_token_at: Optional[float] = None
         error = False
         try:
             finish_sent = False
@@ -173,9 +174,11 @@ class HttpService:
                     )
                     continue
                 out: LLMEngineOutput = ann.data
-                if first_token_at is None and out.token_ids:
-                    first_token_at = time.monotonic()
-                    self.metrics.observe_ttft(req.model, first_token_at - t0)
+                if out.token_ids:
+                    last_token_at = time.monotonic()
+                    if first_token_at is None:
+                        first_token_at = last_token_at
+                        self.metrics.observe_ttft(req.model, first_token_at - t0)
                 if out.text:
                     await resp.write(
                         _sse(gen.text_chunk(out.text, len(out.token_ids)).model_dump_json(exclude_none=True))
@@ -200,7 +203,9 @@ class HttpService:
             raise
         finally:
             self.metrics.request_end(
-                req.model, "chat", t0, error=error, output_tokens=gen.completion_tokens
+                req.model, "chat", t0, error=error, output_tokens=gen.completion_tokens,
+                input_tokens=gen.prompt_tokens, first_token_at=first_token_at,
+                last_token_at=last_token_at,
             )
         return resp
 
@@ -212,6 +217,7 @@ class HttpService:
         n_out = 0
         error_msg = None
         first_token_at = None
+        last_token_at = None
         async for ann in stream:
             if ann.is_error():
                 error_msg = (ann.comment or ["engine error"])[0]
@@ -219,16 +225,22 @@ class HttpService:
             if ann.event is not None:
                 continue
             out: LLMEngineOutput = ann.data
-            if first_token_at is None and out.token_ids:
-                first_token_at = time.monotonic()
-                self.metrics.observe_ttft(req.model, first_token_at - t0)
+            if out.token_ids:
+                last_token_at = time.monotonic()
+                if first_token_at is None:
+                    first_token_at = last_token_at
+                    self.metrics.observe_ttft(req.model, first_token_at - t0)
             n_out += len(out.token_ids)
             if out.text:
                 texts.append(out.text)
             if out.finish_reason:
                 finish = "stop" if out.finish_reason == "eos" else out.finish_reason
                 break
-        self.metrics.request_end(req.model, "chat", t0, error=bool(error_msg), output_tokens=n_out)
+        self.metrics.request_end(
+            req.model, "chat", t0, error=bool(error_msg), output_tokens=n_out,
+            input_tokens=gen.prompt_tokens, first_token_at=first_token_at,
+            last_token_at=last_token_at,
+        )
         if error_msg:
             return self._error(500, error_msg, "engine_error")
         response = ChatCompletionResponse(
@@ -284,7 +296,8 @@ class HttpService:
         )
         await resp.prepare(http_req)
         error = False
-        first = True
+        first_token_at = None
+        last_token_at = None
         try:
             finish_sent = False
             async for ann in stream:
@@ -299,9 +312,11 @@ class HttpService:
                     )
                     continue
                 out: LLMEngineOutput = ann.data
-                if first and out.token_ids:
-                    first = False
-                    self.metrics.observe_ttft(req.model, time.monotonic() - t0)
+                if out.token_ids:
+                    last_token_at = time.monotonic()
+                    if first_token_at is None:
+                        first_token_at = last_token_at
+                        self.metrics.observe_ttft(req.model, first_token_at - t0)
                 if out.text:
                     await resp.write(
                         _sse(gen.text_chunk(out.text, len(out.token_ids)).model_dump_json(exclude_none=True))
@@ -321,7 +336,10 @@ class HttpService:
             raise
         finally:
             self.metrics.request_end(
-                req.model, "completions", t0, error=error, output_tokens=gen.completion_tokens
+                req.model, "completions", t0, error=error,
+                output_tokens=gen.completion_tokens,
+                input_tokens=gen.prompt_tokens, first_token_at=first_token_at,
+                last_token_at=last_token_at,
             )
         return resp
 
@@ -330,6 +348,8 @@ class HttpService:
         finish = "stop"
         n_out = 0
         error_msg = None
+        first_token_at = None
+        last_token_at = None
         async for ann in stream:
             if ann.is_error():
                 error_msg = (ann.comment or ["engine error"])[0]
@@ -337,13 +357,22 @@ class HttpService:
             if ann.event is not None:
                 continue
             out: LLMEngineOutput = ann.data
+            if out.token_ids:
+                last_token_at = time.monotonic()
+                if first_token_at is None:
+                    first_token_at = last_token_at
+                    self.metrics.observe_ttft(req.model, first_token_at - t0)
             n_out += len(out.token_ids)
             if out.text:
                 texts.append(out.text)
             if out.finish_reason:
                 finish = "stop" if out.finish_reason == "eos" else out.finish_reason
                 break
-        self.metrics.request_end(req.model, "completions", t0, error=bool(error_msg), output_tokens=n_out)
+        self.metrics.request_end(
+            req.model, "completions", t0, error=bool(error_msg), output_tokens=n_out,
+            input_tokens=gen.prompt_tokens, first_token_at=first_token_at,
+            last_token_at=last_token_at,
+        )
         if error_msg:
             return self._error(500, error_msg, "engine_error")
         response = CompletionResponse(

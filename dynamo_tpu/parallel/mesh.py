@@ -105,8 +105,6 @@ class MoeShardings(LlamaShardings):
     def param_specs(self) -> dict:
         specs = super().param_specs()
         layers = dict(specs["layers"])
-        for k in ("w_gate", "w_up", "w_down"):
-            del layers[k]
         layers.update(
             {
                 "router": P(None, None, None),  # [L, H, E] replicated

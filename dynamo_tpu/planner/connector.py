@@ -1,0 +1,147 @@
+"""Planner connectors: turn a replica decision into actual scaling.
+
+Reference equivalents: `KubernetesConnector` patches a
+DynamoGraphDeployment CRD (components/planner/src/dynamo/planner/
+kubernetes_connector.py) and `VirtualConnector` publishes the decision to
+etcd for an external orchestrator (virtual_connector.py). Here:
+
+* ``VirtualConnector`` writes the decision to the discovery service KV
+  (``v1/planner/decision``) with a monotonically increasing revision —
+  any orchestrator (k8s operator, slice manager) watches and acts.
+* ``LocalProcessConnector`` scales real worker subprocesses on this host
+  (the test/e2e orchestrator, reference's ManagedProcess-style role).
+* ``NoopConnector`` records decisions (dryrun / unit tests).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import signal
+import sys
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+logger = logging.getLogger(__name__)
+
+PLANNER_DECISION_KEY = "v1/planner/decision"
+
+
+class NoopConnector:
+    def __init__(self):
+        self.decisions: List[Tuple[int, int]] = []
+
+    async def set_replicas(self, prefill: int, decode: int) -> None:
+        self.decisions.append((prefill, decode))
+
+
+class VirtualConnector:
+    """Publish {num_prefill, num_decode, revision} to discovery KV."""
+
+    def __init__(self, discovery_client):
+        self.client = discovery_client
+        self.revision = 0
+
+    async def set_replicas(self, prefill: int, decode: int) -> None:
+        self.revision += 1
+        doc = {
+            "num_prefill_workers": prefill,
+            "num_decode_workers": decode,
+            "revision": self.revision,
+            "ts": time.time(),
+        }
+        await self.client.put(PLANNER_DECISION_KEY, json.dumps(doc).encode())
+        logger.info("published planner decision rev=%d p=%d d=%d",
+                    self.revision, prefill, decode)
+
+
+class LocalProcessConnector:
+    """Scale worker replicas as local subprocesses.
+
+    `prefill_cmd` / `decode_cmd` are argv templates; each spawned replica
+    gets the env of the parent plus DYN_WORKER_INDEX. Scaling down kills
+    the newest replicas first (SIGTERM, then SIGKILL after grace).
+    """
+
+    def __init__(
+        self,
+        prefill_cmd: Sequence[str],
+        decode_cmd: Sequence[str],
+        env: Optional[Dict[str, str]] = None,
+        grace_s: float = 5.0,
+    ):
+        self.prefill_cmd = list(prefill_cmd)
+        self.decode_cmd = list(decode_cmd)
+        self.env = env
+        self.grace_s = grace_s
+        self.procs: Dict[str, List[asyncio.subprocess.Process]] = {
+            "prefill": [],
+            "decode": [],
+        }
+
+    def counts(self) -> Tuple[int, int]:
+        self._reap()
+        return len(self.procs["prefill"]), len(self.procs["decode"])
+
+    def _reap(self) -> None:
+        for role in self.procs:
+            self.procs[role] = [p for p in self.procs[role] if p.returncode is None]
+
+    async def _spawn(self, role: str) -> None:
+        cmd = self.prefill_cmd if role == "prefill" else self.decode_cmd
+        env = dict(os.environ if self.env is None else self.env)
+        env["DYN_WORKER_INDEX"] = str(len(self.procs[role]))
+        proc = await asyncio.create_subprocess_exec(*cmd, env=env)
+        self.procs[role].append(proc)
+        logger.info("spawned %s worker pid=%d", role, proc.pid)
+
+    async def _kill(self, role: str) -> None:
+        proc = self.procs[role].pop()
+        if proc.returncode is not None:
+            return
+        proc.send_signal(signal.SIGTERM)
+        try:
+            await asyncio.wait_for(proc.wait(), timeout=self.grace_s)
+        except asyncio.TimeoutError:
+            proc.kill()
+            await proc.wait()
+        logger.info("stopped %s worker pid=%d", role, proc.pid)
+
+    async def set_replicas(self, prefill: int, decode: int) -> None:
+        self._reap()
+        for role, want in (("prefill", prefill), ("decode", decode)):
+            while len(self.procs[role]) < want:
+                await self._spawn(role)
+            while len(self.procs[role]) > want:
+                await self._kill(role)
+
+    async def shutdown(self) -> None:
+        await self.set_replicas(0, 0)
+
+
+class DiscoveryWorkerCounts:
+    """Count live worker instances from discovery (reference
+    get_workers_info, planner_core.py:180-219)."""
+
+    def __init__(self, discovery_client, namespace: str = "dynamo",
+                 prefill_component: str = "prefill", decode_component: str = "backend"):
+        self.client = discovery_client
+        self.namespace = namespace
+        self.prefill_component = prefill_component
+        self.decode_component = decode_component
+
+    async def count(self) -> Tuple[int, int]:
+        from ..runtime.component import INSTANCE_ROOT
+
+        items = await self.client.get_prefix(INSTANCE_ROOT + self.namespace + "/")
+        n_p = n_d = 0
+        for it in items:
+            key = it["key"] if isinstance(it, dict) else it[0]
+            comp = key[len(INSTANCE_ROOT):].split("/")[1]
+            if comp == self.prefill_component:
+                n_p += 1
+            elif comp == self.decode_component:
+                n_d += 1
+        return n_p, n_d

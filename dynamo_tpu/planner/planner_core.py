@@ -1,0 +1,227 @@
+"""SLA planner core: observe → predict → size → adjust.
+
+Role of the reference's SLA planner
+(components/planner/src/dynamo/planner/utils/planner_core.py:221-583):
+every `adjustment_interval` seconds it observes frontend metrics (request
+rate, ISL/OSL, TTFT/ITL), corrects its performance model against reality
+(p/d correction factors), predicts next-interval load, computes how many
+prefill and decode replicas meet the TTFT/ITL SLAs from profiled
+interpolators, and asks a connector to scale. One deviation: the reference
+queries a Prometheus server; here the planner scrapes the frontend's
+/metrics endpoint directly and differences counters/histograms between
+intervals (same averages, one less moving part).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import math
+from dataclasses import dataclass, field
+from typing import Optional, Protocol
+
+from .load_predictor import BasePredictor, make_predictor
+from .perf_interpolation import DecodeInterpolator, PrefillInterpolator
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class SlaArgs:
+    ttft: float = 0.5  # target time-to-first-token, seconds
+    itl: float = 0.05  # target inter-token latency, seconds
+    adjustment_interval: float = 60.0  # seconds between scaling decisions
+    prefill_engine_num_chips: int = 1
+    decode_engine_num_chips: int = 1
+    max_chip_budget: int = 64
+    min_endpoint: int = 1
+    load_predictor: str = "constant"
+    no_correction: bool = False
+
+
+@dataclass
+class Metrics:
+    """Averages observed over the last adjustment interval."""
+
+    num_req: float = math.nan  # requests served in the interval
+    isl: float = math.nan
+    osl: float = math.nan
+    ttft: float = math.nan  # seconds
+    itl: float = math.nan  # seconds
+    request_duration: float = math.nan  # seconds
+
+    def is_valid(self) -> bool:
+        return all(
+            not math.isnan(v)
+            for v in (self.num_req, self.isl, self.osl, self.ttft, self.itl)
+        ) and self.num_req > 0
+
+
+class MetricsSource(Protocol):
+    async def read(self) -> Metrics: ...
+
+
+class WorkerCounts(Protocol):
+    async def count(self) -> tuple[int, int]:
+        """(prefill_workers, decode_workers) currently live."""
+        ...
+
+
+class PlannerConnector(Protocol):
+    async def set_replicas(self, prefill: int, decode: int) -> None: ...
+
+
+class Planner:
+    def __init__(
+        self,
+        args: SlaArgs,
+        prefill_interpolator: PrefillInterpolator,
+        decode_interpolator: DecodeInterpolator,
+        metrics_source: MetricsSource,
+        workers: WorkerCounts,
+        connector: PlannerConnector,
+    ):
+        self.args = args
+        self.prefill_interpolator = prefill_interpolator
+        self.decode_interpolator = decode_interpolator
+        self.metrics_source = metrics_source
+        self.workers = workers
+        self.connector = connector
+
+        self.num_req_predictor = make_predictor(args.load_predictor)
+        self.isl_predictor = make_predictor(args.load_predictor)
+        self.osl_predictor = make_predictor(args.load_predictor)
+        self.p_correction_factor = 1.0
+        self.d_correction_factor = 1.0
+        self.last_metrics = Metrics()
+        self._stop = asyncio.Event()
+
+    # -- observe -----------------------------------------------------------
+    async def observe_metrics(self) -> None:
+        self.last_metrics = await self.metrics_source.read()
+        m = self.last_metrics
+        logger.info(
+            "observed num_req=%.1f isl=%.1f osl=%.1f ttft=%.3fs itl=%.4fs",
+            m.num_req, m.isl, m.osl, m.ttft, m.itl,
+        )
+        self.num_req_predictor.add_data_point(m.num_req)
+        self.isl_predictor.add_data_point(m.isl)
+        self.osl_predictor.add_data_point(m.osl)
+
+    # -- correct (planner_core.py:383-441) ---------------------------------
+    async def update_correction_factors(self) -> None:
+        m = self.last_metrics
+        if self.args.no_correction or not m.is_valid():
+            return
+        _, n_decode = await self.workers.count()
+        expect_ttft = self.prefill_interpolator.interpolate_ttft(m.isl)
+        if expect_ttft > 0:
+            self.p_correction_factor = m.ttft / expect_ttft
+        concurrency = (
+            m.num_req / max(n_decode, 1)
+            * m.request_duration / self.args.adjustment_interval
+            if not math.isnan(m.request_duration)
+            else 1.0
+        )
+        expect_itl = self.decode_interpolator.interpolate_itl(
+            concurrency=concurrency, context_length=m.isl + m.osl / 2
+        )
+        if expect_itl > 0:
+            self.d_correction_factor = m.itl / expect_itl
+        logger.info(
+            "correction factors: ttft=%.3f itl=%.3f",
+            self.p_correction_factor, self.d_correction_factor,
+        )
+
+    # -- predict ------------------------------------------------------------
+    def predict_load(self) -> tuple[Optional[float], Optional[float], Optional[float]]:
+        return (
+            self.num_req_predictor.predict_next(),
+            self.isl_predictor.predict_next(),
+            self.osl_predictor.predict_next(),
+        )
+
+    # -- size (planner_core.py:287-380) --------------------------------------
+    def compute_replica_requirements(
+        self, next_num_req: float, next_isl: float, next_osl: float
+    ) -> tuple[int, int]:
+        a = self.args
+        # prefill: token throughput needed, derated by observed TTFT headroom
+        # (queueing shows up as p_correction_factor > 1)
+        pred_prefill_thpt = (
+            next_num_req * next_isl / a.adjustment_interval
+            * min(1.0, self.p_correction_factor)
+        )
+        per_p_replica = (
+            self.prefill_interpolator.interpolate_thpt_per_chip(next_isl)
+            * a.prefill_engine_num_chips
+        )
+        next_p = math.ceil(pred_prefill_thpt / max(per_p_replica, 1e-9))
+
+        # decode: tighten the ITL target by the observed miss ratio, then find
+        # the best per-chip throughput that still meets it at predicted context
+        corrected_itl = (
+            a.itl / self.d_correction_factor
+            if self.d_correction_factor > 0
+            else a.itl
+        )
+        thpt_per_chip, _, _ = self.decode_interpolator.find_best_throughput_per_chip(
+            itl=corrected_itl, context_length=next_isl + next_osl / 2
+        )
+        pred_decode_thpt = next_num_req * next_osl / a.adjustment_interval
+        next_d = math.ceil(
+            pred_decode_thpt / max(thpt_per_chip * a.decode_engine_num_chips, 1e-9)
+        )
+
+        next_p = max(next_p, a.min_endpoint)
+        next_d = max(next_d, a.min_endpoint)
+
+        # chip budget: scale down proportionally (planner_core.py:358-380)
+        total = next_p * a.prefill_engine_num_chips + next_d * a.decode_engine_num_chips
+        if total > a.max_chip_budget:
+            scale = a.max_chip_budget / total
+            next_p = max(a.min_endpoint, round(next_p * scale))
+            next_d = max(
+                a.min_endpoint,
+                math.floor(
+                    (a.max_chip_budget - next_p * a.prefill_engine_num_chips)
+                    / a.decode_engine_num_chips
+                ),
+            )
+            logger.warning(
+                "chip budget %d exceeded (%d); scaled to p=%d d=%d",
+                a.max_chip_budget, total, next_p, next_d,
+            )
+        return next_p, next_d
+
+    # -- adjust ---------------------------------------------------------------
+    async def make_adjustments(self) -> Optional[tuple[int, int]]:
+        if not self.last_metrics.is_valid():
+            logger.info("no traffic in interval; skipping adjustment")
+            return None
+        await self.update_correction_factors()
+        num_req, isl, osl = self.predict_load()
+        if num_req is None or isl is None or osl is None:
+            return None
+        p, d = self.compute_replica_requirements(num_req, isl, osl)
+        await self.connector.set_replicas(p, d)
+        return p, d
+
+    async def run(self) -> None:
+        """Planner loop: sleep interval, observe, adjust — until stop()."""
+        while not self._stop.is_set():
+            try:
+                await asyncio.wait_for(
+                    self._stop.wait(), timeout=self.args.adjustment_interval
+                )
+                break
+            except asyncio.TimeoutError:
+                pass
+            try:
+                await self.observe_metrics()
+                await self.make_adjustments()
+            except Exception:  # noqa: BLE001 — planner must survive blips
+                logger.exception("planner iteration failed")
+
+    def stop(self) -> None:
+        self._stop.set()

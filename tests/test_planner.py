@@ -1,0 +1,250 @@
+"""SLA planner: predictors, interpolators, replica math, loop, profiler
+round-trip (reference tests/planner/test_replica_calculation.py model)."""
+
+import asyncio
+import math
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.planner import (
+    ARPredictor,
+    ConstantPredictor,
+    DecodeInterpolator,
+    Metrics,
+    MovingAveragePredictor,
+    NoopConnector,
+    Planner,
+    PrefillInterpolator,
+    SlaArgs,
+)
+from dynamo_tpu.planner.metrics_source import parse_prometheus_text
+
+
+def synthetic_prefill_raw(max_isl=8192):
+    isl = np.array([128, 512, 1024, 2048, 4096, max_isl], np.float64)
+    # TTFT grows superlinearly, throughput decays gently
+    ttft_ms = 5 + isl * 0.02 + (isl / 1000) ** 2
+    thpt = 12000 - isl * 0.5
+    return {
+        "prefill_isl": isl,
+        "prefill_ttft": ttft_ms,
+        "prefill_thpt_per_gpu": thpt,
+    }
+
+
+def synthetic_decode_raw(max_kv_tokens=100_000):
+    xs, ys, itl, thpt = [], [], [], []
+    for ctx in (512, 1024, 2048, 4096):
+        for usage in (0.1, 0.3, 0.5, 0.7, 0.9):
+            xs.append(usage)
+            ys.append(float(ctx))
+            itl.append(4 + 20 * usage + ctx / 2048)  # ms, worsens with load
+            thpt.append(2000 * usage / (4 + 20 * usage + ctx / 2048) * 1000 / 1000)
+    return {
+        "x_kv_usage": np.array(xs),
+        "y_context_length": np.array(ys),
+        "z_itl": np.array(itl),
+        "z_thpt_per_gpu": np.array(thpt),
+        "max_kv_tokens": np.array([max_kv_tokens]),
+    }
+
+
+class TestPredictors:
+    def test_constant(self):
+        p = ConstantPredictor()
+        for v in (1, 5, 3):
+            p.add_data_point(v)
+        assert p.predict_next() == 3
+
+    def test_moving_average(self):
+        p = MovingAveragePredictor(window_size=3)
+        for v in (1, 2, 3, 4, 5):
+            p.add_data_point(v)
+        assert p.predict_next() == pytest.approx(4.0)
+
+    def test_ar_tracks_linear_trend(self):
+        p = ARPredictor(order=2, window_size=50)
+        for t in range(30):
+            p.add_data_point(10 + 2 * t)
+        pred = p.predict_next()
+        # linear series: AR(2) extrapolates the next step (within clamp)
+        assert pred == pytest.approx(10 + 2 * 30, rel=0.1)
+
+    def test_ar_few_points_falls_back(self):
+        p = ARPredictor(order=3)
+        p.add_data_point(7.0)
+        assert p.predict_next() == 7.0
+
+    def test_nan_points_ignored(self):
+        p = ConstantPredictor()
+        p.add_data_point(5.0)
+        p.add_data_point(float("nan"))
+        assert p.predict_next() == 5.0
+
+
+class TestInterpolators:
+    def test_prefill_interpolation_and_clamp(self):
+        it = PrefillInterpolator(raw_data=synthetic_prefill_raw())
+        # at grid points, matches the data (ms -> s)
+        assert it.interpolate_ttft(1024) == pytest.approx(
+            (5 + 1024 * 0.02 + (1024 / 1000) ** 2) / 1000, rel=1e-6
+        )
+        # out-of-range clamps rather than extrapolating
+        assert it.interpolate_ttft(10_000_000) == it.interpolate_ttft(8192)
+        assert it.interpolate_thpt_per_chip(1) == it.interpolate_thpt_per_chip(128)
+
+    def test_decode_interpolation(self):
+        it = DecodeInterpolator(raw_data=synthetic_decode_raw())
+        # ITL grows with load at fixed context
+        ctx = 2048
+        conc_low = 0.1 * it.max_kv_tokens / ctx
+        conc_high = 0.9 * it.max_kv_tokens / ctx
+        assert it.interpolate_itl(conc_low, ctx) < it.interpolate_itl(conc_high, ctx)
+
+    def test_find_best_throughput_meets_itl(self):
+        it = DecodeInterpolator(raw_data=synthetic_decode_raw())
+        thpt, itl, kv = it.find_best_throughput_per_chip(itl=0.015, context_length=2048)
+        assert itl <= 0.015
+        assert 0 <= kv <= 1
+        # a looser SLA admits at least as much load
+        _, _, kv_loose = it.find_best_throughput_per_chip(
+            itl=0.025, context_length=2048
+        )
+        assert kv_loose >= kv
+
+
+def make_planner(args=None, metrics=None, workers=(1, 1)):
+    class FakeMetrics:
+        def __init__(self, m):
+            self.m = m
+
+        async def read(self):
+            return self.m
+
+    class FakeWorkers:
+        async def count(self):
+            return workers
+
+    connector = NoopConnector()
+    planner = Planner(
+        args or SlaArgs(adjustment_interval=60, itl=0.02, ttft=0.2, max_chip_budget=64),
+        PrefillInterpolator(raw_data=synthetic_prefill_raw()),
+        DecodeInterpolator(raw_data=synthetic_decode_raw()),
+        FakeMetrics(metrics or Metrics()),
+        FakeWorkers(),
+        connector,
+    )
+    return planner, connector
+
+
+class TestReplicaCalculation:
+    def test_low_load_min_endpoints(self):
+        planner, _ = make_planner()
+        p, d = planner.compute_replica_requirements(
+            next_num_req=1, next_isl=128, next_osl=16
+        )
+        assert p == 1 and d == 1
+
+    def test_high_load_scales_up(self):
+        planner, _ = make_planner()
+        p_lo, d_lo = planner.compute_replica_requirements(10, 2048, 256)
+        p_hi, d_hi = planner.compute_replica_requirements(1000, 2048, 256)
+        assert p_hi > p_lo
+        assert d_hi > d_lo
+
+    def test_chip_budget_respected(self):
+        args = SlaArgs(adjustment_interval=60, itl=0.02, max_chip_budget=8)
+        planner, _ = make_planner(args)
+        p, d = planner.compute_replica_requirements(100000, 4096, 512)
+        assert p * args.prefill_engine_num_chips + d * args.decode_engine_num_chips <= 8
+
+    def test_chip_budget_respected_multichip_decode(self):
+        args = SlaArgs(
+            adjustment_interval=60, itl=0.02, max_chip_budget=9,
+            decode_engine_num_chips=2,
+        )
+        planner, _ = make_planner(args)
+        p, d = planner.compute_replica_requirements(100000, 4096, 512)
+        assert p + 2 * d <= 9
+
+    def test_prefill_scales_with_isl(self):
+        planner, _ = make_planner()
+        p_short, _ = planner.compute_replica_requirements(200, 256, 128)
+        p_long, _ = planner.compute_replica_requirements(200, 8192, 128)
+        assert p_long >= p_short
+
+    def test_itl_correction_tightens_decode(self):
+        planner, _ = make_planner()
+        _, d_before = planner.compute_replica_requirements(500, 2048, 256)
+        planner.d_correction_factor = 2.0  # observed ITL 2x worse than model
+        _, d_after = planner.compute_replica_requirements(500, 2048, 256)
+        assert d_after >= d_before
+
+
+class TestPlannerLoop:
+    def test_adjustment_flow(self):
+        m = Metrics(
+            num_req=300, isl=1024, osl=128, ttft=0.08, itl=0.012,
+            request_duration=2.0,
+        )
+        planner, connector = make_planner(metrics=m, workers=(2, 2))
+
+        async def run():
+            await planner.observe_metrics()
+            return await planner.make_adjustments()
+
+        res = asyncio.run(run())
+        assert res is not None
+        assert connector.decisions == [res]
+        assert planner.p_correction_factor > 0
+        assert planner.d_correction_factor > 0
+
+    def test_no_traffic_skips(self):
+        planner, connector = make_planner(metrics=Metrics())
+
+        async def run():
+            await planner.observe_metrics()
+            return await planner.make_adjustments()
+
+        assert asyncio.run(run()) is None
+        assert connector.decisions == []
+
+
+class TestMetricsParsing:
+    def test_parse_and_delta(self):
+        text = """
+# HELP dynamo_frontend_requests_total Total
+# TYPE dynamo_frontend_requests_total counter
+dynamo_frontend_requests_total{endpoint="chat",model="m",status="success"} 5.0
+dynamo_frontend_requests_total{endpoint="completions",model="m",status="success"} 2.0
+dynamo_frontend_output_tokens_total{model="m"} 700.0
+"""
+        d = parse_prometheus_text(text)
+        assert d["dynamo_frontend_requests_total"] == 7.0
+        assert d["dynamo_frontend_output_tokens_total"] == 700.0
+
+
+class TestProfilerRoundTrip:
+    def test_profile_tiny_and_interpolate(self, tmp_path):
+        """Sweep the tiny model on CPU, write npz, load via interpolators."""
+        from dynamo_tpu.models import llama
+        from dynamo_tpu.planner.profiler import (
+            profile_decode,
+            profile_prefill,
+            write_profiles,
+        )
+
+        cfg = llama.LlamaConfig.tiny()
+        prefill_raw = profile_prefill(cfg, [32, 64, 128], page=16)
+        decode_raw = profile_decode(
+            cfg, [64, 128], [0.2, 0.6], max_kv_tokens=2048, page=16, decode_steps=2
+        )
+        write_profiles(str(tmp_path), prefill_raw, decode_raw)
+
+        pi = PrefillInterpolator(profile_results_dir=str(tmp_path))
+        di = DecodeInterpolator(profile_results_dir=str(tmp_path))
+        assert pi.interpolate_ttft(64) > 0
+        assert pi.interpolate_thpt_per_chip(64) > 0
+        thpt, itl, kv = di.find_best_throughput_per_chip(itl=10.0, context_length=128)
+        assert thpt > 0 and itl > 0
