@@ -21,6 +21,7 @@ from aiohttp import web
 
 from ...runtime.engine import Context
 from ..discovery import ModelManager
+from ..parsers import JailedStream
 from ..preprocessor import ChatDeltaGenerator, CompletionDeltaGenerator
 from ..protocols import (
     Annotated,
@@ -135,6 +136,15 @@ class HttpService:
         )
         gen.prompt_tokens = len(pre.token_ids)
         stream = pipeline.generate_preprocessed(pre, ctx)
+        # structured-output jail: hold tool-call/reasoning tokens out of the
+        # content stream and release them parsed (parsers/jail.py)
+        rc = pipeline.card.runtime_config
+        tool_parser = rc.get("tool_call_parser") if req.tools else None
+        reasoning_parser = rc.get("reasoning_parser")
+        if tool_parser or reasoning_parser:
+            stream = JailedStream(
+                stream, tool_parser=tool_parser, reasoning_parser=reasoning_parser
+            ).__aiter__()
         try:
             if req.stream:
                 return await self._stream_chat(request, req, stream, gen, ctx, t0)
@@ -179,6 +189,15 @@ class HttpService:
                     if first_token_at is None:
                         first_token_at = last_token_at
                         self.metrics.observe_ttft(req.model, first_token_at - t0)
+                if out.reasoning_content:
+                    # token accounting happens below (text_chunk or the elif)
+                    await resp.write(
+                        _sse(gen.reasoning_chunk(out.reasoning_content).model_dump_json(exclude_none=True))
+                    )
+                if out.tool_calls:
+                    await resp.write(
+                        _sse(gen.tool_calls_chunk(out.tool_calls).model_dump_json(exclude_none=True))
+                    )
                 if out.text:
                     await resp.write(
                         _sse(gen.text_chunk(out.text, len(out.token_ids)).model_dump_json(exclude_none=True))
@@ -218,6 +237,8 @@ class HttpService:
         error_msg = None
         first_token_at = None
         last_token_at = None
+        reasoning_parts: list[str] = []
+        tool_calls: list = []
         async for ann in stream:
             if ann.is_error():
                 error_msg = (ann.comment or ["engine error"])[0]
@@ -231,6 +252,10 @@ class HttpService:
                     first_token_at = last_token_at
                     self.metrics.observe_ttft(req.model, first_token_at - t0)
             n_out += len(out.token_ids)
+            if out.reasoning_content:
+                reasoning_parts.append(out.reasoning_content)
+            if out.tool_calls:
+                tool_calls.extend(out.tool_calls)
             if out.text:
                 texts.append(out.text)
             if out.finish_reason:
@@ -243,13 +268,21 @@ class HttpService:
         )
         if error_msg:
             return self._error(500, error_msg, "engine_error")
+        message = ChatMessage(role="assistant", content="".join(texts))
+        if reasoning_parts:
+            message.reasoning_content = "".join(reasoning_parts)
+        if tool_calls:
+            from ..protocols.openai import ToolCall
+
+            message.tool_calls = [ToolCall.model_validate(tc) for tc in tool_calls]
+            message.content = message.content or None
         response = ChatCompletionResponse(
             id=gen.id,
             model=req.model,
             choices=[
                 Choice(
                     index=0,
-                    message=ChatMessage(role="assistant", content="".join(texts)),
+                    message=message,
                     finish_reason=finish,
                 )
             ],

@@ -29,7 +29,7 @@ from .protocols import (
     PreprocessedRequest,
     Usage,
 )
-from .protocols.openai import StreamChoice
+from .protocols.openai import StreamChoice, ToolCall
 from .tokenizers import Tokenizer
 
 logger = logging.getLogger(__name__)
@@ -191,6 +191,37 @@ class ChatDeltaGenerator:
     def text_chunk(self, text: str, n_tokens: int = 1) -> ChatCompletionChunk:
         self.completion_tokens += n_tokens
         delta = ChoiceDelta(content=text)
+        if self._first:
+            delta.role = "assistant"
+            self._first = False
+        return ChatCompletionChunk(
+            id=self.id,
+            model=self.model,
+            created=self.created,
+            choices=[StreamChoice(index=0, delta=delta)],
+        )
+
+    def reasoning_chunk(self, text: str, n_tokens: int = 0) -> ChatCompletionChunk:
+        self.completion_tokens += n_tokens
+        delta = ChoiceDelta(reasoning_content=text)
+        if self._first:
+            delta.role = "assistant"
+            self._first = False
+        return ChatCompletionChunk(
+            id=self.id,
+            model=self.model,
+            created=self.created,
+            choices=[StreamChoice(index=0, delta=delta)],
+        )
+
+    def tool_calls_chunk(self, tool_calls: list) -> ChatCompletionChunk:
+        # streaming deltas require `index` for client-side aggregation
+        calls = []
+        for i, tc in enumerate(tool_calls):
+            call = ToolCall.model_validate(tc)
+            call.index = i
+            calls.append(call)
+        delta = ChoiceDelta(tool_calls=calls)
         if self._first:
             delta.role = "assistant"
             self._first = False

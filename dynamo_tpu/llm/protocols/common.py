@@ -121,6 +121,9 @@ class LLMEngineOutput:
     kv_transfer_params: Optional[Dict[str, Any]] = None
     completion_usage: Optional[Dict[str, int]] = None
     disagg_info: Optional[Dict[str, Any]] = None
+    # set by the parsers/jail layer, not by engines
+    tool_calls: Optional[List[Dict[str, Any]]] = None
+    reasoning_content: Optional[str] = None
 
     def to_dict(self) -> dict:
         d: Dict[str, Any] = {"token_ids": self.token_ids}
@@ -133,6 +136,8 @@ class LLMEngineOutput:
             "kv_transfer_params",
             "completion_usage",
             "disagg_info",
+            "tool_calls",
+            "reasoning_content",
         ):
             v = getattr(self, k)
             if v is not None:

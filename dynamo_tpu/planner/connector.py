@@ -38,13 +38,26 @@ class NoopConnector:
 
 
 class VirtualConnector:
-    """Publish {num_prefill, num_decode, revision} to discovery KV."""
+    """Publish {num_prefill, num_decode, revision} to discovery KV.
+    Revisions continue from whatever is already stored, so they stay
+    monotonic across planner restarts."""
 
     def __init__(self, discovery_client):
         self.client = discovery_client
-        self.revision = 0
+        self.revision: Optional[int] = None
+
+    async def _load_revision(self) -> int:
+        raw = await self.client.get(PLANNER_DECISION_KEY)
+        if raw:
+            try:
+                return int(json.loads(raw).get("revision", 0))
+            except (ValueError, json.JSONDecodeError):
+                pass
+        return 0
 
     async def set_replicas(self, prefill: int, decode: int) -> None:
+        if self.revision is None:
+            self.revision = await self._load_revision()
         self.revision += 1
         doc = {
             "num_prefill_workers": prefill,

@@ -176,7 +176,9 @@ class Planner:
         next_p = max(next_p, a.min_endpoint)
         next_d = max(next_d, a.min_endpoint)
 
-        # chip budget: scale down proportionally (planner_core.py:358-380)
+        # chip budget: scale down proportionally (planner_core.py:358-380),
+        # then walk down to the hard budget (round()/min_endpoint can leave
+        # the proportional result one replica over)
         total = next_p * a.prefill_engine_num_chips + next_d * a.decode_engine_num_chips
         if total > a.max_chip_budget:
             scale = a.max_chip_budget / total
@@ -188,6 +190,20 @@ class Planner:
                     / a.decode_engine_num_chips
                 ),
             )
+
+            def chips() -> int:
+                return (next_p * a.prefill_engine_num_chips
+                        + next_d * a.decode_engine_num_chips)
+
+            while chips() > a.max_chip_budget and next_p > a.min_endpoint:
+                next_p -= 1
+            while chips() > a.max_chip_budget and next_d > a.min_endpoint:
+                next_d -= 1
+            if chips() > a.max_chip_budget:
+                logger.warning(
+                    "min_endpoint floors alone exceed the chip budget "
+                    "(%d chips > %d)", chips(), a.max_chip_budget,
+                )
             logger.warning(
                 "chip budget %d exceeded (%d); scaled to p=%d d=%d",
                 a.max_chip_budget, total, next_p, next_d,

@@ -1,0 +1,275 @@
+"""Tool-call parsers, reasoning parsers, and the JailedStream operator
+(reference lib/parsers tests + jail.rs behavior)."""
+
+import asyncio
+import json
+
+import pytest
+
+from dynamo_tpu.llm.parsers import (
+    BasicReasoningParser,
+    GptOssReasoningParser,
+    GraniteReasoningParser,
+    JailedStream,
+    detect_tool_call_start,
+    get_available_tool_parsers,
+    get_reasoning_parser,
+    try_tool_call_parse,
+)
+from dynamo_tpu.llm.protocols.common import Annotated, LLMEngineOutput
+
+
+class TestToolCallParsing:
+    def test_available_parsers(self):
+        names = get_available_tool_parsers()
+        for expected in (
+            "hermes", "llama3_json", "mistral", "nemotron_deci", "phi4",
+            "default", "pythonic", "harmony", "deepseek_v3_1",
+        ):
+            assert expected in names
+
+    def test_bare_json_object_default(self):
+        calls, content = try_tool_call_parse(
+            '{ "name": "hello", "parameters": { "x": 1, "y": 2 } }'
+        )
+        assert content == ""
+        assert len(calls) == 1
+        assert calls[0].name == "hello"
+        assert json.loads(calls[0].arguments) == {"x": 1, "y": 2}
+
+    def test_bare_json_arguments_key(self):
+        calls, _ = try_tool_call_parse(
+            '{ "name": "world", "arguments": { "a": "abc", "b": 42 } }'
+        )
+        assert calls[0].name == "world"
+        assert json.loads(calls[0].arguments)["b"] == 42
+
+    def test_hermes_tagged(self):
+        text = (
+            'Sure, checking.\n<tool_call>\n'
+            '{"name": "get_weather", "arguments": {"city": "SF"}}\n'
+            "</tool_call>"
+        )
+        calls, content = try_tool_call_parse(text, "hermes")
+        assert calls[0].name == "get_weather"
+        assert content == "Sure, checking."
+
+    def test_hermes_parallel_calls(self):
+        text = (
+            '<tool_call>{"name": "a", "arguments": {}}</tool_call>'
+            '<tool_call>{"name": "b", "arguments": {"k": 1}}</tool_call>'
+        )
+        calls, _ = try_tool_call_parse(text, "hermes")
+        assert [c.name for c in calls] == ["a", "b"]
+
+    def test_mistral_array(self):
+        text = '[TOOL_CALLS][{"name": "f", "arguments": {"q": "x"}}]'
+        calls, _ = try_tool_call_parse(text, "mistral")
+        assert calls[0].name == "f"
+
+    def test_llama3_python_tag(self):
+        text = '<|python_tag|>{"name": "lookup", "parameters": {"id": 7}}<|eom_id|>'
+        calls, _ = try_tool_call_parse(text, "llama3_json")
+        assert calls[0].name == "lookup"
+        assert json.loads(calls[0].arguments) == {"id": 7}
+
+    def test_pythonic(self):
+        calls, content = try_tool_call_parse(
+            '[get_weather(city="SF"), set_alarm(hour=7, label="up")]', "pythonic"
+        )
+        assert [c.name for c in calls] == ["get_weather", "set_alarm"]
+        assert json.loads(calls[1].arguments) == {"hour": 7, "label": "up"}
+        assert content == ""
+
+    def test_harmony(self):
+        text = (
+            "<|channel|>commentary to=functions.get_weather <|constrain|>json"
+            '<|message|>{"city": "SF"}<|call|>'
+        )
+        calls, _ = try_tool_call_parse(text, "harmony")
+        assert calls[0].name == "get_weather"
+        assert json.loads(calls[0].arguments) == {"city": "SF"}
+
+    def test_plain_text_passthrough(self):
+        calls, content = try_tool_call_parse("just a normal answer", "hermes")
+        assert calls == []
+        assert content == "just a normal answer"
+
+    def test_invalid_json_passthrough(self):
+        calls, content = try_tool_call_parse("{not json", "default")
+        assert calls == []
+        assert content == "{not json"
+
+    def test_detect_start(self):
+        assert detect_tool_call_start("<tool_call>", "hermes")
+        assert detect_tool_call_start("<tool", "hermes")  # partial marker
+        assert detect_tool_call_start('{"name', "default")
+        assert not detect_tool_call_start("hello world", "hermes")
+
+
+class TestReasoningParsers:
+    def test_basic_batch(self):
+        p = BasicReasoningParser()
+        reasoning, content = p.parse("<think>step by step</think>The answer is 4.")
+        assert reasoning == "step by step"
+        assert content == "The answer is 4."
+
+    def test_starts_inside(self):
+        p = get_reasoning_parser("deepseek_r1")
+        reasoning, content = p.parse("thinking...</think>done")
+        assert reasoning == "thinking..."
+        assert content == "done"
+
+    def test_granite(self):
+        p = GraniteReasoningParser()
+        reasoning, content = p.parse(
+            "Here is my thought process: consider x. Here is my response: x=2."
+        )
+        assert "consider x" in reasoning
+        assert "x=2" in content
+
+    def test_gpt_oss(self):
+        p = GptOssReasoningParser()
+        reasoning, content = p.parse(
+            "<|channel|>analysis<|message|>examine<|end|>"
+            "<|channel|>final<|message|>result<|end|>"
+        )
+        assert reasoning == "examine"
+        assert content == "result"
+
+    def test_streaming_split_marker(self):
+        """Markers split across deltas must not leak into content."""
+        p = BasicReasoningParser()
+        rs, cs = [], []
+        for delta in ["<th", "ink>rea", "soning</th", "ink>ans", "wer"]:
+            d = p.feed(delta)
+            rs.append(d.reasoning)
+            cs.append(d.content)
+        d = p.flush()
+        rs.append(d.reasoning)
+        cs.append(d.content)
+        assert "".join(rs) == "reasoning"
+        assert "".join(cs) == "answer"
+
+    def test_streaming_no_markers(self):
+        p = BasicReasoningParser()
+        d = p.feed("hello world")
+        assert d.content == "hello world"
+        assert d.reasoning == ""
+
+
+def _stream_of(texts, finish="stop"):
+    async def agen():
+        for i, t in enumerate(texts):
+            last = i == len(texts) - 1
+            yield Annotated(
+                data=LLMEngineOutput(
+                    token_ids=[i],
+                    text=t,
+                    finish_reason=finish if last else None,
+                )
+            )
+
+    return agen()
+
+
+async def _collect(js):
+    outs = []
+    async for ann in js:
+        outs.append(ann.data)
+    return outs
+
+
+class TestJailedStream:
+    def test_tool_call_jailed_and_released(self):
+        js = JailedStream(
+            _stream_of(['<tool_call>{"name": "f", ', '"arguments": {}}</tool_call>']),
+            tool_parser="hermes",
+        )
+        outs = asyncio.run(_collect(js))
+        # no raw tool-call text ever reached the content stream
+        assert all("tool_call" not in (o.text or "") for o in outs)
+        final = outs[-1]
+        assert final.finish_reason == "tool_calls"
+        assert final.tool_calls[0]["function"]["name"] == "f"
+
+    def test_plain_text_passthrough(self):
+        js = JailedStream(_stream_of(["hello ", "world"]), tool_parser="hermes")
+        outs = asyncio.run(_collect(js))
+        assert "".join(o.text or "" for o in outs) == "hello world"
+        assert outs[-1].finish_reason == "stop"
+        assert outs[-1].tool_calls is None
+
+    def test_reasoning_routing(self):
+        js = JailedStream(
+            _stream_of(["<think>because</think>", "forty-two"]),
+            reasoning_parser="basic",
+        )
+        outs = asyncio.run(_collect(js))
+        assert "".join(o.reasoning_content or "" for o in outs) == "because"
+        assert "".join(o.text or "" for o in outs) == "forty-two"
+
+    def test_reasoning_then_tool_call(self):
+        js = JailedStream(
+            _stream_of(
+                [
+                    "<think>need weather</think>",
+                    '<tool_call>{"name": "w", "arguments": {"c": "SF"}}</tool_call>',
+                ]
+            ),
+            tool_parser="hermes",
+            reasoning_parser="basic",
+        )
+        outs = asyncio.run(_collect(js))
+        assert "".join(o.reasoning_content or "" for o in outs) == "need weather"
+        assert outs[-1].tool_calls[0]["function"]["name"] == "w"
+
+    def test_marker_split_after_content(self):
+        """'Sure. <tool' + '_call>...' — prefix held back, call parsed."""
+        js = JailedStream(
+            _stream_of(
+                ["Sure. <tool", '_call>{"name": "f", "arguments": {}}</tool_call>']
+            ),
+            tool_parser="hermes",
+        )
+        outs = asyncio.run(_collect(js))
+        text = "".join(o.text or "" for o in outs)
+        assert text == "Sure. "
+        assert outs[-1].tool_calls[0]["function"]["name"] == "f"
+
+    def test_jailed_ticks_keep_token_ids(self):
+        """Every token must reach downstream accounting even when jailed."""
+        deltas = ["<tool_call>", '{"name": "f",', ' "arguments": {}}', "</tool_call>"]
+        js = JailedStream(_stream_of(deltas), tool_parser="hermes")
+        outs = asyncio.run(_collect(js))
+        assert sum(len(o.token_ids) for o in outs) == len(deltas)
+
+    def test_unknown_parser_degrades_to_plain_text(self):
+        js = JailedStream(_stream_of(["hello"]), tool_parser="no-such-parser")
+        outs = asyncio.run(_collect(js))
+        assert outs[-1].text == "hello"
+
+    def test_gpt_oss_streaming_strips_final_markers(self):
+        js = JailedStream(
+            _stream_of(
+                [
+                    "<|channel|>analysis<|mess",
+                    "age|>think<|end|><|channel|>final<|message|>hi<|end|>",
+                ]
+            ),
+            reasoning_parser="gpt_oss",
+        )
+        outs = asyncio.run(_collect(js))
+        assert "".join(o.reasoning_content or "" for o in outs) == "think"
+        assert "".join(o.text or "" for o in outs) == "hi"
+
+    def test_unclosed_tool_call_flushes_at_end(self):
+        """Stream dies mid-call: jailed text is parsed (or returned) at eos."""
+        js = JailedStream(
+            _stream_of(['<tool_call>{"name": "f", "arguments": {}}']),
+            tool_parser="hermes",
+        )
+        outs = asyncio.run(_collect(js))
+        final = outs[-1]
+        assert final.tool_calls is not None
+        assert final.tool_calls[0]["function"]["name"] == "f"

@@ -168,6 +168,15 @@ class TestReplicaCalculation:
         p, d = planner.compute_replica_requirements(100000, 4096, 512)
         assert p + 2 * d <= 9
 
+    def test_chip_budget_respected_multichip_prefill(self):
+        args = SlaArgs(
+            adjustment_interval=60, itl=0.02, max_chip_budget=8,
+            prefill_engine_num_chips=4,
+        )
+        planner, _ = make_planner(args)
+        p, d = planner.compute_replica_requirements(100000, 4096, 512)
+        assert 4 * p + d <= 8
+
     def test_prefill_scales_with_isl(self):
         planner, _ = make_planner()
         p_short, _ = planner.compute_replica_requirements(200, 256, 128)
