@@ -59,6 +59,7 @@ class JailedStream:
         self._jailed: List[str] = []
         self._jailing = False
         self._pending = ""  # tail that may be a split start marker
+        self._released_any = False  # past message start: bare-JSON is content
 
     def _route_text(self, text: str) -> tuple[str, str]:
         """-> (reasoning_delta, content_delta) after the reasoning parser."""
@@ -79,15 +80,21 @@ class JailedStream:
         self._pending = ""
         if not text:
             return ""
-        idx, held = find_tool_call_start(text, self.tool_parser)
+        idx, held = find_tool_call_start(
+            text, self.tool_parser, allow_bare=not self._released_any
+        )
         if idx is not None:
             self._jailing = True
             self._jailed.append(text[idx:])
-            return text[:idx]
-        if held:
+            safe = text[:idx]
+        elif held:
             self._pending = text[-held:]
-            return text[:-held]
-        return text
+            safe = text[:-held]
+        else:
+            safe = text
+        if safe.strip():
+            self._released_any = True
+        return safe
 
     def _release(self) -> tuple[List[dict], str]:
         """Parse jailed text -> (tool_call dicts, leftover content)."""
@@ -109,7 +116,29 @@ class JailedStream:
             content,
         )
 
+    def _flush_end_of_stream(self) -> Optional[LLMEngineOutput]:
+        """The stream ended without a finish tick: release everything still
+        held (jailed tool call, pending marker prefix, reasoning tail)."""
+        content = ""
+        reasoning = ""
+        if self.reasoning is not None:
+            tail = self.reasoning.flush()
+            reasoning = tail.reasoning
+            content += self._check_jail(tail.content)
+        content += self._pending
+        self._pending = ""
+        calls, leftover = self._release()
+        if not (content or leftover or reasoning or calls):
+            return None
+        return LLMEngineOutput(
+            text=(content + leftover) or None,
+            reasoning_content=reasoning or None,
+            tool_calls=calls or None,
+            finish_reason="tool_calls" if calls else None,
+        )
+
     async def __aiter__(self):
+        saw_finish = False
         async for ann in self.stream:
             if ann.data is None or ann.event is not None or ann.is_error():
                 yield ann
@@ -125,6 +154,7 @@ class JailedStream:
             content = self._check_jail(content)
 
             if out.finish_reason:
+                saw_finish = True
                 # flush the reasoning parser's held-back marker prefix
                 if self.reasoning is not None:
                     tail = self.reasoning.flush()
@@ -152,3 +182,8 @@ class JailedStream:
             )
             if new.token_ids or new.text or new.reasoning_content:
                 yield dataclasses.replace(ann, data=new)
+
+        if not saw_finish:
+            final = self._flush_end_of_stream()
+            if final is not None:
+                yield Annotated(data=final)

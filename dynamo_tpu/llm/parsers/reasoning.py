@@ -65,13 +65,9 @@ class BasicReasoningParser:
 
     # -- streaming ------------------------------------------------------------
     def _could_be_marker_prefix(self, tail: str) -> int:
-        """Length of the longest suffix of `tail` that is a prefix of either
-        marker (held back until the next delta disambiguates)."""
-        for n in range(min(len(tail), max(len(self.start_token), len(self.end_token))), 0, -1):
-            suf = tail[-n:]
-            if self.start_token.startswith(suf) or self.end_token.startswith(suf):
-                return n
-        return 0
+        from .tool_calling import held_suffix_len
+
+        return held_suffix_len(tail, (self.start_token, self.end_token))
 
     def feed(self, delta: str) -> ParsedDelta:
         self._buf += delta
@@ -127,20 +123,26 @@ class GptOssReasoningParser(BasicReasoningParser):
     _ANALYSIS = "<|channel|>analysis<|message|>"
     _FINAL = "<|channel|>final<|message|>"
     _ENDS = ("<|end|>", "<|return|>")
+    # role headers are stripped, never shown (harmony message framing)
+    _ROLES = ("<|start|>assistant", "<|start|>user", "<|start|>system")
 
     def __init__(self):
         super().__init__(start_token=self._ANALYSIS, end_token="<|end|>")
-        self._markers = (self._ANALYSIS, self._FINAL) + self._ENDS
+        self._markers = (self._ANALYSIS, self._FINAL) + self._ENDS + self._ROLES
 
     # -- streaming: marker-driven channel switch ---------------------------
     def feed(self, delta: str) -> ParsedDelta:
+        from .tool_calling import held_suffix_len
+
         self._buf += delta
         out = ParsedDelta()
         while True:
-            hit = None  # (index, marker)
+            hit = None  # (index, marker); at equal index prefer the longest
             for m in self._markers:
                 i = self._buf.find(m)
-                if i >= 0 and (hit is None or i < hit[0]):
+                if i >= 0 and (
+                    hit is None or i < hit[0] or (i == hit[0] and len(m) > len(hit[1]))
+                ):
                     hit = (i, m)
             if hit is not None:
                 i, m = hit
@@ -152,19 +154,11 @@ class GptOssReasoningParser(BasicReasoningParser):
                 self._buf = self._buf[i + len(m):]
                 if m == self._ANALYSIS:
                     self.in_reasoning = True
-                elif m == self._FINAL:
+                elif m == self._FINAL or m in self._ENDS:
                     self.in_reasoning = False
-                else:  # <|end|> / <|return|>: close the current channel
-                    self.in_reasoning = False
+                # role headers: no state change, just stripped
                 continue
-            hold = 0
-            for n in range(
-                min(len(self._buf), max(len(m) for m in self._markers) - 1), 0, -1
-            ):
-                suf = self._buf[-n:]
-                if any(m.startswith(suf) for m in self._markers):
-                    hold = n
-                    break
+            hold = held_suffix_len(self._buf, self._markers)
             emit = self._buf[: len(self._buf) - hold]
             self._buf = self._buf[len(self._buf) - hold:]
             if self.in_reasoning:

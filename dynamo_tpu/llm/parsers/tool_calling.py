@@ -84,33 +84,43 @@ def _start_tokens_for(parser: str) -> Tuple[Tuple[str, ...], bool]:
     return cfg.start_tokens, cfg.allow_bare_json
 
 
-def find_tool_call_start(text: str, parser: Optional[str] = None) -> Tuple[Optional[int], int]:
+def held_suffix_len(text: str, markers: Sequence[str]) -> int:
+    """Length of the longest suffix of `text` that is a PROPER prefix of any
+    marker (a complete marker would have been found by search, so the scan
+    is bounded at max marker length - 1). Shared by the streaming reasoning
+    parsers and the tool-call jail."""
+    max_len = max((len(m) for m in markers), default=0)
+    for n in range(min(len(text), max_len - 1), 0, -1):
+        suf = text[-n:]
+        if any(m.startswith(suf) for m in markers):
+            return n
+    return 0
+
+
+def find_tool_call_start(
+    text: str, parser: Optional[str] = None, allow_bare: bool = True
+) -> Tuple[Optional[int], int]:
     """Scan accumulated text for a tool-call region start. Returns
     (start_index or None, held_suffix_len): `start_index` is the earliest
     position of a complete start marker (everything from there must be
     jailed); `held_suffix_len` is the length of a trailing partial marker
-    that must be held back until the next delta disambiguates it."""
+    that must be held back until the next delta disambiguates it.
+    `allow_bare=False` disables the bare-JSON heuristic (callers pass False
+    once mid-message — a quoted JSON example must not become a tool call)."""
     parser = parser or "default"
-    starts, allow_bare = _start_tokens_for(parser)
+    starts, cfg_allow_bare = _start_tokens_for(parser)
     idx: Optional[int] = None
     for tok in starts:
         i = text.find(tok)
         if i >= 0 and (idx is None or i < idx):
             idx = i
-    if allow_bare and idx is None:
+    if cfg_allow_bare and allow_bare and idx is None:
         stripped = text.lstrip()
         if stripped[:1] in ("{", "["):
             idx = len(text) - len(stripped)
     if idx is not None:
         return idx, 0
-    held = 0
-    max_len = max((len(t) for t in starts), default=0)
-    for n in range(min(len(text), max_len - 1), 0, -1):
-        suf = text[-n:]
-        if any(t.startswith(suf) for t in starts):
-            held = n
-            break
-    return None, held
+    return None, held_suffix_len(text, starts)
 
 
 def detect_tool_call_start(text: str, parser: Optional[str] = None) -> bool:

@@ -1,0 +1,122 @@
+"""Worker forward-pass metrics structures + aggregation.
+
+Role of the reference's ForwardPassMetrics family
+(lib/bindings/python/src/dynamo/_core.pyi:231-335, published by
+WorkerMetricsPublisher kv_router/publisher.rs:684 and scraped via NATS
+$SRV.STATS transports/nats.rs:107): typed load stats each worker publishes
+every interval, consumed by the KV router's scheduler and aggregated for
+observability.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class WorkerStats:
+    request_active_slots: int = 0
+    request_total_slots: int = 0
+    num_requests_waiting: int = 0
+    data_parallel_rank: Optional[int] = None
+
+
+@dataclass
+class KvStats:
+    kv_active_blocks: int = 0
+    kv_total_blocks: int = 1
+    gpu_cache_usage_perc: float = 0.0
+    gpu_prefix_cache_hit_rate: float = 0.0
+
+
+@dataclass
+class SpecDecodeStats:
+    num_spec_tokens: int = 0
+    num_drafts: int = 0
+    num_draft_tokens: int = 0
+    num_accepted_tokens: int = 0
+    num_accepted_tokens_per_pos: Optional[list] = None
+
+
+@dataclass
+class ForwardPassMetrics:
+    worker_stats: WorkerStats = field(default_factory=WorkerStats)
+    kv_stats: KvStats = field(default_factory=KvStats)
+    spec_decode_stats: Optional[SpecDecodeStats] = None
+
+    def to_dict(self) -> dict:
+        d = {
+            **dataclasses.asdict(self.worker_stats),
+            **dataclasses.asdict(self.kv_stats),
+        }
+        if self.spec_decode_stats is not None:
+            d["spec_decode"] = dataclasses.asdict(self.spec_decode_stats)
+        return d
+
+    @classmethod
+    def from_stats_dict(cls, d: Dict[str, Any]) -> "ForwardPassMetrics":
+        """Build from an engine stats() blob (unknown keys ignored, so engine
+        dialects — vLLM-style names included — parse)."""
+        ws = WorkerStats(
+            request_active_slots=int(
+                d.get("request_active_slots", d.get("num_running_reqs", 0))
+            ),
+            request_total_slots=int(d.get("request_total_slots", 0)),
+            num_requests_waiting=int(
+                d.get("num_requests_waiting", d.get("num_waiting_reqs", 0))
+            ),
+            data_parallel_rank=d.get("data_parallel_rank"),
+        )
+        ks = KvStats(
+            kv_active_blocks=int(d.get("kv_active_blocks", 0)),
+            kv_total_blocks=max(int(d.get("kv_total_blocks", 1)), 1),
+            gpu_cache_usage_perc=float(d.get("gpu_cache_usage_perc", 0.0)),
+            gpu_prefix_cache_hit_rate=float(d.get("gpu_prefix_cache_hit_rate", 0.0)),
+        )
+        sd = None
+        if "spec_decode" in d:
+            sd = SpecDecodeStats(**{
+                k: v for k, v in d["spec_decode"].items()
+                if k in {f.name for f in dataclasses.fields(SpecDecodeStats)}
+            })
+        return cls(worker_stats=ws, kv_stats=ks, spec_decode_stats=sd)
+
+
+class KvMetricsAggregator:
+    """Latest ForwardPassMetrics per worker, fed from the kv_metrics topic
+    (reference KvMetricsAggregator _core.pyi; the router's scheduler keeps
+    its own copy — this one serves observability endpoints)."""
+
+    def __init__(self):
+        self._by_worker: Dict[int, ForwardPassMetrics] = {}
+
+    def update(self, worker_id: int, stats: Dict[str, Any]) -> None:
+        self._by_worker[worker_id] = ForwardPassMetrics.from_stats_dict(stats)
+
+    def remove_worker(self, worker_id: int) -> None:
+        self._by_worker.pop(worker_id, None)
+
+    @property
+    def workers(self) -> Dict[int, ForwardPassMetrics]:
+        return dict(self._by_worker)
+
+    def totals(self) -> dict:
+        ms = list(self._by_worker.values())
+        if not ms:
+            return {
+                "num_workers": 0, "active_slots": 0, "total_slots": 0,
+                "waiting": 0, "kv_active_blocks": 0, "kv_total_blocks": 0,
+                "avg_cache_usage": 0.0,
+            }
+        return {
+            "num_workers": len(ms),
+            "active_slots": sum(m.worker_stats.request_active_slots for m in ms),
+            "total_slots": sum(m.worker_stats.request_total_slots for m in ms),
+            "waiting": sum(m.worker_stats.num_requests_waiting for m in ms),
+            "kv_active_blocks": sum(m.kv_stats.kv_active_blocks for m in ms),
+            "kv_total_blocks": sum(m.kv_stats.kv_total_blocks for m in ms),
+            "avg_cache_usage": sum(m.kv_stats.gpu_cache_usage_perc for m in ms)
+            / len(ms),
+        }

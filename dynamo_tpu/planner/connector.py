@@ -51,8 +51,8 @@ class VirtualConnector:
         if raw:
             try:
                 return int(json.loads(raw).get("revision", 0))
-            except (ValueError, json.JSONDecodeError):
-                pass
+            except (ValueError, TypeError, AttributeError, json.JSONDecodeError):
+                pass  # malformed stored doc: restart revisions from 0
         return 0
 
     async def set_replicas(self, prefill: int, decode: int) -> None:

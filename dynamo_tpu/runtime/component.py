@@ -87,6 +87,23 @@ class DistributedRuntime:
         self._leased_keys: Dict[str, bytes] = {}
         self._shutdown = asyncio.Event()
         self.etcd_root = ""  # prefix for multi-tenant stores (unused for now)
+        # observability (reference: MetricsRegistry root on the DRT lib.rs:92,
+        # SystemHealth system_health.rs, HealthCheckManager health_check.rs)
+        from .health_check import HealthCheckManager
+        from .metrics import MetricsRegistry
+        from .system_status import SystemHealth, SystemStatusServer
+
+        self.metrics = MetricsRegistry()
+        self.system_health = SystemHealth()
+        self.system_status_server: Optional[SystemStatusServer] = None
+        self.health_check_manager: Optional[HealthCheckManager] = None
+        if self.config.health_check_enabled:
+            self.health_check_manager = HealthCheckManager(
+                self,
+                self.system_health,
+                idle_timeout=self.config.health_check_idle_timeout,
+                request_timeout=self.config.health_check_request_timeout,
+            )
 
     @classmethod
     async def create(
@@ -110,6 +127,16 @@ class DistributedRuntime:
             drt.discovery = await DiscoveryClient.connect(host, port)
             drt.primary_lease = await drt.discovery.grant_lease(ttl=10.0)
             drt.primary_lease.on_lost = drt._republish_leased_keys
+        if drt.config.system_enabled:
+            from .system_status import SystemStatusServer
+
+            drt.system_status_server = SystemStatusServer(
+                drt.system_health, drt.metrics,
+                host=drt.config.system_host, port=drt.config.system_port,
+            )
+            await drt.system_status_server.start()
+        if drt.health_check_manager is not None:
+            drt.health_check_manager.start()
         return drt
 
     async def _republish_leased_keys(self, lease):
@@ -153,6 +180,10 @@ class DistributedRuntime:
 
     async def close(self):
         self._shutdown.set()
+        if self.health_check_manager is not None:
+            await self.health_check_manager.stop()
+        if self.system_status_server is not None:
+            await self.system_status_server.stop()
         if self.primary_lease is not None:
             await self.primary_lease.revoke()
         await self.client.close()

@@ -1,0 +1,114 @@
+"""Canary health checks for served endpoints.
+
+Role of the reference's HealthCheckManager
+(lib/runtime/src/health_check.rs:39-162, DYN_HEALTH_CHECK_* config
+config.rs:155-167): an endpoint that has been idle longer than
+`idle_timeout` gets a canary request sent through the real request plane
+(loopback to this process's own server — the full codec/dispatch path).
+Success keeps the endpoint healthy; a timeout or stream error marks it
+unhealthy in SystemHealth, which flips the status server's /health to 503
+so orchestrators can restart the worker.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+from .system_status import SystemHealth
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class _Target:
+    subject: str
+    address: str
+    path: str  # namespace/component/endpoint for SystemHealth
+    canary: Any  # request payload the handler treats as a no-op probe
+    stats: Any  # EndpointStats (idle tracking)
+    consecutive_failures: int = 0
+
+
+class HealthCheckManager:
+    def __init__(
+        self,
+        drt,
+        health: SystemHealth,
+        idle_timeout: float = 60.0,
+        request_timeout: float = 10.0,
+        check_interval: Optional[float] = None,
+    ):
+        self.drt = drt
+        self.health = health
+        self.idle_timeout = idle_timeout
+        self.request_timeout = request_timeout
+        self.check_interval = check_interval or max(idle_timeout / 4, 0.5)
+        self._targets: Dict[str, _Target] = {}
+        self._task: Optional[asyncio.Task] = None
+
+    def register(self, served_endpoint, canary_payload: Any) -> None:
+        """Track a ServedEndpoint; `canary_payload` must be a request the
+        handler completes quickly (reference: engines expose a designated
+        health-check request)."""
+        ep = served_endpoint
+        t = _Target(
+            subject=ep.instance.subject,
+            address=ep.instance.address,
+            path=f"{ep.instance.namespace}/{ep.instance.component}/{ep.instance.endpoint}",
+            canary=canary_payload,
+            stats=ep.stats,
+        )
+        self._targets[t.subject] = t
+        self.health.set_endpoint_health(t.path, True)
+
+    def unregister(self, subject: str) -> None:
+        t = self._targets.pop(subject, None)
+        if t is not None:
+            self.health.remove_endpoint(t.path)
+
+    def start(self) -> None:
+        self._task = asyncio.create_task(self._loop())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def _loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.check_interval)
+            now = time.monotonic()
+            for t in list(self._targets.values()):
+                idle = now - t.stats.last_request_at
+                if idle < self.idle_timeout:
+                    continue
+                await self._probe(t)
+
+    async def _probe(self, t: _Target) -> None:
+        try:
+            stream = await self.drt.client.call(t.address, t.subject, t.canary)
+
+            async def drain():
+                async for _ in stream:
+                    pass
+
+            await asyncio.wait_for(drain(), timeout=self.request_timeout)
+            if t.consecutive_failures:
+                logger.info("endpoint %s recovered", t.path)
+            t.consecutive_failures = 0
+            self.health.set_endpoint_health(t.path, True)
+        except Exception as e:  # noqa: BLE001 — any failure counts
+            t.consecutive_failures += 1
+            logger.warning(
+                "health canary failed for %s (%d consecutive): %s",
+                t.path, t.consecutive_failures, e,
+            )
+            self.health.set_endpoint_health(t.path, False)

@@ -1,0 +1,141 @@
+"""Hierarchical runtime metrics registry.
+
+Role of the reference's MetricsRegistry (lib/runtime/src/metrics.rs,
+MetricsRegistryEntry lib.rs:92): every level of the
+DRT → namespace → component → endpoint hierarchy can mint Prometheus
+counters/gauges/histograms that are automatically labeled with their
+position in the hierarchy (dynamo_namespace / dynamo_component /
+dynamo_endpoint), all collected into one process-wide registry that the
+system status server exports at /metrics. Callback gauges mirror the
+reference's metrics callbacks (scrape-time evaluation).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
+
+HIERARCHY_LABELS = ("dynamo_namespace", "dynamo_component", "dynamo_endpoint")
+
+
+class MetricsRegistry:
+    """One node in the metrics hierarchy. The root owns the
+    prometheus-client CollectorRegistry; children share it and add labels."""
+
+    def __init__(
+        self,
+        prefix: str = "dynamo",
+        _registry: Optional[CollectorRegistry] = None,
+        _labels: Optional[Dict[str, str]] = None,
+        _root: Optional["MetricsRegistry"] = None,
+    ):
+        self.prefix = prefix
+        self.registry = _registry or CollectorRegistry()
+        self.labels = dict(_labels or {})
+        self._root = _root or self
+        if _root is None:
+            self._metrics: Dict[str, object] = {}
+            self._lock = threading.Lock()
+            self._callbacks: List[Callable[[], None]] = []
+
+    # -- hierarchy ----------------------------------------------------------
+    def child(self, level: str, name: str) -> "MetricsRegistry":
+        labels = dict(self.labels)
+        labels[level] = name
+        return MetricsRegistry(
+            self.prefix, _registry=self.registry, _labels=labels, _root=self._root
+        )
+
+    def for_namespace(self, name: str) -> "MetricsRegistry":
+        return self.child("dynamo_namespace", name)
+
+    def for_component(self, name: str) -> "MetricsRegistry":
+        return self.child("dynamo_component", name)
+
+    def for_endpoint(self, name: str) -> "MetricsRegistry":
+        return self.child("dynamo_endpoint", name)
+
+    # -- metric constructors -------------------------------------------------
+    # every metric carries ALL hierarchy labels ("" when minted above that
+    # level): one prometheus collector can then serve the same metric name
+    # from any depth, and label arity never conflicts
+    def _label_names(self, extra: Sequence[str]) -> Tuple[str, ...]:
+        return HIERARCHY_LABELS + tuple(extra)
+
+    def _label_values(self) -> Tuple[str, ...]:
+        return tuple(self.labels.get(k, "") for k in HIERARCHY_LABELS)
+
+    def _get_or_create(self, cls, name: str, doc: str, extra_labels: Sequence[str], **kw):
+        root = self._root
+        full = f"{self.prefix}_{name}"
+        names = self._label_names(extra_labels)
+        with root._lock:
+            metric = root._metrics.get(full)
+            if metric is None:
+                metric = cls(full, doc, names, registry=self.registry, **kw)
+                root._metrics[full] = metric
+            elif tuple(metric._labelnames) != names:
+                raise ValueError(
+                    f"metric {full} already registered with labels "
+                    f"{metric._labelnames}, requested {names}"
+                )
+        return metric
+
+    def counter(self, name: str, doc: str = "", extra_labels: Sequence[str] = ()):
+        m = self._get_or_create(Counter, name, doc or name, extra_labels)
+        return m.labels(*self._label_values()) if not extra_labels else _Partial(m, self._label_values())
+
+    def gauge(self, name: str, doc: str = "", extra_labels: Sequence[str] = ()):
+        m = self._get_or_create(Gauge, name, doc or name, extra_labels)
+        return m.labels(*self._label_values()) if not extra_labels else _Partial(m, self._label_values())
+
+    def histogram(
+        self,
+        name: str,
+        doc: str = "",
+        extra_labels: Sequence[str] = (),
+        buckets: Optional[Sequence[float]] = None,
+    ):
+        kw = {"buckets": tuple(buckets)} if buckets else {}
+        m = self._get_or_create(Histogram, name, doc or name, extra_labels, **kw)
+        return m.labels(*self._label_values()) if not extra_labels else _Partial(m, self._label_values())
+
+    def callback_gauge(self, name: str, doc: str, fn: Callable[[], float]):
+        """Gauge whose value is computed at scrape time (reference metrics
+        callbacks): re-evaluated by render()."""
+        g = self.gauge(name, doc)
+        root = self._root
+
+        def update():
+            try:
+                g.set(fn())
+            except Exception:  # noqa: BLE001 — scrape must not die
+                pass
+
+        root._callbacks.append(update)
+        return g
+
+    # -- export ---------------------------------------------------------------
+    def render(self) -> bytes:
+        for cb in self._root._callbacks:
+            cb()
+        return generate_latest(self.registry)
+
+
+class _Partial:
+    """Metric bound to the hierarchy labels, awaiting the extra labels."""
+
+    def __init__(self, metric, hier_values: Tuple[str, ...]):
+        self._metric = metric
+        self._hier = hier_values
+
+    def labels(self, *values: str):
+        return self._metric.labels(*self._hier, *values)

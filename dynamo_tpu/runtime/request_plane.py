@@ -24,6 +24,7 @@ from __future__ import annotations
 import asyncio
 import itertools
 import logging
+import time
 from typing import Any, AsyncIterator, Awaitable, Callable, Dict, Optional, Tuple
 
 from . import codec
@@ -43,6 +44,7 @@ class EndpointStats:
         self.requests_total = 0
         self.requests_active = 0
         self.errors_total = 0
+        self.last_request_at = time.monotonic()  # idle tracking (health canary)
         self.data = {}  # engine-published stats blob (ForwardPassMetrics)
 
     def snapshot(self) -> dict:
@@ -169,6 +171,7 @@ class RequestPlaneServer:
         if stats:
             stats.requests_total += 1
             stats.requests_active += 1
+            stats.last_request_at = time.monotonic()
         try:
             request = codec.unpack(payload)
             async for item in handler(request, ctx):

@@ -1,0 +1,88 @@
+"""System status server: /health, /live, /metrics.
+
+Role of the reference's system status server
+(lib/runtime/src/system_status_server.rs + system_health.rs): a small HTTP
+server per process, enabled by DYN_SYSTEM_ENABLED/DYN_SYSTEM_PORT,
+reporting liveness (process up), readiness (endpoint health states), and
+the process metrics registry.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Dict, Optional, Tuple
+
+from aiohttp import web
+
+from .metrics import MetricsRegistry
+
+logger = logging.getLogger(__name__)
+
+
+class SystemHealth:
+    """Endpoint-state-driven system health (reference system_health.rs):
+    the process is ready iff every registered endpoint is healthy."""
+
+    def __init__(self):
+        self._endpoints: Dict[str, bool] = {}
+
+    def set_endpoint_health(self, endpoint_path: str, healthy: bool) -> None:
+        self._endpoints[endpoint_path] = healthy
+
+    def remove_endpoint(self, endpoint_path: str) -> None:
+        self._endpoints.pop(endpoint_path, None)
+
+    @property
+    def healthy(self) -> bool:
+        return all(self._endpoints.values()) if self._endpoints else True
+
+    def snapshot(self) -> dict:
+        return {
+            "status": "healthy" if self.healthy else "unhealthy",
+            "endpoints": dict(self._endpoints),
+        }
+
+
+class SystemStatusServer:
+    def __init__(
+        self,
+        health: SystemHealth,
+        metrics: Optional[MetricsRegistry] = None,
+        host: str = "0.0.0.0",
+        port: int = 0,
+    ):
+        self.health = health
+        self.metrics = metrics
+        self.host = host
+        self.port = port
+        self._runner: Optional[web.AppRunner] = None
+
+    async def start(self) -> Tuple[str, int]:
+        app = web.Application()
+        app.router.add_get("/health", self._health)
+        app.router.add_get("/live", self._live)
+        app.router.add_get("/metrics", self._metrics)
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+        logger.info("system status server on %s:%d", self.host, self.port)
+        return self.host, self.port
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+    async def _health(self, request: web.Request) -> web.Response:
+        snap = self.health.snapshot()
+        return web.json_response(snap, status=200 if self.health.healthy else 503)
+
+    async def _live(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "live"})
+
+    async def _metrics(self, request: web.Request) -> web.Response:
+        body = self.metrics.render() if self.metrics is not None else b""
+        return web.Response(body=body, content_type="text/plain", charset="utf-8")

@@ -263,6 +263,54 @@ class TestJailedStream:
         assert "".join(o.reasoning_content or "" for o in outs) == "think"
         assert "".join(o.text or "" for o in outs) == "hi"
 
+    def test_stream_end_without_finish_releases_jail(self):
+        """No finish tick (worker died): jailed call still comes out."""
+
+        async def agen():
+            yield Annotated(
+                data=LLMEngineOutput(
+                    token_ids=[0],
+                    text='<tool_call>{"name": "f", "arguments": {}}</tool_call>',
+                )
+            )
+
+        js = JailedStream(agen(), tool_parser="hermes")
+        outs = asyncio.run(_collect(js))
+        assert outs[-1].tool_calls is not None
+        assert outs[-1].tool_calls[0]["function"]["name"] == "f"
+
+    def test_quoted_json_mid_message_is_content(self):
+        """A delta that merely starts with '{' mid-message must not become
+        a tool call (chunk boundaries are arbitrary)."""
+        js = JailedStream(
+            _stream_of(
+                [
+                    "Here is the JSON you asked for:\n",
+                    '{"name": "get_weather", "arguments": {"city": "SF"}}',
+                ]
+            ),
+            tool_parser="hermes",
+        )
+        outs = asyncio.run(_collect(js))
+        assert outs[-1].finish_reason == "stop"
+        assert outs[-1].tool_calls is None
+        text = "".join(o.text or "" for o in outs)
+        assert '"get_weather"' in text
+
+    def test_gpt_oss_role_headers_stripped(self):
+        js = JailedStream(
+            _stream_of(
+                [
+                    "<|start|>assistant<|channel|>analysis<|message|>think<|end|>",
+                    "<|start|>assistant<|channel|>final<|message|>hello<|return|>",
+                ]
+            ),
+            reasoning_parser="gpt_oss",
+        )
+        outs = asyncio.run(_collect(js))
+        assert "".join(o.reasoning_content or "" for o in outs) == "think"
+        assert "".join(o.text or "" for o in outs) == "hello"
+
     def test_unclosed_tool_call_flushes_at_end(self):
         """Stream dies mid-call: jailed text is parsed (or returned) at eos."""
         js = JailedStream(
