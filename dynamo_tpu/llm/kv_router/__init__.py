@@ -18,7 +18,13 @@ from ...runtime.engine import Context
 from ...runtime.request_plane import StreamLost
 from ..model_card import ModelDeploymentCard
 from ..tokens import compute_seq_hashes
-from .indexer import ApproxKvIndexer, KvIndexer, OverlapScores, RadixTree
+from .indexer import (
+    ApproxKvIndexer,
+    KvIndexer,
+    KvIndexerSharded,
+    OverlapScores,
+    RadixTree,
+)
 from .publisher import KvEventPublisher, WorkerMetricsPublisher, METRICS_TOPIC_FMT
 from .scheduler import KvRouterConfig, KvScheduler, WorkerLoad, softmax_sample
 
@@ -26,6 +32,7 @@ logger = logging.getLogger(__name__)
 
 __all__ = [
     "ApproxKvIndexer",
+    "KvIndexerSharded",
     "KvEventPublisher",
     "KvIndexer",
     "KvPushRouter",

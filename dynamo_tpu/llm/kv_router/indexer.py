@@ -114,26 +114,87 @@ class RadixTree:
 EVENT_TOPIC_FMT = "kv_events/{namespace}/{component}"
 
 
+ROUTER_SNAPSHOT_KEY_FMT = "v1/router_snapshots/{namespace}/{component}"
+
+
 class KvIndexer:
     """Event-driven index: subscribes to the component's KV-event topic and
     applies stored/removed events to the RadixTree
-    (reference KvIndexer indexer.rs + subscriber.rs)."""
+    (reference KvIndexer indexer.rs + subscriber.rs).
 
-    def __init__(self, drt: DistributedRuntime, namespace: str, component: str, block_size: int = 64):
+    With `snapshot_threshold` set, the tree is persisted to the discovery
+    KV (the reference's NATS object-store role, kv_cache_routing.md
+    --router-snapshot-threshold) every N applied events and restored on
+    start, so a restarted/added router replica syncs without replaying the
+    whole event history. `reset_states` drops any stored snapshot instead
+    (--router-reset-states)."""
+
+    def __init__(
+        self,
+        drt: DistributedRuntime,
+        namespace: str,
+        component: str,
+        block_size: int = 64,
+        snapshot_threshold: Optional[int] = None,
+        reset_states: bool = False,
+    ):
         from ...native import make_radix_tree
 
         self.drt = drt
         self.block_size = block_size
         self.topic = EVENT_TOPIC_FMT.format(namespace=namespace, component=component)
+        self.snapshot_key = ROUTER_SNAPSHOT_KEY_FMT.format(
+            namespace=namespace, component=component
+        )
+        self.snapshot_threshold = snapshot_threshold
+        self.reset_states = reset_states
         self.tree = make_radix_tree()  # C++ index when built, else RadixTree
         self._task: Optional[asyncio.Task] = None
         self._sub = None
         self.events_applied = 0
+        self._events_at_snapshot = 0
+        self._persist_task: Optional[asyncio.Task] = None
 
     async def start(self):
         assert self.drt.discovery is not None
+        # subscribe BEFORE restoring: events arriving during the restore are
+        # buffered in the subscription, not lost (load is additive)
         self._sub = await self.drt.discovery.subscribe(self.topic)
+        if self.reset_states:
+            await self.drt.discovery.delete(self.snapshot_key)
+        elif self.snapshot_threshold is not None:
+            await self._restore_snapshot()
         self._task = asyncio.create_task(self._loop())
+
+    async def _restore_snapshot(self):
+        raw = await self.drt.discovery.get(self.snapshot_key)
+        if not raw:
+            return
+        try:
+            self.tree.load(json.loads(raw))
+            logger.info("restored router snapshot (%d blocks)", self.tree.num_blocks)
+        except Exception:  # noqa: BLE001 — corrupt snapshot: start cold
+            logger.exception("router snapshot restore failed; starting cold")
+
+    def _start_persist_snapshot(self):
+        """Dump the tree inline (consistent point-in-time view), then encode
+        and upload off the event-apply hot path."""
+        if self._persist_task is not None and not self._persist_task.done():
+            return  # previous upload still in flight; next threshold retries
+        snapshot = self.tree.dump()
+        self._events_at_snapshot = self.events_applied
+
+        async def upload():
+            try:
+                loop = asyncio.get_running_loop()
+                raw = await loop.run_in_executor(
+                    None, lambda: json.dumps(snapshot).encode()
+                )
+                await self.drt.discovery.put(self.snapshot_key, raw)
+            except Exception:  # noqa: BLE001
+                logger.exception("router snapshot persist failed")
+
+        self._persist_task = asyncio.create_task(upload())
 
     async def _loop(self):
         from ...runtime import codec
@@ -150,6 +211,12 @@ class KvIndexer:
                     elif ev.get("event_type") == "cleared":
                         self.tree.clear_all_blocks(worker_id)
                     self.events_applied += 1
+                if (
+                    self.snapshot_threshold is not None
+                    and self.events_applied - self._events_at_snapshot
+                    >= self.snapshot_threshold
+                ):
+                    self._start_persist_snapshot()
             except Exception:  # noqa: BLE001 — indexer must survive bad events
                 logger.exception("bad kv event")
 
@@ -162,8 +229,80 @@ class KvIndexer:
     async def close(self):
         if self._task:
             self._task.cancel()
+        if self._persist_task is not None and not self._persist_task.done():
+            try:
+                await self._persist_task
+            except Exception:  # noqa: BLE001
+                pass
         if self._sub:
             await self._sub.cancel()
+
+
+class KvIndexerSharded:
+    """N independent trees, workers assigned by worker_id modulo shards;
+    lookups fan out and merge (reference KvIndexerSharded indexer.rs:992 —
+    bounds per-trie size and contention for large fleets)."""
+
+    def __init__(self, num_shards: int = 4, block_size: int = 64):
+        from ...native import make_radix_tree
+
+        if num_shards < 1:
+            raise ValueError("num_shards must be >= 1")
+        self.block_size = block_size
+        self.shards = [make_radix_tree() for _ in range(num_shards)]
+
+    def _shard(self, worker_id: int):
+        return self.shards[worker_id % len(self.shards)]
+
+    def apply_stored(self, worker_id: int, block_hashes: List[int]):
+        self._shard(worker_id).apply_stored(worker_id, block_hashes)
+
+    def apply_removed(self, worker_id: int, block_hashes: List[int]):
+        self._shard(worker_id).apply_removed(worker_id, block_hashes)
+
+    def clear_all_blocks(self, worker_id: int):
+        self._shard(worker_id).clear_all_blocks(worker_id)
+
+    def remove_worker(self, worker_id: int):
+        self._shard(worker_id).remove_worker(worker_id)
+
+    def find_matches(self, seq_hashes: List[int], early_exit: bool = False) -> OverlapScores:
+        merged = OverlapScores()
+        for shard in self.shards:
+            r = shard.find_matches(seq_hashes, early_exit=early_exit)
+            merged.scores.update(r.scores)
+            # frequencies[d] counts workers matching at depth d; shards hold
+            # disjoint workers, so merge is an element-wise sum
+            if len(r.frequencies) > len(merged.frequencies):
+                merged.frequencies.extend(
+                    [0] * (len(r.frequencies) - len(merged.frequencies))
+                )
+            for d, f in enumerate(r.frequencies):
+                merged.frequencies[d] += f
+        return merged
+
+    def find_matches_for_tokens(self, token_ids: List[int]) -> OverlapScores:
+        return self.find_matches(compute_seq_hashes(token_ids, self.block_size))
+
+    @property
+    def num_blocks(self) -> int:
+        return sum(s.num_blocks for s in self.shards)
+
+    def workers(self) -> List[int]:
+        out: List[int] = []
+        for s in self.shards:
+            out.extend(s.workers())
+        return out
+
+    def dump(self) -> dict:
+        merged: dict = {}
+        for s in self.shards:
+            merged.update(s.dump())
+        return merged
+
+    def load(self, snapshot: dict):
+        for w_str, hashes in snapshot.items():
+            self.apply_stored(int(w_str), list(hashes))
 
 
 class ApproxKvIndexer:

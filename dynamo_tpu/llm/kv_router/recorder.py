@@ -1,0 +1,113 @@
+"""KV-event recording and replay.
+
+Role of the reference's KvRecorder (lib/llm/src/kv_router/recorder.rs +
+lib/llm/src/recorder.rs): capture the router's KV-event stream to a JSONL
+file with timestamps, and replay a capture later — into a live event topic
+(load testing, router development without engines) or directly into an
+indexer tree (state reconstruction), optionally time-scaled.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+from pathlib import Path
+from typing import List, Optional, Union
+
+logger = logging.getLogger(__name__)
+
+
+class KvRecorder:
+    """Subscribe to a KV-event topic and append each message as a JSONL line
+    {"ts": relative_seconds, "msg": {worker_id, events}}."""
+
+    def __init__(self, drt, topic: str, path: Union[str, Path]):
+        self.drt = drt
+        self.topic = topic
+        self.path = Path(path)
+        self.events_recorded = 0
+        self._task: Optional[asyncio.Task] = None
+        self._sub = None
+        self._t0: Optional[float] = None
+
+    async def start(self):
+        self._sub = await self.drt.discovery.subscribe(self.topic)
+        self._task = asyncio.create_task(self._loop())
+
+    async def _loop(self):
+        from ...runtime import codec
+
+        with self.path.open("a") as f:
+            async for payload in self._sub:
+                try:
+                    msg = codec.unpack(payload)
+                except Exception:  # noqa: BLE001
+                    logger.exception("unreadable kv event; skipped")
+                    continue
+                now = time.monotonic()
+                if self._t0 is None:
+                    self._t0 = now
+                f.write(json.dumps({"ts": now - self._t0, "msg": msg}) + "\n")
+                f.flush()
+                self.events_recorded += len(msg.get("events", []))
+
+    async def close(self):
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+        if self._sub:
+            await self._sub.cancel()
+
+
+def load_recording(path: Union[str, Path]) -> List[dict]:
+    """Read a JSONL capture; returns [{"ts": float, "msg": {...}}, ...]."""
+    out = []
+    with Path(path).open() as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+def replay_into_tree(records: List[dict], tree) -> int:
+    """Apply a capture directly to a radix tree; returns events applied."""
+    n = 0
+    for rec in records:
+        msg = rec["msg"]
+        worker_id = msg["worker_id"]
+        for ev in msg.get("events", []):
+            if ev.get("event_type") == "stored":
+                tree.apply_stored(worker_id, ev["block_hashes"])
+            elif ev.get("event_type") == "removed":
+                tree.apply_removed(worker_id, ev["block_hashes"])
+            elif ev.get("event_type") == "cleared":
+                tree.clear_all_blocks(worker_id)
+            n += 1
+    return n
+
+
+async def replay_to_topic(
+    drt, topic: str, records: List[dict], timed: bool = False, speed: float = 1.0
+) -> int:
+    """Publish a capture back onto a live topic. With `timed`, inter-event
+    gaps are reproduced (scaled by `speed`) — the reference's replay mode
+    for exercising routers at recorded cadence."""
+    from ...runtime import codec
+
+    prev_ts = None
+    n = 0
+    for rec in records:
+        if timed and prev_ts is not None:
+            gap = (rec["ts"] - prev_ts) / speed
+            if gap > 0:
+                await asyncio.sleep(gap)
+        prev_ts = rec["ts"]
+        await drt.discovery.publish(topic, codec.pack(rec["msg"]))
+        n += len(rec["msg"].get("events", []))
+    return n

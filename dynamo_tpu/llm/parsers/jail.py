@@ -116,9 +116,10 @@ class JailedStream:
             content,
         )
 
-    def _flush_end_of_stream(self) -> Optional[LLMEngineOutput]:
-        """The stream ended without a finish tick: release everything still
-        held (jailed tool call, pending marker prefix, reasoning tail)."""
+    def _drain(self) -> tuple[str, str, List[dict]]:
+        """Release everything still held — reasoning tail, pending marker
+        prefix, jailed tool-call text. -> (content, reasoning, tool_calls).
+        Used by both the finish tick and the end-of-stream fallback."""
         content = ""
         reasoning = ""
         if self.reasoning is not None:
@@ -128,10 +129,15 @@ class JailedStream:
         content += self._pending
         self._pending = ""
         calls, leftover = self._release()
-        if not (content or leftover or reasoning or calls):
+        return content + leftover, reasoning, calls
+
+    def _flush_end_of_stream(self) -> Optional[LLMEngineOutput]:
+        """The stream ended without a finish tick: release held state."""
+        content, reasoning, calls = self._drain()
+        if not (content or reasoning or calls):
             return None
         return LLMEngineOutput(
-            text=(content + leftover) or None,
+            text=content or None,
             reasoning_content=reasoning or None,
             tool_calls=calls or None,
             finish_reason="tool_calls" if calls else None,
@@ -155,18 +161,11 @@ class JailedStream:
 
             if out.finish_reason:
                 saw_finish = True
-                # flush the reasoning parser's held-back marker prefix
-                if self.reasoning is not None:
-                    tail = self.reasoning.flush()
-                    reasoning_delta += tail.reasoning
-                    content += self._check_jail(tail.content)
-                content += self._pending  # un-consumed partial marker
-                self._pending = ""
-                calls, leftover = self._release()
+                d_content, d_reasoning, calls = self._drain()
                 new = dataclasses.replace(
                     out,
-                    text=(content + leftover) or None,
-                    reasoning_content=reasoning_delta or None,
+                    text=(content + d_content) or None,
+                    reasoning_content=(reasoning_delta + d_reasoning) or None,
                     tool_calls=calls or None,
                     finish_reason="tool_calls" if calls else out.finish_reason,
                 )

@@ -1,0 +1,99 @@
+"""Stream-level performance capture: TTFT / ITL from timestamped responses.
+
+Role of the reference's perf module (lib/llm/src/perf.rs:84-340): wrap a
+response stream so every emission is timestamped relative to request
+start, then derive time-to-first-token, inter-token latencies, and token
+throughput for benchmarking and the profiler. Works on any async iterator
+of Annotated[LLMEngineOutput]-shaped items.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, List, Optional
+
+
+@dataclass
+class TimestampedResponse:
+    t: float  # seconds since stream start
+    data: Any
+    num_tokens: int = 0
+
+
+@dataclass
+class StreamPerf:
+    """Recorded stream timeline + derived latency stats."""
+
+    responses: List[TimestampedResponse] = field(default_factory=list)
+
+    def record(self, t: float, data: Any, num_tokens: int) -> None:
+        self.responses.append(TimestampedResponse(t, data, num_tokens))
+
+    # -- derived metrics ----------------------------------------------------
+    def ttft(self) -> Optional[float]:
+        for r in self.responses:
+            if r.num_tokens > 0:
+                return r.t
+        return None
+
+    def token_timestamps(self) -> List[float]:
+        """One timestamp per token (a multi-token emission repeats its
+        arrival time — tokens inside one step are indistinguishable)."""
+        out: List[float] = []
+        for r in self.responses:
+            out.extend([r.t] * r.num_tokens)
+        return out
+
+    def inter_token_latencies(self) -> List[float]:
+        ts = self.token_timestamps()
+        return [b - a for a, b in zip(ts, ts[1:])]
+
+    def mean_itl(self) -> Optional[float]:
+        itls = self.inter_token_latencies()
+        return sum(itls) / len(itls) if itls else None
+
+    def total_tokens(self) -> int:
+        return sum(r.num_tokens for r in self.responses)
+
+    def duration(self) -> float:
+        return self.responses[-1].t if self.responses else 0.0
+
+    def tokens_per_second(self) -> Optional[float]:
+        d = self.duration()
+        n = self.total_tokens()
+        return n / d if d > 0 and n else None
+
+    def summary(self) -> dict:
+        return {
+            "ttft_s": self.ttft(),
+            "mean_itl_s": self.mean_itl(),
+            "total_tokens": self.total_tokens(),
+            "duration_s": self.duration(),
+            "tokens_per_second": self.tokens_per_second(),
+        }
+
+
+def _count_tokens(item: Any) -> int:
+    data = getattr(item, "data", item)
+    ids = getattr(data, "token_ids", None)
+    if ids is None and isinstance(data, dict):
+        ids = data.get("token_ids")
+    return len(ids) if ids else 0
+
+
+async def record_stream(
+    stream: AsyncIterator[Any], perf: Optional[StreamPerf] = None
+) -> AsyncIterator[Any]:
+    """Pass-through wrapper that timestamps every emission into `perf`
+    (reference perf.rs wrap-and-timestamp). Usage:
+
+        perf = StreamPerf()
+        async for item in record_stream(engine_stream, perf): ...
+        print(perf.summary())
+    """
+    perf = perf if perf is not None else StreamPerf()
+    t0 = time.monotonic()
+    async for item in stream:
+        perf.record(time.monotonic() - t0, item, _count_tokens(item))
+        yield item

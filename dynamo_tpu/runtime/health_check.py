@@ -86,11 +86,14 @@ class HealthCheckManager:
         while True:
             await asyncio.sleep(self.check_interval)
             now = time.monotonic()
-            for t in list(self._targets.values()):
-                idle = now - t.stats.last_request_at
-                if idle < self.idle_timeout:
-                    continue
-                await self._probe(t)
+            due = [
+                t for t in self._targets.values()
+                if now - t.stats.last_request_at >= self.idle_timeout
+            ]
+            if due:
+                # concurrent probes: one wedged endpoint must not delay the
+                # others' canaries past a single request_timeout
+                await asyncio.gather(*(self._probe(t) for t in due))
 
     async def _probe(self, t: _Target) -> None:
         try:

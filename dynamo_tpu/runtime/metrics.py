@@ -78,14 +78,21 @@ class MetricsRegistry:
         full = f"{self.prefix}_{name}"
         names = self._label_names(extra_labels)
         with root._lock:
-            metric = root._metrics.get(full)
-            if metric is None:
+            cached = root._metrics.get(full)
+            if cached is None:
                 metric = cls(full, doc, names, registry=self.registry, **kw)
-                root._metrics[full] = metric
-            elif tuple(metric._labelnames) != names:
+                root._metrics[full] = (metric, names, kw)
+                return metric
+            metric, cached_names, cached_kw = cached
+            if cached_names != names:
                 raise ValueError(
                     f"metric {full} already registered with labels "
-                    f"{metric._labelnames}, requested {names}"
+                    f"{cached_names}, requested {names}"
+                )
+            if cached_kw != kw:
+                raise ValueError(
+                    f"metric {full} already registered with options "
+                    f"{cached_kw}, requested {kw} (e.g. differing buckets)"
                 )
         return metric
 

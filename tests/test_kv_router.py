@@ -202,11 +202,19 @@ def test_kv_routing_e2e_prefix_affinity(kv_cluster):
     assert all(w == first for w in repeats), f"affinity broken: {first} vs {repeats}"
 
     # distinct raw-completion prompts (no shared chat-template prefix blocks)
-    # must not all pile onto the warm worker: tie-break spreads them
-    others = {
-        _stream_worker_id(
-            base, f"{i} totally distinct prompt " + chr(65 + i) * 300, endpoint="completions"
+    # must not all pile onto the warm worker: tie-break spreads them. The
+    # spread relies on KV events / load metrics reaching the router between
+    # requests (0.25s publish interval), so pace the requests.
+    others = set()
+    for i in range(8):
+        others.add(
+            _stream_worker_id(
+                base,
+                f"{i} totally distinct prompt " + chr(65 + i) * 300,
+                endpoint="completions",
+            )
         )
-        for i in range(8)
-    }
+        if len(others) == 2:
+            break
+        time.sleep(0.4)
     assert len(others) == 2, f"expected both workers used, got {others}"
