@@ -32,6 +32,8 @@ from ..protocols import (
     CompletionChoice,
     CompletionRequest,
     CompletionResponse,
+    EmbeddingRequest,
+    EmbeddingResponse,
     LLMEngineOutput,
     ModelInfo,
     ModelList,
@@ -67,6 +69,7 @@ class HttpService:
         # reference route assembly: service_v2.rs:319-339
         self.app.router.add_post("/v1/chat/completions", self.chat_completions)
         self.app.router.add_post("/v1/completions", self.completions)
+        self.app.router.add_post("/v1/embeddings", self.embeddings)
         self.app.router.add_get("/v1/models", self.list_models)
         self.app.router.add_get("/health", self.health)
         self.app.router.add_get("/live", self.live)
@@ -101,6 +104,88 @@ class HttpService:
         return web.Response(
             body=self.metrics.render(), content_type="text/plain", charset="utf-8"
         )
+
+    async def _embed_one(self, pipeline, token_ids: list[int]) -> list[float]:
+        """One embed round-trip below the detokenizer; raises on engine
+        errors (including migration-exhausted annotations)."""
+        from ..protocols import PreprocessedRequest
+
+        ctx = Context()
+        pre = PreprocessedRequest(
+            token_ids=token_ids,
+            embed=True,
+            stop_conditions={"max_tokens": 1},
+        )
+        try:
+            async for out in pipeline.raw_engine.generate(pre, ctx):
+                if hasattr(out, "is_error") and out.is_error():
+                    raise RuntimeError((out.comment or ["engine error"])[0])
+                d = out.data if hasattr(out, "data") else out
+                if isinstance(d, dict) and "embedding" in d:
+                    return d["embedding"]
+        finally:
+            ctx.stop_generating()
+        raise RuntimeError(
+            "engine returned no embedding (model not embedding-capable?)"
+        )
+
+    async def embeddings(self, request: web.Request) -> web.Response:
+        """/v1/embeddings (reference openai.rs embeddings handler): tokenize
+        each input, embed all inputs concurrently below the detokenizer, and
+        assemble the OpenAI embedding list."""
+        t0 = time.monotonic()
+        try:
+            body = await request.json()
+            req = EmbeddingRequest.model_validate(body)
+        except Exception as e:  # noqa: BLE001
+            return self._error(400, f"invalid request: {e}")
+        if req.encoding_format not in (None, "float"):
+            return self._error(
+                400, f"encoding_format {req.encoding_format!r} not supported"
+            )
+        pipeline = self.manager.get(req.model)
+        if pipeline is None:
+            return self._error(404, f"model {req.model!r} not found", "model_not_found")
+        self.metrics.request_start(req.model, "embeddings")
+        error_msg = None
+        prompt_tokens = 0
+        data: list[dict] = []
+        try:
+            inputs = req.input if isinstance(req.input, list) else [req.input]
+            if inputs and isinstance(inputs[0], int):  # single pre-tokenized prompt
+                inputs = [inputs]
+            token_lists = [
+                pipeline.tokenizer.encode(item) if isinstance(item, str) else list(item)
+                for item in inputs
+            ]
+            prompt_tokens = sum(len(t) for t in token_lists)
+            results = await asyncio.gather(
+                *(self._embed_one(pipeline, t) for t in token_lists),
+                return_exceptions=True,
+            )
+            for i, emb in enumerate(results):
+                if isinstance(emb, BaseException):
+                    error_msg = str(emb)
+                    break
+                data.append({"object": "embedding", "index": i, "embedding": emb})
+        except Exception as e:  # noqa: BLE001
+            error_msg = str(e)
+        finally:
+            self.metrics.request_end(
+                req.model, "embeddings", t0, error=bool(error_msg),
+                input_tokens=prompt_tokens,
+            )
+        if error_msg:
+            return self._error(500, error_msg, "engine_error")
+        resp = EmbeddingResponse(
+            data=data,
+            model=req.model,
+            usage=Usage(
+                prompt_tokens=prompt_tokens, completion_tokens=0,
+                total_tokens=prompt_tokens,
+            ),
+        )
+        return web.json_response(resp.model_dump(exclude_none=True))
 
     async def list_models(self, request: web.Request) -> web.Response:
         models = ModelList(data=[ModelInfo(id=name) for name in self.manager.names()])

@@ -81,6 +81,7 @@ class PreprocessedRequest:
     disagg_params: Optional[Dict[str, Any]] = None
     request_id: str = ""
     estimated_prefix_hit_num_blocks: Optional[int] = None
+    embed: bool = False  # embeddings request: engine returns {"embedding": [...]}
 
     def to_dict(self) -> dict:
         d = {
@@ -99,6 +100,8 @@ class PreprocessedRequest:
             d["disagg_params"] = self.disagg_params
         if self.estimated_prefix_hit_num_blocks is not None:
             d["estimated_prefix_hit_num_blocks"] = self.estimated_prefix_hit_num_blocks
+        if self.embed:
+            d["embed"] = True
         return d
 
     @classmethod

@@ -56,11 +56,15 @@ class ModelPipeline:
         card: ModelDeploymentCard,
         tokenizer: Tokenizer,
         engine: AsyncEngine,
+        raw_engine: Optional[AsyncEngine] = None,
     ):
         self.card = card
         self.tokenizer = tokenizer
         self.preprocessor = OpenAIPreprocessor(card, tokenizer)
         self.engine = engine  # Backend(Migration(ServiceBackend(router)))
+        # the chain below the detokenizer: embeddings and other non-token
+        # responses must not pass through incremental detokenization
+        self.raw_engine = raw_engine or engine
 
     def generate_preprocessed(
         self, request: PreprocessedRequest, context: Context
@@ -85,4 +89,4 @@ def build_routed_pipeline(
     service = ServiceBackend(router)
     migration = Migration(service, migration_limit=card.migration_limit)
     backend = Backend(migration, tokenizer)
-    return ModelPipeline(card, tokenizer, backend)
+    return ModelPipeline(card, tokenizer, backend, raw_engine=migration)

@@ -91,6 +91,17 @@ async def main():
     asyncio.create_task(stats_loop())
 
     async def handler(request, context):
+        if request.get("embed"):
+            # deterministic fake embedding (hash-seeded) so the embeddings
+            # path is testable without a real embedding model
+            import hashlib
+
+            token_ids = request.get("token_ids") or []
+            h = hashlib.sha256(bytes(str(token_ids), "utf-8")).digest()
+            dim = 32
+            vec = [((h[i % len(h)] / 255.0) * 2 - 1) for i in range(dim)]
+            yield {"embedding": vec, "finish_reason": "stop"}
+            return
         # nvext annotation support: announce which worker serves the request
         # (reference annotations e.g. worker_id / kv_hit_rate)
         if "worker_instance_id" in (request.get("annotations") or []):

@@ -1,0 +1,246 @@
+"""Single-binary launcher: `python -m dynamo_tpu.run in=<input> out=<engine>`.
+
+Role of the reference's dynamo-run CLI (launch/dynamo-run/src/main.rs:31,
+flags.rs): one command that wires an input frontend to an engine —
+
+    in=http            OpenAI HTTP server (default port 8000)
+    in=text            interactive prompt loop on the terminal
+    in=stdin           read one prompt from stdin, print the completion
+    in=batch:FILE      process a JSONL file of {"text": ...} prompts
+    out=mocker         spawn the fake engine worker (default)
+    out=echo           trivial in-process echo engine
+    out=jax            spawn the JAX TPU engine worker
+    out=dyn://ns.comp.ep   attach to already-running workers
+
+The launcher embeds the discovery service, spawns the chosen worker as a
+subprocess (matching production process boundaries), watches for its model
+card, and runs the chosen input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import os
+import signal
+import sys
+from typing import List, Optional, Tuple
+
+logger = logging.getLogger("dynamo_tpu.run")
+
+
+def parse_spec(argv: List[str]) -> Tuple[str, str, argparse.Namespace]:
+    spec = {"in": "text", "out": "mocker"}
+    rest: List[str] = []
+    for a in argv:
+        if a.startswith("in="):
+            spec["in"] = a[3:]
+        elif a.startswith("out="):
+            spec["out"] = a[4:]
+        else:
+            rest.append(a)
+    ap = argparse.ArgumentParser(
+        description="dynamo-tpu run", prog="python -m dynamo_tpu.run"
+    )
+    ap.add_argument("--model-name", default=None)
+    ap.add_argument("--http-port", type=int, default=8000)
+    ap.add_argument("--namespace", default="dynamo")
+    ap.add_argument(
+        "--router-mode", choices=["round-robin", "random", "kv"], default="round-robin"
+    )
+    ap.add_argument("--max-tokens", type=int, default=256)
+    ap.add_argument("--prompt", default=None, help="one-shot prompt for in=text")
+    ap.add_argument("--verbose", "-v", action="store_true")
+    args = ap.parse_args(rest)
+    return spec["in"], spec["out"], args
+
+
+async def _spawn_worker(kind: str, args, discovery: str) -> Optional[asyncio.subprocess.Process]:
+    """Start the engine worker subprocess for out=mocker|jax."""
+    model = args.model_name or ("mock-model" if kind == "mocker" else "tiny")
+    if kind == "mocker":
+        cmd = [sys.executable, "-m", "dynamo_tpu.mocker",
+               "--model-name", model, "--kv-events"]
+    elif kind == "jax":
+        cmd = [sys.executable, "-m", "dynamo_tpu.jax_worker", "--model", model]
+    else:
+        raise ValueError(kind)
+    env = dict(os.environ)
+    env["DYN_DISCOVERY_ENDPOINT"] = discovery
+    proc = await asyncio.create_subprocess_exec(*cmd, env=env)
+    logger.info("spawned %s worker pid=%d (model=%s)", kind, proc.pid, model)
+    return proc
+
+
+async def _serve_echo(drt, namespace: str, model: str):
+    """out=echo — in-process engine that echoes the prompt tokens back
+    (reference dynamo-run's echo engine: latency-path testing)."""
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard, register_llm
+
+    endpoint = drt.namespace(namespace).component("echo").endpoint("generate")
+
+    async def handler(request, context):
+        for tok in request.get("token_ids", [])[: request.get(
+            "stop_conditions", {}
+        ).get("max_tokens") or None]:
+            yield {"token_ids": [tok]}
+        yield {"token_ids": [], "finish_reason": "stop"}
+
+    card = ModelDeploymentCard(name=model, tokenizer="byte")
+    await register_llm(endpoint, card)
+    await endpoint.serve_endpoint(handler)
+
+
+async def _wait_for_model(manager, timeout: float = 120.0) -> str:
+    for _ in range(int(timeout / 0.2)):
+        names = manager.names()
+        if names:
+            return names[0]
+        await asyncio.sleep(0.2)
+    raise TimeoutError("no model appeared in discovery")
+
+
+async def _chat_once(pipeline, model: str, prompt: str, max_tokens: int) -> str:
+    from dynamo_tpu.llm.protocols import ChatCompletionRequest
+    from dynamo_tpu.runtime.engine import Context
+
+    req = ChatCompletionRequest(
+        model=model,
+        messages=[{"role": "user", "content": prompt}],
+        max_tokens=max_tokens,
+        stream=True,
+    )
+    pre = pipeline.preprocessor.preprocess_chat(req)
+    ctx = Context()
+    parts: List[str] = []
+    try:
+        async for ann in pipeline.generate_preprocessed(pre, ctx):
+            if ann.is_error():
+                raise RuntimeError((ann.comment or ["engine error"])[0])
+            if ann.event is not None or ann.data is None:
+                continue
+            if ann.data.text:
+                print(ann.data.text, end="", flush=True)
+                parts.append(ann.data.text)
+            if ann.data.finish_reason:
+                break
+    finally:
+        ctx.stop_generating()
+    print()
+    return "".join(parts)
+
+
+async def amain(argv: List[str]) -> int:
+    input_kind, out_kind, args = parse_spec(argv)
+    if input_kind not in ("http", "text", "stdin") and not input_kind.startswith("batch:"):
+        print(f"unknown in={input_kind}", file=sys.stderr)
+        return 2
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s %(message)s",
+    )
+
+    from dynamo_tpu.llm.discovery import ModelManager, ModelWatcher
+    from dynamo_tpu.runtime import DistributedRuntime, RouterMode, RuntimeConfig
+    from dynamo_tpu.runtime.config import discovery_address
+
+    cfg = RuntimeConfig.from_settings()
+    drt = await DistributedRuntime.create(cfg, embed_discovery=True)
+    host, port = discovery_address(cfg)
+    discovery = f"tcp://{host}:{port}"
+
+    worker_proc = None
+    if out_kind in ("mocker", "jax"):
+        worker_proc = await _spawn_worker(out_kind, args, discovery)
+    elif out_kind == "echo":
+        await _serve_echo(drt, args.namespace, args.model_name or "echo")
+    elif out_kind.startswith("dyn://"):
+        pass  # attach to whatever's registered
+    else:
+        print(f"unknown out={out_kind}", file=sys.stderr)
+        return 2
+
+    manager = ModelManager()
+    router_mode = RouterMode(args.router_mode)
+    kv_router_factory = None
+    if router_mode == RouterMode.KV:
+        from dynamo_tpu.llm.kv_router import KvRouterConfig, make_kv_router_factory
+
+        kv_router_factory = make_kv_router_factory(KvRouterConfig())
+    watcher = ModelWatcher(drt, manager, router_mode, kv_router_factory)
+    await watcher.start()
+
+    try:
+        if input_kind == "http":
+            from dynamo_tpu.llm.http import HttpService
+
+            service = HttpService(manager, host="0.0.0.0", port=args.http_port)
+            await service.start()
+            logger.info("OpenAI server ready on :%d", service.port)
+            await drt.wait_for_shutdown()
+            return 0
+
+        model = await _wait_for_model(manager)
+        pipeline = manager.get(model)
+
+        if input_kind == "text":
+            if args.prompt is not None:
+                await _chat_once(pipeline, model, args.prompt, args.max_tokens)
+                return 0
+            print(f"model: {model} — interactive chat, ctrl-d to exit")
+            loop = asyncio.get_running_loop()
+            while True:
+                try:
+                    line = await loop.run_in_executor(None, input, "> ")
+                except EOFError:
+                    return 0
+                if line.strip():
+                    await _chat_once(pipeline, model, line, args.max_tokens)
+
+        if input_kind == "stdin":
+            prompt = sys.stdin.read().strip()
+            if not prompt:
+                print("empty stdin", file=sys.stderr)
+                return 2
+            await _chat_once(pipeline, model, prompt, args.max_tokens)
+            return 0
+
+        # input_kind was validated above: only batch: remains
+        path = input_kind.split(":", 1)[1]
+        n = 0
+        with open(path) as f, open(path + ".out.jsonl", "w") as out:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                rec = json.loads(line)
+                text = await _chat_once(
+                    pipeline, model, rec["text"], args.max_tokens
+                )
+                out.write(json.dumps({"text": rec["text"], "response": text}) + "\n")
+                n += 1
+        logger.info("batch done: %d prompts -> %s.out.jsonl", n, path)
+        return 0
+    finally:
+        await watcher.stop()
+        if worker_proc is not None and worker_proc.returncode is None:
+            worker_proc.send_signal(signal.SIGTERM)
+            try:
+                await asyncio.wait_for(worker_proc.wait(), timeout=5)
+            except asyncio.TimeoutError:
+                worker_proc.kill()
+        await drt.close()
+
+
+def main() -> None:
+    try:
+        code = asyncio.run(amain(sys.argv[1:]))
+    except KeyboardInterrupt:
+        code = 130
+    raise SystemExit(code)
+
+
+if __name__ == "__main__":
+    main()

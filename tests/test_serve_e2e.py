@@ -206,3 +206,36 @@ def test_request_migration_on_worker_sigkill(cluster):
             },
         )
         assert r.status_code == 200, r.text
+
+
+def test_embeddings(cluster):
+    base, _ = cluster
+    with httpx.Client(timeout=30) as client:
+        r = client.post(
+            f"{base}/v1/embeddings",
+            json={"model": "mock-model", "input": ["hello world", "second text"]},
+        )
+        assert r.status_code == 200, r.text
+        body = r.json()
+        assert body["object"] == "list"
+        assert len(body["data"]) == 2
+        assert body["data"][1]["index"] == 1
+        emb0 = body["data"][0]["embedding"]
+        assert len(emb0) == 32 and all(isinstance(x, float) for x in emb0)
+        assert body["usage"]["prompt_tokens"] > 0
+        # deterministic per input
+        r2 = client.post(
+            f"{base}/v1/embeddings",
+            json={"model": "mock-model", "input": "hello world"},
+        )
+        assert r2.json()["data"][0]["embedding"] == emb0
+
+
+def test_embeddings_base64_rejected(cluster):
+    base, _ = cluster
+    with httpx.Client(timeout=30) as client:
+        r = client.post(
+            f"{base}/v1/embeddings",
+            json={"model": "mock-model", "input": "x", "encoding_format": "base64"},
+        )
+        assert r.status_code == 400
