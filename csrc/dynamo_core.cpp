@@ -17,6 +17,8 @@
 
 #include <cstdint>
 #include <cstring>
+#include <deque>
+#include <mutex>
 #include <unordered_map>
 #include <unordered_set>
 #include <vector>
@@ -188,6 +190,98 @@ uint64_t dyn_index_dump(void* p, int64_t* out_workers, uint64_t* out_hashes,
     }
   }
   return i;
+}
+
+// ------------------------------------------------------- C event ABI
+//
+// Role of the reference's C bindings (lib/bindings/c/src/lib.rs:100,115,281
+// dynamo_llm_init / dynamo_llm_shutdown / kv event publish): native engine
+// runtimes publish KV block stored/removed events from C/C++ threads
+// without touching Python. Events land in a mutex-guarded queue; the
+// Python side drains it (dynamo_tpu/native, NativeKvEventQueue) and
+// forwards to the discovery event topic via KvEventPublisher.
+
+namespace {
+
+struct DynKvEvent {
+  int64_t worker;
+  int32_t type;  // 0 = stored, 1 = removed, 2 = cleared
+  std::vector<uint64_t> hashes;
+};
+
+struct DynEventQueue {
+  std::mutex mu;
+  std::deque<DynKvEvent> events;
+  uint64_t dropped = 0;
+  uint64_t capacity;
+  explicit DynEventQueue(uint64_t cap) : capacity(cap) {}
+};
+
+}  // namespace
+
+void* dyn_llm_init(uint64_t queue_capacity) {
+  return new DynEventQueue(queue_capacity ? queue_capacity : 65536);
+}
+
+void dyn_llm_shutdown(void* p) { delete static_cast<DynEventQueue*>(p); }
+
+static void dyn_push(void* p, int64_t worker, int32_t type,
+                     const uint64_t* hashes, uint64_t n) {
+  auto* q = static_cast<DynEventQueue*>(p);
+  std::lock_guard<std::mutex> lock(q->mu);
+  if (q->events.size() >= q->capacity) {
+    // keep the newest events: stale stored/removed info is the least harmful
+    // thing to lose (the router self-corrects on later events)
+    q->events.pop_front();
+    q->dropped++;
+  }
+  q->events.push_back({worker, type, std::vector<uint64_t>(hashes, hashes + n)});
+}
+
+void dyn_kv_publish_stored(void* p, int64_t worker, const uint64_t* hashes,
+                           uint64_t n) {
+  dyn_push(p, worker, 0, hashes, n);
+}
+
+void dyn_kv_publish_removed(void* p, int64_t worker, const uint64_t* hashes,
+                            uint64_t n) {
+  dyn_push(p, worker, 1, hashes, n);
+}
+
+void dyn_kv_publish_cleared(void* p, int64_t worker) {
+  dyn_push(p, worker, 2, nullptr, 0);
+}
+
+// Pop one event. Returns the number of hashes written (<= cap), or -1 if the
+// queue is empty, or -2 if the event's hashes exceed cap (event stays queued;
+// call again with a bigger buffer; required size in *out_n_hashes).
+int64_t dyn_kv_event_pop(void* p, int64_t* out_worker, int32_t* out_type,
+                         uint64_t* out_hashes, uint64_t cap,
+                         uint64_t* out_n_hashes) {
+  auto* q = static_cast<DynEventQueue*>(p);
+  std::lock_guard<std::mutex> lock(q->mu);
+  if (q->events.empty()) return -1;
+  DynKvEvent& ev = q->events.front();
+  *out_n_hashes = ev.hashes.size();
+  if (ev.hashes.size() > cap) return -2;
+  *out_worker = ev.worker;
+  *out_type = ev.type;
+  std::memcpy(out_hashes, ev.hashes.data(), ev.hashes.size() * sizeof(uint64_t));
+  int64_t n = static_cast<int64_t>(ev.hashes.size());
+  q->events.pop_front();
+  return n;
+}
+
+uint64_t dyn_kv_events_dropped(void* p) {
+  auto* q = static_cast<DynEventQueue*>(p);
+  std::lock_guard<std::mutex> lock(q->mu);
+  return q->dropped;
+}
+
+uint64_t dyn_kv_events_pending(void* p) {
+  auto* q = static_cast<DynEventQueue*>(p);
+  std::lock_guard<std::mutex> lock(q->mu);
+  return q->events.size();
 }
 
 }  // extern "C"

@@ -21,6 +21,8 @@ def parse_args():
     ap = argparse.ArgumentParser(description="dynamo-tpu JAX engine worker")
     ap.add_argument("--model", default="tiny", help="model registry key (tiny/llama3-8b/llama3-70b)")
     ap.add_argument("--model-name", default=None, help="served model name (defaults to --model)")
+    ap.add_argument("--model-path", default=None,
+                    help="HF safetensors checkpoint dir; random init if omitted")
     ap.add_argument("--namespace", default="dynamo")
     ap.add_argument("--component", default="backend")
     ap.add_argument("--endpoint", default="generate")
@@ -66,7 +68,7 @@ async def main():
     kv_sharding = None
     params = None
     model_config = None
-    if args.tp_size > 1:
+    if args.tp_size > 1 or args.model_path:
         from dynamo_tpu.models import llama
         from dynamo_tpu.parallel.mesh import (
             LlamaShardings,
@@ -76,14 +78,27 @@ async def main():
         )
         import jax
 
-        mesh = build_mesh(ParallelConfig(tp_size=args.tp_size))
-        shardings = LlamaShardings(mesh)
         from dynamo_tpu.engine.engine import _resolve_model
 
         model_config = _resolve_model(args.model)
-        params = llama.init_params(model_config, jax.random.PRNGKey(engine_cfg.seed))
-        params = shard_params(params, shardings)
-        kv_sharding = shardings.kv_sharding()
+        shardings = None
+        if args.tp_size > 1:
+            mesh = build_mesh(ParallelConfig(tp_size=args.tp_size))
+            shardings = LlamaShardings(mesh)
+            kv_sharding = shardings.kv_sharding()
+        if args.model_path:
+            from dynamo_tpu.models.loader import load_llama_params
+
+            params = load_llama_params(
+                args.model_path,
+                model_config,
+                shardings.param_shardings() if shardings else None,
+            )
+        else:
+            params = llama.init_params(
+                model_config, jax.random.PRNGKey(engine_cfg.seed)
+            )
+            params = shard_params(params, shardings)
 
     # build the engine BEFORE joining the control plane: param init can take
     # tens of seconds and must not eat into the discovery lease

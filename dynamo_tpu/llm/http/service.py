@@ -143,6 +143,8 @@ class HttpService:
             return self._error(
                 400, f"encoding_format {req.encoding_format!r} not supported"
             )
+        if req.dimensions is not None:
+            return self._error(400, "dimensions parameter not supported")
         pipeline = self.manager.get(req.model)
         if pipeline is None:
             return self._error(404, f"model {req.model!r} not found", "model_not_found")

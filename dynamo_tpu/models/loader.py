@@ -1,0 +1,248 @@
+"""HF safetensors checkpoint loading into model param pytrees.
+
+Role of the reference's model resolution + weight loading (local_model.rs
+LocalModelBuilder + the engines' HF loaders): map HuggingFace
+llama/mixtral checkpoint tensors onto the functional param trees in
+models/llama.py / models/moe.py, layer-stacked and optionally placed
+straight onto a mesh with NamedShardings (one transfer per leaf, no
+host-side full-model copy beyond the memory-mapped safetensors).
+
+HF stores linear weights [out_features, in_features]; our trees use
+[in, out] (x @ W), so projections transpose on load.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["load_llama_params", "load_moe_params", "save_llama_as_hf"]
+
+
+def _open_checkpoint(model_dir: str) -> Dict[str, Any]:
+    """Tensor name -> lazily-loaded numpy array, handling both single-file
+    and index-sharded safetensors layouts."""
+    from safetensors import safe_open
+
+    d = Path(model_dir)
+    index = d / "model.safetensors.index.json"
+    files: Dict[str, Path] = {}
+    handles: Dict[Path, Any] = {}
+    if index.exists():
+        weight_map = json.loads(index.read_text())["weight_map"]
+        for name, fn in weight_map.items():
+            files[name] = d / fn
+    else:
+        sts = sorted(d.glob("*.safetensors"))
+        if not sts:
+            raise FileNotFoundError(f"no safetensors files under {model_dir}")
+        for st in sts:
+            # keep the handle from enumeration — don't mmap shards twice
+            handles[st] = safe_open(st, framework="numpy")
+            for name in handles[st].keys():
+                files[name] = st
+
+    class Reader:
+        def __contains__(self, name: str) -> bool:
+            return name in files
+
+        def keys(self):
+            return files.keys()
+
+        def get(self, name: str) -> np.ndarray:
+            path = files[name]
+            if path not in handles:
+                handles[path] = safe_open(path, framework="numpy")
+            return handles[path].get_tensor(name)
+
+    return Reader()
+
+
+def _to_dtype(x: np.ndarray, dtype) -> Any:
+    import jax.numpy as jnp
+    import ml_dtypes
+
+    if dtype == jnp.bfloat16:
+        return x.astype(ml_dtypes.bfloat16)
+    return x.astype(np.dtype(jnp.dtype(dtype)))
+
+
+def _place(x: np.ndarray, dtype, sharding=None):
+    import jax
+
+    arr = _to_dtype(x, dtype)
+    if sharding is not None:
+        return jax.device_put(arr, sharding)
+    return jax.device_put(arr)
+
+
+def _stack_layers(reader, names_fn, num_layers: int, transpose: bool) -> np.ndarray:
+    mats = []
+    for li in range(num_layers):
+        m = reader.get(names_fn(li))
+        mats.append(m.T if transpose else m)
+    return np.stack(mats)
+
+
+class _TreeBuilder:
+    """Shared backbone assembly (embed / attention / norms / lm_head) for
+    the llama and moe trees — the MLP block is the only difference."""
+
+    def __init__(self, reader, config, shardings: Optional[dict]):
+        self.r = reader
+        self.c = config
+        self.sh = shardings or {}
+
+    def layer_sh(self, key):
+        return self.sh.get("layers", {}).get(key) if self.sh else None
+
+    def stacked(self, key, hf_fmt, transpose=True):
+        arr = _stack_layers(
+            self.r, lambda li: hf_fmt.format(li=li), self.c.num_layers, transpose
+        )
+        return _place(arr, self.c.dtype, self.layer_sh(key))
+
+    def backbone(self) -> Dict[str, Any]:
+        c, r, sh = self.c, self.r, self.sh
+        params: Dict[str, Any] = {
+            "embed": _place(
+                r.get("model.embed_tokens.weight"), c.dtype, sh.get("embed")
+            ),
+            "layers": {
+                "attn_norm": self.stacked(
+                    "attn_norm", "model.layers.{li}.input_layernorm.weight",
+                    transpose=False,
+                ),
+                "wq": self.stacked("wq", "model.layers.{li}.self_attn.q_proj.weight"),
+                "wk": self.stacked("wk", "model.layers.{li}.self_attn.k_proj.weight"),
+                "wv": self.stacked("wv", "model.layers.{li}.self_attn.v_proj.weight"),
+                "wo": self.stacked("wo", "model.layers.{li}.self_attn.o_proj.weight"),
+                "mlp_norm": self.stacked(
+                    "mlp_norm",
+                    "model.layers.{li}.post_attention_layernorm.weight",
+                    transpose=False,
+                ),
+            },
+            "final_norm": _place(
+                r.get("model.norm.weight"), c.dtype, sh.get("final_norm")
+            ),
+        }
+        if c.tie_embeddings or "lm_head.weight" not in r:
+            params["lm_head"] = None
+        else:
+            params["lm_head"] = _place(
+                r.get("lm_head.weight").T, c.dtype, sh.get("lm_head")
+            )
+        return params
+
+
+def load_llama_params(
+    model_dir: str,
+    config,
+    shardings: Optional[dict] = None,
+) -> Dict[str, Any]:
+    """Load an HF llama-family checkpoint into the models/llama.py tree.
+    `shardings` (from LlamaShardings.param_shardings()) places each leaf on
+    the mesh as it loads."""
+    b = _TreeBuilder(_open_checkpoint(model_dir), config, shardings)
+    params = b.backbone()
+    params["layers"].update(
+        {
+            "w_gate": b.stacked("w_gate", "model.layers.{li}.mlp.gate_proj.weight"),
+            "w_up": b.stacked("w_up", "model.layers.{li}.mlp.up_proj.weight"),
+            "w_down": b.stacked("w_down", "model.layers.{li}.mlp.down_proj.weight"),
+        }
+    )
+    return params
+
+
+def load_moe_params(
+    model_dir: str,
+    config,
+    shardings: Optional[dict] = None,
+) -> Dict[str, Any]:
+    """Load an HF mixtral-family checkpoint into the models/moe.py tree
+    (block_sparse_moe.gate + experts.N.w1/w2/w3)."""
+    import jax.numpy as jnp
+
+    c = config
+    b = _TreeBuilder(_open_checkpoint(model_dir), config, shardings)
+    r = b.r
+
+    def stacked_experts(key, hf_fmt):
+        # -> [L, E, in, out]
+        layers = []
+        for li in range(c.num_layers):
+            layers.append(
+                np.stack(
+                    [r.get(hf_fmt.format(li=li, e=e)).T for e in range(c.num_experts)]
+                )
+            )
+        return _place(np.stack(layers), c.dtype, b.layer_sh(key))
+
+    params = b.backbone()
+    params["layers"].update(
+        {
+            # router stays f32 (routing decisions are numerically sensitive)
+            "router": _place(
+                _stack_layers(
+                    r,
+                    lambda li: f"model.layers.{li}.block_sparse_moe.gate.weight",
+                    c.num_layers,
+                    transpose=True,
+                ),
+                jnp.float32,
+                b.layer_sh("router"),
+            ),
+            # mixtral: w1=gate, w3=up, w2=down
+            "w_gate": stacked_experts(
+                "w_gate", "model.layers.{li}.block_sparse_moe.experts.{e}.w1.weight"
+            ),
+            "w_up": stacked_experts(
+                "w_up", "model.layers.{li}.block_sparse_moe.experts.{e}.w3.weight"
+            ),
+            "w_down": stacked_experts(
+                "w_down", "model.layers.{li}.block_sparse_moe.experts.{e}.w2.weight"
+            ),
+        }
+    )
+    return params
+
+
+def save_llama_as_hf(params: Dict[str, Any], config, out_dir: str) -> None:
+    """Export a models/llama.py tree in HF naming (round-trip testing and
+    checkpoint interchange)."""
+    from safetensors.numpy import save_file
+
+    c = config
+    os.makedirs(out_dir, exist_ok=True)
+    tensors: Dict[str, np.ndarray] = {}
+
+    def f32(x) -> np.ndarray:
+        return np.asarray(x, dtype=np.float32)
+
+    def f32t(x) -> np.ndarray:
+        # safetensors requires contiguous buffers; .T alone is a view
+        return np.ascontiguousarray(f32(x).T)
+
+    tensors["model.embed_tokens.weight"] = f32(params["embed"])
+    for li in range(c.num_layers):
+        L = params["layers"]
+        pre = f"model.layers.{li}"
+        tensors[f"{pre}.input_layernorm.weight"] = f32(L["attn_norm"][li])
+        tensors[f"{pre}.self_attn.q_proj.weight"] = f32t(L["wq"][li])
+        tensors[f"{pre}.self_attn.k_proj.weight"] = f32t(L["wk"][li])
+        tensors[f"{pre}.self_attn.v_proj.weight"] = f32t(L["wv"][li])
+        tensors[f"{pre}.self_attn.o_proj.weight"] = f32t(L["wo"][li])
+        tensors[f"{pre}.post_attention_layernorm.weight"] = f32(L["mlp_norm"][li])
+        tensors[f"{pre}.mlp.gate_proj.weight"] = f32t(L["w_gate"][li])
+        tensors[f"{pre}.mlp.up_proj.weight"] = f32t(L["w_up"][li])
+        tensors[f"{pre}.mlp.down_proj.weight"] = f32t(L["w_down"][li])
+    tensors["model.norm.weight"] = f32(params["final_norm"])
+    if params.get("lm_head") is not None:
+        tensors["lm_head.weight"] = f32t(params["lm_head"])
+    save_file(tensors, os.path.join(out_dir, "model.safetensors"))

@@ -1,0 +1,131 @@
+"""Python side of the C event ABI (reference lib/bindings/c).
+
+Native engine code publishes KV events through dyn_llm_init /
+dyn_kv_publish_* (csrc/dynamo_core.cpp); `NativeKvEventQueue` wraps the
+handle via ctypes and `pump()` forwards drained events into a
+KvEventPublisher so they reach the router's event topic.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import ctypes
+from typing import List, Optional
+
+import numpy as np
+
+from . import _load
+
+EVENT_TYPES = {0: "stored", 1: "removed", 2: "cleared"}
+
+
+class NativeKvEventQueue:
+    """ctypes wrapper over the C ABI's thread-safe event queue."""
+
+    def __init__(self, capacity: int = 65536):
+        self._lib = _load()
+        if self._lib is None:
+            raise RuntimeError("native core unavailable (build csrc/ first)")
+        self._bind(self._lib)
+        self._h = self._lib.dyn_llm_init(capacity)
+        self._buf = np.empty(4096, dtype=np.uint64)
+
+    @staticmethod
+    def _bind(lib) -> None:
+        if getattr(lib, "_dyn_c_abi_bound", False):
+            return
+        u64 = ctypes.c_uint64
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        i64 = ctypes.c_int64
+        p = ctypes.c_void_p
+        lib.dyn_llm_init.restype = p
+        lib.dyn_llm_init.argtypes = [u64]
+        lib.dyn_llm_shutdown.argtypes = [p]
+        for fn in (lib.dyn_kv_publish_stored, lib.dyn_kv_publish_removed):
+            fn.restype = None
+            fn.argtypes = [p, i64, u64p, u64]
+        lib.dyn_kv_publish_cleared.restype = None
+        lib.dyn_kv_publish_cleared.argtypes = [p, i64]
+        lib.dyn_kv_event_pop.restype = i64
+        lib.dyn_kv_event_pop.argtypes = [
+            p, ctypes.POINTER(i64), ctypes.POINTER(ctypes.c_int32), u64p, u64,
+            ctypes.POINTER(u64),
+        ]
+        for fn in (lib.dyn_kv_events_dropped, lib.dyn_kv_events_pending):
+            fn.restype = u64
+            fn.argtypes = [p]
+        lib._dyn_c_abi_bound = True
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.dyn_llm_shutdown(self._h)
+            self._h = None
+
+    # -- publish (normally called from native threads; exposed for tests) --
+    def _hashes_ptr(self, hashes: List[int]):
+        arr = np.asarray(hashes, dtype=np.uint64)
+        return arr, arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64))
+
+    def publish_stored(self, worker_id: int, block_hashes: List[int]) -> None:
+        arr, ptr = self._hashes_ptr(block_hashes)
+        self._lib.dyn_kv_publish_stored(self._h, worker_id, ptr, len(arr))
+
+    def publish_removed(self, worker_id: int, block_hashes: List[int]) -> None:
+        arr, ptr = self._hashes_ptr(block_hashes)
+        self._lib.dyn_kv_publish_removed(self._h, worker_id, ptr, len(arr))
+
+    def publish_cleared(self, worker_id: int) -> None:
+        self._lib.dyn_kv_publish_cleared(self._h, worker_id)
+
+    # -- drain --------------------------------------------------------------
+    def pop(self) -> Optional[dict]:
+        worker = ctypes.c_int64(0)
+        etype = ctypes.c_int32(0)
+        need = ctypes.c_uint64(0)
+        while True:
+            n = self._lib.dyn_kv_event_pop(
+                self._h, ctypes.byref(worker), ctypes.byref(etype),
+                self._buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+                len(self._buf), ctypes.byref(need),
+            )
+            if n == -1:
+                return None
+            if n == -2:
+                self._buf = np.empty(int(need.value), dtype=np.uint64)
+                continue
+            return {
+                "worker_id": int(worker.value),
+                "event_type": EVENT_TYPES[int(etype.value)],
+                "block_hashes": self._buf[:n].tolist(),
+            }
+
+    def drain(self, limit: int = 1024) -> List[dict]:
+        out = []
+        for _ in range(limit):
+            ev = self.pop()
+            if ev is None:
+                break
+            out.append(ev)
+        return out
+
+    @property
+    def pending(self) -> int:
+        return int(self._lib.dyn_kv_events_pending(self._h))
+
+    @property
+    def dropped(self) -> int:
+        return int(self._lib.dyn_kv_events_dropped(self._h))
+
+    async def pump(self, publisher, interval: float = 0.05) -> None:
+        """Forward drained events into a KvEventPublisher until cancelled."""
+        from ..llm.mocker.kv_manager import KvEvent
+
+        while True:
+            for ev in self.drain():
+                publisher.publish(
+                    KvEvent(
+                        event_type=ev["event_type"],
+                        block_hashes=ev["block_hashes"],
+                    )
+                )
+            await asyncio.sleep(interval)

@@ -137,6 +137,9 @@ async def amain(argv: List[str]) -> int:
     if input_kind not in ("http", "text", "stdin") and not input_kind.startswith("batch:"):
         print(f"unknown in={input_kind}", file=sys.stderr)
         return 2
+    if out_kind not in ("mocker", "jax", "echo") and not out_kind.startswith("dyn://"):
+        print(f"unknown out={out_kind}", file=sys.stderr)
+        return 2
     logging.basicConfig(
         level=logging.DEBUG if args.verbose else logging.INFO,
         format="%(asctime)s %(levelname)s %(name)s %(message)s",
@@ -156,11 +159,7 @@ async def amain(argv: List[str]) -> int:
         worker_proc = await _spawn_worker(out_kind, args, discovery)
     elif out_kind == "echo":
         await _serve_echo(drt, args.namespace, args.model_name or "echo")
-    elif out_kind.startswith("dyn://"):
-        pass  # attach to whatever's registered
-    else:
-        print(f"unknown out={out_kind}", file=sys.stderr)
-        return 2
+    # else dyn://: attach to whatever's registered
 
     manager = ModelManager()
     router_mode = RouterMode(args.router_mode)

@@ -120,3 +120,70 @@ def test_kv_indexer_uses_native_tree():
 
     tree = make_radix_tree()
     assert isinstance(tree, native.NativeRadixTree)
+
+
+class TestCEventAbi:
+    """C event ABI (reference lib/bindings/c): publish from threads, drain
+    in order, overflow keeps newest."""
+
+    def _queue(self, capacity=65536):
+        from dynamo_tpu.native import native_available
+        from dynamo_tpu.native.c_api import NativeKvEventQueue
+
+        if not native_available():
+            pytest.skip("native core not built")
+        return NativeKvEventQueue(capacity)
+
+    def test_publish_pop_roundtrip(self):
+        q = self._queue()
+        q.publish_stored(7, [1, 2, 3])
+        q.publish_removed(7, [2])
+        q.publish_cleared(9)
+        assert q.pending == 3
+        evs = q.drain()
+        assert [e["event_type"] for e in evs] == ["stored", "removed", "cleared"]
+        assert evs[0] == {"worker_id": 7, "event_type": "stored", "block_hashes": [1, 2, 3]}
+        assert evs[2]["worker_id"] == 9
+        assert q.pop() is None
+        q.close()
+
+    def test_large_event_grows_buffer(self):
+        q = self._queue()
+        hashes = list(range(10_000))
+        q.publish_stored(1, hashes)
+        ev = q.pop()
+        assert ev["block_hashes"] == hashes
+        q.close()
+
+    def test_overflow_drops_oldest(self):
+        q = self._queue(capacity=4)
+        for i in range(8):
+            q.publish_stored(1, [i])
+        assert q.pending == 4
+        assert q.dropped == 4
+        evs = q.drain()
+        assert [e["block_hashes"][0] for e in evs] == [4, 5, 6, 7]
+        q.close()
+
+    def test_threaded_publish(self):
+        import threading
+
+        q = self._queue()
+
+        def worker(wid):
+            for i in range(200):
+                q.publish_stored(wid, [wid * 1000 + i])
+
+        threads = [threading.Thread(target=worker, args=(w,)) for w in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        evs = q.drain(limit=2000)
+        assert len(evs) == 800
+        per_worker = {}
+        for e in evs:
+            per_worker.setdefault(e["worker_id"], []).append(e["block_hashes"][0])
+        for w, vals in per_worker.items():
+            assert vals == sorted(vals)  # per-thread FIFO preserved
+        q.close()
