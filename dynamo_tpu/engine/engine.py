@@ -85,8 +85,13 @@ class JaxEngine:
         self.config = config
         self.model_config = model_config or _resolve_model(config.model)
         c = self.model_config
+        # family dispatch: MoeConfig subclasses LlamaConfig, and models/moe.py
+        # exposes the same init/decode/prefill signatures
+        from ..models import moe
+
+        self._model = moe if isinstance(c, moe.MoeConfig) else llama
         key = jax.random.PRNGKey(config.seed)
-        self.params = params if params is not None else llama.init_params(c, key)
+        self.params = params if params is not None else self._model.init_params(c, key)
         # +1: physical page 0 is scratch
         self.kv_k, self.kv_v = alloc_kv_arrays(
             c.num_layers,
@@ -155,7 +160,7 @@ class JaxEngine:
 
         @partial(jax.jit, donate_argnums=(1, 2))
         def decode_step(params, kv_k, kv_v, tokens, positions, page_tables, seq_lens, samp, key):
-            logits, kv_k, kv_v = llama.decode_forward(
+            logits, kv_k, kv_v = self._model.decode_forward(
                 params, c, tokens, positions, kv_k, kv_v, page_tables, seq_lens
             )
             next_tokens = sample(logits, samp, key)
@@ -165,7 +170,7 @@ class JaxEngine:
 
         @partial(jax.jit, donate_argnums=(1, 2), static_argnums=(8,))
         def prefill_step(params, kv_k, kv_v, tokens, positions, page_table, ctx_len, last_idx, _bucket):
-            logits, kv_k, kv_v = llama.prefill_forward(
+            logits, kv_k, kv_v = self._model.prefill_forward(
                 params, c, tokens, positions, kv_k, kv_v, page_table, ctx_len, last_idx
             )
             return logits, kv_k, kv_v
@@ -796,11 +801,15 @@ class JaxEngine:
 
 
 def _resolve_model(name: str) -> llama.LlamaConfig:
+    from ..models import moe
+
     registry = {
         "tiny": llama.LlamaConfig.tiny,
         "llama3-3b": llama.LlamaConfig.llama3_2_3b,
         "llama3-8b": llama.LlamaConfig.llama3_8b,
         "llama3-70b": llama.LlamaConfig.llama3_70b,
+        "tiny-moe": moe.MoeConfig.tiny_moe,
+        "mixtral-8x7b": moe.MoeConfig.mixtral_8x7b,
     }
     if name in registry:
         return registry[name]()

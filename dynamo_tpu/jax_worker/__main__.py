@@ -32,6 +32,8 @@ def parse_args():
     ap.add_argument("--max-num-seqs", type=int, default=64)
     ap.add_argument("--max-model-len", type=int, default=8192)
     ap.add_argument("--tp-size", type=int, default=1)
+    ap.add_argument("--ep-size", type=int, default=1,
+                    help="expert-parallel axis size (MoE models)")
     ap.add_argument("--kv-events", action="store_true")
     # KVBM tiers (kvbm/): host-RAM + disk KV block offload
     ap.add_argument("--kvbm-host-blocks", type=int, default=0)
@@ -68,10 +70,11 @@ async def main():
     kv_sharding = None
     params = None
     model_config = None
-    if args.tp_size > 1 or args.model_path:
-        from dynamo_tpu.models import llama
+    if args.tp_size > 1 or args.ep_size > 1 or args.model_path:
+        from dynamo_tpu.models import llama, moe
         from dynamo_tpu.parallel.mesh import (
             LlamaShardings,
+            MoeShardings,
             ParallelConfig,
             build_mesh,
             shard_params,
@@ -81,21 +84,26 @@ async def main():
         from dynamo_tpu.engine.engine import _resolve_model
 
         model_config = _resolve_model(args.model)
+        is_moe = isinstance(model_config, moe.MoeConfig)
+        model_mod = moe if is_moe else llama
         shardings = None
-        if args.tp_size > 1:
-            mesh = build_mesh(ParallelConfig(tp_size=args.tp_size))
-            shardings = LlamaShardings(mesh)
+        if args.tp_size > 1 or args.ep_size > 1:
+            mesh = build_mesh(
+                ParallelConfig(tp_size=args.tp_size, ep_size=args.ep_size)
+            )
+            shardings = MoeShardings(mesh) if is_moe else LlamaShardings(mesh)
             kv_sharding = shardings.kv_sharding()
         if args.model_path:
-            from dynamo_tpu.models.loader import load_llama_params
+            from dynamo_tpu.models.loader import load_llama_params, load_moe_params
 
-            params = load_llama_params(
+            load = load_moe_params if is_moe else load_llama_params
+            params = load(
                 args.model_path,
                 model_config,
                 shardings.param_shardings() if shardings else None,
             )
         else:
-            params = llama.init_params(
+            params = model_mod.init_params(
                 model_config, jax.random.PRNGKey(engine_cfg.seed)
             )
             params = shard_params(params, shardings)

@@ -1,0 +1,201 @@
+"""GGUF file parsing: header, metadata KVs, embedded tokenizer.
+
+Role of the reference's gguf module (lib/llm/src/gguf/{content,
+gguf_metadata,gguf_tokenizer}.rs): read enough of a .gguf checkpoint to
+build a ModelDeploymentCard — architecture, context length, block/head
+dims, and the embedded tokenizer vocabulary — without loading tensor data.
+Spec: https://github.com/ggml-org/ggml/blob/master/docs/gguf.md
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, BinaryIO, Dict, List, Optional
+
+GGUF_MAGIC = b"GGUF"
+
+# metadata value type ids (gguf spec)
+T_U8, T_I8, T_U16, T_I16, T_U32, T_I32, T_F32, T_BOOL = range(8)
+T_STRING, T_ARRAY, T_U64, T_I64, T_F64 = 8, 9, 10, 11, 12
+
+_SCALARS = {
+    T_U8: ("<B", 1), T_I8: ("<b", 1), T_U16: ("<H", 2), T_I16: ("<h", 2),
+    T_U32: ("<I", 4), T_I32: ("<i", 4), T_F32: ("<f", 4), T_BOOL: ("<?", 1),
+    T_U64: ("<Q", 8), T_I64: ("<q", 8), T_F64: ("<d", 8),
+}
+
+
+def _read_scalar(f: BinaryIO, vtype: int):
+    fmt, size = _SCALARS[vtype]
+    return struct.unpack(fmt, f.read(size))[0]
+
+
+def _read_string(f: BinaryIO) -> str:
+    (n,) = struct.unpack("<Q", f.read(8))
+    return f.read(n).decode("utf-8", errors="replace")
+
+
+def _read_value(f: BinaryIO, vtype: int):
+    if vtype in _SCALARS:
+        return _read_scalar(f, vtype)
+    if vtype == T_STRING:
+        return _read_string(f)
+    if vtype == T_ARRAY:
+        (elem_type,) = struct.unpack("<I", f.read(4))
+        (count,) = struct.unpack("<Q", f.read(8))
+        return [_read_value(f, elem_type) for _ in range(count)]
+    raise ValueError(f"unknown gguf metadata type {vtype}")
+
+
+@dataclass
+class GgufContent:
+    version: int
+    tensor_count: int
+    metadata: Dict[str, Any] = field(default_factory=dict)
+
+    # -- typed accessors over the conventional keys ------------------------
+    @property
+    def architecture(self) -> Optional[str]:
+        return self.metadata.get("general.architecture")
+
+    @property
+    def name(self) -> Optional[str]:
+        return self.metadata.get("general.name")
+
+    def arch_key(self, suffix: str):
+        arch = self.architecture
+        return self.metadata.get(f"{arch}.{suffix}") if arch else None
+
+    @property
+    def context_length(self) -> Optional[int]:
+        return self.arch_key("context_length")
+
+    @property
+    def num_layers(self) -> Optional[int]:
+        return self.arch_key("block_count")
+
+    @property
+    def num_heads(self) -> Optional[int]:
+        return self.arch_key("attention.head_count")
+
+    @property
+    def num_kv_heads(self) -> Optional[int]:
+        return self.arch_key("attention.head_count_kv") or self.num_heads
+
+    @property
+    def hidden_size(self) -> Optional[int]:
+        return self.arch_key("embedding_length")
+
+    # -- embedded tokenizer (gguf_tokenizer.rs role) -----------------------
+    @property
+    def tokenizer_model(self) -> Optional[str]:
+        return self.metadata.get("tokenizer.ggml.model")
+
+    @property
+    def tokens(self) -> Optional[List[str]]:
+        return self.metadata.get("tokenizer.ggml.tokens")
+
+    @property
+    def bos_token_id(self) -> Optional[int]:
+        return self.metadata.get("tokenizer.ggml.bos_token_id")
+
+    @property
+    def eos_token_id(self) -> Optional[int]:
+        return self.metadata.get("tokenizer.ggml.eos_token_id")
+
+    @property
+    def chat_template(self) -> Optional[str]:
+        return self.metadata.get("tokenizer.chat_template")
+
+
+def read_gguf(path) -> GgufContent:
+    """Parse header + metadata (tensor infos and data are skipped)."""
+    with open(path, "rb") as f:
+        if f.read(4) != GGUF_MAGIC:
+            raise ValueError(f"{path}: not a GGUF file")
+        (version,) = struct.unpack("<I", f.read(4))
+        if version < 2:
+            raise ValueError(f"{path}: gguf v{version} unsupported (need >= 2)")
+        (tensor_count,) = struct.unpack("<Q", f.read(8))
+        (kv_count,) = struct.unpack("<Q", f.read(8))
+        meta: Dict[str, Any] = {}
+        for _ in range(kv_count):
+            key = _read_string(f)
+            (vtype,) = struct.unpack("<I", f.read(4))
+            meta[key] = _read_value(f, vtype)
+    return GgufContent(version=version, tensor_count=tensor_count, metadata=meta)
+
+
+def mdc_from_gguf(path, kv_cache_block_size: int = 64):
+    """Build a ModelDeploymentCard from a .gguf file (reference
+    LocalModelBuilder's GGUF path, local_model.rs:44)."""
+    from .model_card import ModelDeploymentCard
+
+    g = read_gguf(path)
+    name = g.name or Path(path).stem
+    card = ModelDeploymentCard(
+        name=name,
+        tokenizer=f"gguf:{path}",
+        context_length=g.context_length or 8192,
+        kv_cache_block_size=kv_cache_block_size,
+        chat_template=g.chat_template,
+    )
+    card.runtime_config["gguf"] = {
+        "architecture": g.architecture,
+        "num_layers": g.num_layers,
+        "num_heads": g.num_heads,
+        "num_kv_heads": g.num_kv_heads,
+        "hidden_size": g.hidden_size,
+        "tokenizer_model": g.tokenizer_model,
+        "bos_token_id": g.bos_token_id,
+        "eos_token_id": g.eos_token_id,
+    }
+    return card
+
+
+def write_gguf(path, metadata: Dict[str, Any], tensor_count: int = 0) -> None:
+    """Minimal GGUF writer (metadata only) — testing and interchange."""
+
+    def w_string(f, s: str):
+        b = s.encode()
+        f.write(struct.pack("<Q", len(b)))
+        f.write(b)
+
+    def w_value(f, v):
+        if isinstance(v, bool):
+            f.write(struct.pack("<I", T_BOOL))
+            f.write(struct.pack("<?", v))
+        elif isinstance(v, int):
+            f.write(struct.pack("<I", T_I64))
+            f.write(struct.pack("<q", v))
+        elif isinstance(v, float):
+            f.write(struct.pack("<I", T_F64))
+            f.write(struct.pack("<d", v))
+        elif isinstance(v, str):
+            f.write(struct.pack("<I", T_STRING))
+            w_string(f, v)
+        elif isinstance(v, list):
+            f.write(struct.pack("<I", T_ARRAY))
+            if v and isinstance(v[0], str):
+                f.write(struct.pack("<I", T_STRING))
+                f.write(struct.pack("<Q", len(v)))
+                for s in v:
+                    w_string(f, s)
+            else:
+                f.write(struct.pack("<I", T_I64))
+                f.write(struct.pack("<Q", len(v)))
+                for x in v:
+                    f.write(struct.pack("<q", x))
+        else:
+            raise TypeError(f"unsupported gguf value {type(v)}")
+
+    with open(path, "wb") as f:
+        f.write(GGUF_MAGIC)
+        f.write(struct.pack("<I", 3))
+        f.write(struct.pack("<Q", tensor_count))
+        f.write(struct.pack("<Q", len(metadata)))
+        for k, v in metadata.items():
+            w_string(f, k)
+            w_value(f, v)

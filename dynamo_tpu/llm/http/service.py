@@ -44,6 +44,21 @@ from .metrics import HttpMetrics
 logger = logging.getLogger(__name__)
 
 
+def _sse_event(event: str, data: dict) -> bytes:
+    """Named SSE event frame (Responses API framing)."""
+    return f"event: {event}\ndata: {json.dumps(data)}\n\n".encode()
+
+
+def _content_text(message: dict) -> str:
+    """Flatten a Responses-API message's content (string or typed parts)."""
+    content = message.get("content", "")
+    if isinstance(content, str):
+        return content
+    return "".join(
+        p.get("text", "") for p in content if isinstance(p, dict)
+    )
+
+
 def _sse(data: str) -> bytes:
     return f"data: {data}\n\n".encode()
 
@@ -70,6 +85,7 @@ class HttpService:
         self.app.router.add_post("/v1/chat/completions", self.chat_completions)
         self.app.router.add_post("/v1/completions", self.completions)
         self.app.router.add_post("/v1/embeddings", self.embeddings)
+        self.app.router.add_post("/v1/responses", self.responses)
         self.app.router.add_get("/v1/models", self.list_models)
         self.app.router.add_get("/health", self.health)
         self.app.router.add_get("/live", self.live)
@@ -188,6 +204,157 @@ class HttpService:
             ),
         )
         return web.json_response(resp.model_dump(exclude_none=True))
+
+    async def responses(self, request: web.Request) -> web.StreamResponse:
+        """/v1/responses (reference service_v2.rs:319-339 responses route,
+        async-openai Responses types): `input` (string or message list) runs
+        through the chat pipeline; unary returns a `response` object, stream
+        emits response.created / response.output_text.delta /
+        response.completed SSE events."""
+        import secrets as _secrets
+
+        t0 = time.monotonic()
+        try:
+            body = await request.json()
+            model = body["model"]
+            raw_input = body.get("input", "")
+            stream_mode = bool(body.get("stream", False))
+            max_tokens = body.get("max_output_tokens") or body.get("max_tokens")
+        except Exception as e:  # noqa: BLE001
+            return self._error(400, f"invalid request: {e}")
+        pipeline = self.manager.get(model)
+        if pipeline is None:
+            return self._error(404, f"model {model!r} not found", "model_not_found")
+
+        try:
+            if isinstance(raw_input, str):
+                messages = [{"role": "user", "content": raw_input}]
+            elif isinstance(raw_input, list):
+                messages = [
+                    {"role": m.get("role", "user"), "content": _content_text(m)}
+                    if isinstance(m, dict)
+                    else {"role": "user", "content": str(m)}
+                    for m in raw_input
+                ]
+            else:
+                raise ValueError(f"input must be a string or list, got {type(raw_input).__name__}")
+            if body.get("instructions"):
+                messages.insert(0, {"role": "system", "content": body["instructions"]})
+            chat_req = ChatCompletionRequest(
+                model=model, messages=messages, max_tokens=max_tokens,
+                temperature=body.get("temperature"), top_p=body.get("top_p"),
+            )
+        except Exception as e:  # noqa: BLE001 — malformed request, not a 500
+            return self._error(400, f"invalid request: {e}")
+        self.metrics.request_start(model, "responses")
+        ctx = Context()
+        try:
+            pre = pipeline.preprocessor.preprocess_chat(chat_req)
+        except ValueError as e:
+            self.metrics.request_end(model, "responses", t0, error=True)
+            return self._error(400, str(e))
+        resp_id = f"resp_{_secrets.token_hex(12)}"
+        engine_stream = pipeline.generate_preprocessed(pre, ctx)
+        # same structured-output jail as the chat path (reasoning models must
+        # not leak thinking tags into output_text)
+        reasoning_parser = pipeline.card.runtime_config.get("reasoning_parser")
+        if reasoning_parser:
+            engine_stream = JailedStream(
+                engine_stream, reasoning_parser=reasoning_parser
+            ).__aiter__()
+
+        texts: list[str] = []
+        n_out = 0
+        error_msg = None
+        first_token_at = None
+        last_token_at = None
+
+        def response_obj(status: str) -> dict:
+            return {
+                "id": resp_id,
+                "object": "response",
+                "created_at": int(time.time()),
+                "status": status,
+                "model": model,
+                "output": [
+                    {
+                        "type": "message",
+                        "id": f"msg_{resp_id[5:]}",
+                        "role": "assistant",
+                        "status": status,
+                        "content": [
+                            {"type": "output_text", "text": "".join(texts),
+                             "annotations": []}
+                        ],
+                    }
+                ],
+                "usage": {
+                    "input_tokens": len(pre.token_ids),
+                    "output_tokens": n_out,
+                    "total_tokens": len(pre.token_ids) + n_out,
+                },
+            }
+
+        sse_resp: Optional[web.StreamResponse] = None
+        try:
+            if stream_mode:
+                sse_resp = web.StreamResponse(
+                    status=200, headers={"Content-Type": "text/event-stream"}
+                )
+                await sse_resp.prepare(request)
+                await sse_resp.write(
+                    _sse_event("response.created",
+                               {"type": "response.created",
+                                "response": response_obj("in_progress")})
+                )
+            async for ann in engine_stream:
+                if ann.is_error():
+                    error_msg = (ann.comment or ["engine error"])[0]
+                    break
+                if ann.event is not None or ann.data is None:
+                    continue
+                out: LLMEngineOutput = ann.data
+                if out.token_ids:
+                    last_token_at = time.monotonic()
+                    if first_token_at is None:
+                        first_token_at = last_token_at
+                        self.metrics.observe_ttft(model, first_token_at - t0)
+                n_out += len(out.token_ids)
+                if out.text:
+                    texts.append(out.text)
+                    if sse_resp is not None:
+                        await sse_resp.write(
+                            _sse_event(
+                                "response.output_text.delta",
+                                {"type": "response.output_text.delta",
+                                 "item_id": f"msg_{resp_id[5:]}",
+                                 "output_index": 0, "content_index": 0,
+                                 "delta": out.text},
+                            )
+                        )
+                if out.finish_reason:
+                    break
+        except (ConnectionResetError, asyncio.CancelledError):
+            ctx.kill()
+            self.metrics.client_disconnect(model)
+            raise
+        finally:
+            ctx.stop_generating()
+            self.metrics.request_end(
+                model, "responses", t0, error=bool(error_msg),
+                output_tokens=n_out, input_tokens=len(pre.token_ids),
+                first_token_at=first_token_at, last_token_at=last_token_at,
+            )
+        if sse_resp is not None:
+            ev = "response.failed" if error_msg else "response.completed"
+            final = response_obj("failed" if error_msg else "completed")
+            if error_msg:
+                final["error"] = {"message": error_msg}
+            await sse_resp.write(_sse_event(ev, {"type": ev, "response": final}))
+            return sse_resp
+        if error_msg:
+            return self._error(500, error_msg, "engine_error")
+        return web.json_response(response_obj("completed"))
 
     async def list_models(self, request: web.Request) -> web.Response:
         models = ModelList(data=[ModelInfo(id=name) for name in self.manager.names()])

@@ -158,13 +158,83 @@ class HfTokenizer:
         return None
 
 
+class GgufTokenizer:
+    """Tokenizer over a GGUF file's embedded vocabulary (reference
+    gguf_tokenizer.rs): greedy longest-match over the token list, with the
+    llama.cpp `▁`-for-space convention. Enough for serving a .gguf model
+    card end-to-end without external tokenizer files."""
+
+    SPACE = "▁"  # '▁'
+
+    def __init__(self, gguf_path: str):
+        from .gguf import read_gguf
+
+        g = read_gguf(gguf_path)
+        tokens = g.tokens
+        if not tokens:
+            raise ValueError(f"{gguf_path}: no embedded tokenizer vocabulary")
+        self._tokens = tokens
+        self._ids = {t: i for i, t in enumerate(tokens)}
+        self._max_len = max(len(t) for t in tokens)
+        self._eos = [g.eos_token_id] if g.eos_token_id is not None else []
+        self._bos = g.bos_token_id
+        self._unk = 0 if tokens and tokens[0].startswith("<") else None
+
+    def encode(self, text: str) -> List[int]:
+        s = text.replace(" ", self.SPACE)
+        out: List[int] = []
+        i = 0
+        while i < len(s):
+            match = None
+            for n in range(min(self._max_len, len(s) - i), 0, -1):
+                tid = self._ids.get(s[i : i + n])
+                if tid is not None:
+                    match = (tid, n)
+                    break
+            if match is None:
+                if self._unk is not None:
+                    out.append(self._unk)
+                i += 1
+            else:
+                out.append(match[0])
+                i += match[1]
+        return out
+
+    def decode(self, ids: Sequence[int], skip_special_tokens: bool = True) -> str:
+        parts = []
+        for i in ids:
+            if 0 <= i < len(self._tokens):
+                t = self._tokens[i]
+                if skip_special_tokens and t.startswith("<") and t.endswith(">"):
+                    continue
+                parts.append(t)
+        return "".join(parts).replace(self.SPACE, " ")
+
+    def decode_stream(self, skip_special_tokens: bool = True) -> DecodeStream:
+        return DecodeStream(self, skip_special_tokens)
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self._tokens)
+
+    @property
+    def eos_token_ids(self) -> List[int]:
+        return list(self._eos)
+
+    @property
+    def bos_token_id(self) -> Optional[int]:
+        return self._bos
+
+
 def load_tokenizer(spec: str) -> Tokenizer:
-    """Resolve a tokenizer spec: 'byte' | 'byte:<vocab>' | path to
-    tokenizer.json | model folder containing one."""
+    """Resolve a tokenizer spec: 'byte' | 'byte:<vocab>' | 'gguf:<path>'
+    (embedded vocab) | path to tokenizer.json | model folder."""
     if spec == "byte":
         return ByteTokenizer()
     if spec.startswith("byte:"):
         return ByteTokenizer(int(spec.split(":", 1)[1]))
+    if spec.startswith("gguf:"):
+        return GgufTokenizer(spec.split(":", 1)[1])
     p = Path(spec)
     if p.is_dir():
         p = p / "tokenizer.json"

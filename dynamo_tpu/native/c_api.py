@@ -61,6 +61,12 @@ class NativeKvEventQueue:
             self._lib.dyn_llm_shutdown(self._h)
             self._h = None
 
+    def _handle(self):
+        if not self._h:
+            # a NULL handle into the C ABI is a segfault, not an exception
+            raise RuntimeError("NativeKvEventQueue used after close()")
+        return self._h
+
     # -- publish (normally called from native threads; exposed for tests) --
     def _hashes_ptr(self, hashes: List[int]):
         arr = np.asarray(hashes, dtype=np.uint64)
@@ -68,23 +74,24 @@ class NativeKvEventQueue:
 
     def publish_stored(self, worker_id: int, block_hashes: List[int]) -> None:
         arr, ptr = self._hashes_ptr(block_hashes)
-        self._lib.dyn_kv_publish_stored(self._h, worker_id, ptr, len(arr))
+        self._lib.dyn_kv_publish_stored(self._handle(), worker_id, ptr, len(arr))
 
     def publish_removed(self, worker_id: int, block_hashes: List[int]) -> None:
         arr, ptr = self._hashes_ptr(block_hashes)
-        self._lib.dyn_kv_publish_removed(self._h, worker_id, ptr, len(arr))
+        self._lib.dyn_kv_publish_removed(self._handle(), worker_id, ptr, len(arr))
 
     def publish_cleared(self, worker_id: int) -> None:
-        self._lib.dyn_kv_publish_cleared(self._h, worker_id)
+        self._lib.dyn_kv_publish_cleared(self._handle(), worker_id)
 
     # -- drain --------------------------------------------------------------
     def pop(self) -> Optional[dict]:
+        h = self._handle()
         worker = ctypes.c_int64(0)
         etype = ctypes.c_int32(0)
         need = ctypes.c_uint64(0)
         while True:
             n = self._lib.dyn_kv_event_pop(
-                self._h, ctypes.byref(worker), ctypes.byref(etype),
+                h, ctypes.byref(worker), ctypes.byref(etype),
                 self._buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
                 len(self._buf), ctypes.byref(need),
             )
@@ -110,19 +117,40 @@ class NativeKvEventQueue:
 
     @property
     def pending(self) -> int:
-        return int(self._lib.dyn_kv_events_pending(self._h))
+        return int(self._lib.dyn_kv_events_pending(self._handle()))
 
     @property
     def dropped(self) -> int:
-        return int(self._lib.dyn_kv_events_dropped(self._h))
+        return int(self._lib.dyn_kv_events_dropped(self._handle()))
 
-    async def pump(self, publisher, interval: float = 0.05) -> None:
-        """Forward drained events into a KvEventPublisher until cancelled."""
+    async def pump(self, publishers, interval: float = 0.05) -> None:
+        """Forward drained events into KvEventPublishers until cancelled.
+        `publishers` is a single KvEventPublisher (only its own worker's
+        events are forwarded — events the indexer would mis-attribute to
+        the wrong worker are dropped with a warning) or a dict
+        {worker_id: KvEventPublisher}."""
+        import logging
+
         from ..llm.mocker.kv_manager import KvEvent
 
+        log = logging.getLogger(__name__)
+        by_worker = publishers if isinstance(publishers, dict) else None
+        single = None if by_worker is not None else publishers
         while True:
             for ev in self.drain():
-                publisher.publish(
+                if by_worker is not None:
+                    pub = by_worker.get(ev["worker_id"])
+                elif single is not None and ev["worker_id"] == single.worker_id:
+                    pub = single
+                else:
+                    pub = None
+                if pub is None:
+                    log.warning(
+                        "dropping native KV event for unknown worker %d",
+                        ev["worker_id"],
+                    )
+                    continue
+                pub.publish(
                     KvEvent(
                         event_type=ev["event_type"],
                         block_hashes=ev["block_hashes"],

@@ -1,0 +1,96 @@
+"""GGUF metadata parsing + MDC construction (reference lib/llm/src/gguf/)."""
+
+import pytest
+
+from dynamo_tpu.llm.gguf import mdc_from_gguf, read_gguf, write_gguf
+
+
+@pytest.fixture()
+def tiny_gguf(tmp_path):
+    path = tmp_path / "tiny-llama.gguf"
+    write_gguf(
+        path,
+        {
+            "general.architecture": "llama",
+            "general.name": "tiny-llama-test",
+            "llama.context_length": 2048,
+            "llama.block_count": 2,
+            "llama.attention.head_count": 4,
+            "llama.attention.head_count_kv": 2,
+            "llama.embedding_length": 64,
+            "tokenizer.ggml.model": "llama",
+            "tokenizer.ggml.tokens": ["<unk>", "<s>", "</s>", "hello", "world"],
+            "tokenizer.ggml.bos_token_id": 1,
+            "tokenizer.ggml.eos_token_id": 2,
+            "tokenizer.chat_template": "{{ messages }}",
+            "general.quantized": True,
+            "general.some_float": 1.5,
+        },
+        tensor_count=7,
+    )
+    return path
+
+
+def test_read_metadata(tiny_gguf):
+    g = read_gguf(tiny_gguf)
+    assert g.version == 3
+    assert g.tensor_count == 7
+    assert g.architecture == "llama"
+    assert g.name == "tiny-llama-test"
+    assert g.context_length == 2048
+    assert g.num_layers == 2
+    assert g.num_heads == 4
+    assert g.num_kv_heads == 2
+    assert g.hidden_size == 64
+    assert g.tokenizer_model == "llama"
+    assert g.tokens == ["<unk>", "<s>", "</s>", "hello", "world"]
+    assert g.bos_token_id == 1 and g.eos_token_id == 2
+    assert g.metadata["general.quantized"] is True
+    assert g.metadata["general.some_float"] == 1.5
+
+
+def test_kv_heads_defaults_to_heads(tmp_path):
+    path = tmp_path / "mha.gguf"
+    write_gguf(path, {"general.architecture": "llama",
+                      "llama.attention.head_count": 8})
+    assert read_gguf(path).num_kv_heads == 8
+
+
+def test_mdc_from_gguf(tiny_gguf):
+    card = mdc_from_gguf(tiny_gguf)
+    assert card.name == "tiny-llama-test"
+    assert card.context_length == 2048
+    assert card.chat_template == "{{ messages }}"
+    assert card.tokenizer == f"gguf:{tiny_gguf}"
+    g = card.runtime_config["gguf"]
+    assert g["architecture"] == "llama"
+    assert g["eos_token_id"] == 2
+
+
+def test_not_gguf_raises(tmp_path):
+    p = tmp_path / "bogus.gguf"
+    p.write_bytes(b"NOPE" + b"\x00" * 64)
+    with pytest.raises(ValueError, match="not a GGUF"):
+        read_gguf(p)
+
+
+def test_gguf_tokenizer_roundtrip(tiny_gguf):
+    from dynamo_tpu.llm.tokenizers import load_tokenizer
+
+    tok = load_tokenizer(f"gguf:{tiny_gguf}")
+    ids = tok.encode("hello world")
+    assert ids  # vocab has "hello"/"world" (space becomes the ▁ marker)
+    text = tok.decode(ids)
+    assert "hello" in text and "world" in text
+    assert tok.eos_token_ids == [2]
+    assert tok.vocab_size == 5
+
+
+def test_gguf_card_builds_pipeline_tokenizer(tiny_gguf):
+    """An MDC from a .gguf must resolve end-to-end through load_tokenizer."""
+    from dynamo_tpu.llm.gguf import mdc_from_gguf
+    from dynamo_tpu.llm.tokenizers import load_tokenizer
+
+    card = mdc_from_gguf(tiny_gguf)
+    tok = load_tokenizer(card.tokenizer)
+    assert tok.vocab_size == 5

@@ -239,3 +239,75 @@ def test_embeddings_base64_rejected(cluster):
             json={"model": "mock-model", "input": "x", "encoding_format": "base64"},
         )
         assert r.status_code == 400
+
+
+def test_responses_unary(cluster):
+    base, _ = cluster
+    with httpx.Client(timeout=30) as client:
+        r = client.post(
+            f"{base}/v1/responses",
+            json={"model": "mock-model", "input": "say hi", "max_output_tokens": 8},
+        )
+        assert r.status_code == 200, r.text
+        body = r.json()
+        assert body["object"] == "response"
+        assert body["status"] == "completed"
+        msg = body["output"][0]
+        assert msg["role"] == "assistant"
+        assert msg["content"][0]["type"] == "output_text"
+        assert msg["content"][0]["text"]
+        assert body["usage"]["output_tokens"] > 0
+
+
+def test_responses_streaming(cluster):
+    base, _ = cluster
+    with httpx.Client(timeout=30) as client:
+        with client.stream(
+            "POST",
+            f"{base}/v1/responses",
+            json={
+                "model": "mock-model",
+                "input": [{"role": "user", "content": "hello"}],
+                "stream": True,
+                "max_output_tokens": 8,
+            },
+        ) as r:
+            assert r.status_code == 200
+            events = []
+            for line in r.iter_lines():
+                if line.startswith("event: "):
+                    events.append(line[7:])
+        assert events[0] == "response.created"
+        assert "response.output_text.delta" in events
+        assert events[-1] == "response.completed"
+
+
+def _post_retrying_404(client, url, payload):
+    """Under 1-core CPU contention the worker lease can briefly lapse and the
+    model de-registers until the keepalive re-grants it (by design); retry
+    through that window."""
+    for _ in range(40):
+        r = client.post(url, json=payload)
+        if r.status_code != 404:
+            return r
+        time.sleep(0.25)
+    return r
+
+
+def test_responses_bad_input_is_400(cluster):
+    base, _ = cluster
+    with httpx.Client(timeout=30) as client:
+        r = _post_retrying_404(
+            client, f"{base}/v1/responses",
+            {"model": "mock-model", "input": ["hello"]},  # raw strings coerced
+        )
+        assert r.status_code == 200, r.text
+        r = _post_retrying_404(
+            client, f"{base}/v1/responses", {"model": "mock-model", "input": 123}
+        )
+        assert r.status_code == 400
+        r = _post_retrying_404(
+            client, f"{base}/v1/responses",
+            {"model": "mock-model", "input": "x", "temperature": "hot"},
+        )
+        assert r.status_code == 400
