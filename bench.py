@@ -12,6 +12,17 @@ profiler example decode rate of 51.22 tok/s/GPU at TP4 on H100-class
 worker. We report batched decode tok/s on ONE v5e chip divided by that
 per-GPU figure so the ratio reads "v5e-chip decode throughput vs H100-GPU
 decode throughput on the reference's own example".
+
+Shapes follow the engine's production dispatch units (engine/engine.py):
+  * prefill: ONE batched [B, isl] dispatch (all sequences together) with
+    on-device first-token sampling; TTFT = a single-sequence dispatch plus
+    the one host read that delivers the token.
+  * decode: K-step fused blocks (lax.scan, sampling feeds the next step on
+    device) — one host read per K*B tokens.
+
+With --e2e the benchmark instead drives the FULL serving stack (HTTP
+frontend + preprocessor + router + JAX worker) with a ShareGPT-style
+trace at fixed QPS; see bench_e2e.py.
 """
 
 import argparse
@@ -29,8 +40,15 @@ def main():
     ap.add_argument("--batch", type=int, default=32)
     ap.add_argument("--isl", type=int, default=128, help="input seq len")
     ap.add_argument("--osl", type=int, default=128, help="output seq len")
+    ap.add_argument("--block", type=int, default=16, help="fused decode steps per dispatch")
     ap.add_argument("--steps", type=int, default=None, help="decode steps to time")
-    args = ap.parse_args()
+    ap.add_argument("--e2e", action="store_true", help="serve a trace through the full stack")
+    args, extra = ap.parse_known_args()
+
+    if args.e2e:
+        from bench_e2e import main as e2e_main
+
+        return e2e_main(extra + (["--smoke"] if args.smoke else []))
 
     if args.smoke:
         import os
@@ -52,10 +70,12 @@ def main():
     import jax.numpy as jnp
     import numpy as np
 
+    from dynamo_tpu.engine.engine import _enable_compile_cache
     from dynamo_tpu.engine.kv_cache import alloc_kv_arrays
     from dynamo_tpu.engine.sampling import SamplingParams, sample
     from dynamo_tpu.models import llama
 
+    _enable_compile_cache()
     model = args.model or ("tiny" if args.smoke else "llama3-3b")
     cfgs = {
         "tiny": llama.LlamaConfig.tiny,
@@ -66,11 +86,16 @@ def main():
 
     B = args.batch
     PAGE = 64
-    max_len = args.isl + args.osl
+    K = args.block
+    max_len = args.isl + args.osl + K  # fused blocks may overshoot by < K
     pages_per_seq = (max_len + PAGE - 1) // PAGE
     num_pages = B * pages_per_seq + 1
     dev = jax.devices()[0]
-    print(f"# bench: model={model} device={dev.platform} B={B} isl={args.isl} osl={args.osl}", file=sys.stderr)
+    print(
+        f"# bench: model={model} device={dev.platform} B={B} isl={args.isl} "
+        f"osl={args.osl} block={K}",
+        file=sys.stderr,
+    )
 
     params = llama.init_params(cfg, jax.random.PRNGKey(0))
     kv_k, kv_v = alloc_kv_arrays(
@@ -84,79 +109,109 @@ def main():
     pt = pt % num_pages
     page_tables = jnp.asarray(pt)
 
-    # ---- prefill all slots (measures TTFT-ish per-seq prefill rate) ----
-    from dynamo_tpu.models.llama import prefill_forward
-
-    prefill = jax.jit(
-        lambda p, kk, kv, t, pos, tab, cl, li: prefill_forward(
-            p, cfg, t, pos, kk, kv, tab, cl, li
-        ),
-        donate_argnums=(1, 2),
-    )
     # NOTE on timing: under the axon tunnel, block_until_ready() returns
     # before execution finishes — only a host value fetch actually syncs.
     # We therefore fetch a tiny scalar to fence each timed region.
     def fence(x):
         np.asarray(jax.device_get(x.ravel()[0]))
 
+    # ---- batched prefill (one dispatch for the whole batch) ----
+    def _prefill(params, kk, kv, toks, pos, tabs, cls, lis, samp, key):
+        logits, kk, kv = llama.prefill_forward_batched(
+            params, cfg, toks, pos, kk, kv, tabs, cls, lis
+        )
+        return sample(logits, samp, key), kk, kv
+
+    prefill = jax.jit(_prefill, donate_argnums=(1, 2))
+
     rng = np.random.RandomState(0)
-    # compile prefill before timing (first call pays ~20-40s of XLA compile)
-    _toks = jnp.zeros((args.isl,), jnp.int32)
-    _pos = jnp.arange(args.isl, dtype=jnp.int32)
-    logits, kv_k, kv_v = prefill(
-        params, kv_k, kv_v, _toks, _pos, page_tables[0], jnp.asarray(0, jnp.int32),
-        jnp.asarray(args.isl - 1, jnp.int32),
-    )
-    fence(logits)
-    t_prefill0 = time.perf_counter()
-    for b in range(B):
-        toks = jnp.asarray(rng.randint(3, cfg.vocab_size - 1, size=args.isl), jnp.int32)
-        pos = jnp.arange(args.isl, dtype=jnp.int32)
-        logits, kv_k, kv_v = prefill(
-            params, kv_k, kv_v, toks, pos, page_tables[b], jnp.asarray(0, jnp.int32),
-            jnp.asarray(args.isl - 1, jnp.int32),
-        )
-        if b == 0:
-            fence(logits)
-            t_first = time.perf_counter() - t_prefill0
-    fence(logits)
-    t_prefill = time.perf_counter() - t_prefill0
-
-    # ---- decode loop ----
-    def _decode(params, kv_k, kv_v, tokens, positions, page_tables, seq_lens, samp, key):
-        lg, kv_k, kv_v = llama.decode_forward(
-            params, cfg, tokens, positions, kv_k, kv_v, page_tables, seq_lens
-        )
-        return sample(lg, samp, key), kv_k, kv_v
-
-    decode_step = jax.jit(_decode, donate_argnums=(1, 2))
-
-    tokens = jnp.zeros((B,), jnp.int32)
-    positions = jnp.full((B,), args.isl, jnp.int32)
-    seq_lens = jnp.full((B,), args.isl + 1, jnp.int32)
+    all_toks = rng.randint(3, cfg.vocab_size - 1, size=(B, args.isl)).astype(np.int32)
+    all_pos = np.tile(np.arange(args.isl, dtype=np.int32), (B, 1))
+    ctx0 = jnp.zeros((B,), jnp.int32)
+    last = jnp.full((B,), args.isl - 1, jnp.int32)
     samp = SamplingParams.full(B, temperature=0.0)
+    samp1 = SamplingParams.full(1, temperature=0.0)
     key = jax.random.PRNGKey(7)
 
-    # warmup/compile
-    tokens, kv_k, kv_v = decode_step(
-        params, kv_k, kv_v, tokens, positions, page_tables, seq_lens, samp, key
+    # compile both variants before timing (first call pays XLA compile)
+    first1, kv_k, kv_v = prefill(
+        params, kv_k, kv_v, jnp.asarray(all_toks[:1]), jnp.asarray(all_pos[:1]),
+        page_tables[:1], ctx0[:1], last[:1], samp1, key,
     )
-    fence(tokens)
+    fence(first1)
+    firstB, kv_k, kv_v = prefill(
+        params, kv_k, kv_v, jnp.asarray(all_toks), jnp.asarray(all_pos),
+        page_tables, ctx0, last, samp, key,
+    )
+    fence(firstB)
+
+    # TTFT: one sequence arrives alone — dispatch + the host read of its token
+    t0 = time.perf_counter()
+    first1, kv_k, kv_v = prefill(
+        params, kv_k, kv_v, jnp.asarray(all_toks[:1]), jnp.asarray(all_pos[:1]),
+        page_tables[:1], ctx0[:1], last[:1], samp1, key,
+    )
+    tok0 = int(jax.device_get(first1)[0])
+    t_first = time.perf_counter() - t0
+
+    # prefill throughput: the full batch in one dispatch
+    t0 = time.perf_counter()
+    firstB, kv_k, kv_v = prefill(
+        params, kv_k, kv_v, jnp.asarray(all_toks), jnp.asarray(all_pos),
+        page_tables, ctx0, last, samp, key,
+    )
+    fence(firstB)
+    t_prefill = time.perf_counter() - t0
+
+    # ---- fused K-step decode blocks ----
+    # the rng key is threaded THROUGH the jitted block (split on device,
+    # advanced key returned): an eager fold_in/split between dispatches is
+    # a hidden host round-trip (~9 ms/step through the axon tunnel)
+    def _decode_block(params, kv_k, kv_v, tokens, positions, seq_lens, page_tables, samp, key):
+        key, sub = jax.random.split(key)
+        keys = jax.random.split(sub, K)
+
+        def step(carry, k):
+            tokens, positions, seq_lens, kv_k, kv_v = carry
+            logits, kv_k, kv_v = llama.decode_forward(
+                params, cfg, tokens, positions, kv_k, kv_v, page_tables, seq_lens
+            )
+            nxt = sample(logits, samp, k)
+            return (nxt, positions + 1, seq_lens + 1, kv_k, kv_v), nxt
+
+        (tokens, positions, seq_lens, kv_k, kv_v), toks = jax.lax.scan(
+            step, (tokens, positions, seq_lens, kv_k, kv_v), keys
+        )
+        return toks, tokens, positions, seq_lens, kv_k, kv_v, key
+
+    decode_block = jax.jit(_decode_block, donate_argnums=(1, 2, 8))
+
+    tokens = firstB
+    positions = jnp.full((B,), args.isl, jnp.int32)
+    seq_lens = jnp.full((B,), args.isl + 1, jnp.int32)
+
+    # warmup/compile
+    toks, tokens, positions, seq_lens, kv_k, kv_v, key = decode_block(
+        params, kv_k, kv_v, tokens, positions, seq_lens, page_tables, samp, key
+    )
+    fence(toks)
 
     n_steps = args.steps or (args.osl - 1)
+    n_blocks = max(n_steps // K, 1)
     t0 = time.perf_counter()
-    for i in range(n_steps):
-        positions = positions + 1
-        seq_lens = seq_lens + 1
-        key = jax.random.fold_in(key, i)
-        tokens, kv_k, kv_v = decode_step(
-            params, kv_k, kv_v, tokens, positions, page_tables, seq_lens, samp, key
+    for i in range(n_blocks):
+        toks, tokens, positions, seq_lens, kv_k, kv_v, key = decode_block(
+            params, kv_k, kv_v, tokens, positions, seq_lens, page_tables, samp, key
         )
-    fence(tokens)
+        # production fetch cadence: one host read per block (overlaps the
+        # next block's compute in the engine; here serialized = lower bound)
+        last_toks = toks
+    fence(last_toks)
     dt = time.perf_counter() - t0
+    n_done = n_blocks * K
 
-    toks_per_sec = B * n_steps / dt
-    itl_ms = dt / n_steps * 1000
+    toks_per_sec = B * n_done / dt
+    itl_ms = dt / n_done * 1000
     print(
         f"# decode: {toks_per_sec:.1f} tok/s (ITL {itl_ms:.2f} ms @ batch {B}); "
         f"prefill: {B * args.isl / t_prefill:.0f} tok/s, first-seq TTFT {t_first*1000:.1f} ms",

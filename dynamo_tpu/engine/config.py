@@ -16,6 +16,12 @@ class EngineConfig:
     max_prefill_chunk: int = 1024  # chunked-prefill bucket cap
     prefill_buckets: tuple = (128, 256, 512, 1024)
     enable_prefix_caching: bool = True
+    # fused decode: K steps per dispatch (one host read per K*B tokens);
+    # speculated tokens past a stop condition are discarded (bounded waste)
+    decode_block_steps: int = 8
+    # batched prefill: token budget per dispatch; lanes = budget // bucket
+    prefill_batch_tokens: int = 1024
+    max_prefill_batch: int = 8
     # sampling defaults
     default_temperature: float = 0.0
     seed: int = 0

@@ -1,20 +1,30 @@
 """JaxEngine: the TPU-native inference engine.
 
 The role vLLM plays under the reference (SURVEY.md §7 step 4), built the XLA
-way: everything on the token hot path is a pre-compiled static-shape program.
+way: everything on the token hot path is a pre-compiled static-shape program,
+and the host loop is designed around the observation that a synchronous
+device round-trip costs ~10-100x an async dispatch (dispatches are cheap and
+pipelined; host reads are the expensive unit):
 
-  * decode: ONE jitted step for the whole slot batch [max_num_seqs] — paged
-    attention + on-device sampling; KV buffers donated so XLA updates in
-    place. Inactive slots write to a reserved scratch page and are masked.
-  * prefill: chunked + bucketed (compile once per bucket size); a chunk
-    attends to its own causal block plus already-written pages, enabling
-    prefix-cache hits and bounded step latency (the reference gets this from
-    vLLM's chunked prefill; here it is native).
+  * decode: ONE jitted BLOCK of K steps for the whole slot batch
+    [max_num_seqs] — paged attention + on-device sampling, the sampled token
+    feeding the next step inside `lax.scan`. KV buffers are donated so XLA
+    updates in place. Up to two blocks are kept in flight (the fetch of
+    block i overlaps block i+1's compute), so steady-state decode costs ONE
+    host read per K*B tokens.
+  * prefill: chunked + bucketed + BATCHED — chunks from several waiting
+    sequences are packed into one [B_pf, bucket] dispatch (compile variants
+    are bounded: B_pf = budget/bucket), with the first token sampled
+    on-device inside the same program. Chunks that do not complete a prompt
+    need no host read at all.
+  * all host reads of an iteration ride a single `jax.device_get` (one RTT).
   * prefix cache: PageAllocator keys pages by the SAME chained block hashes
     the KV router indexes (llm/tokens.py), and emits stored/removed events.
-  * host scheduler: admission by free pages + slots; continuous batching —
-    each loop iteration runs at most one prefill chunk, then one decode step
-    for all active slots.
+  * preemption: on page exhaustion the newest-admitted sequence is preempted
+    — its full blocks are committed (cheap resume via prefix cache), pages
+    released, and the request requeued; it resumes decoding from its pending
+    token without re-emitting (reference semantics: vLLM preempt/requeue,
+    lib/llm/src/mocker/scheduler.rs:240 watermark eviction).
 """
 
 from __future__ import annotations
@@ -22,6 +32,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Any, AsyncIterator, Callable, Dict, List, Optional
@@ -44,6 +55,28 @@ logger = logging.getLogger(__name__)
 SCRATCH_PAGE = 0  # physical page 0 is the dump target for masked lanes
 
 
+def _next_pow2(n: int) -> int:
+    return 1 << max(n - 1, 0).bit_length()
+
+
+def _enable_compile_cache():
+    """Persistent XLA compilation cache: the engine compiles one variant per
+    (prefill batch x bucket x table-length bucket) — cached across process
+    restarts so only the first-ever run pays the 20-40s Mosaic compiles."""
+    import os
+
+    path = os.environ.get("DYNAMO_TPU_COMPILE_CACHE", "~/.cache/dynamo_tpu_xla")
+    if not path or path.lower() == "off":
+        return
+    try:
+        path = os.path.expanduser(path)
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:  # noqa: BLE001 — cache is an optimization, never fatal
+        logger.warning("could not enable XLA compilation cache", exc_info=True)
+
+
 @dataclass
 class _Slot:
     """One decode slot (host bookkeeping)."""
@@ -58,13 +91,18 @@ class _Slot:
     ignore_eos: bool
     stop_token_ids: List[int]
     seq: TokenBlockSequence
+    kv_prompt: List[int] = field(default_factory=list)  # tokens whose KV
+    # prefill computes; == prompt for fresh slots, prompt+generated-minus-
+    # pending for preempted slots
     pages: List[int] = field(default_factory=list)
     committed_hashes: List[int] = field(default_factory=list)
     prefill_pos: int = 0
     generated: int = 0
     last_token: int = 0
     slot_idx: int = -1
+    admit_seq: int = 0  # admission order; preemption victims = newest
     done: bool = False
+    resume_token: Optional[int] = None  # preempted: continue with this token
     return_kv: bool = False  # prefill role: ship KV pages with the 1st token
     preloaded: Optional[tuple] = None  # decode role: (first_tok, k, v, n_tokens)
     onboard: Optional[tuple] = None  # KVBM tier hit: (alloc_pages, hashes)
@@ -83,6 +121,7 @@ class JaxEngine:
         event_sink: Optional[Callable[[KvEvent], None]] = None,
     ):
         self.config = config
+        _enable_compile_cache()
         self.model_config = model_config or _resolve_model(config.model)
         c = self.model_config
         # family dispatch: MoeConfig subclasses LlamaConfig, and models/moe.py
@@ -140,13 +179,28 @@ class JaxEngine:
         self._rng = jax.random.PRNGKey(config.seed + 1)
         self._step_counter = 0
         self.num_requests = 0
-        # all device calls run on this single thread so XLA compiles (which
-        # can take tens of seconds) never stall the asyncio event loop —
-        # heartbeats/leases/streams stay live during compilation
+        self.num_preemptions = 0
+        self._admit_counter = 0
+        # decode pipeline: device-resident carry (tokens/positions/seq_lens)
+        # + up to two in-flight K-step blocks
+        self._carry = None  # (tokens_dev, positions_dev, seq_lens_dev)
+        self._carry_valid = False
+        self._tables_dev = None
+        self._samp_dev = None
+        self._inflight: deque = deque()  # [{"active": [...], "toks": dev[K,B]}]
+        # pending prefill completions awaiting their first-token fetch
+        self._pending_prefill: List[dict] = []
+        # all device dispatches run on this single thread so XLA compiles
+        # (which can take tens of seconds) never stall the asyncio event
+        # loop; host reads run on a separate fetch thread so a blocking
+        # device_get (~1 RTT) never delays the next dispatch
         import concurrent.futures
 
         self._device_exec = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="jax-step"
+        )
+        self._fetch_exec = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="jax-fetch"
         )
         self._compile()
 
@@ -157,31 +211,45 @@ class JaxEngine:
     def _compile(self):
         c = self.model_config
         cfg = self.config
+        K = cfg.decode_block_steps
 
-        @partial(jax.jit, donate_argnums=(1, 2))
-        def decode_step(params, kv_k, kv_v, tokens, positions, page_tables, seq_lens, samp, key):
-            logits, kv_k, kv_v = self._model.decode_forward(
-                params, c, tokens, positions, kv_k, kv_v, page_tables, seq_lens
+        # the RNG key lives ON DEVICE and is threaded through every program
+        # (split inside jit, advanced key returned): an eager
+        # jax.random.split per dispatch costs a host round-trip — measured
+        # ~9 ms/step through the axon tunnel, the round-1 ITL killer
+        @partial(jax.jit, donate_argnums=(1, 2, 8))
+        def decode_block(params, kv_k, kv_v, tokens, positions, seq_lens, page_tables, samp, rng):
+            """K fused decode steps: sampled tokens feed the next step on
+            device — one host read per K*B tokens instead of per token."""
+            rng, sub = jax.random.split(rng)
+            keys = jax.random.split(sub, K)
+
+            def step(carry, k):
+                tokens, positions, seq_lens, kv_k, kv_v = carry
+                logits, kv_k, kv_v = self._model.decode_forward(
+                    params, c, tokens, positions, kv_k, kv_v, page_tables, seq_lens
+                )
+                nxt = sample(logits, samp, k)
+                return (nxt, positions + 1, seq_lens + 1, kv_k, kv_v), nxt
+
+            (tokens, positions, seq_lens, kv_k, kv_v), toks = jax.lax.scan(
+                step, (tokens, positions, seq_lens, kv_k, kv_v), keys
             )
-            next_tokens = sample(logits, samp, key)
-            return next_tokens, kv_k, kv_v
+            return toks, tokens, positions, seq_lens, kv_k, kv_v, rng
 
-        self._decode_step = decode_step
+        self._decode_block = decode_block
 
-        @partial(jax.jit, donate_argnums=(1, 2), static_argnums=(8,))
-        def prefill_step(params, kv_k, kv_v, tokens, positions, page_table, ctx_len, last_idx, _bucket):
-            logits, kv_k, kv_v = self._model.prefill_forward(
-                params, c, tokens, positions, kv_k, kv_v, page_table, ctx_len, last_idx
+        @partial(jax.jit, donate_argnums=(1, 2, 9))
+        def prefill_batch(params, kv_k, kv_v, tokens, positions, page_tables, ctx_lens, last_idx, samp, rng):
+            """Batched chunked prefill + on-device first-token sampling."""
+            rng, sub = jax.random.split(rng)
+            logits, kv_k, kv_v = self._model.prefill_forward_batched(
+                params, c, tokens, positions, kv_k, kv_v, page_tables, ctx_lens, last_idx
             )
-            return logits, kv_k, kv_v
+            first = sample(logits, samp, sub)
+            return first, kv_k, kv_v, rng
 
-        self._prefill_step = prefill_step
-
-        @jax.jit
-        def sample_one(logits, samp, key):
-            return sample(logits[None, :], samp, key)[0]
-
-        self._sample_one = sample_one
+        self._prefill_batch = prefill_batch
 
         # disagg KV movement (host-staged; llm/disagg.py wire format)
         @jax.jit
@@ -220,17 +288,11 @@ class JaxEngine:
                 await asyncio.sleep(0.01)
             self.kvbm.manager.flush()
 
-    async def generate(self, request: Any, context: Context) -> AsyncIterator[dict]:
-        self.start()
-        req = (
-            request
-            if isinstance(request, PreprocessedRequest)
-            else PreprocessedRequest.from_dict(request)
-        )
+    def _new_slot(self, req: PreprocessedRequest, context: Context, suffix: str = "") -> _Slot:
         stop = req.stop_conditions or {}
         sampling = req.sampling_options or {}
         slot = _Slot(
-            request_id=req.request_id or f"jax-{self.num_requests}",
+            request_id=(req.request_id or f"jax-{self.num_requests}") + suffix,
             queue=asyncio.Queue(),
             context=context,
             prompt=list(req.token_ids),
@@ -241,13 +303,26 @@ class JaxEngine:
             stop_token_ids=list(stop.get("stop_token_ids") or []),
             seq=TokenBlockSequence(req.token_ids, self.config.page_size),
         )
-        slot.temperature = float(sampling.get("temperature", self.config.default_temperature) or 0.0)
+        slot.kv_prompt = slot.prompt
+        slot.temperature = float(
+            sampling.get("temperature", self.config.default_temperature) or 0.0
+        )
         slot.top_k = int(sampling.get("top_k") or 0)
         slot.top_p = float(sampling.get("top_p") or 1.0)
-        disagg = req.disagg_params or {}
-        slot.return_kv = bool(disagg.get("return_kv"))
         if len(slot.prompt) + slot.max_tokens > self.config.max_model_len:
             slot.max_tokens = max(self.config.max_model_len - len(slot.prompt), 1)
+        return slot
+
+    async def generate(self, request: Any, context: Context) -> AsyncIterator[dict]:
+        self.start()
+        req = (
+            request
+            if isinstance(request, PreprocessedRequest)
+            else PreprocessedRequest.from_dict(request)
+        )
+        slot = self._new_slot(req, context)
+        disagg = req.disagg_params or {}
+        slot.return_kv = bool(disagg.get("return_kv"))
         self.num_requests += 1
         self._waiting.append(slot)
         self._wake.set()
@@ -280,23 +355,7 @@ class JaxEngine:
             if isinstance(request, PreprocessedRequest)
             else PreprocessedRequest.from_dict(request)
         )
-        stop = req.stop_conditions or {}
-        sampling = req.sampling_options or {}
-        slot = _Slot(
-            request_id=(req.request_id or f"jax-{self.num_requests}") + "-d",
-            queue=asyncio.Queue(),
-            context=context,
-            prompt=list(req.token_ids),
-            max_tokens=int(stop.get("max_tokens") or 128),
-            min_tokens=int(stop.get("min_tokens") or 0),
-            eos_ids=list(req.eos_token_ids or []),
-            ignore_eos=bool(stop.get("ignore_eos")),
-            stop_token_ids=list(stop.get("stop_token_ids") or []),
-            seq=TokenBlockSequence(req.token_ids, self.config.page_size),
-        )
-        slot.temperature = float(sampling.get("temperature", self.config.default_temperature) or 0.0)
-        slot.top_k = int(sampling.get("top_k") or 0)
-        slot.top_p = float(sampling.get("top_p") or 1.0)
+        slot = self._new_slot(req, context, suffix="-d")
         slot.preloaded = (first_token, kv_k_pages, kv_v_pages, n_tokens)
         self.num_requests += 1
         self._waiting.append(slot)
@@ -332,61 +391,52 @@ class JaxEngine:
     async def _step_loop(self):
         while not self._closed:
             has_active = any(s is not None for s in self.slots)
-            if not self._waiting and not has_active:
+            if (
+                not self._waiting
+                and not has_active
+                and not self._inflight
+                and not self._pending_prefill
+            ):
                 self._wake.clear()
                 await self._wake.wait()
                 continue
             try:
-                did_prefill = await self._admit_and_prefill()
-                did_decode = await self._decode_all()
+                progressed = await self._step_once()
             except Exception as e:  # noqa: BLE001 — engine loop must not die silently
                 logger.exception("engine step failed; failing active requests")
                 self._fail_all(f"engine step failed: {type(e).__name__}: {e}")
                 await asyncio.sleep(0.1)
                 continue
             # yield to the event loop so streams flush between steps
-            await asyncio.sleep(0)
+            await asyncio.sleep(0 if progressed else 0.001)
 
-    # -- admission + chunked prefill ------------------------------------ #
-
-    async def _run_on_device(self, fn, *args):
-        return await asyncio.get_running_loop().run_in_executor(
-            self._device_exec, fn, *args
+    async def _step_once(self) -> bool:
+        """One engine iteration: admit, dispatch (prefill batch + decode
+        block), then collect ALL host-needed values in one device_get."""
+        self._admit_waiting()
+        progressed = await self._run_injections()
+        progressed |= await self._dispatch_prefill()
+        dispatched = await self._dispatch_decode()
+        # fetch the oldest block only once the pipeline is full or stalled,
+        # so its host read overlaps the newer block's compute
+        fetch_block = len(self._inflight) >= 2 or (
+            bool(self._inflight) and not dispatched
         )
+        progressed |= dispatched
+        progressed |= await self._fetch_and_process(fetch_block)
+        return progressed
 
-    async def _admit_and_prefill(self) -> bool:
-        cfg = self.config
-        # admit waiting requests into free slots
+    # -- admission ------------------------------------------------------- #
+
+    def _admit_waiting(self):
         still: List[_Slot] = []
         for slot in self._waiting:
             if slot.done or slot.context.is_stopped():
                 self._emit_finish(slot, "cancelled")
                 continue
-            if not self._free_slots:
+            if not self._free_slots or not self._try_admit(slot):
                 still.append(slot)
-                continue
-            if not self._try_admit(slot):
-                still.append(slot)
-                continue
         self._waiting = still
-
-        # inject one preloaded (disagg-transferred) slot per iteration
-        for slot in self.slots:
-            if slot is not None and slot.preloaded is not None:
-                await self._inject_preloaded(slot)
-                return True
-        # inject one KVBM onboard (G2/G3 tier hit) per iteration
-        for slot in self.slots:
-            if slot is not None and slot.onboard is not None:
-                await self._inject_onboard(slot)
-                return True
-        # run ONE prefill chunk for the first slot still prefilling
-        for slot in self.slots:
-            if slot is None or slot.prefill_pos >= len(slot.prompt):
-                continue
-            await self._prefill_chunk(slot)
-            return True
-        return False
 
     def _try_admit(self, slot: _Slot) -> bool:
         cfg = self.config
@@ -411,7 +461,9 @@ class JaxEngine:
             self.temps[idx] = slot.temperature
             self.top_ks[idx] = slot.top_k
             self.top_ps[idx] = slot.top_p
+            slot.admit_seq = self._admit_counter = self._admit_counter + 1
             return True
+        kv_prompt = slot.kv_prompt
         hashes = slot.seq.block_hashes()
         cached_pages = (
             self.allocator.acquire_cached(hashes) if cfg.enable_prefix_caching else []
@@ -421,11 +473,11 @@ class JaxEngine:
         # are injected before prefill (onboard), extending the cached prefix
         onboard_hashes: List[int] = []
         if self.kvbm is not None and cfg.enable_prefix_caching:
-            prompt_full_blocks = len(slot.prompt) // cfg.page_size
+            prompt_full_blocks = len(kv_prompt) // cfg.page_size
             onboard_hashes = self.kvbm.probe(hashes[n_cached:prompt_full_blocks])
         n_onboard = len(onboard_hashes)
         # allocate the prompt's remaining pages now; generation pages grow later
-        prompt_pages = (len(slot.prompt) + cfg.page_size - 1) // cfg.page_size
+        prompt_pages = (len(kv_prompt) + cfg.page_size - 1) // cfg.page_size
         fresh_prompt = max(prompt_pages - n_cached, 0)
         if not self.allocator.can_allocate(fresh_prompt + 1):
             self.allocator.release(cached_pages, hashes[:n_cached])
@@ -438,13 +490,13 @@ class JaxEngine:
         slot.slot_idx = idx
         slot.pages = cached_pages + fresh
         slot.committed_hashes = hashes[:n_cached]
-        slot.prefill_pos = (n_cached + n_onboard) * cfg.page_size
+        slot.prefill_pos = min((n_cached + n_onboard) * cfg.page_size, len(kv_prompt))
         if n_onboard:
             slot.onboard = (fresh[:n_onboard], onboard_hashes)
         # skip-ahead: if the whole prompt is cached, recompute the last token
         # (need its logits) — back off one position
-        if slot.prefill_pos >= len(slot.prompt):
-            slot.prefill_pos = len(slot.prompt) - 1
+        if slot.prefill_pos >= len(kv_prompt):
+            slot.prefill_pos = len(kv_prompt) - 1
         self.slots[idx] = slot
         # host state
         self.page_tables[idx, :] = SCRATCH_PAGE
@@ -454,105 +506,42 @@ class JaxEngine:
         self.temps[idx] = slot.temperature
         self.top_ks[idx] = slot.top_k
         self.top_ps[idx] = slot.top_p
+        slot.admit_seq = self._admit_counter = self._admit_counter + 1
         return True
 
-    def _bucket_for(self, n: int) -> int:
-        for b in self.config.prefill_buckets:
-            if n <= b:
-                return b
-        return self.config.prefill_buckets[-1]
+    # -- device helpers -------------------------------------------------- #
 
-    async def _prefill_chunk(self, slot: _Slot):
-        cfg = self.config
-        c = self.model_config
-        remaining = len(slot.prompt) - slot.prefill_pos
-        chunk = min(remaining, cfg.max_prefill_chunk)
-        bucket = self._bucket_for(chunk)
-        start = slot.prefill_pos
-        toks = slot.prompt[start : start + chunk]
-        positions = list(range(start, start + chunk))
-        # pad to bucket; pads write to the tail logical page -> scratch
-        pad = bucket - chunk
-        pad_pos = cfg.max_pages_per_seq * cfg.page_size - 1
-        toks = toks + [0] * pad
-        positions = positions + [pad_pos] * pad
+    async def _run_on_device(self, fn, *args):
+        return await asyncio.get_running_loop().run_in_executor(
+            self._device_exec, fn, *args
+        )
 
-        def run_prefill():
-            table = jnp.asarray(self.page_tables[slot.slot_idx])
-            return self._prefill_step(
-                self.params,
-                self.kv_k,
-                self.kv_v,
-                jnp.asarray(np.array(toks, np.int32)),
-                jnp.asarray(np.array(positions, np.int32)),
-                table,
-                jnp.asarray(start, jnp.int32),
-                chunk - 1,
-                bucket,
-            )
+    async def _fetch(self, tree):
+        """One host read (single RTT) for an arbitrary pytree of device
+        arrays, off the dispatch thread."""
+        return await asyncio.get_running_loop().run_in_executor(
+            self._fetch_exec, jax.device_get, tree
+        )
 
-        logits, self.kv_k, self.kv_v = await self._run_on_device(run_prefill)
-        slot.prefill_pos += chunk
-        if slot.prefill_pos >= len(slot.prompt):
-            # prompt done: commit full prompt blocks to the prefix cache
-            self._commit_blocks(slot)
-            # sample the first token from the last real position's logits
-            self._rng, sub = jax.random.split(self._rng)
-            samp = SamplingParams(
-                temperature=jnp.asarray([slot.temperature], jnp.float32),
-                top_k=jnp.asarray([slot.top_k], jnp.int32),
-                top_p=jnp.asarray([slot.top_p], jnp.float32),
-            )
-            first = int(
-                await self._run_on_device(self._sample_one, logits, samp, sub)
-            )
-            if slot.return_kv:
-                # prefill role: ship the prompt KV with the first token and
-                # finish (reference: prefill returns kv_transfer_params,
-                # handlers.py:297-306; here the payload IS the transfer)
-                await self._emit_prefill_result(slot, first)
-                return
-            self._emit_token(slot, first)
-            if not slot.done:
-                slot.last_token = first
-                slot.generated = 1
-                slot.seq.append(first)
-                self.tokens[slot.slot_idx] = first
-                self.seq_lens[slot.slot_idx] = len(slot.prompt) + 1
-                self._maybe_finish(slot, first)
+    # -- injections (disagg preload / KVBM onboard) ---------------------- #
 
-    async def _emit_prefill_result(self, slot: _Slot, first_token: int):
-        from ..llm.disagg import pack_kv_payload
-
-        cfg = self.config
-        n_prompt_pages = (len(slot.prompt) + cfg.page_size - 1) // cfg.page_size
-        page_ids = np.array(
-            [p + 1 for p in slot.pages[:n_prompt_pages]], np.int32
-        )  # +1 scratch shift
-
-        def run_extract():
-            k, v = self._extract_pages(self.kv_k, self.kv_v, jnp.asarray(page_ids))
-            return np.asarray(k), np.asarray(v)
-
-        k_np, v_np = await self._run_on_device(run_extract)
-        payload = pack_kv_payload(k_np, v_np, len(slot.prompt), cfg.page_size)
-        if not slot.done:
-            out = LLMEngineOutput(
-                token_ids=[first_token],
-                finish_reason="remote_prefill_done",
-                kv_transfer_params=payload,
-            ).to_dict()
-            slot.queue.put_nowait(Annotated(data=out).to_dict())
-            slot.queue.put_nowait(None)
-            slot.done = True
-        self._release_slot(slot)
+    async def _run_injections(self) -> bool:
+        did = False
+        for slot in list(self.slots):
+            if slot is not None and slot.preloaded is not None:
+                await self._inject_preloaded(slot)
+                did = True
+        for slot in list(self.slots):
+            if slot is not None and slot.onboard is not None:
+                await self._inject_onboard(slot)
+                did = True
+        return did
 
     async def _inject_preloaded(self, slot: _Slot):
         """Decode role: write transferred KV pages into our cache and enter
         the decode batch as if we had prefilled locally."""
         first_token, k_np, v_np, n_tokens = slot.preloaded
         slot.preloaded = None
-        cfg = self.config
         page_ids = np.array([p + 1 for p in slot.pages], np.int32)
 
         def run_inject():
@@ -574,6 +563,7 @@ class JaxEngine:
         slot.seq.append(first_token)
         self.tokens[slot.slot_idx] = first_token
         self.seq_lens[slot.slot_idx] = len(slot.prompt) + 1
+        self._carry_valid = False
         self._maybe_finish(slot, first_token)
 
     async def _inject_onboard(self, slot: _Slot):
@@ -618,11 +608,169 @@ class JaxEngine:
         slot.committed_hashes.extend(hashes)
         # (whole-prompt clamp already applied at admission, _try_admit)
 
+    # -- batched chunked prefill ----------------------------------------- #
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.config.prefill_buckets:
+            if n <= b:
+                return b
+        return self.config.prefill_buckets[-1]
+
+    async def _dispatch_prefill(self) -> bool:
+        """Pack prefill chunks from several slots into ONE dispatch.
+
+        Shapes are bounded: batch lanes B_pf = prefill_batch_tokens/bucket
+        (padded with dummy lanes), table length = pow2 context bucket + a
+        scratch tail entry for padded positions — so compile variants stay
+        few and cacheable."""
+        cfg = self.config
+        cands = []
+        for s in self.slots:
+            if s is None or s.prefill_pos >= len(s.kv_prompt):
+                continue
+            if s.preloaded is not None or s.onboard is not None:
+                continue
+            if s.done or s.context.is_stopped():
+                self._emit_finish(s, "cancelled")
+                self._release_slot(s)
+                continue
+            cands.append(s)
+        if not cands:
+            return False
+        cands.sort(key=lambda s: s.admit_seq)
+        first_chunk = min(
+            len(cands[0].kv_prompt) - cands[0].prefill_pos, cfg.max_prefill_chunk
+        )
+        bucket = self._bucket_for(first_chunk)
+        lanes = max(1, min(cfg.prefill_batch_tokens // bucket, cfg.max_prefill_batch))
+        chosen = cands[:lanes]
+        B_pf = lanes
+
+        # shared context-bounded table: pow2 pages covering the largest
+        # (history + chunk), plus one guaranteed-scratch tail entry that
+        # padded positions write to
+        chunk_of = {}
+        max_pages_needed = 1
+        for s in chosen:
+            chunk = min(len(s.kv_prompt) - s.prefill_pos, bucket)
+            chunk_of[s.request_id] = chunk
+            pages_needed = (s.prefill_pos + chunk + cfg.page_size - 1) // cfg.page_size
+            max_pages_needed = max(max_pages_needed, pages_needed)
+        ctx_pages = min(_next_pow2(max_pages_needed), cfg.max_pages_per_seq)
+        P = ctx_pages + 1
+        pad_pos = P * cfg.page_size - 1
+
+        toks = np.zeros((B_pf, bucket), np.int32)
+        positions = np.full((B_pf, bucket), pad_pos, np.int32)
+        tables = np.full((B_pf, P), SCRATCH_PAGE, np.int32)
+        ctx_lens = np.zeros((B_pf,), np.int32)
+        last_idx = np.zeros((B_pf,), np.int32)
+        temps = np.zeros((B_pf,), np.float32)
+        top_ks = np.zeros((B_pf,), np.int32)
+        top_ps = np.ones((B_pf,), np.float32)
+        meta = []
+        for lane, s in enumerate(chosen):
+            chunk = chunk_of[s.request_id]
+            start = s.prefill_pos
+            toks[lane, :chunk] = s.kv_prompt[start : start + chunk]
+            positions[lane, :chunk] = np.arange(start, start + chunk)
+            tables[lane, :ctx_pages] = self.page_tables[s.slot_idx][:ctx_pages]
+            ctx_lens[lane] = start
+            last_idx[lane] = chunk - 1
+            temps[lane] = s.temperature
+            top_ks[lane] = s.top_k
+            top_ps[lane] = s.top_p
+            meta.append((s, chunk, lane))
+
+        def run_prefill():
+            samp = SamplingParams(
+                temperature=jnp.asarray(temps),
+                top_k=jnp.asarray(top_ks),
+                top_p=jnp.asarray(top_ps),
+            )
+            return self._prefill_batch(
+                self.params,
+                self.kv_k,
+                self.kv_v,
+                jnp.asarray(toks),
+                jnp.asarray(positions),
+                jnp.asarray(tables),
+                jnp.asarray(ctx_lens),
+                jnp.asarray(last_idx),
+                samp,
+                self._rng,
+            )
+
+        first_dev, self.kv_k, self.kv_v, self._rng = await self._run_on_device(
+            run_prefill
+        )
+        completions = []
+        for s, chunk, lane in meta:
+            s.prefill_pos += chunk
+            if s.prefill_pos >= len(s.kv_prompt):
+                completions.append((s, lane))
+        if completions:
+            self._pending_prefill.append({"first": first_dev, "done": completions})
+        return True
+
+    def _finish_prefill(self, slot: _Slot, first: int):
+        """Prompt KV fully computed; activate the slot for decode."""
+        self._commit_blocks(slot)
+        if slot.done or slot.context.is_stopped():
+            self._emit_finish(slot, "cancelled")
+            self._release_slot(slot)
+            return
+        if slot.resume_token is not None:
+            # preempted resume: continue from the already-emitted pending
+            # token; the freshly sampled token is discarded
+            first = slot.resume_token
+            slot.resume_token = None
+            slot.last_token = first
+            self.tokens[slot.slot_idx] = first
+            self.seq_lens[slot.slot_idx] = len(slot.kv_prompt) + 1
+            self._carry_valid = False
+            return
+        self._emit_token(slot, first)
+        if not slot.done:
+            slot.last_token = first
+            slot.generated = 1
+            slot.seq.append(first)
+            self.tokens[slot.slot_idx] = first
+            self.seq_lens[slot.slot_idx] = len(slot.kv_prompt) + 1
+            self._carry_valid = False
+            self._maybe_finish(slot, first)
+
+    async def _emit_prefill_result(self, slot: _Slot, first_token: int):
+        from ..llm.disagg import pack_kv_payload
+
+        cfg = self.config
+        n_prompt_pages = (len(slot.prompt) + cfg.page_size - 1) // cfg.page_size
+        page_ids = np.array(
+            [p + 1 for p in slot.pages[:n_prompt_pages]], np.int32
+        )  # +1 scratch shift
+
+        def run_extract():
+            return self._extract_pages(self.kv_k, self.kv_v, jnp.asarray(page_ids))
+
+        k_dev, v_dev = await self._run_on_device(run_extract)
+        k_np, v_np = await self._fetch((k_dev, v_dev))
+        payload = pack_kv_payload(k_np, v_np, len(slot.prompt), cfg.page_size)
+        if not slot.done:
+            out = LLMEngineOutput(
+                token_ids=[first_token],
+                finish_reason="remote_prefill_done",
+                kv_transfer_params=payload,
+            ).to_dict()
+            slot.queue.put_nowait(Annotated(data=out).to_dict())
+            slot.queue.put_nowait(None)
+            slot.done = True
+        self._release_slot(slot)
+
     def _commit_blocks(self, slot: _Slot):
         """Bind filled prompt pages to their hashes -> prefix cache + events."""
         hashes = slot.seq.block_hashes()
         n_known = len(slot.committed_hashes)
-        prompt_full_blocks = len(slot.prompt) // self.config.page_size
+        prompt_full_blocks = len(slot.kv_prompt) // self.config.page_size
         new_hashes = hashes[n_known:prompt_full_blocks]
         if new_hashes:
             pages = slot.pages[n_known : n_known + len(new_hashes)]
@@ -642,90 +790,207 @@ class JaxEngine:
         for i, slot in enumerate(self.slots):
             if slot is None:
                 continue
-            if slot.prefill_pos >= len(slot.prompt) and slot.generated > 0:
+            if slot.prefill_pos >= len(slot.kv_prompt) and slot.generated > 0 and slot.resume_token is None:
                 out.append(i)
         return out
 
-    async def _decode_all(self) -> bool:
-        active = self._active_decode_indices()
-        if not active:
-            return False
+    def _grow_pages_for_block(self, active: List[int]) -> List[int]:
+        """Ensure each active lane's pages cover K decode steps; preempt the
+        newest sequence (or finish with 'length' as last resort) when the
+        pool is exhausted. Returns the surviving active set."""
         cfg = self.config
-        # grow pages for slots whose next write crosses a page boundary.
-        # seq_lens counts tokens INCLUDING the pending (last-sampled) token,
-        # whose KV is written this step at position seq_len - 1.
-        for i in active:
+        K = cfg.decode_block_steps
+        for i in list(active):
             slot = self.slots[i]
-            pos = int(self.seq_lens[i]) - 1  # write position this step
-            needed_pages = pos // cfg.page_size + 1
+            if slot is None:
+                continue
+            # clamp to the model-length bound: speculation past it writes to
+            # the scratch page (decode_forward routes out-of-range positions
+            # there), so no pages are needed beyond max_model_len
+            last_pos = min(
+                int(self.seq_lens[i]) - 1 + (K - 1), cfg.max_model_len - 1
+            )
+            needed_pages = last_pos // cfg.page_size + 1
             while len(slot.pages) < needed_pages:
                 fresh = self.allocator.alloc_fresh(1)
-                if fresh is None:
-                    # out of pages: finish with length (simplest backpressure;
-                    # real preemption lands with the KVBM tiers)
+                if fresh is not None:
+                    slot.pages.extend(fresh)
+                    self.page_tables[i, len(slot.pages) - 1] = fresh[0] + 1
+                    self._carry_valid = False
+                    continue
+                if not self._preempt_one(exclude_idx=i):
+                    # nothing left to preempt: finish with length
                     self._emit_finish(slot, "length")
                     self._release_slot(slot)
                     break
-                slot.pages.extend(fresh)
-                self.page_tables[i, len(slot.pages) - 1] = fresh[0] + 1
+        return self._active_decode_indices()
 
+    def _preempt_one(self, exclude_idx: int) -> bool:
+        """Preempt the newest-admitted active sequence: commit its full
+        blocks (so resume rides the prefix cache / KVBM), release pages,
+        requeue. Reference: mocker scheduler watermark eviction
+        (lib/llm/src/mocker/scheduler.rs:240)."""
+        victims = [
+            s
+            for s in self.slots
+            if s is not None and s.slot_idx != exclude_idx and s.generated > 0
+        ]
+        if not victims:
+            return False
+        victim = max(victims, key=lambda s: s.admit_seq)
+        logger.info("preempting %s to reclaim pages", victim.request_id)
+        self.num_preemptions += 1
+        # resume state: recompute KV for everything except the pending token
+        victim.resume_token = victim.last_token
+        victim.kv_prompt = list(victim.seq.tokens[:-1])
+        victim.prefill_pos = 0
+        self._release_slot(victim)
+        self._waiting.insert(0, victim)
+        return True
+
+    async def _dispatch_decode(self) -> bool:
+        cfg = self.config
+        if len(self._inflight) >= 2:
+            return False
+        if not self._carry_valid and self._inflight:
+            return False  # drain in-flight blocks before a state reset
         active = self._active_decode_indices()
         if not active:
             return False
+        active = self._grow_pages_for_block(active)
+        if not active:
+            return False
+        if not self._carry_valid and self._inflight:
+            # growth/preemption invalidated the carry mid-pipeline: drain the
+            # in-flight block first (its results update host state), THEN a
+            # fresh upload is consistent
+            return False
 
         B = cfg.max_num_seqs
-        positions = np.zeros((B,), np.int32)
-        mask = np.zeros((B,), bool)
-        for i in active:
-            positions[i] = self.seq_lens[i] - 1  # pending token's position
-            mask[i] = True
-        seq_lens_step = np.where(mask, self.seq_lens, 0).astype(np.int32)
+        K = cfg.decode_block_steps
+        if not self._carry_valid:
+            mask = np.zeros((B,), bool)
+            for i in active:
+                mask[i] = True
+            positions = np.where(mask, self.seq_lens - 1, 0).astype(np.int32)
+            seq_lens_step = np.where(mask, self.seq_lens, 0).astype(np.int32)
+            tokens = np.where(mask, self.tokens, 0).astype(np.int32)
 
-        self._rng, sub = jax.random.split(self._rng)
+            def upload():
+                samp = SamplingParams(
+                    temperature=jnp.asarray(self.temps),
+                    top_k=jnp.asarray(self.top_ks),
+                    top_p=jnp.asarray(self.top_ps),
+                )
+                return (
+                    jnp.asarray(tokens),
+                    jnp.asarray(positions),
+                    jnp.asarray(seq_lens_step),
+                    jnp.asarray(self.page_tables),
+                    samp,
+                )
 
-        def run_decode():
-            samp = SamplingParams(
-                temperature=jnp.asarray(self.temps),
-                top_k=jnp.asarray(self.top_ks),
-                top_p=jnp.asarray(self.top_ps),
-            )
-            next_tokens, kv_k, kv_v = self._decode_step(
+            tok_d, pos_d, sl_d, tab_d, samp_d = await self._run_on_device(upload)
+            self._carry = (tok_d, pos_d, sl_d)
+            self._tables_dev = tab_d
+            self._samp_dev = samp_d
+            self._carry_valid = True
+
+        carry = self._carry
+
+        def run_block():
+            return self._decode_block(
                 self.params,
                 self.kv_k,
                 self.kv_v,
-                jnp.asarray(self.tokens),
-                jnp.asarray(positions),
-                jnp.asarray(self.page_tables),
-                jnp.asarray(seq_lens_step),
-                samp,
-                sub,
+                carry[0],
+                carry[1],
+                carry[2],
+                self._tables_dev,
+                self._samp_dev,
+                self._rng,
             )
-            return np.asarray(next_tokens), kv_k, kv_v
 
-        next_np, self.kv_k, self.kv_v = await self._run_on_device(run_decode)
-        self._step_counter += 1
-
+        (
+            toks_dev,
+            tok_d,
+            pos_d,
+            sl_d,
+            self.kv_k,
+            self.kv_v,
+            self._rng,
+        ) = await self._run_on_device(run_block)
+        self._carry = (tok_d, pos_d, sl_d)
+        self._inflight.append(
+            {"lanes": [(i, self.slots[i]) for i in active], "toks": toks_dev}
+        )
+        # advance host bookkeeping by K for the NEXT block's page growth
         for i in active:
+            self.seq_lens[i] += K
+        self._step_counter += 1
+        return True
+
+    async def _fetch_and_process(self, fetch_block: bool) -> bool:
+        """One RTT: fetch pending prefill first-tokens + the oldest in-flight
+        decode block together, then run host bookkeeping/emission."""
+        want_block = self._inflight[0] if (fetch_block and self._inflight) else None
+        prefills = self._pending_prefill
+        self._pending_prefill = []
+        if want_block is None and not prefills:
+            return False
+        tree = (
+            [p["first"] for p in prefills],
+            want_block["toks"] if want_block is not None else None,
+        )
+        firsts_np, toks_np = await self._fetch(tree)
+
+        for p, first in zip(prefills, firsts_np):
+            for slot, lane in p["done"]:
+                if slot.slot_idx < 0 or self.slots[slot.slot_idx] is not slot:
+                    continue  # released meanwhile (cancel)
+                tok = int(first[lane])
+                if slot.return_kv:
+                    await self._emit_prefill_result(slot, tok)
+                else:
+                    self._finish_prefill(slot, tok)
+
+        if want_block is not None:
+            self._inflight.popleft()
+            self._process_block(want_block["lanes"], toks_np)
+        return True
+
+    def _process_block(self, lanes: List[tuple], toks: np.ndarray):
+        """Emit a fetched K-step block: per lane, append/emit tokens until a
+        stop condition; excess speculated tokens are discarded. Lanes whose
+        slot was preempted/released (or re-assigned) meanwhile are skipped —
+        their speculated tokens were never emitted, so no client ever sees
+        them."""
+        K = toks.shape[0]
+        for i, slot_ref in lanes:
             slot = self.slots[i]
-            if slot is None:
+            if slot is None or slot is not slot_ref:
                 continue
             if slot.done or slot.context.is_stopped():
                 self._emit_finish(slot, "cancelled")
                 self._release_slot(slot)
                 continue
-            tok = int(next_np[i])
-            slot.seq.append(tok)
-            slot.generated += 1
-            slot.last_token = tok
-            self.tokens[i] = tok
-            self.seq_lens[i] += 1
-            self._emit_token(slot, tok)
-            self._maybe_finish(slot, tok)
-        return True
+            for k in range(K):
+                tok = int(toks[k, i])
+                slot.seq.append(tok)
+                slot.generated += 1
+                slot.last_token = tok
+                self.tokens[i] = tok
+                self._emit_token(slot, tok)
+                self._maybe_finish(slot, tok)
+                if slot.done:
+                    break
 
     def _fail_all(self, message: str):
         """A step raised: the batch state is unreliable. Error every live
         request so callers can migrate/retry rather than hang."""
+        self._inflight.clear()
+        self._pending_prefill = []
+        self._carry_valid = False
         for slot in list(self.slots):
             if slot is not None:
                 if not slot.done:
@@ -772,7 +1037,7 @@ class JaxEngine:
     def _release_slot(self, slot: _Slot):
         if slot.slot_idx >= 0 and self.slots[slot.slot_idx] is slot:
             # commit any full generated blocks before release so decode KV is
-            # reusable (conversation prefix reuse)
+            # reusable (conversation prefix reuse / cheap preemption resume)
             self._commit_generated_blocks(slot)
             self.allocator.release(slot.pages, slot.committed_hashes)
             self.slots[slot.slot_idx] = None
@@ -780,13 +1045,18 @@ class JaxEngine:
             self.page_tables[slot.slot_idx, :] = SCRATCH_PAGE
             self.seq_lens[slot.slot_idx] = 0
             slot.slot_idx = -1
+            slot.pages = []
+            self._carry_valid = False
 
     def _commit_generated_blocks(self, slot: _Slot):
         hashes = slot.seq.block_hashes()
         n_known = len(slot.committed_hashes)
-        full_blocks = len(slot.seq.blocks)
-        # only blocks whose pages exist
-        max_by_pages = min(full_blocks, len(slot.pages))
+        # only commit blocks whose KV is fully WRITTEN: the pending (last
+        # sampled) token's KV never is — a block containing it would poison
+        # the prefix cache with one missing position
+        written = max(len(slot.seq.tokens) - 1, 0)
+        full_written = written // self.config.page_size
+        max_by_pages = min(full_written, len(slot.pages))
         new_hashes = hashes[n_known:max_by_pages]
         if new_hashes:
             pages = slot.pages[n_known : n_known + len(new_hashes)]

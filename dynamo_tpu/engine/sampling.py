@@ -39,10 +39,15 @@ def sample(
 ) -> jax.Array:
     """Returns sampled token ids [B]."""
     B, V = logits.shape
-    greedy_tokens = jnp.argmax(logits, axis=-1)
-
-    # candidate set: top TOPK_CAP logits per row
-    cand_logits, cand_idx = jax.lax.top_k(logits, min(TOPK_CAP, V))  # [B, K]
+    # candidate set: top TOPK_CAP logits per row. approx_max_k is the
+    # TPU-native tiled reduction (recall ~1.0 at K=64 over 128k vocab) —
+    # exact top_k lowers to a full sort and dominated the decode step's
+    # fixed overhead. Greedy == candidate 0 (the max is always exact).
+    if V > 4096:
+        cand_logits, cand_idx = jax.lax.approx_max_k(logits, min(TOPK_CAP, V))
+    else:
+        cand_logits, cand_idx = jax.lax.top_k(logits, min(TOPK_CAP, V))
+    greedy_tokens = cand_idx[:, 0]
     K = cand_logits.shape[1]
 
     temp = jnp.maximum(params.temperature, 1e-6)[:, None]

@@ -27,7 +27,11 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from ..ops.paged_attention import paged_attention_decode, prefill_attention
+from ..ops.paged_attention import (
+    paged_attention_decode,
+    prefill_attention,
+    prefill_attention_batched,
+)
 
 
 @dataclass(frozen=True)
@@ -197,6 +201,11 @@ def prefill_forward(
     x = params["embed"][tokens]  # [T, H]
     cos, sin = rope_cos_sin(positions, c.head_dim, c.rope_theta)
     page_size = kv_k.shape[2]
+    T = tokens.shape[0]
+    # valid context = history + real (unpadded) chunk length; bounds the
+    # Pallas prefill kernel's page streaming (pallas_prefill_attention.py)
+    real_chunk = (last_idx + 1) if last_idx is not None else T
+    total_len = context_len + real_chunk
 
     def body(x, kv_k, kv_v):
         new_k_chunks = []
@@ -216,7 +225,8 @@ def prefill_forward(
             kv_k = _write_chunk(kv_k, li, k, positions, page_table, page_size)
             kv_v = _write_chunk(kv_v, li, v, positions, page_table, page_size)
             attn = prefill_attention(
-                q, k, v, kv_k[li], kv_v[li], positions, page_table, context_len
+                q, k, v, kv_k[li], kv_v[li], positions, page_table, context_len,
+                total_len,
             )
             attn = attn.reshape(-1, c.num_heads * c.head_dim)
             x = x + jnp.dot(attn, layer["wo"], preferred_element_type=jnp.float32).astype(c.dtype)
@@ -226,6 +236,60 @@ def prefill_forward(
     x, kv_k, kv_v = body(x, kv_k, kv_v)
     x = rms_norm(x, params["final_norm"], c.rms_norm_eps)
     last = x[-1] if last_idx is None else x[last_idx]
+    head = params["lm_head"] if params["lm_head"] is not None else params["embed"].T
+    logits = jnp.dot(last, head, preferred_element_type=jnp.float32)
+    return logits, kv_k, kv_v
+
+
+def prefill_forward_batched(
+    params: Dict[str, Any],
+    config: LlamaConfig,
+    tokens: jax.Array,  # [B, T] one chunk per sequence (padded to bucket)
+    positions: jax.Array,  # [B, T] absolute positions (pads -> scratch tail)
+    kv_k: jax.Array,  # [L, pages, page_size, kv_heads, head_dim]
+    kv_v: jax.Array,
+    page_tables: jax.Array,  # [B, max_pages] per-seq tables (ctx-bounded)
+    context_lens: jax.Array,  # [B] history length per seq
+    last_idx: jax.Array,  # [B] index of last REAL token per chunk
+    mlp_fn=None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Batched chunked prefill: one dispatch processes chunks of SEVERAL
+    sequences (the round-1 engine serialized one chunk per loop iteration).
+    Returns (logits_last [B, vocab], kv_k, kv_v)."""
+    c = config
+    mlp_fn = mlp_fn or _mlp
+    B, T = tokens.shape
+    x = params["embed"][tokens]  # [B, T, H]
+    cos, sin = rope_cos_sin(positions, c.head_dim, c.rope_theta)
+    page_size = kv_k.shape[2]
+    total_lens = context_lens + last_idx + 1  # [B] valid context per seq
+
+    logical = positions // page_size
+    phys = jnp.take_along_axis(page_tables, logical, axis=1)  # [B, T]
+    offs = positions % page_size
+
+    for li in range(c.num_layers):
+        layer = jax.tree.map(lambda p: p[li], params["layers"])
+        h = rms_norm(x, layer["attn_norm"], c.rms_norm_eps)
+        q = jnp.dot(h, layer["wq"], preferred_element_type=jnp.float32).astype(c.dtype)
+        k = jnp.dot(h, layer["wk"], preferred_element_type=jnp.float32).astype(c.dtype)
+        v = jnp.dot(h, layer["wv"], preferred_element_type=jnp.float32).astype(c.dtype)
+        q = q.reshape(B, T, c.num_heads, c.head_dim)
+        k = k.reshape(B, T, c.num_kv_heads, c.head_dim)
+        v = v.reshape(B, T, c.num_kv_heads, c.head_dim)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        kv_k = kv_k.at[li, phys, offs].set(k)
+        kv_v = kv_v.at[li, phys, offs].set(v)
+        attn = prefill_attention_batched(
+            q, kv_k[li], kv_v[li], positions, page_tables, total_lens, context_lens
+        )
+        attn = attn.reshape(B, T, c.num_heads * c.head_dim)
+        x = x + jnp.dot(attn, layer["wo"], preferred_element_type=jnp.float32).astype(c.dtype)
+        x = mlp_fn(layer, x, c)
+
+    x = rms_norm(x, params["final_norm"], c.rms_norm_eps)
+    last = x[jnp.arange(B), last_idx]  # [B, hidden]
     head = params["lm_head"] if params["lm_head"] is not None else params["embed"].T
     logits = jnp.dot(last, head, preferred_element_type=jnp.float32)
     return logits, kv_k, kv_v
@@ -270,9 +334,15 @@ def decode_forward(
         v = v.reshape(-1, c.num_kv_heads, c.head_dim)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        # write each slot's new KV at its position
-        logical = positions // page_size
+        # write each slot's new KV at its position. Positions past the table
+        # (fused-block speculation overshooting max_model_len) route to
+        # physical page 0 — the engine's reserved scratch page — instead of
+        # XLA's silent clamp-to-last-page, which could corrupt a real
+        # (possibly shared/committed) KV page.
+        max_positions = page_tables.shape[1] * page_size
+        logical = jnp.minimum(positions // page_size, page_tables.shape[1] - 1)
         phys = jnp.take_along_axis(page_tables, logical[:, None], axis=1)[:, 0]
+        phys = jnp.where(positions < max_positions, phys, 0)
         offs = positions % page_size
         kv_k = kv_k.at[li, phys, offs].set(k[:, 0] if k.ndim == 4 else k)
         kv_v = kv_v.at[li, phys, offs].set(v[:, 0] if v.ndim == 4 else v)

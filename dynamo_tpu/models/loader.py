@@ -53,22 +53,36 @@ def _open_checkpoint(model_dir: str) -> Dict[str, Any]:
         def keys(self):
             return files.keys()
 
-        def get(self, name: str) -> np.ndarray:
+        def _handle(self, name: str):
             path = files[name]
             if path not in handles:
                 handles[path] = safe_open(path, framework="numpy")
-            return handles[path].get_tensor(name)
+            return handles[path]
+
+        def get(self, name: str) -> np.ndarray:
+            return self._handle(name).get_tensor(name)
+
+        def get_slice(self, name: str):
+            """Lazy slicer: partial reads straight off the mmap, so sharded
+            placement never materializes a whole tensor on host."""
+            return self._handle(name).get_slice(name)
 
     return Reader()
 
 
-def _to_dtype(x: np.ndarray, dtype) -> Any:
+def _np_dtype(dtype) -> np.dtype:
     import jax.numpy as jnp
     import ml_dtypes
 
     if dtype == jnp.bfloat16:
-        return x.astype(ml_dtypes.bfloat16)
-    return x.astype(np.dtype(jnp.dtype(dtype)))
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(jnp.dtype(dtype))
+
+
+def _to_dtype(x: np.ndarray, dtype) -> Any:
+    # copy=False: checkpoints already in the target dtype (the common case
+    # for bf16) cast for free instead of duplicating the largest leaves
+    return x.astype(_np_dtype(dtype), copy=False)
 
 
 def _place(x: np.ndarray, dtype, sharding=None):
@@ -78,6 +92,53 @@ def _place(x: np.ndarray, dtype, sharding=None):
     if sharding is not None:
         return jax.device_put(arr, sharding)
     return jax.device_put(arr)
+
+
+def _place_stacked(reader, names_fn, num_layers: int, transpose: bool, dtype, sharding=None):
+    """Device-place a layer-stacked leaf [L, ...] without ever holding more
+    than one host copy (unsharded) or one SHARD (sharded) in RAM.
+
+    Round-1 version np.stack'ed every layer then astype'd — two full host
+    copies of e.g. llama3-70B's [80, 8192, 28672] bf16 (~75 GB transient).
+    Now: unsharded leaves assemble layer-by-layer into a single
+    pre-allocated buffer; sharded leaves assemble each device shard from
+    safetensors PARTIAL reads via jax.make_array_from_callback, so peak
+    host memory is one shard."""
+    import jax
+
+    target = _np_dtype(dtype)
+    first = reader.get_slice(names_fn(0))
+    lshape = tuple(first.get_shape())
+    if transpose:
+        lshape = lshape[::-1]
+    shape = (num_layers, *lshape)
+
+    if sharding is None:
+        out = np.empty(shape, target)
+        for li in range(num_layers):
+            m = reader.get(names_fn(li))
+            out[li] = m.T if transpose else m  # in-place cast during assign
+        return jax.device_put(out)
+
+    def build_shard(index):
+        li_sl = index[0]
+        layer_idx = range(*li_sl.indices(num_layers))
+        sub = tuple(
+            slice(*s.indices(dim)) for s, dim in zip(index[1:], shape[1:])
+        )
+        shard = np.empty(
+            (len(layer_idx), *(s.stop - s.start for s in sub)), target
+        )
+        for i, li in enumerate(layer_idx):
+            sl = reader.get_slice(names_fn(li))
+            if transpose:
+                # slice of transpose == transposed slice (2D leaves)
+                shard[i] = sl[sub[1], sub[0]].T
+            else:
+                shard[i] = sl[sub]
+        return shard
+
+    return jax.make_array_from_callback(shape, sharding, build_shard)
 
 
 def _stack_layers(reader, names_fn, num_layers: int, transpose: bool) -> np.ndarray:
@@ -101,10 +162,14 @@ class _TreeBuilder:
         return self.sh.get("layers", {}).get(key) if self.sh else None
 
     def stacked(self, key, hf_fmt, transpose=True):
-        arr = _stack_layers(
-            self.r, lambda li: hf_fmt.format(li=li), self.c.num_layers, transpose
+        return _place_stacked(
+            self.r,
+            lambda li: hf_fmt.format(li=li),
+            self.c.num_layers,
+            transpose,
+            self.c.dtype,
+            self.layer_sh(key),
         )
-        return _place(arr, self.c.dtype, self.layer_sh(key))
 
     def backbone(self) -> Dict[str, Any]:
         c, r, sh = self.c, self.r, self.sh

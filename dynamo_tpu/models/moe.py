@@ -209,3 +209,30 @@ def prefill_forward(
         params, config, tokens, positions, kv_k, kv_v, page_table, context_len,
         last_idx=last_idx, mlp_fn=moe_mlp,
     )
+
+
+def _moe_mlp_nd(layer, x, c):
+    """moe_mlp over [B, T, H] (batched prefill flattens the token dims —
+    expert dispatch is position-independent)."""
+    if x.ndim == 3:
+        B, T, H = x.shape
+        return moe_mlp(layer, x.reshape(B * T, H), c).reshape(B, T, H)
+    return moe_mlp(layer, x, c)
+
+
+def prefill_forward_batched(
+    params: Dict[str, Any],
+    config: MoeConfig,
+    tokens: jax.Array,  # [B, T]
+    positions: jax.Array,  # [B, T]
+    kv_k: jax.Array,
+    kv_v: jax.Array,
+    page_tables: jax.Array,  # [B, max_pages]
+    context_lens: jax.Array,  # [B]
+    last_idx: jax.Array,  # [B]
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Batched chunked prefill (multiple sequences per dispatch), MoE MLP."""
+    return llama.prefill_forward_batched(
+        params, config, tokens, positions, kv_k, kv_v, page_tables,
+        context_lens, last_idx, mlp_fn=_moe_mlp_nd,
+    )

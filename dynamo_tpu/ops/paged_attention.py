@@ -30,9 +30,27 @@ def prefill_attention(
     positions: jax.Array,  # [T] absolute positions of the chunk
     page_table: jax.Array,  # [max_pages]
     context_len: jax.Array,  # scalar (history before this chunk)
+    total_len: Optional[jax.Array] = None,  # scalar: history + real chunk len
 ) -> jax.Array:
     """Chunk attends to all earlier positions (history pages + itself,
-    causal). Returns [T, H, D]."""
+    causal). Returns [T, H, D].
+
+    Dispatch: on TPU the Pallas flash kernel
+    (ops/pallas_prefill_attention.py) streams only the pages that hold real
+    context; elsewhere the XLA reference path below gathers the page table
+    (the engine bounds the table length to the context bucket, so the
+    gather is context-sized, not max-context-sized).
+    """
+    if (
+        total_len is not None
+        and q.shape[-1] % 128 == 0  # Mosaic lane-slice alignment (see kernel)
+        and _use_pallas_decode()
+    ):
+        from .pallas_prefill_attention import paged_prefill_attention_pallas
+
+        return paged_prefill_attention_pallas(
+            q, kv_k_layer, kv_v_layer, page_table, context_len, total_len
+        )
     page_size = kv_k_layer.shape[1]
     S = page_table.shape[0] * page_size
     ctx_k = kv_k_layer[page_table].reshape(S, *kv_k_layer.shape[2:])  # [S, KH, D]
@@ -52,6 +70,49 @@ def prefill_attention(
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("tkgs,skd->tkgd", probs.astype(ctx_v.dtype), ctx_v)
     return out.reshape(T, H, D)
+
+
+def prefill_attention_batched(
+    q: jax.Array,  # [B, T, H, D] (chunks, rope applied)
+    kv_k_layer: jax.Array,  # [pages, page_size, KH, D]
+    kv_v_layer: jax.Array,
+    positions: jax.Array,  # [B, T] absolute positions
+    page_tables: jax.Array,  # [B, max_pages]
+    total_lens: jax.Array,  # [B] valid context per seq (history + real chunk)
+    starts: jax.Array,  # [B] absolute position of each chunk's row 0
+) -> jax.Array:
+    """Batched chunked prefill: each sequence's chunk attends to its own
+    history pages + itself (causal). Returns [B, T, H, D].
+
+    Dispatch: on TPU the batched Pallas flash kernel streams only real
+    context pages; elsewhere the XLA path gathers each (engine-bounded)
+    page table.
+    """
+    if q.shape[-1] % 128 == 0 and _use_pallas_decode():
+        from .pallas_prefill_attention import paged_prefill_attention_pallas_batched
+
+        return paged_prefill_attention_pallas_batched(
+            q, kv_k_layer, kv_v_layer, page_tables, starts, total_lens
+        )
+    B, T, H, D = q.shape
+    page_size = kv_k_layer.shape[1]
+    KH = kv_k_layer.shape[2]
+    S = page_tables.shape[1] * page_size
+    ctx_k = kv_k_layer[page_tables].reshape(B, S, KH, D)
+    ctx_v = kv_v_layer[page_tables].reshape(B, S, KH, D)
+    G = H // KH
+    qg = q.reshape(B, T, KH, G, D)
+    scores = jnp.einsum(
+        "btkgd,bskd->btkgs", qg, ctx_k, preferred_element_type=jnp.float32
+    ) / jnp.sqrt(jnp.asarray(D, jnp.float32))
+    key_pos = jnp.arange(S)
+    mask = (key_pos[None, None, :] <= positions[:, :, None]) & (
+        key_pos[None, None, :] < total_lens[:, None, None]
+    )  # [B, T, S]
+    scores = jnp.where(mask[:, :, None, None, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("btkgs,bskd->btkgd", probs.astype(ctx_v.dtype), ctx_v)
+    return out.reshape(B, T, H, D)
 
 
 def _use_pallas_decode() -> bool:
@@ -85,7 +146,12 @@ def paged_attention_decode(
     kernel (ops/pallas_paged_attention.py) streams pages HBM→VMEM without
     materializing the gather; elsewhere the XLA reference path below runs.
     """
-    if _use_pallas_decode():
+    KH_, D_ = kv_k_layer.shape[2], kv_k_layer.shape[3]
+    # Mosaic requires DMA lane slices 128-aligned: the decode kernel's page
+    # window has lane dim KH*D (whole-page copies), so KH*D must be a
+    # multiple of 128 (true for all flagship configs; tiny/test models fall
+    # back to the XLA path)
+    if (KH_ * D_) % 128 == 0 and _use_pallas_decode():
         from .pallas_paged_attention import paged_attention_decode_pallas
 
         return paged_attention_decode_pallas(

@@ -97,13 +97,15 @@ class HealthCheckManager:
 
     async def _probe(self, t: _Target) -> None:
         try:
-            stream = await self.drt.client.call(t.address, t.subject, t.canary)
-
-            async def drain():
+            # the connect/call AND the drain share one timeout: a wedged
+            # transport that never returns a stream must count as a failed
+            # probe, not hang the canary loop forever
+            async def call_and_drain():
+                stream = await self.drt.client.call(t.address, t.subject, t.canary)
                 async for _ in stream:
                     pass
 
-            await asyncio.wait_for(drain(), timeout=self.request_timeout)
+            await asyncio.wait_for(call_and_drain(), timeout=self.request_timeout)
             if t.consecutive_failures:
                 logger.info("endpoint %s recovered", t.path)
             t.consecutive_failures = 0

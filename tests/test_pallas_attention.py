@@ -55,6 +55,68 @@ def test_pallas_partial_page_and_len1():
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-3, atol=2e-3)
 
 
+def _mk_prefill_case(T=128, H=8, KH=4, D=32, page_size=8, start=0, real=None, seed=0):
+    """Random paged cache + a page table big enough to cover the context
+    (as the engine guarantees), matching the write-then-attend order."""
+    rng = np.random.RandomState(seed)
+    real = real if real is not None else T
+    max_pages = (start + T + page_size - 1) // page_size + 2
+    pages = max_pages + 8
+    q = jnp.asarray(rng.randn(T, H, D), jnp.float32)
+    kv_k = jnp.asarray(rng.randn(pages, page_size, KH, D), jnp.float32)
+    kv_v = jnp.asarray(rng.randn(pages, page_size, KH, D), jnp.float32)
+    pt = jnp.asarray(rng.choice(pages, size=(max_pages,), replace=False).astype(np.int32))
+    return q, kv_k, kv_v, pt, start, start + real
+
+
+@pytest.mark.parametrize(
+    "T,start,real",
+    [(128, 0, 128), (128, 64, 128), (256, 0, 200), (512, 128, 512), (128, 0, 1)],
+)
+def test_pallas_prefill_matches_xla(T, start, real):
+    from dynamo_tpu.ops.pallas_prefill_attention import paged_prefill_attention_pallas
+
+    q, kv_k, kv_v, pt, s, total = _mk_prefill_case(T=T, start=start, real=real, seed=T + start)
+    positions = jnp.asarray(np.arange(s, s + T), jnp.int32)
+    want = ref_ops.prefill_attention(
+        q, None, None, kv_k, kv_v, positions, pt, jnp.asarray(s, jnp.int32)
+    )
+    got = paged_prefill_attention_pallas(
+        q, kv_k, kv_v, pt, jnp.asarray(s, jnp.int32), jnp.asarray(total, jnp.int32),
+        interpret=True,
+    )
+    # only the real (unpadded) rows must match; padded rows are discarded.
+    # the XLA reference attends to ALL table positions <= q_pos (stale pages
+    # included), the kernel only to positions < total_len — identical for
+    # real rows since their q_pos < total_len.
+    np.testing.assert_allclose(
+        np.asarray(got)[:real], np.asarray(want)[:real], rtol=2e-3, atol=2e-3
+    )
+
+
+def test_pallas_prefill_bf16_gqa():
+    from dynamo_tpu.ops.pallas_prefill_attention import paged_prefill_attention_pallas
+
+    rng = np.random.RandomState(9)
+    T, H, KH, D, pages, page_size, max_pages = 128, 8, 2, 64, 40, 16, 32
+    q = jnp.asarray(rng.randn(T, H, D), jnp.bfloat16)
+    kv_k = jnp.asarray(rng.randn(pages, page_size, KH, D), jnp.bfloat16)
+    kv_v = jnp.asarray(rng.randn(pages, page_size, KH, D), jnp.bfloat16)
+    pt = jnp.asarray(rng.choice(pages, size=(max_pages,), replace=False).astype(np.int32))
+    start = 32
+    positions = jnp.asarray(np.arange(start, start + T), jnp.int32)
+    want = ref_ops.prefill_attention(
+        q, None, None, kv_k, kv_v, positions, pt, jnp.asarray(start, jnp.int32)
+    )
+    got = paged_prefill_attention_pallas(
+        q, kv_k, kv_v, pt, jnp.asarray(start, jnp.int32),
+        jnp.asarray(start + T, jnp.int32), interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32), rtol=5e-2, atol=5e-2
+    )
+
+
 def test_pallas_bf16_gqa():
     rng = np.random.RandomState(3)
     B, H, KH, D, pages, page_size, max_pages = 2, 8, 2, 64, 12, 16, 4
