@@ -119,8 +119,21 @@ class JaxEngine:
         params: Optional[dict] = None,
         kv_sharding=None,
         event_sink: Optional[Callable[[KvEvent], None]] = None,
+        mesh=None,
+        spmd=None,
+        multihost: bool = False,
     ):
+        """`mesh`+`kv_sharding`: jit programs with explicit out_shardings
+        (host-fetched outputs replicated so host 0 can read them on a
+        multi-host mesh). `spmd`: a parallel.multihost.StepBroadcaster —
+        every device dispatch is mirrored to follower hosts, which replay
+        it via `run_follower`. `multihost`: True when jax.distributed is
+        active (disagg KV extraction then rides process_allgather)."""
         self.config = config
+        self._mesh = mesh
+        self._spmd = spmd
+        self._multihost = multihost
+        self._kv_sharding = kv_sharding
         _enable_compile_cache()
         self.model_config = model_config or _resolve_model(config.model)
         c = self.model_config
@@ -213,11 +226,23 @@ class JaxEngine:
         cfg = self.config
         K = cfg.decode_block_steps
 
+        # under a (possibly multi-host) mesh, pin host-fetched outputs to
+        # fully-replicated shardings so every host can read them locally;
+        # the KV cache keeps its tp sharding
+        decode_out_sh = prefill_out_sh = None
+        if self._mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            repl = NamedSharding(self._mesh, PartitionSpec())
+            kvs = self._kv_sharding or repl
+            decode_out_sh = (repl, repl, repl, repl, kvs, kvs, repl)
+            prefill_out_sh = (repl, kvs, kvs, repl)
+
         # the RNG key lives ON DEVICE and is threaded through every program
         # (split inside jit, advanced key returned): an eager
         # jax.random.split per dispatch costs a host round-trip — measured
         # ~9 ms/step through the axon tunnel, the round-1 ITL killer
-        @partial(jax.jit, donate_argnums=(1, 2, 8))
+        @partial(jax.jit, donate_argnums=(1, 2, 8), out_shardings=decode_out_sh)
         def decode_block(params, kv_k, kv_v, tokens, positions, seq_lens, page_tables, samp, rng):
             """K fused decode steps: sampled tokens feed the next step on
             device — one host read per K*B tokens instead of per token."""
@@ -239,7 +264,7 @@ class JaxEngine:
 
         self._decode_block = decode_block
 
-        @partial(jax.jit, donate_argnums=(1, 2, 9))
+        @partial(jax.jit, donate_argnums=(1, 2, 9), out_shardings=prefill_out_sh)
         def prefill_batch(params, kv_k, kv_v, tokens, positions, page_tables, ctx_lens, last_idx, samp, rng):
             """Batched chunked prefill + on-device first-token sampling."""
             rng, sub = jax.random.split(rng)
@@ -523,6 +548,131 @@ class JaxEngine:
             self._fetch_exec, jax.device_get, tree
         )
 
+    def _bcast(self, tag: str, arrays: dict):
+        """Mirror a device dispatch to follower hosts (SPMD: every host
+        must enter the same jitted programs in the same order)."""
+        if self._spmd is not None:
+            self._spmd.send(tag, arrays)
+
+    # -- replicated device programs (leader dispatches these after a
+    # _bcast; followers replay them verbatim in run_follower) ------------ #
+
+    def _dev_prefill(self, toks, positions, tables, ctx_lens, last_idx, temps, top_ks, top_ps):
+        samp = SamplingParams(
+            temperature=jnp.asarray(temps),
+            top_k=jnp.asarray(top_ks),
+            top_p=jnp.asarray(top_ps),
+        )
+        first, self.kv_k, self.kv_v, self._rng = self._prefill_batch(
+            self.params,
+            self.kv_k,
+            self.kv_v,
+            jnp.asarray(toks),
+            jnp.asarray(positions),
+            jnp.asarray(tables),
+            jnp.asarray(ctx_lens),
+            jnp.asarray(last_idx),
+            samp,
+            self._rng,
+        )
+        return first
+
+    def _dev_reset(self, tokens, positions, seq_lens, page_tables, temps, top_ks, top_ps):
+        self._samp_dev = SamplingParams(
+            temperature=jnp.asarray(temps),
+            top_k=jnp.asarray(top_ks),
+            top_p=jnp.asarray(top_ps),
+        )
+        self._carry = (
+            jnp.asarray(tokens),
+            jnp.asarray(positions),
+            jnp.asarray(seq_lens),
+        )
+        self._tables_dev = jnp.asarray(page_tables)
+
+    def _dev_block(self):
+        carry = self._carry
+        (
+            toks,
+            tok_d,
+            pos_d,
+            sl_d,
+            self.kv_k,
+            self.kv_v,
+            self._rng,
+        ) = self._decode_block(
+            self.params,
+            self.kv_k,
+            self.kv_v,
+            carry[0],
+            carry[1],
+            carry[2],
+            self._tables_dev,
+            self._samp_dev,
+            self._rng,
+        )
+        self._carry = (tok_d, pos_d, sl_d)
+        return toks
+
+    def _dev_inject(self, page_ids, k_np, v_np):
+        self.kv_k, self.kv_v = self._inject_pages(
+            self.kv_k,
+            self.kv_v,
+            jnp.asarray(page_ids),
+            jnp.asarray(k_np),
+            jnp.asarray(v_np),
+        )
+
+    def _dev_extract(self, page_ids):
+        """Gather pages to host (disagg KV hand-off). On a multi-host mesh
+        the KV shards live on several hosts — process_allgather (a
+        collective: followers run it too) assembles the full pages."""
+        k, v = self._extract_pages(self.kv_k, self.kv_v, jnp.asarray(page_ids))
+        if self._multihost:
+            from jax.experimental import multihost_utils
+
+            return (
+                multihost_utils.process_allgather(k),
+                multihost_utils.process_allgather(v),
+            )
+        return np.asarray(k), np.asarray(v)
+
+    async def run_follower(self, receiver) -> None:
+        """Follower-host loop: replay the leader's dispatch sequence.
+        No scheduling, no control plane, no host bookkeeping — just the
+        same device programs in the same order (reference analogue: vLLM
+        node ranks > 0 joining the engine group, main.py:64-296)."""
+        while True:
+            tag, p = await receiver.recv()
+            if tag == "stop":
+                return
+            if tag == "prefill":
+                await self._run_on_device(
+                    partial(
+                        self._dev_prefill,
+                        p["toks"], p["positions"], p["tables"], p["ctx_lens"],
+                        p["last_idx"], p["temps"], p["top_ks"], p["top_ps"],
+                    )
+                )
+            elif tag == "reset":
+                await self._run_on_device(
+                    partial(
+                        self._dev_reset,
+                        p["tokens"], p["positions"], p["seq_lens"],
+                        p["page_tables"], p["temps"], p["top_ks"], p["top_ps"],
+                    )
+                )
+            elif tag == "block":
+                await self._run_on_device(self._dev_block)
+            elif tag == "inject":
+                await self._run_on_device(
+                    partial(self._dev_inject, p["page_ids"], p["k"], p["v"])
+                )
+            elif tag == "extract":
+                await self._run_on_device(partial(self._dev_extract, p["page_ids"]))
+            else:
+                logger.warning("unknown step tag %r", tag)
+
     # -- injections (disagg preload / KVBM onboard) ---------------------- #
 
     async def _run_injections(self) -> bool:
@@ -543,18 +693,8 @@ class JaxEngine:
         first_token, k_np, v_np, n_tokens = slot.preloaded
         slot.preloaded = None
         page_ids = np.array([p + 1 for p in slot.pages], np.int32)
-
-        def run_inject():
-            kv_k, kv_v = self._inject_pages(
-                self.kv_k,
-                self.kv_v,
-                jnp.asarray(page_ids),
-                jnp.asarray(k_np),
-                jnp.asarray(v_np),
-            )
-            return kv_k, kv_v
-
-        self.kv_k, self.kv_v = await self._run_on_device(run_inject)
+        self._bcast("inject", {"page_ids": page_ids, "k": np.asarray(k_np), "v": np.asarray(v_np)})
+        await self._run_on_device(partial(self._dev_inject, page_ids, k_np, v_np))
         # transferred prompt KV is now reusable: publish it to the prefix cache
         self._commit_blocks(slot)
         slot.prefill_pos = len(slot.prompt)
@@ -587,18 +727,8 @@ class JaxEngine:
         k_np = k_np.swapaxes(0, 1)
         v_np = v_np.swapaxes(0, 1)
         phys = np.array([p + 1 for p in alloc_pages], np.int32)  # scratch shift
-
-        def run_inject():
-            kv_k, kv_v = self._inject_pages(
-                self.kv_k,
-                self.kv_v,
-                jnp.asarray(phys),
-                jnp.asarray(k_np),
-                jnp.asarray(v_np),
-            )
-            return kv_k, kv_v
-
-        self.kv_k, self.kv_v = await self._run_on_device(run_inject)
+        self._bcast("inject", {"page_ids": phys, "k": k_np, "v": v_np})
+        await self._run_on_device(partial(self._dev_inject, phys, k_np, v_np))
         n_known = len(slot.committed_hashes)
         token_blocks = [
             b.tokens for b in slot.seq.blocks[n_known : n_known + len(hashes)]
@@ -682,27 +812,19 @@ class JaxEngine:
             top_ps[lane] = s.top_p
             meta.append((s, chunk, lane))
 
-        def run_prefill():
-            samp = SamplingParams(
-                temperature=jnp.asarray(temps),
-                top_k=jnp.asarray(top_ks),
-                top_p=jnp.asarray(top_ps),
+        self._bcast(
+            "prefill",
+            {
+                "toks": toks, "positions": positions, "tables": tables,
+                "ctx_lens": ctx_lens, "last_idx": last_idx, "temps": temps,
+                "top_ks": top_ks, "top_ps": top_ps,
+            },
+        )
+        first_dev = await self._run_on_device(
+            partial(
+                self._dev_prefill,
+                toks, positions, tables, ctx_lens, last_idx, temps, top_ks, top_ps,
             )
-            return self._prefill_batch(
-                self.params,
-                self.kv_k,
-                self.kv_v,
-                jnp.asarray(toks),
-                jnp.asarray(positions),
-                jnp.asarray(tables),
-                jnp.asarray(ctx_lens),
-                jnp.asarray(last_idx),
-                samp,
-                self._rng,
-            )
-
-        first_dev, self.kv_k, self.kv_v, self._rng = await self._run_on_device(
-            run_prefill
         )
         completions = []
         for s, chunk, lane in meta:
@@ -749,11 +871,8 @@ class JaxEngine:
             [p + 1 for p in slot.pages[:n_prompt_pages]], np.int32
         )  # +1 scratch shift
 
-        def run_extract():
-            return self._extract_pages(self.kv_k, self.kv_v, jnp.asarray(page_ids))
-
-        k_dev, v_dev = await self._run_on_device(run_extract)
-        k_np, v_np = await self._fetch((k_dev, v_dev))
+        self._bcast("extract", {"page_ids": page_ids})
+        k_np, v_np = await self._run_on_device(partial(self._dev_extract, page_ids))
         payload = pack_kv_payload(k_np, v_np, len(slot.prompt), cfg.page_size)
         if not slot.done:
             out = LLMEngineOutput(
@@ -875,52 +994,27 @@ class JaxEngine:
             positions = np.where(mask, self.seq_lens - 1, 0).astype(np.int32)
             seq_lens_step = np.where(mask, self.seq_lens, 0).astype(np.int32)
             tokens = np.where(mask, self.tokens, 0).astype(np.int32)
-
-            def upload():
-                samp = SamplingParams(
-                    temperature=jnp.asarray(self.temps),
-                    top_k=jnp.asarray(self.top_ks),
-                    top_p=jnp.asarray(self.top_ps),
+            self._bcast(
+                "reset",
+                {
+                    "tokens": tokens, "positions": positions,
+                    "seq_lens": seq_lens_step, "page_tables": self.page_tables,
+                    "temps": self.temps, "top_ks": self.top_ks,
+                    "top_ps": self.top_ps,
+                },
+            )
+            await self._run_on_device(
+                partial(
+                    self._dev_reset,
+                    tokens, positions, seq_lens_step,
+                    self.page_tables.copy(), self.temps.copy(),
+                    self.top_ks.copy(), self.top_ps.copy(),
                 )
-                return (
-                    jnp.asarray(tokens),
-                    jnp.asarray(positions),
-                    jnp.asarray(seq_lens_step),
-                    jnp.asarray(self.page_tables),
-                    samp,
-                )
-
-            tok_d, pos_d, sl_d, tab_d, samp_d = await self._run_on_device(upload)
-            self._carry = (tok_d, pos_d, sl_d)
-            self._tables_dev = tab_d
-            self._samp_dev = samp_d
+            )
             self._carry_valid = True
 
-        carry = self._carry
-
-        def run_block():
-            return self._decode_block(
-                self.params,
-                self.kv_k,
-                self.kv_v,
-                carry[0],
-                carry[1],
-                carry[2],
-                self._tables_dev,
-                self._samp_dev,
-                self._rng,
-            )
-
-        (
-            toks_dev,
-            tok_d,
-            pos_d,
-            sl_d,
-            self.kv_k,
-            self.kv_v,
-            self._rng,
-        ) = await self._run_on_device(run_block)
-        self._carry = (tok_d, pos_d, sl_d)
+        self._bcast("block", {})
+        toks_dev = await self._run_on_device(self._dev_block)
         self._inflight.append(
             {"lanes": [(i, self.slots[i]) for i in active], "toks": toks_dev}
         )

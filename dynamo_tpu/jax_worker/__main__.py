@@ -48,12 +48,38 @@ def parse_args():
     ap.add_argument("--prefill-component", default="prefill")
     ap.add_argument("--disagg-threshold", type=int, default=64,
                     help="remote prefill iff uncached prompt tokens exceed this")
+    # multi-host slice (reference: vLLM node orchestration, main.py:64-296).
+    # All hosts run this same module; host 0 owns the control plane and
+    # broadcasts step descriptors; hosts >0 replay them (SPMD).
+    ap.add_argument("--num-hosts", type=int, default=1)
+    ap.add_argument("--host-id", type=int, default=0)
+    ap.add_argument("--coordinator", default=None,
+                    help="jax.distributed coordinator host:port (required for --num-hosts > 1)")
+    ap.add_argument("--spmd-port", type=int, default=17300,
+                    help="host-0 step-descriptor fan-out port")
     return ap.parse_args()
 
 
 async def main():
     init_logging()
     args = parse_args()
+
+    multihost = args.num_hosts > 1
+    spmd = None
+    if multihost:
+        if not args.coordinator:
+            raise SystemExit("--coordinator is required with --num-hosts > 1")
+        from dynamo_tpu.parallel.multihost import (
+            StepBroadcaster,
+            StepReceiver,
+            init_multihost,
+        )
+
+        # must run before ANY other jax call on every host
+        init_multihost(args.coordinator, args.num_hosts, args.host_id)
+        if args.host_id == 0:
+            spmd = StepBroadcaster("0.0.0.0", args.spmd_port, args.num_hosts - 1)
+            await spmd.start()
 
     engine_cfg = EngineConfig(
         model=args.model,
@@ -70,7 +96,8 @@ async def main():
     kv_sharding = None
     params = None
     model_config = None
-    if args.tp_size > 1 or args.ep_size > 1 or args.model_path:
+    mesh = None
+    if args.tp_size > 1 or args.ep_size > 1 or args.model_path or multihost:
         from dynamo_tpu.models import llama, moe
         from dynamo_tpu.parallel.mesh import (
             LlamaShardings,
@@ -87,7 +114,7 @@ async def main():
         is_moe = isinstance(model_config, moe.MoeConfig)
         model_mod = moe if is_moe else llama
         shardings = None
-        if args.tp_size > 1 or args.ep_size > 1:
+        if args.tp_size > 1 or args.ep_size > 1 or multihost:
             mesh = build_mesh(
                 ParallelConfig(tp_size=args.tp_size, ep_size=args.ep_size)
             )
@@ -116,8 +143,28 @@ async def main():
         model_config=model_config,
         params=params,
         kv_sharding=kv_sharding,
-        event_sink=pending_events.append,
+        event_sink=pending_events.append if args.host_id == 0 else None,
+        mesh=mesh,
+        spmd=spmd,
+        multihost=multihost,
     )
+
+    if multihost and args.host_id != 0:
+        # follower host: no discovery, no endpoint, no KV events (host-0
+        # ownership) — replay the leader's dispatch stream until shutdown
+        leader_host = args.coordinator.rsplit(":", 1)[0]
+        receiver = StepReceiver(leader_host, args.spmd_port)
+        await receiver.connect()
+        logger.info(
+            "jax follower host %d/%d connected to leader %s:%d",
+            args.host_id, args.num_hosts, leader_host, args.spmd_port,
+        )
+        await engine.run_follower(receiver)
+        return
+
+    if spmd is not None:
+        logger.info("waiting for %d follower host(s)", args.num_hosts - 1)
+        await spmd.wait_for_followers()
 
     cfg = RuntimeConfig.from_settings()
     if args.discovery:

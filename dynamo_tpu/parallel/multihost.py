@@ -1,0 +1,183 @@
+"""Multi-host SPMD support: leader-driven step replication.
+
+The reference scales a worker across nodes through the engine's own
+launcher (vLLM node orchestration, components/backends/vllm/src/dynamo/
+vllm/main.py:64-296: node rank 0 registers the endpoint, other ranks join
+the engine's distributed group). The TPU-native equivalent (SURVEY.md §7
+hard part (d)):
+
+  * every host of a slice runs the SAME process image and calls
+    `jax.distributed.initialize` — jax sees one global device set, and
+    every jitted program over mesh-sharded arrays must be entered by ALL
+    hosts in the SAME order (SPMD).
+  * ONLY host 0 talks to the control plane: discovery registration,
+    request endpoint, KV events, metrics (per-host KV-event ownership =
+    host 0).
+  * host 0 runs the real engine scheduler; every device dispatch it makes
+    is first broadcast as a compact STEP DESCRIPTOR (tag + numpy args)
+    over a TCP fan-out; follower hosts replay the identical dispatch
+    sequence against their engine replica. Host-side scheduling stays in
+    exactly one place, so there is no cross-host nondeterminism to keep
+    in lockstep — the only contract is "followers apply descriptors in
+    order", which a single TCP stream per follower gives for free.
+
+Tested without TPU hardware by a 2-process CPU run (gloo collectives):
+tests/test_multihost.py.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import struct
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import msgpack
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_MAGIC = 0xD7A0517E
+
+
+@dataclass
+class MultihostInfo:
+    process_index: int
+    num_processes: int
+
+    @property
+    def is_primary(self) -> bool:
+        return self.process_index == 0
+
+
+def init_multihost(
+    coordinator: str,
+    num_processes: int,
+    process_id: int,
+    local_device_ids: Optional[List[int]] = None,
+) -> MultihostInfo:
+    """`jax.distributed.initialize` wrapper (idempotent for tests)."""
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )
+    return MultihostInfo(process_index=process_id, num_processes=num_processes)
+
+
+def _pack_step(tag: str, arrays: Dict[str, np.ndarray]) -> bytes:
+    payload = {
+        "tag": tag,
+        "arrays": {
+            k: {
+                "dtype": str(v.dtype),
+                "shape": list(v.shape),
+                "data": np.ascontiguousarray(v).tobytes(),
+            }
+            for k, v in arrays.items()
+        },
+    }
+    body = msgpack.packb(payload, use_bin_type=True)
+    return struct.pack("<II", _MAGIC, len(body)) + body
+
+
+def _unpack_step(body: bytes) -> Tuple[str, Dict[str, np.ndarray]]:
+    payload = msgpack.unpackb(body, raw=False)
+    arrays = {
+        k: np.frombuffer(v["data"], dtype=np.dtype(v["dtype"])).reshape(v["shape"])
+        for k, v in payload["arrays"].items()
+    }
+    return payload["tag"], arrays
+
+
+class StepBroadcaster:
+    """Host-0 side: accepts follower connections, fans out step descriptors
+    in dispatch order. `wait_for_followers` gates serving until the whole
+    slice is connected."""
+
+    def __init__(self, host: str, port: int, expected_followers: int):
+        self.host = host
+        self.port = port
+        self.expected = expected_followers
+        self._writers: List[asyncio.StreamWriter] = []
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._connected = asyncio.Event()
+        if expected_followers == 0:
+            self._connected.set()
+
+    async def start(self):
+        self._server = await asyncio.start_server(
+            self._on_connect, self.host, self.port
+        )
+
+    async def _on_connect(self, reader, writer):
+        self._writers.append(writer)
+        logger.info(
+            "follower connected (%d/%d)", len(self._writers), self.expected
+        )
+        if len(self._writers) >= self.expected:
+            self._connected.set()
+
+    async def wait_for_followers(self, timeout: float = 120.0):
+        await asyncio.wait_for(self._connected.wait(), timeout)
+
+    def send(self, tag: str, arrays: Dict[str, np.ndarray]):
+        """Non-blocking ordered fan-out (called before the local dispatch)."""
+        if not self._writers:
+            return
+        frame = _pack_step(tag, arrays)
+        for w in self._writers:
+            if not w.is_closing():
+                w.write(frame)
+
+    async def drain(self):
+        for w in self._writers:
+            if not w.is_closing():
+                await w.drain()
+
+    async def close(self):
+        self.send("stop", {})
+        await self.drain()
+        for w in self._writers:
+            w.close()
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+
+class StepReceiver:
+    """Follower side: ordered step descriptor stream from host 0."""
+
+    def __init__(self, host: str, port: int):
+        self.host = host
+        self.port = port
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+
+    async def connect(self, retries: int = 60, delay: float = 0.5):
+        for attempt in range(retries):
+            try:
+                self._reader, self._writer = await asyncio.open_connection(
+                    self.host, self.port
+                )
+                return
+            except OSError:
+                if attempt == retries - 1:
+                    raise
+                await asyncio.sleep(delay)
+
+    async def recv(self) -> Tuple[str, Dict[str, np.ndarray]]:
+        header = await self._reader.readexactly(8)
+        magic, length = struct.unpack("<II", header)
+        if magic != _MAGIC:
+            raise RuntimeError(f"bad step frame magic {magic:#x}")
+        body = await self._reader.readexactly(length)
+        return _unpack_step(body)
+
+    async def close(self):
+        if self._writer is not None:
+            self._writer.close()
