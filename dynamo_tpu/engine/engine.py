@@ -104,7 +104,9 @@ class _Slot:
     done: bool = False
     resume_token: Optional[int] = None  # preempted: continue with this token
     return_kv: bool = False  # prefill role: ship KV pages with the 1st token
+    kv_pull: bool = False  # prefill role: caller can pull via the data plane
     preloaded: Optional[tuple] = None  # decode role: (first_tok, k, v, n_tokens)
+    pull_desc: Optional[dict] = None  # decode role: pull-path descriptor
     onboard: Optional[tuple] = None  # KVBM tier hit: (alloc_pages, hashes)
 
 
@@ -188,6 +190,9 @@ class JaxEngine:
         self._waiting: List[_Slot] = []
         self._step_task: Optional[asyncio.Task] = None
         self._wake = asyncio.Event()
+        # optional llm.kv_transfer.KvDataPlaneServer (worker attaches it):
+        # enables the descriptor/pull disagg path instead of inline payloads
+        self.data_plane = None
         self._closed = False
         self._rng = jax.random.PRNGKey(config.seed + 1)
         self._step_counter = 0
@@ -348,6 +353,7 @@ class JaxEngine:
         slot = self._new_slot(req, context)
         disagg = req.disagg_params or {}
         slot.return_kv = bool(disagg.get("return_kv"))
+        slot.kv_pull = bool(disagg.get("kv_pull"))
         self.num_requests += 1
         self._waiting.append(slot)
         self._wake.set()
@@ -382,6 +388,35 @@ class JaxEngine:
         )
         slot = self._new_slot(req, context, suffix="-d")
         slot.preloaded = (first_token, kv_k_pages, kv_v_pages, n_tokens)
+        self.num_requests += 1
+        self._waiting.append(slot)
+        self._wake.set()
+        try:
+            while True:
+                item = await slot.queue.get()
+                if item is None:
+                    return
+                yield item
+        finally:
+            slot.done = True
+            self._wake.set()
+
+    async def generate_decode_from_pull(
+        self, request: Any, context: Context, first_token: int, desc: dict
+    ) -> AsyncIterator[dict]:
+        """Disagg decode entry, pull path: the prefill worker staged the KV
+        on its data plane; we allocate pages, then stream-inject chunks while
+        the decode batch keeps stepping (transfer/compute overlap). Falls
+        back to local prefill if the pull dies."""
+        self.start()
+        req = (
+            request
+            if isinstance(request, PreprocessedRequest)
+            else PreprocessedRequest.from_dict(request)
+        )
+        slot = self._new_slot(req, context, suffix="-d")
+        slot.preloaded = (first_token, None, None, int(desc["n_tokens"]))
+        slot.pull_desc = desc
         self.num_requests += 1
         self._waiting.append(slot)
         self._wake.set()
@@ -692,10 +727,22 @@ class JaxEngine:
         the decode batch as if we had prefilled locally."""
         first_token, k_np, v_np, n_tokens = slot.preloaded
         slot.preloaded = None
+        if slot.pull_desc is not None:
+            # pull path: stream chunks in a background task — the decode
+            # batch keeps stepping while later pages are still in flight
+            desc = slot.pull_desc
+            slot.pull_desc = None
+            asyncio.create_task(self._pull_kv_task(slot, desc, first_token))
+            return
         page_ids = np.array([p + 1 for p in slot.pages], np.int32)
         self._bcast("inject", {"page_ids": page_ids, "k": np.asarray(k_np), "v": np.asarray(v_np)})
         await self._run_on_device(partial(self._dev_inject, page_ids, k_np, v_np))
-        # transferred prompt KV is now reusable: publish it to the prefix cache
+        self._activate_transferred(slot, first_token)
+
+    def _activate_transferred(self, slot: _Slot, first_token: int):
+        """All prompt KV is in our pages: publish to the prefix cache and
+        enter the decode batch (first token was emitted by the prefill
+        worker — not re-emitted)."""
         self._commit_blocks(slot)
         slot.prefill_pos = len(slot.prompt)
         slot.generated = 1
@@ -705,6 +752,51 @@ class JaxEngine:
         self.seq_lens[slot.slot_idx] = len(slot.prompt) + 1
         self._carry_valid = False
         self._maybe_finish(slot, first_token)
+
+    async def _pull_kv_task(self, slot: _Slot, desc_dict: dict, first_token: int):
+        """Stream KV chunks from the staging prefill worker, injecting each
+        as it lands. Any failure falls back to computing the prompt KV
+        locally, resuming from the already-emitted first token — disagg
+        stays strictly an optimization."""
+        from ..llm.kv_transfer import KvTransferDescriptor, pull_kv
+
+        desc = KvTransferDescriptor.from_dict(desc_dict)
+        phys = np.array([p + 1 for p in slot.pages], np.int32)
+
+        async def inject(off: int, n: int, k, v):
+            if (
+                slot.done
+                or self._closed
+                or slot.slot_idx < 0
+                or self.slots[slot.slot_idx] is not slot
+            ):
+                raise asyncio.CancelledError("slot released mid-pull")
+            ids = phys[off : off + n]
+            if self._spmd is not None:
+                self._bcast("inject", {"page_ids": ids, "k": np.asarray(k), "v": np.asarray(v)})
+            await self._run_on_device(partial(self._dev_inject, ids, k, v))
+
+        try:
+            await pull_kv(desc, inject)
+        except asyncio.CancelledError:
+            return
+        except Exception as e:  # noqa: BLE001 — any pull failure -> local fallback
+            if slot.done or slot.slot_idx < 0 or self.slots[slot.slot_idx] is not slot:
+                return
+            logger.warning(
+                "kv pull for %s failed (%s); prefilling locally", slot.request_id, e
+            )
+            slot.generated = 1
+            slot.last_token = first_token
+            slot.seq.append(first_token)
+            slot.resume_token = first_token
+            slot.prefill_pos = 0
+            self._wake.set()
+            return
+        if slot.done or slot.slot_idx < 0 or self.slots[slot.slot_idx] is not slot:
+            return
+        self._activate_transferred(slot, first_token)
+        self._wake.set()
 
     async def _inject_onboard(self, slot: _Slot):
         """KVBM onboard: scatter G2/G3 blocks into the freshly allocated
@@ -870,6 +962,16 @@ class JaxEngine:
         page_ids = np.array(
             [p + 1 for p in slot.pages[:n_prompt_pages]], np.int32
         )  # +1 scratch shift
+        # the computed prompt KV is valid — publish full blocks to our own
+        # prefix cache so repeat prefills of shared prefixes are free
+        self._commit_blocks(slot)
+
+        if slot.kv_pull and self.data_plane is not None and not slot.done:
+            # fast path: stage the pages on the data plane and return only a
+            # descriptor — the decode worker pulls chunks while we keep
+            # serving; pages stay pinned until the pull finishes (or TTL)
+            self._stage_kv_pull(slot, first_token, page_ids)
+            return
 
         self._bcast("extract", {"page_ids": page_ids})
         k_np, v_np = await self._run_on_device(partial(self._dev_extract, page_ids))
@@ -884,6 +986,52 @@ class JaxEngine:
             slot.queue.put_nowait(None)
             slot.done = True
         self._release_slot(slot)
+
+    def _stage_kv_pull(self, slot: _Slot, first_token: int, page_ids: np.ndarray):
+        """Pin the finished prefill's pages on the data plane and answer with
+        a descriptor. The extract callback gathers page CHUNKS lazily as the
+        decode worker pulls, so the device gather overlaps the network (and
+        on the in-process path never leaves the device)."""
+        import jax.numpy as jnp
+
+        c = self.model_config
+        cfg = self.config
+
+        async def extract(off: int, n: int, device: bool):
+            ids = page_ids[off : off + n]
+            self._bcast("extract", {"page_ids": ids})
+            if device and not self._multihost:
+                # in-process path: hand over device arrays, no host staging
+                return await self._run_on_device(
+                    lambda: self._extract_pages(self.kv_k, self.kv_v, jnp.asarray(ids))
+                )
+            return await self._run_on_device(partial(self._dev_extract, ids))
+
+        def on_done(ok: bool):
+            if not ok:
+                logger.warning(
+                    "kv pull for %s abandoned; releasing pages", slot.request_id
+                )
+            self._release_slot(slot)
+
+        desc = self.data_plane.stage(
+            n_pages=int(len(page_ids)),
+            n_tokens=len(slot.prompt),
+            page_size=cfg.page_size,
+            page_shape=[c.num_layers, cfg.page_size, c.num_kv_heads, c.head_dim],
+            dtype=str(jnp.zeros((), c.dtype).dtype),
+            extract=extract,
+            on_done=on_done,
+        )
+        out = LLMEngineOutput(
+            token_ids=[first_token],
+            finish_reason="remote_prefill_done",
+            kv_transfer_params={"pull": desc.to_dict()},
+        ).to_dict()
+        slot.queue.put_nowait(Annotated(data=out).to_dict())
+        slot.queue.put_nowait(None)
+        slot.done = True
+        # NOT released here: pages stay pinned until on_done (pull or TTL)
 
     def _commit_blocks(self, slot: _Slot):
         """Bind filled prompt pages to their hashes -> prefix cache + events."""

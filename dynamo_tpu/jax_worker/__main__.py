@@ -57,6 +57,14 @@ def parse_args():
                     help="jax.distributed coordinator host:port (required for --num-hosts > 1)")
     ap.add_argument("--spmd-port", type=int, default=17300,
                     help="host-0 step-descriptor fan-out port")
+    # KV data plane (llm/kv_transfer.py — the NIXL-replacement fast path):
+    # prefill-capable workers stage finished prompts here for pulling
+    ap.add_argument("--kv-data-plane-port", type=int, default=0,
+                    help="KV data plane listen port (0 = ephemeral)")
+    ap.add_argument("--kv-data-plane-host", default=None,
+                    help="advertised data plane host (defaults to local)")
+    ap.add_argument("--no-kv-data-plane", action="store_true",
+                    help="disable the pull data plane (inline KV payloads)")
     return ap.parse_args()
 
 
@@ -166,10 +174,23 @@ async def main():
         logger.info("waiting for %d follower host(s)", args.num_hosts - 1)
         await spmd.wait_for_followers()
 
+    data_plane = None
+    if args.role in ("prefill", "aggregated") and not args.no_kv_data_plane:
+        from dynamo_tpu.llm.kv_transfer import KvDataPlaneServer
+
+        data_plane = KvDataPlaneServer(
+            advertise_host=args.kv_data_plane_host, port=args.kv_data_plane_port
+        )
+        await data_plane.start()
+        engine.data_plane = data_plane
+        logger.info("kv data plane listening on %s", data_plane.addr)
+
     cfg = RuntimeConfig.from_settings()
     if args.discovery:
         cfg.discovery_endpoint = args.discovery
     drt = await DistributedRuntime.create(cfg)
+    if data_plane is not None:
+        await data_plane.register(drt)
     component = args.prefill_component if args.role == "prefill" else args.component
     endpoint = drt.namespace(args.namespace).component(component).endpoint(args.endpoint)
 

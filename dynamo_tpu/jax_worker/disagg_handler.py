@@ -50,7 +50,10 @@ async def maybe_remote_prefill(
     orig_max_tokens = int(stop.get("max_tokens") or 128)
     stop["max_tokens"] = 1
     prefill_req["stop_conditions"] = stop
-    prefill_req["disagg_params"] = {"return_kv": True}
+    # kv_pull: we can pull from the prefill worker's data plane (descriptor
+    # rendezvous instead of an inline payload); workers without a data plane
+    # answer inline anyway, so this is a capability hint, not a demand
+    prefill_req["disagg_params"] = {"return_kv": True, "kv_pull": True}
 
     first_token = None
     kv_payload = None
@@ -75,10 +78,18 @@ async def maybe_remote_prefill(
 
     if want_annotation:
         yield {"event": "remote_prefill", "comment": ["true"]}
-    kv_k, kv_v, n_tokens = unpack_kv_payload(kv_payload)
     # emit the prefill-produced first token to the caller
     yield Annotated(data=LLMEngineOutput(token_ids=[first_token]).to_dict()).to_dict()
-    async for item in engine.generate_decode_from_kv(
-        request, context, first_token, kv_k, kv_v, n_tokens
-    ):
+    if "pull" in kv_payload:
+        # fast path: descriptor only — stream-inject from the prefill
+        # worker's data plane while the decode batch keeps stepping
+        stream = engine.generate_decode_from_pull(
+            request, context, first_token, kv_payload["pull"]
+        )
+    else:
+        kv_k, kv_v, n_tokens = unpack_kv_payload(kv_payload)
+        stream = engine.generate_decode_from_kv(
+            request, context, first_token, kv_k, kv_v, n_tokens
+        )
+    async for item in stream:
         yield item

@@ -1,0 +1,338 @@
+"""KV-cache data plane: the NIXL replacement's fast path.
+
+The reference moves prefill→decode KV via NIXL RDMA: the prefill side
+registers memory and advertises descriptors, the decode side pulls with
+`begin_read` while its engine keeps stepping
+(lib/bindings/python/src/dynamo/nixl_connect/__init__.py:501-723,
+lib/llm/src/block_manager/storage/nixl.rs). The TPU-native equivalent here
+keeps the same *shape* — descriptor rendezvous + receiver-driven pull +
+transfer/compute overlap — with transports that fit TPU hosts:
+
+  * **staged pull over a dedicated TCP data plane**: the prefill worker
+    runs a `KvDataPlaneServer` on its own port (NOT the request plane — a
+    streaming KV payload must never head-of-line-block token traffic).
+    Finishing a remote prefill *stages* the slot's pages and returns only a
+    small descriptor on the response stream; the decode worker connects and
+    pulls page CHUNKS, injecting each into its own cache while later chunks
+    are still in flight. Frames carry raw page bytes (length-prefixed, no
+    msgpack of the bulk) written straight from the array's memoryview.
+  * **in-process device path**: when both engines share a process (one
+    host serving both roles, or tests), the descriptor resolves through a
+    process-local registry and chunks hand over as device arrays —
+    extract→inject without host serialization. A multi-slice deployment
+    whose prefill+decode meshes share one jax.distributed world can swap
+    this transport for ppermute/DCN collectives behind the same interface.
+
+Descriptors are also advertised under `v1/kv_data_plane/<instance>` in
+discovery (the NIXL-metadata-in-etcd rendezvous, docs/architecture/
+dynamo_flow.md S8/S10), so any worker can locate a peer's data plane
+without a request-plane hop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import secrets
+import struct
+import time
+from dataclasses import dataclass, field
+from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
+
+import msgpack
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_MAGIC = 0xD7A04B1D  # frame magic
+_HDR = struct.Struct("<II")  # magic, header length
+
+DATA_PLANE_ROOT = "v1/kv_data_plane/"
+
+# process-local rendezvous: (addr, transfer_id) -> _Staged. The in-process
+# device-direct path (co-located prefill/decode engines) resolves here and
+# never touches the socket.
+_LOCAL: Dict[Tuple[str, str], "_Staged"] = {}
+
+
+def _np_bytes(a: np.ndarray) -> memoryview:
+    """Zero-copy view of an array's bytes (contiguous arrays only)."""
+    a = np.ascontiguousarray(a)
+    return a.reshape(-1).view(np.uint8).data
+
+
+def _np_dtype(name: str):
+    if name == "bfloat16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+@dataclass
+class KvTransferDescriptor:
+    """What rides the response stream instead of the KV payload (the NIXL
+    descriptor role)."""
+
+    transfer_id: str
+    addr: str  # host:port of the staging worker's data plane
+    n_pages: int
+    n_tokens: int
+    page_size: int
+    page_shape: list  # per-page block shape [L, page, KH, D]; a chunk of n
+    # pages is layer-major [L, n, page, KH, D] (the engine's KV layout)
+    dtype: str
+    chunk_pages: int
+
+    def to_dict(self) -> dict:
+        return dict(self.__dict__)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "KvTransferDescriptor":
+        return cls(**d)
+
+
+# extract(page_offset, n_pages, device) -> (k, v) with leading dim n_pages;
+# may return jax arrays when device=True (in-process path)
+ExtractFn = Callable[[int, int, bool], Awaitable[Tuple[Any, Any]]]
+
+
+@dataclass
+class _Staged:
+    desc: KvTransferDescriptor
+    extract: ExtractFn
+    on_done: Callable[[bool], None]  # called exactly once; arg = pulled ok
+    deadline: float
+    started: bool = False
+    finished: bool = False
+
+    def finish(self, ok: bool):
+        if not self.finished:
+            self.finished = True
+            try:
+                self.on_done(ok)
+            except Exception:  # noqa: BLE001 — release callbacks must not kill the server
+                logger.exception("kv transfer on_done failed")
+
+
+class KvDataPlaneServer:
+    """Prefill-side staging server: holds pinned transfers, streams chunks
+    to pulling peers, reaps abandoned transfers so their pages free."""
+
+    def __init__(self, host: str = "0.0.0.0", advertise_host: Optional[str] = None,
+                 port: int = 0, ttl: float = 30.0):
+        self._host = host
+        self._advertise_host = advertise_host or ("127.0.0.1" if host in ("0.0.0.0", "") else host)
+        self._port = port
+        self.ttl = ttl
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._staged: Dict[str, _Staged] = {}
+        self._reaper: Optional[asyncio.Task] = None
+
+    @property
+    def addr(self) -> str:
+        return f"{self._advertise_host}:{self._port}"
+
+    async def start(self):
+        self._server = await asyncio.start_server(self._serve, self._host, self._port)
+        self._port = self._server.sockets[0].getsockname()[1]
+        self._reaper = asyncio.create_task(self._reap_loop())
+
+    async def close(self):
+        if self._reaper is not None:
+            self._reaper.cancel()
+        for t in list(self._staged.values()):
+            self._unstage(t, ok=False)
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def register(self, drt):
+        """Advertise this data plane in discovery (NIXL-metadata rendezvous)."""
+        import json
+
+        try:
+            await drt.put_leased(
+                f"{DATA_PLANE_ROOT}{drt.instance_id:x}",
+                json.dumps({"addr": self.addr}).encode(),
+            )
+        except Exception:  # noqa: BLE001 — advertisement is best-effort
+            logger.warning("could not advertise kv data plane", exc_info=True)
+
+    def stage(
+        self,
+        *,
+        n_pages: int,
+        n_tokens: int,
+        page_size: int,
+        page_shape: list,
+        dtype: str,
+        extract: ExtractFn,
+        on_done: Callable[[bool], None],
+        chunk_pages: int = 0,
+        ttl: Optional[float] = None,
+    ) -> KvTransferDescriptor:
+        """Pin a finished prefill's pages for pulling; returns the descriptor
+        to send on the response stream. `on_done(ok)` fires exactly once —
+        on successful pull, pull failure, or TTL expiry — and is where the
+        engine releases the slot's pages."""
+        if chunk_pages <= 0:
+            # ~4 MiB/chunk of K (plus V): small enough to overlap, large
+            # enough that framing cost vanishes
+            per_page = int(np.prod(page_shape)) * _np_dtype(dtype).itemsize
+            chunk_pages = max(1, (4 << 20) // max(per_page, 1))
+        transfer_id = secrets.token_hex(8)
+        desc = KvTransferDescriptor(
+            transfer_id=transfer_id,
+            addr=self.addr,
+            n_pages=n_pages,
+            n_tokens=n_tokens,
+            page_size=page_size,
+            page_shape=list(page_shape),
+            dtype=dtype,
+            chunk_pages=chunk_pages,
+        )
+        staged = _Staged(
+            desc=desc,
+            extract=extract,
+            on_done=on_done,
+            deadline=time.monotonic() + (ttl if ttl is not None else self.ttl),
+        )
+        self._staged[transfer_id] = staged
+        _LOCAL[(self.addr, transfer_id)] = staged
+        return desc
+
+    def _unstage(self, staged: _Staged, ok: bool):
+        self._staged.pop(staged.desc.transfer_id, None)
+        _LOCAL.pop((self.addr, staged.desc.transfer_id), None)
+        staged.finish(ok)
+
+    async def _reap_loop(self):
+        while True:
+            await asyncio.sleep(1.0)
+            now = time.monotonic()
+            for t in list(self._staged.values()):
+                if not t.started and now > t.deadline:
+                    logger.warning(
+                        "kv transfer %s never pulled; releasing", t.desc.transfer_id
+                    )
+                    self._unstage(t, ok=False)
+
+    async def _serve(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        try:
+            hdr = await reader.readexactly(_HDR.size)
+            magic, length = _HDR.unpack(hdr)
+            if magic != _MAGIC:
+                raise RuntimeError(f"bad kv data plane magic {magic:#x}")
+            transfer_id = (await reader.readexactly(length)).decode()
+            staged = self._staged.get(transfer_id)
+            if staged is None or staged.started:
+                await self._send_header(writer, {"error": f"unknown transfer {transfer_id}"})
+                return
+            staged.started = True
+            try:
+                await self._stream(staged, writer)
+            except (ConnectionError, asyncio.IncompleteReadError):
+                self._unstage(staged, ok=False)
+                raise
+            self._unstage(staged, ok=True)
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass  # peer vanished; reaper/unstage already handled pages
+        except Exception:  # noqa: BLE001 — one bad peer must not kill the server
+            logger.exception("kv data plane connection failed")
+        finally:
+            writer.close()
+
+    async def _send_header(self, writer, header: dict):
+        body = msgpack.packb(header, use_bin_type=True)
+        writer.write(_HDR.pack(_MAGIC, len(body)) + body)
+        await writer.drain()
+
+    async def _stream(self, staged: _Staged, writer: asyncio.StreamWriter):
+        desc = staged.desc
+        # prefetch pipeline depth 1: extract chunk i+1 while chunk i drains
+        # into the socket — the extract (device gather + host read) overlaps
+        # the network transfer
+        np_dtype = _np_dtype(desc.dtype)
+
+        async def get(off: int):
+            n = min(desc.chunk_pages, desc.n_pages - off)
+            k, v = await staged.extract(off, n, False)
+            return off, n, np.asarray(k, np_dtype), np.asarray(v, np_dtype)
+
+        nxt = asyncio.ensure_future(get(0)) if desc.n_pages else None
+        while nxt is not None:
+            off, n, k, v = await nxt
+            after = off + n
+            nxt = asyncio.ensure_future(get(after)) if after < desc.n_pages else None
+            kb, vb = _np_bytes(k), _np_bytes(v)
+            await self._send_header(
+                writer,
+                {"off": off, "n": n, "k_bytes": len(kb), "v_bytes": len(vb)},
+            )
+            writer.write(kb)
+            writer.write(vb)
+            await writer.drain()
+        await self._send_header(writer, {"eof": True})
+
+
+# inject(page_offset, n_pages, k, v) — awaited per chunk as it lands
+InjectFn = Callable[[int, int, Any, Any], Awaitable[None]]
+
+
+async def pull_kv(
+    desc: KvTransferDescriptor,
+    inject: InjectFn,
+    connect_timeout: float = 10.0,
+) -> None:
+    """Decode-side pull: stream chunks from the staging peer and inject each
+    while the rest are still in flight. Raises on any failure (caller falls
+    back to local prefill). In-process transfers short-circuit through the
+    local registry and stay on device."""
+    staged = _LOCAL.get((desc.addr, desc.transfer_id))
+    if staged is not None and not staged.started:
+        staged.started = True
+        try:
+            off = 0
+            while off < desc.n_pages:
+                n = min(desc.chunk_pages, desc.n_pages - off)
+                k, v = await staged.extract(off, n, True)
+                await inject(off, n, k, v)
+                off += n
+        except BaseException:
+            staged.finish(False)
+            raise
+        finally:
+            _LOCAL.pop((desc.addr, desc.transfer_id), None)
+        staged.finish(True)
+        return
+
+    host, port = desc.addr.rsplit(":", 1)
+    reader, writer = await asyncio.wait_for(
+        asyncio.open_connection(host, int(port)), connect_timeout
+    )
+    try:
+        tid = desc.transfer_id.encode()
+        writer.write(_HDR.pack(_MAGIC, len(tid)) + tid)
+        await writer.drain()
+        np_dtype = _np_dtype(desc.dtype)
+        shape = tuple(desc.page_shape)
+        while True:
+            hdr = await reader.readexactly(_HDR.size)
+            magic, length = _HDR.unpack(hdr)
+            if magic != _MAGIC:
+                raise RuntimeError(f"bad kv frame magic {magic:#x}")
+            header = msgpack.unpackb(await reader.readexactly(length), raw=False)
+            if header.get("error"):
+                raise RuntimeError(f"kv transfer refused: {header['error']}")
+            if header.get("eof"):
+                return
+            off, n = header["off"], header["n"]
+            k_raw = await reader.readexactly(header["k_bytes"])
+            v_raw = await reader.readexactly(header["v_bytes"])
+            chunk_shape = (shape[0], n, *shape[1:])
+            k = np.frombuffer(k_raw, dtype=np_dtype).reshape(chunk_shape)
+            v = np.frombuffer(v_raw, dtype=np_dtype).reshape(chunk_shape)
+            await inject(off, n, k, v)
+    finally:
+        writer.close()

@@ -1,0 +1,305 @@
+"""KV data plane (llm/kv_transfer.py): the NIXL-replacement pull path.
+
+Covers: TCP chunk streaming with injection overlap, in-process registry
+short-circuit, TTL reaping (pages released when nobody pulls), failure
+propagation, and the engine-level disagg pull flow with an exact-match
+oracle (reference flow: nixl_connect begin_read, SURVEY §3.3).
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.llm.kv_transfer import (
+    KvDataPlaneServer,
+    KvTransferDescriptor,
+    pull_kv,
+)
+
+
+def _fake_pages(n_pages, L=2, page=4, kh=2, d=8, dtype=np.float32):
+    k = np.arange(L * n_pages * page * kh * d, dtype=dtype).reshape(
+        L, n_pages, page, kh, d
+    )
+    return k, (k * 2).astype(dtype)
+
+
+async def _stage(server, n_pages, *, released, dtype=np.float32, ttl=None):
+    k_all, v_all = _fake_pages(n_pages, dtype=dtype)
+
+    async def extract(off, n, device):
+        return k_all[:, off : off + n], v_all[:, off : off + n]
+
+    desc = server.stage(
+        n_pages=n_pages,
+        n_tokens=n_pages * 4,
+        page_size=4,
+        page_shape=[2, 4, 2, 8],
+        dtype=str(np.dtype(dtype)),
+        extract=extract,
+        on_done=released.append,
+        chunk_pages=3,
+        ttl=ttl,
+    )
+    return desc, k_all, v_all
+
+
+def test_tcp_pull_round_trip():
+    async def main():
+        server = KvDataPlaneServer()
+        await server.start()
+        released = []
+        desc, k_all, v_all = await _stage(server, 8, released=released)
+
+        # force the socket path (drop the local-registry entry)
+        from dynamo_tpu.llm import kv_transfer
+
+        kv_transfer._LOCAL.pop((server.addr, desc.transfer_id))
+
+        got_k = np.zeros_like(k_all)
+        got_v = np.zeros_like(v_all)
+        order = []
+
+        async def inject(off, n, k, v):
+            order.append((off, n))
+            got_k[:, off : off + n] = k
+            got_v[:, off : off + n] = v
+
+        await pull_kv(KvTransferDescriptor.from_dict(desc.to_dict()), inject)
+        np.testing.assert_array_equal(got_k, k_all)
+        np.testing.assert_array_equal(got_v, v_all)
+        assert order == [(0, 3), (3, 3), (6, 2)]  # chunked, in order
+        assert released == [True]
+        await server.close()
+
+    asyncio.run(main())
+
+def test_tcp_pull_bfloat16():
+    async def main():
+        import ml_dtypes
+
+        server = KvDataPlaneServer()
+        await server.start()
+        released = []
+        desc, k_all, v_all = await _stage(
+            server, 4, released=released, dtype=ml_dtypes.bfloat16
+        )
+        from dynamo_tpu.llm import kv_transfer
+
+        kv_transfer._LOCAL.pop((server.addr, desc.transfer_id))
+
+        chunks = []
+
+        async def inject(off, n, k, v):
+            chunks.append((off, np.asarray(k, np.float32), np.asarray(v, np.float32)))
+
+        await pull_kv(desc, inject)
+        got = np.concatenate([c[1] for c in chunks], axis=1)
+        np.testing.assert_array_equal(got, np.asarray(k_all, np.float32))
+        await server.close()
+
+    asyncio.run(main())
+
+def test_local_registry_short_circuit():
+    """Co-located engines: the pull resolves in-process — no socket, and the
+    extract sees device=True (arrays may stay on device)."""
+    async def main():
+        server = KvDataPlaneServer()
+        await server.start()
+        released = []
+        seen_device = []
+        k_all, v_all = _fake_pages(5)
+
+        async def extract(off, n, device):
+            seen_device.append(device)
+            return k_all[:, off : off + n], v_all[:, off : off + n]
+
+        desc = server.stage(
+            n_pages=5, n_tokens=20, page_size=4, page_shape=[2, 4, 2, 8],
+            dtype="float32", extract=extract, on_done=released.append, chunk_pages=2,
+        )
+        got = []
+
+        async def inject(off, n, k, v):
+            got.append((off, n))
+
+        await pull_kv(desc, inject)
+        assert got == [(0, 2), (2, 2), (4, 1)]
+        assert all(seen_device)
+        assert released == [True]
+        # registry entry consumed: a second pull must fail over to TCP and be
+        # refused (transfer already served)
+        with pytest.raises(RuntimeError, match="refused"):
+            await pull_kv(desc, inject)
+        await server.close()
+
+    asyncio.run(main())
+
+def test_ttl_reap_releases_pages():
+    async def main():
+        server = KvDataPlaneServer()
+        await server.start()
+        released = []
+        desc, _, _ = await _stage(server, 2, released=released, ttl=0.1)
+        await asyncio.sleep(1.6)  # reaper tick is 1s
+        assert released == [False]
+        await server.close()
+
+    asyncio.run(main())
+
+def test_pull_unknown_transfer_raises():
+    async def main():
+        server = KvDataPlaneServer()
+        await server.start()
+        desc = KvTransferDescriptor(
+            transfer_id="deadbeef", addr=server.addr, n_pages=1, n_tokens=4,
+            page_size=4, page_shape=[2, 4, 2, 8], dtype="float32", chunk_pages=1,
+        )
+
+        async def inject(off, n, k, v):
+            pass
+
+        with pytest.raises(RuntimeError, match="refused"):
+            await pull_kv(desc, inject)
+        await server.close()
+
+    asyncio.run(main())
+
+def test_inject_failure_releases_staging():
+    """A decode-side crash mid-pull must not leak the staged pages."""
+    async def main():
+        server = KvDataPlaneServer()
+        await server.start()
+        released = []
+        desc, _, _ = await _stage(server, 6, released=released)
+        from dynamo_tpu.llm import kv_transfer
+
+        kv_transfer._LOCAL.pop((server.addr, desc.transfer_id))
+
+        async def inject(off, n, k, v):
+            raise RuntimeError("decode side died")
+
+        with pytest.raises(RuntimeError):
+            await pull_kv(desc, inject)
+        for _ in range(50):
+            if released:
+                break
+            await asyncio.sleep(0.05)
+        # ok may be True (all chunks fit the socket buffer before the peer
+        # died) or False (write failed) — the invariant is release fired once
+        assert len(released) == 1
+        await server.close()
+
+    asyncio.run(main())
+
+# --------------------------------------------------------------------- #
+# engine-level: disagg pull flow, exact-output oracle
+# --------------------------------------------------------------------- #
+
+
+def _engine(**kw):
+    from dynamo_tpu.engine import EngineConfig, JaxEngine
+
+    return JaxEngine(
+        EngineConfig(
+            model="tiny", page_size=8, num_pages=64, max_num_seqs=4,
+            max_model_len=256, **kw,
+        )
+    )
+
+
+async def _collect(engine, agen):
+    ids = []
+    async for item in agen:
+        data = item.get("data") if isinstance(item, dict) else None
+        if data and data.get("token_ids"):
+            ids.extend(data["token_ids"])
+        if data and data.get("kv_transfer_params") is not None:
+            return ids, data["kv_transfer_params"]
+    return ids, None
+
+
+def test_engine_disagg_pull_exact_match():
+    """Prefill engine stages via the data plane; decode engine pulls and
+    decodes. Same seed => output must EXACTLY match aggregated decoding."""
+    async def main():
+        from dynamo_tpu.llm.protocols import PreprocessedRequest
+        from dynamo_tpu.runtime.engine import Context
+
+        prompt = list(range(5, 45))  # 40 tokens, 5 pages
+        req = PreprocessedRequest(
+            token_ids=prompt, stop_conditions={"max_tokens": 10}, request_id="r1"
+        ).to_dict()
+
+        oracle_eng = _engine()
+        oracle_ids, _ = await _collect(
+            oracle_eng, oracle_eng.generate(dict(req), Context())
+        )
+        await oracle_eng.close()
+        assert len(oracle_ids) == 10
+
+        prefill_eng = _engine()
+        decode_eng = _engine()
+        server = KvDataPlaneServer()
+        await server.start()
+        prefill_eng.data_plane = server
+
+        pre_req = dict(req)
+        pre_req["stop_conditions"] = {"max_tokens": 1}
+        pre_req["disagg_params"] = {"return_kv": True, "kv_pull": True}
+        first_ids, payload = await _collect(
+            prefill_eng, prefill_eng.generate(pre_req, Context())
+        )
+        assert payload is not None and "pull" in payload
+        first = first_ids[0]
+        assert first == oracle_ids[0]
+
+        got = [first]
+        async for item in decode_eng.generate_decode_from_pull(
+            dict(req), Context(), first, payload["pull"]
+        ):
+            data = item.get("data") if isinstance(item, dict) else None
+            if data and data.get("token_ids"):
+                got.extend(data["token_ids"])
+        assert got == oracle_ids
+        await prefill_eng.close()
+        await decode_eng.close()
+        await server.close()
+
+    asyncio.run(main())
+
+def test_engine_pull_failure_falls_back_to_local_prefill():
+    """Descriptor points at a dead data plane: decode must recompute the
+    prompt locally and still produce the exact aggregated output."""
+    async def main():
+        from dynamo_tpu.llm.protocols import PreprocessedRequest
+        from dynamo_tpu.runtime.engine import Context
+
+        prompt = list(range(7, 40))
+        req = PreprocessedRequest(
+            token_ids=prompt, stop_conditions={"max_tokens": 8}, request_id="r2"
+        ).to_dict()
+
+        oracle_eng = _engine()
+        oracle_ids, _ = await _collect(
+            oracle_eng, oracle_eng.generate(dict(req), Context())
+        )
+        await oracle_eng.close()
+
+        dead = KvTransferDescriptor(
+            transfer_id="gone", addr="127.0.0.1:1", n_pages=5, n_tokens=len(prompt),
+            page_size=8, page_shape=[2, 8, 2, 8], dtype="float32", chunk_pages=2,
+        )
+        decode_eng = _engine()
+        got = [oracle_ids[0]]
+        async for item in decode_eng.generate_decode_from_pull(
+            dict(req), Context(), oracle_ids[0], dead.to_dict()
+        ):
+            data = item.get("data") if isinstance(item, dict) else None
+            if data and data.get("token_ids"):
+                got.extend(data["token_ids"])
+        assert got == oracle_ids
+        await decode_eng.close()
+
+    asyncio.run(main())
