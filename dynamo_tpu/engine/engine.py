@@ -189,6 +189,9 @@ class JaxEngine:
         self._free_slots = list(range(B - 1, -1, -1))
         self._waiting: List[_Slot] = []
         self._step_task: Optional[asyncio.Task] = None
+        # strong refs to in-flight background pulls: the event loop only
+        # keeps weak refs, and a GC'd pull task would strand its slot
+        self._bg_tasks: set = set()
         self._wake = asyncio.Event()
         # optional llm.kv_transfer.KvDataPlaneServer (worker attaches it):
         # enables the descriptor/pull disagg path instead of inline payloads
@@ -732,7 +735,9 @@ class JaxEngine:
             # batch keeps stepping while later pages are still in flight
             desc = slot.pull_desc
             slot.pull_desc = None
-            asyncio.create_task(self._pull_kv_task(slot, desc, first_token))
+            task = asyncio.create_task(self._pull_kv_task(slot, desc, first_token))
+            self._bg_tasks.add(task)
+            task.add_done_callback(self._bg_tasks.discard)
             return
         page_ids = np.array([p + 1 for p in slot.pages], np.int32)
         self._bcast("inject", {"page_ids": page_ids, "k": np.asarray(k_np), "v": np.asarray(v_np)})

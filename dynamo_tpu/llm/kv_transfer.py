@@ -69,6 +69,24 @@ def _np_dtype(name: str):
     return np.dtype(name)
 
 
+def routable_host() -> str:
+    """Best-effort routable address for descriptor advertisement. Binding to
+    0.0.0.0 and advertising 127.0.0.1 silently defeats cross-host disagg
+    (every pull connects to self and falls back to local prefill), so default
+    to the interface a remote peer would reach us on."""
+    import socket
+
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        # no packets are sent; this just asks the kernel for the route
+        s.connect(("10.255.255.255", 1))
+        return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+    finally:
+        s.close()
+
+
 @dataclass
 class KvTransferDescriptor:
     """What rides the response stream instead of the KV payload (the NIXL
@@ -103,6 +121,7 @@ class _Staged:
     extract: ExtractFn
     on_done: Callable[[bool], None]  # called exactly once; arg = pulled ok
     deadline: float
+    max_transfer_time: float = 120.0  # per-chunk deadline extension budget
     started: bool = False
     finished: bool = False
 
@@ -120,11 +139,18 @@ class KvDataPlaneServer:
     to pulling peers, reaps abandoned transfers so their pages free."""
 
     def __init__(self, host: str = "0.0.0.0", advertise_host: Optional[str] = None,
-                 port: int = 0, ttl: float = 30.0):
+                 port: int = 0, ttl: float = 30.0, max_transfer_time: float = 120.0,
+                 chunk_timeout: float = 30.0):
         self._host = host
-        self._advertise_host = advertise_host or ("127.0.0.1" if host in ("0.0.0.0", "") else host)
+        self._advertise_host = advertise_host or (
+            routable_host() if host in ("0.0.0.0", "") else host
+        )
         self._port = port
         self.ttl = ttl
+        # a pull that has *started* gets this long to finish before the
+        # reaper unstages it (half-open peers must not pin pages forever)
+        self.max_transfer_time = max_transfer_time
+        self.chunk_timeout = chunk_timeout
         self._server: Optional[asyncio.AbstractServer] = None
         self._staged: Dict[str, _Staged] = {}
         self._reaper: Optional[asyncio.Task] = None
@@ -197,6 +223,7 @@ class KvDataPlaneServer:
             extract=extract,
             on_done=on_done,
             deadline=time.monotonic() + (ttl if ttl is not None else self.ttl),
+            max_transfer_time=self.max_transfer_time,
         )
         self._staged[transfer_id] = staged
         _LOCAL[(self.addr, transfer_id)] = staged
@@ -212,27 +239,40 @@ class KvDataPlaneServer:
             await asyncio.sleep(1.0)
             now = time.monotonic()
             for t in list(self._staged.values()):
-                if not t.started and now > t.deadline:
+                if t.finished:
+                    # in-process pulls finish without passing through _serve;
+                    # drop the bookkeeping entry so _staged stays bounded
+                    self._staged.pop(t.desc.transfer_id, None)
+                elif now > t.deadline:
                     logger.warning(
-                        "kv transfer %s never pulled; releasing", t.desc.transfer_id
+                        "kv transfer %s %s; releasing",
+                        t.desc.transfer_id,
+                        "stalled mid-pull" if t.started else "never pulled",
                     )
                     self._unstage(t, ok=False)
 
     async def _serve(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         try:
-            hdr = await reader.readexactly(_HDR.size)
+            hdr = await asyncio.wait_for(
+                reader.readexactly(_HDR.size), self.chunk_timeout
+            )
             magic, length = _HDR.unpack(hdr)
             if magic != _MAGIC:
                 raise RuntimeError(f"bad kv data plane magic {magic:#x}")
-            transfer_id = (await reader.readexactly(length)).decode()
+            if length > 4096:  # transfer ids are 16 hex chars; reject floods
+                raise RuntimeError(f"oversized kv handshake ({length} bytes)")
+            transfer_id = (
+                await asyncio.wait_for(reader.readexactly(length), self.chunk_timeout)
+            ).decode()
             staged = self._staged.get(transfer_id)
             if staged is None or staged.started:
                 await self._send_header(writer, {"error": f"unknown transfer {transfer_id}"})
                 return
             staged.started = True
+            staged.deadline = time.monotonic() + self.max_transfer_time
             try:
                 await self._stream(staged, writer)
-            except (ConnectionError, asyncio.IncompleteReadError):
+            except (ConnectionError, asyncio.IncompleteReadError, TimeoutError):
                 self._unstage(staged, ok=False)
                 raise
             self._unstage(staged, ok=True)
@@ -263,6 +303,11 @@ class KvDataPlaneServer:
         nxt = asyncio.ensure_future(get(0)) if desc.n_pages else None
         while nxt is not None:
             off, n, k, v = await nxt
+            if staged.finished:
+                # the reaper unstaged us (deadline hit) and the pages may
+                # already be reused: abort mid-stream so the peer sees a
+                # broken transfer instead of a "successful" corrupted one
+                raise RuntimeError("transfer reaped mid-stream")
             after = off + n
             nxt = asyncio.ensure_future(get(after)) if after < desc.n_pages else None
             kb, vb = _np_bytes(k), _np_bytes(v)
@@ -272,7 +317,11 @@ class KvDataPlaneServer:
             )
             writer.write(kb)
             writer.write(vb)
-            await writer.drain()
+            # a peer that stops reading must not pin pages: deadline the drain
+            await asyncio.wait_for(writer.drain(), self.chunk_timeout)
+            # a progressing transfer earns its keep — refresh the deadline so
+            # slow-but-alive links are not reaped mid-pull
+            staged.deadline = time.monotonic() + self.max_transfer_time
         await self._send_header(writer, {"eof": True})
 
 
@@ -284,6 +333,7 @@ async def pull_kv(
     desc: KvTransferDescriptor,
     inject: InjectFn,
     connect_timeout: float = 10.0,
+    chunk_timeout: float = 30.0,
 ) -> None:
     """Decode-side pull: stream chunks from the staging peer and inject each
     while the rest are still in flight. Raises on any failure (caller falls
@@ -292,13 +342,17 @@ async def pull_kv(
     staged = _LOCAL.get((desc.addr, desc.transfer_id))
     if staged is not None and not staged.started:
         staged.started = True
+        staged.deadline = time.monotonic() + staged.max_transfer_time
         try:
             off = 0
             while off < desc.n_pages:
+                if staged.finished:
+                    raise RuntimeError("transfer reaped mid-pull")
                 n = min(desc.chunk_pages, desc.n_pages - off)
                 k, v = await staged.extract(off, n, True)
                 await inject(off, n, k, v)
                 off += n
+                staged.deadline = time.monotonic() + staged.max_transfer_time
         except BaseException:
             staged.finish(False)
             raise
@@ -317,19 +371,39 @@ async def pull_kv(
         await writer.drain()
         np_dtype = _np_dtype(desc.dtype)
         shape = tuple(desc.page_shape)
+        # every frame size the peer sends is checked against what the
+        # descriptor implies — a misbehaving peer cannot force a huge alloc
+        max_chunk_bytes = (
+            int(np.prod(shape)) * np_dtype.itemsize * max(desc.chunk_pages, 1)
+        )
         while True:
-            hdr = await reader.readexactly(_HDR.size)
+            hdr = await asyncio.wait_for(reader.readexactly(_HDR.size), chunk_timeout)
             magic, length = _HDR.unpack(hdr)
             if magic != _MAGIC:
                 raise RuntimeError(f"bad kv frame magic {magic:#x}")
-            header = msgpack.unpackb(await reader.readexactly(length), raw=False)
+            if length > 65536:
+                raise RuntimeError(f"oversized kv frame header ({length} bytes)")
+            header = msgpack.unpackb(
+                await asyncio.wait_for(reader.readexactly(length), chunk_timeout),
+                raw=False,
+            )
             if header.get("error"):
                 raise RuntimeError(f"kv transfer refused: {header['error']}")
             if header.get("eof"):
                 return
             off, n = header["off"], header["n"]
-            k_raw = await reader.readexactly(header["k_bytes"])
-            v_raw = await reader.readexactly(header["v_bytes"])
+            if not (0 <= off and 0 < n <= desc.chunk_pages and off + n <= desc.n_pages):
+                raise RuntimeError(f"kv chunk out of range (off={off} n={n})")
+            if header["k_bytes"] > max_chunk_bytes or header["v_bytes"] > max_chunk_bytes:
+                raise RuntimeError(
+                    f"kv frame larger than descriptor allows ({header['k_bytes']})"
+                )
+            k_raw = await asyncio.wait_for(
+                reader.readexactly(header["k_bytes"]), chunk_timeout
+            )
+            v_raw = await asyncio.wait_for(
+                reader.readexactly(header["v_bytes"]), chunk_timeout
+            )
             chunk_shape = (shape[0], n, *shape[1:])
             k = np.frombuffer(k_raw, dtype=np_dtype).reshape(chunk_shape)
             v = np.frombuffer(v_raw, dtype=np_dtype).reshape(chunk_shape)

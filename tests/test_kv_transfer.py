@@ -148,6 +148,107 @@ def test_ttl_reap_releases_pages():
 
     asyncio.run(main())
 
+def test_stalled_pull_is_reaped():
+    """A peer that handshakes then stops reading must not pin pages forever:
+    the reaper deadlines started-but-unfinished transfers (advisor r2 medium)."""
+    async def main():
+        server = KvDataPlaneServer(max_transfer_time=0.2, chunk_timeout=0.5)
+        await server.start()
+        released = []
+        # pages big enough that the stream cannot fit in socket buffers
+        shape = (2, 64, 8, 64)  # 256 KiB/page
+        k_page = np.ones(shape, np.float32)
+
+        async def extract(off, n, device):
+            k = np.broadcast_to(k_page[:, None], (2, n, *shape[1:]))
+            return k, k
+
+        desc = server.stage(
+            n_pages=32, n_tokens=32 * 64, page_size=64,
+            page_shape=[2, 64, 8, 64], dtype="float32",
+            extract=extract, on_done=released.append, chunk_pages=4,
+        )
+        from dynamo_tpu.llm import kv_transfer
+
+        kv_transfer._LOCAL.pop((server.addr, desc.transfer_id))
+
+        # handshake, then never read: the server's drain stalls once the
+        # socket buffer fills
+        import struct
+
+        host, port = server.addr.rsplit(":", 1)
+        reader, writer = await asyncio.open_connection(host, int(port))
+        tid = desc.transfer_id.encode()
+        writer.write(struct.pack("<II", 0xD7A04B1D, len(tid)) + tid)
+        await writer.drain()
+        for _ in range(100):
+            if released:
+                break
+            await asyncio.sleep(0.1)
+        assert released == [False]
+        writer.close()
+        await server.close()
+
+    asyncio.run(main())
+
+def test_local_pull_leaves_no_staged_entry():
+    """In-process pulls must not grow the server's _staged dict without
+    bound (advisor r2 low): the reaper drops finished entries."""
+    async def main():
+        server = KvDataPlaneServer()
+        await server.start()
+        released = []
+        desc, _, _ = await _stage(server, 3, released=released)
+
+        async def inject(off, n, k, v):
+            pass
+
+        await pull_kv(desc, inject)
+        assert released == [True]
+        for _ in range(30):
+            if desc.transfer_id not in server._staged:
+                break
+            await asyncio.sleep(0.1)
+        assert desc.transfer_id not in server._staged
+        await server.close()
+
+    asyncio.run(main())
+
+def test_oversized_frame_rejected():
+    """Peer-supplied frame sizes are capped by what the descriptor implies
+    (advisor r2 low): a lying server cannot force a huge allocation."""
+    async def main():
+        import struct
+
+        import msgpack as _mp
+
+        async def evil(reader, writer):
+            await reader.readexactly(8)  # handshake
+            hdr = _mp.packb(
+                {"off": 0, "n": 1, "k_bytes": 1 << 30, "v_bytes": 1 << 30},
+                use_bin_type=True,
+            )
+            writer.write(struct.pack("<II", 0xD7A04B1D, len(hdr)) + hdr)
+            await writer.drain()
+            writer.close()  # 3.12 wait_closed() waits for open transports
+
+        srv = await asyncio.start_server(evil, "127.0.0.1", 0)
+        port = srv.sockets[0].getsockname()[1]
+        desc = KvTransferDescriptor(
+            transfer_id="aa" * 8, addr=f"127.0.0.1:{port}", n_pages=4, n_tokens=16,
+            page_size=4, page_shape=[2, 4, 2, 8], dtype="float32", chunk_pages=2,
+        )
+
+        async def inject(off, n, k, v):
+            raise AssertionError("must not inject")
+
+        with pytest.raises(RuntimeError, match="larger than descriptor"):
+            await pull_kv(desc, inject)
+        srv.close()
+        await srv.wait_closed()
+
+    asyncio.run(main())
+
 def test_pull_unknown_transfer_raises():
     async def main():
         server = KvDataPlaneServer()
