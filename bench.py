@@ -1,36 +1,86 @@
-"""Headline benchmark: steady-state decode throughput of the JAX engine.
+"""Headline benchmark: the JAX engine raw step rate AND the full serving
+stack (e2e), each in its own subprocess so they never share the device.
 
-Runs on whatever `jax.devices()` provides (the real TPU chip under axon;
-CPU with --smoke). Prints ONE JSON line:
-
-    {"metric": ..., "value": N, "unit": "tok/s", "vs_baseline": N}
+Default run (what the driver executes on TPU) prints TWO JSON lines:
+  1. raw-step decode throughput (engine dispatch units, inline loop)
+  2. e2e serving throughput through frontend+router+worker at fixed QPS
+     (the north-star metric: output tok/s + p50 TTFT; see bench_e2e.py)
+The LAST line is the headline: e2e when it succeeds, raw otherwise.
 
 vs_baseline: the reference publishes no absolute end-to-end tables
 (BASELINE.md); the closest per-accelerator number it documents is the SLA
 profiler example decode rate of 51.22 tok/s/GPU at TP4 on H100-class
 (docs/benchmarks/pre_deployment_profiling.md:56) => 204.9 tok/s per 4-GPU
-worker. We report batched decode tok/s on ONE v5e chip divided by that
-per-GPU figure so the ratio reads "v5e-chip decode throughput vs H100-GPU
-decode throughput on the reference's own example".
+worker. We report tok/s on ONE v5e chip divided by that per-GPU figure so
+the ratio reads "v5e chip vs H100 GPU on the reference's own example".
 
-Shapes follow the engine's production dispatch units (engine/engine.py):
+Raw-step shapes follow the engine's production dispatch units
+(engine/engine.py):
   * prefill: ONE batched [B, isl] dispatch (all sequences together) with
     on-device first-token sampling; TTFT = a single-sequence dispatch plus
     the one host read that delivers the token.
   * decode: K-step fused blocks (lax.scan, sampling feeds the next step on
     device) — one host read per K*B tokens.
 
-With --e2e the benchmark instead drives the FULL serving stack (HTTP
-frontend + preprocessor + router + JAX worker) with a ShareGPT-style
-trace at fixed QPS; see bench_e2e.py.
+Modes:
+  --raw     only the raw-step bench (this file's measurement loop)
+  --e2e     only the serving bench (bench_e2e.py; extra args pass through)
+  (none)    both, as subprocesses
 """
 
 import argparse
 import json
+import subprocess
 import sys
 import time
+from pathlib import Path
 
 H100_DECODE_TOKS_PER_GPU = 51.22  # reference pre_deployment_profiling.md:56
+
+
+def _json_lines(cmd, label):
+    """Run a bench subprocess; return its last stdout JSON line (or None)."""
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True, timeout=1800)
+    except subprocess.TimeoutExpired as e:
+        sys.stderr.write(f"# {label} bench timed out after {e.timeout}s\n")
+        return None
+    sys.stderr.write(r.stderr)
+    out = None
+    for line in r.stdout.splitlines():
+        if line.startswith("{"):
+            out = line
+    return out
+
+
+def _combined(args, extra):
+    """Run raw + e2e as subprocesses; print raw's JSON line, then e2e's.
+    The device is used by one process at a time (the raw bench exits before
+    the e2e worker starts)."""
+    smoke = ["--smoke"] if args.smoke else []
+    model = ["--model", args.model] if args.model else []
+    raw_line = _json_lines(
+        [sys.executable, __file__, "--raw", *smoke, *model,
+         "--batch", str(args.batch), "--isl", str(args.isl),
+         "--osl", str(args.osl), "--block", str(args.block),
+         *(["--steps", str(args.steps)] if args.steps else [])],
+        "raw",
+    )
+    e2e_line = _json_lines(
+        [sys.executable, str(Path(__file__).parent / "bench_e2e.py"),
+         "--mode", "agg", *smoke, *model, *extra],
+        "e2e",
+    )
+    # headline (last line) = e2e if it produced a result, else raw
+    if e2e_line and raw_line:
+        print(raw_line)
+        print(e2e_line)
+    elif raw_line:
+        print(raw_line)
+    elif e2e_line:
+        print(e2e_line)
+    else:
+        sys.exit("bench: no result produced")
 
 
 def main():
@@ -42,6 +92,7 @@ def main():
     ap.add_argument("--osl", type=int, default=128, help="output seq len")
     ap.add_argument("--block", type=int, default=16, help="fused decode steps per dispatch")
     ap.add_argument("--steps", type=int, default=None, help="decode steps to time")
+    ap.add_argument("--raw", action="store_true", help="only the raw-step bench")
     ap.add_argument("--e2e", action="store_true", help="serve a trace through the full stack")
     args, extra = ap.parse_known_args()
 
@@ -49,6 +100,9 @@ def main():
         from bench_e2e import main as e2e_main
 
         return e2e_main(extra + (["--smoke"] if args.smoke else []))
+
+    if not args.raw:
+        return _combined(args, extra)
 
     if args.smoke:
         import os

@@ -1,0 +1,416 @@
+"""End-to-end serving benchmark: the north-star harness.
+
+Drives the FULL product — discovery + OpenAI HTTP frontend + router +
+JAX worker(s) as real OS processes — with a ShareGPT-shaped trace at fixed
+QPS, and reports output tok/s + p50/p99 TTFT/ITL measured at the client.
+This is the genai-perf role for the TPU build (reference: benchmarks/utils/,
+docs/benchmarks/benchmarking.md; load-spec shape from
+recipes/llama-3-70b/vllm/disagg-single-node/perf.yaml:45-58).
+
+Deployment modes (BASELINE.json configs 1-3):
+  * agg     — one aggregated worker (config 1)
+  * disagg  — prefill + decode workers, KV pull data plane (config 2)
+  * kv      — N aggregated workers behind the KV-aware router (config 3)
+
+Measurement method: prompts are PRE-TOKENIZED int arrays (exact ISL), with
+`nvext.ignore_eos` + max_tokens pinning the output length (exact OSL) — so
+token accounting is exact without trusting chunk framing. TTFT = first SSE
+content chunk; ITL = (t_last - t_first) / (osl - 1) per request (tokens
+arrive in K-step engine blocks; the per-request average is the honest
+number, per-gap percentiles would read the block cadence instead).
+
+Usage:  python bench.py --e2e [--mode agg|disagg|kv] [--smoke] ...
+   or:  python bench_e2e.py --mode disagg --smoke
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import List, Optional
+
+REPO = Path(__file__).resolve().parent
+sys.path.insert(0, str(REPO))
+
+from bench import H100_DECODE_TOKS_PER_GPU  # noqa: E402 — shared baseline
+from tests.utils import ManagedProcess, free_port  # noqa: E402
+
+
+# --------------------------------------------------------------------- #
+# trace generation (ShareGPT-shaped, seeded)
+# --------------------------------------------------------------------- #
+
+
+@dataclass
+class TraceRequest:
+    at: float  # arrival offset from t0 (s)
+    isl: int
+    osl: int
+    token_ids: List[int]
+
+
+@dataclass
+class RequestResult:
+    ok: bool
+    isl: int = 0
+    osl: int = 0
+    t_send: float = 0.0
+    t_first: float = 0.0
+    t_last: float = 0.0
+    n_chunks: int = 0
+    error: str = ""
+    remote_prefill: bool = False
+
+
+def build_trace(
+    n_requests: int,
+    qps: float,
+    isl_mean: int,
+    osl_mean: int,
+    max_isl: int,
+    max_osl: int,
+    vocab: int,
+    seed: int = 0,
+    prefix_ratio: float = 0.0,
+) -> List[TraceRequest]:
+    """ShareGPT-shaped lengths: lognormal ISL/OSL (the dataset's heavy right
+    tail), Poisson arrivals at fixed mean QPS. Fully seeded => identical
+    trace across runs/modes. `prefix_ratio` > 0 gives that fraction of
+    requests a shared system-prompt prefix (KV-router prefix-reuse load,
+    reference benchmarks/router/prefix_ratio_benchmark.py)."""
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    # lognormal with sigma=0.7 ~ ShareGPT-ish spread; scale so the MEAN of
+    # the clipped distribution is ~isl_mean
+    sigma = 0.7
+    mu_i = np.log(isl_mean) - sigma * sigma / 2
+    mu_o = np.log(osl_mean) - sigma * sigma / 2
+    isl = np.clip(rng.lognormal(mu_i, sigma, n_requests).astype(int), 4, max_isl)
+    osl = np.clip(rng.lognormal(mu_o, sigma, n_requests).astype(int), 4, max_osl)
+    gaps = rng.exponential(1.0 / qps, n_requests)
+    gaps[0] = 0.0
+    arrivals = np.cumsum(gaps)
+    shared_prefix = rng.randint(5, vocab - 1, size=max(isl_mean // 2, 8)).tolist()
+    out = []
+    for i in range(n_requests):
+        n = int(isl[i])
+        if prefix_ratio > 0 and rng.rand() < prefix_ratio:
+            body = rng.randint(5, vocab - 1, size=max(n - len(shared_prefix), 4))
+            toks = (shared_prefix + body.tolist())[:n]
+        else:
+            toks = rng.randint(5, vocab - 1, size=n).tolist()
+        out.append(
+            TraceRequest(at=float(arrivals[i]), isl=n, osl=int(osl[i]), token_ids=toks)
+        )
+    return out
+
+
+# --------------------------------------------------------------------- #
+# deployment: spawn the real stack
+# --------------------------------------------------------------------- #
+
+
+@dataclass
+class Deployment:
+    procs: List[ManagedProcess] = field(default_factory=list)
+    http_port: int = 0
+
+    def stop(self):
+        for p in reversed(self.procs):
+            p.stop()
+
+
+def launch(mode: str, model: str, *, cpu: bool, num_workers: int = 2,
+           num_pages: int = 2048, max_num_seqs: int = 64,
+           disagg_threshold: int = 64, log_dir: str = "/tmp") -> Deployment:
+    """Spawn discovery + frontend + workers (real processes, real sockets) —
+    the same wiring a production deployment uses, per
+    jax_worker/__main__.py + frontend/__main__.py."""
+    dep = Deployment()
+    disc_port = free_port()
+    http_port = free_port()
+    disc = f"127.0.0.1:{disc_port}"
+    env = {"DYN_DISCOVERY_ENDPOINT": disc}
+
+    d = ManagedProcess(
+        ["-m", "dynamo_tpu.runtime.discovery", "--host", "127.0.0.1",
+         "--port", str(disc_port)],
+        name="bench-discovery", env=env,
+    )
+    d.start(f"{log_dir}/bench_e2e_discovery.log")
+    d.wait_port(disc_port)
+    dep.procs.append(d)
+
+    worker_args = [
+        "-m", "dynamo_tpu.jax_worker", "--model", model,
+        "--model-name", "bench", "--num-pages", str(num_pages),
+        "--max-num-seqs", str(max_num_seqs),
+    ]
+    router_mode = "round-robin"
+    if mode == "agg":
+        specs = [("bench-worker", worker_args + ["--role", "aggregated"])]
+    elif mode == "disagg":
+        specs = [
+            ("bench-prefill", worker_args + ["--role", "prefill"]),
+            ("bench-decode", worker_args
+             + ["--role", "decode", "--disagg-threshold", str(disagg_threshold)]),
+        ]
+    elif mode == "kv":
+        router_mode = "kv"
+        specs = [
+            (f"bench-worker{i}", worker_args + ["--role", "aggregated", "--kv-events"])
+            for i in range(num_workers)
+        ]
+    else:
+        raise ValueError(f"unknown mode {mode!r}")
+
+    for name, args in specs:
+        w = ManagedProcess(args, name=name, env=env, cpu_only=cpu)
+        w.start(f"{log_dir}/bench_e2e_{name}.log")
+        dep.procs.append(w)
+
+    f = ManagedProcess(
+        ["-m", "dynamo_tpu.frontend", "--http-port", str(http_port),
+         "--router-mode", router_mode],
+        name="bench-frontend", env=env,
+    )
+    f.start(f"{log_dir}/bench_e2e_frontend.log")
+    f.wait_port(http_port)
+    dep.procs.append(f)
+    dep.http_port = http_port
+    return dep
+
+
+async def wait_model(port: int, timeout: float) -> None:
+    import aiohttp
+
+    deadline = time.time() + timeout
+    async with aiohttp.ClientSession() as s:
+        while time.time() < deadline:
+            try:
+                async with s.get(f"http://127.0.0.1:{port}/v1/models") as r:
+                    if r.status == 200:
+                        data = await r.json()
+                        if any(m["id"] == "bench" for m in data.get("data", [])):
+                            return
+            except aiohttp.ClientError:
+                pass
+            await asyncio.sleep(0.5)
+    raise TimeoutError(f"model not registered within {timeout}s")
+
+
+# --------------------------------------------------------------------- #
+# load driver
+# --------------------------------------------------------------------- #
+
+
+async def drive_one(session, port: int, tr: TraceRequest) -> RequestResult:
+    body = {
+        "model": "bench",
+        "prompt": tr.token_ids,
+        "max_tokens": tr.osl,
+        "stream": True,
+        # sampled, not greedy: a random-weight bench model under argmax can
+        # lock onto special tokens (PAD/BOS/EOS), which correctly detokenize
+        # to no text — and a zero-text stream has no TTFT signal
+        "temperature": 1.0,
+        "nvext": {"ignore_eos": True, "annotations": ["remote_prefill"]},
+    }
+    res = RequestResult(ok=False, isl=tr.isl, osl=tr.osl, t_send=time.perf_counter())
+    try:
+        async with session.post(
+            f"http://127.0.0.1:{port}/v1/completions", json=body
+        ) as resp:
+            if resp.status != 200:
+                res.error = f"http {resp.status}: {(await resp.text())[:200]}"
+                return res
+            # parse the SSE stream: every `data:` JSON with non-empty text is
+            # token content; `: event [...]` comment lines carry annotations
+            # (worker_instance_id, remote_prefill)
+            async for raw in resp.content:
+                line = raw.decode("utf-8", "replace").strip()
+                if not line:
+                    continue
+                if line.startswith(": "):
+                    if "remote_prefill" in line:
+                        res.remote_prefill = True
+                    continue
+                if not line.startswith("data:"):
+                    continue
+                payload = line[5:].strip()
+                if payload == "[DONE]":
+                    break
+                try:
+                    chunk = json.loads(payload)
+                except json.JSONDecodeError:
+                    continue
+                if chunk.get("error"):
+                    res.error = str(chunk["error"])[:200]
+                    return res
+                choices = chunk.get("choices") or []
+                if choices and choices[0].get("text"):
+                    now = time.perf_counter()
+                    if res.t_first == 0.0:
+                        res.t_first = now
+                    res.t_last = now
+                    res.n_chunks += 1
+        if res.t_first == 0.0:
+            res.error = "no content chunks"
+            return res
+        res.ok = True
+        return res
+    except Exception as e:  # noqa: BLE001 — a failed request is a data point
+        res.error = f"{type(e).__name__}: {e}"
+        return res
+
+
+async def run_trace(port: int, trace: List[TraceRequest]) -> List[RequestResult]:
+    import aiohttp
+
+    connector = aiohttp.TCPConnector(limit=0)
+    timeout = aiohttp.ClientTimeout(total=None, sock_connect=30)
+    async with aiohttp.ClientSession(connector=connector, timeout=timeout) as session:
+        t0 = time.perf_counter()
+        tasks = []
+        for tr in trace:
+            delay = tr.at - (time.perf_counter() - t0)
+            if delay > 0:
+                await asyncio.sleep(delay)
+            tasks.append(asyncio.create_task(drive_one(session, port, tr)))
+        return list(await asyncio.gather(*tasks))
+
+
+def percentile(xs: List[float], p: float) -> float:
+    if not xs:
+        return 0.0  # all-failed run: keep the result line strict-JSON (no NaN)
+    xs = sorted(xs)
+    k = min(int(round((p / 100) * (len(xs) - 1))), len(xs) - 1)
+    return xs[k]
+
+
+def summarize(results: List[RequestResult], wall: float, mode: str, qps: float,
+              model: str) -> dict:
+    ok = [r for r in results if r.ok]
+    failed = [r for r in results if not r.ok]
+    out_tokens = sum(r.osl for r in ok)
+    ttft = [(r.t_first - r.t_send) * 1000 for r in ok]
+    itl = [
+        (r.t_last - r.t_first) / (r.osl - 1) * 1000 for r in ok if r.osl > 1
+    ]
+    e2e_lat = [(r.t_last - r.t_send) * 1000 for r in ok]
+    summary = {
+        "mode": mode,
+        "model": model,
+        "qps": qps,
+        "requests": len(results),
+        "failed": len(failed),
+        "wall_s": round(wall, 2),
+        "output_tok_s": round(out_tokens / wall, 1),
+        "total_tok_s": round(
+            (out_tokens + sum(r.isl for r in ok)) / wall, 1
+        ),
+        "ttft_ms": {
+            "p50": round(percentile(ttft, 50), 1),
+            "p99": round(percentile(ttft, 99), 1),
+        },
+        "itl_ms": {
+            "p50": round(percentile(itl, 50), 2),
+            "p99": round(percentile(itl, 99), 2),
+        },
+        "latency_ms": {
+            "p50": round(percentile(e2e_lat, 50), 1),
+            "p99": round(percentile(e2e_lat, 99), 1),
+        },
+        "remote_prefills": sum(1 for r in ok if r.remote_prefill),
+    }
+    if failed:
+        summary["first_error"] = failed[0].error
+    return summary
+
+
+# --------------------------------------------------------------------- #
+# main
+# --------------------------------------------------------------------- #
+
+
+def main(argv: Optional[List[str]] = None):
+    ap = argparse.ArgumentParser(description="dynamo-tpu e2e serving benchmark")
+    ap.add_argument("--smoke", action="store_true", help="CPU, tiny model, short trace")
+    ap.add_argument("--mode", choices=["agg", "disagg", "kv"], default="agg")
+    ap.add_argument("--model", default=None)
+    ap.add_argument("--qps", type=float, default=None)
+    ap.add_argument("--requests", type=int, default=None)
+    ap.add_argument("--isl-mean", type=int, default=220, help="ShareGPT-ish mean input len")
+    ap.add_argument("--osl-mean", type=int, default=180, help="ShareGPT-ish mean output len")
+    ap.add_argument("--max-isl", type=int, default=2048)
+    ap.add_argument("--max-osl", type=int, default=512)
+    ap.add_argument("--num-workers", type=int, default=2, help="workers in kv mode")
+    ap.add_argument("--prefix-ratio", type=float, default=0.0)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--startup-timeout", type=float, default=None)
+    args = ap.parse_args(argv)
+
+    cpu = bool(args.smoke)
+    model = args.model or ("tiny" if args.smoke else "llama3-3b")
+    qps = args.qps or (8.0 if args.smoke else 4.0)
+    n_requests = args.requests or (32 if args.smoke else 96)
+    startup = args.startup_timeout or (120.0 if args.smoke else 300.0)
+    if args.smoke:
+        args.isl_mean = min(args.isl_mean, 96)
+        args.osl_mean = min(args.osl_mean, 32)
+        args.max_isl, args.max_osl = 256, 64
+    vocab = 512 if model in ("tiny", "tiny-moe") else 128000
+
+    trace = build_trace(
+        n_requests, qps, args.isl_mean, args.osl_mean, args.max_isl,
+        args.max_osl, vocab, seed=args.seed, prefix_ratio=args.prefix_ratio,
+    )
+    print(
+        f"# e2e bench: mode={args.mode} model={model} device="
+        f"{'cpu' if cpu else 'tpu'} qps={qps} requests={n_requests} "
+        f"isl~{args.isl_mean} osl~{args.osl_mean}",
+        file=sys.stderr,
+    )
+
+    dep = launch(args.mode, model, cpu=cpu, num_workers=args.num_workers)
+    try:
+        asyncio.run(wait_model(dep.http_port, startup))
+        # brief warmup: compile every engine variant before the timed trace
+        warm = [TraceRequest(0.0, 32, 8, list(range(5, 37))) for _ in range(2)]
+        asyncio.run(run_trace(dep.http_port, warm))
+        t0 = time.perf_counter()
+        results = asyncio.run(run_trace(dep.http_port, trace))
+        wall = time.perf_counter() - t0
+    finally:
+        dep.stop()
+
+    summary = summarize(results, wall, args.mode, qps, model)
+    print("# " + json.dumps(summary), file=sys.stderr)
+    result = {
+        "metric": f"e2e_output_toks_{args.mode}_{model}_qps{qps:g}",
+        "value": summary["output_tok_s"],
+        "unit": "tok/s",
+        "vs_baseline": round(summary["output_tok_s"] / H100_DECODE_TOKS_PER_GPU, 2),
+        "ttft_p50_ms": summary["ttft_ms"]["p50"],
+        "ttft_p99_ms": summary["ttft_ms"]["p99"],
+        "itl_p50_ms": summary["itl_ms"]["p50"],
+        "itl_p99_ms": summary["itl_ms"]["p99"],
+        "failed": summary["failed"],
+    }
+    print(json.dumps(result))
+    if summary["failed"]:
+        print(f"# {summary['failed']} requests failed: {summary.get('first_error')}",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -272,7 +272,9 @@ class KvDataPlaneServer:
             staged.deadline = time.monotonic() + self.max_transfer_time
             try:
                 await self._stream(staged, writer)
-            except (ConnectionError, asyncio.IncompleteReadError, TimeoutError):
+            except (ConnectionError, asyncio.IncompleteReadError,
+                    TimeoutError, asyncio.TimeoutError):  # asyncio.TimeoutError
+                # is distinct from builtin TimeoutError before 3.11
                 self._unstage(staged, ok=False)
                 raise
             self._unstage(staged, ok=True)

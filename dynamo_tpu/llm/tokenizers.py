@@ -70,11 +70,15 @@ class ByteTokenizer:
 
     ids: 0=PAD, 1=BOS, 2=EOS, 3..258 = bytes 0..255. Deterministic, needs no
     assets; round-trips arbitrary UTF-8. Vocab padded to 32000 by default so
-    model shapes look realistic.
+    model shapes look realistic; ids in the padded region decode to a
+    distinct printable placeholder (U+0100 + id) rather than disappearing —
+    silently dropping generated tokens would make a stream look stalled
+    (and break token accounting for any client counting content chunks).
     """
 
     PAD, BOS, EOS = 0, 1, 2
     OFFSET = 3
+    PLACEHOLDER_BASE = 0x100  # Latin Extended-A onward: printable, 1 char/id
 
     def __init__(self, vocab_size: int = 32000):
         self._vocab_size = max(vocab_size, 256 + self.OFFSET)
@@ -83,12 +87,23 @@ class ByteTokenizer:
         return [b + self.OFFSET for b in text.encode("utf-8")]
 
     def decode(self, ids: Sequence[int], skip_special_tokens: bool = True) -> str:
-        data = bytes(
-            i - self.OFFSET
-            for i in ids
-            if self.OFFSET <= i < self.OFFSET + 256
-        )
-        return data.decode("utf-8", errors="replace")
+        parts: List[str] = []
+        run: List[int] = []  # pending byte-range ids
+
+        def flush():
+            if run:
+                parts.append(bytes(run).decode("utf-8", errors="replace"))
+                run.clear()
+
+        for i in ids:
+            if self.OFFSET <= i < self.OFFSET + 256:
+                run.append(i - self.OFFSET)
+            elif i >= self.OFFSET + 256:
+                flush()
+                parts.append(chr(self.PLACEHOLDER_BASE + (i - self.OFFSET - 256)))
+            # specials (PAD/BOS/EOS) are always dropped
+        flush()
+        return "".join(parts)
 
     def decode_stream(self, skip_special_tokens: bool = True) -> DecodeStream:
         return DecodeStream(self, skip_special_tokens)

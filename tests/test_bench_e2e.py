@@ -1,0 +1,51 @@
+"""The e2e benchmark harness (bench_e2e.py) must actually run: spawn the
+real stack, drive a seeded trace, produce the JSON result line. Guards the
+north-star metric's measurability (reference: benchmarks/utils/ harness
+role; round-2 verdict flagged `bench.py --e2e` as a broken import)."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def test_trace_is_seeded_and_sharegpt_shaped():
+    sys.path.insert(0, str(REPO))
+    from bench_e2e import build_trace
+
+    a = build_trace(64, qps=4.0, isl_mean=220, osl_mean=180, max_isl=2048,
+                    max_osl=512, vocab=512, seed=7)
+    b = build_trace(64, qps=4.0, isl_mean=220, osl_mean=180, max_isl=2048,
+                    max_osl=512, vocab=512, seed=7)
+    assert [r.token_ids for r in a] == [r.token_ids for r in b]
+    assert [r.at for r in a] == [r.at for r in b]
+    isls = [r.isl for r in a]
+    # lognormal: right-skewed, clipped, mean in the right ballpark
+    assert max(isls) <= 2048 and min(isls) >= 4
+    assert 100 < sum(isls) / len(isls) < 400
+    assert all(x.at <= y.at for x, y in zip(a, a[1:]))
+    # prefix_ratio: shared prefixes appear across requests
+    c = build_trace(32, qps=4.0, isl_mean=64, osl_mean=16, max_isl=256,
+                    max_osl=64, vocab=512, seed=7, prefix_ratio=1.0)
+    heads = {tuple(r.token_ids[:8]) for r in c}
+    assert len(heads) == 1
+
+
+def test_bench_e2e_smoke_agg_produces_result():
+    """Full harness: real discovery/frontend/worker processes, 8-request
+    trace, JSON result on stdout. This is `bench.py --e2e --smoke` in
+    miniature."""
+    r = subprocess.run(
+        [sys.executable, str(REPO / "bench_e2e.py"), "--smoke", "--mode", "agg",
+         "--requests", "8", "--qps", "8"],
+        capture_output=True, text=True, timeout=240, cwd=str(REPO),
+    )
+    assert r.returncode == 0, f"stderr tail: {r.stderr[-2000:]}"
+    line = [l for l in r.stdout.splitlines() if l.startswith("{")][-1]
+    result = json.loads(line)
+    assert result["unit"] == "tok/s"
+    assert result["value"] > 0
+    assert result["failed"] == 0
+    assert result["ttft_p50_ms"] > 0 and result["itl_p50_ms"] > 0
