@@ -94,12 +94,19 @@ def main():
     ap.add_argument("--steps", type=int, default=None, help="decode steps to time")
     ap.add_argument("--raw", action="store_true", help="only the raw-step bench")
     ap.add_argument("--e2e", action="store_true", help="serve a trace through the full stack")
+    ap.add_argument("--engine", action="store_true",
+                    help="drive JaxEngine.generate (scheduler + fetch pipeline included)")
     args, extra = ap.parse_known_args()
 
     if args.e2e:
         from bench_e2e import main as e2e_main
 
         return e2e_main(extra + (["--smoke"] if args.smoke else []))
+
+    if args.engine:
+        from bench_engine import main as engine_main
+
+        return engine_main(extra + (["--smoke"] if args.smoke else []))
 
     if not args.raw:
         return _combined(args, extra)

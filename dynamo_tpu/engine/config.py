@@ -19,6 +19,20 @@ class EngineConfig:
     # fused decode: K steps per dispatch (one host read per K*B tokens);
     # speculated tokens past a stop condition are discarded (bounded waste)
     decode_block_steps: int = 8
+    # KV-write strategy inside the fused block (measured on v5e, llama3-3b
+    # B=32 K=16):
+    #   "scatter": per-step XLA scatter into the pool carried through the
+    #     scan. Fastest at small pools (303 ms/block @ 392 pages) but the
+    #     scatter materializes pool-sized copies — 941 ms @ 1024 pages.
+    #   "local": pool stays READ-ONLY inside the scan; new KV accumulates
+    #     in a [K]-entry buffer merged by the fused pallas kernel
+    #     (ops/pallas_paged_attention._decode_local_kernel) and is written
+    #     once per block. Needs decode_block_unroll > 1: under a rolled
+    #     lax.scan XLA re-copies closed-over HBM arrays every iteration
+    #     (~4 ms/GB/step). Near pool-size-invariant; compile time grows
+    #     with the unroll factor.
+    decode_pool_mode: str = "scatter"
+    decode_block_unroll: int = 1
     # batched prefill: token budget per dispatch; lanes = budget // bucket
     prefill_batch_tokens: int = 1024
     max_prefill_batch: int = 8

@@ -206,6 +206,12 @@ class JaxEngine:
         # + up to two in-flight K-step blocks
         self._carry = None  # (tokens_dev, positions_dev, seq_lens_dev)
         self._carry_valid = False
+        # per-lane dirt: admissions/finishes/page-growth touch only their
+        # lanes via the patch program — a full invalidation would drain the
+        # block pipeline and re-upload everything (the round-2 ITL gap)
+        self._dirty_lanes: set = set()  # full lane state from host
+        self._dirty_tables: set = set()  # page-table row only (lane carry
+        # on device is NEWER than host and must not be overwritten)
         self._tables_dev = None
         self._samp_dev = None
         self._inflight: deque = deque()  # [{"active": [...], "toks": dev[K,B]}]
@@ -250,25 +256,86 @@ class JaxEngine:
         # (split inside jit, advanced key returned): an eager
         # jax.random.split per dispatch costs a host round-trip — measured
         # ~9 ms/step through the axon tunnel, the round-1 ITL killer
-        @partial(jax.jit, donate_argnums=(1, 2, 8), out_shardings=decode_out_sh)
-        def decode_block(params, kv_k, kv_v, tokens, positions, seq_lens, page_tables, samp, rng):
-            """K fused decode steps: sampled tokens feed the next step on
-            device — one host read per K*B tokens instead of per token."""
-            rng, sub = jax.random.split(rng)
-            keys = jax.random.split(sub, K)
+        if cfg.decode_pool_mode == "local":
 
-            def step(carry, k):
-                tokens, positions, seq_lens, kv_k, kv_v = carry
-                logits, kv_k, kv_v = self._model.decode_forward(
-                    params, c, tokens, positions, kv_k, kv_v, page_tables, seq_lens
+            @partial(jax.jit, donate_argnums=(1, 2, 8), out_shardings=decode_out_sh)
+            def decode_block(params, kv_k, kv_v, tokens, positions, seq_lens, page_tables, samp, rng):
+                """K fused decode steps, pool READ-ONLY inside the scan.
+
+                A per-step scatter into the pool makes XLA materialize
+                pool-sized copies (941 ms/block at 1024 pages vs 215 at 161
+                on v5e). Here new K/V accumulate in per-layer [B, K, KH, D]
+                local buffers — the fused pallas kernel merges them into the
+                flash softmax — and the pool is written ONCE per block.
+                Requires decode_block_unroll > 1 to dodge lax.scan's
+                per-iteration re-copy of closed-over HBM arrays."""
+                rng, sub = jax.random.split(rng)
+                keys = jax.random.split(sub, K)
+                B = tokens.shape[0]
+                pool_lens = jnp.maximum(seq_lens - 1, 0)
+                start_pos = positions
+                loc_shape = (B, K, c.num_kv_heads, c.head_dim)
+                loc_k0 = tuple(
+                    jnp.zeros(loc_shape, kv_k.dtype) for _ in range(c.num_layers)
                 )
-                nxt = sample(logits, samp, k)
-                return (nxt, positions + 1, seq_lens + 1, kv_k, kv_v), nxt
+                loc_v0 = tuple(
+                    jnp.zeros(loc_shape, kv_v.dtype) for _ in range(c.num_layers)
+                )
 
-            (tokens, positions, seq_lens, kv_k, kv_v), toks = jax.lax.scan(
-                step, (tokens, positions, seq_lens, kv_k, kv_v), keys
-            )
-            return toks, tokens, positions, seq_lens, kv_k, kv_v, rng
+                def step(carry, inp):
+                    key_j, j = inp
+                    tokens, positions, seq_lens, loc_k, loc_v = carry
+                    logits, loc_k, loc_v = self._model.decode_forward_local(
+                        params, c, tokens, positions, loc_k, loc_v, j,
+                        kv_k, kv_v, page_tables, pool_lens,
+                    )
+                    nxt = sample(logits, samp, key_j)
+                    return (nxt, positions + 1, seq_lens + 1, loc_k, loc_v), nxt
+
+                (tokens, positions, seq_lens, loc_k, loc_v), toks = jax.lax.scan(
+                    step,
+                    (tokens, positions, seq_lens, loc_k0, loc_v0),
+                    (keys, jnp.arange(K)),
+                    unroll=min(max(cfg.decode_block_unroll, 1), K),
+                )
+                # one pool scatter for the whole block. Inactive lanes write
+                # via their SCRATCH table rows (the host keeps non-active
+                # lanes' device table rows at scratch), positions past the
+                # table route to physical page 0.
+                page_size = cfg.page_size
+                P = page_tables.shape[1]
+                pos = start_pos[:, None] + jnp.arange(K)[None, :]  # [B, K]
+                logical = jnp.minimum(pos // page_size, P - 1)
+                phys = jnp.take_along_axis(page_tables, logical, axis=1)
+                phys = jnp.where(pos < P * page_size, phys, 0)
+                offs = pos % page_size
+                kv_k = kv_k.at[:, phys, offs].set(jnp.stack(loc_k))
+                kv_v = kv_v.at[:, phys, offs].set(jnp.stack(loc_v))
+                return toks, tokens, positions, seq_lens, kv_k, kv_v, rng
+
+        else:
+
+            @partial(jax.jit, donate_argnums=(1, 2, 8), out_shardings=decode_out_sh)
+            def decode_block(params, kv_k, kv_v, tokens, positions, seq_lens, page_tables, samp, rng):
+                """K fused decode steps: sampled tokens feed the next step on
+                device — one host read per K*B tokens instead of per token.
+                Per-step pool scatter (best at small/medium pools; see
+                EngineConfig.decode_pool_mode for the trade-off)."""
+                rng, sub = jax.random.split(rng)
+                keys = jax.random.split(sub, K)
+
+                def step(carry, k):
+                    tokens, positions, seq_lens, kv_k, kv_v = carry
+                    logits, kv_k, kv_v = self._model.decode_forward(
+                        params, c, tokens, positions, kv_k, kv_v, page_tables, seq_lens
+                    )
+                    nxt = sample(logits, samp, k)
+                    return (nxt, positions + 1, seq_lens + 1, kv_k, kv_v), nxt
+
+                (tokens, positions, seq_lens, kv_k, kv_v), toks = jax.lax.scan(
+                    step, (tokens, positions, seq_lens, kv_k, kv_v), keys
+                )
+                return toks, tokens, positions, seq_lens, kv_k, kv_v, rng
 
         self._decode_block = decode_block
 
@@ -283,6 +350,36 @@ class JaxEngine:
             return first, kv_k, kv_v, rng
 
         self._prefill_batch = prefill_batch
+
+        # per-lane carry patch: admissions/finishes update ONLY their lanes
+        # on device instead of invalidating the whole carry (a full reset
+        # forces a pipeline drain + re-upload — the round-2 ITL gap under
+        # churn). lane_mask patches carry+sampling+table; table_mask extends
+        # to lanes whose page table grew mid-decode (their carry values on
+        # device are NEWER than host state and must not be overwritten).
+        patch_out_sh = None
+        if self._mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            repl = NamedSharding(self._mesh, PartitionSpec())
+            patch_out_sh = (repl,) * 7
+
+        @partial(jax.jit, out_shardings=patch_out_sh)
+        def patch_lanes(
+            tokens, positions, seq_lens, tables, temps, top_ks, top_ps,
+            lane_mask, table_mask,
+            n_tokens, n_positions, n_seq_lens, n_tables, n_temps, n_top_ks, n_top_ps,
+        ):
+            tokens = jnp.where(lane_mask, n_tokens, tokens)
+            positions = jnp.where(lane_mask, n_positions, positions)
+            seq_lens = jnp.where(lane_mask, n_seq_lens, seq_lens)
+            temps = jnp.where(lane_mask, n_temps, temps)
+            top_ks = jnp.where(lane_mask, n_top_ks, top_ks)
+            top_ps = jnp.where(lane_mask, n_top_ps, top_ps)
+            tables = jnp.where(table_mask[:, None], n_tables, tables)
+            return tokens, positions, seq_lens, tables, temps, top_ks, top_ps
+
+        self._patch_lanes = patch_lanes
 
         # disagg KV movement (host-staged; llm/disagg.py wire format)
         @jax.jit
@@ -592,6 +689,12 @@ class JaxEngine:
         if self._spmd is not None:
             self._spmd.send(tag, arrays)
 
+    def _mark_lane_dirty(self, idx: int):
+        """Lane state changed on host (admission/finish/resume): patch just
+        that lane before the next block instead of a full carry reset."""
+        if self._carry_valid and idx >= 0:
+            self._dirty_lanes.add(idx)
+
     # -- replicated device programs (leader dispatches these after a
     # _bcast; followers replay them verbatim in run_follower) ------------ #
 
@@ -627,6 +730,21 @@ class JaxEngine:
             jnp.asarray(seq_lens),
         )
         self._tables_dev = jnp.asarray(page_tables)
+
+    def _dev_patch(self, lane_mask, table_mask, tokens, positions, seq_lens,
+                   tables, temps, top_ks, top_ps):
+        samp = self._samp_dev
+        tok_d, pos_d, sl_d, tab_d, t_d, k_d, p_d = self._patch_lanes(
+            self._carry[0], self._carry[1], self._carry[2], self._tables_dev,
+            samp.temperature, samp.top_k, samp.top_p,
+            jnp.asarray(lane_mask), jnp.asarray(table_mask),
+            jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(seq_lens),
+            jnp.asarray(tables), jnp.asarray(temps), jnp.asarray(top_ks),
+            jnp.asarray(top_ps),
+        )
+        self._carry = (tok_d, pos_d, sl_d)
+        self._tables_dev = tab_d
+        self._samp_dev = SamplingParams(temperature=t_d, top_k=k_d, top_p=p_d)
 
     def _dev_block(self):
         carry = self._carry
@@ -700,6 +818,15 @@ class JaxEngine:
                         p["page_tables"], p["temps"], p["top_ks"], p["top_ps"],
                     )
                 )
+            elif tag == "patch":
+                await self._run_on_device(
+                    partial(
+                        self._dev_patch,
+                        p["lane_mask"], p["table_mask"], p["tokens"],
+                        p["positions"], p["seq_lens"], p["page_tables"],
+                        p["temps"], p["top_ks"], p["top_ps"],
+                    )
+                )
             elif tag == "block":
                 await self._run_on_device(self._dev_block)
             elif tag == "inject":
@@ -755,7 +882,7 @@ class JaxEngine:
         slot.seq.append(first_token)
         self.tokens[slot.slot_idx] = first_token
         self.seq_lens[slot.slot_idx] = len(slot.prompt) + 1
-        self._carry_valid = False
+        self._mark_lane_dirty(slot.slot_idx)
         self._maybe_finish(slot, first_token)
 
     async def _pull_kv_task(self, slot: _Slot, desc_dict: dict, first_token: int):
@@ -947,7 +1074,7 @@ class JaxEngine:
             slot.last_token = first
             self.tokens[slot.slot_idx] = first
             self.seq_lens[slot.slot_idx] = len(slot.kv_prompt) + 1
-            self._carry_valid = False
+            self._mark_lane_dirty(slot.slot_idx)
             return
         self._emit_token(slot, first)
         if not slot.done:
@@ -956,7 +1083,7 @@ class JaxEngine:
             slot.seq.append(first)
             self.tokens[slot.slot_idx] = first
             self.seq_lens[slot.slot_idx] = len(slot.kv_prompt) + 1
-            self._carry_valid = False
+            self._mark_lane_dirty(slot.slot_idx)
             self._maybe_finish(slot, first)
 
     async def _emit_prefill_result(self, slot: _Slot, first_token: int):
@@ -1088,7 +1215,10 @@ class JaxEngine:
                 if fresh is not None:
                     slot.pages.extend(fresh)
                     self.page_tables[i, len(slot.pages) - 1] = fresh[0] + 1
-                    self._carry_valid = False
+                    if self._carry_valid:
+                        # table-row-only patch: this lane's carry values on
+                        # device are newer than host (blocks in flight)
+                        self._dirty_tables.add(i)
                     continue
                 if not self._preempt_one(exclude_idx=i):
                     # nothing left to preempt: finish with length
@@ -1140,6 +1270,13 @@ class JaxEngine:
 
         B = cfg.max_num_seqs
         K = cfg.decode_block_steps
+        # the DEVICE decode table keeps SCRATCH rows for every lane that is
+        # not decode-active: inside a fused block, inactive lanes' seq_lens
+        # still advance (lax.scan carries the whole batch), so their KV
+        # writes would otherwise land at positions 0..K-1 of whatever the
+        # host table row points at — including a PREFILLING slot's pages
+        # (possibly shared prefix-cache pages). A scratch row routes all
+        # such writes to the reserved scratch page by construction.
         if not self._carry_valid:
             mask = np.zeros((B,), bool)
             for i in active:
@@ -1147,11 +1284,14 @@ class JaxEngine:
             positions = np.where(mask, self.seq_lens - 1, 0).astype(np.int32)
             seq_lens_step = np.where(mask, self.seq_lens, 0).astype(np.int32)
             tokens = np.where(mask, self.tokens, 0).astype(np.int32)
+            tables = np.where(
+                mask[:, None], self.page_tables, SCRATCH_PAGE
+            ).astype(np.int32)
             self._bcast(
                 "reset",
                 {
                     "tokens": tokens, "positions": positions,
-                    "seq_lens": seq_lens_step, "page_tables": self.page_tables,
+                    "seq_lens": seq_lens_step, "page_tables": tables,
                     "temps": self.temps, "top_ks": self.top_ks,
                     "top_ps": self.top_ps,
                 },
@@ -1160,11 +1300,53 @@ class JaxEngine:
                 partial(
                     self._dev_reset,
                     tokens, positions, seq_lens_step,
-                    self.page_tables.copy(), self.temps.copy(),
+                    tables, self.temps.copy(),
                     self.top_ks.copy(), self.top_ps.copy(),
                 )
             )
             self._carry_valid = True
+            self._dirty_lanes.clear()
+            self._dirty_tables.clear()
+        elif self._dirty_lanes or self._dirty_tables:
+            # per-lane patch: update just the changed lanes on device — no
+            # pipeline drain, no full re-upload. Untouched lanes keep their
+            # (newer) device carry; table_mask covers lanes whose page table
+            # grew but whose carry must be preserved.
+            lane_mask = np.zeros((B,), bool)
+            for i in self._dirty_lanes:
+                lane_mask[i] = True
+            table_mask = lane_mask.copy()
+            for i in self._dirty_tables:
+                table_mask[i] = True
+            active_mask = np.zeros((B,), bool)
+            for i in active:
+                active_mask[i] = True
+            n_tokens = np.where(active_mask, self.tokens, 0).astype(np.int32)
+            n_positions = np.where(active_mask, self.seq_lens - 1, 0).astype(np.int32)
+            n_seq_lens = np.where(active_mask, self.seq_lens, 0).astype(np.int32)
+            n_tables = np.where(
+                active_mask[:, None], self.page_tables, SCRATCH_PAGE
+            ).astype(np.int32)
+            self._bcast(
+                "patch",
+                {
+                    "lane_mask": lane_mask, "table_mask": table_mask,
+                    "tokens": n_tokens, "positions": n_positions,
+                    "seq_lens": n_seq_lens, "page_tables": n_tables,
+                    "temps": self.temps, "top_ks": self.top_ks,
+                    "top_ps": self.top_ps,
+                },
+            )
+            await self._run_on_device(
+                partial(
+                    self._dev_patch, lane_mask, table_mask,
+                    n_tokens, n_positions, n_seq_lens,
+                    n_tables, self.temps.copy(),
+                    self.top_ks.copy(), self.top_ps.copy(),
+                )
+            )
+            self._dirty_lanes.clear()
+            self._dirty_tables.clear()
 
         self._bcast("block", {})
         toks_dev = await self._run_on_device(self._dev_block)
@@ -1238,6 +1420,8 @@ class JaxEngine:
         self._inflight.clear()
         self._pending_prefill = []
         self._carry_valid = False
+        self._dirty_lanes.clear()
+        self._dirty_tables.clear()
         for slot in list(self.slots):
             if slot is not None:
                 if not slot.done:
@@ -1286,14 +1470,20 @@ class JaxEngine:
             # commit any full generated blocks before release so decode KV is
             # reusable (conversation prefix reuse / cheap preemption resume)
             self._commit_generated_blocks(slot)
+            # releasing while blocks are in flight is safe: in-flight writes
+            # for this lane land strictly AFTER its last committed position
+            # (speculation starts past the fetched tokens), i.e. only on
+            # free tail pages — and any reuse of those pages is re-written
+            # by a later-dispatched (device-ordered) prefill/inject
             self.allocator.release(slot.pages, slot.committed_hashes)
-            self.slots[slot.slot_idx] = None
-            self._free_slots.append(slot.slot_idx)
-            self.page_tables[slot.slot_idx, :] = SCRATCH_PAGE
-            self.seq_lens[slot.slot_idx] = 0
+            idx = slot.slot_idx
+            self.slots[idx] = None
+            self._free_slots.append(idx)
+            self.page_tables[idx, :] = SCRATCH_PAGE
+            self.seq_lens[idx] = 0
             slot.slot_idx = -1
             slot.pages = []
-            self._carry_valid = False
+            self._mark_lane_dirty(idx)
 
     def _commit_generated_blocks(self, slot: _Slot):
         hashes = slot.seq.block_hashes()

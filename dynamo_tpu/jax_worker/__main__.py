@@ -31,6 +31,11 @@ def parse_args():
     ap.add_argument("--num-pages", type=int, default=2048)
     ap.add_argument("--max-num-seqs", type=int, default=64)
     ap.add_argument("--max-model-len", type=int, default=8192)
+    ap.add_argument("--decode-pool-mode", choices=["scatter", "local"],
+                    default="scatter",
+                    help="KV-write strategy in the fused decode block "
+                    "(local + unroll for multi-GB page pools)")
+    ap.add_argument("--decode-block-unroll", type=int, default=1)
     ap.add_argument("--tp-size", type=int, default=1)
     ap.add_argument("--ep-size", type=int, default=1,
                     help="expert-parallel axis size (MoE models)")
@@ -95,6 +100,8 @@ async def main():
         num_pages=args.num_pages,
         max_num_seqs=args.max_num_seqs,
         max_model_len=args.max_model_len,
+        decode_pool_mode=args.decode_pool_mode,
+        decode_block_unroll=args.decode_block_unroll,
         tp_size=args.tp_size,
         kvbm_host_blocks=args.kvbm_host_blocks,
         kvbm_disk_blocks=args.kvbm_disk_blocks,

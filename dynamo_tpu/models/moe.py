@@ -193,6 +193,26 @@ def decode_forward(
     )
 
 
+def decode_forward_local(
+    params: Dict[str, Any],
+    config: MoeConfig,
+    tokens: jax.Array,
+    positions: jax.Array,
+    loc_k: jax.Array,
+    loc_v: jax.Array,
+    step_idx: jax.Array,
+    kv_k: jax.Array,
+    kv_v: jax.Array,
+    page_tables: jax.Array,
+    pool_lens: jax.Array,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Pool-read-only decode step (block-local KV accumulation), MoE MLP."""
+    return llama.decode_forward_local(
+        params, config, tokens, positions, loc_k, loc_v, step_idx,
+        kv_k, kv_v, page_tables, pool_lens, mlp_fn=moe_mlp,
+    )
+
+
 def prefill_forward(
     params: Dict[str, Any],
     config: MoeConfig,

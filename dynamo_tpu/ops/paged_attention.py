@@ -133,6 +133,69 @@ def _use_pallas_decode() -> bool:
         return False
 
 
+def paged_attention_decode_mixed(
+    q: jax.Array,  # [B, H, D]
+    kv_k_layer: jax.Array,  # [pages, page_size, KH, D] — READ-ONLY pool
+    kv_v_layer: jax.Array,
+    page_tables: jax.Array,  # [B, max_pages]
+    pool_lens: jax.Array,  # [B] positions valid IN THE POOL (block-start len)
+    loc_k: jax.Array,  # [B, K, KH, D] block-local new keys (this layer)
+    loc_v: jax.Array,
+    step_idx: jax.Array,  # scalar i32: local entries 0..step_idx are valid
+) -> jax.Array:
+    """Decode attention over paged pool + block-local buffer.
+
+    The fused-decode-block design (engine/engine.py) keeps the KV pool
+    READ-ONLY inside the K-step lax.scan — per-step scatters into a
+    multi-GB pool force XLA to materialize carry copies that scale with
+    pool size, not with bytes written (the reference never meets this: CUDA
+    writes KV in place, lib/llm/src/kernels/block_copy.cu). New tokens
+    accumulate in a [K]-entry local buffer carried through the scan and are
+    scattered into the pool ONCE per block. Attention therefore reads pool
+    pages (frozen at block start) plus the valid local prefix, merged with
+    a log-sum-exp combine on the Pallas path or a single concatenated
+    softmax on the XLA path.
+    """
+    B, H, D = q.shape
+    KH = kv_k_layer.shape[2]
+    G = H // KH
+    K = loc_k.shape[1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
+    KH_, D_ = kv_k_layer.shape[2], kv_k_layer.shape[3]
+    if (KH_ * D_) % 128 == 0 and _use_pallas_decode():
+        # pool chunks AND the local buffer flash-merge inside ONE kernel
+        # launch — an XLA-level lse combine costs ~8 extra op launches per
+        # layer-step, which dominates a 28-layer x 16-step fused block
+        from .pallas_paged_attention import paged_attention_decode_pallas_local
+
+        return paged_attention_decode_pallas_local(
+            q, kv_k_layer, kv_v_layer, page_tables, pool_lens,
+            loc_k, loc_v, step_idx,
+        )
+
+    # XLA reference path: gather pool pages, concatenate the local buffer,
+    # one softmax over both
+    page_size = kv_k_layer.shape[1]
+    S = page_tables.shape[1] * page_size
+    ctx_k = kv_k_layer[page_tables].reshape(B, S, KH, D)
+    ctx_v = kv_v_layer[page_tables].reshape(B, S, KH, D)
+    cat_k = jnp.concatenate([ctx_k, loc_k.astype(ctx_k.dtype)], axis=1)
+    cat_v = jnp.concatenate([ctx_v, loc_v.astype(ctx_v.dtype)], axis=1)
+    qg = q.reshape(B, KH, G, D)
+    scores = jnp.einsum(
+        "bkgd,bskd->bkgs", qg, cat_k, preferred_element_type=jnp.float32
+    ) * scale
+    pool_valid = jnp.arange(S)[None, :] < pool_lens[:, None]  # [B, S]
+    loc_valid = jnp.broadcast_to(
+        jnp.arange(K)[None, :] <= step_idx, (B, K)
+    )
+    mask = jnp.concatenate([pool_valid, loc_valid], axis=1)  # [B, S+K]
+    scores = jnp.where(mask[:, None, None, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgs,bskd->bkgd", probs.astype(cat_v.dtype), cat_v)
+    return out.reshape(B, H, D)
+
+
 def paged_attention_decode(
     q: jax.Array,  # [B, H, D]
     kv_k_layer: jax.Array,  # [pages, page_size, KH, D]

@@ -48,6 +48,9 @@ def _decode_kernel(
     kv_v_hbm,
     # outputs
     out_ref,  # [1, H, D] VMEM block
+    m_ref,  # [1, HG, 128] f32: running max (broadcast over lanes) — lets the
+    # caller merge this flash result with extra keys (block-local buffer)
+    l_ref,  # [1, HG, 128] f32: running sum-exp
     # scratch
     k_buf,  # [2, CHUNK, KH*D] VMEM
     v_buf,
@@ -160,6 +163,221 @@ def _decode_kernel(
         out = out + jnp.where(row_head == k0, blk, 0.0)
     out = out / jnp.maximum(l, 1e-30)
     out_ref[0] = out.astype(out_ref.dtype)
+    m_ref[0] = jnp.broadcast_to(m, (hg, 128))
+    l_ref[0] = jnp.broadcast_to(l, (hg, 128))
+
+
+def _decode_local_kernel(
+    # scalar prefetch
+    pt_ref,  # [B, max_pages] int32 (SMEM)
+    sl_ref,  # [B] int32 (SMEM) — POOL lengths (block-start)
+    step_ref,  # [1] int32 (SMEM) — local entries 0..step valid
+    # inputs
+    q_ref,  # [1, HG, KH*D] VMEM (block-diagonal packed)
+    loc_k_ref,  # [1, K, KH*D] VMEM — block-local new keys for this lane
+    loc_v_ref,
+    kv_k_hbm,  # [num_pages, page_size, KH*D] (ANY/HBM)
+    kv_v_hbm,
+    # outputs
+    out_ref,  # [1, H, D]
+    # scratch
+    k_buf,
+    v_buf,
+    k_sem,
+    v_sem,
+    *,
+    page_size: int,
+    chunk_pages: int,
+    max_pages: int,
+    num_heads: int,
+    num_kv_heads: int,
+    head_dim: int,
+):
+    """Decode flash attention over pool pages PLUS a block-local KV buffer,
+    all in one kernel launch. The local part is what lets the engine keep
+    the KV pool read-only inside its fused K-step scan (engine/engine.py
+    decode_block): per-step XLA-level combines cost ~8 extra op launches
+    per layer-step, which dominated the block at 28 layers x 16 steps."""
+    b = pl.program_id(0)
+    chunk = chunk_pages * page_size
+    num_phys = kv_k_hbm.shape[0]
+    kh, g, d = num_kv_heads, num_heads // num_kv_heads, head_dim
+
+    seq_len = jnp.maximum(sl_ref[b], 1)
+    n_chunks = pl.cdiv(seq_len, chunk)
+
+    def start_chunk(ci, slot):
+        for p in range(chunk_pages):
+            lp = ci * chunk_pages + p
+            lp_safe = jnp.minimum(lp, max_pages - 1)
+            phys = jnp.minimum(pt_ref[b, lp_safe], num_phys - 1)
+            pltpu.make_async_copy(
+                kv_k_hbm.at[phys],
+                k_buf.at[slot, pl.ds(p * page_size, page_size)],
+                k_sem.at[slot, p],
+            ).start()
+            pltpu.make_async_copy(
+                kv_v_hbm.at[phys],
+                v_buf.at[slot, pl.ds(p * page_size, page_size)],
+                v_sem.at[slot, p],
+            ).start()
+
+    def wait_chunk(ci, slot):
+        for p in range(chunk_pages):
+            lp_safe = jnp.minimum(ci * chunk_pages + p, max_pages - 1)
+            phys = jnp.minimum(pt_ref[b, lp_safe], num_phys - 1)
+            pltpu.make_async_copy(
+                kv_k_hbm.at[phys],
+                k_buf.at[slot, pl.ds(p * page_size, page_size)],
+                k_sem.at[slot, p],
+            ).wait()
+            pltpu.make_async_copy(
+                kv_v_hbm.at[phys],
+                v_buf.at[slot, pl.ds(p * page_size, page_size)],
+                v_sem.at[slot, p],
+            ).wait()
+
+    start_chunk(0, 0)
+    hg = kh * g
+    q_bd = q_ref[0]
+
+    m0 = jnp.full((hg, 1), NEG, jnp.float32)
+    l0 = jnp.zeros((hg, 1), jnp.float32)
+    acc0 = jnp.zeros((hg, kh * d), jnp.float32)
+
+    def flash_update(s, valid, v, carry):
+        m, l, acc = carry
+        s = jnp.where(valid, s, NEG)
+        m_n = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_n)
+        p = jnp.exp(s - m_n)
+        l_n = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_n, l_n, acc * alpha + pv
+
+    def body(ci, carry):
+        slot = jax.lax.rem(ci, 2)
+
+        @pl.when(ci + 1 < n_chunks)
+        def _():
+            start_chunk(ci + 1, jax.lax.rem(ci + 1, 2))
+
+        wait_chunk(ci, slot)
+        k = k_buf[slot]
+        v = v_buf[slot]
+        pos = ci * chunk + jax.lax.broadcasted_iota(jnp.int32, (1, chunk), 1)
+        s = jax.lax.dot_general(
+            q_bd.astype(k.dtype), k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return flash_update(s, pos < seq_len, v, carry)
+
+    m, l, acc = jax.lax.fori_loop(0, n_chunks, body, (m0, l0, acc0))
+
+    # local buffer: one more flash iteration over the K in-block entries
+    k_loc = loc_k_ref[0]  # [K, KH*D]
+    v_loc = loc_v_ref[0]
+    K_loc = k_loc.shape[0]
+    j = jax.lax.broadcasted_iota(jnp.int32, (1, K_loc), 1)
+    s_loc = jax.lax.dot_general(
+        q_bd.astype(k_loc.dtype), k_loc, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    m, l, acc = flash_update(s_loc, j <= step_ref[0], v_loc, (m, l, acc))
+
+    row_head = jax.lax.broadcasted_iota(jnp.int32, (hg, 1), 0) // g
+    out = jnp.zeros((hg, d), jnp.float32)
+    for k0 in range(kh):
+        blk = jax.lax.slice(acc, (0, k0 * d), (hg, (k0 + 1) * d))
+        out = out + jnp.where(row_head == k0, blk, 0.0)
+    out = out / jnp.maximum(l, 1e-30)
+    out_ref[0] = out.astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_attention_decode_pallas_local(
+    q: jax.Array,  # [B, H, D]
+    kv_k_layer: jax.Array,  # [num_pages, page_size, KH, D] (READ-ONLY pool)
+    kv_v_layer: jax.Array,
+    page_tables: jax.Array,  # [B, max_pages] int32
+    pool_lens: jax.Array,  # [B] int32 — positions valid in the pool
+    loc_k: jax.Array,  # [B, K, KH, D] block-local new keys
+    loc_v: jax.Array,
+    step_idx: jax.Array,  # scalar i32 — local entries 0..step_idx valid
+    *,
+    interpret: bool = False,
+) -> jax.Array:
+    """Fused pool+local decode attention; returns [B, H, D] (q.dtype)."""
+    B, H, D = q.shape
+    num_pages, page_size, KH, _ = kv_k_layer.shape
+    max_pages = page_tables.shape[1]
+    K_loc = loc_k.shape[1]
+    target = 512 if KH * D * page_size <= 131072 else 256
+    chunk_pages = max(1, target // page_size)
+    chunk_pages = min(chunk_pages, max_pages)
+
+    KHG = KH * (H // KH)
+    scale = 1.0 / (D**0.5)
+    q_r = (q * scale).reshape(B, KH, H // KH, D)
+    eye = jnp.eye(KH, dtype=q.dtype)
+    q_bd = jnp.einsum("bkgd,kj->bkgjd", q_r, eye).reshape(B, KHG, KH * D)
+
+    kv_k_flat = kv_k_layer.reshape(num_pages, page_size, KH * D)
+    kv_v_flat = kv_v_layer.reshape(num_pages, page_size, KH * D)
+    loc_k_flat = loc_k.reshape(B, K_loc, KH * D)
+    loc_v_flat = loc_v.reshape(B, K_loc, KH * D)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((1, KHG, KH * D), lambda b, *_: (b, 0, 0)),
+            pl.BlockSpec((1, K_loc, KH * D), lambda b, *_: (b, 0, 0)),
+            pl.BlockSpec((1, K_loc, KH * D), lambda b, *_: (b, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, H, D), lambda b, *_: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, chunk_pages * page_size, KH * D), kv_k_layer.dtype),
+            pltpu.VMEM((2, chunk_pages * page_size, KH * D), kv_v_layer.dtype),
+            pltpu.SemaphoreType.DMA((2, chunk_pages)),
+            pltpu.SemaphoreType.DMA((2, chunk_pages)),
+        ],
+    )
+    kernel = functools.partial(
+        _decode_local_kernel,
+        page_size=page_size,
+        chunk_pages=chunk_pages,
+        max_pages=max_pages,
+        num_heads=H,
+        num_kv_heads=KH,
+        head_dim=D,
+    )
+    cost = pl.CostEstimate(
+        flops=4 * B * H * D * (max_pages * page_size + K_loc),
+        bytes_accessed=2 * B * max_pages * page_size * KH * D * 2,
+        transcendentals=B * H * (max_pages * page_size + K_loc),
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
+        cost_estimate=cost,
+        interpret=interpret,
+    )(
+        page_tables.astype(jnp.int32),
+        pool_lens.astype(jnp.int32),
+        jnp.reshape(step_idx, (1,)).astype(jnp.int32),
+        q_bd,
+        loc_k_flat,
+        loc_v_flat,
+        kv_k_flat,
+        kv_v_flat,
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -173,6 +391,27 @@ def paged_attention_decode_pallas(
     interpret: bool = False,
 ) -> jax.Array:
     """Flash decode attention over paged KV; returns [B, H, D] (q.dtype)."""
+    out, _, _ = paged_attention_decode_pallas_lse(
+        q, kv_k_layer, kv_v_layer, page_tables, seq_lens, interpret=interpret
+    )
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_attention_decode_pallas_lse(
+    q: jax.Array,  # [B, H, D]
+    kv_k_layer: jax.Array,  # [num_pages, page_size, KH, D]
+    kv_v_layer: jax.Array,
+    page_tables: jax.Array,  # [B, max_pages] int32
+    seq_lens: jax.Array,  # [B] int32
+    *,
+    interpret: bool = False,
+):
+    """Flash decode attention + softmax state: returns (out [B,H,D],
+    m [B,H], l [B,H]) where scores were scaled by 1/sqrt(D). The (m, l)
+    pair lets the caller merge in extra keys (e.g. a block-local KV buffer)
+    with a standard log-sum-exp combine — the mechanism behind the
+    write-KV-once-per-block decode design (engine/engine.py decode_block)."""
     B, H, D = q.shape
     num_pages, page_size, KH, _ = kv_k_layer.shape
     max_pages = page_tables.shape[1]
@@ -202,7 +441,11 @@ def paged_attention_decode_pallas(
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
         ],
-        out_specs=pl.BlockSpec((1, H, D), lambda b, *_: (b, 0, 0)),
+        out_specs=[
+            pl.BlockSpec((1, H, D), lambda b, *_: (b, 0, 0)),
+            pl.BlockSpec((1, KHG, 128), lambda b, *_: (b, 0, 0)),
+            pl.BlockSpec((1, KHG, 128), lambda b, *_: (b, 0, 0)),
+        ],
         scratch_shapes=[
             pltpu.VMEM((2, chunk_pages * page_size, KH * D), kv_k_layer.dtype),
             pltpu.VMEM((2, chunk_pages * page_size, KH * D), kv_v_layer.dtype),
@@ -224,10 +467,17 @@ def paged_attention_decode_pallas(
         bytes_accessed=2 * B * max_pages * page_size * KH * D * 2,
         transcendentals=B * H * max_pages * page_size,
     )
-    return pl.pallas_call(
+    out, m_b, l_b = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, D), q.dtype),
+            jax.ShapeDtypeStruct((B, KHG, 128), jnp.float32),
+            jax.ShapeDtypeStruct((B, KHG, 128), jnp.float32),
+        ],
         cost_estimate=cost,
         interpret=interpret,
     )(page_tables.astype(jnp.int32), seq_lens.astype(jnp.int32), q_bd, kv_k_flat, kv_v_flat)
+    # KHG == H (rows are (kv_head, group) pairs in head order); lane 0 holds
+    # the broadcast value
+    return out, m_b[:, :, 0], l_b[:, :, 0]
