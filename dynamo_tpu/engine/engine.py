@@ -196,6 +196,14 @@ class JaxEngine:
         # optional llm.kv_transfer.KvDataPlaneServer (worker attaches it):
         # enables the descriptor/pull disagg path instead of inline payloads
         self.data_plane = None
+        # multi-host shard rendezvous (worker wires these after the SPMD
+        # followers connect): this host's id and the per-host data-plane
+        # addresses [host0, host1, ...]. With these set, disagg KV moves
+        # per-shard point-to-point — no process_allgather of the full pages,
+        # no leader re-broadcast of KV bytes (reference scaling property:
+        # NIXL point-to-point descriptors, block_manager/storage/nixl.rs)
+        self.host_id = 0
+        self.shard_addrs: Optional[List[str]] = None
         self._closed = False
         self._rng = jax.random.PRNGKey(config.seed + 1)
         self._step_counter = 0
@@ -782,7 +790,9 @@ class JaxEngine:
     def _dev_extract(self, page_ids):
         """Gather pages to host (disagg KV hand-off). On a multi-host mesh
         the KV shards live on several hosts — process_allgather (a
-        collective: followers run it too) assembles the full pages."""
+        collective: followers run it too) assembles the full pages. Used
+        only by the INLINE-payload fallback; the pull data plane moves
+        per-host shards instead (_extract_local_shard)."""
         k, v = self._extract_pages(self.kv_k, self.kv_v, jnp.asarray(page_ids))
         if self._multihost:
             from jax.experimental import multihost_utils
@@ -792,6 +802,66 @@ class JaxEngine:
                 multihost_utils.process_allgather(v),
             )
         return np.asarray(k), np.asarray(v)
+
+    def _local_shard_views(self):
+        """This host's KV shard pieces, deduped across replicas and sorted
+        by the sharded (kv-head) axis slice. Single-device arrays — safe to
+        index at host-divergent times (no collectives)."""
+        def pick(arr):
+            seen = {}
+            for s in arr.addressable_shards:
+                key = tuple(
+                    (sl.start or 0, sl.stop) for sl in s.index
+                )
+                if key not in seen:
+                    seen[key] = s
+            return [
+                s for _, s in sorted(
+                    seen.items(), key=lambda kv: kv[0][3][0]
+                )
+            ]
+        return pick(self.kv_k), pick(self.kv_v)
+
+    def local_shard_page_shape(self) -> List[int]:
+        """[L, page, KH_local, D] of this host's combined shard."""
+        ks, _ = self._local_shard_views()
+        L = ks[0].data.shape[0]
+        page = ks[0].data.shape[2]
+        kh_local = sum(s.data.shape[3] for s in ks)
+        d = ks[0].data.shape[4]
+        return [L, page, kh_local, d]
+
+    def _extract_local_shard(self, page_ids):
+        """Gather the requested page rows of THIS host's shard only: a
+        per-device gather on each addressable shard (no collective, no
+        cross-host bytes). Returns numpy [L, n, page, KH_local, D]."""
+        ids = jnp.asarray(page_ids)
+        ks, vs = self._local_shard_views()
+        k_parts = [np.asarray(s.data[:, ids]) for s in ks]
+        v_parts = [np.asarray(s.data[:, ids]) for s in vs]
+        k = k_parts[0] if len(k_parts) == 1 else np.concatenate(k_parts, axis=3)
+        v = v_parts[0] if len(v_parts) == 1 else np.concatenate(v_parts, axis=3)
+        return k, v
+
+    def _dev_inject_shard(self, page_ids, k_local, v_local):
+        """SPMD inject where each host supplies ITS OWN shard bytes: build a
+        global array from process-local data (metadata-only; no cross-host
+        transfer) and enter the same jitted scatter on every host."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        if self._kv_sharding is not None:
+            sharding = self._kv_sharding
+        else:
+            sharding = NamedSharding(self._mesh, PartitionSpec())
+        L, n, page, d = (
+            k_local.shape[0], k_local.shape[1], k_local.shape[2], k_local.shape[4]
+        )
+        global_shape = (L, n, page, self.model_config.num_kv_heads, d)
+        k_g = jax.make_array_from_process_local_data(sharding, k_local, global_shape)
+        v_g = jax.make_array_from_process_local_data(sharding, v_local, global_shape)
+        self.kv_k, self.kv_v = self._inject_pages(
+            self.kv_k, self.kv_v, jnp.asarray(page_ids), k_g, v_g
+        )
 
     async def run_follower(self, receiver) -> None:
         """Follower-host loop: replay the leader's dispatch sequence.
@@ -835,6 +905,46 @@ class JaxEngine:
                 )
             elif tag == "extract":
                 await self._run_on_device(partial(self._dev_extract, p["page_ids"]))
+            elif tag == "stage_shard":
+                # prefill follower: pin OUR shard of these pages under the
+                # leader-chosen transfer id; the decode worker's matching
+                # host pulls it point-to-point
+                tid = p["tid"].tobytes().decode()
+                if self.data_plane is not None:
+                    self._stage_local_shard(tid, p["page_ids"], lambda ok: None)
+                    logger.info(
+                        "staged shard %s (%d pages) on follower data plane",
+                        tid, len(p["page_ids"]),
+                    )
+            elif tag == "unstage_shard":
+                tid = p["tid"].tobytes().decode()
+                if self.data_plane is not None:
+                    self.data_plane.unstage_by_id(tid, ok=bool(p["ok"][0]))
+            elif tag == "inject_shard":
+                # decode follower: pull OUR shard's chunk from our peer
+                # prefill host, then enter the same SPMD inject program
+                import msgpack as _mp
+
+                from ..llm.kv_transfer import pull_kv_range
+
+                shards = {
+                    s["host_id"]: s["addr"]
+                    for s in _mp.unpackb(p["addrs"].tobytes(), raw=False)
+                }
+                tid = p["tid"].tobytes().decode()
+                off, n = int(p["off"][0]), int(p["n"][0])
+                k_loc, v_loc = await pull_kv_range(
+                    shards[self.host_id], tid, off, n,
+                    [int(x) for x in p["page_shape"]],
+                    str(jnp.zeros((), self.model_config.dtype).dtype),
+                )
+                logger.info(
+                    "follower host %d pulled shard chunk (%d, %d) from %s",
+                    self.host_id, off, n, shards[self.host_id],
+                )
+                await self._run_on_device(
+                    partial(self._dev_inject_shard, p["page_ids"], k_loc, v_loc)
+                )
             else:
                 logger.warning("unknown step tag %r", tag)
 
@@ -909,7 +1019,10 @@ class JaxEngine:
             await self._run_on_device(partial(self._dev_inject, ids, k, v))
 
         try:
-            await pull_kv(desc, inject)
+            if desc.shards is not None:
+                await self._pull_kv_shards(slot, desc, phys)
+            else:
+                await pull_kv(desc, inject)
         except asyncio.CancelledError:
             return
         except Exception as e:  # noqa: BLE001 — any pull failure -> local fallback
@@ -929,6 +1042,75 @@ class JaxEngine:
             return
         self._activate_transferred(slot, first_token)
         self._wake.set()
+
+    async def _pull_kv_shards(self, slot: _Slot, desc, phys: np.ndarray):
+        """Multi-host shard pull: this (leader) host pulls ITS shard chunk
+        by chunk; each chunk's inject is an SPMD dispatch where followers
+        supply their OWN shard bytes (pulled from their peer host inside
+        the inject_shard replay). No host ever moves another host's bytes;
+        nothing is re-broadcast."""
+        from ..llm.kv_transfer import pull_kv_range
+
+        if not (self._multihost and self.shard_addrs):
+            raise RuntimeError("sharded descriptor but this worker is not multi-host")
+        shards = {s["host_id"]: s["addr"] for s in desc.shards}
+        if len(shards) != len(self.shard_addrs):
+            raise RuntimeError(
+                f"shard count mismatch: peer has {len(shards)} hosts, we have "
+                f"{len(self.shard_addrs)} — falling back to local prefill"
+            )
+        my_addr = shards[self.host_id]
+        import msgpack as _mp
+
+        addrs_blob = np.frombuffer(
+            _mp.packb(desc.shards, use_bin_type=True), np.uint8
+        )
+        tid_blob = np.frombuffer(desc.transfer_id.encode(), np.uint8)
+        off = 0
+        while off < desc.n_pages:
+            n = min(desc.chunk_pages, desc.n_pages - off)
+            if (
+                slot.done
+                or self._closed
+                or slot.slot_idx < 0
+                or self.slots[slot.slot_idx] is not slot
+            ):
+                raise asyncio.CancelledError("slot released mid-pull")
+            k_loc, v_loc = await pull_kv_range(
+                my_addr, desc.transfer_id, off, n, desc.page_shape, desc.dtype
+            )
+            ids = phys[off : off + n]
+            # bcast + dispatch in ONE synchronous segment: interleaving an
+            # await between them could reorder against the step loop's own
+            # bcast+dispatch pairs and diverge the SPMD program order
+            self._bcast(
+                "inject_shard",
+                {
+                    "tid": tid_blob,
+                    "addrs": addrs_blob,
+                    "page_ids": ids,
+                    "off": np.array([off], np.int64),
+                    "n": np.array([n], np.int64),
+                    "page_shape": np.array(desc.page_shape, np.int64),
+                },
+            )
+            fut = self._run_on_device(
+                partial(self._dev_inject_shard, ids, k_loc, v_loc)
+            )
+            await fut
+            off += n
+        logger.info(
+            "kv shard pull complete: %d pages from %s (host %d pulled only "
+            "its own shard)", desc.n_pages, my_addr, self.host_id,
+        )
+        # tell the prefill leader the transfer is complete so it releases
+        # (its on_done broadcast unpins the prefill followers' stages)
+        try:
+            from ..llm.kv_transfer import finish_transfer
+
+            await finish_transfer(desc.addr, desc.transfer_id)
+        except Exception:  # noqa: BLE001 — TTL reaper is the backstop
+            logger.warning("could not signal transfer completion", exc_info=True)
 
     async def _inject_onboard(self, slot: _Slot):
         """KVBM onboard: scatter G2/G3 blocks into the freshly allocated
@@ -1123,21 +1305,15 @@ class JaxEngine:
         """Pin the finished prefill's pages on the data plane and answer with
         a descriptor. The extract callback gathers page CHUNKS lazily as the
         decode worker pulls, so the device gather overlaps the network (and
-        on the in-process path never leaves the device)."""
+        on the in-process path never leaves the device). On a multi-host
+        mesh each host stages ITS OWN SHARD under one transfer id (the
+        stage_shard broadcast) and the descriptor carries the per-host
+        rendezvous — the decode worker's hosts pull point-to-point."""
         import jax.numpy as jnp
 
         c = self.model_config
         cfg = self.config
-
-        async def extract(off: int, n: int, device: bool):
-            ids = page_ids[off : off + n]
-            self._bcast("extract", {"page_ids": ids})
-            if device and not self._multihost:
-                # in-process path: hand over device arrays, no host staging
-                return await self._run_on_device(
-                    lambda: self._extract_pages(self.kv_k, self.kv_v, jnp.asarray(ids))
-                )
-            return await self._run_on_device(partial(self._dev_extract, ids))
+        dtype_name = str(jnp.zeros((), c.dtype).dtype)
 
         def on_done(ok: bool):
             if not ok:
@@ -1146,15 +1322,54 @@ class JaxEngine:
                 )
             self._release_slot(slot)
 
-        desc = self.data_plane.stage(
-            n_pages=int(len(page_ids)),
-            n_tokens=len(slot.prompt),
-            page_size=cfg.page_size,
-            page_shape=[c.num_layers, cfg.page_size, c.num_kv_heads, c.head_dim],
-            dtype=str(jnp.zeros((), c.dtype).dtype),
-            extract=extract,
-            on_done=on_done,
-        )
+        if self._multihost and self.shard_addrs:
+            import secrets as _secrets
+
+            tid = _secrets.token_hex(8)
+            self._bcast(
+                "stage_shard",
+                {
+                    "tid": np.frombuffer(tid.encode(), np.uint8),
+                    "page_ids": page_ids,
+                },
+            )
+
+            def on_done_shard(ok: bool):
+                # release leader-side pages AND tell followers to unpin
+                self._bcast(
+                    "unstage_shard",
+                    {
+                        "tid": np.frombuffer(tid.encode(), np.uint8),
+                        "ok": np.array([1 if ok else 0], np.int8),
+                    },
+                )
+                on_done(ok)
+
+            desc = self._stage_local_shard(tid, page_ids, on_done_shard)
+            desc.n_tokens = len(slot.prompt)
+            desc.shards = [
+                {"host_id": h, "addr": a} for h, a in enumerate(self.shard_addrs)
+            ]
+        else:
+            async def extract(off: int, n: int, device: bool):
+                ids = page_ids[off : off + n]
+                self._bcast("extract", {"page_ids": ids})
+                if device and not self._multihost:
+                    # in-process path: hand over device arrays, no host staging
+                    return await self._run_on_device(
+                        lambda: self._extract_pages(self.kv_k, self.kv_v, jnp.asarray(ids))
+                    )
+                return await self._run_on_device(partial(self._dev_extract, ids))
+
+            desc = self.data_plane.stage(
+                n_pages=int(len(page_ids)),
+                n_tokens=len(slot.prompt),
+                page_size=cfg.page_size,
+                page_shape=[c.num_layers, cfg.page_size, c.num_kv_heads, c.head_dim],
+                dtype=dtype_name,
+                extract=extract,
+                on_done=on_done,
+            )
         out = LLMEngineOutput(
             token_ids=[first_token],
             finish_reason="remote_prefill_done",
@@ -1164,6 +1379,32 @@ class JaxEngine:
         slot.queue.put_nowait(None)
         slot.done = True
         # NOT released here: pages stay pinned until on_done (pull or TTL)
+
+    def _stage_local_shard(self, tid: str, page_ids: np.ndarray, on_done):
+        """Stage THIS host's KV shard of `page_ids` under transfer id `tid`
+        on the local data plane (leader and followers run this — leader via
+        _stage_kv_pull, followers via the stage_shard replay)."""
+        import jax.numpy as jnp
+
+        c = self.model_config
+        cfg = self.config
+
+        async def extract(off: int, n: int, device: bool):
+            ids = page_ids[off : off + n]
+            return await self._run_on_device(
+                partial(self._extract_local_shard, ids)
+            )
+
+        return self.data_plane.stage(
+            n_pages=int(len(page_ids)),
+            n_tokens=0,
+            page_size=cfg.page_size,
+            page_shape=self.local_shard_page_shape(),
+            dtype=str(jnp.zeros((), c.dtype).dtype),
+            extract=extract,
+            on_done=on_done,
+            transfer_id=tid,
+        )
 
     def _commit_blocks(self, slot: _Slot):
         """Bind filled prompt pages to their hashes -> prefix cache + events."""

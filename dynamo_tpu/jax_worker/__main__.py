@@ -164,11 +164,32 @@ async def main():
         multihost=multihost,
     )
 
+    # KV data plane: prefill-capable workers stage finished prompts here;
+    # under multi-host EVERY host (followers too) runs one, serving only its
+    # own KV shard — the per-shard point-to-point transfer path
+    data_plane = None
+    if not args.no_kv_data_plane and (
+        multihost or args.role in ("prefill", "aggregated")
+    ):
+        from dynamo_tpu.llm.kv_transfer import KvDataPlaneServer
+
+        data_plane = KvDataPlaneServer(
+            advertise_host=args.kv_data_plane_host, port=args.kv_data_plane_port
+        )
+        await data_plane.start()
+        engine.data_plane = data_plane
+        engine.host_id = args.host_id
+        logger.info("kv data plane listening on %s", data_plane.addr)
+
     if multihost and args.host_id != 0:
         # follower host: no discovery, no endpoint, no KV events (host-0
         # ownership) — replay the leader's dispatch stream until shutdown
         leader_host = args.coordinator.rsplit(":", 1)[0]
-        receiver = StepReceiver(leader_host, args.spmd_port)
+        receiver = StepReceiver(
+            leader_host, args.spmd_port,
+            host_id=args.host_id,
+            data_plane_addr=data_plane.addr if data_plane is not None else "",
+        )
         await receiver.connect()
         logger.info(
             "jax follower host %d/%d connected to leader %s:%d",
@@ -180,22 +201,44 @@ async def main():
     if spmd is not None:
         logger.info("waiting for %d follower host(s)", args.num_hosts - 1)
         await spmd.wait_for_followers()
+        follower_planes = spmd.follower_data_planes
+        if data_plane is not None and len(follower_planes) == args.num_hosts - 1 \
+                and all(follower_planes.get(h) for h in range(1, args.num_hosts)):
+            engine.shard_addrs = [data_plane.addr] + [
+                follower_planes[h] for h in range(1, args.num_hosts)
+            ]
+            logger.info("kv shard rendezvous: %s", engine.shard_addrs)
+        # a dead follower wedges every future collective: fail all in-flight
+        # requests (so callers migrate, llm/migration.py) and shut the
+        # worker down — the lease expires and the frontend drops us
+        # (reference analogue: engine-death watchdog -> runtime shutdown,
+        # vllm handlers.py:268-273)
+        loop = asyncio.get_running_loop()
+        shutdown_holder = {}
 
-    data_plane = None
-    if args.role in ("prefill", "aggregated") and not args.no_kv_data_plane:
-        from dynamo_tpu.llm.kv_transfer import KvDataPlaneServer
+        def _follower_lost(host_id, why):
+            logger.error(
+                "follower %d lost (%s): failing active requests and shutting down",
+                host_id, why,
+            )
+            engine._fail_all(f"follower host {host_id} lost: {why}")
+            if "shutdown" in shutdown_holder:
+                shutdown_holder["shutdown"]()
+            # the device thread may be wedged inside a dead collective and
+            # block interpreter exit: force it after a drain grace period
+            import os
+            import threading
 
-        data_plane = KvDataPlaneServer(
-            advertise_host=args.kv_data_plane_host, port=args.kv_data_plane_port
-        )
-        await data_plane.start()
-        engine.data_plane = data_plane
-        logger.info("kv data plane listening on %s", data_plane.addr)
+            threading.Timer(5.0, lambda: os._exit(1)).start()
+
+        spmd.on_follower_lost = lambda hid, why: loop.call_soon(_follower_lost, hid, why)
 
     cfg = RuntimeConfig.from_settings()
     if args.discovery:
         cfg.discovery_endpoint = args.discovery
     drt = await DistributedRuntime.create(cfg)
+    if spmd is not None:
+        shutdown_holder["shutdown"] = drt.shutdown
     if data_plane is not None:
         await data_plane.register(drt)
     component = args.prefill_component if args.role == "prefill" else args.component

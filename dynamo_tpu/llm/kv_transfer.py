@@ -44,7 +44,8 @@ import numpy as np
 
 logger = logging.getLogger(__name__)
 
-_MAGIC = 0xD7A04B1D  # frame magic
+_MAGIC = 0xD7A04B1D  # frame magic (full-stream pull handshake)
+_MAGIC_RANGE = 0xD7A04B1E  # ranged pull handshake (multi-host shard chunks)
 _HDR = struct.Struct("<II")  # magic, header length
 
 DATA_PLANE_ROOT = "v1/kv_data_plane/"
@@ -101,6 +102,11 @@ class KvTransferDescriptor:
     # pages is layer-major [L, n, page, KH, D] (the engine's KV layout)
     dtype: str
     chunk_pages: int
+    # multi-host shard rendezvous: host h of the PULLING worker fetches its
+    # own shard's chunks (ranged pulls) from shards[h]["addr"] under the
+    # shared transfer_id. page_shape is then the SHARD's per-page shape
+    # (KH split across hosts). None => single staging endpoint (full pages).
+    shards: Optional[list] = None  # [{"host_id": int, "addr": str}]
 
     def to_dict(self) -> dict:
         return dict(self.__dict__)
@@ -124,6 +130,14 @@ class _Staged:
     max_transfer_time: float = 120.0  # per-chunk deadline extension budget
     started: bool = False
     finished: bool = False
+    server: Optional["KvDataPlaneServer"] = None  # for serve accounting
+
+    def count_serve(self, nbytes: int):
+        """Account a served chunk (socket OR in-process) on the owning
+        server's counters."""
+        if self.server is not None:
+            self.server.transfers_served += 1
+            self.server.bytes_served += nbytes
 
     def finish(self, ok: bool):
         if not self.finished:
@@ -154,6 +168,11 @@ class KvDataPlaneServer:
         self._server: Optional[asyncio.AbstractServer] = None
         self._staged: Dict[str, _Staged] = {}
         self._reaper: Optional[asyncio.Task] = None
+        # observability: exact evidence that THIS host's data plane moved
+        # bytes (the disagg tests assert on these — a silent local-prefill
+        # fallback must not be able to masquerade as a working data plane)
+        self.transfers_served = 0
+        self.bytes_served = 0
 
     @property
     def addr(self) -> str:
@@ -197,17 +216,21 @@ class KvDataPlaneServer:
         on_done: Callable[[bool], None],
         chunk_pages: int = 0,
         ttl: Optional[float] = None,
+        transfer_id: Optional[str] = None,
     ) -> KvTransferDescriptor:
         """Pin a finished prefill's pages for pulling; returns the descriptor
         to send on the response stream. `on_done(ok)` fires exactly once —
         on successful pull, pull failure, or TTL expiry — and is where the
-        engine releases the slot's pages."""
+        engine releases the slot's pages. An explicit `transfer_id` lets
+        every host of a multi-host worker stage its shard under ONE id (the
+        leader picks the id and broadcasts it in the stage_shard step
+        descriptor)."""
         if chunk_pages <= 0:
             # ~4 MiB/chunk of K (plus V): small enough to overlap, large
             # enough that framing cost vanishes
             per_page = int(np.prod(page_shape)) * _np_dtype(dtype).itemsize
             chunk_pages = max(1, (4 << 20) // max(per_page, 1))
-        transfer_id = secrets.token_hex(8)
+        transfer_id = transfer_id or secrets.token_hex(8)
         desc = KvTransferDescriptor(
             transfer_id=transfer_id,
             addr=self.addr,
@@ -224,6 +247,7 @@ class KvDataPlaneServer:
             on_done=on_done,
             deadline=time.monotonic() + (ttl if ttl is not None else self.ttl),
             max_transfer_time=self.max_transfer_time,
+            server=self,
         )
         self._staged[transfer_id] = staged
         _LOCAL[(self.addr, transfer_id)] = staged
@@ -233,6 +257,14 @@ class KvDataPlaneServer:
         self._staged.pop(staged.desc.transfer_id, None)
         _LOCAL.pop((self.addr, staged.desc.transfer_id), None)
         staged.finish(ok)
+
+    def unstage_by_id(self, transfer_id: str, ok: bool) -> None:
+        """Explicit release (multi-host shard staging: the leader decides
+        when a transfer is over and broadcasts unstage_shard to followers —
+        ranged pulls have no single is-done connection)."""
+        staged = self._staged.get(transfer_id)
+        if staged is not None:
+            self._unstage(staged, ok)
 
     async def _reap_loop(self):
         while True:
@@ -257,13 +289,17 @@ class KvDataPlaneServer:
                 reader.readexactly(_HDR.size), self.chunk_timeout
             )
             magic, length = _HDR.unpack(hdr)
-            if magic != _MAGIC:
+            if magic not in (_MAGIC, _MAGIC_RANGE):
                 raise RuntimeError(f"bad kv data plane magic {magic:#x}")
             if length > 4096:  # transfer ids are 16 hex chars; reject floods
                 raise RuntimeError(f"oversized kv handshake ({length} bytes)")
-            transfer_id = (
-                await asyncio.wait_for(reader.readexactly(length), self.chunk_timeout)
-            ).decode()
+            body = await asyncio.wait_for(
+                reader.readexactly(length), self.chunk_timeout
+            )
+            if magic == _MAGIC_RANGE:
+                await self._serve_range(body, writer)
+                return
+            transfer_id = body.decode()
             staged = self._staged.get(transfer_id)
             if staged is None or staged.started:
                 await self._send_header(writer, {"error": f"unknown transfer {transfer_id}"})
@@ -277,6 +313,7 @@ class KvDataPlaneServer:
                 # is distinct from builtin TimeoutError before 3.11
                 self._unstage(staged, ok=False)
                 raise
+            self.transfers_served += 1
             self._unstage(staged, ok=True)
         except (asyncio.IncompleteReadError, ConnectionError):
             pass  # peer vanished; reaper/unstage already handled pages
@@ -284,6 +321,44 @@ class KvDataPlaneServer:
             logger.exception("kv data plane connection failed")
         finally:
             writer.close()
+
+    async def _serve_range(self, body: bytes, writer: asyncio.StreamWriter):
+        """One ranged request -> one (k, v) frame. Ranged pulls are how a
+        multi-host decode worker's host h fetches chunk (off, n) of ITS
+        shard from the matching prefill host: many connections may read the
+        same staged transfer, so completion is signalled out-of-band
+        (unstage_by_id from the leader's unstage_shard broadcast), with the
+        TTL/deadline reaper as backstop."""
+        req = msgpack.unpackb(body, raw=False)
+        transfer_id = req.get("tid", "")
+        staged = self._staged.get(transfer_id)
+        if staged is None:
+            await self._send_header(writer, {"error": f"unknown transfer {transfer_id}"})
+            return
+        if req.get("fin"):
+            # puller-side completion signal: release now instead of at TTL
+            # (a control message — not counted as a served transfer)
+            self._unstage(staged, ok=True)
+            await self._send_header(writer, {"ok": True})
+            return
+        off, n = int(req.get("off", 0)), int(req.get("n", 0))
+        if not (0 <= off and 0 < n and off + n <= staged.desc.n_pages):
+            await self._send_header(writer, {"error": f"range out of bounds ({off},{n})"})
+            return
+        # a transfer being actively range-pulled is alive: refresh its clock
+        staged.deadline = time.monotonic() + self.max_transfer_time
+        np_dtype = _np_dtype(staged.desc.dtype)
+        k, v = await staged.extract(off, n, False)
+        k = np.asarray(k, np_dtype)
+        v = np.asarray(v, np_dtype)
+        kb, vb = _np_bytes(k), _np_bytes(v)
+        await self._send_header(
+            writer, {"off": off, "n": n, "k_bytes": len(kb), "v_bytes": len(vb)}
+        )
+        writer.write(kb)
+        writer.write(vb)
+        await asyncio.wait_for(writer.drain(), self.chunk_timeout)
+        staged.count_serve(len(kb) + len(vb))
 
     async def _send_header(self, writer, header: dict):
         body = msgpack.packb(header, use_bin_type=True)
@@ -321,6 +396,7 @@ class KvDataPlaneServer:
             writer.write(vb)
             # a peer that stops reading must not pin pages: deadline the drain
             await asyncio.wait_for(writer.drain(), self.chunk_timeout)
+            self.bytes_served += len(kb) + len(vb)
             # a progressing transfer earns its keep — refresh the deadline so
             # slow-but-alive links are not reaped mid-pull
             staged.deadline = time.monotonic() + self.max_transfer_time
@@ -329,6 +405,88 @@ class KvDataPlaneServer:
 
 # inject(page_offset, n_pages, k, v) — awaited per chunk as it lands
 InjectFn = Callable[[int, int, Any, Any], Awaitable[None]]
+
+
+async def pull_kv_range(
+    addr: str,
+    transfer_id: str,
+    off: int,
+    n: int,
+    page_shape: list,
+    dtype: str,
+    connect_timeout: float = 10.0,
+    chunk_timeout: float = 30.0,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Fetch ONE chunk [off, off+n) of a staged transfer — the multi-host
+    shard path: decode host h pulls its own shard's chunk from prefill host
+    h's data plane, so no host ever hauls another host's bytes (the scaling
+    property NIXL's point-to-point descriptors give the reference,
+    lib/llm/src/block_manager/storage/nixl.rs). Returns (k, v) shaped
+    [L, n, page, KH, D] (the SHARD's shape)."""
+    staged = _LOCAL.get((addr, transfer_id))
+    if staged is not None:
+        staged.deadline = time.monotonic() + staged.max_transfer_time
+        k, v = await staged.extract(off, n, True)
+        np_dtype = _np_dtype(dtype)
+        k, v = np.asarray(k, np_dtype), np.asarray(v, np_dtype)
+        # mirror the socket path's accounting: the staging host DID serve
+        # these bytes, even though they never touched a socket
+        staged.count_serve(k.nbytes + v.nbytes)
+        return k, v
+    host, port = addr.rsplit(":", 1)
+    reader, writer = await asyncio.wait_for(
+        asyncio.open_connection(host, int(port)), connect_timeout
+    )
+    try:
+        body = msgpack.packb({"tid": transfer_id, "off": off, "n": n}, use_bin_type=True)
+        writer.write(_HDR.pack(_MAGIC_RANGE, len(body)) + body)
+        await writer.drain()
+        np_dtype = _np_dtype(dtype)
+        shape = tuple(page_shape)
+        max_bytes = int(np.prod(shape)) * np_dtype.itemsize * n
+        hdr = await asyncio.wait_for(reader.readexactly(_HDR.size), chunk_timeout)
+        magic, length = _HDR.unpack(hdr)
+        if magic != _MAGIC or length > 65536:
+            raise RuntimeError(f"bad kv range frame (magic {magic:#x})")
+        header = msgpack.unpackb(
+            await asyncio.wait_for(reader.readexactly(length), chunk_timeout),
+            raw=False,
+        )
+        if header.get("error"):
+            raise RuntimeError(f"kv range refused: {header['error']}")
+        if header["k_bytes"] > max_bytes or header["v_bytes"] > max_bytes:
+            raise RuntimeError("kv range frame larger than requested")
+        k_raw = await asyncio.wait_for(reader.readexactly(header["k_bytes"]), chunk_timeout)
+        v_raw = await asyncio.wait_for(reader.readexactly(header["v_bytes"]), chunk_timeout)
+        chunk_shape = (shape[0], n, *shape[1:])
+        k = np.frombuffer(k_raw, dtype=np_dtype).reshape(chunk_shape)
+        v = np.frombuffer(v_raw, dtype=np_dtype).reshape(chunk_shape)
+        return k, v
+    finally:
+        writer.close()
+
+
+async def finish_transfer(
+    addr: str, transfer_id: str, connect_timeout: float = 10.0
+) -> None:
+    """Tell the staging peer a range-pulled transfer is complete so its
+    pages release immediately (the TTL reaper is the backstop)."""
+    staged = _LOCAL.get((addr, transfer_id))
+    if staged is not None:
+        _LOCAL.pop((addr, transfer_id), None)
+        staged.finish(True)
+        return
+    host, port = addr.rsplit(":", 1)
+    reader, writer = await asyncio.wait_for(
+        asyncio.open_connection(host, int(port)), connect_timeout
+    )
+    try:
+        body = msgpack.packb({"tid": transfer_id, "fin": True}, use_bin_type=True)
+        writer.write(_HDR.pack(_MAGIC_RANGE, len(body)) + body)
+        await writer.drain()
+        await asyncio.wait_for(reader.readexactly(_HDR.size), connect_timeout)
+    finally:
+        writer.close()
 
 
 async def pull_kv(
@@ -353,6 +511,8 @@ async def pull_kv(
                 n = min(desc.chunk_pages, desc.n_pages - off)
                 k, v = await staged.extract(off, n, True)
                 await inject(off, n, k, v)
+                if hasattr(k, "nbytes"):
+                    staged.count_serve(k.nbytes + v.nbytes)
                 off += n
                 staged.deadline = time.monotonic() + staged.max_transfer_time
         except BaseException:

@@ -48,9 +48,6 @@ def _decode_kernel(
     kv_v_hbm,
     # outputs
     out_ref,  # [1, H, D] VMEM block
-    m_ref,  # [1, HG, 128] f32: running max (broadcast over lanes) — lets the
-    # caller merge this flash result with extra keys (block-local buffer)
-    l_ref,  # [1, HG, 128] f32: running sum-exp
     # scratch
     k_buf,  # [2, CHUNK, KH*D] VMEM
     v_buf,
@@ -163,8 +160,6 @@ def _decode_kernel(
         out = out + jnp.where(row_head == k0, blk, 0.0)
     out = out / jnp.maximum(l, 1e-30)
     out_ref[0] = out.astype(out_ref.dtype)
-    m_ref[0] = jnp.broadcast_to(m, (hg, 128))
-    l_ref[0] = jnp.broadcast_to(l, (hg, 128))
 
 
 def _decode_local_kernel(
@@ -390,28 +385,9 @@ def paged_attention_decode_pallas(
     *,
     interpret: bool = False,
 ) -> jax.Array:
-    """Flash decode attention over paged KV; returns [B, H, D] (q.dtype)."""
-    out, _, _ = paged_attention_decode_pallas_lse(
-        q, kv_k_layer, kv_v_layer, page_tables, seq_lens, interpret=interpret
-    )
-    return out
-
-
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def paged_attention_decode_pallas_lse(
-    q: jax.Array,  # [B, H, D]
-    kv_k_layer: jax.Array,  # [num_pages, page_size, KH, D]
-    kv_v_layer: jax.Array,
-    page_tables: jax.Array,  # [B, max_pages] int32
-    seq_lens: jax.Array,  # [B] int32
-    *,
-    interpret: bool = False,
-):
-    """Flash decode attention + softmax state: returns (out [B,H,D],
-    m [B,H], l [B,H]) where scores were scaled by 1/sqrt(D). The (m, l)
-    pair lets the caller merge in extra keys (e.g. a block-local KV buffer)
-    with a standard log-sum-exp combine — the mechanism behind the
-    write-KV-once-per-block decode design (engine/engine.py decode_block)."""
+    """Flash decode attention over paged KV; returns [B, H, D] (q.dtype).
+    (Block-local merging lives in _decode_local_kernel — the fused variant —
+    so this hot path writes exactly one output.)"""
     B, H, D = q.shape
     num_pages, page_size, KH, _ = kv_k_layer.shape
     max_pages = page_tables.shape[1]
@@ -441,11 +417,7 @@ def paged_attention_decode_pallas_lse(
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
         ],
-        out_specs=[
-            pl.BlockSpec((1, H, D), lambda b, *_: (b, 0, 0)),
-            pl.BlockSpec((1, KHG, 128), lambda b, *_: (b, 0, 0)),
-            pl.BlockSpec((1, KHG, 128), lambda b, *_: (b, 0, 0)),
-        ],
+        out_specs=pl.BlockSpec((1, H, D), lambda b, *_: (b, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((2, chunk_pages * page_size, KH * D), kv_k_layer.dtype),
             pltpu.VMEM((2, chunk_pages * page_size, KH * D), kv_v_layer.dtype),
@@ -467,17 +439,10 @@ def paged_attention_decode_pallas_lse(
         bytes_accessed=2 * B * max_pages * page_size * KH * D * 2,
         transcendentals=B * H * max_pages * page_size,
     )
-    out, m_b, l_b = pl.pallas_call(
+    return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=[
-            jax.ShapeDtypeStruct((B, H, D), q.dtype),
-            jax.ShapeDtypeStruct((B, KHG, 128), jnp.float32),
-            jax.ShapeDtypeStruct((B, KHG, 128), jnp.float32),
-        ],
+        out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
         cost_estimate=cost,
         interpret=interpret,
     )(page_tables.astype(jnp.int32), seq_lens.astype(jnp.int32), q_bd, kv_k_flat, kv_v_flat)
-    # KHG == H (rows are (kv_head, group) pairs in head order); lane 0 holds
-    # the broadcast value
-    return out, m_b[:, :, 0], l_b[:, :, 0]

@@ -94,20 +94,49 @@ def _unpack_step(body: bytes) -> Tuple[str, Dict[str, np.ndarray]]:
     return payload["tag"], arrays
 
 
+ACK_EVERY = 64  # follower acks every N frames
+MAX_BUFFER = 256 << 20  # per-follower write buffer cap before declaring death
+MAX_LAG = 4096  # frames a live follower may trail before declaring death
+
+
+@dataclass
+class _Follower:
+    host_id: int
+    data_plane_addr: str
+    writer: asyncio.StreamWriter
+    acked: int = 0  # highest frame seq the follower confirmed
+
+
 class StepBroadcaster:
     """Host-0 side: accepts follower connections, fans out step descriptors
     in dispatch order. `wait_for_followers` gates serving until the whole
-    slice is connected."""
+    slice is connected.
 
-    def __init__(self, host: str, port: int, expected_followers: int):
+    Hardening (round-2 weak #5): each follower sends a HELLO frame (host id
+    + its KV data plane address — the per-host shard rendezvous) and then
+    ACKs every ACK_EVERY frames on the same socket. A follower whose socket
+    resets, whose write buffer exceeds MAX_BUFFER, or whose ack lag exceeds
+    MAX_LAG is declared dead: `on_follower_lost` fires so the engine can
+    fail in-flight work instead of wedging inside the next collective."""
+
+    def __init__(self, host: str, port: int, expected_followers: int,
+                 on_follower_lost=None):
         self.host = host
         self.port = port
         self.expected = expected_followers
-        self._writers: List[asyncio.StreamWriter] = []
+        self.on_follower_lost = on_follower_lost
+        self._followers: List[_Follower] = []
         self._server: Optional[asyncio.AbstractServer] = None
         self._connected = asyncio.Event()
+        self._seq = 0
+        self._reader_tasks: List[asyncio.Task] = []
         if expected_followers == 0:
             self._connected.set()
+
+    @property
+    def follower_data_planes(self) -> Dict[int, str]:
+        """host_id -> advertised KV data plane address (from hello)."""
+        return {f.host_id: f.data_plane_addr for f in self._followers}
 
     async def start(self):
         self._server = await asyncio.start_server(
@@ -115,48 +144,111 @@ class StepBroadcaster:
         )
 
     async def _on_connect(self, reader, writer):
-        self._writers.append(writer)
-        logger.info(
-            "follower connected (%d/%d)", len(self._writers), self.expected
+        try:
+            header = await asyncio.wait_for(reader.readexactly(8), 30.0)
+            magic, length = struct.unpack("<II", header)
+            if magic != _MAGIC or length > 4096:
+                raise RuntimeError("bad hello frame")
+            hello = msgpack.unpackb(await reader.readexactly(length), raw=False)
+        except Exception:  # noqa: BLE001 — a garbage peer must not wedge startup
+            logger.warning("rejecting malformed follower hello", exc_info=True)
+            writer.close()
+            return
+        f = _Follower(
+            host_id=int(hello.get("host_id", len(self._followers) + 1)),
+            data_plane_addr=str(hello.get("data_plane_addr", "")),
+            writer=writer,
         )
-        if len(self._writers) >= self.expected:
+        self._followers.append(f)
+        self._reader_tasks.append(asyncio.create_task(self._read_acks(f, reader)))
+        logger.info(
+            "follower host %d connected (%d/%d), data plane %s",
+            f.host_id, len(self._followers), self.expected, f.data_plane_addr or "-",
+        )
+        if len(self._followers) >= self.expected:
             self._connected.set()
+
+    async def _read_acks(self, f: _Follower, reader: asyncio.StreamReader):
+        try:
+            while True:
+                header = await reader.readexactly(8)
+                magic, length = struct.unpack("<II", header)
+                if magic != _MAGIC:
+                    raise RuntimeError("bad ack frame")
+                body = msgpack.unpackb(await reader.readexactly(length), raw=False)
+                f.acked = int(body.get("seq", f.acked))
+        except (asyncio.IncompleteReadError, ConnectionError, RuntimeError) as e:
+            self._lose(f, f"step stream closed ({type(e).__name__})")
+        except asyncio.CancelledError:
+            pass
+
+    def _lose(self, f: _Follower, why: str):
+        if f not in self._followers:
+            return
+        self._followers.remove(f)
+        logger.error("follower host %d lost: %s", f.host_id, why)
+        f.writer.close()
+        if self.on_follower_lost is not None:
+            try:
+                self.on_follower_lost(f.host_id, why)
+            except Exception:  # noqa: BLE001
+                logger.exception("on_follower_lost callback failed")
 
     async def wait_for_followers(self, timeout: float = 120.0):
         await asyncio.wait_for(self._connected.wait(), timeout)
 
     def send(self, tag: str, arrays: Dict[str, np.ndarray]):
-        """Non-blocking ordered fan-out (called before the local dispatch)."""
-        if not self._writers:
+        """Non-blocking ordered fan-out (called before the local dispatch).
+        Backpressure is fail-fast: a follower too far behind is dead weight
+        that will wedge the next collective anyway — cut it loose early."""
+        if not self._followers:
             return
+        self._seq += 1
         frame = _pack_step(tag, arrays)
-        for w in self._writers:
-            if not w.is_closing():
-                w.write(frame)
+        for f in list(self._followers):
+            w = f.writer
+            if w.is_closing():
+                self._lose(f, "writer closed")
+                continue
+            if w.transport.get_write_buffer_size() > MAX_BUFFER:
+                self._lose(f, "write buffer overflow (slow consumer)")
+                continue
+            if self._seq - f.acked > MAX_LAG:
+                self._lose(f, f"ack lag {self._seq - f.acked} frames")
+                continue
+            w.write(frame)
 
     async def drain(self):
-        for w in self._writers:
-            if not w.is_closing():
-                await w.drain()
+        for f in self._followers:
+            if not f.writer.is_closing():
+                await f.writer.drain()
 
     async def close(self):
         self.send("stop", {})
         await self.drain()
-        for w in self._writers:
-            w.close()
+        for t in self._reader_tasks:
+            t.cancel()
+        for f in self._followers:
+            f.writer.close()
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
 
 
 class StepReceiver:
-    """Follower side: ordered step descriptor stream from host 0."""
+    """Follower side: ordered step descriptor stream from host 0. Sends a
+    hello (host id + local KV data plane address) at connect and acks every
+    ACK_EVERY frames so the leader can detect death/lag."""
 
-    def __init__(self, host: str, port: int):
+    def __init__(self, host: str, port: int, host_id: int = -1,
+                 data_plane_addr: str = ""):
         self.host = host
         self.port = port
+        self.host_id = host_id
+        self.data_plane_addr = data_plane_addr
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
+        self._recved = 0
 
     async def connect(self, retries: int = 60, delay: float = 0.5):
         for attempt in range(retries):
@@ -164,11 +256,17 @@ class StepReceiver:
                 self._reader, self._writer = await asyncio.open_connection(
                     self.host, self.port
                 )
-                return
+                break
             except OSError:
                 if attempt == retries - 1:
                     raise
                 await asyncio.sleep(delay)
+        hello = msgpack.packb(
+            {"host_id": self.host_id, "data_plane_addr": self.data_plane_addr},
+            use_bin_type=True,
+        )
+        self._writer.write(struct.pack("<II", _MAGIC, len(hello)) + hello)
+        await self._writer.drain()
 
     async def recv(self) -> Tuple[str, Dict[str, np.ndarray]]:
         header = await self._reader.readexactly(8)
@@ -176,6 +274,10 @@ class StepReceiver:
         if magic != _MAGIC:
             raise RuntimeError(f"bad step frame magic {magic:#x}")
         body = await self._reader.readexactly(length)
+        self._recved += 1
+        if self._recved % ACK_EVERY == 0 and not self._writer.is_closing():
+            ack = msgpack.packb({"seq": self._recved}, use_bin_type=True)
+            self._writer.write(struct.pack("<II", _MAGIC, len(ack)) + ack)
         return _unpack_step(body)
 
     async def close(self):

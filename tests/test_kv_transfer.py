@@ -404,3 +404,72 @@ def test_engine_pull_failure_falls_back_to_local_prefill():
         await decode_eng.close()
 
     asyncio.run(main())
+
+
+# --------------------------------------------------------------------- #
+# ranged pulls (multi-host shard path)
+# --------------------------------------------------------------------- #
+
+
+def test_ranged_pull_and_finish():
+    """Ranged pulls serve arbitrary chunks to many connections; completion
+    comes from the explicit fin signal, releasing staged pages."""
+    from dynamo_tpu.llm.kv_transfer import finish_transfer, pull_kv_range
+
+    async def main():
+        server = KvDataPlaneServer()
+        await server.start()
+        released = []
+        desc, k_all, v_all = await _stage(server, 10, released=released)
+        from dynamo_tpu.llm import kv_transfer
+
+        kv_transfer._LOCAL.pop((server.addr, desc.transfer_id))
+
+        # chunks out of order, overlapping — all must match the source
+        for off, n in [(4, 3), (0, 2), (7, 3), (0, 10)]:
+            k, v = await pull_kv_range(
+                server.addr, desc.transfer_id, off, n, desc.page_shape, desc.dtype
+            )
+            np.testing.assert_array_equal(k, np.asarray(k_all)[:, off:off + n])
+            np.testing.assert_array_equal(v, np.asarray(v_all)[:, off:off + n])
+        assert released == []  # ranged pulls do NOT auto-release
+        assert server.transfers_served == 4
+        assert server.bytes_served > 0
+
+        # out-of-range chunk is refused
+        with pytest.raises(RuntimeError, match="refused"):
+            await pull_kv_range(
+                server.addr, desc.transfer_id, 8, 5, desc.page_shape, desc.dtype
+            )
+
+        await finish_transfer(server.addr, desc.transfer_id)
+        assert released == [True]
+        assert desc.transfer_id not in server._staged
+        await server.close()
+
+    asyncio.run(main())
+
+
+def test_explicit_transfer_id_and_unstage_by_id():
+    async def main():
+        server = KvDataPlaneServer()
+        await server.start()
+        released = []
+
+        async def extract(off, n, device):
+            k, _ = _fake_pages(n)
+            return k, k
+
+        desc = server.stage(
+            n_pages=4, n_tokens=16, page_size=4, page_shape=[2, 4, 2, 8],
+            dtype="float32", extract=extract, on_done=released.append,
+            transfer_id="feedc0dedeadbeef",
+        )
+        assert desc.transfer_id == "feedc0dedeadbeef"
+        server.unstage_by_id("feedc0dedeadbeef", ok=False)
+        assert released == [False]
+        server.unstage_by_id("feedc0dedeadbeef", ok=True)  # idempotent
+        assert released == [False]
+        await server.close()
+
+    asyncio.run(main())

@@ -1,0 +1,150 @@
+"""Multi-host x disaggregation: per-shard KV transfer between two 2-host
+workers (round-2 verdict item #2).
+
+Topology (5 real OS processes, CPU/gloo):
+    frontend (embedded discovery)
+    prefill worker  = 2 processes, tp=2 spanning hosts (own jax world)
+    decode worker   = 2 processes, tp=2 spanning hosts (own jax world)
+
+A long prompt goes decode -> remote prefill -> per-shard pull: decode host h
+fetches ONLY its own KV shard from prefill host h's data plane (ranged
+pulls), and the leader broadcasts just metadata — no process_allgather of
+full pages, no re-broadcast of KV bytes (reference scaling property: NIXL
+point-to-point descriptors, lib/llm/src/block_manager/storage/nixl.rs).
+"""
+
+import json
+import time
+from pathlib import Path
+
+import httpx
+import pytest
+
+from .utils import ManagedProcess, free_port
+
+LOGS = {
+    "pre_leader": "/tmp/mhd_pre_leader.log",
+    "pre_follower": "/tmp/mhd_pre_follower.log",
+    "dec_leader": "/tmp/mhd_dec_leader.log",
+    "dec_follower": "/tmp/mhd_dec_follower.log",
+}
+
+
+@pytest.fixture(scope="module")
+def mh_disagg_cluster():
+    http_port = free_port()
+    disc = f"tcp://127.0.0.1:{free_port()}"
+    worker_env = {"XLA_FLAGS": "--xla_force_host_platform_device_count=1"}
+
+    def worker_args(role, host_id, coord_port, spmd_port, extra=()):
+        return [
+            "-m", "dynamo_tpu.jax_worker",
+            "--model", "tiny",
+            "--model-name", "tiny-mhd",
+            "--discovery", disc,
+            "--page-size", "8",
+            "--num-pages", "64",
+            "--max-num-seqs", "4",
+            "--max-model-len", "160",
+            "--context-length", "160",
+            "--tp-size", "2",
+            "--num-hosts", "2",
+            "--host-id", str(host_id),
+            "--coordinator", f"127.0.0.1:{coord_port}",
+            "--spmd-port", str(spmd_port),
+            "--role", role,
+            *extra,
+        ]
+
+    fe = ManagedProcess(
+        ["-m", "dynamo_tpu.frontend", "--http-port", str(http_port),
+         "--embed-discovery", "--discovery", disc],
+        name="mhd_fe",
+    ).start("/tmp/mhd_fe.log")
+    fe.wait_port(http_port)
+
+    pre_coord, pre_spmd = free_port(), free_port()
+    dec_coord, dec_spmd = free_port(), free_port()
+    procs = [fe]
+    for name, args in [
+        ("pre_leader", worker_args("prefill", 0, pre_coord, pre_spmd)),
+        ("pre_follower", worker_args("prefill", 1, pre_coord, pre_spmd)),
+        ("dec_leader",
+         worker_args("decode", 0, dec_coord, dec_spmd, ("--disagg-threshold", "16"))),
+        ("dec_follower",
+         worker_args("decode", 1, dec_coord, dec_spmd, ("--disagg-threshold", "16"))),
+    ]:
+        p = ManagedProcess(args, name=f"mhd_{name}", env=worker_env)
+        p.start(LOGS[name])
+        procs.append(p)
+
+    base = f"http://127.0.0.1:{http_port}"
+    deadline = time.time() + 240  # 4 jax processes + 2 gloo worlds on 1 core
+    with httpx.Client() as client:
+        while time.time() < deadline:
+            for p in procs[1:]:
+                if p.proc.poll() is not None:
+                    raise RuntimeError(f"{p.name} died; see its log")
+            try:
+                if client.get(f"{base}/v1/models").json()["data"]:
+                    break
+            except Exception:
+                pass
+            time.sleep(0.5)
+        else:
+            raise TimeoutError("multihost disagg cluster never registered")
+    yield base
+    for p in reversed(procs):
+        p.stop()
+
+
+def _complete(base: str, prompt_tokens, max_tokens=6):
+    """Streaming completion with the remote_prefill annotation requested;
+    returns (text_chunks, annotations)."""
+    chunks, notes = [], []
+    with httpx.Client(timeout=300) as client:
+        with client.stream(
+            "POST", f"{base}/v1/completions",
+            json={
+                "model": "tiny-mhd",
+                "prompt": prompt_tokens,
+                "max_tokens": max_tokens,
+                "temperature": 0.0,
+                "stream": True,
+                "nvext": {"annotations": ["remote_prefill"]},
+            },
+        ) as r:
+            assert r.status_code == 200
+            for line in r.iter_lines():
+                if line.startswith(": "):
+                    notes.append(line[2:])
+                elif line.startswith("data: ") and line[6:] != "[DONE]":
+                    chunks.append(json.loads(line[6:]))
+    return chunks, notes
+
+
+def test_multihost_disagg_per_shard_pull(mh_disagg_cluster):
+    base = mh_disagg_cluster
+    prompt = list(range(5, 75))  # 70 tokens > threshold 16 => remote prefill
+
+    chunks, notes = _complete(base, prompt)
+    finishes = [c for c in chunks if c["choices"] and c["choices"][0].get("finish_reason")]
+    assert finishes and finishes[-1]["choices"][0]["finish_reason"] in ("length", "stop")
+    assert any("remote_prefill" in n and "true" in n for n in notes), notes
+
+    # deterministic greedy: a repeat (prefix-cached) run matches
+    chunks2, _ = _complete(base, prompt)
+    text1 = "".join(c["choices"][0].get("text", "") for c in chunks if c["choices"])
+    text2 = "".join(c["choices"][0].get("text", "") for c in chunks2 if c["choices"])
+    assert text1 == text2
+
+    time.sleep(1.0)  # let follower logs flush
+    logs = {k: Path(v).read_text(errors="replace") for k, v in LOGS.items()}
+    # decode leader pulled ONLY its shard, point-to-point
+    assert "kv shard pull complete" in logs["dec_leader"], logs["dec_leader"][-2000:]
+    # decode follower pulled its own shard chunks from its peer host
+    assert "pulled shard chunk" in logs["dec_follower"]
+    # prefill follower staged its shard on its own data plane
+    assert "staged shard" in logs["pre_follower"]
+    # and nothing fell back to local prefill
+    assert "prefilling locally" not in logs["dec_leader"]

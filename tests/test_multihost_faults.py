@@ -1,0 +1,144 @@
+"""Multi-host fault behavior (round-2 verdict weak #5/#7):
+
+  * preemption while blocks are in flight on a 2-host SPMD worker — page
+    exhaustion must preempt/requeue and still complete every request with
+    the follower replaying the extra resets deterministically;
+  * follower death mid-service — the leader must detect the lost step
+    stream, fail in-flight requests (migration-ready errors), and shut
+    itself down rather than wedging inside the next gloo collective.
+"""
+
+import time
+
+import httpx
+import pytest
+
+from .utils import ManagedProcess, free_port
+
+
+@pytest.fixture(scope="module")
+def tight_cluster():
+    """2-host aggregated worker with a page pool small enough that
+    concurrent requests force preemption."""
+    http_port = free_port()
+    disc = f"tcp://127.0.0.1:{free_port()}"
+    coord_port, spmd_port = free_port(), free_port()
+    worker_env = {"XLA_FLAGS": "--xla_force_host_platform_device_count=1"}
+
+    def worker_args(host_id):
+        return [
+            "-m", "dynamo_tpu.jax_worker",
+            "--model", "tiny",
+            "--model-name", "tiny-mhf",
+            "--discovery", disc,
+            "--page-size", "8",
+            "--num-pages", "24",  # 192 tokens of KV for up to 4 sequences
+            "--max-num-seqs", "4",
+            "--max-model-len", "96",
+            "--context-length", "96",
+            "--tp-size", "2",
+            "--num-hosts", "2",
+            "--host-id", str(host_id),
+            "--coordinator", f"127.0.0.1:{coord_port}",
+            "--spmd-port", str(spmd_port),
+        ]
+
+    fe = ManagedProcess(
+        ["-m", "dynamo_tpu.frontend", "--http-port", str(http_port),
+         "--embed-discovery", "--discovery", disc],
+        name="mhf_fe",
+    ).start("/tmp/mhf_fe.log")
+    fe.wait_port(http_port)
+    leader = ManagedProcess(
+        worker_args(0), name="mhf_leader", env=worker_env
+    ).start("/tmp/mhf_leader.log")
+    follower = ManagedProcess(
+        worker_args(1), name="mhf_follower", env=worker_env
+    ).start("/tmp/mhf_follower.log")
+
+    base = f"http://127.0.0.1:{http_port}"
+    deadline = time.time() + 180
+    with httpx.Client() as client:
+        while time.time() < deadline:
+            for p, n in [(leader, "leader"), (follower, "follower")]:
+                if p.proc.poll() is not None:
+                    raise RuntimeError(f"{n} died; see /tmp/mhf_{n}.log")
+            try:
+                if client.get(f"{base}/v1/models").json()["data"]:
+                    break
+            except Exception:
+                pass
+            time.sleep(0.5)
+        else:
+            raise TimeoutError("tight multihost cluster never registered")
+    yield base, leader, follower
+    follower.stop()
+    leader.stop()
+    fe.stop()
+
+
+def test_multihost_preemption_completes_all(tight_cluster):
+    """3 concurrent 40+40-token requests need ~240 tokens of KV against a
+    192-token pool: someone gets preempted, committed blocks resume via the
+    prefix cache, and every request still finishes with exactly its
+    requested length — with host 1 replaying every extra reset/patch."""
+    base, leader, follower = tight_cluster
+    prompt = list(range(3, 43))  # 40 tokens
+
+    def one(client):
+        return client.post(
+            f"{base}/v1/completions",
+            json={
+                "model": "tiny-mhf",
+                "prompt": prompt,
+                "max_tokens": 40,
+                "temperature": 0.0,
+                "nvext": {"ignore_eos": True},
+            },
+        ).json()
+
+    import concurrent.futures
+
+    with httpx.Client(timeout=300) as client:
+        with concurrent.futures.ThreadPoolExecutor(3) as ex:
+            results = list(ex.map(lambda _: one(client), range(3)))
+    for r in results:
+        assert r.get("usage", {}).get("completion_tokens") == 40, r
+    assert leader.proc.poll() is None and follower.proc.poll() is None
+
+
+def test_follower_death_fails_fast_and_shuts_down(tight_cluster):
+    """SIGKILL the follower: the leader must notice the dead step stream,
+    error (not hang) anything in flight, and exit — so its lease lapses
+    instead of wedging the whole worker inside a dead collective.
+    Runs LAST: it destroys the cluster."""
+    base, leader, follower = tight_cluster
+    follower.sigkill()
+
+    # the leader notices either via the step-socket reset immediately or at
+    # the next dispatch; a request forces the issue
+    deadline = time.time() + 60
+    failed_fast = False
+    while time.time() < deadline:
+        if leader.proc.poll() is not None:
+            failed_fast = True  # leader exited (fail-fast shutdown)
+            break
+        try:
+            with httpx.Client(timeout=15) as client:
+                r = client.post(
+                    f"{base}/v1/completions",
+                    json={"model": "tiny-mhf", "prompt": [5, 6, 7, 8],
+                          "max_tokens": 4, "temperature": 0.0},
+                )
+            if r.status_code >= 500:
+                failed_fast = True
+                break
+        except (httpx.TimeoutException, httpx.TransportError):
+            pass  # in-flight teardown; retry until leader reacts
+        time.sleep(1.0)
+    assert failed_fast, "leader neither errored requests nor exited after follower death"
+    # and the leader process itself must terminate (os._exit watchdog)
+    deadline = time.time() + 30
+    while time.time() < deadline and leader.proc.poll() is None:
+        time.sleep(0.5)
+    assert leader.proc.poll() is not None, "leader did not shut down"
