@@ -42,6 +42,12 @@ class EngineConfig:
     # parallelism (parallel/mesh.py)
     tp_size: int = 1
     dp_size: int = 1
+    pp_size: int = 1  # pipeline stages (layers over the pp axis; decode and
+    # prefill stream microbatches through parallel/pipeline.py)
+    sp_size: int = 1  # sequence-parallel axis (ring-attention prefill)
+    # route a fresh prompt through the ring-prefill path when it has at
+    # least this many uncached tokens (and sp_size > 1)
+    ring_prefill_threshold: int = 512
     # scheduling
     max_queue: int = 4096
     decode_batch_wait_s: float = 0.0  # wait to fill decode batch (0 = greedy)

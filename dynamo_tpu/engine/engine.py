@@ -146,10 +146,18 @@ class JaxEngine:
         self._model = moe if isinstance(c, moe.MoeConfig) else llama
         key = jax.random.PRNGKey(config.seed)
         self.params = params if params is not None else self._model.init_params(c, key)
-        # +1: physical page 0 is scratch
+        # +1: physical page 0 is scratch. If the layout shards the PAGE axis
+        # (dp-attention: pages over ep), round the pool up to a shardable
+        # size — the allocator still manages only num_pages, spares idle.
+        total_pages = config.num_pages + 1
+        if kv_sharding is not None and len(kv_sharding.spec) > 1 and kv_sharding.spec[1]:
+            axes = kv_sharding.spec[1]
+            names = axes if isinstance(axes, tuple) else (axes,)
+            div = int(np.prod([kv_sharding.mesh.shape[a] for a in names]))
+            total_pages = -(-total_pages // div) * div
         self.kv_k, self.kv_v = alloc_kv_arrays(
             c.num_layers,
-            config.num_pages + 1,
+            total_pages,
             config.page_size,
             c.num_kv_heads,
             c.head_dim,
@@ -334,9 +342,17 @@ class JaxEngine:
 
                 def step(carry, k):
                     tokens, positions, seq_lens, kv_k, kv_v = carry
-                    logits, kv_k, kv_v = self._model.decode_forward(
-                        params, c, tokens, positions, kv_k, kv_v, page_tables, seq_lens
-                    )
+                    if cfg.pp_size > 1:
+                        # layers pipelined over pp: each step is a full
+                        # microbatch schedule (parallel/pipeline.py)
+                        logits, kv_k, kv_v = self._model.decode_forward_pp(
+                            params, c, tokens, positions, kv_k, kv_v,
+                            page_tables, seq_lens, self._mesh,
+                        )
+                    else:
+                        logits, kv_k, kv_v = self._model.decode_forward(
+                            params, c, tokens, positions, kv_k, kv_v, page_tables, seq_lens
+                        )
                     nxt = sample(logits, samp, k)
                     return (nxt, positions + 1, seq_lens + 1, kv_k, kv_v), nxt
 
@@ -358,6 +374,35 @@ class JaxEngine:
             return first, kv_k, kv_v, rng
 
         self._prefill_batch = prefill_batch
+
+        # single-sequence prefill variants for the native parallel layouts
+        # (SURVEY.md §2.5): ring attention over sp (long-context), layer
+        # pipeline over pp. Both sample the first token on device.
+        self._prefill_single = None
+        if self._mesh is not None and (cfg.sp_size > 1 or cfg.pp_size > 1):
+            mode = "pp" if cfg.pp_size > 1 else "ring"
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            repl = NamedSharding(self._mesh, PartitionSpec())
+            kvs = self._kv_sharding or repl
+            single_out_sh = (repl, kvs, kvs, repl)
+
+            @partial(jax.jit, donate_argnums=(1, 2, 7), out_shardings=single_out_sh)
+            def prefill_single(params, kv_k, kv_v, toks, table, ctx_len, real_len, rng, samp):
+                rng, sub = jax.random.split(rng)
+                if mode == "pp":
+                    logits, kv_k, kv_v = self._model.prefill_forward_pp(
+                        params, c, toks, kv_k, kv_v, table, ctx_len, real_len,
+                        self._mesh,
+                    )
+                else:
+                    logits, kv_k, kv_v = self._model.prefill_forward_ring(
+                        params, c, toks, kv_k, kv_v, table, real_len, self._mesh
+                    )
+                first = sample(logits[None], samp, sub)
+                return first, kv_k, kv_v, rng
+
+            self._prefill_single = prefill_single
 
         # per-lane carry patch: admissions/finishes update ONLY their lanes
         # on device instead of invalidating the whole carry (a full reset
@@ -537,6 +582,16 @@ class JaxEngine:
         finally:
             slot.done = True
             self._wake.set()
+
+    def clear_kv_blocks(self) -> int:
+        """Admin flush (reference clear-kv-blocks route, service_v2.rs:
+        319-339): evict every unreferenced prefix-cache page (emitting
+        removed events so routers un-index them) and drop the KVBM tiers.
+        Active sequences keep their pages."""
+        n = self.allocator.clear_cache()
+        if self.kvbm is not None:
+            n += self.kvbm.clear()
+        return n
 
     def stats(self) -> dict:
         alloc_stats = self.allocator.stats()
@@ -888,6 +943,14 @@ class JaxEngine:
                         p["page_tables"], p["temps"], p["top_ks"], p["top_ps"],
                     )
                 )
+            elif tag == "prefill_single":
+                await self._run_on_device(
+                    partial(
+                        self._dev_prefill_single,
+                        p["toks"], p["table"], p["ctx"][0], p["real"][0],
+                        p["temps"], p["top_ks"], p["top_ps"],
+                    )
+                )
             elif tag == "patch":
                 await self._run_on_device(
                     partial(
@@ -1174,6 +1237,19 @@ class JaxEngine:
         if not cands:
             return False
         cands.sort(key=lambda s: s.admit_seq)
+
+        if self._prefill_single is not None:
+            s0 = cands[0]
+            remaining = len(s0.kv_prompt) - s0.prefill_pos
+            # pp: every prompt goes through the pipelined single-seq path
+            # (layer-sharded weights make the batched path degenerate);
+            # sp: only fresh long prompts ride the ring (history-free)
+            use_single = cfg.pp_size > 1 or (
+                s0.prefill_pos == 0 and remaining >= cfg.ring_prefill_threshold
+            )
+            if use_single:
+                await self._dispatch_prefill_one(s0)
+                return True
         first_chunk = min(
             len(cands[0].kv_prompt) - cands[0].prefill_pos, cfg.max_prefill_chunk
         )
@@ -1240,6 +1316,57 @@ class JaxEngine:
         if completions:
             self._pending_prefill.append({"first": first_dev, "done": completions})
         return True
+
+    async def _dispatch_prefill_one(self, slot: _Slot) -> None:
+        """Single-sequence whole-remaining-prompt prefill through the
+        parallel path (_prefill_single: ring over sp / pipeline over pp).
+        Pads to a pow2 bucket so compile variants stay bounded."""
+        cfg = self.config
+        chunk = len(slot.kv_prompt) - slot.prefill_pos
+        unit = max(cfg.sp_size, cfg.pp_size, 1)
+        # pow2 bucket for bounded compile variants, then round UP to a unit
+        # multiple (a non-pow2 sp/pp size would otherwise fail the ring's
+        # divisibility check)
+        T_pad = _next_pow2(chunk)
+        T_pad = -(-T_pad // unit) * unit
+        pages_needed = (slot.prefill_pos + chunk + cfg.page_size - 1) // cfg.page_size
+        P = min(_next_pow2(pages_needed), cfg.max_pages_per_seq) + 1
+        table = np.full((P,), SCRATCH_PAGE, np.int32)
+        table[: min(len(slot.pages), P)] = [p + 1 for p in slot.pages[:P]]
+        toks = np.zeros((T_pad,), np.int32)
+        toks[:chunk] = slot.kv_prompt[slot.prefill_pos :]
+        ctx = np.int32(slot.prefill_pos)
+        real = np.int32(chunk)
+        temps = np.array([slot.temperature], np.float32)
+        top_ks = np.array([slot.top_k], np.int32)
+        top_ps = np.array([slot.top_p], np.float32)
+        self._bcast(
+            "prefill_single",
+            {
+                "toks": toks, "table": table, "ctx": np.array([ctx]),
+                "real": np.array([real]), "temps": temps,
+                "top_ks": top_ks, "top_ps": top_ps,
+            },
+        )
+        first_dev = await self._run_on_device(
+            partial(self._dev_prefill_single, toks, table, ctx, real, temps, top_ks, top_ps)
+        )
+        slot.prefill_pos += chunk
+        self._pending_prefill.append({"first": first_dev, "done": [(slot, 0)]})
+
+    def _dev_prefill_single(self, toks, table, ctx, real, temps, top_ks, top_ps):
+        samp = SamplingParams(
+            temperature=jnp.asarray(temps),
+            top_k=jnp.asarray(top_ks),
+            top_p=jnp.asarray(top_ps),
+        )
+        first, self.kv_k, self.kv_v, self._rng = self._prefill_single(
+            self.params, self.kv_k, self.kv_v,
+            jnp.asarray(toks), jnp.asarray(table),
+            jnp.asarray(ctx, jnp.int32), jnp.asarray(real, jnp.int32),
+            self._rng, samp,
+        )
+        return first
 
     def _finish_prefill(self, slot: _Slot, first: int):
         """Prompt KV fully computed; activate the slot for decode."""

@@ -39,6 +39,9 @@ def parse_args():
     )
     ap.add_argument("--kv-overlap-score-weight", type=float, default=1.0)
     ap.add_argument("--router-temperature", type=float, default=0.0)
+    ap.add_argument("--router-replica-sync", action="store_true",
+                    help="mirror routing decisions between KV-mode frontends "
+                    "(reference kv_router/subscriber.rs)")
     return ap.parse_args()
 
 
@@ -61,6 +64,7 @@ async def main():
             KvRouterConfig(
                 overlap_score_weight=args.kv_overlap_score_weight,
                 router_temperature=args.router_temperature,
+                replica_sync=args.router_replica_sync,
             )
         )
 

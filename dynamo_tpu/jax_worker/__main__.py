@@ -39,6 +39,15 @@ def parse_args():
     ap.add_argument("--tp-size", type=int, default=1)
     ap.add_argument("--ep-size", type=int, default=1,
                     help="expert-parallel axis size (MoE models)")
+    ap.add_argument("--pp-size", type=int, default=1,
+                    help="pipeline stages (layers over the pp mesh axis)")
+    ap.add_argument("--sp-size", type=int, default=1,
+                    help="sequence-parallel axis (ring-attention prefill)")
+    ap.add_argument("--ring-prefill-threshold", type=int, default=512,
+                    help="fresh prompts at least this long ride the sp ring")
+    ap.add_argument("--dp-attention", action="store_true",
+                    help="MoE: attention/batch data-parallel over the ep axis "
+                    "(DeepSeek-style wide-EP layout)")
     ap.add_argument("--kv-events", action="store_true")
     # KVBM tiers (kvbm/): host-RAM + disk KV block offload
     ap.add_argument("--kvbm-host-blocks", type=int, default=0)
@@ -103,6 +112,9 @@ async def main():
         decode_pool_mode=args.decode_pool_mode,
         decode_block_unroll=args.decode_block_unroll,
         tp_size=args.tp_size,
+        pp_size=args.pp_size,
+        sp_size=args.sp_size,
+        ring_prefill_threshold=args.ring_prefill_threshold,
         kvbm_host_blocks=args.kvbm_host_blocks,
         kvbm_disk_blocks=args.kvbm_disk_blocks,
         kvbm_disk_path=args.kvbm_disk_path,
@@ -112,9 +124,14 @@ async def main():
     params = None
     model_config = None
     mesh = None
-    if args.tp_size > 1 or args.ep_size > 1 or args.model_path or multihost:
+    any_parallel = (
+        args.tp_size > 1 or args.ep_size > 1 or args.pp_size > 1
+        or args.sp_size > 1
+    )
+    if any_parallel or args.model_path or multihost:
         from dynamo_tpu.models import llama, moe
         from dynamo_tpu.parallel.mesh import (
+            DpAttentionShardings,
             LlamaShardings,
             MoeShardings,
             ParallelConfig,
@@ -129,11 +146,19 @@ async def main():
         is_moe = isinstance(model_config, moe.MoeConfig)
         model_mod = moe if is_moe else llama
         shardings = None
-        if args.tp_size > 1 or args.ep_size > 1 or multihost:
+        if any_parallel or multihost:
             mesh = build_mesh(
-                ParallelConfig(tp_size=args.tp_size, ep_size=args.ep_size)
+                ParallelConfig(
+                    tp_size=args.tp_size, ep_size=args.ep_size,
+                    pp_size=args.pp_size, sp_size=args.sp_size,
+                )
             )
-            shardings = MoeShardings(mesh) if is_moe else LlamaShardings(mesh)
+            if is_moe and args.dp_attention:
+                shardings = DpAttentionShardings(mesh)
+            elif is_moe:
+                shardings = MoeShardings(mesh)
+            else:
+                shardings = LlamaShardings(mesh)
             kv_sharding = shardings.kv_sharding()
         if args.model_path:
             from dynamo_tpu.models.loader import load_llama_params, load_moe_params
@@ -286,9 +311,61 @@ async def main():
             DisaggConfig(remote_prefill_threshold_tokens=args.disagg_threshold)
         )
 
+        # conditional-disagg queue guard (reference disagg_router.rs:230
+        # "prefill queue below limit"): watch the prefill pool's published
+        # engine stats and feed the LEAST-loaded live worker's queue depth
+        # into the router — remote prefill stops when the whole pool is
+        # backed up
+        async def _watch_prefill_queue():
+            from dynamo_tpu.llm.kv_router.publisher import METRICS_TOPIC_FMT
+            from dynamo_tpu.runtime import codec
+
+            if drt.discovery is None:
+                return
+            sub = await drt.discovery.subscribe(
+                METRICS_TOPIC_FMT.format(
+                    namespace=args.namespace, component=args.prefill_component
+                )
+            )
+            depths: dict[int, int] = {}
+            announced = False
+            async for payload in sub:
+                try:
+                    msg = codec.unpack(payload)
+                    stats = msg.get("stats", {})
+                    depths[int(msg["worker_id"])] = int(
+                        stats.get("num_waiting_reqs", 0)
+                    ) + int(stats.get("num_running_reqs", 0))
+                    live = set(prefill_client.instance_ids())
+                    for w in list(depths):
+                        if w not in live:
+                            del depths[w]
+                    disagg_router.update_queue_depth(
+                        min((depths[w] for w in depths), default=0)
+                    )
+                    if not announced:
+                        announced = True
+                        logger.info(
+                            "prefill queue watcher active (%d worker(s), depth=%d)",
+                            len(depths), disagg_router.prefill_queue_depth,
+                        )
+                except Exception:  # noqa: BLE001 — stats are advisory
+                    logger.debug("bad prefill metrics message", exc_info=True)
+
+        # strong ref: main() outlives it; the loop alone keeps only weak refs
+        _queue_watch_task = asyncio.get_running_loop().create_task(
+            _watch_prefill_queue()
+        )
+
     async def handler(request, context):
         if "worker_instance_id" in (request.get("annotations") or []):
             yield {"event": "worker_instance_id", "comment": [f"{drt.instance_id:x}"]}
+        if "clear_kv_blocks" in (request.get("annotations") or []):
+            # admin flush (reference service_v2.rs:319-339 clear-kv-blocks):
+            # drop every unreferenced prefix-cache page (+ KVBM tiers)
+            cleared = engine.clear_kv_blocks()
+            yield {"event": "clear_kv_blocks", "comment": [str(cleared)]}
+            return
         if args.role == "decode" and disagg_router is not None:
             from dynamo_tpu.jax_worker.disagg_handler import maybe_remote_prefill
 

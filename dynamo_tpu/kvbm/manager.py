@@ -140,6 +140,17 @@ class KvBlockManager:
             if self.disk is not None:
                 self.disk.flush()
 
+    def clear(self) -> int:
+        """Drop every tiered block (admin clear-kv-blocks route)."""
+        with self._lock:
+            n = 0
+            if self.host is not None:
+                n += self.host.clear()
+            if self.disk is not None:
+                n += self.disk.clear()
+                self.disk.flush()  # persist the now-empty index
+            return n
+
     def stats(self) -> dict:
         out = {
             "kvbm_offloaded_blocks": self.offloaded_blocks,
@@ -215,6 +226,9 @@ class KvbmConnector:
 
     def load(self, hashes: Sequence[int]) -> Tuple[np.ndarray, np.ndarray]:
         return self.manager.load_blocks(hashes)
+
+    def clear(self) -> int:
+        return self.manager.clear()
 
     def stats(self) -> dict:
         return {**self.manager.stats(), "kvbm_pending_offloads": self._pending}

@@ -86,6 +86,14 @@ class _BlockPool:
         self._lru.move_to_end(seq_hash)
         return self._k[slot], self._v[slot]
 
+    def clear(self) -> int:
+        """Drop every block (admin clear-kv-blocks); slots return to the
+        free list, data stays in place until overwritten."""
+        n = len(self._by_hash)
+        self._by_hash.clear()
+        self._init_pool()
+        return n
+
     def stats(self) -> dict:
         return {
             f"{self.name}_blocks": len(self._by_hash),

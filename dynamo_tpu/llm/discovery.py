@@ -52,6 +52,9 @@ class ModelManager:
     def kv_router_for(self, model: str):
         return self._kv_routers.get(model)
 
+    def client_for(self, model: str) -> Optional[Client]:
+        return self._clients.get(model)
+
 
 class ModelWatcher:
     """Watch v1/mdc/ and maintain the ModelManager

@@ -90,6 +90,9 @@ class HttpService:
         self.app.router.add_get("/health", self.health)
         self.app.router.add_get("/live", self.live)
         self.app.router.add_get("/metrics", self.prometheus)
+        # admin: flush every worker's reusable KV blocks (reference
+        # clear_kv_blocks route assembly, service_v2.rs:319-339)
+        self.app.router.add_post("/clear-kv-blocks", self.clear_kv_blocks)
 
     async def start(self) -> int:
         self._runner = web.AppRunner(self.app, access_log=None)
@@ -120,6 +123,37 @@ class HttpService:
         return web.Response(
             body=self.metrics.render(), content_type="text/plain", charset="utf-8"
         )
+
+    async def clear_kv_blocks(self, request: web.Request) -> web.Response:
+        """Tell every worker instance of every (or one given) model to drop
+        its reusable KV blocks; returns per-instance cleared counts."""
+        model_filter = request.query.get("model")
+        results: dict = {}
+        for name in self.manager.names():
+            if model_filter and name != model_filter:
+                continue
+            client = self.manager.client_for(name)
+            if client is None:
+                continue
+            per_model: dict = {}
+            for inst in client.instance_ids():
+                try:
+                    ctx = Context()
+                    stream = await client.direct(
+                        {"annotations": ["clear_kv_blocks"], "token_ids": []},
+                        inst,
+                        ctx,
+                    )
+                    cleared = None
+                    async for item in stream:
+                        ev = item.get("event") if isinstance(item, dict) else None
+                        if ev == "clear_kv_blocks":
+                            cleared = int((item.get("comment") or ["0"])[0])
+                    per_model[f"{inst:x}"] = cleared if cleared is not None else "no-op"
+                except Exception as e:  # noqa: BLE001 — report per instance
+                    per_model[f"{inst:x}"] = f"error: {e}"
+            results[name] = per_model
+        return web.json_response({"cleared": results})
 
     async def _embed_one(self, pipeline, token_ids: list[int]) -> list[float]:
         """One embed round-trip below the detokenizer; raises on engine

@@ -75,6 +75,21 @@ class KvPushRouter:
         self._metrics_sub = None
         self._metrics_task: Optional[asyncio.Task] = None
         self._known_workers: set[int] = set()
+        # replica sync (reference kv_router/subscriber.rs): multiple KV-mode
+        # frontends mirror each other's routing decisions so their
+        # active-block accounting (and approx indexers) don't drift
+        import secrets as _secrets
+
+        self._sync_id = _secrets.token_hex(4)
+        self._sync_sub = None
+        self._sync_task: Optional[asyncio.Task] = None
+        self._bg: set = set()
+
+    @property
+    def _sync_topic(self) -> str:
+        ns = self.client.endpoint.component.namespace
+        comp = self.client.endpoint.component.name
+        return f"kv_router_sync/{ns}/{comp}"
 
     async def start(self):
         if isinstance(self.indexer, KvIndexer):
@@ -86,6 +101,43 @@ class KvPushRouter:
                 METRICS_TOPIC_FMT.format(namespace=ns, component=comp)
             )
             self._metrics_task = asyncio.create_task(self._metrics_loop())
+            if self.config.replica_sync:
+                self._sync_sub = await self.drt.discovery.subscribe(self._sync_topic)
+                self._sync_task = asyncio.create_task(self._sync_loop())
+
+    def _publish_sync(self, msg: dict):
+        if self._sync_sub is None or self.drt.discovery is None:
+            return
+        msg["router"] = self._sync_id
+
+        async def _pub():
+            try:
+                await self.drt.discovery.publish(self._sync_topic, codec.pack(msg))
+            except Exception:  # noqa: BLE001 — sync is best-effort
+                logger.debug("replica sync publish failed", exc_info=True)
+
+        t = asyncio.create_task(_pub())
+        self._bg.add(t)
+        t.add_done_callback(self._bg.discard)
+
+    async def _sync_loop(self):
+        async for payload in self._sync_sub:
+            try:
+                msg = codec.unpack(payload)
+                if msg.get("router") == self._sync_id:
+                    continue  # our own event
+                if msg["op"] == "route":
+                    self.scheduler.add_request(
+                        msg["request_id"], msg["worker"], msg["blocks"]
+                    )
+                    if isinstance(self.indexer, ApproxKvIndexer) and msg.get("token_ids"):
+                        self.indexer.process_routing_decision_for_request(
+                            msg["token_ids"], msg["worker"]
+                        )
+                elif msg["op"] == "free":
+                    self.scheduler.mark_free(msg["request_id"])
+            except Exception:  # noqa: BLE001
+                logger.exception("bad replica sync message")
 
     async def _metrics_loop(self):
         async for payload in self._metrics_sub:
@@ -149,10 +201,21 @@ class KvPushRouter:
         self.scheduler.add_request(request_id, worker, blocks)
         if isinstance(self.indexer, ApproxKvIndexer):
             self.indexer.process_routing_decision_for_request(token_ids, worker)
+        self._publish_sync(
+            {
+                "op": "route", "request_id": request_id, "worker": worker,
+                "blocks": blocks,
+                "token_ids": list(token_ids)
+                if isinstance(self.indexer, ApproxKvIndexer) else [],
+            }
+        )
         try:
             inner = await self.client.direct(request, worker, context)
         except StreamLost:
             self.scheduler.mark_free(request_id)
+            # replicas mirrored the route: they must see the free too, or
+            # they leak the active request forever (no TTL pruning)
+            self._publish_sync({"op": "free", "request_id": request_id})
             raise
         return self._wrap(inner, request_id)
 
@@ -162,12 +225,17 @@ class KvPushRouter:
                 yield item
         finally:
             self.scheduler.mark_free(request_id)
+            self._publish_sync({"op": "free", "request_id": request_id})
 
     async def close(self):
         if self._metrics_task:
             self._metrics_task.cancel()
         if self._metrics_sub:
             await self._metrics_sub.cancel()
+        if self._sync_task:
+            self._sync_task.cancel()
+        if self._sync_sub:
+            await self._sync_sub.cancel()
         if isinstance(self.indexer, KvIndexer):
             await self.indexer.close()
 

@@ -295,6 +295,267 @@ def prefill_forward_batched(
     return logits, kv_k, kv_v
 
 
+def prefill_forward_ring(
+    params: Dict[str, Any],
+    config: LlamaConfig,
+    tokens: jax.Array,  # [T] whole prompt (padded to a multiple of sp)
+    kv_k: jax.Array,  # [L, pages, page_size, kv_heads, head_dim]
+    kv_v: jax.Array,
+    page_table: jax.Array,  # [max_pages] this sequence's table
+    real_len: jax.Array,  # scalar i32: tokens beyond this are padding
+    mesh,
+    axis_name: str = "sp",
+    mlp_fn=None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Sequence-parallel whole-prompt prefill: the token dim is sharded over
+    the ``sp`` mesh axis and attention is exact ring attention
+    (ops/ring_attention.py — K/V blocks rotate over ICI, O(T/n) attention
+    memory per device). This is the engine's long-context path (SURVEY.md
+    §2.5 sequence-parallel row: absent upstream, native extension here);
+    the reference handles long prompts only by chunking + disagg
+    (disagg_router.rs:230 thresholds). History-free by design: prefix-cache
+    hits fall back to chunked prefill.
+
+    Returns (logits_of_last_real_token [vocab], kv_k, kv_v)."""
+    from ..ops.ring_attention import ring_attention
+
+    c = config
+    mlp_fn = mlp_fn or _mlp
+    T = tokens.shape[0]
+    positions = jnp.arange(T, dtype=jnp.int32)
+    x = params["embed"][tokens]  # [T, H]
+    cos, sin = rope_cos_sin(positions, c.head_dim, c.rope_theta)
+    page_size = kv_k.shape[2]
+
+    # pad positions write to the scratch page (phys 0), real ones to the table
+    logical = jnp.minimum(positions // page_size, page_table.shape[0] - 1)
+    phys = jnp.where(positions < real_len, page_table[logical], 0)
+    offs = positions % page_size
+
+    for li in range(c.num_layers):
+        layer = jax.tree.map(lambda p: p[li], params["layers"])
+        h = rms_norm(x, layer["attn_norm"], c.rms_norm_eps)
+        q = jnp.dot(h, layer["wq"], preferred_element_type=jnp.float32).astype(c.dtype)
+        k = jnp.dot(h, layer["wk"], preferred_element_type=jnp.float32).astype(c.dtype)
+        v = jnp.dot(h, layer["wv"], preferred_element_type=jnp.float32).astype(c.dtype)
+        q = q.reshape(T, c.num_heads, c.head_dim)
+        k = k.reshape(T, c.num_kv_heads, c.head_dim)
+        v = v.reshape(T, c.num_kv_heads, c.head_dim)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        kv_k = kv_k.at[li, phys, offs].set(k)
+        kv_v = kv_v.at[li, phys, offs].set(v)
+        attn = ring_attention(q, k, v, mesh, axis_name=axis_name, causal=True)
+        attn = attn.reshape(T, c.num_heads * c.head_dim)
+        x = x + jnp.dot(attn, layer["wo"], preferred_element_type=jnp.float32).astype(c.dtype)
+        x = mlp_fn(layer, x, c)
+
+    x = rms_norm(x, params["final_norm"], c.rms_norm_eps)
+    last = x[jnp.maximum(real_len - 1, 0)]
+    head = params["lm_head"] if params["lm_head"] is not None else params["embed"].T
+    logits = jnp.dot(last, head, preferred_element_type=jnp.float32)
+    return logits, kv_k, kv_v
+
+
+def _stage_layers_decode(local_params, local_kv, x, aux, valid, c, mlp_fn):
+    """One pipeline stage's layers for a decode microbatch. local_kv =
+    (kv_k, kv_v) with leading [L/S] layer axis; aux carries the
+    microbatch's positions/page-table rows/seq lens; invalid (bubble)
+    ticks write to the scratch page."""
+    from ..ops.paged_attention import paged_attention_decode
+
+    kv_k_loc, kv_v_loc = local_kv
+    positions, tables, seq_lens = aux["positions"], aux["tables"], aux["seq_lens"]
+    page_size = kv_k_loc.shape[2]
+    cos, sin = rope_cos_sin(positions, c.head_dim, c.rope_theta)
+    max_positions = tables.shape[1] * page_size
+    logical = jnp.minimum(positions // page_size, tables.shape[1] - 1)
+    phys = jnp.take_along_axis(tables, logical[:, None], axis=1)[:, 0]
+    phys = jnp.where(valid & (positions < max_positions), phys, 0)
+    offs = positions % page_size
+    n_local = kv_k_loc.shape[0]
+    for li in range(n_local):
+        layer = jax.tree.map(lambda p: p[li], local_params)
+        h = rms_norm(x, layer["attn_norm"], c.rms_norm_eps)
+        q = jnp.dot(h, layer["wq"], preferred_element_type=jnp.float32).astype(c.dtype)
+        k = jnp.dot(h, layer["wk"], preferred_element_type=jnp.float32).astype(c.dtype)
+        v = jnp.dot(h, layer["wv"], preferred_element_type=jnp.float32).astype(c.dtype)
+        q = q.reshape(-1, c.num_heads, c.head_dim)
+        k = k.reshape(-1, c.num_kv_heads, c.head_dim)
+        v = v.reshape(-1, c.num_kv_heads, c.head_dim)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        kv_k_loc = kv_k_loc.at[li, phys, offs].set(k)
+        kv_v_loc = kv_v_loc.at[li, phys, offs].set(v)
+        attn = paged_attention_decode(q, kv_k_loc[li], kv_v_loc[li], tables, seq_lens)
+        attn = attn.reshape(-1, c.num_heads * c.head_dim)
+        x = x + jnp.dot(attn, layer["wo"], preferred_element_type=jnp.float32).astype(c.dtype)
+        x = mlp_fn(layer, x, c)
+    return x, (kv_k_loc, kv_v_loc)
+
+
+def decode_forward_pp(
+    params: Dict[str, Any],
+    config: LlamaConfig,
+    tokens: jax.Array,  # [B]
+    positions: jax.Array,  # [B]
+    kv_k: jax.Array,  # [L, pages, page_size, KH, D] (pp-sharded on L)
+    kv_v: jax.Array,
+    page_tables: jax.Array,  # [B, max_pages]
+    seq_lens: jax.Array,  # [B]
+    mesh,
+    num_microbatches: int = 0,
+    mlp_fn=None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One decode step with the LAYERS pipelined over the ``pp`` mesh axis:
+    the batch splits into microbatches that stream through the stages
+    (parallel/pipeline.py pipeline_apply_stateful; each stage owns the KV
+    pool of its own layers). The reference delegates PP to its engines
+    (SURVEY.md §2.5 PP row); here it is a native XLA schedule.
+    Returns (logits [B, vocab], kv_k, kv_v)."""
+    from ..parallel.pipeline import pipeline_apply_stateful, stack_stages
+
+    c = config
+    mlp_fn = mlp_fn or _mlp
+    S = mesh.shape["pp"]
+    B = tokens.shape[0]
+    M = num_microbatches or min(S, B)
+    while B % M:
+        M -= 1
+    mb = B // M
+    L = kv_k.shape[0]
+
+    stage_params = stack_stages(params["layers"], S)
+    stage_kv = (
+        kv_k.reshape(S, L // S, *kv_k.shape[1:]),
+        kv_v.reshape(S, L // S, *kv_v.shape[1:]),
+    )
+    x = params["embed"][tokens]  # [B, H]
+    x_mb = x.reshape(M, mb, -1)
+    aux_mb = {
+        "positions": positions.reshape(M, mb),
+        "tables": page_tables.reshape(M, mb, -1),
+        "seq_lens": seq_lens.reshape(M, mb),
+    }
+
+    def stage_fn(local_p, local_s, x, aux, valid):
+        return _stage_layers_decode(local_p, local_s, x, aux, valid, c, mlp_fn)
+
+    out, (kv_k_s, kv_v_s) = pipeline_apply_stateful(
+        stage_params, stage_kv, x_mb, aux_mb, stage_fn, mesh
+    )
+    kv_k = kv_k_s.reshape(L, *kv_k.shape[1:])
+    kv_v = kv_v_s.reshape(L, *kv_v.shape[1:])
+    x = out.reshape(B, -1)
+    x = rms_norm(x, params["final_norm"], c.rms_norm_eps)
+    head = params["lm_head"] if params["lm_head"] is not None else params["embed"].T
+    logits = jnp.dot(x, head, preferred_element_type=jnp.float32)
+    return logits, kv_k, kv_v
+
+
+def _stage_layers_prefill(local_params, local_kv, x, aux, valid, c, mlp_fn):
+    """One pipeline stage's layers for a PREFILL microbatch (a contiguous
+    token span of one sequence). Pipeline order = sequence order, so span
+    j's KV is fully written at every stage before span j+1 arrives —
+    chunked-prefill causality for free."""
+    from ..ops.paged_attention import prefill_attention
+
+    kv_k_loc, kv_v_loc = local_kv
+    positions = aux["positions"]  # [t] absolute
+    table = aux["table"]  # [max_pages]
+    context_len = aux["context_len"]  # scalar: history before this span
+    total_len = aux["total_len"]  # scalar: history + real tokens in span
+    real_mask = aux["real_mask"]  # [t] bool: padding -> scratch writes
+    page_size = kv_k_loc.shape[2]
+    cos, sin = rope_cos_sin(positions, c.head_dim, c.rope_theta)
+    logical = jnp.minimum(positions // page_size, table.shape[0] - 1)
+    phys = jnp.where(valid & real_mask, table[logical], 0)
+    offs = positions % page_size
+    n_local = kv_k_loc.shape[0]
+    for li in range(n_local):
+        layer = jax.tree.map(lambda p: p[li], local_params)
+        h = rms_norm(x, layer["attn_norm"], c.rms_norm_eps)
+        q = jnp.dot(h, layer["wq"], preferred_element_type=jnp.float32).astype(c.dtype)
+        k = jnp.dot(h, layer["wk"], preferred_element_type=jnp.float32).astype(c.dtype)
+        v = jnp.dot(h, layer["wv"], preferred_element_type=jnp.float32).astype(c.dtype)
+        q = q.reshape(-1, c.num_heads, c.head_dim)
+        k = k.reshape(-1, c.num_kv_heads, c.head_dim)
+        v = v.reshape(-1, c.num_kv_heads, c.head_dim)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        kv_k_loc = kv_k_loc.at[li, phys, offs].set(k)
+        kv_v_loc = kv_v_loc.at[li, phys, offs].set(v)
+        attn = prefill_attention(
+            q, k, v, kv_k_loc[li], kv_v_loc[li], positions, table,
+            context_len, total_len,
+        )
+        attn = attn.reshape(-1, c.num_heads * c.head_dim)
+        x = x + jnp.dot(attn, layer["wo"], preferred_element_type=jnp.float32).astype(c.dtype)
+        x = mlp_fn(layer, x, c)
+    return x, (kv_k_loc, kv_v_loc)
+
+
+def prefill_forward_pp(
+    params: Dict[str, Any],
+    config: LlamaConfig,
+    tokens: jax.Array,  # [T] remaining prompt, padded to a multiple of M
+    kv_k: jax.Array,
+    kv_v: jax.Array,
+    page_table: jax.Array,  # [max_pages]
+    context_len: jax.Array,  # scalar: already-cached history length
+    real_len: jax.Array,  # scalar: tokens beyond this are padding
+    mesh,
+    num_microbatches: int = 0,
+    mlp_fn=None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Single-sequence prefill pipelined over ``pp``: the prompt splits into
+    sequential token spans that stream through the layer stages. Returns
+    (logits_of_last_real_token [vocab], kv_k, kv_v)."""
+    from ..parallel.pipeline import pipeline_apply_stateful, stack_stages
+
+    c = config
+    mlp_fn = mlp_fn or _mlp
+    S = mesh.shape["pp"]
+    T = tokens.shape[0]
+    M = num_microbatches or S
+    while T % M:
+        M -= 1
+    t = T // M
+    L = kv_k.shape[0]
+
+    stage_params = stack_stages(params["layers"], S)
+    stage_kv = (
+        kv_k.reshape(S, L // S, *kv_k.shape[1:]),
+        kv_v.reshape(S, L // S, *kv_v.shape[1:]),
+    )
+    positions = context_len + jnp.arange(T, dtype=jnp.int32)
+    x = params["embed"][tokens].reshape(M, t, -1)
+    span_starts = context_len + jnp.arange(M, dtype=jnp.int32) * t
+    span_real = jnp.clip(real_len - jnp.arange(M) * t, 0, t)  # real tokens/span
+    aux_mb = {
+        "positions": positions.reshape(M, t),
+        "table": jnp.broadcast_to(page_table, (M, page_table.shape[0])),
+        "context_len": span_starts,
+        "total_len": span_starts + span_real,
+        "real_mask": (jnp.arange(T).reshape(M, t) < real_len),
+    }
+
+    def stage_fn(local_p, local_s, x, aux, valid):
+        return _stage_layers_prefill(local_p, local_s, x, aux, valid, c, mlp_fn)
+
+    out, (kv_k_s, kv_v_s) = pipeline_apply_stateful(
+        stage_params, stage_kv, x, aux_mb, stage_fn, mesh
+    )
+    kv_k = kv_k_s.reshape(L, *kv_k.shape[1:])
+    kv_v = kv_v_s.reshape(L, *kv_v.shape[1:])
+    flat = out.reshape(T, -1)
+    x = rms_norm(flat, params["final_norm"], c.rms_norm_eps)
+    last = x[jnp.maximum(real_len - 1, 0)]
+    head = params["lm_head"] if params["lm_head"] is not None else params["embed"].T
+    logits = jnp.dot(last, head, preferred_element_type=jnp.float32)
+    return logits, kv_k, kv_v
+
+
 def _write_chunk(kv, layer_idx, vals, positions, page_table, page_size):
     """Scatter chunk KV [T, kv_heads, head_dim] into paged cache at absolute
     positions (page_table maps logical page -> physical page)."""

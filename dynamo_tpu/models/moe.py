@@ -193,6 +193,33 @@ def decode_forward(
     )
 
 
+def decode_forward_pp(params, config, tokens, positions, kv_k, kv_v,
+                      page_tables, seq_lens, mesh, num_microbatches=0):
+    """Pipelined decode step (layers over pp), MoE MLP."""
+    return llama.decode_forward_pp(
+        params, config, tokens, positions, kv_k, kv_v, page_tables, seq_lens,
+        mesh, num_microbatches=num_microbatches, mlp_fn=moe_mlp,
+    )
+
+
+def prefill_forward_pp(params, config, tokens, kv_k, kv_v, page_table,
+                       context_len, real_len, mesh, num_microbatches=0):
+    """Pipelined single-sequence prefill, MoE MLP."""
+    return llama.prefill_forward_pp(
+        params, config, tokens, kv_k, kv_v, page_table, context_len, real_len,
+        mesh, num_microbatches=num_microbatches, mlp_fn=moe_mlp,
+    )
+
+
+def prefill_forward_ring(params, config, tokens, kv_k, kv_v, page_table,
+                         real_len, mesh, axis_name="sp"):
+    """Ring-attention whole-prompt prefill (sequence over sp), MoE MLP."""
+    return llama.prefill_forward_ring(
+        params, config, tokens, kv_k, kv_v, page_table, real_len, mesh,
+        axis_name=axis_name, mlp_fn=moe_mlp,
+    )
+
+
 def decode_forward_local(
     params: Dict[str, Any],
     config: MoeConfig,

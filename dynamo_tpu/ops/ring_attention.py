@@ -100,7 +100,15 @@ def ring_attention(
         raise ValueError(
             f"seq len {q.shape[0]} not divisible by {axis_name}={num_chunks}"
         )
-    spec = P(axis_name, None, None)
+    # co-shard heads over tp when the mesh has a populated tp axis, so the
+    # ring composes with tensor parallelism (q arrives tp-sharded from the
+    # projections; kv heads must split evenly for GQA grouping)
+    head_axis = None
+    if "tp" in mesh.shape and mesh.shape["tp"] > 1:
+        tp = mesh.shape["tp"]
+        if q.shape[1] % tp == 0 and k.shape[1] % tp == 0:
+            head_axis = "tp"
+    spec = P(axis_name, head_axis, None)
     fn = jax.shard_map(
         partial(
             _ring_attention_local,

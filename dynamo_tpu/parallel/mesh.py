@@ -62,19 +62,27 @@ class LlamaShardings:
 
     mesh: Mesh
 
+    @property
+    def _pp(self):
+        """Layer axis: sharded over pp when pipeline stages are configured
+        (parallel/pipeline.py reshapes [L, ...] -> [S, L/S, ...] in-program;
+        a leading-'pp' layout on L is the same placement)."""
+        return "pp" if self.mesh.shape.get("pp", 1) > 1 else None
+
     def param_specs(self) -> dict:
+        pp = self._pp
         return {
             "embed": P(None, "tp"),  # hidden sharded
             "layers": {
-                "attn_norm": P(None),
-                "wq": P(None, None, "tp"),  # [L, H, q_dim/tp]
-                "wk": P(None, None, "tp"),
-                "wv": P(None, None, "tp"),
-                "wo": P(None, "tp", None),  # row-parallel
-                "mlp_norm": P(None),
-                "w_gate": P(None, None, "tp"),
-                "w_up": P(None, None, "tp"),
-                "w_down": P(None, "tp", None),
+                "attn_norm": P(pp),
+                "wq": P(pp, None, "tp"),  # [L, H, q_dim/tp]
+                "wk": P(pp, None, "tp"),
+                "wv": P(pp, None, "tp"),
+                "wo": P(pp, "tp", None),  # row-parallel
+                "mlp_norm": P(pp),
+                "w_gate": P(pp, None, "tp"),
+                "w_up": P(pp, None, "tp"),
+                "w_down": P(pp, "tp", None),
             },
             "final_norm": P(None),
             "lm_head": P(None, "tp"),  # vocab sharded on output
@@ -88,8 +96,9 @@ class LlamaShardings:
         )
 
     def kv_sharding(self) -> NamedSharding:
-        # [layers, pages, page_size, kv_heads, head_dim]: kv heads over tp
-        return NamedSharding(self.mesh, P(None, None, None, "tp", None))
+        # [layers, pages, page_size, kv_heads, head_dim]: kv heads over tp;
+        # layers over pp when pipelining (each stage owns its layers' pool)
+        return NamedSharding(self.mesh, P(self._pp, None, None, "tp", None))
 
     def replicated(self) -> NamedSharding:
         return NamedSharding(self.mesh, P())
@@ -104,17 +113,34 @@ class MoeShardings(LlamaShardings):
 
     def param_specs(self) -> dict:
         specs = super().param_specs()
+        pp = self._pp
         layers = dict(specs["layers"])
         layers.update(
             {
-                "router": P(None, None, None),  # [L, H, E] replicated
-                "w_gate": P(None, "ep", None, "tp"),  # [L, E, H, I/tp]
-                "w_up": P(None, "ep", None, "tp"),
-                "w_down": P(None, "ep", "tp", None),
+                "router": P(pp, None, None),  # [L, H, E]
+                "w_gate": P(pp, "ep", None, "tp"),  # [L, E, H, I/tp]
+                "w_up": P(pp, "ep", None, "tp"),
+                "w_down": P(pp, "ep", "tp", None),
             }
         )
         specs["layers"] = layers
         return specs
+
+
+@dataclass(frozen=True)
+class DpAttentionShardings(MoeShardings):
+    """DeepSeek-style wide-EP serving layout (reference recipe:
+    recipes/deepseek-r1/sglang-wideep/tep16p-dep16d-disagg.yaml
+    `--enable-dp-attention --ep-size 16`): experts are ep-sharded as in
+    MoeShardings, but the KV cache is DATA-parallel over the ep axis — the
+    page pool is sharded over ``ep`` so attention state is partitioned
+    across the expert group instead of replicated on every rank (the KV
+    memory blow-up dp-attention exists to avoid). GSPMD partitions the
+    page gathers/writes across the ep group from this one spec; expert
+    dispatch keeps its all-to-all over the same axis."""
+
+    def kv_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P(self._pp, "ep", None, "tp", None))
 
 
 def shard_params(params: dict, shardings) -> dict:

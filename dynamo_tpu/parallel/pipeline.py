@@ -80,6 +80,100 @@ def _pipeline_local(
     return jax.lax.psum(out_buf * mask, axis_name)
 
 
+def _pipeline_local_stateful(
+    stage_params: Any,  # leaves [1, L/S, ...]
+    stage_state: Any,  # leaves [1, ...] — this device's mutable state (KV)
+    x_mb: jax.Array,  # [M, mb, ...] microbatched hidden states (replicated)
+    aux_mb: Any,  # pytree, leaves [M, ...] — per-microbatch metadata
+    *,
+    stage_fn,
+    num_stages: int,
+    axis_name: str,
+):
+    rank = jax.lax.axis_index(axis_name)
+    local_p = jax.tree.map(lambda p: p[0], stage_params)
+    local_s = jax.tree.map(lambda s: s[0], stage_state)
+    M = x_mb.shape[0]
+    mb_shape = x_mb.shape[1:]
+    ticks = M + num_stages - 1
+    fwd = [(i, i + 1) for i in range(num_stages - 1)]
+
+    def tick(carry, t):
+        recv, out_buf, st = carry
+        feed_idx = jnp.clip(t, 0, M - 1)
+        feed = jax.lax.dynamic_index_in_dim(x_mb, feed_idx, 0, keepdims=False)
+        cur = jnp.where(rank == 0, feed, recv)
+        # at tick t, stage `rank` holds microbatch t - rank (when in range);
+        # out-of-range ticks compute with valid=False so state writes mask
+        # to the scratch page
+        mb_idx = jnp.clip(t - rank, 0, M - 1)
+        aux = jax.tree.map(
+            lambda a: jax.lax.dynamic_index_in_dim(a, mb_idx, 0, keepdims=False),
+            aux_mb,
+        )
+        valid = (t >= rank) & (t - rank <= M - 1)
+        out, st = stage_fn(local_p, st, cur, aux, valid)
+        done_idx = jnp.clip(t - (num_stages - 1), 0, M - 1)
+        take = (rank == num_stages - 1) & (t >= num_stages - 1)
+        slot = jax.lax.dynamic_index_in_dim(out_buf, done_idx, 0, keepdims=False)
+        out_buf = jax.lax.dynamic_update_index_in_dim(
+            out_buf, jnp.where(take, out, slot), done_idx, 0
+        )
+        recv = jax.lax.ppermute(out, axis_name, fwd) if fwd else out
+        return (recv, out_buf, st), None
+
+    recv0 = jnp.zeros(mb_shape, x_mb.dtype)
+    out_buf0 = jnp.zeros((M, *mb_shape), x_mb.dtype)
+    (recv, out_buf, local_s), _ = jax.lax.scan(
+        tick, (recv0, out_buf0, local_s), jnp.arange(ticks)
+    )
+    mask = (rank == num_stages - 1).astype(out_buf.dtype)
+    out = jax.lax.psum(out_buf * mask, axis_name)
+    return out, jax.tree.map(lambda s: s[None], local_s)
+
+
+def pipeline_apply_stateful(
+    stage_params: Any,  # pytree, leaves [S, L/S, ...] (see stack_stages)
+    stage_state: Any,  # pytree, leaves [S, ...] — per-stage KV, sharded pp
+    x_mb: jax.Array,  # [M, mb, ...] microbatched hidden input
+    aux_mb: Any,  # pytree, leaves [M, ...] — per-microbatch metadata (page
+    # table rows, positions, seq lens — replicated)
+    stage_fn: Callable,  # (local_params, local_state, x, aux, valid) ->
+    # (x, local_state)
+    mesh: Mesh,
+    axis_name: str = "pp",
+):
+    """GPipe schedule that also threads PER-STAGE STATE through the ticks —
+    the piece a paged-KV engine needs: each stage owns the KV pool of ITS
+    layers (state sharded over pp), writes it as microbatches stream
+    through, and the updated pool comes back out. Returns
+    (out [M, mb, ...] replicated, new_stage_state [S, ...] pp-sharded).
+
+    The reference only passes PP flags through to engines (SURVEY.md §2.5
+    PP row); this is the native TPU schedule: one XLA while-loop,
+    activations hop stage->stage via ppermute, bubble (S-1)/(M+S-1)."""
+    num_stages = mesh.shape[axis_name]
+    param_specs = jax.tree.map(
+        lambda x: P(axis_name, *([None] * (x.ndim - 1))), stage_params
+    )
+    state_specs = jax.tree.map(
+        lambda x: P(axis_name, *([None] * (x.ndim - 1))), stage_state
+    )
+    fn = jax.shard_map(
+        partial(
+            _pipeline_local_stateful,
+            stage_fn=stage_fn,
+            num_stages=num_stages,
+            axis_name=axis_name,
+        ),
+        mesh=mesh,
+        in_specs=(param_specs, state_specs, P(), P()),
+        out_specs=(P(), state_specs),
+        check_vma=False,
+    )
+    return fn(stage_params, stage_state, x_mb, aux_mb)
+
+
 def pipeline_apply(
     stage_params: Any,  # pytree, leaves [S, L/S, ...] (see stack_stages)
     x_mb: jax.Array,  # [M, mb, ...] microbatched input

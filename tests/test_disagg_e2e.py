@@ -172,6 +172,20 @@ def test_disagg_matches_local_prefill(disagg_cluster):
     _, remote_short = _generate(base, "hi")
     assert remote_short is False
 
+    # conditional-disagg queue guard (disagg_router.rs:230): the decode
+    # worker scrapes the prefill pool's published stats into the router
+    from pathlib import Path
+
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        if "prefill queue watcher active" in Path("/tmp/dis_decode.log").read_text(
+            errors="replace"
+        ):
+            break
+        time.sleep(0.5)
+    else:
+        raise AssertionError("prefill queue watcher never received metrics")
+
 
 def test_disagg_prefill_worker_death_falls_back(disagg_cluster):
     base, disc, common, procs = disagg_cluster

@@ -106,3 +106,33 @@ def test_jax_worker_deterministic_greedy(jax_cluster):
         b = client.post(f"{base}/v1/chat/completions", json=body).json()
     assert a["choices"][0]["message"]["content"] == b["choices"][0]["message"]["content"]
     assert a["usage"]["completion_tokens"] == 8
+
+
+def test_clear_kv_blocks_admin_route(jax_cluster):
+    """POST /clear-kv-blocks flushes every worker's reusable prefix-cache
+    pages (reference service_v2.rs:319-339 admin route)."""
+    base = jax_cluster
+    body = {
+        "model": "tiny-llama",
+        "prompt": list(range(5, 40)),
+        "max_tokens": 4,
+        "temperature": 0.0,
+    }
+    with httpx.Client(timeout=120) as client:
+        r = client.post(f"{base}/v1/completions", json=body)
+        assert r.status_code == 200
+        resp = client.post(f"{base}/clear-kv-blocks")
+        assert resp.status_code == 200
+        cleared = resp.json()["cleared"]["tiny-llama"]
+        assert cleared and all(
+            isinstance(v, int) for v in cleared.values()
+        ), cleared
+        # the finished request's committed pages were reusable -> nonzero
+        assert sum(cleared.values()) > 0
+        # a second flush finds nothing left
+        resp2 = client.post(f"{base}/clear-kv-blocks")
+        assert sum(resp2.json()["cleared"]["tiny-llama"].values()) == 0
+        # serving still works afterwards
+        r2 = client.post(f"{base}/v1/completions", json=body)
+        assert r2.status_code == 200
+        assert r2.json()["choices"][0]["text"] == r.json()["choices"][0]["text"]

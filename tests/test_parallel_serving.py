@@ -1,0 +1,108 @@
+"""Native parallel layouts served THROUGH the product (round-2 verdict #3:
+"dryrun phases replaced by e2e CPU-mesh serving tests"): one real worker
+process per layout on an 8-virtual-CPU-device mesh, real frontend, real
+HTTP requests.
+
+Layouts:
+  * sp=4        — ring-attention prefill for long fresh prompts
+  * pp=2 x tp=2 — layer pipeline (decode + prefill microbatch streaming)
+  * DeepSeek-shaped: tiny-moe, ep=2 x tp=2, --dp-attention (KV pages
+    data-parallel over the expert axis; reference recipe
+    recipes/deepseek-r1/sglang-wideep/tep16p-dep16d-disagg.yaml)
+"""
+
+import time
+
+import httpx
+import pytest
+
+from .utils import ManagedProcess, free_port
+
+WORKER_ENV = {"XLA_FLAGS": "--xla_force_host_platform_device_count=8"}
+
+
+def _launch(worker_extra, model="tiny", name="par"):
+    http_port = free_port()
+    disc = f"tcp://127.0.0.1:{free_port()}"
+    fe = ManagedProcess(
+        ["-m", "dynamo_tpu.frontend", "--http-port", str(http_port),
+         "--embed-discovery", "--discovery", disc],
+        name=f"{name}_fe",
+    ).start(f"/tmp/{name}_fe.log")
+    fe.wait_port(http_port)
+    worker = ManagedProcess(
+        ["-m", "dynamo_tpu.jax_worker", "--model", model,
+         "--model-name", f"{name}-model", "--discovery", disc,
+         "--page-size", "8", "--num-pages", "128", "--max-num-seqs", "4",
+         "--max-model-len", "256", "--context-length", "256",
+         *worker_extra],
+        name=f"{name}_worker", env=WORKER_ENV,
+    ).start(f"/tmp/{name}_worker.log")
+    base = f"http://127.0.0.1:{http_port}"
+    deadline = time.time() + 150
+    with httpx.Client() as client:
+        while time.time() < deadline:
+            if worker.proc.poll() is not None:
+                raise RuntimeError(f"{name} worker died; see /tmp/{name}_worker.log")
+            try:
+                if client.get(f"{base}/v1/models").json()["data"]:
+                    break
+            except Exception:
+                pass
+            time.sleep(0.5)
+        else:
+            raise TimeoutError(f"{name} worker never registered")
+    return base, fe, worker
+
+
+def _serve_and_check(base, model, prompt_tokens, max_tokens=6):
+    body = {
+        "model": model,
+        "prompt": prompt_tokens,
+        "max_tokens": max_tokens,
+        "temperature": 0.0,
+    }
+    with httpx.Client(timeout=240) as client:
+        a = client.post(f"{base}/v1/completions", json=body).json()
+        b = client.post(f"{base}/v1/completions", json=body).json()
+    assert a["usage"]["completion_tokens"] == max_tokens, a
+    # greedy + deterministic (second run rides the prefix cache)
+    assert a["choices"][0]["text"] == b["choices"][0]["text"]
+    return a
+
+
+def test_sp_ring_prefill_serving():
+    """Long fresh prompt rides the ring (threshold 32 < 64-token prompt)."""
+    base, fe, worker = _launch(
+        ["--sp-size", "4", "--ring-prefill-threshold", "32"], name="sp"
+    )
+    try:
+        _serve_and_check(base, "sp-model", list(range(5, 69)))
+        # short prompt takes the batched path on the same engine
+        _serve_and_check(base, "sp-model", list(range(5, 15)))
+    finally:
+        worker.stop()
+        fe.stop()
+
+
+def test_pp_pipeline_serving():
+    base, fe, worker = _launch(["--pp-size", "2", "--tp-size", "2"], name="pp")
+    try:
+        _serve_and_check(base, "pp-model", list(range(5, 45)))
+    finally:
+        worker.stop()
+        fe.stop()
+
+
+def test_deepseek_shaped_dp_attention_serving():
+    """tiny-moe with the wide-EP layout: experts over ep, KV pages
+    data-parallel over ep, attention heads over tp."""
+    base, fe, worker = _launch(
+        ["--ep-size", "2", "--tp-size", "2", "--dp-attention"],
+        model="tiny-moe", name="dpa",
+    )
+    try:
+        _serve_and_check(base, "dpa-model", list(range(5, 40)))
+    finally:
+        worker.stop()
+        fe.stop()

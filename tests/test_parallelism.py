@@ -221,3 +221,90 @@ class TestPipeline:
         np.testing.assert_allclose(
             np.asarray(got), np.asarray(jnp.stack(ref)), atol=1e-4
         )
+
+
+class TestEngineParallelPaths:
+    """The §2.5 strategies wired THROUGH the engine (round-2 verdict #3):
+    greedy output through the sp ring-prefill path and the pp pipelined
+    path must exactly match the plain single-device engine."""
+
+    def _engine_tokens(self, cfg_kw, prompt, n_steps):
+        import asyncio
+
+        from dynamo_tpu.engine import EngineConfig, JaxEngine
+        from dynamo_tpu.llm.protocols import PreprocessedRequest
+        from dynamo_tpu.models import llama
+        from dynamo_tpu.runtime.engine import Context
+
+        mcfg = llama.LlamaConfig.tiny(dtype=jnp.float32)
+
+        async def run():
+            mesh = cfg_kw.pop("mesh", None)
+            kv_sharding = cfg_kw.pop("kv_sharding", None)
+            params = cfg_kw.pop("params", None)
+            cfg = EngineConfig(
+                model="tiny", max_num_seqs=4, page_size=8, num_pages=64,
+                max_model_len=256, prefill_buckets=(16, 32, 64),
+                max_prefill_chunk=64, **cfg_kw,
+            )
+            eng = JaxEngine(
+                cfg, model_config=mcfg, params=params,
+                kv_sharding=kv_sharding, mesh=mesh,
+            )
+            req = PreprocessedRequest(
+                token_ids=prompt, stop_conditions={"max_tokens": n_steps},
+            ).to_dict()
+            toks = []
+            async for item in eng.generate(req, Context()):
+                data = item.get("data")
+                if data:
+                    toks.extend(data["token_ids"])
+            await eng.close()
+            return toks
+
+        import asyncio
+
+        return asyncio.run(run())
+
+    def test_ring_prefill_engine_parity(self):
+        from dynamo_tpu.models import llama
+        from dynamo_tpu.parallel.mesh import LlamaShardings, ParallelConfig, build_mesh, shard_params
+
+        mcfg = llama.LlamaConfig.tiny(dtype=jnp.float32)
+        params = llama.init_params(mcfg, jax.random.PRNGKey(0))
+        prompt = list(range(5, 53))  # 48 tokens >= ring threshold below
+
+        want = self._engine_tokens({"params": params}, prompt, 6)
+
+        mesh = build_mesh(ParallelConfig(sp_size=4))
+        sh = LlamaShardings(mesh)
+        got = self._engine_tokens(
+            {
+                "params": shard_params(params, sh), "mesh": mesh,
+                "kv_sharding": sh.kv_sharding(), "sp_size": 4,
+                "ring_prefill_threshold": 32,
+            },
+            prompt, 6,
+        )
+        assert got == want, f"ring-prefill engine {got} != plain {want}"
+
+    def test_pp_engine_parity(self):
+        from dynamo_tpu.models import llama
+        from dynamo_tpu.parallel.mesh import LlamaShardings, ParallelConfig, build_mesh, shard_params
+
+        mcfg = llama.LlamaConfig.tiny(dtype=jnp.float32)
+        params = llama.init_params(mcfg, jax.random.PRNGKey(0))
+        prompt = list(range(7, 40))  # 33 tokens (pads inside the pipeline)
+
+        want = self._engine_tokens({"params": params}, prompt, 6)
+
+        mesh = build_mesh(ParallelConfig(pp_size=2, tp_size=2))
+        sh = LlamaShardings(mesh)
+        got = self._engine_tokens(
+            {
+                "params": shard_params(params, sh), "mesh": mesh,
+                "kv_sharding": sh.kv_sharding(), "pp_size": 2, "tp_size": 2,
+            },
+            prompt, 6,
+        )
+        assert got == want, f"pp engine {got} != plain {want}"

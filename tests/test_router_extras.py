@@ -214,3 +214,72 @@ class TestStreamPerf:
             assert s["total_tokens"] == 0
 
         asyncio.run(main())
+
+
+class TestReplicaSync:
+    def test_two_routers_mirror_routing_decisions(self):
+        """Two KV-mode frontends with replica_sync share active-block
+        accounting: a decision made by router A appears in router B's
+        scheduler (and its approx indexer), and frees propagate too
+        (reference kv_router/subscriber.rs role)."""
+        from dynamo_tpu.llm.kv_router import KvPushRouter, KvRouterConfig
+
+        class _Comp:
+            namespace = "ns"
+            name = "sync"
+
+        class _Ep:
+            component = _Comp()
+
+        class _FakeClient:
+            endpoint = _Ep()
+
+            def instance_ids(self):
+                return [1, 2]
+
+        async def main():
+            server = DiscoveryServer(port=0)
+            _, port = await server.start()
+            drt_a = await DistributedRuntime.create(_drt_config(port))
+            drt_b = await DistributedRuntime.create(_drt_config(port))
+            cfg = KvRouterConfig(
+                use_kv_events=False, replica_sync=True, block_size=4
+            )
+            ra = KvPushRouter(drt_a, _FakeClient(), cfg, block_size=4)
+            rb = KvPushRouter(drt_b, _FakeClient(), cfg, block_size=4)
+            await ra.start()
+            await rb.start()
+
+            tokens = list(range(16))  # 4 blocks
+            ra.scheduler.add_request("req-1", 1, 4)
+            ra.indexer.process_routing_decision_for_request(tokens, 1)
+            ra._publish_sync(
+                {"op": "route", "request_id": "req-1", "worker": 1,
+                 "blocks": 4, "token_ids": tokens}
+            )
+            for _ in range(100):
+                await asyncio.sleep(0.02)
+                if "req-1" in rb.scheduler._active:
+                    break
+            assert rb.scheduler._active["req-1"].worker_id == 1
+            # approx indexer mirrored the prefix -> same overlap scores
+            assert rb.indexer.find_matches_for_tokens(tokens).scores.get(1)
+
+            ra._publish_sync({"op": "free", "request_id": "req-1"})
+            for _ in range(100):
+                await asyncio.sleep(0.02)
+                if "req-1" not in rb.scheduler._active:
+                    break
+            assert "req-1" not in rb.scheduler._active
+            # A ignores its own sync events: its local state is whatever it
+            # set directly (req-1 still active — B's mirror free and A's own
+            # broadcast free were both skipped as self-echo)
+            assert "req-1" in ra.scheduler._active
+
+            await ra.close()
+            await rb.close()
+            await drt_a.close()
+            await drt_b.close()
+            await server.stop()
+
+        asyncio.run(main())

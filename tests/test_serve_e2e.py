@@ -285,8 +285,8 @@ def test_responses_streaming(cluster):
 def _post_retrying_404(client, url, payload):
     """Under 1-core CPU contention the worker lease can briefly lapse and the
     model de-registers until the keepalive re-grants it (by design); retry
-    through that window."""
-    for _ in range(40):
+    through that window (full-suite runs have starved it past 10s)."""
+    for _ in range(120):
         r = client.post(url, json=payload)
         if r.status_code != 404:
             return r
