@@ -1183,8 +1183,9 @@ class JaxEngine:
         slot.onboard = None
         try:
             # tier reads (host memcpy / disk memmap) run off the event loop,
-            # serialized with offload stores on the same executor
-            k_np, v_np = await self._run_on_device(self.kvbm.load, hashes)
+            # serialized with offload stores on the same executor; remote
+            # (G4/peer) blocks pull over the data plane first
+            k_np, v_np = await self.kvbm.load_async(hashes, self._run_on_device)
         except KeyError as e:
             # block evicted between probe and load: fall back to computing
             # that part of the prompt (pages are already allocated)

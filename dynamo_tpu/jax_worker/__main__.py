@@ -266,6 +266,20 @@ async def main():
         shutdown_holder["shutdown"] = drt.shutdown
     if data_plane is not None:
         await data_plane.register(drt)
+
+    kvbm_dist = None
+    if engine.kvbm is not None and data_plane is not None:
+        # distributed KVBM (reference KvbmLeader/Worker role): announce our
+        # tiered blocks namespace-wide so ANY worker (prefill or decode
+        # pool) can onboard blocks we offloaded, via the data plane
+        from dynamo_tpu.kvbm.distributed import KvbmDistributed
+
+        kvbm_dist = KvbmDistributed(
+            drt, engine.kvbm, data_plane, args.namespace, "kvbm",
+            drt.instance_id,
+        )
+        await kvbm_dist.start()
+        logger.info("distributed KVBM mesh joined (namespace %s)", args.namespace)
     component = args.prefill_component if args.role == "prefill" else args.component
     endpoint = drt.namespace(args.namespace).component(component).endpoint(args.endpoint)
 

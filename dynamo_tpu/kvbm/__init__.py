@@ -16,6 +16,7 @@ eviction never needs a device read-back.
 
 from .storage import DiskTier, HostTier
 from .manager import KvbmConfig, KvBlockManager, KvbmConnector
+from .distributed import KvbmDistributed
 
 __all__ = [
     "DiskTier",
@@ -23,4 +24,5 @@ __all__ = [
     "KvbmConfig",
     "KvBlockManager",
     "KvbmConnector",
+    "KvbmDistributed",
 ]

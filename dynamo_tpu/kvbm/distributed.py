@@ -1,0 +1,209 @@
+"""Distributed KVBM: cross-worker block sharing over the KV data plane.
+
+The reference runs a KVBM leader/worker pair over ZMQ so one worker can
+onboard blocks another worker offloaded (block_manager/distributed/
+leader.rs:126, worker.rs:137) — the disagg-adjacent reuse that makes a
+decode worker's admission hit on a prefill worker's offloaded prefix.
+
+TPU-native redesign (no leader): a symmetric announcement mesh.
+  * every KVBM-enabled worker announces stored/cleared block hashes on a
+    discovery topic (kvbm_blocks/{ns}/{comp}) and serves block reads on
+    its existing KV data plane (llm/kv_transfer.py; the server resolves
+    `{"blocks": [...]}` handshakes straight from the tier manager).
+  * every worker mirrors the announcements into hash -> {instance} plus
+    the peers' data-plane addresses (DATA_PLANE_ROOT entries), so an
+    admission probe extends the local tier prefix with remote hits at
+    in-memory cost.
+  * onboarding pulls the missing blocks point-to-point from ONE owner and
+    write-throughs them into the local host tier (promotion), so repeat
+    hits are local.
+
+The remote-peer pool IS this build's G4 tier (reference CacheLevel G4,
+block_manager.rs:63): same probe/onboard interface as G2/G3, backed by
+another worker's memory instead of object storage.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+KVBM_TOPIC_FMT = "kvbm_blocks/{namespace}/{component}"
+
+
+class KvbmDistributed:
+    """The announcement mesh + remote pull for one worker's KVBM."""
+
+    def __init__(
+        self,
+        drt,
+        connector,  # kvbm.manager.KvbmConnector
+        data_plane,  # llm.kv_transfer.KvDataPlaneServer (serves our blocks)
+        namespace: str,
+        component: str,
+        instance_id: int,
+    ):
+        self.drt = drt
+        self.connector = connector
+        self.manager = connector.manager
+        self.data_plane = data_plane
+        self.topic = KVBM_TOPIC_FMT.format(namespace=namespace, component=component)
+        self.instance_id = instance_id
+        # hash -> instances that announced it; instance -> data plane addr
+        self._owners: Dict[int, Set[int]] = {}
+        self._addrs: Dict[int, str] = {}
+        self._sub = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._task: Optional[asyncio.Task] = None
+        self._addr_task: Optional[asyncio.Task] = None
+        self._bg: set = set()
+        self.remote_onboards = 0
+        self.remote_blocks_pulled = 0
+        # serve our tier blocks on the data plane
+        if data_plane is not None:
+            data_plane.kvbm_source = self.manager
+        connector.distributed = self
+
+    async def start(self):
+        from ..llm.kv_transfer import DATA_PLANE_ROOT
+
+        self._loop = asyncio.get_running_loop()
+        if self.drt.discovery is None:
+            return
+        self._sub = await self.drt.discovery.subscribe(self.topic)
+        self._task = asyncio.create_task(self._mirror_loop())
+        watch = await self.drt.discovery.watch_prefix(DATA_PLANE_ROOT)
+        for item in watch.snapshot:
+            self._on_addr(item["key"], item["value"])
+        self._addr_task = asyncio.create_task(self._addr_loop(watch))
+
+    def _on_addr(self, key: str, raw: Optional[bytes]):
+        import json
+
+        inst = int(key.rsplit("/", 1)[-1], 16)
+        if raw is None:
+            self._addrs.pop(inst, None)
+            for owners in self._owners.values():
+                owners.discard(inst)
+            return
+        try:
+            self._addrs[inst] = json.loads(raw)["addr"]
+        except Exception:  # noqa: BLE001
+            logger.warning("bad data plane advertisement %s", key)
+
+    async def _addr_loop(self, watch):
+        async for event in watch:
+            self._on_addr(event.key, event.value if event.type == "put" else None)
+
+    async def _mirror_loop(self):
+        from ..runtime import codec
+
+        async for payload in self._sub:
+            try:
+                msg = codec.unpack(payload)
+                inst = int(msg["worker"])
+                if inst == self.instance_id:
+                    continue
+                if msg["op"] == "stored":
+                    for h in msg["hashes"]:
+                        self._owners.setdefault(int(h), set()).add(inst)
+                elif msg["op"] == "cleared":
+                    for owners in self._owners.values():
+                        owners.discard(inst)
+            except Exception:  # noqa: BLE001
+                logger.exception("bad kvbm announcement")
+
+    def announce_threadsafe(self, op: str, hashes: Sequence[int]):
+        """Schedule an announcement from any thread (offloads run on the
+        engine's device-exec thread)."""
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self.announce, op, list(hashes))
+
+    def announce(self, op: str, hashes: Sequence[int]):
+        """Fire-and-forget announcement of our tier contents."""
+        from ..runtime import codec
+
+        if self.drt.discovery is None:
+            return
+
+        async def _pub():
+            try:
+                await self.drt.discovery.publish(
+                    self.topic,
+                    codec.pack(
+                        {"worker": self.instance_id, "op": op,
+                         "hashes": [int(h) for h in hashes]}
+                    ),
+                )
+            except Exception:  # noqa: BLE001 — announcements are best-effort
+                logger.debug("kvbm announce failed", exc_info=True)
+
+        t = asyncio.get_running_loop().create_task(_pub())
+        self._bg.add(t)
+        t.add_done_callback(self._bg.discard)
+
+    # -- probe/pull (G4 role) ------------------------------------------- #
+
+    def remote_owner(self, h: int) -> Optional[Tuple[int, str]]:
+        for inst in self._owners.get(int(h), ()):  # first live owner wins
+            addr = self._addrs.get(inst)
+            if addr:
+                return inst, addr
+        return None
+
+    def extend_prefix(self, hashes: Sequence[int]) -> List[int]:
+        """Longest leading run of `hashes` available remotely."""
+        out: List[int] = []
+        for h in hashes:
+            if self.remote_owner(h) is None:
+                break
+            out.append(int(h))
+        return out
+
+    async def pull_blocks(
+        self, hashes: Sequence[int]
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Fetch blocks from peers ([n, *block_shape] stacks), grouping by
+        owner; raises KeyError when any block has no reachable owner."""
+        from ..llm.kv_transfer import pull_kvbm_blocks
+
+        plan: Dict[str, List[int]] = {}
+        for h in hashes:
+            owner = self.remote_owner(h)
+            if owner is None:
+                raise KeyError(f"kvbm block {h} has no remote owner")
+            plan.setdefault(owner[1], []).append(int(h))
+        parts: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+        for addr, hs in plan.items():
+            k, v = await pull_kvbm_blocks(
+                addr, hs, self.manager.block_shape, self.manager.dtype
+            )
+            for i, h in enumerate(hs):
+                parts[h] = (k[i], v[i])
+            self.remote_blocks_pulled += len(hs)
+        self.remote_onboards += 1
+        ks = np.stack([parts[int(h)][0] for h in hashes])
+        vs = np.stack([parts[int(h)][1] for h in hashes])
+        return ks, vs
+
+    def stats(self) -> dict:
+        return {
+            "kvbm_remote_onboards": self.remote_onboards,
+            "kvbm_remote_blocks_pulled": self.remote_blocks_pulled,
+            "kvbm_known_remote_blocks": sum(
+                1 for owners in self._owners.values() if owners
+            ),
+        }
+
+    async def close(self):
+        if self._task:
+            self._task.cancel()
+        if self._addr_task:
+            self._addr_task.cancel()
+        if self._sub:
+            await self._sub.cancel()

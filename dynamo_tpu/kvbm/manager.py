@@ -179,6 +179,9 @@ class KvbmConnector:
         self.manager = manager
         self._pending = 0
         self._pending_lock = threading.Lock()  # bumped on loop, dropped on exec thread
+        # kvbm/distributed.py attaches itself here: cross-worker probe/pull
+        # (the G4 role — peer memory as the tier below disk)
+        self.distributed = None
 
     # -- offload (called on the event loop right after block commit) ----- #
 
@@ -206,6 +209,8 @@ class KvbmConnector:
             v_np = np.asarray(v).swapaxes(0, 1)
             for i, h in enumerate(hashes):
                 self.manager.store(h, k_np[i], v_np[i])
+            if self.distributed is not None:
+                self.distributed.announce_threadsafe("stored", hashes)
 
         with self._pending_lock:
             self._pending += 1
@@ -222,13 +227,61 @@ class KvbmConnector:
     # -- onboard (called at admission) ----------------------------------- #
 
     def probe(self, hashes: Sequence[int]) -> List[int]:
-        return self.manager.match_prefix(hashes)
+        """Longest onboardable prefix: local tiers, extended by remote
+        owners when the distributed mesh is attached (G4 role)."""
+        local = self.manager.match_prefix(hashes)
+        if self.distributed is not None and len(local) < len(hashes):
+            return list(local) + self.distributed.extend_prefix(
+                list(hashes)[len(local):]
+            )
+        return local
 
     def load(self, hashes: Sequence[int]) -> Tuple[np.ndarray, np.ndarray]:
         return self.manager.load_blocks(hashes)
 
+    async def load_async(self, hashes: Sequence[int], run) -> Tuple[np.ndarray, np.ndarray]:
+        """Onboard path: local tier reads ride the engine's device/IO
+        executor (`run`), remote blocks pull point-to-point from their
+        owner's data plane and are PROMOTED into the local host tier so
+        repeat hits stay local. Raises KeyError on any miss (the engine
+        falls back to prefilling that span)."""
+        local = [h for h in hashes if self.manager.has(h)]
+        remote = [h for h in hashes if not self.manager.has(h)]
+        parts: dict = {}
+        if remote:
+            if self.distributed is None:
+                raise KeyError(f"kvbm blocks {remote[:3]}... not tiered here")
+            try:
+                rk, rv = await self.distributed.pull_blocks(remote)
+            except KeyError:
+                raise
+            except Exception as e:  # noqa: BLE001 — dead peer/network: the
+                # engine treats a KeyError as "prefill that span instead"
+                raise KeyError(f"kvbm remote pull failed: {e}") from e
+
+            def promote():
+                for i, h in enumerate(remote):
+                    self.manager.store(h, rk[i], rv[i])
+
+            await run(promote)
+            for i, h in enumerate(remote):
+                parts[h] = (rk[i], rv[i])
+        if local:
+            lk, lv = await run(self.manager.load_blocks, local)
+            for i, h in enumerate(local):
+                parts[h] = (lk[i], lv[i])
+        ks = np.stack([parts[h][0] for h in hashes])
+        vs = np.stack([parts[h][1] for h in hashes])
+        return ks, vs
+
     def clear(self) -> int:
-        return self.manager.clear()
+        n = self.manager.clear()
+        if self.distributed is not None:
+            self.distributed.announce("cleared", [])
+        return n
 
     def stats(self) -> dict:
-        return {**self.manager.stats(), "kvbm_pending_offloads": self._pending}
+        out = {**self.manager.stats(), "kvbm_pending_offloads": self._pending}
+        if self.distributed is not None:
+            out.update(self.distributed.stats())
+        return out

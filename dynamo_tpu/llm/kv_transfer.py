@@ -37,7 +37,7 @@ import secrets
 import struct
 import time
 from dataclasses import dataclass, field
-from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
+from typing import Any, Awaitable, Callable, Dict, Optional, Sequence, Tuple
 
 import msgpack
 import numpy as np
@@ -173,6 +173,10 @@ class KvDataPlaneServer:
         # fallback must not be able to masquerade as a working data plane)
         self.transfers_served = 0
         self.bytes_served = 0
+        # distributed KVBM (kvbm/distributed.py): when set, `{"blocks": [...]}`
+        # handshakes resolve straight from the tier manager — peers onboard
+        # blocks this worker offloaded (reference KvbmLeader/Worker role)
+        self.kvbm_source = None
 
     @property
     def addr(self) -> str:
@@ -330,6 +334,9 @@ class KvDataPlaneServer:
         (unstage_by_id from the leader's unstage_shard broadcast), with the
         TTL/deadline reaper as backstop."""
         req = msgpack.unpackb(body, raw=False)
+        if req.get("blocks") is not None:
+            await self._serve_kvbm_blocks(req, writer)
+            return
         transfer_id = req.get("tid", "")
         staged = self._staged.get(transfer_id)
         if staged is None:
@@ -359,6 +366,36 @@ class KvDataPlaneServer:
         writer.write(vb)
         await asyncio.wait_for(writer.drain(), self.chunk_timeout)
         staged.count_serve(len(kb) + len(vb))
+
+    async def _serve_kvbm_blocks(self, req: dict, writer: asyncio.StreamWriter):
+        """Serve tiered KV blocks by hash (distributed KVBM onboard path,
+        kvbm/distributed.py). One request -> one stacked (k, v) frame."""
+        if self.kvbm_source is None:
+            await self._send_header(writer, {"error": "no kvbm tier here"})
+            return
+        hashes = [int(h) for h in req["blocks"]]
+        if not hashes or len(hashes) > 4096:
+            await self._send_header(writer, {"error": f"bad block count {len(hashes)}"})
+            return
+        try:
+            # tier reads do host memcpy/disk IO: off the event loop
+            k, v = await asyncio.get_running_loop().run_in_executor(
+                None, self.kvbm_source.load_blocks, hashes
+            )
+        except KeyError as e:
+            await self._send_header(writer, {"error": f"block miss: {e}"})
+            return
+        kb, vb = _np_bytes(k), _np_bytes(v)
+        await self._send_header(
+            writer,
+            {"n": len(hashes), "k_bytes": len(kb), "v_bytes": len(vb),
+             "shape": list(k.shape), "dtype": str(k.dtype)},
+        )
+        writer.write(kb)
+        writer.write(vb)
+        await asyncio.wait_for(writer.drain(), self.chunk_timeout)
+        self.transfers_served += 1
+        self.bytes_served += len(kb) + len(vb)
 
     async def _send_header(self, writer, header: dict):
         body = msgpack.packb(header, use_bin_type=True)
@@ -461,6 +498,51 @@ async def pull_kv_range(
         chunk_shape = (shape[0], n, *shape[1:])
         k = np.frombuffer(k_raw, dtype=np_dtype).reshape(chunk_shape)
         v = np.frombuffer(v_raw, dtype=np_dtype).reshape(chunk_shape)
+        return k, v
+    finally:
+        writer.close()
+
+
+async def pull_kvbm_blocks(
+    addr: str,
+    hashes: Sequence[int],
+    block_shape: tuple,
+    dtype,
+    connect_timeout: float = 10.0,
+    chunk_timeout: float = 30.0,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Fetch tiered KV blocks by hash from a peer worker's data plane
+    (distributed KVBM onboard; reference block_manager/distributed/
+    worker.rs:137). Returns (k, v) stacked [n, *block_shape]."""
+    host, port = addr.rsplit(":", 1)
+    reader, writer = await asyncio.wait_for(
+        asyncio.open_connection(host, int(port)), connect_timeout
+    )
+    try:
+        body = msgpack.packb(
+            {"blocks": [int(h) for h in hashes]}, use_bin_type=True
+        )
+        writer.write(_HDR.pack(_MAGIC_RANGE, len(body)) + body)
+        await writer.drain()
+        np_dtype = np.dtype(dtype)
+        expect = int(np.prod(block_shape)) * np_dtype.itemsize * len(hashes)
+        hdr = await asyncio.wait_for(reader.readexactly(_HDR.size), chunk_timeout)
+        magic, length = _HDR.unpack(hdr)
+        if magic != _MAGIC or length > 65536:
+            raise RuntimeError(f"bad kvbm frame (magic {magic:#x})")
+        header = msgpack.unpackb(
+            await asyncio.wait_for(reader.readexactly(length), chunk_timeout),
+            raw=False,
+        )
+        if header.get("error"):
+            raise KeyError(f"kvbm pull refused: {header['error']}")
+        if header["k_bytes"] > expect or header["v_bytes"] > expect:
+            raise RuntimeError("kvbm frame larger than expected")
+        k_raw = await asyncio.wait_for(reader.readexactly(header["k_bytes"]), chunk_timeout)
+        v_raw = await asyncio.wait_for(reader.readexactly(header["v_bytes"]), chunk_timeout)
+        shape = (len(hashes), *block_shape)
+        k = np.frombuffer(k_raw, dtype=np_dtype).reshape(shape)
+        v = np.frombuffer(v_raw, dtype=np_dtype).reshape(shape)
         return k, v
     finally:
         writer.close()
