@@ -26,6 +26,9 @@ def parse_args():
     ap = argparse.ArgumentParser(description="dynamo-tpu OpenAI frontend")
     ap.add_argument("--http-host", default="0.0.0.0")
     ap.add_argument("--http-port", type=int, default=8000)
+    ap.add_argument("--grpc-port", type=int, default=0,
+                    help="KServe gRPC port (0 = disabled; reference "
+                    "lib/llm/src/grpc/service/kserve.rs)")
     ap.add_argument(
         "--router-mode",
         choices=["round-robin", "random", "kv"],
@@ -73,6 +76,14 @@ async def main():
 
     service = HttpService(manager, host=args.http_host, port=args.http_port)
     await service.start()
+    grpc_service = None
+    if args.grpc_port:
+        from dynamo_tpu.llm.grpc import KserveGrpcService
+
+        grpc_service = KserveGrpcService(
+            manager, host=args.http_host, port=args.grpc_port
+        )
+        await grpc_service.start()
     logger.info("frontend ready on :%d (router=%s)", service.port, router_mode.value)
     await drt.wait_for_shutdown()
 

@@ -1,0 +1,31 @@
+"""KServe gRPC frontend (reference lib/llm/src/grpc/service/kserve.rs:33).
+
+`kserve_pb2.py` is generated from kserve.proto by plain `protoc
+--python_out` (the image has no grpc_tools plugin); service stubs are not
+needed — service.py registers the RPC methods through grpc.aio generic
+handlers. If the generated file is missing, import regenerates it.
+"""
+
+from __future__ import annotations
+
+
+def _ensure_pb2():
+    try:
+        from . import kserve_pb2  # noqa: F401
+    except ImportError:
+        import pathlib
+        import subprocess
+
+        here = pathlib.Path(__file__).parent
+        subprocess.run(
+            ["protoc", "--python_out=.", "kserve.proto"],
+            cwd=str(here), check=True,
+        )
+
+
+_ensure_pb2()
+
+from . import kserve_pb2  # noqa: E402,F401
+from .service import KserveGrpcService  # noqa: E402,F401
+
+__all__ = ["KserveGrpcService", "kserve_pb2"]

@@ -1,0 +1,267 @@
+"""KServe gRPC frontend: the L5 tensor-protocol surface.
+
+Mirrors the reference KServe service (lib/llm/src/grpc/service/kserve.rs:33,
+protos lib/llm/src/grpc/protos/kserve.proto): ServerLive/ServerReady/
+ServerMetadata/ModelReady/ModelMetadata plus ModelInfer (unary) and
+ModelStreamInfer (decoupled streaming) over the Open Inference Protocol v2.
+
+LLM tensor mapping (Triton-style): input "text_input" (BYTES, [1]) with
+request parameters max_tokens / temperature / ignore_eos; output
+"text_output" (BYTES) plus completion token counts in response parameters.
+Requests flow through the SAME ModelPipeline chain as the HTTP frontend
+(preprocessor -> backend -> migration -> router), so routing, migration and
+metrics behave identically across protocols.
+
+No generated service stubs (the image lacks the protoc gRPC plugin):
+methods register through grpc.aio generic handlers, which is wire-identical.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+import grpc
+
+from ...runtime.engine import Context
+from ..protocols import CompletionRequest
+from . import kserve_pb2 as pb
+
+logger = logging.getLogger(__name__)
+
+SERVICE = "inference.GRPCInferenceService"
+
+
+def _param(p: "pb.InferParameter"):
+    which = p.WhichOneof("parameter_choice")
+    return getattr(p, which) if which else None
+
+
+def _get_text_input(req: "pb.ModelInferRequest") -> str:
+    for i, t in enumerate(req.inputs):
+        if t.name == "text_input":
+            if t.contents.bytes_contents:
+                return t.contents.bytes_contents[0].decode("utf-8", "replace")
+            if req.raw_input_contents and i < len(req.raw_input_contents):
+                raw = req.raw_input_contents[i]
+                # raw BYTES tensors are length-prefixed (little-endian u32)
+                if len(raw) >= 4:
+                    n = int.from_bytes(raw[:4], "little")
+                    return raw[4 : 4 + n].decode("utf-8", "replace")
+    raise ValueError("missing BYTES input tensor 'text_input'")
+
+
+class KserveGrpcService:
+    """The gRPC frontend server; runs beside the HTTP service on the same
+    ModelManager."""
+
+    def __init__(self, manager, host: str = "0.0.0.0", port: int = 8001):
+        self.manager = manager
+        self.host, self.port = host, port
+        self._server: Optional[grpc.aio.Server] = None
+
+    # -- unary handlers -------------------------------------------------- #
+
+    async def _server_live(self, request, context) -> "pb.ServerLiveResponse":
+        return pb.ServerLiveResponse(live=True)
+
+    async def _server_ready(self, request, context) -> "pb.ServerReadyResponse":
+        return pb.ServerReadyResponse(ready=bool(self.manager.names()))
+
+    async def _server_metadata(self, request, context):
+        return pb.ServerMetadataResponse(
+            name="dynamo-tpu", version="0", extensions=["model_repository"]
+        )
+
+    async def _model_ready(self, request, context) -> "pb.ModelReadyResponse":
+        return pb.ModelReadyResponse(
+            ready=self.manager.get(request.name) is not None
+        )
+
+    async def _model_metadata(self, request, context):
+        pipeline = self.manager.get(request.name)
+        if pipeline is None:
+            await context.abort(
+                grpc.StatusCode.NOT_FOUND, f"model {request.name!r} not found"
+            )
+        return pb.ModelMetadataResponse(
+            name=request.name,
+            versions=["1"],
+            platform="dynamo-tpu",
+            inputs=[
+                pb.ModelMetadataResponse.TensorMetadata(
+                    name="text_input", datatype="BYTES", shape=[1]
+                )
+            ],
+            outputs=[
+                pb.ModelMetadataResponse.TensorMetadata(
+                    name="text_output", datatype="BYTES", shape=[1]
+                )
+            ],
+        )
+
+    # -- inference ------------------------------------------------------- #
+
+    def _to_completion(self, req: "pb.ModelInferRequest") -> CompletionRequest:
+        params = {k: _param(v) for k, v in req.parameters.items()}
+        return CompletionRequest(
+            model=req.model_name,
+            prompt=_get_text_input(req),
+            max_tokens=int(params.get("max_tokens") or 16),
+            temperature=float(params.get("temperature") or 0.0),
+            stream=False,
+        )
+
+    async def _run(self, req: "pb.ModelInferRequest", context, on_delta=None):
+        pipeline = self.manager.get(req.model_name)
+        if pipeline is None:
+            await context.abort(
+                grpc.StatusCode.NOT_FOUND, f"model {req.model_name!r} not found"
+            )
+        creq = self._to_completion(req)
+        ctx = Context()
+        pre = pipeline.preprocessor.preprocess_completion(creq)
+        texts, n_out, finish = [], 0, "stop"
+        try:
+            async for ann in pipeline.generate_preprocessed(pre, ctx):
+                if ann.is_error():
+                    await context.abort(
+                        grpc.StatusCode.INTERNAL,
+                        (ann.comment or ["engine error"])[0],
+                    )
+                if ann.event is not None:
+                    continue
+                out = ann.data
+                n_out += len(out.token_ids or [])
+                if out.text:
+                    texts.append(out.text)
+                    if on_delta is not None:
+                        await on_delta(out.text, n_out, None)
+                if out.finish_reason:
+                    finish = "stop" if out.finish_reason == "eos" else out.finish_reason
+                    break
+        finally:
+            ctx.stop_generating()
+        return "".join(texts), n_out, len(pre.token_ids), finish
+
+    @staticmethod
+    def _infer_response(
+        req, text: str, n_out: int, n_in: int, finish: str, final: bool = True
+    ) -> "pb.ModelInferResponse":
+        resp = pb.ModelInferResponse(
+            model_name=req.model_name, model_version="1", id=req.id
+        )
+        t = resp.outputs.add()
+        t.name = "text_output"
+        t.datatype = "BYTES"
+        t.shape.append(1)
+        t.contents.bytes_contents.append(text.encode())
+        resp.parameters["completion_tokens"].int64_param = n_out
+        resp.parameters["prompt_tokens"].int64_param = n_in
+        resp.parameters["finish_reason"].string_param = finish
+        resp.parameters["final"].bool_param = final
+        return resp
+
+    async def _model_infer(self, request, context) -> "pb.ModelInferResponse":
+        try:
+            text, n_out, n_in, finish = await self._run(request, context)
+        except ValueError as e:
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        return self._infer_response(request, text, n_out, n_in, finish)
+
+    async def _model_stream_infer(self, request_iterator, context):
+        """Decoupled streaming: every request on the stream produces a
+        sequence of delta responses ending with final=true (the shape the
+        reference's OpenAI-over-gRPC streaming takes)."""
+        async for req in request_iterator:
+            q: asyncio.Queue = asyncio.Queue()
+
+            async def on_delta(text, n_out, _q=q, _req=req):
+                _q.put_nowait(
+                    pb.ModelStreamInferResponse(
+                        infer_response=self._infer_response(
+                            _req, text, n_out, 0, "", final=False
+                        )
+                    )
+                )
+
+            async def run(_req=req, _q=q):
+                try:
+                    text, n_out, n_in, finish = await self._run(
+                        _req, context, on_delta=lambda t, n, f: on_delta(t, n)
+                    )
+                    _q.put_nowait(
+                        pb.ModelStreamInferResponse(
+                            infer_response=self._infer_response(
+                                _req, "", n_out, n_in, finish, final=True
+                            )
+                        )
+                    )
+                except Exception as e:  # noqa: BLE001 — surfaced on-stream
+                    _q.put_nowait(pb.ModelStreamInferResponse(error_message=str(e)))
+                _q.put_nowait(None)
+
+            task = asyncio.create_task(run())
+            try:
+                while True:
+                    item = await q.get()
+                    if item is None:
+                        break
+                    yield item
+            finally:
+                task.cancel()
+
+    # -- server lifecycle ------------------------------------------------ #
+
+    def _handlers(self):
+        rpcs = {
+            "ServerLive": grpc.unary_unary_rpc_method_handler(
+                self._server_live,
+                request_deserializer=pb.ServerLiveRequest.FromString,
+                response_serializer=pb.ServerLiveResponse.SerializeToString,
+            ),
+            "ServerReady": grpc.unary_unary_rpc_method_handler(
+                self._server_ready,
+                request_deserializer=pb.ServerReadyRequest.FromString,
+                response_serializer=pb.ServerReadyResponse.SerializeToString,
+            ),
+            "ServerMetadata": grpc.unary_unary_rpc_method_handler(
+                self._server_metadata,
+                request_deserializer=pb.ServerMetadataRequest.FromString,
+                response_serializer=pb.ServerMetadataResponse.SerializeToString,
+            ),
+            "ModelReady": grpc.unary_unary_rpc_method_handler(
+                self._model_ready,
+                request_deserializer=pb.ModelReadyRequest.FromString,
+                response_serializer=pb.ModelReadyResponse.SerializeToString,
+            ),
+            "ModelMetadata": grpc.unary_unary_rpc_method_handler(
+                self._model_metadata,
+                request_deserializer=pb.ModelMetadataRequest.FromString,
+                response_serializer=pb.ModelMetadataResponse.SerializeToString,
+            ),
+            "ModelInfer": grpc.unary_unary_rpc_method_handler(
+                self._model_infer,
+                request_deserializer=pb.ModelInferRequest.FromString,
+                response_serializer=pb.ModelInferResponse.SerializeToString,
+            ),
+            "ModelStreamInfer": grpc.stream_stream_rpc_method_handler(
+                self._model_stream_infer,
+                request_deserializer=pb.ModelInferRequest.FromString,
+                response_serializer=pb.ModelStreamInferResponse.SerializeToString,
+            ),
+        }
+        return grpc.method_handlers_generic_handler(SERVICE, rpcs)
+
+    async def start(self) -> int:
+        self._server = grpc.aio.server()
+        self._server.add_generic_rpc_handlers((self._handlers(),))
+        self.port = self._server.add_insecure_port(f"{self.host}:{self.port}")
+        await self._server.start()
+        logger.info("KServe gRPC service listening on %s:%d", self.host, self.port)
+        return self.port
+
+    async def stop(self):
+        if self._server is not None:
+            await self._server.stop(grace=1.0)
