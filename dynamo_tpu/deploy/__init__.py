@@ -1,0 +1,2 @@
+"""Deploy layer (reference deploy/cloud/operator, helm, recipes/):
+operator-lite reconciler + k8s manifests + per-config recipes."""

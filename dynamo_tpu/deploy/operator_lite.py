@@ -1,0 +1,164 @@
+"""Operator-lite: the reconciler that makes planner decisions real.
+
+The reference ships an 18k-LoC Go operator whose controller reconciles
+DynamoGraphDeployment CRDs (deploy/cloud/operator/internal/controller/
+dynamocomponentdeployment_controller.go); the SLA planner patches the CRD
+and the controller scales worker Deployments. The TPU-build equivalent is
+deliberately small and CRD-free:
+
+  * the planner publishes {num_prefill_workers, num_decode_workers,
+    revision} to the discovery KV (planner/connector.py VirtualConnector,
+    key v1/planner/decision);
+  * THIS process watches that key and reconciles the actual replica
+    counts through a backend:
+      - kubectl: `kubectl scale deployment/<name> --replicas=N`
+        against the manifests in deploy/k8s/ (TPU slice pods);
+      - local:   worker subprocesses on this host
+        (planner/connector.py LocalProcessConnector — the e2e/test
+        orchestrator).
+
+Run: python -m dynamo_tpu.deploy.operator_lite --backend kubectl \
+        --prefill-deployment dynamo-prefill --decode-deployment dynamo-decode
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+from typing import Optional, Sequence
+
+from dynamo_tpu.planner.connector import PLANNER_DECISION_KEY
+
+logger = logging.getLogger("dynamo_tpu.operator_lite")
+
+
+class KubectlScaler:
+    """Scale k8s Deployments via kubectl (no python k8s client in the
+    image; kubectl is the stable, auditable interface)."""
+
+    def __init__(self, prefill_deployment: str, decode_deployment: str,
+                 namespace: str = "default", kubectl: str = "kubectl"):
+        self.prefill_deployment = prefill_deployment
+        self.decode_deployment = decode_deployment
+        self.namespace = namespace
+        self.kubectl = kubectl
+
+    async def _scale(self, deployment: str, replicas: int) -> None:
+        cmd = [
+            self.kubectl, "-n", self.namespace, "scale",
+            f"deployment/{deployment}", f"--replicas={replicas}",
+        ]
+        proc = await asyncio.create_subprocess_exec(
+            *cmd,
+            stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.PIPE,
+        )
+        out, err = await proc.communicate()
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"kubectl scale failed rc={proc.returncode}: {err.decode()!r}"
+            )
+        logger.info("scaled %s to %d: %s", deployment, replicas,
+                    out.decode().strip())
+
+    async def set_replicas(self, prefill: int, decode: int) -> None:
+        await self._scale(self.prefill_deployment, prefill)
+        await self._scale(self.decode_deployment, decode)
+
+
+class OperatorLite:
+    """Watch the planner's published decision; reconcile through a scaler
+    (KubectlScaler or planner.connector.LocalProcessConnector)."""
+
+    def __init__(self, discovery_client, scaler, poll_s: float = 2.0):
+        self.client = discovery_client
+        self.scaler = scaler
+        self.poll_s = poll_s
+        self.applied_revision: Optional[int] = None
+        self.reconciles = 0
+        self._stop = asyncio.Event()
+
+    async def reconcile_once(self) -> bool:
+        """Apply the latest decision if its revision is new; returns True
+        when a scale was performed."""
+        raw = await self.client.get(PLANNER_DECISION_KEY)
+        if not raw:
+            return False
+        try:
+            doc = json.loads(raw)
+            rev = int(doc["revision"])
+            prefill = int(doc["num_prefill_workers"])
+            decode = int(doc["num_decode_workers"])
+        except (KeyError, ValueError, TypeError, json.JSONDecodeError):
+            logger.warning("malformed planner decision: %r", raw[:200])
+            return False
+        if self.applied_revision is not None and rev <= self.applied_revision:
+            return False
+        await self.scaler.set_replicas(prefill, decode)
+        self.applied_revision = rev
+        self.reconciles += 1
+        logger.info("reconciled rev=%d -> prefill=%d decode=%d",
+                    rev, prefill, decode)
+        return True
+
+    async def run(self) -> None:
+        logger.info("operator-lite watching %s", PLANNER_DECISION_KEY)
+        while not self._stop.is_set():
+            try:
+                await self.reconcile_once()
+            except Exception:  # noqa: BLE001 — a bad scale must not kill the loop
+                logger.exception("reconcile failed; retrying")
+            try:
+                await asyncio.wait_for(self._stop.wait(), self.poll_s)
+            except asyncio.TimeoutError:
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+def _build_local_scaler(args) -> "object":
+    from dynamo_tpu.planner.connector import LocalProcessConnector
+
+    base = [
+        "python", "-m", "dynamo_tpu.jax_worker", "--model", args.model,
+        "--discovery", args.discovery or "",
+    ]
+    return LocalProcessConnector(
+        prefill_cmd=base + ["--role", "prefill"],
+        decode_cmd=base + ["--role", "decode"],
+    )
+
+
+async def main(argv: Optional[Sequence[str]] = None) -> None:
+    from dynamo_tpu.runtime import DistributedRuntime, RuntimeConfig, init_logging
+
+    init_logging()
+    ap = argparse.ArgumentParser(description="dynamo-tpu operator-lite")
+    ap.add_argument("--backend", choices=["kubectl", "local"], default="kubectl")
+    ap.add_argument("--discovery", default=None)
+    ap.add_argument("--namespace", default="default", help="k8s namespace")
+    ap.add_argument("--prefill-deployment", default="dynamo-prefill")
+    ap.add_argument("--decode-deployment", default="dynamo-decode")
+    ap.add_argument("--model", default="llama3-8b", help="local backend model")
+    ap.add_argument("--poll-s", type=float, default=2.0)
+    args = ap.parse_args(argv)
+
+    cfg = RuntimeConfig.from_settings()
+    if args.discovery:
+        cfg.discovery_endpoint = args.discovery
+    drt = await DistributedRuntime.create(cfg)
+    if args.backend == "kubectl":
+        scaler = KubectlScaler(
+            args.prefill_deployment, args.decode_deployment, args.namespace
+        )
+    else:
+        scaler = _build_local_scaler(args)
+    op = OperatorLite(drt.discovery, scaler, poll_s=args.poll_s)
+    await op.run()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())
