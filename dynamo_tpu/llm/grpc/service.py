@@ -121,7 +121,7 @@ class KserveGrpcService:
             )
         creq = self._to_completion(req)
         ctx = Context()
-        pre = pipeline.preprocessor.preprocess_completion(creq)
+        pre = await pipeline.preprocessor.preprocess_completion_async(creq)
         texts, n_out, finish = [], 0, "stop"
         try:
             async for ann in pipeline.generate_preprocessed(pre, ctx):

@@ -283,7 +283,7 @@ class HttpService:
         self.metrics.request_start(model, "responses")
         ctx = Context()
         try:
-            pre = pipeline.preprocessor.preprocess_chat(chat_req)
+            pre = await pipeline.preprocessor.preprocess_chat_async(chat_req)
         except ValueError as e:
             self.metrics.request_end(model, "responses", t0, error=True)
             return self._error(400, str(e))
@@ -413,7 +413,7 @@ class HttpService:
         self.metrics.request_start(req.model, "chat")
         ctx = Context()
         try:
-            pre = pipeline.preprocessor.preprocess_chat(req)
+            pre = await pipeline.preprocessor.preprocess_chat_async(req)
         except ValueError as e:
             self.metrics.request_end(req.model, "chat", t0, error=True)
             return self._error(400, str(e))
@@ -595,7 +595,7 @@ class HttpService:
         self.metrics.request_start(req.model, "completions")
         ctx = Context()
         try:
-            pre = pipeline.preprocessor.preprocess_completion(req)
+            pre = await pipeline.preprocessor.preprocess_completion_async(req)
         except ValueError as e:
             self.metrics.request_end(req.model, "completions", t0, error=True)
             return self._error(400, str(e))

@@ -96,6 +96,16 @@ class OpenAIPreprocessor:
         token_ids = self.tokenizer.encode(prompt)
         return self._build_common(request, token_ids)
 
+    async def preprocess_chat_async(
+        self, request: ChatCompletionRequest
+    ) -> PreprocessedRequest:
+        """Template render + tokenize on the compute pool (reference rayon
+        offload, lib/runtime/src/compute/pool.rs): a long-prompt flood must
+        not stall the frontend's event loop."""
+        from ..runtime.compute import ComputePool
+
+        return await ComputePool.get().run(self.preprocess_chat, request)
+
     def preprocess_completion(self, request: CompletionRequest) -> PreprocessedRequest:
         prompt = request.prompt
         if isinstance(prompt, str):
@@ -105,6 +115,15 @@ class OpenAIPreprocessor:
         else:
             raise ValueError("batch prompts must be fanned out before preprocessing")
         return self._build_common(request, token_ids)
+
+    async def preprocess_completion_async(
+        self, request: CompletionRequest
+    ) -> PreprocessedRequest:
+        if not isinstance(request.prompt, str):
+            return self.preprocess_completion(request)  # pre-tokenized: cheap
+        from ..runtime.compute import ComputePool
+
+        return await ComputePool.get().run(self.preprocess_completion, request)
 
     def _build_common(self, request, token_ids: List[int]) -> PreprocessedRequest:
         """Apply sampling defaults + stop conditions (reference

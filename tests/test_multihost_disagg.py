@@ -79,7 +79,8 @@ def mh_disagg_cluster():
         procs.append(p)
 
     base = f"http://127.0.0.1:{http_port}"
-    deadline = time.time() + 240  # 4 jax processes + 2 gloo worlds on 1 core
+    deadline = time.time() + 420  # 4 jax processes + 2 gloo worlds on ONE
+    # core — under full-suite contention startup has exceeded 240s
     with httpx.Client() as client:
         while time.time() < deadline:
             for p in procs[1:]:
