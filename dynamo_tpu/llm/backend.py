@@ -12,6 +12,7 @@ import logging
 from typing import Any, AsyncIterator, Dict, List, Optional
 
 from ..runtime.engine import AsyncEngine, Context
+from ..runtime.pipeline import Operator
 from .protocols import Annotated, LLMEngineOutput, PreprocessedRequest
 from .tokenizers import Tokenizer
 
@@ -58,20 +59,31 @@ class Decoder:
         return (emit or None), False
 
 
-class Backend:
-    """Wrap a downstream engine (router hop) with detokenization
-    (reference Backend.fwd/bwd backend.rs:55)."""
+class Backend(Operator):
+    """Detokenization operator (reference Backend.fwd/bwd backend.rs:55):
+    forward passes the request through untouched; backward turns the token
+    stream into text deltas and enforces stop strings. Usable either as a
+    node in runtime.pipeline.compose() or as a classic engine wrapper
+    (`inner` given)."""
 
-    def __init__(self, inner: AsyncEngine, tokenizer: Tokenizer):
+    def __init__(self, inner: Optional[AsyncEngine] = None,
+                 tokenizer: Optional[Tokenizer] = None):
         self.inner = inner
         self.tokenizer = tokenizer
 
     async def generate(
         self, request: PreprocessedRequest, context: Context
     ) -> AsyncIterator[Annotated]:
+        async for item in self.backward(
+            self.inner.generate(request, context), request, context
+        ):
+            yield item
+
+    async def backward(
+        self, stream, request: PreprocessedRequest, context: Context
+    ) -> AsyncIterator[Annotated]:
         stop_strings = request.stop_conditions.get("stop") or []
         decoder = Decoder(self.tokenizer, stop_strings)
-        stream = self.inner.generate(request, context)
         stopped = False
         async for item in stream:
             ann = item if isinstance(item, Annotated) else Annotated.from_dict(item)

@@ -1,0 +1,6 @@
+"""In-process CPU engines (reference lib/engines/{llamacpp,mistralrs}:
+engines linked into the launcher process for CPU smoke serving)."""
+
+from .hf_cpu import HfCpuEngine
+
+__all__ = ["HfCpuEngine"]

@@ -1,0 +1,103 @@
+"""In-process CPU engine on torch/transformers.
+
+The role of the reference's in-process Rust engines (llamacpp
+lib/engines/llamacpp/src/lib.rs, mistralrs lib/engines/mistralrs): a real
+token-generating engine linked into the launcher process for CPU smoke
+serving and latency-path testing — no TPU, no subprocess, no fake timing
+(the mocker's job). Runs a Hugging Face causal LM on CPU:
+
+  * `model_path` given: `from_pretrained(..., local_files_only=True)` — a
+    real local checkpoint (zero-egress environments load what's on disk);
+  * otherwise: a tiny random-init LlamaForCausalLM built `from_config`,
+    paired with the byte tokenizer — deterministic greedy output with no
+    assets at all.
+
+Implements the MockEngine-compatible `generate(request, context)`
+interface (token-ids in, per-step token dicts out), so it slots behind the
+same preprocessor/backend pipeline as every other engine. The blocking
+torch forward runs on the compute pool so the serving loop stays live.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, AsyncIterator, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class HfCpuEngine:
+    """Greedy/temperature incremental decoding with a KV cache, on CPU."""
+
+    def __init__(self, model_path: Optional[str] = None, vocab_size: int = 512):
+        import torch
+        from transformers import LlamaConfig, LlamaForCausalLM
+
+        torch.manual_seed(0)
+        self.torch = torch
+        if model_path:
+            from transformers import AutoModelForCausalLM
+
+            self.model = AutoModelForCausalLM.from_pretrained(
+                model_path, local_files_only=True, torch_dtype=torch.float32
+            )
+        else:
+            cfg = LlamaConfig(
+                vocab_size=vocab_size, hidden_size=64, intermediate_size=128,
+                num_hidden_layers=2, num_attention_heads=4,
+                num_key_value_heads=2, max_position_embeddings=4096,
+            )
+            self.model = LlamaForCausalLM(cfg)
+        self.model.eval()
+        self.eos_ids = set(
+            self.model.config.eos_token_id
+            if isinstance(self.model.config.eos_token_id, list)
+            else [self.model.config.eos_token_id or -1]
+        )
+
+    def _step(self, input_ids, past, temperature: float):
+        """One forward + sample (blocking; runs on the compute pool)."""
+        torch = self.torch
+        with torch.no_grad():
+            out = self.model(
+                input_ids=input_ids, past_key_values=past, use_cache=True
+            )
+            logits = out.logits[0, -1]
+            if temperature and temperature > 0:
+                probs = torch.softmax(logits / temperature, dim=-1)
+                tok = int(torch.multinomial(probs, 1))
+            else:
+                tok = int(torch.argmax(logits))
+            return tok, out.past_key_values
+
+    async def generate(self, request: Any, context) -> AsyncIterator[dict]:
+        from ...runtime.compute import ComputePool
+
+        req = request if isinstance(request, dict) else request.to_dict()
+        token_ids = list(req.get("token_ids") or [])
+        stop = req.get("stop_conditions") or {}
+        sampling = req.get("sampling_options") or {}
+        max_tokens = int(stop.get("max_tokens") or 64)
+        ignore_eos = bool(stop.get("ignore_eos"))
+        temperature = float(sampling.get("temperature") or 0.0)
+        eos = self.eos_ids | set(req.get("eos_token_ids") or [])
+
+        torch = self.torch
+        pool = ComputePool.get()
+        ids = torch.tensor([token_ids], dtype=torch.long)
+        past = None
+        for i in range(max_tokens):
+            if context is not None and context.is_stopped():
+                return
+            tok, past = await pool.run(self._step, ids, past, temperature)
+            finished = (not ignore_eos and tok in eos) or i == max_tokens - 1
+            yield {
+                "data": {
+                    "token_ids": [tok],
+                    **({"finish_reason": "stop" if tok in eos else "length"}
+                       if finished else {}),
+                }
+            }
+            if finished:
+                return
+            ids = torch.tensor([[tok]], dtype=torch.long)

@@ -12,17 +12,20 @@ import logging
 from typing import Any, AsyncIterator, Optional
 
 from ..runtime.engine import AsyncEngine, Context
+from ..runtime.pipeline import Operator
 from ..runtime.request_plane import StreamLost
 from .protocols import Annotated, LLMEngineOutput, PreprocessedRequest
 
 logger = logging.getLogger(__name__)
 
 
-class Migration:
+class Migration(Operator):
     """Operator wrapping the network hop with retry-on-stream-death
-    (reference Migration migration.rs:26)."""
+    (reference Migration migration.rs:26). As a pipeline node it OWNS the
+    downstream call (`around`) — a retry loop cannot be expressed as a
+    stream wrapper; as a classic engine wrapper it uses `inner`."""
 
-    def __init__(self, inner: AsyncEngine, migration_limit: int = 3):
+    def __init__(self, inner: Optional[AsyncEngine] = None, migration_limit: int = 3):
         self.inner = inner
         self.migration_limit = migration_limit
 
@@ -32,6 +35,9 @@ class Migration:
         manager = RetryManager(self.inner, request, self.migration_limit)
         async for item in manager.run(context):
             yield item
+
+    def around(self, next_engine, request: PreprocessedRequest, context: Context):
+        return RetryManager(next_engine, request, self.migration_limit).run(context)
 
 
 class RetryManager:

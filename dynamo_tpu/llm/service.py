@@ -7,8 +7,10 @@ Mirrors reference lib/llm/src/entrypoint/input/common.rs:259-310
       ServiceBackend(PushRouter | KvPushRouter)   [network hop]
     → Migration.bwd → Backend.bwd → Preprocessor.bwd → frontend
 
-Here each operator is an AsyncEngine wrapping the next, so forward+backward
-are one async-generator pass.
+Built on the generic operator-graph framework (runtime/pipeline.py:
+Operator forward/backward/around + compose — the reference's pipeline.rs
+node model): Backend contributes a backward stream transform, Migration
+owns the downstream call (retry), ServiceBackend is the sink.
 """
 
 from __future__ import annotations
@@ -80,13 +82,17 @@ def build_routed_pipeline(
     busy_threshold: Optional[float] = None,
 ) -> ModelPipeline:
     """Assemble the canonical chain for one model
-    (reference common.rs:259-310)."""
+    (reference common.rs:259-310) via the operator graph."""
+    from ..runtime.pipeline import compose
+
     tokenizer = load_tokenizer(card.tokenizer)
     if router_mode == RouterMode.KV and kv_router is not None:
         router = kv_router
     else:
         router = PushRouter(client, router_mode)
-    service = ServiceBackend(router)
-    migration = Migration(service, migration_limit=card.migration_limit)
-    backend = Backend(migration, tokenizer)
-    return ModelPipeline(card, tokenizer, backend, raw_engine=migration)
+    sink = ServiceBackend(router)
+    migration = Migration(migration_limit=card.migration_limit)
+    backend = Backend(tokenizer=tokenizer)
+    engine = compose([backend, migration], sink)
+    raw_engine = compose([migration], sink)  # below the detokenizer
+    return ModelPipeline(card, tokenizer, engine, raw_engine=raw_engine)

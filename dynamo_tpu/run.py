@@ -74,6 +74,29 @@ async def _spawn_worker(kind: str, args, discovery: str) -> Optional[asyncio.sub
     return proc
 
 
+async def _serve_hf(drt, namespace: str, model: str, model_path: Optional[str]):
+    """out=hf[:path] — in-process torch/transformers CPU engine (reference
+    role: lib/engines/llamacpp + mistralrs, engines linked into the
+    launcher). Random-init tiny model when no path is given."""
+    from dynamo_tpu.llm.engines import HfCpuEngine
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard, register_llm
+    from dynamo_tpu.runtime.compute import ComputePool
+
+    # torch import + model init can take tens of seconds: build on the
+    # compute pool so the discovery lease keepalive keeps running
+    engine = await ComputePool.get().run(HfCpuEngine, model_path)
+    endpoint = drt.namespace(namespace).component("hf").endpoint("generate")
+
+    async def handler(request, context):
+        async for item in engine.generate(request, context):
+            yield item
+
+    tokenizer = model_path if model_path else "byte:512"
+    card = ModelDeploymentCard(name=model, tokenizer=tokenizer)
+    await register_llm(endpoint, card)
+    await endpoint.serve_endpoint(handler)
+
+
 async def _serve_echo(drt, namespace: str, model: str):
     """out=echo — in-process engine that echoes the prompt tokens back
     (reference dynamo-run's echo engine: latency-path testing)."""
@@ -137,7 +160,11 @@ async def amain(argv: List[str]) -> int:
     if input_kind not in ("http", "text", "stdin") and not input_kind.startswith("batch:"):
         print(f"unknown in={input_kind}", file=sys.stderr)
         return 2
-    if out_kind not in ("mocker", "jax", "echo") and not out_kind.startswith("dyn://"):
+    if (
+        out_kind not in ("mocker", "jax", "echo")
+        and not out_kind.startswith("hf")
+        and not out_kind.startswith("dyn://")
+    ):
         print(f"unknown out={out_kind}", file=sys.stderr)
         return 2
     logging.basicConfig(
@@ -159,6 +186,11 @@ async def amain(argv: List[str]) -> int:
         worker_proc = await _spawn_worker(out_kind, args, discovery)
     elif out_kind == "echo":
         await _serve_echo(drt, args.namespace, args.model_name or "echo")
+    elif out_kind.startswith("hf"):
+        _, _, hf_path = out_kind.partition(":")
+        await _serve_hf(
+            drt, args.namespace, args.model_name or "hf-cpu", hf_path or None
+        )
     # else dyn://: attach to whatever's registered
 
     manager = ModelManager()

@@ -69,3 +69,12 @@ def test_unknown_input_fails_fast():
     assert r.returncode == 2
     assert "unknown in=htpp" in r.stderr
     assert time.time() - t0 < 25
+
+
+def test_stdin_hf_cpu_engine():
+    """out=hf — the in-process torch/transformers CPU engine (reference
+    llamacpp/mistralrs role): real token generation, no subprocess."""
+    r = _run(["in=stdin", "out=hf", "--max-tokens", "6"],
+             input_text="hello in-process engine\n", timeout=240)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert len(r.stdout.strip()) > 0

@@ -251,3 +251,60 @@ def test_stream_lost_on_worker_death():
         await server.stop()
 
     asyncio.run(main())
+
+
+class TestOperatorPipeline:
+    """Generic operator graph (runtime/pipeline.py — reference
+    lib/runtime/src/pipeline.rs node model)."""
+
+    def test_forward_backward_order_and_around(self):
+        from dynamo_tpu.runtime.engine import Context
+        from dynamo_tpu.runtime.pipeline import Operator, compose
+
+        calls = []
+
+        class Sink:
+            async def generate(self, request, context):
+                calls.append(("sink", request))
+                yield {"v": request}
+                yield {"v": request + "!"}
+
+        class Tag(Operator):
+            def __init__(self, label):
+                self.label = label
+
+            async def forward(self, request, context):
+                calls.append((f"fwd-{self.label}", request))
+                return request + self.label
+
+            async def backward(self, stream, request, context):
+                async for item in stream:
+                    item["v"] += f"<{self.label}"
+                    yield item
+
+        class Retry(Operator):
+            """around(): owns the sink call — retries once on failure."""
+
+            def __init__(self):
+                self.attempts = 0
+
+            def around(self, next_engine, request, context):
+                return self._run(next_engine, request, context)
+
+            async def _run(self, next_engine, request, context):
+                self.attempts += 1
+                async for item in next_engine.generate(request, context):
+                    yield item
+
+        retry = Retry()
+        pipe = compose([Tag("A"), retry, Tag("B")], Sink())
+
+        async def run():
+            return [i async for i in pipe.generate("req", Context())]
+
+        items = asyncio.run(run())
+        # forward order A (retry owns the tail, which runs B), sink once
+        assert calls == [("fwd-A", "req"), ("fwd-B", "reqA"), ("sink", "reqAB")]
+        # backward order: B wraps first (inner), then A
+        assert [i["v"] for i in items] == ["reqAB<B<A", "reqAB!<B<A"]
+        assert retry.attempts == 1
