@@ -1103,6 +1103,10 @@ class JaxEngine:
             return
         if slot.done or slot.slot_idx < 0 or self.slots[slot.slot_idx] is not slot:
             return
+        logger.info(
+            "kv pull complete for %s: %d pages via data plane %s",
+            slot.request_id, desc.n_pages, desc.addr,
+        )
         self._activate_transferred(slot, first_token)
         self._wake.set()
 

@@ -168,6 +168,22 @@ def test_disagg_matches_local_prefill(disagg_cluster):
     # independent oracle: same params (seed) run aggregated in-process
     assert remote_text == _oracle_greedy(prompt_b, 8)
 
+    # the data plane must have actually moved the KV (round-2 weak #6: the
+    # remote_prefill annotation alone can't distinguish a silent
+    # local-prefill fallback from a working pull)
+    from pathlib import Path
+
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        if "kv pull complete" in Path("/tmp/dis_decode.log").read_text(errors="replace"):
+            break
+        time.sleep(0.5)
+    else:
+        raise AssertionError("no data-plane pull evidence in the decode log")
+    assert "prefilling locally" not in Path("/tmp/dis_decode.log").read_text(
+        errors="replace"
+    )
+
     # short prompts stay local (threshold)
     _, remote_short = _generate(base, "hi")
     assert remote_short is False
