@@ -503,6 +503,15 @@ class JaxEngine:
             if isinstance(request, PreprocessedRequest)
             else PreprocessedRequest.from_dict(request)
         )
+        if req.multimodal:
+            # text-only engine: silently dropping image/audio parts would
+            # be a wrong answer, not a degraded one (protocol contract in
+            # protocols/common.py)
+            yield Annotated.from_error(
+                f"model {self.config.model!r} is text-only; request carries "
+                f"{len(req.multimodal)} multimodal content part(s)"
+            ).to_dict()
+            return
         slot = self._new_slot(req, context)
         disagg = req.disagg_params or {}
         slot.return_kv = bool(disagg.get("return_kv"))

@@ -91,10 +91,32 @@ class OpenAIPreprocessor:
             messages=messages, tools=request.tools, **args
         )
 
+    @staticmethod
+    def _extract_multimodal(request: ChatCompletionRequest) -> list:
+        """Collect non-text content parts (reference multimodal protocol
+        surface: image_url/input_audio parts ride the preprocessed request
+        to the engine; components/backends/trtllm multimodal flows)."""
+        parts = []
+        for m in request.messages:
+            if isinstance(m.content, list):
+                for p in m.content:
+                    if not isinstance(p, dict) or p.get("type") == "text":
+                        continue
+                    if p.get("type") == "image_url":
+                        url = (p.get("image_url") or {}).get("url", "")
+                        parts.append({"type": "image_url", "url": url})
+                    else:
+                        parts.append(dict(p))
+        return parts
+
     def preprocess_chat(self, request: ChatCompletionRequest) -> PreprocessedRequest:
         prompt = self.apply_template(request)
         token_ids = self.tokenizer.encode(prompt)
-        return self._build_common(request, token_ids)
+        pre = self._build_common(request, token_ids)
+        mm = self._extract_multimodal(request)
+        if mm:
+            pre.multimodal = mm
+        return pre
 
     async def preprocess_chat_async(
         self, request: ChatCompletionRequest

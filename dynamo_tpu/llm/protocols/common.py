@@ -82,6 +82,11 @@ class PreprocessedRequest:
     request_id: str = ""
     estimated_prefix_hit_num_blocks: Optional[int] = None
     embed: bool = False  # embeddings request: engine returns {"embedding": [...]}
+    # multimodal content parts extracted from the chat request (reference
+    # multimodal E/P/D protocol surface, components/backends/trtllm):
+    # [{"type": "image_url", "url": ..., "position": <token offset>}].
+    # Engines without multimodal support must REJECT, not silently drop.
+    multimodal: Optional[List[Dict[str, Any]]] = None
 
     def to_dict(self) -> dict:
         d = {
@@ -102,6 +107,8 @@ class PreprocessedRequest:
             d["estimated_prefix_hit_num_blocks"] = self.estimated_prefix_hit_num_blocks
         if self.embed:
             d["embed"] = True
+        if self.multimodal:
+            d["multimodal"] = self.multimodal
         return d
 
     @classmethod

@@ -208,3 +208,67 @@ def test_backend_detokenizes_and_enforces_stop():
         assert ctx.is_stopped()
 
     asyncio.run(main())
+
+
+class TestMultimodalProtocol:
+    """Multimodal protocol surface (reference trtllm multimodal flows):
+    image parts ride the preprocessed request; text-only engines REJECT
+    rather than silently dropping them."""
+
+    def test_image_parts_extracted(self):
+        from dynamo_tpu.llm.model_card import ModelDeploymentCard
+        from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+        from dynamo_tpu.llm.protocols import ChatCompletionRequest
+        from dynamo_tpu.llm.tokenizers import load_tokenizer
+
+        card = ModelDeploymentCard(name="m", tokenizer="byte")
+        pre = OpenAIPreprocessor(card, load_tokenizer("byte"))
+        req = ChatCompletionRequest(
+            model="m",
+            messages=[{
+                "role": "user",
+                "content": [
+                    {"type": "text", "text": "what is in this image?"},
+                    {"type": "image_url",
+                     "image_url": {"url": "data:image/png;base64,AAAA"}},
+                ],
+            }],
+        )
+        out = pre.preprocess_chat(req)
+        assert out.multimodal == [
+            {"type": "image_url", "url": "data:image/png;base64,AAAA"}
+        ]
+        assert "what is in this image?" in "".join(map(chr, [
+            t - 3 for t in out.token_ids if 3 <= t < 259
+        ]))
+        # round-trips the wire format
+        from dynamo_tpu.llm.protocols import PreprocessedRequest
+
+        again = PreprocessedRequest.from_dict(out.to_dict())
+        assert again.multimodal == out.multimodal
+
+    def test_text_only_engine_rejects_multimodal(self):
+        import asyncio
+
+        from dynamo_tpu.engine import EngineConfig, JaxEngine
+        from dynamo_tpu.llm.protocols import Annotated, PreprocessedRequest
+        from dynamo_tpu.runtime.engine import Context
+
+        async def main():
+            eng = JaxEngine(EngineConfig(
+                model="tiny", max_num_seqs=2, page_size=8, num_pages=16,
+                max_model_len=64,
+            ))
+            req = PreprocessedRequest(
+                token_ids=[5, 6, 7],
+                stop_conditions={"max_tokens": 4},
+                multimodal=[{"type": "image_url", "url": "x"}],
+            ).to_dict()
+            items = [item async for item in eng.generate(req, Context())]
+            await eng.close()
+            assert len(items) == 1
+            ann = Annotated.from_dict(items[0])
+            assert ann.is_error()
+            assert "text-only" in (ann.comment or [""])[0]
+
+        asyncio.run(main())
