@@ -125,7 +125,9 @@ class DistributedRuntime:
                 except OSError:
                     drt._embedded_discovery = None  # someone else already runs it
             drt.discovery = await DiscoveryClient.connect(host, port)
-            drt.primary_lease = await drt.discovery.grant_lease(ttl=10.0)
+            drt.primary_lease = await drt.discovery.grant_lease(
+                ttl=drt.config.lease_ttl_s
+            )
             drt.primary_lease.on_lost = drt._republish_leased_keys
         if drt.config.system_enabled:
             from .system_status import SystemStatusServer

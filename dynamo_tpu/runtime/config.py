@@ -49,6 +49,10 @@ class RuntimeConfig:
     health_check_request_timeout: float = 10.0
     # built-in discovery service ("etcd" role)
     discovery_endpoint: str = "tcp://127.0.0.1:2379"
+    # instance-lease TTL: how long after missed keepalives a worker drops
+    # out of discovery (reference etcd lease, transports/etcd.rs:43). Raise
+    # on heavily-contended hosts where event loops can starve past 10s.
+    lease_ttl_s: float = 10.0
     # request-plane bind host for TCP response/request streams
     request_plane_host: str = "127.0.0.1"
 
@@ -92,6 +96,7 @@ class RuntimeConfig:
             "DYN_HEALTH_CHECK_REQUEST_TIMEOUT", cfg.health_check_request_timeout, float
         )
         cfg.discovery_endpoint = _env("DYN_DISCOVERY_ENDPOINT", cfg.discovery_endpoint)
+        cfg.lease_ttl_s = _env("DYN_LEASE_TTL_S", cfg.lease_ttl_s, float)
         cfg.request_plane_host = _env("DYN_REQUEST_PLANE_HOST", cfg.request_plane_host)
         return cfg
 

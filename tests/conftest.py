@@ -27,6 +27,11 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+# one CPU core runs every process the suite spawns: a worker's event loop
+# can starve past the production 10s lease TTL, making its model flap out
+# of discovery mid-test (the 404 flake class). Inherited by ManagedProcess
+# children through os.environ.
+os.environ.setdefault("DYN_LEASE_TTL_S", "45")
 
 import asyncio  # noqa: E402
 
