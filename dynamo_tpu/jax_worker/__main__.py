@@ -193,9 +193,12 @@ async def main():
     # under multi-host EVERY host (followers too) runs one, serving only its
     # own KV shard — the per-shard point-to-point transfer path
     data_plane = None
+    kvbm_enabled = args.kvbm_host_blocks > 0 or args.kvbm_disk_blocks > 0
     if not args.no_kv_data_plane and (
-        multihost or args.role in ("prefill", "aggregated")
+        multihost or kvbm_enabled or args.role in ("prefill", "aggregated")
     ):
+        # kvbm_enabled: decode-role workers join the distributed KVBM mesh
+        # too — they both pull peers' offloaded blocks and serve their own
         from dynamo_tpu.llm.kv_transfer import KvDataPlaneServer
 
         data_plane = KvDataPlaneServer(

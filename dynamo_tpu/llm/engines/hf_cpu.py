@@ -49,11 +49,12 @@ class HfCpuEngine:
             )
             self.model = LlamaForCausalLM(cfg)
         self.model.eval()
-        self.eos_ids = set(
-            self.model.config.eos_token_id
-            if isinstance(self.model.config.eos_token_id, list)
-            else [self.model.config.eos_token_id or -1]
-        )
+        eos = self.model.config.eos_token_id
+        if isinstance(eos, list):
+            self.eos_ids = set(eos)
+        else:
+            # explicit None check: token id 0 is a legitimate EOS in some vocabs
+            self.eos_ids = {eos if eos is not None else -1}
 
     def _step(self, input_ids, past, temperature: float):
         """One forward + sample (blocking; runs on the compute pool)."""

@@ -295,7 +295,11 @@ class KvDataPlaneServer:
             magic, length = _HDR.unpack(hdr)
             if magic not in (_MAGIC, _MAGIC_RANGE):
                 raise RuntimeError(f"bad kv data plane magic {magic:#x}")
-            if length > 4096:  # transfer ids are 16 hex chars; reject floods
+            # _MAGIC handshakes carry a 16-hex-char transfer id; _MAGIC_RANGE
+            # handshakes may carry a {"blocks": [up to 4096 x u64]} kvbm
+            # request (~9 bytes per msgpacked hash => up to ~40 KiB)
+            cap = 65536 if magic == _MAGIC_RANGE else 4096
+            if length > cap:
                 raise RuntimeError(f"oversized kv handshake ({length} bytes)")
             body = await asyncio.wait_for(
                 reader.readexactly(length), self.chunk_timeout

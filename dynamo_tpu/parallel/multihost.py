@@ -124,7 +124,8 @@ class StepBroadcaster:
         self.host = host
         self.port = port
         self.expected = expected_followers
-        self.on_follower_lost = on_follower_lost
+        self._on_follower_lost = on_follower_lost
+        self._lost_pending: List[tuple] = []  # losses before a callback exists
         self._followers: List[_Follower] = []
         self._server: Optional[asyncio.AbstractServer] = None
         self._connected = asyncio.Event()
@@ -132,6 +133,25 @@ class StepBroadcaster:
         self._reader_tasks: List[asyncio.Task] = []
         if expected_followers == 0:
             self._connected.set()
+
+    @property
+    def on_follower_lost(self):
+        return self._on_follower_lost
+
+    @on_follower_lost.setter
+    def on_follower_lost(self, cb):
+        """Losses during startup (between HELLO and the engine wiring the
+        callback) must not vanish: they are queued and replayed here —
+        otherwise the leader's first collective wedges with the watchdog
+        never armed."""
+        self._on_follower_lost = cb
+        if cb is not None:
+            pending, self._lost_pending = self._lost_pending, []
+            for host_id, why in pending:
+                try:
+                    cb(host_id, why)
+                except Exception:  # noqa: BLE001
+                    logger.exception("on_follower_lost callback failed")
 
     @property
     def follower_data_planes(self) -> Dict[int, str]:
@@ -188,11 +208,13 @@ class StepBroadcaster:
         self._followers.remove(f)
         logger.error("follower host %d lost: %s", f.host_id, why)
         f.writer.close()
-        if self.on_follower_lost is not None:
+        if self._on_follower_lost is not None:
             try:
-                self.on_follower_lost(f.host_id, why)
+                self._on_follower_lost(f.host_id, why)
             except Exception:  # noqa: BLE001
                 logger.exception("on_follower_lost callback failed")
+        else:
+            self._lost_pending.append((f.host_id, why))
 
     async def wait_for_followers(self, timeout: float = 120.0):
         await asyncio.wait_for(self._connected.wait(), timeout)
