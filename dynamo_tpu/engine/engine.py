@@ -1899,6 +1899,7 @@ def _resolve_model(name: str) -> llama.LlamaConfig:
         "llama3-70b": llama.LlamaConfig.llama3_70b,
         "tiny-moe": moe.MoeConfig.tiny_moe,
         "mixtral-8x7b": moe.MoeConfig.mixtral_8x7b,
+        "gptoss-120b": moe.MoeConfig.gptoss_120b,
     }
     if name in registry:
         return registry[name]()

@@ -50,6 +50,27 @@ class MoeConfig(LlamaConfig):
         )
 
     @classmethod
+    def gptoss_120b(cls, **overrides):
+        """gpt-oss-120b-shaped wide-MoE config (public architecture: 36
+        layers, 128 experts top-4, ~5B active params; reference recipe
+        recipes/gpt-oss-120b/trtllm/agg). Attention here is GQA (the
+        repo's attention stack) at matching head geometry."""
+        kw = dict(
+            vocab_size=201088,
+            hidden_size=2880,
+            intermediate_size=2880,
+            num_layers=36,
+            num_heads=64,
+            num_kv_heads=8,
+            head_dim=64,
+            rope_theta=150e3,
+            num_experts=128,
+            num_experts_per_tok=4,
+        )
+        kw.update(overrides)
+        return cls(**kw)
+
+    @classmethod
     def tiny_moe(cls, **overrides):
         kw = dict(
             vocab_size=512,

@@ -424,3 +424,31 @@ def test_local_pool_mode_greedy_parity(params):
 
     got = asyncio.run(engine_run())
     assert got == expected, f"local-mode {got} != naive {expected}"
+
+
+def test_gptoss_shaped_registry_resolves_and_steps():
+    """The gpt-oss-120b-shaped wide-MoE config (BASELINE config 5) resolves
+    from the registry and one decode step runs at reduced layer count."""
+    from dynamo_tpu.engine.engine import _resolve_model
+    from dynamo_tpu.models import moe
+
+    cfg = _resolve_model("gptoss-120b")
+    assert isinstance(cfg, moe.MoeConfig)
+    assert cfg.num_experts == 128 and cfg.num_experts_per_tok == 4
+
+    import jax
+    import jax.numpy as jnp
+
+    small = moe.MoeConfig.gptoss_120b(
+        num_layers=1, hidden_size=64, intermediate_size=64, num_heads=4,
+        num_kv_heads=2, head_dim=16, vocab_size=512, num_experts=8,
+        num_experts_per_tok=2, dtype=jnp.float32,
+    )
+    p = moe.init_params(small, jax.random.PRNGKey(0))
+    kv_k = jnp.zeros((1, 8, 8, 2, 16), jnp.float32)
+    kv_v = jnp.zeros_like(kv_k)
+    logits, _, _ = moe.decode_forward(
+        p, small, jnp.zeros((2,), jnp.int32), jnp.zeros((2,), jnp.int32),
+        kv_k, kv_v, jnp.ones((2, 4), jnp.int32), jnp.ones((2,), jnp.int32),
+    )
+    assert logits.shape == (2, 512)
