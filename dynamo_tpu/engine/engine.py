@@ -614,6 +614,9 @@ class JaxEngine:
         }
         if self.kvbm is not None:
             out.update(self.kvbm.stats())
+        if self.data_plane is not None:
+            out["kv_transfers_served"] = self.data_plane.transfers_served
+            out["kv_bytes_served"] = self.data_plane.bytes_served
         return out
 
     # ------------------------------------------------------------------ #
