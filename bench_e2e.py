@@ -361,7 +361,9 @@ def main(argv: Optional[List[str]] = None):
     model = args.model or ("tiny" if args.smoke else "llama3-3b")
     qps = args.qps or (8.0 if args.smoke else 4.0)
     n_requests = args.requests or (32 if args.smoke else 96)
-    startup = args.startup_timeout or (120.0 if args.smoke else 300.0)
+    # TPU first runs pay uncached engine compiles through the tunnel
+    # (~20-40s each across several program variants)
+    startup = args.startup_timeout or (120.0 if args.smoke else 600.0)
     if args.smoke:
         args.isl_mean = min(args.isl_mean, 96)
         args.osl_mean = min(args.osl_mean, 32)
