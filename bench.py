@@ -9,10 +9,17 @@ The LAST line is the headline: e2e when it succeeds, raw otherwise.
 
 vs_baseline: the reference publishes no absolute end-to-end tables
 (BASELINE.md); the closest per-accelerator number it documents is the SLA
-profiler example decode rate of 51.22 tok/s/GPU at TP4 on H100-class
-(docs/benchmarks/pre_deployment_profiling.md:56) => 204.9 tok/s per 4-GPU
-worker. We report tok/s on ONE v5e chip divided by that per-GPU figure so
-the ratio reads "v5e chip vs H100 GPU on the reference's own example".
+profiler example decode rate of 51.22 tok/s/GPU at TP4 on H100-class —
+for a 70B model (docs/benchmarks/pre_deployment_profiling.md:56). Since
+our chip may run a different model, the ratio is PARAM-NORMALIZED:
+(our tok/s x our params) / (51.22 x 70B), i.e. per-accelerator effective
+decode bandwidth on equal terms (see baseline_ratio()).
+
+Outage behavior: every non-smoke entry probes the backend in a killable
+subprocess first (probe_backend); if the TPU is unreachable the bench
+prints CPU fallback numbers plus a structured {"error": "tpu_unavailable"}
+headline and exits 0 — a hung jax.devices() can no longer eat the round's
+measurement budget.
 
 Raw-step shapes follow the engine's production dispatch units
 (engine/engine.py):
@@ -30,6 +37,7 @@ Modes:
 
 import argparse
 import json
+import os
 import subprocess
 import sys
 import time
@@ -37,20 +45,131 @@ from pathlib import Path
 
 H100_DECODE_TOKS_PER_GPU = 51.22  # reference pre_deployment_profiling.md:56
 
+# The reference's 51.22 tok/s/GPU decodes a *70B* model at TP4
+# (docs/benchmarks/pre_deployment_profiling.md:56). Comparing a different
+# model's tok/s against it raw is apples-to-oranges, so vs_baseline is
+# normalized by parameter count: decode is HBM-bandwidth-bound and bytes
+# moved per token scale with params, so (tok/s x params) compares
+# per-accelerator effective throughput on equal terms.
+H100_REF_PARAMS_B = 70.0
+MODEL_PARAMS_B = {
+    "tiny": 0.001,
+    "tiny-moe": 0.004,
+    "llama3-3b": 3.2,
+    "llama3-8b": 8.0,
+    "llama3-70b": 70.0,
+}
+
+
+def baseline_ratio(toks_per_sec: float, model: str):
+    """Param-normalized per-accelerator ratio vs the reference's H100 decode
+    example; None when the model's size is unknown."""
+    params_b = MODEL_PARAMS_B.get(model)
+    if params_b is None:
+        return None
+    return round(
+        (toks_per_sec * params_b) / (H100_DECODE_TOKS_PER_GPU * H100_REF_PARAMS_B), 2
+    )
+
+
+def probe_backend(deadline: float = 120.0):
+    """Probe the accelerator in a killable subprocess with a hard deadline.
+
+    `jax.devices()` hangs indefinitely when the TPU tunnel is down (round 3
+    recorded an rc=124 driver timeout with zero output); probing in a
+    subprocess turns an outage into a structured result. Returns
+    (platform | None, error_message)."""
+    code = "import jax; d = jax.devices(); print(d[0].platform, len(d))"
+    t0 = time.perf_counter()
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=deadline,
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"backend probe timed out after {deadline:.0f}s"
+    if r.returncode != 0:
+        tail = (r.stderr or "").strip().splitlines()
+        return None, "backend probe failed: " + (tail[-1] if tail else f"rc={r.returncode}")
+    plat = r.stdout.split()[0] if r.stdout.split() else "unknown"
+    sys.stderr.write(
+        f"# backend probe: {plat} in {time.perf_counter() - t0:.1f}s\n"
+    )
+    return plat, ""
+
+
+def ensure_backend(metric: str):
+    """Shared entry guard for every bench script: probe the accelerator
+    unless a parent already did (DYN_BENCH_SKIP_PROBE). Returns None when
+    the backend is usable; otherwise a structured result dict the caller
+    should print as its only output before exiting 0."""
+    if os.environ.get("DYN_BENCH_SKIP_PROBE") == "1":
+        return None
+    plat, err = probe_backend()
+    if plat is None:
+        return {
+            "metric": metric, "value": 0.0, "unit": "tok/s",
+            "vs_baseline": 0.0, "error": "tpu_unavailable", "detail": err,
+        }
+    os.environ["DYN_BENCH_SKIP_PROBE"] = "1"
+    return None
+
+
+def _emit_unavailable(detail: str):
+    """TPU down: report whatever CPU numbers we can, then a structured
+    tpu_unavailable headline. Exit 0 so the driver records the JSON."""
+    sys.stderr.write(f"# TPU unavailable: {detail}\n")
+    env = dict(os.environ, DYN_BENCH_SKIP_PROBE="1")
+    try:
+        r = subprocess.run(
+            [sys.executable, __file__, "--raw", "--smoke"],
+            capture_output=True, text=True, timeout=600, env=env,
+        )
+        for line in r.stdout.splitlines():
+            if line.startswith("{"):
+                d = json.loads(line)
+                d["metric"] += "_cpu_fallback"
+                d["note"] = "CPU smoke numbers; TPU was unreachable"
+                print(json.dumps(d))
+    except Exception as e:  # the fallback must never block the error line
+        sys.stderr.write(f"# cpu fallback failed: {e}\n")
+    print(json.dumps({
+        "metric": "e2e_output_toks_agg",
+        "value": 0.0,
+        "unit": "tok/s",
+        "vs_baseline": 0.0,
+        "error": "tpu_unavailable",
+        "detail": detail,
+    }))
+    sys.exit(0)
+
 
 def _json_lines(cmd, label):
-    """Run a bench subprocess; return its last stdout JSON line (or None)."""
+    """Run a bench subprocess; return (last stdout JSON line | None, rc)."""
+    env = dict(os.environ, DYN_BENCH_SKIP_PROBE="1")
     try:
-        r = subprocess.run(cmd, capture_output=True, text=True, timeout=1800)
+        r = subprocess.run(cmd, capture_output=True, text=True, timeout=1800, env=env)
     except subprocess.TimeoutExpired as e:
         sys.stderr.write(f"# {label} bench timed out after {e.timeout}s\n")
-        return None
+        return None, 124
     sys.stderr.write(r.stderr)
     out = None
     for line in r.stdout.splitlines():
         if line.startswith("{"):
             out = line
-    return out
+    if r.returncode != 0:
+        sys.stderr.write(f"# {label} bench exited rc={r.returncode}\n")
+    return out, r.returncode
+
+
+def _tag_error(line, rc):
+    """Mark a JSON result line as coming from a failed subprocess."""
+    try:
+        d = json.loads(line)
+    except (TypeError, ValueError):
+        return line
+    d["error"] = f"bench_exit_{rc}"
+    return json.dumps(d)
 
 
 def _combined(args, extra):
@@ -59,28 +178,43 @@ def _combined(args, extra):
     the e2e worker starts)."""
     smoke = ["--smoke"] if args.smoke else []
     model = ["--model", args.model] if args.model else []
-    raw_line = _json_lines(
+    raw_line, raw_rc = _json_lines(
         [sys.executable, __file__, "--raw", *smoke, *model,
          "--batch", str(args.batch), "--isl", str(args.isl),
          "--osl", str(args.osl), "--block", str(args.block),
          *(["--steps", str(args.steps)] if args.steps else [])],
         "raw",
     )
-    e2e_line = _json_lines(
+    e2e_line, e2e_rc = _json_lines(
         [sys.executable, str(Path(__file__).parent / "bench_e2e.py"),
          "--mode", "agg", *smoke, *model, *extra],
         "e2e",
     )
-    # headline (last line) = e2e if it produced a result, else raw
-    if e2e_line and raw_line:
-        print(raw_line)
+    # headline = LAST printed line; never let a failed subprocess's numbers
+    # stand as the headline untagged, and propagate failure in the exit code
+    raw_ok = raw_line is not None and raw_rc == 0
+    e2e_ok = e2e_line is not None and e2e_rc == 0
+    if e2e_ok:
+        if raw_line:
+            print(raw_line if raw_ok else _tag_error(raw_line, raw_rc))
         print(e2e_line)
-    elif raw_line:
-        print(raw_line)
-    elif e2e_line:
-        print(e2e_line)
-    else:
-        sys.exit("bench: no result produced")
+        sys.exit(0)
+    # headline e2e failed: print whatever was measured (tagged), exit 1.
+    # Ordering keeps the best available line LAST (the headline slot).
+    printed = False
+    if e2e_line:  # e2e produced a line but exited nonzero (failed requests)
+        print(_tag_error(e2e_line, e2e_rc))
+        printed = True
+    if raw_line:
+        print(raw_line if raw_ok else _tag_error(raw_line, raw_rc))
+        printed = True
+    if not printed:
+        print(json.dumps({
+            "metric": "e2e_output_toks_agg", "value": 0.0, "unit": "tok/s",
+            "vs_baseline": 0.0, "error": "bench_failed",
+            "detail": f"raw rc={raw_rc} e2e rc={e2e_rc}, no JSON produced",
+        }))
+    sys.exit(1)
 
 
 def main():
@@ -97,6 +231,14 @@ def main():
     ap.add_argument("--engine", action="store_true",
                     help="drive JaxEngine.generate (scheduler + fetch pipeline included)")
     args, extra = ap.parse_known_args()
+
+    # Any non-smoke path touches the real device: probe it first with a hard
+    # deadline so a dead tunnel yields a structured result, never a hang.
+    # Children spawned by _combined inherit DYN_BENCH_SKIP_PROBE.
+    if not args.smoke:
+        unavailable = ensure_backend("e2e_output_toks_agg")
+        if unavailable is not None:
+            _emit_unavailable(unavailable["detail"])
 
     if args.e2e:
         from bench_e2e import main as e2e_main
@@ -282,10 +424,10 @@ def main():
         "metric": f"decode_throughput_{model}_bs{B}_isl{args.isl}",
         "value": round(toks_per_sec, 1),
         "unit": "tok/s",
-        "vs_baseline": round(toks_per_sec / H100_DECODE_TOKS_PER_GPU, 2),
+        "vs_baseline": baseline_ratio(toks_per_sec, model),
     }
     print(json.dumps(result))
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -38,7 +38,7 @@ from typing import List, Optional
 REPO = Path(__file__).resolve().parent
 sys.path.insert(0, str(REPO))
 
-from bench import H100_DECODE_TOKS_PER_GPU  # noqa: E402 — shared baseline
+from bench import baseline_ratio, ensure_backend  # noqa: E402 — shared baseline
 from tests.utils import ManagedProcess, free_port  # noqa: E402
 
 
@@ -359,6 +359,11 @@ def main(argv: Optional[List[str]] = None):
 
     cpu = bool(args.smoke)
     model = args.model or ("tiny" if args.smoke else "llama3-3b")
+    if not cpu:
+        unavailable = ensure_backend(f"e2e_output_toks_{args.mode}_{model}")
+        if unavailable is not None:
+            print(json.dumps(unavailable))
+            return 0
     qps = args.qps or (8.0 if args.smoke else 4.0)
     n_requests = args.requests or (32 if args.smoke else 96)
     # TPU first runs pay uncached engine compiles through the tunnel
@@ -399,7 +404,7 @@ def main(argv: Optional[List[str]] = None):
         "metric": f"e2e_output_toks_{args.mode}_{model}_qps{qps:g}",
         "value": summary["output_tok_s"],
         "unit": "tok/s",
-        "vs_baseline": round(summary["output_tok_s"] / H100_DECODE_TOKS_PER_GPU, 2),
+        "vs_baseline": baseline_ratio(summary["output_tok_s"], model),
         "ttft_p50_ms": summary["ttft_ms"]["p50"],
         "ttft_p99_ms": summary["ttft_ms"]["p99"],
         "itl_p50_ms": summary["itl_ms"]["p50"],

@@ -21,6 +21,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import os
 import sys
 import time
 from pathlib import Path
@@ -29,7 +30,7 @@ from typing import List, Optional
 REPO = Path(__file__).resolve().parent
 sys.path.insert(0, str(REPO))
 
-from bench import H100_DECODE_TOKS_PER_GPU  # noqa: E402
+from bench import baseline_ratio, ensure_backend  # noqa: E402
 
 
 def _make_engine(model: str, B: int, isl: int, osl: int, K: int, page: int = 64,
@@ -176,6 +177,11 @@ def main(argv: Optional[List[str]] = None):
             assert jax.devices()[0].platform == "cpu"
 
     model = args.model or ("tiny" if args.smoke else "llama3-3b")
+    if not args.smoke:
+        unavailable = ensure_backend(f"engine_decode_{model}")
+        if unavailable is not None:
+            print(json.dumps(unavailable))
+            return 0
     vocab = 512 if model in ("tiny", "tiny-moe") else 128000
     B, isl, osl = args.batch, args.isl, args.osl
     if args.smoke:
@@ -206,7 +212,7 @@ def main(argv: Optional[List[str]] = None):
         "metric": f"engine_decode_{model}_bs{B}_isl{isl}",
         "value": round(steady["decode_tok_s"], 1),
         "unit": "tok/s",
-        "vs_baseline": round(steady["decode_tok_s"] / H100_DECODE_TOKS_PER_GPU, 2),
+        "vs_baseline": baseline_ratio(steady["decode_tok_s"], model),
         "itl_ms": round(steady["itl_ms"], 2),
         "churn_tok_s": round(churn.get("churn_tok_s", 0.0), 1),
     }

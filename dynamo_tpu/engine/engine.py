@@ -870,6 +870,25 @@ class JaxEngine:
             )
         return np.asarray(k), np.asarray(v)
 
+    def _kv_headwise_shards_ok(self) -> bool:
+        """True iff every local KV-pool shard spans the FULL extent on all
+        axes except the kv-head axis (3) — the only layout that
+        _local_shard_views/_extract_local_shard (axis-3 concat) and
+        _dev_inject_shard (global_shape widened on axis 3 only) can
+        reassemble. A pool sharded on layers (pp multihost) or pages
+        (dp-attention over a multi-host mesh) would be silently corrupted
+        by the per-shard path, so such layouts must use the inline
+        allgather transfer instead (advisor r3 finding)."""
+        shape = self.kv_k.shape
+        for s in self.kv_k.addressable_shards:
+            for ax in (0, 1, 2, 4):
+                sl = s.index[ax]
+                if (sl.start or 0) != 0 or not (
+                    sl.stop is None or sl.stop >= shape[ax]
+                ):
+                    return False
+        return True
+
     def _local_shard_views(self):
         """This host's KV shard pieces, deduped across replicas and sorted
         by the sharded (kv-head) axis slice. Single-device arrays — safe to
@@ -1132,6 +1151,13 @@ class JaxEngine:
 
         if not (self._multihost and self.shard_addrs):
             raise RuntimeError("sharded descriptor but this worker is not multi-host")
+        if not self._kv_headwise_shards_ok():
+            # raising here lands in _pull_and_activate's fallback: the
+            # request prefills locally instead of injecting corrupt KV
+            raise RuntimeError(
+                "KV pool host-sharded beyond the kv-head axis; shard-wise "
+                "inject unsupported for this layout"
+            )
         shards = {s["host_id"]: s["addr"] for s in desc.shards}
         if len(shards) != len(self.shard_addrs):
             raise RuntimeError(
@@ -1466,7 +1492,17 @@ class JaxEngine:
                 )
             self._release_slot(slot)
 
-        if self._multihost and self.shard_addrs:
+        shard_path = bool(self._multihost and self.shard_addrs)
+        if shard_path and not self._kv_headwise_shards_ok():
+            # pool sharded beyond the kv-head axis: the per-shard path would
+            # reassemble bytes under wrong layers/pages — use the inline
+            # allgather transfer (correct for any sharding, more bytes)
+            logger.warning(
+                "KV pool is host-sharded beyond the kv-head axis; using the "
+                "inline KV transfer path instead of per-shard pulls"
+            )
+            shard_path = False
+        if shard_path:
             import secrets as _secrets
 
             tid = _secrets.token_hex(8)

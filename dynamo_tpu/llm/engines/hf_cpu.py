@@ -35,6 +35,7 @@ class HfCpuEngine:
 
         torch.manual_seed(0)
         self.torch = torch
+        self.model_name = model_path or "hf-cpu-tiny"
         if model_path:
             from transformers import AutoModelForCausalLM
 
@@ -75,6 +76,17 @@ class HfCpuEngine:
         from ...runtime.compute import ComputePool
 
         req = request if isinstance(request, dict) else request.to_dict()
+        if req.get("multimodal"):
+            # protocol contract (protocols/common.py): engines without
+            # multimodal support must REJECT, not silently answer from the
+            # text tokens alone
+            from ..protocols.common import Annotated
+
+            yield Annotated.from_error(
+                f"model {self.model_name!r} (hf-cpu) is text-only; request "
+                f"carries {len(req['multimodal'])} multimodal content part(s)"
+            ).to_dict()
+            return
         token_ids = list(req.get("token_ids") or [])
         stop = req.get("stop_conditions") or {}
         sampling = req.get("sampling_options") or {}

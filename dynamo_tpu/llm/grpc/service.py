@@ -32,6 +32,10 @@ logger = logging.getLogger(__name__)
 
 SERVICE = "inference.GRPCInferenceService"
 
+# max concurrently-served requests per decoupled stream; pipelined requests
+# beyond this queue at the stream reader (backpressure via flow control)
+MAX_STREAM_INFLIGHT = 32
+
 
 def _param(p: "pb.InferParameter"):
     which = p.WhichOneof("parameter_choice")
@@ -113,9 +117,16 @@ class KserveGrpcService:
             stream=False,
         )
 
-    async def _run(self, req: "pb.ModelInferRequest", context, on_delta=None):
+    async def _run(self, req: "pb.ModelInferRequest", context, on_delta=None,
+                   abort_on_error: bool = True):
+        """abort_on_error=False (the streaming path) raises instead of
+        aborting: context.abort tears down the WHOLE RPC, which on a
+        multiplexed decoupled stream would kill the other in-flight
+        requests sharing it."""
         pipeline = self.manager.get(req.model_name)
         if pipeline is None:
+            if not abort_on_error:
+                raise ValueError(f"model {req.model_name!r} not found")
             await context.abort(
                 grpc.StatusCode.NOT_FOUND, f"model {req.model_name!r} not found"
             )
@@ -126,10 +137,10 @@ class KserveGrpcService:
         try:
             async for ann in pipeline.generate_preprocessed(pre, ctx):
                 if ann.is_error():
-                    await context.abort(
-                        grpc.StatusCode.INTERNAL,
-                        (ann.comment or ["engine error"])[0],
-                    )
+                    msg = (ann.comment or ["engine error"])[0]
+                    if not abort_on_error:
+                        raise RuntimeError(msg)
+                    await context.abort(grpc.StatusCode.INTERNAL, msg)
                 if ann.event is not None:
                     continue
                 out = ann.data
@@ -171,46 +182,76 @@ class KserveGrpcService:
         return self._infer_response(request, text, n_out, n_in, finish)
 
     async def _model_stream_infer(self, request_iterator, context):
-        """Decoupled streaming: every request on the stream produces a
-        sequence of delta responses ending with final=true (the shape the
-        reference's OpenAI-over-gRPC streaming takes)."""
-        async for req in request_iterator:
-            q: asyncio.Queue = asyncio.Queue()
+        """Decoupled streaming: requests pipelined on one stream run
+        CONCURRENTLY — a task per incoming request, responses multiplexed
+        onto the stream as they arrive (each response carries the request
+        id, so interleaving is disambiguated). A request's sequence ends
+        with final=true; the RPC ends when the client closes its side and
+        every in-flight request has finished (the reference's decoupled
+        semantics, kserve.rs:33)."""
+        out: asyncio.Queue = asyncio.Queue()
+        tasks: set = set()
+        # backpressure: the old serialized handler held one request in
+        # flight; concurrency must not mean a pipelining client can force
+        # unbounded tasks + queued engine work
+        gate = asyncio.Semaphore(MAX_STREAM_INFLIGHT)
 
-            async def on_delta(text, n_out, _q=q, _req=req):
-                _q.put_nowait(
+        async def run_one(req):
+            async def on_delta(text, n_out, _finish):
+                out.put_nowait(
                     pb.ModelStreamInferResponse(
                         infer_response=self._infer_response(
-                            _req, text, n_out, 0, "", final=False
+                            req, text, n_out, 0, "", final=False
                         )
                     )
                 )
 
-            async def run(_req=req, _q=q):
-                try:
-                    text, n_out, n_in, finish = await self._run(
-                        _req, context, on_delta=lambda t, n, f: on_delta(t, n)
-                    )
-                    _q.put_nowait(
-                        pb.ModelStreamInferResponse(
-                            infer_response=self._infer_response(
-                                _req, "", n_out, n_in, finish, final=True
-                            )
+            try:
+                text, n_out, n_in, finish = await self._run(
+                    req, context, on_delta=on_delta, abort_on_error=False
+                )
+                out.put_nowait(
+                    pb.ModelStreamInferResponse(
+                        infer_response=self._infer_response(
+                            req, "", n_out, n_in, finish, final=True
                         )
                     )
-                except Exception as e:  # noqa: BLE001 — surfaced on-stream
-                    _q.put_nowait(pb.ModelStreamInferResponse(error_message=str(e)))
-                _q.put_nowait(None)
-
-            task = asyncio.create_task(run())
-            try:
-                while True:
-                    item = await q.get()
-                    if item is None:
-                        break
-                    yield item
+                )
+            except Exception as e:  # noqa: BLE001 — surfaced on-stream
+                # error frame still carries the request id and final=true so
+                # the client can attribute it and stop waiting on this id
+                out.put_nowait(
+                    pb.ModelStreamInferResponse(
+                        error_message=str(e),
+                        infer_response=self._infer_response(
+                            req, "", 0, 0, "error", final=True
+                        ),
+                    )
+                )
             finally:
-                task.cancel()
+                gate.release()
+
+        async def pump():
+            async for req in request_iterator:
+                await gate.acquire()
+                t = asyncio.create_task(run_one(req))
+                tasks.add(t)
+                t.add_done_callback(tasks.discard)
+            if tasks:  # client closed its side; drain in-flight requests
+                await asyncio.gather(*list(tasks), return_exceptions=True)
+            out.put_nowait(None)
+
+        pump_task = asyncio.create_task(pump())
+        try:
+            while True:
+                item = await out.get()
+                if item is None:
+                    break
+                yield item
+        finally:
+            pump_task.cancel()
+            for t in list(tasks):
+                t.cancel()
 
     # -- server lifecycle ------------------------------------------------ #
 

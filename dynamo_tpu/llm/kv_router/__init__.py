@@ -127,8 +127,12 @@ class KvPushRouter:
                 if msg.get("router") == self._sync_id:
                     continue  # our own event
                 if msg["op"] == "route":
+                    # mirrored=True: no local stream ends this entry, so the
+                    # scheduler TTL-prunes it if the peer's 'free' never
+                    # arrives (peer crash / dropped best-effort publish)
                     self.scheduler.add_request(
-                        msg["request_id"], msg["worker"], msg["blocks"]
+                        msg["request_id"], msg["worker"], msg["blocks"],
+                        mirrored=True,
                     )
                     if isinstance(self.indexer, ApproxKvIndexer) and msg.get("token_ids"):
                         self.indexer.process_routing_decision_for_request(
@@ -162,6 +166,9 @@ class KvPushRouter:
         if not live:
             raise StreamLost(f"no instances for {self.client.endpoint.subject}")
         self._prune_dead_workers(live)
+        pruned = self.scheduler.prune_mirrored()
+        if pruned:
+            logger.info("pruned %d stale mirrored sync entries", pruned)
         scores = self.indexer.find_matches_for_tokens(token_ids)
         request_blocks = len(token_ids) // self.block_size
         cfg = self.config

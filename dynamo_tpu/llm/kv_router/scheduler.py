@@ -29,6 +29,11 @@ class KvRouterConfig:
     use_kv_events: bool = True  # False -> ApproxKvIndexer
     replica_sync: bool = False
     block_size: int = 64
+    # mirrored replica-sync entries have no local stream whose end frees
+    # them — if the publishing frontend dies (or its best-effort 'free' is
+    # dropped) they would skew active-block scoring forever; prune at a
+    # max-request-lifetime TTL instead
+    sync_entry_ttl_s: float = 600.0
 
 
 @dataclass
@@ -36,6 +41,7 @@ class _ActiveSeq:
     worker_id: int
     blocks: int
     started: float = field(default_factory=time.monotonic)
+    mirrored: bool = False  # came from replica sync, not a local stream
 
 
 @dataclass
@@ -93,8 +99,14 @@ class KvScheduler:
         load.num_waiting_reqs = int(stats.get("num_waiting_reqs", 0))
         load.updated = time.monotonic()
 
-    def add_request(self, request_id: str, worker_id: int, blocks: int):
-        self._active[request_id] = _ActiveSeq(worker_id, blocks)
+    def add_request(
+        self, request_id: str, worker_id: int, blocks: int, mirrored: bool = False
+    ):
+        # re-adding an id (e.g. duplicate sync delivery) must not leak the
+        # old entry's potential blocks
+        if request_id in self._active:
+            self.mark_free(request_id)
+        self._active[request_id] = _ActiveSeq(worker_id, blocks, mirrored=mirrored)
         self._potential_blocks[worker_id] = (
             self._potential_blocks.get(worker_id, 0) + blocks
         )
@@ -106,6 +118,21 @@ class KvScheduler:
             self._potential_blocks[w] = max(
                 0, self._potential_blocks.get(w, 0) - seq.blocks
             )
+
+    def prune_mirrored(self, now: Optional[float] = None) -> int:
+        """Drop mirrored entries older than sync_entry_ttl_s (reference
+        subscriber.rs keeps replicas converged via resync; here sync is
+        best-effort pub/sub, so staleness is bounded by TTL instead).
+        Returns how many entries were pruned."""
+        now = time.monotonic() if now is None else now
+        ttl = self.config.sync_entry_ttl_s
+        stale = [
+            rid for rid, s in self._active.items()
+            if s.mirrored and now - s.started > ttl
+        ]
+        for rid in stale:
+            self.mark_free(rid)
+        return len(stale)
 
     def remove_worker(self, worker_id: int):
         self.loads.pop(worker_id, None)

@@ -1,0 +1,54 @@
+"""Outage-hardening and baseline-normalization behavior of bench.py.
+
+Round-3 postmortem: a dead TPU tunnel made `jax.devices()` hang inside
+bench.py until the driver's timeout (BENCH_r03.json rc=124, zero output).
+These tests pin the guarantees that make that unrepresentable:
+  * the backend probe runs in a killable subprocess with a hard deadline
+  * failed subprocess results are tagged, never silently used as headline
+  * vs_baseline is param-normalized (the reference's 51.22 tok/s/GPU is a
+    70B-model example — docs/benchmarks/pre_deployment_profiling.md:56)
+"""
+
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import bench  # noqa: E402
+
+
+def test_baseline_ratio_param_normalized():
+    # 51.22 tok/s of a 70B model is the reference point: ratio 1.0
+    assert bench.baseline_ratio(51.22, "llama3-70b") == 1.0
+    # a 3.2B model must clear 70/3.2 x the tok/s for the same ratio
+    r3b = bench.baseline_ratio(51.22 * 70 / 3.2, "llama3-3b")
+    assert abs(r3b - 1.0) < 0.01
+    # unknown models produce None, not a bogus ratio
+    assert bench.baseline_ratio(100.0, "unknown-model") is None
+
+
+def test_probe_backend_deadline_is_hard():
+    # A probe that cannot finish inside the deadline returns a structured
+    # failure instead of hanging (the subprocess is killed).
+    plat, err = bench.probe_backend(deadline=0.05)
+    assert plat is None
+    assert "probe" in err
+
+
+def test_tag_error_marks_failed_results():
+    line = json.dumps({"metric": "m", "value": 1.0})
+    tagged = json.loads(bench._tag_error(line, 3))
+    assert tagged["error"] == "bench_exit_3"
+    assert tagged["value"] == 1.0
+    # non-JSON passes through untouched rather than raising
+    assert bench._tag_error("not json", 1) == "not json"
+
+
+def test_json_lines_reports_returncode():
+    line, rc = bench._json_lines(
+        [sys.executable, "-c", "print('{\"metric\": \"x\"}'); raise SystemExit(7)"],
+        "t",
+    )
+    assert rc == 7
+    assert json.loads(line)["metric"] == "x"

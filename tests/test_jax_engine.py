@@ -452,3 +452,32 @@ def test_gptoss_shaped_registry_resolves_and_steps():
         kv_k, kv_v, jnp.ones((2, 4), jnp.int32), jnp.ones((2,), jnp.int32),
     )
     assert logits.shape == (2, 512)
+
+
+def test_kv_headwise_shard_guard():
+    """The per-shard multi-host KV transfer can only reassemble pools
+    host-sharded on the kv-head axis; any other host-sharded axis must be
+    detected so the engine falls back to the inline allgather transfer
+    instead of silently corrupting KV (advisor r3 finding)."""
+    from types import SimpleNamespace
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from dynamo_tpu.engine.engine import JaxEngine
+
+    devs = np.array(jax.devices()[:4]).reshape(2, 2)
+    mesh = Mesh(devs, ("dp", "tp"))
+    pool = jnp.zeros((2, 8, 4, 4, 8), jnp.float32)  # [L, pages, page, KH, D]
+
+    def check(spec):
+        arr = jax.device_put(pool, NamedSharding(mesh, spec))
+        return JaxEngine._kv_headwise_shards_ok(SimpleNamespace(kv_k=arr))
+
+    assert check(P(None, None, None, "tp", None))  # kv-head sharded: ok
+    assert check(P(None, None, None, ("dp", "tp"), None))  # both axes on KH: ok
+    assert check(P())  # fully replicated: ok
+    assert not check(P(None, "dp", None, "tp", None))  # pages sharded: reject
+    assert not check(P("tp", None, None, None, None))  # layers sharded: reject

@@ -93,6 +93,27 @@ def test_scheduler_prefers_overlap_and_balances_load():
     assert all(v == 0 for v in sched2._potential_blocks.values())
 
 
+def test_scheduler_prunes_stale_mirrored_entries():
+    """Replica-sync mirrored routes have no local stream to free them: if the
+    publishing frontend crashed before its 'free', they must TTL out instead
+    of skewing active-block scoring forever (advisor r3 finding)."""
+    sched = KvScheduler(KvRouterConfig(sync_entry_ttl_s=0.05))
+    sched.add_request("local", 1, 10)  # local entry: never TTL-pruned
+    sched.add_request("peer", 2, 10, mirrored=True)
+    assert sched._potential_blocks == {1: 10, 2: 10}
+    assert sched.prune_mirrored() == 0  # fresh: kept
+    time.sleep(0.08)
+    assert sched.prune_mirrored() == 1
+    assert sched._potential_blocks[2] == 0  # mirrored entry released
+    assert sched._potential_blocks[1] == 10  # local entry untouched
+    # duplicate sync delivery must not leak potential blocks
+    sched.add_request("dup", 2, 8, mirrored=True)
+    sched.add_request("dup", 2, 8, mirrored=True)
+    assert sched._potential_blocks[2] == 8
+    sched.mark_free("dup")
+    assert sched._potential_blocks[2] == 0
+
+
 def test_approx_indexer_ttl():
     idx = ApproxKvIndexer(block_size=4, ttl=0.2)
     toks = list(range(16))

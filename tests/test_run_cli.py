@@ -78,3 +78,30 @@ def test_stdin_hf_cpu_engine():
              input_text="hello in-process engine\n", timeout=240)
     assert r.returncode == 0, r.stderr[-2000:]
     assert len(r.stdout.strip()) > 0
+
+
+def test_hf_cpu_engine_rejects_multimodal():
+    """Protocol contract (protocols/common.py): engines without multimodal
+    support must REJECT, not silently answer from text tokens alone."""
+    import asyncio
+
+    from dynamo_tpu.llm.engines.hf_cpu import HfCpuEngine
+
+    engine = HfCpuEngine()
+
+    async def collect(req):
+        return [item async for item in engine.generate(req, None)]
+
+    mm_req = {
+        "token_ids": [1, 2, 3],
+        "multimodal": [{"type": "image_url", "url": "x", "position": 1}],
+        "stop_conditions": {"max_tokens": 4},
+    }
+    out = asyncio.run(collect(mm_req))
+    assert len(out) == 1
+    assert "text-only" in (out[0].get("comment") or [""])[0]
+    assert out[0].get("event") == "error"
+    # plain text requests still generate
+    out = asyncio.run(collect({"token_ids": [1, 2, 3],
+                               "stop_conditions": {"max_tokens": 4}}))
+    assert any((i.get("data") or {}).get("token_ids") for i in out)
