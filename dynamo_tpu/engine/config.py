@@ -36,6 +36,10 @@ class EngineConfig:
     # batched prefill: token budget per dispatch; lanes = budget // bucket
     prefill_batch_tokens: int = 1024
     max_prefill_batch: int = 8
+    # weight-only quantization ("int8" | None): halves weight HBM traffic
+    # and makes llama3-8b fit a single v5e chip beside a KV pool
+    # (models/quant.py; reference analogue: FP8 recipes)
+    quantize: Optional[str] = None
     # sampling defaults
     default_temperature: float = 0.0
     seed: int = 0

@@ -27,6 +27,7 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from .quant import embed_rows, head_leaf, qdot
 from ..ops.paged_attention import (
     paged_attention_decode,
     prefill_attention,
@@ -171,10 +172,10 @@ def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
 
 def _mlp(layer, x, c: LlamaConfig):
     h = rms_norm(x, layer["mlp_norm"], c.rms_norm_eps)
-    gate = jnp.dot(h, layer["w_gate"], preferred_element_type=jnp.float32)
-    up = jnp.dot(h, layer["w_up"], preferred_element_type=jnp.float32)
+    gate = qdot(h, layer["w_gate"])
+    up = qdot(h, layer["w_up"])
     act = (jax.nn.silu(gate) * up).astype(c.dtype)
-    return x + jnp.dot(act, layer["w_down"], preferred_element_type=jnp.float32).astype(c.dtype)
+    return x + qdot(act, layer["w_down"]).astype(c.dtype)
 
 
 def prefill_forward(
@@ -198,7 +199,7 @@ def prefill_forward(
     """
     c = config
     mlp_fn = mlp_fn or _mlp
-    x = params["embed"][tokens]  # [T, H]
+    x = embed_rows(params["embed"], tokens, c.dtype)  # [T, H]
     cos, sin = rope_cos_sin(positions, c.head_dim, c.rope_theta)
     page_size = kv_k.shape[2]
     T = tokens.shape[0]
@@ -213,9 +214,9 @@ def prefill_forward(
         for li in range(c.num_layers):
             layer = jax.tree.map(lambda p: p[li], params["layers"])
             h = rms_norm(x, layer["attn_norm"], c.rms_norm_eps)
-            q = jnp.dot(h, layer["wq"], preferred_element_type=jnp.float32).astype(c.dtype)
-            k = jnp.dot(h, layer["wk"], preferred_element_type=jnp.float32).astype(c.dtype)
-            v = jnp.dot(h, layer["wv"], preferred_element_type=jnp.float32).astype(c.dtype)
+            q = qdot(h, layer["wq"]).astype(c.dtype)
+            k = qdot(h, layer["wk"]).astype(c.dtype)
+            v = qdot(h, layer["wv"]).astype(c.dtype)
             q = q.reshape(-1, c.num_heads, c.head_dim)
             k = k.reshape(-1, c.num_kv_heads, c.head_dim)
             v = v.reshape(-1, c.num_kv_heads, c.head_dim)
@@ -229,15 +230,15 @@ def prefill_forward(
                 total_len,
             )
             attn = attn.reshape(-1, c.num_heads * c.head_dim)
-            x = x + jnp.dot(attn, layer["wo"], preferred_element_type=jnp.float32).astype(c.dtype)
+            x = x + qdot(attn, layer["wo"]).astype(c.dtype)
             x = mlp_fn(layer, x, c)
         return x, kv_k, kv_v
 
     x, kv_k, kv_v = body(x, kv_k, kv_v)
     x = rms_norm(x, params["final_norm"], c.rms_norm_eps)
     last = x[-1] if last_idx is None else x[last_idx]
-    head = params["lm_head"] if params["lm_head"] is not None else params["embed"].T
-    logits = jnp.dot(last, head, preferred_element_type=jnp.float32)
+    head = head_leaf(params)
+    logits = qdot(last, head)
     return logits, kv_k, kv_v
 
 
@@ -252,14 +253,22 @@ def prefill_forward_batched(
     context_lens: jax.Array,  # [B] history length per seq
     last_idx: jax.Array,  # [B] index of last REAL token per chunk
     mlp_fn=None,
+    emb_override: Optional[jax.Array] = None,  # [B, T, H] multimodal rows
+    emb_mask: Optional[jax.Array] = None,  # [B, T] True where override applies
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Batched chunked prefill: one dispatch processes chunks of SEVERAL
     sequences (the round-1 engine serialized one chunk per loop iteration).
-    Returns (logits_last [B, vocab], kv_k, kv_v)."""
+    Returns (logits_last [B, vocab], kv_k, kv_v).
+
+    `emb_override`/`emb_mask`: multimodal E/P/D splice — encoder-produced
+    embedding rows replace the placeholder tokens' embeddings at their
+    recorded positions (reference trtllm multimodal_epd.md flow)."""
     c = config
     mlp_fn = mlp_fn or _mlp
     B, T = tokens.shape
-    x = params["embed"][tokens]  # [B, T, H]
+    x = embed_rows(params["embed"], tokens, c.dtype)  # [B, T, H]
+    if emb_override is not None:
+        x = jnp.where(emb_mask[..., None], emb_override.astype(c.dtype), x)
     cos, sin = rope_cos_sin(positions, c.head_dim, c.rope_theta)
     page_size = kv_k.shape[2]
     total_lens = context_lens + last_idx + 1  # [B] valid context per seq
@@ -271,9 +280,9 @@ def prefill_forward_batched(
     for li in range(c.num_layers):
         layer = jax.tree.map(lambda p: p[li], params["layers"])
         h = rms_norm(x, layer["attn_norm"], c.rms_norm_eps)
-        q = jnp.dot(h, layer["wq"], preferred_element_type=jnp.float32).astype(c.dtype)
-        k = jnp.dot(h, layer["wk"], preferred_element_type=jnp.float32).astype(c.dtype)
-        v = jnp.dot(h, layer["wv"], preferred_element_type=jnp.float32).astype(c.dtype)
+        q = qdot(h, layer["wq"]).astype(c.dtype)
+        k = qdot(h, layer["wk"]).astype(c.dtype)
+        v = qdot(h, layer["wv"]).astype(c.dtype)
         q = q.reshape(B, T, c.num_heads, c.head_dim)
         k = k.reshape(B, T, c.num_kv_heads, c.head_dim)
         v = v.reshape(B, T, c.num_kv_heads, c.head_dim)
@@ -285,13 +294,13 @@ def prefill_forward_batched(
             q, kv_k[li], kv_v[li], positions, page_tables, total_lens, context_lens
         )
         attn = attn.reshape(B, T, c.num_heads * c.head_dim)
-        x = x + jnp.dot(attn, layer["wo"], preferred_element_type=jnp.float32).astype(c.dtype)
+        x = x + qdot(attn, layer["wo"]).astype(c.dtype)
         x = mlp_fn(layer, x, c)
 
     x = rms_norm(x, params["final_norm"], c.rms_norm_eps)
     last = x[jnp.arange(B), last_idx]  # [B, hidden]
-    head = params["lm_head"] if params["lm_head"] is not None else params["embed"].T
-    logits = jnp.dot(last, head, preferred_element_type=jnp.float32)
+    head = head_leaf(params)
+    logits = qdot(last, head)
     return logits, kv_k, kv_v
 
 
@@ -323,7 +332,7 @@ def prefill_forward_ring(
     mlp_fn = mlp_fn or _mlp
     T = tokens.shape[0]
     positions = jnp.arange(T, dtype=jnp.int32)
-    x = params["embed"][tokens]  # [T, H]
+    x = embed_rows(params["embed"], tokens, c.dtype)  # [T, H]
     cos, sin = rope_cos_sin(positions, c.head_dim, c.rope_theta)
     page_size = kv_k.shape[2]
 
@@ -335,9 +344,9 @@ def prefill_forward_ring(
     for li in range(c.num_layers):
         layer = jax.tree.map(lambda p: p[li], params["layers"])
         h = rms_norm(x, layer["attn_norm"], c.rms_norm_eps)
-        q = jnp.dot(h, layer["wq"], preferred_element_type=jnp.float32).astype(c.dtype)
-        k = jnp.dot(h, layer["wk"], preferred_element_type=jnp.float32).astype(c.dtype)
-        v = jnp.dot(h, layer["wv"], preferred_element_type=jnp.float32).astype(c.dtype)
+        q = qdot(h, layer["wq"]).astype(c.dtype)
+        k = qdot(h, layer["wk"]).astype(c.dtype)
+        v = qdot(h, layer["wv"]).astype(c.dtype)
         q = q.reshape(T, c.num_heads, c.head_dim)
         k = k.reshape(T, c.num_kv_heads, c.head_dim)
         v = v.reshape(T, c.num_kv_heads, c.head_dim)
@@ -347,13 +356,13 @@ def prefill_forward_ring(
         kv_v = kv_v.at[li, phys, offs].set(v)
         attn = ring_attention(q, k, v, mesh, axis_name=axis_name, causal=True)
         attn = attn.reshape(T, c.num_heads * c.head_dim)
-        x = x + jnp.dot(attn, layer["wo"], preferred_element_type=jnp.float32).astype(c.dtype)
+        x = x + qdot(attn, layer["wo"]).astype(c.dtype)
         x = mlp_fn(layer, x, c)
 
     x = rms_norm(x, params["final_norm"], c.rms_norm_eps)
     last = x[jnp.maximum(real_len - 1, 0)]
-    head = params["lm_head"] if params["lm_head"] is not None else params["embed"].T
-    logits = jnp.dot(last, head, preferred_element_type=jnp.float32)
+    head = head_leaf(params)
+    logits = qdot(last, head)
     return logits, kv_k, kv_v
 
 
@@ -377,9 +386,9 @@ def _stage_layers_decode(local_params, local_kv, x, aux, valid, c, mlp_fn):
     for li in range(n_local):
         layer = jax.tree.map(lambda p: p[li], local_params)
         h = rms_norm(x, layer["attn_norm"], c.rms_norm_eps)
-        q = jnp.dot(h, layer["wq"], preferred_element_type=jnp.float32).astype(c.dtype)
-        k = jnp.dot(h, layer["wk"], preferred_element_type=jnp.float32).astype(c.dtype)
-        v = jnp.dot(h, layer["wv"], preferred_element_type=jnp.float32).astype(c.dtype)
+        q = qdot(h, layer["wq"]).astype(c.dtype)
+        k = qdot(h, layer["wk"]).astype(c.dtype)
+        v = qdot(h, layer["wv"]).astype(c.dtype)
         q = q.reshape(-1, c.num_heads, c.head_dim)
         k = k.reshape(-1, c.num_kv_heads, c.head_dim)
         v = v.reshape(-1, c.num_kv_heads, c.head_dim)
@@ -389,7 +398,7 @@ def _stage_layers_decode(local_params, local_kv, x, aux, valid, c, mlp_fn):
         kv_v_loc = kv_v_loc.at[li, phys, offs].set(v)
         attn = paged_attention_decode(q, kv_k_loc[li], kv_v_loc[li], tables, seq_lens)
         attn = attn.reshape(-1, c.num_heads * c.head_dim)
-        x = x + jnp.dot(attn, layer["wo"], preferred_element_type=jnp.float32).astype(c.dtype)
+        x = x + qdot(attn, layer["wo"]).astype(c.dtype)
         x = mlp_fn(layer, x, c)
     return x, (kv_k_loc, kv_v_loc)
 
@@ -430,7 +439,7 @@ def decode_forward_pp(
         kv_k.reshape(S, L // S, *kv_k.shape[1:]),
         kv_v.reshape(S, L // S, *kv_v.shape[1:]),
     )
-    x = params["embed"][tokens]  # [B, H]
+    x = embed_rows(params["embed"], tokens, c.dtype)  # [B, H]
     x_mb = x.reshape(M, mb, -1)
     aux_mb = {
         "positions": positions.reshape(M, mb),
@@ -448,8 +457,8 @@ def decode_forward_pp(
     kv_v = kv_v_s.reshape(L, *kv_v.shape[1:])
     x = out.reshape(B, -1)
     x = rms_norm(x, params["final_norm"], c.rms_norm_eps)
-    head = params["lm_head"] if params["lm_head"] is not None else params["embed"].T
-    logits = jnp.dot(x, head, preferred_element_type=jnp.float32)
+    head = head_leaf(params)
+    logits = qdot(x, head)
     return logits, kv_k, kv_v
 
 
@@ -475,9 +484,9 @@ def _stage_layers_prefill(local_params, local_kv, x, aux, valid, c, mlp_fn):
     for li in range(n_local):
         layer = jax.tree.map(lambda p: p[li], local_params)
         h = rms_norm(x, layer["attn_norm"], c.rms_norm_eps)
-        q = jnp.dot(h, layer["wq"], preferred_element_type=jnp.float32).astype(c.dtype)
-        k = jnp.dot(h, layer["wk"], preferred_element_type=jnp.float32).astype(c.dtype)
-        v = jnp.dot(h, layer["wv"], preferred_element_type=jnp.float32).astype(c.dtype)
+        q = qdot(h, layer["wq"]).astype(c.dtype)
+        k = qdot(h, layer["wk"]).astype(c.dtype)
+        v = qdot(h, layer["wv"]).astype(c.dtype)
         q = q.reshape(-1, c.num_heads, c.head_dim)
         k = k.reshape(-1, c.num_kv_heads, c.head_dim)
         v = v.reshape(-1, c.num_kv_heads, c.head_dim)
@@ -490,7 +499,7 @@ def _stage_layers_prefill(local_params, local_kv, x, aux, valid, c, mlp_fn):
             context_len, total_len,
         )
         attn = attn.reshape(-1, c.num_heads * c.head_dim)
-        x = x + jnp.dot(attn, layer["wo"], preferred_element_type=jnp.float32).astype(c.dtype)
+        x = x + qdot(attn, layer["wo"]).astype(c.dtype)
         x = mlp_fn(layer, x, c)
     return x, (kv_k_loc, kv_v_loc)
 
@@ -529,7 +538,7 @@ def prefill_forward_pp(
         kv_v.reshape(S, L // S, *kv_v.shape[1:]),
     )
     positions = context_len + jnp.arange(T, dtype=jnp.int32)
-    x = params["embed"][tokens].reshape(M, t, -1)
+    x = embed_rows(params["embed"], tokens, c.dtype).reshape(M, t, -1)
     span_starts = context_len + jnp.arange(M, dtype=jnp.int32) * t
     span_real = jnp.clip(real_len - jnp.arange(M) * t, 0, t)  # real tokens/span
     aux_mb = {
@@ -551,8 +560,8 @@ def prefill_forward_pp(
     flat = out.reshape(T, -1)
     x = rms_norm(flat, params["final_norm"], c.rms_norm_eps)
     last = x[jnp.maximum(real_len - 1, 0)]
-    head = params["lm_head"] if params["lm_head"] is not None else params["embed"].T
-    logits = jnp.dot(last, head, preferred_element_type=jnp.float32)
+    head = head_leaf(params)
+    logits = qdot(last, head)
     return logits, kv_k, kv_v
 
 
@@ -580,16 +589,16 @@ def decode_forward(
     (logits [B, vocab], kv_k, kv_v)."""
     c = config
     mlp_fn = mlp_fn or _mlp
-    x = params["embed"][tokens]  # [B, H]
+    x = embed_rows(params["embed"], tokens, c.dtype)  # [B, H]
     cos, sin = rope_cos_sin(positions, c.head_dim, c.rope_theta)
     page_size = kv_k.shape[2]
 
     for li in range(c.num_layers):
         layer = jax.tree.map(lambda p: p[li], params["layers"])
         h = rms_norm(x, layer["attn_norm"], c.rms_norm_eps)
-        q = jnp.dot(h, layer["wq"], preferred_element_type=jnp.float32).astype(c.dtype)
-        k = jnp.dot(h, layer["wk"], preferred_element_type=jnp.float32).astype(c.dtype)
-        v = jnp.dot(h, layer["wv"], preferred_element_type=jnp.float32).astype(c.dtype)
+        q = qdot(h, layer["wq"]).astype(c.dtype)
+        k = qdot(h, layer["wk"]).astype(c.dtype)
+        v = qdot(h, layer["wv"]).astype(c.dtype)
         q = q.reshape(-1, c.num_heads, c.head_dim)
         k = k.reshape(-1, c.num_kv_heads, c.head_dim)
         v = v.reshape(-1, c.num_kv_heads, c.head_dim)
@@ -609,12 +618,12 @@ def decode_forward(
         kv_v = kv_v.at[li, phys, offs].set(v[:, 0] if v.ndim == 4 else v)
         attn = paged_attention_decode(q, kv_k[li], kv_v[li], page_tables, seq_lens)
         attn = attn.reshape(-1, c.num_heads * c.head_dim)
-        x = x + jnp.dot(attn, layer["wo"], preferred_element_type=jnp.float32).astype(c.dtype)
+        x = x + qdot(attn, layer["wo"]).astype(c.dtype)
         x = mlp_fn(layer, x, c)
 
     x = rms_norm(x, params["final_norm"], c.rms_norm_eps)
-    head = params["lm_head"] if params["lm_head"] is not None else params["embed"].T
-    logits = jnp.dot(x, head, preferred_element_type=jnp.float32)
+    head = head_leaf(params)
+    logits = qdot(x, head)
     return logits, kv_k, kv_v
 
 
@@ -644,16 +653,16 @@ def decode_forward_local(
 
     c = config
     mlp_fn = mlp_fn or _mlp
-    x = params["embed"][tokens]
+    x = embed_rows(params["embed"], tokens, c.dtype)
     cos, sin = rope_cos_sin(positions, c.head_dim, c.rope_theta)
     loc_k, loc_v = list(loc_k), list(loc_v)
 
     for li in range(c.num_layers):
         layer = jax.tree.map(lambda p: p[li], params["layers"])
         h = rms_norm(x, layer["attn_norm"], c.rms_norm_eps)
-        q = jnp.dot(h, layer["wq"], preferred_element_type=jnp.float32).astype(c.dtype)
-        k = jnp.dot(h, layer["wk"], preferred_element_type=jnp.float32).astype(c.dtype)
-        v = jnp.dot(h, layer["wv"], preferred_element_type=jnp.float32).astype(c.dtype)
+        q = qdot(h, layer["wq"]).astype(c.dtype)
+        k = qdot(h, layer["wk"]).astype(c.dtype)
+        v = qdot(h, layer["wv"]).astype(c.dtype)
         q = q.reshape(-1, c.num_heads, c.head_dim)
         k = k.reshape(-1, c.num_kv_heads, c.head_dim)
         v = v.reshape(-1, c.num_kv_heads, c.head_dim)
@@ -666,12 +675,12 @@ def decode_forward_local(
             loc_k[li], loc_v[li], step_idx,
         )
         attn = attn.reshape(-1, c.num_heads * c.head_dim)
-        x = x + jnp.dot(attn, layer["wo"], preferred_element_type=jnp.float32).astype(c.dtype)
+        x = x + qdot(attn, layer["wo"]).astype(c.dtype)
         x = mlp_fn(layer, x, c)
 
     x = rms_norm(x, params["final_norm"], c.rms_norm_eps)
-    head = params["lm_head"] if params["lm_head"] is not None else params["embed"].T
-    logits = jnp.dot(x, head, preferred_element_type=jnp.float32)
+    head = head_leaf(params)
+    logits = qdot(x, head)
     return logits, tuple(loc_k), tuple(loc_v)
 
 

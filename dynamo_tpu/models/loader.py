@@ -20,7 +20,63 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-__all__ = ["load_llama_params", "load_moe_params", "save_llama_as_hf"]
+__all__ = [
+    "load_llama_params",
+    "load_moe_params",
+    "resolve_model_path",
+    "save_llama_as_hf",
+]
+
+
+def _looks_like_repo_id(s: str) -> bool:
+    """org/name shape, no leading slash or drive, at most one separator."""
+    if s.startswith(("/", ".", "~")) or "\\" in s:
+        return False
+    parts = s.split("/")
+    return len(parts) == 2 and all(p and not p.startswith(".") for p in parts)
+
+
+def resolve_model_path(
+    path_or_repo: str,
+    revision: Optional[str] = None,
+    allow_download: Optional[bool] = None,
+) -> str:
+    """Resolve a local checkpoint directory OR a HuggingFace repo id to a
+    directory of safetensors (reference LocalModelBuilder,
+    lib/llm/src/local_model.rs:44-120: same local-path-else-hub contract).
+
+    Repo ids resolve through the hub cache first (offline); an actual
+    download happens only when allowed — `allow_download=True` or
+    `DYN_HF_ALLOW_DOWNLOAD=1` — because serving environments are often
+    egress-free and a surprise download would hang worker startup."""
+    p = Path(os.path.expanduser(path_or_repo))
+    if p.exists():
+        return str(p)
+    if not _looks_like_repo_id(path_or_repo):
+        raise FileNotFoundError(
+            f"model path {path_or_repo!r} does not exist and is not an "
+            f"HF repo id"
+        )
+    from huggingface_hub import snapshot_download
+
+    if allow_download is None:
+        allow_download = os.environ.get("DYN_HF_ALLOW_DOWNLOAD") == "1"
+    try:
+        return snapshot_download(
+            path_or_repo, revision=revision, local_files_only=True
+        )
+    except Exception:
+        if not allow_download:
+            raise FileNotFoundError(
+                f"{path_or_repo!r} is not a local path and not in the HF "
+                f"cache; set DYN_HF_ALLOW_DOWNLOAD=1 (or pass "
+                f"allow_download=True) to fetch it from the hub"
+            ) from None
+    return snapshot_download(
+        path_or_repo,
+        revision=revision,
+        allow_patterns=["*.safetensors*", "*.json", "tokenizer*"],
+    )
 
 
 def _open_checkpoint(model_dir: str) -> Dict[str, Any]:
@@ -28,7 +84,7 @@ def _open_checkpoint(model_dir: str) -> Dict[str, Any]:
     and index-sharded safetensors layouts."""
     from safetensors import safe_open
 
-    d = Path(model_dir)
+    d = Path(resolve_model_path(model_dir))
     index = d / "model.safetensors.index.json"
     files: Dict[str, Path] = {}
     handles: Dict[Path, Any] = {}
@@ -149,19 +205,42 @@ def _stack_layers(reader, names_fn, num_layers: int, transpose: bool) -> np.ndar
     return np.stack(mats)
 
 
+def _place_quant(qleaf: Dict[str, np.ndarray], sharding=None):
+    """Device-place a host-quantized {"q", "s"} leaf; the scale gets the
+    leaf's sharding with singleton axes unsharded."""
+    import jax
+
+    from .quant import scale_sharding
+
+    if sharding is None:
+        return {"q": jax.device_put(qleaf["q"]), "s": jax.device_put(qleaf["s"])}
+    return {
+        "q": jax.device_put(qleaf["q"], sharding),
+        "s": jax.device_put(qleaf["s"], scale_sharding(sharding, qleaf["s"].shape)),
+    }
+
+
 class _TreeBuilder:
     """Shared backbone assembly (embed / attention / norms / lm_head) for
     the llama and moe trees — the MLP block is the only difference."""
 
-    def __init__(self, reader, config, shardings: Optional[dict]):
+    def __init__(self, reader, config, shardings: Optional[dict],
+                 quantize: Optional[str] = None):
+        if quantize not in (None, "int8"):
+            raise ValueError(f"unknown quantize mode {quantize!r}")
         self.r = reader
         self.c = config
         self.sh = shardings or {}
+        self.quantize = quantize
 
     def layer_sh(self, key):
         return self.sh.get("layers", {}).get(key) if self.sh else None
 
     def stacked(self, key, hf_fmt, transpose=True):
+        from .quant import _LAYER_LEAVES
+
+        if self.quantize == "int8" and key in _LAYER_LEAVES:
+            return self._stacked_quant(key, hf_fmt, transpose)
         return _place_stacked(
             self.r,
             lambda li: hf_fmt.format(li=li),
@@ -171,12 +250,46 @@ class _TreeBuilder:
             self.layer_sh(key),
         )
 
+    def _stacked_quant(self, key, hf_fmt, transpose):
+        """Quantize a layer-stacked projection at load. Scales are per
+        out-channel over the FULL contraction axis, so (unlike the bf16
+        path) each layer's whole tensor is read to host before placement
+        — peak host memory is one f32 layer leaf plus the int8 stack."""
+        from .quant import quantize_array
+
+        c = self.c
+        first = self.r.get_slice(hf_fmt.format(li=0))
+        lshape = tuple(first.get_shape())
+        if transpose:
+            lshape = lshape[::-1]
+        q_buf = np.empty((c.num_layers, *lshape), np.int8)
+        s_buf = np.empty((c.num_layers, 1, lshape[-1]), np.float32)
+        for li in range(c.num_layers):
+            m = self.r.get(hf_fmt.format(li=li))
+            if transpose:
+                m = m.T
+            ql = quantize_array(np.asarray(m, np.float32))
+            q_buf[li], s_buf[li] = ql["q"], ql["s"]
+        return _place_quant({"q": q_buf, "s": s_buf}, self.layer_sh(key))
+
+    def _backbone_embed(self):
+        c, r, sh = self.c, self.r, self.sh
+        emb = r.get("model.embed_tokens.weight")
+        if self.quantize == "int8":
+            from .quant import quantize_array
+
+            # per-ROW scale: rows gather as output vectors, and the
+            # transpose doubles as the tied lm_head (quant.head_leaf)
+            return _place_quant(
+                quantize_array(np.asarray(emb, np.float32), contract_axis=-1),
+                sh.get("embed"),
+            )
+        return _place(emb, c.dtype, sh.get("embed"))
+
     def backbone(self) -> Dict[str, Any]:
         c, r, sh = self.c, self.r, self.sh
         params: Dict[str, Any] = {
-            "embed": _place(
-                r.get("model.embed_tokens.weight"), c.dtype, sh.get("embed")
-            ),
+            "embed": self._backbone_embed(),
             "layers": {
                 "attn_norm": self.stacked(
                     "attn_norm", "model.layers.{li}.input_layernorm.weight",
@@ -198,6 +311,13 @@ class _TreeBuilder:
         }
         if c.tie_embeddings or "lm_head.weight" not in r:
             params["lm_head"] = None
+        elif self.quantize == "int8":
+            from .quant import quantize_array
+
+            params["lm_head"] = _place_quant(
+                quantize_array(np.asarray(r.get("lm_head.weight").T, np.float32)),
+                sh.get("lm_head"),
+            )
         else:
             params["lm_head"] = _place(
                 r.get("lm_head.weight").T, c.dtype, sh.get("lm_head")
@@ -209,11 +329,14 @@ def load_llama_params(
     model_dir: str,
     config,
     shardings: Optional[dict] = None,
+    quantize: Optional[str] = None,
 ) -> Dict[str, Any]:
     """Load an HF llama-family checkpoint into the models/llama.py tree.
     `shardings` (from LlamaShardings.param_shardings()) places each leaf on
-    the mesh as it loads."""
-    b = _TreeBuilder(_open_checkpoint(model_dir), config, shardings)
+    the mesh as it loads. `quantize="int8"` stores projections/embed/head
+    as int8 + per-channel scales (models/quant.py) — llama3-8b drops from
+    ~16 GB to ~8.5 GB and fits a v5e chip beside its KV pool."""
+    b = _TreeBuilder(_open_checkpoint(model_dir), config, shardings, quantize)
     params = b.backbone()
     params["layers"].update(
         {
@@ -229,13 +352,16 @@ def load_moe_params(
     model_dir: str,
     config,
     shardings: Optional[dict] = None,
+    quantize: Optional[str] = None,
 ) -> Dict[str, Any]:
     """Load an HF mixtral-family checkpoint into the models/moe.py tree
-    (block_sparse_moe.gate + experts.N.w1/w2/w3)."""
+    (block_sparse_moe.gate + experts.N.w1/w2/w3). `quantize="int8"`
+    applies to the attention backbone + embed/head; expert stacks stay in
+    the model dtype (quant.py scope note)."""
     import jax.numpy as jnp
 
     c = config
-    b = _TreeBuilder(_open_checkpoint(model_dir), config, shardings)
+    b = _TreeBuilder(_open_checkpoint(model_dir), config, shardings, quantize)
     r = b.r
 
     def stacked_experts(key, hf_fmt):

@@ -144,15 +144,23 @@ class DpAttentionShardings(MoeShardings):
 
 
 def shard_params(params: dict, shardings) -> dict:
-    """Place a param pytree onto the mesh (works for freshly-initialized or
-    loaded params)."""
+    """Place a param pytree onto the mesh (works for freshly-initialized,
+    loaded, or int8-quantized params — a quantized leaf's scale gets the
+    leaf's sharding with singleton axes unsharded)."""
+    from ..models.quant import is_quant, scale_sharding
+
     shard_tree = shardings.param_shardings()
 
     def place(x, s):
         if x is None:
             return None
+        if is_quant(x):
+            return {
+                "q": jax.device_put(x["q"], s),
+                "s": jax.device_put(x["s"], scale_sharding(s, x["s"].shape)),
+            }
         return jax.device_put(x, s)
 
     return jax.tree.map(
-        place, params, shard_tree, is_leaf=lambda x: x is None
+        place, params, shard_tree, is_leaf=lambda x: x is None or is_quant(x)
     )

@@ -138,3 +138,71 @@ class TestMoeLoader:
                 np.asarray(orig, np.float32), np.asarray(new, np.float32),
                 atol=0, err_msg=str(ko),
             )
+
+
+class TestResolveModelPath:
+    """HF-hub model resolve (reference local_model.rs:44-120): local paths
+    pass through; repo ids hit the hub cache offline-first; downloads are
+    gated behind DYN_HF_ALLOW_DOWNLOAD."""
+
+    def test_local_path_passthrough(self, tmp_path):
+        from dynamo_tpu.models.loader import resolve_model_path
+
+        assert resolve_model_path(str(tmp_path)) == str(tmp_path)
+
+    def test_non_repo_id_missing_path_raises(self):
+        from dynamo_tpu.models.loader import resolve_model_path
+
+        with pytest.raises(FileNotFoundError, match="does not exist"):
+            resolve_model_path("/no/such/dir")
+        with pytest.raises(FileNotFoundError, match="does not exist"):
+            resolve_model_path("a/b/c")  # three segments: not a repo id
+
+    def test_repo_id_resolves_from_faked_hub(self, tiny_ckpt, monkeypatch):
+        import huggingface_hub
+
+        from dynamo_tpu.models.loader import load_llama_params, resolve_model_path
+
+        cfg, params, ckpt = tiny_ckpt
+        calls = []
+
+        def fake_snapshot_download(repo_id, revision=None, **kw):
+            calls.append(kw)
+            if kw.get("local_files_only"):
+                raise FileNotFoundError("not in cache")
+            return str(ckpt)
+
+        monkeypatch.setattr(
+            huggingface_hub, "snapshot_download", fake_snapshot_download
+        )
+        # cache miss + downloads not allowed -> actionable error, no egress
+        with pytest.raises(FileNotFoundError, match="DYN_HF_ALLOW_DOWNLOAD"):
+            resolve_model_path("meta-llama/tiny-test")
+        assert len(calls) == 1 and calls[0]["local_files_only"]
+
+        # allowed -> falls through to the (faked) download
+        path = resolve_model_path("meta-llama/tiny-test", allow_download=True)
+        assert path == str(ckpt)
+        assert load_llama_params is not None  # loader import exercised
+
+    def test_loader_accepts_repo_id_via_env_flag(self, tiny_ckpt, monkeypatch):
+        import huggingface_hub
+
+        from dynamo_tpu.models.loader import load_llama_params
+
+        cfg, params, ckpt = tiny_ckpt
+
+        def fake_snapshot_download(repo_id, revision=None, **kw):
+            if kw.get("local_files_only"):
+                raise FileNotFoundError("not in cache")
+            return str(ckpt)
+
+        monkeypatch.setattr(
+            huggingface_hub, "snapshot_download", fake_snapshot_download
+        )
+        monkeypatch.setenv("DYN_HF_ALLOW_DOWNLOAD", "1")
+        loaded = load_llama_params("meta-llama/tiny-test", cfg)
+        np.testing.assert_allclose(
+            np.asarray(loaded["layers"]["wq"]), np.asarray(params["layers"]["wq"]),
+            atol=0,
+        )

@@ -1,0 +1,240 @@
+"""Int8 weight-only quantization (models/quant.py) + the output-quality
+gate: greedy continuation against the HF transformers CPU reference
+(round-3 verdict #3 — real-checkpoint serving must be verifiable).
+
+Layers of proof:
+  * qdot == dot(dequantized) numerically (plumbing correctness)
+  * quantized forward ~= fp forward (bounded quantization error)
+  * loader-time quantization == tree-time quantization (same arithmetic)
+  * sharded quantized load places q AND s on the mesh, same math
+  * engine generates deterministically with quantize="int8"
+  * greedy parity gate: our engine on an HF checkpoint reproduces HF's
+    greedy continuation token-for-token (bf16) and under int8
+"""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.models import llama
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.models import quant
+from dynamo_tpu.models.loader import load_llama_params
+
+
+def test_qdot_matches_dequant_dot():
+    rng = np.random.RandomState(0)
+    w = rng.randn(32, 48).astype(np.float32)
+    x = rng.randn(4, 32).astype(np.float32)
+    ql = quant.quantize_array(w)
+    assert ql["q"].dtype == np.int8 and ql["s"].shape == (1, 48)
+    ref = x @ np.asarray(quant.dequantize_leaf(ql, jnp.float32))
+    out = np.asarray(quant.qdot(jnp.asarray(x), jax.tree.map(jnp.asarray, ql)))
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+    # quantization error itself is bounded (per-channel symmetric int8)
+    err = np.abs(np.asarray(quant.dequantize_leaf(ql, jnp.float32)) - w)
+    assert err.max() <= (np.abs(w).max(axis=0) / 127.0 * 0.51 + 1e-6).max()
+
+
+def test_quantize_tree_decode_close_to_fp():
+    cfg = llama.LlamaConfig.tiny(dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    qparams = quant.quantize_tree(params)
+    assert quant.is_quant(qparams["layers"]["wq"])
+    assert quant.is_quant(qparams["embed"])
+
+    from dynamo_tpu.engine.kv_cache import alloc_kv_arrays
+
+    kv_k, kv_v = alloc_kv_arrays(cfg.num_layers, 8, 8, cfg.num_kv_heads,
+                                 cfg.head_dim, cfg.dtype)
+    B = 4
+    args = (
+        jnp.array([3, 5, 7, 9], jnp.int32),
+        jnp.zeros((B,), jnp.int32),
+        kv_k, kv_v,
+        jnp.ones((B, 2), jnp.int32),
+        jnp.ones((B,), jnp.int32),
+    )
+    lq, *_ = llama.decode_forward(qparams, cfg, *args)
+    lf, *_ = llama.decode_forward(params, cfg, *args)
+    # quantized forward tracks fp closely (per-channel int8, tiny model)
+    lq, lf = np.asarray(lq), np.asarray(lf)
+    denom = np.maximum(np.abs(lf).max(), 1e-3)
+    assert np.abs(lq - lf).max() / denom < 0.08
+    # and exactly matches the dequantize-then-run forward
+    deq = jax.tree.map(
+        lambda x: quant.dequantize_leaf(x, cfg.dtype) if quant.is_quant(x) else x,
+        qparams, is_leaf=lambda x: x is None or quant.is_quant(x),
+    )
+    ld, *_ = llama.decode_forward(deq, cfg, *args)
+    np.testing.assert_allclose(lq, np.asarray(ld), rtol=2e-4, atol=2e-4)
+
+
+@pytest.fixture()
+def tiny_f32_ckpt(tmp_path):
+    from dynamo_tpu.models.loader import save_llama_as_hf
+
+    cfg = llama.LlamaConfig.tiny(dtype=jnp.float32, tie_embeddings=False)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    save_llama_as_hf(params, cfg, str(tmp_path))
+    return cfg, params, tmp_path
+
+
+def test_loader_quantize_matches_tree_quantize(tiny_f32_ckpt):
+    cfg, params, ckpt = tiny_f32_ckpt
+    loaded = load_llama_params(str(ckpt), cfg, quantize="int8")
+    expected = quant.quantize_tree(params)
+    for name in ("wq", "wo", "w_down"):
+        np.testing.assert_array_equal(
+            np.asarray(loaded["layers"][name]["q"]),
+            np.asarray(expected["layers"][name]["q"]),
+        )
+        np.testing.assert_allclose(
+            np.asarray(loaded["layers"][name]["s"]),
+            np.asarray(expected["layers"][name]["s"]), rtol=1e-6,
+        )
+    np.testing.assert_array_equal(
+        np.asarray(loaded["embed"]["q"]), np.asarray(expected["embed"]["q"])
+    )
+    assert loaded["embed"]["s"].shape == (cfg.vocab_size, 1)
+
+
+def test_sharded_quantized_load_and_shard_params(tiny_f32_ckpt):
+    from dynamo_tpu.parallel.mesh import (
+        LlamaShardings, ParallelConfig, build_mesh, shard_params,
+    )
+
+    cfg, params, ckpt = tiny_f32_ckpt
+    mesh = build_mesh(ParallelConfig(tp_size=2, dp_size=4))
+    sh = LlamaShardings(mesh)
+    loaded = load_llama_params(
+        str(ckpt), cfg, shardings=sh.param_shardings(), quantize="int8"
+    )
+    wq = loaded["layers"]["wq"]
+    assert wq["q"].sharding.spec == sh.param_specs()["layers"]["wq"]
+    # row-parallel wo shards the contraction axis; its scale must NOT
+    # (singleton axis) — the scale_sharding rule
+    wo_s_spec = loaded["layers"]["wo"]["s"].sharding.spec
+    assert all(e is None for e in wo_s_spec)
+    # shard_params on a tree-quantized host tree places the same way
+    qtree = quant.quantize_tree(params)
+    placed = shard_params(qtree, sh)
+    np.testing.assert_array_equal(
+        np.asarray(placed["layers"]["wq"]["q"]), np.asarray(wq["q"])
+    )
+
+
+def test_engine_generates_with_int8():
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import JaxEngine
+
+    async def run():
+        engine = JaxEngine(EngineConfig(
+            model="tiny", max_num_seqs=4, page_size=8, num_pages=64,
+            max_model_len=128, quantize="int8",
+        ))
+        req = {
+            "request_id": "q1",
+            "token_ids": list(range(5, 21)),
+            "stop_conditions": {"max_tokens": 12, "ignore_eos": True},
+            "sampling_options": {"temperature": 0.0},
+        }
+        toks = []
+        async for out in engine.generate(dict(req), Context()):
+            data = out.get("data") or {}
+            toks.extend(data.get("token_ids") or [])
+        toks2 = []
+        async for out in engine.generate(dict(req, request_id="q2"), Context()):
+            data = out.get("data") or {}
+            toks2.extend(data.get("token_ids") or [])
+        await engine.close()
+        return toks, toks2
+
+    toks, toks2 = asyncio.run(run())
+    assert len(toks) == 12
+    assert toks == toks2  # greedy + prefix cache reuse stay deterministic
+
+
+# --------------------------------------------------------------------- #
+# output-quality gate vs the HF transformers CPU reference
+# --------------------------------------------------------------------- #
+
+
+@pytest.fixture(scope="module")
+def hf_tiny_ckpt(tmp_path_factory):
+    torch = pytest.importorskip("torch")
+    from transformers import LlamaConfig as HfLlamaConfig
+    from transformers import LlamaForCausalLM
+
+    torch.manual_seed(7)
+    hf_cfg = HfLlamaConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, rms_norm_eps=1e-5, rope_theta=10000.0,
+        tie_word_embeddings=False, torch_dtype=torch.float32,
+    )
+    model = LlamaForCausalLM(hf_cfg)
+    model.eval()
+    out = tmp_path_factory.mktemp("hf_tiny")
+    model.save_pretrained(out, safe_serialization=True)
+
+    prompt = [7, 42, 101, 9, 250, 33, 17, 5]
+    n_new = 16
+    with torch.no_grad():
+        gen = model.generate(
+            torch.tensor([prompt]), max_new_tokens=n_new, do_sample=False,
+            use_cache=True, pad_token_id=0,
+        )
+    ref = [int(t) for t in gen[0][len(prompt):]]
+    return out, prompt, ref
+
+
+def _engine_greedy(ckpt_dir, prompt, n_new, quantize=None):
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import JaxEngine
+
+    cfg = llama.LlamaConfig.tiny(
+        dtype=jnp.float32, rope_theta=10000.0, tie_embeddings=False,
+    )
+    params = load_llama_params(str(ckpt_dir), cfg, quantize=quantize)
+
+    async def run():
+        engine = JaxEngine(
+            EngineConfig(model="tiny", max_num_seqs=2, page_size=8,
+                         num_pages=64, max_model_len=128),
+            model_config=cfg, params=params,
+        )
+        toks = []
+        req = {
+            "request_id": "gate",
+            "token_ids": list(prompt),
+            "stop_conditions": {"max_tokens": n_new, "ignore_eos": True},
+            "sampling_options": {"temperature": 0.0},
+        }
+        async for out in engine.generate(req, Context()):
+            data = out.get("data") or {}
+            toks.extend(data.get("token_ids") or [])
+        await engine.close()
+        return toks
+
+    return asyncio.run(run())
+
+
+def test_quality_gate_greedy_matches_hf(hf_tiny_ckpt):
+    """The verdict-#3 gate: greedy continuation of a fixed prompt through
+    OUR engine on an HF checkpoint must match transformers token-for-token."""
+    ckpt, prompt, ref = hf_tiny_ckpt
+    toks = _engine_greedy(ckpt, prompt, len(ref))
+    assert toks == ref, f"engine {toks} != hf {ref}"
+
+
+def test_quality_gate_int8_close_to_hf(hf_tiny_ckpt):
+    """Int8 weight-only quantization must preserve the greedy continuation
+    on the reference checkpoint (tiny model, well-separated logits)."""
+    ckpt, prompt, ref = hf_tiny_ckpt
+    toks = _engine_greedy(ckpt, prompt, len(ref), quantize="int8")
+    agree = sum(a == b for a, b in zip(toks, ref))
+    assert agree >= len(ref) - 1, f"int8 {toks} vs hf {ref} ({agree} agree)"
