@@ -1,0 +1,73 @@
+"""Encode worker: `python -m dynamo_tpu.encode_worker` — the E of E/P/D.
+
+Turns multimodal content parts into embedding tensors for the prefill
+engine's splice (reference: the trtllm encode worker in
+components/backends/trtllm/multimodal_epd.md; the processor role in
+multimodal_processor.py). Registers a plain runtime endpoint (no model
+card — it is not a generation model); the frontend's ModelPipeline calls
+it when configured with --encoder (llm/service.py encode hop).
+"""
+
+import argparse
+import asyncio
+import logging
+
+from dynamo_tpu.llm.multimodal import DEFAULT_MM_TOKENS, MockVisionEncoder, encode_parts
+from dynamo_tpu.runtime import DistributedRuntime, RuntimeConfig, init_logging
+
+logger = logging.getLogger("dynamo_tpu.encode_worker")
+
+
+def parse_args():
+    ap = argparse.ArgumentParser(description="dynamo-tpu encode worker (multimodal E/P/D)")
+    ap.add_argument("--namespace", default="dynamo")
+    ap.add_argument("--component", default="encoder")
+    ap.add_argument("--endpoint", default="encode")
+    ap.add_argument("--discovery", default=None, help="tcp://host:port of discovery")
+    ap.add_argument("--hidden-size", type=int, default=None,
+                    help="embedding width; defaults from --model")
+    ap.add_argument("--model", default="tiny",
+                    help="model registry key the embeddings target")
+    ap.add_argument("--mm-tokens", type=int, default=DEFAULT_MM_TOKENS,
+                    help="placeholder span length per content part")
+    return ap.parse_args()
+
+
+async def main():
+    init_logging()
+    args = parse_args()
+    cfg = RuntimeConfig.from_settings()
+    if args.discovery:
+        cfg.discovery_endpoint = args.discovery
+    drt = await DistributedRuntime.create(cfg)
+
+    hidden = args.hidden_size
+    if hidden is None:
+        from dynamo_tpu.engine.engine import _resolve_model
+
+        hidden = _resolve_model(args.model).hidden_size
+    encoder = MockVisionEncoder(hidden, n_tokens=args.mm_tokens)
+    n_encoded = 0
+
+    endpoint = (
+        drt.namespace(args.namespace).component(args.component).endpoint(args.endpoint)
+    )
+
+    async def handler(request, context):
+        nonlocal n_encoded
+        parts = request.get("multimodal") or []
+        encoded = encode_parts(parts, encoder)
+        n_encoded += len(encoded)
+        logger.info("encoded %d part(s) (total %d)", len(encoded), n_encoded)
+        yield {"data": {"multimodal": encoded, "n_tokens": encoder.n_tokens}}
+
+    logger.info(
+        "encode worker up: hidden=%d mm_tokens=%d instance=%x",
+        hidden, encoder.n_tokens, drt.instance_id,
+    )
+    await endpoint.serve_endpoint(handler)
+    await drt.wait_for_shutdown()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

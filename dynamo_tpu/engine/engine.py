@@ -108,6 +108,7 @@ class _Slot:
     preloaded: Optional[tuple] = None  # decode role: (first_tok, k, v, n_tokens)
     pull_desc: Optional[dict] = None  # decode role: pull-path descriptor
     onboard: Optional[tuple] = None  # KVBM tier hit: (alloc_pages, hashes)
+    mm: Optional[List[tuple]] = None  # multimodal splices: (position, emb [n, H])
 
 
 class JaxEngine:
@@ -145,7 +146,15 @@ class JaxEngine:
 
         self._model = moe if isinstance(c, moe.MoeConfig) else llama
         key = jax.random.PRNGKey(config.seed)
-        self.params = params if params is not None else self._model.init_params(c, key)
+        if params is None:
+            params = self._model.init_params(c, key)
+            if config.quantize == "int8":
+                from ..models.quant import quantize_tree
+
+                params = quantize_tree(params)
+            elif config.quantize:
+                raise ValueError(f"unknown quantize mode {config.quantize!r}")
+        self.params = params
         # +1: physical page 0 is scratch. If the layout shards the PAGE axis
         # (dp-attention: pages over ep), round the pool up to a shardable
         # size — the allocator still manages only num_pages, spares idle.
@@ -217,6 +226,12 @@ class JaxEngine:
         self._step_counter = 0
         self.num_requests = 0
         self.num_preemptions = 0
+        # decode-side data-plane counters (the serving side counts on the
+        # KvDataPlaneServer): how many remote-prefill KV pulls actually
+        # landed, and how many pages moved — the disagg tests assert on
+        # these instead of grepping logs
+        self.kv_pulls_completed = 0
+        self.kv_pages_pulled = 0
         self._admit_counter = 0
         # decode pipeline: device-resident carry (tokens/positions/seq_lens)
         # + up to two in-flight K-step blocks
@@ -375,6 +390,24 @@ class JaxEngine:
 
         self._prefill_batch = prefill_batch
 
+        @partial(jax.jit, donate_argnums=(1, 2, 9), out_shardings=prefill_out_sh)
+        def prefill_batch_mm(params, kv_k, kv_v, tokens, positions, page_tables,
+                             ctx_lens, last_idx, samp, rng, emb, emb_mask):
+            """Batched prefill with the multimodal embedding splice: encoder
+            rows replace placeholder-token embeddings (E/P/D flow). A
+            separate program so text-only dispatches never carry the
+            [B, T, H] override operand. jax.jit is lazy — this compiles
+            only when a multimodal request actually arrives."""
+            rng, sub = jax.random.split(rng)
+            logits, kv_k, kv_v = self._model.prefill_forward_batched(
+                params, c, tokens, positions, kv_k, kv_v, page_tables,
+                ctx_lens, last_idx, emb_override=emb, emb_mask=emb_mask,
+            )
+            first = sample(logits, samp, sub)
+            return first, kv_k, kv_v, rng
+
+        self._prefill_batch_mm = prefill_batch_mm
+
         # single-sequence prefill variants for the native parallel layouts
         # (SURVEY.md §2.5): ring attention over sp (long-context), layer
         # pipeline over pp. Both sample the first token on device.
@@ -471,6 +504,49 @@ class JaxEngine:
                 await asyncio.sleep(0.01)
             self.kvbm.manager.flush()
 
+    def _check_multimodal(self, req: PreprocessedRequest) -> Optional[str]:
+        """None when the request is serveable; else the rejection reason.
+        Serveable = text-only, OR every part carries encoder embeddings +
+        a placeholder position (the encode hop ran; llm/multimodal.py)."""
+        if not req.multimodal:
+            return None
+        H = self.model_config.hidden_size
+        for p in req.multimodal:
+            if p.get("embedding") is None or p.get("position") is None:
+                return (
+                    f"model {self.config.model!r} needs encoder embeddings "
+                    f"for multimodal parts (type={p.get('type')!r}); "
+                    f"deploy an encode worker (dynamo_tpu.encode_worker)"
+                )
+            # a malformed embedding must fail THIS request at admission —
+            # inside the shared prefill dispatch it would _fail_all
+            # co-active requests
+            try:
+                arr = np.asarray(p["embedding"], np.float32)
+            except (ValueError, TypeError):
+                return "multimodal embedding is not a numeric [n, hidden] array"
+            if arr.ndim != 2 or arr.shape[1] != H or arr.shape[0] == 0:
+                return (
+                    f"multimodal embedding shape {arr.shape} does not match "
+                    f"[n>0, hidden={H}] — encode worker configured for a "
+                    f"different model?"
+                )
+            # keep the converted array: real encoders are MBs of nested
+            # lists off the wire; _slot_mm must not convert again
+            p["embedding"] = arr
+        if self.config.pp_size > 1 or self.config.sp_size > 1:
+            return "multimodal splice is not supported on pp/sp layouts yet"
+        return None
+
+    @staticmethod
+    def _slot_mm(req: PreprocessedRequest) -> Optional[List[tuple]]:
+        if not req.multimodal:
+            return None
+        return [
+            (int(p["position"]), np.asarray(p["embedding"], np.float32))
+            for p in req.multimodal
+        ]
+
     def _new_slot(self, req: PreprocessedRequest, context: Context, suffix: str = "") -> _Slot:
         stop = req.stop_conditions or {}
         sampling = req.sampling_options or {}
@@ -487,6 +563,7 @@ class JaxEngine:
             seq=TokenBlockSequence(req.token_ids, self.config.page_size),
         )
         slot.kv_prompt = slot.prompt
+        slot.mm = self._slot_mm(req)
         slot.temperature = float(
             sampling.get("temperature", self.config.default_temperature) or 0.0
         )
@@ -503,14 +580,13 @@ class JaxEngine:
             if isinstance(request, PreprocessedRequest)
             else PreprocessedRequest.from_dict(request)
         )
-        if req.multimodal:
-            # text-only engine: silently dropping image/audio parts would
-            # be a wrong answer, not a degraded one (protocol contract in
-            # protocols/common.py)
-            yield Annotated.from_error(
-                f"model {self.config.model!r} is text-only; request carries "
-                f"{len(req.multimodal)} multimodal content part(s)"
-            ).to_dict()
+        mm_err = self._check_multimodal(req)
+        if mm_err is not None:
+            # silently dropping image/audio parts would be a wrong answer,
+            # not a degraded one (protocol contract in protocols/common.py).
+            # Parts that arrived WITH encoder embeddings + positions are
+            # spliced at prefill instead (E/P/D flow, _prefill_batch_mm).
+            yield Annotated.from_error(mm_err).to_dict()
             return
         slot = self._new_slot(req, context)
         disagg = req.disagg_params or {}
@@ -617,6 +693,8 @@ class JaxEngine:
         if self.data_plane is not None:
             out["kv_transfers_served"] = self.data_plane.transfers_served
             out["kv_bytes_served"] = self.data_plane.bytes_served
+        out["kv_pulls_completed"] = self.kv_pulls_completed
+        out["kv_pages_pulled"] = self.kv_pages_pulled
         return out
 
     # ------------------------------------------------------------------ #
@@ -793,6 +871,29 @@ class JaxEngine:
         )
         return first
 
+    def _dev_prefill_mm(self, toks, positions, tables, ctx_lens, last_idx,
+                        temps, top_ks, top_ps, emb, emb_mask):
+        samp = SamplingParams(
+            temperature=jnp.asarray(temps),
+            top_k=jnp.asarray(top_ks),
+            top_p=jnp.asarray(top_ps),
+        )
+        first, self.kv_k, self.kv_v, self._rng = self._prefill_batch_mm(
+            self.params,
+            self.kv_k,
+            self.kv_v,
+            jnp.asarray(toks),
+            jnp.asarray(positions),
+            jnp.asarray(tables),
+            jnp.asarray(ctx_lens),
+            jnp.asarray(last_idx),
+            samp,
+            self._rng,
+            jnp.asarray(emb),
+            jnp.asarray(emb_mask),
+        )
+        return first
+
     def _dev_reset(self, tokens, positions, seq_lens, page_tables, temps, top_ks, top_ps):
         self._samp_dev = SamplingParams(
             temperature=jnp.asarray(temps),
@@ -964,6 +1065,15 @@ class JaxEngine:
                         self._dev_prefill,
                         p["toks"], p["positions"], p["tables"], p["ctx_lens"],
                         p["last_idx"], p["temps"], p["top_ks"], p["top_ps"],
+                    )
+                )
+            elif tag == "prefill_mm":
+                await self._run_on_device(
+                    partial(
+                        self._dev_prefill_mm,
+                        p["toks"], p["positions"], p["tables"], p["ctx_lens"],
+                        p["last_idx"], p["temps"], p["top_ks"], p["top_ps"],
+                        p["emb"], p["emb_mask"],
                     )
                 )
             elif tag == "reset":
@@ -1138,6 +1248,8 @@ class JaxEngine:
             "kv pull complete for %s: %d pages via data plane %s",
             slot.request_id, desc.n_pages, desc.addr,
         )
+        self.kv_pulls_completed += 1
+        self.kv_pages_pulled += int(desc.n_pages)
         self._activate_transferred(slot, first_token)
         self._wake.set()
 
@@ -1286,9 +1398,12 @@ class JaxEngine:
             remaining = len(s0.kv_prompt) - s0.prefill_pos
             # pp: every prompt goes through the pipelined single-seq path
             # (layer-sharded weights make the batched path degenerate);
-            # sp: only fresh long prompts ride the ring (history-free)
-            use_single = cfg.pp_size > 1 or (
-                s0.prefill_pos == 0 and remaining >= cfg.ring_prefill_threshold
+            # sp: only fresh long prompts ride the ring (history-free).
+            # Multimodal slots never ride it (splice unsupported there —
+            # _check_multimodal rejects those configs up front).
+            use_single = not s0.mm and (
+                cfg.pp_size > 1
+                or (s0.prefill_pos == 0 and remaining >= cfg.ring_prefill_threshold)
             )
             if use_single:
                 await self._dispatch_prefill_one(s0)
@@ -1337,20 +1452,52 @@ class JaxEngine:
             top_ps[lane] = s.top_p
             meta.append((s, chunk, lane))
 
-        self._bcast(
-            "prefill",
-            {
-                "toks": toks, "positions": positions, "tables": tables,
-                "ctx_lens": ctx_lens, "last_idx": last_idx, "temps": temps,
-                "top_ks": top_ks, "top_ps": top_ps,
-            },
-        )
-        first_dev = await self._run_on_device(
-            partial(
-                self._dev_prefill,
-                toks, positions, tables, ctx_lens, last_idx, temps, top_ks, top_ps,
+        if any(s.mm for s in chosen):
+            # multimodal splice operands: encoder rows land at their
+            # absolute prompt positions within this chunk window
+            H = self.model_config.hidden_size
+            emb = np.zeros((B_pf, bucket, H), np.float32)
+            emb_mask = np.zeros((B_pf, bucket), bool)
+            for s, chunk, lane in meta:
+                if not s.mm:
+                    continue
+                start = s.prefill_pos  # chunk window [start, start+chunk)
+                for pos0, arr in s.mm:
+                    lo, hi = max(pos0, start), min(pos0 + len(arr), start + chunk)
+                    if lo < hi:
+                        emb[lane, lo - start : hi - start] = arr[lo - pos0 : hi - pos0]
+                        emb_mask[lane, lo - start : hi - start] = True
+            self._bcast(
+                "prefill_mm",
+                {
+                    "toks": toks, "positions": positions, "tables": tables,
+                    "ctx_lens": ctx_lens, "last_idx": last_idx, "temps": temps,
+                    "top_ks": top_ks, "top_ps": top_ps,
+                    "emb": emb, "emb_mask": emb_mask,
+                },
             )
-        )
+            first_dev = await self._run_on_device(
+                partial(
+                    self._dev_prefill_mm,
+                    toks, positions, tables, ctx_lens, last_idx,
+                    temps, top_ks, top_ps, emb, emb_mask,
+                )
+            )
+        else:
+            self._bcast(
+                "prefill",
+                {
+                    "toks": toks, "positions": positions, "tables": tables,
+                    "ctx_lens": ctx_lens, "last_idx": last_idx, "temps": temps,
+                    "top_ks": top_ks, "top_ps": top_ps,
+                },
+            )
+            first_dev = await self._run_on_device(
+                partial(
+                    self._dev_prefill,
+                    toks, positions, tables, ctx_lens, last_idx, temps, top_ks, top_ps,
+                )
+            )
         completions = []
         for s, chunk, lane in meta:
             s.prefill_pos += chunk

@@ -65,6 +65,9 @@ class PageAllocator:
         self._free: List[int] = list(range(num_pages - 1, -1, -1))
         self._by_hash: Dict[int, _CachedPage] = {}
         self._lru: OrderedDict[int, None] = OrderedDict()  # seq_hash -> None
+        # cumulative prefix-cache hits (blocks re-referenced instead of
+        # recomputed) — the KV router-benefit benchmark reads this
+        self.prefix_hit_blocks_total = 0
 
     @property
     def free_pages(self) -> int:
@@ -103,6 +106,7 @@ class PageAllocator:
                 self._lru.pop(h, None)
             page.ref_count += 1
             out.append(page.page_id)
+        self.prefix_hit_blocks_total += len(out)
         return out
 
     def alloc_fresh(self, n: int) -> Optional[List[int]]:
@@ -166,4 +170,5 @@ class PageAllocator:
             "kv_active_blocks": self.used_pages - len(self._lru),
             "kv_total_blocks": self.num_pages,
             "kv_cached_blocks": len(self._lru),
+            "kv_prefix_hit_blocks_total": self.prefix_hit_blocks_total,
         }

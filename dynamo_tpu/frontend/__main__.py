@@ -22,7 +22,7 @@ from dynamo_tpu.runtime import (
 logger = logging.getLogger("dynamo_tpu.frontend")
 
 
-def parse_args():
+def parse_args(argv=None):
     ap = argparse.ArgumentParser(description="dynamo-tpu OpenAI frontend")
     ap.add_argument("--http-host", default="0.0.0.0")
     ap.add_argument("--http-port", type=int, default=8000)
@@ -35,6 +35,10 @@ def parse_args():
         default="round-robin",
     )
     ap.add_argument("--discovery", default=None, help="tcp://host:port of discovery")
+    ap.add_argument("--encoder", default=None,
+                    help="multimodal encode worker endpoint "
+                    "('component' | 'ns/component' | 'ns/component/endpoint'): "
+                    "adds the E/P/D encode hop to every model pipeline")
     ap.add_argument(
         "--embed-discovery",
         action="store_true",
@@ -45,7 +49,7 @@ def parse_args():
     ap.add_argument("--router-replica-sync", action="store_true",
                     help="mirror routing decisions between KV-mode frontends "
                     "(reference kv_router/subscriber.rs)")
-    return ap.parse_args()
+    return ap.parse_args(argv)
 
 
 async def main():
@@ -71,7 +75,9 @@ async def main():
             )
         )
 
-    watcher = ModelWatcher(drt, manager, router_mode, kv_router_factory)
+    watcher = ModelWatcher(
+        drt, manager, router_mode, kv_router_factory, encoder=args.encoder
+    )
     await watcher.start()
 
     service = HttpService(manager, host=args.http_host, port=args.http_port)

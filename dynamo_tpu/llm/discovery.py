@@ -66,11 +66,15 @@ class ModelWatcher:
         manager: ModelManager,
         router_mode: RouterMode = RouterMode.ROUND_ROBIN,
         kv_router_factory: Optional[Callable] = None,
+        encoder: Optional[str] = None,
     ):
         self.drt = drt
         self.manager = manager
         self.router_mode = router_mode
         self.kv_router_factory = kv_router_factory
+        # "namespace/component/endpoint" of a multimodal encode worker:
+        # adds the E hop (llm/multimodal.py) to every model pipeline
+        self.encoder = encoder
         self._task: Optional[asyncio.Task] = None
         self._card_keys: Dict[str, str] = {}  # key -> model name
 
@@ -108,8 +112,21 @@ class ModelWatcher:
         if self.router_mode == RouterMode.KV and self.kv_router_factory is not None:
             kv_router = await self.kv_router_factory(self.drt, card, client)
             self.manager._kv_routers[card.name] = kv_router
+        encode_client = None
+        if self.encoder:
+            seg = self.encoder.split("/")
+            if len(seg) == 1:
+                ns, comp, ep = "dynamo", seg[0], "encode"
+            elif len(seg) == 2:
+                ns, comp, ep = seg[0], seg[1], "encode"
+            else:
+                ns, comp, ep = seg[0], seg[1], seg[2]
+            encode_client = await (
+                self.drt.namespace(ns).component(comp).endpoint(ep).client()
+            )
         pipeline = build_routed_pipeline(
-            card, client, self.router_mode, kv_router=kv_router
+            card, client, self.router_mode, kv_router=kv_router,
+            encode_client=encode_client,
         )
         self.manager.add(card.name, pipeline, client)
         self._card_keys[key] = card.name

@@ -1,0 +1,164 @@
+"""Multimodal E/P/D support: the encode hop.
+
+Reference flow (components/backends/trtllm/multimodal_epd.md +
+multimodal_processor.py): an ENCODE worker turns image/audio content
+parts into embedding tensors; the processor inserts placeholder tokens
+into the prompt at each part's position; the prefill engine replaces the
+placeholders' embedding rows with the encoder output
+(engine._prefill_batch_mm); decode proceeds normally.
+
+Two deliberate TPU-build choices:
+
+  * MockVisionEncoder is a deterministic tiny encoder (content-hash-
+    seeded projection) standing in for a real ViT — the flow, protocol,
+    worker, routing and engine splice are all real; swapping in a real
+    encoder is a drop-in replacement of `encode`.
+  * Placeholder token ids are CONTENT-DERIVED pseudo-tokens: two
+    different images produce different placeholder ids, so KV block
+    hashes (and with them the KV router's prefix scoring and the
+    engine's prefix cache) distinguish images, while identical images
+    still reuse cached KV. Constant placeholders would alias every
+    image to the same prefix.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+__all__ = [
+    "MockVisionEncoder",
+    "encode_parts",
+    "part_content_key",
+    "placeholder_tokens",
+    "splice_placeholders",
+]
+
+DEFAULT_MM_TOKENS = 4
+
+
+def part_content_key(part: Dict[str, Any]) -> bytes:
+    """Stable identity of a content part (url or inline payload)."""
+    ident = part.get("url") or part.get("data") or part.get("input_audio") or ""
+    return hashlib.sha256(
+        f"{part.get('type')}:{ident}".encode("utf-8", "replace")
+    ).digest()
+
+
+class MockVisionEncoder:
+    """Deterministic stand-in encoder: content hash seeds a fixed random
+    projection to [n_tokens, hidden]. Same image -> same embeddings on
+    every host (no weights to distribute)."""
+
+    def __init__(self, hidden_size: int, n_tokens: int = DEFAULT_MM_TOKENS):
+        self.hidden_size = hidden_size
+        self.n_tokens = n_tokens
+
+    def encode(self, part: Dict[str, Any]) -> np.ndarray:
+        seed = int.from_bytes(part_content_key(part)[:4], "little")
+        rng = np.random.RandomState(seed)
+        # small magnitude: comparable to embedding-table rows so the
+        # splice doesn't blow out activation scales
+        return (rng.randn(self.n_tokens, self.hidden_size) * 0.02).astype(
+            np.float32
+        )
+
+
+def placeholder_tokens(part: Dict[str, Any], n_tokens: int, vocab_size: int) -> List[int]:
+    """Content-derived pseudo-token ids for one part (see module docstring).
+    Ids land in [2, vocab) to dodge special tokens at 0/1."""
+    key = part_content_key(part)
+    stretched = hashlib.sha256(key + b"tokens").digest()
+    span = max(vocab_size - 2, 1)
+    return [
+        2 + int.from_bytes(stretched[(2 * i) % 30 : (2 * i) % 30 + 2], "little") % span
+        for i in range(n_tokens)
+    ]
+
+
+def splice_placeholders(
+    token_ids: List[int],
+    parts: List[Dict[str, Any]],
+    n_tokens: int,
+    vocab_size: int,
+) -> Tuple[List[int], List[Dict[str, Any]]]:
+    """Append each part's placeholder span to the prompt and record its
+    position on the part (the chat template flattens text parts, so parts
+    anchor after the rendered prompt, in request order — the reference
+    anchors at the model's image-token markers instead)."""
+    out = list(token_ids)
+    stamped = []
+    for part in parts:
+        p = dict(part)
+        p["position"] = len(out)
+        p["n_tokens"] = n_tokens
+        out.extend(placeholder_tokens(part, n_tokens, vocab_size))
+        stamped.append(p)
+    return out, stamped
+
+
+def encode_parts(
+    parts: List[Dict[str, Any]], encoder: MockVisionEncoder
+) -> List[Dict[str, Any]]:
+    """Worker-side: attach embeddings to each part (wire format: nested
+    lists — msgpack-clean; the engine re-materializes np arrays)."""
+    out = []
+    for part in parts:
+        p = dict(part)
+        p["embedding"] = encoder.encode(part).tolist()
+        p["n_tokens"] = encoder.n_tokens
+        out.append(p)
+    return out
+
+
+class EncodeOperator:
+    """Pipeline forward hop (runtime/pipeline.py Operator): the processor
+    side of E/P/D. For requests carrying multimodal parts, calls the
+    encode worker, then splices placeholder tokens + embeddings into the
+    request BEFORE the router hop — so KV-aware routing and the engine
+    prefix cache see the content-derived placeholder ids."""
+
+    def __init__(self, router, vocab_size: int):
+        self.router = router  # PushRouter over the encode endpoint
+        self.vocab_size = vocab_size
+
+    @property
+    def name(self) -> str:
+        return "Encode"
+
+    async def forward(self, request: Any, context) -> Any:
+        is_dict = isinstance(request, dict)
+        mm = request.get("multimodal") if is_dict else request.multimodal
+        if not mm:
+            return request
+        if all(p.get("embedding") is not None and p.get("position") is not None
+               for p in mm):
+            return request  # already encoded (disagg/migration re-entry)
+        stream = await self.router.generate({"multimodal": list(mm)}, context)
+        encoded, n_tokens = None, DEFAULT_MM_TOKENS
+        async for item in stream:
+            d = item.get("data") if isinstance(item, dict) else None
+            if d and "multimodal" in d:
+                encoded = d["multimodal"]
+                n_tokens = int(d.get("n_tokens") or n_tokens)
+        if encoded is None:
+            raise RuntimeError("encode worker returned no embeddings")
+        token_ids = request["token_ids"] if is_dict else request.token_ids
+        new_ids, stamped = splice_placeholders(
+            token_ids, encoded, n_tokens, self.vocab_size
+        )
+        if is_dict:
+            request = dict(request, token_ids=new_ids, multimodal=stamped)
+        else:
+            request.token_ids = new_ids
+            request.multimodal = stamped
+        return request
+
+    # Operator protocol: pass-through backward, no around
+    def backward(self, stream, request, context):
+        return stream
+
+    def around(self, next_engine, request, context):
+        return None

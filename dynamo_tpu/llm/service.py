@@ -80,9 +80,12 @@ def build_routed_pipeline(
     router_mode: RouterMode = RouterMode.ROUND_ROBIN,
     kv_router=None,
     busy_threshold: Optional[float] = None,
+    encode_client: Optional[Client] = None,
 ) -> ModelPipeline:
     """Assemble the canonical chain for one model
-    (reference common.rs:259-310) via the operator graph."""
+    (reference common.rs:259-310) via the operator graph.
+    `encode_client`: endpoint client of a multimodal encode worker — adds
+    the E hop of E/P/D ahead of the chain (llm/multimodal.py)."""
     from ..runtime.pipeline import compose
 
     tokenizer = load_tokenizer(card.tokenizer)
@@ -93,6 +96,14 @@ def build_routed_pipeline(
     sink = ServiceBackend(router)
     migration = Migration(migration_limit=card.migration_limit)
     backend = Backend(tokenizer=tokenizer)
-    engine = compose([backend, migration], sink)
+    ops = [backend, migration]
+    if encode_client is not None:
+        from .multimodal import EncodeOperator
+
+        ops.insert(0, EncodeOperator(
+            PushRouter(encode_client, RouterMode.ROUND_ROBIN),
+            tokenizer.vocab_size,
+        ))
+    engine = compose(ops, sink)
     raw_engine = compose([migration], sink)  # below the detokenizer
     return ModelPipeline(card, tokenizer, engine, raw_engine=raw_engine)

@@ -269,6 +269,9 @@ class TestMultimodalProtocol:
             assert len(items) == 1
             ann = Annotated.from_dict(items[0])
             assert ann.is_error()
-            assert "text-only" in (ann.comment or [""])[0]
+            # parts without encoder embeddings must be REJECTED, not
+            # silently dropped (protocol contract); the message directs
+            # the operator to the encode worker (E/P/D)
+            assert "encoder embeddings" in (ann.comment or [""])[0]
 
         asyncio.run(main())
