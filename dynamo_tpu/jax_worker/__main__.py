@@ -8,6 +8,7 @@ register_llm, serve_endpoint) with the native JAX engine underneath.
 import argparse
 import asyncio
 import logging
+import time
 
 from dynamo_tpu.engine import EngineConfig, JaxEngine
 from dynamo_tpu.llm.kv_router.publisher import KvEventPublisher, WorkerMetricsPublisher
@@ -17,7 +18,7 @@ from dynamo_tpu.runtime import DistributedRuntime, RuntimeConfig, init_logging
 logger = logging.getLogger("dynamo_tpu.jax_worker")
 
 
-def parse_args():
+def parse_args(argv=None):
     ap = argparse.ArgumentParser(description="dynamo-tpu JAX engine worker")
     ap.add_argument("--model", default="tiny", help="model registry key (tiny/llama3-8b/llama3-70b)")
     ap.add_argument("--model-name", default=None, help="served model name (defaults to --model)")
@@ -36,6 +37,9 @@ def parse_args():
                     help="KV-write strategy in the fused decode block "
                     "(local + unroll for multi-GB page pools)")
     ap.add_argument("--decode-block-unroll", type=int, default=1)
+    ap.add_argument("--quantize", choices=["int8"], default=None,
+                    help="weight-only quantization (models/quant.py): int8 "
+                    "projections/embed/head, per-channel scales")
     ap.add_argument("--tp-size", type=int, default=1)
     ap.add_argument("--ep-size", type=int, default=1,
                     help="expert-parallel axis size (MoE models)")
@@ -79,7 +83,7 @@ def parse_args():
                     help="advertised data plane host (defaults to local)")
     ap.add_argument("--no-kv-data-plane", action="store_true",
                     help="disable the pull data plane (inline KV payloads)")
-    return ap.parse_args()
+    return ap.parse_args(argv)
 
 
 async def main():
@@ -111,6 +115,7 @@ async def main():
         max_model_len=args.max_model_len,
         decode_pool_mode=args.decode_pool_mode,
         decode_block_unroll=args.decode_block_unroll,
+        quantize=args.quantize,
         tp_size=args.tp_size,
         pp_size=args.pp_size,
         sp_size=args.sp_size,
@@ -168,12 +173,18 @@ async def main():
                 args.model_path,
                 model_config,
                 shardings.param_shardings() if shardings else None,
+                quantize=args.quantize,
             )
         else:
             params = model_mod.init_params(
                 model_config, jax.random.PRNGKey(engine_cfg.seed)
             )
-            params = shard_params(params, shardings)
+            if args.quantize == "int8":
+                from dynamo_tpu.models.quant import quantize_tree
+
+                params = quantize_tree(params)
+            if shardings is not None:
+                params = shard_params(params, shardings)
 
     # build the engine BEFORE joining the control plane: param init can take
     # tens of seconds and must not eat into the discovery lease
@@ -299,6 +310,30 @@ async def main():
 
     metrics_pub = WorkerMetricsPublisher(drt, endpoint, drt.instance_id, engine.stats)
     await metrics_pub.start()
+
+    # prometheus surface for the engine counters (system-status /metrics
+    # when DYN_SYSTEM_PORT is set — the deploy/metrics grafana dashboard
+    # reads these; the discovery metrics topic above feeds router/planner)
+    _stats_snap = {"t": 0.0, "v": {}}
+
+    def _snap_stat(k):
+        # one engine.stats() per scrape, shared across the gauges (each
+        # gauge callback fires within the same render pass)
+        now = time.monotonic()
+        if now - _stats_snap["t"] > 0.5:
+            _stats_snap["v"] = engine.stats()
+            _stats_snap["t"] = now
+        return float(_stats_snap["v"].get(k, 0) or 0)
+
+    for _stat in (
+        "kv_transfers_served", "kv_bytes_served", "kv_pulls_completed",
+        "kv_pages_pulled", "num_waiting_reqs", "num_running_reqs",
+    ):
+        # registry prepends the "dynamo" prefix -> dynamo_worker_<stat>
+        drt.metrics.callback_gauge(
+            f"worker_{_stat}", f"engine stat {_stat}",
+            (lambda k=_stat: _snap_stat(k)),
+        )
 
     model_name = args.model_name or args.model
     if args.role != "prefill":

@@ -33,7 +33,14 @@ async def maybe_remote_prefill(
     prompt = request.get("token_ids") or []
     page_size = engine.config.page_size
     hashes = compute_seq_hashes(prompt, page_size)
-    cached_tokens = len(engine.allocator.cached_prefix(hashes)) * page_size
+    n_cached = len(engine.allocator.cached_prefix(hashes))
+    if engine.kvbm is not None and n_cached < len(hashes):
+        # blocks held in KVBM tiers — local, OR announced by a peer (G4
+        # mesh) — onboard at admission; recomputing them remotely would
+        # waste the prefill pool (reference G4 reuse flow,
+        # block_manager/distributed/leader.rs:126)
+        n_cached += len(engine.kvbm.probe(hashes[n_cached:]))
+    cached_tokens = n_cached * page_size
     have_workers = bool(prefill_client and prefill_client.instance_ids())
 
     want_annotation = "remote_prefill" in (request.get("annotations") or [])

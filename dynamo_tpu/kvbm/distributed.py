@@ -81,6 +81,11 @@ class KvbmDistributed:
         for item in watch.snapshot:
             self._on_addr(item["key"], item["value"])
         self._addr_task = asyncio.create_task(self._addr_loop(watch))
+        # announcements are fire-and-forget pub/sub: a worker that joins
+        # AFTER peers offloaded (fresh decode replica, post-crash restart)
+        # would never learn their tier contents — ask everyone to
+        # re-announce (peers reply with their full hash sets)
+        self.announce("sync_request", [])
 
     def _on_addr(self, key: str, raw: Optional[bytes]):
         import json
@@ -115,6 +120,12 @@ class KvbmDistributed:
                 elif msg["op"] == "cleared":
                     for owners in self._owners.values():
                         owners.discard(inst)
+                elif msg["op"] == "sync_request":
+                    # a late joiner asked for the mesh state: re-announce
+                    # everything our tiers hold
+                    held = self.manager.all_hashes()
+                    if held:
+                        self.announce("stored", held)
             except Exception:  # noqa: BLE001
                 logger.exception("bad kvbm announcement")
 

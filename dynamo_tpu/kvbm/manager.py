@@ -89,6 +89,17 @@ class KvBlockManager:
                     self.dropped_blocks += 1
                 self.offloaded_blocks += 1
 
+    def all_hashes(self) -> List[int]:
+        """Every block hash held in any tier (the announcement-mesh
+        sync-reply payload)."""
+        with self._lock:
+            out = set()
+            if self.host is not None:
+                out.update(self.host._by_hash)
+            if self.disk is not None:
+                out.update(self.disk._by_hash)
+            return sorted(out)
+
     def has(self, seq_hash: int) -> bool:
         with self._lock:
             if self.host is not None and self.host.has(seq_hash):

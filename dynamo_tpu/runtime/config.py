@@ -86,6 +86,12 @@ class RuntimeConfig:
         cfg.system_enabled = _env("DYN_SYSTEM_ENABLED", cfg.system_enabled, bool)
         cfg.system_host = _env("DYN_SYSTEM_HOST", cfg.system_host)
         cfg.system_port = _env("DYN_SYSTEM_PORT", cfg.system_port, int)
+        if cfg.system_port > 0 and "DYN_SYSTEM_ENABLED" not in os.environ:
+            # an explicit port IS the ask (the deploy/metrics prometheus
+            # scrape targets it); requiring a second flag to turn the
+            # server on makes the gauges silently absent. An explicit
+            # DYN_SYSTEM_ENABLED=0 still wins.
+            cfg.system_enabled = True
         cfg.health_check_enabled = _env(
             "DYN_HEALTH_CHECK_ENABLED", cfg.health_check_enabled, bool
         )

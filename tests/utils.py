@@ -87,3 +87,53 @@ class ManagedProcess:
 
     def __exit__(self, *exc):
         self.stop()
+
+
+def scrape_worker_stats(disc, predicate=None, *, namespace="dynamo",
+                        component="backend", timeout=20.0, min_workers=None):
+    """Subscribe to the workers' published metrics topic (the product
+    surface the router/planner consume — asserting on it beats log-greps).
+
+    Default: return the first stats payload satisfying `predicate`
+    (raises asyncio.TimeoutError if none arrives in `timeout`).
+    With `min_workers=N`: collect the latest stats per worker until N
+    distinct workers reported (or the deadline), and return
+    {worker_id: stats} — counters are cumulative, so the latest report
+    per worker is the total.
+    """
+    import asyncio
+
+    from dynamo_tpu.llm.kv_router.publisher import METRICS_TOPIC_FMT
+    from dynamo_tpu.runtime import DistributedRuntime, RuntimeConfig, codec
+
+    async def run():
+        cfg = RuntimeConfig.from_settings()
+        cfg.discovery_endpoint = disc
+        drt = await DistributedRuntime.create(cfg)
+        try:
+            sub = await drt.discovery.subscribe(
+                METRICS_TOPIC_FMT.format(namespace=namespace, component=component)
+            )
+            per_worker = {}
+
+            async def scan():
+                async for payload in sub:
+                    msg = codec.unpack(payload)
+                    stats = msg.get("stats") or {}
+                    if min_workers is not None:
+                        per_worker[msg.get("worker_id")] = stats
+                        if len(per_worker) >= min_workers:
+                            return per_worker
+                    elif predicate is None or predicate(stats):
+                        return stats
+
+            try:
+                return await asyncio.wait_for(scan(), timeout)
+            except asyncio.TimeoutError:
+                if min_workers is not None:
+                    return per_worker  # whatever reported before the deadline
+                raise
+        finally:
+            await drt.close()
+
+    return asyncio.run(run())
