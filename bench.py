@@ -182,7 +182,8 @@ def _combined(args, extra):
         [sys.executable, __file__, "--raw", *smoke, *model,
          "--batch", str(args.batch), "--isl", str(args.isl),
          "--osl", str(args.osl), "--block", str(args.block),
-         *(["--steps", str(args.steps)] if args.steps else [])],
+         *(["--steps", str(args.steps)] if args.steps else []),
+         *(["--quantize", args.quantize] if args.quantize else [])],
         "raw",
     )
     e2e_line, e2e_rc = _json_lines(
@@ -226,6 +227,8 @@ def main():
     ap.add_argument("--osl", type=int, default=128, help="output seq len")
     ap.add_argument("--block", type=int, default=16, help="fused decode steps per dispatch")
     ap.add_argument("--steps", type=int, default=None, help="decode steps to time")
+    ap.add_argument("--quantize", choices=["int8"], default=None,
+                    help="int8 weight-only quantization (models/quant.py)")
     ap.add_argument("--raw", action="store_true", help="only the raw-step bench")
     ap.add_argument("--e2e", action="store_true", help="serve a trace through the full stack")
     ap.add_argument("--engine", action="store_true",
@@ -248,7 +251,11 @@ def main():
     if args.engine:
         from bench_engine import main as engine_main
 
-        return engine_main(extra + (["--smoke"] if args.smoke else []))
+        return engine_main(
+            extra
+            + (["--smoke"] if args.smoke else [])
+            + (["--quantize", args.quantize] if args.quantize else [])
+        )
 
     if not args.raw:
         return _combined(args, extra)
@@ -301,6 +308,10 @@ def main():
     )
 
     params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    if args.quantize == "int8":
+        from dynamo_tpu.models.quant import quantize_tree
+
+        params = quantize_tree(params)
     kv_k, kv_v = alloc_kv_arrays(
         cfg.num_layers, num_pages, PAGE, cfg.num_kv_heads, cfg.head_dim, cfg.dtype
     )
@@ -421,7 +432,8 @@ def main():
         file=sys.stderr,
     )
     result = {
-        "metric": f"decode_throughput_{model}_bs{B}_isl{args.isl}",
+        "metric": f"decode_throughput_{model}_bs{B}_isl{args.isl}"
+        + ("_int8" if args.quantize else ""),
         "value": round(toks_per_sec, 1),
         "unit": "tok/s",
         "vs_baseline": baseline_ratio(toks_per_sec, model),

@@ -121,6 +121,7 @@ def build_trace(
 class Deployment:
     procs: List[ManagedProcess] = field(default_factory=list)
     http_port: int = 0
+    discovery: str = ""
 
     def stop(self):
         for p in reversed(self.procs):
@@ -129,7 +130,9 @@ class Deployment:
 
 def launch(mode: str, model: str, *, cpu: bool, num_workers: int = 2,
            num_pages: int = 2048, max_num_seqs: int = 64,
-           disagg_threshold: int = 64, log_dir: str = "/tmp") -> Deployment:
+           disagg_threshold: int = 64, log_dir: str = "/tmp",
+           router_override: Optional[str] = None,
+           quantize: Optional[str] = None) -> Deployment:
     """Spawn discovery + frontend + workers (real processes, real sockets) —
     the same wiring a production deployment uses, per
     jax_worker/__main__.py + frontend/__main__.py."""
@@ -152,6 +155,7 @@ def launch(mode: str, model: str, *, cpu: bool, num_workers: int = 2,
         "-m", "dynamo_tpu.jax_worker", "--model", model,
         "--model-name", "bench", "--num-pages", str(num_pages),
         "--max-num-seqs", str(max_num_seqs),
+        *(["--quantize", quantize] if quantize else []),
     ]
     router_mode = "round-robin"
     if mode == "agg":
@@ -178,14 +182,26 @@ def launch(mode: str, model: str, *, cpu: bool, num_workers: int = 2,
 
     f = ManagedProcess(
         ["-m", "dynamo_tpu.frontend", "--http-port", str(http_port),
-         "--router-mode", router_mode],
+         "--router-mode", router_override or router_mode],
         name="bench-frontend", env=env,
     )
     f.start(f"{log_dir}/bench_e2e_frontend.log")
     f.wait_port(http_port)
     dep.procs.append(f)
     dep.http_port = http_port
+    dep.discovery = disc
     return dep
+
+
+def scrape_prefix_hits(disc: str, expect: int = 2, timeout: float = 10.0) -> int:
+    """Total prefix-cache hit blocks across the worker pool, read from the
+    workers' published stats (the router-benefit oracle)."""
+    from tests.utils import scrape_worker_stats
+
+    per_worker = scrape_worker_stats(disc, min_workers=expect, timeout=timeout)
+    return sum(
+        int(s.get("kv_prefix_hit_blocks_total", 0)) for s in per_worker.values()
+    )
 
 
 async def wait_model(port: int, timeout: float) -> None:
@@ -355,6 +371,14 @@ def main(argv: Optional[List[str]] = None):
     ap.add_argument("--prefix-ratio", type=float, default=0.0)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--startup-timeout", type=float, default=None)
+    ap.add_argument("--quantize", choices=["int8"], default=None,
+                    help="worker weight quantization (models/quant.py)")
+    ap.add_argument("--router-compare", action="store_true",
+                    help="kv mode: ALSO run the identical trace through a "
+                    "round-robin frontend over a fresh identical worker "
+                    "pool and report the router's benefit (TTFT delta + "
+                    "prefix-cache hit blocks) — reference "
+                    "benchmarks/router/prefix_ratio_benchmark.py role")
     args = ap.parse_args(argv)
 
     cpu = bool(args.smoke)
@@ -386,19 +410,48 @@ def main(argv: Optional[List[str]] = None):
         file=sys.stderr,
     )
 
-    dep = launch(args.mode, model, cpu=cpu, num_workers=args.num_workers)
-    try:
-        asyncio.run(wait_model(dep.http_port, startup))
-        # brief warmup: compile every engine variant before the timed trace
-        warm = [TraceRequest(0.0, 32, 8, list(range(5, 37))) for _ in range(2)]
-        asyncio.run(run_trace(dep.http_port, warm))
-        t0 = time.perf_counter()
-        results = asyncio.run(run_trace(dep.http_port, trace))
-        wall = time.perf_counter() - t0
-    finally:
-        dep.stop()
+    def run_arm(router_override=None):
+        """One deployment + trace run; returns (summary, prefix_hit_blocks)."""
+        dep = launch(args.mode, model, cpu=cpu, num_workers=args.num_workers,
+                     router_override=router_override, quantize=args.quantize)
+        hits = 0
+        try:
+            asyncio.run(wait_model(dep.http_port, startup))
+            # brief warmup: compile every engine variant before the timed trace
+            warm = [TraceRequest(0.0, 32, 8, list(range(5, 37))) for _ in range(2)]
+            asyncio.run(run_trace(dep.http_port, warm))
+            t0 = time.perf_counter()
+            results = asyncio.run(run_trace(dep.http_port, trace))
+            wall = time.perf_counter() - t0
+            if args.router_compare and args.mode == "kv":
+                hits = scrape_prefix_hits(dep.discovery, expect=args.num_workers)
+        finally:
+            dep.stop()
+        return summarize(results, wall, args.mode, qps, model), hits
 
-    summary = summarize(results, wall, args.mode, qps, model)
+    if args.router_compare and args.mode != "kv":
+        ap.error("--router-compare requires --mode kv")
+    summary, kv_hits = run_arm()
+
+    if args.router_compare and args.mode == "kv":
+        # arm B: identical trace, identical fresh pool, round-robin routing
+        rr_summary, rr_hits = run_arm(router_override="round-robin")
+        benefit = {
+            "metric": f"kv_router_benefit_{model}_prefix{args.prefix_ratio:g}",
+            "value": round(rr_summary["ttft_ms"]["p50"] - summary["ttft_ms"]["p50"], 1),
+            "unit": "ms_ttft_p50_saved",
+            "vs_baseline": None,
+            "kv": {"ttft_p50_ms": summary["ttft_ms"]["p50"],
+                   "output_tok_s": summary["output_tok_s"],
+                   "prefix_hit_blocks": kv_hits,
+                   "failed": summary["failed"]},
+            "round_robin": {"ttft_p50_ms": rr_summary["ttft_ms"]["p50"],
+                            "output_tok_s": rr_summary["output_tok_s"],
+                            "prefix_hit_blocks": rr_hits,
+                            "failed": rr_summary["failed"]},
+        }
+        print(json.dumps(benefit))
+        return 0 if not (summary["failed"] or rr_summary["failed"]) else 1
     print("# " + json.dumps(summary), file=sys.stderr)
     result = {
         "metric": f"e2e_output_toks_{args.mode}_{model}_qps{qps:g}",

@@ -34,21 +34,24 @@ from bench import baseline_ratio, ensure_backend  # noqa: E402
 
 
 def _make_engine(model: str, B: int, isl: int, osl: int, K: int, page: int = 64,
-                 pool_mode: str = "scatter", unroll: int = 1):
+                 pool_mode: str = "scatter", unroll: int = 1, quantize=None,
+                 num_pages: Optional[int] = None):
     from dynamo_tpu.engine import EngineConfig, JaxEngine
 
     max_len = isl + osl + K + page
     pages_per_seq = (max_len + page - 1) // page
+    auto_pages = 2 * B * pages_per_seq + 8  # churn headroom: old pages
+    # linger in the prefix cache while replacements admit
     cfg = EngineConfig(
         model=model,
         page_size=page,
-        num_pages=2 * B * pages_per_seq + 8,  # churn headroom: old pages
-        # linger in the prefix cache while replacements admit
+        num_pages=max(num_pages, auto_pages) if num_pages else auto_pages,
         max_num_seqs=B,
         max_model_len=max_len,
         decode_block_steps=K,
         decode_pool_mode=pool_mode,
         decode_block_unroll=unroll,
+        quantize=quantize,
         enable_prefix_caching=True,
     )
     return JaxEngine(cfg)
@@ -162,6 +165,10 @@ def main(argv: Optional[List[str]] = None):
     ap.add_argument("--block", type=int, default=16)
     ap.add_argument("--pool-mode", choices=["scatter", "local"], default="scatter")
     ap.add_argument("--unroll", type=int, default=1)
+    ap.add_argument("--quantize", choices=["int8"], default=None)
+    ap.add_argument("--num-pages", type=int, default=None,
+                    help="KV pool size override (floored at the batch's "
+                    "working-set need) — the KV-write-strategy sweep axis")
     ap.add_argument("--churn-s", type=float, default=None,
                     help="closed-loop churn window (0 disables)")
     args = ap.parse_args(argv)
@@ -194,7 +201,8 @@ def main(argv: Optional[List[str]] = None):
     )
     engine = _make_engine(
         model, B, isl, osl, args.block,
-        pool_mode=args.pool_mode, unroll=args.unroll,
+        pool_mode=args.pool_mode, unroll=args.unroll, quantize=args.quantize,
+        num_pages=args.num_pages,
     )
 
     async def run():
@@ -209,12 +217,14 @@ def main(argv: Optional[List[str]] = None):
     line = {**steady, **churn, "preemptions": engine.num_preemptions}
     print("# " + json.dumps(line), file=sys.stderr)
     result = {
-        "metric": f"engine_decode_{model}_bs{B}_isl{isl}",
+        "metric": f"engine_decode_{model}_bs{B}_isl{isl}"
+        + ("_int8" if args.quantize else ""),
         "value": round(steady["decode_tok_s"], 1),
         "unit": "tok/s",
         "vs_baseline": baseline_ratio(steady["decode_tok_s"], model),
         "itl_ms": round(steady["itl_ms"], 2),
         "churn_tok_s": round(churn.get("churn_tok_s", 0.0), 1),
+        "num_pages": engine.config.num_pages,
     }
     print(json.dumps(result))
     return 0

@@ -1,0 +1,106 @@
+"""Decode KV-write strategy sweep (round-3 verdict #2).
+
+Measures engine-path decode across (pool_mode, unroll, num_pages) to pick
+the production default for EngineConfig.decode_pool_mode at >=1024-page
+pools. Each configuration runs in a fresh subprocess (one engine per
+process; donated buffers make in-process re-runs unsafe) and the
+persistent XLA compile cache (engine._enable_compile_cache) amortizes the
+Mosaic compiles across them, so only the first run of each program shape
+pays the 20-40s compile.
+
+Usage: python bench_sweep.py [--quick] [--out sweep.json]
+Prints one JSON line per configuration plus a final summary with the
+winning mode per pool size.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent
+
+
+def run_cfg(pool_mode: str, unroll: int, num_pages: int, *, batch: int,
+            osl: int, timeout: float) -> dict:
+    cmd = [
+        sys.executable, str(REPO / "bench_engine.py"),
+        "--pool-mode", pool_mode, "--unroll", str(unroll),
+        "--num-pages", str(num_pages),
+        "--batch", str(batch), "--osl", str(osl), "--churn-s", "0",
+    ]
+    t0 = time.time()
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return {"pool_mode": pool_mode, "unroll": unroll,
+                "num_pages": num_pages, "error": "timeout"}
+    line = None
+    for ln in (r.stdout or "").splitlines():
+        ln = ln.strip()
+        if ln.startswith("{"):
+            line = json.loads(ln)
+    out = {"pool_mode": pool_mode, "unroll": unroll, "num_pages": num_pages,
+           "wall_s": round(time.time() - t0, 1)}
+    if line is None or r.returncode != 0:
+        out["error"] = (r.stderr or "")[-400:] or f"rc={r.returncode}"
+        return out
+    if "error" in line:
+        out["error"] = line["error"]
+        return out
+    out["decode_tok_s"] = line.get("value")
+    out["itl_ms"] = line.get("itl_ms")
+    # bench_engine floors the pool at the batch's working-set need; record
+    # what actually ran so rows are never mislabeled
+    out["num_pages_effective"] = line.get("num_pages", num_pages)
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description="decode KV-write strategy sweep")
+    ap.add_argument("--quick", action="store_true",
+                    help="fewer points (scatter + local@unroll4, 1024/2048 pages)")
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--osl", type=int, default=64)
+    ap.add_argument("--timeout", type=float, default=900.0,
+                    help="per-configuration budget (first runs pay compiles)")
+    ap.add_argument("--out", default=None, help="also write results to this file")
+    args = ap.parse_args(argv)
+
+    pools = [1024, 2048] if args.quick else [392, 1024, 2048]
+    configs = []
+    for np_ in pools:
+        configs.append(("scatter", 1, np_))
+        for u in ([4] if args.quick else [2, 4, 8, 16]):
+            configs.append(("local", u, np_))
+
+    results = []
+    for mode, unroll, np_ in configs:
+        res = run_cfg(mode, unroll, np_, batch=args.batch, osl=args.osl,
+                      timeout=args.timeout)
+        results.append(res)
+        print(json.dumps(res), flush=True)
+
+    # winner per pool size (highest decode tok/s among clean runs)
+    summary = {}
+    for np_ in pools:
+        clean = [r for r in results if r["num_pages"] == np_ and "decode_tok_s" in r]
+        if clean:
+            best = max(clean, key=lambda r: r["decode_tok_s"])
+            summary[str(np_)] = {
+                "pool_mode": best["pool_mode"], "unroll": best["unroll"],
+                "decode_tok_s": best["decode_tok_s"], "itl_ms": best["itl_ms"],
+            }
+    print(json.dumps({"sweep_summary": summary}), flush=True)
+    if args.out:
+        Path(args.out).write_text(json.dumps(
+            {"results": results, "summary": summary}, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,8 @@
+{{- define "dynamo.discoveryEndpoint" -}}
+{{ .Release.Name }}-discovery:{{ .Values.discovery.port }}
+{{- end -}}
+
+{{- define "dynamo.workerEnv" -}}
+- name: DYN_DISCOVERY_ENDPOINT
+  value: {{ include "dynamo.discoveryEndpoint" . | quote }}
+{{- end -}}

@@ -1,0 +1,185 @@
+"""Deploy-surface validation (round-3 verdict #9).
+
+The contract: everything we SHIP as deployable configuration must
+round-trip into the CLIs it claims to drive —
+  * every recipes/*.yaml worker/frontend/planner args parse through the
+    REAL argparse parsers (a renamed flag fails here, not in prod)
+  * the helm chart's values cover every reference in its templates, and
+    k8s manifest commands use real module flags
+  * the grafana dashboard only queries metric names the code exports
+"""
+
+import json
+import re
+from pathlib import Path
+
+import pytest
+import yaml
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _parse_or_fail(parser, args, source):
+    try:
+        return parser(list(args))
+    except SystemExit as e:
+        raise AssertionError(f"{source}: args {args} rejected by CLI") from e
+
+
+def _recipes():
+    return sorted((REPO / "recipes").glob("*.yaml"))
+
+
+@pytest.mark.parametrize("recipe", _recipes(), ids=lambda p: p.stem)
+def test_recipe_roundtrips_into_cli_flags(recipe):
+    from dynamo_tpu.frontend.__main__ import parse_args as fe_parse
+    from dynamo_tpu.jax_worker.__main__ import parse_args as worker_parse
+    from dynamo_tpu.planner.__main__ import parse_args as planner_parse
+
+    doc = yaml.safe_load(recipe.read_text())
+    if doc.get("frontend"):
+        _parse_or_fail(fe_parse, doc["frontend"].get("args", []),
+                       f"{recipe.name} frontend")
+    for w in doc["workers"]:
+        args = list(w.get("args", []))
+        if w.get("role"):
+            args += ["--role", w["role"]]
+        if w.get("multihost"):
+            args += ["--num-hosts", str(w["multihost"]["num_hosts"]),
+                     "--coordinator", "127.0.0.1:9999"]
+        ns = _parse_or_fail(worker_parse, args, f"{recipe.name} worker")
+        # model must resolve in the registry (or be a path)
+        from dynamo_tpu.engine.engine import _resolve_model
+
+        _resolve_model(ns.model)
+    if doc.get("planner"):
+        _parse_or_fail(planner_parse, doc["planner"].get("args", []),
+                       f"{recipe.name} planner")
+        ol = doc["planner"].get("operator_lite")
+        if ol:
+            import argparse
+
+            # operator_lite.main builds its parser inline; mirror the
+            # supported flags (deploy/operator_lite.py:140-146)
+            ap = argparse.ArgumentParser()
+            ap.add_argument("--backend", choices=["kubectl", "local"])
+            ap.add_argument("--discovery")
+            ap.add_argument("--namespace")
+            ap.add_argument("--prefill-deployment")
+            ap.add_argument("--decode-deployment")
+            ap.add_argument("--model")
+            ap.add_argument("--poll-s", type=float)
+            _parse_or_fail(
+                lambda a: ap.parse_args(a), ol, f"{recipe.name} operator_lite"
+            )
+
+
+def test_k8s_manifest_commands_use_real_flags():
+    """The shipped k8s manifests' container commands must parse through
+    the module CLIs they invoke."""
+    from dynamo_tpu.frontend.__main__ import parse_args as fe_parse
+    from dynamo_tpu.jax_worker.__main__ import parse_args as worker_parse
+
+    parsers = {
+        "dynamo_tpu.frontend": fe_parse,
+        "dynamo_tpu.jax_worker": worker_parse,
+    }
+    checked = 0
+    for m in sorted((REPO / "deploy" / "k8s").glob("*.yaml")):
+        for doc in yaml.safe_load_all(m.read_text()):
+            if not doc or doc.get("kind") != "Deployment":
+                continue
+            for c in doc["spec"]["template"]["spec"]["containers"]:
+                cmd = c.get("command") or []
+                if len(cmd) >= 3 and cmd[:2] == ["python", "-m"]:
+                    mod = cmd[2]
+                    if mod in parsers:
+                        _parse_or_fail(parsers[mod], cmd[3:], f"{m.name}:{c['name']}")
+                        checked += 1
+    assert checked >= 3
+
+
+def test_helm_chart_values_cover_templates():
+    """Every `.Values.x.y` referenced by a template must exist in
+    values.yaml (helm isn't installed in CI, so this is the static half
+    of `helm template`; unknown values render as empty strings — silent
+    breakage)."""
+    chart = REPO / "deploy" / "helm" / "dynamo-tpu"
+    meta = yaml.safe_load((chart / "Chart.yaml").read_text())
+    assert meta["name"] == "dynamo-tpu" and meta["apiVersion"] == "v2"
+    values = yaml.safe_load((chart / "values.yaml").read_text())
+
+    def lookup(path):
+        node = values
+        for seg in path.split("."):
+            if not isinstance(node, dict) or seg not in node:
+                return False
+            node = node[seg]
+        return True
+
+    refs = set()
+    for t in sorted((chart / "templates").glob("*.yaml")):
+        refs.update(re.findall(r"\.Values\.([A-Za-z0-9_.]+)", t.read_text()))
+    assert refs, "templates reference no values?"
+    missing = sorted(r for r in refs if not lookup(r))
+    assert not missing, f"templates reference undefined values: {missing}"
+
+
+def test_helm_worker_command_flags_are_real():
+    """The flags hard-coded in helm worker/frontend templates must exist
+    on the CLIs (catches template/CLI drift without rendering)."""
+    from dynamo_tpu.frontend.__main__ import parse_args as fe_parse
+    from dynamo_tpu.jax_worker.__main__ import parse_args as worker_parse
+
+    import contextlib
+    import io
+
+    def known_flags(parser):
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf), contextlib.redirect_stderr(buf):
+            with contextlib.suppress(SystemExit):
+                parser(["--help"])
+        return set(re.findall(r"--[a-z][a-z0-9-]*", buf.getvalue()))
+
+    flags = {
+        "dynamo_tpu.jax_worker": known_flags(worker_parse),
+        "dynamo_tpu.frontend": known_flags(fe_parse),
+    }
+    chart = REPO / "deploy" / "helm" / "dynamo-tpu" / "templates"
+    checked = 0
+    for t in sorted(chart.glob("*.yaml")):
+        text = t.read_text()
+        for mod, known in flags.items():
+            if mod not in text:
+                continue
+            for flag in re.findall(r'"(--[a-z][a-z0-9-]*)"', text):
+                assert flag in known, f"{t.name}: {flag} not a {mod} flag"
+                checked += 1
+    assert checked >= 8
+
+
+def test_grafana_dashboard_queries_real_metrics():
+    dash = json.loads(
+        (REPO / "deploy" / "metrics" / "grafana_dashboards" /
+         "dynamo-tpu-serving.json").read_text()
+    )
+    # metric names the code actually exports
+    frontend_src = (REPO / "dynamo_tpu" / "llm" / "http" / "metrics.py").read_text()
+    worker_src = (REPO / "dynamo_tpu" / "jax_worker" / "__main__.py").read_text()
+    exported = set(re.findall(r'"(dynamo_frontend_[a-z_]+)"', frontend_src.replace(
+        'f"{ns}_', '"dynamo_frontend_')))
+    for stat in re.findall(r'"([a-z_]+)", "engine stat', worker_src):
+        exported.add(f"dynamo_worker_{stat}")
+    for stat in re.findall(r'"(kv_[a-z_]+|num_[a-z_]+)"', worker_src):
+        exported.add(f"dynamo_worker_{stat}")
+    queried = set()
+    for panel in dash["panels"]:
+        for t in panel.get("targets", []):
+            queried.update(re.findall(r"(dynamo_[a-z_]+?)(?:_bucket)?[{\[]", t["expr"]))
+    assert queried, "dashboard queries nothing?"
+    missing = sorted(q for q in queried if q not in exported)
+    assert not missing, f"dashboard queries unexported metrics: {missing}"
+    # prometheus config parses and scrapes both jobs
+    prom = yaml.safe_load((REPO / "deploy" / "metrics" / "prometheus.yml").read_text())
+    jobs = {j["job_name"] for j in prom["scrape_configs"]}
+    assert {"dynamo-frontend", "dynamo-workers"} <= jobs
