@@ -14,12 +14,14 @@ import pytest
 from .utils import ManagedProcess, free_port, scrape_worker_stats
 
 MODEL = "tiny-disagg"
+ENV = {"DYN_LEASE_TTL_S": "3"}  # death-detection tests wait on lease expiry
 
 
 @pytest.fixture(scope="module")
 def disagg_cluster():
     http_port = free_port()
     disc = f"tcp://127.0.0.1:{free_port()}"
+    env = ENV
     fe = ManagedProcess(
         [
             "-m",
@@ -30,7 +32,7 @@ def disagg_cluster():
             "--discovery",
             disc,
         ],
-        name="dis_fe",
+        name="dis_fe", env=env,
     ).start("/tmp/dis_fe.log")
     fe.wait_port(http_port)
 
@@ -54,7 +56,7 @@ def disagg_cluster():
     ]
     decode = ManagedProcess(
         ["-m", "dynamo_tpu.jax_worker", *common, "--role", "decode", "--disagg-threshold", "16"],
-        name="dis_decode",
+        name="dis_decode", env=env,
     ).start("/tmp/dis_decode.log")
 
     base = f"http://127.0.0.1:{http_port}"
@@ -152,10 +154,10 @@ def test_disagg_matches_local_prefill(disagg_cluster):
     # start the prefill worker; decode worker discovers it
     prefill = ManagedProcess(
         ["-m", "dynamo_tpu.jax_worker", *common, "--role", "prefill"],
-        name="dis_prefill",
+        name="dis_prefill", env=ENV,
     ).start("/tmp/dis_prefill.log")
     procs.append(prefill)
-    time.sleep(20)  # engine build + registration (1 cpu)
+    prefill.wait_log("jax worker up", timeout=60)
 
     # FRESH prompt (prompt_a is now in the decode worker's prefix cache,
     # which correctly suppresses remote prefill)
@@ -212,7 +214,7 @@ def test_disagg_prefill_worker_death_falls_back(disagg_cluster):
     base, disc, common, procs = disagg_cluster
     prefill = next(p for p in procs if p.name == "dis_prefill")
     prefill.sigkill()
-    time.sleep(12)  # lease expiry removes the prefill instance
+    time.sleep(5)  # lease expiry removes the prefill instance (TTL=3)
     prompt = "resilience check " * 10
     text, remote = _generate(base, prompt)
     assert len(text) > 0  # still serves, locally

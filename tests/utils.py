@@ -66,16 +66,35 @@ class ManagedProcess:
                 time.sleep(0.15)
         raise TimeoutError(f"{self.name}: port {port} not up in {timeout}s")
 
+    def wait_log(self, needle: str, timeout: float = 60.0):
+        """Poll this process's log for a marker line (readiness probe —
+        fixed sleeps either waste wall-clock or flake under load)."""
+        deadline = time.time() + timeout
+        path = Path(self.logfile.name)
+        while time.time() < deadline:
+            if self.proc and self.proc.poll() is not None:
+                raise RuntimeError(
+                    f"{self.name} exited early rc={self.proc.returncode}; "
+                    f"log: {path}"
+                )
+            if needle in path.read_text(errors="replace"):
+                return self
+            time.sleep(0.2)
+        raise TimeoutError(f"{self.name}: {needle!r} not in {path} in {timeout}s")
+
     def sigkill(self):
         if self.proc:
             self.proc.send_signal(signal.SIGKILL)
             self.proc.wait()
 
-    def stop(self):
+    def stop(self, grace: float = 2.0):
+        """SIGTERM, then SIGKILL after `grace`. An idle worker exits in
+        ~2s; a multihost follower blocked in a gloo collective never
+        honors SIGTERM at all — a long grace only slows teardown."""
         if self.proc and self.proc.poll() is None:
             self.proc.terminate()
             try:
-                self.proc.wait(timeout=5)
+                self.proc.wait(timeout=grace)
             except subprocess.TimeoutExpired:
                 self.proc.kill()
                 self.proc.wait()
