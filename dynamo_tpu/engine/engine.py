@@ -1412,7 +1412,14 @@ class JaxEngine:
             len(cands[0].kv_prompt) - cands[0].prefill_pos, cfg.max_prefill_chunk
         )
         bucket = self._bucket_for(first_chunk)
-        lanes = max(1, min(cfg.prefill_batch_tokens // bucket, cfg.max_prefill_batch))
+        lanes_cap = max(1, min(cfg.prefill_batch_tokens // bucket, cfg.max_prefill_batch))
+        # two lane variants per bucket — 1 (the lone-arrival TTFT case:
+        # padding one request to the full lane budget multiplies its
+        # prefill FLOPs by the budget) and the cap (batch case). Exactly
+        # two keeps the lazily-compiled shape set small: every new shape
+        # costs a multi-second XLA compile ON the serving path the first
+        # time it occurs (persistent cache amortizes across restarts).
+        lanes = 1 if len(cands) == 1 else lanes_cap
         chosen = cands[:lanes]
         B_pf = lanes
 
@@ -1818,9 +1825,32 @@ class JaxEngine:
         self._waiting.insert(0, victim)
         return True
 
+    def _prefill_work_pending(self) -> bool:
+        """True when prefill compute could actually be dispatched: a slot
+        passing _dispatch_prefill's candidate filter (skip preloaded/
+        onboard slots — their KV arrives by injection, not prefill), or an
+        admittable waiter. An un-admittable waiter or an in-flight KV pull
+        must NOT throttle decode."""
+        if self._waiting and self._free_slots:
+            return True
+        return any(
+            s is not None
+            and s.prefill_pos < len(s.kv_prompt)
+            and s.preloaded is None
+            and s.onboard is None
+            and not s.done
+            for s in self.slots
+        )
+
     async def _dispatch_decode(self) -> bool:
         cfg = self.config
-        if len(self._inflight) >= 2:
+        # prefill-priority depth cap: with dispatchable prefill work, keep
+        # only ONE speculative block in flight — a new arrival's prefill
+        # queues behind every in-flight block on the device stream, so
+        # depth-2 doubles its queueing delay (TTFT) to buy decode overlap
+        # it regains once the queue drains
+        depth = 1 if self._prefill_work_pending() else 2
+        if len(self._inflight) >= depth:
             return False
         if not self._carry_valid and self._inflight:
             return False  # drain in-flight blocks before a state reset

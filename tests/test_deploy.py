@@ -183,3 +183,37 @@ def test_grafana_dashboard_queries_real_metrics():
     prom = yaml.safe_load((REPO / "deploy" / "metrics" / "prometheus.yml").read_text())
     jobs = {j["job_name"] for j in prom["scrape_configs"]}
     assert {"dynamo-frontend", "dynamo-workers"} <= jobs
+
+
+def test_gateway_routes_match_helm_services():
+    """deploy/inference-gateway manifests must reference the Service name
+    and port the helm chart actually creates (release name "dynamo")."""
+    gw_dir = REPO / "deploy" / "inference-gateway"
+    values = yaml.safe_load(
+        (REPO / "deploy" / "helm" / "dynamo-tpu" / "values.yaml").read_text()
+    )
+    http_port = values["frontend"]["httpPort"]
+
+    route_docs = list(yaml.safe_load_all((gw_dir / "httproute.yaml").read_text()))
+    [route] = [d for d in route_docs if d and d["kind"] == "HTTPRoute"]
+    backends = [b for r in route["spec"]["rules"] for b in r["backendRefs"]]
+    assert backends, "HTTPRoute routes to nothing"
+    for b in backends:
+        # helm names the Service {{ .Release.Name }}-frontend
+        assert b["name"].endswith("-frontend"), b
+        assert b["port"] == http_port, (b, http_port)
+    # the gateway the route attaches to exists
+    [gw] = [d for d in yaml.safe_load_all((gw_dir / "gateway.yaml").read_text())
+            if d and d["kind"] == "Gateway"]
+    parents = {p["name"] for p in route["spec"]["parentRefs"]}
+    assert gw["metadata"]["name"] in parents
+
+    pool_docs = [d for d in yaml.safe_load_all(
+        (gw_dir / "inferencepool.yaml").read_text()) if d]
+    [pool] = [d for d in pool_docs if d["kind"] == "InferencePool"]
+    assert pool["spec"]["targetPortNumber"] == http_port
+    # pool selects frontend pods by the same label the chart applies
+    assert pool["spec"]["selector"]["app"].endswith("-frontend")
+    [im] = [d for d in pool_docs if d["kind"] == "InferenceModel"]
+    assert im["spec"]["poolRef"]["name"] == pool["metadata"]["name"]
+    assert im["spec"]["modelName"] == values["model"]["name"]
