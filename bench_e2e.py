@@ -97,7 +97,11 @@ def build_trace(
     gaps = rng.exponential(1.0 / qps, n_requests)
     gaps[0] = 0.0
     arrivals = np.cumsum(gaps)
-    shared_prefix = rng.randint(5, vocab - 1, size=max(isl_mean // 2, 8)).tolist()
+    # the shared prefix must span at least one full KV page (64 tokens at
+    # the worker default) — prefix-cache hits are whole committed blocks,
+    # so a sub-page prefix can never be reused and the kv-vs-round-robin
+    # comparison would measure load balancing only
+    shared_prefix = rng.randint(5, vocab - 1, size=max(isl_mean // 2, 64)).tolist()
     out = []
     for i in range(n_requests):
         n = int(isl[i])

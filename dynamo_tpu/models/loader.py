@@ -356,8 +356,8 @@ def load_moe_params(
 ) -> Dict[str, Any]:
     """Load an HF mixtral-family checkpoint into the models/moe.py tree
     (block_sparse_moe.gate + experts.N.w1/w2/w3). `quantize="int8"`
-    applies to the attention backbone + embed/head; expert stacks stay in
-    the model dtype (quant.py scope note)."""
+    covers the attention backbone, embed/head AND the expert stacks
+    (per-expert scales; the f32 router stays f32)."""
     import jax.numpy as jnp
 
     c = config
@@ -365,7 +365,24 @@ def load_moe_params(
     r = b.r
 
     def stacked_experts(key, hf_fmt):
-        # -> [L, E, in, out]
+        # -> [L, E, in, out]; int8 quantizes per (layer, expert) matrix
+        # incrementally, bounding peak host memory at one f32 expert leaf
+        if quantize == "int8":
+            from .quant import quantize_array
+
+            # shape from metadata only (get_slice): no data read
+            lshape = tuple(r.get_slice(hf_fmt.format(li=0, e=0)).get_shape())[::-1]
+            q_buf = np.empty((c.num_layers, c.num_experts, *lshape), np.int8)
+            s_buf = np.empty(
+                (c.num_layers, c.num_experts, 1, lshape[-1]), np.float32
+            )
+            for li in range(c.num_layers):
+                for e in range(c.num_experts):
+                    ql = quantize_array(
+                        np.asarray(r.get(hf_fmt.format(li=li, e=e)).T, np.float32)
+                    )
+                    q_buf[li, e], s_buf[li, e] = ql["q"], ql["s"]
+            return _place_quant({"q": q_buf, "s": s_buf}, b.layer_sh(key))
         layers = []
         for li in range(c.num_layers):
             layers.append(

@@ -25,6 +25,7 @@ from jax.sharding import PartitionSpec as P
 
 from . import llama
 from .llama import LlamaConfig, rms_norm
+from .quant import qeinsum
 
 
 @dataclass(frozen=True)
@@ -177,17 +178,13 @@ def moe_mlp(layer: Dict[str, Any], x: jax.Array, c: MoeConfig) -> jax.Array:
     )  # [T, E, C]
 
     expert_in = _constrain_ep(jnp.einsum("tec,th->ech", dispatch, h))
-    gate = jnp.einsum(
-        "ech,ehi->eci", expert_in, layer["w_gate"], preferred_element_type=jnp.float32
-    )
-    up = jnp.einsum(
-        "ech,ehi->eci", expert_in, layer["w_up"], preferred_element_type=jnp.float32
-    )
+    # qeinsum: expert stacks may be int8 (models/quant.py) — scale
+    # [E, 1, out] applies to the f32 accumulator after the einsum
+    gate = qeinsum("ech,ehi->eci", expert_in, layer["w_gate"])
+    up = qeinsum("ech,ehi->eci", expert_in, layer["w_up"])
     act = (jax.nn.silu(gate) * up).astype(c.dtype)
     expert_out = _constrain_ep(
-        jnp.einsum(
-            "eci,eih->ech", act, layer["w_down"], preferred_element_type=jnp.float32
-        )
+        qeinsum("eci,eih->ech", act, layer["w_down"])
     )
 
     out = jnp.einsum(

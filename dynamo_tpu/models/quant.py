@@ -19,9 +19,10 @@ dot: y = (x @ q) * s == x @ (q * s) for per-out-channel s, which also
 commutes with TP all-reduces (row-parallel wo/w_down stay correct under
 GSPMD).
 
-Scope: the dense llama-family backbone (projections + embed + lm_head).
-MoE expert weights keep bf16 for now (their einsums contract over the
-expert axis too; quantizing them is a follow-up).
+Scope: the dense llama-family backbone (projections + embed + lm_head)
+AND MoE expert stacks (via qeinsum — expert weights dominate MoE HBM
+traffic, so they benefit most). The MoE router stays f32: it is tiny and
+routing decisions are numerically sensitive.
 """
 
 from __future__ import annotations
@@ -38,6 +39,7 @@ __all__ = [
     "head_leaf",
     "is_quant",
     "qdot",
+    "qeinsum",
     "quantize_array",
     "quantize_tree",
     "scale_sharding",
@@ -86,6 +88,19 @@ def qdot(x: jax.Array, w, preferred_element_type=jnp.float32) -> jax.Array:
     return y * jnp.squeeze(w["s"], axis=-2)
 
 
+def qeinsum(spec: str, x: jax.Array, w) -> jax.Array:
+    """jnp.einsum over (x, w) accepting a quantized w. Valid when the
+    contraction axis is w's axis -2 and w's remaining axes map IN ORDER
+    onto the output's trailing axes — true for the expert matmuls
+    ("ech,ehi->eci" and "eci,eih->ech": scale [E, 1, out] broadcasts
+    against the [E, C, out] result without reshaping)."""
+    if not is_quant(w):
+        return jnp.einsum(spec, x, w, preferred_element_type=jnp.float32)
+    y = jnp.einsum(spec, x, w["q"].astype(x.dtype),
+                   preferred_element_type=jnp.float32)
+    return y * w["s"]
+
+
 def embed_rows(embed, tokens: jax.Array, dtype) -> jax.Array:
     """Embedding gather handling quantized tables (per-row scale [V, 1]:
     gather rows AND their scales)."""
@@ -110,17 +125,19 @@ def head_leaf(params: Dict[str, Any]):
 def quantize_tree(params: Dict[str, Any]) -> Dict[str, Any]:
     """Quantize an already-built (e.g. random-init) llama/moe param tree
     in place of a checkpoint-time quantized load: backbone projections
-    per-out-channel, embed per-row; norms, router and MoE experts keep
-    their dtype."""
+    AND MoE expert stacks per-out-channel, embed per-row; norms and the
+    f32 MoE router keep their dtype."""
     out = dict(params)
     out["embed"] = quantize_array(params["embed"], contract_axis=-1)
     if params.get("lm_head") is not None:
         out["lm_head"] = quantize_array(params["lm_head"])
     layers = dict(params["layers"])
     for name in _LAYER_LEAVES:
-        # moe trees carry w_gate/w_up/w_down as [L, E, in, out] expert
-        # stacks — skipped (see module docstring)
-        if name in layers and not is_quant(layers[name]) and layers[name].ndim == 3:
+        # dense leaves are [L, in, out]; moe expert stacks are
+        # [L, E, in, out] — both quantize per-out-channel over the
+        # contraction axis -2 (expert scale [L, E, 1, out] broadcasts in
+        # qeinsum). The f32 router is NOT in _LAYER_LEAVES and stays f32.
+        if name in layers and not is_quant(layers[name]) and layers[name].ndim in (3, 4):
             layers[name] = quantize_array(layers[name])
     out["layers"] = layers
     return out
