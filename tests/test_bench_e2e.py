@@ -66,3 +66,20 @@ def test_bench_engine_smoke_produces_result():
     assert result["metric"].startswith("engine_decode_")
     assert result["value"] > 0
     assert result["churn_tok_s"] > 0
+
+
+def test_bench_ttft_smoke_produces_breakdown():
+    """`bench_ttft.py --smoke` must produce the TTFT breakdown line with
+    every stage present and a sane ordering (engine >= raw >= noop)."""
+    r = subprocess.run(
+        [sys.executable, str(REPO / "bench_ttft.py"), "--smoke", "--reps", "3"],
+        capture_output=True, text=True, timeout=300, cwd=str(REPO),
+    )
+    assert r.returncode == 0, f"stderr tail: {r.stderr[-2000:]}"
+    line = [l for l in r.stdout.splitlines() if l.startswith("{")][-1]
+    result = json.loads(line)
+    assert result["metric"].startswith("ttft_breakdown_")
+    for k in ("rtt_noop_ms", "arg_transfer_ms", "dispatch_only_ms",
+              "prefill_fetch_ms", "engine_ttft_ms"):
+        assert result[k] > 0, k
+    assert result["engine_ttft_ms"] >= result["prefill_fetch_ms"] >= result["rtt_noop_ms"]
