@@ -71,6 +71,16 @@ class KvPushRouter:
             self.indexer = KvIndexer(drt, ns, comp, self.block_size)
         else:
             self.indexer = ApproxKvIndexer(self.block_size)
+        # event mode: a short-TTL overlay of ROUTED prefixes, merged into
+        # the event-based scores. Engine KV events take seconds to land;
+        # without the overlay, same-prefix requests arriving inside that
+        # window score overlap 0 everywhere and spread across workers —
+        # exactly the requests the KV router exists to co-locate.
+        self._inflight_overlay = (
+            ApproxKvIndexer(self.block_size, ttl=self.config.inflight_prefix_ttl_s)
+            if self.config.use_kv_events and self.config.inflight_prefix_ttl_s > 0
+            else None
+        )
         self.scheduler = KvScheduler(self.config)
         self._metrics_sub = None
         self._metrics_task: Optional[asyncio.Task] = None
@@ -134,10 +144,19 @@ class KvPushRouter:
                         msg["request_id"], msg["worker"], msg["blocks"],
                         mirrored=True,
                     )
-                    if isinstance(self.indexer, ApproxKvIndexer) and msg.get("token_ids"):
-                        self.indexer.process_routing_decision_for_request(
-                            msg["token_ids"], msg["worker"]
+                    hashes = msg.get("prefix_hashes") or []
+                    if not hashes and msg.get("token_ids"):
+                        # older peers shipped raw token ids
+                        hashes = compute_seq_hashes(
+                            msg["token_ids"], self.block_size
                         )
+                    if hashes:
+                        if isinstance(self.indexer, ApproxKvIndexer):
+                            self.indexer.apply_routed_hashes(hashes, msg["worker"])
+                        if self._inflight_overlay is not None:
+                            self._inflight_overlay.apply_routed_hashes(
+                                hashes, msg["worker"]
+                            )
                 elif msg["op"] == "free":
                     self.scheduler.mark_free(msg["request_id"])
             except Exception:  # noqa: BLE001
@@ -156,12 +175,21 @@ class KvPushRouter:
         dead = self._known_workers - live_set
         for w in dead:
             self.indexer.remove_worker(w)
+            if self._inflight_overlay is not None:
+                self._inflight_overlay.remove_worker(w)
             self.scheduler.remove_worker(w)
         self._known_workers = live_set
 
-    def find_best_match(self, token_ids: list[int], router_override: Optional[dict] = None) -> tuple[int, int]:
+    def find_best_match(
+        self,
+        token_ids: list[int],
+        router_override: Optional[dict] = None,
+        seq_hashes: Optional[list[int]] = None,
+    ) -> tuple[int, int]:
         """Returns (worker_id, overlap_blocks) — reference find_best_match
-        kv_router.rs:318."""
+        kv_router.rs:318. `seq_hashes`: precomputed block hashes (generate()
+        hashes the prompt ONCE and reuses them here, for the overlay record
+        and for the sync publish)."""
         live = self.client.instance_ids()
         if not live:
             raise StreamLost(f"no instances for {self.client.endpoint.subject}")
@@ -169,7 +197,13 @@ class KvPushRouter:
         pruned = self.scheduler.prune_mirrored()
         if pruned:
             logger.info("pruned %d stale mirrored sync entries", pruned)
-        scores = self.indexer.find_matches_for_tokens(token_ids)
+        if seq_hashes is None:
+            seq_hashes = compute_seq_hashes(token_ids, self.block_size)
+        scores = self.indexer.find_matches_for_hashes(seq_hashes)
+        if self._inflight_overlay is not None:
+            inflight = self._inflight_overlay.find_matches_for_hashes(seq_hashes)
+            for w, ov in inflight.scores.items():
+                scores.scores[w] = max(scores.scores.get(w, 0), ov)
         request_blocks = len(token_ids) // self.block_size
         cfg = self.config
         if router_override:
@@ -195,25 +229,32 @@ class KvPushRouter:
     ) -> AsyncIterator[Any]:
         token_ids = request.get("token_ids", [])
         request_id = request.get("request_id") or ""
+        seq_hashes = compute_seq_hashes(token_ids, self.block_size)
         pinned = request.get("router", {}).get("backend_instance_id")
         if pinned is not None:
             worker, overlap = int(pinned), 0
         else:
             worker, overlap = self.find_best_match(
-                token_ids, request.get("router") or None
+                token_ids, request.get("router") or None, seq_hashes=seq_hashes
             )
         request = dict(request)
         request["estimated_prefix_hit_num_blocks"] = overlap
         blocks = max(len(token_ids) // self.block_size, 1)
         self.scheduler.add_request(request_id, worker, blocks)
         if isinstance(self.indexer, ApproxKvIndexer):
-            self.indexer.process_routing_decision_for_request(token_ids, worker)
+            self.indexer.apply_routed_hashes(seq_hashes, worker)
+        if self._inflight_overlay is not None:
+            self._inflight_overlay.apply_routed_hashes(seq_hashes, worker)
         self._publish_sync(
             {
                 "op": "route", "request_id": request_id, "worker": worker,
                 "blocks": blocks,
-                "token_ids": list(token_ids)
-                if isinstance(self.indexer, ApproxKvIndexer) else [],
+                # peers mirror prefix state (approx indexer / in-flight
+                # overlay) from the block HASHES — block_size x smaller
+                # than the token list and pre-hashed for the receiver
+                "prefix_hashes": list(seq_hashes)
+                if isinstance(self.indexer, ApproxKvIndexer)
+                or self._inflight_overlay is not None else [],
             }
         )
         try:

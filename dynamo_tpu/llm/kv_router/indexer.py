@@ -221,7 +221,12 @@ class KvIndexer:
                 logger.exception("bad kv event")
 
     def find_matches_for_tokens(self, token_ids: List[int]) -> OverlapScores:
-        return self.tree.find_matches(compute_seq_hashes(token_ids, self.block_size))
+        return self.find_matches_for_hashes(
+            compute_seq_hashes(token_ids, self.block_size)
+        )
+
+    def find_matches_for_hashes(self, hashes: List[int]) -> OverlapScores:
+        return self.tree.find_matches(hashes)
 
     def remove_worker(self, worker_id: int):
         self.tree.remove_worker(worker_id)
@@ -317,12 +322,23 @@ class ApproxKvIndexer:
         self.ttl = ttl
         self.tree = make_radix_tree()
         self._expiry: List[tuple] = []  # (deadline, worker_id, hashes)
+        # refcount per (worker, hash): a hot prefix re-routed inside the
+        # TTL appends a SECOND expiry entry — without counts, the OLDER
+        # entry's expiry would erase the still-valid refresh
+        self._refs: dict = {}
 
     def process_routing_decision_for_request(self, token_ids: List[int], worker_id: int):
+        self.apply_routed_hashes(
+            compute_seq_hashes(token_ids, self.block_size), worker_id
+        )
+
+    def apply_routed_hashes(self, hashes: List[int], worker_id: int):
         import time
 
-        hashes = compute_seq_hashes(token_ids, self.block_size)
         self.tree.apply_stored(worker_id, hashes)
+        for h in hashes:
+            key = (worker_id, h)
+            self._refs[key] = self._refs.get(key, 0) + 1
         self._expiry.append((time.monotonic() + self.ttl, worker_id, hashes))
         self._expire()
 
@@ -332,11 +348,28 @@ class ApproxKvIndexer:
         now = time.monotonic()
         while self._expiry and self._expiry[0][0] < now:
             _, worker_id, hashes = self._expiry.pop(0)
-            self.tree.apply_removed(worker_id, hashes)
+            dead = []
+            for h in hashes:
+                key = (worker_id, h)
+                n = self._refs.get(key, 1) - 1
+                if n <= 0:
+                    self._refs.pop(key, None)
+                    dead.append(h)
+                else:
+                    self._refs[key] = n
+            if dead:
+                self.tree.apply_removed(worker_id, dead)
 
     def find_matches_for_tokens(self, token_ids: List[int]) -> OverlapScores:
+        return self.find_matches_for_hashes(
+            compute_seq_hashes(token_ids, self.block_size)
+        )
+
+    def find_matches_for_hashes(self, hashes: List[int]) -> OverlapScores:
         self._expire()
-        return self.tree.find_matches(compute_seq_hashes(token_ids, self.block_size))
+        return self.tree.find_matches(hashes)
 
     def remove_worker(self, worker_id: int):
         self.tree.remove_worker(worker_id)
+        self._refs = {k: v for k, v in self._refs.items() if k[0] != worker_id}
+        self._expiry = [e for e in self._expiry if e[1] != worker_id]

@@ -34,6 +34,10 @@ class KvRouterConfig:
     # dropped) they would skew active-block scoring forever; prune at a
     # max-request-lifetime TTL instead
     sync_entry_ttl_s: float = 600.0
+    # event mode only: assume a routed prefix is cached on its worker for
+    # this long, so same-prefix requests arriving BEFORE the engine's KV
+    # events co-locate instead of spreading (0 disables the overlay)
+    inflight_prefix_ttl_s: float = 30.0
 
 
 @dataclass

@@ -239,3 +239,74 @@ def test_kv_routing_e2e_prefix_affinity(kv_cluster):
             break
         time.sleep(0.4)
     assert len(others) == 2, f"expected both workers used, got {others}"
+
+
+def test_inflight_prefix_overlay_colocates_before_events():
+    """Event mode: two same-prefix requests arriving before any engine KV
+    event must co-locate (the in-flight overlay supplies the overlap the
+    events haven't delivered yet)."""
+    import asyncio
+
+    from dynamo_tpu.llm.kv_router import KvPushRouter, KvRouterConfig
+
+    class _Comp:
+        namespace, name = "dynamo", "backend"
+
+    class _Ep:
+        component = _Comp()
+        subject = "dynamo.backend.generate"
+
+    class _Client:
+        endpoint = _Ep()
+
+        def instance_ids(self):
+            return [11, 22]
+
+    class _Drt:
+        discovery = None
+
+    async def main():
+        # overlap weight 2: the overlay's 4-block overlap must STRICTLY
+        # beat the load penalty of co-locating (equal weights tie, and a
+        # temperature-0 tie breaks randomly)
+        r = KvPushRouter(
+            _Drt(), _Client(),
+            KvRouterConfig(
+                use_kv_events=True, router_temperature=0.0,
+                overlap_score_weight=2.0,
+            ),
+            block_size=4,
+        )
+        toks = list(range(16))
+        w1, ov1 = r.find_best_match(toks)
+        assert ov1 == 0  # no events, no overlay entry yet
+        # record the routing decision the way generate() does
+        r.scheduler.add_request("req-1", w1, 4)
+        r._inflight_overlay.process_routing_decision_for_request(toks, w1)
+        # same prefix, longer prompt: must follow req-1 despite its load
+        w2, ov2 = r.find_best_match(toks + [99, 100, 101, 102])
+        assert w2 == w1
+        assert ov2 == 4  # the full in-flight prefix counted as overlap
+        # disabling the overlay reproduces the old spread behavior
+        r2 = KvPushRouter(
+            _Drt(), _Client(),
+            KvRouterConfig(use_kv_events=True, inflight_prefix_ttl_s=0.0),
+            block_size=4,
+        )
+        assert r2._inflight_overlay is None
+
+    asyncio.run(main())
+
+
+def test_approx_indexer_refresh_survives_older_expiry():
+    """A hot prefix re-routed inside the TTL must survive the OLDER
+    entry's expiry (refcounted, not last-writer-erases)."""
+    idx = ApproxKvIndexer(block_size=4, ttl=0.3)
+    toks = list(range(16))
+    idx.process_routing_decision_for_request(toks, 7)
+    time.sleep(0.2)
+    idx.process_routing_decision_for_request(toks, 7)  # refresh at t=0.2
+    time.sleep(0.15)  # t=0.35: first entry expired, refresh valid to 0.5
+    assert idx.find_matches_for_tokens(toks).scores == {7: 4}
+    time.sleep(0.2)  # t=0.55: refresh expired too
+    assert idx.find_matches_for_tokens(toks).scores == {}
