@@ -1,0 +1,224 @@
+"""DynamoGraphDeployment: CRD-grade multi-service reconciliation.
+
+Role of the reference operator's CRD semantics
+(deploy/cloud/operator/api/v1alpha1/dynamographdeployment_types.go +
+dynamocomponentdeployment_controller.go): one custom resource describes
+the WHOLE serving graph — frontend, worker pools by role, planner, encode
+worker — and a controller reconciles every service to its declared
+replica count, with the SLA planner's decision overlaying the
+prefill/decode counts.
+
+The TPU build keeps the reconciler in-process (operator_lite) but adopts
+the CR shape: `GraphSpec.from_manifest` parses a DynamoGraphDeployment
+manifest (deploy/k8s/crd-dynamographdeployment.yaml defines the CRD;
+example-graphdeployment.yaml is a working CR), renders per-service k8s
+Deployments for the kubectl backend, or drives local subprocess pools
+for tests/single-host serving.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import subprocess
+import sys
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+logger = logging.getLogger("dynamo_tpu.deploy.graph")
+
+API_VERSION = "dynamo.tpu/v1alpha1"
+KIND = "DynamoGraphDeployment"
+
+
+@dataclass
+class ServiceSpec:
+    """One service of the graph (reference: spec.services map entry)."""
+
+    name: str
+    module: str  # python -m <module>
+    replicas: int = 1
+    role: Optional[str] = None  # prefill | decode | None (role-less)
+    args: List[str] = field(default_factory=list)
+
+    @property
+    def deployment_name(self) -> str:
+        return self.name.lower().replace("_", "-")
+
+    def command(self) -> List[str]:
+        return ["python", "-m", self.module, *self.args]
+
+
+@dataclass
+class GraphSpec:
+    name: str
+    namespace: str
+    image: str
+    services: List[ServiceSpec]
+
+    @classmethod
+    def from_manifest(cls, doc: dict) -> "GraphSpec":
+        if doc.get("apiVersion") != API_VERSION or doc.get("kind") != KIND:
+            raise ValueError(
+                f"not a {KIND} ({API_VERSION}): "
+                f"{doc.get('apiVersion')}/{doc.get('kind')}"
+            )
+        meta = doc.get("metadata") or {}
+        spec = doc.get("spec") or {}
+        raw = spec.get("services") or {}
+        if not raw:
+            raise ValueError("spec.services is empty")
+        services = []
+        for name, s in raw.items():
+            if "module" not in s:
+                raise ValueError(f"service {name!r} has no module")
+            role = s.get("role")
+            if role not in (None, "prefill", "decode"):
+                raise ValueError(f"service {name!r}: unknown role {role!r}")
+            services.append(
+                ServiceSpec(
+                    name=name,
+                    module=s["module"],
+                    replicas=int(s.get("replicas", 1)),
+                    role=role,
+                    args=[str(a) for a in (s.get("args") or [])],
+                )
+            )
+        return cls(
+            name=meta.get("name", "dynamo-graph"),
+            namespace=meta.get("namespace", "default"),
+            image=spec.get("image", "dynamo-tpu:latest"),
+            services=services,
+        )
+
+    def with_planner_overlay(
+        self, num_prefill: Optional[int], num_decode: Optional[int]
+    ) -> "GraphSpec":
+        """The planner's decision overrides replica counts of role-tagged
+        services (reference: the planner patches the CRD's worker
+        replicas; role-less services keep their declared counts)."""
+        out = []
+        for s in self.services:
+            replicas = s.replicas
+            if s.role == "prefill" and num_prefill is not None:
+                replicas = num_prefill
+            elif s.role == "decode" and num_decode is not None:
+                replicas = num_decode
+            out.append(ServiceSpec(s.name, s.module, replicas, s.role, list(s.args)))
+        return GraphSpec(self.name, self.namespace, self.image, out)
+
+    def render_deployments(self) -> List[dict]:
+        """k8s Deployment docs, one per service — what the kubectl backend
+        applies. Matches the label scheme of deploy/k8s/ manifests."""
+        docs = []
+        for s in self.services:
+            full = f"{self.name}-{s.deployment_name}"
+            docs.append(
+                {
+                    "apiVersion": "apps/v1",
+                    "kind": "Deployment",
+                    "metadata": {
+                        "name": full,
+                        "namespace": self.namespace,
+                        "labels": {
+                            "app": full,
+                            "dynamo.tpu/graph": self.name,
+                            "dynamo.tpu/service": s.name,
+                        },
+                    },
+                    "spec": {
+                        "replicas": s.replicas,
+                        "selector": {"matchLabels": {"app": full}},
+                        "template": {
+                            "metadata": {"labels": {"app": full}},
+                            "spec": {
+                                "containers": [
+                                    {
+                                        "name": s.deployment_name,
+                                        "image": self.image,
+                                        "command": s.command(),
+                                    }
+                                ]
+                            },
+                        },
+                    },
+                }
+            )
+        return docs
+
+
+class LocalGraphBackend:
+    """Reconcile every service to N local subprocesses (tests and
+    single-host serving; the graph analogue of LocalProcessConnector)."""
+
+    def __init__(self, env: Optional[dict] = None, python: Optional[str] = None):
+        self._procs: Dict[str, List[subprocess.Popen]] = {}
+        self.env = env
+        self.python = python or sys.executable
+
+    def _spawn(self, svc: ServiceSpec) -> subprocess.Popen:
+        cmd = [self.python, "-m", svc.module, *svc.args]
+        # DEVNULL stdin: services must not share (or die on EOF of) the
+        # operator's stdin
+        return subprocess.Popen(cmd, env=self.env, stdin=subprocess.DEVNULL)
+
+    async def apply(self, graph: GraphSpec) -> None:
+        for svc in graph.services:
+            pool = [p for p in self._procs.get(svc.name, []) if p.poll() is None]
+            while len(pool) < svc.replicas:
+                pool.append(self._spawn(svc))
+                logger.info("graph %s: started %s replica (%d/%d)",
+                            graph.name, svc.name, len(pool), svc.replicas)
+            while len(pool) > svc.replicas:
+                p = pool.pop()
+                p.terminate()
+                logger.info("graph %s: stopped %s replica (%d/%d)",
+                            graph.name, svc.name, len(pool), svc.replicas)
+            self._procs[svc.name] = pool
+
+    def replica_counts(self) -> Dict[str, int]:
+        return {
+            name: sum(1 for p in pool if p.poll() is None)
+            for name, pool in self._procs.items()
+        }
+
+    def shutdown(self) -> None:
+        for pool in self._procs.values():
+            for p in pool:
+                if p.poll() is None:
+                    p.terminate()
+        for pool in self._procs.values():
+            for p in pool:
+                try:
+                    p.wait(timeout=3)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+        self._procs.clear()
+
+
+class KubectlGraphBackend:
+    """Apply the rendered Deployments with `kubectl apply` (idempotent:
+    replica changes ride the same apply)."""
+
+    def __init__(self, kubectl: str = "kubectl"):
+        self.kubectl = kubectl
+
+    async def apply(self, graph: GraphSpec) -> None:
+        import json as _json
+
+        manifest = _json.dumps(
+            {"apiVersion": "v1", "kind": "List",
+             "items": graph.render_deployments()}
+        )
+        proc = await asyncio.create_subprocess_exec(
+            self.kubectl, "-n", graph.namespace, "apply", "-f", "-",
+            stdin=asyncio.subprocess.PIPE,
+            stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.PIPE,
+        )
+        out, err = await proc.communicate(manifest.encode())
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"kubectl apply failed rc={proc.returncode}: {err.decode()!r}"
+            )
+        logger.info("applied graph %s: %s", graph.name, out.decode().strip())

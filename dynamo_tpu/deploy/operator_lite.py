@@ -19,6 +19,12 @@ deliberately small and CRD-free:
 
 Run: python -m dynamo_tpu.deploy.operator_lite --backend kubectl \
         --prefill-deployment dynamo-prefill --decode-deployment dynamo-decode
+
+GRAPH MODE (--graph <manifest.yaml>): reconcile a whole
+DynamoGraphDeployment CR (deploy/graph.py) instead of two fixed
+deployment names — every declared service converges to its replica
+count, and planner decisions overlay the prefill/decode roles
+(reference CRD semantics, dynamographdeployment_types.go).
 """
 
 from __future__ import annotations
@@ -68,43 +74,36 @@ class KubectlScaler:
         await self._scale(self.decode_deployment, decode)
 
 
-class OperatorLite:
-    """Watch the planner's published decision; reconcile through a scaler
-    (KubectlScaler or planner.connector.LocalProcessConnector)."""
+def _parse_decision(raw) -> Optional[tuple]:
+    """(revision, num_prefill, num_decode) from the planner's published
+    decision, or None when absent/malformed."""
+    if not raw:
+        return None
+    try:
+        doc = json.loads(raw)
+        return (
+            int(doc["revision"]),
+            int(doc["num_prefill_workers"]),
+            int(doc["num_decode_workers"]),
+        )
+    except (KeyError, ValueError, TypeError, json.JSONDecodeError):
+        logger.warning("malformed planner decision: %r", raw[:200])
+        return None
 
-    def __init__(self, discovery_client, scaler, poll_s: float = 2.0):
-        self.client = discovery_client
-        self.scaler = scaler
-        self.poll_s = poll_s
-        self.applied_revision: Optional[int] = None
-        self.reconciles = 0
+
+class _PollLoop:
+    """Shared reconcile-forever loop: poll, survive errors, stoppable."""
+
+    poll_s: float = 2.0
+
+    def __init__(self):
         self._stop = asyncio.Event()
 
-    async def reconcile_once(self) -> bool:
-        """Apply the latest decision if its revision is new; returns True
-        when a scale was performed."""
-        raw = await self.client.get(PLANNER_DECISION_KEY)
-        if not raw:
-            return False
-        try:
-            doc = json.loads(raw)
-            rev = int(doc["revision"])
-            prefill = int(doc["num_prefill_workers"])
-            decode = int(doc["num_decode_workers"])
-        except (KeyError, ValueError, TypeError, json.JSONDecodeError):
-            logger.warning("malformed planner decision: %r", raw[:200])
-            return False
-        if self.applied_revision is not None and rev <= self.applied_revision:
-            return False
-        await self.scaler.set_replicas(prefill, decode)
-        self.applied_revision = rev
-        self.reconciles += 1
-        logger.info("reconciled rev=%d -> prefill=%d decode=%d",
-                    rev, prefill, decode)
-        return True
+    async def reconcile_once(self) -> bool:  # pragma: no cover - abstract
+        raise NotImplementedError
 
     async def run(self) -> None:
-        logger.info("operator-lite watching %s", PLANNER_DECISION_KEY)
+        logger.info("%s watching %s", type(self).__name__, PLANNER_DECISION_KEY)
         while not self._stop.is_set():
             try:
                 await self.reconcile_once()
@@ -117,6 +116,74 @@ class OperatorLite:
 
     def stop(self) -> None:
         self._stop.set()
+
+
+class GraphReconciler(_PollLoop):
+    """Reconcile a DynamoGraphDeployment: converge every service, overlay
+    the planner's prefill/decode decision (revision-gated like
+    OperatorLite)."""
+
+    def __init__(self, discovery_client, graph, backend, poll_s: float = 2.0):
+        super().__init__()
+        self.client = discovery_client
+        self.graph = graph
+        self.backend = backend
+        self.poll_s = poll_s
+        self.applied_revision: Optional[int] = None
+        self._applied_base = False
+        self.reconciles = 0
+
+    async def reconcile_once(self) -> bool:
+        raw = await self.client.get(PLANNER_DECISION_KEY) if self.client else None
+        decision = _parse_decision(raw)
+        fresh = decision is not None and (
+            self.applied_revision is None or decision[0] > self.applied_revision
+        )
+        if self._applied_base and not fresh:
+            return False
+        target = self.graph
+        if fresh:
+            target = self.graph.with_planner_overlay(decision[1], decision[2])
+        await self.backend.apply(target)
+        if fresh:
+            self.applied_revision = decision[0]
+        self._applied_base = True
+        self.reconciles += 1
+        logger.info(
+            "reconciled graph %s (rev=%s): %s",
+            target.name, decision[0] if fresh else None,
+            {s.name: s.replicas for s in target.services},
+        )
+        return True
+
+
+class OperatorLite(_PollLoop):
+    """Watch the planner's published decision; reconcile through a scaler
+    (KubectlScaler or planner.connector.LocalProcessConnector)."""
+
+    def __init__(self, discovery_client, scaler, poll_s: float = 2.0):
+        super().__init__()
+        self.client = discovery_client
+        self.scaler = scaler
+        self.poll_s = poll_s
+        self.applied_revision: Optional[int] = None
+        self.reconciles = 0
+
+    async def reconcile_once(self) -> bool:
+        """Apply the latest decision if its revision is new; returns True
+        when a scale was performed."""
+        decision = _parse_decision(await self.client.get(PLANNER_DECISION_KEY))
+        if decision is None:
+            return False
+        rev, prefill, decode = decision
+        if self.applied_revision is not None and rev <= self.applied_revision:
+            return False
+        await self.scaler.set_replicas(prefill, decode)
+        self.applied_revision = rev
+        self.reconciles += 1
+        logger.info("reconciled rev=%d -> prefill=%d decode=%d",
+                    rev, prefill, decode)
+        return True
 
 
 def _build_local_scaler(args) -> "object":
@@ -139,10 +206,15 @@ async def main(argv: Optional[Sequence[str]] = None) -> None:
     ap = argparse.ArgumentParser(description="dynamo-tpu operator-lite")
     ap.add_argument("--backend", choices=["kubectl", "local"], default="kubectl")
     ap.add_argument("--discovery", default=None)
-    ap.add_argument("--namespace", default="default", help="k8s namespace")
+    ap.add_argument("--namespace", default=None,
+                    help="k8s namespace (default: the graph manifest's "
+                    "metadata.namespace in --graph mode, else 'default')")
     ap.add_argument("--prefill-deployment", default="dynamo-prefill")
     ap.add_argument("--decode-deployment", default="dynamo-decode")
     ap.add_argument("--model", default="llama3-8b", help="local backend model")
+    ap.add_argument("--graph", default=None,
+                    help="DynamoGraphDeployment manifest: reconcile the "
+                    "whole graph (deploy/k8s/example-graphdeployment.yaml)")
     ap.add_argument("--poll-s", type=float, default=2.0)
     args = ap.parse_args(argv)
 
@@ -150,9 +222,29 @@ async def main(argv: Optional[Sequence[str]] = None) -> None:
     if args.discovery:
         cfg.discovery_endpoint = args.discovery
     drt = await DistributedRuntime.create(cfg)
+    if args.graph:
+        import dataclasses
+
+        import yaml
+
+        from .graph import GraphSpec, KubectlGraphBackend, LocalGraphBackend
+
+        with open(args.graph) as f:
+            graph = GraphSpec.from_manifest(yaml.safe_load(f))
+        if args.namespace:
+            graph = dataclasses.replace(graph, namespace=args.namespace)
+        backend = (
+            KubectlGraphBackend() if args.backend == "kubectl"
+            else LocalGraphBackend()
+        )
+        await GraphReconciler(
+            drt.discovery, graph, backend, poll_s=args.poll_s
+        ).run()
+        return
     if args.backend == "kubectl":
         scaler = KubectlScaler(
-            args.prefill_deployment, args.decode_deployment, args.namespace
+            args.prefill_deployment, args.decode_deployment,
+            args.namespace or "default",
         )
     else:
         scaler = _build_local_scaler(args)
