@@ -173,38 +173,55 @@ def _tag_error(line, rc):
 
 
 def _combined(args, extra):
-    """Run raw + e2e as subprocesses; print raw's JSON line, then e2e's.
-    The device is used by one process at a time (the raw bench exits before
-    the e2e worker starts)."""
+    """Run raw + engine + e2e as subprocesses (the BENCH_r04 triple the
+    round-3 verdict prescribes). Lines print AS EACH PHASE COMPLETES, so
+    a driver timeout mid-run still leaves the finished phases in the
+    recorded tail; the LAST printed line is the recorded headline."""
     smoke = ["--smoke"] if args.smoke else []
     model = ["--model", args.model] if args.model else []
+    quant = ["--quantize", args.quantize] if args.quantize else []
     raw_line, raw_rc = _json_lines(
         [sys.executable, __file__, "--raw", *smoke, *model,
          "--batch", str(args.batch), "--isl", str(args.isl),
          "--osl", str(args.osl), "--block", str(args.block),
-         *(["--steps", str(args.steps)] if args.steps else []),
-         *(["--quantize", args.quantize] if args.quantize else [])],
+         *(["--steps", str(args.steps)] if args.steps else []), *quant],
         "raw",
     )
+    raw_ok = raw_line is not None and raw_rc == 0
+    if raw_line:
+        print(raw_line if raw_ok else _tag_error(raw_line, raw_rc), flush=True)
+    eng_line, eng_rc = _json_lines(
+        [sys.executable, str(Path(__file__).parent / "bench_engine.py"),
+         *smoke, *model, "--batch", str(args.batch), "--isl", str(args.isl),
+         "--osl", str(args.osl), "--block", str(args.block), *quant],
+        "engine",
+    )
+    if eng_line:
+        print(eng_line if eng_rc == 0 else _tag_error(eng_line, eng_rc),
+              flush=True)
     e2e_line, e2e_rc = _json_lines(
         [sys.executable, str(Path(__file__).parent / "bench_e2e.py"),
          "--mode", "agg", *smoke, *model, *extra],
         "e2e",
     )
     # headline = LAST printed line; never let a failed subprocess's numbers
-    # stand as the headline untagged, and propagate failure in the exit code
-    raw_ok = raw_line is not None and raw_rc == 0
+    # stand as the headline untagged, and propagate ANY phase failure in
+    # the exit code
+    eng_ok = eng_line is not None and eng_rc == 0
     e2e_ok = e2e_line is not None and e2e_rc == 0
     if e2e_ok:
-        if raw_line:
-            print(raw_line if raw_ok else _tag_error(raw_line, raw_rc))
         print(e2e_line)
-        sys.exit(0)
+        sys.exit(0 if (raw_ok and eng_ok) else 1)
     # headline e2e failed: print whatever was measured (tagged), exit 1.
-    # Ordering keeps the best available line LAST (the headline slot).
+    # Ordering keeps the best available UNTAGGED line LAST (the headline
+    # slot) — tagged failures first, then engine, then raw (raw is the
+    # most comparable single number across rounds).
     printed = False
     if e2e_line:  # e2e produced a line but exited nonzero (failed requests)
         print(_tag_error(e2e_line, e2e_rc))
+        printed = True
+    if eng_line:
+        print(eng_line if eng_ok else _tag_error(eng_line, eng_rc))
         printed = True
     if raw_line:
         print(raw_line if raw_ok else _tag_error(raw_line, raw_rc))
@@ -213,7 +230,8 @@ def _combined(args, extra):
         print(json.dumps({
             "metric": "e2e_output_toks_agg", "value": 0.0, "unit": "tok/s",
             "vs_baseline": 0.0, "error": "bench_failed",
-            "detail": f"raw rc={raw_rc} e2e rc={e2e_rc}, no JSON produced",
+            "detail": f"raw rc={raw_rc} engine rc={eng_rc} e2e rc={e2e_rc}, "
+                      "no JSON produced",
         }))
     sys.exit(1)
 
