@@ -23,10 +23,16 @@ Two deliberate TPU-build choices:
 
 from __future__ import annotations
 
+import asyncio
 import hashlib
+import logging
 from typing import Any, Dict, List, Tuple
 
 import numpy as np
+
+from ..runtime import StreamLost
+
+logger = logging.getLogger(__name__)
 
 __all__ = [
     "MockVisionEncoder",
@@ -120,9 +126,12 @@ class EncodeOperator:
     request BEFORE the router hop — so KV-aware routing and the engine
     prefix cache see the content-derived placeholder ids."""
 
-    def __init__(self, router, vocab_size: int):
+    def __init__(self, router, vocab_size: int, max_attempts: int = 3,
+                 retry_delay_s: float = 2.0):
         self.router = router  # PushRouter over the encode endpoint
         self.vocab_size = vocab_size
+        self.max_attempts = max_attempts
+        self.retry_delay_s = retry_delay_s
 
     @property
     def name(self) -> str:
@@ -136,13 +145,39 @@ class EncodeOperator:
         if all(p.get("embedding") is not None and p.get("position") is not None
                for p in mm):
             return request  # already encoded (disagg/migration re-entry)
-        stream = await self.router.generate({"multimodal": list(mm)}, context)
         encoded, n_tokens = None, DEFAULT_MM_TOKENS
-        async for item in stream:
-            d = item.get("data") if isinstance(item, dict) else None
-            if d and "multimodal" in d:
-                encoded = d["multimodal"]
-                n_tokens = int(d.get("n_tokens") or n_tokens)
+        # the engine hop gets retries from the Migration operator; the
+        # encode hop sits ABOVE it, so a restarting encode pool (brief
+        # zero-instance window) must be ridden out here
+        last_exc: Exception | None = None
+        for attempt in range(self.max_attempts):
+            if attempt:
+                await asyncio.sleep(self.retry_delay_s)
+                # cancelled/killed requests must not keep hammering a
+                # recovering encode pool (mirrors migration.py's guard)
+                if context is not None and (
+                    context.is_stopped() or context.is_killed()
+                ):
+                    raise last_exc
+            try:
+                stream = await self.router.generate(
+                    {"multimodal": list(mm)}, context
+                )
+                async for item in stream:
+                    d = item.get("data") if isinstance(item, dict) else None
+                    if d and "multimodal" in d:
+                        encoded = d["multimodal"]
+                        n_tokens = int(d.get("n_tokens") or n_tokens)
+                last_exc = None
+                break
+            except StreamLost as e:
+                last_exc = e
+                logger.warning(
+                    "encode hop attempt %d/%d failed: %s",
+                    attempt + 1, self.max_attempts, e,
+                )
+        if last_exc is not None:
+            raise last_exc
         if encoded is None:
             raise RuntimeError("encode worker returned no embeddings")
         token_ids = request["token_ids"] if is_dict else request.token_ids

@@ -239,3 +239,70 @@ def test_multimodal_epd_serving_e2e(tmp_path):
         worker.stop()
         enc.stop()
         fe.stop()
+
+
+def test_encode_operator_reentry_skips_encode():
+    """Migration re-sends a request whose parts already carry embeddings +
+    positions (the operator stamped them on the first pass) — the encode
+    hop must pass it through untouched, not re-encode or re-splice."""
+    from dynamo_tpu.llm.multimodal import EncodeOperator
+
+    class _Router:
+        called = 0
+
+        async def generate(self, req, ctx):
+            self.called += 1
+            raise AssertionError("must not call the encode worker")
+
+    router = _Router()
+    op = EncodeOperator(router, vocab_size=512)
+    stamped = {"type": "image_url", "url": "x", "position": 8,
+               "n_tokens": 4, "embedding": [[0.0] * 64] * 4}
+    req = {"token_ids": list(range(12)), "multimodal": [stamped]}
+
+    out = asyncio.run(op.forward(dict(req), None))
+    assert out["token_ids"] == req["token_ids"]  # no re-splice
+    assert out["multimodal"] == [stamped]
+    assert router.called == 0
+
+
+def test_encode_operator_retries_transient_stream_loss():
+    """A restarting encode pool (brief zero-instance window) must be
+    ridden out by the hop's retry, not surfaced to the client."""
+    from dynamo_tpu.llm.multimodal import EncodeOperator
+    from dynamo_tpu.runtime import StreamLost
+
+    enc = MockVisionEncoder(hidden_size=16, n_tokens=2)
+    part = {"type": "image_url", "url": "r"}
+
+    class _FlakyRouter:
+        calls = 0
+
+        async def generate(self, req, ctx):
+            self.calls += 1
+            if self.calls == 1:
+                raise StreamLost("no instances for dynamo.encoder.encode")
+
+            async def stream():
+                yield {"data": {"multimodal": encode_parts([part], enc),
+                               "n_tokens": 2}}
+
+            return stream()
+
+    router = _FlakyRouter()
+    op = EncodeOperator(router, vocab_size=512, retry_delay_s=0.05)
+    req = {"token_ids": [5, 6, 7], "multimodal": [part]}
+    out = asyncio.run(op.forward(req, None))
+    assert router.calls == 2
+    assert out["multimodal"][0]["embedding"] is not None
+    assert len(out["token_ids"]) == 3 + 2  # placeholders spliced
+
+    # permanent loss still surfaces after the attempts are exhausted
+    class _DeadRouter:
+        async def generate(self, req, ctx):
+            raise StreamLost("gone")
+
+    op2 = EncodeOperator(_DeadRouter(), vocab_size=512, max_attempts=2,
+                         retry_delay_s=0.05)
+    with pytest.raises(StreamLost):
+        asyncio.run(op2.forward({"token_ids": [1], "multimodal": [part]}, None))
