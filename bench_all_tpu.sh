@@ -1,0 +1,31 @@
+#!/bin/bash
+# The round-4 TPU measurement ladder (BENCH_NOTES_r04.md "queued for
+# TPU"). Run when the axon tunnel is back:  bash bench_all_tpu.sh
+# Appends every JSON line to bench_tpu_results.jsonl as phases complete,
+# so a mid-ladder outage keeps everything already measured.
+set -u
+cd "$(dirname "$0")"
+OUT=bench_tpu_results.jsonl
+log() { echo "### $(date -u +%H:%M:%S) $*" | tee -a $OUT; }
+
+run() {  # run <timeout_s> <label> <cmd...>
+  local t=$1 label=$2; shift 2
+  log "$label: $*"
+  timeout "$t" "$@" 2> >(tail -5 >&2) | grep "^{" | tee -a $OUT
+  log "$label done rc=$?"
+}
+
+log "ladder start"
+# 1. headline triple (raw + engine + e2e agg); first run pays compiles
+run 3600 triple python bench.py
+# 2. ttft breakdown (net of tunnel floor)
+run 1200 ttft python bench_ttft.py
+# 3. KV-write strategy sweep at production pool sizes
+run 5400 sweep python bench_sweep.py --quick --out sweep_tpu.json
+# 4. int8 decode ceiling (raw + engine)
+run 1800 int8_raw python bench.py --raw --quantize int8
+run 1800 int8_engine python bench.py --engine --quantize int8
+# 5. e2e disagg + kv router benefit
+run 3600 disagg python bench_e2e.py --mode disagg
+run 5400 kv_benefit python bench_e2e.py --mode kv --prefix-ratio 0.5 --router-compare
+log "ladder complete"
