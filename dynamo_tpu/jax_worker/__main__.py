@@ -147,7 +147,15 @@ async def main():
 
         from dynamo_tpu.engine.engine import _resolve_model
 
-        model_config = _resolve_model(args.model)
+        from dynamo_tpu.models.loader import _find_gguf, config_from_gguf
+
+        gguf_path = _find_gguf(args.model_path) if args.model_path else None
+        if gguf_path is not None:
+            # the checkpoint is authoritative: shapes come from the .gguf
+            # metadata/tensors, no registry entry needed
+            model_config = config_from_gguf(gguf_path)
+        else:
+            model_config = _resolve_model(args.model)
         is_moe = isinstance(model_config, moe.MoeConfig)
         model_mod = moe if is_moe else llama
         shardings = None

@@ -1,9 +1,11 @@
-"""GGUF file parsing: header, metadata KVs, embedded tokenizer.
+"""GGUF file parsing: header, metadata KVs, embedded tokenizer, tensors.
 
 Role of the reference's gguf module (lib/llm/src/gguf/{content,
 gguf_metadata,gguf_tokenizer}.rs): read enough of a .gguf checkpoint to
-build a ModelDeploymentCard — architecture, context length, block/head
-dims, and the embedded tokenizer vocabulary — without loading tensor data.
+build a ModelDeploymentCard. The reference stops at metadata (tensor
+serving is delegated to llamacpp); here the tensor table + data are ALSO
+readable (f32 / f16 / q8_0), so a .gguf checkpoint loads straight into
+the JAX engine (models/loader.py gguf path) — no llamacpp needed.
 Spec: https://github.com/ggml-org/ggml/blob/master/docs/gguf.md
 """
 
@@ -15,6 +17,11 @@ from pathlib import Path
 from typing import Any, BinaryIO, Dict, List, Optional
 
 GGUF_MAGIC = b"GGUF"
+GGUF_ALIGNMENT = 32  # spec default (general.alignment overrides)
+
+# ggml tensor dtypes we read/write
+GGML_F32, GGML_F16, GGML_Q8_0 = 0, 1, 8
+Q8_0_BLOCK = 32  # elements per q8_0 block (f16 scale + 32 int8)
 
 # metadata value type ids (gguf spec)
 T_U8, T_I8, T_U16, T_I16, T_U32, T_I32, T_F32, T_BOOL = range(8)
@@ -50,10 +57,22 @@ def _read_value(f: BinaryIO, vtype: int):
 
 
 @dataclass
+class GgufTensorInfo:
+    name: str
+    shape: tuple  # numpy order (ggml's ne[] is reversed: ne[0] = innermost)
+    ggml_type: int
+    offset: int  # within the aligned data blob
+
+
+@dataclass
 class GgufContent:
     version: int
     tensor_count: int
     metadata: Dict[str, Any] = field(default_factory=dict)
+    # populated by read_gguf(with_tensors=True):
+    tensors: Dict[str, GgufTensorInfo] = field(default_factory=dict)
+    data_start: int = 0
+    path: Optional[str] = None
 
     # -- typed accessors over the conventional keys ------------------------
     @property
@@ -110,8 +129,9 @@ class GgufContent:
         return self.metadata.get("tokenizer.chat_template")
 
 
-def read_gguf(path) -> GgufContent:
-    """Parse header + metadata (tensor infos and data are skipped)."""
+def read_gguf(path, with_tensors: bool = False) -> GgufContent:
+    """Parse header + metadata; with_tensors=True also parses the tensor
+    table and records the aligned data-blob offset for load_tensor."""
     with open(path, "rb") as f:
         if f.read(4) != GGUF_MAGIC:
             raise ValueError(f"{path}: not a GGUF file")
@@ -125,7 +145,59 @@ def read_gguf(path) -> GgufContent:
             key = _read_string(f)
             (vtype,) = struct.unpack("<I", f.read(4))
             meta[key] = _read_value(f, vtype)
-    return GgufContent(version=version, tensor_count=tensor_count, metadata=meta)
+        tensors: Dict[str, GgufTensorInfo] = {}
+        data_start = 0
+        if with_tensors:
+            for _ in range(tensor_count):
+                name = _read_string(f)
+                (n_dims,) = struct.unpack("<I", f.read(4))
+                dims = struct.unpack(f"<{n_dims}Q", f.read(8 * n_dims))
+                (ggml_type,) = struct.unpack("<I", f.read(4))
+                (offset,) = struct.unpack("<Q", f.read(8))
+                tensors[name] = GgufTensorInfo(
+                    name=name, shape=tuple(reversed(dims)),
+                    ggml_type=ggml_type, offset=offset,
+                )
+            align = int(meta.get("general.alignment", GGUF_ALIGNMENT))
+            pos = f.tell()
+            data_start = (pos + align - 1) // align * align
+    return GgufContent(
+        version=version, tensor_count=tensor_count, metadata=meta,
+        tensors=tensors, data_start=data_start, path=str(path),
+    )
+
+
+def load_tensor(content: GgufContent, name: str):
+    """Read one tensor as float32 numpy (f32 / f16 / q8_0)."""
+    import numpy as np
+
+    info = content.tensors[name]
+    n = 1
+    for d in info.shape:
+        n *= d
+    with open(content.path, "rb") as f:
+        f.seek(content.data_start + info.offset)
+        if info.ggml_type == GGML_F32:
+            arr = np.fromfile(f, dtype="<f4", count=n)
+        elif info.ggml_type == GGML_F16:
+            arr = np.fromfile(f, dtype="<f2", count=n).astype(np.float32)
+        elif info.ggml_type == GGML_Q8_0:
+            if n % Q8_0_BLOCK:
+                raise ValueError(f"{name}: q8_0 size {n} not /{Q8_0_BLOCK}")
+            blocks = np.fromfile(
+                f, dtype=np.dtype([("d", "<f2"), ("qs", "i1", (Q8_0_BLOCK,))]),
+                count=n // Q8_0_BLOCK,
+            )
+            arr = (
+                blocks["d"].astype(np.float32)[:, None]
+                * blocks["qs"].astype(np.float32)
+            ).reshape(-1)
+        else:
+            raise ValueError(
+                f"{name}: ggml type {info.ggml_type} unsupported "
+                f"(f32/f16/q8_0 only)"
+            )
+    return np.asarray(arr, np.float32).reshape(info.shape)
 
 
 def mdc_from_gguf(path, kv_cache_block_size: int = 64):
@@ -155,8 +227,13 @@ def mdc_from_gguf(path, kv_cache_block_size: int = 64):
     return card
 
 
-def write_gguf(path, metadata: Dict[str, Any], tensor_count: int = 0) -> None:
-    """Minimal GGUF writer (metadata only) — testing and interchange."""
+def write_gguf(path, metadata: Dict[str, Any], tensor_count: int = 0,
+               tensors: Optional[Dict[str, Any]] = None,
+               tensor_types: Optional[Dict[str, int]] = None) -> None:
+    """Minimal GGUF writer — testing and interchange. `tensors` maps
+    name -> float32 ndarray; `tensor_types` picks GGML_F32 (default),
+    GGML_F16 or GGML_Q8_0 per tensor (q8_0 quantizes on write)."""
+    import numpy as np
 
     def w_string(f, s: str):
         b = s.encode()
@@ -191,11 +268,54 @@ def write_gguf(path, metadata: Dict[str, Any], tensor_count: int = 0) -> None:
         else:
             raise TypeError(f"unsupported gguf value {type(v)}")
 
+    def encode_tensor(arr: "np.ndarray", t: int) -> bytes:
+        flat = np.asarray(arr, np.float32).reshape(-1)
+        if t == GGML_F32:
+            return flat.astype("<f4").tobytes()
+        if t == GGML_F16:
+            return flat.astype("<f2").tobytes()
+        if t == GGML_Q8_0:
+            if flat.size % Q8_0_BLOCK:
+                raise ValueError(f"q8_0 needs size /{Q8_0_BLOCK}")
+            b = flat.reshape(-1, Q8_0_BLOCK)
+            d = np.maximum(np.abs(b).max(axis=1), 1e-12) / 127.0
+            qs = np.clip(np.round(b / d[:, None]), -127, 127).astype(np.int8)
+            out = np.empty(
+                b.shape[0],
+                np.dtype([("d", "<f2"), ("qs", "i1", (Q8_0_BLOCK,))]),
+            )
+            out["d"] = d.astype("<f2")
+            out["qs"] = qs
+            return out.tobytes()
+        raise ValueError(f"unsupported write type {t}")
+
+    tensors = tensors or {}
+    tensor_types = tensor_types or {}
+    align = int(metadata.get("general.alignment", GGUF_ALIGNMENT))
     with open(path, "wb") as f:
         f.write(GGUF_MAGIC)
         f.write(struct.pack("<I", 3))
-        f.write(struct.pack("<Q", tensor_count))
+        f.write(struct.pack("<Q", tensor_count or len(tensors)))
         f.write(struct.pack("<Q", len(metadata)))
         for k, v in metadata.items():
             w_string(f, k)
             w_value(f, v)
+        if tensors:
+            blobs = []
+            offset = 0
+            for name, arr in tensors.items():
+                t = tensor_types.get(name, GGML_F32)
+                blob = encode_tensor(arr, t)
+                w_string(f, name)
+                dims = tuple(reversed(arr.shape))  # ggml ne order
+                f.write(struct.pack("<I", len(dims)))
+                f.write(struct.pack(f"<{len(dims)}Q", *dims))
+                f.write(struct.pack("<I", t))
+                f.write(struct.pack("<Q", offset))
+                blobs.append(blob)
+                offset += (len(blob) + align - 1) // align * align
+            pos = f.tell()
+            f.write(b"\x00" * (-pos % align))
+            for blob in blobs:
+                f.write(blob)
+                f.write(b"\x00" * (-len(blob) % align))

@@ -335,7 +335,13 @@ def load_llama_params(
     `shardings` (from LlamaShardings.param_shardings()) places each leaf on
     the mesh as it loads. `quantize="int8"` stores projections/embed/head
     as int8 + per-channel scales (models/quant.py) — llama3-8b drops from
-    ~16 GB to ~8.5 GB and fits a v5e chip beside its KV pool."""
+    ~16 GB to ~8.5 GB and fits a v5e chip beside its KV pool.
+
+    A .gguf path (file, or a directory holding one .gguf) takes the GGUF
+    branch (load_llama_params_gguf)."""
+    gg = _find_gguf(model_dir)
+    if gg is not None:
+        return load_llama_params_gguf(gg, config, shardings, quantize)
     b = _TreeBuilder(_open_checkpoint(model_dir), config, shardings, quantize)
     params = b.backbone()
     params["layers"].update(
@@ -454,3 +460,159 @@ def save_llama_as_hf(params: Dict[str, Any], config, out_dir: str) -> None:
     if params.get("lm_head") is not None:
         tensors["lm_head.weight"] = f32t(params["lm_head"])
     save_file(tensors, os.path.join(out_dir, "model.safetensors"))
+
+
+# --------------------------------------------------------------------- #
+# GGUF checkpoints (llama.cpp naming) — reference parity note: the
+# reference only reads GGUF *metadata* and delegates tensor serving to
+# llamacpp (lib/llm/src/gguf/); here the tensors load straight into the
+# JAX engine (llm/gguf.py load_tensor: f32 / f16 / q8_0).
+# --------------------------------------------------------------------- #
+
+def _find_gguf(path_or_repo: str):
+    """The .gguf file a path denotes, or None for the safetensors branch."""
+    p = Path(os.path.expanduser(str(path_or_repo)))
+    if p.suffix == ".gguf" and p.exists():
+        return str(p)
+    if p.is_dir():
+        ggufs = sorted(p.glob("*.gguf"))
+        if len(ggufs) == 1 and not (p / "model.safetensors.index.json").exists() \
+                and not list(p.glob("*.safetensors")):
+            return str(ggufs[0])
+    return None
+
+
+_GGUF_LAYER_MAP = {
+    # gguf name suffix -> (tree key, transpose)
+    "attn_norm.weight": ("attn_norm", False),
+    "attn_q.weight": ("wq", True),
+    "attn_k.weight": ("wk", True),
+    "attn_v.weight": ("wv", True),
+    "attn_output.weight": ("wo", True),
+    "ffn_norm.weight": ("mlp_norm", False),
+    "ffn_gate.weight": ("w_gate", True),
+    "ffn_up.weight": ("w_up", True),
+    "ffn_down.weight": ("w_down", True),
+}
+
+
+def config_from_gguf(path_or_content):
+    """LlamaConfig derived from a .gguf file's metadata + tensor shapes
+    (the checkpoint is authoritative; no registry entry needed). Accepts
+    a path or an already-parsed GgufContent (tokenizer-bearing metadata
+    takes seconds to parse — don't parse twice)."""
+    from ..llm.gguf import GgufContent, read_gguf
+
+    g = (
+        path_or_content
+        if isinstance(path_or_content, GgufContent)
+        else read_gguf(path_or_content, with_tensors=True)
+    )
+    from .llama import LlamaConfig
+
+    emb = g.tensors.get("token_embd.weight")
+    if emb is None:
+        raise ValueError(f"{g.path}: no token_embd.weight tensor")
+    vocab, hidden = emb.shape
+    # critical geometry must COME FROM the file: silently defaulting
+    # layers/heads would serve a truncated model as garbage tokens
+    if not g.num_layers or not g.num_heads:
+        raise ValueError(
+            f"{g.path}: missing {g.architecture or '?'}.block_count / "
+            f".attention.head_count metadata (architecture key "
+            f"{g.metadata.get('general.architecture')!r})"
+        )
+    heads = int(g.num_heads)
+    meta = g.metadata
+    arch = g.architecture or "llama"
+    gate = g.tensors.get("blk.0.ffn_gate.weight")
+    return LlamaConfig(
+        vocab_size=int(vocab),
+        hidden_size=int(hidden),
+        intermediate_size=int(gate.shape[0]) if gate is not None else 4 * hidden,
+        num_layers=int(g.num_layers),
+        num_heads=heads,
+        num_kv_heads=int(g.num_kv_heads or heads),
+        head_dim=int(
+            meta.get(f"{arch}.attention.key_length", hidden // heads)
+        ),
+        rope_theta=float(meta.get(f"{arch}.rope.freq_base", 10000.0)),
+        rms_norm_eps=float(
+            meta.get(f"{arch}.attention.layer_norm_rms_epsilon", 1e-5)
+        ),
+        max_position=int(g.context_length or 8192),
+        tie_embeddings="output.weight" not in g.tensors,
+    )
+
+
+def load_llama_params_gguf(
+    path,
+    config=None,
+    shardings: Optional[dict] = None,
+    quantize: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Load a .gguf llama-family checkpoint into the models/llama.py tree.
+    Tensors dequantize to f32 on read (q8_0 included), then cast to the
+    model dtype — or requantize per-out-channel when quantize="int8"
+    (GGUF's per-32-group q8_0 granularity differs from the engine's
+    per-channel scheme, so int8 serving goes through a requantize)."""
+    from ..llm.gguf import load_tensor, read_gguf
+    from .quant import quantize_array
+
+    g = read_gguf(path, with_tensors=True)
+    c = config or config_from_gguf(g)
+    sh = shardings or {}
+
+    def place(arr, sharding, *, quant, contract_axis=-2):
+        if quantize == "int8" and quant:
+            return _place_quant(
+                quantize_array(arr, contract_axis=contract_axis), sharding
+            )
+        return _place(arr, c.dtype, sharding)
+
+    # one pre-sized buffer per layer-stacked leaf; only ONE layer's f32
+    # tensor is transient at a time (the safetensors path's
+    # _place_stacked/_stacked_quant discipline — a 70B q8_0 gguf must not
+    # materialize ~280 GB of f32 lists)
+    target = _np_dtype(c.dtype)
+    layer_sh = sh.get("layers", {}) if sh else {}
+    layers: Dict[str, Any] = {}
+    for suffix, (key, transpose) in _GGUF_LAYER_MAP.items():
+        info = g.tensors[f"blk.0.{suffix}"]
+        lshape = tuple(reversed(info.shape)) if transpose else info.shape
+        do_quant = quantize == "int8" and key not in ("attn_norm", "mlp_norm")
+        if do_quant:
+            q_buf = np.empty((c.num_layers, *lshape), np.int8)
+            s_buf = np.empty((c.num_layers, *lshape[:-2], 1, lshape[-1]),
+                             np.float32)
+            for li in range(c.num_layers):
+                arr = load_tensor(g, f"blk.{li}.{suffix}")
+                ql = quantize_array(arr.T if transpose else arr)
+                q_buf[li], s_buf[li] = ql["q"], ql["s"]
+            layers[key] = _place_quant(
+                {"q": q_buf, "s": s_buf}, layer_sh.get(key)
+            )
+        else:
+            buf = np.empty((c.num_layers, *lshape), target)
+            for li in range(c.num_layers):
+                arr = load_tensor(g, f"blk.{li}.{suffix}")
+                buf[li] = arr.T if transpose else arr  # casts on assign
+            layers[key] = _place(buf, c.dtype, layer_sh.get(key))
+
+    params: Dict[str, Any] = {
+        "layers": layers,
+        "embed": place(
+            load_tensor(g, "token_embd.weight"), sh.get("embed"),
+            quant=True, contract_axis=-1,
+        ),
+        "final_norm": _place(
+            load_tensor(g, "output_norm.weight"), c.dtype, sh.get("final_norm")
+        ),
+    }
+    if "output.weight" in g.tensors and not c.tie_embeddings:
+        params["lm_head"] = place(
+            load_tensor(g, "output.weight").T, sh.get("lm_head"), quant=True
+        )
+    else:
+        params["lm_head"] = None
+    return params

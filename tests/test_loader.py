@@ -206,3 +206,118 @@ class TestResolveModelPath:
             np.asarray(loaded["layers"]["wq"]), np.asarray(params["layers"]["wq"]),
             atol=0,
         )
+
+
+class TestGgufLoader:
+    """GGUF checkpoint serving (llm/gguf.py tensors + loader gguf branch).
+    The reference reads gguf METADATA only and delegates tensors to
+    llamacpp; here a .gguf loads straight into the JAX engine."""
+
+    def _write_gguf(self, path, cfg, params, ttype):
+        from dynamo_tpu.llm.gguf import GGML_F32, write_gguf
+
+        f32 = lambda x: np.asarray(x, np.float32)  # noqa: E731
+        tensors = {"token_embd.weight": f32(params["embed"])}
+        L = params["layers"]
+        gmap = {
+            "attn_norm": "attn_norm.weight", "wq": "attn_q.weight",
+            "wk": "attn_k.weight", "wv": "attn_v.weight",
+            "wo": "attn_output.weight", "mlp_norm": "ffn_norm.weight",
+            "w_gate": "ffn_gate.weight", "w_up": "ffn_up.weight",
+            "w_down": "ffn_down.weight",
+        }
+        for li in range(cfg.num_layers):
+            for key, suffix in gmap.items():
+                arr = f32(L[key][li])
+                if key not in ("attn_norm", "mlp_norm"):
+                    arr = np.ascontiguousarray(arr.T)  # gguf keeps [out, in]
+                tensors[f"blk.{li}.{suffix}"] = arr
+        tensors["output_norm.weight"] = f32(params["final_norm"])
+        if params.get("lm_head") is not None:
+            tensors["output.weight"] = np.ascontiguousarray(
+                f32(params["lm_head"]).T
+            )
+        types = {
+            # norms/embed stay f32; the matmul weights take the sweep type
+            name: (ttype if ".weight" in name and "norm" not in name
+                   and name != "token_embd.weight" else GGML_F32)
+            for name in tensors
+        }
+        meta = {
+            "general.architecture": "llama",
+            "general.name": "tiny-gguf",
+            "llama.block_count": cfg.num_layers,
+            "llama.attention.head_count": cfg.num_heads,
+            "llama.attention.head_count_kv": cfg.num_kv_heads,
+            "llama.attention.key_length": cfg.head_dim,
+            "llama.embedding_length": cfg.hidden_size,
+            "llama.context_length": 256,
+            "llama.rope.freq_base": cfg.rope_theta,
+            "llama.attention.layer_norm_rms_epsilon": cfg.rms_norm_eps,
+        }
+        write_gguf(path, meta, tensors=tensors, tensor_types=types)
+
+    def _tiny(self):
+        from dynamo_tpu.models import llama
+
+        cfg = llama.LlamaConfig.tiny(dtype=jnp.float32, tie_embeddings=False)
+        return cfg, llama.init_params(cfg, jax.random.PRNGKey(3))
+
+    def test_config_from_gguf(self, tmp_path):
+        from dynamo_tpu.llm.gguf import GGML_F32
+        from dynamo_tpu.models.loader import config_from_gguf
+
+        cfg, params = self._tiny()
+        path = tmp_path / "m.gguf"
+        self._write_gguf(path, cfg, params, GGML_F32)
+        derived = config_from_gguf(str(path))
+        assert derived.vocab_size == cfg.vocab_size
+        assert derived.hidden_size == cfg.hidden_size
+        assert derived.num_layers == cfg.num_layers
+        assert derived.num_heads == cfg.num_heads
+        assert derived.num_kv_heads == cfg.num_kv_heads
+        assert derived.head_dim == cfg.head_dim
+        assert derived.rope_theta == cfg.rope_theta
+        assert derived.tie_embeddings is False
+
+    def test_f32_round_trip_exact(self, tmp_path):
+        from dynamo_tpu.llm.gguf import GGML_F32
+        from dynamo_tpu.models.loader import load_llama_params
+
+        cfg, params = self._tiny()
+        path = tmp_path / "m.gguf"
+        self._write_gguf(path, cfg, params, GGML_F32)
+        # both a direct file path and the containing dir resolve
+        loaded = load_llama_params(str(path), cfg)
+        for (ko, orig), (kn, new) in zip(
+            sorted(jax.tree_util.tree_leaves_with_path(params), key=str),
+            sorted(jax.tree_util.tree_leaves_with_path(loaded), key=str),
+        ):
+            assert str(ko) == str(kn)
+            np.testing.assert_allclose(
+                np.asarray(orig, np.float32), np.asarray(new, np.float32),
+                atol=0, err_msg=str(ko),
+            )
+        loaded_dir = load_llama_params(str(tmp_path), cfg)
+        np.testing.assert_array_equal(
+            np.asarray(loaded_dir["layers"]["wq"]),
+            np.asarray(loaded["layers"]["wq"]),
+        )
+
+    def test_q8_0_loads_close_and_serves_int8(self, tmp_path):
+        from dynamo_tpu.llm.gguf import GGML_Q8_0
+        from dynamo_tpu.models.loader import load_llama_params
+
+        cfg, params = self._tiny()
+        path = tmp_path / "m.gguf"
+        self._write_gguf(path, cfg, params, GGML_Q8_0)
+        loaded = load_llama_params(str(path), cfg)
+        wq0, wq1 = np.asarray(params["layers"]["wq"]), np.asarray(loaded["layers"]["wq"])
+        # q8_0 is per-32-group symmetric int8: bounded error, not exact
+        assert np.abs(wq0 - wq1).max() <= np.abs(wq0).max() / 127.0 + 1e-6
+        assert np.abs(wq0 - wq1).max() > 0
+        # int8 serving path: per-channel requantize of the dequantized tree
+        q = load_llama_params(str(path), cfg, quantize="int8")
+        from dynamo_tpu.models.quant import is_quant
+
+        assert is_quant(q["layers"]["wq"]) and is_quant(q["embed"])
