@@ -363,8 +363,13 @@ def load_moe_params(
     """Load an HF mixtral-family checkpoint into the models/moe.py tree
     (block_sparse_moe.gate + experts.N.w1/w2/w3). `quantize="int8"`
     covers the attention backbone, embed/head AND the expert stacks
-    (per-expert scales; the f32 router stays f32)."""
+    (per-expert scales; the f32 router stays f32). A .gguf path takes
+    the GGUF branch (ffn_*_exps / ffn_gate_inp naming)."""
     import jax.numpy as jnp
+
+    gg = _find_gguf(model_dir)
+    if gg is not None:
+        return load_llama_params_gguf(gg, config, shardings, quantize)
 
     c = config
     b = _TreeBuilder(_open_checkpoint(model_dir), config, shardings, quantize)
@@ -483,7 +488,9 @@ def _find_gguf(path_or_repo: str):
 
 
 _GGUF_LAYER_MAP = {
-    # gguf name suffix -> (tree key, transpose)
+    # gguf name suffix -> (tree key, transpose). "transpose" swaps the
+    # LAST TWO axes: gguf stores each (expert's) matrix [out, in], our
+    # trees contract x @ W with [in, out].
     "attn_norm.weight": ("attn_norm", False),
     "attn_q.weight": ("wq", True),
     "attn_k.weight": ("wk", True),
@@ -493,6 +500,20 @@ _GGUF_LAYER_MAP = {
     "ffn_gate.weight": ("w_gate", True),
     "ffn_up.weight": ("w_up", True),
     "ffn_down.weight": ("w_down", True),
+}
+
+# MoE ggufs (llama.cpp naming): stacked expert tensors + the router
+_GGUF_MOE_LAYER_MAP = {
+    "attn_norm.weight": ("attn_norm", False),
+    "attn_q.weight": ("wq", True),
+    "attn_k.weight": ("wk", True),
+    "attn_v.weight": ("wv", True),
+    "attn_output.weight": ("wo", True),
+    "ffn_norm.weight": ("mlp_norm", False),
+    "ffn_gate_inp.weight": ("router", True),
+    "ffn_gate_exps.weight": ("w_gate", True),
+    "ffn_up_exps.weight": ("w_up", True),
+    "ffn_down_exps.weight": ("w_down", True),
 }
 
 
@@ -514,6 +535,7 @@ def config_from_gguf(path_or_content):
     if emb is None:
         raise ValueError(f"{g.path}: no token_embd.weight tensor")
     vocab, hidden = emb.shape
+    is_moe = "blk.0.ffn_gate_inp.weight" in g.tensors
     # critical geometry must COME FROM the file: silently defaulting
     # layers/heads would serve a truncated model as garbage tokens
     if not g.num_layers or not g.num_heads:
@@ -525,11 +547,36 @@ def config_from_gguf(path_or_content):
     heads = int(g.num_heads)
     meta = g.metadata
     arch = g.architecture or "llama"
-    gate = g.tensors.get("blk.0.ffn_gate.weight")
-    return LlamaConfig(
+    gate = g.tensors.get(
+        "blk.0.ffn_gate_exps.weight" if is_moe else "blk.0.ffn_gate.weight"
+    )
+    inter = (
+        int(gate.shape[-2]) if is_moe and gate is not None
+        else int(gate.shape[0]) if gate is not None
+        else 4 * hidden
+    )
+    cls, extra = LlamaConfig, {}
+    if is_moe:
+        from .moe import MoeConfig
+
+        n_exp = meta.get(f"{arch}.expert_count")
+        n_used = meta.get(f"{arch}.expert_used_count")
+        if not n_exp or not n_used:
+            # silently defaulting top-k would route the wrong number of
+            # experts and degrade output with no error anywhere
+            raise ValueError(
+                f"{g.path}: MoE gguf missing {arch}.expert_count / "
+                f".expert_used_count metadata"
+            )
+        cls = MoeConfig
+        extra = dict(
+            num_experts=int(n_exp), num_experts_per_tok=int(n_used)
+        )
+    return cls(
+        **extra,
         vocab_size=int(vocab),
         hidden_size=int(hidden),
-        intermediate_size=int(gate.shape[0]) if gate is not None else 4 * hidden,
+        intermediate_size=inter,
         num_layers=int(g.num_layers),
         num_heads=heads,
         num_kv_heads=int(g.num_kv_heads or heads),
@@ -559,8 +606,12 @@ def load_llama_params_gguf(
     from ..llm.gguf import load_tensor, read_gguf
     from .quant import quantize_array
 
+    from .moe import MoeConfig
+
     g = read_gguf(path, with_tensors=True)
     c = config or config_from_gguf(g)
+    is_moe = isinstance(c, MoeConfig)
+    layer_map = _GGUF_MOE_LAYER_MAP if is_moe else _GGUF_LAYER_MAP
     sh = shardings or {}
 
     def place(arr, sharding, *, quant, contract_axis=-2):
@@ -577,27 +628,36 @@ def load_llama_params_gguf(
     target = _np_dtype(c.dtype)
     layer_sh = sh.get("layers", {}) if sh else {}
     layers: Dict[str, Any] = {}
-    for suffix, (key, transpose) in _GGUF_LAYER_MAP.items():
+    for suffix, (key, transpose) in layer_map.items():
         info = g.tensors[f"blk.0.{suffix}"]
-        lshape = tuple(reversed(info.shape)) if transpose else info.shape
-        do_quant = quantize == "int8" and key not in ("attn_norm", "mlp_norm")
+        lshape = (
+            (*info.shape[:-2], info.shape[-1], info.shape[-2])
+            if transpose else info.shape
+        )
+        # router stays f32 (numerically sensitive), norms keep dtype
+        do_quant = quantize == "int8" and key not in (
+            "attn_norm", "mlp_norm", "router"
+        )
         if do_quant:
             q_buf = np.empty((c.num_layers, *lshape), np.int8)
             s_buf = np.empty((c.num_layers, *lshape[:-2], 1, lshape[-1]),
                              np.float32)
             for li in range(c.num_layers):
                 arr = load_tensor(g, f"blk.{li}.{suffix}")
-                ql = quantize_array(arr.T if transpose else arr)
+                ql = quantize_array(
+                    np.swapaxes(arr, -1, -2) if transpose else arr
+                )
                 q_buf[li], s_buf[li] = ql["q"], ql["s"]
             layers[key] = _place_quant(
                 {"q": q_buf, "s": s_buf}, layer_sh.get(key)
             )
         else:
-            buf = np.empty((c.num_layers, *lshape), target)
+            leaf_dtype = np.float32 if key == "router" else target
+            buf = np.empty((c.num_layers, *lshape), leaf_dtype)
             for li in range(c.num_layers):
                 arr = load_tensor(g, f"blk.{li}.{suffix}")
-                buf[li] = arr.T if transpose else arr  # casts on assign
-            layers[key] = _place(buf, c.dtype, layer_sh.get(key))
+                buf[li] = np.swapaxes(arr, -1, -2) if transpose else arr
+            layers[key] = _place(buf, leaf_dtype, layer_sh.get(key))
 
     params: Dict[str, Any] = {
         "layers": layers,

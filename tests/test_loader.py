@@ -321,3 +321,80 @@ class TestGgufLoader:
         from dynamo_tpu.models.quant import is_quant
 
         assert is_quant(q["layers"]["wq"]) and is_quant(q["embed"])
+
+
+class TestGgufMoeLoader:
+    """MoE .gguf serving: llama.cpp ffn_*_exps expert stacks + the
+    ffn_gate_inp router map onto the models/moe.py tree."""
+
+    @pytest.mark.parametrize("quantize", [None, "int8"])
+    def test_moe_gguf_round_trip(self, tmp_path, quantize):
+        from dynamo_tpu.llm.gguf import write_gguf
+        from dynamo_tpu.models import moe
+        from dynamo_tpu.models.loader import config_from_gguf, load_moe_params
+
+        cfg = moe.MoeConfig.tiny_moe(dtype=jnp.float32, tie_embeddings=False)
+        params = moe.init_params(cfg, jax.random.PRNGKey(5))
+        f32 = lambda x: np.asarray(x, np.float32)  # noqa: E731
+        swap = lambda x: np.ascontiguousarray(np.swapaxes(f32(x), -1, -2))  # noqa: E731
+        tensors = {"token_embd.weight": f32(params["embed"])}
+        L = params["layers"]
+        for li in range(cfg.num_layers):
+            pre = f"blk.{li}"
+            tensors[f"{pre}.attn_norm.weight"] = f32(L["attn_norm"][li])
+            tensors[f"{pre}.attn_q.weight"] = swap(L["wq"][li])
+            tensors[f"{pre}.attn_k.weight"] = swap(L["wk"][li])
+            tensors[f"{pre}.attn_v.weight"] = swap(L["wv"][li])
+            tensors[f"{pre}.attn_output.weight"] = swap(L["wo"][li])
+            tensors[f"{pre}.ffn_norm.weight"] = f32(L["mlp_norm"][li])
+            tensors[f"{pre}.ffn_gate_inp.weight"] = swap(L["router"][li])
+            tensors[f"{pre}.ffn_gate_exps.weight"] = swap(L["w_gate"][li])
+            tensors[f"{pre}.ffn_up_exps.weight"] = swap(L["w_up"][li])
+            tensors[f"{pre}.ffn_down_exps.weight"] = swap(L["w_down"][li])
+        tensors["output_norm.weight"] = f32(params["final_norm"])
+        tensors["output.weight"] = swap(params["lm_head"])
+        meta = {
+            "general.architecture": "llama",
+            "llama.block_count": cfg.num_layers,
+            "llama.attention.head_count": cfg.num_heads,
+            "llama.attention.head_count_kv": cfg.num_kv_heads,
+            "llama.attention.key_length": cfg.head_dim,
+            "llama.embedding_length": cfg.hidden_size,
+            "llama.context_length": 256,
+            "llama.rope.freq_base": cfg.rope_theta,
+            "llama.expert_count": cfg.num_experts,
+            "llama.expert_used_count": cfg.num_experts_per_tok,
+        }
+        path = tmp_path / "moe.gguf"
+        write_gguf(path, meta, tensors=tensors)
+
+        derived = config_from_gguf(str(path))
+        assert isinstance(derived, moe.MoeConfig)
+        assert derived.num_experts == cfg.num_experts
+        assert derived.num_experts_per_tok == cfg.num_experts_per_tok
+        assert derived.intermediate_size == cfg.intermediate_size
+
+        loaded = load_moe_params(str(path), cfg, quantize=quantize)
+        if quantize == "int8":
+            from dynamo_tpu.models.quant import dequantize_leaf, is_quant
+
+            L2 = loaded["layers"]
+            assert is_quant(L2["w_gate"]) and is_quant(L2["wq"])
+            assert not is_quant(L2["router"])  # f32, never quantized
+            assert L2["w_gate"]["s"].shape == (
+                cfg.num_layers, cfg.num_experts, 1, cfg.intermediate_size
+            )
+            # dequantized expert stack within per-channel int8 error
+            ref = np.asarray(params["layers"]["w_gate"], np.float32)
+            deq = np.asarray(dequantize_leaf(L2["w_gate"], jnp.float32))
+            assert np.abs(ref - deq).max() <= np.abs(ref).max() / 127.0 + 1e-6
+            return
+        for (ko, orig), (kn, new) in zip(
+            sorted(jax.tree_util.tree_leaves_with_path(params), key=str),
+            sorted(jax.tree_util.tree_leaves_with_path(loaded), key=str),
+        ):
+            assert str(ko) == str(kn)
+            np.testing.assert_allclose(
+                np.asarray(orig, np.float32), np.asarray(new, np.float32),
+                atol=0, err_msg=str(ko),
+            )
