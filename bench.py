@@ -329,7 +329,7 @@ def main():
     if args.quantize == "int8":
         from dynamo_tpu.models.quant import quantize_tree
 
-        params = quantize_tree(params)
+        params = quantize_tree(params, consume=True)
     kv_k, kv_v = alloc_kv_arrays(
         cfg.num_layers, num_pages, PAGE, cfg.num_kv_heads, cfg.head_dim, cfg.dtype
     )

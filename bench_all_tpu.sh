@@ -25,7 +25,9 @@ run 5400 sweep python bench_sweep.py --quick --out sweep_tpu.json
 # 4. int8 decode ceiling (raw + engine)
 run 1800 int8_raw python bench.py --raw --quantize int8
 run 1800 int8_engine python bench.py --engine --quantize int8
-# 5. e2e disagg + kv router benefit
-run 3600 disagg python bench_e2e.py --mode disagg
-run 5400 kv_benefit python bench_e2e.py --mode kv --prefix-ratio 0.5 --router-compare
+# 5. e2e disagg + kv router benefit. Two workers share the ONE
+# tunnel-attached chip: int8 weights (2 x ~3.4 GB) + fixed 384-page pools
+# fit 16 GiB HBM where bf16 (2 x 6.4 GB) would not.
+run 3600 disagg python bench_e2e.py --mode disagg --quantize int8
+run 5400 kv_benefit python bench_e2e.py --mode kv --prefix-ratio 0.5 --router-compare --quantize int8
 log "ladder complete"

@@ -133,13 +133,19 @@ class Deployment:
 
 
 def launch(mode: str, model: str, *, cpu: bool, num_workers: int = 2,
-           num_pages: int = 2048, max_num_seqs: int = 64,
+           num_pages: Optional[int] = None, max_num_seqs: int = 64,
            disagg_threshold: int = 64, log_dir: str = "/tmp",
            router_override: Optional[str] = None,
            quantize: Optional[str] = None) -> Deployment:
     """Spawn discovery + frontend + workers (real processes, real sockets) —
     the same wiring a production deployment uses, per
     jax_worker/__main__.py + frontend/__main__.py."""
+    if num_pages is None:
+        # one worker: auto-size the pool from free HBM (engine does it).
+        # Several workers share ONE chip here (the bench environment has a
+        # single tunnel-attached device): concurrent auto-sizing would race
+        # for the same free bytes, so give each a fixed conservative slice.
+        num_pages = 0 if mode == "agg" else 384
     dep = Deployment()
     disc_port = free_port()
     http_port = free_port()
@@ -372,6 +378,9 @@ def main(argv: Optional[List[str]] = None):
     ap.add_argument("--max-isl", type=int, default=2048)
     ap.add_argument("--max-osl", type=int, default=512)
     ap.add_argument("--num-workers", type=int, default=2, help="workers in kv mode")
+    ap.add_argument("--num-pages", type=int, default=None,
+                    help="KV pool per worker (default: auto for agg, a fixed "
+                    "conservative slice for multi-worker single-chip modes)")
     ap.add_argument("--prefix-ratio", type=float, default=0.0)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--startup-timeout", type=float, default=None)
@@ -417,6 +426,7 @@ def main(argv: Optional[List[str]] = None):
     def run_arm(router_override=None):
         """One deployment + trace run; returns (summary, prefix_hit_blocks)."""
         dep = launch(args.mode, model, cpu=cpu, num_workers=args.num_workers,
+                     num_pages=args.num_pages,
                      router_override=router_override, quantize=args.quantize)
         hits = 0
         try:

@@ -72,6 +72,8 @@ async def _run_one(engine, prompt: List[int], osl: int, times: List[tuple]):
     n = 0
     async for item in engine.generate(req, Context()):
         data = item.get("data") if isinstance(item, dict) else None
+        if isinstance(item, dict) and item.get("event") == "error":
+            print(f"# engine error: {item.get('comment')}", file=sys.stderr)
         if data and data.get("token_ids"):
             now = time.perf_counter()
             if first is None:
@@ -97,6 +99,20 @@ async def _steady(engine, B: int, isl: int, osl: int, vocab: int, seed: int = 0)
     t_end = time.perf_counter()
     firsts = [f for f, _ in results if f is not None]
     total = sum(n for _, n in results)
+    if os.environ.get("DYN_BENCH_DUMP_TIMES"):
+        # burst-level trace for post-hoc analysis (e.g. "every request's
+        # tokens arrived in one burst" — the TPU local-mode signature)
+        t_base = min(t for t, _ in times) if times else 0.0
+        print("# bursts: " + json.dumps(
+            [[round(t - t_base, 4), k] for t, k in sorted(times)]),
+            file=sys.stderr)
+    if not firsts:
+        # every request failed (engine errors surface as error annotations,
+        # not emissions) — raise something actionable instead of max([])
+        raise RuntimeError(
+            f"no request produced tokens ({len(results)} submitted); "
+            "engine errors are on stderr above"
+        )
     # decode-phase throughput: tokens emitted after every lane has started
     t_all_started = max(firsts)
     decode_toks = sum(k for t, k in times if t > t_all_started)

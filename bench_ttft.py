@@ -145,10 +145,12 @@ def main(argv: Optional[List[str]] = None):
     from dynamo_tpu.engine import EngineConfig, JaxEngine
     from dynamo_tpu.runtime.engine import Context
 
+    # reuse the already-resident weights: a second init_params inside the
+    # engine would double weight residency and OOM a 16 GiB chip on 3b+
     eng = JaxEngine(EngineConfig(
         model=model, page_size=PAGE, num_pages=max(64, num_pages * 4),
         max_num_seqs=4, max_model_len=isl + 64,
-    ))
+    ), model_config=cfg, params=params)
 
     async def one_ttft() -> float:
         req = {

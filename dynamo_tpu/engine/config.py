@@ -11,7 +11,9 @@ class EngineConfig:
     model: str = "tiny"  # models/registry key or path
     max_num_seqs: int = 64  # decode slot batch
     page_size: int = 64  # tokens per KV page == router block size
-    num_pages: int = 2048  # HBM page pool size (auto if 0)
+    num_pages: int = 2048  # HBM page pool size; 0 = auto-size from free
+    # device memory after weights load (engine._auto_num_pages, vLLM's
+    # gpu_memory_utilization role; DYN_HBM_UTILIZATION / DYN_HBM_BYTES)
     max_model_len: int = 8192
     max_prefill_chunk: int = 1024  # chunked-prefill bucket cap
     prefill_buckets: tuple = (128, 256, 512, 1024)

@@ -77,6 +77,65 @@ def _enable_compile_cache():
         logger.warning("could not enable XLA compilation cache", exc_info=True)
 
 
+def _auto_num_pages(params, model_cfg, config: EngineConfig) -> int:
+    """Size the KV page pool from free device memory (the role vLLM's
+    gpu_memory_utilization plays). Called with the weights already resident,
+    so free = bytes_limit * DYN_HBM_UTILIZATION - bytes_in_use. Platforms
+    without memory_stats (CPU, some tunneled runtimes) fall back to
+    DYN_HBM_BYTES, then a platform guess (TPU), then a fixed test pool.
+
+    The "scatter" decode KV-write strategy materializes pool-sized copies
+    inside the fused block (see EngineConfig.decode_pool_mode), so it needs
+    headroom for a second pool; "local" writes in place.
+    """
+    import os
+
+    dev = jax.local_devices()[0]
+    util = float(os.environ.get("DYN_HBM_UTILIZATION", "0.85"))
+    limit = in_use = None
+    try:
+        ms = dev.memory_stats() or {}
+        limit = ms.get("bytes_limit")
+        in_use = ms.get("bytes_in_use")
+    except Exception:  # noqa: BLE001 — stats are best-effort on any backend
+        pass
+    if limit is None and os.environ.get("DYN_HBM_BYTES"):
+        limit = int(float(os.environ["DYN_HBM_BYTES"]))
+    if limit is None and dev.platform == "tpu":
+        limit = 16 * 1024**3  # v5e/v5lite HBM; override via DYN_HBM_BYTES
+    if limit is None:
+        return 2048  # CPU/test fallback: the legacy fixed pool
+    if in_use is None:
+        in_use = sum(x.nbytes for x in jax.tree_util.tree_leaves(params))
+    dtype_bytes = jnp.zeros((), model_cfg.dtype).dtype.itemsize
+    page_bytes = (
+        2  # K and V
+        * model_cfg.num_layers
+        * config.page_size
+        * model_cfg.num_kv_heads
+        * model_cfg.head_dim
+        * dtype_bytes
+    )
+    n_dev = max(len(jax.devices()), 1)
+    free = int(limit * util) * n_dev - int(in_use) * n_dev
+    if config.decode_pool_mode == "scatter":
+        page_bytes *= 2  # transient pool copy inside the fused block
+    n = free // page_bytes
+    floor = config.max_num_seqs + 2  # at least one page per decode slot
+    if n < floor:
+        raise RuntimeError(
+            f"KV pool auto-sizing found room for only {n} pages "
+            f"(free={free / 2**30:.2f} GiB, page={page_bytes / 2**20:.1f} MiB); "
+            "reduce model size, quantize (--quantize int8), or lower "
+            "max_num_seqs"
+        )
+    logger.info(
+        "auto-sized KV pool: %d pages (%.2f GiB of %.2f GiB free, mode=%s)",
+        n, n * page_bytes / 2**30, free / 2**30, config.decode_pool_mode,
+    )
+    return int(n)
+
+
 @dataclass
 class _Slot:
     """One decode slot (host bookkeeping)."""
@@ -151,10 +210,12 @@ class JaxEngine:
             if config.quantize == "int8":
                 from ..models.quant import quantize_tree
 
-                params = quantize_tree(params)
+                params = quantize_tree(params, consume=True)
             elif config.quantize:
                 raise ValueError(f"unknown quantize mode {config.quantize!r}")
         self.params = params
+        if config.num_pages <= 0:
+            config.num_pages = _auto_num_pages(params, c, config)
         # +1: physical page 0 is scratch. If the layout shards the PAGE axis
         # (dp-attention: pages over ep), round the pool up to a shardable
         # size — the allocator still manages only num_pages, spares idle.

@@ -29,7 +29,9 @@ def parse_args(argv=None):
     ap.add_argument("--endpoint", default="generate")
     ap.add_argument("--discovery", default=None)
     ap.add_argument("--page-size", type=int, default=64)
-    ap.add_argument("--num-pages", type=int, default=2048)
+    ap.add_argument("--num-pages", type=int, default=0,
+                    help="KV page pool; 0 = auto-size from free device HBM "
+                    "(DYN_HBM_UTILIZATION; CPU falls back to a fixed 2048)")
     ap.add_argument("--max-num-seqs", type=int, default=64)
     ap.add_argument("--max-model-len", type=int, default=8192)
     ap.add_argument("--decode-pool-mode", choices=["scatter", "local"],
@@ -190,7 +192,7 @@ async def main():
             if args.quantize == "int8":
                 from dynamo_tpu.models.quant import quantize_tree
 
-                params = quantize_tree(params)
+                params = quantize_tree(params, consume=True)
             if shardings is not None:
                 params = shard_params(params, shardings)
 

@@ -122,23 +122,43 @@ def head_leaf(params: Dict[str, Any]):
     return {"q": e["q"].T, "s": e["s"].T}
 
 
-def quantize_tree(params: Dict[str, Any]) -> Dict[str, Any]:
+def quantize_tree(params: Dict[str, Any], consume: bool = False) -> Dict[str, Any]:
     """Quantize an already-built (e.g. random-init) llama/moe param tree
     in place of a checkpoint-time quantized load: backbone projections
     AND MoE expert stacks per-out-channel, embed per-row; norms and the
-    f32 MoE router keep their dtype."""
+    f32 MoE router keep their dtype.
+
+    consume=True MUTATES `params`, dropping each source leaf as soon as
+    its quantized form exists. Without it the full-precision tree stays
+    resident until the call returns — bf16 tree + f32 temporaries + int8
+    outputs peak ~2.4x the model size, which OOMs a 16 GiB chip on 3b+
+    models (use consume=True whenever the source tree is discarded, as
+    the engine/worker/bench paths do)."""
     out = dict(params)
-    out["embed"] = quantize_array(params["embed"], contract_axis=-1)
+    emb = params["embed"]
+    if consume:
+        params["embed"] = None
+    out["embed"] = quantize_array(emb, contract_axis=-1)
+    del emb
     if params.get("lm_head") is not None:
-        out["lm_head"] = quantize_array(params["lm_head"])
-    layers = dict(params["layers"])
+        lm = params["lm_head"]
+        if consume:
+            params["lm_head"] = None
+        out["lm_head"] = quantize_array(lm)
+        del lm
+    src = params["layers"]
+    layers = dict(src)
     for name in _LAYER_LEAVES:
         # dense leaves are [L, in, out]; moe expert stacks are
         # [L, E, in, out] — both quantize per-out-channel over the
         # contraction axis -2 (expert scale [L, E, 1, out] broadcasts in
         # qeinsum). The f32 router is NOT in _LAYER_LEAVES and stays f32.
         if name in layers and not is_quant(layers[name]) and layers[name].ndim in (3, 4):
-            layers[name] = quantize_array(layers[name])
+            w = layers[name]
+            if consume:
+                src[name] = None
+            layers[name] = quantize_array(w)
+            del w
     out["layers"] = layers
     return out
 
