@@ -449,12 +449,22 @@ def main():
         f"prefill: {B * args.isl / t_prefill:.0f} tok/s, first-seq TTFT {t_first*1000:.1f} ms",
         file=sys.stderr,
     )
+    from bench_eff import efficiency_fields
+
+    n_params = sum(
+        int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(params)
+    )
     result = {
         "metric": f"decode_throughput_{model}_bs{B}_isl{args.isl}"
         + ("_int8" if args.quantize else ""),
         "value": round(toks_per_sec, 1),
         "unit": "tok/s",
         "vs_baseline": baseline_ratio(toks_per_sec, model),
+        **(efficiency_fields(
+            model, toks_per_sec, B, args.isl + n_done / 2, args.quantize,
+            n_params=float(n_params),
+            dims=(cfg.num_layers, cfg.num_heads, cfg.num_kv_heads, cfg.head_dim),
+        ) if dev.platform == "tpu" else {}),
     }
     print(json.dumps(result))
 

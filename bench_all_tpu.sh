@@ -12,7 +12,9 @@ run() {  # run <timeout_s> <label> <cmd...>
   local t=$1 label=$2; shift 2
   log "$label: $*"
   timeout "$t" "$@" 2> >(tail -5 >&2) | grep "^{" | tee -a $OUT
-  log "$label done rc=$?"
+  # rc of the BENCHMARK, not the grep|tee tail (round-4 advisor low #4)
+  local rc=${PIPESTATUS[0]}
+  log "$label done rc=$rc"
 }
 
 log "ladder start"

@@ -467,6 +467,13 @@ def main(argv: Optional[List[str]] = None):
         print(json.dumps(benefit))
         return 0 if not (summary["failed"] or rr_summary["failed"]) else 1
     print("# " + json.dumps(summary), file=sys.stderr)
+    from bench_eff import efficiency_fields
+
+    # e2e batch varies with load; qps*latency ~ concurrency is the honest
+    # denominator for a roofline read. Use the request count in flight at
+    # steady state ~ qps * mean_latency (bounded by max_num_seqs).
+    mean_lat_s = summary["latency_ms"]["p50"] / 1000.0
+    eff_batch = max(1, min(int(qps * mean_lat_s), 64))
     result = {
         "metric": f"e2e_output_toks_{args.mode}_{model}_qps{qps:g}",
         "value": summary["output_tok_s"],
@@ -477,6 +484,10 @@ def main(argv: Optional[List[str]] = None):
         "itl_p50_ms": summary["itl_ms"]["p50"],
         "itl_p99_ms": summary["itl_ms"]["p99"],
         "failed": summary["failed"],
+        **(efficiency_fields(
+            model, summary["output_tok_s"], eff_batch,
+            args.isl_mean + args.osl_mean / 2, args.quantize,
+        ) if not cpu else {}),
     }
     print(json.dumps(result))
     if summary["failed"]:

@@ -35,10 +35,12 @@ from bench import baseline_ratio, ensure_backend  # noqa: E402
 
 def _make_engine(model: str, B: int, isl: int, osl: int, K: int, page: int = 64,
                  pool_mode: str = "scatter", unroll: int = 1, quantize=None,
-                 num_pages: Optional[int] = None):
+                 num_pages: Optional[int] = None, spec=None):
     from dynamo_tpu.engine import EngineConfig, JaxEngine
 
     max_len = isl + osl + K + page
+    if spec:
+        max_len += 32  # spec blocks can overshoot by rounds*(1+d) - 1
     pages_per_seq = (max_len + page - 1) // page
     auto_pages = 2 * B * pages_per_seq + 8  # churn headroom: old pages
     # linger in the prefix cache while replacements admit
@@ -52,12 +54,14 @@ def _make_engine(model: str, B: int, isl: int, osl: int, K: int, page: int = 64,
         decode_pool_mode=pool_mode,
         decode_block_unroll=unroll,
         quantize=quantize,
+        spec_mode=spec,
         enable_prefix_caching=True,
     )
     return JaxEngine(cfg)
 
 
-async def _run_one(engine, prompt: List[int], osl: int, times: List[tuple]):
+async def _run_one(engine, prompt: List[int], osl: int, times: List[tuple],
+                   temperature: float = 1.0):
     """One request through the public engine API; appends (t, n_tokens)
     per emission burst."""
     from dynamo_tpu.llm.protocols import PreprocessedRequest
@@ -66,7 +70,7 @@ async def _run_one(engine, prompt: List[int], osl: int, times: List[tuple]):
     req = PreprocessedRequest(
         token_ids=prompt,
         stop_conditions={"max_tokens": osl, "ignore_eos": True},
-        sampling_options={"temperature": 1.0},
+        sampling_options={"temperature": temperature},
     ).to_dict()
     first = None
     n = 0
@@ -83,14 +87,28 @@ async def _run_one(engine, prompt: List[int], osl: int, times: List[tuple]):
     return first, n
 
 
-async def _steady(engine, B: int, isl: int, osl: int, vocab: int, seed: int = 0):
+def _mk_prompt(rng, vocab: int, isl: int, repetitive: bool) -> List[int]:
+    """Random tokens, or (for the spec-decode bench) a tiled base pattern —
+    the repetition-heavy trace the prompt-lookup drafter exploits."""
+    if repetitive:
+        base = rng.randint(5, vocab - 1, size=max(isl // 8, 4)).tolist()
+        return (base * (isl // len(base) + 1))[:isl]
+    return rng.randint(5, vocab - 1, size=isl).tolist()
+
+
+async def _steady(engine, B: int, isl: int, osl: int, vocab: int, seed: int = 0,
+                  repetitive: bool = False):
     import numpy as np
 
     rng = np.random.RandomState(seed)
     times: List[tuple] = []
+    # spec runs greedy: argmax cycles + repeated prompts are the
+    # acceptance-friendly regime; plain runs sample (see drive_one note)
+    temp = 0.0 if repetitive else 1.0
     tasks = [
         asyncio.create_task(
-            _run_one(engine, rng.randint(5, vocab - 1, size=isl).tolist(), osl, times)
+            _run_one(engine, _mk_prompt(rng, vocab, isl, repetitive), osl,
+                     times, temperature=temp)
         )
         for _ in range(B)
     ]
@@ -185,6 +203,9 @@ def main(argv: Optional[List[str]] = None):
     ap.add_argument("--num-pages", type=int, default=None,
                     help="KV pool size override (floored at the batch's "
                     "working-set need) — the KV-write-strategy sweep axis")
+    ap.add_argument("--spec", choices=["ngram"], default=None,
+                    help="speculative decoding; the steady trace becomes "
+                    "repetition-heavy so acceptance is measurable")
     ap.add_argument("--churn-s", type=float, default=None,
                     help="closed-loop churn window (0 disables)")
     args = ap.parse_args(argv)
@@ -218,13 +239,14 @@ def main(argv: Optional[List[str]] = None):
     engine = _make_engine(
         model, B, isl, osl, args.block,
         pool_mode=args.pool_mode, unroll=args.unroll, quantize=args.quantize,
-        num_pages=args.num_pages,
+        num_pages=args.num_pages, spec=args.spec,
     )
+    rep = bool(args.spec)
 
     async def run():
         # warmup: compile all dispatch variants
-        await _steady(engine, min(B, 2), isl, 8, vocab, seed=99)
-        steady = await _steady(engine, B, isl, osl, vocab)
+        await _steady(engine, min(B, 2), isl, 8, vocab, seed=99, repetitive=rep)
+        steady = await _steady(engine, B, isl, osl, vocab, repetitive=rep)
         churn = await _churn(engine, B, isl, osl, vocab, churn_s) if churn_s > 0 else {}
         await engine.close()
         return steady, churn
@@ -232,15 +254,29 @@ def main(argv: Optional[List[str]] = None):
     steady, churn = asyncio.run(run())
     line = {**steady, **churn, "preemptions": engine.num_preemptions}
     print("# " + json.dumps(line), file=sys.stderr)
+    import jax as _jax
+
+    from bench_eff import efficiency_fields
+
+    stats = engine.stats()
     result = {
         "metric": f"engine_decode_{model}_bs{B}_isl{isl}"
-        + ("_int8" if args.quantize else ""),
+        + ("_int8" if args.quantize else "")
+        + (f"_spec_{args.spec}" if args.spec else ""),
+        **({
+            "spec_mean_accepted_len": round(stats.get("spec_mean_accepted_len", 0.0), 2),
+            "spec_num_draft_tokens": stats.get("spec_num_draft_tokens", 0),
+            "spec_num_accepted_tokens": stats.get("spec_num_accepted_tokens", 0),
+        } if args.spec else {}),
         "value": round(steady["decode_tok_s"], 1),
         "unit": "tok/s",
         "vs_baseline": baseline_ratio(steady["decode_tok_s"], model),
         "itl_ms": round(steady["itl_ms"], 2),
         "churn_tok_s": round(churn.get("churn_tok_s", 0.0), 1),
         "num_pages": engine.config.num_pages,
+        **(efficiency_fields(
+            model, steady["decode_tok_s"], B, isl + osl / 2, args.quantize,
+        ) if _jax.local_devices()[0].platform == "tpu" else {}),
     }
     print(json.dumps(result))
     return 0

@@ -1,0 +1,184 @@
+"""Unattended opportunistic TPU measurement (round-4 verdict next #1).
+
+Rounds 3 and 4 lost their entire hardware-measurement windows to axon
+tunnel outages because the bench ladder only ran when invoked. This
+watchdog runs ALL round: it probes the backend on a short interval and,
+whenever the tunnel is up, advances the measurement ladder one phase at a
+time, appending every result line to `bench_tpu_results.jsonl` as valid
+JSONL (notes are {"note": ...} records, never bare comments — round-4
+advisor low #4).
+
+Robustness model (from the round-4 ladder post-mortem):
+  * per-phase rc comes from the benchmark process itself, not a pipeline
+    tail (`subprocess.run`, no shell);
+  * a cooldown between phases lets the tunnel server release the previous
+    client's HBM (the r4 back-to-back RESOURCE_EXHAUSTED signature);
+  * failed phases are retried up to MAX_ATTEMPTS on later probes, state
+    persists in `bench_watchdog_state.json` so a watchdog restart resumes
+    instead of redoing finished work;
+  * once every phase is ok (or exhausted) the watchdog exits, freeing the
+    chip for the driver's end-of-round bench.py run.
+
+Usage:  nohup python bench_watchdog.py > bench_watchdog.log 2>&1 &
+        python bench_watchdog.py --once          # single pass, no loop
+        python bench_watchdog.py --mark-ok e2e_agg   # seed state
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent
+STATE = REPO / "bench_watchdog_state.json"
+OUT = REPO / "bench_tpu_results.jsonl"
+
+PROBE_INTERVAL_S = 240.0  # tunnel-down re-probe cadence
+COOLDOWN_S = 45.0  # post-phase pause: tunnel-side HBM release
+MAX_ATTEMPTS = 3
+
+# ladder: conservative configs first (int8 + fixed pools dodge the 16 GiB
+# single-chip OOMs that killed half the round-4 ladder), the north-star
+# e2e number before anything else.
+PY = sys.executable
+PHASES = [
+    # (name, argv, timeout_s)
+    ("e2e_agg", [PY, "bench_e2e.py", "--mode", "agg", "--quantize", "int8",
+                 "--num-pages", "512"], 2400),
+    ("raw_bf16", [PY, "bench.py", "--raw"], 1800),
+    ("engine_bf16", [PY, "bench_engine.py"], 1800),
+    ("raw_int8", [PY, "bench.py", "--raw", "--quantize", "int8"], 1800),
+    ("engine_int8", [PY, "bench_engine.py", "--quantize", "int8"], 1800),
+    ("ttft", [PY, "bench_ttft.py"], 1200),
+    ("sweep", [PY, "bench_sweep.py", "--quick", "--out", "sweep_tpu.json"],
+     5400),
+    ("e2e_agg_bf16", [PY, "bench_e2e.py", "--mode", "agg", "--num-pages",
+                      "384"], 2400),
+    ("disagg", [PY, "bench_e2e.py", "--mode", "disagg", "--quantize", "int8"],
+     3600),
+    ("kv_benefit", [PY, "bench_e2e.py", "--mode", "kv", "--prefix-ratio",
+                    "0.5", "--router-compare", "--quantize", "int8"], 5400),
+    ("spec_decode", [PY, "bench_engine.py", "--quantize", "int8",
+                     "--spec", "ngram"], 1800),
+]
+
+
+def log(msg: str):
+    print(f"[{time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+
+def append_jsonl(record: dict):
+    with OUT.open("a") as f:
+        f.write(json.dumps(record) + "\n")
+
+
+def load_state() -> dict:
+    if STATE.exists():
+        try:
+            return json.loads(STATE.read_text())
+        except ValueError:
+            pass
+    return {}
+
+
+def save_state(state: dict):
+    STATE.write_text(json.dumps(state, indent=1))
+
+
+def probe(deadline: float = 90.0) -> bool:
+    code = "import jax; d = jax.devices(); print(d[0].platform)"
+    try:
+        r = subprocess.run([PY, "-c", code], capture_output=True, text=True,
+                           timeout=deadline)
+    except subprocess.TimeoutExpired:
+        return False
+    return r.returncode == 0 and "tpu" in (r.stdout or "")
+
+
+def run_phase(name: str, argv: list, timeout: float) -> int:
+    log(f"phase {name}: {' '.join(argv[1:])}")
+    append_jsonl({"note": f"phase {name} start",
+                  "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())})
+    env = dict(os.environ, DYN_BENCH_SKIP_PROBE="1")
+    t0 = time.time()
+    try:
+        r = subprocess.run(argv, capture_output=True, text=True,
+                           timeout=timeout, env=env, cwd=str(REPO))
+        rc = r.returncode
+        stdout, stderr = r.stdout or "", r.stderr or ""
+    except subprocess.TimeoutExpired as e:
+        rc, stdout = 124, (e.stdout or b"").decode("utf-8", "replace") \
+            if isinstance(e.stdout, bytes) else (e.stdout or "")
+        stderr = ""
+    n_lines = 0
+    for line in stdout.splitlines():
+        if line.startswith("{"):
+            try:
+                append_jsonl({"phase": name, **json.loads(line)})
+                n_lines += 1
+            except ValueError:
+                pass
+    tail = stderr.strip().splitlines()[-3:]
+    append_jsonl({"note": f"phase {name} done", "rc": rc,
+                  "wall_s": round(time.time() - t0, 1), "json_lines": n_lines,
+                  **({"stderr_tail": " | ".join(tail)} if rc != 0 else {})})
+    log(f"phase {name} rc={rc} ({n_lines} result lines, "
+        f"{time.time() - t0:.0f}s)")
+    return rc
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--once", action="store_true",
+                    help="one probe+phase pass, then exit")
+    ap.add_argument("--mark-ok", action="append", default=[],
+                    help="seed a phase as already measured")
+    args = ap.parse_args()
+
+    state = load_state()
+    for name in args.mark_ok:
+        state[name] = {"status": "ok", "attempts": 0, "seeded": True}
+        save_state(state)
+        log(f"seeded {name}=ok")
+    if args.mark_ok and not args.once:
+        return 0
+
+    log(f"watchdog up; ladder = {[p[0] for p in PHASES]}")
+    while True:
+        pending = [
+            (n, a, t) for n, a, t in PHASES
+            if state.get(n, {}).get("status") != "ok"
+            and state.get(n, {}).get("attempts", 0) < MAX_ATTEMPTS
+        ]
+        if not pending:
+            log("ladder complete (all phases ok or exhausted); exiting")
+            append_jsonl({"note": "watchdog ladder complete",
+                          "state": {k: v.get("status") for k, v in
+                                    state.items()}})
+            return 0
+        if not probe():
+            log(f"tunnel down; {len(pending)} phases pending; "
+                f"sleeping {PROBE_INTERVAL_S:.0f}s")
+            if args.once:
+                return 1
+            time.sleep(PROBE_INTERVAL_S)
+            continue
+        name, argv, timeout = pending[0]
+        rc = run_phase(name, argv, timeout)
+        st = state.setdefault(name, {"attempts": 0})
+        st["attempts"] = st.get("attempts", 0) + 1
+        st["status"] = "ok" if rc == 0 else "failed"
+        st["rc"] = rc
+        save_state(state)
+        if args.once:
+            return 0
+        time.sleep(COOLDOWN_S)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

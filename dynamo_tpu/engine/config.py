@@ -42,6 +42,17 @@ class EngineConfig:
     # and makes llama3-8b fit a single v5e chip beside a KV pool
     # (models/quant.py; reference analogue: FP8 recipes)
     quantize: Optional[str] = None
+    # speculative decoding (engine/spec.py; reference SpecDecodeStats
+    # contract _core.pyi:269-301). "ngram" = self-drafting prompt-lookup:
+    # draft spec_draft_len tokens from the most recent spec_ngram-gram
+    # match in a device-resident history ring, verify them all in ONE
+    # batched-prefill pass (one weight stream for up to 1+d tokens/lane).
+    # Each fused block runs spec_rounds draft-verify rounds.
+    spec_mode: Optional[str] = None
+    spec_draft_len: int = 4
+    spec_ngram: int = 2
+    spec_hist: int = 512  # history ring size (tokens) per lane
+    spec_rounds: int = 4
     # sampling defaults
     default_temperature: float = 0.0
     seed: int = 0
@@ -65,3 +76,11 @@ class EngineConfig:
     @property
     def max_pages_per_seq(self) -> int:
         return (self.max_model_len + self.page_size - 1) // self.page_size
+
+    @property
+    def block_advance(self) -> int:
+        """Max tokens one fused block advances a lane: K plain decode
+        steps, or spec_rounds draft-verify rounds of up to 1+d tokens."""
+        if self.spec_mode:
+            return self.spec_rounds * (self.spec_draft_len + 1)
+        return self.decode_block_steps

@@ -77,12 +77,40 @@ def _enable_compile_cache():
         logger.warning("could not enable XLA compilation cache", exc_info=True)
 
 
-def _auto_num_pages(params, model_cfg, config: EngineConfig) -> int:
+def _kv_shard_div(kv_sharding) -> int:
+    """How many devices each KV page is SPLIT across (1 when replicated).
+
+    Derived from the sharding spec, not len(jax.devices()): a replicated
+    pool puts the full page on every device, so free-memory math must not
+    scale with device count (round-4 advisor medium #1)."""
+    if kv_sharding is None:
+        return 1
+    div = 1
+    for axes in kv_sharding.spec:
+        if not axes:
+            continue
+        names = axes if isinstance(axes, tuple) else (axes,)
+        for a in names:
+            div *= int(kv_sharding.mesh.shape[a])
+    return max(div, 1)
+
+
+def _auto_num_pages(params, model_cfg, config: EngineConfig,
+                    kv_sharding=None, multihost: bool = False) -> int:
     """Size the KV page pool from free device memory (the role vLLM's
     gpu_memory_utilization plays). Called with the weights already resident,
     so free = bytes_limit * DYN_HBM_UTILIZATION - bytes_in_use. Platforms
     without memory_stats (CPU, some tunneled runtimes) fall back to
     DYN_HBM_BYTES, then a platform guess (TPU), then a fixed test pool.
+
+    All math is PER-DEVICE: free bytes on one device divided by this
+    device's share of a page (the page axis may be sharded — see
+    _kv_shard_div). `DYN_WORKERS_PER_DEVICE` > 1 splits the free pool
+    between co-located workers sharing one chip (single-chip disagg);
+    `DYN_HBM_RESERVE_MB` (default 512) holds back compile/activation
+    workspace the post-weights snapshot can't see. In multihost mode the
+    leader's result is broadcast so every process allocates identical KV
+    shapes (dispatch replay requires it).
 
     The "scatter" decode KV-write strategy materializes pool-sized copies
     inside the fused block (see EngineConfig.decode_pool_mode), so it needs
@@ -92,6 +120,8 @@ def _auto_num_pages(params, model_cfg, config: EngineConfig) -> int:
 
     dev = jax.local_devices()[0]
     util = float(os.environ.get("DYN_HBM_UTILIZATION", "0.85"))
+    reserve = int(float(os.environ.get("DYN_HBM_RESERVE_MB", "512")) * 2**20)
+    workers = max(int(os.environ.get("DYN_WORKERS_PER_DEVICE", "1")), 1)
     limit = in_use = None
     try:
         ms = dev.memory_stats() or {}
@@ -104,35 +134,57 @@ def _auto_num_pages(params, model_cfg, config: EngineConfig) -> int:
     if limit is None and dev.platform == "tpu":
         limit = 16 * 1024**3  # v5e/v5lite HBM; override via DYN_HBM_BYTES
     if limit is None:
-        return 2048  # CPU/test fallback: the legacy fixed pool
-    if in_use is None:
-        in_use = sum(x.nbytes for x in jax.tree_util.tree_leaves(params))
-    dtype_bytes = jnp.zeros((), model_cfg.dtype).dtype.itemsize
-    page_bytes = (
-        2  # K and V
-        * model_cfg.num_layers
-        * config.page_size
-        * model_cfg.num_kv_heads
-        * model_cfg.head_dim
-        * dtype_bytes
-    )
-    n_dev = max(len(jax.devices()), 1)
-    free = int(limit * util) * n_dev - int(in_use) * n_dev
-    if config.decode_pool_mode == "scatter":
-        page_bytes *= 2  # transient pool copy inside the fused block
-    n = free // page_bytes
+        n = 2048  # CPU/test fallback: the legacy fixed pool
+    else:
+        if in_use is None:
+            # per-device resident weight bytes: sum THIS device's shards,
+            # not global nbytes (a TP-sharded leaf holds 1/tp of its bytes
+            # here; a replicated leaf holds all of them)
+            in_use = 0
+            for x in jax.tree_util.tree_leaves(params):
+                try:
+                    in_use += sum(
+                        s.data.nbytes for s in x.addressable_shards
+                        if s.device == dev
+                    )
+                except Exception:  # noqa: BLE001 — non-Array leaves
+                    in_use += getattr(x, "nbytes", 0)
+        dtype_bytes = jnp.zeros((), model_cfg.dtype).dtype.itemsize
+        page_bytes = (
+            2  # K and V
+            * model_cfg.num_layers
+            * config.page_size
+            * model_cfg.num_kv_heads
+            * model_cfg.head_dim
+            * dtype_bytes
+        )
+        page_bytes_dev = page_bytes // _kv_shard_div(kv_sharding)
+        alloc_bytes_dev = page_bytes_dev
+        if config.decode_pool_mode == "scatter":
+            alloc_bytes_dev *= 2  # transient pool copy inside the fused block
+        free = (int(limit * util) - int(in_use) - reserve) // workers
+        n = free // alloc_bytes_dev
+        logger.info(
+            "auto-sized KV pool: %d pages (%.2f GiB resident of %.2f GiB free"
+            " per device, mode=%s, workers/dev=%d)",
+            n, n * page_bytes_dev / 2**30, free / 2**30,
+            config.decode_pool_mode, workers,
+        )
+    if multihost:
+        # every process must allocate identical KV shapes for dispatch
+        # replay; the leader's sizing wins (followers may see different
+        # free-memory snapshots — round-4 advisor medium #1). The floor
+        # check comes AFTER the rendezvous: a process raising before it
+        # would leave the others hung inside the collective.
+        from jax.experimental import multihost_utils
+
+        n = int(multihost_utils.broadcast_one_to_all(np.int32(n)))
     floor = config.max_num_seqs + 2  # at least one page per decode slot
     if n < floor:
         raise RuntimeError(
-            f"KV pool auto-sizing found room for only {n} pages "
-            f"(free={free / 2**30:.2f} GiB, page={page_bytes / 2**20:.1f} MiB); "
-            "reduce model size, quantize (--quantize int8), or lower "
-            "max_num_seqs"
+            f"KV pool auto-sizing found room for only {n} pages; reduce "
+            "model size, quantize (--quantize int8), or lower max_num_seqs"
         )
-    logger.info(
-        "auto-sized KV pool: %d pages (%.2f GiB of %.2f GiB free, mode=%s)",
-        n, n * page_bytes / 2**30, free / 2**30, config.decode_pool_mode,
-    )
     return int(n)
 
 
@@ -215,7 +267,9 @@ class JaxEngine:
                 raise ValueError(f"unknown quantize mode {config.quantize!r}")
         self.params = params
         if config.num_pages <= 0:
-            config.num_pages = _auto_num_pages(params, c, config)
+            config.num_pages = _auto_num_pages(
+                params, c, config, kv_sharding=kv_sharding, multihost=multihost
+            )
         # +1: physical page 0 is scratch. If the layout shards the PAGE axis
         # (dp-attention: pages over ep), round the pool up to a shardable
         # size — the allocator still manages only num_pages, spares idle.
@@ -294,6 +348,20 @@ class JaxEngine:
         self.kv_pulls_completed = 0
         self.kv_pages_pulled = 0
         self._admit_counter = 0
+        # speculative decoding (engine/spec.py): host mirror of the device
+        # history ring + SpecDecodeStats counters (_core.pyi:269-301 role)
+        self.hist = (
+            np.zeros((config.max_num_seqs, config.spec_hist), np.int32)
+            if config.spec_mode else None
+        )
+        self._hist_dev = None
+        self.spec_num_drafts = 0
+        self.spec_num_draft_tokens = 0
+        self.spec_num_accepted_tokens = 0
+        # per-dispatch-type device occupancy: {tag: (count, seconds)} —
+        # dispatches run serialized on the single device thread, so these
+        # sum to device-stream busy time (the serving-gap diagnostic)
+        self._dev_time: Dict[str, tuple] = {}
         # decode pipeline: device-resident carry (tokens/positions/seq_lens)
         # + up to two in-flight K-step blocks
         self._carry = None  # (tokens_dev, positions_dev, seq_lens_dev)
@@ -439,6 +507,83 @@ class JaxEngine:
 
         self._decode_block = decode_block
 
+        self._spec_block_fn = None
+        if cfg.spec_mode == "ngram":
+            from .spec import hist_write, ngram_draft, verify_accept
+
+            S = cfg.spec_rounds
+            d_len = cfg.spec_draft_len
+            ng = cfg.spec_ngram
+            Hc = cfg.spec_hist
+            Tc = d_len + 1
+
+            spec_out_sh = None
+            if self._mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                repl = NamedSharding(self._mesh, PartitionSpec())
+                kvs = self._kv_sharding or repl
+                spec_out_sh = (
+                    repl, repl, repl, repl, repl, kvs, kvs, repl, repl,
+                )
+
+            @partial(jax.jit, donate_argnums=(1, 2, 8, 9),
+                     out_shardings=spec_out_sh)
+            def spec_block(params, kv_k, kv_v, tokens, positions, seq_lens,
+                           page_tables, samp, rng, hist):
+                """S draft-verify rounds (engine/spec.py). Each round: write
+                the current token into the history ring, n-gram-draft d
+                continuations, verify all 1+d in ONE batched-prefill pass
+                (one weight stream instead of 1+d), accept the longest
+                matching prefix. Emits 1..1+d tokens per lane per round —
+                never fewer than plain decode."""
+                B = tokens.shape[0]
+
+                def round_fn(carry, key):
+                    tokens, positions, seq_lens, kv_k, kv_v, hist = carry
+                    hist = hist_write(hist, positions, tokens)
+                    draft = ngram_draft(hist, tokens, positions, ng, d_len)
+                    chunk = jnp.concatenate([tokens[:, None], draft], axis=1)
+                    cpos = positions[:, None] + jnp.arange(Tc)[None, :]
+                    logits, kv_k, kv_v = self._model.prefill_forward_batched(
+                        params, c, chunk, cpos, kv_k, kv_v, page_tables,
+                        positions,  # context_lens: tokens already in KV
+                        jnp.full((B,), d_len, jnp.int32),
+                        all_logits=True,
+                    )
+                    out_toks, n_emit, key = verify_accept(
+                        logits.astype(jnp.float32), draft, samp, key
+                    )
+                    new_tokens = out_toks[jnp.arange(B), n_emit - 1]
+                    # ring-append the emitted tokens (pos+1 .. pos+n_emit);
+                    # invalid tail indices point out of bounds -> dropped
+                    wpos = positions[:, None] + 1 + jnp.arange(Tc)[None, :]
+                    slot_i = jnp.where(
+                        jnp.arange(Tc)[None, :] < n_emit[:, None],
+                        wpos % Hc, Hc,
+                    )
+                    hist = hist.at[
+                        jnp.arange(B)[:, None], slot_i
+                    ].set(out_toks, mode="drop")
+                    positions = positions + n_emit
+                    seq_lens = seq_lens + n_emit
+                    return (
+                        (new_tokens, positions, seq_lens, kv_k, kv_v, hist),
+                        (out_toks, n_emit),
+                    )
+
+                rng, sub = jax.random.split(rng)
+                keys = jax.random.split(sub, S)
+                (tokens, positions, seq_lens, kv_k, kv_v, hist), (toks_s, n_emit_s) = jax.lax.scan(
+                    round_fn, (tokens, positions, seq_lens, kv_k, kv_v, hist), keys
+                )
+                return (
+                    toks_s, n_emit_s, tokens, positions, seq_lens,
+                    kv_k, kv_v, rng, hist,
+                )
+
+            self._spec_block_fn = spec_block
+
         @partial(jax.jit, donate_argnums=(1, 2, 9), out_shardings=prefill_out_sh)
         def prefill_batch(params, kv_k, kv_v, tokens, positions, page_tables, ctx_lens, last_idx, samp, rng):
             """Batched chunked prefill + on-device first-token sampling."""
@@ -564,6 +709,58 @@ class JaxEngine:
                     break
                 await asyncio.sleep(0.01)
             self.kvbm.manager.flush()
+
+    async def warmup(self) -> int:
+        """Compile every dispatch variant BEFORE serving traffic.
+
+        First-request compiles are 20-40s per program through the axon
+        remote-compile tunnel; paying them on-path once the worker is
+        registered starves discovery-lease renewal and breaks in-flight
+        streams (the round-4 e2e ladder failure: worker dropped from the
+        control plane mid-compile, 96/96 requests "no instances
+        available"). Driving the real `generate` path pre-registration
+        compiles the bounded variant space — per-bucket {1, cap}-lane
+        batched prefill, decode reset/patch/block — into the persistent
+        XLA cache, so restarts are cheap. Returns the number of warmup
+        requests served. vLLM analogue: GPU-worker profile/warmup runs
+        before the engine reports ready."""
+        import numpy as _np
+
+        rng = _np.random.RandomState(0xD74A)
+        vocab = self.model_config.vocab_size
+        K = self.config.decode_block_steps
+
+        async def _drain(isl: int):
+            req = PreprocessedRequest(
+                token_ids=rng.randint(5, max(vocab - 1, 6), size=isl).tolist(),
+                stop_conditions={"max_tokens": K + 2, "ignore_eos": True},
+                sampling_options={"temperature": 1.0},
+            ).to_dict()
+            async for _ in self.generate(req, Context()):
+                pass
+
+        n = 0
+        buckets = [
+            b for b in self.config.prefill_buckets
+            if b <= self.config.max_model_len
+        ] or [self.config.prefill_buckets[0]]
+        for b in buckets:
+            isl = max(b - 8, 4)
+            # lone arrival: the 1-lane prefill variant (+ decode block/reset
+            # on the first bucket)
+            await _drain(isl)
+            n += 1
+            cap = max(1, min(
+                self.config.prefill_batch_tokens // b,
+                self.config.max_prefill_batch,
+            ))
+            if cap > 1:
+                # concurrent arrivals batch into the padded cap-lane
+                # variant; admissions mid-decode also exercise _dev_patch
+                burst = min(cap, 3)
+                await asyncio.gather(*[_drain(isl) for _ in range(burst)])
+                n += burst
+        return n
 
     def _check_multimodal(self, req: PreprocessedRequest) -> Optional[str]:
         """None when the request is serveable; else the rejection reason.
@@ -756,6 +953,17 @@ class JaxEngine:
             out["kv_bytes_served"] = self.data_plane.bytes_served
         out["kv_pulls_completed"] = self.kv_pulls_completed
         out["kv_pages_pulled"] = self.kv_pages_pulled
+        for tag, (cnt, tot) in self._dev_time.items():
+            out[f"dispatch_{tag}_count"] = cnt
+            out[f"dispatch_{tag}_s"] = round(tot, 3)
+        if self.config.spec_mode:
+            out["spec_num_drafts"] = self.spec_num_drafts
+            out["spec_num_draft_tokens"] = self.spec_num_draft_tokens
+            out["spec_num_accepted_tokens"] = self.spec_num_accepted_tokens
+            out["spec_mean_accepted_len"] = (
+                1.0 + self.spec_num_accepted_tokens / self.spec_num_drafts
+                if self.spec_num_drafts else 0.0
+            )
         return out
 
     # ------------------------------------------------------------------ #
@@ -885,7 +1093,21 @@ class JaxEngine:
 
     # -- device helpers -------------------------------------------------- #
 
-    async def _run_on_device(self, fn, *args):
+    def _timed(self, fn, tag: str):
+        """Wrap fn so its wall time accrues to self._dev_time[tag]."""
+        def timed(*a):
+            t0 = time.perf_counter()
+            try:
+                return fn(*a)
+            finally:
+                dt = time.perf_counter() - t0
+                cnt, tot = self._dev_time.get(tag, (0, 0.0))
+                self._dev_time[tag] = (cnt + 1, tot + dt)
+        return timed
+
+    async def _run_on_device(self, fn, *args, tag: str = None):
+        if tag is not None:
+            fn = self._timed(fn, tag)
         return await asyncio.get_running_loop().run_in_executor(
             self._device_exec, fn, *args
         )
@@ -894,7 +1116,7 @@ class JaxEngine:
         """One host read (single RTT) for an arbitrary pytree of device
         arrays, off the dispatch thread."""
         return await asyncio.get_running_loop().run_in_executor(
-            self._fetch_exec, jax.device_get, tree
+            self._fetch_exec, self._timed(jax.device_get, "fetch"), tree
         )
 
     def _bcast(self, tag: str, arrays: dict):
@@ -955,7 +1177,8 @@ class JaxEngine:
         )
         return first
 
-    def _dev_reset(self, tokens, positions, seq_lens, page_tables, temps, top_ks, top_ps):
+    def _dev_reset(self, tokens, positions, seq_lens, page_tables, temps,
+                   top_ks, top_ps, hist=None):
         self._samp_dev = SamplingParams(
             temperature=jnp.asarray(temps),
             top_k=jnp.asarray(top_ks),
@@ -967,9 +1190,11 @@ class JaxEngine:
             jnp.asarray(seq_lens),
         )
         self._tables_dev = jnp.asarray(page_tables)
+        if hist is not None:
+            self._hist_dev = jnp.asarray(hist)
 
     def _dev_patch(self, lane_mask, table_mask, tokens, positions, seq_lens,
-                   tables, temps, top_ks, top_ps):
+                   tables, temps, top_ks, top_ps, hist=None):
         samp = self._samp_dev
         tok_d, pos_d, sl_d, tab_d, t_d, k_d, p_d = self._patch_lanes(
             self._carry[0], self._carry[1], self._carry[2], self._tables_dev,
@@ -982,9 +1207,27 @@ class JaxEngine:
         self._carry = (tok_d, pos_d, sl_d)
         self._tables_dev = tab_d
         self._samp_dev = SamplingParams(temperature=t_d, top_k=k_d, top_p=p_d)
+        if hist is not None and self._hist_dev is not None:
+            # dirty lanes take the host ring row; others keep the (newer)
+            # device rows appended by in-flight spec blocks
+            self._hist_dev = jnp.where(
+                jnp.asarray(lane_mask)[:, None], jnp.asarray(hist),
+                self._hist_dev,
+            )
 
     def _dev_block(self):
         carry = self._carry
+        if self._spec_block_fn is not None:
+            (
+                toks, n_emit, tok_d, pos_d, sl_d,
+                self.kv_k, self.kv_v, self._rng, self._hist_dev,
+            ) = self._spec_block_fn(
+                self.params, self.kv_k, self.kv_v,
+                carry[0], carry[1], carry[2],
+                self._tables_dev, self._samp_dev, self._rng, self._hist_dev,
+            )
+            self._carry = (tok_d, pos_d, sl_d)
+            return (toks, n_emit)
         (
             toks,
             tok_d,
@@ -1143,6 +1386,7 @@ class JaxEngine:
                         self._dev_reset,
                         p["tokens"], p["positions"], p["seq_lens"],
                         p["page_tables"], p["temps"], p["top_ks"], p["top_ps"],
+                        p.get("hist"),
                     )
                 )
             elif tag == "prefill_single":
@@ -1159,7 +1403,7 @@ class JaxEngine:
                         self._dev_patch,
                         p["lane_mask"], p["table_mask"], p["tokens"],
                         p["positions"], p["seq_lens"], p["page_tables"],
-                        p["temps"], p["top_ks"], p["top_ps"],
+                        p["temps"], p["top_ks"], p["top_ps"], p.get("hist"),
                     )
                 )
             elif tag == "block":
@@ -1257,6 +1501,7 @@ class JaxEngine:
         slot.seq.append(first_token)
         self.tokens[slot.slot_idx] = first_token
         self.seq_lens[slot.slot_idx] = len(slot.prompt) + 1
+        self._fill_hist(slot.slot_idx, slot)
         self._mark_lane_dirty(slot.slot_idx)
         self._maybe_finish(slot, first_token)
 
@@ -1549,7 +1794,8 @@ class JaxEngine:
                     self._dev_prefill_mm,
                     toks, positions, tables, ctx_lens, last_idx,
                     temps, top_ks, top_ps, emb, emb_mask,
-                )
+                ),
+                tag="prefill",
             )
         else:
             self._bcast(
@@ -1564,7 +1810,8 @@ class JaxEngine:
                 partial(
                     self._dev_prefill,
                     toks, positions, tables, ctx_lens, last_idx, temps, top_ks, top_ps,
-                )
+                ),
+                tag="prefill",
             )
         completions = []
         for s, chunk, lane in meta:
@@ -1607,7 +1854,8 @@ class JaxEngine:
             },
         )
         first_dev = await self._run_on_device(
-            partial(self._dev_prefill_single, toks, table, ctx, real, temps, top_ks, top_ps)
+            partial(self._dev_prefill_single, toks, table, ctx, real, temps, top_ks, top_ps),
+            tag="prefill",
         )
         slot.prefill_pos += chunk
         self._pending_prefill.append({"first": first_dev, "done": [(slot, 0)]})
@@ -1626,6 +1874,22 @@ class JaxEngine:
         )
         return first
 
+    def _fill_hist(self, idx: int, slot: _Slot):
+        """Load the lane's history ring (host mirror) for n-gram drafting:
+        the last spec_hist tokens of prompt-so-far + the current token.
+        Uploaded to device by the reset/patch that follows lane dirtying."""
+        if self.hist is None:
+            return
+        Hc = self.config.spec_hist
+        toks = np.asarray(
+            list(slot.kv_prompt) + [int(self.tokens[idx])], np.int32
+        )
+        L1 = len(toks)
+        row = self.hist[idx]
+        row[:] = 0
+        ps = np.arange(max(0, L1 - Hc), L1)
+        row[ps % Hc] = toks[ps]
+
     def _finish_prefill(self, slot: _Slot, first: int):
         """Prompt KV fully computed; activate the slot for decode."""
         self._commit_blocks(slot)
@@ -1641,6 +1905,7 @@ class JaxEngine:
             slot.last_token = first
             self.tokens[slot.slot_idx] = first
             self.seq_lens[slot.slot_idx] = len(slot.kv_prompt) + 1
+            self._fill_hist(slot.slot_idx, slot)
             self._mark_lane_dirty(slot.slot_idx)
             return
         self._emit_token(slot, first)
@@ -1650,6 +1915,7 @@ class JaxEngine:
             slot.seq.append(first)
             self.tokens[slot.slot_idx] = first
             self.seq_lens[slot.slot_idx] = len(slot.kv_prompt) + 1
+            self._fill_hist(slot.slot_idx, slot)
             self._mark_lane_dirty(slot.slot_idx)
             self._maybe_finish(slot, first)
 
@@ -1834,7 +2100,7 @@ class JaxEngine:
         newest sequence (or finish with 'length' as last resort) when the
         pool is exhausted. Returns the surviving active set."""
         cfg = self.config
-        K = cfg.decode_block_steps
+        K = cfg.block_advance
         for i in list(active):
             slot = self.slots[i]
             if slot is None:
@@ -1909,8 +2175,13 @@ class JaxEngine:
         # only ONE speculative block in flight — a new arrival's prefill
         # queues behind every in-flight block on the device stream, so
         # depth-2 doubles its queueing delay (TTFT) to buy decode overlap
-        # it regains once the queue drains
-        depth = 1 if self._prefill_work_pending() else 2
+        # it regains once the queue drains. Spec-decode blocks advance
+        # lanes by a DATA-DEPENDENT amount, so host bookkeeping must be
+        # corrected from each block's fetch before the next dispatches:
+        # depth stays 1 (the verify pass amortizes weight streams instead).
+        depth = 1 if (
+            cfg.spec_mode or self._prefill_work_pending()
+        ) else 2
         if len(self._inflight) >= depth:
             return False
         if not self._carry_valid and self._inflight:
@@ -1946,22 +2217,27 @@ class JaxEngine:
             tables = np.where(
                 mask[:, None], self.page_tables, SCRATCH_PAGE
             ).astype(np.int32)
-            self._bcast(
-                "reset",
-                {
-                    "tokens": tokens, "positions": positions,
-                    "seq_lens": seq_lens_step, "page_tables": tables,
-                    "temps": self.temps, "top_ks": self.top_ks,
-                    "top_ps": self.top_ps,
-                },
+            hist = (
+                np.where(mask[:, None], self.hist, 0).astype(np.int32)
+                if self.hist is not None else None
             )
+            payload = {
+                "tokens": tokens, "positions": positions,
+                "seq_lens": seq_lens_step, "page_tables": tables,
+                "temps": self.temps, "top_ks": self.top_ks,
+                "top_ps": self.top_ps,
+            }
+            if hist is not None:
+                payload["hist"] = hist
+            self._bcast("reset", payload)
             await self._run_on_device(
                 partial(
                     self._dev_reset,
                     tokens, positions, seq_lens_step,
                     tables, self.temps.copy(),
-                    self.top_ks.copy(), self.top_ps.copy(),
-                )
+                    self.top_ks.copy(), self.top_ps.copy(), hist,
+                ),
+                tag="reset",
             )
             self._carry_valid = True
             self._dirty_lanes.clear()
@@ -1986,35 +2262,44 @@ class JaxEngine:
             n_tables = np.where(
                 active_mask[:, None], self.page_tables, SCRATCH_PAGE
             ).astype(np.int32)
-            self._bcast(
-                "patch",
-                {
-                    "lane_mask": lane_mask, "table_mask": table_mask,
-                    "tokens": n_tokens, "positions": n_positions,
-                    "seq_lens": n_seq_lens, "page_tables": n_tables,
-                    "temps": self.temps, "top_ks": self.top_ks,
-                    "top_ps": self.top_ps,
-                },
-            )
+            hist = self.hist.astype(np.int32) if self.hist is not None else None
+            payload = {
+                "lane_mask": lane_mask, "table_mask": table_mask,
+                "tokens": n_tokens, "positions": n_positions,
+                "seq_lens": n_seq_lens, "page_tables": n_tables,
+                "temps": self.temps, "top_ks": self.top_ks,
+                "top_ps": self.top_ps,
+            }
+            if hist is not None:
+                payload["hist"] = hist
+            self._bcast("patch", payload)
             await self._run_on_device(
                 partial(
                     self._dev_patch, lane_mask, table_mask,
                     n_tokens, n_positions, n_seq_lens,
                     n_tables, self.temps.copy(),
-                    self.top_ks.copy(), self.top_ps.copy(),
-                )
+                    self.top_ks.copy(), self.top_ps.copy(), hist,
+                ),
+                tag="patch",
             )
             self._dirty_lanes.clear()
             self._dirty_tables.clear()
 
         self._bcast("block", {})
-        toks_dev = await self._run_on_device(self._dev_block)
-        self._inflight.append(
-            {"lanes": [(i, self.slots[i]) for i in active], "toks": toks_dev}
-        )
-        # advance host bookkeeping by K for the NEXT block's page growth
+        toks_dev = await self._run_on_device(self._dev_block, tag="block")
+        entry = {"lanes": [(i, self.slots[i]) for i in active], "toks": toks_dev}
+        if cfg.spec_mode:
+            # spec blocks advance lanes by a data-dependent amount: record
+            # the pre-dispatch seq_lens so the fetch can correct the
+            # worst-case advance below to the device-true values
+            entry["seq_before"] = {i: int(self.seq_lens[i]) for i in active}
+        self._inflight.append(entry)
+        # advance host bookkeeping by the block's max advance for the NEXT
+        # block's page growth (exact for plain decode; an upper bound under
+        # spec, corrected at fetch)
+        adv = cfg.block_advance
         for i in active:
-            self.seq_lens[i] += K
+            self.seq_lens[i] += adv
         self._step_counter += 1
         return True
 
@@ -2044,8 +2329,61 @@ class JaxEngine:
 
         if want_block is not None:
             self._inflight.popleft()
-            self._process_block(want_block["lanes"], toks_np)
+            if self.config.spec_mode:
+                self._process_spec_block(
+                    want_block["lanes"], toks_np[0], toks_np[1],
+                    want_block["seq_before"],
+                )
+            else:
+                self._process_block(want_block["lanes"], toks_np)
         return True
+
+    def _process_spec_block(self, lanes: List[tuple], toks: np.ndarray,
+                            n_emit: np.ndarray, seq_before: dict):
+        """Emit a fetched spec block: toks [S, B, 1+d], n_emit [S, B].
+        Per lane, each round contributes its first n_emit tokens; host
+        seq_lens/tokens mirrors are corrected to the device-true values
+        (dispatch advanced them by the worst-case bound)."""
+        S, B, T = toks.shape
+        Hc = self.config.spec_hist
+        for i, slot_ref in lanes:
+            slot = self.slots[i]
+            if slot is None or slot is not slot_ref:
+                continue
+            true_adv = int(n_emit[:, i].sum())
+            # device-authoritative mirrors (valid even if the slot finishes
+            # below — the lane is re-patched on the next admission anyway)
+            self.seq_lens[i] = seq_before[i] + true_adv
+            self.tokens[i] = int(toks[S - 1, i, int(n_emit[S - 1, i]) - 1])
+            # stats: engine-level acceptance (device view)
+            self.spec_num_drafts += S
+            self.spec_num_draft_tokens += S * (T - 1)
+            self.spec_num_accepted_tokens += true_adv - S
+            if slot.done or slot.context.is_stopped():
+                self._emit_finish(slot, "cancelled")
+                self._release_slot(slot)
+                continue
+            # the round's current token sits at position seq_before-1 (the
+            # device carry was uploaded with positions = seq_lens - 1), so
+            # emitted token t of a round lands at (pos + 1 + t) with
+            # pos = seq_before - 1 — matching the device ring exactly
+            pos = seq_before[i] - 1
+            for s in range(S):
+                k = int(n_emit[s, i])
+                for t in range(k):
+                    tok = int(toks[s, i, t])
+                    slot.seq.append(tok)
+                    slot.generated += 1
+                    slot.last_token = tok
+                    if self.hist is not None:
+                        self.hist[i, (pos + 1 + t) % Hc] = tok
+                    self._emit_token(slot, tok)
+                    self._maybe_finish(slot, tok)
+                    if slot.done:
+                        break
+                pos += k
+                if slot.done:
+                    break
 
     def _process_block(self, lanes: List[tuple], toks: np.ndarray):
         """Emit a fetched K-step block: per lane, append/emit tokens until a

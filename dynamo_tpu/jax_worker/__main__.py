@@ -39,6 +39,12 @@ def parse_args(argv=None):
                     help="KV-write strategy in the fused decode block "
                     "(local + unroll for multi-GB page pools)")
     ap.add_argument("--decode-block-unroll", type=int, default=1)
+    ap.add_argument("--spec", choices=["ngram"], default=None,
+                    help="speculative decoding: self-drafting prompt-lookup "
+                    "verified in one pass (engine/spec.py)")
+    ap.add_argument("--spec-draft-len", type=int, default=4)
+    ap.add_argument("--spec-ngram", type=int, default=2)
+    ap.add_argument("--spec-rounds", type=int, default=4)
     ap.add_argument("--quantize", choices=["int8"], default=None,
                     help="weight-only quantization (models/quant.py): int8 "
                     "projections/embed/head, per-channel scales")
@@ -60,6 +66,11 @@ def parse_args(argv=None):
     ap.add_argument("--kvbm-disk-blocks", type=int, default=0)
     ap.add_argument("--kvbm-disk-path", default=None)
     ap.add_argument("--migration-limit", type=int, default=3)
+    ap.add_argument("--warmup", choices=["auto", "full", "none"],
+                    default="auto",
+                    help="compile all engine dispatch variants before "
+                    "joining the control plane (auto: on for accelerators, "
+                    "off for CPU test runs)")
     ap.add_argument("--context-length", type=int, default=None)
     # disaggregation (reference: --disaggregation-mode prefill|decode)
     ap.add_argument(
@@ -118,6 +129,10 @@ async def main():
         decode_pool_mode=args.decode_pool_mode,
         decode_block_unroll=args.decode_block_unroll,
         quantize=args.quantize,
+        spec_mode=args.spec,
+        spec_draft_len=args.spec_draft_len,
+        spec_ngram=args.spec_ngram,
+        spec_rounds=args.spec_rounds,
         tp_size=args.tp_size,
         pp_size=args.pp_size,
         sp_size=args.sp_size,
@@ -281,6 +296,23 @@ async def main():
             threading.Timer(5.0, lambda: os._exit(1)).start()
 
         spmd.on_follower_lost = lambda hid, why: loop.call_soon(_follower_lost, hid, why)
+
+    # compile every engine program variant BEFORE joining the control
+    # plane: a first-request compile (20-40s/program through the axon
+    # remote-compile tunnel) after registration starves lease renewal and
+    # the frontend drops the worker mid-stream (round-4 e2e failure mode)
+    import jax as _jax
+
+    do_warmup = args.warmup == "full" or (
+        args.warmup == "auto" and _jax.local_devices()[0].platform != "cpu"
+    )
+    if do_warmup:
+        t0 = time.monotonic()
+        n_warm = await engine.warmup()
+        logger.info(
+            "engine warmup: %d requests, all dispatch variants compiled "
+            "in %.1fs", n_warm, time.monotonic() - t0,
+        )
 
     cfg = RuntimeConfig.from_settings()
     if args.discovery:

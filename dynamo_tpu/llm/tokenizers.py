@@ -100,7 +100,14 @@ class ByteTokenizer:
                 run.append(i - self.OFFSET)
             elif i >= self.OFFSET + 256:
                 flush()
-                parts.append(chr(self.PLACEHOLDER_BASE + (i - self.OFFSET - 256)))
+                cp = self.PLACEHOLDER_BASE + (i - self.OFFSET - 256)
+                if cp >= 0xD800:
+                    # skip the UTF-16 surrogate block: chr() there makes a
+                    # lone surrogate that no JSON/UTF-8 serializer accepts
+                    # (a 128k-vocab id sampled into it crashed the SSE
+                    # stream serializer mid-benchmark)
+                    cp += 0x800
+                parts.append(chr(cp))
             # specials (PAD/BOS/EOS) are always dropped
         flush()
         return "".join(parts)

@@ -255,10 +255,13 @@ def prefill_forward_batched(
     mlp_fn=None,
     emb_override: Optional[jax.Array] = None,  # [B, T, H] multimodal rows
     emb_mask: Optional[jax.Array] = None,  # [B, T] True where override applies
+    all_logits: bool = False,  # True: return [B, T, vocab] (spec verify)
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Batched chunked prefill: one dispatch processes chunks of SEVERAL
     sequences (the round-1 engine serialized one chunk per loop iteration).
-    Returns (logits_last [B, vocab], kv_k, kv_v).
+    Returns (logits_last [B, vocab], kv_k, kv_v) — or [B, T, vocab] under
+    `all_logits` (the speculative-decoding verify pass, engine/spec.py,
+    needs every chunk position's logits).
 
     `emb_override`/`emb_mask`: multimodal E/P/D splice — encoder-produced
     embedding rows replace the placeholder tokens' embeddings at their
@@ -273,8 +276,13 @@ def prefill_forward_batched(
     page_size = kv_k.shape[2]
     total_lens = context_lens + last_idx + 1  # [B] valid context per seq
 
-    logical = positions // page_size
+    # route positions past the table to the scratch page (phys 0):
+    # speculative verify chunks (engine/spec.py) may overshoot
+    # max_model_len by up to the draft length near the boundary
+    P_tab = page_tables.shape[1]
+    logical = jnp.minimum(positions // page_size, P_tab - 1)
     phys = jnp.take_along_axis(page_tables, logical, axis=1)  # [B, T]
+    phys = jnp.where(positions < P_tab * page_size, phys, 0)
     offs = positions % page_size
 
     for li in range(c.num_layers):
@@ -298,8 +306,10 @@ def prefill_forward_batched(
         x = mlp_fn(layer, x, c)
 
     x = rms_norm(x, params["final_norm"], c.rms_norm_eps)
-    last = x[jnp.arange(B), last_idx]  # [B, hidden]
     head = head_leaf(params)
+    if all_logits:
+        return qdot(x, head), kv_k, kv_v  # [B, T, vocab]
+    last = x[jnp.arange(B), last_idx]  # [B, hidden]
     logits = qdot(last, head)
     return logits, kv_k, kv_v
 

@@ -297,10 +297,11 @@ def prefill_forward_batched(
     last_idx: jax.Array,  # [B]
     emb_override: Optional[jax.Array] = None,
     emb_mask: Optional[jax.Array] = None,
+    all_logits: bool = False,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Batched chunked prefill (multiple sequences per dispatch), MoE MLP."""
     return llama.prefill_forward_batched(
         params, config, tokens, positions, kv_k, kv_v, page_tables,
         context_lens, last_idx, mlp_fn=_moe_mlp_nd,
-        emb_override=emb_override, emb_mask=emb_mask,
+        emb_override=emb_override, emb_mask=emb_mask, all_logits=all_logits,
     )
