@@ -29,7 +29,15 @@ def parse_args():
     ap.add_argument("--model", default="tiny",
                     help="model registry key the embeddings target")
     ap.add_argument("--mm-tokens", type=int, default=DEFAULT_MM_TOKENS,
-                    help="placeholder span length per content part")
+                    help="placeholder span length per content part (mock)")
+    ap.add_argument("--encoder", choices=["mock", "vit"], default="mock",
+                    help="mock: content-hash projection (tests); "
+                         "vit: real JAX ViT (models/vit.py)")
+    ap.add_argument("--vit-checkpoint", default=None,
+                    help="local HF ViT export dir (safetensors/bin); "
+                         "random-init when omitted")
+    ap.add_argument("--vit-size", choices=["tiny", "base"], default="tiny",
+                    help="ViT architecture when no checkpoint config")
     return ap.parse_args()
 
 
@@ -46,7 +54,16 @@ async def main():
         from dynamo_tpu.engine.engine import _resolve_model
 
         hidden = _resolve_model(args.model).hidden_size
-    encoder = MockVisionEncoder(hidden, n_tokens=args.mm_tokens)
+    if args.encoder == "vit":
+        from dynamo_tpu.llm.multimodal import ViTEncoder
+        from dynamo_tpu.models.vit import ViTConfig
+
+        vcfg = ViTConfig() if args.vit_size == "base" else ViTConfig.tiny()
+        encoder = ViTEncoder(
+            config=vcfg, llm_hidden=hidden, checkpoint=args.vit_checkpoint
+        )
+    else:
+        encoder = MockVisionEncoder(hidden, n_tokens=args.mm_tokens)
     n_encoded = 0
 
     endpoint = (

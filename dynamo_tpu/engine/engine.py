@@ -48,7 +48,7 @@ from ..models import llama
 from ..runtime.engine import Context
 from .config import EngineConfig
 from .kv_cache import PageAllocator, alloc_kv_arrays
-from .sampling import SamplingParams, sample
+from .sampling import SamplingParams, sample, unpack_mask
 
 logger = logging.getLogger(__name__)
 
@@ -220,6 +220,8 @@ class _Slot:
     pull_desc: Optional[dict] = None  # decode role: pull-path descriptor
     onboard: Optional[tuple] = None  # KVBM tier hit: (alloc_pages, hashes)
     mm: Optional[List[tuple]] = None  # multimodal splices: (position, emb [n, H])
+    guided_fsm: Optional[Any] = None  # llm/guided.TokenFsm (structured output)
+    guided_state: int = 0  # current FSM state; advanced per emitted token
 
 
 class JaxEngine:
@@ -358,6 +360,13 @@ class JaxEngine:
         self.spec_num_drafts = 0
         self.spec_num_draft_tokens = 0
         self.spec_num_accepted_tokens = 0
+        # guided decoding (llm/guided.py): tokenizer for vocab→FSM lift
+        # (workers set this to the served model's tokenizer; defaults to
+        # ByteTokenizer over the model vocab), lazily-built compiler, and
+        # a requests counter for stats()
+        self.tokenizer = None
+        self._guided = None
+        self.guided_requests = 0
         # per-dispatch-type device occupancy: {tag: (count, seconds)} —
         # dispatches run serialized on the single device thread, so these
         # sum to device-stream busy time (the serving-gap diagnostic)
@@ -614,6 +623,49 @@ class JaxEngine:
 
         self._prefill_batch_mm = prefill_batch_mm
 
+        # guided-decoding variants (llm/guided.py): same programs with a
+        # [B, V] admissibility mask applied inside the sampler. Separate
+        # jits so unguided dispatches never carry the mask operand —
+        # jax.jit is lazy, these compile only when a guided request
+        # actually arrives. The decode variant is a SINGLE step: the mask
+        # for step t+1 depends host-side on the token emitted at step t,
+        # so guided decode cannot ride the K-step fused block.
+        @partial(jax.jit, donate_argnums=(1, 2, 8), out_shardings=decode_out_sh)
+        def decode_step_guided(params, kv_k, kv_v, tokens, positions, seq_lens,
+                               page_tables, samp, rng, mask_packed):
+            rng, sub = jax.random.split(rng)
+            if cfg.pp_size > 1:
+                logits, kv_k, kv_v = self._model.decode_forward_pp(
+                    params, c, tokens, positions, kv_k, kv_v,
+                    page_tables, seq_lens, self._mesh,
+                )
+            else:
+                logits, kv_k, kv_v = self._model.decode_forward(
+                    params, c, tokens, positions, kv_k, kv_v, page_tables, seq_lens
+                )
+            mask = unpack_mask(mask_packed, c.vocab_size)
+            nxt = sample(logits, samp, sub, mask=mask)
+            return (
+                nxt[None], nxt, positions + 1, seq_lens + 1, kv_k, kv_v, rng
+            )
+
+        self._decode_step_guided = decode_step_guided
+
+        @partial(jax.jit, donate_argnums=(1, 2, 9), out_shardings=prefill_out_sh)
+        def prefill_batch_guided(params, kv_k, kv_v, tokens, positions,
+                                 page_tables, ctx_lens, last_idx, samp, rng,
+                                 mask_packed):
+            rng, sub = jax.random.split(rng)
+            logits, kv_k, kv_v = self._model.prefill_forward_batched(
+                params, c, tokens, positions, kv_k, kv_v, page_tables,
+                ctx_lens, last_idx
+            )
+            mask = unpack_mask(mask_packed, c.vocab_size)
+            first = sample(logits, samp, sub, mask=mask)
+            return first, kv_k, kv_v, rng
+
+        self._prefill_batch_guided = prefill_batch_guided
+
         # single-sequence prefill variants for the native parallel layouts
         # (SURVEY.md §2.5): ring attention over sp (long-context), layer
         # pipeline over pp. Both sample the first token on device.
@@ -760,6 +812,22 @@ class JaxEngine:
                 burst = min(cap, 3)
                 await asyncio.gather(*[_drain(isl) for _ in range(burst)])
                 n += burst
+        if (
+            self.config.pp_size == 1 and self.config.sp_size == 1
+            and not self.config.spec_mode
+        ):
+            # compile the guided prefill/decode variants too (a first
+            # guided request on-path would otherwise pay the compile)
+            isl = max(buckets[0] - 8, 4)
+            req = PreprocessedRequest(
+                token_ids=rng.randint(5, max(vocab - 1, 6), size=isl).tolist(),
+                stop_conditions={"max_tokens": 3},
+                sampling_options={"temperature": 1.0},
+                guided={"kind": "regex", "regex": "[ab]*"},
+            ).to_dict()
+            async for _ in self.generate(req, Context()):
+                pass
+            n += 1
         return n
 
     def _check_multimodal(self, req: PreprocessedRequest) -> Optional[str]:
@@ -805,6 +873,55 @@ class JaxEngine:
             for p in req.multimodal
         ]
 
+    def _guided_compiler(self):
+        if self._guided is None:
+            from ..llm.guided import GuidedCompiler
+
+            tok = self.tokenizer
+            if tok is None:
+                from ..llm.tokenizers import ByteTokenizer
+
+                tok = ByteTokenizer(self.model_config.vocab_size)
+            self._guided = GuidedCompiler(tok)
+        return self._guided
+
+    def _check_guided(self, req: PreprocessedRequest) -> Optional[str]:
+        """Validate + pre-compile a guided-decoding spec. Returns an error
+        string (rejected request) or None. Like multimodal, silently
+        dropping the constraint would be a WRONG answer, not a degraded
+        one — unsupported layouts reject up front."""
+        if not req.guided:
+            return None
+        cfg = self.config
+        if cfg.spec_mode:
+            return (
+                "guided decoding is incompatible with speculative decoding "
+                "(run the worker without --spec)"
+            )
+        if cfg.pp_size > 1 or cfg.sp_size > 1:
+            return "guided decoding is not supported on pp/sp layouts yet"
+        if req.multimodal:
+            return "guided decoding cannot be combined with multimodal parts"
+        try:
+            self._guided_compiler().compile(req.guided)
+        except ValueError as e:
+            return f"guided spec rejected: {e}"
+        return None
+
+    def _guided_lane_mask(self, fsm, state: int) -> np.ndarray:
+        """fsm.allowed trimmed/padded to the MODEL vocab width (the
+        tokenizer vocab may differ; out-of-tokenizer logits rows are
+        inadmissible)."""
+        V = self.model_config.vocab_size
+        row = fsm.allowed(state)
+        if len(row) == V:
+            return row
+        if len(row) > V:
+            return row[:V]
+        out = np.zeros((V,), bool)
+        out[: len(row)] = row
+        return out
+
     def _new_slot(self, req: PreprocessedRequest, context: Context, suffix: str = "") -> _Slot:
         stop = req.stop_conditions or {}
         sampling = req.sampling_options or {}
@@ -827,6 +944,10 @@ class JaxEngine:
         )
         slot.top_k = int(sampling.get("top_k") or 0)
         slot.top_p = float(sampling.get("top_p") or 1.0)
+        if req.guided:
+            slot.guided_fsm = self._guided_compiler().compile(req.guided)
+            slot.guided_state = slot.guided_fsm.start_state
+            self.guided_requests += 1
         if len(slot.prompt) + slot.max_tokens > self.config.max_model_len:
             slot.max_tokens = max(self.config.max_model_len - len(slot.prompt), 1)
         return slot
@@ -845,6 +966,10 @@ class JaxEngine:
             # Parts that arrived WITH encoder embeddings + positions are
             # spliced at prefill instead (E/P/D flow, _prefill_batch_mm).
             yield Annotated.from_error(mm_err).to_dict()
+            return
+        g_err = self._check_guided(req)
+        if g_err is not None:
+            yield Annotated.from_error(g_err).to_dict()
             return
         slot = self._new_slot(req, context)
         disagg = req.disagg_params or {}
@@ -882,7 +1007,17 @@ class JaxEngine:
             if isinstance(request, PreprocessedRequest)
             else PreprocessedRequest.from_dict(request)
         )
+        g_err = self._check_guided(req)
+        if g_err is not None:
+            yield Annotated.from_error(g_err).to_dict()
+            return
         slot = self._new_slot(req, context, suffix="-d")
+        if slot.guided_fsm is not None:
+            # the prefill worker sampled (and emitted) the first token
+            # under the same FSM; catch the state up to it
+            slot.guided_state = slot.guided_fsm.advance(
+                slot.guided_state, first_token
+            )
         slot.preloaded = (first_token, kv_k_pages, kv_v_pages, n_tokens)
         self.num_requests += 1
         self._waiting.append(slot)
@@ -910,7 +1045,15 @@ class JaxEngine:
             if isinstance(request, PreprocessedRequest)
             else PreprocessedRequest.from_dict(request)
         )
+        g_err = self._check_guided(req)
+        if g_err is not None:
+            yield Annotated.from_error(g_err).to_dict()
+            return
         slot = self._new_slot(req, context, suffix="-d")
+        if slot.guided_fsm is not None:
+            slot.guided_state = slot.guided_fsm.advance(
+                slot.guided_state, first_token
+            )
         slot.preloaded = (first_token, None, None, int(desc["n_tokens"]))
         slot.pull_desc = desc
         self.num_requests += 1
@@ -956,6 +1099,8 @@ class JaxEngine:
         for tag, (cnt, tot) in self._dev_time.items():
             out[f"dispatch_{tag}_count"] = cnt
             out[f"dispatch_{tag}_s"] = round(tot, 3)
+        if self.guided_requests:
+            out["guided_requests"] = self.guided_requests
         if self.config.spec_mode:
             out["spec_num_drafts"] = self.spec_num_drafts
             out["spec_num_draft_tokens"] = self.spec_num_draft_tokens
@@ -1177,6 +1322,28 @@ class JaxEngine:
         )
         return first
 
+    def _dev_prefill_guided(self, toks, positions, tables, ctx_lens, last_idx,
+                            temps, top_ks, top_ps, mask):
+        samp = SamplingParams(
+            temperature=jnp.asarray(temps),
+            top_k=jnp.asarray(top_ks),
+            top_p=jnp.asarray(top_ps),
+        )
+        first, self.kv_k, self.kv_v, self._rng = self._prefill_batch_guided(
+            self.params,
+            self.kv_k,
+            self.kv_v,
+            jnp.asarray(toks),
+            jnp.asarray(positions),
+            jnp.asarray(tables),
+            jnp.asarray(ctx_lens),
+            jnp.asarray(last_idx),
+            samp,
+            self._rng,
+            jnp.asarray(mask),
+        )
+        return first
+
     def _dev_reset(self, tokens, positions, seq_lens, page_tables, temps,
                    top_ks, top_ps, hist=None):
         self._samp_dev = SamplingParams(
@@ -1246,6 +1413,31 @@ class JaxEngine:
             self._tables_dev,
             self._samp_dev,
             self._rng,
+        )
+        self._carry = (tok_d, pos_d, sl_d)
+        return toks
+
+    def _dev_block_guided(self, mask):
+        carry = self._carry
+        (
+            toks,
+            tok_d,
+            pos_d,
+            sl_d,
+            self.kv_k,
+            self.kv_v,
+            self._rng,
+        ) = self._decode_step_guided(
+            self.params,
+            self.kv_k,
+            self.kv_v,
+            carry[0],
+            carry[1],
+            carry[2],
+            self._tables_dev,
+            self._samp_dev,
+            self._rng,
+            jnp.asarray(mask),
         )
         self._carry = (tok_d, pos_d, sl_d)
         return toks
@@ -1406,8 +1598,21 @@ class JaxEngine:
                         p["temps"], p["top_ks"], p["top_ps"], p.get("hist"),
                     )
                 )
+            elif tag == "prefill_guided":
+                await self._run_on_device(
+                    partial(
+                        self._dev_prefill_guided,
+                        p["toks"], p["positions"], p["tables"], p["ctx_lens"],
+                        p["last_idx"], p["temps"], p["top_ks"], p["top_ps"],
+                        p["mask"],
+                    )
+                )
             elif tag == "block":
                 await self._run_on_device(self._dev_block)
+            elif tag == "block_guided":
+                await self._run_on_device(
+                    partial(self._dev_block_guided, p["mask"])
+                )
             elif tag == "inject":
                 await self._run_on_device(
                     partial(self._dev_inject, p["page_ids"], p["k"], p["v"])
@@ -1698,6 +1903,18 @@ class JaxEngine:
         if not cands:
             return False
         cands.sort(key=lambda s: s.admit_seq)
+        # guided and multimodal slots never share a prefill batch: each
+        # rides its own dispatch variant (mask vs embedding splice); the
+        # excluded kind simply waits for the next dispatch
+        lead = cands[0]
+        if lead.guided_fsm is not None:
+            cands = [s for s in cands if s.mm is None]
+        elif lead.mm is not None:
+            cands = [s for s in cands if s.guided_fsm is None]
+        elif any(s.mm for s in cands) and any(
+            s.guided_fsm is not None for s in cands
+        ):
+            cands = [s for s in cands if s.guided_fsm is None]
 
         if self._prefill_single is not None:
             s0 = cands[0]
@@ -1794,6 +2011,32 @@ class JaxEngine:
                     self._dev_prefill_mm,
                     toks, positions, tables, ctx_lens, last_idx,
                     temps, top_ks, top_ps, emb, emb_mask,
+                ),
+                tag="prefill",
+            )
+        elif any(s.guided_fsm is not None for s in chosen):
+            # masked first-token sampling: guided lanes constrain the first
+            # generated token the same way decode steps are constrained
+            V = self.model_config.vocab_size
+            mask = np.full((B_pf, (V + 7) // 8), 0xFF, np.uint8)
+            for s, chunk, lane in meta:
+                if s.guided_fsm is not None:
+                    mask[lane] = np.packbits(self._guided_lane_mask(
+                        s.guided_fsm, s.guided_state
+                    ))
+            self._bcast(
+                "prefill_guided",
+                {
+                    "toks": toks, "positions": positions, "tables": tables,
+                    "ctx_lens": ctx_lens, "last_idx": last_idx, "temps": temps,
+                    "top_ks": top_ks, "top_ps": top_ps, "mask": mask,
+                },
+            )
+            first_dev = await self._run_on_device(
+                partial(
+                    self._dev_prefill_guided,
+                    toks, positions, tables, ctx_lens, last_idx,
+                    temps, top_ks, top_ps, mask,
                 ),
                 tag="prefill",
             )
@@ -1908,6 +2151,10 @@ class JaxEngine:
             self._fill_hist(slot.slot_idx, slot)
             self._mark_lane_dirty(slot.slot_idx)
             return
+        if slot.guided_fsm is not None:
+            slot.guided_state = slot.guided_fsm.advance(
+                slot.guided_state, first
+            )
         self._emit_token(slot, first)
         if not slot.done:
             slot.last_token = first
@@ -2179,8 +2426,17 @@ class JaxEngine:
         # lanes by a DATA-DEPENDENT amount, so host bookkeeping must be
         # corrected from each block's fetch before the next dispatches:
         # depth stays 1 (the verify pass amortizes weight streams instead).
+        # guided lanes: the next step's mask depends on the token the
+        # PREVIOUS step emitted, so while any guided slot is decode-active
+        # the pipeline depth is 1 and every block must be fetched+processed
+        # (FSM advanced) before the next dispatch.
+        has_guided = any(
+            s is not None and s.guided_fsm is not None
+            and s.prefill_pos >= len(s.kv_prompt) and s.generated > 0
+            for s in self.slots
+        )
         depth = 1 if (
-            cfg.spec_mode or self._prefill_work_pending()
+            cfg.spec_mode or has_guided or self._prefill_work_pending()
         ) else 2
         if len(self._inflight) >= depth:
             return False
@@ -2285,8 +2541,30 @@ class JaxEngine:
             self._dirty_lanes.clear()
             self._dirty_tables.clear()
 
-        self._bcast("block", {})
-        toks_dev = await self._run_on_device(self._dev_block, tag="block")
+        guided_lanes = [
+            i for i in active if self.slots[i].guided_fsm is not None
+        ]
+        if guided_lanes:
+            # single masked step: guided rows from each lane's FSM state,
+            # unguided rows admit everything. Bitpacked: [B, V/8] uint8
+            # host→device instead of a [B, V] bool (the per-step transfer
+            # would otherwise dominate guided ITL through the tunnel).
+            V = self.model_config.vocab_size
+            packed = np.full((B, (V + 7) // 8), 0xFF, np.uint8)
+            for i in guided_lanes:
+                s = self.slots[i]
+                packed[i] = np.packbits(
+                    self._guided_lane_mask(s.guided_fsm, s.guided_state)
+                )
+            self._bcast("block_guided", {"mask": packed})
+            toks_dev = await self._run_on_device(
+                partial(self._dev_block_guided, packed), tag="block_guided"
+            )
+            adv = 1
+        else:
+            self._bcast("block", {})
+            toks_dev = await self._run_on_device(self._dev_block, tag="block")
+            adv = cfg.block_advance
         entry = {"lanes": [(i, self.slots[i]) for i in active], "toks": toks_dev}
         if cfg.spec_mode:
             # spec blocks advance lanes by a data-dependent amount: record
@@ -2297,7 +2575,6 @@ class JaxEngine:
         # advance host bookkeeping by the block's max advance for the NEXT
         # block's page growth (exact for plain decode; an upper bound under
         # spec, corrected at fetch)
-        adv = cfg.block_advance
         for i in active:
             self.seq_lens[i] += adv
         self._step_counter += 1
@@ -2406,6 +2683,10 @@ class JaxEngine:
                 slot.generated += 1
                 slot.last_token = tok
                 self.tokens[i] = tok
+                if slot.guided_fsm is not None:
+                    slot.guided_state = slot.guided_fsm.advance(
+                        slot.guided_state, tok
+                    )
                 self._emit_token(slot, tok)
                 self._maybe_finish(slot, tok)
                 if slot.done:

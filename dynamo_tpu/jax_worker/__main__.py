@@ -145,6 +145,7 @@ async def main():
     kv_sharding = None
     params = None
     model_config = None
+    gguf_path = None
     mesh = None
     any_parallel = (
         args.tp_size > 1 or args.ep_size > 1 or args.pp_size > 1
@@ -223,6 +224,15 @@ async def main():
         mesh=mesh,
         spmd=spmd,
         multihost=multihost,
+    )
+    # guided decoding compiles token FSMs against the SERVED vocabulary:
+    # GGUF checkpoints carry their own; everything else uses the byte
+    # tokenizer the model card advertises (llm/guided.py)
+    from dynamo_tpu.llm.tokenizers import load_tokenizer
+
+    engine.tokenizer = load_tokenizer(
+        f"gguf:{gguf_path}" if gguf_path is not None
+        else f"byte:{engine.model_config.vocab_size}"
     )
 
     # KV data plane: prefill-capable workers stage finished prompts here;
@@ -383,7 +393,11 @@ async def main():
         # prefill pool is internal, reached by decode orchestration)
         card = ModelDeploymentCard(
             name=model_name,
-            tokenizer="byte",
+            # the card's tokenizer is the SERVING contract: frontend
+            # tokenization and the engine's guided-decoding FSM must agree
+            # on the id↔text mapping, so GGUF checkpoints advertise their
+            # embedded vocab
+            tokenizer=f"gguf:{gguf_path}" if gguf_path is not None else "byte",
             kv_cache_block_size=args.page_size,
             context_length=args.context_length or args.max_model_len,
             migration_limit=args.migration_limit,

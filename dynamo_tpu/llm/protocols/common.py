@@ -87,6 +87,10 @@ class PreprocessedRequest:
     # [{"type": "image_url", "url": ..., "position": <token offset>}].
     # Engines without multimodal support must REJECT, not silently drop.
     multimodal: Optional[List[Dict[str, Any]]] = None
+    # guided-decoding spec ({"kind": "regex"|"choice"|"json_schema"|
+    # "json_object", ...}) normalized from response_format / nvext by
+    # llm/guided.extract_guided_spec; engines compile it to a token FSM
+    guided: Optional[Dict[str, Any]] = None
 
     def to_dict(self) -> dict:
         d = {
@@ -109,6 +113,8 @@ class PreprocessedRequest:
             d["embed"] = True
         if self.multimodal:
             d["multimodal"] = self.multimodal
+        if self.guided:
+            d["guided"] = self.guided
         return d
 
     @classmethod

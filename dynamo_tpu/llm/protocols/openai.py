@@ -25,6 +25,12 @@ class NvExt(BaseModel):
     annotations: Optional[List[str]] = None  # e.g. ["kv_hit_rate", "worker_id"]
     backend_instance_id: Optional[int] = None  # pin to a worker
     router_config_override: Optional[Dict[str, Any]] = None
+    # guided decoding (reference nvext.rs:73-88); enforced natively by the
+    # JAX engine via token-level FSM logit masks (llm/guided.py)
+    guided_json: Optional[Union[Dict[str, Any], str]] = None
+    guided_regex: Optional[str] = None
+    guided_choice: Optional[List[str]] = None
+    guided_grammar: Optional[str] = None  # EBNF: rejected with 400 (unsupported)
 
 
 class FunctionCall(BaseModel):

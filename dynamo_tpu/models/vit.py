@@ -1,0 +1,252 @@
+"""Vision Transformer encoder in JAX — the real E of multimodal E/P/D.
+
+Replaces MockVisionEncoder behind the encode endpoint (llm/multimodal.py;
+the mock stays for tests). Architecture matches HF `ViTModel` semantics
+(CLS token, learned position embeddings, pre-LN blocks, GELU MLP) so
+HF-exported checkpoints load directly — same param-loading discipline as
+models/llama.py (random-init tree shape == checkpoint shape; the loader
+maps safetensors/torch state dicts onto it). A LLaVA-style two-layer
+projector maps patch tokens to the LLM hidden width for the engine's
+prefill splice (engine/_prefill_batch_mm).
+
+Reference parity: the trtllm multimodal processor runs the HF vision
+tower on GPU (components/backends/trtllm/src/dynamo/trtllm/
+multimodal_processor.py); here the tower is jitted JAX on the TPU's MXU
+(patch embed as one big matmul, fused attention over a handful of
+tokens).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_channels: int = 3
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    layer_norm_eps: float = 1e-12
+    out_hidden: int = 768  # LLM hidden width the projector emits
+    dtype: Any = jnp.float32
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @classmethod
+    def tiny(cls, **overrides):
+        """CPU-test scale (mirrors LlamaConfig.tiny)."""
+        kw = dict(
+            image_size=32,
+            patch_size=8,
+            hidden_size=64,
+            num_layers=2,
+            num_heads=4,
+            intermediate_size=128,
+            out_hidden=64,
+        )
+        kw.update(overrides)
+        return cls(**kw)
+
+
+def init_params(config: ViTConfig, key: jax.Array) -> Dict[str, Any]:
+    """Random-init tree, shape-compatible with HF ViTModel weights
+    (loader.load_vit_params maps checkpoints onto the same tree)."""
+    c = config
+    scale = 0.02
+    ks = jax.random.split(key, 6 + c.num_layers)
+
+    def dense(k, shape):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(c.dtype)
+
+    patch_dim = c.num_channels * c.patch_size * c.patch_size
+    layers = []
+    for lk in ks[6:]:
+        k1, k2, k3, k4, k5, k6 = jax.random.split(lk, 6)
+        layers.append({
+            "ln1": {"w": jnp.ones((c.hidden_size,), c.dtype),
+                    "b": jnp.zeros((c.hidden_size,), c.dtype)},
+            "wq": dense(k1, (c.hidden_size, c.hidden_size)),
+            "bq": jnp.zeros((c.hidden_size,), c.dtype),
+            "wk": dense(k2, (c.hidden_size, c.hidden_size)),
+            "bk": jnp.zeros((c.hidden_size,), c.dtype),
+            "wv": dense(k3, (c.hidden_size, c.hidden_size)),
+            "bv": jnp.zeros((c.hidden_size,), c.dtype),
+            "wo": dense(k4, (c.hidden_size, c.hidden_size)),
+            "bo": jnp.zeros((c.hidden_size,), c.dtype),
+            "ln2": {"w": jnp.ones((c.hidden_size,), c.dtype),
+                    "b": jnp.zeros((c.hidden_size,), c.dtype)},
+            "w_up": dense(k5, (c.hidden_size, c.intermediate_size)),
+            "b_up": jnp.zeros((c.intermediate_size,), c.dtype),
+            "w_down": dense(k6, (c.intermediate_size, c.hidden_size)),
+            "b_down": jnp.zeros((c.hidden_size,), c.dtype),
+        })
+    return {
+        # patch embed: HF's Conv2d(stride=patch) == matmul over flattened
+        # (C, ph, pw) patches — one MXU-shaped GEMM instead of a conv
+        "patch_w": dense(ks[0], (patch_dim, c.hidden_size)),
+        "patch_b": jnp.zeros((c.hidden_size,), c.dtype),
+        "cls": dense(ks[1], (1, 1, c.hidden_size)),
+        "pos": dense(ks[2], (1, c.n_patches + 1, c.hidden_size)),
+        "layers": layers,
+        "ln_f": {"w": jnp.ones((c.hidden_size,), c.dtype),
+                 "b": jnp.zeros((c.hidden_size,), c.dtype)},
+        # LLaVA-style projector to the LLM embedding width
+        "proj": {
+            "w1": dense(ks[3], (c.hidden_size, c.out_hidden)),
+            "b1": jnp.zeros((c.out_hidden,), c.dtype),
+            "w2": dense(ks[4], (c.out_hidden, c.out_hidden)),
+            "b2": jnp.zeros((c.out_hidden,), c.dtype),
+        },
+    }
+
+
+def _ln(x, p, eps):
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mu) / jnp.sqrt(var + eps) * p["w"] + p["b"]
+
+
+def forward(params: Dict[str, Any], config: ViTConfig, pixels: jax.Array) -> jax.Array:
+    """pixels [B, C, H, W] (HF layout) → last hidden state
+    [B, n_patches + 1, hidden] (CLS first), post final-LN — matches HF
+    ViTModel.last_hidden_state."""
+    c = config
+    B = pixels.shape[0]
+    P, nc = c.patch_size, c.num_channels
+    n_side = c.image_size // P
+    # [B, C, H, W] → [B, n_side, n_side, C, P, P] → [B, N, C*P*P]
+    x = pixels.reshape(B, nc, n_side, P, n_side, P)
+    x = x.transpose(0, 2, 4, 1, 3, 5).reshape(B, n_side * n_side, nc * P * P)
+    x = x.astype(c.dtype) @ params["patch_w"] + params["patch_b"]
+    cls = jnp.broadcast_to(params["cls"], (B, 1, c.hidden_size)).astype(c.dtype)
+    x = jnp.concatenate([cls, x], axis=1) + params["pos"]
+
+    H, D = c.num_heads, c.head_dim
+    T = x.shape[1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(D, c.dtype))
+    for lyr in params["layers"]:
+        h = _ln(x, lyr["ln1"], c.layer_norm_eps)
+        q = (h @ lyr["wq"] + lyr["bq"]).reshape(B, T, H, D)
+        k = (h @ lyr["wk"] + lyr["bk"]).reshape(B, T, H, D)
+        v = (h @ lyr["wv"] + lyr["bv"]).reshape(B, T, H, D)
+        att = jnp.einsum("bthd,bshd->bhts", q, k) * scale
+        att = jax.nn.softmax(att.astype(jnp.float32), axis=-1).astype(c.dtype)
+        o = jnp.einsum("bhts,bshd->bthd", att, v).reshape(B, T, H * D)
+        x = x + (o @ lyr["wo"] + lyr["bo"])
+        h = _ln(x, lyr["ln2"], c.layer_norm_eps)
+        h = jax.nn.gelu(h @ lyr["w_up"] + lyr["b_up"], approximate=False)
+        x = x + (h @ lyr["w_down"] + lyr["b_down"])
+    return _ln(x, params["ln_f"], c.layer_norm_eps)
+
+
+def encode_tokens(params: Dict[str, Any], config: ViTConfig, pixels: jax.Array) -> jax.Array:
+    """Full encoder: ViT → drop CLS → projector. [B, C, H, W] →
+    [B, n_patches, out_hidden] — the rows the engine splices over
+    placeholder positions."""
+    h = forward(params, config, pixels)[:, 1:]
+    p = params["proj"]
+    h = jax.nn.gelu(h @ p["w1"] + p["b1"], approximate=False)
+    return h @ p["w2"] + p["b2"]
+
+
+# --------------------------------------------------------------------- #
+# HF checkpoint mapping (loader discipline: models/loader.py)
+# --------------------------------------------------------------------- #
+
+def params_from_hf_state(state: Dict[str, np.ndarray], config: ViTConfig,
+                         prefix: str = "") -> Dict[str, Any]:
+    """Map an HF ViTModel state dict (torch tensors or numpy) onto the
+    init_params tree. `prefix` handles nesting (e.g. "vit." for
+    ViTForImageClassification exports). The projector is NOT part of HF
+    ViT — absent keys leave it random-init (train/load separately)."""
+    c = config
+
+    def get(name):
+        t = state[prefix + name]
+        arr = t.numpy() if hasattr(t, "numpy") else np.asarray(t)
+        return jnp.asarray(arr, c.dtype)
+
+    conv_w = get("embeddings.patch_embeddings.projection.weight")
+    # Conv2d [hidden, C, P, P] → matmul [(C, P, P) flat, hidden]; the
+    # flatten order must match forward()'s (C, ph, pw) patch layout
+    patch_w = jnp.transpose(conv_w.reshape(c.hidden_size, -1))
+    params = {
+        "patch_w": patch_w,
+        "patch_b": get("embeddings.patch_embeddings.projection.bias"),
+        "cls": get("embeddings.cls_token"),
+        "pos": get("embeddings.position_embeddings"),
+        "ln_f": {"w": get("layernorm.weight"), "b": get("layernorm.bias")},
+        "layers": [],
+    }
+    for i in range(c.num_layers):
+        p = f"encoder.layer.{i}."
+        lin = lambda n: jnp.transpose(get(p + n + ".weight"))  # noqa: E731
+        bias = lambda n: get(p + n + ".bias")  # noqa: E731
+        params["layers"].append({
+            "ln1": {"w": get(p + "layernorm_before.weight"),
+                    "b": get(p + "layernorm_before.bias")},
+            "wq": lin("attention.attention.query"),
+            "bq": bias("attention.attention.query"),
+            "wk": lin("attention.attention.key"),
+            "bk": bias("attention.attention.key"),
+            "wv": lin("attention.attention.value"),
+            "bv": bias("attention.attention.value"),
+            "wo": lin("attention.output.dense"),
+            "bo": bias("attention.output.dense"),
+            "ln2": {"w": get(p + "layernorm_after.weight"),
+                    "b": get(p + "layernorm_after.bias")},
+            "w_up": lin("intermediate.dense"),
+            "b_up": bias("intermediate.dense"),
+            "w_down": lin("output.dense"),
+            "b_down": bias("output.dense"),
+        })
+    # projector: checkpoint-provided (LLaVA-style exports) or random
+    rng_params = init_params(c, jax.random.PRNGKey(0))
+    proj = rng_params["proj"]
+    for ours, theirs in (("w1", "proj.w1"), ("b1", "proj.b1"),
+                         ("w2", "proj.w2"), ("b2", "proj.b2")):
+        if prefix + theirs in state:
+            proj[ours] = get(theirs)
+    params["proj"] = proj
+    return params
+
+
+def load_vit_params(model_dir: str, config: ViTConfig) -> Dict[str, Any]:
+    """Load an HF ViT export (safetensors or pytorch_model.bin) from a
+    local directory — same resolve discipline as load_llama_params."""
+    from pathlib import Path
+
+    d = Path(model_dir)
+    state: Dict[str, np.ndarray] = {}
+    sts = sorted(d.glob("*.safetensors"))
+    if sts:
+        from safetensors.numpy import load_file
+
+        for f in sts:
+            state.update(load_file(str(f)))
+    else:
+        import torch
+
+        bins = sorted(d.glob("*.bin"))
+        if not bins:
+            raise FileNotFoundError(f"no ViT weights under {model_dir}")
+        for f in bins:
+            state.update(torch.load(str(f), map_location="cpu",
+                                    weights_only=True))
+    prefix = "vit." if any(k.startswith("vit.") for k in state) else ""
+    return params_from_hf_state(state, config, prefix=prefix)

@@ -1,0 +1,341 @@
+"""Guided decoding: regex/schema→DFA→token-FSM units, preprocessor 400s,
+and end-to-end engine enforcement (CPU, tiny model).
+
+Reference surface: nvext guided_choice/guided_regex/guided_json
+(lib/llm/src/protocols/openai/nvext.rs:73-88) + OpenAI response_format.
+The engine must produce constraint-valid output UNDER SAMPLING (not just
+greedy), and unguided traffic sharing the batch must be unaffected.
+"""
+
+import asyncio
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine import EngineConfig, JaxEngine
+from dynamo_tpu.llm import guided as g
+from dynamo_tpu.llm.protocols import PreprocessedRequest
+from dynamo_tpu.llm.protocols.openai import ChatCompletionRequest, NvExt
+from dynamo_tpu.llm.tokenizers import ByteTokenizer
+from dynamo_tpu.models import llama
+from dynamo_tpu.runtime.engine import Context
+
+CFG = llama.LlamaConfig.tiny(dtype=jnp.float32)
+PAGE = 8
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init_params(CFG, jax.random.PRNGKey(0))
+
+
+# --------------------------------------------------------------------- #
+# DFA / regex units
+# --------------------------------------------------------------------- #
+
+
+def test_regex_dfa_basics():
+    d = g.compile_regex("(yes|no)")
+    assert d.fullmatch("yes") and d.fullmatch("no")
+    assert not d.fullmatch("maybe") and not d.fullmatch("ye")
+    d = g.compile_regex("[a-c]+x?")
+    assert d.fullmatch("abc") and d.fullmatch("abx")
+    assert not d.fullmatch("abd") and not d.fullmatch("")
+    d = g.compile_regex("a{2,4}")
+    assert not d.fullmatch("a") and d.fullmatch("aa") and d.fullmatch("aaaa")
+    assert not d.fullmatch("aaaaa")
+    d = g.compile_regex(r"\d+(\.\d+)?")
+    assert d.fullmatch("42") and d.fullmatch("3.14") and not d.fullmatch("3.")
+
+
+def test_regex_dfa_negated_and_other():
+    # negated class admits chars outside the explicit alphabet
+    d = g.compile_regex(r'"[^"]*"')
+    assert d.fullmatch('"héllo wörld"') and not d.fullmatch('"a"b"')
+
+
+def test_json_string_regex_rejects_raw_control_and_bad_escapes():
+    d = g.compile_regex(g._STRING)
+    assert d.fullmatch('"hello"') and d.fullmatch('"a\\"b"')
+    assert d.fullmatch('"\\u00e9"') and d.fullmatch('"\\\\"')
+    assert not d.fullmatch('"a\tb"')  # raw control char
+    assert not d.fullmatch('"\\q"')  # illegal escape
+    assert not d.fullmatch('"oops')
+
+
+def test_schema_to_regex_object():
+    sch = {
+        "type": "object",
+        "properties": {
+            "name": {"type": "string"},
+            "age": {"type": "integer"},
+            "tags": {"type": "array", "items": {"type": "string"},
+                     "maxItems": 3},
+        },
+    }
+    d = g.compile_regex(g.schema_to_regex(sch))
+    assert d.fullmatch(json.dumps({"name": "bo", "age": 3, "tags": ["a"]}))
+    assert d.fullmatch('{ "name": "x", "age": -12, "tags": [] }')
+    assert not d.fullmatch('{"name": 3, "age": 1, "tags": []}')  # wrong type
+    assert not d.fullmatch('{"age": 1}')  # missing property
+
+
+def test_schema_enum_const_union():
+    d = g.compile_regex(g.schema_to_regex({"enum": ["red", "green", 7]}))
+    assert d.fullmatch('"red"') and d.fullmatch("7")
+    assert not d.fullmatch('"blue"')
+    d = g.compile_regex(g.schema_to_regex({"const": {"k": 1}}))
+    assert d.fullmatch('{"k": 1}')
+    d = g.compile_regex(g.schema_to_regex({"type": ["integer", "null"]}))
+    assert d.fullmatch("-3") and d.fullmatch("null") and not d.fullmatch('"x"')
+
+
+def test_free_json_value_bounded_depth():
+    d = g.compile_regex(g._free_value(3))
+    for s in ['{"a": [1, 2, {"b": null}]}', "[]", '"x"', "3.5e-2",
+              '{"k": {"j": true}}']:
+        assert d.fullmatch(s), s
+    assert not d.fullmatch('{"a": }')
+
+
+def test_token_fsm_masks_and_eos():
+    tok = ByteTokenizer()
+    fsm = g.GuidedCompiler(tok).compile(
+        {"kind": "choice", "choices": ["yes", "no"]}
+    )
+    st = fsm.start_state
+    first = {tok.decode([i]) for i in np.nonzero(fsm.allowed(st))[0]}
+    assert first == {"y", "n"}
+    for ch in "yes":
+        tid = tok.encode(ch)[0]
+        assert fsm.allowed(st)[tid]
+        st = fsm.advance(st, tid)
+    assert fsm.is_accepting(st)
+    # at accept with no continuation: only EOS admissible
+    m = fsm.allowed(st)
+    assert all(m[e] for e in fsm.eos_ids)
+    assert m.sum() == len(fsm.eos_ids)
+
+
+def test_token_fsm_constrained_random_walk_yields_valid_json():
+    tok = ByteTokenizer()
+    fsm = g.GuidedCompiler(tok).compile({
+        "kind": "json_schema",
+        "schema": {"type": "object", "properties": {
+            "ok": {"type": "boolean"}, "col": {"enum": ["red", "green"]},
+        }},
+    })
+    rng = np.random.RandomState(7)
+    for _ in range(3):
+        st, out = fsm.start_state, []
+        for _ in range(300):
+            m = fsm.allowed(st)
+            t = int(rng.choice(np.nonzero(m)[0]))
+            if t in fsm.eos_ids:
+                if fsm.is_accepting(st):
+                    break
+                continue
+            out.append(t)
+            st = fsm.advance(st, t)
+        obj = json.loads(tok.decode(out))
+        assert set(obj) == {"ok", "col"}
+        assert isinstance(obj["ok"], bool) and obj["col"] in ("red", "green")
+
+
+# --------------------------------------------------------------------- #
+# request-surface validation (→ HTTP 400 via the service's ValueError map)
+# --------------------------------------------------------------------- #
+
+
+def _chat(**kw):
+    return ChatCompletionRequest(
+        model="m", messages=[{"role": "user", "content": "hi"}], **kw
+    )
+
+
+def test_extract_guided_spec_surface():
+    assert g.extract_guided_spec(None, None) is None
+    assert g.extract_guided_spec({"type": "text"}, None) is None
+    assert g.extract_guided_spec({"type": "json_object"}, None) == {
+        "kind": "json_object"
+    }
+    spec = g.extract_guided_spec(
+        {"type": "json_schema",
+         "json_schema": {"schema": {"type": "integer"}}}, None,
+    )
+    assert spec == {"kind": "json_schema", "schema": {"type": "integer"}}
+    nv = NvExt(guided_choice=["a", "b"])
+    assert g.extract_guided_spec(None, nv) == {
+        "kind": "choice", "choices": ["a", "b"]
+    }
+    with pytest.raises(ValueError):
+        g.extract_guided_spec({"type": "weird"}, None)
+    with pytest.raises(ValueError):
+        g.extract_guided_spec(None, NvExt(guided_grammar="root ::= x"))
+    with pytest.raises(ValueError):  # conflicting constraints
+        g.extract_guided_spec(
+            {"type": "json_object"}, NvExt(guided_regex="a+")
+        )
+    with pytest.raises(ValueError):  # schema missing
+        g.extract_guided_spec({"type": "json_schema"}, None)
+
+
+def test_preprocessor_rejects_unsupported_knobs():
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+
+    card = ModelDeploymentCard(name="m", tokenizer="byte", context_length=512)
+    pre = OpenAIPreprocessor(card, ByteTokenizer())
+    with pytest.raises(ValueError, match="logit_bias"):
+        pre.preprocess_chat(_chat(logit_bias={"5": 1.0}))
+    with pytest.raises(ValueError, match="n > 1"):
+        pre.preprocess_chat(_chat(n=3))
+    with pytest.raises(ValueError, match="guided_grammar"):
+        pre.preprocess_chat(_chat(nvext=NvExt(guided_grammar="g")))
+    # valid guided request lands in the preprocessed payload
+    out = pre.preprocess_chat(_chat(response_format={"type": "json_object"}))
+    assert out.guided == {"kind": "json_object"}
+    assert "guided" in out.to_dict()
+
+
+# --------------------------------------------------------------------- #
+# engine enforcement (CPU, tiny model, REAL sampling)
+# --------------------------------------------------------------------- #
+
+
+def _engine(params, **kw):
+    cfg = EngineConfig(
+        model="tiny",
+        max_num_seqs=4,
+        page_size=PAGE,
+        num_pages=64,
+        max_model_len=256,
+        prefill_buckets=(16, 32),
+        max_prefill_chunk=32,
+        **kw,
+    )
+    return JaxEngine(cfg, model_config=CFG, params=params)
+
+
+async def _collect(eng, req):
+    toks, finish = [], None
+    async for item in eng.generate(req, Context()):
+        data = item.get("data")
+        if data:
+            toks.extend(data["token_ids"])
+            finish = data.get("finish_reason") or finish
+        if item.get("event") == "error":
+            return None, " ".join(item.get("comment") or [])
+    return toks, finish
+
+
+def test_engine_guided_choice_under_sampling(params):
+    async def main():
+        eng = _engine(params)
+        tok = ByteTokenizer(CFG.vocab_size)
+        outs = []
+        for seed in range(3):
+            req = PreprocessedRequest(
+                token_ids=[5, 9, 17, 33],
+                stop_conditions={"max_tokens": 32},
+                sampling_options={"temperature": 1.0, "seed": seed},
+                eos_token_ids=[ByteTokenizer.EOS],
+                guided={"kind": "choice",
+                        "choices": ["yes", "no", "maybe"]},
+                request_id=f"gc{seed}",
+            ).to_dict()
+            toks, finish = await _collect(eng, req)
+            assert toks is not None, finish
+            text = tok.decode(toks)
+            assert text in ("yes", "no", "maybe"), repr(text)
+            assert finish == "eos"
+            outs.append(text)
+        await eng.close()
+        return outs
+
+    asyncio.run(main())
+
+
+def test_engine_guided_json_schema_under_sampling(params):
+    async def main():
+        eng = _engine(params)
+        tok = ByteTokenizer(CFG.vocab_size)
+        req = PreprocessedRequest(
+            token_ids=[11, 4, 200],
+            stop_conditions={"max_tokens": 120},
+            sampling_options={"temperature": 1.0},
+            eos_token_ids=[ByteTokenizer.EOS],
+            guided={"kind": "json_schema", "schema": {
+                "type": "object", "properties": {
+                    "ok": {"type": "boolean"},
+                    "col": {"enum": ["red", "green"]},
+                },
+            }},
+            request_id="gj",
+        ).to_dict()
+        toks, finish = await _collect(eng, req)
+        assert toks is not None, finish
+        text = tok.decode(toks)
+        assert finish == "eos", (finish, text)
+        obj = json.loads(text)
+        assert set(obj) == {"ok", "col"}
+        assert isinstance(obj["ok"], bool) and obj["col"] in ("red", "green")
+        await eng.close()
+
+    asyncio.run(main())
+
+
+def test_engine_guided_and_unguided_coexist(params):
+    """A guided lane must not perturb a concurrent unguided GREEDY lane:
+    its tokens must equal the engine's unguided-only greedy output."""
+
+    async def run(with_guided):
+        eng = _engine(params)
+        prompt = [5, 9, 17, 33, 101, 7, 250, 3]
+        greedy = PreprocessedRequest(
+            token_ids=prompt,
+            stop_conditions={"max_tokens": 8, "ignore_eos": True},
+            request_id="plain",
+        ).to_dict()
+        tasks = [_collect(eng, greedy)]
+        if with_guided:
+            tasks.append(_collect(eng, PreprocessedRequest(
+                token_ids=[8, 8, 8],
+                stop_conditions={"max_tokens": 24},
+                sampling_options={"temperature": 1.0},
+                eos_token_ids=[ByteTokenizer.EOS],
+                guided={"kind": "choice", "choices": ["yes", "no"]},
+                request_id="g",
+            ).to_dict()))
+        results = await asyncio.gather(*tasks)
+        await eng.close()
+        return results
+
+    async def main():
+        (plain_only,) = await run(False)
+        both = await run(True)
+        assert both[0][0] == plain_only[0], "guided lane perturbed greedy lane"
+        tok = ByteTokenizer(CFG.vocab_size)
+        assert tok.decode(both[1][0]) in ("yes", "no")
+
+    asyncio.run(main())
+
+
+def test_engine_rejects_guided_on_spec_mode(params):
+    async def main():
+        eng = _engine(params, spec_mode="ngram")
+        req = PreprocessedRequest(
+            token_ids=[5, 9],
+            stop_conditions={"max_tokens": 8},
+            eos_token_ids=[ByteTokenizer.EOS],
+            guided={"kind": "regex", "regex": "a+"},
+            request_id="gs",
+        ).to_dict()
+        toks, err = await _collect(eng, req)
+        assert toks is None and "speculative" in err
+        await eng.close()
+
+    asyncio.run(main())
