@@ -153,8 +153,19 @@ class LocalGraphBackend:
 
     def __init__(self, env: Optional[dict] = None, python: Optional[str] = None):
         self._procs: Dict[str, List[subprocess.Popen]] = {}
+        self._cmds: Dict[str, tuple] = {}
+        self._reap: List[subprocess.Popen] = []  # terminated, await wait()
         self.env = env
         self.python = python or sys.executable
+
+    def _reap_terminated(self):
+        """Collect exited replicas we previously terminate()d (zombie
+        prevention); survivors stay queued for shutdown()'s escalation."""
+        still = []
+        for p in self._reap:
+            if p.poll() is None:
+                still.append(p)
+        self._reap = still
 
     def _spawn(self, svc: ServiceSpec) -> subprocess.Popen:
         cmd = [self.python, "-m", svc.module, *svc.args]
@@ -164,6 +175,21 @@ class LocalGraphBackend:
 
     async def apply(self, graph: GraphSpec) -> None:
         for svc in graph.services:
+            # rollout: a TEMPLATE change (module/args), not just a replica
+            # change, replaces every running replica — the subprocess
+            # analogue of a Deployment pod-template rollout
+            cmd = tuple(svc.command())
+            if self._cmds.get(svc.name) not in (None, cmd):
+                stale = self._procs.pop(svc.name, [])
+                for p in stale:
+                    if p.poll() is None:
+                        p.terminate()
+                        self._reap.append(p)
+                logger.info(
+                    "graph %s: rolling %s (%d stale replicas terminated)",
+                    graph.name, svc.name, len(stale),
+                )
+            self._cmds[svc.name] = cmd
             pool = [p for p in self._procs.get(svc.name, []) if p.poll() is None]
             while len(pool) < svc.replicas:
                 pool.append(self._spawn(svc))
@@ -172,9 +198,11 @@ class LocalGraphBackend:
             while len(pool) > svc.replicas:
                 p = pool.pop()
                 p.terminate()
+                self._reap.append(p)
                 logger.info("graph %s: stopped %s replica (%d/%d)",
                             graph.name, svc.name, len(pool), svc.replicas)
             self._procs[svc.name] = pool
+        self._reap_terminated()
 
     def replica_counts(self) -> Dict[str, int]:
         return {
@@ -183,17 +211,167 @@ class LocalGraphBackend:
         }
 
     def shutdown(self) -> None:
-        for pool in self._procs.values():
+        pools = list(self._procs.values()) + [self._reap]
+        for pool in pools:
             for p in pool:
                 if p.poll() is None:
                     p.terminate()
-        for pool in self._procs.values():
+        for pool in pools:
             for p in pool:
                 try:
                     p.wait(timeout=3)
                 except subprocess.TimeoutExpired:
                     p.kill()
+                    p.wait(timeout=3)
         self._procs.clear()
+        self._reap = []
+
+
+class GraphController:
+    """Controller semantics over a graph backend — the part of the
+    reference operator the round-4 review flagged as missing
+    (dynamographdeployment_controller.go): status conditions with
+    transitions, observedGeneration writeback, rollout on template
+    change (delegated to the backend's apply), and exponential failure
+    backoff instead of hot-looping a broken spec.
+
+    `status()` returns the CR-status-shaped dict; backends exposing
+    `patch_status` (kubectl) get it written back after every reconcile.
+    """
+
+    BACKOFF_BASE_S = 2.0
+    BACKOFF_MAX_S = 60.0
+
+    def __init__(self, backend, now=None):
+        import time as _time
+
+        self.backend = backend
+        self.now = now or _time.monotonic
+        self._conditions: Dict[str, dict] = {}
+        self._observed_generation = 0
+        self._failures = 0
+        self._retry_at = 0.0
+        self._last_graph: Optional[GraphSpec] = None
+
+    # -- conditions ----------------------------------------------------- #
+
+    def _set_condition(self, ctype: str, status: str, reason: str,
+                       message: str = ""):
+        import time as _time
+
+        cur = self._conditions.get(ctype)
+        if cur and cur["status"] == status and cur["reason"] == reason:
+            cur["message"] = message
+            return
+        self._conditions[ctype] = {
+            "type": ctype,
+            "status": status,
+            "reason": reason,
+            "message": message,
+            # k8s-conventional RFC3339 (self.now drives only the backoff
+            # clock and may be monotonic/fake)
+            "lastTransitionTime": _time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", _time.gmtime()
+            ),
+        }
+
+    def condition(self, ctype: str) -> Optional[dict]:
+        return self._conditions.get(ctype)
+
+    def status(self) -> dict:
+        st = {
+            "observedGeneration": self._observed_generation,
+            "conditions": sorted(
+                self._conditions.values(), key=lambda c: c["type"]
+            ),
+        }
+        counts = getattr(self.backend, "replica_counts", None)
+        if counts is not None:
+            st["services"] = counts()
+        return st
+
+    # -- reconcile ------------------------------------------------------ #
+
+    @property
+    def backoff_remaining(self) -> float:
+        return max(0.0, self._retry_at - self.now())
+
+    @property
+    def needs_retry(self) -> bool:
+        """True while the last apply failed — whether the backoff window
+        is still open (reconcile() will no-op) or has expired (reconcile()
+        will actually retry)."""
+        return self._failures > 0
+
+    async def reconcile(self, graph: GraphSpec, generation: int) -> bool:
+        """One reconcile pass. Returns True when the spec was applied,
+        False when skipped (failure backoff window). Raises nothing:
+        apply errors become the Degraded condition + backoff."""
+        if self._failures and self.now() < self._retry_at:
+            return False
+        self._set_condition(
+            "Progressing", "True", "Reconciling",
+            f"applying generation {generation}",
+        )
+        try:
+            await self.backend.apply(graph)
+        except Exception as e:  # noqa: BLE001 — apply errors become status
+            self._failures += 1
+            delay = min(
+                self.BACKOFF_BASE_S * (2 ** (self._failures - 1)),
+                self.BACKOFF_MAX_S,
+            )
+            self._retry_at = self.now() + delay
+            self._set_condition(
+                "Degraded", "True", "ApplyFailed",
+                f"{type(e).__name__}: {e} (retry in {delay:.0f}s)",
+            )
+            self._set_condition("Ready", "False", "ApplyFailed", str(e))
+            logger.warning("graph %s apply failed (%d consecutive): %s",
+                           graph.name, self._failures, e)
+            await self._write_status(graph)
+            return False
+        self._failures = 0
+        self._retry_at = 0.0
+        self._observed_generation = generation
+        self._last_graph = graph
+        self._set_condition("Degraded", "False", "ApplyOk")
+        self._set_condition(
+            "Progressing", "False", "ReconcileComplete",
+            f"generation {generation} applied",
+        )
+        ready, detail = self._readiness(graph)
+        self._set_condition(
+            "Ready", "True" if ready else "False",
+            "AllReplicasUp" if ready else "ReplicasPending", detail,
+        )
+        await self._write_status(graph)
+        return True
+
+    def _readiness(self, graph: GraphSpec):
+        counts_fn = getattr(self.backend, "replica_counts", None)
+        if counts_fn is None:
+            # backend can't observe replicas (plain kubectl apply):
+            # readiness is ownership of the applied spec
+            return True, "spec applied (backend does not report replicas)"
+        counts = counts_fn()
+        missing = {
+            s.name: (counts.get(s.name, 0), s.replicas)
+            for s in graph.services
+            if counts.get(s.name, 0) < s.replicas
+        }
+        if missing:
+            return False, f"pending: {missing}"
+        return True, f"{len(graph.services)} services at declared replicas"
+
+    async def _write_status(self, graph: GraphSpec):
+        patch = getattr(self.backend, "patch_status", None)
+        if patch is None:
+            return
+        try:
+            await patch(graph, self.status())
+        except Exception as e:  # noqa: BLE001 — status writeback best-effort
+            logger.warning("status writeback failed: %s", e)
 
 
 class KubectlGraphBackend:
@@ -222,3 +400,23 @@ class KubectlGraphBackend:
                 f"kubectl apply failed rc={proc.returncode}: {err.decode()!r}"
             )
         logger.info("applied graph %s: %s", graph.name, out.decode().strip())
+
+    async def patch_status(self, graph: GraphSpec, status: dict) -> None:
+        """Write the controller status back onto the CR's status
+        subresource (reference: controller-runtime Status().Update())."""
+        import json as _json
+
+        proc = await asyncio.create_subprocess_exec(
+            self.kubectl, "-n", graph.namespace, "patch",
+            f"dynamographdeployment/{graph.name}",
+            "--type=merge", "--subresource=status",
+            "-p", _json.dumps({"status": status}),
+            stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.PIPE,
+        )
+        out, err = await proc.communicate()
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"kubectl patch status failed rc={proc.returncode}: "
+                f"{err.decode()!r}"
+            )

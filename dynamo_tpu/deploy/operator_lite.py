@@ -124,14 +124,24 @@ class GraphReconciler(_PollLoop):
     OperatorLite)."""
 
     def __init__(self, discovery_client, graph, backend, poll_s: float = 2.0):
+        from dynamo_tpu.deploy.graph import GraphController
+
         super().__init__()
         self.client = discovery_client
         self.graph = graph
         self.backend = backend
+        self.controller = GraphController(backend)
         self.poll_s = poll_s
         self.applied_revision: Optional[int] = None
         self._applied_base = False
+        self.generation = 0  # bumps on every spec change (base or overlay)
         self.reconciles = 0
+
+    def set_graph(self, graph) -> None:
+        """Spec change (edited manifest): triggers a rollout on the next
+        reconcile (the backend replaces replicas whose template changed)."""
+        self.graph = graph
+        self._applied_base = False
 
     async def reconcile_once(self) -> bool:
         raw = await self.client.get(PLANNER_DECISION_KEY) if self.client else None
@@ -140,18 +150,30 @@ class GraphReconciler(_PollLoop):
             self.applied_revision is None or decision[0] > self.applied_revision
         )
         if self._applied_base and not fresh:
+            if self.controller.needs_retry:
+                # a previously failed apply retries once its backoff
+                # expires, even with no new spec/decision (reconcile()
+                # itself no-ops while the window is still open)
+                return await self.controller.reconcile(
+                    self.graph, self.generation
+                )
             return False
         target = self.graph
         if fresh:
             target = self.graph.with_planner_overlay(decision[1], decision[2])
-        await self.backend.apply(target)
+        self.generation += 1
+        ok = await self.controller.reconcile(target, self.generation)
+        if not ok:
+            self.generation -= 1  # not observed; retry keeps the number
+            return False
         if fresh:
             self.applied_revision = decision[0]
         self._applied_base = True
         self.reconciles += 1
         logger.info(
-            "reconciled graph %s (rev=%s): %s",
-            target.name, decision[0] if fresh else None,
+            "reconciled graph %s gen=%d (rev=%s): %s",
+            target.name, self.generation,
+            decision[0] if fresh else None,
             {s.name: s.replicas for s in target.services},
         )
         return True

@@ -18,7 +18,7 @@ from dynamo_tpu.runtime import DistributedRuntime, RuntimeConfig, init_logging
 logger = logging.getLogger("dynamo_tpu.encode_worker")
 
 
-def parse_args():
+def parse_args(argv=None):
     ap = argparse.ArgumentParser(description="dynamo-tpu encode worker (multimodal E/P/D)")
     ap.add_argument("--namespace", default="dynamo")
     ap.add_argument("--component", default="encoder")
@@ -38,7 +38,7 @@ def parse_args():
                          "random-init when omitted")
     ap.add_argument("--vit-size", choices=["tiny", "base"], default="tiny",
                     help="ViT architecture when no checkpoint config")
-    return ap.parse_args()
+    return ap.parse_args(argv)
 
 
 async def main():

@@ -43,7 +43,7 @@ import numpy as np
 
 from ..llm.mocker.kv_manager import KvEvent
 from ..llm.protocols import Annotated, LLMEngineOutput, PreprocessedRequest
-from ..llm.tokens import TokenBlockSequence, compute_seq_hashes
+from ..llm.tokens import TokenBlockSequence, compute_seq_hashes, salt_hash
 from ..models import llama
 from ..runtime.engine import Context
 from .config import EngineConfig
@@ -222,6 +222,7 @@ class _Slot:
     mm: Optional[List[tuple]] = None  # multimodal splices: (position, emb [n, H])
     guided_fsm: Optional[Any] = None  # llm/guided.TokenFsm (structured output)
     guided_state: int = 0  # current FSM state; advanced per emitted token
+    lora_idx: int = 0  # adapter slot in the engine's LoRA stack (0 = base)
 
 
 class JaxEngine:
@@ -367,6 +368,11 @@ class JaxEngine:
         self.tokenizer = None
         self._guided = None
         self.guided_requests = 0
+        # multi-LoRA (models/lora.py): stacked adapters in HBM + per-lane
+        # adapter index mirror (rides lora dispatch variants as an operand)
+        self._lora = None  # {"a": {...}, "b": {...}, "scale", "names"}
+        self.lora_idx = np.zeros((config.max_num_seqs,), np.int32)
+        self.lora_requests = 0
         # per-dispatch-type device occupancy: {tag: (count, seconds)} —
         # dispatches run serialized on the single device thread, so these
         # sum to device-stream busy time (the serving-gap diagnostic)
@@ -651,6 +657,27 @@ class JaxEngine:
 
         self._decode_step_guided = decode_step_guided
 
+        # guided + LoRA lanes decode-active TOGETHER: the masked single
+        # step must still apply the LoRA deltas, or the LoRA lane would
+        # silently generate (and write KV!) with the base model while a
+        # guided request is in flight
+        @partial(jax.jit, donate_argnums=(1, 2, 8), out_shardings=decode_out_sh)
+        def decode_step_guided_lora(params, kv_k, kv_v, tokens, positions,
+                                    seq_lens, page_tables, samp, rng,
+                                    mask_packed, lora):
+            rng, sub = jax.random.split(rng)
+            logits, kv_k, kv_v = self._model.decode_forward(
+                params, c, tokens, positions, kv_k, kv_v, page_tables,
+                seq_lens, lora=lora,
+            )
+            mask = unpack_mask(mask_packed, c.vocab_size)
+            nxt = sample(logits, samp, sub, mask=mask)
+            return (
+                nxt[None], nxt, positions + 1, seq_lens + 1, kv_k, kv_v, rng
+            )
+
+        self._decode_step_guided_lora = decode_step_guided_lora
+
         @partial(jax.jit, donate_argnums=(1, 2, 9), out_shardings=prefill_out_sh)
         def prefill_batch_guided(params, kv_k, kv_v, tokens, positions,
                                  page_tables, ctx_lens, last_idx, samp, rng,
@@ -665,6 +692,48 @@ class JaxEngine:
             return first, kv_k, kv_v, rng
 
         self._prefill_batch_guided = prefill_batch_guided
+
+        # multi-LoRA variants (models/lora.py): the adapter stack + per-lane
+        # index ride as operands; base-model lanes carry index 0 (the
+        # all-zero adapter — an exact no-op), so mixed batches need no
+        # masking. Lazy jits: compile only when adapters are registered and
+        # a LoRA request arrives. K-step fused blocks work unchanged —
+        # adapters are static per lane, unlike guided masks.
+        @partial(jax.jit, donate_argnums=(1, 2, 8), out_shardings=decode_out_sh)
+        def decode_block_lora(params, kv_k, kv_v, tokens, positions, seq_lens,
+                              page_tables, samp, rng, lora):
+            rng, sub = jax.random.split(rng)
+            keys = jax.random.split(sub, K)
+
+            def step(carry, key_j):
+                tokens, positions, seq_lens, kv_k, kv_v = carry
+                logits, kv_k, kv_v = self._model.decode_forward(
+                    params, c, tokens, positions, kv_k, kv_v, page_tables,
+                    seq_lens, lora=lora,
+                )
+                nxt = sample(logits, samp, key_j)
+                return (nxt, positions + 1, seq_lens + 1, kv_k, kv_v), nxt
+
+            (tokens, positions, seq_lens, kv_k, kv_v), toks = jax.lax.scan(
+                step, (tokens, positions, seq_lens, kv_k, kv_v), keys
+            )
+            return toks, tokens, positions, seq_lens, kv_k, kv_v, rng
+
+        self._decode_block_lora = decode_block_lora
+
+        @partial(jax.jit, donate_argnums=(1, 2, 9), out_shardings=prefill_out_sh)
+        def prefill_batch_lora(params, kv_k, kv_v, tokens, positions,
+                               page_tables, ctx_lens, last_idx, samp, rng,
+                               lora):
+            rng, sub = jax.random.split(rng)
+            logits, kv_k, kv_v = self._model.prefill_forward_batched(
+                params, c, tokens, positions, kv_k, kv_v, page_tables,
+                ctx_lens, last_idx, lora=lora,
+            )
+            first = sample(logits, samp, sub)
+            return first, kv_k, kv_v, rng
+
+        self._prefill_batch_lora = prefill_batch_lora
 
         # single-sequence prefill variants for the native parallel layouts
         # (SURVEY.md §2.5): ring attention over sp (long-context), layer
@@ -828,6 +897,19 @@ class JaxEngine:
             async for _ in self.generate(req, Context()):
                 pass
             n += 1
+        if self._lora is not None and self._lora["names"]:
+            # compile the LoRA prefill/decode variants with a registered
+            # adapter (same on-path-compile hazard as the guided variants)
+            isl = max(buckets[0] - 8, 4)
+            req = PreprocessedRequest(
+                token_ids=rng.randint(5, max(vocab - 1, 6), size=isl).tolist(),
+                stop_conditions={"max_tokens": K + 2, "ignore_eos": True},
+                sampling_options={"temperature": 1.0},
+                lora_name=next(iter(self._lora["names"])),
+            ).to_dict()
+            async for _ in self.generate(req, Context()):
+                pass
+            n += 1
         return n
 
     def _check_multimodal(self, req: PreprocessedRequest) -> Optional[str]:
@@ -885,6 +967,43 @@ class JaxEngine:
             self._guided = GuidedCompiler(tok)
         return self._guided
 
+    def register_adapters(self, adapters) -> None:
+        """Install LoRA adapters (models/lora.LoraAdapter list). The whole
+        stack is (re)built and uploaded; in-flight LoRA requests keep their
+        indices, so call this before serving or append-only."""
+        from ..models import lora as lora_mod
+        from ..models import moe
+
+        if isinstance(self.model_config, moe.MoeConfig):
+            raise ValueError("LoRA serving is not supported on MoE models yet")
+        self._lora = lora_mod.stack_adapters(self.model_config, list(adapters))
+
+    def lora_names(self) -> List[str]:
+        return list(self._lora["names"]) if self._lora else []
+
+    def _check_lora(self, req: PreprocessedRequest) -> Optional[str]:
+        if not req.lora_name:
+            return None
+        cfg = self.config
+        if self._lora is None or req.lora_name not in self._lora["names"]:
+            return (
+                f"unknown LoRA adapter {req.lora_name!r}; available: "
+                f"{sorted(self.lora_names())}"
+            )
+        if cfg.spec_mode:
+            return "LoRA is incompatible with speculative decoding (spec_mode)"
+        if cfg.pp_size > 1 or cfg.sp_size > 1:
+            return "LoRA is not supported on pp/sp layouts yet"
+        if cfg.decode_pool_mode == "local":
+            # the local-accumulator decode path has no LoRA hook yet; the
+            # lora block variant uses per-step pool scatter regardless
+            pass
+        if req.guided:
+            return "guided decoding with a LoRA adapter is not supported yet"
+        if req.multimodal:
+            return "LoRA with multimodal content parts is not supported yet"
+        return None
+
     def _check_guided(self, req: PreprocessedRequest) -> Optional[str]:
         """Validate + pre-compile a guided-decoding spec. Returns an error
         string (rejected request) or None. Like multimodal, silently
@@ -902,10 +1021,25 @@ class JaxEngine:
             return "guided decoding is not supported on pp/sp layouts yet"
         if req.multimodal:
             return "guided decoding cannot be combined with multimodal parts"
+        return None
+
+    async def _compile_guided_async(self, req: PreprocessedRequest) -> Optional[str]:
+        """Static checks + FSM compilation OFF the event loop (DFA subset
+        construction + the full-vocab trie walk are pure-Python and can
+        take seconds on a cold schema; in-flight streams must not stall)."""
+        err = self._check_guided(req)
+        if err is not None or not req.guided:
+            return err
         try:
-            self._guided_compiler().compile(req.guided)
+            fsm = await asyncio.to_thread(
+                self._guided_compiler().compile, req.guided
+            )
         except ValueError as e:
             return f"guided spec rejected: {e}"
+        # hand the FSM to _new_slot directly: an LRU eviction between the
+        # off-loop compile and slot creation must not re-run the compile
+        # ON the event loop
+        req._compiled_fsm = fsm
         return None
 
     def _guided_lane_mask(self, fsm, state: int) -> np.ndarray:
@@ -935,7 +1069,15 @@ class JaxEngine:
             eos_ids=list(req.eos_token_ids or []),
             ignore_eos=bool(stop.get("ignore_eos")),
             stop_token_ids=list(stop.get("stop_token_ids") or []),
-            seq=TokenBlockSequence(req.token_ids, self.config.page_size),
+            # the adapter name salts the hash chain (reference lora_id in
+            # protocols.rs:110-115): prefix cache / KVBM / router events all
+            # key on these hashes, so two adapters sharing a text prefix can
+            # never share KV
+            seq=TokenBlockSequence(
+                req.token_ids, self.config.page_size,
+                salt=salt_hash(req.lora_name.encode())
+                if req.lora_name else 0,
+            ),
         )
         slot.kv_prompt = slot.prompt
         slot.mm = self._slot_mm(req)
@@ -945,9 +1087,16 @@ class JaxEngine:
         slot.top_k = int(sampling.get("top_k") or 0)
         slot.top_p = float(sampling.get("top_p") or 1.0)
         if req.guided:
-            slot.guided_fsm = self._guided_compiler().compile(req.guided)
+            slot.guided_fsm = (
+                getattr(req, "_compiled_fsm", None)
+                or self._guided_compiler().compile(req.guided)
+            )
             slot.guided_state = slot.guided_fsm.start_state
             self.guided_requests += 1
+        if req.lora_name and self._lora is not None:
+            slot.lora_idx = self._lora["names"].get(req.lora_name, 0)
+            if slot.lora_idx:
+                self.lora_requests += 1
         if len(slot.prompt) + slot.max_tokens > self.config.max_model_len:
             slot.max_tokens = max(self.config.max_model_len - len(slot.prompt), 1)
         return slot
@@ -967,9 +1116,13 @@ class JaxEngine:
             # spliced at prefill instead (E/P/D flow, _prefill_batch_mm).
             yield Annotated.from_error(mm_err).to_dict()
             return
-        g_err = self._check_guided(req)
+        g_err = await self._compile_guided_async(req)
         if g_err is not None:
             yield Annotated.from_error(g_err).to_dict()
+            return
+        l_err = self._check_lora(req)
+        if l_err is not None:
+            yield Annotated.from_error(l_err).to_dict()
             return
         slot = self._new_slot(req, context)
         disagg = req.disagg_params or {}
@@ -1007,7 +1160,7 @@ class JaxEngine:
             if isinstance(request, PreprocessedRequest)
             else PreprocessedRequest.from_dict(request)
         )
-        g_err = self._check_guided(req)
+        g_err = await self._compile_guided_async(req) or self._check_lora(req)
         if g_err is not None:
             yield Annotated.from_error(g_err).to_dict()
             return
@@ -1045,7 +1198,7 @@ class JaxEngine:
             if isinstance(request, PreprocessedRequest)
             else PreprocessedRequest.from_dict(request)
         )
-        g_err = self._check_guided(req)
+        g_err = await self._compile_guided_async(req) or self._check_lora(req)
         if g_err is not None:
             yield Annotated.from_error(g_err).to_dict()
             return
@@ -1101,6 +1254,8 @@ class JaxEngine:
             out[f"dispatch_{tag}_s"] = round(tot, 3)
         if self.guided_requests:
             out["guided_requests"] = self.guided_requests
+        if self.lora_requests:
+            out["lora_requests"] = self.lora_requests
         if self.config.spec_mode:
             out["spec_num_drafts"] = self.spec_num_drafts
             out["spec_num_draft_tokens"] = self.spec_num_draft_tokens
@@ -1188,6 +1343,7 @@ class JaxEngine:
             self.temps[idx] = slot.temperature
             self.top_ks[idx] = slot.top_k
             self.top_ps[idx] = slot.top_p
+            self.lora_idx[idx] = slot.lora_idx
             slot.admit_seq = self._admit_counter = self._admit_counter + 1
             return True
         kv_prompt = slot.kv_prompt
@@ -1233,6 +1389,7 @@ class JaxEngine:
         self.temps[idx] = slot.temperature
         self.top_ks[idx] = slot.top_k
         self.top_ps[idx] = slot.top_p
+        self.lora_idx[idx] = slot.lora_idx
         slot.admit_seq = self._admit_counter = self._admit_counter + 1
         return True
 
@@ -1344,6 +1501,61 @@ class JaxEngine:
         )
         return first
 
+    def _lora_operand(self, idx):
+        return {
+            "a": self._lora["a"],
+            "b": self._lora["b"],
+            "scale": self._lora["scale"],
+            "idx": jnp.asarray(idx),
+        }
+
+    def _dev_prefill_lora(self, toks, positions, tables, ctx_lens, last_idx,
+                          temps, top_ks, top_ps, idx):
+        samp = SamplingParams(
+            temperature=jnp.asarray(temps),
+            top_k=jnp.asarray(top_ks),
+            top_p=jnp.asarray(top_ps),
+        )
+        first, self.kv_k, self.kv_v, self._rng = self._prefill_batch_lora(
+            self.params,
+            self.kv_k,
+            self.kv_v,
+            jnp.asarray(toks),
+            jnp.asarray(positions),
+            jnp.asarray(tables),
+            jnp.asarray(ctx_lens),
+            jnp.asarray(last_idx),
+            samp,
+            self._rng,
+            self._lora_operand(idx),
+        )
+        return first
+
+    def _dev_block_lora(self, idx):
+        carry = self._carry
+        (
+            toks,
+            tok_d,
+            pos_d,
+            sl_d,
+            self.kv_k,
+            self.kv_v,
+            self._rng,
+        ) = self._decode_block_lora(
+            self.params,
+            self.kv_k,
+            self.kv_v,
+            carry[0],
+            carry[1],
+            carry[2],
+            self._tables_dev,
+            self._samp_dev,
+            self._rng,
+            self._lora_operand(idx),
+        )
+        self._carry = (tok_d, pos_d, sl_d)
+        return toks
+
     def _dev_reset(self, tokens, positions, seq_lens, page_tables, temps,
                    top_ks, top_ps, hist=None):
         self._samp_dev = SamplingParams(
@@ -1417,28 +1629,23 @@ class JaxEngine:
         self._carry = (tok_d, pos_d, sl_d)
         return toks
 
-    def _dev_block_guided(self, mask):
+    def _dev_block_guided(self, mask, lora_idx=None):
         carry = self._carry
-        (
-            toks,
-            tok_d,
-            pos_d,
-            sl_d,
-            self.kv_k,
-            self.kv_v,
-            self._rng,
-        ) = self._decode_step_guided(
-            self.params,
-            self.kv_k,
-            self.kv_v,
-            carry[0],
-            carry[1],
-            carry[2],
-            self._tables_dev,
-            self._samp_dev,
-            self._rng,
+        args = (
+            self.params, self.kv_k, self.kv_v,
+            carry[0], carry[1], carry[2],
+            self._tables_dev, self._samp_dev, self._rng,
             jnp.asarray(mask),
         )
+        if lora_idx is not None:
+            out = self._decode_step_guided_lora(
+                *args, self._lora_operand(lora_idx)
+            )
+        else:
+            out = self._decode_step_guided(*args)
+        (
+            toks, tok_d, pos_d, sl_d, self.kv_k, self.kv_v, self._rng,
+        ) = out
         self._carry = (tok_d, pos_d, sl_d)
         return toks
 
@@ -1607,11 +1814,26 @@ class JaxEngine:
                         p["mask"],
                     )
                 )
+            elif tag == "prefill_lora":
+                await self._run_on_device(
+                    partial(
+                        self._dev_prefill_lora,
+                        p["toks"], p["positions"], p["tables"], p["ctx_lens"],
+                        p["last_idx"], p["temps"], p["top_ks"], p["top_ps"],
+                        p["idx"],
+                    )
+                )
             elif tag == "block":
                 await self._run_on_device(self._dev_block)
             elif tag == "block_guided":
                 await self._run_on_device(
-                    partial(self._dev_block_guided, p["mask"])
+                    partial(
+                        self._dev_block_guided, p["mask"], p.get("lora_idx")
+                    )
+                )
+            elif tag == "block_lora":
+                await self._run_on_device(
+                    partial(self._dev_block_lora, p["idx"])
                 )
             elif tag == "inject":
                 await self._run_on_device(
@@ -1903,18 +2125,25 @@ class JaxEngine:
         if not cands:
             return False
         cands.sort(key=lambda s: s.admit_seq)
-        # guided and multimodal slots never share a prefill batch: each
-        # rides its own dispatch variant (mask vs embedding splice); the
-        # excluded kind simply waits for the next dispatch
-        lead = cands[0]
-        if lead.guided_fsm is not None:
-            cands = [s for s in cands if s.mm is None]
-        elif lead.mm is not None:
-            cands = [s for s in cands if s.guided_fsm is None]
-        elif any(s.mm for s in cands) and any(
-            s.guided_fsm is not None for s in cands
-        ):
-            cands = [s for s in cands if s.guided_fsm is None]
+        # guided / multimodal / LoRA slots ride different dispatch variants
+        # (mask vs embedding splice vs adapter stack) and never share a
+        # prefill batch with each OTHER; plain slots batch with any single
+        # kind (they are exact no-ops under mask=all-true or adapter 0).
+        # The excluded kind simply waits for the next dispatch.
+        def _kind(s):
+            if s.mm is not None:
+                return "mm"
+            if s.guided_fsm is not None:
+                return "guided"
+            if s.lora_idx:
+                return "lora"
+            return "plain"
+
+        batch_kind = next(
+            (k for k in map(_kind, cands) if k != "plain"), "plain"
+        )
+        if batch_kind != "plain":
+            cands = [s for s in cands if _kind(s) in ("plain", batch_kind)]
 
         if self._prefill_single is not None:
             s0 = cands[0]
@@ -2037,6 +2266,26 @@ class JaxEngine:
                     self._dev_prefill_guided,
                     toks, positions, tables, ctx_lens, last_idx,
                     temps, top_ks, top_ps, mask,
+                ),
+                tag="prefill",
+            )
+        elif any(s.lora_idx for s in chosen):
+            lane_idx = np.zeros((B_pf,), np.int32)
+            for s, chunk, lane in meta:
+                lane_idx[lane] = s.lora_idx
+            self._bcast(
+                "prefill_lora",
+                {
+                    "toks": toks, "positions": positions, "tables": tables,
+                    "ctx_lens": ctx_lens, "last_idx": last_idx, "temps": temps,
+                    "top_ks": top_ks, "top_ps": top_ps, "idx": lane_idx,
+                },
+            )
+            first_dev = await self._run_on_device(
+                partial(
+                    self._dev_prefill_lora,
+                    toks, positions, tables, ctx_lens, last_idx,
+                    temps, top_ks, top_ps, lane_idx,
                 ),
                 tag="prefill",
             )
@@ -2556,11 +2805,26 @@ class JaxEngine:
                 packed[i] = np.packbits(
                     self._guided_lane_mask(s.guided_fsm, s.guided_state)
                 )
-            self._bcast("block_guided", {"mask": packed})
+            lora_idx = (
+                self.lora_idx.copy()
+                if any(self.slots[i].lora_idx for i in active) else None
+            )
+            payload = {"mask": packed}
+            if lora_idx is not None:
+                payload["lora_idx"] = lora_idx
+            self._bcast("block_guided", payload)
             toks_dev = await self._run_on_device(
-                partial(self._dev_block_guided, packed), tag="block_guided"
+                partial(self._dev_block_guided, packed, lora_idx),
+                tag="block_guided",
             )
             adv = 1
+        elif any(self.slots[i].lora_idx for i in active):
+            idx = self.lora_idx.copy()
+            self._bcast("block_lora", {"idx": idx})
+            toks_dev = await self._run_on_device(
+                partial(self._dev_block_lora, idx), tag="block_lora"
+            )
+            adv = cfg.block_advance
         else:
             self._bcast("block", {})
             toks_dev = await self._run_on_device(self._dev_block, tag="block")

@@ -39,6 +39,12 @@ def parse_args(argv=None):
                     help="KV-write strategy in the fused decode block "
                     "(local + unroll for multi-GB page pools)")
     ap.add_argument("--decode-block-unroll", type=int, default=1)
+    ap.add_argument("--lora", action="append", default=[],
+                    metavar="NAME=PATH",
+                    help="serve a LoRA adapter (HF PEFT export dir); "
+                         "repeatable. NAME=random:<seed> makes a random "
+                         "adapter (tests/demos). Select per request via "
+                         "nvext.lora_name.")
     ap.add_argument("--spec", choices=["ngram"], default=None,
                     help="speculative decoding: self-drafting prompt-lookup "
                     "verified in one pass (engine/spec.py)")
@@ -234,6 +240,27 @@ async def main():
         f"gguf:{gguf_path}" if gguf_path is not None
         else f"byte:{engine.model_config.vocab_size}"
     )
+    if args.lora:
+        import jax as _jax_lora
+
+        from dynamo_tpu.models import lora as lora_mod
+
+        adapters = []
+        for spec in args.lora:
+            name, _, src = spec.partition("=")
+            if not src:
+                raise SystemExit(f"--lora expects NAME=PATH, got {spec!r}")
+            if src.startswith("random:"):
+                adapters.append(lora_mod.init_adapter(
+                    engine.model_config, name,
+                    _jax_lora.random.PRNGKey(int(src.split(":", 1)[1])),
+                ))
+            else:
+                adapters.append(lora_mod.load_peft_adapter(
+                    src, engine.model_config, name=name
+                ))
+        engine.register_adapters(adapters)
+        logger.info("LoRA adapters registered: %s", engine.lora_names())
 
     # KV data plane: prefill-capable workers stage finished prompts here;
     # under multi-host EVERY host (followers too) runs one, serving only its
@@ -401,6 +428,7 @@ async def main():
             kv_cache_block_size=args.page_size,
             context_length=args.context_length or args.max_model_len,
             migration_limit=args.migration_limit,
+            lora_adapters=engine.lora_names(),
         )
         await register_llm(endpoint, card)
 

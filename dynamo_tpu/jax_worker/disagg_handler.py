@@ -15,7 +15,7 @@ from typing import Any, AsyncIterator
 
 from dynamo_tpu.llm.disagg import DisaggregatedRouter, unpack_kv_payload
 from dynamo_tpu.llm.protocols import Annotated, LLMEngineOutput
-from dynamo_tpu.llm.tokens import compute_seq_hashes
+from dynamo_tpu.llm.tokens import compute_seq_hashes, salt_hash
 from dynamo_tpu.runtime.engine import Context
 from dynamo_tpu.runtime.push_router import PushRouter, RouterMode
 from dynamo_tpu.runtime.request_plane import EngineError, StreamLost
@@ -32,7 +32,15 @@ async def maybe_remote_prefill(
 ) -> AsyncIterator[Any]:
     prompt = request.get("token_ids") or []
     page_size = engine.config.page_size
-    hashes = compute_seq_hashes(prompt, page_size)
+    # LoRA requests live on an adapter-salted hash chain (llm/tokens.py):
+    # the cached-prefix probe must consult the SAME chain the engine's
+    # prefix cache keys on, or the local/remote decision is wrong in both
+    # directions for adapter traffic
+    salt = (
+        salt_hash(request["lora_name"].encode())
+        if request.get("lora_name") else 0
+    )
+    hashes = compute_seq_hashes(prompt, page_size, salt)
     n_cached = len(engine.allocator.cached_prefix(hashes))
     if engine.kvbm is not None and n_cached < len(hashes):
         # blocks held in KVBM tiers — local, OR announced by a peer (G4

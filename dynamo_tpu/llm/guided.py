@@ -665,13 +665,20 @@ def vocab_strings(tokenizer) -> List[str]:
 
 
 class GuidedCompiler:
-    """Spec → TokenFsm with caching (FSM compiles cost a vocab-trie walk;
-    repeated requests with the same schema — the common serving pattern —
-    hit the cache)."""
+    """Spec → TokenFsm with a bounded LRU cache (FSM compiles cost a
+    vocab-trie walk; repeated requests with the same schema — the common
+    serving pattern — hit the cache, while per-request-unique specs from
+    a hostile/buggy client cannot grow it without bound: each TokenFsm
+    lazily holds bool[V] masks per visited DFA state)."""
 
-    def __init__(self, tokenizer):
+    MAX_ENTRIES = 32
+
+    def __init__(self, tokenizer, max_entries: int = MAX_ENTRIES):
+        from collections import OrderedDict
+
         self.tokenizer = tokenizer
-        self._cache: Dict[str, TokenFsm] = {}
+        self.max_entries = max_entries
+        self._cache: "OrderedDict[str, TokenFsm]" = OrderedDict()
 
     def compile(self, spec: dict) -> TokenFsm:
         key = json.dumps(spec, sort_keys=True)
@@ -683,4 +690,8 @@ class GuidedCompiler:
                 eos = eos()
             fsm = TokenFsm(dfa, vocab_strings(self.tokenizer), eos)
             self._cache[key] = fsm
+            while len(self._cache) > self.max_entries:
+                self._cache.popitem(last=False)
+        else:
+            self._cache.move_to_end(key)
         return fsm

@@ -17,7 +17,7 @@ from ...runtime.component import Client, DistributedRuntime
 from ...runtime.engine import Context
 from ...runtime.request_plane import StreamLost
 from ..model_card import ModelDeploymentCard
-from ..tokens import compute_seq_hashes
+from ..tokens import compute_seq_hashes, salt_hash
 from .indexer import (
     ApproxKvIndexer,
     KvIndexer,
@@ -229,7 +229,14 @@ class KvPushRouter:
     ) -> AsyncIterator[Any]:
         token_ids = request.get("token_ids", [])
         request_id = request.get("request_id") or ""
-        seq_hashes = compute_seq_hashes(token_ids, self.block_size)
+        # LoRA adapters salt the hash chain exactly like the engine's
+        # prefix cache (tokens.py; reference protocols.rs lora_id): the
+        # router only co-locates same-adapter prefixes
+        salt = (
+            salt_hash(request["lora_name"].encode())
+            if request.get("lora_name") else 0
+        )
+        seq_hashes = compute_seq_hashes(token_ids, self.block_size, salt)
         pinned = request.get("router", {}).get("backend_instance_id")
         if pinned is not None:
             worker, overlap = int(pinned), 0

@@ -41,6 +41,9 @@ class ModelDeploymentCard:
     chat_template: Optional[str] = None  # jinja2 source; None = default
     runtime_config: Dict[str, Any] = field(default_factory=dict)
     checksum: Optional[str] = None
+    # LoRA adapters this worker serves (select via nvext.lora_name;
+    # reference lora_id in kv_router/protocols.rs:110-115)
+    lora_adapters: List[str] = field(default_factory=list)
 
     def to_json(self) -> bytes:
         return json.dumps(self.__dict__).encode()

@@ -120,6 +120,11 @@ class OpenAIPreprocessor:
                     "guided decoding cannot be combined with multimodal "
                     "content parts"
                 )
+            if pre.lora_name:
+                raise ValueError(
+                    "LoRA adapters cannot be combined with multimodal "
+                    "content parts yet"
+                )
             pre.multimodal = mm
         return pre
 
@@ -207,6 +212,11 @@ class OpenAIPreprocessor:
         guided = extract_guided_spec(
             getattr(request, "response_format", None), nvext
         )
+        lora_name = getattr(nvext, "lora_name", None) if nvext else None
+        if lora_name and guided:
+            raise ValueError(
+                "guided decoding with a LoRA adapter is not supported yet"
+            )
 
         return PreprocessedRequest(
             token_ids=token_ids,
@@ -217,6 +227,7 @@ class OpenAIPreprocessor:
             annotations=annotations,
             router=router,
             guided=guided,
+            lora_name=lora_name,
             request_id=secrets.token_hex(8),
         )
 

@@ -91,6 +91,10 @@ class PreprocessedRequest:
     # "json_object", ...}) normalized from response_format / nvext by
     # llm/guided.extract_guided_spec; engines compile it to a token FSM
     guided: Optional[Dict[str, Any]] = None
+    # multi-LoRA adapter selection (nvext.lora_name). Salts the token
+    # block hashes (reference protocols.rs:110-115 lora_id) so router +
+    # prefix cache + KVBM never share KV across adapters.
+    lora_name: Optional[str] = None
 
     def to_dict(self) -> dict:
         d = {
@@ -115,6 +119,8 @@ class PreprocessedRequest:
             d["multimodal"] = self.multimodal
         if self.guided:
             d["guided"] = self.guided
+        if self.lora_name:
+            d["lora_name"] = self.lora_name
         return d
 
     @classmethod

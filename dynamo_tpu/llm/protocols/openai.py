@@ -31,6 +31,9 @@ class NvExt(BaseModel):
     guided_regex: Optional[str] = None
     guided_choice: Optional[List[str]] = None
     guided_grammar: Optional[str] = None  # EBNF: rejected with 400 (unsupported)
+    # multi-LoRA: select a served adapter by name (models/lora.py; the
+    # worker's model card advertises available adapters)
+    lora_name: Optional[str] = None
 
 
 class FunctionCall(BaseModel):

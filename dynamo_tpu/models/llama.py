@@ -256,6 +256,7 @@ def prefill_forward_batched(
     emb_override: Optional[jax.Array] = None,  # [B, T, H] multimodal rows
     emb_mask: Optional[jax.Array] = None,  # [B, T] True where override applies
     all_logits: bool = False,  # True: return [B, T, vocab] (spec verify)
+    lora=None,  # models/lora.py stack + per-lane idx (multi-LoRA serving)
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Batched chunked prefill: one dispatch processes chunks of SEVERAL
     sequences (the round-1 engine serialized one chunk per loop iteration).
@@ -285,12 +286,15 @@ def prefill_forward_batched(
     phys = jnp.where(positions < P_tab * page_size, phys, 0)
     offs = positions % page_size
 
+    from . import lora as lora_mod
+
     for li in range(c.num_layers):
         layer = jax.tree.map(lambda p: p[li], params["layers"])
+        ll = lora_mod.layer_lora(lora, li)
         h = rms_norm(x, layer["attn_norm"], c.rms_norm_eps)
-        q = qdot(h, layer["wq"]).astype(c.dtype)
-        k = qdot(h, layer["wk"]).astype(c.dtype)
-        v = qdot(h, layer["wv"]).astype(c.dtype)
+        q = lora_mod.proj(h, layer["wq"], qdot, ll, "wq").astype(c.dtype)
+        k = lora_mod.proj(h, layer["wk"], qdot, ll, "wk").astype(c.dtype)
+        v = lora_mod.proj(h, layer["wv"], qdot, ll, "wv").astype(c.dtype)
         q = q.reshape(B, T, c.num_heads, c.head_dim)
         k = k.reshape(B, T, c.num_kv_heads, c.head_dim)
         v = v.reshape(B, T, c.num_kv_heads, c.head_dim)
@@ -302,7 +306,7 @@ def prefill_forward_batched(
             q, kv_k[li], kv_v[li], positions, page_tables, total_lens, context_lens
         )
         attn = attn.reshape(B, T, c.num_heads * c.head_dim)
-        x = x + qdot(attn, layer["wo"]).astype(c.dtype)
+        x = x + lora_mod.proj(attn, layer["wo"], qdot, ll, "wo").astype(c.dtype)
         x = mlp_fn(layer, x, c)
 
     x = rms_norm(x, params["final_norm"], c.rms_norm_eps)
@@ -594,9 +598,12 @@ def decode_forward(
     page_tables: jax.Array,  # [B, max_pages]
     seq_lens: jax.Array,  # [B] lengths INCLUDING the new token
     mlp_fn=None,  # (layer, x, config) -> x; models/moe.py passes moe_mlp
+    lora=None,  # models/lora.py stack + per-lane idx (multi-LoRA serving)
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One decode step for the whole slot batch; returns
     (logits [B, vocab], kv_k, kv_v)."""
+    from . import lora as lora_mod
+
     c = config
     mlp_fn = mlp_fn or _mlp
     x = embed_rows(params["embed"], tokens, c.dtype)  # [B, H]
@@ -605,10 +612,11 @@ def decode_forward(
 
     for li in range(c.num_layers):
         layer = jax.tree.map(lambda p: p[li], params["layers"])
+        ll = lora_mod.layer_lora(lora, li)
         h = rms_norm(x, layer["attn_norm"], c.rms_norm_eps)
-        q = qdot(h, layer["wq"]).astype(c.dtype)
-        k = qdot(h, layer["wk"]).astype(c.dtype)
-        v = qdot(h, layer["wv"]).astype(c.dtype)
+        q = lora_mod.proj(h, layer["wq"], qdot, ll, "wq").astype(c.dtype)
+        k = lora_mod.proj(h, layer["wk"], qdot, ll, "wk").astype(c.dtype)
+        v = lora_mod.proj(h, layer["wv"], qdot, ll, "wv").astype(c.dtype)
         q = q.reshape(-1, c.num_heads, c.head_dim)
         k = k.reshape(-1, c.num_kv_heads, c.head_dim)
         v = v.reshape(-1, c.num_kv_heads, c.head_dim)
@@ -628,7 +636,7 @@ def decode_forward(
         kv_v = kv_v.at[li, phys, offs].set(v[:, 0] if v.ndim == 4 else v)
         attn = paged_attention_decode(q, kv_k[li], kv_v[li], page_tables, seq_lens)
         attn = attn.reshape(-1, c.num_heads * c.head_dim)
-        x = x + qdot(attn, layer["wo"]).astype(c.dtype)
+        x = x + lora_mod.proj(attn, layer["wo"], qdot, ll, "wo").astype(c.dtype)
         x = mlp_fn(layer, x, c)
 
     x = rms_norm(x, params["final_norm"], c.rms_norm_eps)

@@ -1,0 +1,225 @@
+"""Multi-LoRA: per-request low-rank adapters served concurrently.
+
+Reference surface: the block-hash contract carries `lora_id` so prefix
+reuse is adapter-correct (lib/llm/src/kv_router/protocols.rs:110-115);
+adapter execution itself lives in the reference's engines (vLLM
+multi-LoRA). Here the native JAX engine owns it, TPU-first:
+
+  * all adapters live STACKED in HBM: one [N, L, in, r] / [N, L, r, out]
+    pair per target projection (index 0 is the all-zero "no adapter" —
+    base-model lanes are exact no-ops, so mixed batches need no masking);
+  * a per-lane adapter index gathers each lane's A/B at step time and the
+    delta is two thin einsums fused into the projection — no weight
+    swapping, no per-adapter dispatch;
+  * KV separation comes from hashing, not copying: the adapter name salts
+    the token block hashes (llm/tokens.py salt_hash), so the engine
+    prefix cache, the KVBM registry, and the KV router all distinguish
+    adapters automatically.
+
+Checkpoint format: HF PEFT exports (adapter_model.safetensors +
+adapter_config.json) with q/k/v/o_proj targets.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# target key → (per-layer input width fn, output width fn)
+TARGETS = ("wq", "wk", "wv", "wo")
+_PEFT_NAMES = {"q_proj": "wq", "k_proj": "wk", "v_proj": "wv", "o_proj": "wo"}
+
+
+@dataclass
+class LoraAdapter:
+    """One adapter: per-target (A [L, in, r], B [L, r, out]) + scaling."""
+
+    name: str
+    rank: int
+    scale: float  # alpha / rank
+    a: Dict[str, jnp.ndarray] = field(default_factory=dict)
+    b: Dict[str, jnp.ndarray] = field(default_factory=dict)
+
+
+def target_dims(config) -> Dict[str, Tuple[int, int]]:
+    """(in, out) widths per projection for a llama-family config."""
+    q_dim = config.num_heads * config.head_dim
+    kv_dim = config.num_kv_heads * config.head_dim
+    H = config.hidden_size
+    return {"wq": (H, q_dim), "wk": (H, kv_dim), "wv": (H, kv_dim),
+            "wo": (q_dim, H)}
+
+
+def init_adapter(config, name: str, key: jax.Array, rank: int = 8,
+                 scale: float = 1.0,
+                 targets: Sequence[str] = TARGETS) -> LoraAdapter:
+    """Random adapter (tests / fine-tune init): A gaussian, B gaussian —
+    a *non-zero* delta so serving tests can observe adapter effect."""
+    c = config
+    dims = target_dims(c)
+    ad = LoraAdapter(name=name, rank=rank, scale=scale)
+    keys = jax.random.split(key, 2 * len(targets))
+    for i, t in enumerate(targets):
+        din, dout = dims[t]
+        ad.a[t] = (
+            jax.random.normal(keys[2 * i], (c.num_layers, din, rank),
+                              jnp.float32) * 0.05
+        ).astype(c.dtype)
+        ad.b[t] = (
+            jax.random.normal(keys[2 * i + 1], (c.num_layers, rank, dout),
+                              jnp.float32) * 0.05
+        ).astype(c.dtype)
+    return ad
+
+
+def load_peft_adapter(path: str, config, name: str = None) -> LoraAdapter:
+    """Load an HF PEFT export directory: adapter_config.json +
+    adapter_model.safetensors (or .bin). PEFT stores per-layer
+    lora_A.weight [r, in] / lora_B.weight [out, r]; delta = (alpha/r)·B@A.
+    Same loader discipline as models/loader.py."""
+    d = Path(path)
+    cfg = json.loads((d / "adapter_config.json").read_text())
+    rank = int(cfg.get("r", 8))
+    alpha = float(cfg.get("lora_alpha", rank))
+    state: Dict[str, np.ndarray] = {}
+    st = d / "adapter_model.safetensors"
+    if st.exists():
+        from safetensors.numpy import load_file
+
+        state = load_file(str(st))
+    else:
+        import torch
+
+        bins = sorted(d.glob("adapter_model*.bin"))
+        if not bins:
+            raise FileNotFoundError(f"no adapter weights under {path}")
+        state = {
+            k: v.numpy()
+            for k, v in torch.load(str(bins[0]), map_location="cpu",
+                                   weights_only=True).items()
+        }
+    c = config
+    ad = LoraAdapter(name=name or d.name, rank=rank, scale=alpha / rank)
+    dims = target_dims(c)
+    for peft_t, t in _PEFT_NAMES.items():
+        a_rows, b_rows = [], []
+        for li in range(c.num_layers):
+            a_key = next(
+                (k for k in state
+                 if f"layers.{li}." in k and peft_t in k and "lora_A" in k),
+                None,
+            )
+            if a_key is None:
+                break
+            b_key = a_key.replace("lora_A", "lora_B")
+            # PEFT A [r, in] → ours [in, r]; B [out, r] → [r, out]
+            a_rows.append(np.asarray(state[a_key]).T)
+            b_rows.append(np.asarray(state[b_key]).T)
+        if not a_rows:
+            continue
+        if len(a_rows) != c.num_layers:
+            raise ValueError(
+                f"adapter {ad.name!r}: target {peft_t} present for "
+                f"{len(a_rows)}/{c.num_layers} layers"
+            )
+        din, dout = dims[t]
+        a = np.stack(a_rows)
+        b = np.stack(b_rows)
+        if a.shape != (c.num_layers, din, rank) or b.shape != (
+            c.num_layers, rank, dout
+        ):
+            raise ValueError(
+                f"adapter {ad.name!r} target {t}: shapes {a.shape}/{b.shape} "
+                f"do not match model dims ({din}/{dout}, r={rank})"
+            )
+        ad.a[t] = jnp.asarray(a, c.dtype)
+        ad.b[t] = jnp.asarray(b, c.dtype)
+    if not ad.a:
+        raise ValueError(f"adapter {ad.name!r} has no supported targets")
+    return ad
+
+
+def stack_adapters(config, adapters: List[LoraAdapter]) -> Dict[str, Any]:
+    """Adapters → the engine's device-resident stack. Index 0 is the
+    all-zero base-model adapter; adapter i+1 = adapters[i]. All adapters
+    are padded to the max rank (zero-padded ranks are exact no-ops).
+    Returns {"a": {t: [N, L, in, r]}, "b": {t: [N, L, r, out]},
+    "scale": [N] f32, "names": {name: idx}}."""
+    c = config
+    dims = target_dims(c)
+    r_max = max([a.rank for a in adapters], default=1)
+    N = len(adapters) + 1
+    out_a, out_b = {}, {}
+    for t in TARGETS:
+        din, dout = dims[t]
+        A = np.zeros((N, c.num_layers, din, r_max), np.float32)
+        B = np.zeros((N, c.num_layers, r_max, dout), np.float32)
+        for i, ad in enumerate(adapters):
+            if t in ad.a:
+                A[i + 1, :, :, : ad.rank] = np.asarray(
+                    ad.a[t], np.float32
+                )
+                B[i + 1, :, : ad.rank, :] = np.asarray(
+                    ad.b[t], np.float32
+                )
+        out_a[t] = jnp.asarray(A, c.dtype)
+        out_b[t] = jnp.asarray(B, c.dtype)
+    scale = np.ones((N,), np.float32)
+    for i, ad in enumerate(adapters):
+        scale[i + 1] = ad.scale
+    return {
+        "a": out_a,
+        "b": out_b,
+        "scale": jnp.asarray(scale),
+        "names": {ad.name: i + 1 for i, ad in enumerate(adapters)},
+    }
+
+
+def lora_delta(h: jax.Array, stack_a: jax.Array, stack_b: jax.Array,
+               idx: jax.Array, scale: jax.Array) -> jax.Array:
+    """Per-lane low-rank delta for one layer's projection.
+
+    h [B, in] or [B, T, in]; stack_a [N, in, r] (layer-sliced);
+    stack_b [N, r, out]; idx [B] lane→adapter; scale [N].
+    Returns scale[idx]·(h @ A[idx]) @ B[idx] — two thin matmuls whose
+    FLOPs are r/out of the base projection."""
+    A = stack_a[idx]  # [B, in, r]
+    Bm = stack_b[idx]  # [B, r, out]
+    s = scale[idx]
+    if h.ndim == 2:
+        d = jnp.einsum("bh,bhr->br", h, A)
+        return (jnp.einsum("br,bro->bo", d, Bm) * s[:, None]).astype(h.dtype)
+    d = jnp.einsum("bth,bhr->btr", h, A)
+    return (
+        jnp.einsum("btr,bro->bto", d, Bm) * s[:, None, None]
+    ).astype(h.dtype)
+
+
+def layer_lora(lora: Dict[str, Any], li: int):
+    """Slice the stack to one layer: {t: ([N, in, r], [N, r, out])}."""
+    if lora is None:
+        return None
+    return {
+        "a": {t: v[:, li] for t, v in lora["a"].items()},
+        "b": {t: v[:, li] for t, v in lora["b"].items()},
+        "idx": lora["idx"],
+        "scale": lora["scale"],
+    }
+
+
+def proj(h: jax.Array, w, qdot_fn, lora_layer, target: str) -> jax.Array:
+    """Projection with optional per-lane LoRA delta (the hook llama.py's
+    attention uses)."""
+    y = qdot_fn(h, w)
+    if lora_layer is not None and target in lora_layer["a"]:
+        y = y + lora_delta(
+            h, lora_layer["a"][target], lora_layer["b"][target],
+            lora_layer["idx"], lora_layer["scale"],
+        ).astype(y.dtype)
+    return y

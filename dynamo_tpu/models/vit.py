@@ -63,6 +63,22 @@ class ViTConfig:
         return cls(**kw)
 
 
+def _init_proj(config: ViTConfig, key: jax.Array) -> Dict[str, Any]:
+    """Projector (LLaVA-style two-layer MLP) random init — shared by
+    init_params and the HF-checkpoint loader (which random-inits the
+    projector only when the export doesn't carry one)."""
+    c = config
+    k1, k2 = jax.random.split(key)
+    return {
+        "w1": (jax.random.normal(k1, (c.hidden_size, c.out_hidden),
+                                 jnp.float32) * 0.02).astype(c.dtype),
+        "b1": jnp.zeros((c.out_hidden,), c.dtype),
+        "w2": (jax.random.normal(k2, (c.out_hidden, c.out_hidden),
+                                 jnp.float32) * 0.02).astype(c.dtype),
+        "b2": jnp.zeros((c.out_hidden,), c.dtype),
+    }
+
+
 def init_params(config: ViTConfig, key: jax.Array) -> Dict[str, Any]:
     """Random-init tree, shape-compatible with HF ViTModel weights
     (loader.load_vit_params maps checkpoints onto the same tree)."""
@@ -106,12 +122,7 @@ def init_params(config: ViTConfig, key: jax.Array) -> Dict[str, Any]:
         "ln_f": {"w": jnp.ones((c.hidden_size,), c.dtype),
                  "b": jnp.zeros((c.hidden_size,), c.dtype)},
         # LLaVA-style projector to the LLM embedding width
-        "proj": {
-            "w1": dense(ks[3], (c.hidden_size, c.out_hidden)),
-            "b1": jnp.zeros((c.out_hidden,), c.dtype),
-            "w2": dense(ks[4], (c.out_hidden, c.out_hidden)),
-            "b2": jnp.zeros((c.out_hidden,), c.dtype),
-        },
+        "proj": _init_proj(c, ks[3]),
     }
 
 
@@ -215,9 +226,9 @@ def params_from_hf_state(state: Dict[str, np.ndarray], config: ViTConfig,
             "w_down": lin("output.dense"),
             "b_down": bias("output.dense"),
         })
-    # projector: checkpoint-provided (LLaVA-style exports) or random
-    rng_params = init_params(c, jax.random.PRNGKey(0))
-    proj = rng_params["proj"]
+    # projector: checkpoint-provided (LLaVA-style exports) or random —
+    # only the 4 small proj arrays are generated, not a full init_params
+    proj = _init_proj(c, jax.random.PRNGKey(0))
     for ours, theirs in (("w1", "proj.w1"), ("b1", "proj.b1"),
                          ("w2", "proj.w2"), ("b2", "proj.b2")):
         if prefix + theirs in state:
