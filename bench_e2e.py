@@ -116,6 +116,104 @@ def build_trace(
     return out
 
 
+def synthesize_mooncake_trace(
+    n_requests: int,
+    qps: float,
+    block_size: int,
+    seed: int = 0,
+    n_roots: int = 4,
+    depth: int = 3,
+    leaf_blocks: int = 2,
+    osl_mean: int = 64,
+) -> List[dict]:
+    """Mooncake-style rows with REAL temporal + prefix structure: a radix
+    tree of `n_roots` root chains (depth `depth` shared blocks), requests
+    pick a root and extend it with unique leaf blocks, arrivals are
+    bursty (sessions re-arrive close together — the locality a synthetic
+    prefix-ratio trace lacks). Schema matches the reference's
+    benchmarks/prefix_data_generator synthesizer: timestamp(ms),
+    input_length, output_length, hash_ids."""
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    # shared core tree: root r's path = [r*1000 + d for d in range(depth)]
+    rows = []
+    t_ms = 0.0
+    next_leaf = 10_000_000
+    for i in range(n_requests):
+        # bursty arrivals: occasional session bursts at ~4x rate
+        gap = rng.exponential(1.0 / qps) * (0.25 if rng.rand() < 0.3 else 1.0)
+        t_ms += gap * 1000.0
+        root = int(rng.randint(n_roots))
+        d = int(rng.randint(1, depth + 1))
+        path = [root * 1000 + k for k in range(d)]
+        n_leaf = int(rng.randint(1, leaf_blocks + 1))
+        path += list(range(next_leaf, next_leaf + n_leaf))
+        next_leaf += n_leaf
+        isl = len(path) * block_size - int(rng.randint(0, block_size // 2))
+        rows.append({
+            "timestamp": int(t_ms),
+            "input_length": isl,
+            "output_length": max(4, int(rng.poisson(osl_mean))),
+            "hash_ids": path,
+        })
+    return rows
+
+
+def load_mooncake_trace(
+    rows_or_path,
+    vocab: int,
+    max_isl: int,
+    max_osl: int,
+    block_size: int,
+    speedup: float = 1.0,
+    seed: int = 0,
+) -> List[TraceRequest]:
+    """Mooncake-style JSONL → TraceRequest replay list (reference
+    benchmarks/router/real_data_benchmark.py input schema). Every hash_id
+    deterministically expands to the same `block_size` token block, so
+    rows sharing a hash-id path share a real token prefix the KV router /
+    prefix cache can exploit; arrivals follow the trace's timestamps
+    (scaled by `speedup`)."""
+    import numpy as np
+
+    if isinstance(rows_or_path, (str, Path)):
+        with open(rows_or_path) as f:
+            rows = [json.loads(ln) for ln in f if ln.strip()]
+    else:
+        rows = list(rows_or_path)
+    if not rows:
+        raise ValueError("empty trace")
+    rows.sort(key=lambda r: r["timestamp"])
+    t0 = rows[0]["timestamp"]
+
+    def block_tokens(hid: int) -> List[int]:
+        r = np.random.RandomState((seed * 0x9E3779B1 + int(hid)) & 0x7FFFFFFF)
+        return r.randint(5, vocab - 1, size=block_size).tolist()
+
+    out = []
+    for i, row in enumerate(rows):
+        isl = min(int(row["input_length"]), max_isl)
+        osl = max(min(int(row["output_length"]), max_osl), 1)
+        toks: List[int] = []
+        for hid in row.get("hash_ids") or []:
+            if len(toks) >= isl:
+                break
+            toks.extend(block_tokens(hid))
+        if len(toks) > isl:
+            toks = toks[:isl]  # tail block truncates; leading blocks intact
+        elif len(toks) < isl:
+            r = np.random.RandomState((seed ^ (i * 2654435761)) & 0x7FFFFFFF)
+            toks.extend(
+                r.randint(5, vocab - 1, size=isl - len(toks)).tolist()
+            )
+        out.append(TraceRequest(
+            at=(row["timestamp"] - t0) / 1000.0 / max(speedup, 1e-6),
+            isl=len(toks), osl=osl, token_ids=toks,
+        ))
+    return out
+
+
 # --------------------------------------------------------------------- #
 # deployment: spawn the real stack
 # --------------------------------------------------------------------- #
@@ -382,6 +480,17 @@ def main(argv: Optional[List[str]] = None):
                     help="KV pool per worker (default: auto for agg, a fixed "
                     "conservative slice for multi-worker single-chip modes)")
     ap.add_argument("--prefix-ratio", type=float, default=0.0)
+    ap.add_argument("--trace", default=None, metavar="FILE|synth",
+                    help="replay a mooncake-style trace (JSONL rows with "
+                    "timestamp/input_length/output_length/hash_ids — "
+                    "reference benchmarks/router/real_data_benchmark.py) "
+                    "instead of the synthetic lognormal trace; 'synth' "
+                    "generates a bursty radix-tree trace in-process")
+    ap.add_argument("--trace-block-size", type=int, default=None,
+                    help="tokens per hash_id block (default: 512, or the "
+                    "KV page size in --smoke mode)")
+    ap.add_argument("--trace-speedup", type=float, default=1.0,
+                    help="replay the trace N× faster than recorded")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--startup-timeout", type=float, default=None)
     ap.add_argument("--quantize", choices=["int8"], default=None,
@@ -412,10 +521,25 @@ def main(argv: Optional[List[str]] = None):
         args.max_isl, args.max_osl = 256, 64
     vocab = 512 if model in ("tiny", "tiny-moe") else 128000
 
-    trace = build_trace(
-        n_requests, qps, args.isl_mean, args.osl_mean, args.max_isl,
-        args.max_osl, vocab, seed=args.seed, prefix_ratio=args.prefix_ratio,
-    )
+    if args.trace:
+        block = args.trace_block_size or (64 if args.smoke else 512)
+        rows = (
+            synthesize_mooncake_trace(
+                n_requests, qps, block, seed=args.seed,
+                osl_mean=args.osl_mean,
+            )
+            if args.trace == "synth" else args.trace
+        )
+        trace = load_mooncake_trace(
+            rows, vocab, args.max_isl, args.max_osl, block,
+            speedup=args.trace_speedup, seed=args.seed,
+        )
+        n_requests = len(trace)
+    else:
+        trace = build_trace(
+            n_requests, qps, args.isl_mean, args.osl_mean, args.max_isl,
+            args.max_osl, vocab, seed=args.seed, prefix_ratio=args.prefix_ratio,
+        )
     print(
         f"# e2e bench: mode={args.mode} model={model} device="
         f"{'cpu' if cpu else 'tpu'} qps={qps} requests={n_requests} "
@@ -429,6 +553,8 @@ def main(argv: Optional[List[str]] = None):
                      num_pages=args.num_pages,
                      router_override=router_override, quantize=args.quantize)
         hits = 0
+        dispatch = {}
+        n_reporting = 0
         try:
             asyncio.run(wait_model(dep.http_port, startup))
             # brief warmup: compile every engine variant before the timed trace
@@ -439,9 +565,38 @@ def main(argv: Optional[List[str]] = None):
             wall = time.perf_counter() - t0
             if args.router_compare and args.mode == "kv":
                 hits = scrape_prefix_hits(dep.discovery, expect=args.num_workers)
+            # per-dispatch device occupancy (engine stats()): the
+            # serving-gap diagnostic — what fraction of wall the device
+            # stream spent in block/prefill/reset/patch/fetch, vs idle
+            try:
+                from tests.utils import scrape_worker_stats
+
+                per_worker = scrape_worker_stats(
+                    dep.discovery, min_workers=1, timeout=15
+                )
+                n_reporting = len(per_worker)
+                for st in per_worker.values():
+                    for k, v in st.items():
+                        if k.startswith("dispatch_"):
+                            dispatch[k] = round(dispatch.get(k, 0) + v, 3)
+            except Exception as e:  # noqa: BLE001 — diagnostic only
+                print(f"# dispatch-stat scrape failed: {e}", file=sys.stderr)
         finally:
             dep.stop()
-        return summarize(results, wall, args.mode, qps, model), hits
+        summary = summarize(results, wall, args.mode, qps, model)
+        if dispatch:
+            # fetch runs on its own thread and overlaps compute — not part
+            # of device-stream occupancy. Seconds are summed across
+            # workers, so occupancy averages over the reporting workers.
+            busy = sum(
+                v for k, v in dispatch.items()
+                if k.endswith("_s") and k != "dispatch_fetch_s"
+            )
+            dispatch["device_busy_frac"] = round(
+                busy / max(wall * max(n_reporting, 1), 1e-9), 3
+            )
+            summary["dispatch"] = dispatch
+        return summary, hits
 
     if args.router_compare and args.mode != "kv":
         ap.error("--router-compare requires --mode kv")
@@ -450,8 +605,12 @@ def main(argv: Optional[List[str]] = None):
     if args.router_compare and args.mode == "kv":
         # arm B: identical trace, identical fresh pool, round-robin routing
         rr_summary, rr_hits = run_arm(router_override="round-robin")
+        trace_tag = (
+            f"trace_{Path(args.trace).stem if args.trace != 'synth' else 'synth'}"
+            if args.trace else f"prefix{args.prefix_ratio:g}"
+        )
         benefit = {
-            "metric": f"kv_router_benefit_{model}_prefix{args.prefix_ratio:g}",
+            "metric": f"kv_router_benefit_{model}_{trace_tag}",
             "value": round(rr_summary["ttft_ms"]["p50"] - summary["ttft_ms"]["p50"], 1),
             "unit": "ms_ttft_p50_saved",
             "vs_baseline": None,
@@ -475,7 +634,11 @@ def main(argv: Optional[List[str]] = None):
     mean_lat_s = summary["latency_ms"]["p50"] / 1000.0
     eff_batch = max(1, min(int(qps * mean_lat_s), 64))
     result = {
-        "metric": f"e2e_output_toks_{args.mode}_{model}_qps{qps:g}",
+        "metric": (
+            f"e2e_output_toks_{args.mode}_{model}_trace"
+            if args.trace else
+            f"e2e_output_toks_{args.mode}_{model}_qps{qps:g}"
+        ),
         "value": summary["output_tok_s"],
         "unit": "tok/s",
         "vs_baseline": baseline_ratio(summary["output_tok_s"], model),
@@ -488,6 +651,7 @@ def main(argv: Optional[List[str]] = None):
             model, summary["output_tok_s"], eff_batch,
             args.isl_mean + args.osl_mean / 2, args.quantize,
         ) if not cpu else {}),
+        **({"dispatch": summary["dispatch"]} if "dispatch" in summary else {}),
     }
     print(json.dumps(result))
     if summary["failed"]:

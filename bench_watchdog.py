@@ -63,6 +63,9 @@ PHASES = [
      3600),
     ("kv_benefit", [PY, "bench_e2e.py", "--mode", "kv", "--prefix-ratio",
                     "0.5", "--router-compare", "--quantize", "int8"], 5400),
+    ("kv_trace", [PY, "bench_e2e.py", "--mode", "kv", "--trace", "synth",
+                  "--trace-speedup", "4", "--router-compare",
+                  "--quantize", "int8"], 5400),
     ("spec_decode", [PY, "bench_engine.py", "--quantize", "int8",
                      "--spec", "ngram"], 1800),
 ]

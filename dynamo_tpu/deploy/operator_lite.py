@@ -134,8 +134,14 @@ class GraphReconciler(_PollLoop):
         self.poll_s = poll_s
         self.applied_revision: Optional[int] = None
         self._applied_base = False
+        self._last_overlay = None  # (num_prefill, num_decode) last applied
         self.generation = 0  # bumps on every spec change (base or overlay)
         self.reconciles = 0
+
+    def _overlaid(self, graph):
+        if self._last_overlay is None:
+            return graph
+        return graph.with_planner_overlay(*self._last_overlay)
 
     def set_graph(self, graph) -> None:
         """Spec change (edited manifest): triggers a rollout on the next
@@ -155,12 +161,18 @@ class GraphReconciler(_PollLoop):
                 # expires, even with no new spec/decision (reconcile()
                 # itself no-ops while the window is still open)
                 return await self.controller.reconcile(
-                    self.graph, self.generation
+                    self._overlaid(self.graph), self.generation
                 )
             return False
         target = self.graph
         if fresh:
             target = self.graph.with_planner_overlay(decision[1], decision[2])
+            self._last_overlay = (decision[1], decision[2])
+        else:
+            # spec change (set_graph) with no NEW decision: the planner's
+            # last applied replica counts remain the desired state — a
+            # manifest edit must not scale the fleet back to base counts
+            target = self._overlaid(target)
         self.generation += 1
         ok = await self.controller.reconcile(target, self.generation)
         if not ok:

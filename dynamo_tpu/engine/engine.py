@@ -994,10 +994,8 @@ class JaxEngine:
             return "LoRA is incompatible with speculative decoding (spec_mode)"
         if cfg.pp_size > 1 or cfg.sp_size > 1:
             return "LoRA is not supported on pp/sp layouts yet"
-        if cfg.decode_pool_mode == "local":
-            # the local-accumulator decode path has no LoRA hook yet; the
-            # lora block variant uses per-step pool scatter regardless
-            pass
+        # (decode_pool_mode == "local" needs no rejection: the lora block
+        # variant uses per-step pool scatter regardless of pool mode)
         if req.guided:
             return "guided decoding with a LoRA adapter is not supported yet"
         if req.multimodal:

@@ -674,24 +674,33 @@ class GuidedCompiler:
     MAX_ENTRIES = 32
 
     def __init__(self, tokenizer, max_entries: int = MAX_ENTRIES):
+        import threading
         from collections import OrderedDict
 
         self.tokenizer = tokenizer
         self.max_entries = max_entries
         self._cache: "OrderedDict[str, TokenFsm]" = OrderedDict()
+        # compile() runs on asyncio.to_thread workers (engine
+        # _compile_guided_async): hit/evict must not race
+        self._lock = threading.Lock()
 
     def compile(self, spec: dict) -> TokenFsm:
         key = json.dumps(spec, sort_keys=True)
-        fsm = self._cache.get(key)
-        if fsm is None:
-            dfa = compile_regex(spec_to_regex(spec))
-            eos = self.tokenizer.eos_token_ids
-            if callable(eos):
-                eos = eos()
-            fsm = TokenFsm(dfa, vocab_strings(self.tokenizer), eos)
+        with self._lock:
+            fsm = self._cache.get(key)
+            if fsm is not None:
+                self._cache.move_to_end(key)
+                return fsm
+        dfa = compile_regex(spec_to_regex(spec))
+        eos = self.tokenizer.eos_token_ids
+        if callable(eos):
+            eos = eos()
+        fsm = TokenFsm(dfa, vocab_strings(self.tokenizer), eos)
+        with self._lock:
+            cached = self._cache.get(key)
+            if cached is not None:  # concurrent miss: first insert wins
+                return cached
             self._cache[key] = fsm
             while len(self._cache) > self.max_entries:
                 self._cache.popitem(last=False)
-        else:
-            self._cache.move_to_end(key)
         return fsm

@@ -33,6 +33,57 @@ def test_trace_is_seeded_and_sharegpt_shaped():
     assert len(heads) == 1
 
 
+def test_mooncake_trace_synthesis_and_replay():
+    """Mooncake-style trace (reference real_data_benchmark.py schema):
+    hash-id paths expand deterministically, shared radix paths become
+    shared token prefixes, timestamps drive arrivals."""
+    sys.path.insert(0, str(REPO))
+    from bench_e2e import load_mooncake_trace, synthesize_mooncake_trace
+
+    rows = synthesize_mooncake_trace(48, qps=8.0, block_size=16, seed=3)
+    assert all(
+        set(r) == {"timestamp", "input_length", "output_length", "hash_ids"}
+        for r in rows
+    )
+    # radix structure: many rows share a root chain
+    roots = [tuple(r["hash_ids"][:1]) for r in rows]
+    assert len(set(roots)) <= 4
+
+    trace = load_mooncake_trace(rows, vocab=512, max_isl=256, max_osl=64,
+                                block_size=16, seed=3)
+    assert len(trace) == 48
+    # determinism
+    again = load_mooncake_trace(rows, vocab=512, max_isl=256, max_osl=64,
+                                block_size=16, seed=3)
+    assert [t.token_ids for t in trace] == [t.token_ids for t in again]
+    # same leading hash id => identical leading token block
+    by_root = {}
+    for row, t in zip(rows, trace):
+        by_root.setdefault(row["hash_ids"][0], []).append(t.token_ids[:16])
+    shared = [v for v in by_root.values() if len(v) > 1]
+    assert shared, "no shared roots in synthetic trace"
+    for group in shared:
+        assert all(g == group[0] for g in group)
+    # different roots => different blocks
+    firsts = {tuple(v[0]) for v in by_root.values()}
+    assert len(firsts) == len(by_root)
+    # arrivals: sorted, scaled by speedup
+    ats = [t.at for t in trace]
+    assert ats == sorted(ats) and ats[0] == 0.0
+    fast = load_mooncake_trace(rows, vocab=512, max_isl=256, max_osl=64,
+                               block_size=16, seed=3, speedup=2.0)
+    assert abs(fast[-1].at - ats[-1] / 2.0) < 1e-9
+    # file roundtrip
+    import tempfile
+
+    with tempfile.NamedTemporaryFile("w", suffix=".jsonl", delete=False) as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    from_file = load_mooncake_trace(f.name, vocab=512, max_isl=256,
+                                    max_osl=64, block_size=16, seed=3)
+    assert [t.token_ids for t in from_file] == [t.token_ids for t in trace]
+
+
 def test_bench_e2e_smoke_agg_produces_result():
     """Full harness: real discovery/frontend/worker processes, 8-request
     trace, JSON result on stdout. This is `bench.py --e2e --smoke` in

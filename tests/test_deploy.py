@@ -576,3 +576,51 @@ class TestGraphDeployment:
 
         asyncio.run(run())
         assert applied == [{"fe": ["--a"]}, {"fe": ["--b"]}]
+
+    def test_spec_change_keeps_planner_overlay(self):
+        """A manifest edit with NO new planner decision must not scale the
+        fleet back to base replica counts — the last applied decision
+        stays the desired state."""
+        import asyncio
+
+        from dynamo_tpu.deploy.graph import GraphSpec, ServiceSpec
+        from dynamo_tpu.deploy.operator_lite import GraphReconciler
+
+        applied = []
+
+        class _Backend:
+            async def apply(self, g):
+                applied.append({s.name: s.replicas for s in g.services})
+
+        class _KV:
+            def __init__(self):
+                self.doc = None
+
+            async def get(self, key):
+                return self.doc
+
+        def mk_graph(extra=0):
+            return GraphSpec(
+                name="t", namespace="d", image="x",
+                services=[
+                    ServiceSpec("dc", module="m", replicas=1, role="decode",
+                                args=["--v", str(extra)]),
+                ],
+            )
+
+        kv = _KV()
+        rec = GraphReconciler(kv, mk_graph(), _Backend())
+
+        async def run():
+            await rec.reconcile_once()
+            kv.doc = json.dumps({
+                "revision": 5, "num_prefill_workers": 2,
+                "num_decode_workers": 6,
+            })
+            await rec.reconcile_once()
+            assert applied[-1] == {"dc": 6}
+            rec.set_graph(mk_graph(extra=1))  # manifest edit, same decision
+            await rec.reconcile_once()
+            assert applied[-1] == {"dc": 6}, "spec change dropped the overlay"
+
+        asyncio.run(run())
