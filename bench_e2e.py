@@ -150,7 +150,9 @@ def synthesize_mooncake_trace(
         n_leaf = int(rng.randint(1, leaf_blocks + 1))
         path += list(range(next_leaf, next_leaf + n_leaf))
         next_leaf += n_leaf
-        isl = len(path) * block_size - int(rng.randint(0, block_size // 2))
+        isl = len(path) * block_size - int(
+            rng.randint(0, max(block_size // 2, 1))
+        )
         rows.append({
             "timestamp": int(t_ms),
             "input_length": isl,
@@ -555,11 +557,32 @@ def main(argv: Optional[List[str]] = None):
         hits = 0
         dispatch = {}
         n_reporting = 0
+        n_workers = args.num_workers if args.mode == "kv" else 1
+
+        def _scrape_dispatch():
+            from tests.utils import scrape_worker_stats
+
+            per_worker = scrape_worker_stats(
+                dep.discovery, min_workers=n_workers, timeout=15
+            )
+            agg = {}
+            for st in per_worker.values():
+                for k, v in st.items():
+                    if k.startswith("dispatch_"):
+                        agg[k] = agg.get(k, 0) + v
+            return agg, len(per_worker)
+
         try:
             asyncio.run(wait_model(dep.http_port, startup))
             # brief warmup: compile every engine variant before the timed trace
             warm = [TraceRequest(0.0, 32, 8, list(range(5, 37))) for _ in range(2)]
             asyncio.run(run_trace(dep.http_port, warm))
+            # baseline AFTER warmup: engine _dev_time counters are
+            # cumulative, so the diagnostic must diff out warmup + compile
+            try:
+                base_dispatch, _ = _scrape_dispatch()
+            except Exception:  # noqa: BLE001 — diagnostic only
+                base_dispatch = None
             t0 = time.perf_counter()
             results = asyncio.run(run_trace(dep.http_port, trace))
             wall = time.perf_counter() - t0
@@ -567,18 +590,14 @@ def main(argv: Optional[List[str]] = None):
                 hits = scrape_prefix_hits(dep.discovery, expect=args.num_workers)
             # per-dispatch device occupancy (engine stats()): the
             # serving-gap diagnostic — what fraction of wall the device
-            # stream spent in block/prefill/reset/patch/fetch, vs idle
+            # stream spent in block/prefill/reset/patch, vs idle
             try:
-                from tests.utils import scrape_worker_stats
-
-                per_worker = scrape_worker_stats(
-                    dep.discovery, min_workers=1, timeout=15
-                )
-                n_reporting = len(per_worker)
-                for st in per_worker.values():
-                    for k, v in st.items():
-                        if k.startswith("dispatch_"):
-                            dispatch[k] = round(dispatch.get(k, 0) + v, 3)
+                if base_dispatch is not None:
+                    end_dispatch, n_reporting = _scrape_dispatch()
+                    dispatch = {
+                        k: round(v - base_dispatch.get(k, 0), 3)
+                        for k, v in end_dispatch.items()
+                    }
             except Exception as e:  # noqa: BLE001 — diagnostic only
                 print(f"# dispatch-stat scrape failed: {e}", file=sys.stderr)
         finally:

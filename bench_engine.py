@@ -34,7 +34,7 @@ from bench import baseline_ratio, ensure_backend  # noqa: E402
 
 
 def _make_engine(model: str, B: int, isl: int, osl: int, K: int, page: int = 64,
-                 pool_mode: str = "scatter", unroll: int = 1, quantize=None,
+                 pool_mode=None, unroll: int = 0, quantize=None,
                  num_pages: Optional[int] = None, spec=None):
     from dynamo_tpu.engine import EngineConfig, JaxEngine
 
@@ -197,8 +197,10 @@ def main(argv: Optional[List[str]] = None):
     ap.add_argument("--isl", type=int, default=128)
     ap.add_argument("--osl", type=int, default=128)
     ap.add_argument("--block", type=int, default=16)
-    ap.add_argument("--pool-mode", choices=["scatter", "local"], default="scatter")
-    ap.add_argument("--unroll", type=int, default=1)
+    ap.add_argument("--pool-mode", choices=["scatter", "local"], default=None,
+                    help="default: auto (local on TPU, scatter on CPU)")
+    ap.add_argument("--unroll", type=int, default=0,
+                    help="0 = auto (4 under local, 1 under scatter)")
     ap.add_argument("--quantize", choices=["int8"], default=None)
     ap.add_argument("--num-pages", type=int, default=None,
                     help="KV pool size override (floored at the batch's "

@@ -33,8 +33,16 @@ class EngineConfig:
     #     lax.scan XLA re-copies closed-over HBM arrays every iteration
     #     (~4 ms/GB/step). Near pool-size-invariant; compile time grows
     #     with the unroll factor.
-    decode_pool_mode: str = "scatter"
-    decode_block_unroll: int = 1
+    # DEFAULT = None = auto by platform (engine init): "local" on TPU —
+    # production pools are auto-sized (num_pages=0 → thousands of pages on
+    # a 16G v5e), where scatter's pool copies dominate (941 ms/block
+    # @ 1024 pages vs ~300 projected local, r3 measurement; the r4
+    # sweep's local arms finished ~25% faster by wall-clock before its
+    # metric read crashed) — and "scatter" on CPU, where the pathology
+    # doesn't exist and the unrolled local scan just multiplies compile
+    # time. bench_sweep.py re-decides empirically per chip.
+    decode_pool_mode: Optional[str] = None
+    decode_block_unroll: int = 0  # 0 = auto: 4 under local, 1 under scatter
     # batched prefill: token budget per dispatch; lanes = budget // bucket
     prefill_batch_tokens: int = 1024
     max_prefill_batch: int = 8

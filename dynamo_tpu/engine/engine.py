@@ -246,6 +246,22 @@ class JaxEngine:
         every device dispatch is mirrored to follower hosts, which replay
         it via `run_follower`. `multihost`: True when jax.distributed is
         active (disagg KV extraction then rides process_allgather)."""
+        if config.decode_pool_mode is None or not config.decode_block_unroll:
+            # platform auto (EngineConfig docstring): local's once-per-block
+            # pool write wins on TPU at production pool sizes; scatter
+            # keeps CPU (tests/smoke) compile time sane. Resolve into a
+            # COPY — the caller's config keeps its auto sentinels.
+            import dataclasses as _dc
+
+            mode = config.decode_pool_mode or (
+                "local" if jax.devices()[0].platform == "tpu" else "scatter"
+            )
+            config = _dc.replace(
+                config,
+                decode_pool_mode=mode,
+                decode_block_unroll=config.decode_block_unroll
+                or (4 if mode == "local" else 1),
+            )
         self.config = config
         self._mesh = mesh
         self._spmd = spmd
@@ -350,6 +366,9 @@ class JaxEngine:
         # these instead of grepping logs
         self.kv_pulls_completed = 0
         self.kv_pages_pulled = 0
+        # blocks reused MID-prefill from concurrent same-prefix requests
+        # (_try_skip_ahead; admission-time hits count in the allocator)
+        self.prefix_skip_ahead_blocks = 0
         self._admit_counter = 0
         # speculative decoding (engine/spec.py): host mirror of the device
         # history ring + SpecDecodeStats counters (_core.pyi:269-301 role)
@@ -1247,6 +1266,7 @@ class JaxEngine:
             out["kv_bytes_served"] = self.data_plane.bytes_served
         out["kv_pulls_completed"] = self.kv_pulls_completed
         out["kv_pages_pulled"] = self.kv_pages_pulled
+        out["kv_skip_ahead_blocks"] = self.prefix_skip_ahead_blocks
         for tag, (cnt, tot) in self._dev_time.items():
             out[f"dispatch_{tag}_count"] = cnt
             out[f"dispatch_{tag}_s"] = round(tot, 3)
@@ -2101,6 +2121,38 @@ class JaxEngine:
                 return b
         return self.config.prefill_buckets[-1]
 
+    def _try_skip_ahead(self, s: _Slot) -> None:
+        """Late-binding prefix reuse: blocks committed SINCE this slot was
+        admitted (by a concurrent same-prefix request, possibly via the
+        incremental chunk commit) cover part of the remaining prompt —
+        swap the cached pages into the table and skip the compute. Only
+        whole-page-aligned progress can splice; fresh slots only (resume/
+        disagg/onboard slots carry their own page provenance)."""
+        cfg = self.config
+        if s.generated or s.resume_token is not None or s.onboard is not None:
+            return
+        n_known = len(s.committed_hashes)
+        if s.prefill_pos != n_known * cfg.page_size:
+            return
+        hashes = s.seq.block_hashes()
+        prompt_full = len(s.kv_prompt) // cfg.page_size
+        if n_known >= prompt_full:
+            return
+        extra = self.allocator.acquire_cached(hashes[n_known:prompt_full])
+        if not extra:
+            return
+        self.prefix_skip_ahead_blocks += len(extra)
+        old = s.pages[n_known : n_known + len(extra)]
+        s.pages[n_known : n_known + len(extra)] = extra
+        self.allocator.release(old, [])  # fresh, un-hashed -> free list
+        s.committed_hashes.extend(hashes[n_known : n_known + len(extra)])
+        s.prefill_pos = (n_known + len(extra)) * cfg.page_size
+        if s.prefill_pos >= len(s.kv_prompt):
+            # whole prompt now cached: recompute the last token for logits
+            s.prefill_pos = len(s.kv_prompt) - 1
+        phys = [p + 1 for p in s.pages]
+        self.page_tables[s.slot_idx, : len(phys)] = phys
+
     async def _dispatch_prefill(self) -> bool:
         """Pack prefill chunks from several slots into ONE dispatch.
 
@@ -2119,6 +2171,7 @@ class JaxEngine:
                 self._emit_finish(s, "cancelled")
                 self._release_slot(s)
                 continue
+            self._try_skip_ahead(s)
             cands.append(s)
         if not cands:
             return False
@@ -2304,12 +2357,16 @@ class JaxEngine:
                 tag="prefill",
             )
         completions = []
+        progressed = []
         for s, chunk, lane in meta:
             s.prefill_pos += chunk
+            # commit confirmed at this dispatch's FETCH (execution proof)
+            progressed.append((s, s.prefill_pos))
             if s.prefill_pos >= len(s.kv_prompt):
                 completions.append((s, lane))
-        if completions:
-            self._pending_prefill.append({"first": first_dev, "done": completions})
+        self._pending_prefill.append(
+            {"first": first_dev, "done": completions, "progressed": progressed}
+        )
         return True
 
     async def _dispatch_prefill_one(self, slot: _Slot) -> None:
@@ -2561,11 +2618,21 @@ class JaxEngine:
             transfer_id=tid,
         )
 
-    def _commit_blocks(self, slot: _Slot):
-        """Bind filled prompt pages to their hashes -> prefix cache + events."""
+    def _commit_blocks(self, slot: _Slot, upto_tokens: Optional[int] = None):
+        """Bind filled prompt pages to their hashes -> prefix cache + events.
+
+        `upto_tokens`: incremental commit after a confirmed prefill CHUNK
+        (the fetch of its dispatch's first-token proves the device ran the
+        program, so the pages hold real KV) — concurrent same-prefix
+        requests start hitting these blocks before the whole prompt
+        finishes, instead of redundantly recomputing a prefix another
+        in-flight request already wrote."""
         hashes = slot.seq.block_hashes()
         n_known = len(slot.committed_hashes)
-        prompt_full_blocks = len(slot.kv_prompt) // self.config.page_size
+        limit = len(slot.kv_prompt)
+        if upto_tokens is not None:
+            limit = min(limit, upto_tokens)
+        prompt_full_blocks = limit // self.config.page_size
         new_hashes = hashes[n_known:prompt_full_blocks]
         if new_hashes:
             pages = slot.pages[n_known : n_known + len(new_hashes)]
@@ -2857,6 +2924,13 @@ class JaxEngine:
         firsts_np, toks_np = await self._fetch(tree)
 
         for p, first in zip(prefills, firsts_np):
+            for slot, upto in p.get("progressed", []):
+                if slot.slot_idx < 0 or self.slots[slot.slot_idx] is not slot:
+                    continue
+                if slot.prefill_pos < len(slot.kv_prompt):
+                    # mid-prompt: commit the chunk's full pages now so
+                    # concurrent same-prefix requests can skip ahead
+                    self._commit_blocks(slot, upto_tokens=upto)
             for slot, lane in p["done"]:
                 if slot.slot_idx < 0 or self.slots[slot.slot_idx] is not slot:
                     continue  # released meanwhile (cancel)

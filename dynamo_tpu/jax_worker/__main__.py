@@ -35,10 +35,12 @@ def parse_args(argv=None):
     ap.add_argument("--max-num-seqs", type=int, default=64)
     ap.add_argument("--max-model-len", type=int, default=8192)
     ap.add_argument("--decode-pool-mode", choices=["scatter", "local"],
-                    default="scatter",
+                    default=None,
                     help="KV-write strategy in the fused decode block "
-                    "(local + unroll for multi-GB page pools)")
-    ap.add_argument("--decode-block-unroll", type=int, default=1)
+                    "(default: auto — local on TPU, scatter on CPU; "
+                    "see EngineConfig.decode_pool_mode)")
+    ap.add_argument("--decode-block-unroll", type=int, default=0,
+                    help="0 = auto (4 under local, 1 under scatter)")
     ap.add_argument("--lora", action="append", default=[],
                     metavar="NAME=PATH",
                     help="serve a LoRA adapter (HF PEFT export dir); "

@@ -164,6 +164,66 @@ def test_concurrent_requests_and_prefix_cache(params):
     asyncio.run(main())
 
 
+def test_burst_same_prefix_reuses_inflight_blocks(params):
+    """Concurrent same-prefix requests admitted BEFORE the first finishes
+    must still reuse its prompt blocks: chunks commit incrementally at
+    fetch time and waiting slots skip ahead over newly cached pages —
+    with identical greedy output to independent runs."""
+
+    async def main():
+        cfg = EngineConfig(
+            model="tiny",
+            max_num_seqs=4,
+            page_size=PAGE,
+            num_pages=128,
+            max_model_len=256,
+            prefill_buckets=(16,),  # small chunks: many incremental commits
+            max_prefill_chunk=16,
+        )
+        eng = JaxEngine(cfg, model_config=CFG, params=params)
+
+        async def one(rid, prompt, n):
+            req = PreprocessedRequest(
+                token_ids=prompt,
+                stop_conditions={"max_tokens": n, "ignore_eos": True},
+                request_id=rid,
+            ).to_dict()
+            toks = []
+            async for item in eng.generate(req, Context()):
+                if item.get("data"):
+                    toks.extend(item["data"]["token_ids"])
+            return toks
+
+        shared = list(range(10, 10 + 12 * PAGE))  # 12 pages of shared prefix
+        p1 = shared + [301, 302, 303]
+        p2 = shared + [401, 402, 403]
+
+        solo1 = await one("s1", p1, 4)
+        eng.allocator.clear_cache()
+        hits_before = eng.allocator.prefix_hit_blocks_total
+        t1 = asyncio.create_task(one("a", p1, 4))
+        # stagger: B arrives while A is mid-prefill — after SOME of A's
+        # chunks committed (incrementally, at fetch) but before A finished
+        for _ in range(400):
+            await asyncio.sleep(0.01)
+            if eng.allocator._by_hash:
+                break
+        assert eng.allocator._by_hash, "no incremental chunk commits landed"
+        slot_a = next(s for s in eng.slots if s is not None)
+        assert slot_a.prefill_pos < len(p1), "A already finished; no overlap"
+        t2 = asyncio.create_task(one("b", p2, 4))
+        r1, r2 = await asyncio.gather(t1, t2)
+        hits = eng.allocator.prefix_hit_blocks_total - hits_before
+        await eng.close()
+        assert r1 == solo1, "reuse changed greedy output"
+        # B was admitted with only part of the prefix cached; the rest
+        # must have been picked up mid-flight (skip-ahead over blocks A
+        # committed after B's admission)
+        assert hits > 0, "no in-flight prefix reuse in a same-prefix burst"
+
+    asyncio.run(main())
+
+
 def test_cancellation_releases_pages(params):
     async def main():
         cfg = EngineConfig(
