@@ -557,7 +557,9 @@ def main(argv: Optional[List[str]] = None):
         hits = 0
         dispatch = {}
         n_reporting = 0
-        n_workers = args.num_workers if args.mode == "kv" else 1
+        # workers expected to publish stats: kv runs a pool, disagg runs
+        # prefill+decode (both publish), agg runs one
+        n_workers = {"kv": args.num_workers, "disagg": 2}.get(args.mode, 1)
 
         def _scrape_dispatch():
             from tests.utils import scrape_worker_stats
@@ -581,7 +583,9 @@ def main(argv: Optional[List[str]] = None):
             # cumulative, so the diagnostic must diff out warmup + compile
             try:
                 base_dispatch, _ = _scrape_dispatch()
-            except Exception:  # noqa: BLE001 — diagnostic only
+            except Exception as e:  # noqa: BLE001 — diagnostic only
+                print(f"# dispatch-stat baseline scrape failed: {e}",
+                      file=sys.stderr)
                 base_dispatch = None
             t0 = time.perf_counter()
             results = asyncio.run(run_trace(dep.http_port, trace))

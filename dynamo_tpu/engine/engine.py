@@ -2129,6 +2129,8 @@ class JaxEngine:
         whole-page-aligned progress can splice; fresh slots only (resume/
         disagg/onboard slots carry their own page provenance)."""
         cfg = self.config
+        if not cfg.enable_prefix_caching:
+            return  # caching disabled must disable ALL reuse paths
         if s.generated or s.resume_token is not None or s.onboard is not None:
             return
         n_known = len(s.committed_hashes)

@@ -206,6 +206,17 @@ class OpenAIPreprocessor:
             raise ValueError("logit_bias is not supported")
         if (getattr(request, "n", None) or 1) > 1:
             raise ValueError("n > 1 is not supported; issue parallel requests")
+        logprobs = getattr(request, "logprobs", None)
+        # chat uses a bool (False == absent); completions use an int where
+        # 0 is a VALID ask (sampled-token logprob) that must still 400
+        if logprobs is not None and logprobs is not False:
+            raise ValueError("logprobs are not supported yet")
+        if getattr(request, "top_logprobs", None):
+            raise ValueError("top_logprobs is not supported yet")
+        if getattr(request, "echo", False):
+            raise ValueError("echo is not supported")
+        if getattr(request, "suffix", None):
+            raise ValueError("suffix (fill-in-the-middle) is not supported")
 
         from .guided import extract_guided_spec
 

@@ -195,6 +195,18 @@ def test_preprocessor_rejects_unsupported_knobs():
         pre.preprocess_chat(_chat(n=3))
     with pytest.raises(ValueError, match="guided_grammar"):
         pre.preprocess_chat(_chat(nvext=NvExt(guided_grammar="g")))
+    with pytest.raises(ValueError, match="logprobs"):
+        pre.preprocess_chat(_chat(logprobs=True))
+    from dynamo_tpu.llm.protocols.openai import CompletionRequest
+
+    with pytest.raises(ValueError, match="echo"):
+        pre.preprocess_completion(
+            CompletionRequest(model="m", prompt="x", echo=True)
+        )
+    with pytest.raises(ValueError, match="logprobs"):
+        pre.preprocess_completion(
+            CompletionRequest(model="m", prompt="x", logprobs=3)
+        )
     # valid guided request lands in the preprocessed payload
     out = pre.preprocess_chat(_chat(response_format={"type": "json_object"}))
     assert out.guided == {"kind": "json_object"}
