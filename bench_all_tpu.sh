@@ -6,7 +6,15 @@
 set -u
 cd "$(dirname "$0")"
 OUT=bench_tpu_results.jsonl
-log() { echo "### $(date -u +%H:%M:%S) $*" | tee -a $OUT; }
+# notes are JSON records, never bare comments — the results file must
+# stay valid JSONL (round-4 advisor low #4)
+log() {
+  python - "$*" <<'PYEOF' | tee -a $OUT
+import json, sys, time
+print(json.dumps({"note": sys.argv[1],
+                  "ts": time.strftime("%H:%M:%S", time.gmtime())}))
+PYEOF
+}
 
 run() {  # run <timeout_s> <label> <cmd...>
   local t=$1 label=$2; shift 2
@@ -32,4 +40,8 @@ run 1800 int8_engine python bench.py --engine --quantize int8
 # fit 16 GiB HBM where bf16 (2 x 6.4 GB) would not.
 run 3600 disagg python bench_e2e.py --mode disagg --quantize int8
 run 5400 kv_benefit python bench_e2e.py --mode kv --prefix-ratio 0.5 --router-compare --quantize int8
+# 6. real-trace router benefit (mooncake-style bursty radix trace)
+run 5400 kv_trace python bench_e2e.py --mode kv --trace synth --trace-speedup 4 --router-compare --quantize int8
+# 7. speculative decoding ITL on a repetition-heavy trace
+run 1800 spec python bench_engine.py --quantize int8 --spec ngram
 log "ladder complete"

@@ -557,22 +557,30 @@ def main(argv: Optional[List[str]] = None):
         hits = 0
         dispatch = {}
         n_reporting = 0
-        # workers expected to publish stats: kv runs a pool, disagg runs
-        # prefill+decode (both publish), agg runs one
-        n_workers = {"kv": args.num_workers, "disagg": 2}.get(args.mode, 1)
+        # (component topic, workers expected) per mode: kv runs a backend
+        # pool, disagg runs decode (backend) + prefill on SEPARATE metric
+        # topics, agg runs one backend worker
+        scrape_plan = (
+            [("backend", 1), ("prefill", 1)] if args.mode == "disagg"
+            else [("backend", args.num_workers if args.mode == "kv" else 1)]
+        )
 
         def _scrape_dispatch():
             from tests.utils import scrape_worker_stats
 
-            per_worker = scrape_worker_stats(
-                dep.discovery, min_workers=n_workers, timeout=15
-            )
             agg = {}
-            for st in per_worker.values():
-                for k, v in st.items():
-                    if k.startswith("dispatch_"):
-                        agg[k] = agg.get(k, 0) + v
-            return agg, len(per_worker)
+            n = 0
+            for component, expect in scrape_plan:
+                per_worker = scrape_worker_stats(
+                    dep.discovery, min_workers=expect, timeout=15,
+                    component=component,
+                )
+                n += len(per_worker)
+                for st in per_worker.values():
+                    for k, v in st.items():
+                        if k.startswith("dispatch_"):
+                            agg[k] = agg.get(k, 0) + v
+            return agg, n
 
         try:
             asyncio.run(wait_model(dep.http_port, startup))

@@ -409,6 +409,8 @@ async def main():
     for _stat in (
         "kv_transfers_served", "kv_bytes_served", "kv_pulls_completed",
         "kv_pages_pulled", "num_waiting_reqs", "num_running_reqs",
+        "kv_skip_ahead_blocks", "guided_requests", "lora_requests",
+        "spec_num_drafts", "spec_num_accepted_tokens",
     ):
         # registry prepends the "dynamo" prefix -> dynamo_worker_<stat>
         drt.metrics.callback_gauge(

@@ -206,10 +206,10 @@ class OpenAIPreprocessor:
             raise ValueError("logit_bias is not supported")
         if (getattr(request, "n", None) or 1) > 1:
             raise ValueError("n > 1 is not supported; issue parallel requests")
-        logprobs = getattr(request, "logprobs", None)
-        # chat uses a bool (False == absent); completions use an int where
-        # 0 is a VALID ask (sampled-token logprob) that must still 400
-        if logprobs is not None and logprobs is not False:
+        # chat uses a bool, completions an int — and pydantic coerces an
+        # explicit `false` to 0 on the int field, so 0/False/None all read
+        # as "disabled"; any truthy ask 400s
+        if getattr(request, "logprobs", None):
             raise ValueError("logprobs are not supported yet")
         if getattr(request, "top_logprobs", None):
             raise ValueError("top_logprobs is not supported yet")

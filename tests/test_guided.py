@@ -207,6 +207,10 @@ def test_preprocessor_rejects_unsupported_knobs():
         pre.preprocess_completion(
             CompletionRequest(model="m", prompt="x", logprobs=3)
         )
+    # pydantic coerces an explicit false to 0 on the int field: disabled
+    pre.preprocess_completion(
+        CompletionRequest(model="m", prompt="x", logprobs=False)
+    )
     # valid guided request lands in the preprocessed payload
     out = pre.preprocess_chat(_chat(response_format={"type": "json_object"}))
     assert out.guided == {"kind": "json_object"}
