@@ -48,7 +48,7 @@ from ..models import llama
 from ..runtime.engine import Context
 from .config import EngineConfig
 from .kv_cache import PageAllocator, alloc_kv_arrays
-from .sampling import SamplingParams, sample, unpack_mask
+from .sampling import SamplingParams, sample, sample_lp, unpack_mask
 
 logger = logging.getLogger(__name__)
 
@@ -223,6 +223,7 @@ class _Slot:
     guided_fsm: Optional[Any] = None  # llm/guided.TokenFsm (structured output)
     guided_state: int = 0  # current FSM state; advanced per emitted token
     lora_idx: int = 0  # adapter slot in the engine's LoRA stack (0 = base)
+    want_logprobs: bool = False  # attach sampled-token logprobs to emissions
 
 
 class JaxEngine:
@@ -483,8 +484,11 @@ class JaxEngine:
                         params, c, tokens, positions, loc_k, loc_v, j,
                         kv_k, kv_v, page_tables, pool_lens,
                     )
-                    nxt = sample(logits, samp, key_j)
-                    return (nxt, positions + 1, seq_lens + 1, loc_k, loc_v), nxt
+                    nxt, lp = sample_lp(logits, samp, key_j)
+                    return (
+                        (nxt, positions + 1, seq_lens + 1, loc_k, loc_v),
+                        (nxt, lp),
+                    )
 
                 (tokens, positions, seq_lens, loc_k, loc_v), toks = jax.lax.scan(
                     step,
@@ -531,8 +535,11 @@ class JaxEngine:
                         logits, kv_k, kv_v = self._model.decode_forward(
                             params, c, tokens, positions, kv_k, kv_v, page_tables, seq_lens
                         )
-                    nxt = sample(logits, samp, k)
-                    return (nxt, positions + 1, seq_lens + 1, kv_k, kv_v), nxt
+                    nxt, lp = sample_lp(logits, samp, k)
+                    return (
+                        (nxt, positions + 1, seq_lens + 1, kv_k, kv_v),
+                        (nxt, lp),
+                    )
 
                 (tokens, positions, seq_lens, kv_k, kv_v), toks = jax.lax.scan(
                     step, (tokens, positions, seq_lens, kv_k, kv_v), keys
@@ -625,7 +632,7 @@ class JaxEngine:
             logits, kv_k, kv_v = self._model.prefill_forward_batched(
                 params, c, tokens, positions, kv_k, kv_v, page_tables, ctx_lens, last_idx
             )
-            first = sample(logits, samp, sub)
+            first = sample_lp(logits, samp, sub)
             return first, kv_k, kv_v, rng
 
         self._prefill_batch = prefill_batch
@@ -643,7 +650,7 @@ class JaxEngine:
                 params, c, tokens, positions, kv_k, kv_v, page_tables,
                 ctx_lens, last_idx, emb_override=emb, emb_mask=emb_mask,
             )
-            first = sample(logits, samp, sub)
+            first = sample_lp(logits, samp, sub)
             return first, kv_k, kv_v, rng
 
         self._prefill_batch_mm = prefill_batch_mm
@@ -669,9 +676,10 @@ class JaxEngine:
                     params, c, tokens, positions, kv_k, kv_v, page_tables, seq_lens
                 )
             mask = unpack_mask(mask_packed, c.vocab_size)
-            nxt = sample(logits, samp, sub, mask=mask)
+            nxt, lp = sample_lp(logits, samp, sub, mask=mask)
             return (
-                nxt[None], nxt, positions + 1, seq_lens + 1, kv_k, kv_v, rng
+                (nxt[None], lp[None]), nxt, positions + 1, seq_lens + 1,
+                kv_k, kv_v, rng,
             )
 
         self._decode_step_guided = decode_step_guided
@@ -690,9 +698,10 @@ class JaxEngine:
                 seq_lens, lora=lora,
             )
             mask = unpack_mask(mask_packed, c.vocab_size)
-            nxt = sample(logits, samp, sub, mask=mask)
+            nxt, lp = sample_lp(logits, samp, sub, mask=mask)
             return (
-                nxt[None], nxt, positions + 1, seq_lens + 1, kv_k, kv_v, rng
+                (nxt[None], lp[None]), nxt, positions + 1, seq_lens + 1,
+                kv_k, kv_v, rng,
             )
 
         self._decode_step_guided_lora = decode_step_guided_lora
@@ -707,7 +716,7 @@ class JaxEngine:
                 ctx_lens, last_idx
             )
             mask = unpack_mask(mask_packed, c.vocab_size)
-            first = sample(logits, samp, sub, mask=mask)
+            first = sample_lp(logits, samp, sub, mask=mask)
             return first, kv_k, kv_v, rng
 
         self._prefill_batch_guided = prefill_batch_guided
@@ -730,8 +739,11 @@ class JaxEngine:
                     params, c, tokens, positions, kv_k, kv_v, page_tables,
                     seq_lens, lora=lora,
                 )
-                nxt = sample(logits, samp, key_j)
-                return (nxt, positions + 1, seq_lens + 1, kv_k, kv_v), nxt
+                nxt, lp = sample_lp(logits, samp, key_j)
+                return (
+                    (nxt, positions + 1, seq_lens + 1, kv_k, kv_v),
+                    (nxt, lp),
+                )
 
             (tokens, positions, seq_lens, kv_k, kv_v), toks = jax.lax.scan(
                 step, (tokens, positions, seq_lens, kv_k, kv_v), keys
@@ -749,7 +761,7 @@ class JaxEngine:
                 params, c, tokens, positions, kv_k, kv_v, page_tables,
                 ctx_lens, last_idx, lora=lora,
             )
-            first = sample(logits, samp, sub)
+            first = sample_lp(logits, samp, sub)
             return first, kv_k, kv_v, rng
 
         self._prefill_batch_lora = prefill_batch_lora
@@ -778,7 +790,7 @@ class JaxEngine:
                     logits, kv_k, kv_v = self._model.prefill_forward_ring(
                         params, c, toks, kv_k, kv_v, table, real_len, self._mesh
                     )
-                first = sample(logits[None], samp, sub)
+                first = sample_lp(logits[None], samp, sub)
                 return first, kv_k, kv_v, rng
 
             self._prefill_single = prefill_single
@@ -1021,6 +1033,18 @@ class JaxEngine:
             return "LoRA with multimodal content parts is not supported yet"
         return None
 
+    def _check_logprobs(self, req: PreprocessedRequest) -> Optional[str]:
+        if (
+            self.config.spec_mode
+            and (req.sampling_options or {}).get("logprobs")
+        ):
+            return (
+                "logprobs are not supported with speculative decoding "
+                "(the verify pass emits accepted drafts without per-token "
+                "logprobs); run the worker without --spec"
+            )
+        return None
+
     def _check_guided(self, req: PreprocessedRequest) -> Optional[str]:
         """Validate + pre-compile a guided-decoding spec. Returns an error
         string (rejected request) or None. Like multimodal, silently
@@ -1103,6 +1127,7 @@ class JaxEngine:
         )
         slot.top_k = int(sampling.get("top_k") or 0)
         slot.top_p = float(sampling.get("top_p") or 1.0)
+        slot.want_logprobs = bool(sampling.get("logprobs"))
         if req.guided:
             slot.guided_fsm = (
                 getattr(req, "_compiled_fsm", None)
@@ -1137,7 +1162,7 @@ class JaxEngine:
         if g_err is not None:
             yield Annotated.from_error(g_err).to_dict()
             return
-        l_err = self._check_lora(req)
+        l_err = self._check_lora(req) or self._check_logprobs(req)
         if l_err is not None:
             yield Annotated.from_error(l_err).to_dict()
             return
@@ -1177,7 +1202,7 @@ class JaxEngine:
             if isinstance(request, PreprocessedRequest)
             else PreprocessedRequest.from_dict(request)
         )
-        g_err = await self._compile_guided_async(req) or self._check_lora(req)
+        g_err = (await self._compile_guided_async(req) or self._check_lora(req) or self._check_logprobs(req))
         if g_err is not None:
             yield Annotated.from_error(g_err).to_dict()
             return
@@ -1215,7 +1240,7 @@ class JaxEngine:
             if isinstance(request, PreprocessedRequest)
             else PreprocessedRequest.from_dict(request)
         )
-        g_err = await self._compile_guided_async(req) or self._check_lora(req)
+        g_err = (await self._compile_guided_async(req) or self._check_lora(req) or self._check_logprobs(req))
         if g_err is not None:
             yield Annotated.from_error(g_err).to_dict()
             return
@@ -2439,7 +2464,8 @@ class JaxEngine:
         ps = np.arange(max(0, L1 - Hc), L1)
         row[ps % Hc] = toks[ps]
 
-    def _finish_prefill(self, slot: _Slot, first: int):
+    def _finish_prefill(self, slot: _Slot, first: int,
+                        first_lp: Optional[float] = None):
         """Prompt KV fully computed; activate the slot for decode."""
         self._commit_blocks(slot)
         if slot.done or slot.context.is_stopped():
@@ -2461,7 +2487,7 @@ class JaxEngine:
             slot.guided_state = slot.guided_fsm.advance(
                 slot.guided_state, first
             )
-        self._emit_token(slot, first)
+        self._emit_token(slot, first, first_lp)
         if not slot.done:
             slot.last_token = first
             slot.generated = 1
@@ -2472,7 +2498,8 @@ class JaxEngine:
             self._mark_lane_dirty(slot.slot_idx)
             self._maybe_finish(slot, first)
 
-    async def _emit_prefill_result(self, slot: _Slot, first_token: int):
+    async def _emit_prefill_result(self, slot: _Slot, first_token: int,
+                                   first_lp: Optional[float] = None):
         from ..llm.disagg import pack_kv_payload
 
         cfg = self.config
@@ -2488,7 +2515,7 @@ class JaxEngine:
             # fast path: stage the pages on the data plane and return only a
             # descriptor — the decode worker pulls chunks while we keep
             # serving; pages stay pinned until the pull finishes (or TTL)
-            self._stage_kv_pull(slot, first_token, page_ids)
+            self._stage_kv_pull(slot, first_token, page_ids, first_lp)
             return
 
         self._bcast("extract", {"page_ids": page_ids})
@@ -2497,6 +2524,8 @@ class JaxEngine:
         if not slot.done:
             out = LLMEngineOutput(
                 token_ids=[first_token],
+                log_probs=[first_lp]
+                if (slot.want_logprobs and first_lp is not None) else None,
                 finish_reason="remote_prefill_done",
                 kv_transfer_params=payload,
             ).to_dict()
@@ -2505,7 +2534,9 @@ class JaxEngine:
             slot.done = True
         self._release_slot(slot)
 
-    def _stage_kv_pull(self, slot: _Slot, first_token: int, page_ids: np.ndarray):
+    def _stage_kv_pull(self, slot: _Slot, first_token: int,
+                       page_ids: np.ndarray,
+                       first_lp: Optional[float] = None):
         """Pin the finished prefill's pages on the data plane and answer with
         a descriptor. The extract callback gathers page CHUNKS lazily as the
         decode worker pulls, so the device gather overlaps the network (and
@@ -2586,6 +2617,8 @@ class JaxEngine:
             )
         out = LLMEngineOutput(
             token_ids=[first_token],
+            log_probs=[first_lp]
+            if (slot.want_logprobs and first_lp is not None) else None,
             finish_reason="remote_prefill_done",
             kv_transfer_params={"pull": desc.to_dict()},
         ).to_dict()
@@ -2933,14 +2966,16 @@ class JaxEngine:
                     # mid-prompt: commit the chunk's full pages now so
                     # concurrent same-prefix requests can skip ahead
                     self._commit_blocks(slot, upto_tokens=upto)
+            first_toks, first_lps = first
             for slot, lane in p["done"]:
                 if slot.slot_idx < 0 or self.slots[slot.slot_idx] is not slot:
                     continue  # released meanwhile (cancel)
-                tok = int(first[lane])
+                tok = int(first_toks[lane])
+                lp = float(first_lps[lane])
                 if slot.return_kv:
-                    await self._emit_prefill_result(slot, tok)
+                    await self._emit_prefill_result(slot, tok, lp)
                 else:
-                    self._finish_prefill(slot, tok)
+                    self._finish_prefill(slot, tok, lp)
 
         if want_block is not None:
             self._inflight.popleft()
@@ -2950,7 +2985,7 @@ class JaxEngine:
                     want_block["seq_before"],
                 )
             else:
-                self._process_block(want_block["lanes"], toks_np)
+                self._process_block(want_block["lanes"], *toks_np)
         return True
 
     def _process_spec_block(self, lanes: List[tuple], toks: np.ndarray,
@@ -3000,7 +3035,8 @@ class JaxEngine:
                 if slot.done:
                     break
 
-    def _process_block(self, lanes: List[tuple], toks: np.ndarray):
+    def _process_block(self, lanes: List[tuple], toks: np.ndarray,
+                       lps: np.ndarray):
         """Emit a fetched K-step block: per lane, append/emit tokens until a
         stop condition; excess speculated tokens are discarded. Lanes whose
         slot was preempted/released (or re-assigned) meanwhile are skipped —
@@ -3025,7 +3061,7 @@ class JaxEngine:
                     slot.guided_state = slot.guided_fsm.advance(
                         slot.guided_state, tok
                     )
-                self._emit_token(slot, tok)
+                self._emit_token(slot, tok, float(lps[k, i]))
                 self._maybe_finish(slot, tok)
                 if slot.done:
                     break
@@ -3054,10 +3090,14 @@ class JaxEngine:
 
     # -- emission / teardown --------------------------------------------- #
 
-    def _emit_token(self, slot: _Slot, token: int):
+    def _emit_token(self, slot: _Slot, token: int,
+                    lp: Optional[float] = None):
         if slot.done:
             return
-        out = LLMEngineOutput(token_ids=[token]).to_dict()
+        out = LLMEngineOutput(
+            token_ids=[token],
+            log_probs=[lp] if (slot.want_logprobs and lp is not None) else None,
+        ).to_dict()
         slot.queue.put_nowait(Annotated(data=out).to_dict())
 
     def _maybe_finish(self, slot: _Slot, token: int):

@@ -89,6 +89,24 @@ def sample(
     return jnp.where(params.temperature <= 0.0, greedy_tokens, sampled_tokens)
 
 
+def sample_lp(
+    logits: jax.Array,  # [B, V] f32
+    params: SamplingParams,
+    key: jax.Array,
+    mask: jax.Array = None,
+) -> tuple:
+    """sample() + the chosen token's RAW-model logprob (log-softmax of the
+    unscaled, unmasked logits — the OpenAI `logprobs` surface; under
+    guided masks this honestly reports how (un)likely the forced token
+    was). Returns (tokens [B] i32, logprobs [B] f32)."""
+    tokens = sample(logits, params, key, mask=mask)
+    logz = jax.scipy.special.logsumexp(logits.astype(jnp.float32), axis=-1)
+    chosen = jnp.take_along_axis(
+        logits.astype(jnp.float32), tokens[:, None], axis=-1
+    )[:, 0]
+    return tokens, chosen - logz
+
+
 def apply_logit_penalties(
     logits: jax.Array,  # [B, V]
     recent_tokens: jax.Array,  # [B, W] window of recent token ids (pad = -1)

@@ -71,6 +71,7 @@ async def maybe_remote_prefill(
     prefill_req["disagg_params"] = {"return_kv": True, "kv_pull": True}
 
     first_token = None
+    first_lp = None
     kv_payload = None
     try:
         router = PushRouter(prefill_client, RouterMode.ROUND_ROBIN)
@@ -81,6 +82,7 @@ async def maybe_remote_prefill(
                 kv_payload = data["kv_transfer_params"]
                 if data.get("token_ids"):
                     first_token = data["token_ids"][0]
+                    first_lp = (data.get("log_probs") or [None])[0]
     except (StreamLost, EngineError) as e:
         logger.warning("remote prefill failed (%s); falling back to local", e)
 
@@ -93,8 +95,12 @@ async def maybe_remote_prefill(
 
     if want_annotation:
         yield {"event": "remote_prefill", "comment": ["true"]}
-    # emit the prefill-produced first token to the caller
-    yield Annotated(data=LLMEngineOutput(token_ids=[first_token]).to_dict()).to_dict()
+    # emit the prefill-produced first token to the caller (with its
+    # logprob when the request asked — the lists must stay aligned)
+    yield Annotated(data=LLMEngineOutput(
+        token_ids=[first_token],
+        log_probs=[first_lp] if first_lp is not None else None,
+    ).to_dict()).to_dict()
     if "pull" in kv_payload:
         # fast path: descriptor only — stream-inject from the prefill
         # worker's data plane while the decode batch keeps stepping

@@ -96,15 +96,24 @@ class Backend(Operator):
                 else LLMEngineOutput.from_dict(ann.data)
             )
             text_parts: List[str] = []
-            for tok in out.token_ids:
+            lp_entries: List[dict] = []
+            for idx, tok in enumerate(out.token_ids):
                 delta, hit = decoder.step(tok)
                 if delta:
                     text_parts.append(delta)
+                if out.log_probs is not None and idx < len(out.log_probs):
+                    # per-token pairing happens HERE — the only layer that
+                    # sees both the token's text delta and its logprob
+                    lp_entries.append(
+                        {"token": delta or "", "logprob": out.log_probs[idx]}
+                    )
                 if hit:
                     stopped = True
                     break
             if out.text is None:
                 out.text = "".join(text_parts) if text_parts else None
+            if lp_entries:
+                out.logprob_entries = lp_entries
             if stopped and out.finish_reason is None:
                 out.finish_reason = "stop"
             yield Annotated(data=out, id=ann.id, event=ann.event, comment=ann.comment)

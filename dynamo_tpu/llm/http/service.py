@@ -486,9 +486,12 @@ class HttpService:
                     await resp.write(
                         _sse(gen.tool_calls_chunk(out.tool_calls).model_dump_json(exclude_none=True))
                     )
-                if out.text:
+                if out.text or out.logprob_entries:
                     await resp.write(
-                        _sse(gen.text_chunk(out.text, len(out.token_ids)).model_dump_json(exclude_none=True))
+                        _sse(gen.text_chunk(
+                            out.text or "", len(out.token_ids),
+                            logprob_entries=out.logprob_entries,
+                        ).model_dump_json(exclude_none=True))
                     )
                 elif out.token_ids:
                     gen.completion_tokens += len(out.token_ids)
@@ -527,6 +530,7 @@ class HttpService:
         last_token_at = None
         reasoning_parts: list[str] = []
         tool_calls: list = []
+        lp_entries: list = []
         async for ann in stream:
             if ann.is_error():
                 error_msg = (ann.comment or ["engine error"])[0]
@@ -546,6 +550,8 @@ class HttpService:
                 tool_calls.extend(out.tool_calls)
             if out.text:
                 texts.append(out.text)
+            if out.logprob_entries:
+                lp_entries.extend(out.logprob_entries)
             if out.finish_reason:
                 finish = "stop" if out.finish_reason == "eos" else out.finish_reason
                 break
@@ -564,6 +570,9 @@ class HttpService:
 
             message.tool_calls = [ToolCall.model_validate(tc) for tc in tool_calls]
             message.content = message.content or None
+        from ..protocols.openai import chat_logprobs
+
+        chat_lp = chat_logprobs(lp_entries)
         response = ChatCompletionResponse(
             id=gen.id,
             model=req.model,
@@ -572,6 +581,7 @@ class HttpService:
                     index=0,
                     message=message,
                     finish_reason=finish,
+                    logprobs=chat_lp,
                 )
             ],
             usage=Usage(
@@ -638,9 +648,9 @@ class HttpService:
                     if first_token_at is None:
                         first_token_at = last_token_at
                         self.metrics.observe_ttft(req.model, first_token_at - t0)
-                if out.text:
+                if out.text or out.logprob_entries:
                     await resp.write(
-                        _sse(gen.text_chunk(out.text, len(out.token_ids)).model_dump_json(exclude_none=True))
+                        _sse(gen.text_chunk(out.text or "", len(out.token_ids), logprob_entries=out.logprob_entries).model_dump_json(exclude_none=True))
                     )
                 if out.finish_reason:
                     await resp.write(
@@ -671,6 +681,7 @@ class HttpService:
         error_msg = None
         first_token_at = None
         last_token_at = None
+        lp_entries: list = []
         async for ann in stream:
             if ann.is_error():
                 error_msg = (ann.comment or ["engine error"])[0]
@@ -686,6 +697,8 @@ class HttpService:
             n_out += len(out.token_ids)
             if out.text:
                 texts.append(out.text)
+            if out.logprob_entries:
+                lp_entries.extend(out.logprob_entries)
             if out.finish_reason:
                 finish = "stop" if out.finish_reason == "eos" else out.finish_reason
                 break
@@ -696,11 +709,15 @@ class HttpService:
         )
         if error_msg:
             return self._error(500, error_msg, "engine_error")
+        from ..protocols.openai import completion_logprobs
+
+        lp = completion_logprobs(lp_entries)
         response = CompletionResponse(
             id=gen.id,
             model=req.model,
             choices=[
-                CompletionChoice(index=0, text="".join(texts), finish_reason=finish)
+                CompletionChoice(index=0, text="".join(texts),
+                                 finish_reason=finish, logprobs=lp)
             ],
             usage=Usage(
                 prompt_tokens=gen.prompt_tokens,

@@ -206,11 +206,21 @@ class OpenAIPreprocessor:
             raise ValueError("logit_bias is not supported")
         if (getattr(request, "n", None) or 1) > 1:
             raise ValueError("n > 1 is not supported; issue parallel requests")
-        # chat uses a bool, completions an int — and pydantic coerces an
-        # explicit `false` to 0 on the int field, so 0/False/None all read
-        # as "disabled"; any truthy ask 400s
-        if getattr(request, "logprobs", None):
-            raise ValueError("logprobs are not supported yet")
+        # logprobs: the engine reports the SAMPLED token's raw-model
+        # logprob (chat `logprobs: true`; completions `logprobs: 0`, whose
+        # legacy meaning is exactly that). Top-K alternatives are not
+        # computed — completions logprobs>0 and chat top_logprobs 400.
+        # Note: pydantic coerces completions `logprobs: false` to 0, which
+        # therefore ALSO enables the (harmless) sampled-token logprobs.
+        logprobs = getattr(request, "logprobs", None)
+        if isinstance(logprobs, int) and not isinstance(logprobs, bool) \
+                and logprobs > 0:
+            raise ValueError(
+                "logprobs > 0 (top-k alternatives) is not supported; "
+                "logprobs: 0 returns the sampled token's logprob"
+            )
+        if logprobs is not None and logprobs is not False:
+            sampling["logprobs"] = True
         if getattr(request, "top_logprobs", None):
             raise ValueError("top_logprobs is not supported yet")
         if getattr(request, "echo", False):
@@ -270,17 +280,21 @@ class ChatDeltaGenerator:
             choices=[StreamChoice(index=0, delta=ChoiceDelta(role="assistant", content=""))],
         )
 
-    def text_chunk(self, text: str, n_tokens: int = 1) -> ChatCompletionChunk:
+    def text_chunk(self, text: str, n_tokens: int = 1,
+                   logprob_entries=None) -> ChatCompletionChunk:
         self.completion_tokens += n_tokens
         delta = ChoiceDelta(content=text)
         if self._first:
             delta.role = "assistant"
             self._first = False
+        from .protocols.openai import chat_logprobs
+
+        lp = chat_logprobs(logprob_entries)
         return ChatCompletionChunk(
             id=self.id,
             model=self.model,
             created=self.created,
-            choices=[StreamChoice(index=0, delta=delta)],
+            choices=[StreamChoice(index=0, delta=delta, logprobs=lp)],
         )
 
     def reasoning_chunk(self, text: str, n_tokens: int = 0) -> ChatCompletionChunk:
@@ -347,13 +361,17 @@ class CompletionDeltaGenerator:
         self.prompt_tokens = 0
         self.completion_tokens = 0
 
-    def text_chunk(self, text: str, n_tokens: int = 1) -> CompletionChunk:
+    def text_chunk(self, text: str, n_tokens: int = 1,
+                   logprob_entries=None) -> CompletionChunk:
         self.completion_tokens += n_tokens
+        from .protocols.openai import completion_logprobs
+
+        lp = completion_logprobs(logprob_entries)
         return CompletionChunk(
             id=self.id,
             model=self.model,
             created=self.created,
-            choices=[CompletionChoice(index=0, text=text)],
+            choices=[CompletionChoice(index=0, text=text, logprobs=lp)],
         )
 
     def finish_chunk(self, reason: str) -> CompletionChunk:

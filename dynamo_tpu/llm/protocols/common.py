@@ -146,6 +146,9 @@ class LLMEngineOutput:
     # set by the parsers/jail layer, not by engines
     tool_calls: Optional[List[Dict[str, Any]]] = None
     reasoning_content: Optional[str] = None
+    # set by the detokenizer backend when the request asked for logprobs:
+    # [{"token": <delta text>, "logprob": f}] aligned with token_ids
+    logprob_entries: Optional[List[Dict[str, Any]]] = None
 
     def to_dict(self) -> dict:
         d: Dict[str, Any] = {"token_ids": self.token_ids}
@@ -160,6 +163,7 @@ class LLMEngineOutput:
             "disagg_info",
             "tool_calls",
             "reasoning_content",
+            "logprob_entries",
         ):
             v = getattr(self, k)
             if v is not None:

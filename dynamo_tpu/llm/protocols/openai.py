@@ -142,6 +142,26 @@ class ChoiceLogProbs(BaseModel):
     content: Optional[List[LogProbEntry]] = None
 
 
+def chat_logprobs(entries) -> Optional[ChoiceLogProbs]:
+    """[{token, logprob}] (backend logprob_entries) → chat logprobs
+    object — the ONE builder every chat surface uses."""
+    if not entries:
+        return None
+    return ChoiceLogProbs(content=[LogProbEntry(**e) for e in entries])
+
+
+def completion_logprobs(entries) -> Optional[Dict[str, Any]]:
+    """[{token, logprob}] → the legacy completions logprobs object."""
+    if not entries:
+        return None
+    return {
+        "tokens": [e["token"] for e in entries],
+        "token_logprobs": [e["logprob"] for e in entries],
+        "top_logprobs": None,
+        "text_offset": None,
+    }
+
+
 class ChoiceDelta(BaseModel):
     model_config = ConfigDict(extra="allow")
     role: Optional[str] = None

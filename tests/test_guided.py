@@ -195,22 +195,29 @@ def test_preprocessor_rejects_unsupported_knobs():
         pre.preprocess_chat(_chat(n=3))
     with pytest.raises(ValueError, match="guided_grammar"):
         pre.preprocess_chat(_chat(nvext=NvExt(guided_grammar="g")))
-    with pytest.raises(ValueError, match="logprobs"):
-        pre.preprocess_chat(_chat(logprobs=True))
+    # chat logprobs=true is SUPPORTED (sampled-token logprob); top-k asks 400
+    out = pre.preprocess_chat(_chat(logprobs=True))
+    assert out.sampling_options.get("logprobs") is True
+    out = pre.preprocess_chat(_chat(logprobs=False))
+    assert "logprobs" not in out.sampling_options
+    with pytest.raises(ValueError, match="top_logprobs"):
+        pre.preprocess_chat(_chat(logprobs=True, top_logprobs=3))
     from dynamo_tpu.llm.protocols.openai import CompletionRequest
 
     with pytest.raises(ValueError, match="echo"):
         pre.preprocess_completion(
             CompletionRequest(model="m", prompt="x", echo=True)
         )
-    with pytest.raises(ValueError, match="logprobs"):
+    with pytest.raises(ValueError, match="logprobs > 0"):
         pre.preprocess_completion(
             CompletionRequest(model="m", prompt="x", logprobs=3)
         )
-    # pydantic coerces an explicit false to 0 on the int field: disabled
-    pre.preprocess_completion(
-        CompletionRequest(model="m", prompt="x", logprobs=False)
+    # legacy logprobs=0 == sampled-token logprob (note: an explicit false
+    # pydantic-coerces to 0 and also lands here — harmless extra field)
+    out = pre.preprocess_completion(
+        CompletionRequest(model="m", prompt="x", logprobs=0)
     )
+    assert out.sampling_options.get("logprobs") is True
     # valid guided request lands in the preprocessed payload
     out = pre.preprocess_chat(_chat(response_format={"type": "json_object"}))
     assert out.guided == {"kind": "json_object"}

@@ -48,6 +48,27 @@ def naive_next_token(params, tokens):
     return int(jnp.argmax(logits))
 
 
+def naive_logits(params, tokens):
+    """Full-recompute logits at the last position (logprob oracle)."""
+    n = len(tokens)
+    pages = (n + PAGE - 1) // PAGE + 1
+    kv_k, kv_v = alloc_kv_arrays(
+        CFG.num_layers, pages, PAGE, CFG.num_kv_heads, CFG.head_dim, CFG.dtype
+    )
+    table = jnp.arange(pages, dtype=jnp.int32)
+    logits, _, _ = llama.prefill_forward(
+        params,
+        CFG,
+        jnp.asarray(tokens, jnp.int32),
+        jnp.arange(n, dtype=jnp.int32),
+        kv_k,
+        kv_v,
+        table,
+        jnp.asarray(0, jnp.int32),
+    )
+    return logits
+
+
 def test_greedy_decode_matches_full_recompute(params):
     """Engine (prefill once + paged decode steps) == naive recompute."""
     prompt = [5, 9, 17, 33, 101, 7, 250, 3]
@@ -222,6 +243,68 @@ def test_burst_same_prefix_reuses_inflight_blocks(params):
         assert hits > 0, "no in-flight prefix reuse in a same-prefix burst"
 
     asyncio.run(main())
+
+
+def test_greedy_logprobs_match_full_recompute(params):
+    """sampling_options.logprobs: every emitted token carries its
+    raw-model logprob, equal to log_softmax of a naive full-recompute
+    forward at that position (prefill first token AND fused-block decode
+    steps)."""
+    prompt = [5, 9, 17, 33, 101, 7, 250, 3]
+    n_steps = 6
+
+    async def main():
+        cfg = EngineConfig(
+            model="tiny", max_num_seqs=4, page_size=PAGE, num_pages=64,
+            max_model_len=128, prefill_buckets=(16, 32),
+        )
+        eng = JaxEngine(cfg, model_config=CFG, params=params)
+        req = PreprocessedRequest(
+            token_ids=prompt,
+            stop_conditions={"max_tokens": n_steps, "ignore_eos": True},
+            sampling_options={"logprobs": True},
+            request_id="lp",
+        ).to_dict()
+        toks, lps = [], []
+        async for item in eng.generate(req, Context()):
+            data = item.get("data")
+            if data:
+                toks.extend(data["token_ids"])
+                lps.extend(data.get("log_probs") or [])
+        await eng.close()
+        return toks, lps
+
+    toks, lps = asyncio.run(main())
+    assert len(lps) == len(toks) == n_steps
+    seq = list(prompt)
+    for tok, lp in zip(toks, lps):
+        logits = naive_logits(params, seq)
+        want = float(
+            jax.nn.log_softmax(jnp.asarray(logits, jnp.float32))[tok]
+        )
+        assert abs(lp - want) < 2e-3, (tok, lp, want)
+        seq.append(tok)
+
+    # without the flag: no log_probs on the wire
+    async def plain():
+        cfg = EngineConfig(
+            model="tiny", max_num_seqs=4, page_size=PAGE, num_pages=64,
+            max_model_len=128, prefill_buckets=(16, 32),
+        )
+        eng = JaxEngine(cfg, model_config=CFG, params=params)
+        req = PreprocessedRequest(
+            token_ids=prompt,
+            stop_conditions={"max_tokens": 2, "ignore_eos": True},
+            request_id="nolp",
+        ).to_dict()
+        outs = []
+        async for item in eng.generate(req, Context()):
+            if item.get("data"):
+                outs.append(item["data"])
+        await eng.close()
+        return outs
+
+    assert all("log_probs" not in o for o in asyncio.run(plain()))
 
 
 def test_cancellation_releases_pages(params):
