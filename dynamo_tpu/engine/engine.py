@@ -224,6 +224,7 @@ class _Slot:
     guided_state: int = 0  # current FSM state; advanced per emitted token
     lora_idx: int = 0  # adapter slot in the engine's LoRA stack (0 = base)
     want_logprobs: bool = False  # attach sampled-token logprobs to emissions
+    want_top_logprobs: int = 0  # top-k alternatives per token (max 5)
 
 
 class JaxEngine:
@@ -484,10 +485,10 @@ class JaxEngine:
                         params, c, tokens, positions, loc_k, loc_v, j,
                         kv_k, kv_v, page_tables, pool_lens,
                     )
-                    nxt, lp = sample_lp(logits, samp, key_j)
+                    nxt, lp, tid, tlp = sample_lp(logits, samp, key_j)
                     return (
                         (nxt, positions + 1, seq_lens + 1, loc_k, loc_v),
-                        (nxt, lp),
+                        (nxt, lp, tid, tlp),
                     )
 
                 (tokens, positions, seq_lens, loc_k, loc_v), toks = jax.lax.scan(
@@ -535,10 +536,10 @@ class JaxEngine:
                         logits, kv_k, kv_v = self._model.decode_forward(
                             params, c, tokens, positions, kv_k, kv_v, page_tables, seq_lens
                         )
-                    nxt, lp = sample_lp(logits, samp, k)
+                    nxt, lp, tid, tlp = sample_lp(logits, samp, k)
                     return (
                         (nxt, positions + 1, seq_lens + 1, kv_k, kv_v),
-                        (nxt, lp),
+                        (nxt, lp, tid, tlp),
                     )
 
                 (tokens, positions, seq_lens, kv_k, kv_v), toks = jax.lax.scan(
@@ -676,10 +677,10 @@ class JaxEngine:
                     params, c, tokens, positions, kv_k, kv_v, page_tables, seq_lens
                 )
             mask = unpack_mask(mask_packed, c.vocab_size)
-            nxt, lp = sample_lp(logits, samp, sub, mask=mask)
+            nxt, lp, tid, tlp = sample_lp(logits, samp, sub, mask=mask)
             return (
-                (nxt[None], lp[None]), nxt, positions + 1, seq_lens + 1,
-                kv_k, kv_v, rng,
+                (nxt[None], lp[None], tid[None], tlp[None]),
+                nxt, positions + 1, seq_lens + 1, kv_k, kv_v, rng,
             )
 
         self._decode_step_guided = decode_step_guided
@@ -698,10 +699,10 @@ class JaxEngine:
                 seq_lens, lora=lora,
             )
             mask = unpack_mask(mask_packed, c.vocab_size)
-            nxt, lp = sample_lp(logits, samp, sub, mask=mask)
+            nxt, lp, tid, tlp = sample_lp(logits, samp, sub, mask=mask)
             return (
-                (nxt[None], lp[None]), nxt, positions + 1, seq_lens + 1,
-                kv_k, kv_v, rng,
+                (nxt[None], lp[None], tid[None], tlp[None]),
+                nxt, positions + 1, seq_lens + 1, kv_k, kv_v, rng,
             )
 
         self._decode_step_guided_lora = decode_step_guided_lora
@@ -739,10 +740,10 @@ class JaxEngine:
                     params, c, tokens, positions, kv_k, kv_v, page_tables,
                     seq_lens, lora=lora,
                 )
-                nxt, lp = sample_lp(logits, samp, key_j)
+                nxt, lp, tid, tlp = sample_lp(logits, samp, key_j)
                 return (
                     (nxt, positions + 1, seq_lens + 1, kv_k, kv_v),
-                    (nxt, lp),
+                    (nxt, lp, tid, tlp),
                 )
 
             (tokens, positions, seq_lens, kv_k, kv_v), toks = jax.lax.scan(
@@ -1128,6 +1129,7 @@ class JaxEngine:
         slot.top_k = int(sampling.get("top_k") or 0)
         slot.top_p = float(sampling.get("top_p") or 1.0)
         slot.want_logprobs = bool(sampling.get("logprobs"))
+        slot.want_top_logprobs = min(int(sampling.get("top_logprobs") or 0), 5)
         if req.guided:
             slot.guided_fsm = (
                 getattr(req, "_compiled_fsm", None)
@@ -2464,8 +2466,20 @@ class JaxEngine:
         ps = np.arange(max(0, L1 - Hc), L1)
         row[ps % Hc] = toks[ps]
 
+    def _top_entry(self, slot: _Slot, tids, tlps) -> Optional[dict]:
+        """Top-k alternatives for one emitted token, sliced to the
+        request's ask (None when not requested — zero overhead)."""
+        n = slot.want_top_logprobs
+        if not n:
+            return None
+        return {
+            "ids": [int(t) for t in tids[:n]],
+            "logprobs": [float(v) for v in tlps[:n]],
+        }
+
     def _finish_prefill(self, slot: _Slot, first: int,
-                        first_lp: Optional[float] = None):
+                        first_lp: Optional[float] = None,
+                        first_top: Optional[dict] = None):
         """Prompt KV fully computed; activate the slot for decode."""
         self._commit_blocks(slot)
         if slot.done or slot.context.is_stopped():
@@ -2487,7 +2501,7 @@ class JaxEngine:
             slot.guided_state = slot.guided_fsm.advance(
                 slot.guided_state, first
             )
-        self._emit_token(slot, first, first_lp)
+        self._emit_token(slot, first, first_lp, first_top)
         if not slot.done:
             slot.last_token = first
             slot.generated = 1
@@ -2499,7 +2513,8 @@ class JaxEngine:
             self._maybe_finish(slot, first)
 
     async def _emit_prefill_result(self, slot: _Slot, first_token: int,
-                                   first_lp: Optional[float] = None):
+                                   first_lp: Optional[float] = None,
+                                   first_top: Optional[dict] = None):
         from ..llm.disagg import pack_kv_payload
 
         cfg = self.config
@@ -2515,7 +2530,8 @@ class JaxEngine:
             # fast path: stage the pages on the data plane and return only a
             # descriptor — the decode worker pulls chunks while we keep
             # serving; pages stay pinned until the pull finishes (or TTL)
-            self._stage_kv_pull(slot, first_token, page_ids, first_lp)
+            self._stage_kv_pull(slot, first_token, page_ids, first_lp,
+                                first_top)
             return
 
         self._bcast("extract", {"page_ids": page_ids})
@@ -2526,6 +2542,7 @@ class JaxEngine:
                 token_ids=[first_token],
                 log_probs=[first_lp]
                 if (slot.want_logprobs and first_lp is not None) else None,
+                top_logprobs=[first_top] if first_top else None,
                 finish_reason="remote_prefill_done",
                 kv_transfer_params=payload,
             ).to_dict()
@@ -2536,7 +2553,8 @@ class JaxEngine:
 
     def _stage_kv_pull(self, slot: _Slot, first_token: int,
                        page_ids: np.ndarray,
-                       first_lp: Optional[float] = None):
+                       first_lp: Optional[float] = None,
+                       first_top: Optional[dict] = None):
         """Pin the finished prefill's pages on the data plane and answer with
         a descriptor. The extract callback gathers page CHUNKS lazily as the
         decode worker pulls, so the device gather overlaps the network (and
@@ -2619,6 +2637,7 @@ class JaxEngine:
             token_ids=[first_token],
             log_probs=[first_lp]
             if (slot.want_logprobs and first_lp is not None) else None,
+            top_logprobs=[first_top] if first_top else None,
             finish_reason="remote_prefill_done",
             kv_transfer_params={"pull": desc.to_dict()},
         ).to_dict()
@@ -2966,16 +2985,17 @@ class JaxEngine:
                     # mid-prompt: commit the chunk's full pages now so
                     # concurrent same-prefix requests can skip ahead
                     self._commit_blocks(slot, upto_tokens=upto)
-            first_toks, first_lps = first
+            first_toks, first_lps, first_tids, first_tlps = first
             for slot, lane in p["done"]:
                 if slot.slot_idx < 0 or self.slots[slot.slot_idx] is not slot:
                     continue  # released meanwhile (cancel)
                 tok = int(first_toks[lane])
                 lp = float(first_lps[lane])
+                top = self._top_entry(slot, first_tids[lane], first_tlps[lane])
                 if slot.return_kv:
-                    await self._emit_prefill_result(slot, tok, lp)
+                    await self._emit_prefill_result(slot, tok, lp, top)
                 else:
-                    self._finish_prefill(slot, tok, lp)
+                    self._finish_prefill(slot, tok, lp, top)
 
         if want_block is not None:
             self._inflight.popleft()
@@ -3036,7 +3056,8 @@ class JaxEngine:
                     break
 
     def _process_block(self, lanes: List[tuple], toks: np.ndarray,
-                       lps: np.ndarray):
+                       lps: np.ndarray, tids: np.ndarray,
+                       tlps: np.ndarray):
         """Emit a fetched K-step block: per lane, append/emit tokens until a
         stop condition; excess speculated tokens are discarded. Lanes whose
         slot was preempted/released (or re-assigned) meanwhile are skipped —
@@ -3061,7 +3082,10 @@ class JaxEngine:
                     slot.guided_state = slot.guided_fsm.advance(
                         slot.guided_state, tok
                     )
-                self._emit_token(slot, tok, float(lps[k, i]))
+                self._emit_token(
+                    slot, tok, float(lps[k, i]),
+                    self._top_entry(slot, tids[k, i], tlps[k, i]),
+                )
                 self._maybe_finish(slot, tok)
                 if slot.done:
                     break
@@ -3091,12 +3115,14 @@ class JaxEngine:
     # -- emission / teardown --------------------------------------------- #
 
     def _emit_token(self, slot: _Slot, token: int,
-                    lp: Optional[float] = None):
+                    lp: Optional[float] = None,
+                    top: Optional[dict] = None):
         if slot.done:
             return
         out = LLMEngineOutput(
             token_ids=[token],
             log_probs=[lp] if (slot.want_logprobs and lp is not None) else None,
+            top_logprobs=[top] if top else None,
         ).to_dict()
         slot.queue.put_nowait(Annotated(data=out).to_dict())
 

@@ -42,6 +42,17 @@ class SamplingParams(NamedTuple):
         )
 
 
+def _candidates(logits: jax.Array) -> tuple:
+    """Top-TOPK_CAP candidate set per row (sorted desc). approx_max_k is
+    the TPU-native tiled reduction (recall ~1.0 at K=64 over 128k vocab)
+    — exact top_k lowers to a full sort and dominated the decode step's
+    fixed overhead. The max (candidate 0) is always exact."""
+    V = logits.shape[-1]
+    if V > 4096:
+        return jax.lax.approx_max_k(logits, min(TOPK_CAP, V))
+    return jax.lax.top_k(logits, min(TOPK_CAP, V))
+
+
 def sample(
     logits: jax.Array,  # [B, V] f32
     params: SamplingParams,
@@ -55,14 +66,7 @@ def sample(
         # vocabulary only (llm/guided.py token FSM masks)
         logits = jnp.where(mask, logits, -1e30)
     B, V = logits.shape
-    # candidate set: top TOPK_CAP logits per row. approx_max_k is the
-    # TPU-native tiled reduction (recall ~1.0 at K=64 over 128k vocab) —
-    # exact top_k lowers to a full sort and dominated the decode step's
-    # fixed overhead. Greedy == candidate 0 (the max is always exact).
-    if V > 4096:
-        cand_logits, cand_idx = jax.lax.approx_max_k(logits, min(TOPK_CAP, V))
-    else:
-        cand_logits, cand_idx = jax.lax.top_k(logits, min(TOPK_CAP, V))
+    cand_logits, cand_idx = _candidates(logits)
     greedy_tokens = cand_idx[:, 0]
     K = cand_logits.shape[1]
 
@@ -89,22 +93,37 @@ def sample(
     return jnp.where(params.temperature <= 0.0, greedy_tokens, sampled_tokens)
 
 
+TOP_LOGPROBS_N = 5  # OpenAI caps top_logprobs alternatives at 5
+
+
 def sample_lp(
     logits: jax.Array,  # [B, V] f32
     params: SamplingParams,
     key: jax.Array,
     mask: jax.Array = None,
 ) -> tuple:
-    """sample() + the chosen token's RAW-model logprob (log-softmax of the
-    unscaled, unmasked logits — the OpenAI `logprobs` surface; under
-    guided masks this honestly reports how (un)likely the forced token
-    was). Returns (tokens [B] i32, logprobs [B] f32)."""
+    """sample() + RAW-model logprobs (log-softmax of the unscaled,
+    unmasked logits — the OpenAI `logprobs` surface; under guided masks
+    this honestly reports how (un)likely the forced token was).
+
+    Returns (tokens [B] i32, logprobs [B] f32,
+             top_ids [B, 5] i32, top_lps [B, 5] f32) — the top-5
+    alternatives serve chat `top_logprobs` / legacy completions
+    `logprobs=k`; the host slices to the requested k.
+
+    Cost discipline: alternatives come from the RAW logits' candidate
+    set (the same approx-top-K reduction sample() uses — no full-vocab
+    sort on the step path); the only full-vocab extra is one logsumexp
+    pass for normalization."""
     tokens = sample(logits, params, key, mask=mask)
-    logz = jax.scipy.special.logsumexp(logits.astype(jnp.float32), axis=-1)
-    chosen = jnp.take_along_axis(
-        logits.astype(jnp.float32), tokens[:, None], axis=-1
-    )[:, 0]
-    return tokens, chosen - logz
+    raw = logits.astype(jnp.float32)
+    logz = jax.scipy.special.logsumexp(raw, axis=-1)
+    chosen = jnp.take_along_axis(raw, tokens[:, None], axis=-1)[:, 0]
+    k = min(TOP_LOGPROBS_N, raw.shape[-1])
+    cand_logits, cand_idx = _candidates(raw)
+    top_ids = cand_idx[:, :k]
+    top_vals = cand_logits[:, :k]
+    return tokens, chosen - logz, top_ids, top_vals - logz[:, None]
 
 
 def apply_logit_penalties(

@@ -72,6 +72,7 @@ async def maybe_remote_prefill(
 
     first_token = None
     first_lp = None
+    first_top = None
     kv_payload = None
     try:
         router = PushRouter(prefill_client, RouterMode.ROUND_ROBIN)
@@ -83,6 +84,7 @@ async def maybe_remote_prefill(
                 if data.get("token_ids"):
                     first_token = data["token_ids"][0]
                     first_lp = (data.get("log_probs") or [None])[0]
+                    first_top = (data.get("top_logprobs") or [None])[0]
     except (StreamLost, EngineError) as e:
         logger.warning("remote prefill failed (%s); falling back to local", e)
 
@@ -100,6 +102,7 @@ async def maybe_remote_prefill(
     yield Annotated(data=LLMEngineOutput(
         token_ids=[first_token],
         log_probs=[first_lp] if first_lp is not None else None,
+        top_logprobs=[first_top] if first_top else None,
     ).to_dict()).to_dict()
     if "pull" in kv_payload:
         # fast path: descriptor only — stream-inject from the prefill

@@ -102,11 +102,25 @@ class Backend(Operator):
                 if delta:
                     text_parts.append(delta)
                 if out.log_probs is not None and idx < len(out.log_probs):
-                    # per-token pairing happens HERE — the only layer that
-                    # sees both the token's text delta and its logprob
-                    lp_entries.append(
-                        {"token": delta or "", "logprob": out.log_probs[idx]}
-                    )
+                    # per-token pairing happens HERE. The entry's token
+                    # string decodes the id directly — the incremental
+                    # delta can be empty (multi-byte UTF-8 split, stop-
+                    # string holdback) and entries must stay 1:1 with
+                    # tokens for legacy-completions alignment
+                    entry = {"token": self.tokenizer.decode([tok]),
+                             "logprob": out.log_probs[idx]}
+                    tops = out.top_logprobs
+                    if tops and idx < len(tops) and tops[idx]:
+                        entry["top_logprobs"] = [
+                            {
+                                "token": self.tokenizer.decode([tid]),
+                                "logprob": tlp,
+                            }
+                            for tid, tlp in zip(
+                                tops[idx]["ids"], tops[idx]["logprobs"]
+                            )
+                        ]
+                    lp_entries.append(entry)
                 if hit:
                     stopped = True
                     break

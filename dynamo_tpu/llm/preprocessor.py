@@ -206,23 +206,23 @@ class OpenAIPreprocessor:
             raise ValueError("logit_bias is not supported")
         if (getattr(request, "n", None) or 1) > 1:
             raise ValueError("n > 1 is not supported; issue parallel requests")
-        # logprobs: the engine reports the SAMPLED token's raw-model
-        # logprob (chat `logprobs: true`; completions `logprobs: 0`, whose
-        # legacy meaning is exactly that). Top-K alternatives are not
-        # computed — completions logprobs>0 and chat top_logprobs 400.
-        # Note: pydantic coerces completions `logprobs: false` to 0, which
-        # therefore ALSO enables the (harmless) sampled-token logprobs.
+        # logprobs: raw-model logprob of each sampled token, plus up to 5
+        # top alternatives (chat `logprobs: true` + `top_logprobs: n`;
+        # completions `logprobs: k` — its legacy top-k meaning, k=0 =
+        # sampled-token only; an explicit false parses as StrictBool and
+        # stays disabled).
         logprobs = getattr(request, "logprobs", None)
-        if isinstance(logprobs, int) and not isinstance(logprobs, bool) \
-                and logprobs > 0:
-            raise ValueError(
-                "logprobs > 0 (top-k alternatives) is not supported; "
-                "logprobs: 0 returns the sampled token's logprob"
-            )
+        top_n = getattr(request, "top_logprobs", None) or 0
+        if isinstance(logprobs, int) and not isinstance(logprobs, bool):
+            top_n = max(top_n, logprobs)  # completions legacy top-k ask
+        if top_n > 5:
+            raise ValueError("top_logprobs is capped at 5")
+        if top_n and logprobs in (None, False):
+            raise ValueError("top_logprobs requires logprobs to be set")
         if logprobs is not None and logprobs is not False:
             sampling["logprobs"] = True
-        if getattr(request, "top_logprobs", None):
-            raise ValueError("top_logprobs is not supported yet")
+            if top_n:
+                sampling["top_logprobs"] = int(top_n)
         if getattr(request, "echo", False):
             raise ValueError("echo is not supported")
         if getattr(request, "suffix", None):

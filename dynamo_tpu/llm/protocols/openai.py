@@ -11,7 +11,7 @@ from __future__ import annotations
 import time
 from typing import Any, Dict, List, Literal, Optional, Union
 
-from pydantic import BaseModel, ConfigDict, Field
+from pydantic import BaseModel, ConfigDict, Field, StrictBool
 
 
 class NvExt(BaseModel):
@@ -111,7 +111,9 @@ class CompletionRequest(BaseModel):
     n: Optional[int] = 1
     stream: Optional[bool] = False
     stream_options: Optional[StreamOptions] = None
-    logprobs: Optional[int] = None
+    # StrictBool first: an explicit `false` must survive parsing as False
+    # (plain int would coerce it to 0 == the legacy sampled-token ask)
+    logprobs: Optional[Union[StrictBool, int]] = None
     echo: Optional[bool] = False
     stop: Optional[Union[str, List[str]]] = None
     presence_penalty: Optional[float] = None
@@ -154,11 +156,21 @@ def completion_logprobs(entries) -> Optional[Dict[str, Any]]:
     """[{token, logprob}] → the legacy completions logprobs object."""
     if not entries:
         return None
+    tops = None
+    if any(e.get("top_logprobs") for e in entries):
+        tops = [
+            {t["token"]: t["logprob"] for t in e.get("top_logprobs") or []}
+            for e in entries
+        ]
+    offsets, pos = [], 0
+    for e in entries:
+        offsets.append(pos)
+        pos += len(e["token"])
     return {
         "tokens": [e["token"] for e in entries],
         "token_logprobs": [e["logprob"] for e in entries],
-        "top_logprobs": None,
-        "text_offset": None,
+        "top_logprobs": tops,
+        "text_offset": offsets,
     }
 
 

@@ -195,29 +195,42 @@ def test_preprocessor_rejects_unsupported_knobs():
         pre.preprocess_chat(_chat(n=3))
     with pytest.raises(ValueError, match="guided_grammar"):
         pre.preprocess_chat(_chat(nvext=NvExt(guided_grammar="g")))
-    # chat logprobs=true is SUPPORTED (sampled-token logprob); top-k asks 400
+    # chat logprobs + top_logprobs (n<=5) are SUPPORTED
     out = pre.preprocess_chat(_chat(logprobs=True))
     assert out.sampling_options.get("logprobs") is True
     out = pre.preprocess_chat(_chat(logprobs=False))
     assert "logprobs" not in out.sampling_options
-    with pytest.raises(ValueError, match="top_logprobs"):
-        pre.preprocess_chat(_chat(logprobs=True, top_logprobs=3))
+    out = pre.preprocess_chat(_chat(logprobs=True, top_logprobs=3))
+    assert out.sampling_options.get("top_logprobs") == 3
+    with pytest.raises(ValueError, match="capped at 5"):
+        pre.preprocess_chat(_chat(logprobs=True, top_logprobs=9))
+    with pytest.raises(ValueError, match="requires logprobs"):
+        pre.preprocess_chat(_chat(top_logprobs=3))
     from dynamo_tpu.llm.protocols.openai import CompletionRequest
 
     with pytest.raises(ValueError, match="echo"):
         pre.preprocess_completion(
             CompletionRequest(model="m", prompt="x", echo=True)
         )
-    with pytest.raises(ValueError, match="logprobs > 0"):
+    # legacy completions logprobs=k == top-k; 0 == sampled-token only
+    out = pre.preprocess_completion(
+        CompletionRequest(model="m", prompt="x", logprobs=3)
+    )
+    assert out.sampling_options.get("top_logprobs") == 3
+    with pytest.raises(ValueError, match="capped at 5"):
         pre.preprocess_completion(
-            CompletionRequest(model="m", prompt="x", logprobs=3)
+            CompletionRequest(model="m", prompt="x", logprobs=7)
         )
-    # legacy logprobs=0 == sampled-token logprob (note: an explicit false
-    # pydantic-coerces to 0 and also lands here — harmless extra field)
     out = pre.preprocess_completion(
         CompletionRequest(model="m", prompt="x", logprobs=0)
     )
     assert out.sampling_options.get("logprobs") is True
+    assert "top_logprobs" not in out.sampling_options
+    # explicit false survives as StrictBool -> disabled (not coerced to 0)
+    out = pre.preprocess_completion(
+        CompletionRequest(model="m", prompt="x", logprobs=False)
+    )
+    assert "logprobs" not in out.sampling_options
     # valid guided request lands in the preprocessed payload
     out = pre.preprocess_chat(_chat(response_format={"type": "json_object"}))
     assert out.guided == {"kind": "json_object"}

@@ -262,27 +262,36 @@ def test_greedy_logprobs_match_full_recompute(params):
         req = PreprocessedRequest(
             token_ids=prompt,
             stop_conditions={"max_tokens": n_steps, "ignore_eos": True},
-            sampling_options={"logprobs": True},
+            sampling_options={"logprobs": True, "top_logprobs": 3},
             request_id="lp",
         ).to_dict()
-        toks, lps = [], []
+        toks, lps, tops = [], [], []
         async for item in eng.generate(req, Context()):
             data = item.get("data")
             if data:
                 toks.extend(data["token_ids"])
                 lps.extend(data.get("log_probs") or [])
+                tops.extend(data.get("top_logprobs") or [])
         await eng.close()
-        return toks, lps
+        return toks, lps, tops
 
-    toks, lps = asyncio.run(main())
-    assert len(lps) == len(toks) == n_steps
+    toks, lps, tops = asyncio.run(main())
+    assert len(lps) == len(toks) == len(tops) == n_steps
     seq = list(prompt)
-    for tok, lp in zip(toks, lps):
+    for tok, lp, top in zip(toks, lps, tops):
         logits = naive_logits(params, seq)
-        want = float(
-            jax.nn.log_softmax(jnp.asarray(logits, jnp.float32))[tok]
-        )
+        lsm = jax.nn.log_softmax(jnp.asarray(logits, jnp.float32))
+        want = float(lsm[tok])
         assert abs(lp - want) < 2e-3, (tok, lp, want)
+        # top-3 alternatives match the oracle's top-3 (greedy: top1 == tok)
+        assert len(top["ids"]) == 3
+        oracle_top = np.asarray(jnp.argsort(-lsm)[:3])
+        assert top["ids"] == [int(x) for x in oracle_top], (
+            top["ids"], oracle_top,
+        )
+        assert top["ids"][0] == tok
+        for tid, tlp in zip(top["ids"], top["logprobs"]):
+            assert abs(tlp - float(lsm[tid])) < 2e-3
         seq.append(tok)
 
     # without the flag: no log_probs on the wire
