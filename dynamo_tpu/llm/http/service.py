@@ -59,6 +59,11 @@ def _content_text(message: dict) -> str:
     )
 
 
+# chat n>1 fan-out bound (OpenAI caps n at 128; engine slots are the real
+# limit here — one HTTP request must not monopolize the worker batch)
+MAX_N_CHOICES = 8
+
+
 def _sse(data: str) -> bytes:
     return f"data: {data}\n\n".encode()
 
@@ -417,22 +422,76 @@ class HttpService:
         except ValueError as e:
             self.metrics.request_end(req.model, "chat", t0, error=True)
             return self._error(400, str(e))
-        gen = ChatDeltaGenerator(
-            req.model,
-            pre.request_id,
-            include_usage=bool(req.stream_options and req.stream_options.include_usage),
+        include_usage = bool(
+            req.stream_options and req.stream_options.include_usage
         )
-        gen.prompt_tokens = len(pre.token_ids)
-        stream = pipeline.generate_preprocessed(pre, ctx)
-        # structured-output jail: hold tool-call/reasoning tokens out of the
-        # content stream and release them parsed (parsers/jail.py)
         rc = pipeline.card.runtime_config
         tool_parser = rc.get("tool_call_parser") if req.tools else None
         reasoning_parser = rc.get("reasoning_parser")
-        if tool_parser or reasoning_parser:
-            stream = JailedStream(
-                stream, tool_parser=tool_parser, reasoning_parser=reasoning_parser
-            ).__aiter__()
+
+        def mk_stream(p, c=None):
+            s = pipeline.generate_preprocessed(p, c or ctx)
+            # structured-output jail: hold tool-call/reasoning tokens out
+            # of the content stream, release them parsed (parsers/jail.py)
+            if tool_parser or reasoning_parser:
+                s = JailedStream(
+                    s, tool_parser=tool_parser,
+                    reasoning_parser=reasoning_parser,
+                ).__aiter__()
+            return s
+
+        n = req.n or 1
+        if n > MAX_N_CHOICES:
+            self.metrics.request_end(req.model, "chat", t0, error=True)
+            return self._error(
+                400, f"n is capped at {MAX_N_CHOICES} (got {n})"
+            )
+        if n > 1:
+            # parallel sampling: n engine requests over the SAME prompt —
+            # the prefix cache + in-flight skip-ahead dedupe the prompt
+            # compute, so choices cost ~decode only (vLLM n>1 role).
+            # Each choice runs under its OWN child context: a stop-string
+            # hit on one choice must not cancel its siblings (parent
+            # kill/stop still propagates to all).
+            import dataclasses as _dc
+
+            pres = []
+            for i in range(n):
+                p = _dc.replace(
+                    pre,
+                    request_id=f"{pre.request_id}-{i}",
+                    sampling_options=dict(pre.sampling_options),
+                )
+                seed = p.sampling_options.get("seed")
+                if seed is not None:
+                    p.sampling_options["seed"] = int(seed) + i
+                pres.append(p)
+            gens = [
+                ChatDeltaGenerator(
+                    req.model, pre.request_id,
+                    include_usage=include_usage, index=i,
+                )
+                for i in range(n)
+            ]
+            for g in gens:
+                g.prompt_tokens = len(pre.token_ids)
+            streams = [mk_stream(p, ctx.child()) for p in pres]
+            try:
+                if req.stream:
+                    return await self._stream_chat_multi(
+                        request, req, streams, gens, ctx, t0
+                    )
+                return await self._unary_chat_multi(
+                    req, streams, gens, ctx, t0
+                )
+            finally:
+                ctx.stop_generating()
+
+        gen = ChatDeltaGenerator(
+            req.model, pre.request_id, include_usage=include_usage,
+        )
+        gen.prompt_tokens = len(pre.token_ids)
+        stream = mk_stream(pre)
         try:
             if req.stream:
                 return await self._stream_chat(request, req, stream, gen, ctx, t0)
@@ -443,30 +502,58 @@ class HttpService:
     async def _stream_chat(
         self, http_req, req, stream: AsyncIterator[Annotated], gen, ctx: Context, t0
     ) -> web.StreamResponse:
+        """Single-choice streaming == the multi path with one stream (kept
+        as an alias so chunk-handling fixes live in ONE place)."""
+        return await self._stream_chat_multi(
+            http_req, req, [stream], [gen], ctx, t0
+        )
+
+    async def _stream_chat_multi(
+        self, http_req, req, streams, gens, ctx: Context, t0
+    ) -> web.StreamResponse:
+        """n>1 streaming: merge the per-choice streams into one SSE flow;
+        every chunk carries its choice index (OpenAI multi-choice chunks)."""
         resp = web.StreamResponse(
             status=200,
-            headers={
-                "Content-Type": "text/event-stream",
-                "Cache-Control": "no-cache",
-                "Connection": "keep-alive",
-            },
+            headers={"Content-Type": "text/event-stream",
+                     "Cache-Control": "no-cache"},
         )
         await resp.prepare(http_req)
-        first_token_at: Optional[float] = None
-        last_token_at: Optional[float] = None
+        n = len(streams)
+        queue: asyncio.Queue = asyncio.Queue()
+
+        async def pump(i, s):
+            try:
+                async for ann in s:
+                    await queue.put((i, ann))
+            finally:
+                await queue.put((i, None))
+
+        tasks = [asyncio.create_task(pump(i, s)) for i, s in enumerate(streams)]
+        first_token_at = None
+        last_token_at = None
         error = False
+        done = 0
+        finished = [False] * n
         try:
-            finish_sent = False
-            async for ann in stream:
+            while done < n:
+                i, ann = await queue.get()
+                gen = gens[i]
+                if ann is None:
+                    done += 1
+                    if not finished[i] and not error:
+                        await resp.write(_sse(
+                            gen.finish_chunk("stop").model_dump_json(
+                                exclude_none=True)))
+                        finished[i] = True
+                    continue
                 if ann.is_error():
                     error = True
                     msg = (ann.comment or ["engine error"])[0]
-                    await resp.write(_sse(json.dumps({"error": {"message": msg}})))
+                    await resp.write(
+                        _sse(json.dumps({"error": {"message": msg}})))
                     break
                 if ann.event is not None:
-                    # annotation (kv-hit-rate, worker id): SSE comment line —
-                    # spec-compliant clients ignore it, harness tests parse it
-                    # (reference Annotated SSE events)
                     await resp.write(
                         f": {ann.event} {json.dumps(ann.comment)}\n\n".encode()
                     )
@@ -476,48 +563,190 @@ class HttpService:
                     last_token_at = time.monotonic()
                     if first_token_at is None:
                         first_token_at = last_token_at
-                        self.metrics.observe_ttft(req.model, first_token_at - t0)
+                        self.metrics.observe_ttft(
+                            req.model, first_token_at - t0)
                 if out.reasoning_content:
-                    # token accounting happens below (text_chunk or the elif)
-                    await resp.write(
-                        _sse(gen.reasoning_chunk(out.reasoning_content).model_dump_json(exclude_none=True))
-                    )
+                    await resp.write(_sse(gen.reasoning_chunk(
+                        out.reasoning_content).model_dump_json(
+                            exclude_none=True)))
                 if out.tool_calls:
-                    await resp.write(
-                        _sse(gen.tool_calls_chunk(out.tool_calls).model_dump_json(exclude_none=True))
-                    )
+                    await resp.write(_sse(gen.tool_calls_chunk(
+                        out.tool_calls).model_dump_json(exclude_none=True)))
                 if out.text or out.logprob_entries:
-                    await resp.write(
-                        _sse(gen.text_chunk(
-                            out.text or "", len(out.token_ids),
-                            logprob_entries=out.logprob_entries,
-                        ).model_dump_json(exclude_none=True))
-                    )
+                    await resp.write(_sse(gen.text_chunk(
+                        out.text or "", len(out.token_ids),
+                        logprob_entries=out.logprob_entries,
+                    ).model_dump_json(exclude_none=True)))
                 elif out.token_ids:
                     gen.completion_tokens += len(out.token_ids)
-                if out.finish_reason:
-                    await resp.write(
-                        _sse(gen.finish_chunk(out.finish_reason).model_dump_json(exclude_none=True))
-                    )
-                    finish_sent = True
-                    break
-            if not error and not finish_sent:
-                await resp.write(_sse(gen.finish_chunk("stop").model_dump_json(exclude_none=True)))
-            if not error and gen.include_usage:
-                await resp.write(_sse(gen.usage_chunk().model_dump_json(exclude_none=True)))
+                if out.finish_reason and not finished[i]:
+                    await resp.write(_sse(gen.finish_chunk(
+                        out.finish_reason).model_dump_json(
+                            exclude_none=True)))
+                    finished[i] = True
+            if not error and gens[0].include_usage:
+                usage = gens[0].usage_chunk()
+                usage.usage.completion_tokens = sum(
+                    g.completion_tokens for g in gens)
+                usage.usage.total_tokens = (
+                    gens[0].prompt_tokens + usage.usage.completion_tokens)
+                await resp.write(_sse(usage.model_dump_json(exclude_none=True)))
             await resp.write(b"data: [DONE]\n\n")
         except (ConnectionResetError, asyncio.CancelledError):
-            # client went away: hard-cancel the pipeline (reference disconnect.rs)
             ctx.kill()
             self.metrics.client_disconnect(req.model)
             raise
         finally:
+            for t in tasks:
+                t.cancel()
             self.metrics.request_end(
-                req.model, "chat", t0, error=error, output_tokens=gen.completion_tokens,
-                input_tokens=gen.prompt_tokens, first_token_at=first_token_at,
-                last_token_at=last_token_at,
+                req.model, "chat", t0, error=error,
+                output_tokens=sum(g.completion_tokens for g in gens),
+                input_tokens=gens[0].prompt_tokens,
+                first_token_at=first_token_at, last_token_at=last_token_at,
             )
         return resp
+
+    async def _unary_chat_multi(
+        self, req, streams, gens, ctx: Context, t0
+    ) -> web.Response:
+        """n>1 non-streamed: collect every choice, answer once."""
+        from ..protocols.openai import chat_logprobs
+
+        async def collect(s):
+            texts, reasoning, tools, lp_entries = [], [], [], []
+            finish, n_out, err = "stop", 0, None
+            async for ann in s:
+                if ann.is_error():
+                    err = (ann.comment or ["engine error"])[0]
+                    break
+                if ann.event is not None:
+                    continue
+                out: LLMEngineOutput = ann.data
+                n_out += len(out.token_ids)
+                if out.reasoning_content:
+                    reasoning.append(out.reasoning_content)
+                if out.tool_calls:
+                    tools.extend(out.tool_calls)
+                if out.text:
+                    texts.append(out.text)
+                if out.logprob_entries:
+                    lp_entries.extend(out.logprob_entries)
+                if out.finish_reason:
+                    finish = ("stop" if out.finish_reason == "eos"
+                              else out.finish_reason)
+                    break
+            return texts, reasoning, tools, lp_entries, finish, n_out, err
+
+        results = await asyncio.gather(*[collect(s) for s in streams])
+        total_out = sum(r[5] for r in results)
+        self.metrics.request_end(
+            req.model, "chat", t0, error=any(r[6] for r in results),
+            output_tokens=total_out, input_tokens=gens[0].prompt_tokens,
+        )
+        for r in results:
+            if r[6]:
+                return self._error(500, r[6], "engine_error")
+        choices = []
+        for i, (texts, reasoning, tools, lp_entries, finish, _n, _e) in \
+                enumerate(results):
+            message = ChatMessage(role="assistant", content="".join(texts))
+            if reasoning:
+                message.reasoning_content = "".join(reasoning)
+            if tools:
+                from ..protocols.openai import ToolCall
+
+                message.tool_calls = [
+                    ToolCall.model_validate(tc) for tc in tools]
+                message.content = message.content or None
+            choices.append(Choice(
+                index=i, message=message, finish_reason=finish,
+                logprobs=chat_logprobs(lp_entries),
+            ))
+        response = ChatCompletionResponse(
+            id=gens[0].id,
+            model=req.model,
+            choices=choices,
+            usage=Usage(
+                prompt_tokens=gens[0].prompt_tokens,
+                completion_tokens=total_out,
+                total_tokens=gens[0].prompt_tokens + total_out,
+            ),
+        )
+        return web.json_response(response.model_dump(exclude_none=True))
+
+    async def _unary_chat(
+        self, req, stream: AsyncIterator[Annotated], gen, ctx: Context, t0
+    ) -> web.Response:
+        return await self._unary_chat_multi(req, [stream], [gen], ctx, t0)
+
+    async def _unary_chat_multi(
+        self, req, streams, gens, ctx: Context, t0
+    ) -> web.Response:
+        """n>1 non-streamed: collect every choice, answer once."""
+        from ..protocols.openai import chat_logprobs
+
+        async def collect(s):
+            texts, reasoning, tools, lp_entries = [], [], [], []
+            finish, n_out, err = "stop", 0, None
+            async for ann in s:
+                if ann.is_error():
+                    err = (ann.comment or ["engine error"])[0]
+                    break
+                if ann.event is not None:
+                    continue
+                out: LLMEngineOutput = ann.data
+                n_out += len(out.token_ids)
+                if out.reasoning_content:
+                    reasoning.append(out.reasoning_content)
+                if out.tool_calls:
+                    tools.extend(out.tool_calls)
+                if out.text:
+                    texts.append(out.text)
+                if out.logprob_entries:
+                    lp_entries.extend(out.logprob_entries)
+                if out.finish_reason:
+                    finish = ("stop" if out.finish_reason == "eos"
+                              else out.finish_reason)
+                    break
+            return texts, reasoning, tools, lp_entries, finish, n_out, err
+
+        results = await asyncio.gather(*[collect(s) for s in streams])
+        total_out = sum(r[5] for r in results)
+        self.metrics.request_end(
+            req.model, "chat", t0, error=any(r[6] for r in results),
+            output_tokens=total_out, input_tokens=gens[0].prompt_tokens,
+        )
+        for r in results:
+            if r[6]:
+                return self._error(500, r[6], "engine_error")
+        choices = []
+        for i, (texts, reasoning, tools, lp_entries, finish, _n, _e) in \
+                enumerate(results):
+            message = ChatMessage(role="assistant", content="".join(texts))
+            if reasoning:
+                message.reasoning_content = "".join(reasoning)
+            if tools:
+                from ..protocols.openai import ToolCall
+
+                message.tool_calls = [
+                    ToolCall.model_validate(tc) for tc in tools]
+                message.content = message.content or None
+            choices.append(Choice(
+                index=i, message=message, finish_reason=finish,
+                logprobs=chat_logprobs(lp_entries),
+            ))
+        response = ChatCompletionResponse(
+            id=gens[0].id,
+            model=req.model,
+            choices=choices,
+            usage=Usage(
+                prompt_tokens=gens[0].prompt_tokens,
+                completion_tokens=total_out,
+                total_tokens=gens[0].prompt_tokens + total_out,
+            ),
+        )
+        return web.json_response(response.model_dump(exclude_none=True))
 
     async def _unary_chat(
         self, req, stream: AsyncIterator[Annotated], gen, ctx: Context, t0

@@ -204,8 +204,16 @@ class OpenAIPreprocessor:
         # the embeddings handler applies to `dimensions`; r4 verdict weak #7)
         if getattr(request, "logit_bias", None):
             raise ValueError("logit_bias is not supported")
-        if (getattr(request, "n", None) or 1) > 1:
-            raise ValueError("n > 1 is not supported; issue parallel requests")
+        if (getattr(request, "n", None) or 1) > 1 and isinstance(
+            request, CompletionRequest
+        ):
+            # chat n>1 fans out at the service layer (prefix cache +
+            # skip-ahead dedupe the prompt compute); legacy completions
+            # n×prompts batching is not implemented
+            raise ValueError(
+                "n > 1 is not supported on /v1/completions; use "
+                "/v1/chat/completions or issue parallel requests"
+            )
         # logprobs: raw-model logprob of each sampled token, plus up to 5
         # top alternatives (chat `logprobs: true` + `top_logprobs: n`;
         # completions `logprobs: k` — its legacy top-k meaning, k=0 =
@@ -263,11 +271,13 @@ class ChatDeltaGenerator:
     engine deltas (reference DeltaGenerator protocols/openai/chat_completions/
     delta.rs)."""
 
-    def __init__(self, model: str, request_id: Optional[str] = None, include_usage: bool = True):
+    def __init__(self, model: str, request_id: Optional[str] = None,
+                 include_usage: bool = True, index: int = 0):
         self.id = f"chatcmpl-{request_id or secrets.token_hex(12)}"
         self.model = model
         self.created = int(time.time())
         self.include_usage = include_usage
+        self.index = index  # choice index (n > 1 fan-out)
         self.prompt_tokens = 0
         self.completion_tokens = 0
         self._first = True
@@ -277,7 +287,7 @@ class ChatDeltaGenerator:
             id=self.id,
             model=self.model,
             created=self.created,
-            choices=[StreamChoice(index=0, delta=ChoiceDelta(role="assistant", content=""))],
+            choices=[StreamChoice(index=self.index, delta=ChoiceDelta(role="assistant", content=""))],
         )
 
     def text_chunk(self, text: str, n_tokens: int = 1,
@@ -294,7 +304,7 @@ class ChatDeltaGenerator:
             id=self.id,
             model=self.model,
             created=self.created,
-            choices=[StreamChoice(index=0, delta=delta, logprobs=lp)],
+            choices=[StreamChoice(index=self.index, delta=delta, logprobs=lp)],
         )
 
     def reasoning_chunk(self, text: str, n_tokens: int = 0) -> ChatCompletionChunk:
@@ -307,7 +317,7 @@ class ChatDeltaGenerator:
             id=self.id,
             model=self.model,
             created=self.created,
-            choices=[StreamChoice(index=0, delta=delta)],
+            choices=[StreamChoice(index=self.index, delta=delta)],
         )
 
     def tool_calls_chunk(self, tool_calls: list) -> ChatCompletionChunk:
@@ -325,7 +335,7 @@ class ChatDeltaGenerator:
             id=self.id,
             model=self.model,
             created=self.created,
-            choices=[StreamChoice(index=0, delta=delta)],
+            choices=[StreamChoice(index=self.index, delta=delta)],
         )
 
     def finish_chunk(self, reason: str) -> ChatCompletionChunk:
@@ -334,7 +344,7 @@ class ChatDeltaGenerator:
             id=self.id,
             model=self.model,
             created=self.created,
-            choices=[StreamChoice(index=0, delta=ChoiceDelta(), finish_reason=reason)],
+            choices=[StreamChoice(index=self.index, delta=ChoiceDelta(), finish_reason=reason)],
         )
 
     def usage_chunk(self) -> ChatCompletionChunk:
@@ -360,13 +370,15 @@ class CompletionDeltaGenerator:
         self.created = int(time.time())
         self.prompt_tokens = 0
         self.completion_tokens = 0
+        self._chars_sent = 0  # running text_offset base across chunks
 
     def text_chunk(self, text: str, n_tokens: int = 1,
                    logprob_entries=None) -> CompletionChunk:
         self.completion_tokens += n_tokens
         from .protocols.openai import completion_logprobs
 
-        lp = completion_logprobs(logprob_entries)
+        lp = completion_logprobs(logprob_entries, self._chars_sent)
+        self._chars_sent += len(text)
         return CompletionChunk(
             id=self.id,
             model=self.model,

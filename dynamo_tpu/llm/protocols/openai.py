@@ -152,8 +152,10 @@ def chat_logprobs(entries) -> Optional[ChoiceLogProbs]:
     return ChoiceLogProbs(content=[LogProbEntry(**e) for e in entries])
 
 
-def completion_logprobs(entries) -> Optional[Dict[str, Any]]:
-    """[{token, logprob}] → the legacy completions logprobs object."""
+def completion_logprobs(entries, base_offset: int = 0) -> Optional[Dict[str, Any]]:
+    """[{token, logprob}] → the legacy completions logprobs object.
+    `base_offset`: chars already streamed (offsets index the ACCUMULATED
+    text, so chunked emission must carry the running total)."""
     if not entries:
         return None
     tops = None
@@ -162,7 +164,7 @@ def completion_logprobs(entries) -> Optional[Dict[str, Any]]:
             {t["token"]: t["logprob"] for t in e.get("top_logprobs") or []}
             for e in entries
         ]
-    offsets, pos = [], 0
+    offsets, pos = [], base_offset
     for e in entries:
         offsets.append(pos)
         pos += len(e["token"])

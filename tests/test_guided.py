@@ -191,8 +191,8 @@ def test_preprocessor_rejects_unsupported_knobs():
     pre = OpenAIPreprocessor(card, ByteTokenizer())
     with pytest.raises(ValueError, match="logit_bias"):
         pre.preprocess_chat(_chat(logit_bias={"5": 1.0}))
-    with pytest.raises(ValueError, match="n > 1"):
-        pre.preprocess_chat(_chat(n=3))
+    # chat n>1 is now supported (service-layer fan-out); completions isn't
+    pre.preprocess_chat(_chat(n=3))
     with pytest.raises(ValueError, match="guided_grammar"):
         pre.preprocess_chat(_chat(nvext=NvExt(guided_grammar="g")))
     # chat logprobs + top_logprobs (n<=5) are SUPPORTED

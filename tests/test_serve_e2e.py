@@ -92,6 +92,71 @@ def test_chat_completion_unary(cluster):
         assert isinstance(body["choices"][0]["message"]["content"], str)
 
 
+def test_chat_n_parallel_choices(cluster):
+    """n>1 fan-out: one request returns n independent choices (unary) and
+    index-tagged chunks (streamed); usage sums across choices."""
+    base, _ = cluster
+    with httpx.Client(timeout=60) as client:
+        r = client.post(
+            f"{base}/v1/chat/completions",
+            json={
+                "model": "mock-model",
+                "messages": [{"role": "user", "content": "hello"}],
+                "max_tokens": 6,
+                "n": 3,
+            },
+        )
+        assert r.status_code == 200, r.text
+        body = r.json()
+        assert [c["index"] for c in body["choices"]] == [0, 1, 2]
+        assert all(
+            c["finish_reason"] == "length" for c in body["choices"]
+        )
+        assert body["usage"]["completion_tokens"] == 18  # 3 × 6
+
+        # streamed: chunks for every choice index, one finish each
+        seen_idx = set()
+        finishes = {}
+        with client.stream(
+            "POST",
+            f"{base}/v1/chat/completions",
+            json={
+                "model": "mock-model",
+                "messages": [{"role": "user", "content": "hello"}],
+                "max_tokens": 4,
+                "n": 2,
+                "stream": True,
+            },
+        ) as resp:
+            assert resp.status_code == 200
+            for line in resp.iter_lines():
+                if not line.startswith("data:") or line == "data: [DONE]":
+                    continue
+                chunk = json.loads(line[5:])
+                for ch in chunk.get("choices", []):
+                    seen_idx.add(ch["index"])
+                    if ch.get("finish_reason"):
+                        finishes[ch["index"]] = ch["finish_reason"]
+        assert seen_idx == {0, 1}
+        assert set(finishes) == {0, 1}
+
+        # completions keeps the explicit 400; chat n is capped
+        r = client.post(
+            f"{base}/v1/completions",
+            json={"model": "mock-model", "prompt": "x",
+                  "max_tokens": 4, "n": 2},
+        )
+        assert r.status_code == 400
+        r = client.post(
+            f"{base}/v1/chat/completions",
+            json={"model": "mock-model",
+                  "messages": [{"role": "user", "content": "x"}],
+                  "max_tokens": 4, "n": 9},
+        )
+        assert r.status_code == 400
+        assert "capped" in r.json()["error"]["message"]
+
+
 def test_chat_completion_streaming(cluster):
     base, _ = cluster
     chunks = []
