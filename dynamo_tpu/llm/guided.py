@@ -48,6 +48,7 @@ _WORD = frozenset(
 _SPACE = frozenset(" \t\n\r\f\v")
 
 _META = set(r"\.[](){}*+?|^$")
+MAX_REPEAT = 4096  # cap on {m,n} expansion (DoS guard; see _repeat)
 
 
 @dataclass(frozen=True)
@@ -132,6 +133,13 @@ class _Parser:
                 hi = int(hi_s) if hi_s else None
             else:
                 lo = hi = int(spec)
+            if lo > MAX_REPEAT or (hi or 0) > MAX_REPEAT:
+                # quantifiers expand to lo+hi AST nodes BEFORE the DFA
+                # max_states guard can fire: a {0,300000} would pin the
+                # compile thread / OOM long before subset construction
+                raise ValueError(
+                    f"repetition bound exceeds {MAX_REPEAT}"
+                )
             parts: list = [node] * lo
             if hi is None:
                 parts.append(("star", node))
@@ -380,7 +388,8 @@ def compile_regex(pattern: str, max_states: int = 20000) -> Dfa:
 
 _WS = "[ \t\n]*"
 # JSON string: no raw control chars; only the legal JSON escapes
-_STRING = r'"([^"\\\x00-\x1f]|\\(["\\/bfnrt]|u[0-9a-fA-F]{4}))*"'
+_STRING_CHAR = r'([^"\\\x00-\x1f]|\\(["\\/bfnrt]|u[0-9a-fA-F]{4}))'
+_STRING = '"' + _STRING_CHAR + '*"'
 _INT = "\\-?(0|[1-9][0-9]*)"
 _NUM = _INT + "(\\.[0-9]+)?([eE][\\-+]?[0-9]+)?"
 _SCALAR = f"({_STRING}|{_NUM}|true|false|null)"
@@ -413,6 +422,27 @@ def schema_to_regex(schema: dict, depth: int = DEFAULT_DEPTH) -> str:
         return (
             "(" + "|".join(_esc_literal(json.dumps(v)) for v in schema["enum"]) + ")"
         )
+    for union_key in ("anyOf", "oneOf"):
+        if union_key in schema:
+            siblings = (
+                {"type", "properties", "items", "enum", "const", "required"}
+                & set(schema)
+            )
+            if siblings:
+                # intersecting a union with sibling constraints is not
+                # supported — enforcing only the union would be WEAKER
+                # than the client asked for (silent-accept discipline)
+                raise ValueError(
+                    f"{union_key} cannot be combined with {sorted(siblings)}"
+                )
+            subs = schema[union_key]
+            if not subs or not isinstance(subs, list):
+                raise ValueError(f"{union_key} must be a non-empty list")
+            return (
+                "("
+                + "|".join(schema_to_regex(s, depth) for s in subs)
+                + ")"
+            )
     t = schema.get("type")
     if isinstance(t, list):
         return (
@@ -431,6 +461,12 @@ def schema_to_regex(schema: dict, depth: int = DEFAULT_DEPTH) -> str:
                 "string `pattern` is not supported in guided json_schema; "
                 "use guided_regex for free-form patterns"
             )
+        lo = schema.get("minLength")
+        hi = schema.get("maxLength")
+        if lo is not None or hi is not None:
+            lo = int(lo or 0)
+            quant = "{%d,%s}" % (lo, "" if hi is None else int(hi))
+            return '"' + _STRING_CHAR + quant + '"'
         return _STRING
     if t == "integer":
         return _INT
@@ -462,15 +498,41 @@ def schema_to_regex(schema: dict, depth: int = DEFAULT_DEPTH) -> str:
             return _free_value(max(depth, 1))
         if depth <= 0:
             raise ValueError("schema nesting exceeds supported depth")
-        parts = []
-        for key, sub in props.items():
-            parts.append(
+        # `required` honored when present; absent = ALL required (stricter
+        # than JSON Schema's none-required default, but the right default
+        # for structured output — and the pre-round-5 behavior). Optional
+        # properties keep declaration order; comma placement rides a
+        # first-present-item alternation (an item can open the object only
+        # if every earlier item is optional).
+        required = (
+            set(schema["required"]) if "required" in schema else set(props)
+        )
+        unknown = required - set(props)
+        if unknown:
+            raise ValueError(f"required names undeclared properties: {unknown}")
+        items = [
+            (
                 _esc_literal(json.dumps(key))
                 + _WS + ":" + _WS
-                + schema_to_regex(sub, depth - 1)
+                + schema_to_regex(sub, depth - 1),
+                key in required,
             )
+            for key, sub in props.items()
+        ]
         sep = _WS + "," + _WS
-        return "\\{" + _WS + sep.join(parts) + _WS + "\\}"
+        branches = []
+        for i in range(len(items)):
+            if any(req for _, req in items[:i]):
+                break  # a required item before i cannot be skipped
+            body = items[i][0]
+            for re_j, req_j in items[i + 1:]:
+                seg = sep + re_j
+                body += seg if req_j else "(" + seg + ")?"
+            branches.append(body)
+        inner = "(" + "|".join(branches) + ")"
+        if not any(req for _, req in items):
+            inner += "?"
+        return "\\{" + _WS + inner + _WS + "\\}"
     if t is None:
         return _free_value(depth)
     raise ValueError(f"unsupported schema type {t!r}")
@@ -626,12 +688,20 @@ def extract_guided_spec(response_format, nvext) -> Optional[dict]:
 
 def spec_to_regex(spec: dict) -> str:
     kind = spec.get("kind")
-    if kind == "regex":
-        return spec["regex"]
-    if kind == "choice":
-        return choice_to_regex(spec["choices"])
-    if kind == "json_schema":
-        return schema_to_regex(spec["schema"])
+    try:
+        if kind == "regex":
+            return spec["regex"]
+        if kind == "choice":
+            return choice_to_regex(spec["choices"])
+        if kind == "json_schema":
+            return schema_to_regex(spec["schema"])
+    except ValueError:
+        raise
+    except Exception as e:  # noqa: BLE001 — malformed client schemas
+        # (required: 5, minLength: [2], anyOf: 7, ...) raise TypeError/
+        # KeyError deep in the compiler; the serving path maps ONLY
+        # ValueError to a 400, so normalize here
+        raise ValueError(f"malformed schema: {type(e).__name__}: {e}")
     if kind == "json_object":
         return _free_value(DEFAULT_DEPTH)
     raise ValueError(f"unknown guided kind {kind!r}")

@@ -93,6 +93,81 @@ def test_schema_enum_const_union():
     assert d.fullmatch("-3") and d.fullmatch("null") and not d.fullmatch('"x"')
 
 
+def test_schema_optional_properties_and_unions():
+    sch = {
+        "type": "object",
+        "properties": {
+            "a": {"type": "integer"},
+            "b": {"type": "boolean"},
+            "c": {"type": "null"},
+        },
+        "required": ["b"],
+    }
+    d = g.compile_regex(g.schema_to_regex(sch))
+    assert d.fullmatch('{"a": 1, "b": true, "c": null}')
+    assert d.fullmatch('{"b": false}')  # optionals omitted
+    assert d.fullmatch('{"a": 2, "b": true}')
+    assert d.fullmatch('{"b": true, "c": null}')
+    assert not d.fullmatch('{"a": 1, "c": null}')  # required b missing
+    assert not d.fullmatch('{"b": true, "a": 1}')  # order is declaration
+    assert not d.fullmatch("{}")
+    # no required at all: empty object admissible
+    d = g.compile_regex(g.schema_to_regex({
+        "type": "object",
+        "properties": {"x": {"type": "integer"}},
+        "required": [],
+    }))
+    assert d.fullmatch("{}") and d.fullmatch('{"x": 7}')
+    with pytest.raises(ValueError, match="undeclared"):
+        g.schema_to_regex({
+            "type": "object", "properties": {"x": {"type": "integer"}},
+            "required": ["y"],
+        })
+    # anyOf / oneOf unions
+    d = g.compile_regex(g.schema_to_regex({
+        "anyOf": [{"type": "integer"}, {"type": "boolean"}],
+    }))
+    assert d.fullmatch("-4") and d.fullmatch("true")
+    assert not d.fullmatch('"x"')
+    # string length bounds
+    d = g.compile_regex(g.schema_to_regex({
+        "type": "string", "minLength": 2, "maxLength": 4,
+    }))
+    assert not d.fullmatch('"a"')
+    assert d.fullmatch('"ab"') and d.fullmatch('"abcd"')
+    assert not d.fullmatch('"abcde"')
+    d = g.compile_regex(g.schema_to_regex({"type": "string", "minLength": 3}))
+    assert not d.fullmatch('"ab"') and d.fullmatch('"abcdefg"')
+
+
+def test_schema_hostile_inputs_reject_cleanly():
+    """Malformed/hostile schemas must raise ValueError (→ HTTP 400), never
+    TypeError (unhandled crash) or unbounded compile work."""
+    import time as _t
+
+    for bad in (
+        {"type": "object", "properties": {"x": {"type": "integer"}},
+         "required": 5},
+        {"type": "string", "minLength": [2]},
+        {"anyOf": 7},
+        {"anyOf": []},
+        # union + sibling constraints: enforcing only the union would be
+        # WEAKER than asked — reject
+        {"type": "object", "properties": {"x": {"type": "integer"}},
+         "anyOf": [{"type": "integer"}]},
+    ):
+        with pytest.raises(ValueError):
+            g.spec_to_regex({"kind": "json_schema", "schema": bad})
+    # giant repetition bounds must fail fast, not pin the compile thread
+    t0 = _t.monotonic()
+    with pytest.raises(ValueError, match="repetition bound"):
+        g.compile_regex(g.spec_to_regex({
+            "kind": "json_schema",
+            "schema": {"type": "string", "maxLength": 300000},
+        }))
+    assert _t.monotonic() - t0 < 2.0
+
+
 def test_free_json_value_bounded_depth():
     d = g.compile_regex(g._free_value(3))
     for s in ['{"a": [1, 2, {"b": null}]}', "[]", '"x"', "3.5e-2",
