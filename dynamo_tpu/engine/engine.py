@@ -224,6 +224,7 @@ class _Slot:
     guided_state: int = 0  # current FSM state; advanced per emitted token
     lora_idx: int = 0  # adapter slot in the engine's LoRA stack (0 = base)
     want_logprobs: bool = False  # attach sampled-token logprobs to emissions
+    sample_seed: int = 0  # per-request sampling seed (SamplingParams.seed)
     want_top_logprobs: int = 0  # top-k alternatives per token (max 5)
 
 
@@ -338,6 +339,7 @@ class JaxEngine:
         self.temps = np.zeros((B,), np.float32)
         self.top_ks = np.zeros((B,), np.int32)
         self.top_ps = np.ones((B,), np.float32)
+        self.seeds = np.zeros((B,), np.uint32)  # per-lane sampling seeds
         self.slots: List[Optional[_Slot]] = [None] * B
         self._free_slots = list(range(B - 1, -1, -1))
         self._waiting: List[_Slot] = []
@@ -485,7 +487,9 @@ class JaxEngine:
                         params, c, tokens, positions, loc_k, loc_v, j,
                         kv_k, kv_v, page_tables, pool_lens,
                     )
-                    nxt, lp, tid, tlp = sample_lp(logits, samp, key_j)
+                    nxt, lp, tid, tlp = sample_lp(
+                        logits, samp, key_j, positions=positions
+                    )
                     return (
                         (nxt, positions + 1, seq_lens + 1, loc_k, loc_v),
                         (nxt, lp, tid, tlp),
@@ -536,7 +540,9 @@ class JaxEngine:
                         logits, kv_k, kv_v = self._model.decode_forward(
                             params, c, tokens, positions, kv_k, kv_v, page_tables, seq_lens
                         )
-                    nxt, lp, tid, tlp = sample_lp(logits, samp, k)
+                    nxt, lp, tid, tlp = sample_lp(
+                        logits, samp, k, positions=positions
+                    )
                     return (
                         (nxt, positions + 1, seq_lens + 1, kv_k, kv_v),
                         (nxt, lp, tid, tlp),
@@ -633,7 +639,9 @@ class JaxEngine:
             logits, kv_k, kv_v = self._model.prefill_forward_batched(
                 params, c, tokens, positions, kv_k, kv_v, page_tables, ctx_lens, last_idx
             )
-            first = sample_lp(logits, samp, sub)
+            first = sample_lp(
+                logits, samp, sub, positions=ctx_lens + last_idx
+            )
             return first, kv_k, kv_v, rng
 
         self._prefill_batch = prefill_batch
@@ -651,7 +659,9 @@ class JaxEngine:
                 params, c, tokens, positions, kv_k, kv_v, page_tables,
                 ctx_lens, last_idx, emb_override=emb, emb_mask=emb_mask,
             )
-            first = sample_lp(logits, samp, sub)
+            first = sample_lp(
+                logits, samp, sub, positions=ctx_lens + last_idx
+            )
             return first, kv_k, kv_v, rng
 
         self._prefill_batch_mm = prefill_batch_mm
@@ -677,7 +687,9 @@ class JaxEngine:
                     params, c, tokens, positions, kv_k, kv_v, page_tables, seq_lens
                 )
             mask = unpack_mask(mask_packed, c.vocab_size)
-            nxt, lp, tid, tlp = sample_lp(logits, samp, sub, mask=mask)
+            nxt, lp, tid, tlp = sample_lp(
+                logits, samp, sub, mask=mask, positions=positions
+            )
             return (
                 (nxt[None], lp[None], tid[None], tlp[None]),
                 nxt, positions + 1, seq_lens + 1, kv_k, kv_v, rng,
@@ -699,7 +711,9 @@ class JaxEngine:
                 seq_lens, lora=lora,
             )
             mask = unpack_mask(mask_packed, c.vocab_size)
-            nxt, lp, tid, tlp = sample_lp(logits, samp, sub, mask=mask)
+            nxt, lp, tid, tlp = sample_lp(
+                logits, samp, sub, mask=mask, positions=positions
+            )
             return (
                 (nxt[None], lp[None], tid[None], tlp[None]),
                 nxt, positions + 1, seq_lens + 1, kv_k, kv_v, rng,
@@ -717,7 +731,9 @@ class JaxEngine:
                 ctx_lens, last_idx
             )
             mask = unpack_mask(mask_packed, c.vocab_size)
-            first = sample_lp(logits, samp, sub, mask=mask)
+            first = sample_lp(
+                logits, samp, sub, mask=mask, positions=ctx_lens + last_idx
+            )
             return first, kv_k, kv_v, rng
 
         self._prefill_batch_guided = prefill_batch_guided
@@ -740,7 +756,9 @@ class JaxEngine:
                     params, c, tokens, positions, kv_k, kv_v, page_tables,
                     seq_lens, lora=lora,
                 )
-                nxt, lp, tid, tlp = sample_lp(logits, samp, key_j)
+                nxt, lp, tid, tlp = sample_lp(
+                    logits, samp, key_j, positions=positions
+                )
                 return (
                     (nxt, positions + 1, seq_lens + 1, kv_k, kv_v),
                     (nxt, lp, tid, tlp),
@@ -762,7 +780,9 @@ class JaxEngine:
                 params, c, tokens, positions, kv_k, kv_v, page_tables,
                 ctx_lens, last_idx, lora=lora,
             )
-            first = sample_lp(logits, samp, sub)
+            first = sample_lp(
+                logits, samp, sub, positions=ctx_lens + last_idx
+            )
             return first, kv_k, kv_v, rng
 
         self._prefill_batch_lora = prefill_batch_lora
@@ -791,7 +811,10 @@ class JaxEngine:
                     logits, kv_k, kv_v = self._model.prefill_forward_ring(
                         params, c, toks, kv_k, kv_v, table, real_len, self._mesh
                     )
-                first = sample_lp(logits[None], samp, sub)
+                first = sample_lp(
+                    logits[None], samp, sub,
+                    positions=(ctx_len + real_len - 1)[None],
+                )
                 return first, kv_k, kv_v, rng
 
             self._prefill_single = prefill_single
@@ -807,13 +830,14 @@ class JaxEngine:
             from jax.sharding import NamedSharding, PartitionSpec
 
             repl = NamedSharding(self._mesh, PartitionSpec())
-            patch_out_sh = (repl,) * 7
+            patch_out_sh = (repl,) * 8
 
         @partial(jax.jit, out_shardings=patch_out_sh)
         def patch_lanes(
-            tokens, positions, seq_lens, tables, temps, top_ks, top_ps,
+            tokens, positions, seq_lens, tables, temps, top_ks, top_ps, seeds,
             lane_mask, table_mask,
-            n_tokens, n_positions, n_seq_lens, n_tables, n_temps, n_top_ks, n_top_ps,
+            n_tokens, n_positions, n_seq_lens, n_tables, n_temps, n_top_ks,
+            n_top_ps, n_seeds,
         ):
             tokens = jnp.where(lane_mask, n_tokens, tokens)
             positions = jnp.where(lane_mask, n_positions, positions)
@@ -821,8 +845,12 @@ class JaxEngine:
             temps = jnp.where(lane_mask, n_temps, temps)
             top_ks = jnp.where(lane_mask, n_top_ks, top_ks)
             top_ps = jnp.where(lane_mask, n_top_ps, top_ps)
+            seeds = jnp.where(lane_mask, n_seeds, seeds)
             tables = jnp.where(table_mask[:, None], n_tables, tables)
-            return tokens, positions, seq_lens, tables, temps, top_ks, top_ps
+            return (
+                tokens, positions, seq_lens, tables, temps, top_ks, top_ps,
+                seeds,
+            )
 
         self._patch_lanes = patch_lanes
 
@@ -1129,6 +1157,16 @@ class JaxEngine:
         slot.top_k = int(sampling.get("top_k") or 0)
         slot.top_p = float(sampling.get("top_p") or 1.0)
         slot.want_logprobs = bool(sampling.get("logprobs"))
+        # explicit seed => reproducible output independent of co-batched
+        # traffic (counter-based draws, sampling.py); else a random one —
+        # concurrent identical unseeded requests (n>1) must diverge
+        import secrets as _secrets
+
+        seed = sampling.get("seed")
+        slot.sample_seed = (
+            int(seed) & 0xFFFFFFFF if seed is not None
+            else _secrets.randbits(32)
+        )
         slot.want_top_logprobs = min(int(sampling.get("top_logprobs") or 0), 5)
         if req.guided:
             slot.guided_fsm = (
@@ -1389,6 +1427,7 @@ class JaxEngine:
             self.top_ks[idx] = slot.top_k
             self.top_ps[idx] = slot.top_p
             self.lora_idx[idx] = slot.lora_idx
+            self.seeds[idx] = slot.sample_seed
             slot.admit_seq = self._admit_counter = self._admit_counter + 1
             return True
         kv_prompt = slot.kv_prompt
@@ -1435,6 +1474,7 @@ class JaxEngine:
         self.top_ks[idx] = slot.top_k
         self.top_ps[idx] = slot.top_p
         self.lora_idx[idx] = slot.lora_idx
+        self.seeds[idx] = slot.sample_seed
         slot.admit_seq = self._admit_counter = self._admit_counter + 1
         return True
 
@@ -1481,11 +1521,13 @@ class JaxEngine:
     # -- replicated device programs (leader dispatches these after a
     # _bcast; followers replay them verbatim in run_follower) ------------ #
 
-    def _dev_prefill(self, toks, positions, tables, ctx_lens, last_idx, temps, top_ks, top_ps):
+    def _dev_prefill(self, toks, positions, tables, ctx_lens, last_idx,
+                     temps, top_ks, top_ps, seeds):
         samp = SamplingParams(
             temperature=jnp.asarray(temps),
             top_k=jnp.asarray(top_ks),
             top_p=jnp.asarray(top_ps),
+            seed=jnp.asarray(seeds),
         )
         first, self.kv_k, self.kv_v, self._rng = self._prefill_batch(
             self.params,
@@ -1502,11 +1544,12 @@ class JaxEngine:
         return first
 
     def _dev_prefill_mm(self, toks, positions, tables, ctx_lens, last_idx,
-                        temps, top_ks, top_ps, emb, emb_mask):
+                        temps, top_ks, top_ps, seeds, emb, emb_mask):
         samp = SamplingParams(
             temperature=jnp.asarray(temps),
             top_k=jnp.asarray(top_ks),
             top_p=jnp.asarray(top_ps),
+            seed=jnp.asarray(seeds),
         )
         first, self.kv_k, self.kv_v, self._rng = self._prefill_batch_mm(
             self.params,
@@ -1525,11 +1568,12 @@ class JaxEngine:
         return first
 
     def _dev_prefill_guided(self, toks, positions, tables, ctx_lens, last_idx,
-                            temps, top_ks, top_ps, mask):
+                            temps, top_ks, top_ps, seeds, mask):
         samp = SamplingParams(
             temperature=jnp.asarray(temps),
             top_k=jnp.asarray(top_ks),
             top_p=jnp.asarray(top_ps),
+            seed=jnp.asarray(seeds),
         )
         first, self.kv_k, self.kv_v, self._rng = self._prefill_batch_guided(
             self.params,
@@ -1555,11 +1599,12 @@ class JaxEngine:
         }
 
     def _dev_prefill_lora(self, toks, positions, tables, ctx_lens, last_idx,
-                          temps, top_ks, top_ps, idx):
+                          temps, top_ks, top_ps, seeds, idx):
         samp = SamplingParams(
             temperature=jnp.asarray(temps),
             top_k=jnp.asarray(top_ks),
             top_p=jnp.asarray(top_ps),
+            seed=jnp.asarray(seeds),
         )
         first, self.kv_k, self.kv_v, self._rng = self._prefill_batch_lora(
             self.params,
@@ -1602,11 +1647,12 @@ class JaxEngine:
         return toks
 
     def _dev_reset(self, tokens, positions, seq_lens, page_tables, temps,
-                   top_ks, top_ps, hist=None):
+                   top_ks, top_ps, seeds, hist=None):
         self._samp_dev = SamplingParams(
             temperature=jnp.asarray(temps),
             top_k=jnp.asarray(top_ks),
             top_p=jnp.asarray(top_ps),
+            seed=jnp.asarray(seeds),
         )
         self._carry = (
             jnp.asarray(tokens),
@@ -1618,19 +1664,21 @@ class JaxEngine:
             self._hist_dev = jnp.asarray(hist)
 
     def _dev_patch(self, lane_mask, table_mask, tokens, positions, seq_lens,
-                   tables, temps, top_ks, top_ps, hist=None):
+                   tables, temps, top_ks, top_ps, seeds, hist=None):
         samp = self._samp_dev
-        tok_d, pos_d, sl_d, tab_d, t_d, k_d, p_d = self._patch_lanes(
+        tok_d, pos_d, sl_d, tab_d, t_d, k_d, p_d, s_d = self._patch_lanes(
             self._carry[0], self._carry[1], self._carry[2], self._tables_dev,
-            samp.temperature, samp.top_k, samp.top_p,
+            samp.temperature, samp.top_k, samp.top_p, samp.seed,
             jnp.asarray(lane_mask), jnp.asarray(table_mask),
             jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(seq_lens),
             jnp.asarray(tables), jnp.asarray(temps), jnp.asarray(top_ks),
-            jnp.asarray(top_ps),
+            jnp.asarray(top_ps), jnp.asarray(seeds),
         )
         self._carry = (tok_d, pos_d, sl_d)
         self._tables_dev = tab_d
-        self._samp_dev = SamplingParams(temperature=t_d, top_k=k_d, top_p=p_d)
+        self._samp_dev = SamplingParams(
+            temperature=t_d, top_k=k_d, top_p=p_d, seed=s_d
+        )
         if hist is not None and self._hist_dev is not None:
             # dirty lanes take the host ring row; others keep the (newer)
             # device rows appended by in-flight spec blocks
@@ -1813,6 +1861,7 @@ class JaxEngine:
                         self._dev_prefill,
                         p["toks"], p["positions"], p["tables"], p["ctx_lens"],
                         p["last_idx"], p["temps"], p["top_ks"], p["top_ps"],
+                        p["seeds"],
                     )
                 )
             elif tag == "prefill_mm":
@@ -1821,7 +1870,7 @@ class JaxEngine:
                         self._dev_prefill_mm,
                         p["toks"], p["positions"], p["tables"], p["ctx_lens"],
                         p["last_idx"], p["temps"], p["top_ks"], p["top_ps"],
-                        p["emb"], p["emb_mask"],
+                        p["seeds"], p["emb"], p["emb_mask"],
                     )
                 )
             elif tag == "reset":
@@ -1830,7 +1879,7 @@ class JaxEngine:
                         self._dev_reset,
                         p["tokens"], p["positions"], p["seq_lens"],
                         p["page_tables"], p["temps"], p["top_ks"], p["top_ps"],
-                        p.get("hist"),
+                        p["seeds"], p.get("hist"),
                     )
                 )
             elif tag == "prefill_single":
@@ -1838,7 +1887,7 @@ class JaxEngine:
                     partial(
                         self._dev_prefill_single,
                         p["toks"], p["table"], p["ctx"][0], p["real"][0],
-                        p["temps"], p["top_ks"], p["top_ps"],
+                        p["temps"], p["top_ks"], p["top_ps"], p["seeds"],
                     )
                 )
             elif tag == "patch":
@@ -1847,7 +1896,8 @@ class JaxEngine:
                         self._dev_patch,
                         p["lane_mask"], p["table_mask"], p["tokens"],
                         p["positions"], p["seq_lens"], p["page_tables"],
-                        p["temps"], p["top_ks"], p["top_ps"], p.get("hist"),
+                        p["temps"], p["top_ks"], p["top_ps"], p["seeds"],
+                        p.get("hist"),
                     )
                 )
             elif tag == "prefill_guided":
@@ -1856,7 +1906,7 @@ class JaxEngine:
                         self._dev_prefill_guided,
                         p["toks"], p["positions"], p["tables"], p["ctx_lens"],
                         p["last_idx"], p["temps"], p["top_ks"], p["top_ps"],
-                        p["mask"],
+                        p["seeds"], p["mask"],
                     )
                 )
             elif tag == "prefill_lora":
@@ -1865,7 +1915,7 @@ class JaxEngine:
                         self._dev_prefill_lora,
                         p["toks"], p["positions"], p["tables"], p["ctx_lens"],
                         p["last_idx"], p["temps"], p["top_ks"], p["top_ps"],
-                        p["idx"],
+                        p["seeds"], p["idx"],
                     )
                 )
             elif tag == "block":
@@ -2277,6 +2327,7 @@ class JaxEngine:
         temps = np.zeros((B_pf,), np.float32)
         top_ks = np.zeros((B_pf,), np.int32)
         top_ps = np.ones((B_pf,), np.float32)
+        seeds = np.zeros((B_pf,), np.uint32)
         meta = []
         for lane, s in enumerate(chosen):
             chunk = chunk_of[s.request_id]
@@ -2289,6 +2340,7 @@ class JaxEngine:
             temps[lane] = s.temperature
             top_ks[lane] = s.top_k
             top_ps[lane] = s.top_p
+            seeds[lane] = s.sample_seed
             meta.append((s, chunk, lane))
 
         if any(s.mm for s in chosen):
@@ -2311,7 +2363,7 @@ class JaxEngine:
                 {
                     "toks": toks, "positions": positions, "tables": tables,
                     "ctx_lens": ctx_lens, "last_idx": last_idx, "temps": temps,
-                    "top_ks": top_ks, "top_ps": top_ps,
+                    "top_ks": top_ks, "top_ps": top_ps, "seeds": seeds,
                     "emb": emb, "emb_mask": emb_mask,
                 },
             )
@@ -2319,7 +2371,7 @@ class JaxEngine:
                 partial(
                     self._dev_prefill_mm,
                     toks, positions, tables, ctx_lens, last_idx,
-                    temps, top_ks, top_ps, emb, emb_mask,
+                    temps, top_ks, top_ps, seeds, emb, emb_mask,
                 ),
                 tag="prefill",
             )
@@ -2338,14 +2390,15 @@ class JaxEngine:
                 {
                     "toks": toks, "positions": positions, "tables": tables,
                     "ctx_lens": ctx_lens, "last_idx": last_idx, "temps": temps,
-                    "top_ks": top_ks, "top_ps": top_ps, "mask": mask,
+                    "top_ks": top_ks, "top_ps": top_ps, "seeds": seeds,
+                    "mask": mask,
                 },
             )
             first_dev = await self._run_on_device(
                 partial(
                     self._dev_prefill_guided,
                     toks, positions, tables, ctx_lens, last_idx,
-                    temps, top_ks, top_ps, mask,
+                    temps, top_ks, top_ps, seeds, mask,
                 ),
                 tag="prefill",
             )
@@ -2358,14 +2411,15 @@ class JaxEngine:
                 {
                     "toks": toks, "positions": positions, "tables": tables,
                     "ctx_lens": ctx_lens, "last_idx": last_idx, "temps": temps,
-                    "top_ks": top_ks, "top_ps": top_ps, "idx": lane_idx,
+                    "top_ks": top_ks, "top_ps": top_ps, "seeds": seeds,
+                    "idx": lane_idx,
                 },
             )
             first_dev = await self._run_on_device(
                 partial(
                     self._dev_prefill_lora,
                     toks, positions, tables, ctx_lens, last_idx,
-                    temps, top_ks, top_ps, lane_idx,
+                    temps, top_ks, top_ps, seeds, lane_idx,
                 ),
                 tag="prefill",
             )
@@ -2375,13 +2429,14 @@ class JaxEngine:
                 {
                     "toks": toks, "positions": positions, "tables": tables,
                     "ctx_lens": ctx_lens, "last_idx": last_idx, "temps": temps,
-                    "top_ks": top_ks, "top_ps": top_ps,
+                    "top_ks": top_ks, "top_ps": top_ps, "seeds": seeds,
                 },
             )
             first_dev = await self._run_on_device(
                 partial(
                     self._dev_prefill,
-                    toks, positions, tables, ctx_lens, last_idx, temps, top_ks, top_ps,
+                    toks, positions, tables, ctx_lens, last_idx, temps,
+                    top_ks, top_ps, seeds,
                 ),
                 tag="prefill",
             )
@@ -2421,26 +2476,30 @@ class JaxEngine:
         temps = np.array([slot.temperature], np.float32)
         top_ks = np.array([slot.top_k], np.int32)
         top_ps = np.array([slot.top_p], np.float32)
+        seeds = np.array([slot.sample_seed], np.uint32)
         self._bcast(
             "prefill_single",
             {
                 "toks": toks, "table": table, "ctx": np.array([ctx]),
                 "real": np.array([real]), "temps": temps,
-                "top_ks": top_ks, "top_ps": top_ps,
+                "top_ks": top_ks, "top_ps": top_ps, "seeds": seeds,
             },
         )
         first_dev = await self._run_on_device(
-            partial(self._dev_prefill_single, toks, table, ctx, real, temps, top_ks, top_ps),
+            partial(self._dev_prefill_single, toks, table, ctx, real, temps,
+                    top_ks, top_ps, seeds),
             tag="prefill",
         )
         slot.prefill_pos += chunk
         self._pending_prefill.append({"first": first_dev, "done": [(slot, 0)]})
 
-    def _dev_prefill_single(self, toks, table, ctx, real, temps, top_ks, top_ps):
+    def _dev_prefill_single(self, toks, table, ctx, real, temps, top_ks,
+                            top_ps, seeds):
         samp = SamplingParams(
             temperature=jnp.asarray(temps),
             top_k=jnp.asarray(top_ks),
             top_p=jnp.asarray(top_ps),
+            seed=jnp.asarray(seeds),
         )
         first, self.kv_k, self.kv_v, self._rng = self._prefill_single(
             self.params, self.kv_k, self.kv_v,
@@ -2849,7 +2908,7 @@ class JaxEngine:
                 "tokens": tokens, "positions": positions,
                 "seq_lens": seq_lens_step, "page_tables": tables,
                 "temps": self.temps, "top_ks": self.top_ks,
-                "top_ps": self.top_ps,
+                "top_ps": self.top_ps, "seeds": self.seeds,
             }
             if hist is not None:
                 payload["hist"] = hist
@@ -2859,7 +2918,8 @@ class JaxEngine:
                     self._dev_reset,
                     tokens, positions, seq_lens_step,
                     tables, self.temps.copy(),
-                    self.top_ks.copy(), self.top_ps.copy(), hist,
+                    self.top_ks.copy(), self.top_ps.copy(),
+                    self.seeds.copy(), hist,
                 ),
                 tag="reset",
             )
@@ -2892,7 +2952,7 @@ class JaxEngine:
                 "tokens": n_tokens, "positions": n_positions,
                 "seq_lens": n_seq_lens, "page_tables": n_tables,
                 "temps": self.temps, "top_ks": self.top_ks,
-                "top_ps": self.top_ps,
+                "top_ps": self.top_ps, "seeds": self.seeds,
             }
             if hist is not None:
                 payload["hist"] = hist
@@ -2902,7 +2962,8 @@ class JaxEngine:
                     self._dev_patch, lane_mask, table_mask,
                     n_tokens, n_positions, n_seq_lens,
                     n_tables, self.temps.copy(),
-                    self.top_ks.copy(), self.top_ps.copy(), hist,
+                    self.top_ks.copy(), self.top_ps.copy(),
+                    self.seeds.copy(), hist,
                 ),
                 tag="patch",
             )

@@ -27,18 +27,27 @@ def unpack_mask(packed: jax.Array, vocab: int) -> jax.Array:
 
 
 class SamplingParams(NamedTuple):
-    """Per-slot device-resident sampling state."""
+    """Per-slot device-resident sampling state.
+
+    `seed`: per-lane sampling seed (uint32). Sampling draws are derived
+    from (seed, position) — NOT from a shared RNG stream — so a request
+    with an explicit seed reproduces its output exactly, independent of
+    what other traffic it was batched with, of lane placement, and of
+    preemption/resume. (The engines the reference fronts can't promise
+    batch-independent seeded sampling.)"""
 
     temperature: jax.Array  # [B] f32; <=0 means greedy
     top_k: jax.Array  # [B] i32; 0 = disabled
     top_p: jax.Array  # [B] f32; 1.0 = disabled
+    seed: jax.Array = None  # [B] u32; per-lane sampling seed
 
     @classmethod
-    def full(cls, batch: int, temperature=0.0, top_k=0, top_p=1.0):
+    def full(cls, batch: int, temperature=0.0, top_k=0, top_p=1.0, seed=0):
         return cls(
             temperature=jnp.full((batch,), temperature, jnp.float32),
             top_k=jnp.full((batch,), top_k, jnp.int32),
             top_p=jnp.full((batch,), top_p, jnp.float32),
+            seed=jnp.full((batch,), seed, jnp.uint32),
         )
 
 
@@ -58,8 +67,12 @@ def sample(
     params: SamplingParams,
     key: jax.Array,
     mask: jax.Array = None,  # [B, V] bool: admissible tokens (guided decoding)
+    positions: jax.Array = None,  # [B] i32: per-lane draw counter (seeded path)
 ) -> jax.Array:
-    """Returns sampled token ids [B]."""
+    """Returns sampled token ids [B]. With `positions` (and params.seed)
+    the draw is counter-based per lane — batch-independent seeded
+    sampling; without, the legacy shared-key categorical path runs
+    (spec verify, profiler, compile-check callers)."""
     if mask is not None:
         # guided decoding: inadmissible tokens are removed BEFORE the
         # candidate extraction so the top-K set is drawn from the legal
@@ -87,7 +100,25 @@ def sample(
     keep = (cum - probs) < params.top_p[:, None]  # always keeps the first
     scaled = jnp.where(keep, scaled, -jnp.inf)
 
-    sampled_pos = jax.random.categorical(key, scaled, axis=-1)  # [B]
+    if positions is not None and params.seed is not None:
+        # counter-based per-lane draw: uniforms from (lane seed, position)
+        # via gumbel-max — reproducible under re-batching, lane moves and
+        # preemption resume (see SamplingParams.seed)
+        def lane_u(s, p):
+            k = jax.random.fold_in(
+                jax.random.fold_in(jax.random.PRNGKey(7), s), p
+            )
+            return jax.random.uniform(
+                k, (K,), minval=1e-7, maxval=1.0 - 1e-7
+            )
+
+        u = jax.vmap(lane_u)(
+            params.seed.astype(jnp.uint32), positions.astype(jnp.uint32)
+        )  # [B, K]
+        gumbel = -jnp.log(-jnp.log(u))
+        sampled_pos = jnp.argmax(scaled + gumbel, axis=-1)
+    else:
+        sampled_pos = jax.random.categorical(key, scaled, axis=-1)  # [B]
     sampled_tokens = jnp.take_along_axis(cand_idx, sampled_pos[:, None], axis=1)[:, 0]
 
     return jnp.where(params.temperature <= 0.0, greedy_tokens, sampled_tokens)
@@ -101,6 +132,7 @@ def sample_lp(
     params: SamplingParams,
     key: jax.Array,
     mask: jax.Array = None,
+    positions: jax.Array = None,
 ) -> tuple:
     """sample() + RAW-model logprobs (log-softmax of the unscaled,
     unmasked logits — the OpenAI `logprobs` surface; under guided masks
@@ -115,7 +147,7 @@ def sample_lp(
     set (the same approx-top-K reduction sample() uses — no full-vocab
     sort on the step path); the only full-vocab extra is one logsumexp
     pass for normalization."""
-    tokens = sample(logits, params, key, mask=mask)
+    tokens = sample(logits, params, key, mask=mask, positions=positions)
     raw = logits.astype(jnp.float32)
     logz = jax.scipy.special.logsumexp(raw, axis=-1)
     chosen = jnp.take_along_axis(raw, tokens[:, None], axis=-1)[:, 0]

@@ -425,7 +425,8 @@ def schema_to_regex(schema: dict, depth: int = DEFAULT_DEPTH) -> str:
     for union_key in ("anyOf", "oneOf"):
         if union_key in schema:
             siblings = (
-                {"type", "properties", "items", "enum", "const", "required"}
+                {"type", "properties", "items", "enum", "const", "required",
+                 "minLength", "maxLength", "pattern", "minItems", "maxItems"}
                 & set(schema)
             )
             if siblings:
@@ -438,9 +439,11 @@ def schema_to_regex(schema: dict, depth: int = DEFAULT_DEPTH) -> str:
             subs = schema[union_key]
             if not subs or not isinstance(subs, list):
                 raise ValueError(f"{union_key} must be a non-empty list")
+            if depth <= 0:
+                raise ValueError("schema nesting exceeds supported depth")
             return (
                 "("
-                + "|".join(schema_to_regex(s, depth) for s in subs)
+                + "|".join(schema_to_regex(s, depth - 1) for s in subs)
                 + ")"
             )
     t = schema.get("type")

@@ -155,6 +155,8 @@ def test_schema_hostile_inputs_reject_cleanly():
         # WEAKER than asked — reject
         {"type": "object", "properties": {"x": {"type": "integer"}},
          "anyOf": [{"type": "integer"}]},
+        {"anyOf": [{"type": "string"}], "maxLength": 3},
+        {"anyOf": [{"type": "string"}], "pattern": "a+"},
     ):
         with pytest.raises(ValueError):
             g.spec_to_regex({"kind": "json_schema", "schema": bad})
@@ -166,6 +168,13 @@ def test_schema_hostile_inputs_reject_cleanly():
             "schema": {"type": "string", "maxLength": 300000},
         }))
     assert _t.monotonic() - t0 < 2.0
+    # union nesting respects the depth bound (clean reject, not a
+    # RecursionError rescued by the blanket handler)
+    deep = {"type": "integer"}
+    for _ in range(50):
+        deep = {"anyOf": [deep]}
+    with pytest.raises(ValueError, match="depth"):
+        g.schema_to_regex(deep)
 
 
 def test_free_json_value_bounded_depth():

@@ -316,6 +316,61 @@ def test_greedy_logprobs_match_full_recompute(params):
     assert all("log_probs" not in o for o in asyncio.run(plain()))
 
 
+def test_seeded_sampling_batch_independent(params):
+    """A seeded request reproduces its output EXACTLY regardless of what
+    it was co-batched with (counter-based per-lane draws keyed on
+    (seed, position) — sampling.py SamplingParams.seed). Unseeded
+    concurrent identical requests must still diverge."""
+
+    prompt = [5, 9, 17, 33, 101, 7]
+
+    def mk():
+        return JaxEngine(EngineConfig(
+            model="tiny", max_num_seqs=4, page_size=PAGE, num_pages=128,
+            max_model_len=256, prefill_buckets=(16, 32),
+        ), model_config=CFG, params=params)
+
+    async def run(eng, rid, seed, with_noise=False, prompt_=None):
+        async def one(r, p, s):
+            req = PreprocessedRequest(
+                token_ids=p,
+                stop_conditions={"max_tokens": 10, "ignore_eos": True},
+                sampling_options={"temperature": 1.0,
+                                  **({"seed": s} if s is not None else {})},
+                request_id=r,
+            ).to_dict()
+            toks = []
+            async for item in eng.generate(req, Context()):
+                if item.get("data"):
+                    toks.extend(item["data"]["token_ids"])
+            return toks
+
+        tasks = [one(rid, prompt_ or prompt, seed)]
+        if with_noise:
+            tasks += [one(f"noise{i}", list(range(40 + i, 70 + i)), None)
+                      for i in range(2)]
+        return (await asyncio.gather(*tasks))[0]
+
+    async def main():
+        e1 = mk()
+        alone = await run(e1, "a", 1234)
+        await e1.close()
+        e2 = mk()
+        batched = await run(e2, "b", 1234, with_noise=True)
+        other_seed = await run(e2, "c", 99)
+        unseeded = await asyncio.gather(
+            run(e2, "u1", None), run(e2, "u2", None)
+        )
+        await e2.close()
+        assert alone == batched, "seeded output changed under co-batching"
+        assert alone != other_seed, "different seeds gave identical output"
+        assert unseeded[0] != unseeded[1], (
+            "unseeded concurrent identical requests must diverge (n>1)"
+        )
+
+    asyncio.run(main())
+
+
 def test_cancellation_releases_pages(params):
     async def main():
         cfg = EngineConfig(
