@@ -64,6 +64,11 @@ class EngineConfig:
     # sampling defaults
     default_temperature: float = 0.0
     seed: int = 0
+    # OpenAI penalties window: recent tokens tracked per lane ON DEVICE
+    # (static shape; vLLM penalizes the full context — a bounded window
+    # is the TPU-shaped approximation, covering the repetition loops
+    # penalties exist to break)
+    penalty_window: int = 256
     # parallelism (parallel/mesh.py)
     tp_size: int = 1
     dp_size: int = 1

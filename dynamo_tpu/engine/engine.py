@@ -48,7 +48,7 @@ from ..models import llama
 from ..runtime.engine import Context
 from .config import EngineConfig
 from .kv_cache import PageAllocator, alloc_kv_arrays
-from .sampling import SamplingParams, sample, sample_lp, unpack_mask
+from .sampling import SamplingParams, penalized, sample, sample_lp, unpack_mask
 
 logger = logging.getLogger(__name__)
 
@@ -225,6 +225,9 @@ class _Slot:
     lora_idx: int = 0  # adapter slot in the engine's LoRA stack (0 = base)
     want_logprobs: bool = False  # attach sampled-token logprobs to emissions
     sample_seed: int = 0  # per-request sampling seed (SamplingParams.seed)
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
+    repetition_penalty: float = 1.0
     want_top_logprobs: int = 0  # top-k alternatives per token (max 5)
 
 
@@ -340,6 +343,12 @@ class JaxEngine:
         self.top_ks = np.zeros((B,), np.int32)
         self.top_ps = np.ones((B,), np.float32)
         self.seeds = np.zeros((B,), np.uint32)  # per-lane sampling seeds
+        self.presence = np.zeros((B,), np.float32)
+        self.frequency = np.zeros((B,), np.float32)
+        self.repetition = np.ones((B,), np.float32)
+        # recent-token ring per lane (penalties window; pad = -1). Host
+        # mirror for reset/patch; the device copy rides the decode carry.
+        self.recent = np.full((B, config.penalty_window), -1, np.int32)
         self.slots: List[Optional[_Slot]] = [None] * B
         self._free_slots = list(range(B - 1, -1, -1))
         self._waiting: List[_Slot] = []
@@ -412,6 +421,7 @@ class JaxEngine:
         # on device is NEWER than host and must not be overwritten)
         self._tables_dev = None
         self._samp_dev = None
+        self._pen_dev = None  # [B, W] recent-token ring (penalties)
         self._inflight: deque = deque()  # [{"active": [...], "toks": dev[K,B]}]
         # pending prefill completions awaiting their first-token fetch
         self._pending_prefill: List[dict] = []
@@ -447,7 +457,7 @@ class JaxEngine:
 
             repl = NamedSharding(self._mesh, PartitionSpec())
             kvs = self._kv_sharding or repl
-            decode_out_sh = (repl, repl, repl, repl, kvs, kvs, repl)
+            decode_out_sh = (repl, repl, repl, repl, kvs, kvs, repl, repl)
             prefill_out_sh = (repl, kvs, kvs, repl)
 
         # the RNG key lives ON DEVICE and is threaded through every program
@@ -456,8 +466,8 @@ class JaxEngine:
         # ~9 ms/step through the axon tunnel, the round-1 ITL killer
         if cfg.decode_pool_mode == "local":
 
-            @partial(jax.jit, donate_argnums=(1, 2, 8), out_shardings=decode_out_sh)
-            def decode_block(params, kv_k, kv_v, tokens, positions, seq_lens, page_tables, samp, rng):
+            @partial(jax.jit, donate_argnums=(1, 2, 8, 9), out_shardings=decode_out_sh)
+            def decode_block(params, kv_k, kv_v, tokens, positions, seq_lens, page_tables, samp, rng, pen):
                 """K fused decode steps, pool READ-ONLY inside the scan.
 
                 A per-step scatter into the pool makes XLA materialize
@@ -480,24 +490,28 @@ class JaxEngine:
                     jnp.zeros(loc_shape, kv_v.dtype) for _ in range(c.num_layers)
                 )
 
+                W = pen.shape[1]
+
                 def step(carry, inp):
                     key_j, j = inp
-                    tokens, positions, seq_lens, loc_k, loc_v = carry
+                    tokens, positions, seq_lens, loc_k, loc_v, pen = carry
                     logits, loc_k, loc_v = self._model.decode_forward_local(
                         params, c, tokens, positions, loc_k, loc_v, j,
                         kv_k, kv_v, page_tables, pool_lens,
                     )
+                    plogits = penalized(logits, samp, pen)
                     nxt, lp, tid, tlp = sample_lp(
-                        logits, samp, key_j, positions=positions
+                        plogits, samp, key_j, positions=positions, raw=logits
                     )
+                    pen = pen.at[jnp.arange(B), (positions + 1) % W].set(nxt)
                     return (
-                        (nxt, positions + 1, seq_lens + 1, loc_k, loc_v),
+                        (nxt, positions + 1, seq_lens + 1, loc_k, loc_v, pen),
                         (nxt, lp, tid, tlp),
                     )
 
-                (tokens, positions, seq_lens, loc_k, loc_v), toks = jax.lax.scan(
+                (tokens, positions, seq_lens, loc_k, loc_v, pen), toks = jax.lax.scan(
                     step,
-                    (tokens, positions, seq_lens, loc_k0, loc_v0),
+                    (tokens, positions, seq_lens, loc_k0, loc_v0, pen),
                     (keys, jnp.arange(K)),
                     unroll=min(max(cfg.decode_block_unroll, 1), K),
                 )
@@ -514,21 +528,23 @@ class JaxEngine:
                 offs = pos % page_size
                 kv_k = kv_k.at[:, phys, offs].set(jnp.stack(loc_k))
                 kv_v = kv_v.at[:, phys, offs].set(jnp.stack(loc_v))
-                return toks, tokens, positions, seq_lens, kv_k, kv_v, rng
+                return toks, tokens, positions, seq_lens, kv_k, kv_v, rng, pen
 
         else:
 
-            @partial(jax.jit, donate_argnums=(1, 2, 8), out_shardings=decode_out_sh)
-            def decode_block(params, kv_k, kv_v, tokens, positions, seq_lens, page_tables, samp, rng):
+            @partial(jax.jit, donate_argnums=(1, 2, 8, 9), out_shardings=decode_out_sh)
+            def decode_block(params, kv_k, kv_v, tokens, positions, seq_lens, page_tables, samp, rng, pen):
                 """K fused decode steps: sampled tokens feed the next step on
                 device — one host read per K*B tokens instead of per token.
                 Per-step pool scatter (best at small/medium pools; see
                 EngineConfig.decode_pool_mode for the trade-off)."""
                 rng, sub = jax.random.split(rng)
                 keys = jax.random.split(sub, K)
+                W = pen.shape[1]
+                B = tokens.shape[0]
 
                 def step(carry, k):
-                    tokens, positions, seq_lens, kv_k, kv_v = carry
+                    tokens, positions, seq_lens, kv_k, kv_v, pen = carry
                     if cfg.pp_size > 1:
                         # layers pipelined over pp: each step is a full
                         # microbatch schedule (parallel/pipeline.py)
@@ -540,18 +556,20 @@ class JaxEngine:
                         logits, kv_k, kv_v = self._model.decode_forward(
                             params, c, tokens, positions, kv_k, kv_v, page_tables, seq_lens
                         )
+                    plogits = penalized(logits, samp, pen)
                     nxt, lp, tid, tlp = sample_lp(
-                        logits, samp, k, positions=positions
+                        plogits, samp, k, positions=positions, raw=logits
                     )
+                    pen = pen.at[jnp.arange(B), (positions + 1) % W].set(nxt)
                     return (
-                        (nxt, positions + 1, seq_lens + 1, kv_k, kv_v),
+                        (nxt, positions + 1, seq_lens + 1, kv_k, kv_v, pen),
                         (nxt, lp, tid, tlp),
                     )
 
-                (tokens, positions, seq_lens, kv_k, kv_v), toks = jax.lax.scan(
-                    step, (tokens, positions, seq_lens, kv_k, kv_v), keys
+                (tokens, positions, seq_lens, kv_k, kv_v, pen), toks = jax.lax.scan(
+                    step, (tokens, positions, seq_lens, kv_k, kv_v, pen), keys
                 )
-                return toks, tokens, positions, seq_lens, kv_k, kv_v, rng
+                return toks, tokens, positions, seq_lens, kv_k, kv_v, rng, pen
 
         self._decode_block = decode_block
 
@@ -633,14 +651,15 @@ class JaxEngine:
             self._spec_block_fn = spec_block
 
         @partial(jax.jit, donate_argnums=(1, 2, 9), out_shardings=prefill_out_sh)
-        def prefill_batch(params, kv_k, kv_v, tokens, positions, page_tables, ctx_lens, last_idx, samp, rng):
+        def prefill_batch(params, kv_k, kv_v, tokens, positions, page_tables, ctx_lens, last_idx, samp, rng, pen):
             """Batched chunked prefill + on-device first-token sampling."""
             rng, sub = jax.random.split(rng)
             logits, kv_k, kv_v = self._model.prefill_forward_batched(
                 params, c, tokens, positions, kv_k, kv_v, page_tables, ctx_lens, last_idx
             )
+            plogits = penalized(logits, samp, pen)
             first = sample_lp(
-                logits, samp, sub, positions=ctx_lens + last_idx
+                plogits, samp, sub, positions=ctx_lens + last_idx, raw=logits
             )
             return first, kv_k, kv_v, rng
 
@@ -648,7 +667,7 @@ class JaxEngine:
 
         @partial(jax.jit, donate_argnums=(1, 2, 9), out_shardings=prefill_out_sh)
         def prefill_batch_mm(params, kv_k, kv_v, tokens, positions, page_tables,
-                             ctx_lens, last_idx, samp, rng, emb, emb_mask):
+                             ctx_lens, last_idx, samp, rng, pen, emb, emb_mask):
             """Batched prefill with the multimodal embedding splice: encoder
             rows replace placeholder-token embeddings (E/P/D flow). A
             separate program so text-only dispatches never carry the
@@ -659,8 +678,9 @@ class JaxEngine:
                 params, c, tokens, positions, kv_k, kv_v, page_tables,
                 ctx_lens, last_idx, emb_override=emb, emb_mask=emb_mask,
             )
+            plogits = penalized(logits, samp, pen)
             first = sample_lp(
-                logits, samp, sub, positions=ctx_lens + last_idx
+                plogits, samp, sub, positions=ctx_lens + last_idx, raw=logits
             )
             return first, kv_k, kv_v, rng
 
@@ -673,9 +693,9 @@ class JaxEngine:
         # actually arrives. The decode variant is a SINGLE step: the mask
         # for step t+1 depends host-side on the token emitted at step t,
         # so guided decode cannot ride the K-step fused block.
-        @partial(jax.jit, donate_argnums=(1, 2, 8), out_shardings=decode_out_sh)
+        @partial(jax.jit, donate_argnums=(1, 2, 8, 10), out_shardings=decode_out_sh)
         def decode_step_guided(params, kv_k, kv_v, tokens, positions, seq_lens,
-                               page_tables, samp, rng, mask_packed):
+                               page_tables, samp, rng, mask_packed, pen):
             rng, sub = jax.random.split(rng)
             if cfg.pp_size > 1:
                 logits, kv_k, kv_v = self._model.decode_forward_pp(
@@ -686,13 +706,17 @@ class JaxEngine:
                 logits, kv_k, kv_v = self._model.decode_forward(
                     params, c, tokens, positions, kv_k, kv_v, page_tables, seq_lens
                 )
+            plogits = penalized(logits, samp, pen)
             mask = unpack_mask(mask_packed, c.vocab_size)
             nxt, lp, tid, tlp = sample_lp(
-                logits, samp, sub, mask=mask, positions=positions
+                plogits, samp, sub, mask=mask, positions=positions, raw=logits
             )
+            pen = pen.at[
+                jnp.arange(pen.shape[0]), (positions + 1) % pen.shape[1]
+            ].set(nxt)
             return (
                 (nxt[None], lp[None], tid[None], tlp[None]),
-                nxt, positions + 1, seq_lens + 1, kv_k, kv_v, rng,
+                nxt, positions + 1, seq_lens + 1, kv_k, kv_v, rng, pen,
             )
 
         self._decode_step_guided = decode_step_guided
@@ -701,22 +725,26 @@ class JaxEngine:
         # step must still apply the LoRA deltas, or the LoRA lane would
         # silently generate (and write KV!) with the base model while a
         # guided request is in flight
-        @partial(jax.jit, donate_argnums=(1, 2, 8), out_shardings=decode_out_sh)
+        @partial(jax.jit, donate_argnums=(1, 2, 8, 10), out_shardings=decode_out_sh)
         def decode_step_guided_lora(params, kv_k, kv_v, tokens, positions,
                                     seq_lens, page_tables, samp, rng,
-                                    mask_packed, lora):
+                                    mask_packed, pen, lora):
             rng, sub = jax.random.split(rng)
             logits, kv_k, kv_v = self._model.decode_forward(
                 params, c, tokens, positions, kv_k, kv_v, page_tables,
                 seq_lens, lora=lora,
             )
+            plogits = penalized(logits, samp, pen)
             mask = unpack_mask(mask_packed, c.vocab_size)
             nxt, lp, tid, tlp = sample_lp(
-                logits, samp, sub, mask=mask, positions=positions
+                plogits, samp, sub, mask=mask, positions=positions, raw=logits
             )
+            pen = pen.at[
+                jnp.arange(pen.shape[0]), (positions + 1) % pen.shape[1]
+            ].set(nxt)
             return (
                 (nxt[None], lp[None], tid[None], tlp[None]),
-                nxt, positions + 1, seq_lens + 1, kv_k, kv_v, rng,
+                nxt, positions + 1, seq_lens + 1, kv_k, kv_v, rng, pen,
             )
 
         self._decode_step_guided_lora = decode_step_guided_lora
@@ -724,15 +752,17 @@ class JaxEngine:
         @partial(jax.jit, donate_argnums=(1, 2, 9), out_shardings=prefill_out_sh)
         def prefill_batch_guided(params, kv_k, kv_v, tokens, positions,
                                  page_tables, ctx_lens, last_idx, samp, rng,
-                                 mask_packed):
+                                 pen, mask_packed):
             rng, sub = jax.random.split(rng)
             logits, kv_k, kv_v = self._model.prefill_forward_batched(
                 params, c, tokens, positions, kv_k, kv_v, page_tables,
                 ctx_lens, last_idx
             )
+            plogits = penalized(logits, samp, pen)
             mask = unpack_mask(mask_packed, c.vocab_size)
             first = sample_lp(
-                logits, samp, sub, mask=mask, positions=ctx_lens + last_idx
+                plogits, samp, sub, mask=mask,
+                positions=ctx_lens + last_idx, raw=logits
             )
             return first, kv_k, kv_v, rng
 
@@ -744,44 +774,49 @@ class JaxEngine:
         # masking. Lazy jits: compile only when adapters are registered and
         # a LoRA request arrives. K-step fused blocks work unchanged —
         # adapters are static per lane, unlike guided masks.
-        @partial(jax.jit, donate_argnums=(1, 2, 8), out_shardings=decode_out_sh)
+        @partial(jax.jit, donate_argnums=(1, 2, 8, 9), out_shardings=decode_out_sh)
         def decode_block_lora(params, kv_k, kv_v, tokens, positions, seq_lens,
-                              page_tables, samp, rng, lora):
+                              page_tables, samp, rng, pen, lora):
             rng, sub = jax.random.split(rng)
             keys = jax.random.split(sub, K)
+            W = pen.shape[1]
+            B = tokens.shape[0]
 
             def step(carry, key_j):
-                tokens, positions, seq_lens, kv_k, kv_v = carry
+                tokens, positions, seq_lens, kv_k, kv_v, pen = carry
                 logits, kv_k, kv_v = self._model.decode_forward(
                     params, c, tokens, positions, kv_k, kv_v, page_tables,
                     seq_lens, lora=lora,
                 )
+                plogits = penalized(logits, samp, pen)
                 nxt, lp, tid, tlp = sample_lp(
-                    logits, samp, key_j, positions=positions
+                    plogits, samp, key_j, positions=positions, raw=logits
                 )
+                pen = pen.at[jnp.arange(B), (positions + 1) % W].set(nxt)
                 return (
-                    (nxt, positions + 1, seq_lens + 1, kv_k, kv_v),
+                    (nxt, positions + 1, seq_lens + 1, kv_k, kv_v, pen),
                     (nxt, lp, tid, tlp),
                 )
 
-            (tokens, positions, seq_lens, kv_k, kv_v), toks = jax.lax.scan(
-                step, (tokens, positions, seq_lens, kv_k, kv_v), keys
+            (tokens, positions, seq_lens, kv_k, kv_v, pen), toks = jax.lax.scan(
+                step, (tokens, positions, seq_lens, kv_k, kv_v, pen), keys
             )
-            return toks, tokens, positions, seq_lens, kv_k, kv_v, rng
+            return toks, tokens, positions, seq_lens, kv_k, kv_v, rng, pen
 
         self._decode_block_lora = decode_block_lora
 
         @partial(jax.jit, donate_argnums=(1, 2, 9), out_shardings=prefill_out_sh)
         def prefill_batch_lora(params, kv_k, kv_v, tokens, positions,
                                page_tables, ctx_lens, last_idx, samp, rng,
-                               lora):
+                               pen, lora):
             rng, sub = jax.random.split(rng)
             logits, kv_k, kv_v = self._model.prefill_forward_batched(
                 params, c, tokens, positions, kv_k, kv_v, page_tables,
                 ctx_lens, last_idx, lora=lora,
             )
+            plogits = penalized(logits, samp, pen)
             first = sample_lp(
-                logits, samp, sub, positions=ctx_lens + last_idx
+                plogits, samp, sub, positions=ctx_lens + last_idx, raw=logits
             )
             return first, kv_k, kv_v, rng
 
@@ -800,7 +835,7 @@ class JaxEngine:
             single_out_sh = (repl, kvs, kvs, repl)
 
             @partial(jax.jit, donate_argnums=(1, 2, 7), out_shardings=single_out_sh)
-            def prefill_single(params, kv_k, kv_v, toks, table, ctx_len, real_len, rng, samp):
+            def prefill_single(params, kv_k, kv_v, toks, table, ctx_len, real_len, rng, samp, pen):
                 rng, sub = jax.random.split(rng)
                 if mode == "pp":
                     logits, kv_k, kv_v = self._model.prefill_forward_pp(
@@ -812,8 +847,9 @@ class JaxEngine:
                         params, c, toks, kv_k, kv_v, table, real_len, self._mesh
                     )
                 first = sample_lp(
-                    logits[None], samp, sub,
+                    penalized(logits[None], samp, pen), samp, sub,
                     positions=(ctx_len + real_len - 1)[None],
+                    raw=logits[None],
                 )
                 return first, kv_k, kv_v, rng
 
@@ -830,14 +866,15 @@ class JaxEngine:
             from jax.sharding import NamedSharding, PartitionSpec
 
             repl = NamedSharding(self._mesh, PartitionSpec())
-            patch_out_sh = (repl,) * 8
+            patch_out_sh = (repl,) * 10
 
         @partial(jax.jit, out_shardings=patch_out_sh)
         def patch_lanes(
             tokens, positions, seq_lens, tables, temps, top_ks, top_ps, seeds,
+            pens, recent,
             lane_mask, table_mask,
             n_tokens, n_positions, n_seq_lens, n_tables, n_temps, n_top_ks,
-            n_top_ps, n_seeds,
+            n_top_ps, n_seeds, n_pens, n_recent,
         ):
             tokens = jnp.where(lane_mask, n_tokens, tokens)
             positions = jnp.where(lane_mask, n_positions, positions)
@@ -846,10 +883,12 @@ class JaxEngine:
             top_ks = jnp.where(lane_mask, n_top_ks, top_ks)
             top_ps = jnp.where(lane_mask, n_top_ps, top_ps)
             seeds = jnp.where(lane_mask, n_seeds, seeds)
+            pens = jnp.where(lane_mask[:, None], n_pens, pens)
+            recent = jnp.where(lane_mask[:, None], n_recent, recent)
             tables = jnp.where(table_mask[:, None], n_tables, tables)
             return (
                 tokens, positions, seq_lens, tables, temps, top_ks, top_ps,
-                seeds,
+                seeds, pens, recent,
             )
 
         self._patch_lanes = patch_lanes
@@ -1063,6 +1102,16 @@ class JaxEngine:
         return None
 
     def _check_logprobs(self, req: PreprocessedRequest) -> Optional[str]:
+        s = req.sampling_options or {}
+        if self.config.spec_mode and (
+            s.get("presence_penalty") or s.get("frequency_penalty")
+            or (s.get("repetition_penalty") or 1.0) != 1.0
+        ):
+            return (
+                "sampling penalties are not supported with speculative "
+                "decoding (the verify pass has no penalty hook); run the "
+                "worker without --spec"
+            )
         if (
             self.config.spec_mode
             and (req.sampling_options or {}).get("logprobs")
@@ -1157,6 +1206,11 @@ class JaxEngine:
         slot.top_k = int(sampling.get("top_k") or 0)
         slot.top_p = float(sampling.get("top_p") or 1.0)
         slot.want_logprobs = bool(sampling.get("logprobs"))
+        slot.presence_penalty = float(sampling.get("presence_penalty") or 0.0)
+        slot.frequency_penalty = float(sampling.get("frequency_penalty") or 0.0)
+        slot.repetition_penalty = float(
+            sampling.get("repetition_penalty") or 1.0
+        )
         # explicit seed => reproducible output independent of co-batched
         # traffic (counter-based draws, sampling.py); else a random one —
         # concurrent identical unseeded requests (n>1) must diverge
@@ -1428,6 +1482,10 @@ class JaxEngine:
             self.top_ps[idx] = slot.top_p
             self.lora_idx[idx] = slot.lora_idx
             self.seeds[idx] = slot.sample_seed
+            self.presence[idx] = slot.presence_penalty
+            self.frequency[idx] = slot.frequency_penalty
+            self.repetition[idx] = slot.repetition_penalty
+            self._fill_recent(idx, slot)
             slot.admit_seq = self._admit_counter = self._admit_counter + 1
             return True
         kv_prompt = slot.kv_prompt
@@ -1475,6 +1533,10 @@ class JaxEngine:
         self.top_ps[idx] = slot.top_p
         self.lora_idx[idx] = slot.lora_idx
         self.seeds[idx] = slot.sample_seed
+        self.presence[idx] = slot.presence_penalty
+        self.frequency[idx] = slot.frequency_penalty
+        self.repetition[idx] = slot.repetition_penalty
+        self._fill_recent(idx, slot)
         slot.admit_seq = self._admit_counter = self._admit_counter + 1
         return True
 
@@ -1522,12 +1584,15 @@ class JaxEngine:
     # _bcast; followers replay them verbatim in run_follower) ------------ #
 
     def _dev_prefill(self, toks, positions, tables, ctx_lens, last_idx,
-                     temps, top_ks, top_ps, seeds):
+                     temps, top_ks, top_ps, seeds, pens, pen_rows):
         samp = SamplingParams(
             temperature=jnp.asarray(temps),
             top_k=jnp.asarray(top_ks),
             top_p=jnp.asarray(top_ps),
             seed=jnp.asarray(seeds),
+            presence=jnp.asarray(pens[:, 0]),
+            frequency=jnp.asarray(pens[:, 1]),
+            repetition=jnp.asarray(pens[:, 2]),
         )
         first, self.kv_k, self.kv_v, self._rng = self._prefill_batch(
             self.params,
@@ -1540,16 +1605,21 @@ class JaxEngine:
             jnp.asarray(last_idx),
             samp,
             self._rng,
+            jnp.asarray(pen_rows),
         )
         return first
 
     def _dev_prefill_mm(self, toks, positions, tables, ctx_lens, last_idx,
-                        temps, top_ks, top_ps, seeds, emb, emb_mask):
+                        temps, top_ks, top_ps, seeds, pens, pen_rows,
+                        emb, emb_mask):
         samp = SamplingParams(
             temperature=jnp.asarray(temps),
             top_k=jnp.asarray(top_ks),
             top_p=jnp.asarray(top_ps),
             seed=jnp.asarray(seeds),
+            presence=jnp.asarray(pens[:, 0]),
+            frequency=jnp.asarray(pens[:, 1]),
+            repetition=jnp.asarray(pens[:, 2]),
         )
         first, self.kv_k, self.kv_v, self._rng = self._prefill_batch_mm(
             self.params,
@@ -1562,18 +1632,23 @@ class JaxEngine:
             jnp.asarray(last_idx),
             samp,
             self._rng,
+            jnp.asarray(pen_rows),
             jnp.asarray(emb),
             jnp.asarray(emb_mask),
         )
         return first
 
     def _dev_prefill_guided(self, toks, positions, tables, ctx_lens, last_idx,
-                            temps, top_ks, top_ps, seeds, mask):
+                            temps, top_ks, top_ps, seeds, pens, pen_rows,
+                            mask):
         samp = SamplingParams(
             temperature=jnp.asarray(temps),
             top_k=jnp.asarray(top_ks),
             top_p=jnp.asarray(top_ps),
             seed=jnp.asarray(seeds),
+            presence=jnp.asarray(pens[:, 0]),
+            frequency=jnp.asarray(pens[:, 1]),
+            repetition=jnp.asarray(pens[:, 2]),
         )
         first, self.kv_k, self.kv_v, self._rng = self._prefill_batch_guided(
             self.params,
@@ -1586,6 +1661,7 @@ class JaxEngine:
             jnp.asarray(last_idx),
             samp,
             self._rng,
+            jnp.asarray(pen_rows),
             jnp.asarray(mask),
         )
         return first
@@ -1599,12 +1675,15 @@ class JaxEngine:
         }
 
     def _dev_prefill_lora(self, toks, positions, tables, ctx_lens, last_idx,
-                          temps, top_ks, top_ps, seeds, idx):
+                          temps, top_ks, top_ps, seeds, pens, pen_rows, idx):
         samp = SamplingParams(
             temperature=jnp.asarray(temps),
             top_k=jnp.asarray(top_ks),
             top_p=jnp.asarray(top_ps),
             seed=jnp.asarray(seeds),
+            presence=jnp.asarray(pens[:, 0]),
+            frequency=jnp.asarray(pens[:, 1]),
+            repetition=jnp.asarray(pens[:, 2]),
         )
         first, self.kv_k, self.kv_v, self._rng = self._prefill_batch_lora(
             self.params,
@@ -1617,6 +1696,7 @@ class JaxEngine:
             jnp.asarray(last_idx),
             samp,
             self._rng,
+            jnp.asarray(pen_rows),
             self._lora_operand(idx),
         )
         return first
@@ -1631,6 +1711,7 @@ class JaxEngine:
             self.kv_k,
             self.kv_v,
             self._rng,
+            self._pen_dev,
         ) = self._decode_block_lora(
             self.params,
             self.kv_k,
@@ -1641,43 +1722,59 @@ class JaxEngine:
             self._tables_dev,
             self._samp_dev,
             self._rng,
+            self._pen_dev,
             self._lora_operand(idx),
         )
         self._carry = (tok_d, pos_d, sl_d)
         return toks
 
     def _dev_reset(self, tokens, positions, seq_lens, page_tables, temps,
-                   top_ks, top_ps, seeds, hist=None):
+                   top_ks, top_ps, seeds, pens, recent, hist=None):
         self._samp_dev = SamplingParams(
             temperature=jnp.asarray(temps),
             top_k=jnp.asarray(top_ks),
             top_p=jnp.asarray(top_ps),
             seed=jnp.asarray(seeds),
+            presence=jnp.asarray(pens[:, 0]),
+            frequency=jnp.asarray(pens[:, 1]),
+            repetition=jnp.asarray(pens[:, 2]),
         )
         self._carry = (
             jnp.asarray(tokens),
             jnp.asarray(positions),
             jnp.asarray(seq_lens),
         )
+        self._pen_dev = jnp.asarray(recent)
         self._tables_dev = jnp.asarray(page_tables)
         if hist is not None:
             self._hist_dev = jnp.asarray(hist)
 
     def _dev_patch(self, lane_mask, table_mask, tokens, positions, seq_lens,
-                   tables, temps, top_ks, top_ps, seeds, hist=None):
+                   tables, temps, top_ks, top_ps, seeds, pens, recent,
+                   hist=None):
         samp = self._samp_dev
-        tok_d, pos_d, sl_d, tab_d, t_d, k_d, p_d, s_d = self._patch_lanes(
+        pens_cur = jnp.stack(
+            [samp.presence, samp.frequency, samp.repetition], axis=1
+        )
+        (
+            tok_d, pos_d, sl_d, tab_d, t_d, k_d, p_d, s_d, pen_d, rec_d,
+        ) = self._patch_lanes(
             self._carry[0], self._carry[1], self._carry[2], self._tables_dev,
             samp.temperature, samp.top_k, samp.top_p, samp.seed,
+            pens_cur, self._pen_dev,
             jnp.asarray(lane_mask), jnp.asarray(table_mask),
             jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(seq_lens),
             jnp.asarray(tables), jnp.asarray(temps), jnp.asarray(top_ks),
-            jnp.asarray(top_ps), jnp.asarray(seeds),
+            jnp.asarray(top_ps), jnp.asarray(seeds), jnp.asarray(pens),
+            jnp.asarray(recent),
         )
         self._carry = (tok_d, pos_d, sl_d)
         self._tables_dev = tab_d
+        self._pen_dev = rec_d
         self._samp_dev = SamplingParams(
-            temperature=t_d, top_k=k_d, top_p=p_d, seed=s_d
+            temperature=t_d, top_k=k_d, top_p=p_d, seed=s_d,
+            presence=pen_d[:, 0], frequency=pen_d[:, 1],
+            repetition=pen_d[:, 2],
         )
         if hist is not None and self._hist_dev is not None:
             # dirty lanes take the host ring row; others keep the (newer)
@@ -1708,6 +1805,7 @@ class JaxEngine:
             self.kv_k,
             self.kv_v,
             self._rng,
+            self._pen_dev,
         ) = self._decode_block(
             self.params,
             self.kv_k,
@@ -1718,6 +1816,7 @@ class JaxEngine:
             self._tables_dev,
             self._samp_dev,
             self._rng,
+            self._pen_dev,
         )
         self._carry = (tok_d, pos_d, sl_d)
         return toks
@@ -1728,7 +1827,7 @@ class JaxEngine:
             self.params, self.kv_k, self.kv_v,
             carry[0], carry[1], carry[2],
             self._tables_dev, self._samp_dev, self._rng,
-            jnp.asarray(mask),
+            jnp.asarray(mask), self._pen_dev,
         )
         if lora_idx is not None:
             out = self._decode_step_guided_lora(
@@ -1738,6 +1837,7 @@ class JaxEngine:
             out = self._decode_step_guided(*args)
         (
             toks, tok_d, pos_d, sl_d, self.kv_k, self.kv_v, self._rng,
+            self._pen_dev,
         ) = out
         self._carry = (tok_d, pos_d, sl_d)
         return toks
@@ -1861,7 +1961,7 @@ class JaxEngine:
                         self._dev_prefill,
                         p["toks"], p["positions"], p["tables"], p["ctx_lens"],
                         p["last_idx"], p["temps"], p["top_ks"], p["top_ps"],
-                        p["seeds"],
+                        p["seeds"], p["pens"], p["pen_rows"],
                     )
                 )
             elif tag == "prefill_mm":
@@ -1870,7 +1970,8 @@ class JaxEngine:
                         self._dev_prefill_mm,
                         p["toks"], p["positions"], p["tables"], p["ctx_lens"],
                         p["last_idx"], p["temps"], p["top_ks"], p["top_ps"],
-                        p["seeds"], p["emb"], p["emb_mask"],
+                        p["seeds"], p["pens"], p["pen_rows"],
+                        p["emb"], p["emb_mask"],
                     )
                 )
             elif tag == "reset":
@@ -1879,7 +1980,7 @@ class JaxEngine:
                         self._dev_reset,
                         p["tokens"], p["positions"], p["seq_lens"],
                         p["page_tables"], p["temps"], p["top_ks"], p["top_ps"],
-                        p["seeds"], p.get("hist"),
+                        p["seeds"], p["pens"], p["recent"], p.get("hist"),
                     )
                 )
             elif tag == "prefill_single":
@@ -1888,6 +1989,7 @@ class JaxEngine:
                         self._dev_prefill_single,
                         p["toks"], p["table"], p["ctx"][0], p["real"][0],
                         p["temps"], p["top_ks"], p["top_ps"], p["seeds"],
+                        p["pens"], p["pen_rows"],
                     )
                 )
             elif tag == "patch":
@@ -1897,7 +1999,7 @@ class JaxEngine:
                         p["lane_mask"], p["table_mask"], p["tokens"],
                         p["positions"], p["seq_lens"], p["page_tables"],
                         p["temps"], p["top_ks"], p["top_ps"], p["seeds"],
-                        p.get("hist"),
+                        p["pens"], p["recent"], p.get("hist"),
                     )
                 )
             elif tag == "prefill_guided":
@@ -1906,7 +2008,7 @@ class JaxEngine:
                         self._dev_prefill_guided,
                         p["toks"], p["positions"], p["tables"], p["ctx_lens"],
                         p["last_idx"], p["temps"], p["top_ks"], p["top_ps"],
-                        p["seeds"], p["mask"],
+                        p["seeds"], p["pens"], p["pen_rows"], p["mask"],
                     )
                 )
             elif tag == "prefill_lora":
@@ -1915,7 +2017,7 @@ class JaxEngine:
                         self._dev_prefill_lora,
                         p["toks"], p["positions"], p["tables"], p["ctx_lens"],
                         p["last_idx"], p["temps"], p["top_ks"], p["top_ps"],
-                        p["seeds"], p["idx"],
+                        p["seeds"], p["pens"], p["pen_rows"], p["idx"],
                     )
                 )
             elif tag == "block":
@@ -2024,6 +2126,7 @@ class JaxEngine:
         self.tokens[slot.slot_idx] = first_token
         self.seq_lens[slot.slot_idx] = len(slot.prompt) + 1
         self._fill_hist(slot.slot_idx, slot)
+        self._fill_recent(slot.slot_idx, slot)
         self._mark_lane_dirty(slot.slot_idx)
         self._maybe_finish(slot, first_token)
 
@@ -2328,6 +2431,10 @@ class JaxEngine:
         top_ks = np.zeros((B_pf,), np.int32)
         top_ps = np.ones((B_pf,), np.float32)
         seeds = np.zeros((B_pf,), np.uint32)
+        pens = np.zeros((B_pf, 3), np.float32)
+        pens[:, 2] = 1.0  # repetition off
+        W = self.config.penalty_window
+        pen_rows = np.full((B_pf, W), -1, np.int32)
         meta = []
         for lane, s in enumerate(chosen):
             chunk = chunk_of[s.request_id]
@@ -2341,6 +2448,9 @@ class JaxEngine:
             top_ks[lane] = s.top_k
             top_ps[lane] = s.top_p
             seeds[lane] = s.sample_seed
+            pens[lane] = (s.presence_penalty, s.frequency_penalty,
+                          s.repetition_penalty)
+            pen_rows[lane] = self.recent[s.slot_idx]
             meta.append((s, chunk, lane))
 
         if any(s.mm for s in chosen):
@@ -2364,6 +2474,7 @@ class JaxEngine:
                     "toks": toks, "positions": positions, "tables": tables,
                     "ctx_lens": ctx_lens, "last_idx": last_idx, "temps": temps,
                     "top_ks": top_ks, "top_ps": top_ps, "seeds": seeds,
+                    "pens": pens, "pen_rows": pen_rows,
                     "emb": emb, "emb_mask": emb_mask,
                 },
             )
@@ -2371,7 +2482,8 @@ class JaxEngine:
                 partial(
                     self._dev_prefill_mm,
                     toks, positions, tables, ctx_lens, last_idx,
-                    temps, top_ks, top_ps, seeds, emb, emb_mask,
+                    temps, top_ks, top_ps, seeds, pens, pen_rows,
+                    emb, emb_mask,
                 ),
                 tag="prefill",
             )
@@ -2391,14 +2503,14 @@ class JaxEngine:
                     "toks": toks, "positions": positions, "tables": tables,
                     "ctx_lens": ctx_lens, "last_idx": last_idx, "temps": temps,
                     "top_ks": top_ks, "top_ps": top_ps, "seeds": seeds,
-                    "mask": mask,
+                    "pens": pens, "pen_rows": pen_rows, "mask": mask,
                 },
             )
             first_dev = await self._run_on_device(
                 partial(
                     self._dev_prefill_guided,
                     toks, positions, tables, ctx_lens, last_idx,
-                    temps, top_ks, top_ps, seeds, mask,
+                    temps, top_ks, top_ps, seeds, pens, pen_rows, mask,
                 ),
                 tag="prefill",
             )
@@ -2412,14 +2524,14 @@ class JaxEngine:
                     "toks": toks, "positions": positions, "tables": tables,
                     "ctx_lens": ctx_lens, "last_idx": last_idx, "temps": temps,
                     "top_ks": top_ks, "top_ps": top_ps, "seeds": seeds,
-                    "idx": lane_idx,
+                    "pens": pens, "pen_rows": pen_rows, "idx": lane_idx,
                 },
             )
             first_dev = await self._run_on_device(
                 partial(
                     self._dev_prefill_lora,
                     toks, positions, tables, ctx_lens, last_idx,
-                    temps, top_ks, top_ps, seeds, lane_idx,
+                    temps, top_ks, top_ps, seeds, pens, pen_rows, lane_idx,
                 ),
                 tag="prefill",
             )
@@ -2430,13 +2542,14 @@ class JaxEngine:
                     "toks": toks, "positions": positions, "tables": tables,
                     "ctx_lens": ctx_lens, "last_idx": last_idx, "temps": temps,
                     "top_ks": top_ks, "top_ps": top_ps, "seeds": seeds,
+                    "pens": pens, "pen_rows": pen_rows,
                 },
             )
             first_dev = await self._run_on_device(
                 partial(
                     self._dev_prefill,
                     toks, positions, tables, ctx_lens, last_idx, temps,
-                    top_ks, top_ps, seeds,
+                    top_ks, top_ps, seeds, pens, pen_rows,
                 ),
                 tag="prefill",
             )
@@ -2477,37 +2590,56 @@ class JaxEngine:
         top_ks = np.array([slot.top_k], np.int32)
         top_ps = np.array([slot.top_p], np.float32)
         seeds = np.array([slot.sample_seed], np.uint32)
+        pens = np.array([[slot.presence_penalty, slot.frequency_penalty,
+                          slot.repetition_penalty]], np.float32)
+        pen_rows = self.recent[slot.slot_idx : slot.slot_idx + 1]
         self._bcast(
             "prefill_single",
             {
                 "toks": toks, "table": table, "ctx": np.array([ctx]),
                 "real": np.array([real]), "temps": temps,
                 "top_ks": top_ks, "top_ps": top_ps, "seeds": seeds,
+                "pens": pens, "pen_rows": pen_rows,
             },
         )
         first_dev = await self._run_on_device(
             partial(self._dev_prefill_single, toks, table, ctx, real, temps,
-                    top_ks, top_ps, seeds),
+                    top_ks, top_ps, seeds, pens, pen_rows),
             tag="prefill",
         )
         slot.prefill_pos += chunk
         self._pending_prefill.append({"first": first_dev, "done": [(slot, 0)]})
 
     def _dev_prefill_single(self, toks, table, ctx, real, temps, top_ks,
-                            top_ps, seeds):
+                            top_ps, seeds, pens, pen_rows):
         samp = SamplingParams(
             temperature=jnp.asarray(temps),
             top_k=jnp.asarray(top_ks),
             top_p=jnp.asarray(top_ps),
             seed=jnp.asarray(seeds),
+            presence=jnp.asarray(pens[:, 0]),
+            frequency=jnp.asarray(pens[:, 1]),
+            repetition=jnp.asarray(pens[:, 2]),
         )
         first, self.kv_k, self.kv_v, self._rng = self._prefill_single(
             self.params, self.kv_k, self.kv_v,
             jnp.asarray(toks), jnp.asarray(table),
             jnp.asarray(ctx, jnp.int32), jnp.asarray(real, jnp.int32),
-            self._rng, samp,
+            self._rng, samp, jnp.asarray(pen_rows),
         )
         return first
+
+    def _fill_recent(self, idx: int, slot: _Slot):
+        """Load the lane's penalty window from the tokens so far (prompt +
+        generated); ring-indexed by absolute position so device-side
+        appends stay consistent across patches."""
+        W = self.config.penalty_window
+        toks = np.asarray(slot.seq.tokens, np.int32)
+        row = self.recent[idx]
+        row[:] = -1
+        if len(toks):
+            ps = np.arange(max(0, len(toks) - W), len(toks))
+            row[ps % W] = toks[ps]
 
     def _fill_hist(self, idx: int, slot: _Slot):
         """Load the lane's history ring (host mirror) for n-gram drafting:
@@ -2554,6 +2686,7 @@ class JaxEngine:
             self.tokens[slot.slot_idx] = first
             self.seq_lens[slot.slot_idx] = len(slot.kv_prompt) + 1
             self._fill_hist(slot.slot_idx, slot)
+            self._fill_recent(slot.slot_idx, slot)
             self._mark_lane_dirty(slot.slot_idx)
             return
         if slot.guided_fsm is not None:
@@ -2568,6 +2701,7 @@ class JaxEngine:
             self.tokens[slot.slot_idx] = first
             self.seq_lens[slot.slot_idx] = len(slot.kv_prompt) + 1
             self._fill_hist(slot.slot_idx, slot)
+            self._fill_recent(slot.slot_idx, slot)
             self._mark_lane_dirty(slot.slot_idx)
             self._maybe_finish(slot, first)
 
@@ -2904,11 +3038,15 @@ class JaxEngine:
                 np.where(mask[:, None], self.hist, 0).astype(np.int32)
                 if self.hist is not None else None
             )
+            pens = np.stack(
+                [self.presence, self.frequency, self.repetition], axis=1
+            )
             payload = {
                 "tokens": tokens, "positions": positions,
                 "seq_lens": seq_lens_step, "page_tables": tables,
                 "temps": self.temps, "top_ks": self.top_ks,
                 "top_ps": self.top_ps, "seeds": self.seeds,
+                "pens": pens, "recent": self.recent,
             }
             if hist is not None:
                 payload["hist"] = hist
@@ -2919,7 +3057,7 @@ class JaxEngine:
                     tokens, positions, seq_lens_step,
                     tables, self.temps.copy(),
                     self.top_ks.copy(), self.top_ps.copy(),
-                    self.seeds.copy(), hist,
+                    self.seeds.copy(), pens, self.recent.copy(), hist,
                 ),
                 tag="reset",
             )
@@ -2947,12 +3085,16 @@ class JaxEngine:
                 active_mask[:, None], self.page_tables, SCRATCH_PAGE
             ).astype(np.int32)
             hist = self.hist.astype(np.int32) if self.hist is not None else None
+            pens = np.stack(
+                [self.presence, self.frequency, self.repetition], axis=1
+            )
             payload = {
                 "lane_mask": lane_mask, "table_mask": table_mask,
                 "tokens": n_tokens, "positions": n_positions,
                 "seq_lens": n_seq_lens, "page_tables": n_tables,
                 "temps": self.temps, "top_ks": self.top_ks,
                 "top_ps": self.top_ps, "seeds": self.seeds,
+                "pens": pens, "recent": self.recent,
             }
             if hist is not None:
                 payload["hist"] = hist
@@ -2963,7 +3105,7 @@ class JaxEngine:
                     n_tokens, n_positions, n_seq_lens,
                     n_tables, self.temps.copy(),
                     self.top_ks.copy(), self.top_ps.copy(),
-                    self.seeds.copy(), hist,
+                    self.seeds.copy(), pens, self.recent.copy(), hist,
                 ),
                 tag="patch",
             )

@@ -40,14 +40,23 @@ class SamplingParams(NamedTuple):
     top_k: jax.Array  # [B] i32; 0 = disabled
     top_p: jax.Array  # [B] f32; 1.0 = disabled
     seed: jax.Array = None  # [B] u32; per-lane sampling seed
+    # OpenAI penalties, applied over a bounded recent-token window
+    # (apply_logit_penalties; all-zero/1.0 is an exact identity)
+    presence: jax.Array = None  # [B] f32; 0 = off
+    frequency: jax.Array = None  # [B] f32; 0 = off
+    repetition: jax.Array = None  # [B] f32; 1.0 = off
 
     @classmethod
-    def full(cls, batch: int, temperature=0.0, top_k=0, top_p=1.0, seed=0):
+    def full(cls, batch: int, temperature=0.0, top_k=0, top_p=1.0, seed=0,
+             presence=0.0, frequency=0.0, repetition=1.0):
         return cls(
             temperature=jnp.full((batch,), temperature, jnp.float32),
             top_k=jnp.full((batch,), top_k, jnp.int32),
             top_p=jnp.full((batch,), top_p, jnp.float32),
             seed=jnp.full((batch,), seed, jnp.uint32),
+            presence=jnp.full((batch,), presence, jnp.float32),
+            frequency=jnp.full((batch,), frequency, jnp.float32),
+            repetition=jnp.full((batch,), repetition, jnp.float32),
         )
 
 
@@ -128,11 +137,12 @@ TOP_LOGPROBS_N = 5  # OpenAI caps top_logprobs alternatives at 5
 
 
 def sample_lp(
-    logits: jax.Array,  # [B, V] f32
+    logits: jax.Array,  # [B, V] f32 (possibly penalized — the sampling dist)
     params: SamplingParams,
     key: jax.Array,
     mask: jax.Array = None,
     positions: jax.Array = None,
+    raw: jax.Array = None,  # pre-penalty logits for the REPORTED logprobs
 ) -> tuple:
     """sample() + RAW-model logprobs (log-softmax of the unscaled,
     unmasked logits — the OpenAI `logprobs` surface; under guided masks
@@ -148,7 +158,7 @@ def sample_lp(
     sort on the step path); the only full-vocab extra is one logsumexp
     pass for normalization."""
     tokens = sample(logits, params, key, mask=mask, positions=positions)
-    raw = logits.astype(jnp.float32)
+    raw = (raw if raw is not None else logits).astype(jnp.float32)
     logz = jax.scipy.special.logsumexp(raw, axis=-1)
     chosen = jnp.take_along_axis(raw, tokens[:, None], axis=-1)[:, 0]
     k = min(TOP_LOGPROBS_N, raw.shape[-1])
@@ -156,6 +166,30 @@ def sample_lp(
     top_ids = cand_idx[:, :k]
     top_vals = cand_logits[:, :k]
     return tokens, chosen - logz, top_ids, top_vals - logz[:, None]
+
+
+def penalized(logits: jax.Array, params: SamplingParams,
+              recent: jax.Array) -> jax.Array:
+    """Apply the params' penalties over the lane's recent-token window
+    (no-op when the fields are absent — legacy callers). Runtime-gated
+    with lax.cond: when NO lane in the batch carries a penalty (the
+    common case), the [B, V] counts scatter is skipped entirely at
+    execution time — one program variant, near-zero idle cost."""
+    if params.presence is None or recent is None:
+        return logits
+    active = jnp.any(
+        (params.presence != 0.0)
+        | (params.frequency != 0.0)
+        | (params.repetition != 1.0)
+    )
+    return jax.lax.cond(
+        active,
+        lambda l: apply_logit_penalties(
+            l, recent, params.presence, params.frequency, params.repetition
+        ),
+        lambda l: l,
+        logits,
+    )
 
 
 def apply_logit_penalties(

@@ -128,10 +128,24 @@ def test_multihost_disagg_per_shard_pull(mh_disagg_cluster):
     base = mh_disagg_cluster
     prompt = list(range(5, 75))  # 70 tokens > threshold 16 => remote prefill
 
-    chunks, notes = _complete(base, prompt)
+    # the decode worker may answer before it has DISCOVERED the prefill
+    # pool (registration race on a loaded box) — retry with fresh prompts
+    # until remote prefill engages, like test_disagg_e2e does
+    deadline = time.time() + 60
+    attempt = 0
+    while True:
+        chunks, notes = _complete(base, prompt)
+        if any("remote_prefill" in n and "true" in n for n in notes):
+            break
+        attempt += 1
+        assert time.time() < deadline, f"remote prefill never engaged: {notes}"
+        # fresh prompt: the previous one is now locally prefix-cached,
+        # which CORRECTLY suppresses remote prefill (ids stay < tiny vocab)
+        base_tok = 5 + (attempt * 97) % 300
+        prompt = list(range(base_tok, base_tok + 70))
+        time.sleep(1)
     finishes = [c for c in chunks if c["choices"] and c["choices"][0].get("finish_reason")]
     assert finishes and finishes[-1]["choices"][0]["finish_reason"] in ("length", "stop")
-    assert any("remote_prefill" in n and "true" in n for n in notes), notes
 
     # deterministic greedy: a repeat (prefix-cached) run matches
     chunks2, _ = _complete(base, prompt)
