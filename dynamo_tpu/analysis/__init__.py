@@ -1,0 +1,36 @@
+"""dynolint: AST-based invariant checker for the serving stack.
+
+The serving stack's correctness contracts are machine-checkable, and the
+round-5 history shows why they must be: API parameters accepted by the
+OpenAI frontend and silently ignored by the engine (the sampling-penalties
+bug) survived multiple reviews. Each contract is a `Rule` over the parsed
+AST of the package; `tests/test_static_analysis.py` runs the pack as a
+tier-1 test so every PR inherits enforcement.
+
+Run locally:
+
+    python -m dynamo_tpu.analysis                # text report, exit 1 on hits
+    python -m dynamo_tpu.analysis --format=json  # machine-readable
+    python -m dynamo_tpu.analysis --emit-env-docs docs/configuration.md
+
+Suppress a finding on its line (reason required by convention):
+
+    x = thing()  # dynolint: disable=async-blocking -- startup path, loop not running
+
+See docs/static_analysis.md for the rule pack and how to add a rule.
+"""
+
+from .core import Project, Rule, SourceFile, Violation, format_json, format_text, run
+from .rules import ALL_RULES, default_rules
+
+__all__ = [
+    "ALL_RULES",
+    "Project",
+    "Rule",
+    "SourceFile",
+    "Violation",
+    "default_rules",
+    "format_json",
+    "format_text",
+    "run",
+]

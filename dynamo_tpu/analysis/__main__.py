@@ -1,0 +1,141 @@
+"""dynolint CLI.
+
+    python -m dynamo_tpu.analysis                      # lint, text output
+    python -m dynamo_tpu.analysis --format=json        # lint, JSON output
+    python -m dynamo_tpu.analysis --rules silent-drop  # subset
+    python -m dynamo_tpu.analysis --list-rules
+    python -m dynamo_tpu.analysis --emit-env-docs docs/configuration.md
+
+Exit status: 0 clean, 1 violations found, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from .core import Project, format_json, format_text, run
+from .rules import ALL_RULES, default_rules
+
+
+def emit_env_docs(root: Path) -> str:
+    """Render runtime/config.py's ENV_REGISTRY as the configuration doc.
+
+    config.py is executed in ISOLATION (spec_from_file_location, no package
+    __init__) so the CLI needs none of the package's dependencies and
+    renders the registry of the tree under --root, not whatever
+    installation happens to be importable."""
+    import importlib.util
+
+    cfg_path = root / "dynamo_tpu" / "runtime" / "config.py"
+    spec = importlib.util.spec_from_file_location("_dynolint_config", cfg_path)
+    module = importlib.util.module_from_spec(spec)
+    # dataclasses resolves the module through sys.modules at class-creation
+    # time; exec without registration breaks it
+    sys.modules[spec.name] = module
+    try:
+        spec.loader.exec_module(module)
+        ENV_REGISTRY = module.ENV_REGISTRY
+    finally:
+        sys.modules.pop(spec.name, None)
+
+    lines = [
+        "# Configuration — environment variables",
+        "",
+        "<!-- GENERATED FILE — do not edit by hand.",
+        "     Regenerate: python -m dynamo_tpu.analysis --emit-env-docs"
+        " docs/configuration.md -->",
+        "",
+        "Every environment variable the package consults, from the single",
+        "registry in `dynamo_tpu/runtime/config.py` (`ENV_REGISTRY`). The",
+        "`env-registry` dynolint rule fails CI on any env read that",
+        "bypasses this table.",
+        "",
+        "| Variable | Type | Default | Consumed by | Description |",
+        "|---|---|---|---|---|",
+    ]
+    for var in sorted(ENV_REGISTRY, key=lambda v: v.name):
+        default = "—" if var.default is None else f"`{var.default}`"
+        lines.append(
+            f"| `{var.name}` | {var.type} | {default} | `{var.module}` "
+            f"| {var.description} |"
+        )
+    lines.append("")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m dynamo_tpu.analysis",
+        description="dynolint: AST invariant checker for the serving stack",
+    )
+    parser.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="violation report format",
+    )
+    parser.add_argument(
+        "--root", default=None,
+        help="repo root containing the dynamo_tpu package "
+        "(default: autodetect from this file)",
+    )
+    parser.add_argument(
+        "--rules", default=None,
+        help="comma-separated rule names to run (default: all)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="list rules and exit"
+    )
+    parser.add_argument(
+        "--emit-env-docs", nargs="?", const="-", metavar="PATH",
+        help="render the env-var registry as markdown to PATH ('-' = stdout) "
+        "and exit",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for cls in ALL_RULES:
+            print(f"{cls.name:16} {cls.description}")
+        return 0
+
+    root = Path(args.root) if args.root else Path(__file__).resolve().parents[2]
+    if not (root / "dynamo_tpu").is_dir():
+        print(f"error: no dynamo_tpu package under {root}", file=sys.stderr)
+        return 2
+
+    if args.emit_env_docs is not None:
+        doc = emit_env_docs(root)
+        if args.emit_env_docs == "-":
+            sys.stdout.write(doc)
+        else:
+            Path(args.emit_env_docs).write_text(doc)
+            print(f"wrote {args.emit_env_docs}")
+        return 0
+
+    rules = default_rules()
+    if args.rules:
+        wanted = {r.strip() for r in args.rules.split(",") if r.strip()}
+        known = {r.name for r in rules}
+        unknown = wanted - known
+        if unknown:
+            print(
+                f"error: unknown rule(s): {', '.join(sorted(unknown))}; "
+                f"known: {', '.join(sorted(known))}",
+                file=sys.stderr,
+            )
+            return 2
+        rules = [r for r in rules if r.name in wanted]
+
+    project = Project.load(root)
+    violations = run(project, rules)
+    out = (
+        format_json(violations)
+        if args.format == "json"
+        else format_text(violations)
+    )
+    print(out)
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

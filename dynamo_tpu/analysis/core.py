@@ -1,0 +1,246 @@
+"""dynolint framework: source model, suppression parsing, rule runner.
+
+Design notes:
+  * Rules are PROJECT-level, not file-level — the flagship rule
+    (silent-drop) cross-references the request schema parsed in one layer
+    against consumption sites two layers down, so the unit of analysis is
+    the whole package tree.
+  * Everything is stdlib `ast`; no third-party parser. The checker must
+    run in CI and in the tier-1 test suite with zero extra deps.
+  * Suppressions are line-scoped comments, mirroring the tools people
+    already know: `# dynolint: disable=<rule>[,<rule>...] [-- reason]`.
+    A directive on a pure-comment line applies to the next code line, so
+    long expressions can carry their waiver above them. File-scoped:
+    `# dynolint: disable-file=<rule>`.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import json
+import re
+import tokenize
+from pathlib import Path
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set
+
+_DIRECTIVE = re.compile(
+    # rule list only: a `-- reason` tail must never be parsed as more
+    # rules (a comma inside the reason would silently widen the waiver)
+    r"#\s*dynolint:\s*(disable|disable-file)="
+    r"([A-Za-z0-9_\-]+(?:\s*,\s*[A-Za-z0-9_\-]+)*)"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    """One rule finding, addressed by repo-relative path + 1-based line."""
+
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+class SourceFile:
+    """A parsed module plus its suppression directives."""
+
+    def __init__(self, root: Path, path: Path):
+        self.path = path
+        self.rel = path.relative_to(root).as_posix()
+        self.text = path.read_text()
+        self.lines = self.text.splitlines()
+        self.tree = ast.parse(self.text, filename=str(path))
+        self._line_disables: Dict[int, Set[str]] = {}
+        self._file_disables: Set[str] = set()
+        self._parse_directives()
+
+    def _parse_directives(self):
+        # directives live in COMMENT tokens only — a directive QUOTED in a
+        # docstring or string literal (e.g. docs describing the syntax)
+        # must never take effect, so raw-line regex scanning is out
+        try:
+            comments = [
+                tok
+                for tok in tokenize.generate_tokens(
+                    io.StringIO(self.text).readline
+                )
+                if tok.type == tokenize.COMMENT
+            ]
+        except tokenize.TokenError:  # pragma: no cover - tree already parsed
+            comments = []
+        for tok in comments:
+            m = _DIRECTIVE.search(tok.string)
+            if not m:
+                continue
+            i = tok.start[0]
+            kind = m.group(1)
+            rules = {
+                r.strip() for r in m.group(2).split(",") if r.strip()
+            }
+            if kind == "disable-file":
+                self._file_disables |= rules
+            else:
+                self._line_disables.setdefault(i, set()).update(rules)
+                if self.lines[i - 1].lstrip().startswith("#"):
+                    # pure-comment line: the waiver covers the next CODE
+                    # line — skip over blanks and further comment lines
+                    j = i + 1
+                    while j <= len(self.lines) and (
+                        not self.lines[j - 1].strip()
+                        or self.lines[j - 1].lstrip().startswith("#")
+                    ):
+                        j += 1
+                    self._line_disables.setdefault(j, set()).update(rules)
+        self._spread_over_statements()
+
+    # compound statements own their bodies; a waiver inside a body must
+    # NOT creep up to the header line (Match/TryStar guarded: 3.10/3.11)
+    _COMPOUND = tuple(
+        getattr(ast, n)
+        for n in (
+            "FunctionDef", "AsyncFunctionDef", "ClassDef",
+            "For", "AsyncFor", "While", "If",
+            "With", "AsyncWith", "Try", "TryStar", "Match",
+        )
+        if hasattr(ast, n)
+    )
+
+    def _spread_over_statements(self):
+        """A waiver on ANY line of a multi-line simple statement covers the
+        whole statement — black puts trailing comments on the closing
+        paren, while violations anchor at the offending call's line."""
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.stmt) or isinstance(node, self._COMPOUND):
+                continue
+            end = getattr(node, "end_lineno", None) or node.lineno
+            if end <= node.lineno:
+                continue
+            span = range(node.lineno, end + 1)
+            rules = set()
+            for ln in span:
+                rules |= self._line_disables.get(ln, set())
+            if rules:
+                for ln in span:
+                    self._line_disables.setdefault(ln, set()).update(rules)
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        if rule in self._file_disables:
+            return True
+        return rule in self._line_disables.get(line, set())
+
+
+class Project:
+    """The file set a lint run sees: every .py under `root`/`package`."""
+
+    def __init__(self, root: Path, files: Sequence[SourceFile]):
+        self.root = Path(root)
+        self.files = list(files)
+        self._by_rel = {f.rel: f for f in self.files}
+
+    @classmethod
+    def load(cls, root: Path, package: str = "dynamo_tpu") -> "Project":
+        root = Path(root)
+        base = root / package
+        files = []
+        errors = []
+        for path in sorted(base.rglob("*.py")):
+            if "analysis" in path.relative_to(base).parts[:1]:
+                # the linter does not lint itself: its fixture strings and
+                # pattern tables are full of the exact shapes it flags
+                continue
+            try:
+                files.append(SourceFile(root, path))
+            except SyntaxError as e:  # pragma: no cover - tree should parse
+                errors.append(f"{path}: {e}")
+        if errors:
+            raise SyntaxError("unparseable files: " + "; ".join(errors))
+        return cls(root, files)
+
+    def get(self, rel: str) -> Optional[SourceFile]:
+        return self._by_rel.get(rel)
+
+    def in_scope(self, scopes: Sequence[str]) -> Iterator[SourceFile]:
+        """Files whose package-relative path starts with any scope prefix.
+        Scopes are relative to the package dir (e.g. "runtime/", "llm/")."""
+        for f in self.files:
+            rel = f.rel.split("/", 1)[1] if "/" in f.rel else f.rel
+            if any(rel.startswith(s) for s in scopes):
+                yield f
+
+
+class Rule:
+    """Base rule. Subclasses set `name`/`description` and yield Violations
+    from `check`; the runner applies suppressions afterwards so rules never
+    need to think about them."""
+
+    name: str = "base"
+    description: str = ""
+
+    def check(self, project: Project) -> Iterator[Violation]:  # pragma: no cover
+        raise NotImplementedError
+
+
+def run(project: Project, rules: Iterable[Rule]) -> List[Violation]:
+    out: List[Violation] = []
+    for rule in rules:
+        for v in rule.check(project):
+            src = project.get(v.path)
+            if src is not None and src.suppressed(v.rule, v.line):
+                continue
+            out.append(v)
+    out.sort(key=lambda v: (v.path, v.line, v.rule))
+    return out
+
+
+def format_text(violations: Sequence[Violation]) -> str:
+    if not violations:
+        return "dynolint: clean"
+    lines = [str(v) for v in violations]
+    lines.append(f"dynolint: {len(violations)} violation(s)")
+    return "\n".join(lines)
+
+
+def format_json(violations: Sequence[Violation]) -> str:
+    return json.dumps(
+        {
+            "violations": [v.to_dict() for v in violations],
+            "count": len(violations),
+        },
+        indent=2,
+    )
+
+
+# --------------------------------------------------------------------- #
+# shared AST helpers used by several rules
+# --------------------------------------------------------------------- #
+
+
+def call_name(node: ast.Call) -> str:
+    """Dotted name of a call target: `time.sleep(..)` -> "time.sleep",
+    `sleep(..)` -> "sleep". Unresolvable targets -> ""."""
+    return dotted_name(node.func)
+
+
+def dotted_name(node: ast.AST) -> str:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def str_const(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None

@@ -1,0 +1,30 @@
+"""dynolint rule pack: the invariants this codebase has been burned by."""
+
+from .async_safety import AsyncBlockingRule
+from .env_registry import EnvRegistryRule
+from .jax_purity import JaxPurityRule
+from .lock_discipline import LockDisciplineRule
+from .silent_drop import SilentDropRule
+
+ALL_RULES = (
+    SilentDropRule,
+    AsyncBlockingRule,
+    JaxPurityRule,
+    EnvRegistryRule,
+    LockDisciplineRule,
+)
+
+
+def default_rules():
+    return [cls() for cls in ALL_RULES]
+
+
+__all__ = [
+    "ALL_RULES",
+    "AsyncBlockingRule",
+    "EnvRegistryRule",
+    "JaxPurityRule",
+    "LockDisciplineRule",
+    "SilentDropRule",
+    "default_rules",
+]

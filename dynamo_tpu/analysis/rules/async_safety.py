@@ -1,0 +1,133 @@
+"""Rule: async-blocking — no synchronous blocking calls inside `async def`.
+
+The serving stack is one event loop per process; a single blocking call on
+it stalls EVERY in-flight stream (the round-4 failure mode: an on-path XLA
+compile starved discovery-lease renewal and the control plane dropped the
+worker). The compute pool (`runtime/compute.py`) and `asyncio.to_thread`
+exist precisely so CPU-bound or blocking work rides a worker thread.
+
+Flags, inside `async def` bodies in `runtime/` and `llm/`:
+  * `time.sleep(...)` (use `asyncio.sleep`)
+  * `subprocess.run/call/check_call/check_output/Popen`, `os.system`
+  * `socket.create_connection`, `requests.*`, `urllib.request.*`
+  * bare `open(...)` and Path-style `.read_text()/.write_text()/
+    .read_bytes()/.write_bytes()` (use the compute pool / to_thread)
+  * zero-argument `.result()` / `.join()` — the concurrent.futures /
+    threading blocking waits. The zero-arg restriction keeps `str.join`
+    (one arg) and `os.path.join` (>=1 args) out of scope; `.result()` on
+    an already-completed asyncio task is non-blocking and gets a line
+    waiver with its reason.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List
+
+from ..core import Project, Rule, SourceFile, Violation, call_name
+
+# dotted-prefix -> remedy
+_BLOCKING_CALLS = {
+    "time.sleep": "use `await asyncio.sleep(...)`",
+    "subprocess.run": "offload via asyncio.to_thread / create_subprocess_exec",
+    "subprocess.call": "offload via asyncio.to_thread / create_subprocess_exec",
+    "subprocess.check_call": "offload via asyncio.to_thread",
+    "subprocess.check_output": "offload via asyncio.to_thread",
+    "subprocess.Popen": "use asyncio.create_subprocess_exec",
+    "os.system": "use asyncio.create_subprocess_shell",
+    "socket.create_connection": "use asyncio.open_connection",
+    "requests.get": "use an async HTTP client (aiohttp)",
+    "requests.post": "use an async HTTP client (aiohttp)",
+    "urllib.request.urlopen": "use an async HTTP client (aiohttp)",
+}
+
+_BLOCKING_METHODS = {
+    "read_text": "sync file I/O on the event loop; offload to the compute pool",
+    "write_text": "sync file I/O on the event loop; offload to the compute pool",
+    "read_bytes": "sync file I/O on the event loop; offload to the compute pool",
+    "write_bytes": "sync file I/O on the event loop; offload to the compute pool",
+}
+
+# blocking waits when called with NO arguments (str.join/os.path.join take
+# arguments; future.result(timeout) at least states its bound)
+_BLOCKING_WAITS = {
+    "result": "blocking Future wait; await the future or run_in_executor",
+    "join": "blocking thread/process join; await or offload",
+}
+
+
+class _AsyncBodyVisitor(ast.NodeVisitor):
+    """Collects blocking calls whose NEAREST enclosing function is async.
+    A sync helper nested inside an async def is excluded: it is a callable
+    the async code may hand to an executor, not loop-resident code."""
+
+    def __init__(self, src: SourceFile):
+        self.src = src
+        self.stack: List[ast.AST] = []
+        self.hits: List[Violation] = []
+
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        self.stack.append(node)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef):
+        self.stack.append(node)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    def _in_async(self) -> bool:
+        return bool(self.stack) and isinstance(
+            self.stack[-1], ast.AsyncFunctionDef
+        )
+
+    def visit_Call(self, node: ast.Call):
+        if self._in_async():
+            name = call_name(node)
+            remedy = _BLOCKING_CALLS.get(name)
+            if remedy is None and name == "open":
+                remedy = "sync file I/O on the event loop; offload it"
+            if (
+                remedy is None
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _BLOCKING_METHODS
+            ):
+                remedy = _BLOCKING_METHODS[node.func.attr]
+                name = f".{node.func.attr}"
+            if (
+                remedy is None
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _BLOCKING_WAITS
+                and not node.args
+                and not node.keywords
+            ):
+                remedy = _BLOCKING_WAITS[node.func.attr]
+                name = f".{node.func.attr}"
+            if remedy is not None:
+                self.hits.append(
+                    Violation(
+                        rule=AsyncBlockingRule.name,
+                        path=self.src.rel,
+                        line=node.lineno,
+                        message=(
+                            f"blocking call `{name}(...)` inside "
+                            f"`async def {self.stack[-1].name}` — {remedy}"
+                        ),
+                    )
+                )
+        self.generic_visit(node)
+
+
+class AsyncBlockingRule(Rule):
+    name = "async-blocking"
+    description = (
+        "no synchronous blocking calls (sleep/subprocess/sync I/O/"
+        "Future waits) inside async def bodies in runtime/ and llm/"
+    )
+    scopes = ("runtime/", "llm/")
+
+    def check(self, project: Project) -> Iterator[Violation]:
+        for src in project.in_scope(self.scopes):
+            visitor = _AsyncBodyVisitor(src)
+            visitor.visit(src.tree)
+            yield from visitor.hits

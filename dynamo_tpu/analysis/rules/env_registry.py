@@ -1,0 +1,124 @@
+"""Rule: env-registry — every `DYN_*` env var read must be declared.
+
+Config discoverability contract: `runtime/config.py` owns the single
+registry (`ENV_REGISTRY`) of every environment variable the package
+consults, with type, default, and consuming module. `python -m
+dynamo_tpu.analysis --emit-env-docs` renders it to docs/configuration.md.
+An env read that bypasses the registry is invisible to operators — the
+`DYN_HBM_BYTES` shape of bug: a load-bearing knob documented nowhere.
+
+Detection: any string literal fully matching `DYN_[A-Z0-9_]+` or
+`DYNAMO_TPU_[A-Z0-9_]+` used in an ACCESS position — a call argument, a
+subscript index, or an `in`/`not in` comparison — anywhere in the package.
+Docstrings and comments never match (they are not access positions).
+Registry keys are read from the AST of `runtime/config.py` (first argument
+of each `EnvVar(...)` entry), so this rule works on fixture trees too.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, List, Set, Tuple
+
+from ..core import Project, Rule, Violation, str_const
+
+_ENV_NAME = re.compile(r"^(DYN|DYNAMO_TPU)_[A-Z0-9_]+$")
+
+REGISTRY_FILE = "dynamo_tpu/runtime/config.py"
+REGISTRY_NAME = "ENV_REGISTRY"
+
+
+def registry_keys(project: Project) -> Tuple[Set[str], bool]:
+    """(declared env names, registry_found) from the registry file's AST."""
+    src = project.get(REGISTRY_FILE)
+    if src is None:
+        return set(), False
+    keys: Set[str] = set()
+    found = False
+    for node in ast.walk(src.tree):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+        for t in targets:
+            if isinstance(t, ast.Name) and t.id == REGISTRY_NAME:
+                found = True
+                for call in ast.walk(node):
+                    if not isinstance(call, ast.Call):
+                        continue
+                    key = str_const(call.args[0]) if call.args else None
+                    if key is None:
+                        key = next(
+                            (
+                                str_const(kw.value)
+                                for kw in call.keywords
+                                if kw.arg == "name"
+                            ),
+                            None,
+                        )
+                    if key is not None:
+                        keys.add(key)
+    return keys, found
+
+
+def _access_literals(tree: ast.AST) -> List[Tuple[str, int]]:
+    """(env name, line) for every DYN_* literal in an access position."""
+    out: List[Tuple[str, int]] = []
+
+    def grab(node):
+        s = str_const(node)
+        if s is not None and _ENV_NAME.match(s):
+            out.append((s, node.lineno))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            for arg in node.args:
+                grab(arg)
+            for kw in node.keywords:
+                grab(kw.value)
+        elif isinstance(node, ast.Subscript):
+            grab(node.slice)
+        elif isinstance(node, ast.Compare):
+            grab(node.left)
+            for c in node.comparators:
+                grab(c)
+    return out
+
+
+class EnvRegistryRule(Rule):
+    name = "env-registry"
+    description = (
+        "every DYN_*/DYNAMO_TPU_* env var accessed anywhere in the package "
+        "must be declared in runtime/config.py's ENV_REGISTRY"
+    )
+
+    def check(self, project: Project) -> Iterator[Violation]:
+        keys, found = registry_keys(project)
+        if not found:
+            src = project.get(REGISTRY_FILE)
+            if src is not None:
+                yield Violation(
+                    rule=self.name,
+                    path=REGISTRY_FILE,
+                    line=1,
+                    message=(
+                        f"no `{REGISTRY_NAME}` table found — declare the env "
+                        "var registry here"
+                    ),
+                )
+            return
+        for src in project.files:
+            for name, line in _access_literals(src.tree):
+                if name not in keys:
+                    yield Violation(
+                        rule=self.name,
+                        path=src.rel,
+                        line=line,
+                        message=(
+                            f"env var `{name}` is read but not declared in "
+                            f"runtime/config.py:{REGISTRY_NAME} — register "
+                            "it (name, type, default, description, module)"
+                        ),
+                    )

@@ -1,0 +1,217 @@
+"""Rule: jax-purity — no host side effects or tracer coercions in staged code.
+
+A function under `jax.jit`/`pjit` (or handed to `lax.scan`/`lax.cond`/
+`lax.while_loop`) runs ONCE as a trace; Python-level effects inside it
+either crash at trace time (`float(tracer)` → ConcretizationTypeError,
+usually only on the rarely-taken branch that CI never compiles) or silently
+bake in stale values. On the decode hot path a stray `.item()`/
+`device_get` is worse than a crash: it inserts a synchronous device
+round-trip (~10-100x an async dispatch) into a program the engine believes
+is fully pipelined.
+
+Flags, inside staged bodies in `engine/` and `ops/`:
+  * `float()/int()/bool()` on non-static expressions (tracer coercion)
+  * `.item()`, `.tolist()`, `jax.device_get`, `np.asarray`/`np.array`
+    (host sync / host materialization)
+  * `print(...)`, `time.time()`, `time.perf_counter()`, `random.*`,
+    `np.random.*` (impure; use `jax.debug.print` / `jax.random`)
+  * iterating a `set` literal or `set(...)` call (nondeterministic order
+    across runs — a silent cache-key/compile-variant hazard)
+
+"Staged" = decorated with jit/pjit (directly or via `partial(jax.jit, ..)`)
+or passed by name to `lax.scan`/`lax.cond`/`lax.while_loop`/`lax.fori_loop`
+— nested defs inside a staged function are staged too.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set
+
+from ..core import Project, Rule, SourceFile, Violation, call_name, dotted_name
+
+_JIT_NAMES = {"jit", "jax.jit", "pjit", "jax.pjit", "pallas_call", "pl.pallas_call"}
+_STAGING_CALLS = {
+    "jax.lax.scan", "lax.scan",
+    "jax.lax.cond", "lax.cond",
+    "jax.lax.while_loop", "lax.while_loop",
+    "jax.lax.fori_loop", "lax.fori_loop",
+    "jax.lax.switch", "lax.switch",
+    "pl.pallas_call", "pallas_call", "pltpu.emit_pipeline",
+}
+_PARTIAL_NAMES = {"partial", "functools.partial"}
+_HOST_SYNC_METHODS = {"item", "tolist"}
+_IMPURE_CALLS = {
+    "print": "use jax.debug.print (or hoist to the host loop)",
+    "jax.device_get": "host sync inside a staged program",
+    "time.time": "wall clock is not a traced value",
+    "time.perf_counter": "wall clock is not a traced value",
+    "np.asarray": "host materialization of a tracer",
+    "np.array": "host materialization of a tracer",
+    "numpy.asarray": "host materialization of a tracer",
+    "numpy.array": "host materialization of a tracer",
+}
+_IMPURE_PREFIXES = {
+    "np.random.": "host RNG inside a staged program; use jax.random",
+    "numpy.random.": "host RNG inside a staged program; use jax.random",
+    "random.": "host RNG inside a staged program; use jax.random",
+}
+
+
+def _is_jit_decorator(dec: ast.AST) -> bool:
+    """@jit / @jax.jit / @partial(jax.jit, ...) / @jax.jit(...)."""
+    name = dotted_name(dec)
+    if name in _JIT_NAMES:
+        return True
+    if isinstance(dec, ast.Call):
+        inner = dotted_name(dec.func)
+        if inner in _JIT_NAMES:
+            return True
+        if inner in ("partial", "functools.partial") and dec.args:
+            return dotted_name(dec.args[0]) in _JIT_NAMES
+    return False
+
+
+def _is_static_expr(node: ast.AST) -> bool:
+    """Expressions whose value is known at trace time: constants, shape/
+    dtype/ndim attribute chains, len() and arithmetic over those."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.Attribute):
+        return node.attr in ("shape", "ndim", "size", "itemsize", "dtype")
+    if isinstance(node, ast.Subscript):
+        return _is_static_expr(node.value)
+    if isinstance(node, ast.Call):
+        return call_name(node) in ("len", "min", "max") and all(
+            _is_static_expr(a) for a in node.args
+        )
+    if isinstance(node, ast.BinOp):
+        return _is_static_expr(node.left) and _is_static_expr(node.right)
+    if isinstance(node, ast.UnaryOp):
+        return _is_static_expr(node.operand)
+    return False
+
+
+class _StagedScanner(ast.NodeVisitor):
+    """Finds staged function defs, then scans their whole subtree."""
+
+    def __init__(self, src: SourceFile):
+        self.src = src
+        self.hits: List[Violation] = []
+        self._staged_names: Set[str] = set()
+
+    def run(self):
+        # pass 1: names handed to lax.scan/cond/pallas_call anywhere in
+        # the module — directly, or wrapped in partial(fn, ...)
+        aliases = {}  # name -> function names it may stand for
+        for node in ast.walk(self.src.tree):
+            if isinstance(node, ast.Call) and call_name(node) in _STAGING_CALLS:
+                for arg in node.args:
+                    if isinstance(arg, ast.Name):
+                        self._staged_names.add(arg.id)
+                    elif (
+                        isinstance(arg, ast.Call)
+                        and call_name(arg) in _PARTIAL_NAMES
+                        and arg.args
+                        and isinstance(arg.args[0], ast.Name)
+                    ):
+                        self._staged_names.add(arg.args[0].id)
+            elif (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+            ):
+                tgt, val = node.targets[0].id, node.value
+                if isinstance(val, ast.Name):
+                    aliases.setdefault(tgt, set()).add(val.id)
+                elif (
+                    isinstance(val, ast.Call)
+                    and call_name(val) in _PARTIAL_NAMES
+                    and val.args
+                    and isinstance(val.args[0], ast.Name)
+                ):
+                    aliases.setdefault(tgt, set()).add(val.args[0].id)
+        # resolve `kernel = partial(_decode_kernel, ...)` one hop at a time
+        for _ in range(3):
+            extra = set()
+            for name in self._staged_names:
+                extra |= aliases.get(name, set())
+            if extra <= self._staged_names:
+                break
+            self._staged_names |= extra
+        # pass 2: scan bodies of jit-decorated or staged-by-name defs
+        self._descend(self.src.tree)
+
+    def _descend(self, node: ast.AST):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if (
+                    any(_is_jit_decorator(d) for d in child.decorator_list)
+                    or child.name in self._staged_names
+                ):
+                    # _scan_body covers the whole subtree incl. nested defs
+                    self._scan_body(child)
+                    continue
+            self._descend(child)
+
+    def _scan_body(self, fn: ast.AST):
+        for node in ast.walk(fn):
+            v = self._check_node(node, fn)
+            if v is not None:
+                self.hits.append(v)
+
+    def _check_node(self, node: ast.AST, fn) -> Optional[Violation]:
+        mk = lambda msg: Violation(  # noqa: E731
+            rule=JaxPurityRule.name,
+            path=self.src.rel,
+            line=getattr(node, "lineno", fn.lineno),
+            message=f"in staged `{fn.name}`: {msg}",
+        )
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            if name in ("float", "int", "bool") and node.args and not all(
+                _is_static_expr(a) for a in node.args
+            ):
+                return mk(
+                    f"`{name}(...)` coerces a (possible) tracer to a Python "
+                    "scalar — ConcretizationTypeError on the traced branch; "
+                    "keep it as an array op"
+                )
+            if name in _IMPURE_CALLS:
+                return mk(f"`{name}(...)` — {_IMPURE_CALLS[name]}")
+            for prefix, why in _IMPURE_PREFIXES.items():
+                if name.startswith(prefix):
+                    return mk(f"`{name}(...)` — {why}")
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _HOST_SYNC_METHODS
+            ):
+                return mk(
+                    f"`.{node.func.attr}()` forces a host sync inside a "
+                    "staged program"
+                )
+        if isinstance(node, (ast.For, ast.comprehension)):
+            it = node.iter
+            if isinstance(it, ast.Set) or (
+                isinstance(it, ast.Call) and call_name(it) == "set"
+            ):
+                return mk(
+                    "iterating a set inside staged code — nondeterministic "
+                    "order changes the traced program between runs"
+                )
+        return None
+
+
+class JaxPurityRule(Rule):
+    name = "jax-purity"
+    description = (
+        "no Python side effects, tracer coercions, or host syncs inside "
+        "jit/pjit/lax-staged functions in engine/ and ops/"
+    )
+    scopes = ("engine/", "ops/")
+
+    def check(self, project: Project) -> Iterator[Violation]:
+        for src in project.in_scope(self.scopes):
+            scanner = _StagedScanner(src)
+            scanner.run()
+            yield from scanner.hits

@@ -1,0 +1,172 @@
+"""Rule: lock-discipline — no mixed locked/unlocked mutation of shared state.
+
+Scope: the three modules whose objects are touched from more than one
+execution context (the event loop plus the device-executor / fetch / store
+threads): `runtime/component.py`, `runtime/request_plane.py`,
+`kvbm/manager.py`.
+
+The check is a CONSISTENCY invariant, which keeps it free of false
+positives on loop-confined state: if a class guards mutations of
+`self.x` with one of its own locks anywhere, then EVERY mutation of
+`self.x` in that class must hold that lock (or carry an explicit
+`# dynolint: disable=lock-discipline -- reason` waiver). Mutations in
+`__init__` are exempt — the object is not yet shared.
+
+A "lock" is an attribute assigned from `threading.Lock/RLock` or
+`asyncio.Lock`. A "mutation" is an assignment/augmented assignment to
+`self.attr` (or `self.attr[...]`), or a call of a known mutator method
+(`append/add/pop/update/clear/remove/extend/discard/setdefault/put_nowait`)
+on `self.attr`.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from ..core import Project, Rule, SourceFile, Violation, dotted_name
+
+_LOCK_FACTORIES = {
+    "threading.Lock", "threading.RLock", "asyncio.Lock",
+    "Lock", "RLock", "multiprocessing.Lock",
+}
+_MUTATORS = {
+    "append", "add", "pop", "update", "clear", "remove", "extend",
+    "discard", "setdefault", "put_nowait", "insert", "popitem",
+}
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """`self.x` -> "x", `self.x[..]` -> "x" (the container is the state)."""
+    if isinstance(node, ast.Subscript):
+        node = node.value
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+class _ClassAuditor:
+    def __init__(self, src: SourceFile, cls: ast.ClassDef):
+        self.src = src
+        self.cls = cls
+        self.locks: Set[str] = set()
+        # attr -> [(line, method, lock_held | None)]
+        self.mutations: Dict[str, List[Tuple[int, str, Optional[str]]]] = {}
+
+    def run(self):
+        self._find_locks()
+        if not self.locks:
+            return
+        for item in self.cls.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._scan_method(item)
+
+    def _find_locks(self):
+        for node in ast.walk(self.cls):
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AnnAssign):
+                # `self._lock: threading.Lock = threading.Lock()` must
+                # register too, or a typed refactor silently disables
+                # the whole class audit
+                targets = [node.target]
+            if (
+                targets
+                and isinstance(getattr(node, "value", None), ast.Call)
+                and dotted_name(node.value.func) in _LOCK_FACTORIES
+            ):
+                for t in targets:
+                    attr = _self_attr(t)
+                    if attr is not None:
+                        self.locks.add(attr)
+
+    def _held_lock(self, stack: List[ast.AST]) -> Optional[str]:
+        for node in reversed(stack):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    attr = _self_attr(item.context_expr)
+                    if attr in self.locks:
+                        return attr
+        return None
+
+    def _scan_method(self, fn: ast.AST):
+        if fn.name == "__init__":
+            return
+
+        def walk(node: ast.AST, stack: List[ast.AST]):
+            stack.append(node)
+            attr = None
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (
+                    node.targets if isinstance(node, ast.Assign) else [node.target]
+                )
+                for t in targets:
+                    attr = _self_attr(t)
+                    if attr is not None and attr not in self.locks:
+                        self._record(attr, node.lineno, fn.name, stack)
+                attr = None
+            elif isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Attribute) and f.attr in _MUTATORS:
+                    attr = _self_attr(f.value)
+                    if attr is not None and attr not in self.locks:
+                        self._record(attr, node.lineno, fn.name, stack)
+            for child in ast.iter_child_nodes(node):
+                walk(child, stack)
+            stack.pop()
+
+        walk(fn, [])
+
+    def _record(self, attr: str, line: int, method: str, stack: List[ast.AST]):
+        self.mutations.setdefault(attr, []).append(
+            (line, method, self._held_lock(stack))
+        )
+
+    def violations(self) -> Iterator[Violation]:
+        for attr, sites in self.mutations.items():
+            held = {lock for _, _, lock in sites if lock is not None}
+            if not held:
+                continue  # never lock-guarded: loop-confined state
+            lock = sorted(held)[0]
+            for line, method, got in sites:
+                if got is None:
+                    yield Violation(
+                        rule=LockDisciplineRule.name,
+                        path=self.src.rel,
+                        line=line,
+                        message=(
+                            f"{self.cls.name}.{attr} is mutated under "
+                            f"self.{lock} elsewhere but unlocked in "
+                            f"`{method}` — hold the lock or waive with a "
+                            "reason"
+                        ),
+                    )
+
+
+class LockDisciplineRule(Rule):
+    name = "lock-discipline"
+    description = (
+        "attributes guarded by a lock anywhere in a class must be guarded "
+        "at every mutation site (component/request_plane/kvbm-manager)"
+    )
+    files = (
+        "dynamo_tpu/runtime/component.py",
+        "dynamo_tpu/runtime/request_plane.py",
+        "dynamo_tpu/kvbm/manager.py",
+    )
+
+    def check(self, project: Project) -> Iterator[Violation]:
+        for rel in self.files:
+            src = project.get(rel)
+            if src is None:
+                continue
+            for node in ast.walk(src.tree):
+                if isinstance(node, ast.ClassDef):
+                    auditor = _ClassAuditor(src, node)
+                    auditor.run()
+                    yield from auditor.violations()

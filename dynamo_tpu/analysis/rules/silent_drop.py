@@ -1,0 +1,148 @@
+"""Rule: silent-drop — every accepted request field must have a consumer.
+
+THE recurring bug class in this repo's history: the OpenAI frontend
+accepts a sampling parameter, the preprocessor packs it into
+`sampling_options`, and the engine never reads it — the request succeeds
+and silently returns output computed with a different distribution than
+the client asked for. The penalties trio (`presence_penalty`,
+`frequency_penalty`, `repetition_penalty`) shipped exactly this way and
+was only caught by a human reading benchmark output.
+
+Contract enforced:
+  * PRODUCERS (`llm/preprocessor.py`, `llm/http/service.py`): a request
+    field is "accepted" when it is stored into a sampling dict — either
+    via the canonical loop `for key in ("temperature", ...): sampling[key]
+    = v`, or an explicit `sampling["logprobs"] = ...` /
+    `p.sampling_options["seed"] = ...` store.
+  * CONSUMERS (`engine/engine.py`, `engine/sampling.py`,
+    `llm/http/service.py`): the same field name must appear in a read
+    position — a `.get("field")` call, a `[...]"field"...]` subscript
+    load, or a `req.field` attribute access on a request object.
+
+An accepted field with zero consumption sites fails the tree, reported at
+the producer line that accepts it. Deleting the last consumer of e.g.
+`frequency_penalty` re-creates the historical bug and turns the tree red.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Set, Tuple
+
+from ..core import Project, Rule, SourceFile, Violation, dotted_name, str_const
+
+# request-object receivers whose attribute reads count as consumption
+# (`req.n` in the http service is the fan-out consumer of `n`)
+_REQUEST_NAMES = {"req", "request", "pre", "p", "r"}
+
+
+def _is_sampling_dict(node: ast.AST) -> bool:
+    return "sampling" in dotted_name(node).lower()
+
+
+def accepted_fields(src: SourceFile) -> List[Tuple[str, int]]:
+    """(field, line) pairs this producer file accepts into sampling dicts."""
+    out: List[Tuple[str, int]] = []
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.For) and isinstance(node.target, ast.Name):
+            # for key in ("temperature", "top_p", ...): ... sampling[key] = v
+            if not isinstance(node.iter, (ast.Tuple, ast.List)):
+                continue
+            consts = [str_const(e) for e in node.iter.elts]
+            if not consts or any(c is None for c in consts):
+                continue
+            loop_var = node.target.id
+            stores_into_sampling = any(
+                isinstance(sub, ast.Subscript)
+                and isinstance(sub.ctx, ast.Store)
+                and isinstance(sub.slice, ast.Name)
+                and sub.slice.id == loop_var
+                and _is_sampling_dict(sub.value)
+                for stmt in node.body
+                for sub in ast.walk(stmt)
+            )
+            if stores_into_sampling:
+                out.extend((c, node.iter.lineno) for c in consts)
+        elif isinstance(node, ast.Subscript) and isinstance(node.ctx, ast.Store):
+            key = str_const(node.slice)
+            if key is not None and _is_sampling_dict(node.value):
+                out.append((key, node.lineno))
+    return out
+
+
+def consumed_fields(src: SourceFile) -> Set[str]:
+    """Field names this consumer file reads."""
+    out: Set[str] = set()
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr in ("get", "pop")
+                and node.args
+            ):
+                key = str_const(node.args[0])
+                if key is not None:
+                    out.add(key)
+        elif isinstance(node, ast.Subscript) and isinstance(node.ctx, ast.Load):
+            key = str_const(node.slice)
+            if key is not None:
+                out.add(key)
+        elif isinstance(node, ast.Attribute):
+            if (
+                isinstance(node.value, ast.Name)
+                and node.value.id in _REQUEST_NAMES
+            ):
+                out.add(node.attr)
+    return out
+
+
+class SilentDropRule(Rule):
+    name = "silent-drop"
+    description = (
+        "every sampling/request field the frontend accepts must be read "
+        "somewhere in the engine (or the http fan-out layer)"
+    )
+    producer_files = (
+        "dynamo_tpu/llm/preprocessor.py",
+        "dynamo_tpu/llm/http/service.py",
+    )
+    consumer_files = (
+        "dynamo_tpu/engine/engine.py",
+        "dynamo_tpu/engine/sampling.py",
+        "dynamo_tpu/llm/http/service.py",
+    )
+
+    def check(self, project: Project) -> Iterator[Violation]:
+        producers = [
+            p for rel in self.producer_files
+            if (p := project.get(rel)) is not None
+        ]
+        consumers = [
+            c for rel in self.consumer_files
+            if (c := project.get(rel)) is not None
+        ]
+        if not producers or not consumers:
+            return
+        consumed: Set[str] = set()
+        for src in consumers:
+            consumed |= consumed_fields(src)
+        seen: Dict[str, bool] = {}
+        for src in producers:
+            for field, line in accepted_fields(src):
+                if field in consumed or seen.get(field):
+                    continue
+                seen[field] = True
+                yield Violation(
+                    rule=self.name,
+                    path=src.rel,
+                    line=line,
+                    message=(
+                        f"request field `{field}` is accepted here but "
+                        "never consumed in "
+                        f"{', '.join(self.consumer_files)} — the request "
+                        "succeeds while silently ignoring the parameter "
+                        "(the penalties-bug shape); consume it or reject "
+                        "the request with a 400"
+                    ),
+                )

@@ -60,7 +60,9 @@ def resolve_model_path(
     from huggingface_hub import snapshot_download
 
     if allow_download is None:
-        allow_download = os.environ.get("DYN_HF_ALLOW_DOWNLOAD") == "1"
+        from ..runtime.config import env_bool
+
+        allow_download = env_bool("DYN_HF_ALLOW_DOWNLOAD")
     try:
         return snapshot_download(
             path_or_repo, revision=revision, local_files_only=True

@@ -34,7 +34,9 @@ def _load() -> Optional[ctypes.CDLL]:
     if _load_attempted:
         return _lib
     _load_attempted = True
-    if os.environ.get("DYN_NATIVE", "1") == "0":
+    from ..runtime.config import env_bool
+
+    if not env_bool("DYN_NATIVE", True):
         return None
     # always invoke make: a no-op when the .so is fresh, a rebuild when
     # csrc/ changed (a stale gitignored .so must not silently win)

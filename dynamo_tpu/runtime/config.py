@@ -30,6 +30,126 @@ def _env(name: str, default: Any = None, cast=str):
     return cast(raw)
 
 
+def env_bool(name: str, default: bool = False) -> bool:
+    """Canonical bool parsing for registry-typed env vars: truthy spellings
+    are exactly 1/true/yes/on (case-insensitive); anything else is False.
+    Every `bool`-typed ENV_REGISTRY read must go through this (or _env) so
+    the accepted spellings cannot drift between modules."""
+    return bool(_env(name, default, bool))
+
+
+@dataclasses.dataclass(frozen=True)
+class EnvVar:
+    """One registered environment variable: the discoverability contract.
+
+    Every `DYN_*` / `DYNAMO_TPU_*` read anywhere in the package must have
+    an entry here — enforced by the `env-registry` dynolint rule
+    (dynamo_tpu/analysis). `python -m dynamo_tpu.analysis --emit-env-docs`
+    renders the table to docs/configuration.md."""
+
+    name: str
+    type: str  # "str" | "int" | "float" | "bool" | "path" | "enum"
+    default: Optional[str]
+    description: str
+    module: str  # primary consuming module (repo-relative)
+
+
+ENV_REGISTRY: tuple = (
+    # -- logging ------------------------------------------------------- #
+    EnvVar("DYN_LOG", "str", "info",
+           "Log filter, RUST_LOG-style: a level (`debug`) or "
+           "`target=level` pairs (`dynamo_tpu.engine=debug,info`).",
+           "runtime/logging.py"),
+    EnvVar("DYN_LOGGING_JSONL", "bool", "0",
+           "Switch log output to JSON lines (one object per record).",
+           "runtime/logging.py"),
+    # -- runtime / event loop ------------------------------------------ #
+    EnvVar("DYN_RUNTIME_CONFIG", "path", None,
+           "Optional TOML/JSON/YAML config file layered under the env.",
+           "runtime/config.py"),
+    EnvVar("DYN_RUNTIME_NUM_WORKER_THREADS", "int", "0",
+           "Worker thread count hint; 0 = library default.",
+           "runtime/config.py"),
+    EnvVar("DYN_RUNTIME_MAX_BLOCKING_THREADS", "int", "4",
+           "Cap on blocking-offload threads.",
+           "runtime/config.py"),
+    EnvVar("DYN_RUNTIME_GRACEFUL_SHUTDOWN_TIMEOUT", "float", "30.0",
+           "Seconds to wait for in-flight streams on shutdown.",
+           "runtime/config.py"),
+    EnvVar("DYN_COMPUTE_THREADS", "int", "min(4, cpus)",
+           "Compute-pool size for CPU-bound offload (tokenize/template).",
+           "runtime/compute.py"),
+    # -- system status / health ---------------------------------------- #
+    EnvVar("DYN_SYSTEM_ENABLED", "bool", "0",
+           "Enable the system-status HTTP server (health + metrics).",
+           "runtime/system_status.py"),
+    EnvVar("DYN_SYSTEM_HOST", "str", "0.0.0.0",
+           "Bind host for the system-status server.",
+           "runtime/system_status.py"),
+    EnvVar("DYN_SYSTEM_PORT", "int", "0",
+           "Bind port for the system-status server; 0 = ephemeral. An "
+           "explicit port implies DYN_SYSTEM_ENABLED=1.",
+           "runtime/system_status.py"),
+    EnvVar("DYN_HEALTH_CHECK_ENABLED", "bool", "0",
+           "Enable canary health checks against served endpoints.",
+           "runtime/health_check.py"),
+    EnvVar("DYN_HEALTH_CHECK_IDLE_TIMEOUT", "float", "60.0",
+           "Seconds of endpoint idleness before a canary probe fires.",
+           "runtime/health_check.py"),
+    EnvVar("DYN_HEALTH_CHECK_REQUEST_TIMEOUT", "float", "10.0",
+           "Canary probe request timeout in seconds.",
+           "runtime/health_check.py"),
+    # -- discovery / request plane ------------------------------------- #
+    EnvVar("DYN_DISCOVERY_ENDPOINT", "str", "tcp://127.0.0.1:2379",
+           "Discovery-service address (etcd role).",
+           "runtime/discovery.py"),
+    EnvVar("DYN_LEASE_TTL_S", "float", "10.0",
+           "Instance-lease TTL: missed keepalives past this drop the "
+           "worker from discovery.",
+           "runtime/discovery.py"),
+    EnvVar("DYN_REQUEST_PLANE_HOST", "str", "127.0.0.1",
+           "Bind host for the TCP request-plane server.",
+           "runtime/request_plane.py"),
+    # -- engine / memory sizing ---------------------------------------- #
+    EnvVar("DYN_HBM_UTILIZATION", "float", "0.85",
+           "Fraction of device memory the KV pool auto-sizer may plan "
+           "for (the gpu_memory_utilization role).",
+           "engine/engine.py"),
+    EnvVar("DYN_HBM_BYTES", "int", None,
+           "Device memory override in bytes for platforms without "
+           "memory_stats (CPU, tunneled runtimes).",
+           "engine/engine.py"),
+    EnvVar("DYN_HBM_RESERVE_MB", "float", "512",
+           "Memory held back for compile/activation workspace the "
+           "post-weights snapshot cannot see.",
+           "engine/engine.py"),
+    EnvVar("DYN_WORKERS_PER_DEVICE", "int", "1",
+           "Split the free KV pool between co-located workers sharing "
+           "one chip (single-chip disagg).",
+           "engine/engine.py"),
+    # -- workers / models / native ------------------------------------- #
+    EnvVar("DYN_WORKER_INDEX", "int", None,
+           "Set by the planner for each spawned worker: its index within "
+           "its role's replica set.",
+           "planner/connector.py"),
+    EnvVar("DYN_HF_ALLOW_DOWNLOAD", "bool", "0",
+           "Allow model loads to hit the HuggingFace hub; default is "
+           "cache-only (serving environments are often airgapped).",
+           "models/loader.py"),
+    EnvVar("DYN_NATIVE", "bool", "1",
+           "Set to 0 to disable the optional native (C) extension and "
+           "force the pure-Python paths.",
+           "native/__init__.py"),
+    EnvVar("DYNAMO_TPU_COMPILE_CACHE", "path", "~/.cache/dynamo_tpu_xla",
+           "Persistent XLA compilation-cache directory; 'off' disables.",
+           "engine/engine.py"),
+    EnvVar("DYNAMO_TPU_PAGED_ATTN", "enum", "auto",
+           "Paged-attention kernel selection: auto / pallas / xla "
+           "reference.",
+           "ops/paged_attention.py"),
+)
+
+
 @dataclasses.dataclass
 class RuntimeConfig:
     """Process-local runtime configuration (reference: RuntimeConfig config.rs:72)."""

@@ -104,7 +104,9 @@ def init_logging(level: Optional[str] = None, jsonl: Optional[bool] = None):
     _INITIALIZED = True
     level = level or os.environ.get("DYN_LOG", "info")
     if jsonl is None:
-        jsonl = os.environ.get("DYN_LOGGING_JSONL", "").lower() in ("1", "true")
+        from .config import env_bool
+
+        jsonl = env_bool("DYN_LOGGING_JSONL")
     handler = logging.StreamHandler(sys.stderr)
     if jsonl:
         handler.setFormatter(_JsonFormatter())

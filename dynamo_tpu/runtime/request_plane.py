@@ -323,7 +323,9 @@ class RequestPlaneClient:
                 if get_task not in done:
                     get_task.cancel()
                     continue
-                control, payload = get_task.result()
+                # the task is in asyncio.wait's done set, so result()
+                # returns immediately — it never blocks here
+                control, payload = get_task.result()  # dynolint: disable=async-blocking -- task already done
                 get_task = None
                 t = control.get("t")
                 if t == "data":
