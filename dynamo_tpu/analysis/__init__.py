@@ -7,10 +7,17 @@ bug) survived multiple reviews. Each contract is a `Rule` over the parsed
 AST of the package; `tests/test_static_analysis.py` runs the pack as a
 tier-1 test so every PR inherits enforcement.
 
+Two packs: the per-file `core` rules (rules/) and the interprocedural
+`shard` pack (shard/ — mesh-axis registry, Pallas grid consistency,
+collective symmetry; resolution through call chains, defaults, and
+functools.partial).
+
 Run locally:
 
     python -m dynamo_tpu.analysis                # text report, exit 1 on hits
     python -m dynamo_tpu.analysis --format=json  # machine-readable
+    python -m dynamo_tpu.analysis --rules shard  # one pack
+    python -m dynamo_tpu.analysis --changed-only # git-scoped report
     python -m dynamo_tpu.analysis --emit-env-docs docs/configuration.md
 
 Suppress a finding on its line (reason required by convention):

@@ -3,6 +3,9 @@
     python -m dynamo_tpu.analysis                      # lint, text output
     python -m dynamo_tpu.analysis --format=json        # lint, JSON output
     python -m dynamo_tpu.analysis --rules silent-drop  # subset
+    python -m dynamo_tpu.analysis --rules shard        # a whole pack
+    python -m dynamo_tpu.analysis --changed-only       # report only files
+                                                       # touched vs HEAD
     python -m dynamo_tpu.analysis --list-rules
     python -m dynamo_tpu.analysis --emit-env-docs docs/configuration.md
 
@@ -12,11 +15,13 @@ Exit status: 0 clean, 1 violations found, 2 usage error.
 from __future__ import annotations
 
 import argparse
+import subprocess
 import sys
 from pathlib import Path
+from typing import List, Optional
 
 from .core import Project, format_json, format_text, run
-from .rules import ALL_RULES, default_rules
+from .rules import ALL_RULES, PACKS, default_rules
 
 
 def emit_env_docs(root: Path) -> str:
@@ -65,6 +70,32 @@ def emit_env_docs(root: Path) -> str:
     return "\n".join(lines)
 
 
+def changed_files(root: Path, base: str) -> Optional[List[str]]:
+    """Repo-relative .py paths under dynamo_tpu/ that differ from `base`
+    (committed diff + working tree + untracked). None when git is
+    unavailable — the caller falls back to a full run rather than
+    silently skipping the gate."""
+    try:
+        # --relative: paths relative to cwd (= root), matching
+        # Violation.path even when root is nested inside a larger git
+        # repo (git diff is toplevel-relative by default; ls-files is
+        # already cwd-relative)
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", "--relative", base, "--", "dynamo_tpu"],
+            cwd=root, capture_output=True, text=True, timeout=30,
+        )
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard", "dynamo_tpu"],
+            cwd=root, capture_output=True, text=True, timeout=30,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if diff.returncode != 0 or untracked.returncode != 0:
+        return None
+    out = set(diff.stdout.split()) | set(untracked.stdout.split())
+    return sorted(p for p in out if p.endswith(".py"))
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m dynamo_tpu.analysis",
@@ -81,10 +112,23 @@ def main(argv=None) -> int:
     )
     parser.add_argument(
         "--rules", default=None,
-        help="comma-separated rule names to run (default: all)",
+        help="comma-separated rule names or pack aliases "
+        f"({', '.join(sorted(PACKS))}) to run (default: all)",
     )
     parser.add_argument(
         "--list-rules", action="store_true", help="list rules and exit"
+    )
+    parser.add_argument(
+        "--changed-only", action="store_true",
+        help="report violations only in files changed vs --diff-base "
+        "(committed + working tree + untracked). Rules still see the "
+        "whole tree — interprocedural context is never truncated — but "
+        "findings in untouched files are filtered, and a no-change diff "
+        "exits immediately. Intended for fast pre-pytest gating",
+    )
+    parser.add_argument(
+        "--diff-base", default="HEAD", metavar="REF",
+        help="git ref --changed-only diffs against (default: HEAD)",
     )
     parser.add_argument(
         "--emit-env-docs", nargs="?", const="-", metavar="PATH",
@@ -94,8 +138,10 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
 
     if args.list_rules:
-        for cls in ALL_RULES:
-            print(f"{cls.name:16} {cls.description}")
+        for alias, pack in PACKS.items():
+            print(f"[{alias}]")
+            for cls in pack:
+                print(f"  {cls.name:26} {cls.description}")
         return 0
 
     root = Path(args.root) if args.root else Path(__file__).resolve().parents[2]
@@ -114,20 +160,47 @@ def main(argv=None) -> int:
 
     rules = default_rules()
     if args.rules:
-        wanted = {r.strip() for r in args.rules.split(",") if r.strip()}
+        wanted = set()
+        for token in args.rules.split(","):
+            token = token.strip()
+            if not token:
+                continue
+            if token in PACKS:
+                wanted |= {cls.name for cls in PACKS[token]}
+            else:
+                wanted.add(token)
         known = {r.name for r in rules}
         unknown = wanted - known
         if unknown:
             print(
                 f"error: unknown rule(s): {', '.join(sorted(unknown))}; "
-                f"known: {', '.join(sorted(known))}",
+                f"known: {', '.join(sorted(known | set(PACKS)))}",
                 file=sys.stderr,
             )
             return 2
         rules = [r for r in rules if r.name in wanted]
 
+    scope: Optional[List[str]] = None
+    if args.changed_only:
+        scope = changed_files(root, args.diff_base)
+        if scope is not None and not scope:
+            print(
+                f"dynolint: no package files changed vs {args.diff_base}; "
+                "nothing to lint"
+            )
+            return 0
+        if scope is None:
+            print(
+                "dynolint: --changed-only could not read git state; "
+                "falling back to a full run",
+                file=sys.stderr,
+            )
+
     project = Project.load(root)
     violations = run(project, rules)
+    if scope is not None:
+        scoped = set(scope)
+        violations = [v for v in violations if v.path in scoped]
     out = (
         format_json(violations)
         if args.format == "json"

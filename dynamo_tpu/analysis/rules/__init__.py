@@ -1,18 +1,27 @@
 """dynolint rule pack: the invariants this codebase has been burned by."""
 
+from ..shard import SHARD_RULES, AxisRegistryRule, CollectiveSymmetryRule, PallasGridRule
 from .async_safety import AsyncBlockingRule
 from .env_registry import EnvRegistryRule
 from .jax_purity import JaxPurityRule
 from .lock_discipline import LockDisciplineRule
 from .silent_drop import SilentDropRule
 
-ALL_RULES = (
+CORE_RULES = (
     SilentDropRule,
     AsyncBlockingRule,
     JaxPurityRule,
     EnvRegistryRule,
     LockDisciplineRule,
 )
+
+ALL_RULES = CORE_RULES + SHARD_RULES
+
+#: pack aliases accepted by the CLI's --rules (e.g. `--rules shard`)
+PACKS = {
+    "core": CORE_RULES,
+    "shard": SHARD_RULES,
+}
 
 
 def default_rules():
@@ -21,10 +30,15 @@ def default_rules():
 
 __all__ = [
     "ALL_RULES",
+    "CORE_RULES",
+    "PACKS",
     "AsyncBlockingRule",
+    "AxisRegistryRule",
+    "CollectiveSymmetryRule",
     "EnvRegistryRule",
     "JaxPurityRule",
     "LockDisciplineRule",
+    "PallasGridRule",
     "SilentDropRule",
     "default_rules",
 ]

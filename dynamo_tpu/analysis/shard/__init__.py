@@ -1,0 +1,28 @@
+"""dynoshard: the interprocedural shard-consistency rule pack.
+
+PR 1's dynolint rules are per-file and syntactic; this pack adds the
+parallelism layer's contracts, which are inherently cross-module: axis
+names flow through call chains before reaching a collective, and Pallas
+grid arithmetic spans wrapper + kernel. See docs/static_analysis.md
+("The shard pack") and shard/callgraph.py for the resolution machinery.
+"""
+
+from .axis_registry import AxisRegistryRule
+from .callgraph import FunctionIndex, load_axis_registry
+from .collective_symmetry import CollectiveSymmetryRule
+from .pallas_grid import PallasGridRule
+
+SHARD_RULES = (
+    AxisRegistryRule,
+    PallasGridRule,
+    CollectiveSymmetryRule,
+)
+
+__all__ = [
+    "AxisRegistryRule",
+    "CollectiveSymmetryRule",
+    "FunctionIndex",
+    "PallasGridRule",
+    "SHARD_RULES",
+    "load_axis_registry",
+]

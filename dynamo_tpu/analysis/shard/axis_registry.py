@@ -1,0 +1,229 @@
+"""Rule: shard-axis-registry — every mesh-axis reference resolves into
+KNOWN_AXES (parallel/mesh.py).
+
+A collective over a mistyped axis name is the worst kind of bug this stack
+has: `jax.lax.psum(x, "qp")` does not fail until a mesh is in context, and
+under `shard_map` an axis that exists-but-is-wrong silently reduces over
+the wrong device group (a numerics bug, not a crash). Axis names travel
+through default parameters, keyword forwarding, and functools.partial
+before reaching the collective, so the check is interprocedural: axis
+arguments are resolved through the call graph (shard/callgraph.py) and
+every string they can take must be registered in mesh.py's KNOWN_AXES.
+
+Checked reference positions:
+  * collectives: `psum`/`pmean`/`pmax`/`pmin`/`ppermute`/`all_gather`/
+    `all_to_all`/`psum_scatter`/`axis_index`/`axis_size`/`pbroadcast`
+    (under `jax.lax`/`lax` or imported bare)
+  * `PartitionSpec(...)` entries (incl. tuple entries), under any alias
+  * `Mesh(..., axis_names=...)`
+  * `mesh.shape[...]` / `mesh.shape.get(...)` / `<name> in mesh.shape` /
+    `<name> in mesh.axis_names`
+
+Violations anchor at the line the offending string literal was WRITTEN
+(default value, constant, or call argument), which is where the fix or
+waiver belongs — not at the collective that happened to consume it.
+Unresolvable expressions are skipped: the rule under-approximates and
+never guesses.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, Optional, Set, Tuple
+
+from ..core import Project, Rule, SourceFile, Violation, call_name, dotted_name
+from .callgraph import FunctionIndex, MESH_MODULE, iter_calls, load_axis_registry
+
+#: collective -> positional index of its axis-name argument
+_COLLECTIVES = {
+    "psum": 1,
+    "pmean": 1,
+    "pmax": 1,
+    "pmin": 1,
+    "ppermute": 1,
+    "all_gather": 1,
+    "all_to_all": 1,
+    "psum_scatter": 1,
+    "pbroadcast": 1,
+    "axis_index": 0,
+    "axis_size": 0,
+}
+_LAX_PREFIXES = ("", "lax", "jax.lax")
+_PSPEC_SOURCES = {"jax.sharding", "jax.sharding.partition_spec"}
+
+
+def _pspec_aliases(src: SourceFile) -> Set[str]:
+    """Local names PartitionSpec is bound to in this file (`P`, ...)."""
+    names = {"jax.sharding.PartitionSpec", "sharding.PartitionSpec"}
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.ImportFrom) and node.module in _PSPEC_SOURCES:
+            for alias in node.names:
+                if alias.name == "PartitionSpec":
+                    names.add(alias.asname or alias.name)
+    return names
+
+
+class AxisRegistryRule(Rule):
+    name = "shard-axis-registry"
+    description = (
+        "collectives, PartitionSpecs, and mesh lookups only reference axes "
+        "registered in parallel/mesh.py KNOWN_AXES (resolved through call "
+        "chains, defaults, and partial application)"
+    )
+
+    def check(self, project: Project) -> Iterator[Violation]:
+        registry, err = load_axis_registry(project)
+        if err is not None:
+            yield Violation(
+                rule=self.name,
+                path=MESH_MODULE,
+                line=1,
+                message=err,
+            )
+            return
+        index = FunctionIndex(project)
+        # one violation per offending LITERAL: a bad default reaching three
+        # collectives is one typo to fix, not three findings
+        seen: Set[Tuple[str, int, str]] = set()
+        for src in project.files:
+            for violation, axis in self._check_file(src, index, registry):
+                key = (violation.path, violation.line, axis)
+                if key not in seen:
+                    seen.add(key)
+                    yield violation
+
+    # ----------------------------------------------------------------- #
+
+    def _check_file(
+        self, src: SourceFile, index: FunctionIndex, registry: Dict[str, str]
+    ) -> Iterator[Tuple[Violation, str]]:
+        pspec_names = _pspec_aliases(src)
+        for call, enclosing in iter_calls(src):
+            name = call_name(call)
+            yield from self._check_collective(
+                src, index, registry, call, enclosing, name
+            )
+            if name in pspec_names:
+                for arg in call.args:
+                    yield from self._flag_bad(
+                        src, index, registry, enclosing, arg,
+                        f"`{name}(...)` entry",
+                    )
+            if name.split(".")[-1] == "Mesh":
+                for kw in call.keywords:
+                    if kw.arg == "axis_names":
+                        yield from self._flag_bad(
+                            src, index, registry, enclosing, kw.value,
+                            "`Mesh(axis_names=...)` entry",
+                        )
+            # mesh.shape.get("pp", 1)
+            if name.endswith(".shape.get") and call.args:
+                yield from self._flag_bad(
+                    src, index, registry, enclosing, call.args[0],
+                    f"`{name}(...)` key",
+                )
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Subscript):
+                base = dotted_name(node.value)
+                if base.endswith(".shape") or base.endswith(".axis_names"):
+                    yield from self._flag_literal_only(
+                        src, index, registry, node.slice, f"`{base}[...]` key"
+                    )
+            elif isinstance(node, ast.Compare) and len(node.ops) == 1:
+                if isinstance(node.ops[0], (ast.In, ast.NotIn)):
+                    target = dotted_name(node.comparators[0])
+                    if target.endswith(".shape") or target.endswith(".axis_names"):
+                        yield from self._flag_literal_only(
+                            src, index, registry, node.left,
+                            f"membership test on `{target}`",
+                        )
+
+    def _check_collective(
+        self,
+        src: SourceFile,
+        index: FunctionIndex,
+        registry: Dict[str, str],
+        call: ast.Call,
+        enclosing,
+        name: str,
+    ) -> Iterator[Tuple[Violation, str]]:
+        simple = name.split(".")[-1]
+        if simple not in _COLLECTIVES:
+            return
+        prefix = name[: -len(simple)].rstrip(".")
+        if prefix not in _LAX_PREFIXES:
+            return
+        pos = _COLLECTIVES[simple]
+        axis_expr: Optional[ast.AST] = None
+        for kw in call.keywords:
+            if kw.arg in ("axis_name", "axis_names"):
+                axis_expr = kw.value
+                break
+        if axis_expr is None and pos < len(call.args):
+            axis_expr = call.args[pos]
+        if axis_expr is None:
+            return
+        yield from self._flag_bad(
+            src, index, registry, enclosing, axis_expr,
+            f"`{name}` at {src.rel}:{call.lineno}",
+        )
+
+    def _flag_bad(
+        self,
+        src: SourceFile,
+        index: FunctionIndex,
+        registry: Dict[str, str],
+        enclosing,
+        expr: ast.AST,
+        context: str,
+    ) -> Iterator[Tuple[Violation, str]]:
+        res = index.resolve_strings(src, enclosing, expr)
+        for r in sorted(res.values, key=lambda r: (r.path, r.line, r.value)):
+            if r.value not in registry:
+                yield Violation(
+                    rule=self.name,
+                    path=r.path,
+                    line=r.line,
+                    message=(
+                        f"axis '{r.value}' (reaching {context}) is not in "
+                        f"KNOWN_AXES ({MESH_MODULE}: "
+                        f"{', '.join(sorted(registry))})"
+                    ),
+                ), r.value
+
+    def _flag_literal_only(
+        self,
+        src: SourceFile,
+        index: FunctionIndex,
+        registry: Dict[str, str],
+        expr: ast.AST,
+        context: str,
+    ) -> Iterator[Tuple[Violation, str]]:
+        """Subscript keys / membership operands: only flag plain string
+        literals and module-level constants (incl. imported ones) — and
+        only values that LOOK like axis names (<=3 chars, lowercase), so a
+        hypothetical dict keyed on `.shape`/`.axis_names` strings can
+        never be dragged in. No call-chain resolution here."""
+        value: Optional[str] = None
+        line = getattr(expr, "lineno", None)
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            value = expr.value
+        elif isinstance(expr, ast.Name):
+            const = index.module_consts.get(src.rel, {}).get(expr.id)
+            if const is None:
+                return
+            value = const.value
+        if value is None or line is None:
+            return
+        if len(value) > 3 or not value.islower():
+            return  # not axis-shaped: a real dict key like "positions"
+        if value not in registry:
+            yield Violation(
+                rule=self.name,
+                path=src.rel,
+                line=line,
+                message=(
+                    f"axis '{value}' ({context}) is not in KNOWN_AXES "
+                    f"({MESH_MODULE}: {', '.join(sorted(registry))})"
+                ),
+            ), value

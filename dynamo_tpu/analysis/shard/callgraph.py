@@ -1,0 +1,417 @@
+"""Interprocedural string/constant resolution for the shard rule pack.
+
+The parallelism layer wires mesh-axis names through several indirection
+levels before they reach a collective:
+
+    moe.prefill_forward_ring(axis_name=SP_AXIS)          # module constant
+      -> llama.prefill_forward_ring(axis_name=axis_name) # keyword forwarding
+        -> ring_attention(q, k, v, mesh, axis_name=...)  # default parameter
+          -> partial(_ring_attention_local, axis_name=axis_name)  # partial
+            -> jax.lax.ppermute(k_blk, axis_name, perm)  # the collective
+
+A per-file syntactic rule cannot see through any of that. This module
+builds the project-wide indices the shard rules share:
+
+  * module-level string constants, resolved THROUGH import chains
+    (`from ..parallel.mesh import SP_AXIS` binds mesh.py's value);
+  * a function index (simple name -> defs) and a call-site index
+    (callee simple name -> calls, including `functools.partial(fn, ...)`
+    treated as a deferred call site);
+  * `resolve_strings`: given an expression in a function context, the set
+    of string values it can take — following local assignments, module
+    constants, parameter defaults, and actual arguments at every call
+    site of the enclosing function (bounded depth, cycle-safe).
+
+Resolution is deliberately UNDER-approximate: anything it cannot prove is
+reported as incomplete and the rules stay quiet about it. Every resolved
+string carries the (file, line) where the literal was written, so
+violations anchor where a maintainer would fix or waive them.
+
+Everything is stdlib `ast`; mesh.py is parsed, never imported, so the
+checker runs on hosts without JAX installed (same contract as the env
+registry in rules/env_registry.py).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..core import Project, SourceFile, call_name, dotted_name
+
+MESH_MODULE = "dynamo_tpu/parallel/mesh.py"
+_PARTIAL_NAMES = {"partial", "functools.partial"}
+_MAX_DEPTH = 6
+
+
+@dataclasses.dataclass(frozen=True)
+class ResolvedStr:
+    """A string value plus the site where its literal was written."""
+
+    value: str
+    path: str  # repo-relative path of the literal
+    line: int
+
+
+@dataclasses.dataclass
+class Resolution:
+    """Outcome of resolving one expression: the string values it provably
+    takes, and whether the value set is complete (False -> the expression
+    has at least one binding the resolver could not follow)."""
+
+    values: Set[ResolvedStr] = dataclasses.field(default_factory=set)
+    complete: bool = True
+
+    def merge(self, other: "Resolution") -> None:
+        self.values |= other.values
+        self.complete = self.complete and other.complete
+
+
+@dataclasses.dataclass(frozen=True)
+class FunctionInfo:
+    src: SourceFile
+    node: ast.AST  # FunctionDef | AsyncFunctionDef
+
+    @property
+    def name(self) -> str:
+        return self.node.name
+
+
+#: nesting chain of function defs around a node, outermost first; () at
+#: module level. Closure lookups walk it innermost-outward.
+Chain = Tuple[ast.AST, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class CallSite:
+    src: SourceFile
+    call: ast.Call
+    chain: Chain  # function defs enclosing the call, outermost first
+    is_partial: bool  # partial(fn, ...): positional args shift by one
+
+
+def _module_rel_for_import(src: SourceFile, node: ast.ImportFrom) -> Optional[str]:
+    """Repo-relative path of the module an ImportFrom names, or None for
+    out-of-package imports. `from ..parallel.mesh import SP_AXIS` inside
+    dynamo_tpu/models/moe.py -> dynamo_tpu/parallel/mesh.py."""
+    if node.level == 0:
+        if not node.module or not node.module.startswith("dynamo_tpu"):
+            return None
+        return node.module.replace(".", "/") + ".py"
+    parts = src.rel.split("/")[:-1]  # package dir of the importing file
+    hops = node.level - 1
+    if hops > len(parts):
+        return None
+    base = parts[: len(parts) - hops] if hops else parts
+    tail = node.module.split(".") if node.module else []
+    return "/".join(base + tail) + ".py"
+
+
+class FunctionIndex:
+    """Project-wide indices; build once per rule run and share."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        self.functions: Dict[str, List[FunctionInfo]] = {}
+        self.call_sites: Dict[str, List[CallSite]] = {}
+        #: rel path -> {name: ResolvedStr} module-level string constants
+        self.module_consts: Dict[str, Dict[str, ResolvedStr]] = {}
+        self._build()
+
+    # ----------------------------------------------------------------- #
+    # construction
+    # ----------------------------------------------------------------- #
+
+    def _build(self) -> None:
+        imports: Dict[str, Dict[str, Tuple[str, str]]] = {}
+        for src in self.project.files:
+            consts: Dict[str, ResolvedStr] = {}
+            imps: Dict[str, Tuple[str, str]] = {}
+            for node in src.tree.body:
+                if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    tgt = node.targets[0]
+                    if isinstance(tgt, ast.Name) and isinstance(node.value, ast.Constant) \
+                            and isinstance(node.value.value, str):
+                        consts[tgt.id] = ResolvedStr(
+                            node.value.value, src.rel, node.value.lineno
+                        )
+                elif isinstance(node, ast.ImportFrom):
+                    mod = _module_rel_for_import(src, node)
+                    if mod is None:
+                        continue
+                    for alias in node.names:
+                        imps[alias.asname or alias.name] = (mod, alias.name)
+            self.module_consts[src.rel] = consts
+            imports[src.rel] = imps
+            self._index_defs_and_calls(src)
+        # fixpoint: a constant may be an import of an import
+        for _ in range(4):
+            changed = False
+            for rel, imps in imports.items():
+                for local, (mod, orig) in imps.items():
+                    if local in self.module_consts[rel]:
+                        continue
+                    hit = self.module_consts.get(mod, {}).get(orig)
+                    if hit is not None:
+                        self.module_consts[rel][local] = hit
+                        changed = True
+            if not changed:
+                break
+
+    def _index_defs_and_calls(self, src: SourceFile) -> None:
+        for child, chain in _walk_with_chain(src.tree):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions.setdefault(child.name, []).append(
+                    FunctionInfo(src, child)
+                )
+            if isinstance(child, ast.Call):
+                name = call_name(child)
+                if name in _PARTIAL_NAMES and child.args:
+                    inner = dotted_name(child.args[0])
+                    if inner:
+                        self.call_sites.setdefault(
+                            inner.split(".")[-1], []
+                        ).append(CallSite(src, child, chain, True))
+                elif name:
+                    self.call_sites.setdefault(
+                        name.split(".")[-1], []
+                    ).append(CallSite(src, child, chain, False))
+
+    # ----------------------------------------------------------------- #
+    # resolution
+    # ----------------------------------------------------------------- #
+
+    def resolve_strings(
+        self,
+        src: SourceFile,
+        chain: Chain,
+        expr: ast.AST,
+        _depth: int = 0,
+        _visited: Optional[Set[Tuple[int, str]]] = None,
+    ) -> Resolution:
+        """All string values `expr` can take in the context of the scope
+        chain (() = module level). Tuples/lists resolve element-wise; None
+        constants resolve to nothing (complete) so PartitionSpec entries
+        like `P(pp, None, "tp")` work unmodified."""
+        res = Resolution()
+        if _depth > _MAX_DEPTH:
+            res.complete = False
+            return res
+        visited = _visited if _visited is not None else set()
+
+        if isinstance(expr, ast.Constant):
+            if isinstance(expr.value, str):
+                res.values.add(ResolvedStr(expr.value, src.rel, expr.lineno))
+            elif expr.value is not None:
+                res.complete = False  # a non-str, non-None constant
+            return res
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            for el in expr.elts:
+                res.merge(
+                    self.resolve_strings(src, chain, el, _depth, visited)
+                )
+            return res
+        if isinstance(expr, ast.IfExp):
+            res.merge(self.resolve_strings(src, chain, expr.body, _depth, visited))
+            res.merge(self.resolve_strings(src, chain, expr.orelse, _depth, visited))
+            return res
+        if isinstance(expr, ast.Name):
+            return self._resolve_name(src, chain, expr.id, _depth, visited)
+        res.complete = False
+        return res
+
+    def _resolve_name(
+        self,
+        src: SourceFile,
+        chain: Chain,
+        name: str,
+        depth: int,
+        visited: Set[Tuple[int, str]],
+    ) -> Resolution:
+        res = Resolution()
+        # innermost scope outward: closure variables resolve against the
+        # def that owns them (a ppermute inside a scan-body `tick` reads
+        # `perm` assigned in the enclosing schedule function)
+        for i in range(len(chain) - 1, -1, -1):
+            func = chain[i]
+            key = (id(func), name)
+            if key in visited:
+                return res  # cycle: contributes nothing, stays complete
+            visited.add(key)
+            local = scoped_assignments(func, name)
+            if local:
+                for val in local:
+                    res.merge(
+                        self.resolve_strings(
+                            src, chain[: i + 1], val, depth + 1, visited
+                        )
+                    )
+                return res
+            if self._is_param(func, name):
+                res.merge(
+                    self._resolve_param(src, chain[: i + 1], name, depth, visited)
+                )
+                return res
+        const = self.module_consts.get(src.rel, {}).get(name)
+        if const is not None:
+            res.values.add(const)
+            return res
+        res.complete = False
+        return res
+
+    @staticmethod
+    def _is_param(func: ast.AST, name: str) -> bool:
+        a = func.args
+        params = a.posonlyargs + a.args + a.kwonlyargs
+        return any(p.arg == name for p in params)
+
+    def _resolve_param(
+        self,
+        src: SourceFile,
+        chain: Chain,
+        name: str,
+        depth: int,
+        visited: Set[Tuple[int, str]],
+    ) -> Resolution:
+        """Default value plus every actual argument for `name` across the
+        project's call sites of chain[-1] (by simple name; partial()
+        shifts positional indexing by one)."""
+        res = Resolution()
+        func = chain[-1]
+        a = func.args
+        params = a.posonlyargs + a.args
+        # default, if any
+        defaults = dict(zip([p.arg for p in params[len(params) - len(a.defaults):]], a.defaults))
+        kw_defaults = {
+            p.arg: d for p, d in zip(a.kwonlyargs, a.kw_defaults) if d is not None
+        }
+        default = defaults.get(name, kw_defaults.get(name))
+        if default is not None:
+            res.merge(self.resolve_strings(src, chain, default, depth + 1, visited))
+        try:
+            pos_index = [p.arg for p in params].index(name)
+        except ValueError:
+            pos_index = None
+        for site in self.call_sites.get(func.name, []):
+            actual: Optional[ast.AST] = None
+            for kw in site.call.keywords:
+                if kw.arg == name:
+                    actual = kw.value
+                    break
+            if actual is None and pos_index is not None:
+                args = site.call.args[1:] if site.is_partial else site.call.args
+                if pos_index < len(args):
+                    arg = args[pos_index]
+                    if isinstance(arg, ast.Starred):
+                        res.complete = False
+                        continue
+                    actual = arg
+            if actual is None:
+                continue  # call site relies on the default, already merged
+            res.merge(
+                self.resolve_strings(
+                    site.src, site.chain, actual, depth + 1, visited
+                )
+            )
+        return res
+
+
+# --------------------------------------------------------------------- #
+# axis registry extraction (AST of parallel/mesh.py, never imported)
+# --------------------------------------------------------------------- #
+
+
+def load_axis_registry(project: Project) -> Tuple[Optional[Dict[str, str]], Optional[str]]:
+    """Parse KNOWN_AXES out of parallel/mesh.py. Returns (registry, error):
+    registry maps axis name -> role; error is a human message when the
+    registry is missing or unreadable (the rule reports it as a violation,
+    mirroring the env-registry contract)."""
+    src = project.get(MESH_MODULE)
+    if src is None:
+        return None, f"{MESH_MODULE} not found: the mesh-axis registry is gone"
+    consts: Dict[str, str] = {}
+    known: Optional[ast.Dict] = None
+    for node in src.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt = node.targets[0]
+            if not isinstance(tgt, ast.Name):
+                continue
+            if isinstance(node.value, ast.Constant) and isinstance(node.value.value, str):
+                consts[tgt.id] = node.value.value
+            elif tgt.id == "KNOWN_AXES" and isinstance(node.value, ast.Dict):
+                known = node.value
+    if known is None:
+        return None, (
+            f"{MESH_MODULE} defines no KNOWN_AXES dict literal — the shard "
+            "rules need the axis registry as their source of truth"
+        )
+    registry: Dict[str, str] = {}
+    for k, v in zip(known.keys, known.values):
+        if isinstance(k, ast.Constant) and isinstance(k.value, str):
+            axis = k.value
+        elif isinstance(k, ast.Name) and k.id in consts:
+            axis = consts[k.id]
+        else:
+            return None, (
+                f"{MESH_MODULE}: KNOWN_AXES key {ast.dump(k)} is not a "
+                "resolvable string — keep keys as literals or same-module "
+                "string constants"
+            )
+        role = v.value if isinstance(v, ast.Constant) and isinstance(v.value, str) else ""
+        registry[axis] = role
+    return registry, None
+
+
+def _walk_with_chain(tree: ast.AST) -> Iterable[Tuple[ast.AST, Chain]]:
+    """Every node paired with its enclosing-function chain (outermost
+    first; the node's OWN def is not part of its chain)."""
+    stack: List[Tuple[ast.AST, Chain]] = [(tree, ())]
+    while stack:
+        node, chain = stack.pop()
+        for child in ast.iter_child_nodes(node):
+            yield child, chain
+            child_chain = chain
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                child_chain = chain + (child,)
+            stack.append((child, child_chain))
+
+
+def iter_calls(src: SourceFile) -> Iterable[Tuple[ast.Call, Chain]]:
+    """(call, enclosing scope chain) pairs for every Call in a file."""
+    for node, chain in _walk_with_chain(src.tree):
+        if isinstance(node, ast.Call):
+            yield node, chain
+
+
+def scoped_assignments(func: ast.AST, name: str) -> List[ast.AST]:
+    """Values assigned to `name` DIRECTLY in func's scope — nested defs
+    are their own scopes and are not descended into."""
+    out: List[ast.AST] = []
+    stack = list(ast.iter_child_nodes(func))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            continue
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == name:
+                    out.append(node.value)
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            if isinstance(node.target, ast.Name) and node.target.id == name:
+                out.append(node.value)
+        stack.extend(ast.iter_child_nodes(node))
+    out.sort(key=lambda n: getattr(n, "lineno", 0))
+    return out
+
+
+def chain_value(chain: Chain, expr: ast.AST) -> ast.AST:
+    """Follow ONE `name = <expr>` hop through the scope chain, innermost
+    scope that assigns the name wins (last assignment in that scope)."""
+    if not isinstance(expr, ast.Name):
+        return expr
+    for func in reversed(chain):
+        hops = scoped_assignments(func, expr.id)
+        if hops:
+            return hops[-1]
+    return expr

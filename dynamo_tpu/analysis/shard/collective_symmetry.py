@@ -1,0 +1,160 @@
+"""Rule: shard-collective-symmetry — ppermute permutations are total and
+masks are applied before, not after, reductions.
+
+Two failure shapes specific to hand-written collectives inside
+`scan`/`fori_loop` bodies (ring attention, pipeline schedules):
+
+  * A `ppermute` permutation that is not total on the axis: devices
+    missing as SOURCES receive zeros at the destination — silently, since
+    ppermute fills unaddressed destinations instead of failing. A ring
+    built as `[(i, (i + 1) % n) for i in range(n)]` is total; a schedule
+    built over `range(n - 1)` leaves the last device sending to nobody,
+    which is only ever correct for deliberately-open topologies (the
+    GPipe forward edge) and must carry a waiver saying so.
+
+  * A mask multiplied onto the RESULT of a `psum`-family reduction:
+    `psum(x, axis) * mask` has already accumulated every rank's
+    contribution — masking after the fact keeps the unwanted ranks' data
+    in the sum on the ranks where mask == 1. The correct shape is
+    `psum(x * mask, axis)` (pipeline.py's last-stage broadcast does
+    exactly this).
+
+Both checks resolve a Name perm/operand through local assignments in the
+enclosing function. Literal permutation lists are additionally checked
+for duplicate sources (two sends from one device is a trace-time error on
+TPU but only when the axis is actually materialized). Anything the rule
+cannot resolve it ignores.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from ..core import Project, Rule, SourceFile, Violation, call_name
+from .callgraph import Chain, chain_value, iter_calls
+
+_REDUCTIONS = {"psum", "pmean", "pmax", "pmin", "psum_scatter"}
+_LAX_PREFIXES = ("", "lax", "jax.lax")
+
+
+def _is_collective(call: ast.Call, names) -> bool:
+    name = call_name(call)
+    simple = name.split(".")[-1]
+    return simple in names and name[: -len(simple)].rstrip(".") in _LAX_PREFIXES
+
+
+def _mentions_mask(expr: ast.AST) -> bool:
+    return any(
+        isinstance(n, ast.Name) and "mask" in n.id.lower()
+        for n in ast.walk(expr)
+    )
+
+
+class CollectiveSymmetryRule(Rule):
+    name = "shard-collective-symmetry"
+    description = (
+        "ppermute permutations are total on the axis (non-total topologies "
+        "need a waiver) and masks multiply the operand, not the result, of "
+        "psum-family reductions"
+    )
+    scopes = ("ops/", "parallel/", "models/", "engine/")
+
+    def check(self, project: Project) -> Iterator[Violation]:
+        for src in project.in_scope(self.scopes):
+            for call, chain in iter_calls(src):
+                if _is_collective(call, {"ppermute"}):
+                    yield from self._check_perm(src, chain, call)
+            for node in ast.walk(src.tree):
+                if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mult):
+                    yield from self._check_mask_after(src, node)
+
+    # ----------------------------------------------------------------- #
+
+    def _check_perm(
+        self, src: SourceFile, chain: Chain, call: ast.Call
+    ) -> Iterator[Violation]:
+        perm = call.args[2] if len(call.args) > 2 else None
+        if perm is None:
+            for kw in call.keywords:
+                if kw.arg == "perm":
+                    perm = kw.value
+        if perm is None:
+            return
+        perm = chain_value(chain, perm)
+        msg = self._perm_defect(perm)
+        if msg is not None:
+            yield Violation(
+                rule=self.name, path=src.rel, line=call.lineno,
+                message=f"`{call_name(call)}`: {msg}",
+            )
+
+    @staticmethod
+    def _perm_defect(perm: ast.AST) -> Optional[str]:
+        # comprehension over range(...): total iff the range covers the
+        # whole axis; `range(n - k)` provably leaves devices out
+        if isinstance(perm, ast.ListComp) and len(perm.generators) == 1:
+            gen = perm.generators[0]
+            it = gen.iter
+            if isinstance(it, ast.Call) and call_name(it) == "range" \
+                    and len(it.args) == 1:
+                arg = it.args[0]
+                if isinstance(arg, ast.BinOp) and isinstance(arg.op, ast.Sub) \
+                        and isinstance(arg.right, ast.Constant) \
+                        and isinstance(arg.right.value, int) \
+                        and arg.right.value > 0:
+                    return (
+                        f"permutation ranges over `{ast.unparse(arg)}` — not "
+                        "total on the axis; devices outside the range "
+                        "receive ZEROS from ppermute. If the open topology "
+                        "is deliberate (e.g. a pipeline forward edge), "
+                        "waive with a reason"
+                    )
+            # element must send FROM the loop variable for the range
+            # argument to say anything about totality of sources; an
+            # element like `(0, i)` fans out from one source only
+            if isinstance(perm.elt, ast.Tuple) and len(perm.elt.elts) == 2 \
+                    and isinstance(gen.target, ast.Name):
+                src_el = perm.elt.elts[0]
+                if isinstance(src_el, ast.Constant):
+                    return (
+                        "every pair sends from the same constant source "
+                        f"`{ast.unparse(src_el)}` — not a permutation of "
+                        "the axis"
+                    )
+            return None
+        # literal list of constant pairs: duplicate sources are always a
+        # defect (ppermute requires source-uniqueness)
+        if isinstance(perm, (ast.List, ast.Tuple)):
+            sources = []
+            for el in perm.elts:
+                if isinstance(el, ast.Tuple) and len(el.elts) == 2 \
+                        and isinstance(el.elts[0], ast.Constant):
+                    sources.append(el.elts[0].value)
+                else:
+                    return None  # not fully literal: stay quiet
+            dupes = {s for s in sources if sources.count(s) > 1}
+            if dupes:
+                return (
+                    f"duplicate send source(s) {sorted(dupes)} — a "
+                    "permutation sends from each device at most once"
+                )
+        return None
+
+    def _check_mask_after(
+        self, src: SourceFile, node: ast.BinOp
+    ) -> Iterator[Violation]:
+        for reduced, other in ((node.left, node.right), (node.right, node.left)):
+            if isinstance(reduced, ast.Call) \
+                    and _is_collective(reduced, _REDUCTIONS) \
+                    and _mentions_mask(other):
+                yield Violation(
+                    rule=self.name, path=src.rel, line=node.lineno,
+                    message=(
+                        f"mask applied AFTER `{call_name(reduced)}` — the "
+                        "reduction has already accumulated every rank's "
+                        "contribution; multiply the mask into the operand "
+                        "(`psum(x * mask, axis)`) instead"
+                    ),
+                )
+                return

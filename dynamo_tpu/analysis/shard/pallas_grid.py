@@ -1,0 +1,272 @@
+"""Rule: shard-pallas-grid — pallas_call grid/BlockSpec arithmetic is
+internally consistent.
+
+Mosaic only rejects a malformed grid spec at lowering time, on TPU, with
+an error pointing into generated MLIR — and some mismatches don't even
+fail there: an `index_map` lambda with the wrong arity under
+`PrefetchScalarGridSpec` silently binds a scalar-prefetch ref as a grid
+index (the `lambda b, *_:` convention exists precisely because the index
+map receives `(*grid_indices, *scalar_refs)`). This rule checks, per
+`pl.pallas_call` site in `ops/`:
+
+  * index_map arity: each BlockSpec's lambda must name exactly
+    `len(grid)` positional parameters; with `num_scalar_prefetch=S > 0`
+    it must also carry a vararg (`*_`) to absorb the S scalar refs.
+  * block rank: a BlockSpec's block-shape tuple and its index_map's
+    returned tuple must have the same length.
+  * out rank: the out_specs block tuple and the
+    `jax.ShapeDtypeStruct((...), ...)` out_shape must have equal rank.
+  * operand count: when the pallas_call is invoked in the same function
+    (directly or through one local name), the number of operands must be
+    `num_scalar_prefetch + len(in_specs)`.
+  * guarded divisibility: a grid entry computed as `a // b` (directly or
+    via one local assignment) must be guarded by an `a % b` test
+    (assert / if-raise) in the same wrapper — an unguarded floor division
+    silently drops the remainder rows of the last tile. `pl.cdiv` needs
+    no guard.
+
+Everything literal-only and under-approximate: specs built in ways the
+rule cannot see (spec lists from helpers, computed grids) are skipped,
+never guessed at.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Tuple
+
+from ..core import Project, Rule, SourceFile, Violation, call_name
+from .callgraph import Chain, chain_value, iter_calls
+
+_PALLAS_CALL = {"pl.pallas_call", "pallas_call", "pallas.pallas_call"}
+_GRID_SPECS = {"GridSpec", "PrefetchScalarGridSpec"}
+_BLOCK_SPEC = "BlockSpec"
+_SHAPE_STRUCT = "ShapeDtypeStruct"
+
+
+def _kw(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _tuple_len(expr: ast.AST) -> Optional[int]:
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        return len(expr.elts)
+    return None
+
+
+class _Site:
+    """One pallas_call with its resolved grid/spec components."""
+
+    def __init__(self, src: SourceFile, call: ast.Call, chain: Chain):
+        self.src = src
+        self.call = call
+        self.chain = chain
+        self.grid: Optional[ast.AST] = None
+        self.in_specs: Optional[List[ast.AST]] = None
+        self.out_specs: Optional[ast.AST] = None
+        self.num_scalar_prefetch = 0
+        self.out_shape = _kw(call, "out_shape")
+        spec_call = self._grid_spec_call()
+        source = spec_call if spec_call is not None else call
+        self.grid = _kw(source, "grid")
+        if self.grid is not None:
+            self.grid = chain_value(chain, self.grid)
+        in_specs = _kw(source, "in_specs")
+        if in_specs is not None:
+            in_specs = chain_value(chain, in_specs)
+            if isinstance(in_specs, (ast.List, ast.Tuple)):
+                self.in_specs = list(in_specs.elts)
+        out_specs = _kw(source, "out_specs")
+        if out_specs is not None:
+            self.out_specs = chain_value(chain, out_specs)
+        nsp = _kw(source, "num_scalar_prefetch")
+        if isinstance(nsp, ast.Constant) and isinstance(nsp.value, int):
+            self.num_scalar_prefetch = nsp.value
+
+    def _grid_spec_call(self) -> Optional[ast.Call]:
+        spec = _kw(self.call, "grid_spec")
+        if spec is None:
+            return None
+        spec = chain_value(self.chain, spec)
+        if isinstance(spec, ast.Call) and \
+                call_name(spec).split(".")[-1] in _GRID_SPECS:
+            return spec
+        return None
+
+    @property
+    def grid_rank(self) -> Optional[int]:
+        return _tuple_len(self.grid) if self.grid is not None else None
+
+
+class PallasGridRule(Rule):
+    name = "shard-pallas-grid"
+    description = (
+        "pallas_call sites in ops/: index_map arity == grid rank, block "
+        "shapes match index_map/out_shape ranks, operand count matches "
+        "in_specs, and grid floor-divisions are divisibility-guarded"
+    )
+    scopes = ("ops/",)
+
+    def check(self, project: Project) -> Iterator[Violation]:
+        for src in project.in_scope(self.scopes):
+            for call, chain in iter_calls(src):
+                if call_name(call) not in _PALLAS_CALL:
+                    continue
+                site = _Site(src, call, chain)
+                yield from self._check_block_specs(site)
+                yield from self._check_out_shape(site)
+                yield from self._check_operand_count(site)
+                yield from self._check_divisibility(site)
+
+    # ----------------------------------------------------------------- #
+
+    def _iter_block_specs(self, site: _Site) -> Iterator[Tuple[ast.Call, str]]:
+        if site.in_specs:
+            for i, spec in enumerate(site.in_specs):
+                if isinstance(spec, ast.Call) and \
+                        call_name(spec).split(".")[-1] == _BLOCK_SPEC:
+                    yield spec, f"in_specs[{i}]"
+        out = site.out_specs
+        if isinstance(out, ast.Call) and \
+                call_name(out).split(".")[-1] == _BLOCK_SPEC:
+            yield out, "out_specs"
+
+    @staticmethod
+    def _spec_parts(spec: ast.Call) -> Tuple[Optional[ast.AST], Optional[ast.Lambda]]:
+        block = spec.args[0] if spec.args else _kw(spec, "block_shape")
+        imap = spec.args[1] if len(spec.args) > 1 else _kw(spec, "index_map")
+        return block, imap if isinstance(imap, ast.Lambda) else None
+
+    def _violation(self, site: _Site, line: int, msg: str) -> Violation:
+        return Violation(rule=self.name, path=site.src.rel, line=line, message=msg)
+
+    def _check_block_specs(self, site: _Site) -> Iterator[Violation]:
+        rank = site.grid_rank
+        for spec, label in self._iter_block_specs(site):
+            block, imap = self._spec_parts(spec)
+            if imap is None:
+                continue
+            n_explicit = len(imap.args.posonlyargs) + len(imap.args.args)
+            has_vararg = imap.args.vararg is not None
+            if rank is not None and n_explicit != rank:
+                yield self._violation(
+                    site, imap.lineno,
+                    f"{label}: index_map names {n_explicit} grid "
+                    f"parameter(s) but the grid has rank {rank} — each "
+                    "lambda must bind exactly one parameter per grid "
+                    "dimension (scalar-prefetch refs ride the vararg)",
+                )
+            elif site.num_scalar_prefetch > 0 and not has_vararg:
+                yield self._violation(
+                    site, imap.lineno,
+                    f"{label}: num_scalar_prefetch="
+                    f"{site.num_scalar_prefetch} appends scalar refs to the "
+                    "index_map arguments; add a `*_` vararg or the call "
+                    "fails at trace time",
+                )
+            block_rank = _tuple_len(block) if block is not None else None
+            ret_rank = _tuple_len(imap.body)
+            if block_rank is not None and ret_rank is not None \
+                    and block_rank != ret_rank:
+                yield self._violation(
+                    site, imap.lineno,
+                    f"{label}: block shape has rank {block_rank} but "
+                    f"index_map returns {ret_rank} coordinate(s)",
+                )
+
+    def _check_out_shape(self, site: _Site) -> Iterator[Violation]:
+        out = site.out_specs
+        if not (isinstance(out, ast.Call)
+                and call_name(out).split(".")[-1] == _BLOCK_SPEC):
+            return
+        block, _ = self._spec_parts(out)
+        block_rank = _tuple_len(block) if block is not None else None
+        shape = site.out_shape
+        if shape is not None:
+            shape = chain_value(site.chain, shape)
+        if not (isinstance(shape, ast.Call)
+                and call_name(shape).split(".")[-1] == _SHAPE_STRUCT
+                and shape.args):
+            return
+        out_rank = _tuple_len(shape.args[0])
+        if block_rank is not None and out_rank is not None \
+                and block_rank != out_rank:
+            yield self._violation(
+                site, site.call.lineno,
+                f"out_specs block shape has rank {block_rank} but out_shape "
+                f"is rank {out_rank}",
+            )
+
+    def _check_operand_count(self, site: _Site) -> Iterator[Violation]:
+        if site.in_specs is None:
+            return
+        expected = site.num_scalar_prefetch + len(site.in_specs)
+        invocation = self._find_invocation(site)
+        if invocation is None:
+            return
+        if any(isinstance(a, ast.Starred) for a in invocation.args) \
+                or invocation.keywords:
+            return
+        got = len(invocation.args)
+        if got != expected:
+            yield self._violation(
+                site, invocation.lineno,
+                f"pallas_call invoked with {got} operand(s) but "
+                f"num_scalar_prefetch ({site.num_scalar_prefetch}) + "
+                f"len(in_specs) ({len(site.in_specs)}) = {expected}",
+            )
+
+    def _find_invocation(self, site: _Site) -> Optional[ast.Call]:
+        """The Call applying this pallas_call's result: `pl.pallas_call(
+        ...)(ops...)` directly, or through one local name."""
+        scope = site.chain[-1] if site.chain else site.src.tree
+        bound: Optional[str] = None
+        for node in ast.walk(scope):
+            if isinstance(node, ast.Call) and node.func is site.call:
+                return node
+            if isinstance(node, ast.Assign) and node.value is site.call \
+                    and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                bound = node.targets[0].id
+        if bound is not None:
+            for node in ast.walk(scope):
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Name) \
+                        and node.func.id == bound:
+                    return node
+        return None
+
+    def _check_divisibility(self, site: _Site) -> Iterator[Violation]:
+        if not isinstance(site.grid, (ast.Tuple, ast.List)) or not site.chain:
+            return
+        guards = {
+            (ast.unparse(n.left), ast.unparse(n.right))
+            for n in self._guard_exprs(site.chain[-1])
+            if isinstance(n, ast.BinOp) and isinstance(n.op, ast.Mod)
+        }
+        for el in site.grid.elts:
+            div = chain_value(site.chain, el)
+            if not (isinstance(div, ast.BinOp) and isinstance(div.op, ast.FloorDiv)):
+                continue
+            key = (ast.unparse(div.left), ast.unparse(div.right))
+            if key not in guards:
+                yield self._violation(
+                    site, el.lineno,
+                    f"grid entry `{ast.unparse(div)}` floor-divides without "
+                    f"a `{key[0]} % {key[1]}` guard in the wrapper — the "
+                    "remainder rows of the last tile are silently dropped "
+                    "(use pl.cdiv, or assert divisibility)",
+                )
+
+    @staticmethod
+    def _guard_exprs(func: ast.AST) -> Iterator[ast.AST]:
+        """Expressions acting as divisibility guards: assert tests, if/
+        while tests (an `if x % y: raise` wrapper counts)."""
+        for node in ast.walk(func):
+            if isinstance(node, ast.Assert):
+                yield from ast.walk(node.test)
+            elif isinstance(node, (ast.If, ast.While)):
+                yield from ast.walk(node.test)

@@ -33,6 +33,7 @@ from ..ops.paged_attention import (
     prefill_attention,
     prefill_attention_batched,
 )
+from ..parallel.mesh import PP_AXIS, SP_AXIS
 
 
 @dataclass(frozen=True)
@@ -327,7 +328,7 @@ def prefill_forward_ring(
     page_table: jax.Array,  # [max_pages] this sequence's table
     real_len: jax.Array,  # scalar i32: tokens beyond this are padding
     mesh,
-    axis_name: str = "sp",
+    axis_name: str = SP_AXIS,
     mlp_fn=None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Sequence-parallel whole-prompt prefill: the token dim is sharded over
@@ -440,7 +441,7 @@ def decode_forward_pp(
 
     c = config
     mlp_fn = mlp_fn or _mlp
-    S = mesh.shape["pp"]
+    S = mesh.shape[PP_AXIS]
     B = tokens.shape[0]
     M = num_microbatches or min(S, B)
     while B % M:
@@ -538,7 +539,7 @@ def prefill_forward_pp(
 
     c = config
     mlp_fn = mlp_fn or _mlp
-    S = mesh.shape["pp"]
+    S = mesh.shape[PP_AXIS]
     T = tokens.shape[0]
     M = num_microbatches or S
     while T % M:

@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from ..parallel.mesh import EP_AXIS, SP_AXIS
 from . import llama
 from .llama import LlamaConfig, rms_norm
 from .quant import qeinsum
@@ -135,10 +136,10 @@ def _constrain_ep(x: jax.Array) -> jax.Array:
     dispatch/combine to an all-to-all. No-op when no mesh with an ``ep``
     axis is in context (single-chip, CPU tests)."""
     mesh = jax.sharding.get_abstract_mesh()
-    if mesh is None or "ep" not in mesh.axis_names:
+    if mesh is None or EP_AXIS not in mesh.axis_names:
         return x
     return jax.lax.with_sharding_constraint(
-        x, P("ep", *([None] * (x.ndim - 1)))
+        x, P(EP_AXIS, *([None] * (x.ndim - 1)))
     )
 
 
@@ -230,7 +231,7 @@ def prefill_forward_pp(params, config, tokens, kv_k, kv_v, page_table,
 
 
 def prefill_forward_ring(params, config, tokens, kv_k, kv_v, page_table,
-                         real_len, mesh, axis_name="sp"):
+                         real_len, mesh, axis_name=SP_AXIS):
     """Ring-attention whole-prompt prefill (sequence over sp), MoE MLP."""
     return llama.prefill_forward_ring(
         params, config, tokens, kv_k, kv_v, page_table, real_len, mesh,

@@ -21,6 +21,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..parallel.mesh import SP_AXIS, TP_AXIS
+
 NEG_INF = -1e30
 
 
@@ -89,7 +91,7 @@ def ring_attention(
     k: jax.Array,  # [T, KH, D]
     v: jax.Array,
     mesh: Mesh,
-    axis_name: str = "sp",
+    axis_name: str = SP_AXIS,
     causal: bool = True,
 ) -> jax.Array:
     """Exact (ring) attention with the sequence dim sharded over
@@ -104,10 +106,10 @@ def ring_attention(
     # ring composes with tensor parallelism (q arrives tp-sharded from the
     # projections; kv heads must split evenly for GQA grouping)
     head_axis = None
-    if "tp" in mesh.shape and mesh.shape["tp"] > 1:
-        tp = mesh.shape["tp"]
+    if TP_AXIS in mesh.shape and mesh.shape[TP_AXIS] > 1:
+        tp = mesh.shape[TP_AXIS]
         if q.shape[1] % tp == 0 and k.shape[1] % tp == 0:
-            head_axis = "tp"
+            head_axis = TP_AXIS
     spec = P(axis_name, head_axis, None)
     fn = jax.shard_map(
         partial(

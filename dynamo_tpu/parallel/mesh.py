@@ -21,6 +21,38 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+# --------------------------------------------------------------------- #
+# Mesh-axis registry — the single source of truth for axis names.
+#
+# Every collective (`psum`/`ppermute`/`all_gather`/`axis_index`), every
+# `PartitionSpec`, and every `mesh.shape[...]` lookup in the package must
+# reference one of these names; the `shard-axis-registry` dynolint rule
+# (dynamo_tpu/analysis/shard/) resolves axis arguments through call chains
+# and fails CI on anything not registered here. Modules import the
+# constants instead of repeating the string literals, so a typo is an
+# ImportError rather than a silent wrong-axis collective.
+# --------------------------------------------------------------------- #
+
+DP_AXIS = "dp"
+PP_AXIS = "pp"
+SP_AXIS = "sp"
+EP_AXIS = "ep"
+TP_AXIS = "tp"
+
+#: axis name -> role. Parsed (as AST, never imported) by the shard
+#: analysis pack; keep values one-line human-readable.
+KNOWN_AXES = {
+    DP_AXIS: "data-parallel replica axis",
+    PP_AXIS: "pipeline-stage axis (layers sharded across stages)",
+    SP_AXIS: "sequence-parallel (ring-attention) axis",
+    EP_AXIS: "expert-parallel axis for MoE dispatch",
+    TP_AXIS: "tensor-parallel axis (heads / MLP hidden / vocab)",
+}
+
+#: outer→inner device-grid order; tp innermost so its all-reduces ride
+#: the fastest ICI dimension (scaling-book layout recipe)
+MESH_AXIS_ORDER = (DP_AXIS, PP_AXIS, SP_AXIS, EP_AXIS, TP_AXIS)
+
 
 @dataclass(frozen=True)
 class ParallelConfig:
@@ -48,7 +80,7 @@ def build_mesh(parallel: ParallelConfig, devices=None) -> Mesh:
     grid = np.asarray(devices[:n]).reshape(
         p.dp_size, p.pp_size, p.sp_size, p.ep_size, p.tp_size
     )
-    return Mesh(grid, axis_names=("dp", "pp", "sp", "ep", "tp"))
+    return Mesh(grid, axis_names=MESH_AXIS_ORDER)
 
 
 @dataclass(frozen=True)
@@ -67,25 +99,25 @@ class LlamaShardings:
         """Layer axis: sharded over pp when pipeline stages are configured
         (parallel/pipeline.py reshapes [L, ...] -> [S, L/S, ...] in-program;
         a leading-'pp' layout on L is the same placement)."""
-        return "pp" if self.mesh.shape.get("pp", 1) > 1 else None
+        return PP_AXIS if self.mesh.shape.get(PP_AXIS, 1) > 1 else None
 
     def param_specs(self) -> dict:
         pp = self._pp
         return {
-            "embed": P(None, "tp"),  # hidden sharded
+            "embed": P(None, TP_AXIS),  # hidden sharded
             "layers": {
                 "attn_norm": P(pp),
-                "wq": P(pp, None, "tp"),  # [L, H, q_dim/tp]
-                "wk": P(pp, None, "tp"),
-                "wv": P(pp, None, "tp"),
-                "wo": P(pp, "tp", None),  # row-parallel
+                "wq": P(pp, None, TP_AXIS),  # [L, H, q_dim/tp]
+                "wk": P(pp, None, TP_AXIS),
+                "wv": P(pp, None, TP_AXIS),
+                "wo": P(pp, TP_AXIS, None),  # row-parallel
                 "mlp_norm": P(pp),
-                "w_gate": P(pp, None, "tp"),
-                "w_up": P(pp, None, "tp"),
-                "w_down": P(pp, "tp", None),
+                "w_gate": P(pp, None, TP_AXIS),
+                "w_up": P(pp, None, TP_AXIS),
+                "w_down": P(pp, TP_AXIS, None),
             },
             "final_norm": P(None),
-            "lm_head": P(None, "tp"),  # vocab sharded on output
+            "lm_head": P(None, TP_AXIS),  # vocab sharded on output
         }
 
     def param_shardings(self) -> dict:
@@ -98,7 +130,7 @@ class LlamaShardings:
     def kv_sharding(self) -> NamedSharding:
         # [layers, pages, page_size, kv_heads, head_dim]: kv heads over tp;
         # layers over pp when pipelining (each stage owns its layers' pool)
-        return NamedSharding(self.mesh, P(self._pp, None, None, "tp", None))
+        return NamedSharding(self.mesh, P(self._pp, None, None, TP_AXIS, None))
 
     def replicated(self) -> NamedSharding:
         return NamedSharding(self.mesh, P())
@@ -118,9 +150,9 @@ class MoeShardings(LlamaShardings):
         layers.update(
             {
                 "router": P(pp, None, None),  # [L, H, E]
-                "w_gate": P(pp, "ep", None, "tp"),  # [L, E, H, I/tp]
-                "w_up": P(pp, "ep", None, "tp"),
-                "w_down": P(pp, "ep", "tp", None),
+                "w_gate": P(pp, EP_AXIS, None, TP_AXIS),  # [L, E, H, I/tp]
+                "w_up": P(pp, EP_AXIS, None, TP_AXIS),
+                "w_down": P(pp, EP_AXIS, TP_AXIS, None),
             }
         )
         specs["layers"] = layers
@@ -140,7 +172,7 @@ class DpAttentionShardings(MoeShardings):
     dispatch keeps its all-to-all over the same axis."""
 
     def kv_sharding(self) -> NamedSharding:
-        return NamedSharding(self.mesh, P(self._pp, "ep", None, "tp", None))
+        return NamedSharding(self.mesh, P(self._pp, EP_AXIS, None, TP_AXIS, None))
 
 
 def shard_params(params: dict, shardings) -> dict:

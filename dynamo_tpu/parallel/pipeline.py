@@ -23,6 +23,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .mesh import PP_AXIS
+
 
 def stack_stages(params_layers: Any, num_stages: int) -> Any:
     """Re-stack a layer-stacked param pytree [L, ...] into [S, L/S, ...] so
@@ -66,6 +68,7 @@ def _pipeline_local(
         out_buf = jax.lax.dynamic_update_index_in_dim(
             out_buf, jnp.where(take, out, slot), done_idx, 0
         )
+        # dynolint: disable=shard-collective-symmetry -- GPipe forward edge: the last stage deliberately sends to nobody (stage i -> i+1 only)
         recv = jax.lax.ppermute(out, axis_name, fwd) if fwd else out
         return (recv, out_buf), None
 
@@ -119,6 +122,7 @@ def _pipeline_local_stateful(
         out_buf = jax.lax.dynamic_update_index_in_dim(
             out_buf, jnp.where(take, out, slot), done_idx, 0
         )
+        # dynolint: disable=shard-collective-symmetry -- GPipe forward edge: the last stage deliberately sends to nobody (stage i -> i+1 only)
         recv = jax.lax.ppermute(out, axis_name, fwd) if fwd else out
         return (recv, out_buf, st), None
 
@@ -141,7 +145,7 @@ def pipeline_apply_stateful(
     stage_fn: Callable,  # (local_params, local_state, x, aux, valid) ->
     # (x, local_state)
     mesh: Mesh,
-    axis_name: str = "pp",
+    axis_name: str = PP_AXIS,
 ):
     """GPipe schedule that also threads PER-STAGE STATE through the ticks —
     the piece a paged-KV engine needs: each stage owns the KV pool of ITS
@@ -179,7 +183,7 @@ def pipeline_apply(
     x_mb: jax.Array,  # [M, mb, ...] microbatched input
     stage_fn: Callable[[Any, jax.Array], jax.Array],
     mesh: Mesh,
-    axis_name: str = "pp",
+    axis_name: str = PP_AXIS,
 ) -> jax.Array:
     """Run M microbatches through S pipeline stages; returns [M, mb, ...]
     outputs (replicated over pp)."""

@@ -1,35 +1,29 @@
-"""Guided decoding: regex/schema→DFA→token-FSM units, preprocessor 400s,
-and end-to-end engine enforcement (CPU, tiny model).
+"""Guided decoding: regex/schema→DFA→token-FSM units and preprocessor 400s.
 
 Reference surface: nvext guided_choice/guided_regex/guided_json
 (lib/llm/src/protocols/openai/nvext.rs:73-88) + OpenAI response_format.
 The engine must produce constraint-valid output UNDER SAMPLING (not just
-greedy), and unguided traffic sharing the batch must be unaffected.
+greedy), and unguided traffic sharing the batch must be unaffected — those
+end-to-end tests live in tests/test_guided_engine.py and run in a FRESH
+INTERPRETER via the subprocess wrapper at the bottom of this file, so the
+intermittent full-suite-only XLA CPU segfault they trigger (CHANGES.md)
+fails one wrapper test instead of taking down the whole tier-1 run.
 """
 
-import asyncio
 import json
+import os
+import subprocess
+import sys
+from pathlib import Path
 
-import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from dynamo_tpu.engine import EngineConfig, JaxEngine
 from dynamo_tpu.llm import guided as g
-from dynamo_tpu.llm.protocols import PreprocessedRequest
 from dynamo_tpu.llm.protocols.openai import ChatCompletionRequest, NvExt
 from dynamo_tpu.llm.tokenizers import ByteTokenizer
-from dynamo_tpu.models import llama
-from dynamo_tpu.runtime.engine import Context
 
-CFG = llama.LlamaConfig.tiny(dtype=jnp.float32)
-PAGE = 8
-
-
-@pytest.fixture(scope="module")
-def params():
-    return llama.init_params(CFG, jax.random.PRNGKey(0))
+REPO = Path(__file__).resolve().parents[1]
 
 
 # --------------------------------------------------------------------- #
@@ -322,140 +316,27 @@ def test_preprocessor_rejects_unsupported_knobs():
 
 
 # --------------------------------------------------------------------- #
-# engine enforcement (CPU, tiny model, REAL sampling)
+# engine enforcement: isolated in a subprocess (native-crash containment)
 # --------------------------------------------------------------------- #
 
 
-def _engine(params, **kw):
-    cfg = EngineConfig(
-        model="tiny",
-        max_num_seqs=4,
-        page_size=PAGE,
-        num_pages=64,
-        max_model_len=256,
-        prefill_buckets=(16, 32),
-        max_prefill_chunk=32,
-        **kw,
+def test_engine_tests_pass_in_subprocess():
+    """Run tests/test_guided_engine.py in a fresh interpreter. The engine
+    tests intermittently segfault XLA CPU when sharing a process with the
+    full suite; isolation turns a native crash into ONE red test here
+    (with the child's output attached) instead of a dead pytest run."""
+    env = dict(os.environ, DYN_GUIDED_ENGINE_DIRECT="1")
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "-q", "-p", "no:cacheprovider",
+         "tests/test_guided_engine.py"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600,
     )
-    return JaxEngine(cfg, model_config=CFG, params=params)
-
-
-async def _collect(eng, req):
-    toks, finish = [], None
-    async for item in eng.generate(req, Context()):
-        data = item.get("data")
-        if data:
-            toks.extend(data["token_ids"])
-            finish = data.get("finish_reason") or finish
-        if item.get("event") == "error":
-            return None, " ".join(item.get("comment") or [])
-    return toks, finish
-
-
-def test_engine_guided_choice_under_sampling(params):
-    async def main():
-        eng = _engine(params)
-        tok = ByteTokenizer(CFG.vocab_size)
-        outs = []
-        for seed in range(3):
-            req = PreprocessedRequest(
-                token_ids=[5, 9, 17, 33],
-                stop_conditions={"max_tokens": 32},
-                sampling_options={"temperature": 1.0, "seed": seed},
-                eos_token_ids=[ByteTokenizer.EOS],
-                guided={"kind": "choice",
-                        "choices": ["yes", "no", "maybe"]},
-                request_id=f"gc{seed}",
-            ).to_dict()
-            toks, finish = await _collect(eng, req)
-            assert toks is not None, finish
-            text = tok.decode(toks)
-            assert text in ("yes", "no", "maybe"), repr(text)
-            assert finish == "eos"
-            outs.append(text)
-        await eng.close()
-        return outs
-
-    asyncio.run(main())
-
-
-def test_engine_guided_json_schema_under_sampling(params):
-    async def main():
-        eng = _engine(params)
-        tok = ByteTokenizer(CFG.vocab_size)
-        req = PreprocessedRequest(
-            token_ids=[11, 4, 200],
-            stop_conditions={"max_tokens": 120},
-            sampling_options={"temperature": 1.0},
-            eos_token_ids=[ByteTokenizer.EOS],
-            guided={"kind": "json_schema", "schema": {
-                "type": "object", "properties": {
-                    "ok": {"type": "boolean"},
-                    "col": {"enum": ["red", "green"]},
-                },
-            }},
-            request_id="gj",
-        ).to_dict()
-        toks, finish = await _collect(eng, req)
-        assert toks is not None, finish
-        text = tok.decode(toks)
-        assert finish == "eos", (finish, text)
-        obj = json.loads(text)
-        assert set(obj) == {"ok", "col"}
-        assert isinstance(obj["ok"], bool) and obj["col"] in ("red", "green")
-        await eng.close()
-
-    asyncio.run(main())
-
-
-def test_engine_guided_and_unguided_coexist(params):
-    """A guided lane must not perturb a concurrent unguided GREEDY lane:
-    its tokens must equal the engine's unguided-only greedy output."""
-
-    async def run(with_guided):
-        eng = _engine(params)
-        prompt = [5, 9, 17, 33, 101, 7, 250, 3]
-        greedy = PreprocessedRequest(
-            token_ids=prompt,
-            stop_conditions={"max_tokens": 8, "ignore_eos": True},
-            request_id="plain",
-        ).to_dict()
-        tasks = [_collect(eng, greedy)]
-        if with_guided:
-            tasks.append(_collect(eng, PreprocessedRequest(
-                token_ids=[8, 8, 8],
-                stop_conditions={"max_tokens": 24},
-                sampling_options={"temperature": 1.0},
-                eos_token_ids=[ByteTokenizer.EOS],
-                guided={"kind": "choice", "choices": ["yes", "no"]},
-                request_id="g",
-            ).to_dict()))
-        results = await asyncio.gather(*tasks)
-        await eng.close()
-        return results
-
-    async def main():
-        (plain_only,) = await run(False)
-        both = await run(True)
-        assert both[0][0] == plain_only[0], "guided lane perturbed greedy lane"
-        tok = ByteTokenizer(CFG.vocab_size)
-        assert tok.decode(both[1][0]) in ("yes", "no")
-
-    asyncio.run(main())
-
-
-def test_engine_rejects_guided_on_spec_mode(params):
-    async def main():
-        eng = _engine(params, spec_mode="ngram")
-        req = PreprocessedRequest(
-            token_ids=[5, 9],
-            stop_conditions={"max_tokens": 8},
-            eos_token_ids=[ByteTokenizer.EOS],
-            guided={"kind": "regex", "regex": "a+"},
-            request_id="gs",
-        ).to_dict()
-        toks, err = await _collect(eng, req)
-        assert toks is None and "speculative" in err
-        await eng.close()
-
-    asyncio.run(main())
+    assert proc.returncode == 0, (
+        f"guided engine subprocess group failed (rc={proc.returncode}):\n"
+        f"{proc.stdout}\n{proc.stderr}"
+    )
+    # an all-skipped child also exits 0 — if the env-var handoff breaks,
+    # the engine coverage must not silently evaporate behind a green wrapper
+    assert "passed" in proc.stdout and "skipped" not in proc.stdout, (
+        f"engine tests did not actually run in the child:\n{proc.stdout}"
+    )

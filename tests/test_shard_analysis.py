@@ -1,0 +1,513 @@
+"""dynoshard (analysis/shard/) fixture tests.
+
+Mirrors tests/test_static_analysis.py: every rule gets a shape it FIRES
+on, a shape it stays QUIET on, and a suppression check — plus seeded-bug
+reconstructions for the acceptance criteria: an axis-name typo in a
+pipeline collective, a non-total ppermute permutation, and an
+index_map/grid arity mismatch must each produce EXACTLY ONE violation.
+
+The tree-clean gate for the shard pack rides the existing
+tests/test_static_analysis.py::test_tree_is_clean (default_rules() now
+includes the pack).
+"""
+
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+from dynamo_tpu.analysis import Project, run
+from dynamo_tpu.analysis.shard import (
+    AxisRegistryRule,
+    CollectiveSymmetryRule,
+    PallasGridRule,
+)
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def make_project(tmp_path: Path, files: dict) -> Project:
+    for rel, text in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(text))
+    return Project.load(tmp_path)
+
+
+def rule_hits(project: Project, rule) -> list:
+    return run(project, [rule])
+
+
+# the registry every fixture tree shares (axis constants + KNOWN_AXES,
+# same shape as the real parallel/mesh.py)
+_MESH_FIXTURE = """
+    PP_AXIS = "pp"
+    SP_AXIS = "sp"
+
+    KNOWN_AXES = {
+        PP_AXIS: "pipeline-stage axis",
+        SP_AXIS: "sequence axis",
+        "tp": "tensor axis",
+    }
+"""
+
+
+# --------------------------------------------------------------------- #
+# shard-axis-registry
+# --------------------------------------------------------------------- #
+
+
+def test_axis_registry_quiet_on_registered_axes_through_chain(tmp_path):
+    """Registered axes survive default-param + keyword-forwarding +
+    partial-application resolution without a finding."""
+    project = make_project(tmp_path, {
+        "dynamo_tpu/parallel/mesh.py": _MESH_FIXTURE,
+        "dynamo_tpu/parallel/sched.py": """
+            from functools import partial
+
+            import jax
+            from jax.sharding import PartitionSpec as P
+
+            from .mesh import PP_AXIS
+
+            def _local(x, *, axis_name):
+                rank = jax.lax.axis_index(axis_name)
+                return jax.lax.psum(x, axis_name) + rank
+
+            def apply(x, mesh, axis_name=PP_AXIS):
+                spec = P(axis_name, None)
+                fn = jax.shard_map(
+                    partial(_local, axis_name=axis_name),
+                    mesh=mesh, in_specs=(spec,), out_specs=spec,
+                )
+                return fn(x)
+
+            def caller(x, mesh):
+                return apply(x, mesh, axis_name="sp")
+        """,
+    })
+    assert rule_hits(project, AxisRegistryRule()) == []
+
+
+def test_axis_registry_typo_in_pipeline_collective_is_one_violation(tmp_path):
+    """Seeded-bug reconstruction: the pp typo'd to 'qp' in a pipeline
+    psum. Exactly one violation, anchored at the literal."""
+    project = make_project(tmp_path, {
+        "dynamo_tpu/parallel/mesh.py": _MESH_FIXTURE,
+        "dynamo_tpu/parallel/pipe.py": """
+            import jax
+            import jax.numpy as jnp
+
+            def _pipeline_local(x, num_stages, axis_name="qp"):
+                rank = jax.lax.axis_index(axis_name)
+                mask = (rank == num_stages - 1).astype(x.dtype)
+                return jax.lax.psum(x * mask, axis_name)
+        """,
+    })
+    hits = rule_hits(project, AxisRegistryRule())
+    assert len(hits) == 1
+    assert "qp" in hits[0].message
+    assert hits[0].path == "dynamo_tpu/parallel/pipe.py"
+
+
+def test_axis_registry_resolves_keyword_forwarding_to_caller_literal(tmp_path):
+    """A typo at the CALLER flows through forwarding into the collective;
+    the violation anchors at the caller's literal, not the collective."""
+    project = make_project(tmp_path, {
+        "dynamo_tpu/parallel/mesh.py": _MESH_FIXTURE,
+        "dynamo_tpu/ops/ring.py": """
+            import jax
+
+            def ring(x, axis_name="sp"):
+                return jax.lax.ppermute(
+                    x, axis_name, [(0, 1), (1, 0)]
+                )
+        """,
+        "dynamo_tpu/models/model.py": """
+            from ..ops.ring import ring
+
+            def fwd(x, axis_name="sq"):
+                return ring(x, axis_name=axis_name)
+        """,
+    })
+    hits = rule_hits(project, AxisRegistryRule())
+    assert len(hits) == 1
+    assert hits[0].path == "dynamo_tpu/models/model.py"
+    assert "sq" in hits[0].message
+
+
+def test_axis_registry_flags_partition_spec_and_mesh_shape_keys(tmp_path):
+    project = make_project(tmp_path, {
+        "dynamo_tpu/parallel/mesh.py": _MESH_FIXTURE,
+        "dynamo_tpu/models/shards.py": """
+            from jax.sharding import PartitionSpec as P
+
+            def specs(mesh):
+                good = P("pp", None, "tp")
+                bad = P("xp", None)
+                stages = mesh.shape["pq"]
+                ok = mesh.shape["pp"]
+                return good, bad, stages, ok
+        """,
+    })
+    hits = rule_hits(project, AxisRegistryRule())
+    flagged = {m.split("'")[1] for m in (v.message for v in hits)}
+    assert flagged == {"xp", "pq"}
+
+
+def test_axis_registry_ignores_plain_dict_subscripts_and_unresolvable(tmp_path):
+    project = make_project(tmp_path, {
+        "dynamo_tpu/parallel/mesh.py": _MESH_FIXTURE,
+        "dynamo_tpu/models/clean.py": """
+            import jax
+
+            def fwd(aux, mesh, name):
+                positions = aux["positions"]      # dict key, not an axis
+                x = jax.lax.psum(positions, name)  # unresolvable: quiet
+                return x
+        """,
+    })
+    assert rule_hits(project, AxisRegistryRule()) == []
+
+
+def test_axis_registry_requires_known_axes_table(tmp_path):
+    project = make_project(tmp_path, {
+        "dynamo_tpu/parallel/mesh.py": "X = 1\n",
+    })
+    hits = rule_hits(project, AxisRegistryRule())
+    assert len(hits) == 1
+    assert "KNOWN_AXES" in hits[0].message
+
+
+def test_axis_registry_suppression_at_literal_site(tmp_path):
+    project = make_project(tmp_path, {
+        "dynamo_tpu/parallel/mesh.py": _MESH_FIXTURE,
+        "dynamo_tpu/parallel/experimental.py": """
+            import jax
+
+            def fwd(x):
+                return jax.lax.psum(x, "fsdp")  # dynolint: disable=shard-axis-registry -- staging a new axis ahead of registry entry
+        """,
+    })
+    assert rule_hits(project, AxisRegistryRule()) == []
+
+
+# --------------------------------------------------------------------- #
+# shard-pallas-grid
+# --------------------------------------------------------------------- #
+
+_GOOD_PALLAS = """
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def _kernel(pt_ref, q_ref, kv_hbm, out_ref):
+        out_ref[0] = q_ref[0]
+
+    def wrapper(q, kv, page_tables):
+        B, H, D = q.shape
+        T = H * D
+        tile = min(128, T)
+        assert T % tile == 0, "bucket must tile"
+        num_tiles = T // tile
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(B, num_tiles),
+            in_specs=[
+                pl.BlockSpec((1, H, D), lambda b, t, *_: (b, 0, 0)),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=pl.BlockSpec((1, H, D), lambda b, t, *_: (b, 0, 0)),
+        )
+        return pl.pallas_call(
+            _kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
+        )(page_tables, q, kv)
+"""
+
+
+def test_pallas_grid_quiet_on_consistent_site(tmp_path):
+    project = make_project(tmp_path, {
+        "dynamo_tpu/ops/kernel.py": _GOOD_PALLAS,
+    })
+    assert rule_hits(project, PallasGridRule()) == []
+
+
+def test_pallas_grid_index_map_arity_mismatch_is_one_violation(tmp_path):
+    """Seeded-bug reconstruction: index_map drops a grid parameter —
+    under scalar prefetch the next operand silently becomes a grid
+    index. Exactly one violation."""
+    bad = _GOOD_PALLAS.replace(
+        "pl.BlockSpec((1, H, D), lambda b, t, *_: (b, 0, 0)),\n"
+        "                pl.BlockSpec(memory_space=pl.ANY),",
+        "pl.BlockSpec((1, H, D), lambda b, *_: (b, 0, 0)),\n"
+        "                pl.BlockSpec(memory_space=pl.ANY),",
+    )
+    assert bad != _GOOD_PALLAS
+    project = make_project(tmp_path, {"dynamo_tpu/ops/kernel.py": bad})
+    hits = rule_hits(project, PallasGridRule())
+    assert len(hits) == 1
+    assert "rank 2" in hits[0].message and "index_map" in hits[0].message
+
+
+def test_pallas_grid_flags_missing_vararg_under_scalar_prefetch(tmp_path):
+    bad = _GOOD_PALLAS.replace(
+        "out_specs=pl.BlockSpec((1, H, D), lambda b, t, *_: (b, 0, 0)),",
+        "out_specs=pl.BlockSpec((1, H, D), lambda b, t: (b, 0, 0)),",
+    )
+    assert bad != _GOOD_PALLAS
+    project = make_project(tmp_path, {"dynamo_tpu/ops/kernel.py": bad})
+    hits = rule_hits(project, PallasGridRule())
+    assert len(hits) == 1
+    assert "num_scalar_prefetch" in hits[0].message
+
+
+def test_pallas_grid_flags_block_shape_vs_index_map_rank(tmp_path):
+    bad = _GOOD_PALLAS.replace(
+        "pl.BlockSpec((1, H, D), lambda b, t, *_: (b, 0, 0)),\n"
+        "                pl.BlockSpec(memory_space=pl.ANY),",
+        "pl.BlockSpec((1, H, D), lambda b, t, *_: (b, 0)),\n"
+        "                pl.BlockSpec(memory_space=pl.ANY),",
+    )
+    project = make_project(tmp_path, {"dynamo_tpu/ops/kernel.py": bad})
+    hits = rule_hits(project, PallasGridRule())
+    assert len(hits) == 1
+    assert "block shape has rank 3" in hits[0].message
+
+
+def test_pallas_grid_flags_operand_count_mismatch(tmp_path):
+    bad = _GOOD_PALLAS.replace(
+        ")(page_tables, q, kv)", ")(page_tables, q)"
+    )
+    project = make_project(tmp_path, {"dynamo_tpu/ops/kernel.py": bad})
+    hits = rule_hits(project, PallasGridRule())
+    assert len(hits) == 1
+    assert "operand" in hits[0].message
+
+
+def test_pallas_grid_flags_unguarded_grid_floordiv(tmp_path):
+    bad = _GOOD_PALLAS.replace(
+        '        assert T % tile == 0, "bucket must tile"\n', ""
+    )
+    project = make_project(tmp_path, {"dynamo_tpu/ops/kernel.py": bad})
+    hits = rule_hits(project, PallasGridRule())
+    assert len(hits) == 1
+    assert "floor-divides" in hits[0].message
+
+
+def test_pallas_grid_out_shape_rank_mismatch_and_suppression(tmp_path):
+    bad = _GOOD_PALLAS.replace(
+        "out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),",
+        "out_shape=jax.ShapeDtypeStruct((B, H * D), q.dtype),",
+    )
+    project = make_project(tmp_path, {"dynamo_tpu/ops/kernel.py": bad})
+    hits = rule_hits(project, PallasGridRule())
+    assert len(hits) == 1
+    assert "out_shape" in hits[0].message
+    waived = bad.replace(
+        "return pl.pallas_call(",
+        "# dynolint: disable=shard-pallas-grid -- transitional shape\n"
+        "        return pl.pallas_call(",
+    )
+    project = make_project(tmp_path / "w", {"dynamo_tpu/ops/kernel.py": waived})
+    assert rule_hits(project, PallasGridRule()) == []
+
+
+def test_pallas_grid_only_audits_ops(tmp_path):
+    project = make_project(tmp_path, {
+        "dynamo_tpu/engine/kernel.py": _GOOD_PALLAS.replace(
+            "lambda b, t, *_: (b, 0, 0)", "lambda b: (b, 0, 0)"
+        ),
+    })
+    assert rule_hits(project, PallasGridRule()) == []
+
+
+# --------------------------------------------------------------------- #
+# shard-collective-symmetry
+# --------------------------------------------------------------------- #
+
+
+def test_collective_symmetry_quiet_on_total_ring_and_pre_masked_psum(tmp_path):
+    project = make_project(tmp_path, {
+        "dynamo_tpu/ops/ring.py": """
+            import jax
+
+            def _local(k_blk, x, mask, num_chunks, axis_name="sp"):
+                perm = [(i, (i + 1) % num_chunks) for i in range(num_chunks)]
+
+                def step(i, blk):
+                    return jax.lax.ppermute(blk, axis_name, perm)
+
+                out = jax.lax.fori_loop(0, num_chunks, step, k_blk)
+                return jax.lax.psum(out * mask, axis_name)
+        """,
+    })
+    assert rule_hits(project, CollectiveSymmetryRule()) == []
+
+
+def test_collective_symmetry_non_total_permutation_is_one_violation(tmp_path):
+    """Seeded-bug reconstruction: a forward-only schedule without a
+    waiver. Exactly one violation."""
+    project = make_project(tmp_path, {
+        "dynamo_tpu/parallel/pipe.py": """
+            import jax
+
+            def _local(x, num_stages, axis_name="pp"):
+                fwd = [(i, i + 1) for i in range(num_stages - 1)]
+
+                def tick(carry, t):
+                    return jax.lax.ppermute(carry, axis_name, fwd), None
+
+                out, _ = jax.lax.scan(tick, x, None, length=4)
+                return out
+        """,
+    })
+    hits = rule_hits(project, CollectiveSymmetryRule())
+    assert len(hits) == 1
+    assert "not total" in hits[0].message
+
+
+def test_collective_symmetry_flags_mask_after_reduction(tmp_path):
+    project = make_project(tmp_path, {
+        "dynamo_tpu/parallel/pipe.py": """
+            import jax
+
+            def broadcast_last(out_buf, mask, axis_name="pp"):
+                return jax.lax.psum(out_buf, axis_name) * mask
+        """,
+    })
+    hits = rule_hits(project, CollectiveSymmetryRule())
+    assert len(hits) == 1
+    assert "AFTER" in hits[0].message
+
+
+def test_collective_symmetry_flags_duplicate_literal_sources(tmp_path):
+    project = make_project(tmp_path, {
+        "dynamo_tpu/parallel/wire.py": """
+            import jax
+
+            def shuffle(x, axis_name="pp"):
+                return jax.lax.ppermute(x, axis_name, [(0, 1), (0, 2)])
+        """,
+    })
+    hits = rule_hits(project, CollectiveSymmetryRule())
+    assert len(hits) == 1
+    assert "duplicate" in hits[0].message
+
+
+def test_collective_symmetry_suppression_with_reason(tmp_path):
+    project = make_project(tmp_path, {
+        "dynamo_tpu/parallel/pipe.py": """
+            import jax
+
+            def _local(x, num_stages, axis_name="pp"):
+                fwd = [(i, i + 1) for i in range(num_stages - 1)]
+                # dynolint: disable=shard-collective-symmetry -- forward edge open by design
+                return jax.lax.ppermute(x, axis_name, fwd)
+        """,
+    })
+    assert rule_hits(project, CollectiveSymmetryRule()) == []
+
+
+# --------------------------------------------------------------------- #
+# the real tree's intentional waivers stay load-bearing
+# --------------------------------------------------------------------- #
+
+
+def test_real_pipeline_forward_edge_is_waived_not_invisible():
+    """parallel/pipeline.py's open forward edge must be VISIBLE to the
+    raw rule (else the waiver comments are dead weight) and suppressed in
+    the gated run."""
+    project = Project.load(REPO)
+    raw = list(CollectiveSymmetryRule().check(project))
+    pipeline_hits = [
+        v for v in raw if v.path == "dynamo_tpu/parallel/pipeline.py"
+    ]
+    assert len(pipeline_hits) == 2, pipeline_hits
+    assert rule_hits(project, CollectiveSymmetryRule()) == []
+
+
+def test_real_tree_axis_resolution_reaches_ring_collectives():
+    """The interprocedural chain moe/llama -> ring_attention ->
+    _ring_attention_local resolves the ppermute axis to a registered
+    name (guards against the resolver silently going blind — an empty
+    resolution would also produce zero violations)."""
+    import ast
+
+    from dynamo_tpu.analysis.shard.callgraph import FunctionIndex
+
+    project = Project.load(REPO)
+    index = FunctionIndex(project)
+    info = index.functions["_ring_attention_local"][0]
+    perm_axes = set()
+    for node in ast.walk(info.node):
+        if isinstance(node, ast.Call) and getattr(node.func, "attr", "") == "ppermute":
+            res = index.resolve_strings(info.src, (info.node,), node.args[1])
+            perm_axes |= {r.value for r in res.values}
+    assert perm_axes == {"sp"}
+
+
+# --------------------------------------------------------------------- #
+# CLI: --changed-only
+# --------------------------------------------------------------------- #
+
+
+def _git(cwd, *args):
+    subprocess.run(
+        ["git", "-c", "user.email=t@t", "-c", "user.name=t", *args],
+        cwd=cwd, check=True, capture_output=True,
+    )
+
+
+def test_changed_only_scopes_report_to_diffed_files(tmp_path):
+    files = {
+        "dynamo_tpu/parallel/mesh.py": _MESH_FIXTURE,
+        "dynamo_tpu/models/bad.py": """
+            import jax
+
+            def fwd(x):
+                return jax.lax.psum(x, "zz")
+        """,
+        "dynamo_tpu/models/clean.py": "X = 1\n",
+    }
+    for rel, text in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(text))
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "add", ".")
+    _git(tmp_path, "commit", "-q", "-m", "seed")
+
+    cli = [sys.executable, "-m", "dynamo_tpu.analysis", "--root", str(tmp_path)]
+
+    # full run sees bad.py
+    proc = subprocess.run(cli, cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1 and "zz" in proc.stdout
+
+    # nothing changed: fast exit 0 without linting
+    proc = subprocess.run(
+        cli + ["--changed-only"], cwd=REPO,
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0 and "nothing to lint" in proc.stdout
+
+    # touching only the clean file filters the pre-existing violation
+    (tmp_path / "dynamo_tpu/models/clean.py").write_text("X = 2\n")
+    proc = subprocess.run(
+        cli + ["--changed-only"], cwd=REPO,
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0 and "clean" in proc.stdout
+
+    # touching the bad file reports it
+    bad = tmp_path / "dynamo_tpu/models/bad.py"
+    bad.write_text(bad.read_text() + "\n")
+    proc = subprocess.run(
+        cli + ["--changed-only"], cwd=REPO,
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 1 and "zz" in proc.stdout
