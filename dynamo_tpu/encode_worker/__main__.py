@@ -84,6 +84,7 @@ async def main():
     )
     await endpoint.serve_endpoint(handler)
     await drt.wait_for_shutdown()
+    await drt.close()  # graceful drain (runtime/component.py close())
 
 
 if __name__ == "__main__":

@@ -45,6 +45,7 @@ from ..llm.mocker.kv_manager import KvEvent
 from ..llm.protocols import Annotated, LLMEngineOutput, PreprocessedRequest
 from ..llm.tokens import TokenBlockSequence, compute_seq_hashes, salt_hash
 from ..models import llama
+from ..runtime import faults
 from ..runtime.engine import Context
 from .config import EngineConfig
 from .kv_cache import PageAllocator, alloc_kv_arrays
@@ -1420,6 +1421,11 @@ class JaxEngine:
                 await self._wake.wait()
                 continue
             try:
+                f = faults.FAULTS
+                if f.enabled:
+                    # dynochaos `engine.step`: a raised FaultError rides the
+                    # organic step-failure path below (fail-all -> migration)
+                    await f.on("engine.step")
                 progressed = await self._step_once()
             except Exception as e:  # noqa: BLE001 — engine loop must not die silently
                 logger.exception("engine step failed; failing active requests")

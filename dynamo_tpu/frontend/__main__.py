@@ -92,6 +92,7 @@ async def main():
         await grpc_service.start()
     logger.info("frontend ready on :%d (router=%s)", service.port, router_mode.value)
     await drt.wait_for_shutdown()
+    await drt.close()  # graceful drain (runtime/component.py close())
 
 
 if __name__ == "__main__":

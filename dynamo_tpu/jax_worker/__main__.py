@@ -526,6 +526,10 @@ async def main():
     )
     await endpoint.serve_endpoint(handler)
     await drt.wait_for_shutdown()
+    # graceful drain: lease revoked first (routers stop picking us), then
+    # in-flight streams finish within DYN_RUNTIME_GRACEFUL_SHUTDOWN_TIMEOUT,
+    # then survivors are force-cancelled (runtime/component.py close())
+    await drt.close()
 
 
 if __name__ == "__main__":

@@ -42,6 +42,8 @@ from typing import Any, Awaitable, Callable, Dict, Optional, Sequence, Tuple
 import msgpack
 import numpy as np
 
+from ..runtime import faults
+
 logger = logging.getLogger(__name__)
 
 _MAGIC = 0xD7A04B1D  # frame magic (full-stream pull handshake)
@@ -421,6 +423,12 @@ class KvDataPlaneServer:
         nxt = asyncio.ensure_future(get(0)) if desc.n_pages else None
         while nxt is not None:
             off, n, k, v = await nxt
+            f = faults.FAULTS
+            if f.enabled and await f.on("kv_transfer.chunk") == "sever":
+                # partial transfer: abort mid-stream so the peer sees a
+                # broken pull (same surface as the reaped-deadline path)
+                # and falls back to local prefill / retries
+                raise RuntimeError("injected: kv transfer severed mid-stream")
             if staged.finished:
                 # the reaper unstaged us (deadline hit) and the pages may
                 # already be reused: abort mid-stream so the peer sees a

@@ -11,9 +11,10 @@ from __future__ import annotations
 import logging
 from typing import Any, AsyncIterator, Optional
 
+from ..runtime.backoff import Backoff
 from ..runtime.engine import AsyncEngine, Context
 from ..runtime.pipeline import Operator
-from ..runtime.request_plane import StreamLost
+from ..runtime.request_plane import DeadlineExceeded, StreamLost
 from .protocols import Annotated, LLMEngineOutput, PreprocessedRequest
 
 logger = logging.getLogger(__name__)
@@ -50,6 +51,11 @@ class RetryManager:
         self.request = request
         self.retries_left = limit
         self.emitted_tokens: list[int] = []
+        # deterministic jitter, seeded per request: a fleet of retrying
+        # streams spreads out, yet a chaos-test re-run reproduces exactly
+        self.backoff = Backoff.seeded(
+            request.request_id or "", base=0.02, max_delay=0.5
+        )
 
     def _retry_request(self) -> PreprocessedRequest:
         req = PreprocessedRequest.from_dict(self.request.to_dict())
@@ -76,12 +82,24 @@ class RetryManager:
                         self.emitted_tokens.extend(data.get("token_ids", []))
                     yield ann
                 return
+            except DeadlineExceeded as e:
+                yield Annotated.from_error(f"deadline exceeded: {e}")
+                return
             except StreamLost as e:
                 if context.is_stopped() or context.is_killed():
                     return
                 if self.retries_left <= 0:
                     logger.error("stream lost and migration budget exhausted: %s", e)
                     yield Annotated.from_error(f"stream lost, migration exhausted: {e}")
+                    return
+                if context.deadline_exceeded():
+                    # retrying past the request budget only burns a worker
+                    # slot the caller already gave up on — surface a clean
+                    # terminal error instead
+                    logger.error("stream lost past request deadline: %s", e)
+                    yield Annotated.from_error(
+                        f"stream lost and request deadline exceeded: {e}"
+                    )
                     return
                 self.retries_left -= 1
                 request = self._retry_request()
@@ -91,3 +109,8 @@ class RetryManager:
                     len(self.emitted_tokens),
                     self.retries_left,
                 )
+                if not await self.backoff.wait(context.deadline):
+                    yield Annotated.from_error(
+                        "stream lost and request deadline exceeded during backoff"
+                    )
+                    return

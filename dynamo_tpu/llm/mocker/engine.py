@@ -18,6 +18,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, AsyncIterator, Callable, Dict, List, Optional
 
+from ...runtime import faults
 from ...runtime.engine import Context
 from ..protocols import Annotated, LLMEngineOutput, PreprocessedRequest
 from ..tokens import DEFAULT_BLOCK_SIZE, TokenBlockSequence, compute_seq_hashes
@@ -158,8 +159,21 @@ class MockEngine:
                 await self._wake.wait()
                 continue
             t_step0 = time.monotonic()
-            prefill_tokens = self._do_admission_and_prefill()
-            decoded = self._do_decode()
+            try:
+                f = faults.FAULTS
+                if f.enabled:
+                    # dynochaos `mocker.step`: rides the same fail-all path
+                    # a real scheduler bug would take
+                    await f.on("mocker.step")
+                prefill_tokens = self._do_admission_and_prefill()
+                decoded = self._do_decode()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — step loop must not die silently
+                logger.exception("mock engine step failed; failing active requests")
+                self._fail_all(f"mock engine step failed: {type(e).__name__}: {e}")
+                await asyncio.sleep(0.05)
+                continue
             # synthetic step latency
             a = self.args
             step_time = (
@@ -262,6 +276,22 @@ class MockEngine:
         while tok in req.eos_token_ids:
             tok = 35 + (tok + 1 - 35) % 92
         return tok
+
+    def _fail_all(self, message: str):
+        """A step raised: error every live request so callers see a clean
+        typed terminal chunk and can retry/migrate, instead of hanging on
+        queues a dead step loop will never fill (mirrors
+        JaxEngine._fail_all)."""
+        for req in [*self._running, *self._waiting]:
+            if req.held_hashes:
+                self.kv.release(req.held_hashes)
+                req.held_hashes = []
+            if not req.done:
+                req.queue.put_nowait(Annotated.from_error(message).to_dict())
+                req.queue.put_nowait(None)
+                req.done = True
+        self._running = []
+        self._waiting = []
 
     def _finish(self, req: _MockRequest, reason: Optional[str], emit: bool = True):
         if req in self._running:

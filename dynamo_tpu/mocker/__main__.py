@@ -118,6 +118,7 @@ async def main():
     logger.info("mocker worker up: model=%s instance=%x", args.model_name, drt.instance_id)
     await endpoint.serve_endpoint(handler)
     await drt.wait_for_shutdown()
+    await drt.close()  # graceful drain (runtime/component.py close())
 
 
 if __name__ == "__main__":

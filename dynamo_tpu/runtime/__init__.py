@@ -5,6 +5,8 @@ DistributedRuntime, Namespace/Component/Endpoint, Context, AsyncEngine,
 PushRouter/RouterMode, discovery client/server, config, logging.
 """
 
+from . import faults
+from .backoff import Backoff
 from .config import RuntimeConfig, discovery_address
 from .component import (
     Client,
@@ -28,6 +30,7 @@ from .logging import (
 )
 from .push_router import PushRouter, RouterMode
 from .request_plane import (
+    DeadlineExceeded,
     EndpointStats,
     EngineError,
     RequestPlaneClient,
@@ -37,9 +40,11 @@ from .request_plane import (
 
 __all__ = [
     "AsyncEngine",
+    "Backoff",
     "Client",
     "Component",
     "Context",
+    "DeadlineExceeded",
     "DiscoveryClient",
     "DiscoveryServer",
     "DistributedRuntime",
@@ -66,6 +71,7 @@ __all__ = [
     "collect",
     "current_trace",
     "discovery_address",
+    "faults",
     "init_logging",
     "parse_traceparent",
     "set_trace",

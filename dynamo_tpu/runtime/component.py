@@ -81,7 +81,9 @@ class DistributedRuntime:
         self.primary_lease: Optional[Lease] = None
         self._embedded_discovery: Optional[DiscoveryServer] = None
         self.server = RequestPlaneServer(host=self.config.request_plane_host)
-        self.client = RequestPlaneClient()
+        self.client = RequestPlaneClient(
+            connect_timeout=self.config.request_plane_connect_timeout
+        )
         self._server_started = False
         self._namespaces: Dict[str, Namespace] = {}
         self._leased_keys: Dict[str, bytes] = {}
@@ -180,14 +182,35 @@ class DistributedRuntime:
     async def wait_for_shutdown(self):
         await self._shutdown.wait()
 
-    async def close(self):
+    async def close(self, graceful: bool = True):
+        """Shutdown with the drain sequence the reference's graceful-
+        shutdown contract requires (DYN_RUNTIME_GRACEFUL_SHUTDOWN_TIMEOUT):
+
+          1. revoke the primary lease — instance keys vanish, routers stop
+             picking this process for NEW requests;
+          2. stop accepting new streams (listening socket closes, races
+             that already hold our address get a `draining` rejection they
+             treat as StreamLost);
+          3. drain in-flight streams within the graceful timeout;
+          4. force-cancel survivors.
+
+        `graceful=False` skips 2-3 (crash-style teardown, used by tests
+        that simulate worker death)."""
         self._shutdown.set()
         if self.health_check_manager is not None:
             await self.health_check_manager.stop()
-        if self.system_status_server is not None:
-            await self.system_status_server.stop()
         if self.primary_lease is not None:
             await self.primary_lease.revoke()
+        if graceful and self._server_started:
+            drained = await self.server.drain(self.config.graceful_shutdown_timeout)
+            if not drained:
+                logger.warning(
+                    "graceful drain timed out after %.1fs; force-cancelling %d stream(s)",
+                    self.config.graceful_shutdown_timeout,
+                    self.server.active_streams,
+                )
+        if self.system_status_server is not None:
+            await self.system_status_server.stop()
         await self.client.close()
         await self.server.stop()
         if self.discovery is not None:
@@ -311,31 +334,72 @@ class Client:
         self._watch_task: Optional[asyncio.Task] = None
         self._instances_event = asyncio.Event()
         self._default_router = None  # lazy PushRouter for .generate()
+        self._closed = False
 
     async def start(self):
         drt = self.endpoint.drt
         if drt.discovery is None:
             return
         self._watch = await drt.discovery.watch_prefix(self.endpoint.instance_prefix())
-        for item in self._watch.snapshot:
-            inst = Instance.from_json(item["value"])
-            self.instances[inst.instance_id] = inst
-        if self.instances:
-            self._instances_event.set()
+        self._load_snapshot(self._watch.snapshot)
         self._watch_task = asyncio.create_task(self._watch_loop())
 
+    def _load_snapshot(self, snapshot):
+        """Reconcile the full instance set from a watch snapshot — on a
+        re-watch this REPLACES the map, dropping instances that died while
+        the watch was down (their deletes were never delivered)."""
+        fresh = {}
+        for item in snapshot:
+            inst = Instance.from_json(item["value"])
+            fresh[inst.instance_id] = inst
+        self.instances.clear()
+        self.instances.update(fresh)
+        if self.instances:
+            self._instances_event.set()
+        else:
+            self._instances_event.clear()
+
     async def _watch_loop(self):
+        from .backoff import Backoff
+
         assert self._watch is not None
-        async for event in self._watch:
-            if event.type == "put":
-                inst = Instance.from_json(event.value)
-                self.instances[inst.instance_id] = inst
-                self._instances_event.set()
-            elif event.type == "delete":
-                iid = int(event.key.rsplit("/", 1)[-1], 16)
-                self.instances.pop(iid, None)
-                if not self.instances:
-                    self._instances_event.clear()
+        # stable seed: re-watch timing reproduces across chaos re-runs
+        backoff = Backoff.seeded(self.endpoint.subject, base=0.05, max_delay=1.0)
+        while not self._closed:
+            async for event in self._watch:
+                backoff.reset()
+                if event.type == "put":
+                    inst = Instance.from_json(event.value)
+                    self.instances[inst.instance_id] = inst
+                    self._instances_event.set()
+                elif event.type == "delete":
+                    iid = int(event.key.rsplit("/", 1)[-1], 16)
+                    self.instances.pop(iid, None)
+                    if not self.instances:
+                        self._instances_event.clear()
+            # the watch ended without cancel(): the discovery connection
+            # died. Reconnect + re-watch with backoff — the live instance
+            # list is this client's routing authority and must not silently
+            # freeze at its last state.
+            drt = self.endpoint.drt
+            while not self._closed:
+                await backoff.wait()
+                if not await drt.discovery.ensure_connected():
+                    if drt.discovery._closed:
+                        return  # runtime shut down under us — nothing to watch
+                    continue
+                try:
+                    self._watch = await drt.discovery.watch_prefix(
+                        self.endpoint.instance_prefix()
+                    )
+                except ConnectionError:
+                    continue
+                self._load_snapshot(self._watch.snapshot)
+                logger.info(
+                    "re-watching %s after discovery reconnect (%d instance(s))",
+                    self.endpoint.instance_prefix(), len(self.instances),
+                )
+                break
 
     def instance_ids(self) -> List[int]:
         return sorted(self.instances.keys())
@@ -377,6 +441,7 @@ class Client:
         return await self._default_router.generate(request, context)
 
     async def close(self):
+        self._closed = True
         if self._watch_task:
             self._watch_task.cancel()
         if self._watch:

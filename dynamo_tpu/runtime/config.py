@@ -110,6 +110,26 @@ ENV_REGISTRY: tuple = (
     EnvVar("DYN_REQUEST_PLANE_HOST", "str", "127.0.0.1",
            "Bind host for the TCP request-plane server.",
            "runtime/request_plane.py"),
+    EnvVar("DYN_REQUEST_PLANE_CONNECT_TIMEOUT", "float", "5.0",
+           "Connect budget for dialing a worker's request-plane server; "
+           "a black-holed address raises StreamLost (retryable) instead "
+           "of hanging the caller.",
+           "runtime/request_plane.py"),
+    # -- fault injection (dynochaos) ----------------------------------- #
+    EnvVar("DYN_FAULT_PLAN", "str", None,
+           "dynochaos fault plan: `;`-separated `point[:spec,...]` rules "
+           "(e.g. `request_plane.frame:sever,after=3;discovery.lease:"
+           "drop@t=2.0`). Unset = injection compiled out to a no-op "
+           "pass-through. See docs/fault_tolerance.md.",
+           "runtime/faults.py"),
+    EnvVar("DYN_FAULT_SEED", "int", "0",
+           "Seed for probabilistic (`p=`) fault rules — same plan + seed "
+           "+ hit sequence fires identically.",
+           "runtime/faults.py"),
+    EnvVar("DYN_FAULT_DISABLE", "bool", "0",
+           "Global dynochaos kill-switch: force the no-op injector even "
+           "when DYN_FAULT_PLAN is set.",
+           "runtime/faults.py"),
     # -- engine / memory sizing ---------------------------------------- #
     EnvVar("DYN_HBM_UTILIZATION", "float", "0.85",
            "Fraction of device memory the KV pool auto-sizer may plan "
@@ -175,6 +195,8 @@ class RuntimeConfig:
     lease_ttl_s: float = 10.0
     # request-plane bind host for TCP response/request streams
     request_plane_host: str = "127.0.0.1"
+    # connect budget for dialing a worker (black-holed address -> StreamLost)
+    request_plane_connect_timeout: float = 5.0
 
     @classmethod
     def from_settings(cls, config_path: Optional[str] = None) -> "RuntimeConfig":
@@ -224,6 +246,9 @@ class RuntimeConfig:
         cfg.discovery_endpoint = _env("DYN_DISCOVERY_ENDPOINT", cfg.discovery_endpoint)
         cfg.lease_ttl_s = _env("DYN_LEASE_TTL_S", cfg.lease_ttl_s, float)
         cfg.request_plane_host = _env("DYN_REQUEST_PLANE_HOST", cfg.request_plane_host)
+        cfg.request_plane_connect_timeout = _env(
+            "DYN_REQUEST_PLANE_CONNECT_TIMEOUT", cfg.request_plane_connect_timeout, float
+        )
         return cfg
 
 

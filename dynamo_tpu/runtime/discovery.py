@@ -26,7 +26,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
-from . import codec
+from . import codec, faults
+from .backoff import Backoff
 
 logger = logging.getLogger(__name__)
 
@@ -404,6 +405,16 @@ class Lease:
         interval = max(self.ttl / 3.0, 0.2)
         while self.alive:
             await asyncio.sleep(interval)
+            f = faults.FAULTS
+            if f.enabled and f.check("discovery.lease") == "drop":
+                # simulate server-side expiry (reaped TTL): revoke behind our
+                # own back so the NEXT keepalive walks the lost/re-grant path
+                try:
+                    await self._client._call(
+                        {"op": "lease_revoke", "lease_id": self.lease_id}
+                    )
+                except ConnectionError:
+                    pass
             try:
                 resp = await self._client._call({"op": "lease_keepalive", "lease_id": self.lease_id})
                 if not resp[0].get("ok"):
@@ -416,7 +427,17 @@ class Lease:
                         continue
                     self.alive = False
             except ConnectionError:
-                logger.warning("lease %d keepalive connection lost", self.lease_id)
+                # the discovery socket died, not the lease: reconnect with
+                # backoff inside the TTL budget, then re-grant — a worker
+                # must not silently fall out of the serving set because of
+                # one TCP reset
+                logger.warning(
+                    "lease %d keepalive connection lost; reconnecting", self.lease_id
+                )
+                deadline = time.monotonic() + self.ttl
+                if await self._client.ensure_connected(deadline=deadline) and \
+                        await self._regrant():
+                    continue
                 self.alive = False
 
     async def _regrant(self) -> bool:
@@ -456,6 +477,8 @@ class DiscoveryClient:
         self._subs: Dict[int, Subscription] = {}
         self._recv_task: Optional[asyncio.Task] = None
         self._lock = asyncio.Lock()
+        self._closed = False
+        self._reconnect_lock = asyncio.Lock()
 
     @classmethod
     async def connect(
@@ -474,17 +497,82 @@ class DiscoveryClient:
         raise ConnectionError(f"cannot reach discovery service at {host}:{port}: {last_err}")
 
     async def close(self):
+        self._closed = True
         if self._recv_task:
             self._recv_task.cancel()
         if self._writer:
             self._writer.close()
+        # the recv loop may have ALREADY exited (connection died earlier,
+        # subscriptions left parked awaiting a reconnect that now never
+        # comes): flush terminators unconditionally — a duplicate None
+        # past the first is harmless
+        for watch in self._watches.values():
+            watch._queue.put_nowait(None)
+        for sub in self._subs.values():
+            sub._queue.put_nowait(None)
+
+    async def ensure_connected(
+        self, deadline: Optional[float] = None, backoff: Optional[Backoff] = None
+    ) -> bool:
+        """Re-establish the discovery socket after a loss, with backoff.
+
+        Watches do NOT survive (the server binds them to the connection) —
+        holders re-watch via `watch_prefix` (component.Client does this);
+        topic subscriptions are re-established here in place, keeping the
+        Subscription objects valid. Returns False once `deadline` passes
+        or the client was deliberately closed."""
+        if self._closed:
+            return False
+        if self._writer is not None and not self._writer.is_closing():
+            return True
+        async with self._reconnect_lock:
+            if self._closed:
+                return False
+            if self._writer is not None and not self._writer.is_closing():
+                return True  # another caller reconnected while we waited
+            if backoff is None:
+                # stable seed: reconnect timing reproduces across re-runs
+                backoff = Backoff.seeded(
+                    f"{self.host}:{self.port}", base=0.05, max_delay=1.0
+                )
+            while not self._closed:
+                try:
+                    self._reader, self._writer = await asyncio.open_connection(
+                        self.host, self.port
+                    )
+                    self._recv_task = asyncio.create_task(self._recv_loop())
+                    break
+                except OSError:
+                    if not await backoff.wait(deadline):
+                        return False
+            if self._closed:
+                return False
+            for sub in list(self._subs.values()):
+                try:
+                    resp, _ = await self._call({"op": "subscribe", "topic": sub.topic})
+                    self._subs.pop(sub.sub_id, None)
+                    sub.sub_id = resp["sub_id"]
+                    self._subs[sub.sub_id] = sub
+                except (ConnectionError, KeyError):
+                    logger.warning("failed to re-subscribe %s after reconnect", sub.topic)
+            logger.info("discovery connection re-established to %s:%d", self.host, self.port)
+            return True
 
     async def _recv_loop(self):
-        assert self._reader is not None
+        # capture THIS connection's streams: after a reconnect the old
+        # loop's finally must close the dead writer, never the fresh one
+        reader, writer = self._reader, self._writer
+        assert reader is not None
         try:
             while True:
-                frame = await codec.read_frame(self._reader)
+                frame = await codec.read_frame(reader)
                 if frame is None:
+                    break
+                f = faults.FAULTS
+                if f.enabled and f.check("discovery.watch") == "disconnect":
+                    # drop the whole control-plane connection: watches end,
+                    # pending calls fail — exercising the re-watch path
+                    writer.close()
                     break
                 control, payload = frame
                 if control.get("push") == "watch":
@@ -509,10 +597,21 @@ class DiscoveryClient:
                 if not fut.done():
                     fut.set_exception(ConnectionError("discovery connection lost"))
             self._pending.clear()
-            for watch in self._watches.values():
+            # watches end (server state died with the connection): holders
+            # notice the None and re-watch after ensure_connected
+            for watch in list(self._watches.values()):
                 watch._queue.put_nowait(None)
-            for sub in self._subs.values():
-                sub._queue.put_nowait(None)
+            self._watches.clear()
+            if self._closed:
+                # deliberate close: end subscription iterators too. On an
+                # accidental loss they stay parked — ensure_connected
+                # re-subscribes them in place.
+                for sub in self._subs.values():
+                    sub._queue.put_nowait(None)
+            # an organic EOF (server died/restarted) must mark this
+            # connection dead, or ensure_connected() would report the
+            # corpse healthy and every later _call() would park forever
+            writer.close()
 
     async def _call(self, control: dict, payload: bytes = b"") -> Tuple[dict, bytes]:
         if self._writer is None or self._writer.is_closing():

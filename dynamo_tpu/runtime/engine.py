@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import asyncio
 import secrets
+import time
 from typing import Any, AsyncIterator, Awaitable, Callable, Optional, Protocol, runtime_checkable
 
 
@@ -25,13 +26,27 @@ class Context:
     `stop_generating` = graceful: finish the current token, emit a final
     usage chunk. `kill` = hard: stop streaming immediately. Child contexts
     form a cancellation tree like the reference's token hierarchy.
+
+    A context may carry a `deadline` (absolute `time.monotonic()` value):
+    the end-to-end budget for the request. Connect attempts, retry loops
+    (migration) and backoff waits clip to it — past the deadline they stop
+    retrying and surface a clean error instead of spinning. Children
+    inherit the tightest deadline on the parent chain; the deadline also
+    crosses the request plane (`deadline_ms` on the wire) so worker-side
+    contexts see the same budget.
     """
 
-    def __init__(self, id: Optional[str] = None, parent: Optional["Context"] = None):
+    def __init__(
+        self,
+        id: Optional[str] = None,
+        parent: Optional["Context"] = None,
+        deadline: Optional[float] = None,
+    ):
         self._id = id or secrets.token_hex(8)
         self._stopped = asyncio.Event()
         self._killed = asyncio.Event()
         self._parent = parent
+        self._deadline = deadline
         self._children: list[Context] = []
         if parent is not None:
             parent._children.append(self)
@@ -39,6 +54,29 @@ class Context:
     @property
     def id(self) -> str:
         return self._id
+
+    @property
+    def deadline(self) -> Optional[float]:
+        """Effective deadline: the tightest on the parent chain."""
+        own = self._deadline
+        if self._parent is not None:
+            inherited = self._parent.deadline
+            if inherited is not None and (own is None or inherited < own):
+                return inherited
+        return own
+
+    def set_deadline(self, seconds_from_now: float) -> "Context":
+        self._deadline = time.monotonic() + seconds_from_now
+        return self
+
+    def time_remaining(self) -> Optional[float]:
+        """Seconds until the deadline (>= 0), or None when unbounded."""
+        dl = self.deadline
+        return None if dl is None else max(0.0, dl - time.monotonic())
+
+    def deadline_exceeded(self) -> bool:
+        dl = self.deadline
+        return dl is not None and time.monotonic() >= dl
 
     def is_stopped(self) -> bool:
         return self._stopped.is_set() or (self._parent is not None and self._parent.is_stopped())

@@ -40,15 +40,23 @@ class PushRouter:
         self._rr_index = 0
 
     def _pick(self, exclude: set) -> int:
+        if self.mode == RouterMode.DIRECT:
+            # pinned routing has no failover set: a dead pinned instance
+            # must fail fast after ONE StreamLost, not be re-dialed once
+            # per live instance
+            if self.direct_instance is None:
+                raise ValueError("direct mode requires an instance id")
+            if self.direct_instance in exclude:
+                raise StreamLost(
+                    f"pinned instance {self.direct_instance:x} unavailable "
+                    f"for {self.client.endpoint.subject}"
+                )
+            return self.direct_instance
         ids = [i for i in self.client.instance_ids() if i not in exclude]
         if not ids:
             raise StreamLost(f"no instances for {self.client.endpoint.subject}")
         if self.mode == RouterMode.RANDOM:
             return random.choice(ids)
-        if self.mode == RouterMode.DIRECT:
-            if self.direct_instance is None:
-                raise ValueError("direct mode requires an instance id")
-            return self.direct_instance
         # round-robin default
         inst = ids[self._rr_index % len(ids)]
         self._rr_index += 1

@@ -27,13 +27,17 @@ import logging
 import time
 from typing import Any, AsyncIterator, Awaitable, Callable, Dict, Optional, Tuple
 
-from . import codec
+from . import codec, faults
 from .engine import Context
 from .logging import DistributedTraceContext, current_trace, parse_traceparent, set_trace
 
 logger = logging.getLogger(__name__)
 
 Handler = Callable[[Any, Context], AsyncIterator[Any]]
+
+#: wire error code a draining server attaches to rejected new streams;
+#: clients surface it as StreamLost so routers retry another instance
+DRAINING = "draining"
 
 
 class EndpointStats:
@@ -67,6 +71,11 @@ class RequestPlaneServer:
         self._server: Optional[asyncio.base_events.Server] = None
         self._active: Dict[Tuple[asyncio.StreamWriter, int], Context] = {}
         self._connections: set = set()
+        self._draining = False
+
+    @property
+    def active_streams(self) -> int:
+        return len(self._active)
 
     def register(self, subject: str, handler: Handler) -> EndpointStats:
         self._handlers[subject] = handler
@@ -87,6 +96,21 @@ class RequestPlaneServer:
         self._server = await asyncio.start_server(self._serve_conn, self.host, self.port)
         self.port = self._server.sockets[0].getsockname()[1]
         return self.host, self.port
+
+    async def drain(self, timeout: float) -> bool:
+        """Graceful-shutdown step 2 and 3 (step 1, lease revocation, is the
+        runtime's job): stop accepting NEW streams — the listening socket
+        closes and connected callers get a `draining` error they treat as
+        StreamLost — then wait up to `timeout` for in-flight streams to
+        finish. Returns True when fully drained; False means survivors
+        remain for stop() to force-kill."""
+        self._draining = True
+        if self._server:
+            self._server.close()
+        deadline = time.monotonic() + timeout
+        while self._active and time.monotonic() < deadline:
+            await asyncio.sleep(0.02)
+        return not self._active
 
     async def stop(self):
         for ctx in self._active.values():
@@ -111,6 +135,14 @@ class RequestPlaneServer:
                 t = control.get("t")
                 if t == "req":
                     stream_id = control["stream"]
+                    if self._draining:
+                        async with write_lock:
+                            await codec.write_frame(writer, {
+                                "t": "err", "stream": stream_id,
+                                "code": DRAINING,
+                                "error": "worker draining: not accepting new streams",
+                            })
+                        continue
                     task = asyncio.create_task(
                         self._run_stream(control, payload, writer, write_lock)
                     )
@@ -162,6 +194,10 @@ class RequestPlaneServer:
             return
 
         ctx = Context(id=control.get("ctx_id"))
+        deadline_ms = control.get("deadline_ms")
+        if deadline_ms is not None:
+            # the caller's remaining budget, rebased onto this host's clock
+            ctx.set_deadline(max(0.0, deadline_ms / 1000.0))
         self._active[(writer, stream_id)] = ctx
         tp = control.get("traceparent")
         if tp:
@@ -204,6 +240,11 @@ class StreamLost(EngineError):
     migration (reference migration.rs)."""
 
 
+class DeadlineExceeded(EngineError):
+    """The context's end-to-end deadline passed. Clean and terminal:
+    retry loops (migration, reconnects) must stop, not spin."""
+
+
 class _Connection:
     def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         self.reader = reader
@@ -237,12 +278,15 @@ class RequestPlaneClient:
     many concurrent streams multiplexed per connection
     (reference AddressedPushRouter addressed_router.rs:52)."""
 
-    def __init__(self):
+    def __init__(self, connect_timeout: float = 5.0):
         self._conns: Dict[str, _Connection] = {}
         self._stream_ids = itertools.count(1)
         self._conn_locks: Dict[str, asyncio.Lock] = {}
+        self.connect_timeout = connect_timeout
 
-    async def _get_conn(self, address: str) -> _Connection:
+    async def _get_conn(
+        self, address: str, deadline: Optional[float] = None
+    ) -> _Connection:
         conn = self._conns.get(address)
         if conn is not None and not conn.closed:
             return conn
@@ -252,7 +296,29 @@ class RequestPlaneClient:
             if conn is not None and not conn.closed:
                 return conn
             host, _, port = address.rpartition(":")
-            reader, writer = await asyncio.open_connection(host, int(port))
+            # a black-holed address (dead host, dropped SYN) must raise
+            # StreamLost within the connect budget, never hang the caller;
+            # the context deadline tightens the budget further
+            timeout = self.connect_timeout
+            if deadline is not None:
+                timeout = min(timeout, max(0.0, deadline - time.monotonic()))
+
+            async def _dial():
+                f = faults.FAULTS
+                if f.enabled:
+                    act = await f.on("request_plane.connect")
+                    if act == "refuse":
+                        raise ConnectionRefusedError(
+                            f"injected: connect to {address} refused"
+                        )
+                return await asyncio.open_connection(host, int(port))
+
+            try:
+                reader, writer = await asyncio.wait_for(_dial(), timeout)
+            except asyncio.TimeoutError:
+                raise StreamLost(
+                    f"connect to {address} timed out after {timeout:.1f}s"
+                ) from None
             conn = _Connection(reader, writer)
             conn.recv_task = asyncio.create_task(conn.recv_loop())
             self._conns[address] = conn
@@ -260,6 +326,12 @@ class RequestPlaneClient:
 
     async def close(self):
         for conn in self._conns.values():
+            # unblock consumers parked on queue.get() FIRST: they unwind
+            # via the normal StreamLost path instead of hanging on a queue
+            # nobody will ever fill again
+            conn.closed = True
+            for q in conn.streams.values():
+                q.put_nowait(({"t": "lost"}, b""))
             if conn.recv_task:
                 conn.recv_task.cancel()
             conn.writer.close()
@@ -275,8 +347,10 @@ class RequestPlaneClient:
         """Issue a request; returns the async response stream. Cancelling the
         context sends a cancel frame to the worker."""
         ctx = context or Context()
+        if ctx.deadline_exceeded():
+            raise DeadlineExceeded(f"deadline passed before calling {address}")
         try:
-            conn = await self._get_conn(address)
+            conn = await self._get_conn(address, deadline=ctx.deadline)
         except OSError as e:
             raise StreamLost(f"cannot connect to {address}: {e}") from e
         stream_id = next(self._stream_ids)
@@ -284,6 +358,11 @@ class RequestPlaneClient:
         conn.streams[stream_id] = queue
 
         control = {"t": "req", "stream": stream_id, "subject": subject, "ctx_id": ctx.id}
+        remaining = ctx.time_remaining()
+        if remaining is not None:
+            # ship the REMAINING budget, not an absolute time: monotonic
+            # clocks don't compare across hosts
+            control["deadline_ms"] = int(remaining * 1000)
         trace = current_trace()
         if trace is not None:
             control["traceparent"] = trace.traceparent()
@@ -329,10 +408,27 @@ class RequestPlaneClient:
                 get_task = None
                 t = control.get("t")
                 if t == "data":
+                    f = faults.FAULTS
+                    if f.enabled:
+                        act = await f.on("request_plane.frame")
+                        if act == "sever":
+                            # sever the CONNECTION, not just this stream:
+                            # every stream multiplexed on it sees a real
+                            # mid-flight loss, exactly like a worker SIGKILL.
+                            # Mark it dead NOW so a concurrent _get_conn
+                            # never hands out the dying transport in the
+                            # window before recv_loop's finally runs
+                            conn.closed = True
+                            conn.writer.close()
+                            raise StreamLost("injected: connection severed mid-stream")
                     yield codec.unpack(payload)
                 elif t == "done":
                     return
                 elif t == "err":
+                    if control.get("code") == DRAINING:
+                        # a draining worker is connection-level unavailable:
+                        # routers and migration retry another instance
+                        raise StreamLost(control.get("error", "worker draining"))
                     raise EngineError(control.get("error", "engine error"))
                 elif t == "lost":
                     raise StreamLost("connection to worker lost mid-stream")

@@ -1,0 +1,628 @@
+"""dynochaos: seeded fault injection + recovery hardening (ISSUE 3).
+
+The chaos soak drives an in-proc multi-worker cluster through seeded fault
+plans (connect refusal, mid-stream sever, lease expiry) and asserts the
+serving invariants the migration/health/drain machinery promises:
+
+  * every request either completes with a CONTIGUOUS, duplicate-free token
+    stream (migration must not re-emit or drop tokens across a mid-stream
+    kill) or fails with a clean typed error — never a hang;
+  * the fault plan actually fired (no vacuous passes);
+  * instances recover (lease re-grant republishes registrations);
+  * no leaked asyncio tasks after teardown;
+  * /health flips 503 and back as canaries fail and recover;
+  * graceful drain finishes in-flight streams, force-kill bounds it.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from dynamo_tpu.llm.migration import Migration
+from dynamo_tpu.llm.protocols import Annotated, LLMEngineOutput, PreprocessedRequest
+from dynamo_tpu.runtime import (
+    Backoff,
+    Context,
+    DeadlineExceeded,
+    DiscoveryServer,
+    DistributedRuntime,
+    PushRouter,
+    RequestPlaneClient,
+    RequestPlaneServer,
+    RouterMode,
+    RuntimeConfig,
+    StreamLost,
+    faults,
+)
+from dynamo_tpu.runtime.faults import FaultError, FaultInjector
+
+
+@pytest.fixture(autouse=True)
+def _reset_faults():
+    """No chaos plan may leak into another test (or the wider suite)."""
+    yield
+    faults.reset()
+
+
+# --------------------------------------------------------------------------- #
+# injector unit behavior
+# --------------------------------------------------------------------------- #
+
+
+def test_noop_passthrough_installed_when_unconfigured():
+    # acceptance: with DYN_FAULT_* unset the hot path must see the shared
+    # no-op object — sites short-circuit on `.enabled` and pay nothing
+    assert faults.FAULTS is faults.NOOP
+    assert faults.FAULTS.enabled is False
+    inj = faults.configure("engine.step:error")
+    assert faults.FAULTS is inj and inj.enabled
+    faults.reset()
+    assert faults.FAULTS is faults.NOOP
+
+
+def test_kill_switch_forces_noop(monkeypatch):
+    monkeypatch.setenv("DYN_FAULT_PLAN", "engine.step:error")
+    monkeypatch.setenv("DYN_FAULT_DISABLE", "1")
+    faults.reset()
+    assert faults.FAULTS is faults.NOOP
+    monkeypatch.delenv("DYN_FAULT_DISABLE")
+    faults.reset()
+    assert isinstance(faults.FAULTS, FaultInjector)
+
+
+def test_plan_grammar_issue_example():
+    rules = faults.parse_plan(
+        "request_plane.frame:sever,after=3;discovery.lease:drop@t=2.0"
+    )
+    assert [(r.point, r.action) for r in rules] == [
+        ("request_plane.frame", "sever"), ("discovery.lease", "drop"),
+    ]
+    assert rules[0].after == 3 and rules[1].t == 2.0
+    with pytest.raises(ValueError):
+        faults.parse_plan("request_plane.frame:after=three")
+    with pytest.raises(ValueError):
+        faults.parse_plan(":sever")
+    with pytest.raises(ValueError):  # misspelled key must not become an action
+        faults.parse_plan("request_plane.frame:sever,atfer=3")
+
+
+def test_trigger_semantics_after_at_times():
+    inj = FaultInjector("p:sever,after=2,times=2")
+    fires = [inj.check("p") for _ in range(6)]
+    assert fires == [None, None, "sever", "sever", None, None]
+    inj = FaultInjector("q:error,at=3")
+    assert [inj.check("q") for _ in range(5)] == [None, None, "error", None, None]
+    assert inj.check("unknown.point") is None
+    # multi-rule point: every rule counts every hit, so at= positions stay
+    # exact even after an earlier rule fired
+    inj = FaultInjector("p:delay,at=2;p:sever,at=5")
+    assert [inj.check("p") for _ in range(6)] == [
+        None, "delay", None, None, "sever", None,
+    ]
+
+
+def test_probabilistic_rules_are_seed_deterministic():
+    def seq(seed):
+        inj = FaultInjector("p:sever,p=0.5", seed)
+        return [inj.check("p") for _ in range(64)]
+
+    a = seq(7)
+    assert a == seq(7)  # same (plan, seed, hit sequence) -> same firings
+    assert any(x == "sever" for x in a) and any(x is None for x in a)
+
+
+def test_error_action_raises_typed_fault():
+    inj = faults.configure("engine.step:error,times=1")
+
+    async def main():
+        with pytest.raises(FaultError):
+            await inj.on("engine.step")
+        assert await inj.on("engine.step") is None  # times exhausted
+
+    asyncio.run(main())
+
+
+def test_backoff_deterministic_and_deadline_clipped():
+    a, b = Backoff(base=0.01, seed=3), Backoff(base=0.01, seed=3)
+    assert [a.next_delay() for _ in range(5)] == [b.next_delay() for _ in range(5)]
+    assert a.next_delay() <= a.max_delay * (1 + a.jitter)
+
+    async def main():
+        bo = Backoff(base=10.0, jitter=0.0)  # would sleep 10s unclipped
+        t0 = time.monotonic()
+        assert await bo.wait(deadline=time.monotonic() + 0.05) is False
+        assert time.monotonic() - t0 < 1.0
+
+    asyncio.run(main())
+
+
+# --------------------------------------------------------------------------- #
+# chaos soak: in-proc cluster, seeded plans, serving invariants
+# --------------------------------------------------------------------------- #
+
+
+def _tagged_counting_handler(tag, calls):
+    """Deterministic continuation engine: token i is len(prompt)+i, so a
+    migrated retry (prompt grows by the emitted tokens) continues EXACTLY
+    where the lost stream stopped — any duplicate or gap is visible in the
+    client-side token sequence."""
+
+    async def handler(request, context):
+        calls.append(tag)
+        toks = request["token_ids"]
+        n = int(request["stop_conditions"]["max_tokens"])
+        start = len(toks)
+        for i in range(n):
+            out = LLMEngineOutput(
+                token_ids=[start + i],
+                finish_reason="length" if i == n - 1 else None,
+            ).to_dict()
+            yield Annotated(data=out).to_dict()
+            await asyncio.sleep(0.002)  # let faults interleave mid-stream
+
+    return handler
+
+
+class _RouterEngine:
+    """Bridge Migration -> PushRouter -> request plane (the real serving
+    wiring, minus HTTP)."""
+
+    def __init__(self, router):
+        self.router = router
+
+    async def generate(self, request, context):
+        stream = await self.router.generate(request.to_dict(), context)
+        async for item in stream:
+            yield item
+
+
+async def _run_one(mig_engine, rid, prompt_len, n_tokens, migration_limit=4):
+    req = PreprocessedRequest(
+        token_ids=list(range(prompt_len)),
+        stop_conditions={"max_tokens": n_tokens},
+        request_id=rid,
+    )
+    mig = Migration(mig_engine, migration_limit=migration_limit)
+    toks, err = [], None
+    async for ann in mig.generate(req, Context()):
+        if ann.is_error():
+            err = (ann.comment or ["error"])[0]
+        elif ann.data:
+            toks.extend(ann.data.get("token_ids", []))
+    return toks, err
+
+
+PLANS = {
+    "connect-refuse": "request_plane.connect:refuse,times=2",
+    "mid-stream-sever": "request_plane.frame:sever,after=5,times=2",
+    "lease-expiry": "discovery.lease:drop,times=2",
+}
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+@pytest.mark.parametrize("plan_name", sorted(PLANS))
+def test_chaos_soak(plan_name, seed):
+    plan = PLANS[plan_name]
+    n_workers, n_requests, n_tokens = 3, 8, 12
+
+    async def main():
+        baseline_tasks = len(asyncio.all_tasks())
+        disc = DiscoveryServer(port=0)
+        host, port = await disc.start()
+        cfg = RuntimeConfig()
+        cfg.discovery_endpoint = f"tcp://{host}:{port}"
+        cfg.graceful_shutdown_timeout = 2.0
+        cfg.lease_ttl_s = 0.9  # fast keepalives so lease faults fire quickly
+
+        calls = []
+        workers = []
+        for i in range(n_workers):
+            w = await DistributedRuntime.create(cfg)
+            await w.namespace("chaos").component("bk").endpoint("gen").serve_endpoint(
+                _tagged_counting_handler(f"w{i}", calls)
+            )
+            workers.append(w)
+        fe = await DistributedRuntime.create(cfg)
+        client = await fe.namespace("chaos").component("bk").endpoint("gen").client()
+        await client.wait_for_instances()
+        engine = _RouterEngine(PushRouter(client, RouterMode.ROUND_ROBIN))
+
+        inj = faults.configure(plan, seed)
+        try:
+            results = await asyncio.gather(*(
+                _run_one(engine, f"req-{plan_name}-{seed}-{i}", 4 + i, n_tokens)
+                for i in range(n_requests)
+            ))
+            # lease faults fire on keepalive ticks, which may land after the
+            # (fast) requests finish — keep the plan armed until it has
+            deadline = time.monotonic() + 6.0
+            while len(inj.fired_log) < 2 and time.monotonic() < deadline:
+                await asyncio.sleep(0.05)
+        finally:
+            faults.reset()
+
+        # invariant 1: exactly-once completion with a contiguous,
+        # duplicate-free stream — or a clean typed error (never a hang;
+        # gather returning at all proves no request wedged)
+        completed = 0
+        for i, (toks, err) in enumerate(results):
+            if err is None:
+                start = 4 + i
+                assert toks == list(range(start, start + n_tokens)), (
+                    f"req {i}: non-contiguous stream {toks}"
+                )
+                completed += 1
+            else:
+                assert isinstance(err, str) and err
+        # with per-plan bounded faults and migration_limit=4, everything
+        # should in fact complete
+        assert completed == n_requests, [e for _, e in results if e]
+
+        # invariant 2: the plan actually fired (no vacuous pass)
+        assert len(inj.fired_log) == 2, inj.fired_log
+
+        # invariant 3: recovery — every worker registered (lease re-grant
+        # republishes after drops); settle wait covers keepalive latency
+        deadline = time.monotonic() + 8.0
+        while len(client.instance_ids()) < n_workers and time.monotonic() < deadline:
+            await asyncio.sleep(0.05)
+        assert len(client.instance_ids()) == n_workers
+
+        await client.close()
+        for drt in (fe, *workers):
+            await drt.close()
+        await disc.stop()
+
+        # invariant 4: no leaked tasks/sockets after teardown
+        await asyncio.sleep(0.2)
+        leaked = [
+            t for t in asyncio.all_tasks()
+            if t is not asyncio.current_task() and not t.done()
+        ]
+        assert len(leaked) <= baseline_tasks, leaked
+        assert not fe.client._conns
+
+    asyncio.run(main())
+
+
+# --------------------------------------------------------------------------- #
+# /health flips 503 <-> 200 as canaries fail and recover
+# --------------------------------------------------------------------------- #
+
+
+def test_health_flips_on_canary_failure_and_recovery():
+    import httpx
+
+    from dynamo_tpu.runtime.health_check import HealthCheckManager
+
+    async def wait_status(client, url, want, timeout=6.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            r = await client.get(url)
+            if r.status_code == want:
+                return r
+            await asyncio.sleep(0.05)
+        raise AssertionError(f"{url} never reached {want}")
+
+    async def main():
+        disc = DiscoveryServer(port=0)
+        host, port = await disc.start()
+        cfg = RuntimeConfig()
+        cfg.discovery_endpoint = f"tcp://{host}:{port}"
+        cfg.system_enabled = True
+        cfg.system_host = "127.0.0.1"
+
+        drt = await DistributedRuntime.create(cfg)
+
+        async def handler(request, context):
+            f = faults.FAULTS
+            if f.enabled:
+                await f.on("engine.step")
+            yield {"ok": True}
+
+        served = await drt.namespace("h").component("c").endpoint("e").serve_endpoint(handler)
+        # tight canary cadence (the config default of 60s idle is for prod)
+        hcm = HealthCheckManager(
+            drt, drt.system_health,
+            idle_timeout=0.05, request_timeout=0.5, check_interval=0.08,
+        )
+        drt.health_check_manager = hcm
+        hcm.register(served, {"canary": True})
+        hcm.start()
+
+        url = f"http://127.0.0.1:{drt.system_status_server.port}/health"
+        async with httpx.AsyncClient() as client:
+            await wait_status(client, url, 200)
+            # worker "dies": the next 6 canary probes hit an injected step
+            # fault and error out
+            faults.configure("engine.step:error,times=6")
+            r = await wait_status(client, url, 503)
+            assert r.json()["status"] == "unhealthy"
+            # plan exhausts -> canaries succeed -> "recovers"
+            r = await wait_status(client, url, 200)
+            assert r.json()["status"] == "healthy"
+
+        await drt.close()
+        await disc.stop()
+
+    asyncio.run(main())
+
+
+# --------------------------------------------------------------------------- #
+# graceful drain + force-kill
+# --------------------------------------------------------------------------- #
+
+
+def _slow_tagged_handler(tag, n=15, dt=0.02):
+    async def handler(request, context):
+        for i in range(n):
+            yield {"i": i, "worker": tag}
+            await asyncio.sleep(dt)
+
+    return handler
+
+
+def test_graceful_drain_finishes_inflight_and_reroutes_new():
+    async def main():
+        disc = DiscoveryServer(port=0)
+        host, port = await disc.start()
+        cfg = RuntimeConfig()
+        cfg.discovery_endpoint = f"tcp://{host}:{port}"
+        cfg.graceful_shutdown_timeout = 10.0
+
+        a = await DistributedRuntime.create(cfg)
+        await a.namespace("d").component("c").endpoint("e").serve_endpoint(
+            _slow_tagged_handler("A")
+        )
+        b = await DistributedRuntime.create(cfg)
+        await b.namespace("d").component("c").endpoint("e").serve_endpoint(
+            _slow_tagged_handler("B")
+        )
+        fe = await DistributedRuntime.create(cfg)
+        client = await fe.namespace("d").component("c").endpoint("e").client()
+        await client.wait_for_instances()
+
+        stream = await client.direct({}, a.instance_id)
+        got = [await stream.__anext__() for _ in range(3)]
+
+        # shutdown A while its stream is in flight
+        close_task = asyncio.create_task(a.close())
+        # drain step 1: the lease revoke removes A from discovery, so new
+        # requests route to B
+        deadline = time.monotonic() + 5.0
+        while a.instance_id in client.instance_ids() and time.monotonic() < deadline:
+            await asyncio.sleep(0.02)
+        assert client.instance_ids() == [b.instance_id]
+        router = PushRouter(client, RouterMode.ROUND_ROBIN)
+        new_stream = await router.generate({})
+        first = await new_stream.__anext__()
+        assert first["worker"] == "B"
+
+        # drain step 3: the in-flight stream on A runs to completion
+        async for item in stream:
+            got.append(item)
+        assert [g["i"] for g in got] == list(range(15))
+        await close_task
+
+        # drain step 2: A's listener is closed — a fresh dial fails fast
+        fresh = RequestPlaneClient(connect_timeout=0.5)
+        with pytest.raises(StreamLost):
+            s = await fresh.call(f"{a.server.host}:{a.server.port}", "d.c.e", {})
+            async for _ in s:
+                pass
+        await fresh.close()
+
+        async for item in new_stream:  # drain B's stream before teardown
+            pass
+        await client.close()
+        for drt in (fe, b):
+            await drt.close()
+        await disc.stop()
+
+    asyncio.run(main())
+
+
+def test_drain_force_kills_past_timeout():
+    async def main():
+        disc = DiscoveryServer(port=0)
+        host, port = await disc.start()
+        cfg = RuntimeConfig()
+        cfg.discovery_endpoint = f"tcp://{host}:{port}"
+        cfg.graceful_shutdown_timeout = 0.3  # tiny budget: force-kill path
+
+        w = await DistributedRuntime.create(cfg)
+
+        async def endless(request, context):
+            i = 0
+            while True:
+                yield {"i": i}
+                i += 1
+                await asyncio.sleep(0.02)
+
+        await w.namespace("d").component("c").endpoint("k").serve_endpoint(endless)
+        fe = await DistributedRuntime.create(cfg)
+        client = await fe.namespace("d").component("c").endpoint("k").client()
+        await client.wait_for_instances()
+
+        stream = await client.direct({}, w.instance_id)
+        assert (await stream.__anext__())["i"] == 0
+
+        t0 = time.monotonic()
+        await w.close()  # drain cannot finish; survivors force-cancelled
+        took = time.monotonic() - t0
+        assert 0.25 <= took < 5.0, took
+
+        # the consumer unwinds promptly (killed stream ends or reports loss)
+        with pytest.raises((StreamLost, StopAsyncIteration)):
+            async def drain_rest():
+                async for _ in stream:
+                    pass
+            await asyncio.wait_for(drain_rest(), timeout=5.0)
+
+        await client.close()
+        await fe.close()
+        await disc.stop()
+
+    asyncio.run(main())
+
+
+# --------------------------------------------------------------------------- #
+# request-plane hardening: connect timeout, close() unblocks, deadlines
+# --------------------------------------------------------------------------- #
+
+
+def test_connect_timeout_raises_stream_lost_not_hang():
+    async def main():
+        faults.configure("request_plane.connect:hang")
+        client = RequestPlaneClient(connect_timeout=0.2)
+        t0 = time.monotonic()
+        with pytest.raises(StreamLost, match="timed out"):
+            await client.call("127.0.0.1:1", "x", {})
+        assert time.monotonic() - t0 < 2.0
+        await client.close()
+
+    asyncio.run(main())
+
+
+def test_client_close_unblocks_pending_consumers():
+    async def main():
+        server = RequestPlaneServer(port=0)
+
+        async def trickle(request, context):
+            yield {"first": True}
+            await asyncio.sleep(30)  # consumer would park on queue.get()
+            yield {"never": True}
+
+        server.register("s", trickle)
+        host, port = await server.start()
+        client = RequestPlaneClient()
+        stream = await client.call(f"{host}:{port}", "s", {})
+        assert (await stream.__anext__())["first"]
+
+        async def consume():
+            async for _ in stream:
+                pass
+
+        task = asyncio.create_task(consume())
+        await asyncio.sleep(0.05)
+        await client.close()
+        with pytest.raises(StreamLost):
+            await asyncio.wait_for(task, timeout=2.0)
+        await server.stop()
+
+    asyncio.run(main())
+
+
+def test_deadline_checked_before_call_and_carried_to_worker():
+    async def main():
+        server = RequestPlaneServer(port=0)
+
+        async def report(request, context):
+            yield {"remaining": context.time_remaining()}
+
+        server.register("s", report)
+        host, port = await server.start()
+        client = RequestPlaneClient()
+
+        ctx = Context().set_deadline(5.0)
+        stream = await client.call(f"{host}:{port}", "s", {}, ctx)
+        item = await stream.__anext__()
+        # the worker-side context sees the caller's remaining budget
+        assert item["remaining"] is not None and 0 < item["remaining"] <= 5.0
+
+        expired = Context().set_deadline(0.0)
+        with pytest.raises(DeadlineExceeded):
+            await client.call(f"{host}:{port}", "s", {}, expired)
+
+        await client.close()
+        await server.stop()
+
+    asyncio.run(main())
+
+
+def test_discovery_reconnect_after_organic_server_restart():
+    """No fault injection here on purpose: a clean server FIN (restart)
+    must mark the client connection dead so ensure_connected() redials —
+    the injected `discovery.watch:disconnect` closes the writer itself and
+    would mask a broken organic-EOF path."""
+    from dynamo_tpu.runtime import DiscoveryClient
+
+    async def main():
+        disc = DiscoveryServer(port=0)
+        host, port = await disc.start()
+        client = await DiscoveryClient.connect(host, port)
+        await client.put("v1/x", b"1")
+        await disc.stop()
+        await asyncio.sleep(0.1)  # recv loop sees EOF
+        assert client._writer.is_closing(), "organic EOF left the corpse 'healthy'"
+
+        disc2 = DiscoveryServer(port=port)  # discovery restarts on its port
+        await disc2.start()
+        assert await client.ensure_connected(deadline=time.monotonic() + 5.0)
+        status = await client.status()  # must not park forever
+        assert status["ok"]
+
+        await client.close()
+        await disc2.stop()
+
+    asyncio.run(main())
+
+
+def test_discovery_close_unblocks_subs_parked_by_earlier_connection_death():
+    from dynamo_tpu.runtime import DiscoveryClient
+
+    async def main():
+        disc = DiscoveryServer(port=0)
+        host, port = await disc.start()
+        client = await DiscoveryClient.connect(host, port)
+        sub = await client.subscribe("topic")
+
+        async def consume():
+            async for _ in sub:
+                pass
+
+        task = asyncio.create_task(consume())
+        await disc.stop()
+        await asyncio.sleep(0.1)  # connection dies; sub stays parked
+        assert not task.done()    # (awaiting a reconnect, by design)
+        await client.close()      # shutdown must flush the terminator
+        await asyncio.wait_for(task, timeout=2.0)
+
+    asyncio.run(main())
+
+
+def test_direct_router_fails_fast_on_dead_pinned_instance():
+    async def main():
+        disc = DiscoveryServer(port=0)
+        host, port = await disc.start()
+        cfg = RuntimeConfig()
+        cfg.discovery_endpoint = f"tcp://{host}:{port}"
+
+        w1 = await DistributedRuntime.create(cfg)
+        await w1.namespace("t").component("c").endpoint("e").serve_endpoint(
+            _slow_tagged_handler("w1")
+        )
+        w2 = await DistributedRuntime.create(cfg)
+        await w2.namespace("t").component("c").endpoint("e").serve_endpoint(
+            _slow_tagged_handler("w2")
+        )
+        fe = await DistributedRuntime.create(cfg)
+        client = await fe.namespace("t").component("c").endpoint("e").client()
+        await client.wait_for_instances()
+
+        # pin to w1, then refuse every dial: the router must give up after
+        # ONE attempt instead of re-dialing the corpse per live instance
+        inj = faults.configure("request_plane.connect:refuse,times=100")
+        router = PushRouter(client, RouterMode.DIRECT, direct_instance=w1.instance_id)
+        with pytest.raises(StreamLost):
+            await router.generate({})
+        assert len(inj.fired_log) == 1, "dead pinned instance was re-dialed"
+        faults.reset()
+
+        await client.close()
+        for drt in (fe, w1, w2):
+            await drt.close()
+        await disc.stop()
+
+    asyncio.run(main())

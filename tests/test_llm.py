@@ -178,8 +178,71 @@ def test_migration_exhaustion_yields_error():
         events = []
         async for ann in mig.generate(req, Context()):
             events.append(ann)
+        # budget exhaustion is a clean TERMINAL CHUNK (Annotated.from_error),
+        # not a raise: the HTTP layer renders it as an SSE error event
         assert events[-1].is_error()
+        assert "migration exhausted" in (events[-1].comment or [""])[0]
         assert eng.call == 3  # initial + 2 retries
+
+    asyncio.run(main())
+
+
+def test_migration_retry_max_tokens_never_below_one():
+    async def main():
+        # 5 tokens emitted against a 4-token budget before death (the engine
+        # overshoots by one step): the retry must ask for max(1, 4-5) = 1,
+        # never 0 or negative (engines reject those)
+        eng = _ScriptedEngine([5, None])
+        mig = Migration(eng, migration_limit=3)
+        req = PreprocessedRequest(token_ids=[1, 2], stop_conditions={"max_tokens": 4})
+        async for _ in mig.generate(req, Context()):
+            pass
+        assert eng.requests[1].stop_conditions["max_tokens"] == 1
+        # and the emitted tokens rode along in the retry prompt
+        assert eng.requests[1].token_ids == [1, 2, 2, 3, 4, 5, 6]
+
+    asyncio.run(main())
+
+
+def test_migration_stops_immediately_when_context_stopped():
+    async def main():
+        eng = _ScriptedEngine([2, None])
+        mig = Migration(eng, migration_limit=3)
+        req = PreprocessedRequest(token_ids=[1], stop_conditions={"max_tokens": 10})
+        ctx = Context()
+        events = []
+        async for ann in mig.generate(req, ctx):
+            events.append(ann)
+            ctx.stop_generating()  # caller cancelled mid-stream
+        # the StreamLost after the stop must NOT trigger a retry (the
+        # caller is gone) and must not surface as an error either
+        assert eng.call == 1
+        assert not any(e.is_error() for e in events)
+
+        eng2 = _ScriptedEngine([2, None])
+        mig2 = Migration(eng2, migration_limit=3)
+        ctx2 = Context()
+        async for _ in mig2.generate(req, ctx2):
+            ctx2.kill()
+        assert eng2.call == 1
+
+    asyncio.run(main())
+
+
+def test_migration_stops_retrying_past_deadline():
+    async def main():
+        eng = _ScriptedEngine([2, 2, None])
+        mig = Migration(eng, migration_limit=5)
+        req = PreprocessedRequest(token_ids=[1], stop_conditions={"max_tokens": 10})
+        ctx = Context().set_deadline(0.0)  # budget already spent
+        events = []
+        async for ann in mig.generate(req, ctx):
+            events.append(ann)
+        # one attempt, then a clean typed error — no retry burn past the
+        # request budget
+        assert eng.call == 1
+        assert events[-1].is_error()
+        assert "deadline" in (events[-1].comment or [""])[0]
 
     asyncio.run(main())
 
