@@ -1,0 +1,250 @@
+#!/usr/bin/env python
+"""Serving-plane overhead bench: frontend → router → worker → SSE on CPU.
+
+Measures the token path the ISSUE-4 serving-gap work targets, WITHOUT a
+TPU: mocker workers decode at a known synthetic rate, so everything above
+the engine — slot queues, request-plane frames, detokenization, SSE
+assembly — is what the measured throughput actually prices. Reports:
+
+  * aggregate streamed tok/s across N concurrent SSE streams
+  * serving-plane overhead in µs/token (wall time minus the mocker's
+    synthetic engine time, over total streamed tokens)
+  * mean tokens per SSE event (frontend-side batching signal)
+  * worker-side items/frames ratio (request-plane coalescing signal,
+    scraped from the frontend's tokens-per-frame histogram + the metrics
+    topic republished by WorkerMetricsPublisher)
+  * TTFT p50/p99 per stream
+
+Usage:
+  python bench_serving_overhead.py                      # default load
+  python bench_serving_overhead.py --streams 16 --osl 128
+  python bench_serving_overhead.py --smoke --min-tok-s 300   # CI gate
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import signal
+import socket
+import statistics
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def spawn(args, name, env=None):
+    full_env = dict(os.environ)
+    full_env["JAX_PLATFORMS"] = "cpu"
+    prev = ":".join(
+        p for p in full_env.get("PYTHONPATH", "").split(":")
+        if p and ".axon_site" not in p
+    )
+    full_env["PYTHONPATH"] = f"{REPO}:{prev}" if prev else str(REPO)
+    if env:
+        full_env.update(env)
+    log = open(f"/tmp/bench_overhead_{name}.log", "wb")
+    return subprocess.Popen(
+        [sys.executable, *args], env=full_env, stdout=log, stderr=subprocess.STDOUT
+    )
+
+
+async def wait_ready(base: str, timeout: float = 30.0):
+    import aiohttp
+
+    deadline = time.monotonic() + timeout
+    async with aiohttp.ClientSession() as sess:
+        while time.monotonic() < deadline:
+            try:
+                async with sess.get(base + "/v1/models") as r:
+                    if r.status == 200 and (await r.json())["data"]:
+                        return
+            except aiohttp.ClientError:
+                pass
+            await asyncio.sleep(0.2)
+    raise TimeoutError("frontend/model never became ready")
+
+
+async def one_stream(sess, base: str, idx: int, osl: int) -> dict:
+    """Run one streaming chat completion; returns per-stream measurements."""
+    body = {
+        "model": "bench-model",
+        "messages": [
+            {"role": "user", "content": f"serving overhead bench prompt {idx} "
+             + "q" * 64}
+        ],
+        "stream": True,
+        "max_tokens": osl,
+        "stream_options": {"include_usage": True},
+    }
+    t0 = time.monotonic()
+    ttft = None
+    events = 0
+    completion_tokens = 0
+    async with sess.post(base + "/v1/chat/completions", json=body) as resp:
+        assert resp.status == 200, await resp.text()
+        async for raw in resp.content:
+            line = raw.decode().strip()
+            if not line.startswith("data: ") or line == "data: [DONE]":
+                continue
+            chunk = json.loads(line[6:])
+            if chunk.get("usage"):
+                completion_tokens = chunk["usage"]["completion_tokens"]
+                continue
+            delta = (chunk.get("choices") or [{}])[0].get("delta", {})
+            if delta.get("content"):
+                events += 1
+                if ttft is None:
+                    ttft = time.monotonic() - t0
+    return {
+        "wall_s": time.monotonic() - t0,
+        "ttft_s": ttft,
+        "sse_events": events,
+        "completion_tokens": completion_tokens,
+    }
+
+
+def scrape_tokens_per_frame(metrics_text: str) -> float | None:
+    """Mean of the frontend's dynamo_frontend_tokens_per_frame histogram."""
+    total = count = None
+    for line in metrics_text.splitlines():
+        if line.startswith("dynamo_frontend_tokens_per_frame_sum"):
+            total = float(line.rsplit(" ", 1)[1])
+        elif line.startswith("dynamo_frontend_tokens_per_frame_count"):
+            count = float(line.rsplit(" ", 1)[1])
+    if total is not None and count:
+        return total / count
+    return None
+
+
+async def run_bench(args) -> dict:
+    import aiohttp
+
+    http_port = free_port()
+    disc = f"tcp://127.0.0.1:{free_port()}"
+    procs = [
+        spawn(
+            ["-m", "dynamo_tpu.frontend", "--http-port", str(http_port),
+             "--embed-discovery", "--discovery", disc],
+            "frontend",
+        )
+    ]
+    for i in range(args.workers):
+        procs.append(
+            spawn(
+                ["-m", "dynamo_tpu.mocker", "--model-name", "bench-model",
+                 "--discovery", disc, "--speedup-ratio", str(args.speedup),
+                 "--block-size", "16"],
+                f"mocker{i}",
+                # the mocker decodes one token per step (worst case for the
+                # serving plane); a small coalesce window is what turns its
+                # singleton emissions into multi-item frames — the real
+                # engine's K-step blocks batch with the window at 0
+                env={"DYN_STREAM_COALESCE_MS": str(args.coalesce_ms)},
+            )
+        )
+    base = f"http://127.0.0.1:{http_port}"
+    try:
+        await wait_ready(base)
+        conn = aiohttp.TCPConnector(limit=args.streams + 4)
+        async with aiohttp.ClientSession(connector=conn) as sess:
+            # tiny warmup round so connection setup/compile-analogous costs
+            # don't pollute the measured window
+            await asyncio.gather(*(one_stream(sess, base, 900 + i, 4)
+                                   for i in range(min(args.streams, 4))))
+            t0 = time.monotonic()
+            results = await asyncio.gather(
+                *(one_stream(sess, base, i, args.osl)
+                  for i in range(args.streams))
+            )
+            wall = time.monotonic() - t0
+            async with sess.get(base + "/metrics") as r:
+                tpf = scrape_tokens_per_frame(await r.text())
+    finally:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+    total_tokens = sum(r["completion_tokens"] for r in results)
+    total_events = sum(r["sse_events"] for r in results)
+    ttfts = sorted(r["ttft_s"] for r in results if r["ttft_s"] is not None)
+    # the mocker's synthetic engine time for the measured window: osl decode
+    # steps, each decoding every concurrent stream in one step
+    per_step = (0.008 + args.streams * 60e-6) / args.speedup
+    ideal_s = args.osl * per_step
+    overhead_us = (
+        (wall - ideal_s) / total_tokens * 1e6 if total_tokens else None
+    )
+    return {
+        "streams": args.streams,
+        "osl": args.osl,
+        "workers": args.workers,
+        "speedup": args.speedup,
+        "wall_s": round(wall, 3),
+        "total_tokens": total_tokens,
+        "tok_s": round(total_tokens / wall, 1) if wall else None,
+        "engine_ideal_s": round(ideal_s, 3),
+        "serving_overhead_us_per_tok": round(overhead_us, 1)
+        if overhead_us is not None else None,
+        "sse_events": total_events,
+        "tokens_per_sse_event": round(total_tokens / total_events, 2)
+        if total_events else None,
+        "frontend_tokens_per_frame": round(tpf, 2) if tpf else None,
+        "ttft_p50_s": round(statistics.median(ttfts), 4) if ttfts else None,
+        "ttft_p99_s": round(ttfts[max(0, int(len(ttfts) * 0.99) - 1)], 4)
+        if ttfts else None,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--streams", type=int, default=8,
+                    help="concurrent SSE streams (acceptance: batch >= 8)")
+    ap.add_argument("--osl", type=int, default=96, help="tokens per stream")
+    ap.add_argument("--workers", type=int, default=1, help="mocker workers")
+    ap.add_argument("--speedup", type=float, default=100.0,
+                    help="mocker speedup_ratio (higher = engine further "
+                    "from being the bottleneck)")
+    ap.add_argument("--coalesce-ms", type=float, default=3.0,
+                    help="DYN_STREAM_COALESCE_MS for the workers (0 = "
+                    "measure the pure ready-drain path)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI gate: exit 1 below --min-tok-s or if streams "
+                    "averaged <= 1 token per frame")
+    ap.add_argument("--min-tok-s", type=float, default=300.0,
+                    help="generous non-regression floor for --smoke")
+    args = ap.parse_args()
+
+    out = asyncio.run(run_bench(args))
+    print(json.dumps(out, indent=2))
+    if args.smoke:
+        ok = True
+        if (out["tok_s"] or 0) < args.min_tok_s:
+            print(f"SMOKE FAIL: {out['tok_s']} tok/s < floor {args.min_tok_s}",
+                  file=sys.stderr)
+            ok = False
+        tpf = out["frontend_tokens_per_frame"] or out["tokens_per_sse_event"] or 0
+        if tpf <= 1.0:
+            print(f"SMOKE FAIL: tokens-per-frame mean {tpf} <= 1 "
+                  "(token path not batching)", file=sys.stderr)
+            ok = False
+        sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -410,6 +410,11 @@ class JaxEngine:
         # dispatches run serialized on the single device thread, so these
         # sum to device-stream busy time (the serving-gap diagnostic)
         self._dev_time: Dict[str, tuple] = {}
+        # emit batching (tokens-per-delta-batch): mean > 1 in steady decode
+        # means the serving plane is getting whole blocks, not singletons —
+        # the self-diagnosing coalescing signal on hardware e2e rows
+        self.emit_batches = 0
+        self.emit_tokens = 0
         # decode pipeline: device-resident carry (tokens/positions/seq_lens)
         # + up to two in-flight K-step blocks
         self._carry = None  # (tokens_dev, positions_dev, seq_lens_dev)
@@ -1387,6 +1392,8 @@ class JaxEngine:
         out["kv_pulls_completed"] = self.kv_pulls_completed
         out["kv_pages_pulled"] = self.kv_pages_pulled
         out["kv_skip_ahead_blocks"] = self.prefix_skip_ahead_blocks
+        out["emit_batches"] = self.emit_batches
+        out["emit_tokens"] = self.emit_tokens
         for tag, (cnt, tot) in self._dev_time.items():
             out[f"dispatch_{tag}_count"] = cnt
             out[f"dispatch_{tag}_s"] = round(tot, 3)
@@ -3247,6 +3254,11 @@ class JaxEngine:
             # emitted token t of a round lands at (pos + 1 + t) with
             # pos = seq_before - 1 — matching the device ring exactly
             pos = seq_before[i] - 1
+            # all accepted rounds flow into one delta batch (same O(1)-per-
+            # dispatch contract as _process_block); a stop mid-round
+            # truncates host-side before anything reaches the client
+            batch: List[int] = []
+            finish = None
             for s in range(S):
                 k = int(n_emit[s, i])
                 for t in range(k):
@@ -3256,13 +3268,17 @@ class JaxEngine:
                     slot.last_token = tok
                     if self.hist is not None:
                         self.hist[i, (pos + 1 + t) % Hc] = tok
-                    self._emit_token(slot, tok)
-                    self._maybe_finish(slot, tok)
-                    if slot.done:
+                    batch.append(tok)
+                    finish = self._finish_reason(slot, tok)
+                    if finish:
                         break
                 pos += k
-                if slot.done:
+                if finish:
                     break
+            self._emit_tokens(slot, batch, [], [])
+            if finish:
+                self._emit_finish(slot, finish)
+                self._release_slot(slot)
 
     def _process_block(self, lanes: List[tuple], toks: np.ndarray,
                        lps: np.ndarray, tids: np.ndarray,
@@ -3281,6 +3297,17 @@ class JaxEngine:
                 self._emit_finish(slot, "cancelled")
                 self._release_slot(slot)
                 continue
+            # the whole K-step block lands in ONE delta batch on the slot
+            # queue: downstream (request plane, detokenizer, SSE) then pays
+            # O(1) work per dispatch instead of per token. A mid-block
+            # stop/eos truncates host-side — tokens past it were speculated
+            # by the device and are never client-visible. The batch commits
+            # atomically: resume/migration accounting counts it all-or-
+            # nothing, exactly like the singleton emissions it replaces.
+            batch: List[int] = []
+            batch_lps: List[float] = []
+            batch_tops: List[Optional[dict]] = []
+            finish = None
             for k in range(K):
                 tok = int(toks[k, i])
                 slot.seq.append(tok)
@@ -3291,13 +3318,19 @@ class JaxEngine:
                     slot.guided_state = slot.guided_fsm.advance(
                         slot.guided_state, tok
                     )
-                self._emit_token(
-                    slot, tok, float(lps[k, i]),
-                    self._top_entry(slot, tids[k, i], tlps[k, i]),
-                )
-                self._maybe_finish(slot, tok)
-                if slot.done:
+                batch.append(tok)
+                if slot.want_logprobs:
+                    batch_lps.append(float(lps[k, i]))
+                    batch_tops.append(
+                        self._top_entry(slot, tids[k, i], tlps[k, i])
+                    )
+                finish = self._finish_reason(slot, tok)
+                if finish:
                     break
+            self._emit_tokens(slot, batch, batch_lps, batch_tops)
+            if finish:
+                self._emit_finish(slot, finish)
+                self._release_slot(slot)
 
     def _fail_all(self, message: str):
         """A step raised: the batch state is unreliable. Error every live
@@ -3335,16 +3368,38 @@ class JaxEngine:
         ).to_dict()
         slot.queue.put_nowait(Annotated(data=out).to_dict())
 
-    def _maybe_finish(self, slot: _Slot, token: int):
-        finish = None
+    def _emit_tokens(self, slot: _Slot, tokens: List[int],
+                     lps: List[float], tops: List[Optional[dict]]):
+        """Emit a decode block's accepted tokens as ONE delta batch.
+        `lps`/`tops` are 1:1 with `tokens` when the request asked for
+        logprobs, else empty. The batch is committed atomically to the
+        slot queue — the serving plane never sees a partial block."""
+        if slot.done or not tokens:
+            return
+        out = LLMEngineOutput(
+            token_ids=tokens,
+            log_probs=lps if (slot.want_logprobs and lps) else None,
+            top_logprobs=tops if any(tops) else None,
+        ).to_dict()
+        slot.queue.put_nowait(Annotated(data=out).to_dict())
+        self.emit_batches += 1
+        self.emit_tokens += len(tokens)
+
+    def _finish_reason(self, slot: _Slot, token: int) -> Optional[str]:
+        """Host-side stop check for one generated token (eos / stop token
+        / length) — pure, so block loops can truncate before emitting."""
         if (
             not slot.ignore_eos
             and slot.generated >= slot.min_tokens
             and (token in slot.eos_ids or token in slot.stop_token_ids)
         ):
-            finish = "eos"
-        elif slot.generated >= slot.max_tokens:
-            finish = "length"
+            return "eos"
+        if slot.generated >= slot.max_tokens:
+            return "length"
+        return None
+
+    def _maybe_finish(self, slot: _Slot, token: int):
+        finish = self._finish_reason(slot, token)
         if finish:
             self._emit_finish(slot, finish)
             self._release_slot(slot)

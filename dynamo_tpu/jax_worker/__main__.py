@@ -411,6 +411,9 @@ async def main():
         "kv_pages_pulled", "num_waiting_reqs", "num_running_reqs",
         "kv_skip_ahead_blocks", "guided_requests", "lora_requests",
         "spec_num_drafts", "spec_num_accepted_tokens",
+        # tokens/batches ratio = tokens-per-delta-batch (serving-gap
+        # coalescing diagnostic; mean > 1 in steady decode)
+        "emit_batches", "emit_tokens",
     ):
         # registry prepends the "dynamo" prefix -> dynamo_worker_<stat>
         drt.metrics.callback_gauge(
@@ -419,9 +422,13 @@ async def main():
         )
 
     model_name = args.model_name or args.model
+    card = None
     if args.role != "prefill":
         # only decode/aggregated workers front the model (reference: the
-        # prefill pool is internal, reached by decode orchestration)
+        # prefill pool is internal, reached by decode orchestration).
+        # Publication is deferred until AFTER serve_endpoint below: the
+        # card is what makes frontends build a pipeline, so the instance
+        # must already be live (and warmup done) when it appears.
         card = ModelDeploymentCard(
             name=model_name,
             # the card's tokenizer is the SERVING contract: frontend
@@ -434,7 +441,6 @@ async def main():
             migration_limit=args.migration_limit,
             lora_adapters=engine.lora_names(),
         )
-        await register_llm(endpoint, card)
 
     prefill_client = None
     disagg_router = None
@@ -518,13 +524,15 @@ async def main():
         async for item in engine.generate(request, context):
             yield item
 
+    await endpoint.serve_endpoint(handler)
+    if card is not None:
+        await register_llm(endpoint, card)
     logger.info(
         "jax worker up: model=%s tp=%d instance=%x",
         model_name,
         args.tp_size,
         drt.instance_id,
     )
-    await endpoint.serve_endpoint(handler)
     await drt.wait_for_shutdown()
     # graceful drain: lease revoked first (routers stop picking us), then
     # in-flight streams finish within DYN_RUNTIME_GRACEFUL_SHUTDOWN_TIMEOUT,

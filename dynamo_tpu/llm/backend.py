@@ -8,15 +8,98 @@ stream into text deltas and enforcing stop strings that the engine can't see
 
 from __future__ import annotations
 
+import asyncio
 import logging
 from typing import Any, AsyncIterator, Dict, List, Optional
 
+from ..runtime.config import _env
 from ..runtime.engine import AsyncEngine, Context
 from ..runtime.pipeline import Operator
 from .protocols import Annotated, LLMEngineOutput, PreprocessedRequest
 from .tokenizers import Tokenizer
 
 logger = logging.getLogger(__name__)
+
+
+def _mergeable_ids(ann: Annotated) -> Optional[List[int]]:
+    """token_ids if `ann` is a PURE token delta (no event/text/logprobs/
+    finish/usage riders) — the only shape safe to concatenate."""
+    if ann.event is not None or ann.comment:
+        return None
+    d = ann.data
+    if isinstance(d, LLMEngineOutput):
+        d = d.to_dict()
+    if not isinstance(d, dict) or set(d) - {"token_ids"}:
+        return None
+    return d.get("token_ids") or None
+
+
+async def merge_token_deltas(
+    stream: AsyncIterator[Any], max_items: int = 0
+) -> AsyncIterator[Annotated]:
+    """Merge already-ready pure-token items into one delta batch.
+
+    A pump task drains the upstream while the consumer works; each
+    iteration takes everything the pump has ready (never waiting, so a
+    slow stream's latency is untouched) and concatenates consecutive
+    token-only deltas. Engines that emit per-token (the mocker; a real
+    engine between block boundaries) thus still reach the detokenizer and
+    SSE assembler as batches — O(1) frontend work per event-loop tick."""
+    if max_items <= 0:
+        max_items = max(_env("DYN_STREAM_COALESCE_MAX_ITEMS", 64, int), 1)
+    done = object()
+    queue: asyncio.Queue = asyncio.Queue()
+
+    async def pump():
+        try:
+            async for item in stream:
+                queue.put_nowait(item)
+            queue.put_nowait(done)
+        except asyncio.CancelledError:
+            raise
+        except BaseException as e:  # noqa: BLE001 — re-raised consumer-side
+            queue.put_nowait(e)
+
+    pump_task = asyncio.create_task(pump())
+    try:
+        held: Optional[Annotated] = None
+        while True:
+            if held is not None:
+                item, held = held, None
+            else:
+                item = await queue.get()
+            if item is done:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            ann = item if isinstance(item, Annotated) else Annotated.from_dict(item)
+            ids = _mergeable_ids(ann)
+            if ids is None:
+                yield ann
+                continue
+            merged = list(ids)
+            terminal = None
+            while len(merged) < max_items:
+                try:
+                    nxt = queue.get_nowait()
+                except asyncio.QueueEmpty:
+                    break
+                if nxt is done or isinstance(nxt, BaseException):
+                    terminal = nxt
+                    break
+                nann = nxt if isinstance(nxt, Annotated) else Annotated.from_dict(nxt)
+                nids = _mergeable_ids(nann)
+                if nids is None:
+                    held = nann
+                    break
+                merged.extend(nids)
+            yield Annotated(data={"token_ids": merged}, id=ann.id)
+            if terminal is not None:
+                if terminal is done:
+                    return
+                raise terminal
+    finally:
+        pump_task.cancel()
 
 
 class Decoder:
@@ -45,6 +128,10 @@ class Decoder:
         delta = self._stream.step(token_id)
         if delta is None:
             return None, False
+        return self._scan(delta)
+
+    def _scan(self, delta: str) -> tuple[Optional[str], bool]:
+        """Stop-string scan + holdback over newly decoded text."""
         if not self._stop_strings:
             return delta, False
         window = self._pending + delta
@@ -57,6 +144,42 @@ class Decoder:
         emit = window[: len(window) - hold] if hold else window
         self._pending = window[len(window) - hold :] if hold else ""
         return (emit or None), False
+
+    def step_batch(self, token_ids: List[int]) -> tuple[Optional[str], int, bool]:
+        """Feed a whole delta batch; returns (text_delta, n_consumed, hit).
+
+        One tokenizer decode for the batch; stop-string holdback applies to
+        the joined text, so a stop string straddling a batch boundary is
+        caught exactly as in per-token stepping. On a hit the batch replays
+        per-token from a state snapshot to attribute the hit to its token —
+        `n_consumed` then counts tokens up to and including it, so usage
+        accounting matches the singleton-emission path. The replay happens
+        at most once per request (at stream end)."""
+        if not token_ids:
+            return None, 0, False
+        if not self._stop_strings:
+            return self._stream.step_batch(token_ids), len(token_ids), False
+        snap = self._stream.snapshot()
+        pending = self._pending
+        delta = self._stream.step_batch(token_ids)
+        window = pending + (delta or "")
+        if not any(s in window for s in self._stop_strings):
+            if delta is None:
+                return None, len(token_ids), False
+            emit, _hit = self._scan(delta)
+            return emit, len(token_ids), False
+        # a stop string completed somewhere inside the batch: replay to
+        # find WHICH token finished it (tokens past it were never "said")
+        self._stream.restore(snap)
+        self._pending = pending
+        parts: List[str] = []
+        for n, tok in enumerate(token_ids, start=1):
+            emit, hit = self.step(tok)
+            if emit:
+                parts.append(emit)
+            if hit:
+                return ("".join(parts) or None), n, True
+        return ("".join(parts) or None), len(token_ids), False
 
 
 class Backend(Operator):
@@ -85,8 +208,7 @@ class Backend(Operator):
         stop_strings = request.stop_conditions.get("stop") or []
         decoder = Decoder(self.tokenizer, stop_strings)
         stopped = False
-        async for item in stream:
-            ann = item if isinstance(item, Annotated) else Annotated.from_dict(item)
+        async for ann in merge_token_deltas(stream):
             if ann.data is None:
                 yield ann  # pure annotation/error event passes through
                 continue
@@ -97,33 +219,49 @@ class Backend(Operator):
             )
             text_parts: List[str] = []
             lp_entries: List[dict] = []
-            for idx, tok in enumerate(out.token_ids):
-                delta, hit = decoder.step(tok)
+            if out.log_probs is None:
+                # batched fast path: one tokenizer call for the whole
+                # delta batch; tokens past a stop-string hit are dropped
+                # so usage accounting matches per-token stepping
+                delta, n_used, stopped = decoder.step_batch(out.token_ids)
+                if n_used < len(out.token_ids):
+                    out.token_ids = out.token_ids[:n_used]
                 if delta:
                     text_parts.append(delta)
-                if out.log_probs is not None and idx < len(out.log_probs):
+            else:
+                for idx, tok in enumerate(out.token_ids):
+                    delta, hit = decoder.step(tok)
+                    if delta:
+                        text_parts.append(delta)
                     # per-token pairing happens HERE. The entry's token
                     # string decodes the id directly — the incremental
                     # delta can be empty (multi-byte UTF-8 split, stop-
                     # string holdback) and entries must stay 1:1 with
                     # tokens for legacy-completions alignment
-                    entry = {"token": self.tokenizer.decode([tok]),
-                             "logprob": out.log_probs[idx]}
-                    tops = out.top_logprobs
-                    if tops and idx < len(tops) and tops[idx]:
-                        entry["top_logprobs"] = [
-                            {
-                                "token": self.tokenizer.decode([tid]),
-                                "logprob": tlp,
-                            }
-                            for tid, tlp in zip(
-                                tops[idx]["ids"], tops[idx]["logprobs"]
-                            )
-                        ]
-                    lp_entries.append(entry)
-                if hit:
-                    stopped = True
-                    break
+                    if idx < len(out.log_probs):
+                        entry = {"token": self.tokenizer.decode([tok]),
+                                 "logprob": out.log_probs[idx]}
+                        tops = out.top_logprobs
+                        if tops and idx < len(tops) and tops[idx]:
+                            entry["top_logprobs"] = [
+                                {
+                                    "token": self.tokenizer.decode([tid]),
+                                    "logprob": tlp,
+                                }
+                                for tid, tlp in zip(
+                                    tops[idx]["ids"], tops[idx]["logprobs"]
+                                )
+                            ]
+                        lp_entries.append(entry)
+                    if hit:
+                        stopped = True
+                        # truncate the batch at the hit token: 1:1 entry
+                        # alignment and token counts end where the text did
+                        out.token_ids = out.token_ids[: idx + 1]
+                        out.log_probs = out.log_probs[: idx + 1]
+                        if out.top_logprobs:
+                            out.top_logprobs = out.top_logprobs[: idx + 1]
+                        break
             if out.text is None:
                 out.text = "".join(text_parts) if text_parts else None
             if lp_entries:

@@ -73,6 +73,17 @@ class HttpMetrics:
             ["model"],
             registry=self.registry,
         )
+        # token-path batching visibility: tokens per streamed delta batch
+        # (= per SSE event). Mean > 1 in steady decode means the batched
+        # emit/coalesce path is active end-to-end; mean == 1 flags a
+        # serving plane paying per-token overhead again.
+        self.tokens_per_frame = Histogram(
+            f"{ns}_tokens_per_frame",
+            "Generated tokens carried by each streamed delta batch",
+            ["model"],
+            registry=self.registry,
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128),
+        )
 
     def request_start(self, model: str, endpoint: str):
         self.inflight.labels(model, endpoint).inc()
@@ -105,6 +116,9 @@ class HttpMetrics:
 
     def observe_ttft(self, model: str, seconds: float):
         self.ttft.labels(model).observe(seconds)
+
+    def observe_tokens_per_frame(self, model: str, n_tokens: int):
+        self.tokens_per_frame.labels(model).observe(n_tokens)
 
     def client_disconnect(self, model: str):
         self.disconnects.labels(model).inc()

@@ -44,9 +44,18 @@ from .metrics import HttpMetrics
 logger = logging.getLogger(__name__)
 
 
+#: compact separators on every wire-bound json.dumps — SSE framing bytes
+#: are pure per-token overhead (llm/preprocessor.py COMPACT is the same
+#: contract for the chunk templates)
+_COMPACT = (",", ":")
+
+
 def _sse_event(event: str, data: dict) -> bytes:
     """Named SSE event frame (Responses API framing)."""
-    return f"event: {event}\ndata: {json.dumps(data)}\n\n".encode()
+    return (
+        f"event: {event}\ndata: "
+        f"{json.dumps(data, separators=_COMPACT)}\n\n".encode()
+    )
 
 
 def _content_text(message: dict) -> str:
@@ -542,20 +551,20 @@ class HttpService:
                 if ann is None:
                     done += 1
                     if not finished[i] and not error:
-                        await resp.write(_sse(
-                            gen.finish_chunk("stop").model_dump_json(
-                                exclude_none=True)))
+                        await resp.write(_sse(gen.finish_chunk_json("stop")))
                         finished[i] = True
                     continue
                 if ann.is_error():
                     error = True
                     msg = (ann.comment or ["engine error"])[0]
-                    await resp.write(
-                        _sse(json.dumps({"error": {"message": msg}})))
+                    await resp.write(_sse(json.dumps(
+                        {"error": {"message": msg}}, separators=_COMPACT)))
                     break
                 if ann.event is not None:
                     await resp.write(
-                        f": {ann.event} {json.dumps(ann.comment)}\n\n".encode()
+                        f": {ann.event} "
+                        f"{json.dumps(ann.comment, separators=_COMPACT)}"
+                        "\n\n".encode()
                     )
                     continue
                 out: LLMEngineOutput = ann.data
@@ -565,6 +574,8 @@ class HttpService:
                         first_token_at = last_token_at
                         self.metrics.observe_ttft(
                             req.model, first_token_at - t0)
+                    self.metrics.observe_tokens_per_frame(
+                        req.model, len(out.token_ids))
                 if out.reasoning_content:
                     await resp.write(_sse(gen.reasoning_chunk(
                         out.reasoning_content).model_dump_json(
@@ -573,16 +584,22 @@ class HttpService:
                     await resp.write(_sse(gen.tool_calls_chunk(
                         out.tool_calls).model_dump_json(exclude_none=True)))
                 if out.text or out.logprob_entries:
-                    await resp.write(_sse(gen.text_chunk(
-                        out.text or "", len(out.token_ids),
-                        logprob_entries=out.logprob_entries,
-                    ).model_dump_json(exclude_none=True)))
+                    # one SSE event per delta batch; the preserialized
+                    # template path serializes only the delta fields
+                    if out.logprob_entries:
+                        payload = gen.text_chunk(
+                            out.text or "", len(out.token_ids),
+                            logprob_entries=out.logprob_entries,
+                        ).model_dump_json(exclude_none=True)
+                    else:
+                        payload = gen.text_chunk_json(
+                            out.text or "", len(out.token_ids))
+                    await resp.write(_sse(payload))
                 elif out.token_ids:
                     gen.completion_tokens += len(out.token_ids)
                 if out.finish_reason and not finished[i]:
-                    await resp.write(_sse(gen.finish_chunk(
-                        out.finish_reason).model_dump_json(
-                            exclude_none=True)))
+                    await resp.write(_sse(gen.finish_chunk_json(
+                        out.finish_reason)))
                     finished[i] = True
             if not error and gens[0].include_usage:
                 usage = gens[0].usage_chunk()
@@ -606,79 +623,6 @@ class HttpService:
                 first_token_at=first_token_at, last_token_at=last_token_at,
             )
         return resp
-
-    async def _unary_chat_multi(
-        self, req, streams, gens, ctx: Context, t0
-    ) -> web.Response:
-        """n>1 non-streamed: collect every choice, answer once."""
-        from ..protocols.openai import chat_logprobs
-
-        async def collect(s):
-            texts, reasoning, tools, lp_entries = [], [], [], []
-            finish, n_out, err = "stop", 0, None
-            async for ann in s:
-                if ann.is_error():
-                    err = (ann.comment or ["engine error"])[0]
-                    break
-                if ann.event is not None:
-                    continue
-                out: LLMEngineOutput = ann.data
-                n_out += len(out.token_ids)
-                if out.reasoning_content:
-                    reasoning.append(out.reasoning_content)
-                if out.tool_calls:
-                    tools.extend(out.tool_calls)
-                if out.text:
-                    texts.append(out.text)
-                if out.logprob_entries:
-                    lp_entries.extend(out.logprob_entries)
-                if out.finish_reason:
-                    finish = ("stop" if out.finish_reason == "eos"
-                              else out.finish_reason)
-                    break
-            return texts, reasoning, tools, lp_entries, finish, n_out, err
-
-        results = await asyncio.gather(*[collect(s) for s in streams])
-        total_out = sum(r[5] for r in results)
-        self.metrics.request_end(
-            req.model, "chat", t0, error=any(r[6] for r in results),
-            output_tokens=total_out, input_tokens=gens[0].prompt_tokens,
-        )
-        for r in results:
-            if r[6]:
-                return self._error(500, r[6], "engine_error")
-        choices = []
-        for i, (texts, reasoning, tools, lp_entries, finish, _n, _e) in \
-                enumerate(results):
-            message = ChatMessage(role="assistant", content="".join(texts))
-            if reasoning:
-                message.reasoning_content = "".join(reasoning)
-            if tools:
-                from ..protocols.openai import ToolCall
-
-                message.tool_calls = [
-                    ToolCall.model_validate(tc) for tc in tools]
-                message.content = message.content or None
-            choices.append(Choice(
-                index=i, message=message, finish_reason=finish,
-                logprobs=chat_logprobs(lp_entries),
-            ))
-        response = ChatCompletionResponse(
-            id=gens[0].id,
-            model=req.model,
-            choices=choices,
-            usage=Usage(
-                prompt_tokens=gens[0].prompt_tokens,
-                completion_tokens=total_out,
-                total_tokens=gens[0].prompt_tokens + total_out,
-            ),
-        )
-        return web.json_response(response.model_dump(exclude_none=True))
-
-    async def _unary_chat(
-        self, req, stream: AsyncIterator[Annotated], gen, ctx: Context, t0
-    ) -> web.Response:
-        return await self._unary_chat_multi(req, [stream], [gen], ctx, t0)
 
     async def _unary_chat_multi(
         self, req, streams, gens, ctx: Context, t0
@@ -864,11 +808,14 @@ class HttpService:
                 if ann.is_error():
                     error = True
                     msg = (ann.comment or ["engine error"])[0]
-                    await resp.write(_sse(json.dumps({"error": {"message": msg}})))
+                    await resp.write(_sse(json.dumps(
+                        {"error": {"message": msg}}, separators=_COMPACT)))
                     break
                 if ann.event is not None:
                     await resp.write(
-                        f": {ann.event} {json.dumps(ann.comment)}\n\n".encode()
+                        f": {ann.event} "
+                        f"{json.dumps(ann.comment, separators=_COMPACT)}"
+                        "\n\n".encode()
                     )
                     continue
                 out: LLMEngineOutput = ann.data
@@ -877,18 +824,29 @@ class HttpService:
                     if first_token_at is None:
                         first_token_at = last_token_at
                         self.metrics.observe_ttft(req.model, first_token_at - t0)
+                    self.metrics.observe_tokens_per_frame(
+                        req.model, len(out.token_ids))
                 if out.text or out.logprob_entries:
-                    await resp.write(
-                        _sse(gen.text_chunk(out.text or "", len(out.token_ids), logprob_entries=out.logprob_entries).model_dump_json(exclude_none=True))
-                    )
+                    if out.logprob_entries:
+                        payload = gen.text_chunk(
+                            out.text or "", len(out.token_ids),
+                            logprob_entries=out.logprob_entries,
+                        ).model_dump_json(exclude_none=True)
+                    else:
+                        payload = gen.text_chunk_json(
+                            out.text or "", len(out.token_ids))
+                    await resp.write(_sse(payload))
+                elif out.token_ids:
+                    # batch fully held back (mid multi-byte sequence /
+                    # stop-string holdback): no chunk, but the tokens
+                    # still count toward usage — same as the chat path
+                    gen.completion_tokens += len(out.token_ids)
                 if out.finish_reason:
-                    await resp.write(
-                        _sse(gen.finish_chunk(out.finish_reason).model_dump_json(exclude_none=True))
-                    )
+                    await resp.write(_sse(gen.finish_chunk_json(out.finish_reason)))
                     finish_sent = True
                     break
             if not error and not finish_sent:
-                await resp.write(_sse(gen.finish_chunk("stop").model_dump_json(exclude_none=True)))
+                await resp.write(_sse(gen.finish_chunk_json("stop")))
             await resp.write(b"data: [DONE]\n\n")
         except (ConnectionResetError, asyncio.CancelledError):
             ctx.kill()

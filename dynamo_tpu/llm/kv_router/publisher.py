@@ -90,6 +90,7 @@ class WorkerMetricsPublisher:
     ):
         self.drt = drt
         self.worker_id = worker_id
+        self.subject = endpoint.subject
         self.topic = METRICS_TOPIC_FMT.format(
             namespace=endpoint.component.namespace, component=endpoint.component.name
         )
@@ -100,6 +101,17 @@ class WorkerMetricsPublisher:
     async def start(self):
         self._task = asyncio.create_task(self._loop())
 
+    def _stats(self) -> dict:
+        stats = dict(self.stats_fn() or {})
+        # request-plane coalescing counters ride along: items/frames is the
+        # worker-side tokens-per-frame signal the serving-gap bench and
+        # hardware e2e rows read off this topic
+        ep = self.drt.server.stats(self.subject)
+        if ep is not None:
+            stats.setdefault("frames_total", ep.frames_total)
+            stats.setdefault("items_total", ep.items_total)
+        return stats
+
     async def _loop(self):
         while True:
             try:
@@ -107,7 +119,7 @@ class WorkerMetricsPublisher:
                     await self.drt.discovery.publish(
                         self.topic,
                         codec.pack(
-                            {"worker_id": self.worker_id, "stats": self.stats_fn()}
+                            {"worker_id": self.worker_id, "stats": self._stats()}
                         ),
                     )
             except ConnectionError:

@@ -99,6 +99,25 @@ class MockEngine:
         if self._step_task:
             self._step_task.cancel()
 
+    async def warmup(self, extra_delay: float = 0.0) -> int:
+        """Drive a few requests through the real step loop BEFORE the
+        worker joins the control plane (the JaxEngine.warmup contract:
+        first-iteration costs are paid pre-registration, never absorbed by
+        live traffic). `extra_delay` simulates compile time so ordering
+        tests can observe the not-yet-routable window."""
+        n = 0
+        for _ in range(2):
+            req = PreprocessedRequest(
+                token_ids=list(range(40, 56)),
+                stop_conditions={"max_tokens": 4, "ignore_eos": True},
+            ).to_dict()
+            async for _ in self.generate(req, Context()):
+                pass
+            n += 1
+        if extra_delay > 0:
+            await asyncio.sleep(extra_delay)
+        return n
+
     # -- public engine interface -------------------------------------------- #
 
     async def generate(

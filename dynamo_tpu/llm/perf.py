@@ -56,6 +56,17 @@ class StreamPerf:
     def total_tokens(self) -> int:
         return sum(r.num_tokens for r in self.responses)
 
+    def token_frames(self) -> int:
+        """Emissions that carried at least one token (delta batches)."""
+        return sum(1 for r in self.responses if r.num_tokens > 0)
+
+    def tokens_per_frame(self) -> Optional[float]:
+        """Mean tokens per delta batch — the token-path batching signal:
+        > 1 in steady decode means the serving plane is moving whole
+        blocks, not singletons (ISSUE 4 serving-gap diagnostic)."""
+        f = self.token_frames()
+        return self.total_tokens() / f if f else None
+
     def duration(self) -> float:
         return self.responses[-1].t if self.responses else 0.0
 
@@ -71,6 +82,7 @@ class StreamPerf:
             "total_tokens": self.total_tokens(),
             "duration_s": self.duration(),
             "tokens_per_second": self.tokens_per_second(),
+            "tokens_per_frame": self.tokens_per_frame(),
         }
 
 

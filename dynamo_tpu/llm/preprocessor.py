@@ -9,6 +9,7 @@ HF chat-template semantics).
 
 from __future__ import annotations
 
+import json
 import logging
 import secrets
 import time
@@ -265,6 +266,14 @@ class OpenAIPreprocessor:
 # backward path — delta generators
 # ---------------------------------------------------------------------- #
 
+#: compact JSON separators for everything that goes on the wire/SSE path —
+#: the default ", "/": " pads every token chunk with dead bytes
+COMPACT = (",", ":")
+
+
+def _cjson(obj: Any) -> str:
+    return json.dumps(obj, separators=COMPACT, ensure_ascii=False)
+
 
 class ChatDeltaGenerator:
     """Assemble OpenAI chat.completion.chunk SSE events from detokenized
@@ -281,6 +290,15 @@ class ChatDeltaGenerator:
         self.prompt_tokens = 0
         self.completion_tokens = 0
         self._first = True
+        # preserialized chunk template: everything but the delta fields is
+        # static per request, so the SSE hot loop serializes ONLY the delta
+        # (one small json.dumps per batch) instead of running a pydantic
+        # model_dump per token
+        self._tmpl = (
+            f'{{"id":{_cjson(self.id)},"object":"chat.completion.chunk",'
+            f'"created":{self.created},"model":{_cjson(self.model)},'
+            f'"choices":[{{"index":{self.index},"delta":'
+        )
 
     def role_chunk(self) -> ChatCompletionChunk:
         return ChatCompletionChunk(
@@ -306,6 +324,21 @@ class ChatDeltaGenerator:
             created=self.created,
             choices=[StreamChoice(index=self.index, delta=delta, logprobs=lp)],
         )
+
+    def text_chunk_json(self, text: str, n_tokens: int = 1) -> str:
+        """Preserialized fast path for plain content deltas (the steady-
+        state decode chunk); semantically identical to
+        `text_chunk(...).model_dump_json(exclude_none=True)`."""
+        self.completion_tokens += n_tokens
+        delta: Dict[str, str] = {"content": text}
+        if self._first:
+            delta = {"role": "assistant", "content": text}
+            self._first = False
+        return f"{self._tmpl}{_cjson(delta)}}}]}}"
+
+    def finish_chunk_json(self, reason: str) -> str:
+        reason = "stop" if reason == "eos" else reason
+        return f'{self._tmpl}{{}},"finish_reason":{_cjson(reason)}}}]}}'
 
     def reasoning_chunk(self, text: str, n_tokens: int = 0) -> ChatCompletionChunk:
         self.completion_tokens += n_tokens
@@ -371,6 +404,22 @@ class CompletionDeltaGenerator:
         self.prompt_tokens = 0
         self.completion_tokens = 0
         self._chars_sent = 0  # running text_offset base across chunks
+        # preserialized template (same contract as ChatDeltaGenerator)
+        self._tmpl = (
+            f'{{"id":{_cjson(self.id)},"object":"text_completion",'
+            f'"created":{self.created},"model":{_cjson(self.model)},'
+            f'"choices":[{{"index":0,"text":'
+        )
+
+    def text_chunk_json(self, text: str, n_tokens: int = 1) -> str:
+        """Preserialized fast path for plain text deltas (no logprobs)."""
+        self.completion_tokens += n_tokens
+        self._chars_sent += len(text)
+        return f"{self._tmpl}{_cjson(text)}}}]}}"
+
+    def finish_chunk_json(self, reason: str) -> str:
+        reason = "stop" if reason == "eos" else reason
+        return f'{self._tmpl}"","finish_reason":{_cjson(reason)}}}]}}'
 
     def text_chunk(self, text: str, n_tokens: int = 1,
                    logprob_entries=None) -> CompletionChunk:

@@ -219,4 +219,8 @@ class Annotated:
     def from_annotation(cls, name: str, value: Any) -> "Annotated":
         import json
 
-        return cls(data=None, event=name, comment=[json.dumps(value)])
+        # compact separators: annotation comments ride the SSE stream
+        return cls(
+            data=None, event=name,
+            comment=[json.dumps(value, separators=(",", ":"))],
+        )

@@ -47,22 +47,53 @@ class DecodeStream:
         self._prefix_index = 0
 
     def step(self, token_id: int) -> Optional[str]:
-        self._ids.append(token_id)
+        return self.step_batch([token_id])
+
+    def step_batch(self, token_ids: Sequence[int]) -> Optional[str]:
+        """Feed a whole delta batch with ONE tokenizer decode call.
+
+        Equivalent to concatenating `step()` deltas for the same ids:
+        decode is prefix-stable (decoding more ids only extends the text),
+        so the joined delta is identical — this is what makes engine-side
+        emit batching free for the detokenizer instead of K× cost."""
+        if not token_ids:
+            return None
+        self._ids.extend(token_ids)
         text = self._tok.decode(self._ids[self._prefix_index :], self._skip)
-        if text.endswith("�"):
-            return None  # mid multi-byte sequence; wait for more tokens
-        if len(text) <= len(self._prefix_text):
-            # no new visible text yet (e.g. special token skipped)
-            if text == self._prefix_text:
-                return None
-        delta = text[len(self._prefix_text) :]
-        # slide the window at whitespace boundaries to bound cost
-        if len(self._ids) - self._prefix_index > 16 and delta:
+        # withhold the trailing pending-multibyte run (replacement chars):
+        # those bytes stay buffered until later tokens complete them; the
+        # decodable prefix before the run IS stable and emits now
+        emit_upto = len(text)
+        while emit_upto > 0 and text[emit_upto - 1] == "�":
+            emit_upto -= 1
+        stable = text[:emit_upto]
+        if len(stable) <= len(self._prefix_text):
+            # no new visible text yet (special token skipped / all pending)
+            return None
+        delta = stable[len(self._prefix_text) :]
+        # slide the window to bound cost — only when nothing is pending,
+        # so buffered partial bytes keep decoding against their prefix
+        if (
+            emit_upto == len(text)
+            and len(self._ids) - self._prefix_index > 16
+            and delta
+        ):
             self._prefix_index = len(self._ids)
             self._prefix_text = ""
         else:
-            self._prefix_text = text
+            self._prefix_text = stable
         return delta or None
+
+    def snapshot(self) -> tuple:
+        """Cheap state capture for replay (stop-string hit attribution:
+        llm/backend.py re-steps a batch per-token to find the hit index)."""
+        return (len(self._ids), self._prefix_text, self._prefix_index)
+
+    def restore(self, state: tuple) -> None:
+        n_ids, prefix_text, prefix_index = state
+        del self._ids[n_ids:]
+        self._prefix_text = prefix_text
+        self._prefix_index = prefix_index
 
 
 class ByteTokenizer:

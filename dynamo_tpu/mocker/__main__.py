@@ -31,6 +31,10 @@ def parse_args():
     ap.add_argument("--no-prefix-caching", action="store_true")
     ap.add_argument("--migration-limit", type=int, default=3)
     ap.add_argument("--kv-events", action="store_true", help="publish KV events")
+    ap.add_argument("--warmup-delay", type=float, default=0.0,
+                    help="extra seconds of simulated compile time during "
+                    "warmup (ordering tests observe the pre-registration "
+                    "window with this)")
     return ap.parse_args()
 
 
@@ -63,9 +67,16 @@ async def main():
         publisher = KvEventPublisher(drt, endpoint, drt.instance_id)
         await publisher.start()
 
-    engine = MockEngine(
-        engine_args, event_sink=publisher.publish if publisher else None
-    )
+    engine = MockEngine(engine_args)
+
+    # warmup BEFORE anything is registered in discovery: the worker must
+    # not be routable until first-iteration costs are paid (same contract
+    # as the jax_worker --warmup flow; the KV-event sink attaches after so
+    # warmup prefixes never pollute the router index)
+    n_warm = await engine.warmup(extra_delay=args.warmup_delay)
+    logger.info("mocker warmup done: %d requests", n_warm)
+    if publisher is not None:
+        engine.kv.event_sink = publisher.publish
 
     from dynamo_tpu.llm.kv_router.publisher import WorkerMetricsPublisher
 
@@ -78,7 +89,6 @@ async def main():
         kv_cache_block_size=args.block_size,
         migration_limit=args.migration_limit,
     )
-    await register_llm(endpoint, card)
 
     # metrics publishing for the KV router's scheduler
     async def stats_loop():
@@ -115,8 +125,13 @@ async def main():
         async for item in engine.generate(request, context):
             yield item
 
-    logger.info("mocker worker up: model=%s instance=%x", args.model_name, drt.instance_id)
+    # instance first, card second: frontends build a model pipeline the
+    # moment the CARD appears, so the instance must already be live when
+    # they look — the reverse order opens a routable-but-absent window
+    # (StreamLost storms on cold start)
     await endpoint.serve_endpoint(handler)
+    await register_llm(endpoint, card)
+    logger.info("mocker worker up: model=%s instance=%x", args.model_name, drt.instance_id)
     await drt.wait_for_shutdown()
     await drt.close()  # graceful drain (runtime/component.py close())
 

@@ -56,7 +56,13 @@ async def read_frame(reader: asyncio.StreamReader) -> Optional[Tuple[dict, bytes
 async def write_frame(
     writer: asyncio.StreamWriter, control: dict, payload: bytes = b""
 ):
-    writer.write(encode_frame(control, payload))
+    # corked write: hand the transport the three segments in one call
+    # instead of concatenating header+payload into a fresh buffer — on the
+    # token hot path the payload is the large part and must not be copied
+    header = msgpack.packb(control, use_bin_type=True)
+    writer.writelines(
+        (_HDR.pack(MAGIC, len(header), len(payload)), header, payload)
+    )
     await writer.drain()
 
 

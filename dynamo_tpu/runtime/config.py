@@ -115,6 +115,18 @@ ENV_REGISTRY: tuple = (
            "a black-holed address raises StreamLost (retryable) instead "
            "of hanging the caller.",
            "runtime/request_plane.py"),
+    EnvVar("DYN_STREAM_COALESCE_MS", "float", "0",
+           "Extra milliseconds the worker-side response writer may wait "
+           "after the first ready stream item to gather more into one "
+           "multi-item request-plane frame. 0 (default) coalesces only "
+           "items already queued in the same event-loop tick, adding no "
+           "latency; raising it trades TTFT/ITL for fewer, fuller frames.",
+           "runtime/request_plane.py"),
+    EnvVar("DYN_STREAM_COALESCE_MAX_ITEMS", "int", "64",
+           "Cap on stream items packed into one multi-item request-plane "
+           "frame (and on token deltas merged per detokenizer batch on "
+           "the frontend). Bounds frame size and per-batch latency.",
+           "runtime/request_plane.py"),
     # -- fault injection (dynochaos) ----------------------------------- #
     EnvVar("DYN_FAULT_PLAN", "str", None,
            "dynochaos fault plan: `;`-separated `point[:spec,...]` rules "

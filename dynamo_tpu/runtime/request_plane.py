@@ -14,9 +14,19 @@ directly over DCN anyway.
 Wire protocol (two-part frames, codec.py):
   request :  {t:"req", stream:<id>, subject:<str>, traceparent?:<str>}  + payload
   cancel  :  {t:"cancel", stream:<id>, kill:<bool>}
-  response:  {t:"data", stream:<id>} + payload        (one per stream item)
+  response:  {t:"data", stream:<id>} + payload        (one stream item)
+             {t:"data", stream:<id>, n:<k>} + payload (k coalesced items,
+                                                       payload = packed list)
              {t:"done", stream:<id>}                  (clean end)
              {t:"err",  stream:<id>, error:<str>}     (terminal error)
+
+Token-path batching: the response writer gathers every stream item that is
+already ready (same event-loop tick, optionally up to DYN_STREAM_COALESCE_MS
+longer) into ONE multi-item frame — one msgpack pack, one corked write — so
+steady-state decode pays O(1) serving-plane work per engine dispatch instead
+of per token. Item order is preserved; a frame is committed atomically
+(a mid-stream sever loses whole frames, never splits one), so migration's
+contiguity accounting is unchanged.
 """
 
 from __future__ import annotations
@@ -24,10 +34,12 @@ from __future__ import annotations
 import asyncio
 import itertools
 import logging
+import socket as _socket
 import time
 from typing import Any, AsyncIterator, Awaitable, Callable, Dict, Optional, Tuple
 
 from . import codec, faults
+from .config import _env
 from .engine import Context
 from .logging import DistributedTraceContext, current_trace, parse_traceparent, set_trace
 
@@ -40,6 +52,25 @@ Handler = Callable[[Any, Context], AsyncIterator[Any]]
 DRAINING = "draining"
 
 
+def tune_transport(writer: asyncio.StreamWriter):
+    """TCP_NODELAY + bounded write buffer on a request-plane socket.
+
+    Token frames are small and latency-critical — Nagle can hold one back
+    a full RTT waiting for an ACK; the high-water mark makes drain() block
+    against a stalled peer instead of buffering frames unbounded in
+    userspace."""
+    sock = writer.get_extra_info("socket")
+    if sock is not None:
+        try:
+            sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+        except (OSError, AttributeError):
+            pass  # unix sockets / test doubles have no TCP layer
+    try:
+        writer.transport.set_write_buffer_limits(high=1 << 20)
+    except (AttributeError, RuntimeError, NotImplementedError):
+        pass
+
+
 class EndpointStats:
     """Per-endpoint counters, scraped by metrics + KV-router metrics
     aggregation (reference: NATS $SRV.STATS scraping, transports/nats.rs:107)."""
@@ -48,6 +79,11 @@ class EndpointStats:
         self.requests_total = 0
         self.requests_active = 0
         self.errors_total = 0
+        # coalescing visibility: items/frames > 1 means the writer is
+        # batching; the router/planner metrics topic republishes these so
+        # hardware e2e rows self-diagnose serving-plane overhead
+        self.frames_total = 0
+        self.items_total = 0
         self.last_request_at = time.monotonic()  # idle tracking (health canary)
         self.data = {}  # engine-published stats blob (ForwardPassMetrics)
 
@@ -56,6 +92,8 @@ class EndpointStats:
             "requests_total": self.requests_total,
             "requests_active": self.requests_active,
             "errors_total": self.errors_total,
+            "frames_total": self.frames_total,
+            "items_total": self.items_total,
             "data": self.data,
         }
 
@@ -72,6 +110,10 @@ class RequestPlaneServer:
         self._active: Dict[Tuple[asyncio.StreamWriter, int], Context] = {}
         self._connections: set = set()
         self._draining = False
+        # read per-server (not at import) so test clusters can set the env
+        # after the module is loaded
+        self.coalesce_s = max(_env("DYN_STREAM_COALESCE_MS", 0.0, float), 0.0) / 1e3
+        self.coalesce_max = max(_env("DYN_STREAM_COALESCE_MAX_ITEMS", 64, int), 1)
 
     @property
     def active_streams(self) -> int:
@@ -123,6 +165,7 @@ class RequestPlaneServer:
             await self._server.wait_closed()
 
     async def _serve_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        tune_transport(writer)
         write_lock = asyncio.Lock()
         tasks: Dict[int, asyncio.Task] = {}
         self._connections.add(writer)
@@ -208,13 +251,65 @@ class RequestPlaneServer:
             stats.requests_total += 1
             stats.requests_active += 1
             stats.last_request_at = time.monotonic()
+        # response coalescing: a pump task drains the handler while the
+        # writer loop below packs every already-ready item into ONE
+        # multi-item frame. The engine emits a whole decode block between
+        # event-loop ticks, so steady state is one frame per dispatch, not
+        # one per token. DYN_STREAM_COALESCE_MS (default 0) optionally
+        # waits a bounded window for more items — off by default so a slow
+        # stream's TTFT/ITL is untouched.
+        _DATA, _DONE, _ERR = 0, 1, 2
+        queue: asyncio.Queue = asyncio.Queue()
+
+        async def pump():
+            try:
+                async for item in handler(request, ctx):
+                    if ctx.is_killed():
+                        break
+                    queue.put_nowait((_DATA, item))
+                queue.put_nowait((_DONE, None))
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — forwarded to the caller
+                queue.put_nowait((_ERR, e))
+
+        pump_task: Optional[asyncio.Task] = None
         try:
             request = codec.unpack(payload)
-            async for item in handler(request, ctx):
-                if ctx.is_killed():
+            pump_task = asyncio.create_task(pump())
+            terminal: Optional[tuple] = None
+            while terminal is None:
+                kind, item = await queue.get()
+                if kind != _DATA:
+                    terminal = (kind, item)
                     break
-                await send({"t": "data"}, codec.pack(item))
-            await send({"t": "done"})
+                items = [item]
+                waited = self.coalesce_s <= 0.0
+                while len(items) < self.coalesce_max:
+                    try:
+                        kind, item = queue.get_nowait()
+                    except asyncio.QueueEmpty:
+                        if waited:
+                            break
+                        waited = True
+                        await asyncio.sleep(self.coalesce_s)
+                        continue
+                    if kind != _DATA:
+                        terminal = (kind, item)
+                        break
+                    items.append(item)
+                if stats:
+                    stats.frames_total += 1
+                    stats.items_total += len(items)
+                if len(items) == 1:
+                    await send({"t": "data"}, codec.pack(items[0]))
+                else:
+                    await send({"t": "data", "n": len(items)}, codec.pack(items))
+            kind, item = terminal
+            if kind == _DONE:
+                await send({"t": "done"})
+            else:
+                raise item  # handler exception: report like the inline path
         except asyncio.CancelledError:
             raise
         except Exception as e:  # noqa: BLE001 — stream errors go to the caller
@@ -226,6 +321,8 @@ class RequestPlaneServer:
             except (ConnectionError, RuntimeError):
                 pass
         finally:
+            if pump_task is not None:
+                pump_task.cancel()
             if stats:
                 stats.requests_active -= 1
             self._active.pop((writer, stream_id), None)
@@ -319,6 +416,7 @@ class RequestPlaneClient:
                 raise StreamLost(
                     f"connect to {address} timed out after {timeout:.1f}s"
                 ) from None
+            tune_transport(writer)
             conn = _Connection(reader, writer)
             conn.recv_task = asyncio.create_task(conn.recv_loop())
             self._conns[address] = conn
@@ -421,7 +519,14 @@ class RequestPlaneClient:
                             conn.closed = True
                             conn.writer.close()
                             raise StreamLost("injected: connection severed mid-stream")
-                    yield codec.unpack(payload)
+                    if control.get("n"):
+                        # coalesced multi-item frame: the payload is the
+                        # packed item list, committed atomically on the
+                        # wire — yield in order
+                        for it in codec.unpack(payload):
+                            yield it
+                    else:
+                        yield codec.unpack(payload)
                 elif t == "done":
                     return
                 elif t == "err":
