@@ -70,6 +70,52 @@ def emit_env_docs(root: Path) -> str:
     return "\n".join(lines)
 
 
+#: markers delimiting the generated block in docs/fault_tolerance.md
+FAULT_BEGIN = (
+    "<!-- FAULT_POINTS:BEGIN — generated from runtime/faults.py:"
+    "KNOWN_FAULT_POINTS; regenerate: python -m dynamo_tpu.analysis"
+    " --emit-fault-docs -->"
+)
+FAULT_END = "<!-- FAULT_POINTS:END -->"
+
+
+def render_fault_table(root: Path) -> str:
+    """Render runtime/faults.py's KNOWN_FAULT_POINTS as a markdown table.
+
+    Parsed from the AST (never imported — faults.py installs a process
+    injector at import time), so this works on hosts without the
+    package's deps, like --emit-env-docs."""
+    import ast
+
+    from .flow.fault_registry import FAULTS_MODULE, load_fault_points
+
+    tree = ast.parse((root / FAULTS_MODULE).read_text())
+    points, _, err = load_fault_points(tree)
+    if err is not None:
+        raise SystemExit(f"error: {err}")
+    lines = [
+        "| Point | Actions — where it bites |",
+        "|---|---|",
+    ]
+    for name, desc in points.items():  # registry order is the doc order
+        lines.append(f"| `{name}` | {desc.replace('|', chr(92) + '|')} |")
+    return "\n".join(lines)
+
+
+def emit_fault_docs(root: Path, target: Path) -> str:
+    """Splice the generated point table between the FAULT_POINTS markers
+    of `target` (docs/fault_tolerance.md) and return the new content."""
+    text = target.read_text()
+    if FAULT_BEGIN not in text or FAULT_END not in text:
+        raise SystemExit(
+            f"error: {target} has no FAULT_POINTS:BEGIN/END markers to "
+            "splice the generated table into"
+        )
+    head, rest = text.split(FAULT_BEGIN, 1)
+    _, tail = rest.split(FAULT_END, 1)
+    return head + FAULT_BEGIN + "\n" + render_fault_table(root) + "\n" + FAULT_END + tail
+
+
 def changed_files(root: Path, base: str) -> Optional[List[str]]:
     """Repo-relative .py paths under dynamo_tpu/ that differ from `base`
     (committed diff + working tree + untracked). None when git is
@@ -135,6 +181,13 @@ def main(argv=None) -> int:
         help="render the env-var registry as markdown to PATH ('-' = stdout) "
         "and exit",
     )
+    parser.add_argument(
+        "--emit-fault-docs", nargs="?", const="docs/fault_tolerance.md",
+        metavar="PATH",
+        help="regenerate the fault-point table between the FAULT_POINTS "
+        "markers of PATH (default docs/fault_tolerance.md; '-' = print the "
+        "table) from runtime/faults.py KNOWN_FAULT_POINTS, and exit",
+    )
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -156,6 +209,17 @@ def main(argv=None) -> int:
         else:
             Path(args.emit_env_docs).write_text(doc)
             print(f"wrote {args.emit_env_docs}")
+        return 0
+
+    if args.emit_fault_docs is not None:
+        if args.emit_fault_docs == "-":
+            sys.stdout.write(render_fault_table(root) + "\n")
+        else:
+            target = Path(args.emit_fault_docs)
+            if not target.is_absolute() and not target.exists():
+                target = root / args.emit_fault_docs
+            target.write_text(emit_fault_docs(root, target))
+            print(f"wrote {target}")
         return 0
 
     rules = default_rules()

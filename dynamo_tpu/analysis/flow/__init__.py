@@ -1,0 +1,36 @@
+"""dynoflow: the async task-lifecycle / cancellation / wire-protocol pack.
+
+Third rules pack on the analysis core. Where dynolint (core) is per-file
+and dynoshard covers the parallelism layer, this pack covers the layer
+where the serving plane's worst bugs have actually lived: orphaned
+`asyncio.create_task` results whose exceptions vanish (the silent mocker
+step-loop death), cleanup `await`s that a cancellation rips through
+mid-drain, wire-frame tags that drift between producer and consumer,
+and fault-injection points that fall out of the documented set. See
+docs/static_analysis.md ("The flow pack") and docs/wire_protocol.md.
+
+Interprocedural resolution (module constants through import chains,
+call-site argument chasing) is shared with dynoshard via
+shard/callgraph.py.
+"""
+
+from .cancellation_safety import CancellationSafetyRule
+from .fault_registry import FaultPointRegistryRule
+from .frame_protocol import FrameProtocolRule, load_frame_tags
+from .task_lifecycle import TaskLifecycleRule
+
+FLOW_RULES = (
+    TaskLifecycleRule,
+    CancellationSafetyRule,
+    FrameProtocolRule,
+    FaultPointRegistryRule,
+)
+
+__all__ = [
+    "CancellationSafetyRule",
+    "FLOW_RULES",
+    "FaultPointRegistryRule",
+    "FrameProtocolRule",
+    "TaskLifecycleRule",
+    "load_frame_tags",
+]

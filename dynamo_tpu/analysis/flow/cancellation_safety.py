@@ -1,0 +1,182 @@
+"""Rule: flow-cancellation-safety — cleanup paths must survive cancellation.
+
+The graceful-drain sequence (docs/fault_tolerance.md) relies on `finally:`
+blocks actually finishing: a worker that is cancelled mid-shutdown must
+still revoke its lease, flush its queues, and close its sockets. But an
+`await` inside a `finally:` is a cancellation delivery point — when the
+enclosing task has a pending cancellation, the await raises
+`CancelledError` immediately and the REST OF THE CLEANUP IS ABANDONED.
+Likewise, an `except CancelledError:` that does not re-raise turns a
+caller's cancel into a silent no-op: the task reports itself finished,
+`Task.cancelled()` is False, and drain accounting wedges.
+
+Three checks, over every `try` in the package:
+
+  * an `await` inside `finally:` must be wrapped in `asyncio.shield(...)`
+    or `asyncio.wait_for(...)` (bounding/shielding the cleanup step) —
+    or be made synchronous (`put_nowait`, `close()`);
+  * an `except CancelledError:` handler must re-raise. The one blessed
+    exception is the cancel-then-reap idiom — `t.cancel()` followed by
+    `try: await t / except CancelledError: pass` — where the swallowed
+    error belongs to the CHILD task just cancelled, not the caller; the
+    rule recognizes it when something awaited in the try body received a
+    `.cancel()` in the same enclosing scope;
+  * an `await` inside an `except CancelledError:` handler gets the same
+    shield/wait_for requirement as `finally:`.
+
+Violations anchor at the offending await / handler line. Nested function
+definitions are their own coroutines and are not scanned as part of the
+enclosing cleanup block.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Iterator, List, Set
+
+from ..core import Project, Rule, SourceFile, Violation, dotted_name
+from ..shard.callgraph import Chain, _walk_with_chain
+
+#: await wrappers accepted inside cleanup blocks
+_SAFE_WRAPPERS = {"shield", "wait_for"}
+
+
+def _is_cancelled_type(t: ast.AST) -> bool:
+    if isinstance(t, ast.Tuple):
+        return any(_is_cancelled_type(e) for e in t.elts)
+    return (isinstance(t, ast.Name) and t.id == "CancelledError") or (
+        isinstance(t, ast.Attribute) and t.attr == "CancelledError"
+    )
+
+
+def _walk_same_coroutine(stmts: Iterable[ast.stmt]) -> Iterator[ast.AST]:
+    """Walk statements without descending into nested def/lambda bodies —
+    their awaits belong to a different coroutine."""
+    stack: List[ast.AST] = list(stmts)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _is_wrapped(await_node: ast.Await) -> bool:
+    v = await_node.value
+    if not isinstance(v, ast.Call):
+        return False
+    fn = v.func
+    name = fn.attr if isinstance(fn, ast.Attribute) else getattr(fn, "id", "")
+    return name in _SAFE_WRAPPERS
+
+
+def _cancelled_receivers(scope: ast.AST) -> Set[str]:
+    """Dotted names that receive `.cancel()` anywhere in the scope."""
+    out: Set[str] = set()
+    for node in ast.walk(scope):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "cancel"
+        ):
+            d = dotted_name(node.func.value)
+            if d:
+                out.add(d)
+    return out
+
+
+class CancellationSafetyRule(Rule):
+    name = "flow-cancellation-safety"
+    description = (
+        "awaits in finally:/except CancelledError: blocks are shielded or "
+        "bounded (asyncio.shield/wait_for), and CancelledError is re-raised "
+        "except in the cancel-then-reap idiom"
+    )
+
+    def check(self, project: Project) -> Iterator[Violation]:
+        for src in project.files:
+            yield from self._check_file(src)
+
+    def _check_file(self, src: SourceFile) -> Iterator[Violation]:
+        for node, chain in _walk_with_chain(src.tree):
+            if not isinstance(node, ast.Try):
+                continue
+            yield from self._check_finally(src, node)
+            yield from self._check_handlers(src, node, chain)
+
+    def _check_finally(self, src: SourceFile, node: ast.Try) -> Iterator[Violation]:
+        for sub in _walk_same_coroutine(node.finalbody):
+            if isinstance(sub, ast.Await) and not _is_wrapped(sub):
+                yield Violation(
+                    rule=self.name,
+                    path=src.rel,
+                    line=sub.lineno,
+                    message=(
+                        "`await` inside `finally:` is a cancellation "
+                        "delivery point — a pending CancelledError fires "
+                        "here and abandons the rest of the cleanup. Wrap "
+                        "it in asyncio.shield(...)/wait_for(...) or use a "
+                        "synchronous equivalent (put_nowait, close)"
+                    ),
+                )
+
+    def _check_handlers(
+        self, src: SourceFile, node: ast.Try, chain: Chain
+    ) -> Iterator[Violation]:
+        scope = chain[0] if chain else src.tree
+        for handler in node.handlers:
+            if handler.type is None or not _is_cancelled_type(handler.type):
+                continue
+            for sub in _walk_same_coroutine(handler.body):
+                if isinstance(sub, ast.Await) and not _is_wrapped(sub):
+                    yield Violation(
+                        rule=self.name,
+                        path=src.rel,
+                        line=sub.lineno,
+                        message=(
+                            "`await` inside `except CancelledError:` runs "
+                            "while the task is being torn down — wrap it "
+                            "in asyncio.shield(...)/wait_for(...) or make "
+                            "it synchronous"
+                        ),
+                    )
+            if any(
+                isinstance(s, ast.Raise)
+                for s in _walk_same_coroutine(handler.body)
+            ):
+                continue
+            if self._is_cancel_then_reap(node, scope):
+                continue
+            yield Violation(
+                rule=self.name,
+                path=src.rel,
+                line=handler.lineno,
+                message=(
+                    "`except CancelledError:` swallows cancellation — the "
+                    "caller's cancel() becomes a no-op and graceful drain "
+                    "can wedge on a task that reports itself finished. "
+                    "Re-raise after cleanup (the cancel-then-reap idiom, "
+                    "`t.cancel(); await t`, is recognized and exempt)"
+                ),
+            )
+
+    @staticmethod
+    def _is_cancel_then_reap(node: ast.Try, scope: ast.AST) -> bool:
+        """try-body awaits something that received `.cancel()` in the same
+        enclosing scope: the swallowed CancelledError is the child's."""
+        cancelled = _cancelled_receivers(scope)
+        if not cancelled:
+            return False
+        for sub in _walk_same_coroutine(node.body):
+            if not isinstance(sub, ast.Await):
+                continue
+            target = sub.value
+            if isinstance(target, ast.Call) and _is_wrapped(sub):
+                targets = target.args[:1]
+            else:
+                targets = [target]
+            for t in targets:
+                d = dotted_name(t)
+                if d and d in cancelled:
+                    return True
+        return False

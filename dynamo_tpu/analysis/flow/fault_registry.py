@@ -1,0 +1,152 @@
+"""Rule: flow-fault-point-registry — fault injection points stay documented.
+
+dynochaos plans (`DYN_FAULT_PLAN`) are written from the docs: an operator
+spells `request_plane.frame:sever,after=3` trusting that the point name
+in docs/fault_tolerance.md matches a live `faults.FAULTS.on/check(...)`
+site. That trust is only as good as the table. This rule pins both ends
+to `runtime/faults.py:KNOWN_FAULT_POINTS`:
+
+  * every injection site in the package — `await f.on("point")` /
+    `f.check("point")` where `f` is (or was assigned from)
+    `faults.FAULTS` — must name a registered point. The point string is
+    resolved through the call graph (constants, defaults, call-site
+    args), and the violation anchors at the line the literal was
+    written;
+  * every registry entry must still have at least one site — a point
+    that was refactored away must leave the table (and the generated
+    docs) with it.
+
+The table itself renders into docs/fault_tolerance.md via
+`python -m dynamo_tpu.analysis --emit-fault-docs`, freshness-tested like
+docs/configuration.md.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, Optional, Tuple
+
+from ..core import Project, Rule, SourceFile, Violation, dotted_name, str_const
+from ..shard.callgraph import Chain, FunctionIndex, chain_value, iter_calls
+
+FAULTS_MODULE = "dynamo_tpu/runtime/faults.py"
+
+
+def load_fault_points(
+    tree: ast.AST,
+) -> Tuple[Optional[Dict[str, str]], Optional[Dict[str, int]], Optional[str]]:
+    """Parse KNOWN_FAULT_POINTS from faults.py's AST (never imported — the
+    module installs an injector at import time). Returns (points, lines,
+    error); points maps name -> description, lines anchor stale-entry
+    findings."""
+    table: Optional[ast.Dict] = None
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt = node.targets[0]
+            if isinstance(tgt, ast.Name) and tgt.id == "KNOWN_FAULT_POINTS" \
+                    and isinstance(node.value, ast.Dict):
+                table = node.value
+    if table is None:
+        return None, None, (
+            f"{FAULTS_MODULE} defines no KNOWN_FAULT_POINTS dict literal — "
+            "the fault-point registry is the source DYN_FAULT_PLAN docs "
+            "are generated from"
+        )
+    points: Dict[str, str] = {}
+    lines: Dict[str, int] = {}
+    for k, v in zip(table.keys, table.values):
+        name = str_const(k) if k is not None else None
+        if name is None:
+            return None, None, (
+                f"{FAULTS_MODULE}: KNOWN_FAULT_POINTS keys must be string "
+                "literals"
+            )
+        points[name] = str_const(v) or ""
+        lines[name] = k.lineno
+    return points, lines, None
+
+
+def _is_faults_receiver(chain: Chain, expr: ast.AST) -> bool:
+    """True when `expr` is (or is locally assigned from) faults.FAULTS."""
+    d = dotted_name(expr)
+    if d == "FAULTS" or d.endswith(".FAULTS"):
+        return True
+    if isinstance(expr, ast.Name):
+        v = chain_value(chain, expr)
+        if v is not expr:
+            dv = dotted_name(v)
+            return dv == "FAULTS" or dv.endswith(".FAULTS")
+    return False
+
+
+class FaultPointRegistryRule(Rule):
+    name = "flow-fault-point-registry"
+    description = (
+        "every faults.FAULTS.on/check(...) site names a point registered "
+        "in runtime/faults.py KNOWN_FAULT_POINTS, and every registered "
+        "point still has a site (DYN_FAULT_PLAN stays spellable from docs)"
+    )
+
+    def check(self, project: Project) -> Iterator[Violation]:
+        src = project.get(FAULTS_MODULE)
+        if src is None:
+            yield Violation(
+                rule=self.name, path=FAULTS_MODULE, line=1,
+                message=f"{FAULTS_MODULE} not found: the fault-point registry is gone",
+            )
+            return
+        points, lines, err = load_fault_points(src.tree)
+        if err is not None:
+            yield Violation(rule=self.name, path=FAULTS_MODULE, line=1, message=err)
+            return
+        index = FunctionIndex(project)
+        used = set()
+        for f in project.files:
+            if f.rel == FAULTS_MODULE:
+                continue
+            yield from self._check_file(f, index, points, used)
+        for point in points:
+            if point not in used:
+                yield Violation(
+                    rule=self.name,
+                    path=FAULTS_MODULE,
+                    line=lines[point],
+                    message=(
+                        f"KNOWN_FAULT_POINTS entry '{point}' has no "
+                        "injection site left in the package — remove it so "
+                        "the generated docs stop advertising a dead point"
+                    ),
+                )
+
+    def _check_file(
+        self,
+        src: SourceFile,
+        index: FunctionIndex,
+        points: Dict[str, str],
+        used: set,
+    ) -> Iterator[Violation]:
+        for call, chain in iter_calls(src):
+            if not isinstance(call.func, ast.Attribute):
+                continue
+            if call.func.attr not in ("on", "check") or not call.args:
+                continue
+            if not _is_faults_receiver(chain, call.func.value):
+                continue
+            res = index.resolve_strings(src, chain, call.args[0])
+            for r in sorted(res.values, key=lambda r: (r.path, r.line, r.value)):
+                if r.value in points:
+                    used.add(r.value)
+                else:
+                    yield Violation(
+                        rule=self.name,
+                        path=r.path,
+                        line=r.line,
+                        message=(
+                            f"fault point '{r.value}' (injected at "
+                            f"{src.rel}:{call.lineno}) is not in "
+                            f"KNOWN_FAULT_POINTS ({FAULTS_MODULE}: "
+                            f"{', '.join(sorted(points))}) — register it "
+                            "with a one-line description so DYN_FAULT_PLAN "
+                            "stays spellable from docs"
+                        ),
+                    )

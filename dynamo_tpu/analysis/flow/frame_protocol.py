@@ -1,0 +1,283 @@
+"""Rule: flow-frame-protocol — wire-frame tags stay registered and symmetric.
+
+The serving plane speaks three framed dialects (docs/wire_protocol.md):
+the request plane's `"t"` channel, discovery's `"op"` request channel and
+`"push"` server-push channel. Tags are plain strings in control dicts, so
+nothing in the runtime stops a producer from emitting a frame no consumer
+dispatches on — the frame is silently dropped on the floor (or worse, a
+stream hangs waiting for a terminal tag that will never come). This rule
+pins the protocol to one registry, `runtime/codec.py:FRAME_TAGS`, and
+checks both directions of every channel:
+
+  * every tag VALUE reaching a frame-dict literal (`{"t": <tag>, ...}`)
+    in a protocol module must resolve into the registry — resolution
+    goes through module constants and import chains (callgraph.py), so
+    `T_DATA` imported from codec.py resolves to "data";
+  * every tag a dispatch comparison consumes (`t == T_DATA` where `t`
+    came from `control.get("t")`, or `control.get("push") == PUSH_MSG`,
+    or `t in (T_DONE, T_ERR)`) must resolve into the registry;
+  * per channel, the emitted and consumed sets must MATCH: a tag emitted
+    with no dispatch arm, or a dispatch arm no producer can reach, is
+    protocol drift and fires at the offending site;
+  * a registry entry that neither side uses is dead weight and fires at
+    the registry line.
+
+Under-approximation: a channel with any UNRESOLVABLE emit (or consume)
+site suppresses that channel's absence findings in the matching
+direction — the rule never accuses symmetric code it cannot fully read.
+Unregistered-tag findings still fire on whatever does resolve.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from ..core import Project, Rule, SourceFile, Violation, str_const
+from ..shard.callgraph import Chain, FunctionIndex, chain_value, _walk_with_chain
+
+CODEC_MODULE = "dynamo_tpu/runtime/codec.py"
+
+#: the modules that put frames on (or take frames off) the wire
+PROTOCOL_MODULES = (
+    "dynamo_tpu/runtime/request_plane.py",
+    "dynamo_tpu/runtime/discovery.py",
+    "dynamo_tpu/llm/kv_transfer.py",
+)
+
+_Site = Tuple[str, int]  # (repo-relative path, line)
+
+
+def load_frame_tags(
+    project: Project,
+) -> Tuple[Optional[Dict[str, Dict[str, str]]],
+           Optional[Dict[Tuple[str, str], int]],
+           Optional[str]]:
+    """Parse FRAME_TAGS out of runtime/codec.py (AST only, never imported).
+
+    Returns (registry, lines, error): registry maps channel -> {tag:
+    description}; lines maps (channel, tag) -> codec.py line for anchoring
+    dead-entry findings; error is a human message when the registry is
+    missing or malformed (reported as a violation, mirroring KNOWN_AXES).
+    """
+    src = project.get(CODEC_MODULE)
+    if src is None:
+        return None, None, f"{CODEC_MODULE} not found: the frame-tag registry is gone"
+    consts: Dict[str, str] = {}
+    table: Optional[ast.Dict] = None
+    for node in src.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt = node.targets[0]
+            if not isinstance(tgt, ast.Name):
+                continue
+            if isinstance(node.value, ast.Constant) and isinstance(node.value.value, str):
+                consts[tgt.id] = node.value.value
+            elif tgt.id == "FRAME_TAGS" and isinstance(node.value, ast.Dict):
+                table = node.value
+    if table is None:
+        return None, None, (
+            f"{CODEC_MODULE} defines no FRAME_TAGS dict literal — the flow "
+            "rules need the frame-tag registry as their source of truth"
+        )
+    registry: Dict[str, Dict[str, str]] = {}
+    lines: Dict[Tuple[str, str], int] = {}
+    for ck, cv in zip(table.keys, table.values):
+        channel = str_const(ck) if ck is not None else None
+        if channel is None or not isinstance(cv, ast.Dict):
+            return None, None, (
+                f"{CODEC_MODULE}: FRAME_TAGS channels must be string "
+                "literals mapping to dict literals"
+            )
+        registry[channel] = {}
+        for tk, tv in zip(cv.keys, cv.values):
+            if tk is None:
+                continue
+            tag = str_const(tk)
+            if tag is None and isinstance(tk, ast.Name):
+                tag = consts.get(tk.id)
+            if tag is None:
+                return None, None, (
+                    f"{CODEC_MODULE}: FRAME_TAGS['{channel}'] key "
+                    f"{ast.dump(tk)} is not a resolvable string — keep keys "
+                    "as literals or same-module string constants"
+                )
+            desc = str_const(tv) or ""
+            registry[channel][tag] = desc
+            lines[(channel, tag)] = tk.lineno
+    return registry, lines, None
+
+
+class FrameProtocolRule(Rule):
+    name = "flow-frame-protocol"
+    description = (
+        "wire-frame tags in the protocol modules resolve into "
+        "runtime/codec.py FRAME_TAGS, and every emitted tag has a consumer "
+        "dispatch arm (and vice versa) per channel"
+    )
+
+    def check(self, project: Project) -> Iterator[Violation]:
+        registry, reg_lines, err = load_frame_tags(project)
+        if err is not None:
+            yield Violation(rule=self.name, path=CODEC_MODULE, line=1, message=err)
+            return
+        index = FunctionIndex(project)
+        emits: Dict[str, Dict[str, List[_Site]]] = {c: {} for c in registry}
+        consumes: Dict[str, Dict[str, List[_Site]]] = {c: {} for c in registry}
+        incomplete_emit: Set[str] = set()
+        incomplete_consume: Set[str] = set()
+        scanned_any = False
+        for rel in PROTOCOL_MODULES:
+            src = project.get(rel)
+            if src is None:
+                continue
+            scanned_any = True
+            self._scan(
+                src, index, registry, emits, consumes,
+                incomplete_emit, incomplete_consume,
+            )
+        if not scanned_any:
+            return
+        seen: Set[Tuple[str, int, str, str]] = set()
+
+        def emit_violation(path: str, line: int, channel: str, tag: str, msg: str):
+            key = (path, line, channel, tag)
+            if key in seen:
+                return None
+            seen.add(key)
+            return Violation(rule=self.name, path=path, line=line, message=msg)
+
+        for channel in registry:
+            known = registry[channel]
+            for tag, sites in sorted(emits[channel].items()):
+                if tag not in known:
+                    for path, line in sites:
+                        v = emit_violation(
+                            path, line, channel, tag,
+                            f"producer emits unregistered '{channel}' tag "
+                            f"'{tag}' — add it to FRAME_TAGS['{channel}'] in "
+                            f"{CODEC_MODULE} (and a consumer dispatch arm)",
+                        )
+                        if v:
+                            yield v
+                elif (
+                    tag not in consumes[channel]
+                    and channel not in incomplete_consume
+                ):
+                    path, line = sorted(sites)[0]
+                    v = emit_violation(
+                        path, line, channel, tag,
+                        f"'{channel}' tag '{tag}' is emitted here but no "
+                        "consumer in the protocol modules dispatches on it "
+                        "— the frame is dropped on the floor (protocol "
+                        "drift)",
+                    )
+                    if v:
+                        yield v
+            for tag, sites in sorted(consumes[channel].items()):
+                if tag not in known:
+                    for path, line in sites:
+                        v = emit_violation(
+                            path, line, channel, tag,
+                            f"dispatch arm matches unregistered '{channel}' "
+                            f"tag '{tag}' — add it to FRAME_TAGS"
+                            f"['{channel}'] in {CODEC_MODULE}",
+                        )
+                        if v:
+                            yield v
+                elif (
+                    tag not in emits[channel]
+                    and channel not in incomplete_emit
+                ):
+                    path, line = sorted(sites)[0]
+                    v = emit_violation(
+                        path, line, channel, tag,
+                        f"dispatch arm for '{channel}' tag '{tag}' is dead: "
+                        "no producer in the protocol modules emits it "
+                        "(protocol drift)",
+                    )
+                    if v:
+                        yield v
+            if channel in incomplete_emit or channel in incomplete_consume:
+                continue  # partially-resolved channel: no dead-entry claims
+            for tag in sorted(known):
+                if tag in emits[channel] or tag in consumes[channel]:
+                    continue
+                yield Violation(
+                    rule=self.name,
+                    path=CODEC_MODULE,
+                    line=reg_lines.get((channel, tag), 1),
+                    message=(
+                        f"FRAME_TAGS['{channel}'] entry '{tag}' is used by "
+                        "no producer or consumer — dead registry weight "
+                        "(remove it, or wire it up)"
+                    ),
+                )
+
+    # ----------------------------------------------------------------- #
+
+    def _scan(
+        self,
+        src: SourceFile,
+        index: FunctionIndex,
+        registry: Dict[str, Dict[str, str]],
+        emits: Dict[str, Dict[str, List[_Site]]],
+        consumes: Dict[str, Dict[str, List[_Site]]],
+        incomplete_emit: Set[str],
+        incomplete_consume: Set[str],
+    ) -> None:
+        for node, chain in _walk_with_chain(src.tree):
+            if isinstance(node, ast.Dict):
+                for k, v in zip(node.keys, node.values):
+                    channel = str_const(k) if k is not None else None
+                    if channel not in registry:
+                        continue
+                    res = index.resolve_strings(src, chain, v)
+                    if not res.complete:
+                        incomplete_emit.add(channel)
+                    for r in res.values:
+                        emits[channel].setdefault(r.value, []).append(
+                            (src.rel, node.lineno)
+                        )
+            elif isinstance(node, ast.Compare) and len(node.ops) == 1:
+                op = node.ops[0]
+                sides = (node.left, node.comparators[0])
+                if isinstance(op, (ast.Eq, ast.NotEq)):
+                    pairs = ((sides[0], sides[1]), (sides[1], sides[0]))
+                elif isinstance(op, (ast.In, ast.NotIn)):
+                    pairs = ((sides[0], sides[1]),)
+                else:
+                    continue
+                for read_side, tag_side in pairs:
+                    channel = self._tag_read_channel(read_side, chain, registry)
+                    if channel is None:
+                        continue
+                    res = index.resolve_strings(src, chain, tag_side)
+                    if not res.complete:
+                        incomplete_consume.add(channel)
+                    for r in res.values:
+                        consumes[channel].setdefault(r.value, []).append(
+                            (src.rel, node.lineno)
+                        )
+                    break
+
+    @staticmethod
+    def _tag_read_channel(
+        expr: ast.AST, chain: Chain, registry: Dict[str, Dict[str, str]]
+    ) -> Optional[str]:
+        """Channel name when `expr` reads a frame tag: `<e>.get("t")`,
+        `<e>["t"]`, or a name assigned from either in the scope chain."""
+        e = chain_value(chain, expr)
+        if (
+            isinstance(e, ast.Call)
+            and isinstance(e.func, ast.Attribute)
+            and e.func.attr == "get"
+            and e.args
+        ):
+            key = str_const(e.args[0])
+            if key in registry:
+                return key
+        if isinstance(e, ast.Subscript):
+            key = str_const(e.slice)
+            if key in registry:
+                return key
+        return None

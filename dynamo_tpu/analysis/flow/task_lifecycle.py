@@ -1,0 +1,434 @@
+"""Rule: flow-task-lifecycle — every spawned asyncio task has an owner.
+
+The worst async bug this stack has shipped was silent: the mocker's step
+loop died on an exception inside a task nobody held, the exception was
+never retrieved, and every active stream hung forever without a log line
+(fixed by hand in the dynochaos PR). This rule makes the ownership
+contract checkable. The task object returned by `asyncio.create_task` /
+`loop.create_task` / `asyncio.ensure_future` must be provably
+
+  * awaited — directly, or through `asyncio.wait`/`gather`/`wait_for`/
+    `shield`;
+  * reaped — `.cancel()`/`.result()`/`.exception()`, including a sweep
+    `for t in <tracked>: t.cancel()`; or
+  * registered with an owner — stored into an attribute or container
+    that is cancelled/awaited/swept ANYWHERE in the project (that is,
+    reachable from some `close()`/drain path), or returned to a caller
+    that does one of the above (call sites resolved through
+    shard/callgraph.py's project-wide index).
+
+A bare `asyncio.create_task(...)` expression statement, or a binding
+with no such evidence, is a violation anchored at the spawn site — the
+line a maintainer fixes or waives — even when the missing evidence would
+live in another file.
+
+Deliberate approximations (both biased toward silence, never invention):
+  * evidence is matched by NAME project-wide — any `<e>._task.cancel()`
+    anywhere vouches for every task bound to an attribute `_task`;
+  * a task handed as an argument into an arbitrary call is assumed
+    owned by the callee.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, Optional, Set, Tuple
+
+from ..core import Project, Rule, SourceFile, Violation, call_name
+from ..shard.callgraph import Chain, FunctionIndex, _walk_with_chain
+
+_SPAWN_NAMES = {"create_task", "ensure_future"}
+#: calls whose await covers the tasks passed into them
+_WAIT_FNS = {"wait", "wait_for", "gather", "shield", "as_completed"}
+#: methods that consume a task's fate (cancellation or its result/exception)
+_REAP_METHODS = {"cancel", "result", "exception"}
+_MAX_RETURN_DEPTH = 3
+
+
+def is_spawn(call: ast.Call) -> bool:
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr in _SPAWN_NAMES
+    if isinstance(call.func, ast.Name):
+        return call.func.id in _SPAWN_NAMES
+    return False
+
+
+def _simple_fn(call: ast.Call) -> str:
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr
+    if isinstance(call.func, ast.Name):
+        return call.func.id
+    return ""
+
+
+def _parent_map(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    out: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            out[child] = node
+    return out
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _loop_cancels_target(loop: ast.AST) -> bool:
+    """`for t in <iter>: ... t.cancel()/.result()/.exception() ...`"""
+    targets = _names_in(loop.target)
+    for stmt in loop.body:
+        for sub in ast.walk(stmt):
+            if (
+                isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)
+                and sub.func.attr in _REAP_METHODS
+                and isinstance(sub.func.value, ast.Name)
+                and sub.func.value.id in targets
+            ):
+                return True
+    return False
+
+
+class EvidenceIndex:
+    """Project-wide ownership evidence, keyed by attribute name.
+
+    Built once per rule run. Name-keyed on purpose: the owner's cancel
+    path (a `close()` in another file) references the task through the
+    same attribute spelling the spawn site stored it under.
+    """
+
+    def __init__(self, project: Project):
+        #: X such that `<e>.X.cancel()` / `.result()` / `.exception()` exists
+        self.reaped_attrs: Set[str] = set()
+        #: X such that `await <e>.X` or `<e>.X` rides a wait-fn call
+        self.awaited_attrs: Set[str] = set()
+        #: X such that a loop over an iterable mentioning `.X` reaps its target
+        self.swept_attrs: Set[str] = set()
+        for src in project.files:
+            self._scan(src.tree)
+
+    def _scan(self, tree: ast.AST) -> None:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _REAP_METHODS
+                    and isinstance(node.func.value, ast.Attribute)
+                ):
+                    self.reaped_attrs.add(node.func.value.attr)
+                # wait-fns match by simple name: `asyncio.gather(...)` AND
+                # bare `gather(...)` after a from-import both count
+                if _simple_fn(node) in _WAIT_FNS:
+                    for arg in node.args:
+                        for sub in ast.walk(arg):
+                            if isinstance(sub, ast.Attribute):
+                                self.awaited_attrs.add(sub.attr)
+            elif isinstance(node, ast.Await):
+                if isinstance(node.value, ast.Attribute):
+                    self.awaited_attrs.add(node.value.attr)
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                if _loop_cancels_target(node):
+                    for sub in ast.walk(node.iter):
+                        if isinstance(sub, ast.Attribute):
+                            self.swept_attrs.add(sub.attr)
+
+
+class TaskLifecycleRule(Rule):
+    name = "flow-task-lifecycle"
+    description = (
+        "every asyncio.create_task/ensure_future result is awaited, "
+        "cancelled, or registered in a tracked attribute/container some "
+        "close()/drain path reaps (ownership chased cross-file)"
+    )
+
+    def check(self, project: Project) -> Iterator[Violation]:
+        index = FunctionIndex(project)
+        evidence = EvidenceIndex(project)
+        parent_cache: Dict[str, Dict[ast.AST, ast.AST]] = {}
+
+        def parents_for(src: SourceFile) -> Dict[ast.AST, ast.AST]:
+            if src.rel not in parent_cache:
+                parent_cache[src.rel] = _parent_map(src.tree)
+            return parent_cache[src.rel]
+
+        for src in project.files:
+            for node, chain in _walk_with_chain(src.tree):
+                if not (isinstance(node, ast.Call) and is_spawn(node)):
+                    continue
+                reason = self._site_reason(
+                    index, evidence, parents_for, src, node, chain, 0
+                )
+                if reason is not None:
+                    target = call_name(node.args[0]) if node.args else ""
+                    what = f"task `{target}(...)`" if target else "task"
+                    yield Violation(
+                        rule=self.name,
+                        path=src.rel,
+                        line=node.lineno,
+                        message=(
+                            f"{what} spawned here is orphaned: {reason}. "
+                            "An unowned task swallows its exception and "
+                            "outlives shutdown — await it, cancel it from "
+                            "the owning close()/drain path, or register "
+                            "it in a tracked set that path sweeps"
+                        ),
+                    )
+
+    # ----------------------------------------------------------------- #
+    # classification: what does the spawn expression bind to?
+    # ----------------------------------------------------------------- #
+
+    def _classify(
+        self, parents: Dict[ast.AST, ast.AST], node: ast.AST
+    ) -> Tuple[Optional[str], object]:
+        parent = parents.get(node)
+        while True:
+            if isinstance(parent, ast.Await):
+                return ("owned", None)
+            if isinstance(parent, ast.IfExp) and node in (parent.body, parent.orelse):
+                node, parent = parent, parents.get(parent)
+                continue
+            if (
+                isinstance(parent, (ast.ListComp, ast.SetComp))
+                and node is parent.elt
+            ):
+                node, parent = parent, parents.get(parent)
+                continue
+            if isinstance(parent, ast.Starred):
+                node, parent = parent, parents.get(parent)
+                continue
+            if isinstance(parent, ast.Call) and node in parent.args:
+                fn = parent.func
+                if (
+                    isinstance(fn, ast.Attribute)
+                    and fn.attr in ("append", "add")
+                    and len(parent.args) == 1
+                ):
+                    return ("container", fn.value)
+                if _simple_fn(parent) in _WAIT_FNS:
+                    node, parent = parent, parents.get(parent)
+                    continue
+                # handed to an arbitrary callee: assume the callee owns it
+                return (None, None)
+            break
+        if isinstance(parent, ast.Expr):
+            return ("bare", None)
+        if isinstance(parent, ast.Return):
+            return ("returned", None)
+        if isinstance(parent, (ast.Assign, ast.AnnAssign, ast.NamedExpr)):
+            targets = (
+                parent.targets if isinstance(parent, ast.Assign) else [parent.target]
+            )
+            value = parent.value
+            if value is not node:
+                if (
+                    isinstance(value, ast.Tuple)
+                    and node in value.elts
+                    and len(targets) == 1
+                    and isinstance(targets[0], ast.Tuple)
+                    and len(targets[0].elts) == len(value.elts)
+                ):
+                    return self._target_kind(targets[0].elts[value.elts.index(node)])
+                return (None, None)
+            return self._target_kind(targets[0])
+        return (None, None)
+
+    @staticmethod
+    def _target_kind(tgt: ast.AST) -> Tuple[Optional[str], object]:
+        if isinstance(tgt, ast.Name):
+            return ("local", tgt.id)
+        if isinstance(tgt, ast.Attribute):
+            return ("attr", tgt.attr)
+        if isinstance(tgt, ast.Subscript):
+            return ("container", tgt.value)
+        return (None, None)
+
+    # ----------------------------------------------------------------- #
+    # ownership evidence
+    # ----------------------------------------------------------------- #
+
+    def _site_reason(
+        self,
+        index: FunctionIndex,
+        evidence: EvidenceIndex,
+        parents_for,
+        src: SourceFile,
+        node: ast.AST,
+        chain: Chain,
+        depth: int,
+    ) -> Optional[str]:
+        """None = owned (or unprovable: stay quiet); else the reason."""
+        kind, data = self._classify(parents_for(src), node)
+        scope = chain[0] if chain else src.tree
+        if kind is None or kind == "owned":
+            return None
+        if kind == "bare":
+            return "its task object is discarded at the call site (fire-and-forget)"
+        if kind == "attr":
+            return self._attr_reason(evidence, data)
+        if kind == "container":
+            return self._container_reason(evidence, scope, data)
+        if kind == "local":
+            return self._local_reason(
+                index, evidence, parents_for, src, scope, data, chain, depth
+            )
+        if kind == "returned":
+            return self._returned_reason(
+                index, evidence, parents_for, chain, depth
+            )
+        return None  # pragma: no cover - kinds are exhaustive
+
+    @staticmethod
+    def _attr_reason(evidence: EvidenceIndex, attr: str) -> Optional[str]:
+        if attr in (
+            evidence.reaped_attrs | evidence.awaited_attrs | evidence.swept_attrs
+        ):
+            return None
+        return (
+            f"bound to attribute `.{attr}`, which no close()/drain path in "
+            "the project cancels, awaits, or sweeps"
+        )
+
+    def _container_reason(
+        self, evidence: EvidenceIndex, scope: ast.AST, container: ast.AST
+    ) -> Optional[str]:
+        if isinstance(container, ast.Attribute):
+            if container.attr in (evidence.swept_attrs | evidence.awaited_attrs):
+                return None
+            return (
+                f"tracked in container `.{container.attr}`, but no path in "
+                "the project sweeps that container with cancel()"
+            )
+        if isinstance(container, ast.Name):
+            if self._local_sweep(scope, container.id):
+                return None
+            return (
+                f"tracked in local container `{container.id}`, which is "
+                "never swept with cancel() in the enclosing scope"
+            )
+        return None  # container shape we cannot follow: stay quiet
+
+    @staticmethod
+    def _local_sweep(scope: ast.AST, name: str) -> bool:
+        for sub in ast.walk(scope):
+            if isinstance(sub, (ast.For, ast.AsyncFor)):
+                if name in _names_in(sub.iter) and _loop_cancels_target(sub):
+                    return True
+            elif isinstance(sub, ast.Call) and _simple_fn(sub) in _WAIT_FNS:
+                if any(name in _names_in(a) for a in sub.args):
+                    return True
+        return False
+
+    def _local_reason(
+        self,
+        index: FunctionIndex,
+        evidence: EvidenceIndex,
+        parents_for,
+        src: SourceFile,
+        scope: ast.AST,
+        name: str,
+        chain: Chain,
+        depth: int,
+        _seen: Optional[Set[str]] = None,
+    ) -> Optional[str]:
+        seen = _seen or {name}
+        # a failed ownership TRANSFER (stored into an unswept container /
+        # unreaped attribute) is a better diagnosis than the generic
+        # "never awaited" — remember it
+        transfer_reason: Optional[str] = None
+        for sub in ast.walk(scope):
+            if isinstance(sub, ast.Await) and name in _names_in(sub.value):
+                return None
+            if (
+                isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)
+                and sub.func.attr in _REAP_METHODS
+                and isinstance(sub.func.value, ast.Name)
+                and sub.func.value.id == name
+            ):
+                return None
+            if isinstance(sub, ast.Call) and _simple_fn(sub) in _WAIT_FNS:
+                if any(name in _names_in(a) for a in sub.args):
+                    return None
+            if isinstance(sub, (ast.For, ast.AsyncFor)):
+                if name in _names_in(sub.iter) and _loop_cancels_target(sub):
+                    return None
+            if isinstance(sub, ast.Return) and sub.value is not None:
+                if (
+                    isinstance(sub.value, ast.Name)
+                    and sub.value.id == name
+                ):
+                    return None  # escapes to a caller we did not spawn-site: quiet
+            # ownership transfers: container store, attribute store, alias
+            if (
+                isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)
+                and sub.func.attr in ("append", "add")
+                and len(sub.args) == 1
+                and isinstance(sub.args[0], ast.Name)
+                and sub.args[0].id == name
+            ):
+                r = self._container_reason(evidence, scope, sub.func.value)
+                if r is None:
+                    return None
+                transfer_reason = transfer_reason or r
+            if isinstance(sub, ast.Assign) and isinstance(sub.value, ast.Name) \
+                    and sub.value.id == name:
+                for tgt in sub.targets:
+                    if isinstance(tgt, ast.Subscript):
+                        r = self._container_reason(evidence, scope, tgt.value)
+                        if r is None:
+                            return None
+                        transfer_reason = transfer_reason or r
+                    elif isinstance(tgt, ast.Attribute):
+                        r = self._attr_reason(evidence, tgt.attr)
+                        if r is None:
+                            return None
+                        transfer_reason = transfer_reason or r
+                    elif isinstance(tgt, ast.Name) and tgt.id not in seen:
+                        seen.add(tgt.id)
+                        if (
+                            self._local_reason(
+                                index, evidence, parents_for, src, scope,
+                                tgt.id, chain, depth, seen,
+                            )
+                            is None
+                        ):
+                            return None
+        return transfer_reason or (
+            f"local `{name}` is never awaited, cancelled, swept, or handed "
+            "to a tracked owner in its enclosing scope"
+        )
+
+    def _returned_reason(
+        self,
+        index: FunctionIndex,
+        evidence: EvidenceIndex,
+        parents_for,
+        chain: Chain,
+        depth: int,
+    ) -> Optional[str]:
+        """The spawn is `return create_task(...)`: ownership moves to the
+        callers. Chase every call site of the enclosing function; fire
+        only when sites exist and EVERY one provably drops the task."""
+        if depth >= _MAX_RETURN_DEPTH or not chain:
+            return None
+        func = chain[-1]
+        sites = index.call_sites.get(func.name, [])
+        if not sites:
+            return None  # exported factory / dynamic dispatch: stay quiet
+        reasons = []
+        for site in sites:
+            if site.is_partial:
+                return None
+            r = self._site_reason(
+                index, evidence, parents_for, site.src, site.call,
+                site.chain, depth + 1,
+            )
+            if r is None:
+                return None
+            reasons.append(f"{site.src.rel}:{site.call.lineno}")
+        return (
+            f"returned from `{func.name}`, but every call site drops it "
+            f"({'; '.join(sorted(set(reasons))[:3])})"
+        )

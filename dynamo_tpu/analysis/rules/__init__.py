@@ -1,5 +1,12 @@
 """dynolint rule pack: the invariants this codebase has been burned by."""
 
+from ..flow import (
+    FLOW_RULES,
+    CancellationSafetyRule,
+    FaultPointRegistryRule,
+    FrameProtocolRule,
+    TaskLifecycleRule,
+)
 from ..shard import SHARD_RULES, AxisRegistryRule, CollectiveSymmetryRule, PallasGridRule
 from .async_safety import AsyncBlockingRule
 from .env_registry import EnvRegistryRule
@@ -15,12 +22,13 @@ CORE_RULES = (
     LockDisciplineRule,
 )
 
-ALL_RULES = CORE_RULES + SHARD_RULES
+ALL_RULES = CORE_RULES + SHARD_RULES + FLOW_RULES
 
 #: pack aliases accepted by the CLI's --rules (e.g. `--rules shard`)
 PACKS = {
     "core": CORE_RULES,
     "shard": SHARD_RULES,
+    "flow": FLOW_RULES,
 }
 
 
@@ -31,14 +39,19 @@ def default_rules():
 __all__ = [
     "ALL_RULES",
     "CORE_RULES",
+    "FLOW_RULES",
     "PACKS",
     "AsyncBlockingRule",
     "AxisRegistryRule",
+    "CancellationSafetyRule",
     "CollectiveSymmetryRule",
     "EnvRegistryRule",
+    "FaultPointRegistryRule",
+    "FrameProtocolRule",
     "JaxPurityRule",
     "LockDisciplineRule",
     "PallasGridRule",
     "SilentDropRule",
+    "TaskLifecycleRule",
     "default_rules",
 ]

@@ -928,6 +928,10 @@ class JaxEngine:
         self._wake.set()
         if self._step_task:
             self._step_task.cancel()
+        # in-flight KV pulls: their slots are dead with the engine, and a
+        # pull left running would keep injecting into reused pages
+        for t in list(self._bg_tasks):
+            t.cancel()
         if self.kvbm is not None:
             # drain in-flight write-through offloads, then persist G3 index
             for _ in range(500):
@@ -2172,7 +2176,10 @@ class JaxEngine:
             else:
                 await pull_kv(desc, inject)
         except asyncio.CancelledError:
-            return
+            # slot released mid-pull (inject raises) or engine close()
+            # cancelled us: nothing to fall back to — propagate so the
+            # task records itself cancelled, not finished
+            raise
         except Exception as e:  # noqa: BLE001 — any pull failure -> local fallback
             if slot.done or slot.slot_idx < 0 or self.slots[slot.slot_idx] is not slot:
                 return

@@ -444,6 +444,7 @@ async def main():
 
     prefill_client = None
     disagg_router = None
+    _queue_watch_task = None
     if args.role == "decode":
         from dynamo_tpu.llm.disagg import DisaggConfig, DisaggregatedRouter
 
@@ -498,7 +499,8 @@ async def main():
                 except Exception:  # noqa: BLE001 — stats are advisory
                     logger.debug("bad prefill metrics message", exc_info=True)
 
-        # strong ref: main() outlives it; the loop alone keeps only weak refs
+        # owned by main(): strong ref (the event loop keeps only weak
+        # refs), cancelled after wait_for_shutdown
         _queue_watch_task = asyncio.get_running_loop().create_task(
             _watch_prefill_queue()
         )
@@ -534,6 +536,8 @@ async def main():
         drt.instance_id,
     )
     await drt.wait_for_shutdown()
+    if _queue_watch_task is not None:
+        _queue_watch_task.cancel()
     # graceful drain: lease revoked first (routers stop picking us), then
     # in-flight streams finish within DYN_RUNTIME_GRACEFUL_SHUTDOWN_TIMEOUT,
     # then survivors are force-cancelled (runtime/component.py close())

@@ -212,6 +212,9 @@ class KvbmDistributed:
         }
 
     async def close(self):
+        # in-flight best-effort announcements die with the mirror
+        for t in list(self._bg):
+            t.cancel()
         if self._task:
             self._task.cancel()
         if self._addr_task:

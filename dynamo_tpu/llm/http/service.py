@@ -536,7 +536,9 @@ class HttpService:
                 async for ann in s:
                     await queue.put((i, ann))
             finally:
-                await queue.put((i, None))
+                # synchronous: an await here is a cancellation delivery
+                # point and the end-of-choice marker must always land
+                queue.put_nowait((i, None))
 
         tasks = [asyncio.create_task(pump(i, s)) for i, s in enumerate(streams)]
         first_token_at = None

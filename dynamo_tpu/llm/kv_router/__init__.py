@@ -283,6 +283,9 @@ class KvPushRouter:
             self._publish_sync({"op": "free", "request_id": request_id})
 
     async def close(self):
+        # in-flight best-effort sync publishes die with the router
+        for t in list(self._bg):
+            t.cancel()
         if self._metrics_task:
             self._metrics_task.cancel()
         if self._metrics_sub:

@@ -98,7 +98,7 @@ async def main():
                 stats.data = engine.stats()
             await asyncio.sleep(0.5)
 
-    asyncio.create_task(stats_loop())
+    stats_task = asyncio.create_task(stats_loop())
 
     async def handler(request, context):
         if request.get("embed"):
@@ -133,6 +133,7 @@ async def main():
     await register_llm(endpoint, card)
     logger.info("mocker worker up: model=%s instance=%x", args.model_name, drt.instance_id)
     await drt.wait_for_shutdown()
+    stats_task.cancel()
     await drt.close()  # graceful drain (runtime/component.py close())
 
 

@@ -199,8 +199,8 @@ class StepBroadcaster:
                 f.acked = int(body.get("seq", f.acked))
         except (asyncio.IncompleteReadError, ConnectionError, RuntimeError) as e:
             self._lose(f, f"step stream closed ({type(e).__name__})")
-        except asyncio.CancelledError:
-            pass
+        # cancellation (leader close()) propagates: the task must record
+        # itself cancelled, not finished, so drain accounting stays honest
 
     def _lose(self, f: _Follower, why: str):
         if f not in self._followers:

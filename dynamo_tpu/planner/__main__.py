@@ -86,7 +86,9 @@ async def amain(args: argparse.Namespace) -> None:
     try:
         await planner.run()
     finally:
-        await disc.close()
+        # shielded: a cancellation (Ctrl-C) landing mid-close must not
+        # abandon the discovery teardown
+        await asyncio.shield(disc.close())
 
 
 def main(argv=None) -> None:

@@ -255,14 +255,20 @@ async def amain(argv: List[str]) -> int:
         logger.info("batch done: %d prompts -> %s.out.jsonl", n, path)
         return 0
     finally:
-        await watcher.stop()
-        if worker_proc is not None and worker_proc.returncode is None:
-            worker_proc.send_signal(signal.SIGTERM)
-            try:
-                await asyncio.wait_for(worker_proc.wait(), timeout=5)
-            except asyncio.TimeoutError:
-                worker_proc.kill()
-        await drt.close()
+        # one shielded teardown coroutine: a Ctrl-C cancellation landing
+        # mid-cleanup must not abandon the worker SIGTERM or the runtime
+        # drain halfway through
+        async def _teardown():
+            await watcher.stop()
+            if worker_proc is not None and worker_proc.returncode is None:
+                worker_proc.send_signal(signal.SIGTERM)
+                try:
+                    await asyncio.wait_for(worker_proc.wait(), timeout=5)
+                except asyncio.TimeoutError:
+                    worker_proc.kill()
+            await drt.close()
+
+        await asyncio.shield(_teardown())
 
 
 def main() -> None:

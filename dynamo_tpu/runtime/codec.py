@@ -21,6 +21,83 @@ MAGIC = 0xD7A0C0DE
 _HDR = struct.Struct("<III")
 MAX_FRAME = 1 << 30  # 1 GiB sanity bound
 
+# --------------------------------------------------------------------- #
+# Wire-frame tag registry
+# --------------------------------------------------------------------- #
+# The single spelling of every dispatch tag the serving plane's framed
+# protocols put on the wire. Producers and consumers import these
+# constants; the `flow-frame-protocol` dynolint rule checks that every
+# tag literal reaching a frame dict or a dispatch comparison resolves
+# into FRAME_TAGS, and that the producer and consumer sets stay
+# symmetric per channel (a tag emitted with no dispatch arm — or a
+# dispatch arm no producer can reach — is protocol drift and fails CI).
+# See docs/wire_protocol.md.
+
+# request/response plane, "t" channel (runtime/request_plane.py)
+T_REQ = "req"
+T_CANCEL = "cancel"
+T_PING = "ping"
+T_PONG = "pong"
+T_DATA = "data"
+T_DONE = "done"
+T_ERR = "err"
+T_LOST = "lost"  # synthesized client-side on connection loss; never sent
+
+# discovery control plane, "op" channel (runtime/discovery.py)
+OP_PUT = "put"
+OP_CREATE = "create"
+OP_GET = "get"
+OP_GET_PREFIX = "get_prefix"
+OP_DELETE = "delete"
+OP_DELETE_PREFIX = "delete_prefix"
+OP_LEASE_GRANT = "lease_grant"
+OP_LEASE_KEEPALIVE = "lease_keepalive"
+OP_LEASE_REVOKE = "lease_revoke"
+OP_WATCH = "watch"
+OP_UNWATCH = "unwatch"
+OP_PUBLISH = "publish"
+OP_SUBSCRIBE = "subscribe"
+OP_UNSUBSCRIBE = "unsubscribe"
+OP_STATUS = "status"
+
+# discovery server->client pushes, "push" channel (runtime/discovery.py)
+PUSH_WATCH = "watch"
+PUSH_MSG = "msg"
+
+FRAME_TAGS = {
+    "t": {
+        T_REQ: "open a stream: subject + packed request payload",
+        T_CANCEL: "cancel a stream (kill=bool: hard vs graceful stop)",
+        T_PING: "transport liveness probe",
+        T_PONG: "liveness probe reply",
+        T_DATA: "one stream item (n=k: payload is k coalesced items)",
+        T_DONE: "clean end of stream",
+        T_ERR: "terminal stream error (code=draining: retry elsewhere)",
+        T_LOST: "local marker: connection died mid-stream (never on wire)",
+    },
+    "op": {
+        OP_PUT: "write a key (optionally lease-attached)",
+        OP_CREATE: "atomic create: fails if the key exists",
+        OP_GET: "read one key",
+        OP_GET_PREFIX: "read all keys under a prefix",
+        OP_DELETE: "delete one key",
+        OP_DELETE_PREFIX: "delete all keys under a prefix",
+        OP_LEASE_GRANT: "grant a TTL lease",
+        OP_LEASE_KEEPALIVE: "refresh a lease's deadline",
+        OP_LEASE_REVOKE: "revoke a lease (deletes attached keys)",
+        OP_WATCH: "start a prefix watch (reply carries snapshot)",
+        OP_UNWATCH: "end a prefix watch",
+        OP_PUBLISH: "fan a payload out to topic subscribers",
+        OP_SUBSCRIBE: "subscribe to a topic",
+        OP_UNSUBSCRIBE: "end a topic subscription",
+        OP_STATUS: "server status snapshot",
+    },
+    "push": {
+        PUSH_WATCH: "server-pushed watch event (type=put|delete)",
+        PUSH_MSG: "server-pushed topic message",
+    },
+}
+
 
 def encode_frame(control: dict, payload: bytes = b"") -> bytes:
     header = msgpack.packb(control, use_bin_type=True)

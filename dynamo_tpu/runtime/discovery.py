@@ -28,6 +28,25 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from . import codec, faults
 from .backoff import Backoff
+from .codec import (
+    OP_CREATE,
+    OP_DELETE,
+    OP_DELETE_PREFIX,
+    OP_GET,
+    OP_GET_PREFIX,
+    OP_LEASE_GRANT,
+    OP_LEASE_KEEPALIVE,
+    OP_LEASE_REVOKE,
+    OP_PUBLISH,
+    OP_PUT,
+    OP_STATUS,
+    OP_SUBSCRIBE,
+    OP_UNSUBSCRIBE,
+    OP_UNWATCH,
+    OP_WATCH,
+    PUSH_MSG,
+    PUSH_WATCH,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -160,7 +179,7 @@ class DiscoveryServer:
                 try:
                     await codec.write_frame(
                         w.writer,
-                        {"push": "watch", "watch_id": w.watch_id, "type": ev_type, "key": key},
+                        {"push": PUSH_WATCH, "watch_id": w.watch_id, "type": ev_type, "key": key},
                         value,
                     )
                 except (ConnectionError, RuntimeError):
@@ -209,22 +228,22 @@ class DiscoveryServer:
         self, control: dict, payload: bytes, writer, conn_watches
     ) -> Tuple[dict, bytes]:
         op = control.get("op")
-        if op == "put":
+        if op == OP_PUT:
             r = await self._put(
                 control["key"], payload, control.get("lease_id", 0), create_only=False
             )
             return r, b""
-        if op == "create":
+        if op == OP_CREATE:
             r = await self._put(
                 control["key"], payload, control.get("lease_id", 0), create_only=True
             )
             return r, b""
-        if op == "get":
+        if op == OP_GET:
             rec = self._kv.get(control["key"])
             if rec is None:
                 return {"ok": True, "found": False}, b""
             return {"ok": True, "found": True, "revision": rec.mod_revision}, rec.value
-        if op == "get_prefix":
+        if op == OP_GET_PREFIX:
             prefix = control["prefix"]
             items = [
                 {"key": k, "value": rec.value, "revision": rec.mod_revision}
@@ -232,29 +251,29 @@ class DiscoveryServer:
                 if k.startswith(prefix)
             ]
             return {"ok": True, "revision": self._revision}, codec.pack(items)
-        if op == "delete":
+        if op == OP_DELETE:
             deleted = await self._delete_key(control["key"])
             return {"ok": True, "deleted": deleted}, b""
-        if op == "delete_prefix":
+        if op == OP_DELETE_PREFIX:
             keys = [k for k in list(self._kv) if k.startswith(control["prefix"])]
             for k in keys:
                 await self._delete_key(k)
             return {"ok": True, "deleted": len(keys)}, b""
-        if op == "lease_grant":
+        if op == OP_LEASE_GRANT:
             ttl = float(control.get("ttl", 10.0))
             lease = _Lease(next(self._lease_ids), ttl, time.monotonic() + ttl)
             self._leases[lease.lease_id] = lease
             return {"ok": True, "lease_id": lease.lease_id, "ttl": ttl}, b""
-        if op == "lease_keepalive":
+        if op == OP_LEASE_KEEPALIVE:
             lease = self._leases.get(control["lease_id"])
             if lease is None:
                 return {"ok": False, "error": "lease expired"}, b""
             lease.deadline = time.monotonic() + lease.ttl
             return {"ok": True, "ttl": lease.ttl}, b""
-        if op == "lease_revoke":
+        if op == OP_LEASE_REVOKE:
             await self._revoke(control["lease_id"])
             return {"ok": True}, b""
-        if op == "watch":
+        if op == OP_WATCH:
             wid = next(self._watch_ids)
             self._watchers[wid] = _Watcher(wid, control["prefix"], writer)
             conn_watches.append(wid)
@@ -265,10 +284,10 @@ class DiscoveryServer:
                 if k.startswith(control["prefix"])
             ]
             return {"ok": True, "watch_id": wid}, codec.pack(items)
-        if op == "unwatch":
+        if op == OP_UNWATCH:
             self._watchers.pop(control["watch_id"], None)
             return {"ok": True}, b""
-        if op == "publish":
+        if op == OP_PUBLISH:
             # NATS-core-role pub/sub: fan out to live topic subscribers, no
             # persistence (KV events, metrics broadcast)
             topic = control["topic"]
@@ -276,25 +295,25 @@ class DiscoveryServer:
                 try:
                     await codec.write_frame(
                         sub.writer,
-                        {"push": "msg", "sub_id": sub.watch_id, "topic": topic},
+                        {"push": PUSH_MSG, "sub_id": sub.watch_id, "topic": topic},
                         payload,
                     )
                 except (ConnectionError, RuntimeError):
                     self._drop_sub(sub)
             return {"ok": True}, b""
-        if op == "subscribe":
+        if op == OP_SUBSCRIBE:
             wid = next(self._watch_ids)
             sub = _Watcher(wid, control["topic"], writer)
             self._subs.setdefault(control["topic"], []).append(sub)
             self._subs_by_id[wid] = sub
             conn_watches.append(-wid)  # negative marks a topic sub
             return {"ok": True, "sub_id": wid}, b""
-        if op == "unsubscribe":
+        if op == OP_UNSUBSCRIBE:
             sub = self._subs_by_id.get(control["sub_id"])
             if sub:
                 self._drop_sub(sub)
             return {"ok": True}, b""
-        if op == "status":
+        if op == OP_STATUS:
             return {
                 "ok": True,
                 "revision": self._revision,
@@ -376,7 +395,7 @@ class Subscription:
     async def cancel(self):
         self._client._subs.pop(self.sub_id, None)
         try:
-            await self._client._call({"op": "unsubscribe", "sub_id": self.sub_id})
+            await self._client._call({"op": OP_UNSUBSCRIBE, "sub_id": self.sub_id})
         except ConnectionError:
             pass
         self._queue.put_nowait(None)
@@ -411,12 +430,12 @@ class Lease:
                 # own back so the NEXT keepalive walks the lost/re-grant path
                 try:
                     await self._client._call(
-                        {"op": "lease_revoke", "lease_id": self.lease_id}
+                        {"op": OP_LEASE_REVOKE, "lease_id": self.lease_id}
                     )
                 except ConnectionError:
                     pass
             try:
-                resp = await self._client._call({"op": "lease_keepalive", "lease_id": self.lease_id})
+                resp = await self._client._call({"op": OP_LEASE_KEEPALIVE, "lease_id": self.lease_id})
                 if not resp[0].get("ok"):
                     logger.warning(
                         "lease %d lost (%s); attempting re-grant",
@@ -442,7 +461,7 @@ class Lease:
 
     async def _regrant(self) -> bool:
         try:
-            resp, _ = await self._client._call({"op": "lease_grant", "ttl": self.ttl})
+            resp, _ = await self._client._call({"op": OP_LEASE_GRANT, "ttl": self.ttl})
             if not resp.get("ok"):
                 return False
             self.lease_id = resp["lease_id"]
@@ -458,7 +477,7 @@ class Lease:
         if self._task:
             self._task.cancel()
         try:
-            await self._client._call({"op": "lease_revoke", "lease_id": self.lease_id})
+            await self._client._call({"op": OP_LEASE_REVOKE, "lease_id": self.lease_id})
         except ConnectionError:
             pass
 
@@ -549,7 +568,7 @@ class DiscoveryClient:
                 return False
             for sub in list(self._subs.values()):
                 try:
-                    resp, _ = await self._call({"op": "subscribe", "topic": sub.topic})
+                    resp, _ = await self._call({"op": OP_SUBSCRIBE, "topic": sub.topic})
                     self._subs.pop(sub.sub_id, None)
                     sub.sub_id = resp["sub_id"]
                     self._subs[sub.sub_id] = sub
@@ -575,14 +594,14 @@ class DiscoveryClient:
                     writer.close()
                     break
                 control, payload = frame
-                if control.get("push") == "watch":
+                if control.get("push") == PUSH_WATCH:
                     watch = self._watches.get(control["watch_id"])
                     if watch:
                         watch._queue.put_nowait(
                             WatchEvent(control["type"], control["key"], payload)
                         )
                     continue
-                if control.get("push") == "msg":
+                if control.get("push") == PUSH_MSG:
                     sub = self._subs.get(control["sub_id"])
                     if sub:
                         sub._queue.put_nowait(payload)
@@ -590,8 +609,10 @@ class DiscoveryClient:
                 fut = self._pending.pop(control.get("req_id"), None)
                 if fut and not fut.done():
                     fut.set_result((control, payload))
-        except (ConnectionError, asyncio.CancelledError):
+        except ConnectionError:
             pass
+        except asyncio.CancelledError:
+            raise  # cleanup below still runs; the task records cancelled
         finally:
             for fut in self._pending.values():
                 if not fut.done():
@@ -636,7 +657,7 @@ class DiscoveryClient:
 
     async def put(self, key: str, value: bytes, lease: Optional[Lease] = None):
         resp, _ = await self._call(
-            {"op": "put", "key": key, "lease_id": lease.lease_id if lease else 0}, value
+            {"op": OP_PUT, "key": key, "lease_id": lease.lease_id if lease else 0}, value
         )
         if not resp["ok"]:
             raise RuntimeError(f"put {key} failed: {resp.get('error')}")
@@ -645,7 +666,7 @@ class DiscoveryClient:
         """Atomic create; returns False if the key already exists
         (reference etcd kv_create)."""
         resp, _ = await self._call(
-            {"op": "create", "key": key, "lease_id": lease.lease_id if lease else 0}, value
+            {"op": OP_CREATE, "key": key, "lease_id": lease.lease_id if lease else 0}, value
         )
         if not resp["ok"] and resp.get("error") == "key exists":
             return False
@@ -654,30 +675,30 @@ class DiscoveryClient:
         return True
 
     async def get(self, key: str) -> Optional[bytes]:
-        resp, payload = await self._call({"op": "get", "key": key})
+        resp, payload = await self._call({"op": OP_GET, "key": key})
         return payload if resp.get("found") else None
 
     async def get_prefix(self, prefix: str) -> List[dict]:
-        _, payload = await self._call({"op": "get_prefix", "prefix": prefix})
+        _, payload = await self._call({"op": OP_GET_PREFIX, "prefix": prefix})
         return codec.unpack(payload)
 
     async def delete(self, key: str) -> bool:
-        resp, _ = await self._call({"op": "delete", "key": key})
+        resp, _ = await self._call({"op": OP_DELETE, "key": key})
         return bool(resp.get("deleted"))
 
     async def delete_prefix(self, prefix: str) -> int:
-        resp, _ = await self._call({"op": "delete_prefix", "prefix": prefix})
+        resp, _ = await self._call({"op": OP_DELETE_PREFIX, "prefix": prefix})
         return int(resp.get("deleted", 0))
 
     async def grant_lease(self, ttl: float = 10.0, keepalive: bool = True) -> Lease:
-        resp, _ = await self._call({"op": "lease_grant", "ttl": ttl})
+        resp, _ = await self._call({"op": OP_LEASE_GRANT, "ttl": ttl})
         lease = Lease(resp["lease_id"], resp["ttl"], self)
         if keepalive:
             lease.start_keepalive()
         return lease
 
     async def watch_prefix(self, prefix: str) -> Watch:
-        resp, payload = await self._call({"op": "watch", "prefix": prefix})
+        resp, payload = await self._call({"op": OP_WATCH, "prefix": prefix})
         watch = Watch(resp["watch_id"], codec.unpack(payload), self)
         self._watches[watch.watch_id] = watch
         return watch
@@ -685,16 +706,16 @@ class DiscoveryClient:
     async def _unwatch(self, watch_id: int):
         self._watches.pop(watch_id, None)
         try:
-            await self._call({"op": "unwatch", "watch_id": watch_id})
+            await self._call({"op": OP_UNWATCH, "watch_id": watch_id})
         except ConnectionError:
             pass
 
     async def publish(self, topic: str, payload: bytes):
         """Fire-and-forget topic publish (NATS-core role)."""
-        await self._call({"op": "publish", "topic": topic}, payload)
+        await self._call({"op": OP_PUBLISH, "topic": topic}, payload)
 
     async def subscribe(self, topic: str) -> Subscription:
-        resp, _ = await self._call({"op": "subscribe", "topic": topic})
+        resp, _ = await self._call({"op": OP_SUBSCRIBE, "topic": topic})
         sub = Subscription(resp["sub_id"], topic, self)
         self._subs[sub.sub_id] = sub
         return sub
@@ -713,7 +734,7 @@ class DiscoveryClient:
         await self.delete(f"v1/locks/{name}")
 
     async def status(self) -> dict:
-        resp, _ = await self._call({"op": "status"})
+        resp, _ = await self._call({"op": OP_STATUS})
         return resp
 
 

@@ -65,17 +65,34 @@ logger = logging.getLogger(__name__)
 _HANG_SECONDS = 3600.0
 _UNLIMITED = 1 << 30
 
-#: Canonical injection point names, for docs and plan validation. Sites may
-#: use ad-hoc names (tests do), but these are the threaded serving-plane set.
-KNOWN_POINTS = (
-    "request_plane.connect",  # client dial: refuse | hang
-    "request_plane.frame",    # client recv, per data frame: sever | delay | hang
-    "discovery.lease",        # lease keepalive: drop (server-side expiry)
-    "discovery.watch",        # discovery recv loop: disconnect
-    "engine.step",            # JaxEngine step loop: error
-    "mocker.step",            # MockEngine step loop: error
-    "kv_transfer.chunk",      # data-plane chunk serve: sever | delay
-)
+#: Canonical injection points: name -> one-line description (actions the
+#: site interprets, then where it bites). This table is the source of
+#: truth three consumers share: DYN_FAULT_PLAN validation-by-docs, the
+#: generated point table in docs/fault_tolerance.md
+#: (`python -m dynamo_tpu.analysis --emit-fault-docs`), and the
+#: `flow-fault-point-registry` dynolint rule, which fails CI when an
+#: injection site names a point missing here (or an entry here has no
+#: site left). Sites may use ad-hoc names in tests, but every
+#: `faults.FAULTS.on/check(...)` call inside the package must resolve
+#: into this table.
+KNOWN_FAULT_POINTS = {
+    "request_plane.connect":
+        "`refuse` | `hang` — client dial of a worker's request plane",
+    "request_plane.frame":
+        "`sever` | `delay` | `hang` — client recv, per data frame; "
+        "`sever` kills the whole connection",
+    "discovery.lease":
+        "`drop` — lease keepalive tick; simulates server-side TTL expiry",
+    "discovery.watch":
+        "`disconnect` — discovery recv loop; drops the control-plane "
+        "connection to exercise the re-watch path",
+    "engine.step":
+        "`error` — JaxEngine step loop; fail-all then migration",
+    "mocker.step":
+        "`error` — MockEngine step loop; fail-all",
+    "kv_transfer.chunk":
+        "`sever` | `delay` — KV data-plane chunk serve; partial transfer",
+}
 
 
 class FaultError(RuntimeError):

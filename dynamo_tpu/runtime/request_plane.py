@@ -19,6 +19,11 @@ Wire protocol (two-part frames, codec.py):
                                                        payload = packed list)
              {t:"done", stream:<id>}                  (clean end)
              {t:"err",  stream:<id>, error:<str>}     (terminal error)
+  liveness:  {t:"ping", stream:<id>} -> {t:"pong", stream:<id>}
+
+Tag spellings are the constants in codec.py's FRAME_TAGS registry
+(docs/wire_protocol.md); the flow-frame-protocol lint keeps producer and
+consumer arms symmetric.
 
 Token-path batching: the response writer gathers every stream item that is
 already ready (same event-loop tick, optionally up to DYN_STREAM_COALESCE_MS
@@ -39,6 +44,7 @@ import time
 from typing import Any, AsyncIterator, Awaitable, Callable, Dict, Optional, Tuple
 
 from . import codec, faults
+from .codec import T_CANCEL, T_DATA, T_DONE, T_ERR, T_LOST, T_PING, T_PONG, T_REQ
 from .config import _env
 from .engine import Context
 from .logging import DistributedTraceContext, current_trace, parse_traceparent, set_trace
@@ -176,12 +182,12 @@ class RequestPlaneServer:
                     break
                 control, payload = frame
                 t = control.get("t")
-                if t == "req":
+                if t == T_REQ:
                     stream_id = control["stream"]
                     if self._draining:
                         async with write_lock:
                             await codec.write_frame(writer, {
-                                "t": "err", "stream": stream_id,
+                                "t": T_ERR, "stream": stream_id,
                                 "code": DRAINING,
                                 "error": "worker draining: not accepting new streams",
                             })
@@ -191,16 +197,21 @@ class RequestPlaneServer:
                     )
                     tasks[stream_id] = task
                     task.add_done_callback(lambda _, sid=stream_id: tasks.pop(sid, None))
-                elif t == "cancel":
+                elif t == T_CANCEL:
                     ctx = self._active.get((writer, control["stream"]))
                     if ctx is not None:
                         if control.get("kill"):
                             ctx.kill()
                         else:
                             ctx.stop_generating()
-                elif t == "ping":
+                elif t == T_PING:
                     async with write_lock:
-                        await codec.write_frame(writer, {"t": "pong"})
+                        # echo the stream id so the pinger's reply queue
+                        # (RequestPlaneClient.ping) can route the pong
+                        await codec.write_frame(
+                            writer,
+                            {"t": T_PONG, "stream": control.get("stream")},
+                        )
         except (ConnectionError, asyncio.IncompleteReadError):
             pass
         except ValueError as e:
@@ -233,7 +244,7 @@ class RequestPlaneServer:
                 await codec.write_frame(writer, ctrl, pl)
 
         if handler is None:
-            await send({"t": "err", "error": f"no such endpoint: {subject}"})
+            await send({"t": T_ERR, "error": f"no such endpoint: {subject}"})
             return
 
         ctx = Context(id=control.get("ctx_id"))
@@ -302,12 +313,12 @@ class RequestPlaneServer:
                     stats.frames_total += 1
                     stats.items_total += len(items)
                 if len(items) == 1:
-                    await send({"t": "data"}, codec.pack(items[0]))
+                    await send({"t": T_DATA}, codec.pack(items[0]))
                 else:
-                    await send({"t": "data", "n": len(items)}, codec.pack(items))
+                    await send({"t": T_DATA, "n": len(items)}, codec.pack(items))
             kind, item = terminal
             if kind == _DONE:
-                await send({"t": "done"})
+                await send({"t": T_DONE})
             else:
                 raise item  # handler exception: report like the inline path
         except asyncio.CancelledError:
@@ -317,7 +328,7 @@ class RequestPlaneServer:
             if stats:
                 stats.errors_total += 1
             try:
-                await send({"t": "err", "error": f"{type(e).__name__}: {e}"})
+                await send({"t": T_ERR, "error": f"{type(e).__name__}: {e}"})
             except (ConnectionError, RuntimeError):
                 pass
         finally:
@@ -361,12 +372,14 @@ class _Connection:
                 q = self.streams.get(control.get("stream"))
                 if q is not None:
                     q.put_nowait((control, payload))
-        except (ConnectionError, asyncio.CancelledError):
+        except ConnectionError:
             pass
+        except asyncio.CancelledError:
+            raise  # cleanup below still runs; the task records cancelled
         finally:
             self.closed = True
             for q in self.streams.values():
-                q.put_nowait(({"t": "lost"}, b""))
+                q.put_nowait(({"t": T_LOST}, b""))
             self.writer.close()
 
 
@@ -429,11 +442,49 @@ class RequestPlaneClient:
             # nobody will ever fill again
             conn.closed = True
             for q in conn.streams.values():
-                q.put_nowait(({"t": "lost"}, b""))
+                q.put_nowait(({"t": T_LOST}, b""))
             if conn.recv_task:
                 conn.recv_task.cancel()
             conn.writer.close()
         self._conns.clear()
+
+    async def ping(self, address: str, timeout: float = 5.0) -> float:
+        """Transport liveness probe: one ping/pong round-trip on the pooled
+        connection (no handler dispatch — cheaper than a canary request
+        and usable against a draining worker). Returns the RTT in seconds;
+        raises StreamLost when the peer is unreachable or silent past
+        `timeout`."""
+        try:
+            # the dial shares the probe's budget, not the default connect
+            # timeout — a black-holed host answers within `timeout` too
+            conn = await self._get_conn(
+                address, deadline=time.monotonic() + timeout
+            )
+        except OSError as e:
+            raise StreamLost(f"cannot connect to {address}: {e}") from e
+        stream_id = next(self._stream_ids)
+        queue: asyncio.Queue = asyncio.Queue()
+        conn.streams[stream_id] = queue
+        t0 = time.monotonic()
+        try:
+            async with conn.write_lock:
+                await codec.write_frame(
+                    conn.writer, {"t": T_PING, "stream": stream_id}
+                )
+            try:
+                control, _ = await asyncio.wait_for(queue.get(), timeout)
+            except asyncio.TimeoutError:
+                raise StreamLost(
+                    f"ping to {address} timed out after {timeout:.1f}s"
+                ) from None
+            t = control.get("t")
+            if t == T_PONG:
+                return time.monotonic() - t0
+            raise StreamLost(f"ping to {address} answered '{t}', not pong")
+        except (ConnectionError, OSError) as e:
+            raise StreamLost(f"ping to {address} failed: {e}") from e
+        finally:
+            conn.streams.pop(stream_id, None)
 
     async def call(
         self,
@@ -455,7 +506,7 @@ class RequestPlaneClient:
         queue: asyncio.Queue = asyncio.Queue()
         conn.streams[stream_id] = queue
 
-        control = {"t": "req", "stream": stream_id, "subject": subject, "ctx_id": ctx.id}
+        control = {"t": T_REQ, "stream": stream_id, "subject": subject, "ctx_id": ctx.id}
         remaining = ctx.time_remaining()
         if remaining is not None:
             # ship the REMAINING budget, not an absolute time: monotonic
@@ -505,7 +556,7 @@ class RequestPlaneClient:
                 control, payload = get_task.result()  # dynolint: disable=async-blocking -- task already done
                 get_task = None
                 t = control.get("t")
-                if t == "data":
+                if t == T_DATA:
                     f = faults.FAULTS
                     if f.enabled:
                         act = await f.on("request_plane.frame")
@@ -527,15 +578,15 @@ class RequestPlaneClient:
                             yield it
                     else:
                         yield codec.unpack(payload)
-                elif t == "done":
+                elif t == T_DONE:
                     return
-                elif t == "err":
+                elif t == T_ERR:
                     if control.get("code") == DRAINING:
                         # a draining worker is connection-level unavailable:
                         # routers and migration retry another instance
                         raise StreamLost(control.get("error", "worker draining"))
                     raise EngineError(control.get("error", "engine error"))
-                elif t == "lost":
+                elif t == T_LOST:
                     raise StreamLost("connection to worker lost mid-stream")
         finally:
             for task in (kill_task, stop_task, get_task):
@@ -547,7 +598,7 @@ class RequestPlaneClient:
         try:
             async with conn.write_lock:
                 await codec.write_frame(
-                    conn.writer, {"t": "cancel", "stream": stream_id, "kill": kill}
+                    conn.writer, {"t": T_CANCEL, "stream": stream_id, "kill": kill}
                 )
         except (ConnectionError, OSError):
             pass

@@ -1,0 +1,702 @@
+"""dynoflow (analysis/flow/) fixture tests.
+
+Mirrors tests/test_shard_analysis.py: every rule gets a shape it FIRES
+on, a shape it stays QUIET on, and a suppression check — plus seeded-bug
+reconstructions for the acceptance criteria, each producing EXACTLY ONE
+violation:
+
+  * flow-task-lifecycle: the PR-3 silent mocker step-loop death (an
+    orphaned `create_task` whose exception vanished and hung every
+    stream);
+  * flow-cancellation-safety: a drain-sequence cleanup await that a
+    cancellation rips through mid-shutdown;
+  * flow-frame-protocol: a coalesced data-frame tag typo (producer emits
+    a tag no consumer dispatches);
+  * flow-fault-point-registry: an injection site renamed away from the
+    documented point set.
+
+Plus the red-test the acceptance criteria demand: removing any single
+frame-tag consumer dispatch arm from the REAL protocol modules makes
+flow-frame-protocol fail; and a --changed-only CLI e2e for the flow pack
+in a throwaway git repo.
+
+The tree-clean gate for the flow pack rides the existing
+tests/test_static_analysis.py::test_tree_is_clean (default_rules() now
+includes the pack); test_real_tree_flow_pack_clean below pins it
+explicitly as well.
+"""
+
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+from dynamo_tpu.analysis import Project, run
+from dynamo_tpu.analysis.flow import (
+    CancellationSafetyRule,
+    FaultPointRegistryRule,
+    FrameProtocolRule,
+    TaskLifecycleRule,
+)
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def make_project(tmp_path: Path, files: dict) -> Project:
+    for rel, text in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(text))
+    return Project.load(tmp_path)
+
+
+def rule_hits(project: Project, rule) -> list:
+    return run(project, [rule])
+
+
+# --------------------------------------------------------------------- #
+# flow-task-lifecycle
+# --------------------------------------------------------------------- #
+
+
+def test_task_lifecycle_quiet_on_owned_shapes(tmp_path):
+    """Attribute + close(), local await, tracked container + sweep, and
+    tuple-iteration reaping all count as ownership."""
+    project = make_project(tmp_path, {
+        "dynamo_tpu/runtime/owned.py": """
+            import asyncio
+
+            class Loop:
+                def start(self):
+                    self._task = asyncio.create_task(self._run())
+
+                async def close(self):
+                    if self._task:
+                        self._task.cancel()
+
+                async def _run(self):
+                    await asyncio.sleep(1)
+
+            async def inline():
+                t = asyncio.create_task(asyncio.sleep(0))
+                await t
+
+            async def tracked():
+                tasks = [asyncio.create_task(asyncio.sleep(0)) for _ in range(3)]
+                extra = asyncio.create_task(asyncio.sleep(0))
+                try:
+                    await asyncio.sleep(1)
+                finally:
+                    for t in (extra, *tasks):
+                        t.cancel()
+        """,
+    })
+    assert rule_hits(project, TaskLifecycleRule()) == []
+
+
+def test_task_lifecycle_mocker_step_loop_reconstruction(tmp_path):
+    """Seeded-bug reconstruction (PR 3): the mocker's step loop ran in a
+    task nobody owned — an exception killed it silently and every active
+    stream hung forever. Exactly one violation, at the spawn site."""
+    project = make_project(tmp_path, {
+        "dynamo_tpu/llm/mocker_like.py": """
+            import asyncio
+
+            class MockEngine:
+                def __init__(self):
+                    self._step_task = None
+
+                def start(self):
+                    if self._step_task is None:
+                        self._step_task = asyncio.create_task(self._step_loop())
+
+                async def _step_loop(self):
+                    while True:
+                        self._do_admission_and_prefill()
+                        await asyncio.sleep(0.01)
+        """,
+    })
+    hits = rule_hits(project, TaskLifecycleRule())
+    assert len(hits) == 1
+    assert hits[0].path == "dynamo_tpu/llm/mocker_like.py"
+    assert "_step_loop" in hits[0].message and "orphaned" in hits[0].message
+
+
+def test_task_lifecycle_bare_fire_and_forget_fires(tmp_path):
+    project = make_project(tmp_path, {
+        "dynamo_tpu/runtime/bare.py": """
+            import asyncio
+
+            async def main():
+                asyncio.create_task(stats_loop())
+
+            async def stats_loop():
+                await asyncio.sleep(1)
+        """,
+    })
+    hits = rule_hits(project, TaskLifecycleRule())
+    assert len(hits) == 1
+    assert "fire-and-forget" in hits[0].message
+
+
+def test_task_lifecycle_cross_file_close_path_counts(tmp_path):
+    """Ownership evidence lives in ANOTHER file: the spawn binds
+    `client._recv_task`, the class's close() cancels it elsewhere."""
+    project = make_project(tmp_path, {
+        "dynamo_tpu/runtime/spawn.py": """
+            import asyncio
+
+            async def connect(client):
+                client._recv_task = asyncio.create_task(client.recv_loop())
+                return client
+        """,
+        "dynamo_tpu/runtime/owner.py": """
+            class Client:
+                async def close(self):
+                    if self._recv_task:
+                        self._recv_task.cancel()
+        """,
+    })
+    assert rule_hits(project, TaskLifecycleRule()) == []
+
+
+def test_task_lifecycle_container_needs_a_sweep(tmp_path):
+    """`self._bg.add(t)` + done-callback discard is NOT ownership (the
+    real _bg bug this PR fixed); adding the close() sweep quiets it."""
+    leaky = """
+        import asyncio
+
+        class Pub:
+            def __init__(self):
+                self._bg = set()
+
+            def publish(self):
+                t = asyncio.create_task(self._pub())
+                self._bg.add(t)
+                t.add_done_callback(self._bg.discard)
+
+            async def _pub(self):
+                await asyncio.sleep(0)
+    """
+    project = make_project(tmp_path, {"dynamo_tpu/llm/pub.py": leaky})
+    hits = rule_hits(project, TaskLifecycleRule())
+    assert len(hits) == 1
+    assert "_bg" in hits[0].message
+
+    fixed = leaky + """
+            async def close(self):
+                for t in list(self._bg):
+                    t.cancel()
+    """
+    project = make_project(tmp_path / "fixed", {"dynamo_tpu/llm/pub.py": fixed})
+    assert rule_hits(project, TaskLifecycleRule()) == []
+
+
+def test_task_lifecycle_returned_task_chased_to_call_sites(tmp_path):
+    """A factory's returned task is judged at its call sites — and the
+    violation still anchors at the factory's spawn line (cross-file)."""
+    dropping = {
+        "dynamo_tpu/runtime/factory.py": """
+            import asyncio
+
+            def spawn_worker():
+                return asyncio.create_task(work())
+
+            async def work():
+                await asyncio.sleep(1)
+        """,
+        "dynamo_tpu/runtime/caller.py": """
+            from .factory import spawn_worker
+
+            async def main():
+                spawn_worker()
+        """,
+    }
+    project = make_project(tmp_path, dropping)
+    hits = rule_hits(project, TaskLifecycleRule())
+    assert len(hits) == 1
+    assert hits[0].path == "dynamo_tpu/runtime/factory.py"
+    assert "every call site drops it" in hits[0].message
+
+    owning = dict(dropping)
+    owning["dynamo_tpu/runtime/caller.py"] = """
+        from .factory import spawn_worker
+
+        async def main():
+            t = spawn_worker()
+            try:
+                await asyncio.sleep(1)
+            finally:
+                t.cancel()
+    """
+    project = make_project(tmp_path / "own", owning)
+    assert rule_hits(project, TaskLifecycleRule()) == []
+
+
+def test_task_lifecycle_suppression_with_reason(tmp_path):
+    project = make_project(tmp_path, {
+        "dynamo_tpu/runtime/bare.py": """
+            import asyncio
+
+            async def main():
+                asyncio.create_task(beacon())  # dynolint: disable=flow-task-lifecycle -- one-shot beacon, self-terminating
+
+            async def beacon():
+                return None
+        """,
+    })
+    assert rule_hits(project, TaskLifecycleRule()) == []
+
+
+# --------------------------------------------------------------------- #
+# flow-cancellation-safety
+# --------------------------------------------------------------------- #
+
+
+def test_cancellation_safety_drain_await_reconstruction(tmp_path):
+    """Seeded-bug reconstruction: the drain sequence awaits the server's
+    close inside finally — a cancellation delivered there abandons the
+    lease revoke that follows. Exactly one violation."""
+    project = make_project(tmp_path, {
+        "dynamo_tpu/runtime/drain.py": """
+            import asyncio
+
+            async def close(server, lease):
+                try:
+                    await server.drain(30.0)
+                finally:
+                    await server.wait_closed()
+                    lease.revoke_nowait()
+        """,
+    })
+    hits = rule_hits(project, CancellationSafetyRule())
+    assert len(hits) == 1
+    assert "finally" in hits[0].message
+    assert hits[0].line == 8
+
+
+def test_cancellation_safety_quiet_on_shielded_and_sync_cleanup(tmp_path):
+    project = make_project(tmp_path, {
+        "dynamo_tpu/runtime/ok.py": """
+            import asyncio
+
+            async def close(server, queue):
+                try:
+                    await server.drain(30.0)
+                finally:
+                    queue.put_nowait(None)
+                    await asyncio.shield(server.wait_closed())
+                    await asyncio.wait_for(server.flush(), timeout=5)
+        """,
+    })
+    assert rule_hits(project, CancellationSafetyRule()) == []
+
+
+def test_cancellation_safety_swallowed_cancellation_fires(tmp_path):
+    project = make_project(tmp_path, {
+        "dynamo_tpu/runtime/swallow.py": """
+            import asyncio
+
+            async def recv_loop(reader):
+                try:
+                    while True:
+                        await reader.read()
+                except asyncio.CancelledError:
+                    pass
+        """,
+    })
+    hits = rule_hits(project, CancellationSafetyRule())
+    assert len(hits) == 1
+    assert "swallows cancellation" in hits[0].message
+
+
+def test_cancellation_safety_quiet_on_reraise_and_cancel_then_reap(tmp_path):
+    project = make_project(tmp_path, {
+        "dynamo_tpu/runtime/ok.py": """
+            import asyncio
+
+            async def recv_loop(reader):
+                try:
+                    while True:
+                        await reader.read()
+                except asyncio.CancelledError:
+                    raise
+
+            async def stop(self):
+                self._task.cancel()
+                try:
+                    await self._task
+                except asyncio.CancelledError:
+                    pass
+        """,
+    })
+    assert rule_hits(project, CancellationSafetyRule()) == []
+
+
+def test_cancellation_safety_await_in_handler_fires_and_suppression(tmp_path):
+    bad = """
+        import asyncio
+
+        async def teardown(task, sock):
+            try:
+                await task
+            except asyncio.CancelledError:
+                await sock.close()
+                raise
+    """
+    project = make_project(tmp_path, {"dynamo_tpu/runtime/h.py": bad})
+    hits = rule_hits(project, CancellationSafetyRule())
+    assert len(hits) == 1
+    assert "except CancelledError" in hits[0].message
+    waived = bad.replace(
+        "await sock.close()",
+        "await sock.close()  # dynolint: disable=flow-cancellation-safety -- close never blocks",
+    )
+    project = make_project(tmp_path / "w", {"dynamo_tpu/runtime/h.py": waived})
+    assert rule_hits(project, CancellationSafetyRule()) == []
+
+
+# --------------------------------------------------------------------- #
+# flow-frame-protocol
+# --------------------------------------------------------------------- #
+
+# the registry every frame fixture shares (same shape as runtime/codec.py)
+_CODEC_FIXTURE = """
+    T_DATA = "data"
+    T_DONE = "done"
+
+    FRAME_TAGS = {
+        "t": {
+            T_DATA: "one stream item",
+            T_DONE: "clean end",
+        },
+    }
+"""
+
+_SYMMETRIC_PLANE = """
+    from .codec import T_DATA, T_DONE
+
+    async def writer(send):
+        await send({"t": T_DATA, "stream": 1})
+        await send({"t": T_DATA, "stream": 1, "n": 2})
+        await send({"t": T_DONE, "stream": 1})
+
+    async def reader(control):
+        t = control.get("t")
+        if t == T_DATA:
+            return "item"
+        elif t == T_DONE:
+            return "end"
+"""
+
+
+def test_frame_protocol_quiet_on_symmetric_channel(tmp_path):
+    project = make_project(tmp_path, {
+        "dynamo_tpu/runtime/codec.py": _CODEC_FIXTURE,
+        "dynamo_tpu/runtime/request_plane.py": _SYMMETRIC_PLANE,
+    })
+    assert rule_hits(project, FrameProtocolRule()) == []
+
+
+def test_frame_protocol_tag_typo_reconstruction(tmp_path):
+    """Seeded-bug reconstruction: the coalesced data frame's tag typo'd
+    at the producer — consumers drop every frame on the floor. Exactly
+    one violation, at the emit site."""
+    bad = _SYMMETRIC_PLANE.replace(
+        'await send({"t": T_DATA, "stream": 1, "n": 2})',
+        'await send({"t": "dta", "stream": 1, "n": 2})',
+    )
+    assert bad != _SYMMETRIC_PLANE
+    project = make_project(tmp_path, {
+        "dynamo_tpu/runtime/codec.py": _CODEC_FIXTURE,
+        "dynamo_tpu/runtime/request_plane.py": bad,
+    })
+    hits = rule_hits(project, FrameProtocolRule())
+    assert len(hits) == 1
+    assert "'dta'" in hits[0].message and "unregistered" in hits[0].message
+    assert hits[0].path == "dynamo_tpu/runtime/request_plane.py"
+
+
+def test_frame_protocol_missing_consumer_arm_fires(tmp_path):
+    bad = _SYMMETRIC_PLANE.replace("elif t == T_DONE:", "elif t == T_DATA:")
+    project = make_project(tmp_path, {
+        "dynamo_tpu/runtime/codec.py": _CODEC_FIXTURE,
+        "dynamo_tpu/runtime/request_plane.py": bad,
+    })
+    hits = rule_hits(project, FrameProtocolRule())
+    assert len(hits) == 1
+    assert "'done'" in hits[0].message and "no consumer" in hits[0].message
+
+
+def test_frame_protocol_dead_registry_entry_fires(tmp_path):
+    codec = _CODEC_FIXTURE.replace(
+        'T_DONE: "clean end",',
+        'T_DONE: "clean end",\n            "zombie": "never wired",',
+    )
+    project = make_project(tmp_path, {
+        "dynamo_tpu/runtime/codec.py": codec,
+        "dynamo_tpu/runtime/request_plane.py": _SYMMETRIC_PLANE,
+    })
+    hits = rule_hits(project, FrameProtocolRule())
+    assert len(hits) == 1
+    assert "'zombie'" in hits[0].message and hits[0].path == "dynamo_tpu/runtime/codec.py"
+
+
+def test_frame_protocol_requires_registry_and_suppression(tmp_path):
+    project = make_project(tmp_path, {
+        "dynamo_tpu/runtime/codec.py": "X = 1\n",
+        "dynamo_tpu/runtime/request_plane.py": _SYMMETRIC_PLANE,
+    })
+    hits = rule_hits(project, FrameProtocolRule())
+    assert len(hits) == 1 and "FRAME_TAGS" in hits[0].message
+
+    waived = _SYMMETRIC_PLANE.replace(
+        'await send({"t": T_DATA, "stream": 1, "n": 2})',
+        'await send({"t": "x1", "stream": 1})  # dynolint: disable=flow-frame-protocol -- staging a new tag',
+    )
+    project = make_project(tmp_path / "w", {
+        "dynamo_tpu/runtime/codec.py": _CODEC_FIXTURE,
+        "dynamo_tpu/runtime/request_plane.py": waived,
+    })
+    assert rule_hits(project, FrameProtocolRule()) == []
+
+
+# every consumer dispatch arm of the real tree, with the swap that
+# removes it while keeping the channel fully resolvable
+_REAL_ARMS = [
+    ("dynamo_tpu/runtime/request_plane.py", "if t == T_REQ:", "if t == T_CANCEL:", "req"),
+    ("dynamo_tpu/runtime/request_plane.py", "elif t == T_CANCEL:", "elif t == T_PING:", "cancel"),
+    ("dynamo_tpu/runtime/request_plane.py", "elif t == T_PING:", "elif t == T_CANCEL:", "ping"),
+    ("dynamo_tpu/runtime/request_plane.py", "if t == T_PONG:", "if t == T_ERR:", "pong"),
+    ("dynamo_tpu/runtime/request_plane.py", "if t == T_DATA:", "if t == T_DONE:", "data"),
+    ("dynamo_tpu/runtime/request_plane.py", "elif t == T_DONE:", "elif t == T_ERR:", "done"),
+    ("dynamo_tpu/runtime/request_plane.py", "elif t == T_ERR:", "elif t == T_DONE:", "err"),
+    ("dynamo_tpu/runtime/request_plane.py", "elif t == T_LOST:", "elif t == T_DONE:", "lost"),
+    ("dynamo_tpu/runtime/discovery.py", "if op == OP_PUT:", "if op == OP_GET:", "put"),
+    ("dynamo_tpu/runtime/discovery.py", "if op == OP_LEASE_KEEPALIVE:", "if op == OP_GET:", "lease_keepalive"),
+    ("dynamo_tpu/runtime/discovery.py", 'if control.get("push") == PUSH_WATCH:', 'if control.get("push") == PUSH_MSG:', "watch"),
+]
+
+_PROTOCOL_FILES = (
+    "dynamo_tpu/runtime/codec.py",
+    "dynamo_tpu/runtime/request_plane.py",
+    "dynamo_tpu/runtime/discovery.py",
+    "dynamo_tpu/llm/kv_transfer.py",
+)
+
+
+def _copy_real_protocol(tmp_path: Path) -> dict:
+    return {rel: (REPO / rel).read_text() for rel in _PROTOCOL_FILES}
+
+
+def test_frame_protocol_red_removing_any_real_consumer_arm_fails(tmp_path):
+    """Acceptance red-test: the copied REAL protocol modules are clean;
+    removing any single consumer dispatch arm (swapping its tag for one
+    that is already consumed elsewhere) makes flow-frame-protocol fail,
+    naming the orphaned tag."""
+    files = _copy_real_protocol(tmp_path)
+    for rel, text in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(text)
+    assert rule_hits(Project.load(tmp_path), FrameProtocolRule()) == []
+
+    for i, (rel, old, new, tag) in enumerate(_REAL_ARMS):
+        assert files[rel].count(old) == 1, (rel, old)
+        broken = dict(files)
+        broken[rel] = files[rel].replace(old, new)
+        base = tmp_path / f"arm{i}"
+        for r, text in broken.items():
+            p = base / r
+            p.parent.mkdir(parents=True, exist_ok=True)
+            p.write_text(text)
+        hits = rule_hits(Project.load(base), FrameProtocolRule())
+        orphan = [v for v in hits if f"'{tag}'" in v.message]
+        assert orphan, (tag, hits)
+
+
+# --------------------------------------------------------------------- #
+# flow-fault-point-registry
+# --------------------------------------------------------------------- #
+
+_FAULTS_FIXTURE = """
+    KNOWN_FAULT_POINTS = {
+        "plane.frame": "sever — per response frame",
+        "plane.connect": "refuse — client dial",
+    }
+"""
+
+
+def test_fault_registry_quiet_on_registered_points(tmp_path):
+    """Literal sites and module-constant sites both resolve."""
+    project = make_project(tmp_path, {
+        "dynamo_tpu/runtime/faults.py": _FAULTS_FIXTURE,
+        "dynamo_tpu/runtime/plane.py": """
+            from . import faults
+
+            _POINT = "plane.connect"
+
+            async def recv():
+                f = faults.FAULTS
+                if f.enabled:
+                    await f.on("plane.frame")
+
+            async def dial():
+                if faults.FAULTS.check(_POINT) == "refuse":
+                    raise ConnectionRefusedError
+        """,
+    })
+    assert rule_hits(project, FaultPointRegistryRule()) == []
+
+
+def test_fault_registry_renamed_point_reconstruction(tmp_path):
+    """Seeded-bug reconstruction: a site's point name drifts from the
+    documented table — DYN_FAULT_PLAN spelled from docs silently never
+    fires. Exactly one violation, anchored at the literal."""
+    project = make_project(tmp_path, {
+        "dynamo_tpu/runtime/faults.py": _FAULTS_FIXTURE,
+        "dynamo_tpu/runtime/plane.py": """
+            from . import faults
+
+            async def recv():
+                f = faults.FAULTS
+                if f.enabled:
+                    await f.on("plane.frames")
+                    await f.on("plane.connect")
+
+            async def stream():
+                await faults.FAULTS.on("plane.frame")
+        """,
+    })
+    hits = rule_hits(project, FaultPointRegistryRule())
+    assert len(hits) == 1
+    assert "'plane.frames'" in hits[0].message
+    assert hits[0].path == "dynamo_tpu/runtime/plane.py"
+
+
+def test_fault_registry_stale_entry_fires(tmp_path):
+    project = make_project(tmp_path, {
+        "dynamo_tpu/runtime/faults.py": _FAULTS_FIXTURE,
+        "dynamo_tpu/runtime/plane.py": """
+            from . import faults
+
+            async def recv():
+                await faults.FAULTS.on("plane.frame")
+        """,
+    })
+    hits = rule_hits(project, FaultPointRegistryRule())
+    assert len(hits) == 1
+    assert "'plane.connect'" in hits[0].message
+    assert hits[0].path == "dynamo_tpu/runtime/faults.py"
+
+
+def test_fault_registry_suppression_with_reason(tmp_path):
+    project = make_project(tmp_path, {
+        "dynamo_tpu/runtime/faults.py": _FAULTS_FIXTURE,
+        "dynamo_tpu/runtime/plane.py": """
+            from . import faults
+
+            async def recv():
+                await faults.FAULTS.on("plane.frame")
+                await faults.FAULTS.on("plane.connect")
+                await faults.FAULTS.on("plane.experimental")  # dynolint: disable=flow-fault-point-registry -- staging a new point
+        """,
+    })
+    assert rule_hits(project, FaultPointRegistryRule()) == []
+
+
+# --------------------------------------------------------------------- #
+# real tree, generated docs, CLI
+# --------------------------------------------------------------------- #
+
+
+def test_real_tree_flow_pack_clean():
+    project = Project.load(REPO)
+    rules = [
+        TaskLifecycleRule(), CancellationSafetyRule(),
+        FrameProtocolRule(), FaultPointRegistryRule(),
+    ]
+    assert run(project, rules) == []
+
+
+def test_fault_point_docs_are_fresh():
+    """docs/fault_tolerance.md's generated point table matches the
+    registry (same contract as the env-docs freshness test)."""
+    from dynamo_tpu.analysis.__main__ import emit_fault_docs
+
+    target = REPO / "docs" / "fault_tolerance.md"
+    assert emit_fault_docs(REPO, target) == target.read_text(), (
+        "docs/fault_tolerance.md point table is stale — run "
+        "python -m dynamo_tpu.analysis --emit-fault-docs"
+    )
+
+
+def test_real_tree_ping_pong_symmetry_is_load_bearing():
+    """The t-channel registry covers ping/pong because the client really
+    implements the probe — guard against the method quietly going away
+    while the registry keeps advertising the tags."""
+    from dynamo_tpu.runtime.request_plane import RequestPlaneClient
+
+    assert callable(getattr(RequestPlaneClient, "ping", None))
+
+
+def _git(cwd, *args):
+    subprocess.run(
+        ["git", "-c", "user.email=t@t", "-c", "user.name=t", *args],
+        cwd=cwd, check=True, capture_output=True,
+    )
+
+
+def test_changed_only_flow_pack_e2e(tmp_path):
+    files = {
+        "dynamo_tpu/runtime/bare.py": """
+            import asyncio
+
+            async def main():
+                asyncio.create_task(stats_loop())
+
+            async def stats_loop():
+                await asyncio.sleep(1)
+        """,
+        "dynamo_tpu/runtime/clean.py": "X = 1\n",
+    }
+    for rel, text in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(text))
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "add", ".")
+    _git(tmp_path, "commit", "-q", "-m", "seed")
+
+    cli = [
+        sys.executable, "-m", "dynamo_tpu.analysis",
+        "--root", str(tmp_path), "--rules", "flow-task-lifecycle",
+    ]
+
+    # full run sees the orphan
+    proc = subprocess.run(cli, cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1 and "fire-and-forget" in proc.stdout
+
+    # nothing changed: fast exit 0 without linting
+    proc = subprocess.run(
+        cli + ["--changed-only"], cwd=REPO,
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0 and "nothing to lint" in proc.stdout
+
+    # touching only the clean file filters the pre-existing violation
+    (tmp_path / "dynamo_tpu/runtime/clean.py").write_text("X = 2\n")
+    proc = subprocess.run(
+        cli + ["--changed-only"], cwd=REPO,
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0 and "clean" in proc.stdout
+
+    # touching the bad file reports it
+    bad = tmp_path / "dynamo_tpu/runtime/bare.py"
+    bad.write_text(bad.read_text() + "\n")
+    proc = subprocess.run(
+        cli + ["--changed-only"], cwd=REPO,
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 1 and "fire-and-forget" in proc.stdout

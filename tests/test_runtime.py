@@ -308,3 +308,36 @@ class TestOperatorPipeline:
         # backward order: B wraps first (inner), then A
         assert [i["v"] for i in items] == ["reqAB<B<A", "reqAB!<B<A"]
         assert retry.attempts == 1
+
+
+def test_request_plane_ping_pong_roundtrip():
+    """Transport liveness probe: ping answers pong with the stream id
+    echoed (the flow-frame-protocol symmetry contract), and a dead peer
+    surfaces StreamLost within the timeout instead of hanging."""
+    from dynamo_tpu.runtime.request_plane import (
+        RequestPlaneClient,
+        RequestPlaneServer,
+    )
+
+    async def main():
+        srv = RequestPlaneServer()
+        host, port = await srv.start()
+        cli = RequestPlaneClient()
+        try:
+            rtt = await cli.ping(f"{host}:{port}")
+            assert 0.0 <= rtt < 5.0
+            # repeatable on the same pooled connection
+            assert await cli.ping(f"{host}:{port}") >= 0.0
+        finally:
+            await cli.close()
+            await srv.stop()
+
+        # dead peer: refused dial -> StreamLost, not a hang
+        dead = RequestPlaneClient(connect_timeout=0.5)
+        try:
+            with pytest.raises(StreamLost):
+                await dead.ping(f"{host}:{port}", timeout=0.5)
+        finally:
+            await dead.close()
+
+    asyncio.run(main())
