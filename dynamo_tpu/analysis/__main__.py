@@ -2,12 +2,15 @@
 
     python -m dynamo_tpu.analysis                      # lint, text output
     python -m dynamo_tpu.analysis --format=json        # lint, JSON output
+    python -m dynamo_tpu.analysis --format=sarif       # SARIF 2.1.0 (CI
+                                                       # PR annotations)
     python -m dynamo_tpu.analysis --rules silent-drop  # subset
-    python -m dynamo_tpu.analysis --rules shard        # a whole pack
+    python -m dynamo_tpu.analysis --rules race         # a whole pack
     python -m dynamo_tpu.analysis --changed-only       # report only files
                                                        # touched vs HEAD
     python -m dynamo_tpu.analysis --list-rules
     python -m dynamo_tpu.analysis --emit-env-docs docs/configuration.md
+    python -m dynamo_tpu.analysis --emit-sync-docs     # docs/concurrency.md
 
 Exit status: 0 clean, 1 violations found, 2 usage error.
 """
@@ -20,7 +23,7 @@ import sys
 from pathlib import Path
 from typing import List, Optional
 
-from .core import Project, format_json, format_text, run
+from .core import Project, format_json, format_sarif, format_text, run
 from .rules import ALL_RULES, PACKS, default_rules
 
 
@@ -102,18 +105,76 @@ def render_fault_table(root: Path) -> str:
     return "\n".join(lines)
 
 
+def splice_generated(text: str, begin: str, end: str, table: str,
+                     target: Path, what: str) -> str:
+    """Replace the block between the `begin`/`end` markers of `text` with
+    `table`; every generated-docs emitter shares this shape."""
+    if begin not in text or end not in text:
+        raise SystemExit(
+            f"error: {target} has no {what}:BEGIN/END markers to "
+            "splice the generated table into"
+        )
+    head, rest = text.split(begin, 1)
+    _, tail = rest.split(end, 1)
+    return head + begin + "\n" + table + "\n" + end + tail
+
+
 def emit_fault_docs(root: Path, target: Path) -> str:
     """Splice the generated point table between the FAULT_POINTS markers
     of `target` (docs/fault_tolerance.md) and return the new content."""
-    text = target.read_text()
-    if FAULT_BEGIN not in text or FAULT_END not in text:
-        raise SystemExit(
-            f"error: {target} has no FAULT_POINTS:BEGIN/END markers to "
-            "splice the generated table into"
-        )
-    head, rest = text.split(FAULT_BEGIN, 1)
-    _, tail = rest.split(FAULT_END, 1)
-    return head + FAULT_BEGIN + "\n" + render_fault_table(root) + "\n" + FAULT_END + tail
+    return splice_generated(
+        target.read_text(), FAULT_BEGIN, FAULT_END,
+        render_fault_table(root), target, "FAULT_POINTS",
+    )
+
+
+#: markers delimiting the generated block in docs/concurrency.md
+SYNC_BEGIN = (
+    "<!-- GUARDED_STATE:BEGIN — generated from runtime/sync.py:"
+    "GUARDED_STATE; regenerate: python -m dynamo_tpu.analysis"
+    " --emit-sync-docs -->"
+)
+SYNC_END = "<!-- GUARDED_STATE:END -->"
+
+_GUARD_DOC = {
+    "lock": "every access holds `with self.{target}`",
+    "single-task": "mutations confined to the `{target}` task",
+    "thread": "mutations confined to `{target}` (dedicated thread); "
+              "cross-thread readers snapshot",
+}
+
+
+def render_sync_table(root: Path) -> str:
+    """Render runtime/sync.py's GUARDED_STATE as a markdown table (parsed
+    from the AST via the race pack's loader, never imported — same
+    contract as the fault table)."""
+    from .core import SourceFile
+    from .race.registry import SYNC_MODULE, load_guarded_state
+
+    # a one-file Project: the loader only ever reads the registry module,
+    # so parsing the whole package here would be pure waste on the CI
+    # freshness path
+    project = Project(root, [SourceFile(root, root / SYNC_MODULE)])
+    entries, err = load_guarded_state(project)
+    if err is not None:
+        raise SystemExit(f"error: {err}")
+    lines = [
+        "| Attribute | Guard | Discipline the `race-guarded-state` rule enforces |",
+        "|---|---|---|",
+    ]
+    for e in entries:  # registry order is the doc order
+        doc = _GUARD_DOC[e.kind].format(target=e.target)
+        lines.append(f"| `{e.key}` | `{e.kind}:{e.target}` | {doc} |")
+    return "\n".join(lines)
+
+
+def emit_sync_docs(root: Path, target: Path) -> str:
+    """Splice the generated guard table between the GUARDED_STATE markers
+    of `target` (docs/concurrency.md) and return the new content."""
+    return splice_generated(
+        target.read_text(), SYNC_BEGIN, SYNC_END,
+        render_sync_table(root), target, "GUARDED_STATE",
+    )
 
 
 def changed_files(root: Path, base: str) -> Optional[List[str]]:
@@ -148,8 +209,9 @@ def main(argv=None) -> int:
         description="dynolint: AST invariant checker for the serving stack",
     )
     parser.add_argument(
-        "--format", choices=("text", "json"), default="text",
-        help="violation report format",
+        "--format", choices=("text", "json", "sarif"), default="text",
+        help="violation report format (sarif: SARIF 2.1.0 for CI "
+        "code-scanning uploads / inline PR annotations)",
     )
     parser.add_argument(
         "--root", default=None,
@@ -159,7 +221,7 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--rules", default=None,
         help="comma-separated rule names or pack aliases "
-        f"({', '.join(sorted(PACKS))}) to run (default: all)",
+        f"({', '.join(sorted(PACKS))}, or 'all') to run (default: all)",
     )
     parser.add_argument(
         "--list-rules", action="store_true", help="list rules and exit"
@@ -187,6 +249,13 @@ def main(argv=None) -> int:
         help="regenerate the fault-point table between the FAULT_POINTS "
         "markers of PATH (default docs/fault_tolerance.md; '-' = print the "
         "table) from runtime/faults.py KNOWN_FAULT_POINTS, and exit",
+    )
+    parser.add_argument(
+        "--emit-sync-docs", nargs="?", const="docs/concurrency.md",
+        metavar="PATH",
+        help="regenerate the guarded-state table between the GUARDED_STATE "
+        "markers of PATH (default docs/concurrency.md; '-' = print the "
+        "table) from runtime/sync.py GUARDED_STATE, and exit",
     )
     args = parser.parse_args(argv)
 
@@ -222,6 +291,17 @@ def main(argv=None) -> int:
             print(f"wrote {target}")
         return 0
 
+    if args.emit_sync_docs is not None:
+        if args.emit_sync_docs == "-":
+            sys.stdout.write(render_sync_table(root) + "\n")
+        else:
+            target = Path(args.emit_sync_docs)
+            if not target.is_absolute() and not target.exists():
+                target = root / args.emit_sync_docs
+            target.write_text(emit_sync_docs(root, target))
+            print(f"wrote {target}")
+        return 0
+
     rules = default_rules()
     if args.rules:
         wanted = set()
@@ -229,7 +309,9 @@ def main(argv=None) -> int:
             token = token.strip()
             if not token:
                 continue
-            if token in PACKS:
+            if token == "all":
+                wanted |= {r.name for r in rules}
+            elif token in PACKS:
                 wanted |= {cls.name for cls in PACKS[token]}
             else:
                 wanted.add(token)
@@ -238,7 +320,7 @@ def main(argv=None) -> int:
         if unknown:
             print(
                 f"error: unknown rule(s): {', '.join(sorted(unknown))}; "
-                f"known: {', '.join(sorted(known | set(PACKS)))}",
+                f"known: {', '.join(sorted(known | set(PACKS) | {'all'}))}",
                 file=sys.stderr,
             )
             return 2
@@ -265,11 +347,12 @@ def main(argv=None) -> int:
     if scope is not None:
         scoped = set(scope)
         violations = [v for v in violations if v.path in scoped]
-    out = (
-        format_json(violations)
-        if args.format == "json"
-        else format_text(violations)
-    )
+    if args.format == "json":
+        out = format_json(violations)
+    elif args.format == "sarif":
+        out = format_sarif(violations, rules)
+    else:
+        out = format_text(violations)
     print(out)
     return 1 if violations else 0
 

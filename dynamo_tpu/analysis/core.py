@@ -218,6 +218,66 @@ def format_json(violations: Sequence[Violation]) -> str:
     )
 
 
+def format_sarif(
+    violations: Sequence[Violation], rules: Sequence[Rule]
+) -> str:
+    """SARIF 2.1.0 — the interchange format CI annotation uploads speak.
+
+    One run, one driver ("dynolint"), one reportingDescriptor per
+    registered rule (so PR annotations link a finding to its contract
+    description), one result per violation with a physical location
+    anchored at the file/line a maintainer would fix.  Suppressed
+    findings never reach this layer: `run()` filters them first, which
+    is exactly the suppression-awareness SARIF consumers expect (a
+    waived finding is not an annotation)."""
+    by_name = {}
+    for r in rules:
+        by_name.setdefault(r.name, r.description)
+    results = []
+    for v in violations:
+        results.append({
+            "ruleId": v.rule,
+            "level": "error",
+            "message": {"text": v.message},
+            "locations": [{
+                "physicalLocation": {
+                    # repo-relative URI: github's SARIF upload resolves it
+                    # against the checkout root for inline PR annotations
+                    "artifactLocation": {"uri": v.path},
+                    "region": {"startLine": v.line},
+                },
+            }],
+        })
+        by_name.setdefault(v.rule, "")
+    doc = {
+        "$schema": (
+            "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+            "Schemata/sarif-schema-2.1.0.json"
+        ),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "dynolint",
+                    "informationUri": (
+                        "https://github.com/ltalal/dynamo-tpu/blob/main/"
+                        "docs/static_analysis.md"
+                    ),
+                    "rules": [
+                        {
+                            "id": name,
+                            "shortDescription": {"text": desc or name},
+                        }
+                        for name, desc in sorted(by_name.items())
+                    ],
+                },
+            },
+            "results": results,
+        }],
+    }
+    return json.dumps(doc, indent=2)
+
+
 # --------------------------------------------------------------------- #
 # shared AST helpers used by several rules
 # --------------------------------------------------------------------- #

@@ -65,6 +65,7 @@ def load_frame_tags(
         return None, None, f"{CODEC_MODULE} not found: the frame-tag registry is gone"
     consts: Dict[str, str] = {}
     table: Optional[ast.Dict] = None
+    err_codes: Optional[ast.Dict] = None
     for node in src.tree.body:
         if isinstance(node, ast.Assign) and len(node.targets) == 1:
             tgt = node.targets[0]
@@ -74,10 +75,19 @@ def load_frame_tags(
                 consts[tgt.id] = node.value.value
             elif tgt.id == "FRAME_TAGS" and isinstance(node.value, ast.Dict):
                 table = node.value
+            elif tgt.id == "ERR_CODES" and isinstance(node.value, ast.Dict):
+                # wire error codes ride T_ERR frames under the "code" key:
+                # same symmetry contract, folded in as one more channel
+                err_codes = node.value
     if table is None:
         return None, None, (
             f"{CODEC_MODULE} defines no FRAME_TAGS dict literal — the flow "
             "rules need the frame-tag registry as their source of truth"
+        )
+    if err_codes is not None:
+        table = ast.Dict(
+            keys=list(table.keys) + [ast.Constant("code", lineno=err_codes.lineno, col_offset=0)],
+            values=list(table.values) + [err_codes],
         )
     registry: Dict[str, Dict[str, str]] = {}
     lines: Dict[Tuple[str, str], int] = {}

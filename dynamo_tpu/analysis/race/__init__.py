@@ -1,0 +1,45 @@
+"""dynorace: the interprocedural race / atomicity / lock-order pack.
+
+Fourth rules pack on the analysis core.  Where dynoflow covers task
+lifecycle and protocol drift, this pack covers the failure mode asyncio
+makes easiest to write and hardest to see: shared state whose
+check-then-act sequence is silently torn by an `await`, guarded state
+accessed off its guard, lock pairs acquired in inconsistent orders, and
+containers mutated under a suspended iterator.  Every open ROADMAP item
+(SLA scheduler, radix prefix index, autoscaling soak) adds more
+concurrently-mutated slot tables / scoring maps / block maps on top of
+exactly this plane — the pack is the convention they land into.
+
+The guard registry lives in `runtime/sync.py:GUARDED_STATE` (same
+single-spelling pattern as ENV_REGISTRY / FRAME_TAGS /
+KNOWN_FAULT_POINTS) and renders into docs/concurrency.md via
+`--emit-sync-docs`.  See docs/static_analysis.md ("The race pack").
+"""
+
+from .await_atomicity import RaceAwaitAtomicityRule
+from .iter_mutation import RaceIterMutationRule
+from .lock_order import RaceLockOrderRule
+from .registry import (
+    GuardEntry,
+    RaceGuardedStateRule,
+    guarded_keys,
+    load_guarded_state,
+)
+
+RACE_RULES = (
+    RaceAwaitAtomicityRule,
+    RaceGuardedStateRule,
+    RaceLockOrderRule,
+    RaceIterMutationRule,
+)
+
+__all__ = [
+    "GuardEntry",
+    "RACE_RULES",
+    "RaceAwaitAtomicityRule",
+    "RaceGuardedStateRule",
+    "RaceIterMutationRule",
+    "RaceLockOrderRule",
+    "guarded_keys",
+    "load_guarded_state",
+]

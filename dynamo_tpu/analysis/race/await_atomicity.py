@@ -1,0 +1,523 @@
+"""Rule: race-await-atomicity — check-then-act must not tear across await.
+
+The asyncio serving plane's classic silent failure: a coroutine TESTS a
+piece of shared state, suspends at an `await` (any other task may run
+and mutate that state), then ACTS on the stale answer:
+
+    if slot.free:                  # test
+        await allocate_pages()     # suspension — another task takes slot
+        slot.free = False          # act on a stale check: double-booked
+
+Within one async function, for each attribute path (`self.<attr>`,
+`slot.<attr>`, ...), the rule looks for the event sequence
+
+    TEST-READ  ->  await  ->  WRITE      (same path, same spelling)
+
+with no re-validation between the LAST suspension and the write.  Two
+idioms make the sequence safe and keep the rule quiet:
+
+  * holding a lock across the region — test and write share an
+    enclosing `async with`/`with` block;
+  * re-checking after the suspension — a fresh test of the same path
+    between the last await and the write (the engine's
+    `if slot.done or self.slots[i] is not slot: return` pattern).
+
+TEST-READS are reads in genuinely conditional positions: `if`/ternary/
+`assert` tests, and the source/conditions of a filtering comprehension
+(`[l for l in self._leases.values() if l.expired]` is a check whose
+answer goes stale at the next await).  `while` tests are exempt as
+anchors — `while not pred: await wake()` re-tests after every wake,
+which is the condition-variable idiom — but they do count as
+re-validation.  Writes are assignments, subscript stores, deletes, and
+container-mutator calls; an awaited same-class method that mutates
+`self.<attr>` is folded in as a write at the call site (one level), and
+loops wrap: a write early in a loop body races the awaits of the
+previous iteration.
+
+Attributes registered in runtime/sync.py GUARDED_STATE are exempt here —
+their discipline (lock/owner confinement) is race-guarded-state's job,
+and confinement makes the tear impossible by construction.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from ..core import Project, Rule, SourceFile, Violation, dotted_name
+from .common import (
+    MUTATOR_METHODS,
+    SNAPSHOT_CALLS,
+    enclosing_classes,
+    full_path,
+)
+from .registry import guarded_keys
+
+# event kinds
+_READ, _RECHECK_ONLY, _AWAIT, _WRITE = "read", "recheck", "await", "write"
+
+
+@dataclasses.dataclass
+class _Event:
+    kind: str
+    path: Optional[str]  # None for awaits
+    line: int
+    withs: Tuple[int, ...]  # ids of enclosing With/AsyncWith nodes
+    loops: Tuple[int, ...]  # ids of enclosing loop nodes
+    regions: Tuple[int, ...]  # ids of enclosing TERMINAL branches (a body
+    # ending in return/raise never flows to the code after it — its
+    # events are invisible to the fall-through path)
+
+    def on_path_to(self, other: "_Event") -> bool:
+        return set(self.regions) <= set(other.regions)
+
+
+@dataclasses.dataclass
+class _CalleeSummary:
+    """What one level of `self.<meth>()` contributes: attr paths the
+    method writes on `self` (and whether an await precedes the write),
+    plus the self-attrs it READS — a callee that re-reads what it writes
+    observes fresh state and is self-validating."""
+
+    writes: Dict[str, bool]  # attr -> callee awaits before first write
+    reads: "Set[str]"
+    has_await: bool
+    is_async: bool
+
+
+def _summarize_callee(fn: ast.AST) -> _CalleeSummary:
+    first_await: Optional[int] = None
+    writes: Dict[str, int] = {}
+    loads: List[ast.Attribute] = []
+    write_receivers: Set[int] = set()  # Attribute node ids that ARE the write
+    stack: List[ast.AST] = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        if isinstance(node, ast.Await):
+            if first_await is None or node.lineno < first_await:
+                first_await = node.lineno
+        if isinstance(node, ast.Attribute) and isinstance(node.ctx, ast.Load) \
+                and dotted_name(node.value) == "self":
+            loads.append(node)
+        tgt: Optional[ast.AST] = None
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign, ast.Delete)):
+            tgts = node.targets if isinstance(node, (ast.Assign, ast.Delete)) \
+                else [node.target]
+            for t in tgts:
+                if isinstance(t, ast.Subscript):
+                    t = t.value
+                    write_receivers.add(id(t))
+                if isinstance(t, ast.Attribute) and dotted_name(t.value) == "self":
+                    writes.setdefault(t.attr, t.lineno)
+        elif (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in MUTATOR_METHODS
+        ):
+            tgt = node.func.value
+            if isinstance(tgt, ast.Attribute) and dotted_name(tgt.value) == "self":
+                writes.setdefault(tgt.attr, tgt.lineno)
+                write_receivers.add(id(tgt))
+        stack.extend(ast.iter_child_nodes(node))
+    # a mutator's own receiver observes existence, not freshness — only
+    # an INDEPENDENT load of the attr counts as re-reading it
+    reads = {n.attr for n in loads if id(n) not in write_receivers}
+    return _CalleeSummary(
+        writes={
+            attr: first_await is not None and first_await < line
+            for attr, line in writes.items()
+        },
+        reads=reads,
+        has_await=first_await is not None,
+        is_async=isinstance(fn, ast.AsyncFunctionDef),
+    )
+
+
+def _ends_terminal(body: List[ast.stmt]) -> bool:
+    """A branch body whose last statement is return/raise never reaches
+    the code after its enclosing if/try — continue/break are deliberately
+    NOT terminal (they re-enter the loop, whose next iteration does reach
+    that code)."""
+    return bool(body) and isinstance(body[-1], (ast.Return, ast.Raise))
+
+
+def _test_read_paths(expr: ast.AST) -> Iterator[Tuple[str, int]]:
+    """Paths read inside a conditional expression, the snapshot calls
+    stripped (testing `len(list(self.slots))` still reads self.slots)."""
+    stack = [expr]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        if isinstance(node, ast.Attribute) and isinstance(node.ctx, ast.Load):
+            p = full_path(node)
+            if p:
+                yield p, node.lineno
+                # also surface the receiver chain: a test of
+                # `self.slots[i].free` goes stale when self.slots mutates
+                inner = full_path(node.value)
+                if inner:
+                    yield inner, node.lineno
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class _FunctionScanner:
+    """Linearize one async function body into an ordered event stream."""
+
+    def __init__(self, callees: Dict[str, _CalleeSummary]):
+        self.callees = callees
+        self.events: List[_Event] = []
+        self._withs: List[int] = []
+        self._loops: List[int] = []
+        self._regions: List[int] = []
+
+    # -- emit helpers -------------------------------------------------- #
+
+    def _emit(self, kind: str, path: Optional[str], line: int):
+        self.events.append(
+            _Event(
+                kind, path, line,
+                tuple(self._withs), tuple(self._loops), tuple(self._regions),
+            )
+        )
+
+    def _emit_test_reads(self, expr: ast.AST, recheck_only: bool = False):
+        kind = _RECHECK_ONLY if recheck_only else _READ
+        for path, line in _test_read_paths(expr):
+            self._emit(kind, path, line)
+
+    # -- traversal ----------------------------------------------------- #
+
+    def scan(self, fn: ast.AST):
+        self._stmts(fn.body)
+
+    def _stmts(self, body: List[ast.stmt]):
+        for stmt in body:
+            self._stmt(stmt)
+
+    def _branch(self, body: List[ast.stmt]):
+        """An if/except branch: when it ends in return/raise its events
+        never flow to the statements after the compound — scope them to a
+        diverted region the judge filters by."""
+        if not body:
+            return
+        if _ends_terminal(body):
+            self._regions.append(id(body[0]))
+            self._stmts(body)
+            self._regions.pop()
+        else:
+            self._stmts(body)
+
+    def _stmt(self, stmt: ast.stmt):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return
+        if isinstance(stmt, ast.If):
+            self._emit_test_reads(stmt.test)
+            self._expr(stmt.test)
+            self._branch(stmt.body)
+            self._branch(stmt.orelse)
+            return
+        if isinstance(stmt, ast.Assert):
+            # asserts are developer invariants, not acted-on checks: they
+            # revalidate but never anchor a check-then-act finding
+            self._emit_test_reads(stmt.test, recheck_only=True)
+            self._expr(stmt.test)
+            return
+        if isinstance(stmt, ast.While):
+            # while-tests re-run after every in-loop await: they are the
+            # SAFE retest idiom, so they revalidate but never anchor
+            self._emit_test_reads(stmt.test, recheck_only=True)
+            self._expr(stmt.test)
+            self._loops.append(id(stmt))
+            self._stmts(stmt.body)
+            self._loops.pop()
+            self._stmts(stmt.orelse)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._expr(stmt.iter)
+            self._loops.append(id(stmt))
+            self._stmts(stmt.body)
+            self._loops.pop()
+            self._stmts(stmt.orelse)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._expr(item.context_expr)
+            self._withs.append(id(stmt))
+            self._stmts(stmt.body)
+            self._withs.pop()
+            return
+        if isinstance(stmt, ast.Try):
+            self._stmts(stmt.body)
+            for handler in stmt.handlers:
+                self._branch(handler.body)
+            self._stmts(stmt.orelse)
+            self._stmts(stmt.finalbody)
+            return
+        # simple statement.  For assignments, the RHS evaluates (and may
+        # suspend) BEFORE the store lands — event order must match, or a
+        # `self.x = await compute()` under an `if self.x:` hides its tear
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            if getattr(stmt, "value", None) is not None:
+                self._expr(stmt.value)
+            for path, line in self._stmt_writes(stmt):
+                self._emit(_WRITE, path, line)
+            return
+        if isinstance(stmt, ast.Delete):
+            for path, line in self._stmt_writes(stmt):
+                self._emit(_WRITE, path, line)
+            return
+        self._expr(stmt)
+
+    def _stmt_writes(self, stmt: ast.stmt) -> Iterator[Tuple[str, int]]:
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign, ast.Delete)):
+            tgts = stmt.targets if isinstance(stmt, (ast.Assign, ast.Delete)) \
+                else [stmt.target]
+            stack: List[ast.AST] = list(tgts)
+            while stack:
+                t = stack.pop()
+                if isinstance(t, (ast.Tuple, ast.List)):
+                    stack.extend(t.elts)
+                    continue
+                if isinstance(t, ast.Starred):
+                    stack.append(t.value)
+                    continue
+                if isinstance(t, ast.Subscript):
+                    p = full_path(t.value)
+                    if p:
+                        yield p, t.lineno
+                    continue
+                p = full_path(t)
+                if p:
+                    yield p, t.lineno
+
+    def _expr(self, node: ast.AST):
+        """Walk an expression/statement in source order for awaits,
+        mutator calls, comprehension filters, and ternary tests."""
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return
+        if isinstance(node, ast.IfExp):
+            self._emit_test_reads(node.test)
+            self._expr(node.test)
+            self._expr(node.body)
+            self._expr(node.orelse)
+            return
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)):
+            for gen in node.generators:
+                if gen.ifs:
+                    # a filtering comprehension over shared state is a
+                    # check whose answer goes stale at the next await
+                    self._emit_test_reads(gen.iter)
+                    for cond in gen.ifs:
+                        self._emit_test_reads(cond)
+                self._expr(gen.iter)
+                for cond in gen.ifs:
+                    self._expr(cond)
+            if hasattr(node, "elt"):
+                self._expr(node.elt)
+            else:
+                self._expr(node.key)
+                self._expr(node.value)
+            return
+        if isinstance(node, ast.Await):
+            self._await(node)
+            return
+        if isinstance(node, ast.Call):
+            for child in ast.iter_child_nodes(node):
+                self._expr(child)
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in MUTATOR_METHODS
+            ):
+                p = full_path(node.func.value)
+                if p:
+                    self._emit(_WRITE, p, node.lineno)
+            # one level of sync same-class helper: self._meth() writing
+            # self.<attr> acts at this site (a bare call to an ASYNC
+            # method only builds a coroutine — nothing runs here).  A
+            # callee that re-reads what it writes observes fresh state —
+            # fold the read in as revalidation.
+            summary = self._self_call_summary(node)
+            if summary is not None and not summary.is_async:
+                for attr in sorted(summary.writes):
+                    if attr in summary.reads:
+                        self._emit(_RECHECK_ONLY, f"self.{attr}", node.lineno)
+                    self._emit(_WRITE, f"self.{attr}", node.lineno)
+            return
+        for child in ast.iter_child_nodes(node):
+            self._expr(child)
+
+    def _await(self, node: ast.Await):
+        inner = node.value
+        summary = (
+            self._self_call_summary(inner) if isinstance(inner, ast.Call) else None
+        )
+        if summary is None or not summary.is_async:
+            # walk inside for nested awaits/mutators in arguments; an
+            # unresolvable awaitable is assumed to suspend
+            self._expr(inner)
+            self._emit(_AWAIT, None, node.lineno)
+            return
+        # awaited same-class coroutine: its arguments evaluate first,
+        # then the folded-in writes order against the suspension the way
+        # the callee body does.  A callee with no await of its own runs
+        # inline without yielding — writes, but no suspension.
+        for arg in inner.args:
+            self._expr(arg)
+        for kw in inner.keywords:
+            self._expr(kw.value)
+        before = [a for a, awaited_first in summary.writes.items() if not awaited_first]
+        after = [a for a, awaited_first in summary.writes.items() if awaited_first]
+        for attr in sorted(before):
+            if attr in summary.reads:
+                self._emit(_RECHECK_ONLY, f"self.{attr}", node.lineno)
+            self._emit(_WRITE, f"self.{attr}", node.lineno)
+        if summary.has_await:
+            self._emit(_AWAIT, None, node.lineno)
+        for attr in sorted(after):
+            if attr in summary.reads:
+                self._emit(_RECHECK_ONLY, f"self.{attr}", node.lineno)
+            self._emit(_WRITE, f"self.{attr}", node.lineno)
+
+    def _self_call_summary(self, call: ast.Call) -> Optional[_CalleeSummary]:
+        if not isinstance(call.func, ast.Attribute):
+            return None
+        if dotted_name(call.func.value) != "self":
+            return None
+        return self.callees.get(call.func.attr)
+
+
+class RaceAwaitAtomicityRule(Rule):
+    name = "race-await-atomicity"
+    description = (
+        "a conditional read of shared state followed across an await by a "
+        "write to the same state, with no spanning lock and no re-check "
+        "after the suspension (check-then-act torn by the event loop)"
+    )
+
+    def check(self, project: Project) -> Iterator[Violation]:
+        exempt = guarded_keys(project)
+        for src in project.files:
+            yield from self._check_file(src, exempt)
+
+    def _check_file(self, src: SourceFile, exempt: Set[str]) -> Iterator[Violation]:
+        classes = enclosing_classes(src.tree)
+        # per-class one-level callee summaries for self-method folding
+        summaries: Dict[str, Dict[str, _CalleeSummary]] = {}
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                cls = classes.get(id(node), "")
+                if cls:
+                    summaries.setdefault(cls, {})[node.name] = _summarize_callee(node)
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.AsyncFunctionDef):
+                continue
+            cls = classes.get(id(node), "")
+            callees = dict(summaries.get(cls, {}))
+            callees.pop(node.name, None)  # no self-recursion folding
+            scanner = _FunctionScanner(callees)
+            scanner.scan(node)
+            yield from self._judge(src, cls, node, scanner.events, exempt)
+
+    def _judge(
+        self,
+        src: SourceFile,
+        cls: str,
+        fn: ast.AST,
+        events: List[_Event],
+        exempt: Set[str],
+    ) -> Iterator[Violation]:
+        seen: Set[Tuple[str, int, int]] = set()
+        for wi, w in enumerate(events):
+            if w.kind != _WRITE:
+                continue
+            path = w.path
+            if path is None:
+                continue
+            if path.startswith("self.") and cls \
+                    and f"{cls}.{path[5:]}" in exempt:
+                continue
+            hit = self._linear(events, wi) or self._wrapped(events, wi)
+            if hit is None:
+                continue
+            read, awaited = hit
+            # a lock spanning test and act makes the region atomic
+            if set(read.withs) & set(w.withs):
+                continue
+            key = (path, read.line, w.line)
+            if key in seen:
+                continue
+            seen.add(key)
+            yield Violation(
+                rule=self.name,
+                path=src.rel,
+                line=read.line,
+                message=(
+                    f"check-then-act on `{path}` torn by await: tested here, "
+                    f"suspended at line {awaited.line} (any other task may "
+                    f"mutate it), then written at line {w.line} with no "
+                    "re-check and no spanning lock — hold a lock across the "
+                    "region, re-validate after the await, or register the "
+                    "attribute's confinement in runtime/sync.py GUARDED_STATE"
+                ),
+            )
+
+    @staticmethod
+    def _linear(
+        events: List[_Event], wi: int
+    ) -> Optional[Tuple[_Event, _Event]]:
+        w = events[wi]
+        path = w.path
+        last_await: Optional[int] = None
+        for i in range(wi - 1, -1, -1):
+            ev = events[i]
+            if not ev.on_path_to(w):
+                continue  # a terminal branch never flows to this write
+            if ev.kind == _AWAIT:
+                last_await = i
+                break
+            if ev.kind in (_READ, _RECHECK_ONLY) and ev.path == path:
+                return None  # revalidated after every suspension before the act
+        if last_await is None:
+            return None
+        for i in range(last_await - 1, -1, -1):
+            ev = events[i]
+            if not ev.on_path_to(w):
+                continue
+            if ev.kind == _READ and ev.path == path:
+                return ev, events[last_await]
+        return None
+
+    @staticmethod
+    def _wrapped(
+        events: List[_Event], wi: int
+    ) -> Optional[Tuple[_Event, _Event]]:
+        """Loop wrap-around: a write inside a loop follows the PREVIOUS
+        iteration's awaits.  Fires when the loop body suspends, the test
+        lives before the loop, and nothing inside the loop re-tests."""
+        w = events[wi]
+        if not w.loops:
+            return None
+        loop = w.loops[-1]
+        loop_awaits = [
+            e for e in events
+            if e.kind == _AWAIT and loop in e.loops and e.on_path_to(w)
+        ]
+        if not loop_awaits:
+            return None
+        for e in events:
+            if e.kind in (_READ, _RECHECK_ONLY) and e.path == w.path \
+                    and loop in e.loops and e.on_path_to(w):
+                return None  # re-tested every iteration
+        first_in_loop = next(
+            (i for i, e in enumerate(events) if loop in e.loops), len(events)
+        )
+        for i in range(first_in_loop - 1, -1, -1):
+            e = events[i]
+            if e.kind == _READ and e.path == w.path and e.on_path_to(w):
+                # the stale test precedes the loop
+                return e, loop_awaits[0]
+        return None

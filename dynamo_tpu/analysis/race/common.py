@@ -1,0 +1,191 @@
+"""Shared AST machinery for the race pack.
+
+All four race rules reason about the same three primitives:
+
+  * attribute PATHS — `self.slots`, `slot.free`, `conn.streams` — the
+    dotted receiver+attribute spelling of a piece of shared state as it
+    appears inside one function.  Matching is textual within a function
+    (the same spelling names the same object on every line of a method),
+    which is exactly the granularity an `async with`/re-check fix
+    operates at;
+  * WRITES — assignments, augmented assignments, deletes, subscript
+    stores, and calls to container mutators (`.append/.pop/.update/...`)
+    on a path;
+  * suspension points — `await` expressions, where the event loop can
+    interleave any other task.
+
+Nested `def`/`lambda` bodies are other coroutines/functions and are
+never scanned as part of the enclosing one (same contract as
+flow-cancellation-safety).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
+
+from ..core import Project, dotted_name
+
+#: container-mutation method names treated as writes to their receiver.
+#: Queue.put_nowait/get_nowait are deliberately absent: asyncio queues are
+#: the sanctioned cross-task handoff primitive.
+MUTATOR_METHODS = {
+    "append", "appendleft", "extend", "insert",
+    "pop", "popleft", "popitem", "remove", "discard",
+    "add", "clear", "update", "setdefault",
+}
+
+#: callables that take an atomic snapshot of a container (iterating the
+#: result cannot race a concurrent mutation of the source)
+SNAPSHOT_CALLS = {"list", "tuple", "sorted", "set", "dict", "frozenset"}
+
+
+def attr_path(node: ast.AST) -> Optional[Tuple[str, str]]:
+    """(receiver, attr) for an attribute access with a resolvable dotted
+    receiver: `self.slots` -> ("self", "slots"), `self.kvbm._pending` ->
+    ("self.kvbm", "_pending").  None for computed receivers."""
+    if not isinstance(node, ast.Attribute):
+        return None
+    base = dotted_name(node.value)
+    if not base:
+        return None
+    return base, node.attr
+
+
+def full_path(node: ast.AST) -> Optional[str]:
+    p = attr_path(node)
+    return f"{p[0]}.{p[1]}" if p else None
+
+
+def write_targets(stmt: ast.AST) -> Iterator[Tuple[str, int]]:
+    """(path, line) of every attribute path a statement writes: direct
+    assignment, subscript/attribute store on the path, del, aug-assign."""
+    targets: List[ast.AST] = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    elif isinstance(stmt, ast.Delete):
+        targets = list(stmt.targets)
+    for tgt in targets:
+        # unpack tuple targets: `a.x, b.y = ...`
+        stack = [tgt]
+        while stack:
+            t = stack.pop()
+            if isinstance(t, (ast.Tuple, ast.List)):
+                stack.extend(t.elts)
+                continue
+            if isinstance(t, ast.Starred):
+                stack.append(t.value)
+                continue
+            if isinstance(t, ast.Subscript):
+                # store INTO a container held at the path: self.slots[i]=x
+                p = full_path(t.value)
+                if p:
+                    yield p, t.lineno
+                continue
+            p = full_path(t)
+            if p:
+                yield p, t.lineno
+
+
+def mutator_calls(expr: ast.AST) -> Iterator[Tuple[str, int]]:
+    """(path, line) for container-mutator calls on a path anywhere inside
+    `expr` (not descending into nested defs)."""
+    for node in walk_same_scope(expr):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in MUTATOR_METHODS
+        ):
+            p = full_path(node.func.value)
+            if p:
+                yield p, node.lineno
+
+
+def walk_same_scope(node: ast.AST) -> Iterator[ast.AST]:
+    """Walk without descending into nested def/lambda bodies."""
+    stack: List[ast.AST] = [node]
+    while stack:
+        n = stack.pop()
+        if n is not node and isinstance(
+            n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def contains_await(node: ast.AST) -> bool:
+    return any(isinstance(n, ast.Await) for n in walk_same_scope(node))
+
+
+def read_paths(expr: ast.AST) -> Iterator[Tuple[str, int]]:
+    """(path, line) of attribute paths READ inside an expression."""
+    for node in walk_same_scope(expr):
+        if isinstance(node, ast.Attribute) and isinstance(node.ctx, ast.Load):
+            p = full_path(node)
+            if p:
+                yield p, node.lineno
+
+
+def enclosing_classes(tree: ast.AST) -> Dict[int, str]:
+    """id(function def node) -> name of its immediately enclosing class."""
+    out: Dict[int, str] = {}
+    stack: List[Tuple[ast.AST, Optional[str]]] = [(tree, None)]
+    while stack:
+        node, cls = stack.pop()
+        for child in ast.iter_child_nodes(node):
+            child_cls = cls
+            if isinstance(child, ast.ClassDef):
+                child_cls = child.name
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out[id(child)] = cls or ""
+                child_cls = None  # methods of nested classes only
+            stack.append((child, child_cls))
+    return out
+
+
+def function_calls(func: ast.AST) -> Set[str]:
+    """Simple names of every call target inside a function (descending
+    into nested defs: a spawned/nested callee is still this function's
+    code path for ownership-closure purposes)."""
+    out: Set[str] = set()
+    for node in ast.walk(func):
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            if name:
+                out.add(name.split(".")[-1])
+    return out
+
+
+def call_closure(project_functions: Dict[str, List[ast.AST]], root: str,
+                 max_depth: int = 12) -> Set[str]:
+    """Simple-name BFS closure of functions reachable from `root` through
+    project-wide call sites.  Under-approximation runs the permissive
+    way: name conflation can only ALLOW more sites, never flag a
+    legitimate one."""
+    seen: Set[str] = {root}
+    frontier = [root]
+    depth = 0
+    while frontier and depth < max_depth:
+        nxt: List[str] = []
+        for name in frontier:
+            for fn in project_functions.get(name, ()):
+                for callee in function_calls(fn):
+                    if callee not in seen and callee in project_functions:
+                        seen.add(callee)
+                        nxt.append(callee)
+        frontier = nxt
+        depth += 1
+    return seen
+
+
+def project_function_defs(project: Project) -> Dict[str, List[ast.AST]]:
+    """Simple name -> every def in the package (nested included)."""
+    out: Dict[str, List[ast.AST]] = {}
+    for src in project.files:
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.setdefault(node.name, []).append(node)
+    return out

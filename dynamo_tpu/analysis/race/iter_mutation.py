@@ -1,0 +1,187 @@
+"""Rule: race-iter-mutation — don't await while iterating shared state.
+
+`for x in self.<container>:` followed by an `await` in the loop body is
+a suspension in the middle of a live iterator.  Any other task that
+runs during the suspension and mutates the container either corrupts
+the iteration (`RuntimeError: dictionary changed size`) or — worse on
+lists — silently skips/duplicates elements.  Discovery instance caches,
+`RequestPlaneClient._conns`, and engine slot dicts are all iterated on
+notification paths exactly like this.
+
+The rule fires on a sync `for` whose iterable reads a `self.<attr>`
+container (bare, or through `.values()/.items()/.keys()`) when:
+
+  * the loop body (same coroutine — nested defs excluded) contains an
+    `await`, and
+  * some OTHER function in the project mutates an attribute of that
+    name (assignment / container-mutator call, matched by attribute
+    name project-wide — same evidence contract as flow-task-lifecycle:
+    collisions can only add a mutator, and a container nobody else
+    mutates is loop-private), and
+  * the iterable is not an atomic snapshot (`list(...)`, `tuple(...)`,
+    `sorted(...)`, `.copy()`), and the loop is not under a spanning
+    `with`/`async with` guard.
+
+`async for` is exempt: the protocol objects it iterates (queues,
+subscriptions, watches) are the sanctioned cross-task handoff, not a
+shared container.  Fix by snapshotting (`list(self.<attr>.values())`),
+or by holding the container's lock across the loop.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from ..core import Project, Rule, SourceFile, Violation, call_name, dotted_name
+from .common import (
+    MUTATOR_METHODS,
+    SNAPSHOT_CALLS,
+    contains_await,
+    walk_same_scope,
+)
+
+_VIEW_METHODS = {"values", "items", "keys"}
+
+
+def _iter_attr(expr: ast.AST) -> Optional[Tuple[str, bool]]:
+    """(attr name, snapshotted) when a for-loop iterable reads a
+    `self.<attr>` container; None otherwise."""
+    snapshotted = False
+    e = expr
+    while isinstance(e, ast.Call):
+        name = call_name(e)
+        if name in SNAPSHOT_CALLS:
+            snapshotted = True
+            if not e.args:
+                return None
+            e = e.args[0]
+            continue
+        if isinstance(e.func, ast.Attribute) and e.func.attr in _VIEW_METHODS:
+            e = e.func.value
+            continue
+        if isinstance(e.func, ast.Attribute) and e.func.attr == "copy":
+            snapshotted = True
+            e = e.func.value
+            continue
+        if isinstance(e.func, ast.Attribute) and e.func.attr == "get":
+            # dict.get(topic, []) fetches ONE value; iterating it is only
+            # safe if snapshotted — keep chasing the receiver
+            e = e.func.value
+            continue
+        return None
+    if isinstance(e, ast.Attribute) and dotted_name(e.value) == "self":
+        return e.attr, snapshotted
+    return None
+
+
+def _project_mutators(project: Project) -> Dict[str, List[Tuple[str, int, str]]]:
+    """attr name -> [(rel, line, function)] of mutation sites anywhere in
+    the package (any receiver — name-based evidence)."""
+    out: Dict[str, List[Tuple[str, int, str]]] = {}
+
+    def add(attr: str, src: SourceFile, line: int, fn: str):
+        out.setdefault(attr, []).append((src.rel, line, fn))
+
+    for src in project.files:
+        # map nodes to their enclosing function name cheaply
+        stack: List[Tuple[ast.AST, str]] = [(src.tree, "<module>")]
+        while stack:
+            node, fname = stack.pop()
+            for child in ast.iter_child_nodes(node):
+                cname = fname
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    cname = child.name
+                if isinstance(child, (ast.Assign, ast.AugAssign, ast.AnnAssign, ast.Delete)):
+                    tgts = child.targets if isinstance(child, (ast.Assign, ast.Delete)) \
+                        else [child.target]
+                    for t in tgts:
+                        tt = t.value if isinstance(t, ast.Subscript) else t
+                        if isinstance(tt, ast.Attribute):
+                            add(tt.attr, src, child.lineno, fname)
+                elif (
+                    isinstance(child, ast.Call)
+                    and isinstance(child.func, ast.Attribute)
+                    and child.func.attr in MUTATOR_METHODS
+                    and isinstance(child.func.value, ast.Attribute)
+                ):
+                    add(child.func.value.attr, src, child.lineno, fname)
+                stack.append((child, cname))
+    return out
+
+
+class RaceIterMutationRule(Rule):
+    name = "race-iter-mutation"
+    description = (
+        "no await inside a sync for-loop iterating a shared self.<attr> "
+        "container that another function mutates, unless the iterable is "
+        "a snapshot or the loop holds a spanning lock"
+    )
+
+    def check(self, project: Project) -> Iterator[Violation]:
+        mutators = _project_mutators(project)
+        for src in project.files:
+            yield from self._check_file(src, mutators)
+
+    def _check_file(
+        self, src: SourceFile, mutators: Dict[str, List[Tuple[str, int, str]]]
+    ) -> Iterator[Violation]:
+        for fn in ast.walk(src.tree):
+            if not isinstance(fn, ast.AsyncFunctionDef):
+                continue
+            yield from self._check_fn(src, fn, mutators)
+
+    def _check_fn(
+        self,
+        src: SourceFile,
+        fn: ast.AsyncFunctionDef,
+        mutators: Dict[str, List[Tuple[str, int, str]]],
+    ) -> Iterator[Violation]:
+        # (for-node, under-with) in this coroutine's own body
+        stack: List[Tuple[ast.AST, bool]] = [(fn, False)]
+        loops: List[Tuple[ast.For, bool]] = []
+        while stack:
+            node, guarded = stack.pop()
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                    continue
+                child_guarded = guarded or isinstance(
+                    child, (ast.With, ast.AsyncWith)
+                )
+                if isinstance(child, ast.For):
+                    loops.append((child, guarded))
+                stack.append((child, child_guarded))
+        for loop, guarded in loops:
+            got = _iter_attr(loop.iter)
+            if got is None:
+                continue
+            attr, snapshotted = got
+            if snapshotted or guarded:
+                continue
+            if not any(contains_await(s) for s in loop.body):
+                continue
+            enclosing = self._enclosing_fn_name(fn)
+            foreign = [
+                m for m in mutators.get(attr, [])
+                if m[2] != enclosing
+            ]
+            if not foreign:
+                continue
+            where = ", ".join(
+                f"{rel}:{line} ({fname})" for rel, line, fname in foreign[:3]
+            )
+            yield Violation(
+                rule=self.name,
+                path=src.rel,
+                line=loop.lineno,
+                message=(
+                    f"awaiting inside a loop over `self.{attr}` — the "
+                    "suspension lets any task mutate the container "
+                    f"mid-iteration (mutators: {where}); iterate a snapshot "
+                    f"(`list(self.{attr})`) or hold its guard across the loop"
+                ),
+            )
+
+    @staticmethod
+    def _enclosing_fn_name(fn: ast.AST) -> str:
+        return getattr(fn, "name", "<module>")

@@ -1,0 +1,398 @@
+"""Rule: race-lock-order — lock acquisition stays ordered and primitive-pure.
+
+Three hazards, over every `with`/`async with` acquisition of a lock the
+package constructs (`asyncio.Lock/Semaphore/Condition`,
+`threading.Lock/RLock/Condition`, attribute-held or local):
+
+  * ORDER INVERSION — lock B acquired while holding A on one callgraph
+    path, and A acquired while holding B on another.  Two tasks running
+    the two paths deadlock.  The acquisition graph is interprocedural:
+    holding A and calling `f()` charges A against every lock f (or its
+    callees, bounded depth) acquires.
+  * THREADING LOCK HELD ACROSS AWAIT — `with self._lock:` (a
+    threading primitive) whose body suspends at an `await` parks the
+    lock on a suspended task; any OTHER thread (and any other task that
+    needs the lock via an executor hop) blocks the whole event loop
+    when it tries to take it.
+  * PRIMITIVE CONFUSION — a sync `with` on an asyncio lock (raises at
+    runtime on 3.10+, silently does nothing useful before), or an
+    `async with` on a threading lock (blocks the loop), e.g. touching
+    an asyncio.Lock from the kvbm device-exec thread.
+
+Lock identity: `self.<attr>` resolves against the enclosing class;
+`<obj>.<attr>` resolves when exactly one class constructs a lock under
+that attribute name (unique-attr matching — ambiguous names are
+skipped); bare locals assigned a lock constructor resolve within their
+function.  Resolution is under-approximate: an unresolvable context
+expression participates in no edge.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from ..core import Project, Rule, SourceFile, Violation, call_name, dotted_name
+from .common import enclosing_classes, walk_same_scope
+
+_ASYNC_LOCKS = {
+    "asyncio.Lock", "asyncio.Semaphore", "asyncio.BoundedSemaphore",
+    "asyncio.Condition",
+}
+_THREAD_LOCKS = {
+    "threading.Lock", "threading.RLock", "threading.Semaphore",
+    "threading.BoundedSemaphore", "threading.Condition",
+}
+#: conflation-bounded: call matching is by simple name, so deep chains
+#: compound collisions (a `.drain()` on a StreamWriter is not the
+#: server's drain()).  Two hops catch the real holder->helper->lock
+#: shapes without manufacturing cross-subsystem edges.
+_MAX_CALL_DEPTH = 2
+
+
+def _lock_kind(value: ast.AST) -> Optional[str]:
+    """'async' | 'thread' when the expression constructs a lock —
+    directly, or as the default of a dict `.setdefault(key,
+    asyncio.Lock())`-style call argument."""
+    if not isinstance(value, ast.Call):
+        return None
+    name = call_name(value)
+    if name in _ASYNC_LOCKS or name.split(".")[-1] in {
+        n.split(".")[-1] for n in _ASYNC_LOCKS
+    } and name.startswith("asyncio"):
+        return "async"
+    if name in _THREAD_LOCKS or (
+        name.startswith("threading")
+        and name.split(".")[-1] in {n.split(".")[-1] for n in _THREAD_LOCKS}
+    ):
+        return "thread"
+    for arg in list(value.args) + [kw.value for kw in value.keywords]:
+        k = _lock_kind(arg)
+        if k:
+            return k
+    return None
+
+
+@dataclasses.dataclass(frozen=True)
+class _Acquisition:
+    lock: str  # canonical lock id, e.g. "DiscoveryClient._lock"
+    kind: str  # "async" | "thread" | "unknown"
+    is_async_with: bool
+    src_rel: str
+    line: int
+    with_node_id: int
+
+
+class _LockIndex:
+    """Project-wide lock declarations: class-attr locks (with kind) and
+    the attr-name -> classes map for unique-attr resolution."""
+
+    def __init__(self, project: Project):
+        self.class_attr_kind: Dict[Tuple[str, str], str] = {}
+        self.attr_classes: Dict[str, Set[str]] = {}
+        for src in project.files:
+            classes = enclosing_classes(src.tree)
+            for node in ast.walk(src.tree):
+                if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    continue
+                value = node.value
+                if value is None:
+                    continue
+                kind = _lock_kind(value)
+                if kind is None:
+                    continue
+                targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+                for tgt in targets:
+                    if isinstance(tgt, ast.Attribute) \
+                            and dotted_name(tgt.value) == "self":
+                        cls = self._owner_class(src, tgt)
+                        if cls:
+                            self.class_attr_kind[(cls, tgt.attr)] = kind
+                            self.attr_classes.setdefault(tgt.attr, set()).add(cls)
+
+    @staticmethod
+    def _owner_class(src: SourceFile, node: ast.AST) -> Optional[str]:
+        # line-range containment: the innermost class whose span holds the node
+        best: Optional[Tuple[int, str]] = None
+        for cand in ast.walk(src.tree):
+            if isinstance(cand, ast.ClassDef):
+                end = getattr(cand, "end_lineno", cand.lineno)
+                if cand.lineno <= node.lineno <= end:
+                    if best is None or cand.lineno > best[0]:
+                        best = (cand.lineno, cand.name)
+        return best[1] if best else None
+
+    def resolve(
+        self,
+        src: SourceFile,
+        cls: str,
+        func: Optional[ast.AST],
+        expr: ast.AST,
+    ) -> Optional[Tuple[str, str]]:
+        """(lock id, kind) for a with-item context expression."""
+        d = dotted_name(expr)
+        if not d:
+            return None
+        if d.startswith("self.") and d.count(".") == 1:
+            # `self` IS the enclosing class — never fall back to another
+            # class that happens to share the attribute name
+            attr = d.split(".")[1]
+            kind = self.class_attr_kind.get((cls, attr))
+            if kind:
+                return f"{cls}.{attr}", kind
+            return None
+        if "." in d:
+            return self._by_unique_attr(d.rsplit(".", 1)[1])
+        # bare local: a lock constructed (or fetched from a lock dict) in
+        # this function
+        if func is not None:
+            for node in walk_same_scope(func):
+                if isinstance(node, ast.Assign):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name) and tgt.id == d:
+                            kind = _lock_kind(node.value)
+                            if kind:
+                                return (
+                                    f"{src.rel}:{getattr(func, 'name', '?')}:{d}",
+                                    kind,
+                                )
+        return None
+
+    def _by_unique_attr(self, attr: str) -> Optional[Tuple[str, str]]:
+        classes = self.attr_classes.get(attr)
+        if classes and len(classes) == 1:
+            cls = next(iter(classes))
+            return f"{cls}.{attr}", self.class_attr_kind[(cls, attr)]
+        return None
+
+
+class RaceLockOrderRule(Rule):
+    name = "race-lock-order"
+    description = (
+        "lock pairs are acquired in one global order on every callgraph "
+        "path (inversion = deadlock candidate); threading locks are never "
+        "held across an await; async/thread lock primitives are not "
+        "confused across contexts"
+    )
+
+    def check(self, project: Project) -> Iterator[Violation]:
+        index = _LockIndex(project)
+        # function name -> defs (with src), for interprocedural charging
+        fn_defs: Dict[str, List[Tuple[SourceFile, ast.AST, str]]] = {}
+        for src in project.files:
+            classes = enclosing_classes(src.tree)
+            for node in ast.walk(src.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    fn_defs.setdefault(node.name, []).append(
+                        (src, node, classes.get(id(node), ""))
+                    )
+        # per-function direct acquisitions (with held-set context) and
+        # calls made while holding each lock
+        edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+        first_acq: Dict[str, Tuple[str, int]] = {}
+
+        def record_edge(a: str, b: str, src_rel: str, line: int, via: str):
+            edges.setdefault((a, b), (src_rel, line, via))
+
+        # direct scan + primitive-purity checks
+        mixed: List[Violation] = []
+        fn_summary: Dict[int, Tuple[List[_Acquisition], Dict[str, Set[str]]]] = {}
+        for name, defs in fn_defs.items():
+            for src, fn, cls in defs:
+                acqs, calls_under = self._scan_function(
+                    src, fn, cls, index, mixed
+                )
+                fn_summary[id(fn)] = (acqs, calls_under)
+                for a in acqs:
+                    first_acq.setdefault(a.lock, (a.src_rel, a.line))
+        yield from mixed
+
+        # nested (intra-function) edges + interprocedural edges
+        for name, defs in fn_defs.items():
+            for src, fn, cls in defs:
+                acqs, calls_under = fn_summary[id(fn)]
+                # intra-function nesting
+                for i, outer in enumerate(acqs):
+                    for inner in acqs:
+                        if inner is outer:
+                            continue
+                        if self._nested_inside(src, fn, outer, inner):
+                            record_edge(
+                                outer.lock, inner.lock,
+                                inner.src_rel, inner.line,
+                                f"nested in {name}()",
+                            )
+                # calls made while holding a lock: charge transitively.
+                # A sync holder can only execute sync callees (calling an
+                # async def just builds a coroutine) — the asymmetry stops
+                # name conflation from bridging sync thread-lock code into
+                # the asyncio plane and back.
+                holder_async = isinstance(fn, ast.AsyncFunctionDef)
+                for lock, callees in calls_under.items():
+                    if lock == "":
+                        continue
+                    seen: Set[str] = set()
+                    frontier = [(c, holder_async) for c in callees]
+                    depth = 0
+                    while frontier and depth < _MAX_CALL_DEPTH:
+                        nxt: List[Tuple[str, bool]] = []
+                        for callee, may_async in frontier:
+                            if callee in seen:
+                                continue
+                            seen.add(callee)
+                            for csrc, cfn, _ccls in fn_defs.get(callee, ()):
+                                cfn_async = isinstance(cfn, ast.AsyncFunctionDef)
+                                if cfn_async and not may_async:
+                                    continue
+                                cacqs, ccalls = fn_summary[id(cfn)]
+                                for a in cacqs:
+                                    if a.lock != lock:
+                                        record_edge(
+                                            lock, a.lock, a.src_rel, a.line,
+                                            f"{name}() holds it and calls "
+                                            f"{callee}()",
+                                        )
+                                for sub in ccalls.get("", ()):  # calls anywhere
+                                    nxt.append((sub, cfn_async))
+                        frontier = nxt
+                        depth += 1
+
+        reported: Set[Tuple[str, str]] = set()
+        for (a, b), (rel, line, via) in sorted(edges.items()):
+            if (b, a) not in edges or (b, a) in reported or a == b:
+                continue
+            reported.add((a, b))
+            rel2, line2, via2 = edges[(b, a)]
+            yield Violation(
+                rule=self.name,
+                path=rel,
+                line=line,
+                message=(
+                    f"lock-order inversion: `{b}` acquired under `{a}` here "
+                    f"({via}), but `{a}` is acquired under `{b}` at "
+                    f"{rel2}:{line2} ({via2}) — two tasks on these paths "
+                    "deadlock; pick one global order"
+                ),
+            )
+
+    # ----------------------------------------------------------------- #
+
+    def _scan_function(
+        self,
+        src: SourceFile,
+        fn: ast.AST,
+        cls: str,
+        index: _LockIndex,
+        mixed: List[Violation],
+    ) -> Tuple[List[_Acquisition], Dict[str, Set[str]]]:
+        """Direct acquisitions in one function scope, the simple names of
+        calls made while holding each (key "" = calls made anywhere in
+        the function), and primitive-purity findings appended to
+        `mixed`."""
+        acqs: List[_Acquisition] = []
+        calls_under: Dict[str, Set[str]] = {"": set()}
+        is_async_fn = isinstance(fn, ast.AsyncFunctionDef)
+
+        def visit(node: ast.AST, held: Tuple[str, ...]):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                    continue
+                child_held = held
+                if isinstance(child, (ast.With, ast.AsyncWith)):
+                    is_aw = isinstance(child, ast.AsyncWith)
+                    acquired: List[str] = []
+                    for item in child.items:
+                        resolved = index.resolve(src, cls, fn, item.context_expr)
+                        if resolved is None:
+                            continue
+                        lock, kind = resolved
+                        acqs.append(_Acquisition(
+                            lock, kind, is_aw, src.rel,
+                            item.context_expr.lineno, id(child),
+                        ))
+                        calls_under.setdefault(lock, set())
+                        if kind == "thread" and is_aw:
+                            mixed.append(Violation(
+                                rule=self.name, path=src.rel,
+                                line=item.context_expr.lineno,
+                                message=(
+                                    f"`async with` on threading lock "
+                                    f"`{lock}` — threading locks have no "
+                                    "async protocol and would block the "
+                                    "event loop; use asyncio.Lock, or a "
+                                    "sync `with` on a non-loop thread"
+                                ),
+                            ))
+                        elif kind == "async" and not is_aw:
+                            where = (
+                                "an async function" if is_async_fn
+                                else "sync/thread context (asyncio locks "
+                                "are event-loop-only — the kvbm "
+                                "device-exec thread must use a "
+                                "threading.Lock)"
+                            )
+                            mixed.append(Violation(
+                                rule=self.name, path=src.rel,
+                                line=item.context_expr.lineno,
+                                message=(
+                                    f"sync `with` on asyncio lock `{lock}` "
+                                    f"in {where} — acquisition never "
+                                    "suspends and raises on 3.10+; use "
+                                    "`async with` on the loop, or switch "
+                                    "primitives"
+                                ),
+                            ))
+                        elif kind == "thread" and not is_aw and is_async_fn:
+                            # held across await?
+                            for sub in walk_same_scope(child):
+                                if isinstance(sub, ast.Await):
+                                    mixed.append(Violation(
+                                        rule=self.name, path=src.rel,
+                                        line=sub.lineno,
+                                        message=(
+                                            f"threading lock `{lock}` held "
+                                            "across an await (acquired at "
+                                            f"line {item.context_expr.lineno})"
+                                            " — the suspended task parks the "
+                                            "lock and any thread (or "
+                                            "executor-hopping task) that "
+                                            "wants it wedges the process; "
+                                            "release before suspending or "
+                                            "use asyncio.Lock"
+                                        ),
+                                    ))
+                                    break
+                        acquired.append(lock)
+                    if acquired:
+                        child_held = held + tuple(acquired)
+                elif isinstance(child, ast.Call):
+                    name = call_name(child)
+                    if name:
+                        simple = name.split(".")[-1]
+                        calls_under[""].add(simple)
+                        for lock in held:
+                            calls_under.setdefault(lock, set()).add(simple)
+                visit(child, child_held)
+
+        visit(fn, ())
+        return acqs, calls_under
+
+    @staticmethod
+    def _nested_inside(
+        src: SourceFile, fn: ast.AST, outer: _Acquisition, inner: _Acquisition
+    ) -> bool:
+        """True when `inner`'s with-node sits inside `outer`'s with-node."""
+        outer_node = inner_node = None
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                if id(node) == outer.with_node_id:
+                    outer_node = node
+                if id(node) == inner.with_node_id:
+                    inner_node = node
+        if outer_node is None or inner_node is None or outer_node is inner_node:
+            return False
+        return any(
+            n is inner_node
+            for n in ast.walk(outer_node)
+        )

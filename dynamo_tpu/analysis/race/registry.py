@@ -1,0 +1,327 @@
+"""Rule: race-guarded-state — registered shared state keeps its guard.
+
+`runtime/sync.py:GUARDED_STATE` is the single-spelling table of every
+attribute whose concurrency discipline is a project contract (see that
+module's docstring for the guard grammar).  This rule holds both ends:
+
+  * `lock:<attr>` entries: every access (read or write) of the
+    attribute inside the owning class — `__init__` exempt — must sit
+    lexically under `with self.<attr>` / `async with self.<attr>` on
+    the named lock (a local alias assigned from the lock attribute
+    counts);
+  * `single-task:<owner>` / `thread:<owner>` entries: every MUTATION
+    site of the attribute inside the owning class must be `<owner>` or
+    a function in the project-wide call closure of `<owner>` (reads are
+    event-loop-atomic for tasks, and snapshot-required for threads —
+    documented in runtime/sync.py);
+  * stale/unresolvable entries fire AT THE REGISTRY LINE: a class,
+    attribute, guard lock, or owner function that no longer exists must
+    leave the table (and the generated docs/concurrency.md) with it.
+
+Under-approximation: enforcement is scoped to the owning class's own
+methods (nested closures are checked as their own scopes — a lock held
+where a closure is DEFINED is not held where it runs); an external
+accessor reaching through another object's attribute chain is invisible
+to this rule and belongs to code review.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from ..core import Project, Rule, SourceFile, Violation, dotted_name, str_const
+from .common import (
+    MUTATOR_METHODS,
+    call_closure,
+    project_function_defs,
+)
+
+SYNC_MODULE = "dynamo_tpu/runtime/sync.py"
+
+_GUARD_KINDS = ("lock", "single-task", "thread")
+
+
+@dataclasses.dataclass(frozen=True)
+class GuardEntry:
+    cls: str
+    attr: str
+    kind: str  # "lock" | "single-task" | "thread"
+    target: str  # lock attr or owner function name
+    line: int  # registry line, for stale-entry anchoring
+
+    @property
+    def key(self) -> str:
+        return f"{self.cls}.{self.attr}"
+
+
+def load_guarded_state(
+    project: Project,
+) -> Tuple[Optional[List[GuardEntry]], Optional[str]]:
+    """Parse GUARDED_STATE out of runtime/sync.py (AST only, never
+    imported).  Returns (entries, error) — error is a human message when
+    the registry is missing or malformed, reported as a violation like
+    KNOWN_AXES/FRAME_TAGS/KNOWN_FAULT_POINTS."""
+    src = project.get(SYNC_MODULE)
+    if src is None:
+        return None, f"{SYNC_MODULE} not found: the guarded-state registry is gone"
+    table: Optional[ast.Dict] = None
+    for node in src.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt = node.targets[0]
+            if isinstance(tgt, ast.Name) and tgt.id == "GUARDED_STATE" \
+                    and isinstance(node.value, ast.Dict):
+                table = node.value
+    if table is None:
+        return None, (
+            f"{SYNC_MODULE} defines no GUARDED_STATE dict literal — the race "
+            "rules need the guard registry as their source of truth"
+        )
+    entries: List[GuardEntry] = []
+    for k, v in zip(table.keys, table.values):
+        key = str_const(k) if k is not None else None
+        spec = str_const(v)
+        if key is None or spec is None:
+            return None, (
+                f"{SYNC_MODULE}: GUARDED_STATE keys and guard specs must be "
+                "string literals"
+            )
+        if key.count(".") != 1:
+            return None, (
+                f"{SYNC_MODULE}: GUARDED_STATE key '{key}' is not "
+                "'Class.attr'"
+            )
+        kind, sep, target = spec.partition(":")
+        if not sep or kind not in _GUARD_KINDS or not target:
+            return None, (
+                f"{SYNC_MODULE}: GUARDED_STATE['{key}'] guard '{spec}' is not "
+                f"'<kind>:<target>' with kind in {_GUARD_KINDS}"
+            )
+        cls, attr = key.split(".")
+        entries.append(GuardEntry(cls, attr, kind, target, k.lineno))
+    return entries, None
+
+
+def guarded_keys(project: Project) -> Set[str]:
+    """'Class.attr' keys of the registry; empty on load failure (the rule
+    itself reports the failure — siblings just see no exemptions)."""
+    entries, err = load_guarded_state(project)
+    if err is not None or entries is None:
+        return set()
+    return {e.key for e in entries}
+
+
+def _class_defs(project: Project) -> Dict[str, List[Tuple[SourceFile, ast.ClassDef]]]:
+    out: Dict[str, List[Tuple[SourceFile, ast.ClassDef]]] = {}
+    for src in project.files:
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.ClassDef):
+                out.setdefault(node.name, []).append((src, node))
+    return out
+
+
+def _with_guards(with_node: ast.AST, lock_attr: str) -> bool:
+    """True when a With/AsyncWith acquires `<recv>.<lock_attr>` (or a
+    bare name equal to the lock attr — a local alias)."""
+    for item in with_node.items:
+        d = dotted_name(item.context_expr)
+        if d and (d.endswith(f".{lock_attr}") or d == lock_attr):
+            return True
+    return False
+
+
+def _class_scopes(cls: ast.ClassDef):
+    """Every function scope in a class, nested closures included, each
+    yielded once as (scope, is_class_init).  Nested defs are separate
+    scopes: a lock held where a closure is DEFINED is not held where it
+    runs (the closure body must take it again)."""
+    for meth in cls.body:
+        if not isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        stack = [meth]
+        while stack:
+            fn = stack.pop()
+            yield fn, (fn is meth and meth.name == "__init__")
+            for node in ast.walk(fn):
+                if node is not fn and isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    stack.append(node)
+
+
+def _scope_walk(func: ast.AST):
+    """(node, with_stack) inside one function scope only — no descent
+    into nested defs/lambdas."""
+    stack = [(func, ())]
+    while stack:
+        node, withs = stack.pop()
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            child_withs = withs
+            if isinstance(child, (ast.With, ast.AsyncWith)):
+                child_withs = withs + (child,)
+            yield child, child_withs
+            stack.append((child, child_withs))
+
+
+def _self_attr_nodes(func: ast.AST, attr: str):
+    """(attribute-or-subscript node, with_stack, is_mutation) for every
+    `self.<attr>` access in one scope.  A Subscript store/del on the
+    attribute, and container-mutator calls, count as mutations."""
+    for node, withs in _scope_walk(func):
+        if isinstance(node, ast.Attribute) and node.attr == attr \
+                and dotted_name(node.value) == "self":
+            yield node, withs, isinstance(node.ctx, (ast.Store, ast.Del))
+        elif (
+            isinstance(node, ast.Subscript)
+            and isinstance(node.ctx, (ast.Store, ast.Del))
+            and isinstance(node.value, ast.Attribute)
+            and node.value.attr == attr
+            and dotted_name(node.value.value) == "self"
+        ):
+            yield node, withs, True
+        elif (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in MUTATOR_METHODS
+            and isinstance(node.func.value, ast.Attribute)
+            and node.func.value.attr == attr
+            and dotted_name(node.func.value.value) == "self"
+        ):
+            yield node, withs, True
+
+
+class RaceGuardedStateRule(Rule):
+    name = "race-guarded-state"
+    description = (
+        "every access of an attribute registered in runtime/sync.py "
+        "GUARDED_STATE happens under its declared guard (lock held / "
+        "owner task-or-thread confinement), and stale registry entries fire"
+    )
+
+    def check(self, project: Project) -> Iterator[Violation]:
+        entries, err = load_guarded_state(project)
+        if err is not None:
+            yield Violation(rule=self.name, path=SYNC_MODULE, line=1, message=err)
+            return
+        classes = _class_defs(project)
+        functions = project_function_defs(project)
+        closures: Dict[str, Set[str]] = {}
+        for entry in entries:
+            defs = classes.get(entry.cls)
+            if not defs:
+                yield Violation(
+                    rule=self.name, path=SYNC_MODULE, line=entry.line,
+                    message=(
+                        f"GUARDED_STATE entry '{entry.key}': class "
+                        f"'{entry.cls}' no longer exists in the package — "
+                        "remove the entry or fix the spelling"
+                    ),
+                )
+                continue
+            if entry.kind == "lock":
+                yield from self._check_lock(entry, defs)
+            else:
+                if entry.target not in functions:
+                    yield Violation(
+                        rule=self.name, path=SYNC_MODULE, line=entry.line,
+                        message=(
+                            f"GUARDED_STATE entry '{entry.key}': owner "
+                            f"function '{entry.target}' no longer exists — "
+                            "the confinement claim is unverifiable; update "
+                            "or remove the entry"
+                        ),
+                    )
+                    continue
+                if entry.target not in closures:
+                    closures[entry.target] = call_closure(functions, entry.target)
+                yield from self._check_confined(entry, defs, closures[entry.target])
+
+    # ----------------------------------------------------------------- #
+
+    def _check_lock(
+        self, entry: GuardEntry, defs: List[Tuple[SourceFile, ast.ClassDef]]
+    ) -> Iterator[Violation]:
+        touched = False
+        for src, cls in defs:
+            has_lock = any(
+                isinstance(n, ast.Attribute) and n.attr == entry.target
+                and isinstance(n.ctx, ast.Store)
+                for n in ast.walk(cls)
+            )
+            if not has_lock:
+                yield Violation(
+                    rule=self.name, path=SYNC_MODULE, line=entry.line,
+                    message=(
+                        f"GUARDED_STATE entry '{entry.key}': guard lock "
+                        f"'{entry.target}' is never assigned in class "
+                        f"'{entry.cls}' ({src.rel}) — the entry is "
+                        "unresolvable; fix the lock name or the guard spec"
+                    ),
+                )
+                continue
+            for scope, is_init in _class_scopes(cls):
+                for node, withs, _mut in _self_attr_nodes(scope, entry.attr):
+                    touched = True
+                    if is_init:
+                        continue  # construction precedes sharing
+                    if any(_with_guards(w, entry.target) for w in withs):
+                        continue
+                    yield Violation(
+                        rule=self.name, path=src.rel, line=node.lineno,
+                        message=(
+                            f"access of {entry.key} outside `with "
+                            f"self.{entry.target}` — GUARDED_STATE declares "
+                            f"this attribute lock-guarded ({SYNC_MODULE}); "
+                            "take the lock, or change/remove the registry "
+                            "entry, or waive with a reason"
+                        ),
+                    )
+        if not touched:
+            yield Violation(
+                rule=self.name, path=SYNC_MODULE, line=entry.line,
+                message=(
+                    f"GUARDED_STATE entry '{entry.key}' matches no access of "
+                    f"self.{entry.attr} in class '{entry.cls}' — stale "
+                    "registry weight; remove it"
+                ),
+            )
+
+    def _check_confined(
+        self,
+        entry: GuardEntry,
+        defs: List[Tuple[SourceFile, ast.ClassDef]],
+        closure: Set[str],
+    ) -> Iterator[Violation]:
+        noun = "task" if entry.kind == "single-task" else "thread"
+        touched = False
+        for src, cls in defs:
+            for scope, is_init in _class_scopes(cls):
+                for node, _withs, mut in _self_attr_nodes(scope, entry.attr):
+                    touched = True
+                    if not mut or is_init:
+                        continue
+                    if scope.name in closure:
+                        continue
+                    yield Violation(
+                        rule=self.name, path=src.rel, line=node.lineno,
+                        message=(
+                            f"mutation of {entry.key} outside its owner "
+                            f"{noun} — GUARDED_STATE confines this attribute "
+                            f"to '{entry.target}' (and its callees, "
+                            f"{SYNC_MODULE}); route the mutation through the "
+                            "owner, change/remove the registry entry, or "
+                            "waive with a reason"
+                        ),
+                    )
+        if not touched:
+            yield Violation(
+                rule=self.name, path=SYNC_MODULE, line=entry.line,
+                message=(
+                    f"GUARDED_STATE entry '{entry.key}' matches no access of "
+                    f"self.{entry.attr} in class '{entry.cls}' — stale "
+                    "registry weight; remove it"
+                ),
+            )

@@ -7,6 +7,13 @@ from ..flow import (
     FrameProtocolRule,
     TaskLifecycleRule,
 )
+from ..race import (
+    RACE_RULES,
+    RaceAwaitAtomicityRule,
+    RaceGuardedStateRule,
+    RaceIterMutationRule,
+    RaceLockOrderRule,
+)
 from ..shard import SHARD_RULES, AxisRegistryRule, CollectiveSymmetryRule, PallasGridRule
 from .async_safety import AsyncBlockingRule
 from .env_registry import EnvRegistryRule
@@ -22,13 +29,14 @@ CORE_RULES = (
     LockDisciplineRule,
 )
 
-ALL_RULES = CORE_RULES + SHARD_RULES + FLOW_RULES
+ALL_RULES = CORE_RULES + SHARD_RULES + FLOW_RULES + RACE_RULES
 
 #: pack aliases accepted by the CLI's --rules (e.g. `--rules shard`)
 PACKS = {
     "core": CORE_RULES,
     "shard": SHARD_RULES,
     "flow": FLOW_RULES,
+    "race": RACE_RULES,
 }
 
 
@@ -41,6 +49,7 @@ __all__ = [
     "CORE_RULES",
     "FLOW_RULES",
     "PACKS",
+    "RACE_RULES",
     "AsyncBlockingRule",
     "AxisRegistryRule",
     "CancellationSafetyRule",
@@ -51,6 +60,10 @@ __all__ = [
     "JaxPurityRule",
     "LockDisciplineRule",
     "PallasGridRule",
+    "RaceAwaitAtomicityRule",
+    "RaceGuardedStateRule",
+    "RaceIterMutationRule",
+    "RaceLockOrderRule",
     "SilentDropRule",
     "TaskLifecycleRule",
     "default_rules",

@@ -147,7 +147,7 @@ class GraphReconciler(_PollLoop):
         """Spec change (edited manifest): triggers a rollout on the next
         reconcile (the backend replaces replicas whose template changed)."""
         self.graph = graph
-        self._applied_base = False
+        self._applied_base = False  # dynolint: disable=race-guarded-state -- the one sanctioned external trigger: a sync one-shot flag flip the poll task picks up next pass
 
     async def reconcile_once(self) -> bool:
         raw = await self.client.get(PLANNER_DECISION_KEY) if self.client else None

@@ -935,7 +935,7 @@ class JaxEngine:
         if self.kvbm is not None:
             # drain in-flight write-through offloads, then persist G3 index
             for _ in range(500):
-                if self.kvbm._pending == 0:
+                if self.kvbm.pending_offloads() == 0:
                     break
                 await asyncio.sleep(0.01)
             self.kvbm.manager.flush()
@@ -1398,7 +1398,9 @@ class JaxEngine:
         out["kv_skip_ahead_blocks"] = self.prefix_skip_ahead_blocks
         out["emit_batches"] = self.emit_batches
         out["emit_tokens"] = self.emit_tokens
-        for tag, (cnt, tot) in self._dev_time.items():
+        # list() is one atomic C-level snapshot: the jax-step thread keeps
+        # inserting while we iterate (GUARDED_STATE: thread-confined)
+        for tag, (cnt, tot) in list(self._dev_time.items()):
             out[f"dispatch_{tag}_count"] = cnt
             out[f"dispatch_{tag}_s"] = round(tot, 3)
         if self.guided_requests:
@@ -2365,7 +2367,10 @@ class JaxEngine:
         cfg = self.config
         cands = []
         for s in self.slots:
-            if s is None or s.prefill_pos >= len(s.kv_prompt):
+            # prefill_pos has a single writer per LIVE slot (this dispatch
+            # path); the pull-failure fallback rewrite only reaches slots
+            # excluded from cands while their pull is in flight
+            if s is None or s.prefill_pos >= len(s.kv_prompt):  # dynolint: disable=race-await-atomicity -- single writer per live slot; pull-path slots are filtered below
                 continue
             if s.preloaded is not None or s.onboard is not None:
                 continue
@@ -3045,6 +3050,14 @@ class JaxEngine:
         # (possibly shared prefix-cache pages). A scratch row routes all
         # such writes to the reserved scratch page by construction.
         if not self._carry_valid:
+            # TAKE the dirt before building the upload: the dispatch below
+            # suspends, and a background KV-pull activation landing during
+            # that await marks fresh lanes dirty — clearing after the await
+            # would erase their mark and leave stale lane state on device.
+            # Taken synchronously with the array snapshot, new dirt simply
+            # rides the next step's patch.
+            self._dirty_lanes.clear()
+            self._dirty_tables.clear()
             mask = np.zeros((B,), bool)
             for i in active:
                 mask[i] = True
@@ -3082,18 +3095,21 @@ class JaxEngine:
                 tag="reset",
             )
             self._carry_valid = True
-            self._dirty_lanes.clear()
-            self._dirty_tables.clear()
         elif self._dirty_lanes or self._dirty_tables:
             # per-lane patch: update just the changed lanes on device — no
             # pipeline drain, no full re-upload. Untouched lanes keep their
             # (newer) device carry; table_mask covers lanes whose page table
-            # grew but whose carry must be preserved.
+            # grew but whose carry must be preserved.  TAKE the dirty sets
+            # atomically with the host-array snapshot (same reasoning as
+            # the reset branch: dirt added during the dispatch await must
+            # survive into the next step, not be cleared with this one).
+            dirty_lanes, dirty_tables = self._dirty_lanes, self._dirty_tables
+            self._dirty_lanes, self._dirty_tables = set(), set()
             lane_mask = np.zeros((B,), bool)
-            for i in self._dirty_lanes:
+            for i in dirty_lanes:
                 lane_mask[i] = True
             table_mask = lane_mask.copy()
-            for i in self._dirty_tables:
+            for i in dirty_tables:
                 table_mask[i] = True
             active_mask = np.zeros((B,), bool)
             for i in active:
@@ -3129,8 +3145,6 @@ class JaxEngine:
                 ),
                 tag="patch",
             )
-            self._dirty_lanes.clear()
-            self._dirty_tables.clear()
 
         guided_lanes = [
             i for i in active if self.slots[i].guided_fsm is not None

@@ -163,17 +163,20 @@ class KvBlockManager:
             return n
 
     def stats(self) -> dict:
-        out = {
-            "kvbm_offloaded_blocks": self.offloaded_blocks,
-            "kvbm_onboarded_blocks": self.onboarded_blocks,
-            "kvbm_disk_evictions": self.disk_evictions,
-            "kvbm_dropped_blocks": self.dropped_blocks,
-        }
-        if self.host is not None:
-            out.update({f"kvbm_{k}": v for k, v in self.host.stats().items()})
-        if self.disk is not None:
-            out.update({f"kvbm_{k}": v for k, v in self.disk.stats().items()})
-        return out
+        # the event loop reads while the device-exec thread stores: the
+        # lock buys a consistent counter+tier snapshot (GUARDED_STATE)
+        with self._lock:
+            out = {
+                "kvbm_offloaded_blocks": self.offloaded_blocks,
+                "kvbm_onboarded_blocks": self.onboarded_blocks,
+                "kvbm_disk_evictions": self.disk_evictions,
+                "kvbm_dropped_blocks": self.dropped_blocks,
+            }
+            if self.host is not None:
+                out.update({f"kvbm_{k}": v for k, v in self.host.stats().items()})
+            if self.disk is not None:
+                out.update({f"kvbm_{k}": v for k, v in self.disk.stats().items()})
+            return out
 
 
 class KvbmConnector:
@@ -291,8 +294,15 @@ class KvbmConnector:
             self.distributed.announce("cleared", [])
         return n
 
+    def pending_offloads(self) -> int:
+        """In-flight write-through count (engine close() drains on this)."""
+        with self._pending_lock:
+            return self._pending
+
     def stats(self) -> dict:
-        out = {**self.manager.stats(), "kvbm_pending_offloads": self._pending}
+        with self._pending_lock:
+            pending = self._pending
+        out = {**self.manager.stats(), "kvbm_pending_offloads": pending}
         if self.distributed is not None:
             out.update(self.distributed.stats())
         return out

@@ -99,7 +99,7 @@ class ModelWatcher:
         payload = json.loads(raw)
         card = ModelDeploymentCard.from_json(raw)
         ep_info = payload.get("endpoint") or {}
-        if self.manager.get(card.name) is not None:
+        if self.manager.get(card.name) is not None:  # dynolint: disable=race-await-atomicity -- the model watcher is one serial task: _loop awaits each _on_put to completion
             self._card_keys[key] = card.name
             return  # another worker instance of an already-live model
         endpoint = (

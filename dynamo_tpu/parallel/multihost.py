@@ -241,7 +241,9 @@ class StepBroadcaster:
             w.write(frame)
 
     async def drain(self):
-        for f in self._followers:
+        # snapshot: a slow follower's drain() suspends, and _lose/_on_connect
+        # mutate the follower list from other tasks mid-iteration
+        for f in list(self._followers):
             if not f.writer.is_closing():
                 await f.writer.drain()
 

@@ -45,6 +45,10 @@ class VirtualConnector:
     def __init__(self, discovery_client):
         self.client = discovery_client
         self.revision: Optional[int] = None
+        # two concurrent publishers would both lazy-load, both increment,
+        # and ship duplicate revision numbers — which the revision-gated
+        # consumers (operator-lite) silently skip
+        self._rev_lock = asyncio.Lock()
 
     async def _load_revision(self) -> int:
         raw = await self.client.get(PLANNER_DECISION_KEY)
@@ -56,18 +60,19 @@ class VirtualConnector:
         return 0
 
     async def set_replicas(self, prefill: int, decode: int) -> None:
-        if self.revision is None:
-            self.revision = await self._load_revision()
-        self.revision += 1
-        doc = {
-            "num_prefill_workers": prefill,
-            "num_decode_workers": decode,
-            "revision": self.revision,
-            "ts": time.time(),
-        }
-        await self.client.put(PLANNER_DECISION_KEY, json.dumps(doc).encode())
-        logger.info("published planner decision rev=%d p=%d d=%d",
-                    self.revision, prefill, decode)
+        async with self._rev_lock:
+            if self.revision is None:
+                self.revision = await self._load_revision()
+            self.revision += 1
+            doc = {
+                "num_prefill_workers": prefill,
+                "num_decode_workers": decode,
+                "revision": self.revision,
+                "ts": time.time(),
+            }
+            await self.client.put(PLANNER_DECISION_KEY, json.dumps(doc).encode())
+            logger.info("published planner decision rev=%d p=%d d=%d",
+                        self.revision, prefill, decode)
 
 
 class LocalProcessConnector:

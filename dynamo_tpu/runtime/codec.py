@@ -64,6 +64,14 @@ OP_STATUS = "status"
 PUSH_WATCH = "watch"
 PUSH_MSG = "msg"
 
+# machine-readable error codes riding T_ERR frames, "code" channel
+# (runtime/request_plane.py).  The human `error` string is for logs; the
+# code is what clients DISPATCH on — drift here is the same silent-hang
+# class as an unconsumed frame tag, so ERR_CODES holds producer/consumer
+# symmetry exactly like FRAME_TAGS.
+ERR_DRAINING = "draining"
+ERR_DEADLINE = "deadline"
+
 FRAME_TAGS = {
     "t": {
         T_REQ: "open a stream: subject + packed request payload",
@@ -96,6 +104,15 @@ FRAME_TAGS = {
         PUSH_WATCH: "server-pushed watch event (type=put|delete)",
         PUSH_MSG: "server-pushed topic message",
     },
+}
+
+#: wire error codes on T_ERR frames; checked by flow-frame-protocol as
+#: the "code" channel (emit/consume symmetry, dead entries fire)
+ERR_CODES = {
+    ERR_DRAINING: "worker draining: clients treat as StreamLost and retry "
+                  "another instance",
+    ERR_DEADLINE: "end-to-end deadline passed worker-side: clients raise "
+                  "DeadlineExceeded so migration stops retrying",
 }
 
 

@@ -85,6 +85,10 @@ class DistributedRuntime:
             connect_timeout=self.config.request_plane_connect_timeout
         )
         self._server_started = False
+        # two endpoints serving concurrently must not both start the
+        # request-plane server: the loser's listening socket would leak
+        # and its registrations would point at a dead port
+        self._server_lock = asyncio.Lock()
         self._namespaces: Dict[str, Namespace] = {}
         self._leased_keys: Dict[str, bytes] = {}
         self._shutdown = asyncio.Event()
@@ -161,9 +165,10 @@ class DistributedRuntime:
 
     async def ensure_server(self) -> str:
         """Start the request-plane server on first use; returns host:port."""
-        if not self._server_started:
-            await self.server.start()
-            self._server_started = True
+        async with self._server_lock:
+            if not self._server_started:
+                await self.server.start()
+                self._server_started = True
         host = self.server.host
         if host in ("0.0.0.0", "::"):
             host = socket.gethostbyname(socket.gethostname())
@@ -420,7 +425,7 @@ class Client:
             address=address,
             subject=subject or self.endpoint.subject,
         )
-        self.instances[inst.instance_id] = inst
+        self.instances[inst.instance_id] = inst  # dynolint: disable=race-guarded-state -- static mode: discovery is off and the owning watch task never exists
         self._instances_event.set()
 
     async def direct(self, request: Any, instance_id: int, context: Optional[Context] = None):

@@ -126,6 +126,14 @@ class DiscoveryServer:
             now = time.monotonic()
             dead = [l for l in self._leases.values() if l.deadline < now]
             for lease in dead:
+                # revoking awaits (watch notifications) — a keepalive can
+                # land between the scan above and this revoke, and killing
+                # a just-refreshed lease would drop a live worker from the
+                # serving set. Re-check the CURRENT deadline.
+                if lease.lease_id not in self._leases:
+                    continue
+                if self._leases[lease.lease_id].deadline >= now:
+                    continue
                 logger.info("lease %d expired; deleting %d keys", lease.lease_id, len(lease.keys))
                 await self._revoke(lease.lease_id)
 
@@ -256,9 +264,15 @@ class DiscoveryServer:
             return {"ok": True, "deleted": deleted}, b""
         if op == OP_DELETE_PREFIX:
             keys = [k for k in list(self._kv) if k.startswith(control["prefix"])]
+            deleted = 0
             for k in keys:
+                # each delete awaits watcher notification — skip keys a
+                # concurrent op already removed during an earlier await
+                if k not in self._kv:
+                    continue
                 await self._delete_key(k)
-            return {"ok": True, "deleted": len(keys)}, b""
+                deleted += 1
+            return {"ok": True, "deleted": deleted}, b""
         if op == OP_LEASE_GRANT:
             ttl = float(control.get("ttl", 10.0))
             lease = _Lease(next(self._lease_ids), ttl, time.monotonic() + ttl)

@@ -74,13 +74,16 @@ class HealthCheckManager:
         self._task = asyncio.create_task(self._loop())
 
     async def stop(self) -> None:
-        if self._task is not None:
-            self._task.cancel()
+        # take-then-act: claim the task BEFORE awaiting, so a concurrent
+        # stop() (or a start() racing a stop) never reaps the same task
+        # twice or nulls out a fresh one
+        task, self._task = self._task, None
+        if task is not None:
+            task.cancel()
             try:
-                await self._task
+                await task
             except asyncio.CancelledError:
                 pass
-            self._task = None
 
     async def _loop(self) -> None:
         while True:

@@ -44,7 +44,18 @@ import time
 from typing import Any, AsyncIterator, Awaitable, Callable, Dict, Optional, Tuple
 
 from . import codec, faults
-from .codec import T_CANCEL, T_DATA, T_DONE, T_ERR, T_LOST, T_PING, T_PONG, T_REQ
+from .codec import (
+    ERR_DEADLINE,
+    ERR_DRAINING,
+    T_CANCEL,
+    T_DATA,
+    T_DONE,
+    T_ERR,
+    T_LOST,
+    T_PING,
+    T_PONG,
+    T_REQ,
+)
 from .config import _env
 from .engine import Context
 from .logging import DistributedTraceContext, current_trace, parse_traceparent, set_trace
@@ -53,9 +64,8 @@ logger = logging.getLogger(__name__)
 
 Handler = Callable[[Any, Context], AsyncIterator[Any]]
 
-#: wire error code a draining server attaches to rejected new streams;
-#: clients surface it as StreamLost so routers retry another instance
-DRAINING = "draining"
+#: back-compat alias — the registered spelling lives in codec.ERR_CODES
+DRAINING = ERR_DRAINING
 
 
 def tune_transport(writer: asyncio.StreamWriter):
@@ -188,7 +198,7 @@ class RequestPlaneServer:
                         async with write_lock:
                             await codec.write_frame(writer, {
                                 "t": T_ERR, "stream": stream_id,
-                                "code": DRAINING,
+                                "code": ERR_DRAINING,
                                 "error": "worker draining: not accepting new streams",
                             })
                         continue
@@ -327,8 +337,18 @@ class RequestPlaneServer:
             logger.exception("handler error on %s", subject)
             if stats:
                 stats.errors_total += 1
+            if isinstance(e, DeadlineExceeded):
+                # machine-readable: the caller re-raises DeadlineExceeded
+                # (not a generic EngineError) so its migration/retry loops
+                # STOP instead of burning another worker slot
+                ctrl = {
+                    "t": T_ERR, "code": ERR_DEADLINE,
+                    "error": f"{type(e).__name__}: {e}",
+                }
+            else:
+                ctrl = {"t": T_ERR, "error": f"{type(e).__name__}: {e}"}
             try:
-                await send({"t": T_ERR, "error": f"{type(e).__name__}: {e}"})
+                await send(ctrl)
             except (ConnectionError, RuntimeError):
                 pass
         finally:
@@ -391,8 +411,25 @@ class RequestPlaneClient:
     def __init__(self, connect_timeout: float = 5.0):
         self._conns: Dict[str, _Connection] = {}
         self._stream_ids = itertools.count(1)
+        # per-address dial serialization.  Entries are PRUNED when the
+        # address's connection dies (recv-loop done-callback below): under
+        # worker churn the router dials a new host:port per replacement,
+        # and a setdefault-only dict would grow one lock per address ever
+        # seen, forever.
         self._conn_locks: Dict[str, asyncio.Lock] = {}
         self.connect_timeout = connect_timeout
+
+    def _evict_conn(self, address: str, conn: _Connection):
+        """The connection's recv loop ended: it can never carry another
+        stream.  Drop it from the pool (identity-checked — a newer dial
+        may already own the slot) and prune the address's dial lock once
+        no dial is in flight."""
+        if self._conns.get(address) is conn:
+            self._conns.pop(address, None)
+        lock = self._conn_locks.get(address)
+        if lock is not None and not lock.locked() \
+                and address not in self._conns:
+            self._conn_locks.pop(address, None)
 
     async def _get_conn(
         self, address: str, deadline: Optional[float] = None
@@ -401,6 +438,19 @@ class RequestPlaneClient:
         if conn is not None and not conn.closed:
             return conn
         lock = self._conn_locks.setdefault(address, asyncio.Lock())
+        try:
+            return await self._dial_locked(address, lock, deadline)
+        except BaseException:
+            # no connection materialized (refused/timed out/black-holed):
+            # a lock kept for an address we never reached is pure growth
+            if address not in self._conns and not lock.locked() \
+                    and self._conn_locks.get(address) is lock:
+                self._conn_locks.pop(address, None)
+            raise
+
+    async def _dial_locked(
+        self, address: str, lock: asyncio.Lock, deadline: Optional[float]
+    ) -> _Connection:
         async with lock:
             conn = self._conns.get(address)
             if conn is not None and not conn.closed:
@@ -430,8 +480,17 @@ class RequestPlaneClient:
                     f"connect to {address} timed out after {timeout:.1f}s"
                 ) from None
             tune_transport(writer)
+            current = self._conns.get(address)
+            if current is not None and not current.closed:
+                # a racing dial through a just-pruned lock won: keep ONE
+                # connection per address, drop ours unused
+                writer.close()
+                return current
             conn = _Connection(reader, writer)
             conn.recv_task = asyncio.create_task(conn.recv_loop())
+            conn.recv_task.add_done_callback(
+                lambda _t, a=address, c=conn: self._evict_conn(a, c)
+            )
             self._conns[address] = conn
             return conn
 
@@ -447,6 +506,7 @@ class RequestPlaneClient:
                 conn.recv_task.cancel()
             conn.writer.close()
         self._conns.clear()
+        self._conn_locks.clear()
 
     async def ping(self, address: str, timeout: float = 5.0) -> float:
         """Transport liveness probe: one ping/pong round-trip on the pooled
@@ -581,10 +641,17 @@ class RequestPlaneClient:
                 elif t == T_DONE:
                     return
                 elif t == T_ERR:
-                    if control.get("code") == DRAINING:
+                    code = control.get("code")
+                    if code == ERR_DRAINING:
                         # a draining worker is connection-level unavailable:
                         # routers and migration retry another instance
                         raise StreamLost(control.get("error", "worker draining"))
+                    if code == ERR_DEADLINE:
+                        # terminal, not retryable: the request's own budget
+                        # ran out worker-side
+                        raise DeadlineExceeded(
+                            control.get("error", "deadline exceeded")
+                        )
                     raise EngineError(control.get("error", "engine error"))
                 elif t == T_LOST:
                     raise StreamLost("connection to worker lost mid-stream")

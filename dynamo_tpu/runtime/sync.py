@@ -1,0 +1,81 @@
+"""Concurrency registry: the single spelling of who guards what.
+
+The serving plane mutates shared state from four kinds of context — the
+asyncio event loop's many tasks, the engine's single-threaded device
+executor (``jax-step``), its host-fetch thread, and the KVBM store path
+that rides the device executor — and the classic failure is not a crash
+but a check-then-act sequence silently torn by an ``await`` or an
+unlocked cross-thread read.  ``GUARDED_STATE`` below is the machine-
+checked table of every attribute whose guard discipline the
+``race-guarded-state`` dynolint rule enforces project-wide, in the same
+single-spelling pattern as ``ENV_REGISTRY`` (config.py), ``FRAME_TAGS``
+(codec.py) and ``KNOWN_FAULT_POINTS`` (faults.py).
+
+Guard grammar (the value string):
+
+  ``lock:<attr>``
+      Every access (read or write) of the attribute inside the owning
+      class happens under ``with self.<attr>`` / ``async with
+      self.<attr>`` on the named lock.  ``__init__`` is exempt
+      (construction precedes sharing).
+
+  ``single-task:<owner>``
+      Mutations are confined to the asyncio task whose body is
+      ``<owner>``: every mutation site must sit in ``<owner>`` or a
+      function (transitively) called from it.  Reads from other tasks
+      are allowed — the event loop makes a sync read atomic — which is
+      exactly why check-then-act ACROSS an await needs the
+      ``race-await-atomicity`` rule instead.
+
+  ``thread:<owner>``
+      Same confinement check, but ``<owner>`` runs on a dedicated
+      non-event-loop thread (the engine's device executor); readers on
+      other threads must take an atomic snapshot (``list(d.items())``)
+      rather than iterate live state.
+
+A registry entry whose class, attribute, guard lock, or owner function
+no longer exists FIRES — the table cannot drift from the code.  The
+table renders into docs/concurrency.md via
+``python -m dynamo_tpu.analysis --emit-sync-docs`` (freshness-tested),
+so the guard conventions future schedulers must land into are readable
+without opening this file.
+"""
+
+from __future__ import annotations
+
+#: "Class.attr" -> guard spec (grammar above).  Keep keys as plain string
+#: literals: the race rules parse this file's AST and never import it.
+GUARDED_STATE = {
+    # KVBM tier state: written on the device-exec thread (write-through
+    # offload), read on the event loop (admission probe) — the lock is
+    # the only thing standing between them.
+    "KvBlockManager.host": "lock:_lock",
+    "KvBlockManager.disk": "lock:_lock",
+    "KvBlockManager.offloaded_blocks": "lock:_lock",
+    "KvBlockManager.onboarded_blocks": "lock:_lock",
+    "KvBlockManager.disk_evictions": "lock:_lock",
+    "KvBlockManager.dropped_blocks": "lock:_lock",
+    # in-flight offload count: bumped on the event loop, dropped in the
+    # executor's done-callback thread.
+    "KvbmConnector._pending": "lock:_pending_lock",
+    # engine decode pipeline: the step-loop task owns the in-flight block
+    # queue and prefill-completion list; ROADMAP item 1's scheduler must
+    # keep mutations inside the step loop (or take over this entry).
+    "JaxEngine._inflight": "single-task:_step_loop",
+    "JaxEngine._pending_prefill": "single-task:_step_loop",
+    "JaxEngine._carry_valid": "single-task:_step_loop",
+    # per-dispatch-type device occupancy: mutated only inside the `timed`
+    # wrapper, which runs on the jax-step device-executor thread; readers
+    # (stats) take a list() snapshot.
+    "JaxEngine._dev_time": "thread:timed",
+    # endpoint instance table: the watch task is the only mutator once
+    # the client is started (static mode carries a reasoned waiver).
+    "Client.instances": "single-task:_watch_loop",
+    # deploy/planner reconcilers: one _PollLoop task per reconciler owns
+    # the failure-backoff and revision bookkeeping end to end.
+    "GraphController._failures": "single-task:reconcile_once",
+    "GraphController._retry_at": "single-task:reconcile_once",
+    "GraphReconciler._applied_base": "single-task:reconcile_once",
+    "GraphReconciler.applied_revision": "single-task:reconcile_once",
+    "OperatorLite.applied_revision": "single-task:reconcile_once",
+}

@@ -72,9 +72,11 @@ class SystemStatusServer:
         return self.host, self.port
 
     async def stop(self) -> None:
-        if self._runner is not None:
-            await self._runner.cleanup()
-            self._runner = None
+        # take-then-act: cleanup() suspends, and a concurrent stop() passing
+        # the None-check during that await would run cleanup twice
+        runner, self._runner = self._runner, None
+        if runner is not None:
+            await runner.cleanup()
 
     async def _health(self, request: web.Request) -> web.Response:
         snap = self.health.snapshot()

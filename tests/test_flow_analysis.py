@@ -461,9 +461,112 @@ def test_frame_protocol_requires_registry_and_suppression(tmp_path):
     assert rule_hits(project, FrameProtocolRule()) == []
 
 
+# --------------------------------------------------------------------- #
+# the wire err-code channel (codec.py ERR_CODES, folded in as one more
+# symmetry-checked channel — drift here is the same silent-hang class
+# PING/PONG was)
+# --------------------------------------------------------------------- #
+
+_ERR_CODEC_FIXTURE = """
+    T_ERR = "err"
+    ERR_DRAINING = "draining"
+
+    FRAME_TAGS = {
+        "t": {
+            T_ERR: "terminal error",
+        },
+    }
+
+    ERR_CODES = {
+        ERR_DRAINING: "worker draining",
+    }
+"""
+
+_ERR_SYMMETRIC_PLANE = """
+    from .codec import T_ERR, ERR_DRAINING
+
+    async def writer(send):
+        await send({"t": T_ERR, "code": ERR_DRAINING, "error": "x"})
+
+    async def reader(control):
+        t = control.get("t")
+        if t == T_ERR:
+            if control.get("code") == ERR_DRAINING:
+                return "retry"
+            return "fail"
+"""
+
+
+def test_err_codes_quiet_on_symmetric_channel(tmp_path):
+    project = make_project(tmp_path, {
+        "dynamo_tpu/runtime/codec.py": _ERR_CODEC_FIXTURE,
+        "dynamo_tpu/runtime/request_plane.py": _ERR_SYMMETRIC_PLANE,
+    })
+    assert rule_hits(project, FrameProtocolRule()) == []
+
+
+def test_err_codes_unconsumed_code_fires(tmp_path):
+    """An emitted code no client dispatches on is the draining-hang
+    class: the worker politely refuses and the router retries nothing."""
+    bad = """
+        from .codec import T_ERR, ERR_DRAINING
+
+        async def writer(send):
+            await send({"t": T_ERR, "code": ERR_DRAINING, "error": "x"})
+
+        async def reader(control):
+            t = control.get("t")
+            if t == T_ERR:
+                return "fail"
+    """
+    project = make_project(tmp_path, {
+        "dynamo_tpu/runtime/codec.py": _ERR_CODEC_FIXTURE,
+        "dynamo_tpu/runtime/request_plane.py": bad,
+    })
+    hits = rule_hits(project, FrameProtocolRule())
+    assert len(hits) == 1
+    assert "'draining'" in hits[0].message and "no consumer" in hits[0].message
+
+
+def test_err_codes_unregistered_and_dead_entry_fire(tmp_path):
+    typo = _ERR_SYMMETRIC_PLANE.replace(
+        '"code": ERR_DRAINING', '"code": "drainign"'
+    )
+    project = make_project(tmp_path, {
+        "dynamo_tpu/runtime/codec.py": _ERR_CODEC_FIXTURE,
+        "dynamo_tpu/runtime/request_plane.py": typo,
+    })
+    msgs = " | ".join(v.message for v in rule_hits(project, FrameProtocolRule()))
+    assert "unregistered 'code' tag 'drainign'" in msgs
+
+    dead = _ERR_CODEC_FIXTURE.replace(
+        'ERR_DRAINING: "worker draining",',
+        'ERR_DRAINING: "worker draining",\n        "zombie": "never wired",',
+    )
+    project = make_project(tmp_path / "dead", {
+        "dynamo_tpu/runtime/codec.py": dead,
+        "dynamo_tpu/runtime/request_plane.py": _ERR_SYMMETRIC_PLANE,
+    })
+    hits = rule_hits(project, FrameProtocolRule())
+    assert len(hits) == 1
+    assert "'zombie'" in hits[0].message
+    assert hits[0].path == "dynamo_tpu/runtime/codec.py"
+
+
+def test_real_tree_err_codes_registered():
+    """The registered codes are the ones the plane really speaks —
+    constants, registry, and the client dispatch arms all exist."""
+    from dynamo_tpu.runtime import codec
+
+    assert codec.ERR_CODES.keys() == {codec.ERR_DRAINING, codec.ERR_DEADLINE}
+    assert codec.ERR_DRAINING == "draining" and codec.ERR_DEADLINE == "deadline"
+
+
 # every consumer dispatch arm of the real tree, with the swap that
 # removes it while keeping the channel fully resolvable
 _REAL_ARMS = [
+    ("dynamo_tpu/runtime/request_plane.py", "if code == ERR_DRAINING:", "if code == ERR_DEADLINE:", "draining"),
+    ("dynamo_tpu/runtime/request_plane.py", "if code == ERR_DEADLINE:", "if code == ERR_DRAINING:", "deadline"),
     ("dynamo_tpu/runtime/request_plane.py", "if t == T_REQ:", "if t == T_CANCEL:", "req"),
     ("dynamo_tpu/runtime/request_plane.py", "elif t == T_CANCEL:", "elif t == T_PING:", "cancel"),
     ("dynamo_tpu/runtime/request_plane.py", "elif t == T_PING:", "elif t == T_CANCEL:", "ping"),

@@ -310,6 +310,55 @@ class TestOperatorPipeline:
         assert retry.attempts == 1
 
 
+def test_conn_locks_pruned_with_connections():
+    """Regression: `RequestPlaneClient._conn_locks` grew one lock per
+    address ever dialed, forever (setdefault, never pruned). Under worker
+    churn every replacement instance brings a fresh host:port, so the
+    dict must shrink when a connection dies — and a failed dial must not
+    leave a lock behind either."""
+    from dynamo_tpu.runtime.request_plane import (
+        RequestPlaneClient,
+        RequestPlaneServer,
+    )
+
+    async def main():
+        srv = RequestPlaneServer()
+        host, port = await srv.start()
+        addr = f"{host}:{port}"
+        cli = RequestPlaneClient(connect_timeout=0.5)
+        try:
+            await cli.ping(addr)
+            assert addr in cli._conns and addr in cli._conn_locks
+
+            # server dies -> recv loop ends -> both pool and lock pruned
+            await srv.stop()
+            for _ in range(100):
+                if addr not in cli._conn_locks and addr not in cli._conns:
+                    break
+                await asyncio.sleep(0.02)
+            assert addr not in cli._conns
+            assert addr not in cli._conn_locks
+
+            # refused dial: no connection, and no lock kept for it
+            with pytest.raises(StreamLost):
+                await cli.ping(addr, timeout=0.5)
+            assert addr not in cli._conn_locks
+
+            # close() leaves nothing behind even with a live entry
+            srv2 = RequestPlaneServer()
+            host2, port2 = await srv2.start()
+            addr2 = f"{host2}:{port2}"
+            await cli.ping(addr2)
+            assert addr2 in cli._conn_locks
+            await cli.close()
+            assert cli._conn_locks == {} and cli._conns == {}
+            await srv2.stop()
+        finally:
+            await cli.close()
+
+    asyncio.run(main())
+
+
 def test_request_plane_ping_pong_roundtrip():
     """Transport liveness probe: ping answers pong with the stream id
     echoed (the flow-frame-protocol symmetry contract), and a dead peer
