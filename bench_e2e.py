@@ -236,7 +236,10 @@ def launch(mode: str, model: str, *, cpu: bool, num_workers: int = 2,
            num_pages: Optional[int] = None, max_num_seqs: int = 64,
            disagg_threshold: int = 64, log_dir: str = "/tmp",
            router_override: Optional[str] = None,
-           quantize: Optional[str] = None) -> Deployment:
+           quantize: Optional[str] = None,
+           sched_policy: Optional[str] = None,
+           ttft_slo_ms: Optional[float] = None,
+           itl_slo_ms: Optional[float] = None) -> Deployment:
     """Spawn discovery + frontend + workers (real processes, real sockets) —
     the same wiring a production deployment uses, per
     jax_worker/__main__.py + frontend/__main__.py."""
@@ -251,6 +254,14 @@ def launch(mode: str, model: str, *, cpu: bool, num_workers: int = 2,
     http_port = free_port()
     disc = f"127.0.0.1:{disc_port}"
     env = {"DYN_DISCOVERY_ENDPOINT": disc}
+    # dynosched knobs ride the env so every worker role (and a disagg
+    # decode worker's router) sees the same policy/targets
+    if sched_policy:
+        env["DYN_SCHED_POLICY"] = sched_policy
+    if ttft_slo_ms is not None:
+        env["DYN_SLA_TTFT_MS"] = str(ttft_slo_ms)
+    if itl_slo_ms is not None:
+        env["DYN_SLA_ITL_MS"] = str(itl_slo_ms)
 
     d = ManagedProcess(
         ["-m", "dynamo_tpu.runtime.discovery", "--host", "127.0.0.1",
@@ -421,6 +432,34 @@ def percentile(xs: List[float], p: float) -> float:
     return xs[k]
 
 
+def sla_fields(results: List[RequestResult], ttft_slo_ms: float,
+               itl_slo_ms: float, wall: float) -> dict:
+    """SLA-attainment block: the fraction of successful requests meeting
+    each target, plus goodput (output tok/s counting ONLY requests that
+    met every set target — the number an SLA-priced deployment actually
+    sells). Failed requests count as misses by construction."""
+    ok = [r for r in results if r.ok]
+    n_all = max(len(results), 1)
+    ttft_met = [r for r in ok if (r.t_first - r.t_send) * 1000 <= ttft_slo_ms]
+    out = {
+        "ttft_target_ms": ttft_slo_ms,
+        "ttft_attainment": round(len(ttft_met) / n_all, 3),
+    }
+    good = ttft_met
+    if itl_slo_ms:
+        itl_met = [
+            r for r in ok
+            if r.osl <= 1
+            or (r.t_last - r.t_first) / (r.osl - 1) * 1000 <= itl_slo_ms
+        ]
+        out["itl_target_ms"] = itl_slo_ms
+        out["itl_attainment"] = round(len(itl_met) / n_all, 3)
+        met_ids = set(id(r) for r in itl_met)
+        good = [r for r in ttft_met if id(r) in met_ids]
+    out["goodput_tok_s"] = round(sum(r.osl for r in good) / wall, 1)
+    return out
+
+
 def summarize(results: List[RequestResult], wall: float, mode: str, qps: float,
               model: str) -> dict:
     ok = [r for r in results if r.ok]
@@ -495,6 +534,22 @@ def main(argv: Optional[List[str]] = None):
                     help="replay the trace N× faster than recorded")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--startup-timeout", type=float, default=None)
+    # dynosched (engine/scheduler/): worker scheduling policy + the SLA
+    # targets both the workers optimize for and the report grades against
+    ap.add_argument("--sched-policy", choices=["fifo", "sla"], default=None,
+                    help="worker step-scheduling policy (DYN_SCHED_POLICY); "
+                    "default: workers' own env/default (fifo)")
+    ap.add_argument("--ttft-slo-ms", type=float, default=2000.0,
+                    help="TTFT target: fed to workers as DYN_SLA_TTFT_MS "
+                    "and used for the attainment report")
+    ap.add_argument("--itl-slo-ms", type=float, default=100.0,
+                    help="ITL target: fed to workers as DYN_SLA_ITL_MS and "
+                    "used for the attainment report (0 = off)")
+    ap.add_argument("--sla-compare", action="store_true",
+                    help="run the identical trace twice — workers under "
+                    "DYN_SCHED_POLICY=fifo then =sla — and report TTFT/"
+                    "tok-s/attainment side by side (the scheduler-benefit "
+                    "oracle, reference: --router-compare)")
     ap.add_argument("--quantize", choices=["int8"], default=None,
                     help="worker weight quantization (models/quant.py)")
     ap.add_argument("--router-compare", action="store_true",
@@ -549,11 +604,13 @@ def main(argv: Optional[List[str]] = None):
         file=sys.stderr,
     )
 
-    def run_arm(router_override=None):
+    def run_arm(router_override=None, sched_policy=None):
         """One deployment + trace run; returns (summary, prefix_hit_blocks)."""
         dep = launch(args.mode, model, cpu=cpu, num_workers=args.num_workers,
                      num_pages=args.num_pages,
-                     router_override=router_override, quantize=args.quantize)
+                     router_override=router_override, quantize=args.quantize,
+                     sched_policy=sched_policy or args.sched_policy,
+                     ttft_slo_ms=args.ttft_slo_ms, itl_slo_ms=args.itl_slo_ms)
         hits = 0
         dispatch = {}
         n_reporting = 0
@@ -615,6 +672,9 @@ def main(argv: Optional[List[str]] = None):
         finally:
             dep.stop()
         summary = summarize(results, wall, args.mode, qps, model)
+        summary["sla"] = sla_fields(
+            results, args.ttft_slo_ms, args.itl_slo_ms, wall
+        )
         if dispatch:
             # fetch runs on its own thread and overlaps compute — not part
             # of device-stream occupancy. Seconds are summed across
@@ -631,6 +691,43 @@ def main(argv: Optional[List[str]] = None):
 
     if args.router_compare and args.mode != "kv":
         ap.error("--router-compare requires --mode kv")
+    if args.sla_compare and args.router_compare:
+        ap.error("--sla-compare and --router-compare are mutually exclusive")
+
+    if args.sla_compare:
+        # identical trace, fresh identical deployments: fifo arm then sla
+        # arm — the scheduler-benefit oracle (acceptance: TTFT improves,
+        # decode tok/s stays within 5%)
+        fifo_summary, _ = run_arm(sched_policy="fifo")
+        sla_summary, _ = run_arm(sched_policy="sla")
+
+        def _arm(s):
+            return {
+                "output_tok_s": s["output_tok_s"],
+                "ttft_p50_ms": s["ttft_ms"]["p50"],
+                "ttft_p99_ms": s["ttft_ms"]["p99"],
+                "itl_p50_ms": s["itl_ms"]["p50"],
+                "itl_p99_ms": s["itl_ms"]["p99"],
+                "sla": s["sla"],
+                "failed": s["failed"],
+            }
+
+        benefit = {
+            "metric": f"e2e_sla_compare_{args.mode}_{model}_qps{qps:g}",
+            "value": round(
+                fifo_summary["ttft_ms"]["p50"] - sla_summary["ttft_ms"]["p50"],
+                1,
+            ),
+            "unit": "ms_ttft_p50_saved",
+            "vs_baseline": None,
+            "ttft_slo_ms": args.ttft_slo_ms,
+            "itl_slo_ms": args.itl_slo_ms,
+            "fifo": _arm(fifo_summary),
+            "sla": _arm(sla_summary),
+        }
+        print(json.dumps(benefit))
+        return 0 if not (fifo_summary["failed"] or sla_summary["failed"]) else 1
+
     summary, kv_hits = run_arm()
 
     if args.router_compare and args.mode == "kv":
@@ -678,6 +775,8 @@ def main(argv: Optional[List[str]] = None):
         "itl_p50_ms": summary["itl_ms"]["p50"],
         "itl_p99_ms": summary["itl_ms"]["p99"],
         "failed": summary["failed"],
+        "sla": summary["sla"],
+        **({"sched_policy": args.sched_policy} if args.sched_policy else {}),
         **(efficiency_fields(
             model, summary["output_tok_s"], eff_batch,
             args.isl_mean + args.osl_mean / 2, args.quantize,

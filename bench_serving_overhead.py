@@ -128,7 +128,7 @@ def scrape_tokens_per_frame(metrics_text: str) -> float | None:
     return None
 
 
-async def run_bench(args) -> dict:
+async def run_bench(args, extra_env=None) -> dict:
     import aiohttp
 
     http_port = free_port()
@@ -151,7 +151,8 @@ async def run_bench(args) -> dict:
                 # serving plane); a small coalesce window is what turns its
                 # singleton emissions into multi-item frames — the real
                 # engine's K-step blocks batch with the window at 0
-                env={"DYN_STREAM_COALESCE_MS": str(args.coalesce_ms)},
+                env={"DYN_STREAM_COALESCE_MS": str(args.coalesce_ms),
+                     **(extra_env or {})},
             )
         )
     base = f"http://127.0.0.1:{http_port}"
@@ -228,7 +229,65 @@ def main():
                     "averaged <= 1 token per frame")
     ap.add_argument("--min-tok-s", type=float, default=300.0,
                     help="generous non-regression floor for --smoke")
+    # SLA-attainment smoke (engine/scheduler/): the same load twice —
+    # workers under DYN_SCHED_POLICY=fifo then =sla — gating that the sla
+    # policy holds TTFT p99 under a generous floor without giving up
+    # throughput (catches deferral runaway / EDF starvation regressions)
+    ap.add_argument("--sla-smoke", action="store_true",
+                    help="CI gate: run fifo and sla arms; exit 1 if the "
+                    "sla arm's TTFT p99 exceeds --sla-ttft-p99-floor or "
+                    "its tok/s drops below --sla-tok-frac of the fifo arm")
+    ap.add_argument("--sla-ttft-ms", type=float, default=1500.0,
+                    help="DYN_SLA_TTFT_MS for the sla arm")
+    ap.add_argument("--sla-itl-ms", type=float, default=50.0,
+                    help="DYN_SLA_ITL_MS for the sla arm")
+    ap.add_argument("--sla-ttft-p99-floor", type=float, default=3.0,
+                    help="generous TTFT p99 ceiling (seconds) for the sla "
+                    "arm")
+    ap.add_argument("--sla-tok-frac", type=float, default=0.85,
+                    help="sla arm tok/s must stay above this fraction of "
+                    "the fifo arm")
     args = ap.parse_args()
+
+    if args.sla_smoke:
+        def _arms():
+            fifo = asyncio.run(run_bench(args, {"DYN_SCHED_POLICY": "fifo"}))
+            sla = asyncio.run(run_bench(args, {
+                "DYN_SCHED_POLICY": "sla",
+                "DYN_SLA_TTFT_MS": str(args.sla_ttft_ms),
+                "DYN_SLA_ITL_MS": str(args.sla_itl_ms),
+            }))
+            return fifo, sla
+
+        def _ratio(fifo, sla):
+            return (sla["tok_s"] or 0) / max(fifo["tok_s"] or 1e-9, 1e-9)
+
+        fifo, sla = _arms()
+        if _ratio(fifo, sla) < args.sla_tok_frac:
+            # the arms run sequentially, so a noisy ambient-load window
+            # during one arm skews the ratio — retry once and keep the
+            # better pair; a real policy regression fails both rounds
+            print("sla/fifo tok-s ratio below gate; retrying once "
+                  "(ambient-load protection)", file=sys.stderr)
+            fifo2, sla2 = _arms()
+            if _ratio(fifo2, sla2) > _ratio(fifo, sla):
+                fifo, sla = fifo2, sla2
+        print(json.dumps({"fifo": fifo, "sla": sla}, indent=2))
+        ok = True
+        if (sla["ttft_p99_s"] or 1e9) > args.sla_ttft_p99_floor:
+            print(
+                f"SLA SMOKE FAIL: sla TTFT p99 {sla['ttft_p99_s']}s > "
+                f"floor {args.sla_ttft_p99_floor}s", file=sys.stderr,
+            )
+            ok = False
+        if (sla["tok_s"] or 0) < args.sla_tok_frac * (fifo["tok_s"] or 0):
+            print(
+                f"SLA SMOKE FAIL: sla {sla['tok_s']} tok/s < "
+                f"{args.sla_tok_frac} x fifo {fifo['tok_s']} tok/s",
+                file=sys.stderr,
+            )
+            ok = False
+        sys.exit(0 if ok else 1)
 
     out = asyncio.run(run_bench(args))
     print(json.dumps(out, indent=2))

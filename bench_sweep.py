@@ -60,6 +60,86 @@ def run_cfg(pool_mode: str, unroll: int, num_pages: int, *, batch: int,
     return out
 
 
+def run_sla_cfg(qps: float, ttft_ms: float, itl_ms: float, *, smoke: bool,
+                requests: int, timeout: float) -> dict:
+    """One point on the SLA frontier: bench_e2e with the sla policy at
+    (ttft, itl) targets and the given qps; rows carry attainment +
+    throughput so BENCH_NOTES can chart the frontier."""
+    cmd = [
+        sys.executable, str(REPO / "bench_e2e.py"),
+        *(["--smoke"] if smoke else []),
+        "--qps", str(qps), "--requests", str(requests),
+        "--sched-policy", "sla",
+        "--ttft-slo-ms", str(ttft_ms), "--itl-slo-ms", str(itl_ms),
+    ]
+    out = {"qps": qps, "ttft_target_ms": ttft_ms, "itl_target_ms": itl_ms}
+    t0 = time.time()
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        out["error"] = "timeout"
+        return out
+    line = None
+    for ln in (r.stdout or "").splitlines():
+        ln = ln.strip()
+        if ln.startswith("{"):
+            line = json.loads(ln)
+    out["wall_s"] = round(time.time() - t0, 1)
+    if line is None:
+        out["error"] = (r.stderr or "")[-400:] or f"rc={r.returncode}"
+        return out
+    out["output_tok_s"] = line.get("value")
+    out["ttft_p50_ms"] = line.get("ttft_p50_ms")
+    out["ttft_p99_ms"] = line.get("ttft_p99_ms")
+    out["itl_p50_ms"] = line.get("itl_p50_ms")
+    out["failed"] = line.get("failed")
+    sla = line.get("sla") or {}
+    out["ttft_attainment"] = sla.get("ttft_attainment")
+    out["itl_attainment"] = sla.get("itl_attainment")
+    out["goodput_tok_s"] = sla.get("goodput_tok_s")
+    return out
+
+
+def sla_sweep(args) -> int:
+    """--sla axis: ttft/itl targets x qps -> attainment/throughput
+    frontier (CPU-mocker-scale by default via --smoke-scale)."""
+    if args.quick:
+        qps_axis = [4.0, 8.0]
+        targets = [(1000.0, 50.0), (2000.0, 100.0)]
+    else:
+        qps_axis = [2.0, 4.0, 8.0]
+        targets = [(500.0, 25.0), (1000.0, 50.0), (2000.0, 100.0)]
+    results = []
+    for qps in qps_axis:
+        for ttft_ms, itl_ms in targets:
+            res = run_sla_cfg(
+                qps, ttft_ms, itl_ms, smoke=args.smoke_scale,
+                requests=args.requests, timeout=args.timeout,
+            )
+            results.append(res)
+            print(json.dumps(res), flush=True)
+    # frontier summary: per qps, the tightest target still attaining >=0.9
+    summary = {}
+    for qps in qps_axis:
+        clean = [
+            r for r in results
+            if r["qps"] == qps and (r.get("ttft_attainment") or 0) >= 0.9
+        ]
+        if clean:
+            best = min(clean, key=lambda r: r["ttft_target_ms"])
+            summary[str(qps)] = {
+                "tightest_ttft_ms": best["ttft_target_ms"],
+                "ttft_attainment": best["ttft_attainment"],
+                "output_tok_s": best["output_tok_s"],
+                "goodput_tok_s": best["goodput_tok_s"],
+            }
+    print(json.dumps({"sla_sweep_summary": summary}), flush=True)
+    if args.out:
+        Path(args.out).write_text(json.dumps(
+            {"results": results, "summary": summary}, indent=2))
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description="decode KV-write strategy sweep")
     ap.add_argument("--quick", action="store_true",
@@ -69,7 +149,21 @@ def main(argv=None):
     ap.add_argument("--timeout", type=float, default=900.0,
                     help="per-configuration budget (first runs pay compiles)")
     ap.add_argument("--out", default=None, help="also write results to this file")
+    ap.add_argument("--sla", action="store_true",
+                    help="sweep the SLA frontier instead: ttft/itl targets "
+                    "x qps through bench_e2e --sched-policy sla "
+                    "(attainment + throughput per point)")
+    ap.add_argument("--smoke-scale", action="store_true", default=True,
+                    help="--sla: run bench_e2e at --smoke scale (CPU, tiny "
+                    "model); use --no-smoke-scale on hardware")
+    ap.add_argument("--no-smoke-scale", dest="smoke_scale",
+                    action="store_false")
+    ap.add_argument("--requests", type=int, default=32,
+                    help="--sla: requests per point")
     args = ap.parse_args(argv)
+
+    if args.sla:
+        return sla_sweep(args)
 
     pools = [1024, 2048] if args.quick else [392, 1024, 2048]
     configs = []

@@ -1,4 +1,21 @@
-from .config import EngineConfig
-from .engine import JaxEngine
+"""Engine package.
+
+Lazy exports (PEP 562): `engine.py` imports jax at module scope, but the
+`engine.scheduler` subpackage is pure host-side policy code that the CPU
+mocker worker also uses — importing it must not drag jax (and its seconds
+of import time) into jax-free processes.
+"""
 
 __all__ = ["EngineConfig", "JaxEngine"]
+
+
+def __getattr__(name):
+    if name == "EngineConfig":
+        from .config import EngineConfig
+
+        return EngineConfig
+    if name == "JaxEngine":
+        from .engine import JaxEngine
+
+        return JaxEngine
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -81,6 +81,15 @@ class EngineConfig:
     # scheduling
     max_queue: int = 4096
     decode_batch_wait_s: float = 0.0  # wait to fill decode batch (0 = greedy)
+    # SLA-aware step scheduling (engine/scheduler/, docs/scheduler.md).
+    # None = resolve from the DYN_SCHED_POLICY / DYN_SLA_TTFT_MS /
+    # DYN_SLA_ITL_MS env knobs; "fifo" preserves the legacy admit-order
+    # dispatch bit-for-bit (sole exception: the batch-kind anti-starvation
+    # guard, a fairness bug fix active under both policies), "sla" enables
+    # the EDF + ITL-budget StepPlanner.
+    sched_policy: Optional[str] = None
+    ttft_target_ms: Optional[float] = None
+    itl_target_ms: Optional[float] = None
     # KVBM tiers (kvbm/manager.py); 0 disables a tier
     kvbm_host_blocks: int = 0
     kvbm_disk_blocks: int = 0

@@ -50,6 +50,7 @@ from ..runtime.engine import Context
 from .config import EngineConfig
 from .kv_cache import PageAllocator, alloc_kv_arrays
 from .sampling import SamplingParams, penalized, sample, sample_lp, unpack_mask
+from .scheduler import SlaConfig, StepPlanner
 
 logger = logging.getLogger(__name__)
 
@@ -230,6 +231,14 @@ class _Slot:
     frequency_penalty: float = 0.0
     repetition_penalty: float = 1.0
     want_top_logprobs: int = 0  # top-k alternatives per token (max 5)
+    # dynosched (engine/scheduler/): SLA bookkeeping. priority scales the
+    # TTFT target (each +1 halves it); sched_deadline is the EDF key;
+    # sched_skips counts dispatches this candidate was passed over (the
+    # starvation guard's aging signal) and resets on every granted chunk.
+    priority: int = 0
+    arrival_s: float = 0.0
+    sched_deadline: float = 0.0
+    sched_skips: int = 0
 
 
 class JaxEngine:
@@ -384,6 +393,21 @@ class JaxEngine:
         # (_try_skip_ahead; admission-time hits count in the allocator)
         self.prefix_skip_ahead_blocks = 0
         self._admit_counter = 0
+        # dynosched (engine/scheduler/): the StepPlanner owns prefill
+        # ordering and chunk budgeting; policy "fifo" (the default)
+        # reproduces the legacy admit-order dispatch bit-for-bit (modulo
+        # the batch-kind anti-starvation fairness fix, active under both
+        # policies), "sla" spends explicit TTFT/ITL targets
+        # (docs/scheduler.md). Its cost
+        # model is fed by the _timed dispatch instrumentation below.
+        self.scheduler = StepPlanner(
+            config,
+            SlaConfig.from_env(
+                policy=config.sched_policy,
+                ttft_target_ms=config.ttft_target_ms,
+                itl_target_ms=config.itl_target_ms,
+            ),
+        )
         # speculative decoding (engine/spec.py): host mirror of the device
         # history ring + SpecDecodeStats counters (_core.pyi:269-301 role)
         self.hist = (
@@ -1245,6 +1269,9 @@ class JaxEngine:
                 self.lora_requests += 1
         if len(slot.prompt) + slot.max_tokens > self.config.max_model_len:
             slot.max_tokens = max(self.config.max_model_len - len(slot.prompt), 1)
+        slot.priority = int(req.priority or 0)
+        slot.arrival_s = time.monotonic()
+        self.scheduler.assign_deadline(slot)
         return slot
 
     async def generate(self, request: Any, context: Context) -> AsyncIterator[dict]:
@@ -1398,6 +1425,15 @@ class JaxEngine:
         out["kv_skip_ahead_blocks"] = self.prefix_skip_ahead_blocks
         out["emit_batches"] = self.emit_batches
         out["emit_tokens"] = self.emit_tokens
+        # dynosched: policy/targets, per-step decision counters, and the
+        # queue/deadline view (published on the worker metrics topic, so
+        # disagg decode workers and the planner see prefill-pool pressure)
+        out.update(self.scheduler.stats())
+        est = self.estimated_prefill_wait_ms()
+        out["sched_est_ttft_ms"] = round(est, 1) if est is not None else 0.0
+        recent = self.scheduler.recent_decisions()
+        if recent:
+            out["sched_last_decision"] = recent[-1]
         # list() is one atomic C-level snapshot: the jax-step thread keeps
         # inserting while we iterate (GUARDED_STATE: thread-confined)
         for tag, (cnt, tot) in list(self._dev_time.items()):
@@ -1416,6 +1452,24 @@ class JaxEngine:
                 if self.spec_num_drafts else 0.0
             )
         return out
+
+    def estimated_prefill_wait_ms(self, n_new_tokens: int = 0) -> Optional[float]:
+        """Estimated local TTFT contribution of this engine's prefill
+        queue for a hypothetical `n_new_tokens`-token arrival: (tokens
+        still to prefill across admitted + waiting slots + the new
+        prompt) x the cost model's observed per-token prefill rate.
+        None until the model has seen a prefill (cold start) — callers
+        (DisaggregatedRouter) fall back to the static threshold rule."""
+        pending = int(n_new_tokens)
+        for s in self.slots:
+            if (
+                s is not None and not s.done
+                and s.preloaded is None and s.onboard is None
+            ):
+                pending += max(len(s.kv_prompt) - s.prefill_pos, 0)
+        for s in self._waiting:
+            pending += len(s.prompt)
+        return self.scheduler.estimate_wait_ms(pending)
 
     # ------------------------------------------------------------------ #
     # step loop
@@ -1468,7 +1522,10 @@ class JaxEngine:
 
     def _admit_waiting(self):
         still: List[_Slot] = []
-        for slot in self._waiting:
+        # sla policy: admit earliest-TTFT-deadline first (preempted victims
+        # keep their original arrival, so they stay at the front exactly as
+        # the legacy insert-at-0 intended); fifo: arrival order untouched
+        for slot in self.scheduler.order_waiting(self._waiting):
             if slot.done or slot.context.is_stopped():
                 self._emit_finish(slot, "cancelled")
                 continue
@@ -1557,12 +1614,16 @@ class JaxEngine:
         self.repetition[idx] = slot.repetition_penalty
         self._fill_recent(idx, slot)
         slot.admit_seq = self._admit_counter = self._admit_counter + 1
+        self.scheduler.on_admit(slot)
         return True
 
     # -- device helpers -------------------------------------------------- #
 
-    def _timed(self, fn, tag: str):
-        """Wrap fn so its wall time accrues to self._dev_time[tag]."""
+    def _timed(self, fn, tag: str, shape: Optional[tuple] = None):
+        """Wrap fn so its wall time accrues to self._dev_time[tag] (and,
+        when `shape`=(bucket, lanes) is given, feeds the scheduler's
+        per-shape cost model — the EWMA behind ITL budgeting and the
+        disagg router's local-TTFT estimate)."""
         def timed(*a):
             t0 = time.perf_counter()
             try:
@@ -1571,11 +1632,14 @@ class JaxEngine:
                 dt = time.perf_counter() - t0
                 cnt, tot = self._dev_time.get(tag, (0, 0.0))
                 self._dev_time[tag] = (cnt + 1, tot + dt)
+                if shape is not None:
+                    self.scheduler.cost.observe(tag, shape[0], shape[1], dt)
         return timed
 
-    async def _run_on_device(self, fn, *args, tag: str = None):
+    async def _run_on_device(self, fn, *args, tag: str = None,
+                             shape: Optional[tuple] = None):
         if tag is not None:
-            fn = self._timed(fn, tag)
+            fn = self._timed(fn, tag, shape)
         return await asyncio.get_running_loop().run_in_executor(
             self._device_exec, fn, *args
         )
@@ -2317,12 +2381,6 @@ class JaxEngine:
 
     # -- batched chunked prefill ----------------------------------------- #
 
-    def _bucket_for(self, n: int) -> int:
-        for b in self.config.prefill_buckets:
-            if n <= b:
-                return b
-        return self.config.prefill_buckets[-1]
-
     def _try_skip_ahead(self, s: _Slot) -> None:
         """Late-binding prefix reuse: blocks committed SINCE this slot was
         admitted (by a concurrent same-prefix request, possibly via the
@@ -2382,12 +2440,18 @@ class JaxEngine:
             cands.append(s)
         if not cands:
             return False
-        cands.sort(key=lambda s: s.admit_seq)
+        # dynosched: candidate order is the planner's call — fifo is the
+        # legacy admit_seq sort bit-for-bit, sla is EDF over TTFT deadlines
+        # with a starvation guard (docs/scheduler.md)
+        cands = self.scheduler.order(cands)
         # guided / multimodal / LoRA slots ride different dispatch variants
         # (mask vs embedding splice vs adapter stack) and never share a
         # prefill batch with each OTHER; plain slots batch with any single
         # kind (they are exact no-ops under mask=all-true or adapter 0).
-        # The excluded kind simply waits for the next dispatch.
+        # The excluded kind waits for a later dispatch — the planner's aging
+        # tiebreak bounds that wait (a kind skipped starve_dispatches times
+        # wins the batch outright, so no kind starves under a steady stream
+        # of another kind).
         def _kind(s):
             if s.mm is not None:
                 return "mm"
@@ -2397,11 +2461,13 @@ class JaxEngine:
                 return "lora"
             return "plain"
 
-        batch_kind = next(
-            (k for k in map(_kind, cands) if k != "plain"), "plain"
-        )
+        batch_kind = self.scheduler.pick_batch_kind(cands, _kind)
         if batch_kind != "plain":
-            cands = [s for s in cands if _kind(s) in ("plain", batch_kind)]
+            excluded = [s for s in cands if _kind(s) not in ("plain", batch_kind)]
+            if excluded:
+                for s in excluded:
+                    s.sched_skips += 1
+                cands = [s for s in cands if _kind(s) in ("plain", batch_kind)]
 
         if self._prefill_single is not None:
             s0 = cands[0]
@@ -2418,19 +2484,33 @@ class JaxEngine:
             if use_single:
                 await self._dispatch_prefill_one(s0)
                 return True
-        first_chunk = min(
-            len(cands[0].kv_prompt) - cands[0].prefill_pos, cfg.max_prefill_chunk
-        )
-        bucket = self._bucket_for(first_chunk)
-        lanes_cap = max(1, min(cfg.prefill_batch_tokens // bucket, cfg.max_prefill_batch))
         # two lane variants per bucket — 1 (the lone-arrival TTFT case:
         # padding one request to the full lane budget multiplies its
         # prefill FLOPs by the budget) and the cap (batch case). Exactly
         # two keeps the lazily-compiled shape set small: every new shape
         # costs a multi-second XLA compile ON the serving path the first
         # time it occurs (persistent cache amortizes across restarts).
-        lanes = 1 if len(cands) == 1 else lanes_cap
-        chosen = cands[:lanes]
+        # The planner chooses WITHIN that bounded shape space: fifo
+        # reproduces the legacy head-candidate formula exactly; sla scores
+        # shapes by slots-served/tokens-granted under the ITL budget and
+        # may defer the dispatch entirely to protect decode cadence.
+        has_decode = any(
+            s is not None and s.generated > 0 and s.resume_token is None
+            and s.prefill_pos >= len(s.kv_prompt)
+            for s in self.slots
+        )
+        plan = self.scheduler.plan_prefill(cands, decode_active=has_decode)
+        if plan is None:
+            # ITL budget exhausted and no deadline at risk: prefill yields
+            # this step; skipped candidates age toward the starvation guard
+            for s in cands:
+                s.sched_skips += 1
+            return False
+        bucket = plan.bucket
+        lanes = plan.lanes
+        chosen = plan.chosen
+        for s in cands[len(chosen):]:
+            s.sched_skips += 1
         B_pf = lanes
 
         # shared context-bounded table: pow2 pages covering the largest
@@ -2476,6 +2556,7 @@ class JaxEngine:
             pens[lane] = (s.presence_penalty, s.frequency_penalty,
                           s.repetition_penalty)
             pen_rows[lane] = self.recent[s.slot_idx]
+            s.sched_skips = 0  # granted a chunk: starvation clock restarts
             meta.append((s, chunk, lane))
 
         if any(s.mm for s in chosen):
@@ -2510,7 +2591,7 @@ class JaxEngine:
                     temps, top_ks, top_ps, seeds, pens, pen_rows,
                     emb, emb_mask,
                 ),
-                tag="prefill",
+                tag="prefill", shape=(bucket, B_pf),
             )
         elif any(s.guided_fsm is not None for s in chosen):
             # masked first-token sampling: guided lanes constrain the first
@@ -2537,7 +2618,7 @@ class JaxEngine:
                     toks, positions, tables, ctx_lens, last_idx,
                     temps, top_ks, top_ps, seeds, pens, pen_rows, mask,
                 ),
-                tag="prefill",
+                tag="prefill", shape=(bucket, B_pf),
             )
         elif any(s.lora_idx for s in chosen):
             lane_idx = np.zeros((B_pf,), np.int32)
@@ -2558,7 +2639,7 @@ class JaxEngine:
                     toks, positions, tables, ctx_lens, last_idx,
                     temps, top_ks, top_ps, seeds, pens, pen_rows, lane_idx,
                 ),
-                tag="prefill",
+                tag="prefill", shape=(bucket, B_pf),
             )
         else:
             self._bcast(
@@ -2576,7 +2657,7 @@ class JaxEngine:
                     toks, positions, tables, ctx_lens, last_idx, temps,
                     top_ks, top_ps, seeds, pens, pen_rows,
                 ),
-                tag="prefill",
+                tag="prefill", shape=(bucket, B_pf),
             )
         completions = []
         progressed = []
@@ -2630,7 +2711,7 @@ class JaxEngine:
         first_dev = await self._run_on_device(
             partial(self._dev_prefill_single, toks, table, ctx, real, temps,
                     top_ks, top_ps, seeds, pens, pen_rows),
-            tag="prefill",
+            tag="prefill", shape=(T_pad, 1),
         )
         slot.prefill_pos += chunk
         self._pending_prefill.append({"first": first_dev, "done": [(slot, 0)]})
@@ -3171,19 +3252,22 @@ class JaxEngine:
             self._bcast("block_guided", payload)
             toks_dev = await self._run_on_device(
                 partial(self._dev_block_guided, packed, lora_idx),
-                tag="block_guided",
+                tag="block_guided", shape=(1, B),
             )
             adv = 1
         elif any(self.slots[i].lora_idx for i in active):
             idx = self.lora_idx.copy()
             self._bcast("block_lora", {"idx": idx})
             toks_dev = await self._run_on_device(
-                partial(self._dev_block_lora, idx), tag="block_lora"
+                partial(self._dev_block_lora, idx), tag="block_lora",
+                shape=(K, B),
             )
             adv = cfg.block_advance
         else:
             self._bcast("block", {})
-            toks_dev = await self._run_on_device(self._dev_block, tag="block")
+            toks_dev = await self._run_on_device(
+                self._dev_block, tag="block", shape=(K, B)
+            )
             adv = cfg.block_advance
         entry = {"lanes": [(i, self.slots[i]) for i in active], "toks": toks_dev}
         if cfg.spec_mode:
@@ -3361,6 +3445,9 @@ class JaxEngine:
         self._carry_valid = False
         self._dirty_lanes.clear()
         self._dirty_tables.clear()
+        # no deadline may outlive its slot (chaos contract: an engine.step
+        # fault mid-schedule leaves no orphaned scheduler state)
+        self.scheduler.reset()
         for slot in list(self.slots):
             if slot is not None:
                 if not slot.done:
@@ -3434,6 +3521,7 @@ class JaxEngine:
 
     def _release_slot(self, slot: _Slot):
         if slot.slot_idx >= 0 and self.slots[slot.slot_idx] is slot:
+            self.scheduler.on_release(slot)
             # commit any full generated blocks before release so decode KV is
             # reusable (conversation prefix reuse / cheap preemption resume)
             self._commit_generated_blocks(slot)

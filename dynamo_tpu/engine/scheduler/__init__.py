@@ -1,0 +1,20 @@
+"""dynosched: the SLA-aware prefill/decode scheduler subsystem.
+
+Owns every "what runs this step" decision the engine step loop used to
+hardcode (ROADMAP item 1): which waiting/partial slots get prefill chunks,
+how large the chunk budget is, and whether prefill defers to protect the
+decode ITL budget. The same policy state drives conditional disaggregation
+(llm/disagg.py consults the planner's estimated local TTFT) and the
+planner's queue/deadline stats ride the worker metrics topic.
+
+Pure host-side policy code — no jax imports — so the CPU mocker worker
+shares the policy (llm/mocker/engine.py) without paying the jax import.
+See docs/scheduler.md for the policy, knobs, and a worked ITL-budget
+example.
+"""
+
+from .cost_model import CostModel
+from .policy import PrefillPlan, StepPlanner
+from .sla import SlaConfig
+
+__all__ = ["CostModel", "PrefillPlan", "SlaConfig", "StepPlanner"]

@@ -74,6 +74,20 @@ def parse_args(argv=None):
     ap.add_argument("--kvbm-disk-blocks", type=int, default=0)
     ap.add_argument("--kvbm-disk-path", default=None)
     ap.add_argument("--migration-limit", type=int, default=3)
+    # SLA-aware step scheduling (engine/scheduler/, docs/scheduler.md);
+    # defaults resolve from DYN_SCHED_POLICY / DYN_SLA_TTFT_MS /
+    # DYN_SLA_ITL_MS so fleet-wide rollout needs no CLI change
+    ap.add_argument("--sched-policy", choices=["fifo", "sla"], default=None,
+                    help="step-scheduling policy: fifo = legacy admit-order "
+                    "dispatch (bit-for-bit, modulo the batch-kind "
+                    "anti-starvation fix), sla = EDF + ITL-budget planner "
+                    "(default: DYN_SCHED_POLICY, fifo)")
+    ap.add_argument("--ttft-target-ms", type=float, default=None,
+                    help="TTFT target under sla policy (default: "
+                    "DYN_SLA_TTFT_MS)")
+    ap.add_argument("--itl-target-ms", type=float, default=None,
+                    help="decode ITL budget under sla policy; 0 disables "
+                    "(default: DYN_SLA_ITL_MS)")
     ap.add_argument("--warmup", choices=["auto", "full", "none"],
                     default="auto",
                     help="compile all engine dispatch variants before "
@@ -148,6 +162,9 @@ async def main():
         kvbm_host_blocks=args.kvbm_host_blocks,
         kvbm_disk_blocks=args.kvbm_disk_blocks,
         kvbm_disk_path=args.kvbm_disk_path,
+        sched_policy=args.sched_policy,
+        ttft_target_ms=args.ttft_target_ms,
+        itl_target_ms=args.itl_target_ms,
     )
 
     kv_sharding = None
@@ -414,6 +431,14 @@ async def main():
         # tokens/batches ratio = tokens-per-delta-batch (serving-gap
         # coalescing diagnostic; mean > 1 in steady decode)
         "emit_batches", "emit_tokens",
+        # dynosched: scheduler queue/deadline pressure beside the raw
+        # depth metric — est TTFT is the disagg router's routing signal,
+        # deferred/shrunk/override counters show where the ITL budget and
+        # starvation guard actually bit
+        "sched_est_ttft_ms", "sched_pending_deadlines",
+        "sched_granted_tokens", "sched_deferred_steps",
+        "sched_itl_shrunk_steps", "sched_deadline_overrides",
+        "sched_starvation_overrides",
     ):
         # registry prepends the "dynamo" prefix -> dynamo_worker_<stat>
         drt.metrics.callback_gauge(

@@ -52,7 +52,19 @@ async def maybe_remote_prefill(
     have_workers = bool(prefill_client and prefill_client.instance_ids())
 
     want_annotation = "remote_prefill" in (request.get("annotations") or [])
-    if not disagg_router.prefill_remote(len(prompt), cached_tokens, have_workers):
+    # the scheduler's estimated local TTFT (queue depth x cost model)
+    # augments the static token threshold once the cost model is warm —
+    # a below-threshold prompt still offloads when the LOCAL queue would
+    # spend the TTFT budget (sla policy only; fifo keeps the reference
+    # threshold rule alone)
+    est_ms = target_ms = None
+    if engine.scheduler.policy == "sla":
+        est_ms = engine.estimated_prefill_wait_ms(len(prompt) - cached_tokens)
+        target_ms = engine.scheduler.sla.ttft_target_ms
+    if not disagg_router.prefill_remote(
+        len(prompt), cached_tokens, have_workers,
+        local_ttft_est_ms=est_ms, ttft_target_ms=target_ms,
+    ):
         if want_annotation:
             yield {"event": "remote_prefill", "comment": ["false"]}
         async for item in engine.generate(request, context):

@@ -18,6 +18,7 @@ interface.
 from __future__ import annotations
 
 import logging
+import time
 from dataclasses import dataclass
 from typing import Any, Dict, Optional, Tuple
 
@@ -36,25 +37,80 @@ class DisaggConfig:
     remote_prefill_threshold_tokens: int = 64
     # skip remote if the prefill pool is this backed up
     max_prefill_queue: int = 64
+    # a published queue depth older than this is UNKNOWN, not gospel: a
+    # depth published just before a prefill worker died would otherwise
+    # pin the routing decision forever (the decision falls back to the
+    # threshold/SLA rule, exactly as if no depth had ever been published)
+    queue_depth_ttl_s: float = 5.0
+    # scheduler-informed routing floor: prompts with at most this many
+    # uncached tokens never go remote (the KV transfer would cost more
+    # than the prefill), regardless of the local TTFT estimate
+    min_remote_tokens: int = 16
+    # offload when the estimated LOCAL prefill wait eats this fraction of
+    # the TTFT target (the remote hop must still leave budget for the
+    # transfer + decode admission)
+    ttft_headroom: float = 0.5
 
 
 class DisaggregatedRouter:
     """Decide local vs remote prefill (reference prefill_remote
-    disagg_router.rs:230)."""
+    disagg_router.rs:230). Two signals:
+
+      * prefill-pool backpressure — published queue depth, with a
+        staleness TTL so a dead worker's last report decays to "unknown";
+      * the local engine scheduler's estimated TTFT (queue depth x cost
+        model, JaxEngine.estimated_prefill_wait_ms) — when available it
+        ADDS a queue-pressure offload trigger on top of the static token
+        threshold: a prompt below the threshold still goes remote when
+        the local queue would spend the TTFT budget. Big prompts keep
+        going remote regardless (prefill-interference avoidance, the
+        Nexus rationale), except tiny uncached remainders under
+        min_remote_tokens, where the KV transfer costs more than the
+        prefill.
+    """
 
     def __init__(self, config: Optional[DisaggConfig] = None):
         self.config = config or DisaggConfig()
         self.prefill_queue_depth = 0  # updated from prefill worker metrics
+        self._depth_at: Optional[float] = None  # monotonic publish time
 
-    def update_queue_depth(self, depth: int):
+    def update_queue_depth(self, depth: int, now: Optional[float] = None):
         self.prefill_queue_depth = depth
+        self._depth_at = time.monotonic() if now is None else now
 
-    def prefill_remote(self, prompt_len: int, prefix_hit_tokens: int, have_prefill_workers: bool) -> bool:
+    def queue_depth_known(self, now: Optional[float] = None) -> bool:
+        """True while the last published depth is fresh enough to act on."""
+        if self._depth_at is None:
+            return False
+        now = time.monotonic() if now is None else now
+        return (now - self._depth_at) <= self.config.queue_depth_ttl_s
+
+    def prefill_remote(
+        self,
+        prompt_len: int,
+        prefix_hit_tokens: int,
+        have_prefill_workers: bool,
+        *,
+        local_ttft_est_ms: Optional[float] = None,
+        ttft_target_ms: Optional[float] = None,
+        now: Optional[float] = None,
+    ) -> bool:
         if not self.config.enabled or not have_prefill_workers:
             return False
-        if self.prefill_queue_depth > self.config.max_prefill_queue:
+        if self.queue_depth_known(now) and (
+            self.prefill_queue_depth > self.config.max_prefill_queue
+        ):
             return False
-        return (prompt_len - prefix_hit_tokens) > self.config.remote_prefill_threshold_tokens
+        uncached = prompt_len - prefix_hit_tokens
+        if local_ttft_est_ms is not None and ttft_target_ms:
+            # scheduler-informed: a below-threshold prompt still offloads
+            # when the LOCAL queue leaves no room for its TTFT target;
+            # above-threshold prompts fall through to the reference rule
+            if uncached <= self.config.min_remote_tokens:
+                return False
+            if local_ttft_est_ms > self.config.ttft_headroom * ttft_target_ms:
+                return True
+        return uncached > self.config.remote_prefill_threshold_tokens
 
 
 # ---------------------------------------------------------------------- #

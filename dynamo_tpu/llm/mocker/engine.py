@@ -18,6 +18,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, AsyncIterator, Callable, Dict, List, Optional
 
+from ...engine.scheduler.sla import SlaConfig
 from ...runtime import faults
 from ...runtime.engine import Context
 from ..protocols import Annotated, LLMEngineOutput, PreprocessedRequest
@@ -44,6 +45,16 @@ class MockEngineArgs:
     decode_time_per_step: float = 8e-3
     decode_time_per_seq: float = 60e-6
     vocab_size: int = 32000
+    # SLA-aware scheduling (engine/scheduler/sla.py): None = resolve from
+    # the DYN_SCHED_POLICY / DYN_SLA_TTFT_MS / DYN_SLA_ITL_MS env knobs.
+    # "fifo" keeps the reference scheduler bit-for-bit; "sla" orders
+    # admission+prefill by TTFT deadline (EDF) and caps the per-step
+    # prefill budget so the synthetic decode cadence holds the ITL target
+    # — the same policy the JaxEngine's StepPlanner applies, priced by
+    # the mocker's own timing model instead of the EWMA cost model.
+    sched_policy: Optional[str] = None
+    ttft_target_ms: Optional[float] = None
+    itl_target_ms: Optional[float] = None
 
 
 @dataclass
@@ -61,6 +72,8 @@ class _MockRequest:
     held_hashes: List[int] = field(default_factory=list)
     done: bool = False
     decode_only: bool = False  # disagg: KV assumed transferred in
+    priority: int = 0
+    sched_deadline: float = 0.0  # EDF key (monotonic s; sla policy only)
 
 
 class MockEngine:
@@ -86,6 +99,13 @@ class MockEngine:
         self._wake = asyncio.Event()
         self._closed = False
         self.num_requests = 0
+        self.sla = SlaConfig.from_env(
+            policy=self.args.sched_policy,
+            ttft_target_ms=self.args.ttft_target_ms,
+            itl_target_ms=self.args.itl_target_ms,
+        )
+        self.sched_deferred_steps = 0  # steps the ITL budget zeroed prefill
+        self.sched_deadline_overrides = 0  # overdue requests that broke it
 
     # -- lifecycle ---------------------------------------------------------- #
 
@@ -142,6 +162,8 @@ class MockEngine:
             decode_only=bool(disagg.get("remote_prefill_done")),
         )
         mreq.seq = TokenBlockSequence(mreq.prompt, self.args.block_size)
+        mreq.priority = int(req.priority or 0)
+        mreq.sched_deadline = self.sla.deadline(time.monotonic(), mreq.priority)
         self.num_requests += 1
         self._waiting.append(mreq)
         self._wake.set()
@@ -165,6 +187,9 @@ class MockEngine:
             "kv_active_blocks": self.kv.active_blocks,
             "kv_total_blocks": self.kv.num_blocks,
             "request_total_slots": self.args.max_num_seqs,
+            "sched_policy": self.sla.policy,
+            "sched_deferred_steps": self.sched_deferred_steps,
+            "sched_deadline_overrides": self.sched_deadline_overrides,
         }
 
     # -- scheduler ---------------------------------------------------------- #
@@ -202,15 +227,60 @@ class MockEngine:
             elapsed = time.monotonic() - t_step0
             await asyncio.sleep(max(step_time - elapsed, 0.0001))
 
+    def _itl_prefill_budget(self) -> int:
+        """sla policy: prefill tokens this step may spend while the
+        projected step latency (decode + prefill, the mocker's synthetic
+        timing model) stays under the ITL target. Full throttle when no
+        request is decode-active; a request past its TTFT deadline breaks
+        a zero budget (one block) — TTFT attainment outranks decode
+        smoothness, mirroring StepPlanner's deadline override."""
+        a = self.args
+        full = a.max_num_batched_tokens
+        if self.sla.itl_target_ms <= 0:
+            return full
+        n_dec = sum(
+            1 for r in self._running if r.prefill_pos >= len(r.prompt)
+        )
+        if not n_dec:
+            return full
+        speed = max(a.speedup_ratio, 1e-9)
+        decode_s = (a.decode_time_per_step + n_dec * a.decode_time_per_seq) / speed
+        per_tok = a.prefill_time_per_token / speed
+        if per_tok <= 0:
+            return full
+        budget = int(max(self.sla.itl_target_ms / 1000.0 - decode_s, 0.0) / per_tok)
+        budget = min(budget, full)
+        if budget <= 0:
+            pending = [
+                r for r in [*self._waiting, *self._running]
+                if r.prefill_pos < len(r.prompt) and not r.done
+            ]
+            if not pending:
+                return 0  # nothing to defer: the counters must not move
+            now = time.monotonic()
+            if any(r.sched_deadline <= now for r in pending):
+                self.sched_deadline_overrides += 1
+                return a.block_size
+            self.sched_deferred_steps += 1
+            return 0
+        return budget
+
     def _do_admission_and_prefill(self) -> int:
         """Admit waiting requests (prefix-cache aware) and advance chunked
-        prefill; returns prefill tokens processed this step."""
+        prefill; returns prefill tokens processed this step. Under the
+        sla policy, admission and chunk order are EDF over TTFT deadlines
+        and the budget is ITL-capped; under fifo (default) this is the
+        reference scheduler bit-for-bit."""
         a = self.args
         budget = a.max_num_batched_tokens
+        waiting = self._waiting
+        if self.sla.policy == "sla":
+            waiting = sorted(waiting, key=lambda r: r.sched_deadline)
+            budget = min(budget, self._itl_prefill_budget())
         processed = 0
         # admit
         still_waiting: List[_MockRequest] = []
-        for req in self._waiting:
+        for req in waiting:
             if req.done or req.context.is_stopped():
                 self._finish(req, "cancelled", emit=not req.done)
                 continue
@@ -231,8 +301,14 @@ class MockEngine:
             req.prefill_pos = cached * a.block_size if not req.decode_only else len(req.prompt)
             self._running.append(req)
         self._waiting = still_waiting
-        # chunked prefill over running requests
-        for req in self._running:
+        # chunked prefill over running requests (EDF order under sla;
+        # taken AFTER admission so fresh admits prefill this same step,
+        # exactly like the fifo path)
+        prefill_order = (
+            sorted(self._running, key=lambda r: r.sched_deadline)
+            if self.sla.policy == "sla" else self._running
+        )
+        for req in prefill_order:
             if req.prefill_pos >= len(req.prompt):
                 continue
             remaining = len(req.prompt) - req.prefill_pos

@@ -247,6 +247,16 @@ class OpenAIPreprocessor:
             raise ValueError(
                 "guided decoding with a LoRA adapter is not supported yet"
             )
+        # scheduling priority (engine/scheduler/): bounded so a client
+        # cannot collapse its TTFT deadline to zero (or push it to years)
+        priority = getattr(nvext, "priority", None) if nvext else None
+        if priority is not None:
+            try:
+                priority = int(priority)
+            except (TypeError, ValueError):
+                raise ValueError("nvext.priority must be an integer")
+            if not -8 <= priority <= 8:
+                raise ValueError("nvext.priority must be in [-8, 8]")
 
         return PreprocessedRequest(
             token_ids=token_ids,
@@ -258,6 +268,7 @@ class OpenAIPreprocessor:
             router=router,
             guided=guided,
             lora_name=lora_name,
+            priority=priority or 0,
             request_id=secrets.token_hex(8),
         )
 

@@ -95,6 +95,11 @@ class PreprocessedRequest:
     # block hashes (reference protocols.rs:110-115 lora_id) so router +
     # prefix cache + KVBM never share KV across adapters.
     lora_name: Optional[str] = None
+    # scheduling priority (nvext.priority, engine/scheduler/): each +1
+    # halves the request's TTFT target (tighter EDF deadline), each -1
+    # doubles it. 0 = default class. Only consulted under
+    # DYN_SCHED_POLICY=sla; fifo ignores it.
+    priority: int = 0
 
     def to_dict(self) -> dict:
         d = {
@@ -121,6 +126,8 @@ class PreprocessedRequest:
             d["guided"] = self.guided
         if self.lora_name:
             d["lora_name"] = self.lora_name
+        if self.priority:
+            d["priority"] = self.priority
         return d
 
     @classmethod

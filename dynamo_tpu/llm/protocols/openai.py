@@ -34,6 +34,9 @@ class NvExt(BaseModel):
     # multi-LoRA: select a served adapter by name (models/lora.py; the
     # worker's model card advertises available adapters)
     lora_name: Optional[str] = None
+    # scheduling priority under DYN_SCHED_POLICY=sla (engine/scheduler/):
+    # each +1 halves the request's TTFT target, each -1 doubles it
+    priority: Optional[int] = None
 
 
 class FunctionCall(BaseModel):

@@ -142,6 +142,26 @@ ENV_REGISTRY: tuple = (
            "Global dynochaos kill-switch: force the no-op injector even "
            "when DYN_FAULT_PLAN is set.",
            "runtime/faults.py"),
+    # -- engine scheduling / SLA (engine/scheduler/, docs/scheduler.md) - #
+    EnvVar("DYN_SCHED_POLICY", "enum", "fifo",
+           "Engine step-scheduling policy: `fifo` preserves the legacy "
+           "admit-order prefill dispatch bit-for-bit (modulo the "
+           "batch-kind anti-starvation fairness fix, active under both "
+           "policies); `sla` enables the EDF + ITL-budget StepPlanner "
+           "(also honored by the CPU mocker's scheduler).",
+           "engine/scheduler/sla.py"),
+    EnvVar("DYN_SLA_TTFT_MS", "float", "2000",
+           "Per-request TTFT target under DYN_SCHED_POLICY=sla: prefill "
+           "deadlines are arrival + target, halved per +1 of the "
+           "request's nvext.priority. Drives EDF ordering and the disagg "
+           "router's local-vs-remote prefill decision.",
+           "engine/scheduler/sla.py"),
+    EnvVar("DYN_SLA_ITL_MS", "float", "0",
+           "Decode ITL budget (ms/token) under DYN_SCHED_POLICY=sla: "
+           "prefill dispatches are shrunk or deferred so the projected "
+           "per-token latency of decode-block + prefill stays under it. "
+           "0 (default) disables the ITL budget.",
+           "engine/scheduler/sla.py"),
     # -- engine / memory sizing ---------------------------------------- #
     EnvVar("DYN_HBM_UTILIZATION", "float", "0.85",
            "Fraction of device memory the KV pool auto-sizer may plan "

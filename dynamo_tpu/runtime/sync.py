@@ -68,6 +68,16 @@ GUARDED_STATE = {
     # wrapper, which runs on the jax-step device-executor thread; readers
     # (stats) take a list() snapshot.
     "JaxEngine._dev_time": "thread:timed",
+    # dynosched (engine/scheduler/): the cost model's per-shape EWMA is
+    # written on the jax-step thread (the `timed` wrapper observes every
+    # dispatch) and read on the event loop (planning, stats, the disagg
+    # TTFT estimate) — the lock is the only thing between them. Planner
+    # bookkeeping (deadline table, decision records) stays confined to
+    # the engine step loop, per the convention this registry was seeded
+    # to enforce on ROADMAP item 1's scheduler.
+    "CostModel._ewma": "lock:_lock",
+    "StepPlanner._deadlines": "single-task:_step_loop",
+    "StepPlanner._records": "single-task:_step_loop",
     # endpoint instance table: the watch task is the only mutator once
     # the client is started (static mode carries a reasoned waiver).
     "Client.instances": "single-task:_watch_loop",
