@@ -35,7 +35,8 @@ from bench import baseline_ratio, ensure_backend  # noqa: E402
 
 def _make_engine(model: str, B: int, isl: int, osl: int, K: int, page: int = 64,
                  pool_mode=None, unroll: int = 0, quantize=None,
-                 num_pages: Optional[int] = None, spec=None):
+                 num_pages: Optional[int] = None, spec=None,
+                 mixed: Optional[bool] = None):
     from dynamo_tpu.engine import EngineConfig, JaxEngine
 
     max_len = isl + osl + K + page
@@ -56,6 +57,7 @@ def _make_engine(model: str, B: int, isl: int, osl: int, K: int, page: int = 64,
         quantize=quantize,
         spec_mode=spec,
         enable_prefix_caching=True,
+        mixed_dispatch=mixed,
     )
     return JaxEngine(cfg)
 
@@ -189,6 +191,136 @@ async def _churn(engine, B: int, isl: int, osl: int, vocab: int,
     }
 
 
+def _pct(xs: List[float], p: float) -> float:
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    i = min(int(round((len(xs) - 1) * p)), len(xs) - 1)
+    return xs[i]
+
+
+async def _mixed_replay(engine, B: int, isl: int, osl: int, vocab: int,
+                        n_arrivals: int, seed: int = 0):
+    """Replay a mixed prefill+decode schedule: B decode lanes run long
+    generations while `n_arrivals` staggered prompts prefill into the same
+    engine — every arrival step is a mixed-opportunity step (prefill work
+    + active decode). Per-step wall times are recorded by wrapping the
+    engine's own `_step_once` and classified by which path served the step
+    (mixed / split-pair / other)."""
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    times: List[tuple] = []
+    step_times = {"mixed": [], "split": [], "other": []}
+
+    orig_step = engine._step_once
+
+    async def timed_step():
+        m0, s0 = engine.mixed_steps, engine.split_steps
+        t0 = time.perf_counter()
+        r = await orig_step()
+        dt = time.perf_counter() - t0
+        kind = (
+            "mixed" if engine.mixed_steps > m0
+            else "split" if engine.split_steps > s0
+            else "other"
+        )
+        step_times[kind].append(dt * 1000.0)
+        return r
+
+    engine._step_once = timed_step
+    try:
+        # the decode group must outlast the whole arrival schedule, so
+        # every arrival's prefill chunks land beside active decode lanes
+        osl_dec = max(osl, 16 * n_arrivals)
+        decode_tasks = [
+            asyncio.create_task(_run_one(
+                engine, _mk_prompt(rng, vocab, isl, False), osl_dec, times
+            ))
+            for _ in range(max(B // 2, 1))
+        ]
+        await asyncio.sleep(0.25)  # let the decode group reach steady decode
+        arrival_tasks = []
+        for _ in range(n_arrivals):
+            arrival_tasks.append(asyncio.create_task(_run_one(
+                engine, _mk_prompt(rng, vocab, isl, False), 4, times,
+            )))
+            await asyncio.sleep(0.1)  # stagger: chunks land mid-decode
+        await asyncio.gather(*decode_tasks, *arrival_tasks)
+    finally:
+        engine._step_once = orig_step
+    return step_times
+
+
+def _mixed_arm_report(engine, step_times) -> dict:
+    s = engine.stats()
+    fused = s["mixed_steps"] > 0
+    times = step_times["mixed"] if fused else step_times["split"]
+    return {
+        "mixed_steps": s["mixed_steps"],
+        "split_steps": s["split_steps"],
+        # device dispatches needed to serve one mixed-opportunity step:
+        # the fused path does prefill+decode in ONE call, the split path
+        # pays a prefill dispatch AND a decode dispatch
+        "dispatches_per_mixed_step": 1 if fused else 2,
+        "padding_frac": s["mixed_padding_frac"] if fused
+        else s["split_padding_frac"],
+        "step_ms_p50": round(_pct(times, 0.50), 2),
+        "step_ms_p99": round(_pct(times, 0.99), 2),
+        "dispatch_counts": {
+            k.removeprefix("dispatch_").removesuffix("_count"): v
+            for k, v in s.items()
+            if k.startswith("dispatch_") and k.endswith("_count")
+        },
+    }
+
+
+def run_mixed_bench(args, model: str, vocab: int, B: int, isl: int, osl: int):
+    """`--mixed`: the unified-vs-split comparison on the same seeded
+    schedule — dispatches per mixed step (2 -> 1), padding-waste ratio,
+    and step-time p50/p99 for each arm (ISSUE 8 acceptance surface)."""
+    arms = {}
+    for name, flag in (("unified", True), ("split", False)):
+        engine = _make_engine(
+            model, B, isl, osl, args.block, quantize=args.quantize,
+            mixed=flag,
+        )
+
+        async def run(eng=engine):
+            # warmup: compile the dispatch variants both arms use — the
+            # steady pass covers prefill/decode, the short staggered
+            # replay covers the mixed variant (its first occurrence pays
+            # the XLA compile, which must not pollute step-time p50/p99)
+            await _steady(eng, min(B, 2), isl, 8, vocab, seed=99)
+            await _mixed_replay(eng, B, isl, osl, vocab,
+                                n_arrivals=max(B, 4), seed=99)
+            st = await _mixed_replay(eng, B, isl, osl, vocab,
+                                     n_arrivals=max(B, 4))
+            await eng.close()
+            return st
+
+        step_times = asyncio.run(run())
+        arms[name] = _mixed_arm_report(engine, step_times)
+        print(f"# {name}: {json.dumps(arms[name])}", file=sys.stderr)
+    result = {
+        "metric": f"engine_mixed_{model}_bs{B}_isl{isl}",
+        "value": arms["unified"]["dispatches_per_mixed_step"],
+        "unit": "dispatches/mixed-step",
+        "split_dispatches_per_mixed_step":
+            arms["split"]["dispatches_per_mixed_step"],
+        "mixed_padding_frac": arms["unified"]["padding_frac"],
+        "split_padding_frac": arms["split"]["padding_frac"],
+        "mixed_step_ms_p50": arms["unified"]["step_ms_p50"],
+        "mixed_step_ms_p99": arms["unified"]["step_ms_p99"],
+        "split_step_ms_p50": arms["split"]["step_ms_p50"],
+        "split_step_ms_p99": arms["split"]["step_ms_p99"],
+        "mixed_steps": arms["unified"]["mixed_steps"],
+        "split_steps": arms["split"]["split_steps"],
+    }
+    print(json.dumps(result))
+    return 0
+
+
 def main(argv: Optional[List[str]] = None):
     ap = argparse.ArgumentParser(description="dynamo-tpu engine benchmark")
     ap.add_argument("--smoke", action="store_true")
@@ -210,6 +342,11 @@ def main(argv: Optional[List[str]] = None):
                     "repetition-heavy so acceptance is measurable")
     ap.add_argument("--churn-s", type=float, default=None,
                     help="closed-loop churn window (0 disables)")
+    ap.add_argument("--mixed", action="store_true",
+                    help="unified-vs-split mixed-step comparison: replay a "
+                    "mixed prefill+decode schedule on both paths and report "
+                    "dispatches/step, padding-waste ratio, and step-time "
+                    "p50/p99 (docs/ragged_attention.md)")
     args = ap.parse_args(argv)
 
     if args.smoke:
@@ -238,6 +375,8 @@ def main(argv: Optional[List[str]] = None):
         f"# engine bench: model={model} B={B} isl={isl} osl={osl} block={args.block}",
         file=sys.stderr,
     )
+    if args.mixed:
+        return run_mixed_bench(args, model, vocab, B, isl, osl)
     engine = _make_engine(
         model, B, isl, osl, args.block,
         pool_mode=args.pool_mode, unroll=args.unroll, quantize=args.quantize,

@@ -68,6 +68,13 @@ PHASES = [
                   "--quantize", "int8"], 5400),
     ("spec_decode", [PY, "bench_engine.py", "--quantize", "int8",
                      "--spec", "ngram"], 1800),
+    # PR 8 remeasure: unified-vs-split mixed dispatch on real hardware
+    # (CPU interpreter-mode numbers in BENCH_NOTES_r07.md; the step-time
+    # split only means anything where the Pallas kernel actually runs) —
+    # pre-PR-8 phases are seeded ok in bench_watchdog_state.json so a
+    # watchdog restart runs just this phase
+    ("engine_mixed", [PY, "bench_engine.py", "--mixed", "--quantize",
+                      "int8"], 2400),
 ]
 
 

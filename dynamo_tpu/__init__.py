@@ -12,7 +12,17 @@ Layer map (mirrors reference SURVEY.md §1):
                KV router, block manager, mocker engine
   engine/    — the JAX inference engine: continuous batching, paged KV
   models/    — model zoo (functional JAX, param pytrees)
-  ops/       — Pallas TPU kernels (ragged paged attention, block copy)
+  ops/       — Pallas TPU kernels. Kernel map (each with an XLA reference
+               fallback + the shared `_pallas_eligible` dispatch gate in
+               ops/paged_attention.py):
+                 pallas_paged_attention.py   — decode (T=1) flash over paged
+                                               KV, + fused pool+local variant
+                 pallas_prefill_attention.py — batched chunked-prefill flash
+                 pallas_ragged_attention.py  — ragged UNIFIED mixed
+                                               prefill+decode (one flat
+                                               buffer, one dispatch;
+                                               docs/ragged_attention.md)
+                 ring_attention.py           — sequence-parallel ring prefill
   parallel/  — mesh construction, shardings (tp/dp/pp/ep/sp)
   planner/   — SLA planner: load prediction, perf interpolation, autoscale
   frontend/  — `python -m dynamo_tpu.frontend` OpenAI entrypoint

@@ -90,6 +90,20 @@ class EngineConfig:
     sched_policy: Optional[str] = None
     ttft_target_ms: Optional[float] = None
     itl_target_ms: Optional[float] = None
+    # ragged unified mixed dispatch (ops/pallas_ragged_attention.py,
+    # docs/ragged_attention.md): when the planner has BOTH runnable prefill
+    # chunks and active decode lanes, pack them into ONE flat ragged token
+    # buffer and ONE device call per layer stack (ragged_forward) instead
+    # of a prefill dispatch followed by a decode dispatch. Plain traffic
+    # only — guided/lora/mm/spec and pp/sp layouts ride their split
+    # variants. None = resolve from DYN_MIXED_DISPATCH (default on).
+    mixed_dispatch: Optional[bool] = None
+    # flat-token budget of one mixed dispatch: decode rows + granted
+    # prefill chunks, pow2-bucketed up to this cap. Bounds the mixed
+    # compile-variant space exactly like prefill_buckets bounds prefill's
+    # (one lazily-compiled variant per (token bucket, table bucket); the
+    # row axis is a single fixed bucket, see engine._mixed_row_bucket).
+    mixed_max_tokens: int = 2048
     # KVBM tiers (kvbm/manager.py); 0 disables a tier
     kvbm_host_blocks: int = 0
     kvbm_disk_blocks: int = 0

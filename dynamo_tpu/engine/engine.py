@@ -408,6 +408,46 @@ class JaxEngine:
                 itl_target_ms=config.itl_target_ms,
             ),
         )
+        # ragged unified mixed dispatch (docs/ragged_attention.md): when
+        # the planner has BOTH runnable prefill chunks and active decode
+        # lanes, ONE flat ragged buffer + ONE device call replaces the
+        # split prefill-batch + decode-block pair. Plain traffic only;
+        # spec/pp/sp configs keep the split path outright.
+        from ..ops.paged_attention import _pallas_eligible
+        from ..ops.pallas_ragged_attention import ragged_tile_q
+        from ..runtime.config import env_bool
+
+        self._mixed_enabled = (
+            config.mixed_dispatch
+            if config.mixed_dispatch is not None
+            else env_bool("DYN_MIXED_DISPATCH", True)
+        ) and not config.spec_mode and config.pp_size == 1 and config.sp_size == 1
+        # row-start alignment of the flat packer: the Pallas ragged kernel
+        # needs q-tile-aligned rows; the XLA reference packs dense
+        self._mixed_align = (
+            ragged_tile_q(c.dtype) if _pallas_eligible(c.head_dim) else 1
+        )
+        # ONE fixed row bucket: the row axis only sizes scalar operands
+        # (tables, sampling state), so a single padded variant is free —
+        # compile variants stay (token bucket x table bucket)
+        self._mixed_row_bucket = _next_pow2(
+            config.max_num_seqs + config.max_prefill_batch
+        )
+        # fused-vs-split visibility (stats() + jax_worker gauges): is the
+        # fused path actually taken in production, and what padding does
+        # each path pay per step
+        self.mixed_steps = 0
+        self.split_steps = 0
+        self.mixed_padded_tokens = 0
+        self.mixed_real_tokens = 0
+        self.split_padded_tokens = 0
+        self.split_real_tokens = 0
+        self._last_prefill_shape = None  # (padded, real) of the latest dispatch
+        self._last_decode_shape = None
+        # set by _dispatch_mixed when only the in-flight decode pipeline
+        # blocks fusing: the step loop holds the split prefill one step so
+        # the drained pipeline fuses next step instead
+        self._mixed_wait_drain = False
         # speculative decoding (engine/spec.py): host mirror of the device
         # history ring + SpecDecodeStats counters (_core.pyi:269-301 role)
         self.hist = (
@@ -694,6 +734,35 @@ class JaxEngine:
             return first, kv_k, kv_v, rng
 
         self._prefill_batch = prefill_batch
+
+        @partial(jax.jit, donate_argnums=(1, 2, 12), out_shardings=prefill_out_sh)
+        def mixed_step(params, kv_k, kv_v, tokens, positions, row_ids,
+                       page_tables, row_starts, row_lens, ctx_lens, last_flat,
+                       samp, rng, pen_rows):
+            """Unified mixed step: ONE ragged forward over a flat buffer
+            packing prefill chunks (row_len > 1) and decode lanes
+            (row_len == 1), with each row's last-token logits sampled on
+            device — the fused replacement for a prefill_batch dispatch
+            followed by a decode dispatch (docs/ragged_attention.md).
+            Attention rides ops/pallas_ragged_attention on TPU, the XLA
+            ragged reference elsewhere."""
+            rng, sub = jax.random.split(rng)
+            logits, kv_k, kv_v = self._model.ragged_forward(
+                params, c, tokens, positions, row_ids, kv_k, kv_v,
+                page_tables, row_starts, row_lens, ctx_lens, last_flat,
+            )
+            plogits = penalized(logits, samp, pen_rows)
+            # the sampled token's position counter: the row's last real
+            # token (= ctx + last_idx for prefill rows, seq_len - 1 for
+            # decode rows) — identical to what the split dispatches use,
+            # so seeded streams don't depend on the dispatch shape
+            first = sample_lp(
+                plogits, samp, sub, positions=ctx_lens + row_lens - 1,
+                raw=logits,
+            )
+            return first, kv_k, kv_v, rng
+
+        self._mixed_step = mixed_step
 
         @partial(jax.jit, donate_argnums=(1, 2, 9), out_shardings=prefill_out_sh)
         def prefill_batch_mm(params, kv_k, kv_v, tokens, positions, page_tables,
@@ -1030,6 +1099,17 @@ class JaxEngine:
             async for _ in self.generate(req, Context()):
                 pass
             n += 1
+        if self._mixed_enabled:
+            # compile the unified mixed-step variant: a staggered pair puts
+            # one request in decode while the other's prefill chunk is
+            # runnable, so the fused ragged program (ragged_forward +
+            # sampling) compiles before serving traffic instead of on-path
+            isl = max(buckets[0] - 8, 4)
+            t1 = asyncio.create_task(_drain(isl))
+            await asyncio.sleep(0.05)
+            t2 = asyncio.create_task(_drain(isl))
+            await asyncio.gather(t1, t2)
+            n += 2
         if self._lora is not None and self._lora["names"]:
             # compile the LoRA prefill/decode variants with a registered
             # adapter (same on-path-compile hazard as the guided variants)
@@ -1425,6 +1505,18 @@ class JaxEngine:
         out["kv_skip_ahead_blocks"] = self.prefix_skip_ahead_blocks
         out["emit_batches"] = self.emit_batches
         out["emit_tokens"] = self.emit_tokens
+        # ragged unified dispatch: is the fused path actually taken in
+        # production, and what padding does each path pay per step
+        # (docs/ragged_attention.md; jax_worker republishes these as
+        # prometheus gauges)
+        out["mixed_steps"] = self.mixed_steps
+        out["split_steps"] = self.split_steps
+        out["mixed_padding_frac"] = round(
+            1.0 - self.mixed_real_tokens / self.mixed_padded_tokens, 4
+        ) if self.mixed_padded_tokens else 0.0
+        out["split_padding_frac"] = round(
+            1.0 - self.split_real_tokens / self.split_padded_tokens, 4
+        ) if self.split_padded_tokens else 0.0
         # dynosched: policy/targets, per-step decision counters, and the
         # queue/deadline view (published on the worker metrics topic, so
         # disagg decode workers and the planner see prefill-pool pressure)
@@ -1503,12 +1595,34 @@ class JaxEngine:
             await asyncio.sleep(0 if progressed else 0.001)
 
     async def _step_once(self) -> bool:
-        """One engine iteration: admit, dispatch (prefill batch + decode
-        block), then collect ALL host-needed values in one device_get."""
+        """One engine iteration: admit, dispatch (ONE fused mixed step
+        when both prefill and decode are runnable, else prefill batch +
+        decode block), then collect ALL host-needed values in one
+        device_get."""
         self._admit_waiting()
         progressed = await self._run_injections()
-        progressed |= await self._dispatch_prefill()
-        dispatched = await self._dispatch_decode()
+        dispatched = False
+        if await self._dispatch_mixed():
+            progressed = True
+        else:
+            self._last_prefill_shape = self._last_decode_shape = None
+            pf = False
+            if not self._mixed_wait_drain:
+                pf = await self._dispatch_prefill()
+            progressed |= pf
+            dispatched = await self._dispatch_decode()
+            if pf and dispatched and self._last_prefill_shape \
+                    and self._last_decode_shape:
+                # a mixed-shaped step served by the split pair (mixed off,
+                # variant kinds, pipeline in flight, planner refusal):
+                # account its padding beside the fused path's
+                self.split_steps += 1
+                self.split_padded_tokens += (
+                    self._last_prefill_shape[0] + self._last_decode_shape[0]
+                )
+                self.split_real_tokens += (
+                    self._last_prefill_shape[1] + self._last_decode_shape[1]
+                )
         # fetch the oldest block only once the pipeline is full or stalled,
         # so its host read overlaps the newer block's compute
         fetch_block = len(self._inflight) >= 2 or (
@@ -1686,6 +1800,36 @@ class JaxEngine:
             jnp.asarray(tables),
             jnp.asarray(ctx_lens),
             jnp.asarray(last_idx),
+            samp,
+            self._rng,
+            jnp.asarray(pen_rows),
+        )
+        return first
+
+    def _dev_mixed(self, toks, positions, row_ids, tables, row_starts,
+                   row_lens, ctx_lens, last_flat, temps, top_ks, top_ps,
+                   seeds, pens, pen_rows):
+        samp = SamplingParams(
+            temperature=jnp.asarray(temps),
+            top_k=jnp.asarray(top_ks),
+            top_p=jnp.asarray(top_ps),
+            seed=jnp.asarray(seeds),
+            presence=jnp.asarray(pens[:, 0]),
+            frequency=jnp.asarray(pens[:, 1]),
+            repetition=jnp.asarray(pens[:, 2]),
+        )
+        first, self.kv_k, self.kv_v, self._rng = self._mixed_step(
+            self.params,
+            self.kv_k,
+            self.kv_v,
+            jnp.asarray(toks),
+            jnp.asarray(positions),
+            jnp.asarray(row_ids),
+            jnp.asarray(tables),
+            jnp.asarray(row_starts),
+            jnp.asarray(row_lens),
+            jnp.asarray(ctx_lens),
+            jnp.asarray(last_flat),
             samp,
             self._rng,
             jnp.asarray(pen_rows),
@@ -2101,6 +2245,16 @@ class JaxEngine:
                         p["toks"], p["positions"], p["tables"], p["ctx_lens"],
                         p["last_idx"], p["temps"], p["top_ks"], p["top_ps"],
                         p["seeds"], p["pens"], p["pen_rows"], p["idx"],
+                    )
+                )
+            elif tag == "mixed":
+                await self._run_on_device(
+                    partial(
+                        self._dev_mixed,
+                        p["toks"], p["positions"], p["row_ids"], p["tables"],
+                        p["row_starts"], p["row_lens"], p["ctx_lens"],
+                        p["last_flat"], p["temps"], p["top_ks"], p["top_ps"],
+                        p["seeds"], p["pens"], p["pen_rows"],
                     )
                 )
             elif tag == "block":
@@ -2558,6 +2712,9 @@ class JaxEngine:
             pen_rows[lane] = self.recent[s.slot_idx]
             s.sched_skips = 0  # granted a chunk: starvation clock restarts
             meta.append((s, chunk, lane))
+        self._last_prefill_shape = (
+            B_pf * bucket, sum(ch for _, ch, _ in meta)
+        )
 
         if any(s.mm for s in chosen):
             # multimodal splice operands: encoder rows land at their
@@ -2713,6 +2870,7 @@ class JaxEngine:
                     top_ks, top_ps, seeds, pens, pen_rows),
             tag="prefill", shape=(T_pad, 1),
         )
+        self._last_prefill_shape = (T_pad, chunk)
         slot.prefill_pos += chunk
         self._pending_prefill.append({"first": first_dev, "done": [(slot, 0)]})
 
@@ -3009,12 +3167,14 @@ class JaxEngine:
                 out.append(i)
         return out
 
-    def _grow_pages_for_block(self, active: List[int]) -> List[int]:
-        """Ensure each active lane's pages cover K decode steps; preempt the
-        newest sequence (or finish with 'length' as last resort) when the
-        pool is exhausted. Returns the surviving active set."""
+    def _grow_pages_for_block(self, active: List[int],
+                              steps: Optional[int] = None) -> List[int]:
+        """Ensure each active lane's pages cover `steps` decode steps
+        (default: one fused block's max advance); preempt the newest
+        sequence (or finish with 'length' as last resort) when the pool is
+        exhausted. Returns the surviving active set."""
         cfg = self.config
-        K = cfg.block_advance
+        K = steps or cfg.block_advance
         for i in list(active):
             slot = self.slots[i]
             if slot is None:
@@ -3082,6 +3242,232 @@ class JaxEngine:
             and not s.done
             for s in self.slots
         )
+
+    async def _dispatch_mixed(self) -> bool:
+        """Unified mixed step (ROADMAP 2, "Ragged Paged Attention"): when
+        there are BOTH runnable prefill chunks and active decode lanes,
+        pack them into one flat ragged token buffer — prefill chunks as
+        T>1 rows, decode lanes as T=1 rows with ctx = seq_len - 1 — and
+        run ONE device call per layer stack instead of a prefill dispatch
+        followed by a decode dispatch. Every decode lane advances one
+        token; completed prompts sample their first token; both ride the
+        same fetched [R] result. Returns False (split path runs) whenever
+        the fused step is inapplicable: mixed disabled, a variant kind
+        (guided/mm/lora) active, decode blocks in flight (their device
+        carry owns lane state — the mixed step needs host-authoritative
+        lanes), or the planner declines.
+
+        Shapes stay bounded: flat tokens pow2-bucketed to
+        config.mixed_max_tokens, ONE fixed row bucket
+        (self._mixed_row_bucket — the row axis only sizes scalar
+        operands), tables pow2-bucketed like the prefill dispatch. Row
+        starts are aligned to the Pallas ragged kernel's q tile exactly
+        when ops._pallas_eligible says the kernel will run; on the XLA
+        reference path the packer is dense."""
+        cfg = self.config
+        self._mixed_wait_drain = False
+        if not self._mixed_enabled:
+            return False
+        active = self._active_decode_indices()
+        if not active:
+            return False
+        if any(
+            self.slots[i].guided_fsm is not None or self.slots[i].lora_idx
+            for i in active
+        ):
+            return False
+        cands = []
+        for s in self.slots:
+            if s is None or s.prefill_pos >= len(s.kv_prompt):  # dynolint: disable=race-await-atomicity -- single writer per live slot (same shape as _dispatch_prefill); pull-path slots filtered below
+                continue
+            if s.preloaded is not None or s.onboard is not None:
+                continue
+            if s.done or s.context.is_stopped():
+                self._emit_finish(s, "cancelled")
+                self._release_slot(s)
+                continue
+            if s.mm is not None or s.guided_fsm is not None or s.lora_idx:
+                return False  # variant kinds ride their split programs
+            self._try_skip_ahead(s)
+            cands.append(s)
+        if not cands:
+            return False
+        cands = self.scheduler.order(cands)
+        align = self._mixed_align
+        plan = self.scheduler.plan_mixed(
+            cands, n_decode=len(active), align=align
+        )
+        if plan is None:
+            return False  # nothing fuses (e.g. decode lanes fill the
+            # budget) — split path runs at full rate, no hold
+        if self._inflight or self._pending_prefill:
+            # a decode block in flight owns these lanes' device carry, so
+            # the fused step can't pack them yet. Signal the step loop to
+            # HOLD the split prefill for one step while the pipeline
+            # drains (the split dispatch would queue behind the in-flight
+            # block on the device stream anyway) — the next step fuses.
+            # Only worth it when a fused step is actually plannable,
+            # hence AFTER the plan check.
+            self._mixed_wait_drain = True
+            # the held step grants nothing: every candidate ages, same as
+            # a plan_prefill defer (the skipped _dispatch_prefill would
+            # otherwise never age them on hold steps)
+            for s in cands:
+                s.sched_skips += 1
+            return False
+        # one decode step of page headroom; growth can preempt — re-filter
+        # both the decode set and the chosen prefill slots against it
+        active = self._grow_pages_for_block(active, steps=1)
+        if not active:
+            return False
+        chosen = [
+            (s, ch) for s, ch in zip(plan.chosen, plan.chunks)
+            if s.slot_idx >= 0 and self.slots[s.slot_idx] is s
+        ]
+        if not chosen:
+            return False
+        # the dispatch is committed from here on — account it (plan_mixed
+        # itself is pure, so an abandoned plan never skews the sched_*
+        # grant counters the split path's plan_prefill also feeds)
+        self.scheduler.commit_mixed(plan, chosen)
+        # candidates the plan passed over age toward the starvation guard,
+        # exactly as on the split path — fused steps must not exempt a
+        # steady tight-deadline stream from starve_dispatches promotion
+        granted_slots = {id(s) for s, _ in chosen}
+        for s in cands:
+            if id(s) not in granted_slots:
+                s.sched_skips += 1
+
+        def aligned(n: int) -> int:
+            return -(-n // align) * align
+
+        # the bucket cap floored to the alignment, mirroring plan_mixed's
+        # budget: total <= cap by construction, and a non-aligned
+        # mixed_max_tokens can never produce an N_pad the Pallas kernel's
+        # N % tile_q assert would reject
+        cap = cfg.mixed_max_tokens - cfg.mixed_max_tokens % align
+        total = sum(aligned(ch) for _, ch in chosen) + aligned(1) * len(active)
+        N_pad = min(_next_pow2(max(total, align)), cap)
+        R_pad = self._mixed_row_bucket
+        max_pages_needed = 1
+        for s, ch in chosen:
+            pages = (s.prefill_pos + ch + cfg.page_size - 1) // cfg.page_size
+            max_pages_needed = max(max_pages_needed, pages)
+        for i in active:
+            pages = (int(self.seq_lens[i]) - 1) // cfg.page_size + 1
+            max_pages_needed = max(max_pages_needed, pages)
+        ctx_pages = min(_next_pow2(max_pages_needed), cfg.max_pages_per_seq)
+        P = ctx_pages + 1
+        pad_pos = P * cfg.page_size - 1  # pads write to the scratch tail
+
+        W = cfg.penalty_window
+        toks = np.zeros((N_pad,), np.int32)
+        positions = np.full((N_pad,), pad_pos, np.int32)
+        row_ids = np.full((N_pad,), R_pad - 1, np.int32)
+        row_starts = np.full((R_pad,), N_pad, np.int32)
+        row_lens = np.zeros((R_pad,), np.int32)
+        ctx_lens = np.zeros((R_pad,), np.int32)
+        tables = np.full((R_pad, P), SCRATCH_PAGE, np.int32)
+        last_flat = np.zeros((R_pad,), np.int32)
+        temps = np.zeros((R_pad,), np.float32)
+        top_ks = np.zeros((R_pad,), np.int32)
+        top_ps = np.ones((R_pad,), np.float32)
+        seeds = np.zeros((R_pad,), np.uint32)
+        pens = np.zeros((R_pad, 3), np.float32)
+        pens[:, 2] = 1.0  # repetition off
+        pen_rows = np.full((R_pad, W), -1, np.int32)
+
+        off = 0
+        row = 0
+        meta = []  # prefill rows: (slot, chunk, row)
+        decode_rows = []  # (row, lane_idx, slot)
+        for s, chunk in chosen:
+            start = s.prefill_pos
+            row_starts[row] = off
+            row_lens[row] = chunk
+            ctx_lens[row] = start
+            toks[off : off + chunk] = s.kv_prompt[start : start + chunk]
+            positions[off : off + chunk] = np.arange(start, start + chunk)
+            row_ids[off : off + aligned(chunk)] = row
+            tables[row, :ctx_pages] = self.page_tables[s.slot_idx][:ctx_pages]
+            last_flat[row] = off + chunk - 1
+            temps[row] = s.temperature
+            top_ks[row] = s.top_k
+            top_ps[row] = s.top_p
+            seeds[row] = s.sample_seed
+            pens[row] = (s.presence_penalty, s.frequency_penalty,
+                         s.repetition_penalty)
+            pen_rows[row] = self.recent[s.slot_idx]
+            s.sched_skips = 0
+            meta.append((s, chunk, row))
+            off += aligned(chunk)
+            row += 1
+        for i in active:
+            s = self.slots[i]
+            L = int(self.seq_lens[i])
+            row_starts[row] = off
+            row_lens[row] = 1
+            ctx_lens[row] = L - 1
+            toks[off] = int(self.tokens[i])
+            positions[off] = L - 1
+            row_ids[off : off + aligned(1)] = row
+            tables[row, :ctx_pages] = self.page_tables[i][:ctx_pages]
+            last_flat[row] = off
+            temps[row] = self.temps[i]
+            top_ks[row] = self.top_ks[i]
+            top_ps[row] = self.top_ps[i]
+            seeds[row] = self.seeds[i]
+            pens[row] = (self.presence[i], self.frequency[i],
+                         self.repetition[i])
+            # the device pen ring (decode carry) is not host-visible;
+            # rebuild this lane's window from the authoritative token
+            # sequence (ring-indexed by absolute position, so the patch
+            # after the fetch stays consistent with it)
+            self._fill_recent(i, s)
+            pen_rows[row] = self.recent[i]
+            decode_rows.append((row, i, s))
+            off += aligned(1)
+            row += 1
+
+        self._bcast(
+            "mixed",
+            {
+                "toks": toks, "positions": positions, "row_ids": row_ids,
+                "tables": tables, "row_starts": row_starts,
+                "row_lens": row_lens, "ctx_lens": ctx_lens,
+                "last_flat": last_flat, "temps": temps, "top_ks": top_ks,
+                "top_ps": top_ps, "seeds": seeds, "pens": pens,
+                "pen_rows": pen_rows,
+            },
+        )
+        first_dev = await self._run_on_device(
+            partial(
+                self._dev_mixed, toks, positions, row_ids, tables,
+                row_starts, row_lens, ctx_lens, last_flat, temps, top_ks,
+                top_ps, seeds, pens, pen_rows,
+            ),
+            tag="mixed", shape=(N_pad, row),
+        )
+        completions = []
+        progressed = []
+        for s, chunk, row_i in meta:
+            s.prefill_pos += chunk
+            progressed.append((s, s.prefill_pos))
+            if s.prefill_pos >= len(s.kv_prompt):
+                completions.append((s, row_i))
+        for row_i, i, s in decode_rows:
+            self.seq_lens[i] += 1
+        # rides the prefill-pending fetch (drained THIS step, so no decode
+        # block can dispatch against the stale device carry in between)
+        self._pending_prefill.append({
+            "first": first_dev, "done": completions,
+            "progressed": progressed, "decode": decode_rows,
+        })
+        self.mixed_steps += 1
+        self.mixed_padded_tokens += N_pad
+        self.mixed_real_tokens += sum(ch for _, ch, _ in meta) + len(decode_rows)
+        self._step_counter += 1
+        return True
 
     async def _dispatch_decode(self) -> bool:
         cfg = self.config
@@ -3269,6 +3655,7 @@ class JaxEngine:
                 self._dev_block, tag="block", shape=(K, B)
             )
             adv = cfg.block_advance
+        self._last_decode_shape = (B * adv, len(active) * adv)
         entry = {"lanes": [(i, self.slots[i]) for i in active], "toks": toks_dev}
         if cfg.spec_mode:
             # spec blocks advance lanes by a data-dependent amount: record
@@ -3317,6 +3704,36 @@ class JaxEngine:
                     await self._emit_prefill_result(slot, tok, lp, top)
                 else:
                     self._finish_prefill(slot, tok, lp, top)
+            # mixed-step decode rows: each active lane advanced ONE token
+            # inside the fused dispatch — emit it and re-sync the (stale)
+            # device decode carry for this lane via the patch path
+            for row, i, slot_ref in p.get("decode", []):
+                slot = self.slots[i]
+                if slot is None or slot is not slot_ref:
+                    continue  # released/preempted meanwhile
+                if slot.done or slot.context.is_stopped():
+                    self._emit_finish(slot, "cancelled")
+                    self._release_slot(slot)
+                    continue
+                tok = int(first_toks[row])
+                slot.seq.append(tok)
+                slot.generated += 1
+                slot.last_token = tok
+                self.tokens[i] = tok
+                lp = float(first_lps[row])
+                top = self._top_entry(slot, first_tids[row], first_tlps[row])
+                self._emit_tokens(
+                    slot, [tok],
+                    [lp] if slot.want_logprobs else [],
+                    [top] if top else [],
+                )
+                finish = self._finish_reason(slot, tok)
+                if finish:
+                    self._emit_finish(slot, finish)
+                    self._release_slot(slot)
+                else:
+                    self._fill_recent(i, slot)
+                    self._mark_lane_dirty(i)
 
         if want_block is not None:
             self._inflight.popleft()

@@ -14,7 +14,7 @@ example.
 """
 
 from .cost_model import CostModel
-from .policy import PrefillPlan, StepPlanner
+from .policy import MixedPlan, PrefillPlan, StepPlanner
 from .sla import SlaConfig
 
-__all__ = ["CostModel", "PrefillPlan", "SlaConfig", "StepPlanner"]
+__all__ = ["CostModel", "MixedPlan", "PrefillPlan", "SlaConfig", "StepPlanner"]

@@ -57,6 +57,25 @@ class PrefillPlan:
 
 
 @dataclass
+class MixedPlan:
+    """One unified mixed-step dispatch decision: which prefill chunks ride
+    the flat ragged buffer beside the active decode lanes, and how big
+    the buffer is (engine `_dispatch_mixed`)."""
+
+    bucket: int  # flat token bucket (pow2, <= config.mixed_max_tokens)
+    chosen: List  # prefill slots riding this dispatch, in row order
+    chunks: List[int]  # granted chunk per chosen slot (1:1 with chosen)
+    n_decode: int  # decode rows packed beside the chunks
+    reason: str  # "mixed" | "mixed-shrunk"
+    predicted_s: Optional[float] = None  # CostModel("mixed", ...) estimate
+    deferred_slots: int = 0  # candidates that did not fit this dispatch
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(n - 1, 0).bit_length()
+
+
+@dataclass
 class _Decision:
     """Per-step decision record (bounded history for stats/debugging)."""
 
@@ -268,6 +287,113 @@ class StepPlanner:
             slack_ms=self._min_slack_ms(cands, now),
         ))
         return None
+
+    def plan_mixed(
+        self,
+        cands: List,
+        n_decode: int,
+        align: int = 1,
+        now: Optional[float] = None,
+    ) -> Optional[MixedPlan]:
+        """Shape the unified mixed dispatch: greedily grant prefill chunks
+        (planner order, each padded to the packer's row alignment) into
+        the flat-token budget left beside `n_decode` one-token decode
+        rows. Returns None when nothing fits — the engine falls back to
+        the split path for this step. `cands` must already be in planner
+        order.
+
+        Under sla with an ITL target, the mixed step IS the decode step
+        (it advances every decode lane one token), so its predicted wall
+        time is budgeted directly against `itl_target_ms`: chunks are
+        halved until the CostModel("mixed", bucket, rows) estimate fits,
+        floored at one aligned unit per chunk (a mixed step never defers
+        outright — serving the decode lanes is the point).
+
+        Pure: no counters or decision records — the engine may still
+        abandon the plan (pipeline in flight, page-growth preemption);
+        it calls `commit_mixed` with what actually dispatched."""
+        cfg = self.config
+        if now is None:
+            now = time.monotonic()
+
+        def aligned(n: int) -> int:
+            return -(-n // align) * align
+
+        # floor the budget to the packer alignment: every granted span is
+        # a multiple of `align`, so an aligned budget keeps `space`
+        # aligned throughout and no grant can overpack the flat buffer
+        budget = cfg.mixed_max_tokens - cfg.mixed_max_tokens % align
+        dec_tokens = aligned(1) * n_decode
+        if dec_tokens >= budget:
+            return None  # too many decode lanes to fuse a chunk beside
+
+        chosen: List = []
+        chunks: List[int] = []
+        space = budget - dec_tokens
+        for s in cands[: cfg.max_prefill_batch]:
+            remaining = len(s.kv_prompt) - s.prefill_pos
+            take = min(remaining, cfg.max_prefill_chunk, space)
+            if take <= 0:
+                break
+            chosen.append(s)
+            chunks.append(take)
+            space -= aligned(take)
+
+        if not chosen:
+            return None
+
+        total = budget - space
+        bucket = min(_next_pow2(max(total, align)), budget)
+        rows = len(chosen) + n_decode
+        reason = "mixed"
+        t = self.cost.predict("mixed", bucket, rows)
+        if (
+            self.sla.policy == "sla"
+            and self.sla.itl_target_ms > 0
+            and t is not None
+        ):
+            itl_budget = self.sla.itl_target_ms / 1000.0
+            while t is not None and t > itl_budget and max(chunks) > align:
+                # halve the biggest chunk (floored at one aligned unit)
+                i = max(range(len(chunks)), key=lambda j: chunks[j])
+                chunks[i] = max(align, chunks[i] // 2)
+                total = dec_tokens + sum(aligned(ch) for ch in chunks)
+                bucket = min(_next_pow2(max(total, align)), budget)
+                t = self.cost.predict("mixed", bucket, rows)
+                reason = "mixed-shrunk"
+        return MixedPlan(
+            bucket=bucket, chosen=chosen, chunks=chunks, n_decode=n_decode,
+            reason=reason, predicted_s=t,
+            deferred_slots=len(cands) - len(chosen),
+        )
+
+    def commit_mixed(
+        self,
+        plan: MixedPlan,
+        dispatched,
+        now: Optional[float] = None,
+    ) -> None:
+        """Account a mixed dispatch the engine actually committed.
+        `dispatched` is the [(slot, chunk)] list that survived the
+        engine's post-plan re-filter (page-growth preemption can drop
+        slots) — counters and the decision record reflect dispatched
+        work only, never an abandoned plan (the split path's plan_prefill
+        would otherwise double-count the same step)."""
+        if now is None:
+            now = time.monotonic()
+        slots = [s for s, _ in dispatched]
+        granted = sum(ch for _, ch in dispatched)
+        if plan.reason == "mixed-shrunk":
+            self.itl_shrunk_steps += 1
+        self.granted_chunks += len(slots)
+        self.granted_tokens += granted
+        self._records.append(_Decision(
+            t=now, reason=plan.reason, bucket=plan.bucket,
+            lanes=len(slots) + plan.n_decode,
+            granted_tokens=granted, granted_slots=len(slots),
+            deferred_slots=plan.deferred_slots,
+            slack_ms=self._min_slack_ms(slots, now),
+        ))
 
     def _min_slack_ms(self, slots: List, now: float) -> Optional[float]:
         if not slots:

@@ -431,6 +431,11 @@ async def main():
         # tokens/batches ratio = tokens-per-delta-batch (serving-gap
         # coalescing diagnostic; mean > 1 in steady decode)
         "emit_batches", "emit_tokens",
+        # ragged unified dispatch (docs/ragged_attention.md): whether the
+        # fused mixed path is actually taken in production (mixed vs
+        # split step counts) and the padding each path pays
+        "mixed_steps", "split_steps", "mixed_padding_frac",
+        "split_padding_frac",
         # dynosched: scheduler queue/deadline pressure beside the raw
         # depth metric — est TTFT is the disagg router's routing signal,
         # deferred/shrunk/override counters show where the ITL budget and

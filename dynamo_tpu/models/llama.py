@@ -32,6 +32,7 @@ from ..ops.paged_attention import (
     paged_attention_decode,
     prefill_attention,
     prefill_attention_batched,
+    ragged_attention,
 )
 from ..parallel.mesh import PP_AXIS, SP_AXIS
 
@@ -315,6 +316,73 @@ def prefill_forward_batched(
     if all_logits:
         return qdot(x, head), kv_k, kv_v  # [B, T, vocab]
     last = x[jnp.arange(B), last_idx]  # [B, hidden]
+    logits = qdot(last, head)
+    return logits, kv_k, kv_v
+
+
+def ragged_forward(
+    params: Dict[str, Any],
+    config: LlamaConfig,
+    tokens: jax.Array,  # [N] flat packed: prefill chunks + decode singletons
+    positions: jax.Array,  # [N] absolute positions (pads -> scratch tail)
+    row_ids: jax.Array,  # [N] owning row per flat token
+    kv_k: jax.Array,  # [L, pages, page_size, kv_heads, head_dim]
+    kv_v: jax.Array,
+    page_tables: jax.Array,  # [R, max_pages] per-row tables (ctx-bounded)
+    row_starts: jax.Array,  # [R] flat index of each row's token 0
+    row_lens: jax.Array,  # [R] real tokens per row (1 for decode rows)
+    ctx_lens: jax.Array,  # [R] history length per row
+    last_flat: jax.Array,  # [R] flat index of each row's LAST real token
+    mlp_fn=None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """The unified mixed-step forward: ONE pass over a flat ragged token
+    buffer that packs prefill chunks (row_len > 1) and decode slots
+    (row_len == 1, ctx = seq_len - 1) — the single device dispatch behind
+    the engine's `_dispatch_mixed` (vs the split prefill-batch + decode
+    dispatches). Returns (logits_last [R, vocab], kv_k, kv_v) with every
+    row's chunk KV written into its pages; each row's last-token logits
+    feed on-device sampling (the next decode token / the prefill first
+    token). Attention rides ops/paged_attention.ragged_attention (Pallas
+    ragged kernel on TPU, XLA reference elsewhere)."""
+    c = config
+    mlp_fn = mlp_fn or _mlp
+    x = embed_rows(params["embed"], tokens, c.dtype)  # [N, H]
+    cos, sin = rope_cos_sin(positions, c.head_dim, c.rope_theta)
+    page_size = kv_k.shape[2]
+
+    # per-token physical page: gather the OWNING row's table, route pad
+    # positions (and any overshoot) to the scratch page — same trick as
+    # prefill_forward_batched, per flat token instead of per [B, T] cell
+    P_tab = page_tables.shape[1]
+    tab_tok = page_tables[row_ids]  # [N, max_pages]
+    logical = jnp.minimum(positions // page_size, P_tab - 1)
+    phys = jnp.take_along_axis(tab_tok, logical[:, None], axis=1)[:, 0]
+    phys = jnp.where(positions < P_tab * page_size, phys, 0)
+    offs = positions % page_size
+
+    for li in range(c.num_layers):
+        layer = jax.tree.map(lambda p: p[li], params["layers"])
+        h = rms_norm(x, layer["attn_norm"], c.rms_norm_eps)
+        q = qdot(h, layer["wq"]).astype(c.dtype)
+        k = qdot(h, layer["wk"]).astype(c.dtype)
+        v = qdot(h, layer["wv"]).astype(c.dtype)
+        q = q.reshape(-1, c.num_heads, c.head_dim)
+        k = k.reshape(-1, c.num_kv_heads, c.head_dim)
+        v = v.reshape(-1, c.num_kv_heads, c.head_dim)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        kv_k = kv_k.at[li, phys, offs].set(k)
+        kv_v = kv_v.at[li, phys, offs].set(v)
+        attn = ragged_attention(
+            q, kv_k[li], kv_v[li], page_tables, row_starts, row_lens, ctx_lens
+        )
+        attn = attn.reshape(-1, c.num_heads * c.head_dim)
+        x = x + qdot(attn, layer["wo"]).astype(c.dtype)
+        x = mlp_fn(layer, x, c)
+
+    x = rms_norm(x, params["final_norm"], c.rms_norm_eps)
+    last = x[last_flat]  # [R, hidden]
+    head = head_leaf(params)
     logits = qdot(last, head)
     return logits, kv_k, kv_v
 

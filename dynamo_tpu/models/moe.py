@@ -277,6 +277,30 @@ def prefill_forward(
     )
 
 
+def ragged_forward(
+    params: Dict[str, Any],
+    config: MoeConfig,
+    tokens: jax.Array,  # [N] flat packed mixed prefill+decode buffer
+    positions: jax.Array,  # [N]
+    row_ids: jax.Array,  # [N]
+    kv_k: jax.Array,
+    kv_v: jax.Array,
+    page_tables: jax.Array,  # [R, max_pages]
+    row_starts: jax.Array,  # [R]
+    row_lens: jax.Array,  # [R]
+    ctx_lens: jax.Array,  # [R]
+    last_flat: jax.Array,  # [R]
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Unified mixed-step forward (engine `_dispatch_mixed`), MoE MLP —
+    the flat buffer is already [tokens, H], exactly the shape expert
+    dispatch wants."""
+    return llama.ragged_forward(
+        params, config, tokens, positions, row_ids, kv_k, kv_v,
+        page_tables, row_starts, row_lens, ctx_lens, last_flat,
+        mlp_fn=moe_mlp,
+    )
+
+
 def _moe_mlp_nd(layer, x, c):
     """moe_mlp over [B, T, H] (batched prefill flattens the token dims —
     expert dispatch is position-independent)."""

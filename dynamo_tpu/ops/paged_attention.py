@@ -41,11 +41,7 @@ def prefill_attention(
     (the engine bounds the table length to the context bucket, so the
     gather is context-sized, not max-context-sized).
     """
-    if (
-        total_len is not None
-        and q.shape[-1] % 128 == 0  # Mosaic lane-slice alignment (see kernel)
-        and _use_pallas_decode()
-    ):
+    if total_len is not None and _pallas_eligible(q.shape[-1]):
         from .pallas_prefill_attention import paged_prefill_attention_pallas
 
         return paged_prefill_attention_pallas(
@@ -88,7 +84,7 @@ def prefill_attention_batched(
     context pages; elsewhere the XLA path gathers each (engine-bounded)
     page table.
     """
-    if q.shape[-1] % 128 == 0 and _use_pallas_decode():
+    if _pallas_eligible(q.shape[-1]):
         from .pallas_prefill_attention import paged_prefill_attention_pallas_batched
 
         return paged_prefill_attention_pallas_batched(
@@ -133,6 +129,17 @@ def _use_pallas_decode() -> bool:
         return False
 
 
+def _pallas_eligible(lane_dim: int) -> bool:
+    """THE Pallas dispatch gate, shared by every attention op in this
+    module: the DYNAMO_TPU_PAGED_ATTN env/platform knob (auto = single-chip
+    TPU) plus the Mosaic 128-lane DMA alignment on the kernel's lane
+    dimension. `lane_dim` is whatever the kernel's page DMA slices —
+    head_dim for the per-head-column prefill/ragged kernels, KH*D for the
+    whole-page decode kernels; smaller (tiny/test) models fall back to the
+    bounded XLA reference paths."""
+    return lane_dim % 128 == 0 and _use_pallas_decode()
+
+
 def paged_attention_decode_mixed(
     q: jax.Array,  # [B, H, D]
     kv_k_layer: jax.Array,  # [pages, page_size, KH, D] — READ-ONLY pool
@@ -162,7 +169,7 @@ def paged_attention_decode_mixed(
     K = loc_k.shape[1]
     scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
     KH_, D_ = kv_k_layer.shape[2], kv_k_layer.shape[3]
-    if (KH_ * D_) % 128 == 0 and _use_pallas_decode():
+    if _pallas_eligible(KH_ * D_):
         # pool chunks AND the local buffer flash-merge inside ONE kernel
         # launch — an XLA-level lse combine costs ~8 extra op launches per
         # layer-step, which dominates a 28-layer x 16-step fused block
@@ -210,11 +217,9 @@ def paged_attention_decode(
     materializing the gather; elsewhere the XLA reference path below runs.
     """
     KH_, D_ = kv_k_layer.shape[2], kv_k_layer.shape[3]
-    # Mosaic requires DMA lane slices 128-aligned: the decode kernel's page
-    # window has lane dim KH*D (whole-page copies), so KH*D must be a
-    # multiple of 128 (true for all flagship configs; tiny/test models fall
-    # back to the XLA path)
-    if (KH_ * D_) % 128 == 0 and _use_pallas_decode():
+    # the decode kernel's page window has lane dim KH*D (whole-page
+    # copies), so that is what must be 128-aligned here
+    if _pallas_eligible(KH_ * D_):
         from .pallas_paged_attention import paged_attention_decode_pallas
 
         return paged_attention_decode_pallas(
@@ -238,3 +243,84 @@ def paged_attention_decode(
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bkgs,bskd->bkgd", probs.astype(ctx_v.dtype), ctx_v)
     return out.reshape(B, H, D)
+
+
+def ragged_attention_reference(
+    q: jax.Array,  # [N, H, D] flat packed tokens (rope applied)
+    kv_k_layer: jax.Array,  # [pages, page_size, KH, D]
+    kv_v_layer: jax.Array,
+    page_tables: jax.Array,  # [R, max_pages]
+    row_starts: jax.Array,  # [R] flat index of row r's token 0 (ascending;
+    # padding rows sit at N)
+    row_lens: jax.Array,  # [R] real tokens per row (0 for padding rows)
+    ctx_lens: jax.Array,  # [R] history length before each row's chunk
+) -> jax.Array:
+    """XLA reference for the ragged mixed prefill+decode attention: every
+    flat token attends to its OWN row's pages (history + chunk, causal).
+    Returns [N, H, D]. The CPU/non-aligned fallback of the Pallas ragged
+    kernel (ops/pallas_ragged_attention.py) and the fuzz-parity oracle
+    (tests/test_ragged_attention.py). Tokens outside every row span
+    (alignment/tail padding) return finite garbage — callers only read
+    real rows."""
+    N, H, D = q.shape
+    R, P = page_tables.shape
+    page_size = kv_k_layer.shape[1]
+    KH = kv_k_layer.shape[2]
+    S = P * page_size
+    idx = jnp.arange(N)
+    # owning row per token: the last row whose start <= idx (padding
+    # tokens fold into the nearest preceding row and mask to nothing)
+    row_ids = jnp.clip(
+        jnp.sum(idx[:, None] >= row_starts[None, :], axis=1) - 1, 0, R - 1
+    )
+    local = idx - row_starts[row_ids]
+    positions = ctx_lens[row_ids] + local
+    totals = ctx_lens[row_ids] + row_lens[row_ids]
+    ctx_k = kv_k_layer[page_tables].reshape(R, S, KH, D)[row_ids]  # [N, S, KH, D]
+    ctx_v = kv_v_layer[page_tables].reshape(R, S, KH, D)[row_ids]
+    G = H // KH
+    qg = q.reshape(N, KH, G, D)
+    scores = jnp.einsum(
+        "nkgd,nskd->nkgs", qg, ctx_k, preferred_element_type=jnp.float32
+    ) / jnp.sqrt(jnp.asarray(D, jnp.float32))
+    key_pos = jnp.arange(S)
+    mask = (
+        (key_pos[None, :] <= positions[:, None])
+        & (key_pos[None, :] < totals[:, None])
+        & (local < row_lens[row_ids])[:, None]
+    )  # [N, S]
+    scores = jnp.where(mask[:, None, None, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("nkgs,nskd->nkgd", probs.astype(ctx_v.dtype), ctx_v)
+    return out.reshape(N, H, D)
+
+
+def ragged_attention(
+    q: jax.Array,  # [N, H, D]
+    kv_k_layer: jax.Array,  # [pages, page_size, KH, D]
+    kv_v_layer: jax.Array,
+    page_tables: jax.Array,  # [R, max_pages]
+    row_starts: jax.Array,  # [R]
+    row_lens: jax.Array,  # [R]
+    ctx_lens: jax.Array,  # [R]
+) -> jax.Array:
+    """Ragged mixed prefill+decode attention over paged KV: one call for a
+    flat buffer packing prefill chunks (T>1) and decode slots (T=1).
+    Returns [N, H, D].
+
+    Dispatch: on TPU the Pallas ragged kernel streams only each row's real
+    context pages; elsewhere the XLA reference path gathers the (engine-
+    bounded) tables. The Pallas path additionally requires row starts
+    aligned to `ragged_tile_q(q.dtype)` — the engine's mixed packer aligns
+    exactly when this gate says the kernel will run
+    (engine/engine.py:_dispatch_mixed)."""
+    if _pallas_eligible(q.shape[-1]):
+        from .pallas_ragged_attention import ragged_paged_attention_pallas
+
+        return ragged_paged_attention_pallas(
+            q, kv_k_layer, kv_v_layer, page_tables,
+            row_starts, row_lens, ctx_lens,
+        )
+    return ragged_attention_reference(
+        q, kv_k_layer, kv_v_layer, page_tables, row_starts, row_lens, ctx_lens
+    )

@@ -1,0 +1,302 @@
+"""Pallas TPU kernel: ragged paged-attention over mixed prefill+decode rows.
+
+One kernel for what used to be two dispatches: the engine's mixed step
+(engine/engine.py:_dispatch_mixed) packs the StepPlanner's chosen prefill
+chunks (T > 1) and the active decode lanes (T = 1) into ONE flat token
+buffer, and this kernel runs attention for every row in one grid — the
+"Ragged Paged Attention" shape (PAPERS.md) folding the roles of
+ops/pallas_prefill_attention.py and ops/pallas_paged_attention.py.
+
+Layouts (match ops/paged_attention.py and engine/kv_cache.py):
+    q:           [N, H, D]  flat packed tokens (rope applied, chunk KV
+                            already written into pages by the model)
+    kv_{k,v}:    [num_pages, page_size, KH, D]   (one layer)
+    page_tables: [R, max_pages] int32 (per-row logical -> physical)
+    row_starts:  [R] int32 — flat index of row r's first token, ascending,
+                 ALIGNED to the q tile (ragged_tile_q); padding rows sit
+                 at N (they own no tiles)
+    row_lens:    [R] int32 — real tokens in row r (1 for decode rows;
+                 0 for padding rows)
+    ctx_lens:    [R] int32 — history length before the row's chunk (the
+                 absolute position of its token 0)
+
+Design notes:
+  * grid = (num_tiles, KH): the flat buffer is cut into TQ-token q tiles
+    and a scalar-prefetched `tile_rows` map (built by the wrapper from
+    row_starts) names each tile's owning row — tiles never straddle rows
+    because the packer aligns row starts to TQ. Per (tile, kv-head) step
+    the kernel streams ONLY that row's real context pages (history +
+    chunk, causally bounded per tile) through a double-buffered VMEM
+    window and flash-accumulates, exactly like the prefill kernel; a
+    decode row is simply a one-tile row with ctx = seq_len - 1 and
+    row_len = 1.
+  * per-head DMA: each step fetches only kv-head k0's D-wide column slice
+    of a page, so total HBM bytes equal one pass over the real context.
+  * q tiles are pre-arranged [num_tiles, KH, TQ, G*D] by the wrapper; the
+    G query heads of the group are static column slices (no Mosaic
+    reshapes of minor dims).
+  * masking: a q row is real iff its in-row offset < row_len; keys are
+    valid iff key_pos <= q_pos and key_pos < ctx + row_len. Rows that are
+    pure padding produce finite garbage (discarded by the caller).
+  * REQUIRES head_dim % 128 == 0 (the per-head DMA slices the flattened
+    KH*D lane dim in head_dim-wide columns) — the dispatcher
+    (ops/paged_attention.py:_pallas_eligible) falls back to
+    ragged_attention_reference otherwise, and on CPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG = -1e30
+
+
+def ragged_tile_q(dtype) -> int:
+    """Q-tile height (and the row-start alignment the packer must honor):
+    the Mosaic second-minor register tile — 16 for bf16, 8 for f32."""
+    return 16 if jnp.dtype(dtype).itemsize < 4 else 8
+
+
+def _ragged_kernel(
+    # scalar prefetch
+    tr_ref,  # [num_tiles] int32 (SMEM) — owning row per q tile
+    rs_ref,  # [R] int32 (SMEM) — row start (flat token index)
+    rl_ref,  # [R] int32 (SMEM) — real row length
+    ctx_ref,  # [R] int32 (SMEM) — history length
+    pt_ref,  # [R, max_pages] int32 (SMEM)
+    # inputs
+    q_ref,  # [1, 1, TQ, G*D] VMEM block (one tile, one kv-head's group)
+    kv_k_hbm,  # [num_pages, page_size, KH*D] (ANY/HBM; flattened by wrapper)
+    kv_v_hbm,
+    # outputs
+    out_ref,  # [1, 1, TQ, G*D] VMEM block
+    # scratch
+    k_buf,  # [2, C, D] VMEM — this head's column slice of the chunk pages
+    v_buf,
+    k_sem,  # DMA sems [2, chunk_pages]
+    v_sem,
+    *,
+    page_size: int,
+    chunk_pages: int,
+    max_pages: int,
+    group: int,
+    head_dim: int,
+    tile_q: int,
+):
+    t = pl.program_id(0)
+    k0 = pl.program_id(1)
+    g, d, tq = group, head_dim, tile_q
+    chunk = chunk_pages * page_size
+    num_phys = kv_k_hbm.shape[0]
+
+    r = tr_ref[t]
+    ctx = ctx_ref[r]
+    row_len = rl_ref[r]
+    local0 = t * tq - rs_ref[r]  # this tile's first in-row offset
+    total_len = ctx + row_len
+    # causal limit for this tile: its last row is position ctx+local0+tq-1
+    limit = jnp.minimum(total_len, ctx + local0 + tq)
+    n_chunks = pl.cdiv(jnp.maximum(limit, 1), chunk)
+
+    def start_chunk(ci, slot):
+        for p in range(chunk_pages):
+            lp = jnp.minimum(ci * chunk_pages + p, max_pages - 1)
+            phys = jnp.minimum(pt_ref[r, lp], num_phys - 1)
+            pltpu.make_async_copy(
+                kv_k_hbm.at[phys, :, pl.ds(k0 * d, d)],
+                k_buf.at[slot, pl.ds(p * page_size, page_size)],
+                k_sem.at[slot, p],
+            ).start()
+            pltpu.make_async_copy(
+                kv_v_hbm.at[phys, :, pl.ds(k0 * d, d)],
+                v_buf.at[slot, pl.ds(p * page_size, page_size)],
+                v_sem.at[slot, p],
+            ).start()
+
+    def wait_chunk(ci, slot):
+        for p in range(chunk_pages):
+            lp = jnp.minimum(ci * chunk_pages + p, max_pages - 1)
+            phys = jnp.minimum(pt_ref[r, lp], num_phys - 1)
+            pltpu.make_async_copy(
+                kv_k_hbm.at[phys, :, pl.ds(k0 * d, d)],
+                k_buf.at[slot, pl.ds(p * page_size, page_size)],
+                k_sem.at[slot, p],
+            ).wait()
+            pltpu.make_async_copy(
+                kv_v_hbm.at[phys, :, pl.ds(k0 * d, d)],
+                v_buf.at[slot, pl.ds(p * page_size, page_size)],
+                v_sem.at[slot, p],
+            ).wait()
+
+    start_chunk(0, 0)
+
+    q_tile = q_ref[0, 0]  # [TQ, G*D], pre-scaled by 1/sqrt(D)
+    local = local0 + jax.lax.broadcasted_iota(jnp.int32, (tq, 1), 0)
+    q_pos = ctx + local
+    q_real = local < row_len  # [TQ, 1]
+
+    m0 = tuple(jnp.full((tq, 1), NEG, jnp.float32) for _ in range(g))
+    l0 = tuple(jnp.zeros((tq, 1), jnp.float32) for _ in range(g))
+    acc0 = tuple(jnp.zeros((tq, d), jnp.float32) for _ in range(g))
+
+    def body(ci, carry):
+        m, l, acc = carry
+        slot = jax.lax.rem(ci, 2)
+
+        @pl.when(ci + 1 < n_chunks)
+        def _():
+            start_chunk(ci + 1, jax.lax.rem(ci + 1, 2))
+
+        wait_chunk(ci, slot)
+        k = k_buf[slot]  # [C, D]
+        v = v_buf[slot]
+
+        key_pos = ci * chunk + jax.lax.broadcasted_iota(jnp.int32, (1, chunk), 1)
+        valid = q_real & (key_pos <= q_pos) & (key_pos < total_len)  # [TQ, C]
+
+        m_n, l_n, acc_n = [], [], []
+        for gi in range(g):
+            qg = q_tile[:, gi * d : (gi + 1) * d]  # [TQ, D] static slice
+            s = jax.lax.dot_general(
+                qg.astype(k.dtype),
+                k,
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [TQ, C]
+            s = jnp.where(valid, s, NEG)
+            mg = jnp.maximum(m[gi], jnp.max(s, axis=-1, keepdims=True))
+            alpha = jnp.exp(m[gi] - mg)
+            p = jnp.exp(s - mg)
+            lg = l[gi] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            pv = jax.lax.dot_general(
+                p.astype(v.dtype),
+                v,
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [TQ, D]
+            m_n.append(mg)
+            l_n.append(lg)
+            acc_n.append(acc[gi] * alpha + pv)
+        return tuple(m_n), tuple(l_n), tuple(acc_n)
+
+    m, l, acc = jax.lax.fori_loop(0, n_chunks, body, (m0, l0, acc0))
+    for gi in range(g):
+        out = acc[gi] / jnp.maximum(l[gi], 1e-30)
+        out_ref[0, 0, :, gi * d : (gi + 1) * d] = out.astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def ragged_paged_attention_pallas(
+    q: jax.Array,  # [N, H, D] flat packed tokens (rope applied)
+    kv_k_layer: jax.Array,  # [num_pages, page_size, KH, D]
+    kv_v_layer: jax.Array,
+    page_tables: jax.Array,  # [R, max_pages] int32
+    row_starts: jax.Array,  # [R] int32, ascending, TQ-aligned
+    row_lens: jax.Array,  # [R] int32
+    ctx_lens: jax.Array,  # [R] int32
+    *,
+    interpret: bool = False,
+) -> jax.Array:
+    """Ragged flash attention over paged KV; returns [N, H, D] (q.dtype).
+    Rows outside every [row_start, row_start+row_len) span return finite
+    garbage — the caller only reads real rows."""
+    N, H, D = q.shape
+    num_pages, page_size, KH, _ = kv_k_layer.shape
+    G = H // KH
+    max_pages = page_tables.shape[1]
+    tile_q = ragged_tile_q(q.dtype)
+    assert N % tile_q == 0, (
+        f"flat buffer {N} must be a multiple of the q tile {tile_q} "
+        "(the mixed packer pads to ragged_tile_q)"
+    )
+    num_tiles = N // tile_q
+    # KV streamed in ~512-position chunks: full 128-lane score tiles, and
+    # 2 slots x (K+V) x [C, D] comfortably inside VMEM
+    chunk_pages = max(1, 512 // page_size)
+    chunk_pages = min(chunk_pages, max_pages)
+
+    # each tile's owning row: rows are TQ-aligned and packed ascending, so
+    # the owner of tile t is the last row whose start <= t*TQ (tail-padding
+    # tiles fold into the last real row and mask to nothing)
+    t0s = jnp.arange(num_tiles, dtype=jnp.int32) * tile_q
+    tile_rows = jnp.maximum(
+        jnp.sum(
+            t0s[:, None] >= row_starts.astype(jnp.int32)[None, :], axis=1
+        ).astype(jnp.int32)
+        - 1,
+        0,
+    )
+
+    scale = 1.0 / (D**0.5)
+    # [N, H, D] -> [num_tiles, KH, TQ, G*D]: group g of kv-head k0 in
+    # column block g (same pre-arrangement as the prefill kernel)
+    q_g = (
+        (q * scale)
+        .reshape(num_tiles, tile_q, KH, G, D)
+        .transpose(0, 2, 1, 3, 4)
+        .reshape(num_tiles, KH, tile_q, G * D)
+    )
+    # flatten pages' minor dims in XLA (contiguous bitcast) — Mosaic cannot
+    # merge minor dims in-register
+    kv_k_flat = kv_k_layer.reshape(num_pages, page_size, KH * D)
+    kv_v_flat = kv_v_layer.reshape(num_pages, page_size, KH * D)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=5,
+        grid=(num_tiles, KH),
+        in_specs=[
+            pl.BlockSpec((1, 1, tile_q, G * D), lambda t, k0, *_: (t, k0, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, tile_q, G * D), lambda t, k0, *_: (t, k0, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, chunk_pages * page_size, D), kv_k_layer.dtype),
+            pltpu.VMEM((2, chunk_pages * page_size, D), kv_v_layer.dtype),
+            pltpu.SemaphoreType.DMA((2, chunk_pages)),
+            pltpu.SemaphoreType.DMA((2, chunk_pages)),
+        ],
+    )
+    kernel = functools.partial(
+        _ragged_kernel,
+        page_size=page_size,
+        chunk_pages=chunk_pages,
+        max_pages=max_pages,
+        group=G,
+        head_dim=D,
+        tile_q=tile_q,
+    )
+    cost = pl.CostEstimate(
+        flops=4 * N * H * D * max_pages * page_size // 2,
+        bytes_accessed=2 * num_tiles * max_pages * page_size * KH * D * 2,
+        transcendentals=N * H * max_pages * page_size // 2,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((num_tiles, KH, tile_q, G * D), q.dtype),
+        cost_estimate=cost,
+        interpret=interpret,
+    )(
+        tile_rows,
+        row_starts.astype(jnp.int32),
+        row_lens.astype(jnp.int32),
+        ctx_lens.astype(jnp.int32),
+        page_tables.astype(jnp.int32),
+        q_g,
+        kv_k_flat,
+        kv_v_flat,
+    )
+    # [num_tiles, KH, TQ, G*D] -> [N, H, D]
+    return (
+        out.reshape(num_tiles, KH, tile_q, G, D)
+        .transpose(0, 2, 1, 3, 4)
+        .reshape(N, H, D)
+    )

@@ -197,8 +197,15 @@ ENV_REGISTRY: tuple = (
            "engine/engine.py"),
     EnvVar("DYNAMO_TPU_PAGED_ATTN", "enum", "auto",
            "Paged-attention kernel selection: auto / pallas / xla "
-           "reference.",
+           "reference. One gate (`_pallas_eligible`) covers the prefill, "
+           "decode, and ragged mixed-step kernels.",
            "ops/paged_attention.py"),
+    EnvVar("DYN_MIXED_DISPATCH", "bool", "1",
+           "Ragged unified mixed dispatch: fuse the step's prefill chunks "
+           "and active decode lanes into one device call "
+           "(docs/ragged_attention.md). EngineConfig.mixed_dispatch "
+           "overrides.",
+           "engine/engine.py"),
 )
 
 

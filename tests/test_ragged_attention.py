@@ -1,0 +1,217 @@
+"""Ragged unified attention: interpreter-mode fuzz parity vs the XLA
+reference, plus cross-checks against the pre-existing prefill/decode ops.
+
+The ragged kernel (ops/pallas_ragged_attention.py) runs one grid over a
+flat token buffer packing prefill chunks (T>1) and decode slots (T=1);
+`ragged_attention_reference` (ops/paged_attention.py) is its oracle and
+the engine's CPU/non-aligned fallback. Runs in Pallas interpreter mode on
+the CPU test mesh (conftest pins JAX_PLATFORMS=cpu); on real TPU the same
+kernel compiles via Mosaic.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.ops import paged_attention as ref_ops
+from dynamo_tpu.ops.pallas_ragged_attention import (
+    ragged_paged_attention_pallas,
+    ragged_tile_q,
+)
+
+
+def _pack_rows(rows, tile_q, R_pad=None):
+    """rows = [(row_len, ctx_len)] -> (row_starts, row_lens, ctx_lens, N)
+    with starts tile-aligned (the engine packer's layout)."""
+    starts, lens, ctxs = [], [], []
+    off = 0
+    for (length, ctx) in rows:
+        starts.append(off)
+        lens.append(length)
+        ctxs.append(ctx)
+        off += -(-length // tile_q) * tile_q
+    N = -(-max(off, tile_q) // tile_q) * tile_q
+    R_pad = R_pad or len(rows)
+    pad = R_pad - len(rows)
+    return (
+        np.array(starts + [N] * pad, np.int32),
+        np.array(lens + [0] * pad, np.int32),
+        np.array(ctxs + [0] * pad, np.int32),
+        N,
+    )
+
+
+def _mk_ragged_case(rows, H=8, KH=4, D=32, page_size=8, seed=0, R_pad=None,
+                    dtype=jnp.float32):
+    rng = np.random.RandomState(seed)
+    tile_q = ragged_tile_q(dtype)
+    row_starts, row_lens, ctx_lens, N = _pack_rows(rows, tile_q, R_pad)
+    R = len(row_starts)
+    max_pages = max(
+        (int(c) + int(l) + page_size - 1) // page_size + 1
+        for l, c in rows
+    ) + 1
+    pages = R * max_pages + 4
+    q = jnp.asarray(rng.randn(N, H, D), dtype)
+    kv_k = jnp.asarray(rng.randn(pages, page_size, KH, D), dtype)
+    kv_v = jnp.asarray(rng.randn(pages, page_size, KH, D), dtype)
+    pt = jnp.asarray(
+        rng.choice(pages, size=(R, max_pages), replace=False).astype(np.int32)
+    )
+    return (
+        q, kv_k, kv_v, pt,
+        jnp.asarray(row_starts), jnp.asarray(row_lens), jnp.asarray(ctx_lens),
+        row_starts, row_lens, N,
+    )
+
+
+def _assert_real_rows_close(got, want, row_starts, row_lens, rtol, atol):
+    got, want = np.asarray(got, np.float32), np.asarray(want, np.float32)
+    assert got.shape == want.shape  # bit-identical shapes
+    assert got.dtype == want.dtype
+    for s, l in zip(row_starts, row_lens):
+        if l:
+            np.testing.assert_allclose(
+                got[s : s + l], want[s : s + l], rtol=rtol, atol=atol
+            )
+
+
+MIX = [(24, 7), (1, 13), (1, 40), (9, 0), (1, 1), (17, 31)]
+
+
+@pytest.mark.parametrize(
+    "rows,name",
+    [
+        (MIX, "mixed"),
+        ([(1, 5), (1, 17), (1, 64), (1, 1)], "all_decode"),
+        ([(32, 0), (16, 8), (40, 24)], "all_prefill"),
+        # context lengths straddling page boundaries (page_size=8): ctx at
+        # page_size-1 / page_size / page_size+1, and chunk ends mid-page
+        ([(1, 7), (1, 8), (1, 9), (5, 15), (11, 16), (3, 17)], "page_straddle"),
+    ],
+)
+def test_ragged_kernel_matches_reference(rows, name):
+    (q, kv_k, kv_v, pt, rs, rl, cl, starts, lens, _N) = _mk_ragged_case(
+        rows, seed=len(rows)
+    )
+    want = ref_ops.ragged_attention_reference(q, kv_k, kv_v, pt, rs, rl, cl)
+    got = ragged_paged_attention_pallas(
+        q, kv_k, kv_v, pt, rs, rl, cl, interpret=True
+    )
+    _assert_real_rows_close(got, want, starts, lens, rtol=2e-3, atol=2e-3)
+
+
+@pytest.mark.parametrize("gqa", [(8, 8), (8, 2), (4, 1)])
+def test_ragged_kernel_gqa_group_sizes(gqa):
+    H, KH = gqa
+    (q, kv_k, kv_v, pt, rs, rl, cl, starts, lens, _N) = _mk_ragged_case(
+        MIX, H=H, KH=KH, seed=H * 7 + KH
+    )
+    want = ref_ops.ragged_attention_reference(q, kv_k, kv_v, pt, rs, rl, cl)
+    got = ragged_paged_attention_pallas(
+        q, kv_k, kv_v, pt, rs, rl, cl, interpret=True
+    )
+    _assert_real_rows_close(got, want, starts, lens, rtol=2e-3, atol=2e-3)
+
+
+def test_ragged_kernel_bf16_and_padding_rows():
+    """bf16 (the production KV dtype, 16-row tiles) + padded row bucket:
+    trailing zero-length rows must not disturb real rows."""
+    (q, kv_k, kv_v, pt, rs, rl, cl, starts, lens, _N) = _mk_ragged_case(
+        [(20, 5), (1, 33), (3, 0)], seed=9, R_pad=8, dtype=jnp.bfloat16
+    )
+    want = ref_ops.ragged_attention_reference(q, kv_k, kv_v, pt, rs, rl, cl)
+    got = ragged_paged_attention_pallas(
+        q, kv_k, kv_v, pt, rs, rl, cl, interpret=True
+    )
+    _assert_real_rows_close(got, want, starts, lens, rtol=5e-2, atol=5e-2)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_ragged_fuzz_parity(seed):
+    """Random mixes of prefill chunks and decode slots with page-boundary-
+    straddling context lengths — the kernel and the XLA oracle must agree
+    on every real row."""
+    rng = np.random.RandomState(100 + seed)
+    page_size = int(rng.choice([8, 16]))
+    n_rows = rng.randint(2, 7)
+    rows = []
+    for _ in range(n_rows):
+        if rng.rand() < 0.5:
+            rows.append((1, int(rng.randint(1, 70))))  # decode slot
+        else:
+            rows.append(
+                (int(rng.randint(2, 40)), int(rng.randint(0, 40)))
+            )  # prefill chunk
+    KH = int(rng.choice([1, 2, 4]))
+    H = KH * int(rng.choice([1, 2, 4]))
+    (q, kv_k, kv_v, pt, rs, rl, cl, starts, lens, _N) = _mk_ragged_case(
+        rows, H=H, KH=KH, page_size=page_size, seed=seed, R_pad=n_rows + 2
+    )
+    want = ref_ops.ragged_attention_reference(q, kv_k, kv_v, pt, rs, rl, cl)
+    got = ragged_paged_attention_pallas(
+        q, kv_k, kv_v, pt, rs, rl, cl, interpret=True
+    )
+    _assert_real_rows_close(got, want, starts, lens, rtol=2e-3, atol=2e-3)
+
+
+# --------------------------------------------------------------------- #
+# the reference itself vs the pre-existing split-path ops: a ragged row
+# must equal the same computation done the split way
+# --------------------------------------------------------------------- #
+
+
+def test_reference_prefill_row_equals_batched_prefill_op():
+    rows = [(24, 7), (1, 13)]
+    (q, kv_k, kv_v, pt, rs, rl, cl, starts, lens, _N) = _mk_ragged_case(
+        rows, seed=3
+    )
+    ref = ref_ops.ragged_attention_reference(q, kv_k, kv_v, pt, rs, rl, cl)
+    T, ctx = rows[0]
+    qb = q[starts[0] : starts[0] + T][None]
+    positions = jnp.asarray(np.arange(ctx, ctx + T))[None]
+    want = ref_ops.prefill_attention_batched(
+        qb, kv_k, kv_v, positions, pt[0:1],
+        jnp.asarray([ctx + T]), jnp.asarray([ctx]),
+    )
+    np.testing.assert_allclose(
+        np.asarray(ref)[starts[0] : starts[0] + T], np.asarray(want)[0],
+        rtol=2e-3, atol=2e-3,
+    )
+
+
+def test_reference_decode_row_equals_decode_op():
+    rows = [(24, 7), (1, 13)]
+    (q, kv_k, kv_v, pt, rs, rl, cl, starts, lens, _N) = _mk_ragged_case(
+        rows, seed=3
+    )
+    ref = ref_ops.ragged_attention_reference(q, kv_k, kv_v, pt, rs, rl, cl)
+    # decode row: ctx=13, len=1 == classic decode with seq_len 14 over a
+    # pool already holding the current token's KV
+    qd = q[starts[1] : starts[1] + 1]
+    want = ref_ops.paged_attention_decode(
+        qd, kv_k, kv_v, pt[1:2], jnp.asarray([14])
+    )
+    np.testing.assert_allclose(
+        np.asarray(ref)[starts[1] : starts[1] + 1], np.asarray(want),
+        rtol=2e-3, atol=2e-3,
+    )
+
+
+def test_pallas_eligible_gate_is_shared():
+    """The centralized gate: env knob + 128-lane alignment, one spelling
+    for prefill/decode/ragged dispatch."""
+    import os
+
+    os.environ["DYNAMO_TPU_PAGED_ATTN"] = "pallas"
+    try:
+        assert ref_ops._pallas_eligible(128)
+        assert ref_ops._pallas_eligible(256)
+        assert not ref_ops._pallas_eligible(64)
+    finally:
+        os.environ.pop("DYNAMO_TPU_PAGED_ATTN", None)
+    os.environ["DYNAMO_TPU_PAGED_ATTN"] = "xla"
+    try:
+        assert not ref_ops._pallas_eligible(128)
+    finally:
+        os.environ.pop("DYNAMO_TPU_PAGED_ATTN", None)

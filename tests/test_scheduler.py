@@ -41,6 +41,7 @@ class _FakeCfg:
     max_prefill_chunk: int = 256
     decode_block_steps: int = 4
     max_num_seqs: int = 32
+    mixed_max_tokens: int = 512
 
 
 @dataclass
@@ -348,6 +349,69 @@ def test_unknown_cost_means_no_constraint():
 # --------------------------------------------------------------------------- #
 
 
+def test_plan_mixed_packs_chunks_beside_decode_rows():
+    """plan_mixed grants aligned prefill chunks into the flat-token budget
+    left beside the decode rows; the bucket is the pow2 cover of the
+    packed total."""
+    p = _planner(policy="fifo")
+    cands = _slots(2, prompt_len=100)
+    plan = p.plan_mixed(cands, n_decode=4, align=8)
+    assert plan is not None and plan.reason == "mixed"
+    assert plan.chosen == cands and plan.chunks == [100, 100]
+    assert plan.n_decode == 4
+    # 2x ceil(100/8)*8 = 208 chunk span + 4x8 decode span = 240 -> 256
+    assert plan.bucket == 256
+    # plan_mixed is pure — grants count only on engine commit
+    assert p.granted_tokens == 0 and p.granted_chunks == 0
+    p.commit_mixed(plan, list(zip(plan.chosen, plan.chunks)))
+    assert p.granted_tokens == 200 and p.granted_chunks == 2
+
+
+def test_plan_mixed_non_aligned_budget_never_overpacks():
+    """A mixed_max_tokens that is not a multiple of the packer alignment
+    is floored to it: the granted spans can never exceed the flat buffer
+    the engine will actually allocate (regression: 519-token budget with
+    align=8 used to grant a 520-token span, writing past N_pad)."""
+    p = _planner(policy="fifo", cfg=_FakeCfg(mixed_max_tokens=519))
+    cands = _slots(3, prompt_len=400)
+    plan = p.plan_mixed(cands, n_decode=1, align=8)
+    assert plan is not None
+    span = sum(-(-ch // 8) * 8 for ch in plan.chunks) + 8  # + decode row
+    assert span <= 519 - 519 % 8
+    assert plan.bucket % 8 == 0 and plan.bucket <= 519 - 519 % 8
+
+
+def test_plan_mixed_respects_budget_and_declines_when_full():
+    p = _planner(policy="fifo")
+    # decode rows alone exceed the flat budget -> no fused step
+    assert p.plan_mixed(_slots(1), n_decode=600, align=1) is None
+    # chunks shrink to what fits beside the decode rows
+    cands = _slots(3, prompt_len=400)
+    plan = p.plan_mixed(cands, n_decode=100, align=1)
+    assert plan is not None
+    assert 100 + sum(plan.chunks) <= 512
+    assert all(ch <= 256 for ch in plan.chunks)  # max_prefill_chunk cap
+
+
+def test_plan_mixed_itl_budget_shrinks_chunks():
+    """Under sla with an ITL target, a too-slow predicted mixed step
+    halves chunks until the estimate fits (never defers outright — the
+    decode lanes ride the same dispatch)."""
+    p = _planner(policy="sla", itl_ms=10.0)
+    # teach the model: big mixed dispatches are slow, small ones fast
+    for _ in range(12):
+        p.cost.observe("mixed", 512, 10, 0.050)
+        p.cost.observe("mixed", 64, 10, 0.004)
+    cands = _slots(1, prompt_len=400)
+    plan = p.plan_mixed(cands, n_decode=8, align=8)
+    assert plan is not None
+    assert plan.reason == "mixed-shrunk"
+    assert plan.chunks[0] < 256
+    assert p.itl_shrunk_steps == 0  # pure until commit
+    p.commit_mixed(plan, list(zip(plan.chosen, plan.chunks)))
+    assert p.itl_shrunk_steps == 1
+
+
 def test_deadline_lifecycle_and_reset():
     p = _planner("sla")
     slots = _slots(3)
@@ -648,6 +712,73 @@ def test_disagg_routes_on_estimated_local_ttft():
 # --------------------------------------------------------------------------- #
 # chaos arm: engine.step fault mid-schedule -> no orphaned deadline state
 # --------------------------------------------------------------------------- #
+
+
+def test_mixed_dispatch_streams_byte_identical_to_split_path():
+    """PR 7 parity suite extended to the mixed dispatch (ISSUE 8
+    acceptance): on the same scripted staggered trace under the fifo
+    policy, the unified ragged path and the split prefill+decode path
+    must emit byte-identical token streams — sampling draws are
+    (seed, position)-keyed, so the dispatch shape must not leak into the
+    output. The unified arm must actually take the fused path at least
+    once (mixed_steps > 0), or this test proves nothing."""
+    import jax
+    import jax.numpy as jnp
+
+    from dynamo_tpu.engine import EngineConfig, JaxEngine
+    from dynamo_tpu.models import llama
+
+    cfg_model = llama.LlamaConfig.tiny(dtype=jnp.float32)
+    params = llama.init_params(cfg_model, jax.random.PRNGKey(0))
+
+    async def drive(mixed: bool):
+        cfg = EngineConfig(
+            model="tiny", max_num_seqs=4, page_size=16, num_pages=128,
+            max_model_len=256, decode_block_steps=4,
+            mixed_dispatch=mixed,
+        )
+        eng = JaxEngine(cfg, model_config=cfg_model, params=params)
+
+        async def one(prompt, osl, seed):
+            req = PreprocessedRequest(
+                token_ids=prompt,
+                stop_conditions={"max_tokens": osl, "ignore_eos": True},
+                sampling_options={"temperature": 1.0, "seed": seed},
+            ).to_dict()
+            toks = []
+            async for item in eng.generate(req, Context()):
+                assert item.get("event") != "error", item.get("comment")
+                if item.get("data"):
+                    toks.extend(item["data"]["token_ids"])
+            return toks
+
+        rng = random.Random(42)
+        prompts = [
+            [rng.randrange(5, 500) for _ in range(n)] for n in (40, 60, 33)
+        ]
+        # staggered: the first request decodes while the others prefill —
+        # the unified arm serves those steps with the fused dispatch
+        t1 = asyncio.create_task(one(prompts[0], 24, 1))
+        await asyncio.sleep(0.4)
+        t2 = asyncio.create_task(one(prompts[1], 20, 2))
+        await asyncio.sleep(0.2)
+        t3 = asyncio.create_task(one(prompts[2], 12, 3))
+        streams = await asyncio.gather(t1, t2, t3)
+        stats = eng.stats()
+        await eng.close()
+        return streams, stats
+
+    async def main():
+        unified, s_uni = await drive(True)
+        split, s_split = await drive(False)
+        assert s_uni["mixed_steps"] > 0, \
+            "the unified arm never took the fused path — trace too fast?"
+        assert s_split["mixed_steps"] == 0
+        assert unified == split
+        # the fused step fed the cost model under its own shape tag
+        assert s_uni["dispatch_mixed_count"] == s_uni["mixed_steps"]
+
+    asyncio.run(main())
 
 
 def test_engine_step_fault_leaves_no_orphaned_deadline_state():

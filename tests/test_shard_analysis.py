@@ -254,6 +254,42 @@ def test_pallas_grid_index_map_arity_mismatch_is_one_violation(tmp_path):
     assert "rank 2" in hits[0].message and "index_map" in hits[0].message
 
 
+def test_ragged_kernel_index_map_arity_mistake_is_one_violation(tmp_path):
+    """Seeded-bug reconstruction on the REAL ragged unified-attention
+    kernel (ops/pallas_ragged_attention.py — ROADMAP names it a stress
+    test for this rule): dropping the kv-head grid parameter from its
+    q-tile index_map (`lambda t, k0, *_` -> `lambda t, *_`) silently
+    binds the first scalar-prefetch ref (tile_rows) as a grid index.
+    Exactly one violation, anchored at the mutated lambda."""
+    real = (REPO / "dynamo_tpu/ops/pallas_ragged_attention.py").read_text()
+    assert real.count("lambda t, k0, *_: (t, k0, 0, 0)") == 2  # in + out spec
+    bad = real.replace(
+        "pl.BlockSpec((1, 1, tile_q, G * D), lambda t, k0, *_: (t, k0, 0, 0)),\n"
+        "            pl.BlockSpec(memory_space=pl.ANY),",
+        "pl.BlockSpec((1, 1, tile_q, G * D), lambda t, *_: (t, 0, 0, 0)),\n"
+        "            pl.BlockSpec(memory_space=pl.ANY),",
+    )
+    assert bad != real
+    project = make_project(tmp_path, {
+        "dynamo_tpu/ops/pallas_ragged_attention.py": bad,
+    })
+    hits = rule_hits(project, PallasGridRule())
+    assert len(hits) == 1
+    assert "rank 2" in hits[0].message and "index_map" in hits[0].message
+
+
+def test_ragged_kernel_passes_shard_pallas_grid_clean():
+    """The shipped ragged kernel itself is clean under the rule (the
+    tree-clean gate covers it too; this pins the specific file so a
+    regression names the kernel, not the whole tree)."""
+    project = Project.load(REPO)
+    hits = [
+        v for v in rule_hits(project, PallasGridRule())
+        if "pallas_ragged_attention" in str(v.path)
+    ]
+    assert hits == []
+
+
 def test_pallas_grid_flags_missing_vararg_under_scalar_prefetch(tmp_path):
     bad = _GOOD_PALLAS.replace(
         "out_specs=pl.BlockSpec((1, H, D), lambda b, t, *_: (b, 0, 0)),",
