@@ -48,6 +48,8 @@ async def main():
     if args.discovery:
         cfg.discovery_endpoint = args.discovery
     drt = await DistributedRuntime.create(cfg)
+    # SIGTERM (planner scale-down) walks the graceful drain, not a hard exit
+    drt.install_signal_handlers()
 
     hidden = args.hidden_size
     if hidden is None:

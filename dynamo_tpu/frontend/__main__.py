@@ -59,6 +59,8 @@ async def main():
     if args.discovery:
         cfg.discovery_endpoint = args.discovery
     drt = await DistributedRuntime.create(cfg, embed_discovery=args.embed_discovery)
+    # SIGTERM walks the graceful drain, not a hard exit mid-stream
+    drt.install_signal_handlers()
 
     manager = ModelManager()
     router_mode = RouterMode(args.router_mode)

@@ -374,6 +374,8 @@ async def main():
     if args.discovery:
         cfg.discovery_endpoint = args.discovery
     drt = await DistributedRuntime.create(cfg)
+    # SIGTERM (planner scale-down) walks the graceful drain, not a hard exit
+    drt.install_signal_handlers()
     if spmd is not None:
         shutdown_holder["shutdown"] = drt.shutdown
     if data_plane is not None:

@@ -191,7 +191,13 @@ class KvPushRouter:
         hashes the prompt ONCE and reuses them here, for the overlay record
         and for the sync publish)."""
         live = self.client.instance_ids()
-        if not live:
+        # NEW streams schedule only onto ready instances: a `draining`
+        # worker (scale-down in progress) would reject the stream anyway —
+        # same invariant as PushRouter._pick. It stays in `live` though:
+        # its index/overlay state is pruned by the lease-revoke delete,
+        # not by the drain mark.
+        ready = self.client.ready_instance_ids()
+        if not ready:
             raise StreamLost(f"no instances for {self.client.endpoint.subject}")
         self._prune_dead_workers(live)
         pruned = self.scheduler.prune_mirrored()
@@ -219,7 +225,7 @@ class KvPushRouter:
         saved = self.scheduler.config
         self.scheduler.config = cfg
         try:
-            worker = self.scheduler.schedule(request_blocks, scores.scores, live)
+            worker = self.scheduler.schedule(request_blocks, scores.scores, ready)
         finally:
             self.scheduler.config = saved
         return worker, scores.scores.get(worker, 0)

@@ -60,10 +60,19 @@ class ModelDeploymentCard:
 
 def mdc_key(endpoint: Endpoint, card: ModelDeploymentCard) -> str:
     """Discovery key for a card published by an endpoint's worker
-    (reference MODEL_ROOT_PATH v1/mdc/)."""
+    (reference MODEL_ROOT_PATH v1/mdc/).
+
+    The key is PER-INSTANCE: without the instance-id suffix, N replicas of
+    the same model share one key whose lease belongs to whichever replica
+    registered LAST — when that replica drains (planner scale-down kills
+    newest-first), its lease revoke deletes the shared card and the
+    frontend 404s the model while live replicas still serve it. With
+    per-instance keys the ModelWatcher's existing refcount keeps the model
+    up until the LAST replica leaves."""
     return (
         f"{MODEL_ROOT}{endpoint.component.namespace}/"
-        f"{endpoint.component.name}/{endpoint.name}/{card.slug()}"
+        f"{endpoint.component.name}/{endpoint.name}/{card.slug()}/"
+        f"{endpoint.drt.instance_id:x}"
     )
 
 

@@ -45,6 +45,9 @@ async def main():
     if args.discovery:
         cfg.discovery_endpoint = args.discovery
     drt = await DistributedRuntime.create(cfg)
+    # SIGTERM (planner scale-down) must walk the graceful drain, not the
+    # interpreter's default hard exit that kills in-flight streams
+    drt.install_signal_handlers()
 
     engine_args = MockEngineArgs(
         model_name=args.model_name,

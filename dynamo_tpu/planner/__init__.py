@@ -20,7 +20,7 @@ from .load_predictor import (
 )
 from .metrics_source import FrontendMetricsSource
 from .perf_interpolation import DecodeInterpolator, PrefillInterpolator
-from .planner_core import Metrics, Planner, SlaArgs
+from .planner_core import Metrics, Planner, ScaleDecision, SlaArgs
 
 __all__ = [
     "ARPredictor",
@@ -34,6 +34,7 @@ __all__ = [
     "NoopConnector",
     "Planner",
     "PrefillInterpolator",
+    "ScaleDecision",
     "SlaArgs",
     "VirtualConnector",
     "make_predictor",

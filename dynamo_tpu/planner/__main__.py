@@ -12,6 +12,8 @@ import asyncio
 import logging
 import shlex
 
+logger = logging.getLogger(__name__)
+
 
 def parse_args(argv=None) -> argparse.Namespace:
     ap = argparse.ArgumentParser(description="SLA planner")
@@ -36,6 +38,11 @@ def parse_args(argv=None) -> argparse.Namespace:
     ap.add_argument("--prefill-cmd", default="", help="argv for a prefill worker (local connector)")
     ap.add_argument("--decode-cmd", default="", help="argv for a decode worker (local connector)")
     ap.add_argument("--namespace", default="dynamo")
+    ap.add_argument("--prefill-component", default="prefill",
+                    help="discovery component name counted as prefill capacity")
+    ap.add_argument("--decode-component", default="backend",
+                    help="discovery component name counted as decode capacity "
+                    "(mocker workers default to 'mocker')")
     ap.add_argument("--log-level", default="INFO")
     return ap.parse_args(argv)
 
@@ -54,20 +61,28 @@ async def amain(args: argparse.Namespace) -> None:
     from .planner_core import Planner, SlaArgs
 
     host, port = discovery_address()
-    disc = DiscoveryClient(host, port)
-    await disc.connect()
+    # NB: connect is a classmethod factory — `DiscoveryClient(host, port)`
+    # followed by an instance .connect() was a TypeError waiting for the
+    # first real deployment of this entrypoint
+    disc = await DiscoveryClient.connect(host, port)
 
+    counts = DiscoveryWorkerCounts(
+        disc, namespace=args.namespace,
+        prefill_component=args.prefill_component,
+        decode_component=args.decode_component,
+    )
     if args.no_operation or args.connector == "noop":
         connector = NoopConnector()
     elif args.connector == "local":
         connector = LocalProcessConnector(
-            shlex.split(args.prefill_cmd), shlex.split(args.decode_cmd)
+            shlex.split(args.prefill_cmd), shlex.split(args.decode_cmd),
+            ready_fn=counts.ready_fn(),
         )
     else:
         connector = VirtualConnector(disc)
 
     planner = Planner(
-        SlaArgs(
+        SlaArgs.from_env(
             ttft=args.ttft,
             itl=args.itl,
             adjustment_interval=args.adjustment_interval,
@@ -80,15 +95,37 @@ async def amain(args: argparse.Namespace) -> None:
         PrefillInterpolator(profile_results_dir=args.profile_results_dir),
         DecodeInterpolator(profile_results_dir=args.profile_results_dir),
         FrontendMetricsSource(args.frontend_url),
-        DiscoveryWorkerCounts(disc, namespace=args.namespace),
+        counts,
         connector,
     )
+    # SIGTERM/SIGINT stop the loop cleanly so the finally below actually
+    # runs — the interpreter's default SIGTERM exit would orphan every
+    # connector-managed worker subprocess
+    import signal
+
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            loop.add_signal_handler(sig, planner.stop)
+        except (NotImplementedError, RuntimeError):
+            break
     try:
         await planner.run()
     finally:
         # shielded: a cancellation (Ctrl-C) landing mid-close must not
-        # abandon the discovery teardown
-        await asyncio.shield(disc.close())
+        # abandon the teardown. Connector-managed children die with the
+        # planner (SIGTERM → their own graceful drain) — otherwise a
+        # planner restart would spawn a duplicate fleet beside orphans.
+        async def _teardown():
+            shutdown = getattr(connector, "shutdown", None)
+            if shutdown is not None:
+                try:
+                    await shutdown()
+                except Exception:  # noqa: BLE001 — teardown is best-effort
+                    logger.exception("connector shutdown failed")
+            await disc.close()
+
+        await asyncio.shield(_teardown())
 
 
 def main(argv=None) -> None:

@@ -22,7 +22,10 @@ import os
 import signal
 import sys
 import time
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Awaitable, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..runtime import faults
+from ..runtime.backoff import Backoff, retry_async
 
 logger = logging.getLogger(__name__)
 
@@ -60,6 +63,9 @@ class VirtualConnector:
         return 0
 
     async def set_replicas(self, prefill: int, decode: int) -> None:
+        f = faults.FAULTS
+        if f.enabled:
+            await f.on("planner.connector")  # `error` raises; planner retries
         async with self._rev_lock:
             if self.revision is None:
                 self.revision = await self._load_revision()
@@ -80,7 +86,26 @@ class LocalProcessConnector:
 
     `prefill_cmd` / `decode_cmd` are argv templates; each spawned replica
     gets the env of the parent plus DYN_WORKER_INDEX. Scaling down kills
-    the newest replicas first (SIGTERM, then SIGKILL after grace).
+    the newest replicas first (SIGTERM → the worker's graceful drain;
+    SIGKILL after grace).
+
+    Robustness contract (exercised by the `worker.spawn` fault point and
+    the planner soak): a failed exec or a child that dies before reporting
+    ready is retried with seeded backoff, bounded by `spawn_retries` per
+    set_replicas call — and because the planner re-asserts its target via
+    `reconcile()` every interval, even an exhausted budget never strands
+    the replica count. With `ready_fn` set (an async `(role) -> int` of
+    READY replicas, e.g. `DiscoveryWorkerCounts` — which excludes draining
+    workers and, because workers register only after warmup, counts a
+    fresh replica only once its warmup gate passed), scale-up additionally
+    waits up to `ready_timeout` for the new capacity to actually appear,
+    respawning children that died in the window.
+
+    The replica counts given to set_replicas are the connector's OWN
+    child-process counts. Workers of the same component managed outside
+    the connector still count in `ready_fn`'s discovery-wide number —
+    point the planner's `DiscoveryWorkerCounts` at a component only this
+    connector manages, or the fleet runs `want + external` replicas.
     """
 
     def __init__(
@@ -89,15 +114,22 @@ class LocalProcessConnector:
         decode_cmd: Sequence[str],
         env: Optional[Dict[str, str]] = None,
         grace_s: float = 5.0,
+        spawn_retries: int = 3,
+        ready_fn: Optional[Callable[[str], Awaitable[int]]] = None,
+        ready_timeout: float = 30.0,
     ):
         self.prefill_cmd = list(prefill_cmd)
         self.decode_cmd = list(decode_cmd)
         self.env = env
         self.grace_s = grace_s
+        self.spawn_retries = spawn_retries
+        self.ready_fn = ready_fn
+        self.ready_timeout = ready_timeout
         self.procs: Dict[str, List[asyncio.subprocess.Process]] = {
             "prefill": [],
             "decode": [],
         }
+        self._want: Optional[Tuple[int, int]] = None  # last asked (p, d)
 
     def counts(self) -> Tuple[int, int]:
         self._reap()
@@ -107,13 +139,91 @@ class LocalProcessConnector:
         for role in self.procs:
             self.procs[role] = [p for p in self.procs[role] if p.returncode is None]
 
+    def _next_index(self, role: str) -> int:
+        """Smallest index not held by a LIVE replica: a kill-then-respawn
+        reuses the dead slot's index (ports/names derived from it stay
+        stable), and never collides with a living replica's — `len(procs)`
+        would hand a churn replacement a duplicate of the survivor's."""
+        used = {getattr(p, "_dyn_worker_index", i)
+                for i, p in enumerate(self.procs[role])}
+        idx = 0
+        while idx in used:
+            idx += 1
+        return idx
+
     async def _spawn(self, role: str) -> None:
         cmd = self.prefill_cmd if role == "prefill" else self.decode_cmd
         env = dict(os.environ if self.env is None else self.env)
-        env["DYN_WORKER_INDEX"] = str(len(self.procs[role]))
+        index = self._next_index(role)
+        env["DYN_WORKER_INDEX"] = str(index)
+        act = None
+        f = faults.FAULTS
+        if f.enabled:
+            act = await f.on("worker.spawn")  # `error` raises FaultError
         proc = await asyncio.create_subprocess_exec(*cmd, env=env)
+        if act == "crash":
+            # the child dies before it ever reports ready — the readiness
+            # wait (or the next reconcile) must replace it
+            proc.kill()
+        proc._dyn_worker_index = index
         self.procs[role].append(proc)
-        logger.info("spawned %s worker pid=%d", role, proc.pid)
+        logger.info("spawned %s worker pid=%d index=%d", role, proc.pid, index)
+
+    async def _spawn_with_retry(self, role: str, backoff: Backoff) -> bool:
+        try:
+            await retry_async(
+                lambda: self._spawn(role),
+                attempts=self.spawn_retries, backoff=backoff,
+                desc=f"spawn {role}", log=logger,
+            )
+            return True
+        except asyncio.CancelledError:
+            raise
+        except Exception:  # noqa: BLE001 — exhausted; caller decides
+            return False
+
+    async def _wait_ready(self, role: str, want: int, backoff: Backoff) -> None:
+        """Block until the asked capacity is actually up: all `want` managed
+        children ALIVE (a child that died before ready is replaced in the
+        window, bounded by the spawn-retry budget) AND `ready_fn(role)`
+        reporting at least `want` registered replicas. Bounded by
+        ready_timeout so a crash-looping worker can't wedge the loop.
+
+        The alive check is authoritative for the connector's OWN children —
+        `ready_fn` typically counts discovery-wide capacity, and in a mixed
+        deployment (externally-managed workers of the same component) its
+        count alone could mask a dead child; see the class docstring."""
+        if self.ready_fn is None:
+            return
+        deadline = time.monotonic() + self.ready_timeout
+        respawns = 0
+        while time.monotonic() < deadline:
+            self._reap()
+            if len(self.procs[role]) < want:
+                # a child died before reporting ready: replace it in the
+                # window instead of waiting a whole adjustment interval
+                if respawns >= max(1, self.spawn_retries):
+                    logger.error(
+                        "%s replica died before ready %d time(s); giving up "
+                        "this interval (reconcile retries)", role, respawns,
+                    )
+                    return
+                respawns += 1
+                logger.warning(
+                    "%s replica died before ready; respawning (%d/%d)",
+                    role, respawns, self.spawn_retries,
+                )
+                await self._spawn_with_retry(role, backoff)
+                continue
+            try:
+                ready = await self.ready_fn(role)
+            except Exception as e:  # noqa: BLE001 — readiness probe is advisory
+                logger.warning("ready_fn(%s) failed: %s", role, e)
+                ready = 0
+            if ready >= want:
+                return
+            await asyncio.sleep(0.1)
+        logger.warning("%s capacity not ready within %.1fs", role, self.ready_timeout)
 
     async def _kill(self, role: str) -> None:
         proc = self.procs[role].pop()
@@ -128,20 +238,71 @@ class LocalProcessConnector:
         logger.info("stopped %s worker pid=%d", role, proc.pid)
 
     async def set_replicas(self, prefill: int, decode: int) -> None:
+        f = faults.FAULTS
+        if f.enabled:
+            await f.on("planner.connector")  # `error` raises; planner retries
         self._reap()
+        backoff = Backoff.seeded("worker.spawn", base=0.05, max_delay=1.0)
         for role, want in (("prefill", prefill), ("decode", decode)):
+            cmd = self.prefill_cmd if role == "prefill" else self.decode_cmd
+            if not cmd:
+                continue  # role not managed here (e.g. decode-only soak)
+            grew = False
             while len(self.procs[role]) < want:
-                await self._spawn(role)
+                if not await self._spawn_with_retry(role, backoff):
+                    raise RuntimeError(
+                        f"could not spawn {role} replica after "
+                        f"{self.spawn_retries} attempts"
+                    )
+                grew = True
             while len(self.procs[role]) > want:
                 await self._kill(role)
+            if grew:
+                await self._wait_ready(role, want, backoff)
+        # committed only on SUCCESS: the planner treats a raised
+        # set_replicas as uncommitted and holds its own target, so
+        # reconcile() must keep re-asserting the LAST SUCCESSFUL counts —
+        # advancing _want on a failed apply would let reconcile grow the
+        # fleet past what the planner believes exists (and any partial
+        # spawns from the failed attempt are culled by the next
+        # reconcile's kill-down to the old counts)
+        self._want = (prefill, decode)
+
+    async def reconcile(self) -> None:
+        """Re-assert the last committed replica counts: respawn replicas
+        that died since (the planner calls this every interval)."""
+        if self._want is None:
+            return
+        p, d = self._want
+        self._reap()
+        dead = [
+            (role, want, len(self.procs[role]))
+            for role, want, cmd in (
+                ("prefill", p, self.prefill_cmd), ("decode", d, self.decode_cmd)
+            )
+            # only roles this connector actually manages can "die" on it
+            if cmd and len(self.procs[role]) < want
+        ]
+        if dead:
+            logger.warning(
+                "reconcile: replica(s) died: %s",
+                ", ".join(f"{r}: have {h}, want {w}" for r, w, h in dead),
+            )
+        await self.set_replicas(p, d)
 
     async def shutdown(self) -> None:
         await self.set_replicas(0, 0)
 
 
 class DiscoveryWorkerCounts:
-    """Count live worker instances from discovery (reference
-    get_workers_info, planner_core.py:180-219)."""
+    """Count READY worker instances from discovery (reference
+    get_workers_info, planner_core.py:180-219).
+
+    Two gates make this the planner's capacity truth: workers register in
+    discovery only AFTER their warmup/health gate passes (so a freshly
+    spawned replica never counts early), and instances whose record is in
+    `draining` state (scale-down in progress) are excluded (so capacity
+    being shed never counts either)."""
 
     def __init__(self, discovery_client, namespace: str = "dynamo",
                  prefill_component: str = "prefill", decode_component: str = "backend"):
@@ -151,15 +312,31 @@ class DiscoveryWorkerCounts:
         self.decode_component = decode_component
 
     async def count(self) -> Tuple[int, int]:
-        from ..runtime.component import INSTANCE_ROOT
+        from ..runtime.component import INSTANCE_ROOT, STATE_DRAINING
 
         items = await self.client.get_prefix(INSTANCE_ROOT + self.namespace + "/")
         n_p = n_d = 0
         for it in items:
             key = it["key"] if isinstance(it, dict) else it[0]
+            value = it.get("value", b"") if isinstance(it, dict) else it[1]
+            try:
+                if json.loads(value).get("state") == STATE_DRAINING:
+                    continue
+            except (ValueError, TypeError, AttributeError):
+                pass  # unparseable record: count it (legacy writers)
             comp = key[len(INSTANCE_ROOT):].split("/")[1]
             if comp == self.prefill_component:
                 n_p += 1
             elif comp == self.decode_component:
                 n_d += 1
         return n_p, n_d
+
+    def ready_fn(self) -> Callable[[str], Awaitable[int]]:
+        """Adapter for LocalProcessConnector(ready_fn=...): per-role READY
+        replica count."""
+
+        async def ready(role: str) -> int:
+            p, d = await self.count()
+            return p if role == "prefill" else d
+
+        return ready

@@ -15,6 +15,7 @@ from typing import Dict, Optional, Tuple
 
 import aiohttp
 
+from ..runtime import faults
 from .planner_core import Metrics
 
 _NS = "dynamo_frontend"
@@ -48,6 +49,12 @@ class FrontendMetricsSource:
         self._prev: Optional[Dict[str, float]] = None
 
     async def _scrape(self) -> Dict[str, float]:
+        f = faults.FAULTS
+        if f.enabled:
+            # `error` raises FaultError, `hang` parks until the planner's
+            # per-attempt timeout cuts it, `delay` slows the scrape — all
+            # land on the retry/staleness path the planner must survive
+            await f.on("planner.scrape")
         async with aiohttp.ClientSession() as s:
             async with s.get(self.url) as resp:
                 resp.raise_for_status()

@@ -17,9 +17,12 @@ from __future__ import annotations
 import asyncio
 import logging
 import math
-from dataclasses import dataclass, field
-from typing import Optional, Protocol
+import time
+from dataclasses import dataclass
+from typing import List, Optional, Protocol, Tuple
 
+from ..runtime.backoff import Backoff, retry_async
+from ..runtime.config import _env
 from .load_predictor import BasePredictor, make_predictor
 from .perf_interpolation import DecodeInterpolator, PrefillInterpolator
 
@@ -37,6 +40,48 @@ class SlaArgs:
     min_endpoint: int = 1
     load_predictor: str = "constant"
     no_correction: bool = False
+    # -- loop robustness (docs/autoscaling.md) -------------------------- #
+    # metrics scrape: bounded attempts, each under a timeout, backoff
+    # between — a hung /metrics endpoint must cost one interval, not the
+    # whole loop
+    scrape_timeout: float = 5.0
+    scrape_retries: int = 3
+    # observations older than this never reach the scaling math: on scrape
+    # failure the planner HOLDS rather than re-consuming a stale interval
+    # average (0 = default of 2.5 × adjustment_interval)
+    metrics_max_age: float = 0.0
+    # decision governor: a noisy interval must not flap the fleet
+    cooldown_intervals: int = 1    # intervals to hold after an applied change
+    max_step: int = 2              # max replica delta per decision, per role
+    scale_down_stable_intervals: int = 2  # consecutive below-target intervals
+    #                                       required before stepping down
+
+    def effective_metrics_max_age(self) -> float:
+        return self.metrics_max_age or 2.5 * self.adjustment_interval
+
+    @classmethod
+    def from_env(cls, **overrides) -> "SlaArgs":
+        """Default args layered with the DYN_PLANNER_* env knobs (all in
+        ENV_REGISTRY, rendered to docs/configuration.md); explicit
+        keyword overrides win."""
+        args = cls(
+            scrape_timeout=_env("DYN_PLANNER_SCRAPE_TIMEOUT", cls.scrape_timeout, float),
+            scrape_retries=_env("DYN_PLANNER_SCRAPE_RETRIES", cls.scrape_retries, int),
+            metrics_max_age=_env(
+                "DYN_PLANNER_METRICS_MAX_AGE_S", cls.metrics_max_age, float
+            ),
+            cooldown_intervals=_env(
+                "DYN_PLANNER_COOLDOWN_INTERVALS", cls.cooldown_intervals, int
+            ),
+            max_step=_env("DYN_PLANNER_MAX_STEP", cls.max_step, int),
+            scale_down_stable_intervals=_env(
+                "DYN_PLANNER_SCALE_DOWN_STABLE_INTERVALS",
+                cls.scale_down_stable_intervals, int,
+            ),
+        )
+        for k, v in overrides.items():
+            setattr(args, k, v)
+        return args
 
 
 @dataclass
@@ -71,6 +116,18 @@ class PlannerConnector(Protocol):
     async def set_replicas(self, prefill: int, decode: int) -> None: ...
 
 
+@dataclass
+class ScaleDecision:
+    """One governed planner decision, recorded every interval (including
+    holds) — the soak's no-flapping assertion reads this log."""
+
+    at: float  # time.monotonic() when decided
+    raw: Optional[Tuple[int, int]]  # model-requested (p, d); None on a hold
+    target: Tuple[int, int]  # governed target the connector is held to
+    applied: bool  # connector called with a CHANGED target this interval
+    reason: str  # scale-up | scale-down | steady | hold:* | connector-error
+
+
 class Planner:
     def __init__(
         self,
@@ -95,18 +152,58 @@ class Planner:
         self.d_correction_factor = 1.0
         self.last_metrics = Metrics()
         self._stop = asyncio.Event()
+        # decision-governor state: all mutated only from the planner's own
+        # loop task (run → make_adjustments), per GUARDED_STATE
+        self._target: Optional[Tuple[int, int]] = None  # last applied target
+        self._intervals_since_change = 10**9
+        # PER-ROLE consecutive below-target ask counters: one role's noisy
+        # interval must not pre-arm the other role's scale-down
+        self._below_streak = [0, 0]  # [prefill, decode]
+        self._observed_at: Optional[float] = None  # monotonic, last GOOD read
+        self.decision_log: List[ScaleDecision] = []
+        self.scrape_failures = 0  # consecutive; resets on a good read
 
     # -- observe -----------------------------------------------------------
-    async def observe_metrics(self) -> None:
-        self.last_metrics = await self.metrics_source.read()
-        m = self.last_metrics
+    async def observe_metrics(self) -> bool:
+        """Scrape the metrics source: bounded attempts under a per-attempt
+        timeout, backoff between. Returns False when every attempt failed —
+        last_metrics is left untouched and its age keeps growing, so the
+        staleness gate (not a NaN average) is what the scaling math sees."""
+        try:
+            m = await retry_async(
+                lambda: asyncio.wait_for(
+                    self.metrics_source.read(), timeout=self.args.scrape_timeout
+                ),
+                attempts=self.args.scrape_retries,
+                backoff=Backoff.seeded("planner.scrape", base=0.1, max_delay=1.0),
+                desc="metrics scrape", log=logger,
+            )
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — scrape must never kill the loop
+            self.scrape_failures += 1
+            logger.error("metrics scrape exhausted retries: %s", e)
+            return False
+        self.scrape_failures = 0
+        self.last_metrics = m
+        self._observed_at = time.monotonic()
         logger.info(
             "observed num_req=%.1f isl=%.1f osl=%.1f ttft=%.3fs itl=%.4fs",
             m.num_req, m.isl, m.osl, m.ttft, m.itl,
         )
-        self.num_req_predictor.add_data_point(m.num_req)
-        self.isl_predictor.add_data_point(m.isl)
-        self.osl_predictor.add_data_point(m.osl)
+        if m.is_valid():
+            # an empty/invalid interval must not pollute the predictors
+            # (a moving average dragged toward 0 by a quiet minute would
+            # scale-to-min the moment traffic resumes)
+            self.num_req_predictor.add_data_point(m.num_req)
+            self.isl_predictor.add_data_point(m.isl)
+            self.osl_predictor.add_data_point(m.osl)
+        return True
+
+    def observation_age(self) -> float:
+        if self._observed_at is None:
+            return math.inf
+        return time.monotonic() - self._observed_at
 
     # -- correct (planner_core.py:383-441) ---------------------------------
     async def update_correction_factors(self) -> None:
@@ -210,18 +307,147 @@ class Planner:
             )
         return next_p, next_d
 
+    # -- govern (hysteresis / cooldown / bounded step) ------------------------
+    def _record(self, raw, target, applied, reason) -> ScaleDecision:
+        dec = ScaleDecision(time.monotonic(), raw, target, applied, reason)
+        self.decision_log.append(dec)
+        logger.info(
+            "planner decision: raw=%s target=%s applied=%s (%s)",
+            raw, target, applied, reason,
+        )
+        return dec
+
+    def _govern(self, raw: Tuple[int, int], cur: Tuple[int, int]
+                ) -> Tuple[Tuple[int, int], str]:
+        """Turn the model's raw replica ask into a governed target:
+
+        * bounded step — at most `max_step` replicas per role per decision;
+        * scale-down hysteresis — the ask must sit below the current target
+          for `scale_down_stable_intervals` CONSECUTIVE intervals before a
+          step down (one quiet interval can't shed capacity);
+        * cooldown — after any applied change, hold `cooldown_intervals`
+          intervals before another (structurally rules out A→B→A flapping
+          inside the window).
+
+        Scale-up is only cooldown-gated (never hysteresis-gated): restoring
+        SLA outranks fleet stability."""
+        a = self.args
+        step = max(1, a.max_step)
+        govern = [
+            max(cur[i] - step, min(cur[i] + step, raw[i])) for i in (0, 1)
+        ]
+        held_down = False
+        for i in (0, 1):
+            # per-role streaks: role i steps down only after ITS OWN ask
+            # sat below target for scale_down_stable_intervals in a row
+            self._below_streak[i] = (
+                self._below_streak[i] + 1 if raw[i] < cur[i] else 0
+            )
+            if govern[i] < cur[i] and \
+                    self._below_streak[i] < a.scale_down_stable_intervals:
+                govern[i] = cur[i]
+                held_down = True
+        p, d = govern
+        if (p, d) == cur:
+            return cur, ("hold:hysteresis" if held_down else "steady")
+        # `<=`, not `<`: _intervals_since_change was already incremented for
+        # THIS interval, so cooldown_intervals=N must hold decisions on the
+        # N intervals after a change (with `<` the default of 1 held none)
+        if self._intervals_since_change <= a.cooldown_intervals:
+            return cur, "hold:cooldown"
+        if p > cur[0] or d > cur[1]:
+            # mixed asks (one role up, one down) classify as scale-up; the
+            # down half already passed its own hysteresis gate above
+            return (p, d), "scale-up"
+        return (p, d), "scale-down"
+
+    async def _apply_target(self, target: Tuple[int, int]) -> bool:
+        """Push a target through the connector with bounded retries: a
+        transient connector failure (fault plan, spawn blip, discovery
+        reset) must not strand the replica count — on final failure the
+        target is NOT committed, so the next interval re-decides and
+        re-asserts it."""
+        try:
+            await retry_async(
+                lambda: self.connector.set_replicas(*target),
+                attempts=3,
+                backoff=Backoff.seeded("planner.connector", base=0.1, max_delay=1.0),
+                desc=f"connector set_replicas{target}", log=logger,
+            )
+            return True
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — surfaced in the log, retried next interval
+            logger.error("connector failed after retries: %s", e)
+            return False
+
     # -- adjust ---------------------------------------------------------------
     async def make_adjustments(self) -> Optional[tuple[int, int]]:
+        if self._target is None:
+            self._target = await self.workers.count()
+        cur = self._target
+        self._intervals_since_change += 1
+        floor = self.args.min_endpoint
+        if cur[0] < floor or cur[1] < floor:
+            # cold start (or below-floor fleet): bring the fleet up to the
+            # min_endpoint floor WITHOUT waiting for traffic — with zero
+            # workers no model serves, so no request ever arrives and a
+            # traffic-gated planner would deadlock at zero forever
+            target = (max(cur[0], floor), max(cur[1], floor))
+            if not await self._apply_target(target):
+                self._record(None, cur, False, "connector-error")
+                return None
+            self._target = target
+            self._intervals_since_change = 0
+            self._record(None, target, True, "bootstrap:min-endpoint")
+            return target
+        if self.observation_age() > self.args.effective_metrics_max_age():
+            # scrapes kept failing: the last averages are stale — hold the
+            # current target rather than steer the fleet on old data
+            self._record(None, cur, False, "hold:stale-metrics")
+            return None
         if not self.last_metrics.is_valid():
-            logger.info("no traffic in interval; skipping adjustment")
+            # first interval / zero-request interval: hold the last
+            # decision (never scale-to-min on a quiet minute)
+            self._record(None, cur, False, "hold:no-traffic")
             return None
         await self.update_correction_factors()
         num_req, isl, osl = self.predict_load()
         if num_req is None or isl is None or osl is None:
+            self._record(None, cur, False, "hold:no-prediction")
             return None
-        p, d = self.compute_replica_requirements(num_req, isl, osl)
-        await self.connector.set_replicas(p, d)
-        return p, d
+        raw = self.compute_replica_requirements(num_req, isl, osl)
+        target, reason = self._govern(raw, cur)
+        if target == cur:
+            self._record(raw, cur, False, reason)
+            return None
+        if not await self._apply_target(target):
+            self._record(raw, cur, False, "connector-error")
+            return None
+        self._target = target
+        self._intervals_since_change = 0
+        for i in (0, 1):
+            if target[i] < cur[i]:
+                # an applied step down re-arms that role's hysteresis:
+                # further shedding needs fresh consecutive confirmation
+                self._below_streak[i] = 0
+        self._record(raw, target, True, reason)
+        return target
+
+    async def _reconcile_connector(self) -> None:
+        """Connectors that manage real processes expose reconcile(): re-
+        assert the committed target every interval so a replica that died
+        (or a spawn that failed mid-apply) is replaced without waiting for
+        the next load-driven decision."""
+        reconcile = getattr(self.connector, "reconcile", None)
+        if reconcile is None or self._target is None:
+            return
+        try:
+            await reconcile()
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — retried next interval
+            logger.warning("connector reconcile failed: %s", e)
 
     async def run(self) -> None:
         """Planner loop: sleep interval, observe, adjust — until stop()."""
@@ -236,6 +462,7 @@ class Planner:
             try:
                 await self.observe_metrics()
                 await self.make_adjustments()
+                await self._reconcile_connector()
             except Exception:  # noqa: BLE001 — planner must survive blips
                 logger.exception("planner iteration failed")
 

@@ -1,0 +1,524 @@
+"""Autoscaling soak harness: the planner loop's proving ground.
+
+ROADMAP item 4 / docs/autoscaling.md: an in-proc cluster — real frontend
+(HTTP service + model watcher + /metrics), real discovery, N mock workers
+— with the real `Planner` scraping the frontend and scaling the worker set
+while a seeded qps ramp runs and dynochaos fault plans fire. The pieces
+here are reusable by tests (tests/test_planner_soak.py), the CI soak
+smoke, and interactive debugging; none of them stub the serving plane —
+streams ride the same request-plane/migration/drain machinery production
+traffic does.
+
+Two worker backends implement the `PlannerConnector` protocol:
+
+* :class:`InProcWorkerPool` — workers are `DistributedRuntime`s inside
+  this process (fast: tier-1 soak). Scale-down closes gracefully (the
+  PR-3 drain: mark draining → revoke lease → finish in-flight);
+  `kill_one()` tears a worker down crash-style for migration tests.
+* `planner.connector.LocalProcessConnector` — real subprocess workers
+  (`python -m dynamo_tpu.mocker`), SIGTERM-drained on scale-down; the
+  slow soak + CI smoke use it via :func:`mocker_cmd`.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import math
+import sys
+import time
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import aiohttp
+import numpy as np
+
+from ..runtime import DistributedRuntime, RouterMode, RuntimeConfig
+from ..runtime.discovery import DiscoveryServer
+from .perf_interpolation import DecodeInterpolator, PrefillInterpolator
+
+logger = logging.getLogger(__name__)
+
+
+# --------------------------------------------------------------------------- #
+# synthetic interpolation profiles
+# --------------------------------------------------------------------------- #
+
+
+def synthetic_profiles(
+    decode_tok_s_per_chip: float = 56.0,
+    prefill_tok_s_per_chip: float = 5000.0,
+    itl_grid_ms: float = 40.0,
+    max_kv_tokens: int = 100_000,
+) -> Tuple[dict, dict]:
+    """(prefill_raw, decode_raw) interpolator inputs with CONSTANT
+    throughput surfaces, so the planner's replica math reduces to
+    `ceil(load_tok_s / per_chip)` — the soak can predict the correct
+    replica count for a given ramp exactly."""
+    isl = np.array([16.0, 256.0, 1024.0, 4096.0])
+    prefill_raw = {
+        "prefill_isl": isl,
+        "prefill_ttft": np.full_like(isl, 5.0),  # ms; flat
+        "prefill_thpt_per_gpu": np.full_like(isl, prefill_tok_s_per_chip),
+    }
+    xs, ys = np.meshgrid(
+        np.array([0.1, 0.3, 0.5, 0.7, 0.9]), np.array([64.0, 512.0, 2048.0])
+    )
+    xs, ys = xs.ravel(), ys.ravel()
+    decode_raw = {
+        "x_kv_usage": xs,
+        "y_context_length": ys,
+        "z_itl": np.full_like(xs, itl_grid_ms),
+        "z_thpt_per_gpu": np.full_like(xs, decode_tok_s_per_chip),
+        "max_kv_tokens": np.array([max_kv_tokens]),
+    }
+    return prefill_raw, decode_raw
+
+
+def make_interpolators(**kwargs) -> Tuple[PrefillInterpolator, DecodeInterpolator]:
+    p_raw, d_raw = synthetic_profiles(**kwargs)
+    return (
+        PrefillInterpolator(raw_data=p_raw),
+        DecodeInterpolator(raw_data=d_raw),
+    )
+
+
+# --------------------------------------------------------------------------- #
+# in-proc cluster pieces
+# --------------------------------------------------------------------------- #
+
+
+class SoakFrontend:
+    """Discovery server + frontend runtime + model watcher + HTTP service,
+    all in-proc — the real serving plane the ramp drives and the planner
+    scrapes."""
+
+    def __init__(self, router_mode: RouterMode = RouterMode.ROUND_ROBIN,
+                 lease_ttl_s: float = 3.0, graceful_timeout: float = 10.0):
+        self.router_mode = router_mode
+        self.lease_ttl_s = lease_ttl_s
+        self.graceful_timeout = graceful_timeout
+        self.disc: Optional[DiscoveryServer] = None
+        self.drt: Optional[DistributedRuntime] = None
+        self.http = None
+        self.watcher = None
+        self.port: int = 0
+
+    @property
+    def cfg(self) -> RuntimeConfig:
+        cfg = RuntimeConfig()
+        assert self.disc is not None
+        cfg.discovery_endpoint = f"tcp://127.0.0.1:{self.disc.port}"
+        cfg.lease_ttl_s = self.lease_ttl_s
+        cfg.graceful_shutdown_timeout = self.graceful_timeout
+        return cfg
+
+    @property
+    def metrics_url(self) -> str:
+        return f"http://127.0.0.1:{self.port}/metrics"
+
+    @property
+    def base_url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    async def start(self) -> "SoakFrontend":
+        from ..llm.discovery import ModelManager, ModelWatcher
+        from ..llm.http import HttpService
+
+        self.disc = DiscoveryServer(port=0)
+        await self.disc.start()
+        self.drt = await DistributedRuntime.create(self.cfg)
+        manager = ModelManager()
+        self.watcher = ModelWatcher(self.drt, manager, self.router_mode)
+        await self.watcher.start()
+        self.http = HttpService(manager, host="127.0.0.1", port=0)
+        self.port = await self.http.start()
+        return self
+
+    async def wait_model(self, model: str, timeout: float = 30.0):
+        deadline = time.monotonic() + timeout
+        async with aiohttp.ClientSession() as s:
+            while time.monotonic() < deadline:
+                try:
+                    async with s.get(f"{self.base_url}/v1/models") as r:
+                        data = await r.json()
+                    if any(m["id"] == model for m in data.get("data", [])):
+                        return
+                except (aiohttp.ClientError, OSError):
+                    pass
+                await asyncio.sleep(0.1)
+        raise TimeoutError(f"model {model} never registered")
+
+    async def stop(self):
+        if self.watcher is not None:
+            await self.watcher.stop()
+        if self.http is not None:
+            await self.http.stop()
+        if self.drt is not None:
+            await self.drt.close()
+        if self.disc is not None:
+            await self.disc.stop()
+
+
+class InProcMockWorker:
+    """One in-proc mock worker: mirrors `python -m dynamo_tpu.mocker` —
+    warmup BEFORE registration (the capacity-readiness gate the planner
+    counts on), MockEngine behind a served endpoint, model card under the
+    primary lease."""
+
+    def __init__(self, cfg: RuntimeConfig, engine_args, *,
+                 namespace: str = "dynamo", component: str = "mocker",
+                 endpoint: str = "generate", migration_limit: int = 3):
+        self.cfg = cfg
+        self.engine_args = engine_args
+        self.namespace, self.component, self.endpoint = namespace, component, endpoint
+        self.migration_limit = migration_limit
+        self.drt: Optional[DistributedRuntime] = None
+        self.engine = None
+
+    async def start(self) -> "InProcMockWorker":
+        from ..llm.mocker import MockEngine
+        from ..llm.model_card import ModelDeploymentCard, register_llm
+
+        self.drt = await DistributedRuntime.create(self.cfg)
+        self.engine = MockEngine(self.engine_args)
+        await self.engine.warmup()
+        ep = (self.drt.namespace(self.namespace)
+              .component(self.component).endpoint(self.endpoint))
+        engine = self.engine
+
+        async def handler(request, context):
+            async for item in engine.generate(request, context):
+                yield item
+
+        await ep.serve_endpoint(handler)
+        await register_llm(ep, ModelDeploymentCard(
+            name=self.engine_args.model_name,
+            tokenizer="byte",
+            kv_cache_block_size=self.engine_args.block_size,
+            migration_limit=self.migration_limit,
+        ))
+        return self
+
+    @property
+    def instance_id(self) -> int:
+        assert self.drt is not None
+        return self.drt.instance_id
+
+    async def stop(self, graceful: bool = True):
+        if self.drt is not None:
+            await self.drt.close(graceful=graceful)
+
+
+class InProcWorkerPool:
+    """PlannerConnector over in-proc mock workers (decode role; the
+    prefill count is accepted and ignored — co-located serving). Honors
+    the same `planner.connector` / `worker.spawn` fault points as
+    LocalProcessConnector so fault-plan soaks exercise one grammar."""
+
+    def __init__(self, cfg: RuntimeConfig, engine_args, *,
+                 component: str = "mocker", spawn_retries: int = 3):
+        self.cfg = cfg
+        self.engine_args = engine_args
+        self.component = component
+        self.spawn_retries = spawn_retries
+        self.workers: List[InProcMockWorker] = []
+        self.scale_events: List[Tuple[float, int]] = []  # (t, decode_count)
+        self._want: Optional[int] = None
+
+    async def _spawn(self) -> None:
+        from ..runtime import faults
+        from ..runtime.backoff import Backoff, retry_async
+
+        async def start_one():
+            w = InProcMockWorker(self.cfg, self.engine_args,
+                                 component=self.component)
+            f = faults.FAULTS
+            if f.enabled:
+                act = await f.on("worker.spawn")  # `error` raises
+                if act == "crash":
+                    # worker dies before it reports ready: start, then
+                    # tear down crash-style before registration counts
+                    await w.start()
+                    await w.stop(graceful=False)
+                    raise ConnectionError("injected: worker crashed before ready")
+            await w.start()
+            self.workers.append(w)
+
+        await retry_async(
+            start_one, attempts=self.spawn_retries,
+            backoff=Backoff.seeded("worker.spawn", base=0.05, max_delay=0.5),
+            desc="in-proc worker spawn", log=logger,
+        )
+
+    async def set_replicas(self, prefill: int, decode: int) -> None:
+        from ..runtime import faults
+
+        f = faults.FAULTS
+        if f.enabled:
+            await f.on("planner.connector")  # `error` raises; planner retries
+        while len(self.workers) < decode:
+            await self._spawn()
+        while len(self.workers) > decode:
+            w = self.workers.pop()
+            await w.stop(graceful=True)  # the PR-3 drain sequence
+        # committed only on success (same contract as LocalProcessConnector:
+        # reconcile re-asserts the last SUCCESSFUL counts, never a target
+        # the planner recorded as connector-error)
+        self._want = decode
+        self.scale_events.append((time.monotonic(), len(self.workers)))
+
+    async def reconcile(self) -> None:
+        if self._want is not None and len(self.workers) < self._want:
+            await self.set_replicas(0, self._want)
+
+    async def kill_one(self, index: int = -1) -> int:
+        """Crash-style teardown of one worker (no drain): the in-proc
+        analog of SIGKILL, for mid-stream migration scenarios. Returns the
+        killed instance id."""
+        w = self.workers.pop(index)
+        iid = w.instance_id
+        await w.stop(graceful=False)
+        self.scale_events.append((time.monotonic(), len(self.workers)))
+        return iid
+
+    async def shutdown(self) -> None:
+        await self.set_replicas(0, 0)
+
+
+def mocker_cmd(discovery: str, *, model_name: str = "mock-model",
+               component: str = "mocker", block_size: int = 8,
+               speedup_ratio: float = 2.0,
+               extra: Sequence[str] = ()) -> List[str]:
+    """argv template for LocalProcessConnector: a real mocker worker
+    subprocess wired to the soak's discovery service."""
+    return [
+        sys.executable, "-m", "dynamo_tpu.mocker",
+        "--model-name", model_name,
+        "--component", component,
+        "--discovery", discovery,
+        "--block-size", str(block_size),
+        "--speedup-ratio", str(speedup_ratio),
+        *extra,
+    ]
+
+
+# --------------------------------------------------------------------------- #
+# seeded qps ramp load
+# --------------------------------------------------------------------------- #
+
+
+@dataclass
+class RampPhase:
+    qps: float
+    duration_s: float
+    label: str = ""
+
+
+@dataclass
+class StreamRecord:
+    """One client stream's observation, sufficient for both SLA windows
+    and the zero-lost/zero-duplicated contiguity check (the byte
+    tokenizer maps one token to one character, so received characters
+    count emitted stream items exactly — migration replays would inflate
+    the count, drops would shrink it)."""
+
+    phase: str
+    t_send: float
+    t_first: Optional[float] = None
+    t_last: Optional[float] = None
+    content_tokens: int = 0
+    usage_completion: Optional[int] = None
+    max_tokens: int = 0
+    finish_reason: Optional[str] = None
+    error: Optional[str] = None
+
+    @property
+    def ok(self) -> bool:
+        return self.error is None and self.finish_reason is not None
+
+    def ttft_ms(self) -> float:
+        if self.t_first is None:
+            return math.inf
+        return (self.t_first - self.t_send) * 1000.0
+
+    def contiguity_problems(self) -> List[str]:
+        out = []
+        if self.error is not None:
+            out.append(f"error: {self.error}")
+            return out
+        if self.finish_reason is None:
+            out.append("no finish_reason (truncated stream)")
+        if self.content_tokens != self.max_tokens:
+            out.append(
+                f"{'lost' if self.content_tokens < self.max_tokens else 'duplicated'}"
+                f" items: got {self.content_tokens}, asked {self.max_tokens}"
+            )
+        if self.usage_completion is not None and \
+                self.usage_completion != self.content_tokens:
+            out.append(
+                f"usage mismatch: usage={self.usage_completion} "
+                f"streamed={self.content_tokens}"
+            )
+        return out
+
+
+async def drive_stream(session: aiohttp.ClientSession, base_url: str,
+                       model: str, prompt: str, max_tokens: int,
+                       phase: str = "") -> StreamRecord:
+    """One streaming chat completion, recorded chunk by chunk."""
+    rec = StreamRecord(phase=phase, t_send=time.monotonic(),
+                       max_tokens=max_tokens)
+    try:
+        async with session.post(
+            f"{base_url}/v1/chat/completions",
+            json={
+                "model": model,
+                "messages": [{"role": "user", "content": prompt}],
+                "max_tokens": max_tokens,
+                "stream": True,
+                "stream_options": {"include_usage": True},
+            },
+            timeout=aiohttp.ClientTimeout(total=120),
+        ) as resp:
+            if resp.status != 200:
+                rec.error = f"HTTP {resp.status}: {(await resp.text())[:200]}"
+                return rec
+            async for raw in resp.content:
+                line = raw.decode("utf-8", "replace").strip()
+                if not line.startswith("data:"):
+                    continue
+                payload = line[5:].strip()
+                if payload == "[DONE]":
+                    break
+                chunk = json.loads(payload)
+                if chunk.get("usage"):
+                    rec.usage_completion = chunk["usage"]["completion_tokens"]
+                for ch in chunk.get("choices", []):
+                    content = (ch.get("delta") or {}).get("content")
+                    if content:
+                        if rec.t_first is None:
+                            rec.t_first = time.monotonic()
+                        rec.t_last = time.monotonic()
+                        rec.content_tokens += len(content)
+                    if ch.get("finish_reason"):
+                        rec.finish_reason = ch["finish_reason"]
+    except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+        rec.error = f"{type(e).__name__}: {e}"
+    return rec
+
+
+class RampLoad:
+    """Seeded deterministic qps ramp: fixed inter-arrival 1/qps per phase,
+    prompts varied per request index (prefix caching stays honest)."""
+
+    def __init__(self, base_url: str, model: str, phases: Sequence[RampPhase],
+                 *, isl_chars: int = 24, osl_tokens: int = 16, seed: int = 0):
+        self.base_url = base_url
+        self.model = model
+        self.phases = list(phases)
+        self.isl_chars = isl_chars
+        self.osl_tokens = osl_tokens
+        self.seed = seed
+        self.records: List[StreamRecord] = []
+
+    async def run(self) -> List[StreamRecord]:
+        tasks: List[asyncio.Task] = []
+        i = 0
+        async with aiohttp.ClientSession() as session:
+            for phase in self.phases:
+                t_phase = time.monotonic()
+                gap = 1.0 / max(phase.qps, 1e-9)
+                n = max(1, int(round(phase.qps * phase.duration_s)))
+                for k in range(n):
+                    at = t_phase + k * gap
+                    delay = at - time.monotonic()
+                    if delay > 0:
+                        await asyncio.sleep(delay)
+                    prompt = f"soak-{self.seed}-{i:05d} " + "x" * self.isl_chars
+                    tasks.append(asyncio.create_task(drive_stream(
+                        session, self.base_url, self.model, prompt,
+                        self.osl_tokens, phase=phase.label or f"qps{phase.qps}",
+                    )))
+                    i += 1
+                # hold the phase boundary even if requests lag
+                tail = t_phase + phase.duration_s - time.monotonic()
+                if tail > 0:
+                    await asyncio.sleep(tail)
+            self.records = list(await asyncio.gather(*tasks))
+        return self.records
+
+
+# --------------------------------------------------------------------------- #
+# report helpers
+# --------------------------------------------------------------------------- #
+
+
+def attainment(records: Sequence[StreamRecord], ttft_slo_ms: float) -> float:
+    """Fraction of records meeting the TTFT target (failures count as
+    misses) — the bench_e2e `sla_fields` definition."""
+    if not records:
+        return 1.0
+    met = [r for r in records if r.ok and r.ttft_ms() <= ttft_slo_ms]
+    return len(met) / len(records)
+
+
+def window_attainment(records: Sequence[StreamRecord], t0: float,
+                      window_s: float, ttft_slo_ms: float
+                      ) -> List[Tuple[float, float, int]]:
+    """Per-window (offset_s, attainment, n) over send time — how the soak
+    sees SLA degrade under the ramp and recover after scale-up."""
+    if not records:
+        return []
+    t_end = max(r.t_send for r in records)
+    out = []
+    t = t0
+    while t < t_end:
+        win = [r for r in records if t <= r.t_send < t + window_s]
+        if win:
+            out.append((t - t0, attainment(win, ttft_slo_ms), len(win)))
+        t += window_s
+    return out
+
+
+def contiguity_report(records: Sequence[StreamRecord]) -> List[str]:
+    """Flat list of per-stream contiguity violations (empty = zero lost,
+    zero duplicated, every stream finished)."""
+    problems = []
+    for idx, r in enumerate(records):
+        for p in r.contiguity_problems():
+            problems.append(f"stream {idx} [{r.phase}]: {p}")
+    return problems
+
+
+def replica_trace(decisions) -> List[Tuple[int, int]]:
+    """Applied (p, d) targets in order, deduplicated — the soak's
+    scale-cycle assertion reads this."""
+    out: List[Tuple[int, int]] = []
+    for d in decisions:
+        if d.applied and (not out or out[-1] != d.target):
+            out.append(d.target)
+    return out
+
+
+def assert_no_flapping(decisions, cooldown_intervals: int,
+                       adjustment_interval: float) -> None:
+    """No A→B→A oscillation inside the cooldown window, and no two applied
+    changes closer than the cooldown allows."""
+    applied = [d for d in decisions if d.applied]
+    for a, b in zip(applied, applied[1:]):
+        gap = b.at - a.at
+        min_gap = cooldown_intervals * adjustment_interval
+        if gap < min_gap * 0.99:  # tolerance for loop-timing slop
+            raise AssertionError(
+                f"applied changes {a.target}→{b.target} only {gap:.2f}s apart "
+                f"(cooldown {min_gap:.2f}s)"
+            )
+    for a, b, c in zip(applied, applied[1:], applied[2:]):
+        if a.target == c.target and a.target != b.target and \
+                c.at - a.at <= (cooldown_intervals + 1) * adjustment_interval:
+            raise AssertionError(
+                f"replica flap {a.target}→{b.target}→{c.target} within "
+                f"the cooldown window"
+            )

@@ -12,10 +12,15 @@ exact timing it asserted on.
 from __future__ import annotations
 
 import asyncio
+import logging
 import random
 import time
 import zlib
-from typing import Optional
+from typing import Awaitable, Callable, Optional, TypeVar
+
+logger = logging.getLogger(__name__)
+
+T = TypeVar("T")
 
 
 class Backoff:
@@ -74,3 +79,38 @@ class Backoff:
                 return False
         await asyncio.sleep(delay)
         return True
+
+
+async def retry_async(
+    fn: Callable[[], Awaitable[T]],
+    *,
+    attempts: int,
+    backoff: Backoff,
+    desc: str = "operation",
+    log: Optional[logging.Logger] = None,
+) -> T:
+    """Bounded retry with backoff: call `fn` up to `attempts` times,
+    sleeping the backoff between failures but NEVER after the last one
+    (an exhausted retry cycle must not add dead delay to the failing
+    path). CancelledError passes straight through; when every attempt
+    fails the LAST exception is re-raised for the caller to classify.
+
+    The one retry idiom the planner loop's callers share (metrics scrape,
+    connector apply, replica spawn) so attempt accounting and logging
+    cannot drift between copies."""
+    last: Optional[BaseException] = None
+    n = max(1, attempts)
+    for attempt in range(n):
+        try:
+            return await fn()
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — retried, then re-raised
+            last = e
+            (log or logger).warning(
+                "%s failed (attempt %d/%d): %s", desc, attempt + 1, n, e
+            )
+            if attempt + 1 < n:
+                await backoff.wait()
+    assert last is not None
+    raise last

@@ -39,6 +39,14 @@ INSTANCE_ROOT = "v1/instances/"
 MODEL_ROOT = "v1/mdc/"
 
 
+#: Instance lifecycle states written into the discovery record. `draining`
+#: is published by the graceful-shutdown sequence the moment a worker stops
+#: accepting new streams, so routers and the planner's capacity counter can
+#: skip it WITHOUT waiting for the lease-revoke delete event to propagate.
+STATE_READY = "ready"
+STATE_DRAINING = "draining"
+
+
 @dataclass
 class Instance:
     """A live endpoint instance (reference Instance component.rs:98)."""
@@ -49,6 +57,7 @@ class Instance:
     instance_id: int
     address: str  # host:port of the worker's request-plane server
     subject: str  # routing subject within that server
+    state: str = STATE_READY  # STATE_READY | STATE_DRAINING
 
     @property
     def path(self) -> str:
@@ -163,6 +172,39 @@ class DistributedRuntime:
         if self.discovery is not None:
             await self.discovery.put(key, value, self.primary_lease)
 
+    async def _mark_instances_draining(self):
+        """Re-publish every served Instance record with state=`draining`
+        BEFORE the lease revoke deletes it: watch consumers (PushRouter,
+        planner capacity counts) see the put immediately, closing the
+        window where a router still dials a worker that will only answer
+        with a `draining` rejection."""
+        if self.discovery is None:
+            return
+        for key, value in list(self._leased_keys.items()):
+            if not key.startswith(INSTANCE_ROOT):
+                continue
+            try:
+                inst = Instance.from_json(value)
+                inst.state = STATE_DRAINING
+                await self.discovery.put(key, inst.to_json(), self.primary_lease)
+            except (ConnectionError, RuntimeError, ValueError, TypeError):
+                pass  # best-effort: the revoke delete is the authority
+
+    def install_signal_handlers(self):
+        """SIGTERM/SIGINT trigger the graceful-shutdown sequence instead of
+        the interpreter's default hard exit — this is what turns a planner
+        scale-down (`LocalProcessConnector._kill` sends SIGTERM) into the
+        drain path (mark draining → revoke lease → finish in-flight) rather
+        than a mid-stream kill that every live request pays for."""
+        import signal
+
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                loop.add_signal_handler(sig, self.shutdown)
+            except (NotImplementedError, RuntimeError):
+                return  # platform without signal support (or non-main thread)
+
     async def ensure_server(self) -> str:
         """Start the request-plane server on first use; returns host:port."""
         async with self._server_lock:
@@ -204,6 +246,8 @@ class DistributedRuntime:
         self._shutdown.set()
         if self.health_check_manager is not None:
             await self.health_check_manager.stop()
+        if graceful:
+            await self._mark_instances_draining()
         if self.primary_lease is not None:
             await self.primary_lease.revoke()
         if graceful and self._server_started:
@@ -408,6 +452,15 @@ class Client:
 
     def instance_ids(self) -> List[int]:
         return sorted(self.instances.keys())
+
+    def ready_instance_ids(self) -> List[int]:
+        """Instances eligible for NEW streams: excludes workers whose
+        discovery record is in `draining` state (scale-down in progress —
+        dialing them only buys a per-request rejection)."""
+        return sorted(
+            iid for iid, inst in self.instances.items()
+            if inst.state != STATE_DRAINING
+        )
 
     async def wait_for_instances(self, timeout: float = 30.0) -> List[int]:
         """Block until at least one instance is live (reference

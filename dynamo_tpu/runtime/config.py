@@ -162,6 +162,35 @@ ENV_REGISTRY: tuple = (
            "per-token latency of decode-block + prefill stays under it. "
            "0 (default) disables the ITL budget.",
            "engine/scheduler/sla.py"),
+    # -- SLA planner loop (planner/, docs/autoscaling.md) ---------------- #
+    EnvVar("DYN_PLANNER_SCRAPE_TIMEOUT", "float", "5.0",
+           "Per-attempt timeout for the planner's frontend /metrics "
+           "scrape; a hung endpoint costs one bounded attempt, never the "
+           "whole planner loop.",
+           "planner/planner_core.py"),
+    EnvVar("DYN_PLANNER_SCRAPE_RETRIES", "int", "3",
+           "Scrape attempts per adjustment interval (backoff between); "
+           "when all fail the planner holds its last decision instead of "
+           "feeding NaN/stale averages into the scaling math.",
+           "planner/planner_core.py"),
+    EnvVar("DYN_PLANNER_METRICS_MAX_AGE_S", "float", "0",
+           "Observations older than this never reach a scaling decision "
+           "(the planner holds). 0 = 2.5 × the adjustment interval.",
+           "planner/planner_core.py"),
+    EnvVar("DYN_PLANNER_COOLDOWN_INTERVALS", "int", "1",
+           "Intervals the planner holds after an applied replica change "
+           "before it may change again — structurally rules out A→B→A "
+           "flapping inside the window.",
+           "planner/planner_core.py"),
+    EnvVar("DYN_PLANNER_MAX_STEP", "int", "2",
+           "Bound on the replica delta per decision per role: one noisy "
+           "interval can move the fleet at most this far.",
+           "planner/planner_core.py"),
+    EnvVar("DYN_PLANNER_SCALE_DOWN_STABLE_INTERVALS", "int", "2",
+           "Consecutive intervals the model must ask for below-current "
+           "capacity before the planner steps down (scale-up is never "
+           "hysteresis-gated: restoring SLA outranks fleet stability).",
+           "planner/planner_core.py"),
     # -- engine / memory sizing ---------------------------------------- #
     EnvVar("DYN_HBM_UTILIZATION", "float", "0.85",
            "Fraction of device memory the KV pool auto-sizer may plan "

@@ -92,6 +92,15 @@ KNOWN_FAULT_POINTS = {
         "`error` — MockEngine step loop; fail-all",
     "kv_transfer.chunk":
         "`sever` | `delay` — KV data-plane chunk serve; partial transfer",
+    "planner.scrape":
+        "`error` | `hang` | `delay` — planner's frontend /metrics scrape; "
+        "the planner retries with backoff and ages out stale observations",
+    "planner.connector":
+        "`error` — planner connector set_replicas; the planner retries "
+        "with backoff and re-asserts the target next interval",
+    "worker.spawn":
+        "`error` | `crash` — LocalProcessConnector replica spawn; `error` "
+        "fails the exec, `crash` kills the child before it reports ready",
 }
 
 

@@ -52,7 +52,11 @@ class PushRouter:
                     f"for {self.client.endpoint.subject}"
                 )
             return self.direct_instance
-        ids = [i for i in self.client.instance_ids() if i not in exclude]
+        # NEW streams only target ready instances: a `draining` discovery
+        # record means the worker is mid-scale-down and will reject the
+        # stream anyway — skipping it here saves a dial + rejection per
+        # request during the drain window
+        ids = [i for i in self.client.ready_instance_ids() if i not in exclude]
         if not ids:
             raise StreamLost(f"no instances for {self.client.endpoint.subject}")
         if self.mode == RouterMode.RANDOM:

@@ -81,6 +81,17 @@ GUARDED_STATE = {
     # endpoint instance table: the watch task is the only mutator once
     # the client is started (static mode carries a reasoned waiver).
     "Client.instances": "single-task:_watch_loop",
+    # SLA planner loop (planner/planner_core.py): the governor's committed
+    # target and streak/cooldown counters are owned end-to-end by the
+    # planner's own `run` task (observe → adjust → reconcile, serially);
+    # the soak and unit tests drive the same methods single-task too.
+    "Planner._target": "single-task:run",
+    "Planner._below_streak": "single-task:run",
+    "Planner._intervals_since_change": "single-task:run",
+    # connector replica bookkeeping: written only by set_replicas /
+    # reconcile, both reached from the planner's run task.
+    "LocalProcessConnector._want": "single-task:run",
+    "InProcWorkerPool._want": "single-task:run",
     # deploy/planner reconcilers: one _PollLoop task per reconciler owns
     # the failure-backoff and revision bookkeeping end to end.
     "GraphController._failures": "single-task:reconcile_once",

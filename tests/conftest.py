@@ -38,6 +38,13 @@ import asyncio  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running (subprocess soaks etc.); tier-1 runs -m 'not slow'",
+    )
+
+
 @pytest.fixture
 def event_loop_policy():
     return asyncio.DefaultEventLoopPolicy()

@@ -241,6 +241,50 @@ def test_kv_routing_e2e_prefix_affinity(kv_cluster):
     assert len(others) == 2, f"expected both workers used, got {others}"
 
 
+def test_find_best_match_skips_draining_instances():
+    """KV mode honors the drain invariant too: a draining worker is never
+    scheduled for a NEW stream, even when it holds the best prefix overlap
+    (same contract as PushRouter._pick during planner scale-down)."""
+    import asyncio
+
+    from dynamo_tpu.llm.kv_router import KvPushRouter, KvRouterConfig
+
+    class _Comp:
+        namespace, name = "dynamo", "backend"
+
+    class _Ep:
+        component = _Comp()
+        subject = "dynamo.backend.generate"
+
+    class _Client:
+        endpoint = _Ep()
+
+        def instance_ids(self):
+            return [11, 22]
+
+        def ready_instance_ids(self):
+            return [22]  # 11 is draining (scale-down in progress)
+
+    class _Drt:
+        discovery = None
+
+    async def main():
+        r = KvPushRouter(
+            _Drt(), _Client(),
+            KvRouterConfig(use_kv_events=True, router_temperature=0.0,
+                           overlap_score_weight=2.0),
+            block_size=4,
+        )
+        toks = list(range(16))
+        # hand the draining worker the winning overlap: it must STILL lose
+        r._inflight_overlay.process_routing_decision_for_request(toks, 11)
+        for _ in range(6):
+            w, _ov = r.find_best_match(toks)
+            assert w == 22, "new stream scheduled onto a draining worker"
+
+    asyncio.run(main())
+
+
 def test_inflight_prefix_overlay_colocates_before_events():
     """Event mode: two same-prefix requests arriving before any engine KV
     event must co-locate (the in-flight overlay supplies the overlap the
@@ -261,6 +305,11 @@ def test_inflight_prefix_overlay_colocates_before_events():
 
         def instance_ids(self):
             return [11, 22]
+
+        def ready_instance_ids(self):
+            # no draining instances in this fixture (the real Client
+            # filters state == "draining" out of the schedulable set)
+            return self.instance_ids()
 
     class _Drt:
         discovery = None

@@ -82,6 +82,42 @@ class TestPredictors:
         p.add_data_point(float("nan"))
         assert p.predict_next() == 5.0
 
+    def test_empty_buffer_predicts_none(self):
+        # never-fed predictors (first interval) must answer None, not 0 —
+        # the planner holds instead of scaling to min
+        assert ConstantPredictor().predict_next() is None
+        assert MovingAveragePredictor().predict_next() is None
+        assert ARPredictor().predict_next() is None
+
+    def test_nan_only_buffer_predicts_none(self):
+        p = MovingAveragePredictor(window_size=4)
+        for _ in range(3):
+            p.add_data_point(float("nan"))
+        assert p.predict_next() is None
+
+    def test_ar_single_sample_falls_back_to_last(self):
+        p = ARPredictor(order=3, minimum_data_points=5)
+        p.add_data_point(12.5)
+        assert p.predict_next() == 12.5
+
+    def test_ar_clamped_to_observed_band(self):
+        # a wild AR fit on a short noisy window must not extrapolate far
+        # outside the observed range (the planner would size a fleet off it)
+        p = ARPredictor(order=3, window_size=16)
+        data = [10, 11, 9, 10, 50, 10, 11, 9, 10, 48]
+        for v in data:
+            p.add_data_point(v)
+        pred = p.predict_next()
+        lo, hi = min(data), max(data)
+        span = max(hi - lo, abs(hi) * 0.1)
+        assert lo - span <= pred <= hi + span
+
+    def test_ar_window_bounds_buffer(self):
+        p = ARPredictor(order=2, window_size=10)
+        for t in range(100):
+            p.add_data_point(float(t))
+        assert len(p.data_buffer) == 10
+
 
 class TestInterpolators:
     def test_prefill_interpolation_and_clamp(self):
@@ -112,6 +148,44 @@ class TestInterpolators:
             itl=0.025, context_length=2048
         )
         assert kv_loose >= kv
+
+    def test_find_best_unmeetable_itl_falls_back_to_lightest_load(self):
+        it = DecodeInterpolator(raw_data=synthetic_decode_raw())
+        # an ITL target below every grid point: the linear scan exhausts
+        # and answers the lightest-load column instead of crashing
+        thpt, itl, kv = it.find_best_throughput_per_chip(
+            itl=1e-6, context_length=2048
+        )
+        assert kv == 0.0 and itl > 1e-6 and thpt >= 0
+
+    def test_prefill_few_points_uses_linear_not_cubic(self):
+        # 3 samples: cubic needs 4 — the kind fallback must interpolate,
+        # clamped at both ends, without scipy raising
+        raw = {
+            "prefill_isl": np.array([128.0, 512.0, 2048.0]),
+            "prefill_ttft": np.array([10.0, 30.0, 120.0]),
+            "prefill_thpt_per_gpu": np.array([8000.0, 7000.0, 5000.0]),
+        }
+        it = PrefillInterpolator(raw_data=raw)
+        assert it.interpolate_ttft(128) == pytest.approx(0.010)
+        mid = it.interpolate_ttft(320)
+        assert 0.010 < mid < 0.030
+        assert it.interpolate_ttft(10**9) == pytest.approx(0.120)
+
+    def test_decode_interpolator_context_beyond_grid_clamps(self):
+        it = DecodeInterpolator(raw_data=synthetic_decode_raw())
+        # zero concurrency pins kv_usage, isolating the context axis: an
+        # out-of-range context clamps to the top grid row
+        a = it.interpolate_itl(concurrency=0, context_length=4096)
+        b = it.interpolate_itl(concurrency=0, context_length=10**7)
+        assert b == pytest.approx(a)
+
+    def test_decode_grid_has_no_nan_cells(self):
+        # sparse sweeps leave griddata NaN holes; the nearest-neighbour
+        # backfill must cover every cell the planner can index
+        it = DecodeInterpolator(raw_data=synthetic_decode_raw())
+        assert not np.isnan(it.itl_grid).any()
+        assert not np.isnan(it.thpt_grid).any()
 
 
 def make_planner(args=None, metrics=None, workers=(1, 1)):
@@ -232,6 +306,52 @@ dynamo_frontend_output_tokens_total{model="m"} 700.0
         d = parse_prometheus_text(text)
         assert d["dynamo_frontend_requests_total"] == 7.0
         assert d["dynamo_frontend_output_tokens_total"] == 700.0
+
+
+class TestMetricsSourceIntervals:
+    def test_first_and_zero_delta_reads_are_invalid_then_valid(self):
+        """First scrape has no interval to difference; an unchanged-counter
+        interval means zero requests — both must come back invalid (the
+        planner holds) and never poison the following valid interval."""
+        from dynamo_tpu.planner import FrontendMetricsSource
+
+        ns = "dynamo_frontend"
+
+        def sample(req, in_tok, out_tok, ttft_sum, ttft_n, itl_sum, itl_n):
+            return {
+                f"{ns}_requests_total": req,
+                f"{ns}_input_tokens_total": in_tok,
+                f"{ns}_output_tokens_total": out_tok,
+                f"{ns}_time_to_first_token_seconds_sum": ttft_sum,
+                f"{ns}_time_to_first_token_seconds_count": ttft_n,
+                f"{ns}_inter_token_latency_seconds_sum": itl_sum,
+                f"{ns}_inter_token_latency_seconds_count": itl_n,
+            }
+
+        samples = [
+            sample(10, 240, 160, 0.5, 10, 0.4, 20),
+            sample(10, 240, 160, 0.5, 10, 0.4, 20),  # quiet: no deltas
+            sample(16, 384, 256, 1.1, 16, 1.0, 50),
+        ]
+
+        src = FrontendMetricsSource("http://unused/metrics")
+
+        async def fake_scrape():
+            return samples.pop(0)
+
+        src._scrape = fake_scrape
+
+        async def run():
+            return [await src.read() for _ in range(3)]
+
+        first, quiet, busy = asyncio.run(run())
+        assert not first.is_valid()
+        assert not quiet.is_valid() and quiet.num_req == 0.0
+        assert busy.is_valid()
+        assert busy.num_req == 6.0
+        assert busy.isl == pytest.approx(24.0)
+        assert busy.osl == pytest.approx(16.0)
+        assert busy.ttft == pytest.approx(0.1)
 
 
 class TestProfilerRoundTrip:
