@@ -75,6 +75,14 @@ PHASES = [
     # watchdog restart runs just this phase
     ("engine_mixed", [PY, "bench_engine.py", "--mixed", "--quantize",
                       "int8"], 2400),
+    # PR 10 remeasure: KVBM tier pipeline on real hardware — where the
+    # XLA gather dispatch is actually async, so the batched-offload
+    # device-µs split (CPU numbers in BENCH_NOTES_r08.md are
+    # synchronous-execution artifacts) and the onboard-vs-recompute TTFT
+    # gap mean something
+    ("engine_kv", [PY, "bench_kv_cache.py", "--repeat", "2", "--requests",
+                   "64", "--quantize", "int8", "--num-pages", "512",
+                   "--host-blocks", "1024", "--disk-blocks", "512"], 3600),
 ]
 
 

@@ -389,9 +389,22 @@ class JaxEngine:
         # these instead of grepping logs
         self.kv_pulls_completed = 0
         self.kv_pages_pulled = 0
-        # blocks reused MID-prefill from concurrent same-prefix requests
+        # blocks reused MID-prefix from concurrent same-prefix requests
         # (_try_skip_ahead; admission-time hits count in the allocator)
         self.prefix_skip_ahead_blocks = 0
+        # KVBM tier-chain effectiveness (docs/kvbm.md): G1 = device prefix
+        # cache hits at admission; misses = prompt blocks the device cache
+        # could not serve (onboarded from G2/G3 or prefilled). Tier-level
+        # G2/G3 hit counters live on the tiers themselves.
+        self.kvbm_g1_hit_blocks = 0
+        self.kvbm_g1_miss_blocks = 0
+        # onboard latency histogram (ms buckets) + recompute comparison
+        # inputs: the bench and planner read these to judge whether tier
+        # onboarding actually beats recompute
+        self._onboard_hist_bounds = (1.0, 5.0, 20.0, 100.0, 500.0)
+        self.kvbm_onboard_hist = [0] * (len(self._onboard_hist_bounds) + 1)
+        self.kvbm_onboard_ms_sum = 0.0
+        self.kvbm_onboard_count = 0
         self._admit_counter = 0
         # dynosched (engine/scheduler/): the StepPlanner owns prefill
         # ordering and chunk budgeting; policy "fifo" (the default)
@@ -1026,11 +1039,15 @@ class JaxEngine:
         for t in list(self._bg_tasks):
             t.cancel()
         if self.kvbm is not None:
-            # drain in-flight write-through offloads, then persist G3 index
+            # flush any staged commits, drain in-flight write-through
+            # offloads (staged + queued + legacy inline), stop the tier
+            # thread, then persist the G3 index
+            self.kvbm.flush_step()
             for _ in range(500):
                 if self.kvbm.pending_offloads() == 0:
                     break
                 await asyncio.sleep(0.01)
+            self.kvbm.shutdown()
             self.kvbm.manager.flush()
 
     async def warmup(self) -> int:
@@ -1497,6 +1514,20 @@ class JaxEngine:
         }
         if self.kvbm is not None:
             out.update(self.kvbm.stats())
+            # tier-chain effectiveness (docs/kvbm.md): G1 admission hit/miss
+            # plus the onboard latency histogram the bench/planner read
+            out["kvbm_g1_hit_blocks"] = self.kvbm_g1_hit_blocks
+            out["kvbm_g1_miss_blocks"] = self.kvbm_g1_miss_blocks
+            out["kvbm_onboard_count"] = self.kvbm_onboard_count
+            out["kvbm_onboard_ms_sum"] = round(self.kvbm_onboard_ms_sum, 3)
+            out["kvbm_onboard_hist"] = {
+                **{
+                    f"le_{b:g}ms": n
+                    for b, n in zip(self._onboard_hist_bounds,
+                                    self.kvbm_onboard_hist)
+                },
+                "inf": self.kvbm_onboard_hist[-1],
+            }
         if self.data_plane is not None:
             out["kv_transfers_served"] = self.data_plane.transfers_served
             out["kv_bytes_served"] = self.data_plane.bytes_served
@@ -1630,6 +1661,11 @@ class JaxEngine:
         )
         progressed |= dispatched
         progressed |= await self._fetch_and_process(fetch_block)
+        if self.kvbm is not None:
+            # coalesce this step's block commits into ONE offload gather
+            # (kvbm pipeline, docs/kvbm.md) — the only KVBM work the
+            # device executor ever sees is that single dispatch
+            self.kvbm.flush_step()
         return progressed
 
     # -- admission ------------------------------------------------------- #
@@ -1687,10 +1723,9 @@ class JaxEngine:
         # KVBM: probe G2/G3 for the hashes the device cache missed; tier hits
         # are injected before prefill (onboard), extending the cached prefix
         onboard_hashes: List[int] = []
+        prompt_full_blocks = len(kv_prompt) // cfg.page_size
         if self.kvbm is not None and cfg.enable_prefix_caching:
-            prompt_full_blocks = len(kv_prompt) // cfg.page_size
             onboard_hashes = self.kvbm.probe(hashes[n_cached:prompt_full_blocks])
-        n_onboard = len(onboard_hashes)
         # allocate the prompt's remaining pages now; generation pages grow later
         prompt_pages = (len(kv_prompt) + cfg.page_size - 1) // cfg.page_size
         fresh_prompt = max(prompt_pages - n_cached, 0)
@@ -1701,6 +1736,36 @@ class JaxEngine:
         if fresh is None:
             self.allocator.release(cached_pages, hashes[:n_cached])
             return False
+        # admission is now certain: count G1 hit/miss and settle the
+        # onboard budget HERE, not before the allocation checks — a
+        # pool-pressured slot retries _try_admit every step, and counting
+        # pre-failure would re-count the same request per retry
+        if self.kvbm is not None and cfg.enable_prefix_caching:
+            self.kvbm_g1_hit_blocks += n_cached
+            self.kvbm_g1_miss_blocks += max(prompt_full_blocks - n_cached, 0)
+            if onboard_hashes:
+                # onboard budget (docs/kvbm.md): under the sla policy, a
+                # tier load projected past the slot's TTFT headroom is
+                # only WORSE than recompute when recompute is actually
+                # faster — a request already past its deadline still
+                # wants the cheaper path. Cold tiers / cold cost model
+                # (no observation yet) never defer, same rule as the
+                # scheduler's CostModel.
+                headroom_ms = self.scheduler.onboard_headroom_ms(slot)
+                if headroom_ms is not None:
+                    est = self.kvbm.estimate_onboard_ms(onboard_hashes)
+                    rate = self.scheduler.cost.per_token("prefill")
+                    recompute_ms = (
+                        rate * 1000.0 * len(onboard_hashes) * cfg.page_size
+                        if rate is not None else None
+                    )
+                    if (
+                        est is not None and est > headroom_ms
+                        and recompute_ms is not None and est > recompute_ms
+                    ):
+                        self.kvbm.note_onboard_recompute()
+                        onboard_hashes = []
+        n_onboard = len(onboard_hashes)
         idx = self._free_slots.pop()
         slot.slot_idx = idx
         slot.pages = cached_pages + fresh
@@ -2506,14 +2571,17 @@ class JaxEngine:
         concurrent sequences share them."""
         alloc_pages, hashes = slot.onboard
         slot.onboard = None
+        t0 = time.perf_counter()
         try:
             # tier reads (host memcpy / disk memmap) run off the event loop,
             # serialized with offload stores on the same executor; remote
             # (G4/peer) blocks pull over the data plane first
             k_np, v_np = await self.kvbm.load_async(hashes, self._run_on_device)
-        except KeyError as e:
-            # block evicted between probe and load: fall back to computing
-            # that part of the prompt (pages are already allocated)
+        except (KeyError, faults.FaultError) as e:
+            # block evicted between probe and load — or a dynochaos
+            # `kvbm.onboard` error: fall back to computing that part of
+            # the prompt (pages are already allocated); onboarding is a
+            # latency optimization, never a correctness dependency
             logger.warning("KVBM onboard miss: %s; prefilling instead", e)
             n_known = len(slot.committed_hashes)
             slot.prefill_pos = n_known * self.config.page_size
@@ -2532,6 +2600,19 @@ class JaxEngine:
         self.allocator.commit_hashes(alloc_pages, hashes, token_blocks, parent)
         slot.committed_hashes.extend(hashes)
         # (whole-prompt clamp already applied at admission, _try_admit)
+        self._record_onboard_ms((time.perf_counter() - t0) * 1000.0)
+
+    def _record_onboard_ms(self, ms: float):
+        """Onboard-latency histogram (tier load + device inject, per
+        onboard): the cache-effectiveness signal beside the hit counters."""
+        for i, bound in enumerate(self._onboard_hist_bounds):
+            if ms <= bound:
+                self.kvbm_onboard_hist[i] += 1
+                break
+        else:
+            self.kvbm_onboard_hist[-1] += 1
+        self.kvbm_onboard_ms_sum += ms
+        self.kvbm_onboard_count += 1
 
     # -- batched chunked prefill ----------------------------------------- #
 
@@ -3154,7 +3235,9 @@ class JaxEngine:
             self.allocator.commit_hashes(pages, new_hashes, token_blocks, parent)
             slot.committed_hashes.extend(new_hashes)
             if self.kvbm is not None:
-                self.kvbm.offload_commit(new_hashes, [p + 1 for p in pages])
+                self.kvbm.offload_commit(
+                    new_hashes, [p + 1 for p in pages], parent=parent
+                )
 
     # -- decode ---------------------------------------------------------- #
 
@@ -3942,6 +4025,13 @@ class JaxEngine:
             # commit any full generated blocks before release so decode KV is
             # reusable (conversation prefix reuse / cheap preemption resume)
             self._commit_generated_blocks(slot)
+            if self.kvbm is not None:
+                # flush the stage NOW: release makes these pages evictable,
+                # and the offload gather must enter the device queue before
+                # any later dispatch that could recycle them (the step-end
+                # flush would be too late for a mid-step release — preempt,
+                # cancel from the generate() task)
+                self.kvbm.flush_step()
             # releasing while blocks are in flight is safe: in-flight writes
             # for this lane land strictly AFTER its last committed position
             # (speculation starts past the fetched tokens), i.e. only on
@@ -3976,7 +4066,9 @@ class JaxEngine:
             self.allocator.commit_hashes(pages, new_hashes, token_blocks, parent)
             slot.committed_hashes.extend(new_hashes)
             if self.kvbm is not None:
-                self.kvbm.offload_commit(new_hashes, [p + 1 for p in pages])
+                self.kvbm.offload_commit(
+                    new_hashes, [p + 1 for p in pages], parent=parent
+                )
 
 
 def _resolve_model(name: str) -> llama.LlamaConfig:

@@ -120,17 +120,28 @@ class PageAllocator:
 
     def commit_hashes(self, pages: List[int], seq_hashes: List[int], token_blocks=None, parent_hash=None):
         """Bind freshly filled pages to their block hashes (after prefill or
-        after a generation block completes) -> emits `stored`."""
-        stored = []
+        after a generation block completes) -> emits `stored`.
+
+        Hashes already cached by a concurrent sequence are skipped, which
+        can leave GAPS in the committed subsequence — `stored_event_runs`
+        (the shared producer contract, llm/mocker/kv_manager.py) splits
+        the emission into one event per contiguous run with true chain
+        parents and aligned token_blocks, so the router's bounded index
+        never links across a gap (the seed's single gapped event also
+        misaligned token_blocks with the stored subset)."""
+        from ..llm.mocker.kv_manager import stored_event_runs
+
+        created = set()
         for page_id, h in zip(pages, seq_hashes):
             if h in self._by_hash:
                 continue  # already cached by a concurrent sequence
             self._by_hash[h] = _CachedPage(page_id, h, ref_count=1)
-            stored.append(h)
-        if stored and self.event_sink:
-            self.event_sink(
-                KvEvent("stored", stored, parent_hash=parent_hash, token_blocks=token_blocks)
-            )
+            created.add(h)
+        if created and self.event_sink:
+            for ev in stored_event_runs(
+                seq_hashes, created, token_blocks, parent_hash
+            ):
+                self.event_sink(ev)
 
     def release(self, pages: List[int], seq_hashes: List[int]):
         """Release a sequence's pages. Hashed pages go to LRU cache;

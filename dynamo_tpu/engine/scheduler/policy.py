@@ -126,6 +126,14 @@ class StepPlanner:
         """Track the admitted slot's deadline (step-loop only)."""
         self._deadlines[slot.request_id] = slot.sched_deadline
 
+    def onboard_headroom_ms(self, slot) -> Optional[float]:
+        """TTFT headroom a KVBM tier onboard may spend for this slot (ms;
+        floor 0). None under fifo — no deadline means no budget, so the
+        engine never trades a tier hit for recompute (docs/kvbm.md)."""
+        if self.sla.policy != "sla":
+            return None
+        return max((slot.sched_deadline - time.monotonic()) * 1000.0, 0.0)
+
     def on_release(self, slot) -> None:
         self._deadlines.pop(slot.request_id, None)
 

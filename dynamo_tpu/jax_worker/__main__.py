@@ -446,6 +446,18 @@ async def main():
         "sched_granted_tokens", "sched_deferred_steps",
         "sched_itl_shrunk_steps", "sched_deadline_overrides",
         "sched_starvation_overrides",
+        # KVBM tier pipeline (docs/kvbm.md): per-tier hit/miss counters
+        # (G1 = device prefix cache at admission, G2/G3 = host/disk
+        # tiers), offload queue depth + drop counters, and the onboard
+        # latency sum/count pair (mean ms = sum/count) — the planner and
+        # bench read cache effectiveness from these
+        "kvbm_g1_hit_blocks", "kvbm_g1_miss_blocks",
+        "kvbm_host_hits", "kvbm_host_misses", "kvbm_host_evictions",
+        "kvbm_disk_hits", "kvbm_disk_misses", "kvbm_disk_evictions",
+        "kvbm_offload_gathers", "kvbm_offload_queue_depth",
+        "kvbm_offload_blocks_dropped", "kvbm_offload_failures",
+        "kvbm_onboard_count", "kvbm_onboard_ms_sum",
+        "kvbm_onboard_recompute_fallbacks",
     ):
         # registry prepends the "dynamo" prefix -> dynamo_worker_<stat>
         drt.metrics.callback_gauge(

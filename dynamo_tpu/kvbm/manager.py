@@ -3,36 +3,82 @@
 Reference: lib/llm/src/block_manager.rs (KvBlockManager :99) and
 block_manager/offload.rs (OffloadManager). The reference offloads a block
 down the G1->G2->G3 chain when it is *registered* (hash bound); onboarding
-walks the chain upward on a prefix-cache lookup miss. We do the same:
+walks the chain upward on a prefix-cache lookup miss. We do the same, but
+the data path is a PIPELINE (docs/kvbm.md), not a sequence of inline
+copies:
 
-  * offload is WRITE-THROUGH at block-commit time: the engine's
-    `_commit_blocks` hands us (hashes, physical pages); we enqueue one XLA
-    gather (`extract_pages`) on the engine's serial device executor and copy
-    the result into the host pool. Because every later write to those pages
-    is itself a device op queued behind ours on the same executor, the
-    extract always reads the pre-eviction contents — no device read-back is
-    ever needed at eviction time (the reference needs its CUDA
-    block_copy.cu + bounce buffers for this; XLA gather + serialized
-    execution makes it free of synchronization hazards).
+  * offload is WRITE-THROUGH at block-commit time, BATCHED per engine
+    step: every `_commit_blocks` in a step stages its (hash, page) pairs;
+    the engine's end-of-step `flush_step()` submits ONE `extract_pages`
+    gather for all of them onto the serial device executor. Because every
+    later write to those pages is itself a device op queued behind ours on
+    the same executor, the gather always reads the pre-eviction contents —
+    no device read-back is ever needed at eviction time (the reference
+    needs its CUDA block_copy.cu + bounce buffers for this; XLA gather +
+    serialized execution makes it free of synchronization hazards). The
+    gather job only DISPATCHES (XLA execution is async); the device->host
+    copy, the G2 store, and any G2->G3 cascade + file I/O run on a
+    dedicated `kvbm-tier` thread, so the device executor loses only the
+    dispatch microseconds per step.
+  * the staged->stored path is a BOUNDED queue: when the tier thread falls
+    behind, the OLDEST in-flight batch is dropped (blocks are unreferenced
+    cache copies — dropping loses a future cache hit, never correctness)
+    rather than stalling the step loop; drops are counted.
   * onboard happens at admission: after the device prefix cache
     (PageAllocator.acquire_cached) is consulted, the engine probes the
     tiers for the NEXT hashes in the chain; hits are scatter-injected
     (`inject_pages`) into freshly allocated device pages before prefill,
-    extending the cached prefix and skipping that prefill compute.
+    extending the cached prefix and skipping that prefill compute. Under
+    DYN_SCHED_POLICY=sla the engine first compares the tiers' observed
+    per-block load latency against the slot's TTFT headroom and falls
+    back to recompute when onboarding would blow the deadline.
+
+DYN_KVBM_PIPELINE=0 restores the seed's inline per-commit offload (one
+gather + store per `_commit_blocks` call, all on the device executor) —
+kept as the bench_kv_cache.py before/after arm and as a safety valve.
 """
 
 from __future__ import annotations
 
 import logging
 import threading
+import time
+from collections import deque
 from dataclasses import dataclass
-from typing import List, Optional, Sequence, Tuple
+from typing import Deque, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from .storage import DiskTier, HostTier
+from ..runtime import faults
+from .storage import EVICTION_POLICIES, DiskTier, HostTier
 
 logger = logging.getLogger(__name__)
+
+
+def _parse_eviction(spec: Optional[str]) -> Tuple[str, str]:
+    """DYN_KVBM_EVICTION: a single policy (`lru`) applies to both tiers;
+    `host=lfu,disk=lru` sets them independently. Unknown spellings fall
+    back to lru (an eviction-policy typo must not take the worker down)."""
+    import os
+
+    spec = spec if spec is not None else os.environ.get("DYN_KVBM_EVICTION")
+    if not spec:
+        return "lru", "lru"
+    spec = spec.strip().lower()
+    if "=" not in spec:
+        if spec not in EVICTION_POLICIES:
+            logger.warning("DYN_KVBM_EVICTION=%r unknown; using lru", spec)
+            spec = "lru"
+        return spec, spec
+    out = {"host": "lru", "disk": "lru"}
+    for part in spec.split(","):
+        tier, _, pol = part.partition("=")
+        tier, pol = tier.strip(), pol.strip()
+        if tier not in out or pol not in EVICTION_POLICIES:
+            logger.warning("DYN_KVBM_EVICTION part %r unknown; ignoring", part)
+            continue
+        out[tier] = pol
+    return out["host"], out["disk"]
 
 
 @dataclass
@@ -40,6 +86,7 @@ class KvbmConfig:
     host_blocks: int = 0  # G2 capacity (0 disables the tier)
     disk_blocks: int = 0  # G3 capacity (0 disables the tier)
     disk_path: Optional[str] = None
+    eviction: Optional[str] = None  # None -> DYN_KVBM_EVICTION -> lru
 
 
 class KvBlockManager:
@@ -51,41 +98,53 @@ class KvBlockManager:
         self.dtype = dtype
         if cfg.disk_blocks > 0 and not cfg.disk_path:
             raise ValueError("kvbm_disk_blocks > 0 requires kvbm_disk_path")
+        host_policy, disk_policy = _parse_eviction(cfg.eviction)
         self.host: Optional[HostTier] = (
-            HostTier(cfg.host_blocks, block_shape, dtype)
+            HostTier(cfg.host_blocks, block_shape, dtype, policy=host_policy)
             if cfg.host_blocks > 0
             else None
         )
         self.disk: Optional[DiskTier] = (
-            DiskTier(cfg.disk_blocks, block_shape, dtype, cfg.disk_path)
+            DiskTier(cfg.disk_blocks, block_shape, dtype, cfg.disk_path,
+                     policy=disk_policy)
             if cfg.disk_blocks > 0
             else None
         )
-        self._lock = threading.Lock()  # store runs on the device-exec thread
+        self._lock = threading.Lock()  # store runs on the kvbm-tier thread
         self.offloaded_blocks = 0
         self.onboarded_blocks = 0
         self.disk_evictions = 0
         self.dropped_blocks = 0
+        # per-tier per-block load latency EWMA (ms): feeds the onboard
+        # budget (estimate_load_ms). None until first observed — a cold
+        # tier never defers an onboard (same rule as the scheduler's
+        # CostModel: never-observed = no constraint).
+        self._load_ms: dict = {"host": None, "disk": None}
 
-    # -- store path (device executor thread) ----------------------------- #
+    # -- store path (kvbm-tier thread; device-exec thread on the legacy
+    # inline path) ------------------------------------------------------- #
 
-    def store(self, seq_hash: int, k: np.ndarray, v: np.ndarray):
+    def store(self, seq_hash: int, k: np.ndarray, v: np.ndarray,
+              parent: Optional[int] = None):
         """Insert one block at the top of the G2->G3 chain, cascading the
-        host tier's LRU eviction down to disk."""
+        host tier's eviction down to disk. `parent` = preceding chain hash
+        when known (prefix-aware eviction protection)."""
         with self._lock:
             if self.host is not None:
-                evicted = self.host.put(seq_hash, k, v)
+                evicted = self.host.put(seq_hash, k, v, parent=parent)
                 self.offloaded_blocks += 1
                 if evicted is not None:
-                    old_hash, old_k, old_v = evicted
+                    old_hash, old_k, old_v, old_parent = evicted
                     if self.disk is not None:
-                        if self.disk.put(old_hash, old_k, old_v) is not None:
+                        if self.disk.put(
+                            old_hash, old_k, old_v, parent=old_parent
+                        ) is not None:
                             self.dropped_blocks += 1
                         self.disk_evictions += 1
                     else:
                         self.dropped_blocks += 1
             elif self.disk is not None:
-                if self.disk.put(seq_hash, k, v) is not None:
+                if self.disk.put(seq_hash, k, v, parent=parent) is not None:
                     self.dropped_blocks += 1
                 self.offloaded_blocks += 1
 
@@ -118,6 +177,25 @@ class KvBlockManager:
                 break
         return out
 
+    def estimate_load_ms(self, hashes: Sequence[int]) -> Optional[float]:
+        """Projected load_blocks latency for `hashes` from the per-tier
+        EWMAs. None when any needed tier has never been observed (cold
+        tiers never defer an onboard) or when a hash is not tiered here
+        (remote pull cost is unknowable locally)."""
+        with self._lock:
+            total = 0.0
+            for h in hashes:
+                if self.host is not None and self.host.has(h):
+                    ms = self._load_ms["host"]
+                elif self.disk is not None and self.disk.has(h):
+                    ms = self._load_ms["disk"]
+                else:
+                    return None
+                if ms is None:
+                    return None
+                total += ms
+            return total
+
     def load_blocks(
         self, hashes: Sequence[int]
     ) -> Tuple[np.ndarray, np.ndarray]:
@@ -126,14 +204,25 @@ class KvBlockManager:
         ks, vs = [], []
         with self._lock:
             for h in hashes:
+                t0 = time.perf_counter()
                 got = self.host.get(h) if self.host is not None else None
+                src = "host"
                 if got is None and self.disk is not None:
                     got = self.disk.get(h)
+                    src = "disk"
                     if got is not None and self.host is not None:
-                        evicted = self.host.put(h, got[0], got[1])
+                        # promotion carries the chain link: without it a
+                        # just-promoted chain loses its prefix-aware
+                        # descendant protection in the host tier
+                        evicted = self.host.put(
+                            h, got[0], got[1],
+                            parent=self.disk._parent.get(h),
+                        )
                         if evicted is not None:
-                            old_hash, old_k, old_v = evicted
-                            if self.disk.put(old_hash, old_k, old_v) is not None:
+                            old_hash, old_k, old_v, old_parent = evicted
+                            if self.disk.put(
+                                old_hash, old_k, old_v, parent=old_parent
+                            ) is not None:
                                 self.dropped_blocks += 1
                             self.disk_evictions += 1
                 if got is None:
@@ -142,6 +231,12 @@ class KvBlockManager:
                 # promotion in this same loop may evict+overwrite those slots
                 ks.append(np.array(got[0]))
                 vs.append(np.array(got[1]))
+                # per-tier load-latency EWMA feeding estimate_load_ms
+                ms = (time.perf_counter() - t0) * 1000.0
+                prev = self._load_ms[src]
+                self._load_ms[src] = (
+                    ms if prev is None else 0.8 * prev + 0.2 * ms
+                )
             self.onboarded_blocks += len(hashes)
         return np.stack(ks), np.stack(vs)
 
@@ -163,8 +258,8 @@ class KvBlockManager:
             return n
 
     def stats(self) -> dict:
-        # the event loop reads while the device-exec thread stores: the
-        # lock buys a consistent counter+tier snapshot (GUARDED_STATE)
+        # the event loop reads while the tier thread stores: the lock buys
+        # a consistent counter+tier snapshot (GUARDED_STATE)
         with self._lock:
             out = {
                 "kvbm_offloaded_blocks": self.offloaded_blocks,
@@ -174,45 +269,264 @@ class KvBlockManager:
             }
             if self.host is not None:
                 out.update({f"kvbm_{k}": v for k, v in self.host.stats().items()})
+                out["kvbm_host_eviction_policy"] = self.host.policy
             if self.disk is not None:
                 out.update({f"kvbm_{k}": v for k, v in self.disk.stats().items()})
+                out["kvbm_disk_eviction_policy"] = self.disk.policy
+            for tier, ms in self._load_ms.items():
+                if ms is not None:
+                    out[f"kvbm_{tier}_load_ms_per_block"] = round(ms, 3)
             return out
+
+
+@dataclass
+class _OffloadBatch:
+    """One step's coalesced commits, gathered on-device, awaiting the tier
+    thread. `k`/`v` are jax device arrays ([layers, n, page, heads, dim]);
+    np.asarray on the tier thread performs the device->host copy."""
+
+    hashes: List[int]
+    parents: List[Optional[int]]
+    k: object = None
+    v: object = None
+    ready: bool = False  # gather dispatched (k/v populated)
+    dropped: bool = False  # backpressure victim: tier thread must skip it
 
 
 class KvbmConnector:
     """Engine-side glue (reference block_manager/connector/scheduler.rs:
     the piece that integrates the pool with the engine's forward pass).
 
-    Holds a reference to the JaxEngine for its jitted extract/inject ops and
-    its serial device executor; see module docstring for the ordering
-    argument that makes write-through offload race-free.
+    Holds a reference to the JaxEngine for its jitted extract/inject ops
+    and its serial device executor; see module docstring for the pipeline
+    stages and the ordering argument that makes write-through offload
+    race-free.
     """
 
     def __init__(self, engine, manager: KvBlockManager):
+        from ..runtime.config import env_bool
+
         self.engine = engine
         self.manager = manager
+        self.pipelined = env_bool("DYN_KVBM_PIPELINE", True)
+        import os
+
+        try:
+            self.queue_cap = max(
+                int(os.environ.get("DYN_KVBM_OFFLOAD_QUEUE") or 8), 1
+            )
+        except ValueError:
+            self.queue_cap = 8
         self._pending = 0
-        self._pending_lock = threading.Lock()  # bumped on loop, dropped on exec thread
+        self._pending_lock = threading.Lock()  # legacy inline path only
+        # pipeline state — ALL of it guarded by _offload_cv's lock: the
+        # event loop stages and flushes, the device-exec thread marks
+        # batches ready, the kvbm-tier thread consumes (GUARDED_STATE)
+        self._offload_cv = threading.Condition()
+        self._staged: List[Tuple[int, int, Optional[int]]] = []  # (hash, phys_page, parent)
+        self._queue: Deque[_OffloadBatch] = deque()
+        self._inflight_hashes: set = set()  # staged or queued, pre-store
+        self._processing = 0  # blocks of the batch the tier thread holds
+        self._tier_thread: Optional[threading.Thread] = None
+        self._stopped = False
+        # counters (read via stats() under the cv lock)
+        self.offload_commit_calls = 0
+        self.offload_gathers = 0
+        self.offload_batches_dropped = 0
+        self.offload_blocks_dropped = 0
+        self.offload_failures = 0
+        self.onboard_recompute_fallbacks = 0
         # kvbm/distributed.py attaches itself here: cross-worker probe/pull
         # (the G4 role — peer memory as the tier below disk)
         self.distributed = None
 
-    # -- offload (called on the event loop right after block commit) ----- #
+    # -- offload (event loop: stage at commit, flush once per step) ------ #
 
-    def offload_commit(self, seq_hashes: List[int], phys_pages: List[int]):
+    def offload_commit(self, seq_hashes: List[int], phys_pages: List[int],
+                       parent: Optional[int] = None):
         """Write-through: snapshot the just-committed device pages into G2.
-        Submitted to the engine's device executor so the gather is ordered
-        before any later page rewrite."""
-        todo = [
-            (h, p)
-            for h, p in zip(seq_hashes, phys_pages)
-            if not self.manager.has(h)
-        ]
+        Pipelined (default): stage the pairs; the engine's end-of-step
+        `flush_step()` coalesces every stage from this step into one
+        gather. Legacy (DYN_KVBM_PIPELINE=0): one gather + inline store per
+        call on the device executor. `parent` = hash chained immediately
+        before `seq_hashes[0]` (None at a chain head)."""
+        if not self.pipelined:
+            self._offload_commit_inline(seq_hashes, phys_pages, parent)
+            return
+        # probe the tiers BEFORE taking the cv: manager._lock nests under
+        # _offload_cv nowhere (one global lock order, race-lock-order)
+        missing = {h for h in seq_hashes if not self.manager.has(h)}
+        with self._offload_cv:
+            self.offload_commit_calls += 1
+            prev = parent
+            for h, p in zip(seq_hashes, phys_pages):
+                if h in missing and h not in self._inflight_hashes:
+                    self._staged.append((h, p, prev))
+                    self._inflight_hashes.add(h)
+                prev = h
+
+    def flush_step(self):
+        """Submit ONE gather for everything staged this step (engine step
+        loop, once per `_step_once`). The gather job runs on the device
+        executor but only dispatches; the device->host copy and tier
+        stores happen on the kvbm-tier thread."""
+        with self._offload_cv:
+            if self._stopped or not self._staged:
+                return
+            staged, self._staged = self._staged, []
+            batch = _OffloadBatch(
+                hashes=[h for h, _, _ in staged],
+                parents=[par for _, _, par in staged],
+            )
+            # backpressure: bound the not-yet-stored batches; the OLDEST
+            # uncommitted batch is the least valuable (most likely already
+            # superseded or about to be re-requested) — drop it, count it
+            while len(self._queue) >= self.queue_cap:
+                victim = self._queue.popleft()
+                victim.dropped = True
+                self.offload_batches_dropped += 1
+                self.offload_blocks_dropped += len(victim.hashes)
+                self._inflight_hashes.difference_update(victim.hashes)
+            self._queue.append(batch)
+            self.offload_gathers += 1
+            self._ensure_tier_thread()
+        # pad the gather to a pow2 page-count bucket (pad rows read the
+        # scratch page and are never stored): a varying batch size would
+        # compile a fresh extract_pages variant per distinct size —
+        # unbounded compile space; buckets bound it at log2(max_batch)
+        n = len(staged)
+        bucket = 1 << (n - 1).bit_length()
+        pages = np.zeros((bucket,), np.int32)
+        pages[:n] = [p for _, p, _ in staged]
+        eng = self.engine
+
+        def run_gather():
+            import jax.numpy as jnp
+
+            try:
+                k, v = eng._extract_pages(eng.kv_k, eng.kv_v, jnp.asarray(pages))
+            except Exception as e:  # noqa: BLE001 — a failed gather loses
+                # cache copies, never correctness; drop the batch
+                logger.warning("KVBM offload gather failed: %s", e)
+                with self._offload_cv:
+                    if not batch.dropped:
+                        # lost cache copies are DROPPED blocks wherever
+                        # they die — dashboards alarm on one counter. A
+                        # backpressure victim was already counted when it
+                        # left the queue; its failing gather adds nothing.
+                        self.offload_failures += 1
+                        self.offload_blocks_dropped += len(batch.hashes)
+                        self._inflight_hashes.difference_update(batch.hashes)
+                    batch.dropped = True
+                    batch.ready = True
+                    self._offload_cv.notify_all()
+                return
+            with self._offload_cv:
+                batch.k, batch.v = k, v
+                batch.ready = True
+                self._offload_cv.notify_all()
+
+        # the device executor orders this gather before any later rewrite
+        # of the same pages; _timed accrues its (dispatch-only) cost to
+        # dispatch_kvbm_offload_* so the bench can see the µs stolen
+        eng._device_exec.submit(eng._timed(run_gather, "kvbm_offload"))
+
+    def _ensure_tier_thread(self):
+        """Caller holds _offload_cv."""
+        if self._tier_thread is None or not self._tier_thread.is_alive():
+            self._tier_thread = threading.Thread(
+                target=self._tier_loop, name="kvbm-tier", daemon=True
+            )
+            self._tier_thread.start()
+
+    def _tier_loop(self):
+        """Dedicated tier thread: device->host copy, G2 store, G2->G3
+        cascade and G3 file I/O — everything the seed ran on the device
+        executor past the gather. One batch at a time, FIFO."""
+        while True:
+            with self._offload_cv:
+                while not self._stopped and not (
+                    self._queue and self._queue[0].ready
+                ):
+                    self._offload_cv.wait()
+                if self._stopped and not self._queue:
+                    return
+                batch = self._queue[0]
+                if not batch.ready:
+                    # stopped with an un-gathered batch queued: nothing to
+                    # store — the device job will never mark it ready.
+                    # These are lost cache copies like any other drop.
+                    self._queue.popleft()
+                    self.offload_batches_dropped += 1
+                    self.offload_blocks_dropped += len(batch.hashes)
+                    self._inflight_hashes.difference_update(batch.hashes)
+                    continue
+                self._queue.popleft()
+                self._processing = len(batch.hashes)
+            try:
+                if batch.dropped:
+                    continue
+                try:
+                    self._store_batch(batch)
+                except faults.FaultError as e:
+                    # dynochaos kvbm.offload `error`: the batch is dropped,
+                    # counted, and the stream never notices — offload is a
+                    # cache write, not part of any request's critical path
+                    logger.warning("KVBM offload batch dropped (%s)", e)
+                    with self._offload_cv:
+                        self.offload_failures += 1
+                        self.offload_blocks_dropped += len(batch.hashes)
+                        self._inflight_hashes.difference_update(batch.hashes)
+                except Exception:  # noqa: BLE001 — the tier thread must not die
+                    logger.exception("KVBM offload store failed; batch dropped")
+                    with self._offload_cv:
+                        self.offload_failures += 1
+                        self.offload_blocks_dropped += len(batch.hashes)
+                        self._inflight_hashes.difference_update(batch.hashes)
+            finally:
+                with self._offload_cv:
+                    self._processing = 0
+
+    def _store_batch(self, batch: _OffloadBatch):
+        f = faults.FAULTS
+        if f.enabled:
+            act = f.check("kvbm.offload")
+            if act == "error":
+                raise faults.FaultError("injected fault at kvbm.offload")
+            if act == "delay":
+                time.sleep(0.05)
+        # np.asarray blocks until the async gather lands — on THIS thread,
+        # not the device executor; [layers, n, ...] -> per-block [n, ...]
+        k_np = np.asarray(batch.k).swapaxes(0, 1)
+        v_np = np.asarray(batch.v).swapaxes(0, 1)
+        for i, h in enumerate(batch.hashes):
+            self.manager.store(h, k_np[i], v_np[i], parent=batch.parents[i])
+        with self._offload_cv:
+            self._inflight_hashes.difference_update(batch.hashes)
+        if self.distributed is not None:
+            self.distributed.announce_threadsafe("stored", batch.hashes)
+
+    def _offload_commit_inline(self, seq_hashes: List[int], phys_pages: List[int],
+                               parent: Optional[int] = None):
+        """Seed-shaped inline path (DYN_KVBM_PIPELINE=0): one gather +
+        synchronous store per commit call, all on the device executor.
+        Parents chain through exactly like the pipeline, so prefix-aware
+        eviction behaves identically on both arms."""
+        todo = []
+        prev = parent
+        for h, p in zip(seq_hashes, phys_pages):
+            if not self.manager.has(h):
+                todo.append((h, p, prev))
+            prev = h
         if not todo:
             return
+        with self._offload_cv:
+            self.offload_commit_calls += 1
+            self.offload_gathers += 1
         eng = self.engine
-        hashes = [h for h, _ in todo]
-        pages = np.array([p for _, p in todo], np.int32)
+        hashes = [h for h, _, _ in todo]
+        parents = [par for _, _, par in todo]
+        pages = np.array([p for _, p, _ in todo], np.int32)
 
         def run_extract():
             import jax.numpy as jnp
@@ -222,7 +536,7 @@ class KvbmConnector:
             k_np = np.asarray(k).swapaxes(0, 1)
             v_np = np.asarray(v).swapaxes(0, 1)
             for i, h in enumerate(hashes):
-                self.manager.store(h, k_np[i], v_np[i])
+                self.manager.store(h, k_np[i], v_np[i], parent=parents[i])
             if self.distributed is not None:
                 self.distributed.announce_threadsafe("stored", hashes)
 
@@ -236,7 +550,9 @@ class KvbmConnector:
             if exc is not None:
                 logger.warning("KVBM offload failed: %s", exc)
 
-        eng._device_exec.submit(run_extract).add_done_callback(done)
+        eng._device_exec.submit(
+            eng._timed(run_extract, "kvbm_offload")
+        ).add_done_callback(done)
 
     # -- onboard (called at admission) ----------------------------------- #
 
@@ -250,6 +566,17 @@ class KvbmConnector:
             )
         return local
 
+    def estimate_onboard_ms(self, hashes: Sequence[int]) -> Optional[float]:
+        """Projected tier-load latency for an onboard of `hashes` (None =
+        unknown; the engine only defers to recompute on a KNOWN blowout)."""
+        return self.manager.estimate_load_ms(hashes)
+
+    def note_onboard_recompute(self):
+        """The engine skipped an onboard whose projected tier-load latency
+        exceeded the slot's TTFT headroom (docs/kvbm.md onboard budget)."""
+        with self._offload_cv:
+            self.onboard_recompute_fallbacks += 1
+
     def load(self, hashes: Sequence[int]) -> Tuple[np.ndarray, np.ndarray]:
         return self.manager.load_blocks(hashes)
 
@@ -258,9 +585,22 @@ class KvbmConnector:
         executor (`run`), remote blocks pull point-to-point from their
         owner's data plane and are PROMOTED into the local host tier so
         repeat hits stay local. Raises KeyError on any miss (the engine
-        falls back to prefilling that span)."""
+        falls back to prefilling that span); a dynochaos `kvbm.onboard`
+        error rides the same fallback."""
+        f = faults.FAULTS
+        if f.enabled:
+            # FaultError propagates to _inject_onboard, which treats it
+            # exactly like an evicted block: recompute that span
+            await f.on("kvbm.onboard")
         local = [h for h in hashes if self.manager.has(h)]
         remote = [h for h in hashes if not self.manager.has(h)]
+        # `hashes` is a contiguous onboard span: each hash's predecessor
+        # is its chain parent (first unknown) — promotion keeps the links
+        parent_of: dict = {}
+        prev = None
+        for h in hashes:
+            parent_of[h] = prev
+            prev = h
         parts: dict = {}
         if remote:
             if self.distributed is None:
@@ -275,7 +615,7 @@ class KvbmConnector:
 
             def promote():
                 for i, h in enumerate(remote):
-                    self.manager.store(h, rk[i], rv[i])
+                    self.manager.store(h, rk[i], rv[i], parent=parent_of[h])
 
             await run(promote)
             for i, h in enumerate(remote):
@@ -295,14 +635,55 @@ class KvbmConnector:
         return n
 
     def pending_offloads(self) -> int:
-        """In-flight write-through count (engine close() drains on this)."""
+        """In-flight write-through count: staged pairs + queued batches'
+        blocks + the batch mid-store on the tier thread (pipeline) +
+        legacy inline jobs (engine close() drains on this)."""
+        with self._offload_cv:
+            n = (
+                len(self._staged)
+                + sum(len(b.hashes) for b in self._queue)
+                + self._processing
+            )
         with self._pending_lock:
-            return self._pending
+            n += self._pending
+        return n
+
+    def drain(self, timeout_s: float = 5.0) -> bool:
+        """Block (event-loop-free callers only) until every staged/queued
+        offload is stored or dropped. Returns False on timeout."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self.pending_offloads() == 0:
+                return True
+            time.sleep(0.005)
+        return self.pending_offloads() == 0
+
+    def shutdown(self):
+        """Stop the tier thread after the queue empties (engine close();
+        call after an async drain)."""
+        with self._offload_cv:
+            self._stopped = True
+            self._offload_cv.notify_all()
+        t = self._tier_thread
+        if t is not None and t.is_alive():
+            t.join(timeout=5.0)
 
     def stats(self) -> dict:
-        with self._pending_lock:
-            pending = self._pending
-        out = {**self.manager.stats(), "kvbm_pending_offloads": pending}
+        with self._offload_cv:
+            queue_depth = len(self._queue)
+            staged = len(self._staged)
+            out = {
+                "kvbm_offload_commit_calls": self.offload_commit_calls,
+                "kvbm_offload_gathers": self.offload_gathers,
+                "kvbm_offload_queue_depth": queue_depth,
+                "kvbm_offload_staged_blocks": staged,
+                "kvbm_offload_batches_dropped": self.offload_batches_dropped,
+                "kvbm_offload_blocks_dropped": self.offload_blocks_dropped,
+                "kvbm_offload_failures": self.offload_failures,
+                "kvbm_onboard_recompute_fallbacks": self.onboard_recompute_fallbacks,
+            }
+        out.update(self.manager.stats())
+        out["kvbm_pending_offloads"] = self.pending_offloads()
         if self.distributed is not None:
             out.update(self.distributed.stats())
         return out

@@ -3,9 +3,27 @@
 Reference: lib/llm/src/block_manager/storage.rs (Storage traits :157,219,322)
 and layout.rs (fully-contiguous layout). Each tier is a fixed-capacity pool
 of KV blocks keyed by the chained block hash (llm/tokens.py — the SAME hash
-the router indexes), with LRU eviction of the whole pool (every block in a
+the router indexes), with a pluggable eviction policy (every block in a
 tier is an unreferenced cache copy; onboarding copies data out, so no
 pinning is needed).
+
+Eviction policies (DYN_KVBM_EVICTION, docs/kvbm.md):
+
+  ``lru``           evict the least-recently-touched block (the seed
+                    behavior; `get` and re-`put` both count as touches).
+  ``lfu``           evict the least-frequently-touched block, oldest
+                    touch breaking ties (lazy-heap implementation: stale
+                    heap entries are skipped at eviction time, so touches
+                    stay O(log n) and eviction is amortized O(log n)).
+  ``prefix-aware``  LRU, but a block with a live DESCENDANT in the same
+                    pool is protected: because hashes are chained, an
+                    interior block is useful exactly as long as a deeper
+                    block extends it — evicting the interior block first
+                    would break the child's onboardable prefix while its
+                    bytes still occupy a slot (the RTP-LLM / Mooncake
+                    leaf-first heuristic). A chained forest always has a
+                    leaf, so the scan terminates; blocks with unknown
+                    parentage (warm disk restart) just look like roots.
 
 A block is one page of one sequence across all layers:
     k, v: [num_layers, page_size, num_kv_heads, head_dim]
@@ -13,37 +31,130 @@ A block is one page of one sequence across all layers:
 
 from __future__ import annotations
 
+import heapq
 import json
 import os
 from collections import OrderedDict
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
+EVICTION_POLICIES = ("lru", "lfu", "prefix-aware")
+
 
 class _BlockPool:
-    """Shared slot-pool + LRU bookkeeping for both tiers. Subclasses supply
-    the backing arrays (`_k`/`_v`) and may pre-seed `_by_hash` before
-    calling `_init_pool`."""
+    """Shared slot-pool + eviction bookkeeping for both tiers. Subclasses
+    supply the backing arrays (`_k`/`_v`) and may pre-seed `_by_hash`
+    before calling `_init_pool`."""
 
     name = "pool"
 
-    def __init__(self, capacity: int, block_shape: tuple, dtype):
+    def __init__(self, capacity: int, block_shape: tuple, dtype,
+                 policy: str = "lru"):
+        if policy not in EVICTION_POLICIES:
+            raise ValueError(
+                f"unknown eviction policy {policy!r}; want one of "
+                f"{'/'.join(EVICTION_POLICIES)}"
+            )
         self.capacity = capacity
         self.block_shape = tuple(block_shape)
         self.dtype = np.dtype(dtype)
+        self.policy = policy
         self._by_hash: Dict[int, int] = {}  # seq_hash -> slot
         self._k: np.ndarray
         self._v: np.ndarray
         self._free: List[int] = []
         self._lru: "OrderedDict[int, None]" = OrderedDict()
+        # lfu bookkeeping (lazy heap: entries go stale when a hash is
+        # touched again or evicted; victim search pops until fresh)
+        self._freq: Dict[int, int] = {}
+        self._heap: List[Tuple[int, int, int]] = []  # (freq, tick, hash)
+        self._tick = 0
+        # prefix-aware bookkeeping: parent link + in-pool children per
+        # hash, plus the childless blocks in recency order so victim
+        # selection is O(1), not an LRU scan under the manager lock
+        self._parent: Dict[int, int] = {}  # child hash -> parent hash
+        self._children: Dict[int, Set[int]] = {}  # parent -> in-pool children
+        self._leaves: "OrderedDict[int, None]" = OrderedDict()
+        # counters
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
 
     def _init_pool(self):
-        """Build free list / LRU from whatever `_by_hash` holds (empty for a
-        cold start; the persisted index for a warm disk restart)."""
+        """Build free list / recency from whatever `_by_hash` holds (empty
+        for a cold start; the persisted index for a warm disk restart —
+        restored blocks carry no parent links, so prefix-aware treats them
+        as roots)."""
         used = set(self._by_hash.values())
         self._free = [s for s in range(self.capacity - 1, -1, -1) if s not in used]
         self._lru = OrderedDict((h, None) for h in self._by_hash)
+        self._freq = {h: 1 for h in self._by_hash}
+        self._heap = []
+        for h in self._by_hash:
+            self._push_heap(h)
+        self._parent = {}
+        self._children = {}
+        self._leaves = OrderedDict((h, None) for h in self._by_hash)
+
+    def _push_heap(self, seq_hash: int):
+        self._tick += 1
+        heapq.heappush(self._heap, (self._freq[seq_hash], self._tick, seq_hash))
+        if len(self._heap) > max(4 * self.capacity, 64):
+            # lazy-heap compaction: every touch pushes an entry but only
+            # eviction pops, so a hit-heavy tier whose working set fits
+            # in capacity would otherwise grow the heap without bound.
+            # freq only increases, so exactly one entry per live hash
+            # matches its current freq — keep those, drop the stale.
+            self._heap = [
+                (f, t, h) for f, t, h in self._heap
+                if h in self._by_hash and self._freq.get(h) == f
+            ]
+            heapq.heapify(self._heap)
+
+    def _touch(self, seq_hash: int):
+        self._lru[seq_hash] = None
+        self._lru.move_to_end(seq_hash)
+        if seq_hash in self._leaves:
+            self._leaves.move_to_end(seq_hash)
+        if self.policy == "lfu":
+            self._freq[seq_hash] = self._freq.get(seq_hash, 0) + 1
+            self._push_heap(seq_hash)
+
+    def _pick_victim(self) -> int:
+        if self.policy == "lfu":
+            while self._heap:
+                freq, _, h = heapq.heappop(self._heap)
+                if h in self._by_hash and self._freq.get(h) == freq:
+                    return h
+            return next(iter(self._lru))  # heap drifted (shouldn't happen)
+        if self.policy == "prefix-aware":
+            if self._leaves:
+                return next(iter(self._leaves))
+            # every block has an in-pool descendant — impossible for a
+            # chained forest, but stale bookkeeping must not wedge the pool
+            return next(iter(self._lru))
+        return next(iter(self._lru))
+
+    def _forget(self, seq_hash: int):
+        """Drop all policy bookkeeping for an evicted hash."""
+        self._lru.pop(seq_hash, None)
+        self._leaves.pop(seq_hash, None)
+        self._freq.pop(seq_hash, None)
+        parent = self._parent.pop(seq_hash, None)
+        if parent is not None:
+            kids = self._children.get(parent)
+            if kids is not None:
+                kids.discard(seq_hash)
+                if not kids:
+                    del self._children[parent]
+                    if parent in self._by_hash:
+                        # last in-pool child left: the parent is a leaf
+                        # again, at the MRU end (it had descendants — it
+                        # earned its keep)
+                        self._leaves[parent] = None
+        # children keep their _parent link: if this hash is re-stored the
+        # chain is intact; _children[seq_hash] stays until its kids leave
 
     def __len__(self) -> int:
         return len(self._by_hash)
@@ -52,38 +163,63 @@ class _BlockPool:
         return seq_hash in self._by_hash
 
     def put(
-        self, seq_hash: int, k: np.ndarray, v: np.ndarray
-    ) -> Optional[Tuple[int, Optional[np.ndarray], Optional[np.ndarray]]]:
+        self, seq_hash: int, k: np.ndarray, v: np.ndarray,
+        parent: Optional[int] = None,
+    ) -> Optional[Tuple[int, Optional[np.ndarray], Optional[np.ndarray], Optional[int]]]:
         """Store a block. If the pool was full, returns the evicted
-        (hash, k, v) — with data copies only when `evict_with_data` — so the
-        caller can cascade it to the next tier. Returns None otherwise."""
+        (hash, k, v, parent) — with data copies only when
+        `evict_with_data` — so the caller can cascade it (parent included)
+        to the next tier. Returns None otherwise. `parent` is the
+        preceding block hash in the chain when known (prefix-aware
+        protection)."""
         if seq_hash in self._by_hash:
-            self._lru[seq_hash] = None
-            self._lru.move_to_end(seq_hash)
+            self._touch(seq_hash)
+            if parent is not None and seq_hash not in self._parent:
+                self._link_parent(seq_hash, parent)
             return None
         evicted = None
         if not self._free:
-            old_hash, _ = self._lru.popitem(last=False)
+            old_hash = self._pick_victim()
             slot = self._by_hash.pop(old_hash)
+            old_parent = self._parent.get(old_hash)
             if self.evict_with_data:
-                evicted = (old_hash, self._k[slot].copy(), self._v[slot].copy())
+                evicted = (old_hash, self._k[slot].copy(), self._v[slot].copy(),
+                           old_parent)
             else:
-                evicted = (old_hash, None, None)
+                evicted = (old_hash, None, None, old_parent)
+            self._forget(old_hash)
+            self.evictions += 1
             self._free.append(slot)
         slot = self._free.pop()
         self._k[slot] = k
         self._v[slot] = v
         self._by_hash[seq_hash] = slot
         self._lru[seq_hash] = None
+        if not self._children.get(seq_hash):
+            # childless on arrival (a re-added interior block whose kids
+            # are still pooled stays protected)
+            self._leaves[seq_hash] = None
+        if self.policy == "lfu":
+            self._freq[seq_hash] = 1
+            self._push_heap(seq_hash)
+        if parent is not None:
+            self._link_parent(seq_hash, parent)
         return evicted
+
+    def _link_parent(self, seq_hash: int, parent: int):
+        self._parent[seq_hash] = parent
+        self._children.setdefault(parent, set()).add(seq_hash)
+        self._leaves.pop(parent, None)  # parent now interior
 
     def get(self, seq_hash: int) -> Optional[Tuple[np.ndarray, np.ndarray]]:
         """Returns VIEWS into the pool; callers that hold the result across
         further put()s must copy."""
         slot = self._by_hash.get(seq_hash)
         if slot is None:
+            self.misses += 1
             return None
-        self._lru.move_to_end(seq_hash)
+        self.hits += 1
+        self._touch(seq_hash)
         return self._k[slot], self._v[slot]
 
     def clear(self) -> int:
@@ -98,6 +234,9 @@ class _BlockPool:
         return {
             f"{self.name}_blocks": len(self._by_hash),
             f"{self.name}_capacity": self.capacity,
+            f"{self.name}_hits": self.hits,
+            f"{self.name}_misses": self.misses,
+            f"{self.name}_evictions": self.evictions,
         }
 
     evict_with_data = True
@@ -111,8 +250,9 @@ class HostTier(_BlockPool):
     name = "host"
     evict_with_data = True
 
-    def __init__(self, capacity: int, block_shape: tuple, dtype):
-        super().__init__(capacity, block_shape, dtype)
+    def __init__(self, capacity: int, block_shape: tuple, dtype,
+                 policy: str = "lru"):
+        super().__init__(capacity, block_shape, dtype, policy)
         self._k = np.zeros((capacity, *self.block_shape), self.dtype)
         self._v = np.zeros((capacity, *self.block_shape), self.dtype)
         self._init_pool()
@@ -132,8 +272,9 @@ class DiskTier(_BlockPool):
     name = "disk"
     evict_with_data = False
 
-    def __init__(self, capacity: int, block_shape: tuple, dtype, path: str):
-        super().__init__(capacity, block_shape, dtype)
+    def __init__(self, capacity: int, block_shape: tuple, dtype, path: str,
+                 policy: str = "lru"):
+        super().__init__(capacity, block_shape, dtype, policy)
         self.path = path
         os.makedirs(path, exist_ok=True)
         shape = (capacity, *self.block_shape)
@@ -167,16 +308,26 @@ class DiskTier(_BlockPool):
         self._v = np.memmap(v_path, dtype=self.dtype, mode=mode, shape=shape)
         self._init_pool()
 
-    def put(self, seq_hash: int, k: np.ndarray, v: np.ndarray) -> Optional[int]:
+    def put(self, seq_hash: int, k: np.ndarray, v: np.ndarray,
+            parent: Optional[int] = None) -> Optional[int]:
         """Returns the dropped hash if the pool was full, else None."""
-        evicted = super().put(seq_hash, k, v)
+        evicted = super().put(seq_hash, k, v, parent=parent)
         return evicted[0] if evicted is not None else None
 
     def flush(self):
-        """Persist pool + index. NOT thread-safe on its own — call via
-        KvBlockManager.flush(), which holds the manager lock."""
+        """Persist pool + index. Crash-consistent: the index is written to
+        a temp file and atomically renamed over index.json, so a crash
+        mid-flush leaves the PREVIOUS index intact (a torn index.json
+        would poison every warm restart until manually deleted). NOT
+        thread-safe on its own — call via KvBlockManager.flush(), which
+        holds the manager lock."""
         self._k.flush()
         self._v.flush()
         index = {str(h): s for h, s in self._by_hash.items()}
-        with open(os.path.join(self.path, "index.json"), "w") as f:
+        index_path = os.path.join(self.path, "index.json")
+        tmp_path = index_path + ".tmp"
+        with open(tmp_path, "w") as f:
             json.dump({"block_shape": self.block_shape, "index": index}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp_path, index_path)

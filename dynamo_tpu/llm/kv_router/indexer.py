@@ -15,13 +15,28 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
-from collections import defaultdict
+import os
+from collections import OrderedDict, defaultdict
 from typing import Dict, List, Optional, Set
 
 from ...runtime.component import DistributedRuntime
 from ..tokens import compute_seq_hashes
 
 logger = logging.getLogger(__name__)
+
+
+def _index_cap_from_env() -> Optional[int]:
+    """DYN_ROUTER_INDEX_MAX_BLOCKS: block-count cap per router index
+    (0/unset = unbounded, the seed behavior). At a million sessions the
+    event stream grows the index without bound — the cap turns that into
+    leaf-first eviction instead of a frontend OOM."""
+    raw = os.environ.get("DYN_ROUTER_INDEX_MAX_BLOCKS")
+    try:
+        cap = int(raw) if raw else 0
+    except ValueError:
+        logger.warning("DYN_ROUTER_INDEX_MAX_BLOCKS=%r invalid; unbounded", raw)
+        cap = 0
+    return cap if cap > 0 else None
 
 
 class OverlapScores:
@@ -36,36 +51,132 @@ class OverlapScores:
         return f"OverlapScores({self.scores})"
 
 
+#: stats() memory-estimate coefficients: rough CPython cost of one hash
+#: entry (two dict slots + OrderedDict node + parent/children bookkeeping)
+#: and one (hash, worker) set membership, measured-once constants, not
+#: precise accounting — the point is that the estimate SCALES with the
+#: index so an operator can alarm on it.
+_BYTES_PER_BLOCK = 240
+_BYTES_PER_MAPPING = 100
+
+
 class RadixTree:
     """Flat chained-hash index with match-walk semantics
-    (reference RadixTree indexer.rs:224)."""
+    (reference RadixTree indexer.rs:224).
 
-    def __init__(self):
+    `max_blocks` bounds the index (docs/kv_cache_routing.md): when the
+    cap is exceeded, LEAVES are evicted first in least-recently-matched
+    order. Because hashes are chained, an interior block is exactly as
+    useful as the deepest chain through it — evicting leaf-first means a
+    capped index degrades from the deep (cold, most specific) end of each
+    prefix chain while shared roots survive, and a match walk over the
+    survivors still returns correct (merely shallower) overlap scores.
+    Eviction drops the hash for ALL workers: it is routing metadata, not
+    cache state — the worker still holds the block; the router just stops
+    scoring it."""
+
+    def __init__(self, max_blocks: Optional[int] = None):
         self._blocks: Dict[int, Set[int]] = defaultdict(set)  # hash -> workers
         self._worker_blocks: Dict[int, Set[int]] = defaultdict(set)  # worker -> hashes
+        self.max_blocks = max_blocks if max_blocks and max_blocks > 0 else None
+        # chain bookkeeping for leaf-first eviction: parent link per hash,
+        # in-index children per hash, and leaves in least-recently-matched
+        # order (OrderedDict as an O(1) recency list)
+        self._parent: Dict[int, int] = {}
+        self._children: Dict[int, Set[int]] = {}
+        self._leaf_order: "OrderedDict[int, None]" = OrderedDict()
+        self._mappings = 0  # live (hash, worker) pairs, for the mem estimate
+        self.evicted_blocks = 0
 
-    def apply_stored(self, worker_id: int, block_hashes: List[int]):
+    def apply_stored(self, worker_id: int, block_hashes: List[int],
+                     chained: bool = True, parent: Optional[int] = None):
+        """`chained=True` (live stored events): consecutive hashes are a
+        contiguous chain, so each records the previous as its parent, and
+        `parent` (the stored event's `parent_hash`) links the FIRST block
+        to the chain it extends — without it, per-block stored events
+        (one per generated block) would leave every block a root/leaf and
+        leaf-first eviction would take the roots first.
+        `chained=False` (snapshot restore via load(): dump() sorts hash
+        sets, destroying chain order): no parent links are fabricated —
+        restored blocks are all roots/leaves until live events re-chain
+        them, degrading eviction quality, never correctness."""
+        bounded = self.max_blocks is not None
+        prev: Optional[int] = parent if chained else None
         for h in block_hashes:
-            self._blocks[h].add(worker_id)
-            self._worker_blocks[worker_id].add(h)
+            workers = self._blocks[h]
+            if worker_id not in workers:
+                workers.add(worker_id)
+                self._worker_blocks[worker_id].add(h)
+                self._mappings += 1
+            if not bounded:
+                continue  # chain/leaf bookkeeping only feeds eviction —
+                # an uncapped tree skips its ~2x per-block overhead
+            if h not in self._leaf_order and not self._children.get(h):
+                self._leaf_order[h] = None
+            if chained and prev is not None and h not in self._parent:
+                self._parent[h] = prev
+                self._children.setdefault(prev, set()).add(h)
+                self._leaf_order.pop(prev, None)  # prev now interior
+            prev = h
+        self._maybe_evict()
+
+    def _unlink(self, h: int):
+        """Chain bookkeeping for a hash that left the index entirely:
+        drop its leaf/parent entries, and re-leaf the parent (at the MRU
+        end — it just proved useful by having had descendants) when `h`
+        was its last in-index child."""
+        self._leaf_order.pop(h, None)
+        parent = self._parent.pop(h, None)
+        if parent is not None:
+            kids = self._children.get(parent)
+            if kids is not None:
+                kids.discard(h)
+                if not kids:
+                    del self._children[parent]
+                    if parent in self._blocks:
+                        self._leaf_order[parent] = None
+
+    def _drop_hash(self, h: int):
+        """Remove `h` for every holder + all chain bookkeeping."""
+        workers = self._blocks.pop(h, None)
+        if workers:
+            for w in workers:
+                wb = self._worker_blocks.get(w)
+                if wb is not None:
+                    wb.discard(h)
+            self._mappings -= len(workers)
+        self._unlink(h)
+
+    def _maybe_evict(self):
+        if self.max_blocks is None:
+            return
+        while len(self._blocks) > self.max_blocks:
+            if self._leaf_order:
+                victim = next(iter(self._leaf_order))
+            else:
+                # no known leaf (stale bookkeeping) — never wedge the cap
+                victim = next(iter(self._blocks))
+            self._drop_hash(victim)
+            self.evicted_blocks += 1
+
+    def _forget_for_worker(self, worker_id: int, h: int):
+        workers = self._blocks.get(h)
+        if workers and worker_id in workers:
+            workers.discard(worker_id)
+            self._mappings -= 1
+            if not workers:
+                self._blocks.pop(h, None)
+                self._unlink(h)  # fully gone: same cleanup as an eviction
 
     def apply_removed(self, worker_id: int, block_hashes: List[int]):
         for h in block_hashes:
-            workers = self._blocks.get(h)
-            if workers:
-                workers.discard(worker_id)
-                if not workers:
-                    self._blocks.pop(h, None)
+            self._forget_for_worker(worker_id, h)
             self._worker_blocks[worker_id].discard(h)
 
     def remove_worker(self, worker_id: int):
         """Worker died: drop all its blocks (reference remove_worker)."""
         for h in self._worker_blocks.pop(worker_id, set()):
-            workers = self._blocks.get(h)
-            if workers:
-                workers.discard(worker_id)
-                if not workers:
-                    self._blocks.pop(h, None)
+            self._forget_for_worker(worker_id, h)
 
     def clear_all_blocks(self, worker_id: int):
         self.remove_worker(worker_id)
@@ -79,6 +190,9 @@ class RadixTree:
             holders = self._blocks.get(h)
             if not holders:
                 break
+            if self.max_blocks is not None and h in self._leaf_order:
+                # matched leaves are hot: refresh their eviction recency
+                self._leaf_order.move_to_end(h)
             active = set(holders) if active is None else (active & holders)
             if not active:
                 break
@@ -92,6 +206,23 @@ class RadixTree:
     @property
     def num_blocks(self) -> int:
         return len(self._blocks)
+
+    def memory_bytes_estimate(self) -> int:
+        """Order-of-magnitude resident cost of the index (docs note in
+        kv_cache_routing.md: an alarmable scale signal, not an accountant)."""
+        return (
+            _BYTES_PER_BLOCK * len(self._blocks)
+            + _BYTES_PER_MAPPING * self._mappings
+        )
+
+    def stats(self) -> dict:
+        return {
+            "index_blocks": len(self._blocks),
+            "index_max_blocks": self.max_blocks or 0,
+            "index_evicted_blocks": self.evicted_blocks,
+            "index_mappings": self._mappings,
+            "index_memory_bytes_estimate": self.memory_bytes_estimate(),
+        }
 
     def worker_block_count(self, worker_id: int) -> int:
         return len(self._worker_blocks.get(worker_id, ()))
@@ -107,8 +238,11 @@ class RadixTree:
         }
 
     def load(self, snapshot: dict):
+        # dump() sorts each worker's hash set — chain order is gone, so
+        # restoring must NOT fabricate parent links (chained=False);
+        # restored blocks are all leaves until live events re-chain them
         for w_str, hashes in snapshot.items():
-            self.apply_stored(int(w_str), list(hashes))
+            self.apply_stored(int(w_str), list(hashes), chained=False)
 
 
 EVENT_TOPIC_FMT = "kv_events/{namespace}/{component}"
@@ -137,6 +271,7 @@ class KvIndexer:
         block_size: int = 64,
         snapshot_threshold: Optional[int] = None,
         reset_states: bool = False,
+        max_blocks: Optional[int] = None,
     ):
         from ...native import make_radix_tree
 
@@ -148,7 +283,12 @@ class KvIndexer:
         )
         self.snapshot_threshold = snapshot_threshold
         self.reset_states = reset_states
-        self.tree = make_radix_tree()  # C++ index when built, else RadixTree
+        if max_blocks is None:
+            max_blocks = _index_cap_from_env()
+        self.max_blocks = max_blocks
+        # C++ index when built AND unbounded, else RadixTree (the cap's
+        # leaf-first bookkeeping lives in the Python tree)
+        self.tree = make_radix_tree(max_blocks=max_blocks)
         self._task: Optional[asyncio.Task] = None
         self._sub = None
         self.events_applied = 0
@@ -205,7 +345,10 @@ class KvIndexer:
                 worker_id = msg["worker_id"]
                 for ev in msg.get("events", []):
                     if ev.get("event_type") == "stored":
-                        self.tree.apply_stored(worker_id, ev["block_hashes"])
+                        self.tree.apply_stored(
+                            worker_id, ev["block_hashes"],
+                            parent=ev.get("parent_hash"),
+                        )
                     elif ev.get("event_type") == "removed":
                         self.tree.apply_removed(worker_id, ev["block_hashes"])
                     elif ev.get("event_type") == "cleared":
@@ -231,6 +374,15 @@ class KvIndexer:
     def remove_worker(self, worker_id: int):
         self.tree.remove_worker(worker_id)
 
+    def stats(self) -> dict:
+        out = {"events_applied": self.events_applied}
+        tree_stats = getattr(self.tree, "stats", None)
+        if tree_stats is not None:
+            out.update(tree_stats())
+        else:  # native tree: block count only
+            out["index_blocks"] = self.tree.num_blocks
+        return out
+
     async def close(self):
         if self._task:
             self._task.cancel()
@@ -248,19 +400,33 @@ class KvIndexerSharded:
     lookups fan out and merge (reference KvIndexerSharded indexer.rs:992 —
     bounds per-trie size and contention for large fleets)."""
 
-    def __init__(self, num_shards: int = 4, block_size: int = 64):
+    def __init__(self, num_shards: int = 4, block_size: int = 64,
+                 max_blocks: Optional[int] = None):
         from ...native import make_radix_tree
 
         if num_shards < 1:
             raise ValueError("num_shards must be >= 1")
         self.block_size = block_size
-        self.shards = [make_radix_tree() for _ in range(num_shards)]
+        if max_blocks is None:
+            max_blocks = _index_cap_from_env()
+        self.max_blocks = max_blocks
+        # shards hold disjoint workers, so the global cap splits evenly
+        # (ceil: the sum may exceed max_blocks by < num_shards)
+        per_shard = (
+            -(-max_blocks // num_shards) if max_blocks is not None else None
+        )
+        self.shards = [
+            make_radix_tree(max_blocks=per_shard) for _ in range(num_shards)
+        ]
 
     def _shard(self, worker_id: int):
         return self.shards[worker_id % len(self.shards)]
 
-    def apply_stored(self, worker_id: int, block_hashes: List[int]):
-        self._shard(worker_id).apply_stored(worker_id, block_hashes)
+    def apply_stored(self, worker_id: int, block_hashes: List[int],
+                     chained: bool = True, parent: Optional[int] = None):
+        self._shard(worker_id).apply_stored(
+            worker_id, block_hashes, chained=chained, parent=parent
+        )
 
     def apply_removed(self, worker_id: int, block_hashes: List[int]):
         self._shard(worker_id).apply_removed(worker_id, block_hashes)
@@ -293,6 +459,19 @@ class KvIndexerSharded:
     def num_blocks(self) -> int:
         return sum(s.num_blocks for s in self.shards)
 
+    def stats(self) -> dict:
+        out: dict = {"index_blocks": 0, "index_max_blocks": self.max_blocks or 0}
+        for s in self.shards:
+            shard_stats = getattr(s, "stats", None)
+            if shard_stats is None:
+                out["index_blocks"] += s.num_blocks
+                continue
+            for k, v in shard_stats().items():
+                if k == "index_max_blocks":
+                    continue
+                out[k] = out.get(k, 0) + v
+        return out
+
     def workers(self) -> List[int]:
         out: List[int] = []
         for s in self.shards:
@@ -306,8 +485,11 @@ class KvIndexerSharded:
         return merged
 
     def load(self, snapshot: dict):
+        # route through each shard's own load: the sorted snapshot must
+        # not be re-interpreted as chains (Python tree), and a native
+        # shard's plain apply_stored is chain-free anyway
         for w_str, hashes in snapshot.items():
-            self.apply_stored(int(w_str), list(hashes))
+            self._shard(int(w_str)).load({w_str: list(hashes)})
 
 
 class ApproxKvIndexer:

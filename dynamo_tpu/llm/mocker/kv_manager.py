@@ -34,6 +34,41 @@ class KvEvent:
         return d
 
 
+def stored_event_runs(
+    seq_hashes: List[int],
+    new_hashes: Set[int],
+    token_blocks: Optional[List[List[int]]] = None,
+    parent_of_first: Optional[int] = None,
+) -> List[KvEvent]:
+    """Split the newly stored subset of a chained sequence into one
+    `stored` event per CONTIGUOUS run, each carrying the run's true chain
+    parent (the seq_hashes element just before it) and its aligned
+    token_blocks slice. A commit can skip hashes a concurrent sequence
+    already cached, and a single gapped event would make the router's
+    bounded index fabricate parent links across the gap — this is the
+    single spelling of the contract for BOTH producers (the engine's
+    PageAllocator.commit_hashes and the mocker's KvManager.acquire)."""
+    runs: List[dict] = []
+    run: Optional[dict] = None
+    prev = parent_of_first
+    for i, h in enumerate(seq_hashes):
+        if h in new_hashes:
+            if run is None:
+                run = {"parent": prev, "hashes": [], "tb": []}
+                runs.append(run)
+            run["hashes"].append(h)
+            if token_blocks is not None and i < len(token_blocks):
+                run["tb"].append(token_blocks[i])
+        else:
+            run = None
+        prev = h
+    return [
+        KvEvent("stored", r["hashes"], parent_hash=r["parent"],
+                token_blocks=r["tb"] or None)
+        for r in runs
+    ]
+
+
 @dataclass
 class _Block:
     seq_hash: int
@@ -108,29 +143,22 @@ class KvManager:
         # evict as needed
         while self._used + len(new_hashes) > self.num_blocks and self._lru:
             self._evict_one()
-        stored: List[int] = []
-        stored_tokens: List[List[int]] = []
-        for i, h in enumerate(seq_hashes):
+        created: Set[int] = set()
+        for h in seq_hashes:
             blk = self._active.get(h)
             if blk is None:
                 blk = _Block(seq_hash=h, ref_count=0)
                 self._active[h] = blk
                 self._used += 1
-                stored.append(h)
-                if token_blocks is not None and i < len(token_blocks):
-                    stored_tokens.append(token_blocks[i])
+                created.add(h)
             if blk.ref_count == 0:
                 self._lru.pop(h, None)
             blk.ref_count += 1
-        if stored and self.event_sink:
-            self.event_sink(
-                KvEvent(
-                    "stored",
-                    stored,
-                    parent_hash=parent_of_first,
-                    token_blocks=stored_tokens or None,
-                )
-            )
+        if created and self.event_sink:
+            for ev in stored_event_runs(
+                seq_hashes, created, token_blocks, parent_of_first
+            ):
+                self.event_sink(ev)
         return True
 
     def release(self, seq_hashes: List[int]):

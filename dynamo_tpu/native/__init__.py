@@ -153,7 +153,10 @@ class NativeRadixTree:
             lib.dyn_index_free(idx)
             self._idx = None
 
-    def apply_stored(self, worker_id: int, block_hashes: List[int]):
+    def apply_stored(self, worker_id: int, block_hashes: List[int],
+                     chained: bool = True, parent=None):
+        # chained/parent are the Python tree's bounded-eviction chain
+        # metadata; the C++ index is unbounded and ignores them
         arr = _as_u64_array(block_hashes)
         self._lib.dyn_index_apply_stored(
             self._idx,
@@ -240,10 +243,16 @@ class NativeRadixTree:
             self.apply_stored(int(w_str), list(hashes))
 
 
-def make_radix_tree():
-    """Best tree available: native C++ index, else the Python one."""
-    if native_available():
-        return NativeRadixTree()
+def make_radix_tree(max_blocks=None):
+    """Best tree available: native C++ index, else the Python one. A
+    block-count cap (`max_blocks`, DYN_ROUTER_INDEX_MAX_BLOCKS) forces
+    the Python tree — leaf-first eviction needs the chain bookkeeping the
+    C++ index does not carry; a bounded index is chosen for memory, not
+    match speed, so that is the right trade."""
     from ..llm.kv_router.indexer import RadixTree
 
+    if max_blocks is not None and max_blocks > 0:
+        return RadixTree(max_blocks=max_blocks)
+    if native_available():
+        return NativeRadixTree()
     return RadixTree()

@@ -235,6 +235,40 @@ ENV_REGISTRY: tuple = (
            "(docs/ragged_attention.md). EngineConfig.mixed_dispatch "
            "overrides.",
            "engine/engine.py"),
+    # -- KVBM tier pipeline (kvbm/, docs/kvbm.md) ----------------------- #
+    EnvVar("DYN_KVBM_PIPELINE", "bool", "1",
+           "Batched KVBM offload pipeline: coalesce a step's block "
+           "commits into one device gather and run tier stores on the "
+           "dedicated kvbm-tier thread. 0 restores the inline "
+           "per-commit offload (one gather + store per commit, all on "
+           "the device executor) — the bench_kv_cache.py before/after "
+           "arm and a safety valve.",
+           "kvbm/manager.py"),
+    EnvVar("DYN_KVBM_OFFLOAD_QUEUE", "int", "8",
+           "Max in-flight offload batches between the per-step gather "
+           "and the kvbm-tier thread's stores. When the tier thread "
+           "falls behind, the OLDEST queued batch is dropped (counted "
+           "in kvbm_offload_blocks_dropped) instead of stalling the "
+           "step loop — offloads are cache copies, never correctness.",
+           "kvbm/manager.py"),
+    EnvVar("DYN_KVBM_EVICTION", "enum", "lru",
+           "KVBM tier eviction policy: `lru`, `lfu`, or `prefix-aware` "
+           "(protects blocks with live chained descendants in the same "
+           "tier — the RTP-LLM/Mooncake heuristic). One value applies "
+           "to both tiers; `host=lfu,disk=lru` sets them independently.",
+           "kvbm/manager.py"),
+    # -- KV router index bound (llm/kv_router/, docs/kv_cache_routing.md) #
+    EnvVar("DYN_ROUTER_INDEX_MAX_BLOCKS", "int", "0",
+           "Block-count cap per KV-router index (KvIndexer tree; "
+           "KvIndexerSharded ceil-splits it statically across shards, "
+           "so with fewer workers than shards the effective cap is "
+           "proportionally lower — the memory bound always holds, the "
+           "hit-rate errs conservative). Past the cap, leaves are "
+           "evicted least-recently-matched first, so the index degrades "
+           "from the deep cold end of each prefix chain instead of "
+           "OOMing the frontend. 0 = unbounded (seed behavior; keeps "
+           "the native C++ index eligible).",
+           "llm/kv_router/indexer.py"),
 )
 
 

@@ -101,6 +101,12 @@ KNOWN_FAULT_POINTS = {
     "worker.spawn":
         "`error` | `crash` — LocalProcessConnector replica spawn; `error` "
         "fails the exec, `crash` kills the child before it reports ready",
+    "kvbm.offload":
+        "`error` | `delay` — kvbm-tier thread store of one offload batch; "
+        "`error` drops the batch (counted), streams never notice",
+    "kvbm.onboard":
+        "`error` | `delay` — tier load at admission onboard; `error` "
+        "falls back to full prefill of that span",
 }
 
 

@@ -46,18 +46,32 @@ from __future__ import annotations
 #: "Class.attr" -> guard spec (grammar above).  Keep keys as plain string
 #: literals: the race rules parse this file's AST and never import it.
 GUARDED_STATE = {
-    # KVBM tier state: written on the device-exec thread (write-through
-    # offload), read on the event loop (admission probe) — the lock is
-    # the only thing standing between them.
+    # KVBM tier state: written on the kvbm-tier thread (batched offload
+    # stores; the device-exec thread on the DYN_KVBM_PIPELINE=0 inline
+    # path), read on the event loop (admission probe) — the lock is the
+    # only thing standing between them.
     "KvBlockManager.host": "lock:_lock",
     "KvBlockManager.disk": "lock:_lock",
     "KvBlockManager.offloaded_blocks": "lock:_lock",
     "KvBlockManager.onboarded_blocks": "lock:_lock",
     "KvBlockManager.disk_evictions": "lock:_lock",
     "KvBlockManager.dropped_blocks": "lock:_lock",
-    # in-flight offload count: bumped on the event loop, dropped in the
-    # executor's done-callback thread.
+    "KvBlockManager._load_ms": "lock:_lock",
+    # legacy inline offload count: bumped on the event loop, dropped in
+    # the executor's done-callback thread.
     "KvbmConnector._pending": "lock:_pending_lock",
+    # kvbm offload pipeline (docs/kvbm.md): the event loop stages commits
+    # and flushes them into batches, the device-exec thread marks a
+    # batch's gather ready, the kvbm-tier thread consumes — three
+    # contexts, one condition variable's lock over all of it.
+    "KvbmConnector._staged": "lock:_offload_cv",
+    "KvbmConnector._queue": "lock:_offload_cv",
+    "KvbmConnector._inflight_hashes": "lock:_offload_cv",
+    "KvbmConnector._processing": "lock:_offload_cv",
+    "KvbmConnector._stopped": "lock:_offload_cv",
+    "KvbmConnector.offload_gathers": "lock:_offload_cv",
+    "KvbmConnector.offload_blocks_dropped": "lock:_offload_cv",
+    "KvbmConnector.offload_failures": "lock:_offload_cv",
     # engine decode pipeline: the step-loop task owns the in-flight block
     # queue and prefill-completion list; ROADMAP item 1's scheduler must
     # keep mutations inside the step loop (or take over this entry).

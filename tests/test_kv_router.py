@@ -58,6 +58,189 @@ def test_radix_tree_dump_load():
     assert tree2.find_matches(hashes).scores == {7: 2}
 
 
+# ------------------------------------------------------------------ #
+# bounded index (DYN_ROUTER_INDEX_MAX_BLOCKS): cap, leaf-first
+# eviction, score correctness, memory estimate
+# ------------------------------------------------------------------ #
+
+
+def test_bounded_radix_cap_respected_leaf_first():
+    tree = RadixTree(max_blocks=4)
+    tree.apply_stored(1, [10, 11, 12])  # chain A (root 10)
+    tree.apply_stored(1, [20, 21, 22])  # chain B -> over cap by 2
+    assert tree.num_blocks == 4
+    assert tree.evicted_blocks == 2
+    # leaves went first; shared roots (the valuable end of a prefix
+    # chain) survive
+    assert 10 in tree._blocks and 20 in tree._blocks
+    assert 12 not in tree._blocks and 22 not in tree._blocks
+
+
+def test_bounded_radix_scores_stay_correct_after_eviction():
+    tree = RadixTree(max_blocks=4)
+    tree.apply_stored(1, [10, 11, 12])
+    tree.apply_stored(2, [10, 11])
+    tree.apply_stored(1, [20, 21, 22])  # forces evictions
+    # whatever survives, a match walk returns a CONTIGUOUS retained
+    # prefix — never a score through an evicted gap
+    scores = tree.find_matches([10, 11, 12])
+    for w, depth in scores.scores.items():
+        for h in [10, 11, 12][:depth]:
+            assert w in tree._blocks.get(h, set()), (
+                f"worker {w} scored depth {depth} but lost block {h}"
+            )
+    # and the eviction never drops an interior block before its leaf
+    for h, parent in tree._parent.items():
+        assert parent in tree._blocks, "child retained past its parent"
+
+
+def test_bounded_radix_matched_leaves_refresh_recency():
+    tree = RadixTree(max_blocks=3)
+    tree.apply_stored(1, [10, 11])
+    tree.apply_stored(2, [20])
+    # touch chain A's leaf: 11 becomes most-recently-matched
+    tree.find_matches([10, 11])
+    tree.apply_stored(3, [30])  # over cap: evicts leaf 20, not hot 11
+    assert 11 in tree._blocks
+    assert 20 not in tree._blocks
+
+
+def test_bounded_radix_dump_load_roundtrip_under_eviction():
+    tree = RadixTree(max_blocks=4)
+    tree.apply_stored(1, [10, 11, 12])
+    tree.apply_stored(2, [10, 11])
+    tree.apply_stored(1, [20, 21, 22])
+    snap = tree.dump()
+    tree2 = RadixTree(max_blocks=4)
+    tree2.load(snap)
+    assert tree2.num_blocks == tree.num_blocks
+    for probe in ([10, 11, 12], [20, 21, 22]):
+        assert tree2.find_matches(probe).scores == tree.find_matches(probe).scores
+
+
+def test_allocator_gapped_commit_emits_per_run_events():
+    """commit_hashes skips hashes a concurrent sequence already cached,
+    so the stored subsequence can have gaps — each contiguous run must
+    ship as its own event with its true chain parent and an aligned
+    token_blocks slice, or the bounded index fabricates links across the
+    gap (and token_blocks zip against the wrong hashes)."""
+    from dynamo_tpu.engine.kv_cache import PageAllocator
+
+    events = []
+    alloc = PageAllocator(16, 8, event_sink=events.append)
+    alloc.commit_hashes([0, 1], [101, 102])
+    # concurrent request re-commits the cached prefix + new tail: one
+    # event for the [103, 104] run, chained to 102
+    alloc.commit_hashes([2, 3, 4, 5], [101, 102, 103, 104],
+                        token_blocks=[[1], [2], [3], [4]])
+    stored = [e for e in events if e.event_type == "stored"]
+    assert [e.block_hashes for e in stored] == [[101, 102], [103, 104]]
+    assert stored[1].parent_hash == 102
+    assert stored[1].token_blocks == [[3], [4]]
+    # interior gap: middle block pre-cached -> two runs, correct parents
+    events.clear()
+    alloc.commit_hashes([6], [302])
+    alloc.commit_hashes([7, 8, 9], [301, 302, 303], parent_hash=300)
+    stored = [e.block_hashes for e in events if e.event_type == "stored"]
+    parents = [e.parent_hash for e in events if e.event_type == "stored"]
+    assert stored == [[302], [301], [303]]
+    assert parents == [None, 300, 302]
+
+
+def test_bounded_radix_event_parent_links_cross_event_chains():
+    """Per-block stored events (one per generated block) carry
+    parent_hash; the bounded tree must link them, or every block is a
+    leaf and eviction takes roots first."""
+    tree = RadixTree(max_blocks=100)
+    tree.apply_stored(1, [10])
+    tree.apply_stored(1, [11], parent=10)
+    tree.apply_stored(1, [12], parent=11)
+    assert tree._parent == {11: 10, 12: 11}
+    assert list(tree._leaf_order) == [12]
+    # restore path never fabricates: parent ignored when chained=False
+    t2 = RadixTree(max_blocks=100)
+    t2.apply_stored(1, [11], chained=False, parent=10)
+    assert t2._parent == {}
+
+
+def test_bounded_radix_load_fabricates_no_chains():
+    """dump() sorts each worker's hashes — restoring must not reinterpret
+    that order as parent links, or leaf-first eviction would protect
+    arbitrary hashes and evict in hash order."""
+    tree = RadixTree(max_blocks=100)
+    tree.apply_stored(1, [30, 10, 20])  # a real chain, unsorted hashes
+    restored = RadixTree(max_blocks=100)
+    restored.load(tree.dump())
+    assert restored._parent == {}
+    assert set(restored._leaf_order) == {10, 20, 30}  # all leaves
+    # live events re-chain restored blocks
+    restored.apply_stored(1, [10, 11])
+    assert restored._parent.get(11) == 10
+    assert 10 not in restored._leaf_order
+
+
+def test_bounded_radix_removal_keeps_bookkeeping_consistent():
+    tree = RadixTree(max_blocks=8)
+    tree.apply_stored(1, [10, 11, 12])
+    tree.apply_stored(2, [10, 11, 12])
+    tree.remove_worker(1)
+    assert tree.find_matches([10, 11, 12]).scores == {2: 3}
+    tree.apply_removed(2, [12])
+    assert tree.find_matches([10, 11, 12]).scores == {2: 2}
+    # 11 lost its only child -> it is a leaf again and evictable
+    assert 11 in tree._leaf_order
+    st = tree.stats()
+    assert st["index_blocks"] == 2
+    assert st["index_mappings"] == 2
+
+
+def test_bounded_radix_memory_estimate_tracks_size():
+    tree = RadixTree(max_blocks=1000)
+    assert tree.memory_bytes_estimate() == 0
+    tree.apply_stored(1, list(range(100, 150)))
+    grown = tree.memory_bytes_estimate()
+    assert grown > 0
+    tree.apply_stored(2, list(range(100, 150)))  # same blocks, more mappings
+    assert tree.memory_bytes_estimate() > grown
+    tree.remove_worker(1)
+    tree.remove_worker(2)
+    assert tree.memory_bytes_estimate() == 0
+    assert tree.stats()["index_memory_bytes_estimate"] == 0
+
+
+def test_sharded_indexer_splits_cap_across_shards():
+    from dynamo_tpu.llm.kv_router import KvIndexerSharded
+
+    idx = KvIndexerSharded(num_shards=2, block_size=64, max_blocks=4)
+    # workers 0 and 2 land on shard 0; its per-shard cap is 2
+    idx.apply_stored(0, [10, 11, 12])
+    assert idx.shards[0].num_blocks == 2
+    idx.apply_stored(1, [20, 21])  # shard 1, under its cap
+    st = idx.stats()
+    assert st["index_max_blocks"] == 4
+    assert st["index_blocks"] == 4
+    assert st["index_evicted_blocks"] == 1
+
+
+def test_indexer_cap_env_plumbing(monkeypatch):
+    from dynamo_tpu.llm.kv_router.indexer import _index_cap_from_env
+    from dynamo_tpu.native import make_radix_tree
+
+    monkeypatch.delenv("DYN_ROUTER_INDEX_MAX_BLOCKS", raising=False)
+    assert _index_cap_from_env() is None
+    monkeypatch.setenv("DYN_ROUTER_INDEX_MAX_BLOCKS", "0")
+    assert _index_cap_from_env() is None
+    monkeypatch.setenv("DYN_ROUTER_INDEX_MAX_BLOCKS", "123")
+    assert _index_cap_from_env() == 123
+    monkeypatch.setenv("DYN_ROUTER_INDEX_MAX_BLOCKS", "bogus")
+    assert _index_cap_from_env() is None
+    # a cap always selects the Python tree (the C++ index carries no
+    # chain bookkeeping for leaf-first eviction)
+    tree = make_radix_tree(max_blocks=10)
+    assert isinstance(tree, RadixTree)
+    assert tree.max_blocks == 10
+
+
 def test_softmax_sample_temperature_zero_argmin():
     costs = {1: 5.0, 2: 1.0, 3: 9.0}
     assert all(softmax_sample(costs, 0.0) == 2 for _ in range(20))

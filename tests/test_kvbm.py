@@ -207,12 +207,367 @@ def _hashes(prompt):
 
 
 async def _drain_offloads(eng):
-    """Wait for queued write-through offloads on the device executor."""
-    for _ in range(100):
-        if eng.kvbm is None or eng.kvbm._pending == 0:
+    """Flush + wait out the offload pipeline (staged pairs, queued batches
+    and legacy inline jobs alike)."""
+    if eng.kvbm is None:
+        return
+    eng.kvbm.flush_step()
+    for _ in range(300):
+        if eng.kvbm.pending_offloads() == 0:
             return
         await asyncio.sleep(0.01)
     raise TimeoutError("offloads did not drain")
+
+
+# ------------------------------------------------------------------ #
+# eviction policies (storage seam; DYN_KVBM_EVICTION)
+# ------------------------------------------------------------------ #
+
+
+def test_lfu_eviction_prefers_cold_blocks():
+    tier = HostTier(2, BLOCK_SHAPE, np.float32, policy="lfu")
+    tier.put(1, *_blk(1))
+    tier.put(2, *_blk(2))
+    tier.get(1)
+    tier.get(1)  # 1 is hot (freq 3), 2 cold (freq 1)
+    evicted = tier.put(3, *_blk(3))
+    assert evicted is not None and evicted[0] == 2
+    assert tier.has(1) and tier.has(3)
+
+
+def test_prefix_aware_protects_interior_blocks():
+    tier = HostTier(2, BLOCK_SHAPE, np.float32, policy="prefix-aware")
+    tier.put(1, *_blk(1))
+    tier.put(2, *_blk(2), parent=1)
+    # 1 is LRU-oldest but has live descendant 2 in-pool: the leaf goes
+    evicted = tier.put(3, *_blk(3))
+    assert evicted is not None and evicted[0] == 2
+    assert tier.has(1) and tier.has(3)
+    # with 2 gone, 1 is a leaf again and evictable
+    evicted = tier.put(4, *_blk(4))
+    assert evicted[0] == 1
+
+
+def test_lfu_heap_compacts_on_hit_heavy_workload():
+    """The lazy LFU heap grows one entry per touch and only eviction
+    pops: without compaction a hit-heavy tier whose working set fits in
+    capacity leaks heap entries forever."""
+    tier = HostTier(4, BLOCK_SHAPE, np.float32, policy="lfu")
+    tier.put(1, *_blk(1))
+    tier.put(2, *_blk(2))
+    for _ in range(5000):
+        tier.get(1)
+    assert len(tier._heap) <= max(4 * tier.capacity, 64) + 1
+    # compaction kept the live ordering: 2 is still the coldest victim
+    tier.put(3, *_blk(3))
+    tier.put(4, *_blk(4))
+    evicted = tier.put(5, *_blk(5))
+    assert evicted is not None and evicted[0] == 2
+
+
+def test_eviction_spec_parsing():
+    from dynamo_tpu.kvbm.manager import _parse_eviction
+
+    assert _parse_eviction("lfu") == ("lfu", "lfu")
+    assert _parse_eviction("host=lfu,disk=prefix-aware") == ("lfu", "prefix-aware")
+    assert _parse_eviction("bogus") == ("lru", "lru")  # typo never fatal
+    assert _parse_eviction("host=bogus") == ("lru", "lru")
+
+
+@pytest.mark.parametrize("policy", ["lru", "lfu", "prefix-aware"])
+def test_eviction_policy_invariants_fuzz(policy):
+    """All policies preserve the pool invariants under random
+    put/get/clear sequences: capacity respected, slots partition exactly,
+    recency tracks membership, retrievals return exact bytes."""
+    rng = np.random.RandomState(7)
+    cap = 4
+    tier = HostTier(cap, BLOCK_SHAPE, np.float32, policy=policy)
+    for _ in range(400):
+        op = rng.rand()
+        h = int(rng.randint(1, 12))
+        if op < 0.62:
+            parent = h - 1 if h > 1 and rng.rand() < 0.5 else None
+            tier.put(h, *_blk(h), parent=parent)
+        elif op < 0.94:
+            got = tier.get(h)
+            if got is not None:
+                np.testing.assert_array_equal(got[0], _blk(h)[0])
+                np.testing.assert_array_equal(got[1], _blk(h)[1])
+        else:
+            tier.clear()
+        assert len(tier) <= cap
+        used = set(tier._by_hash.values())
+        assert len(used) == len(tier._by_hash), "slot aliasing"
+        assert used.isdisjoint(tier._free)
+        assert len(used) + len(tier._free) == cap, "slot leak"
+        assert set(tier._lru) == set(tier._by_hash), "recency drift"
+        # leaf index tracks exactly the in-pool childless blocks
+        assert set(tier._leaves) == {
+            h for h in tier._by_hash if not tier._children.get(h)
+        }, "leaf-index drift"
+    for h in list(tier._by_hash):
+        got = tier.get(h)
+        np.testing.assert_array_equal(got[0], _blk(h)[0])
+
+
+# ------------------------------------------------------------------ #
+# crash-consistent G3 index (temp file + atomic rename)
+# ------------------------------------------------------------------ #
+
+
+def test_disk_flush_crash_mid_write_keeps_old_index(tmp_path, monkeypatch):
+    """A crash mid-flush must leave the PREVIOUS index intact: the new
+    index lands via temp-file + atomic os.replace, never a partial
+    overwrite of index.json."""
+    import os as _os
+
+    path = str(tmp_path / "g3")
+    tier = DiskTier(4, BLOCK_SHAPE, np.float32, path)
+    tier.put(1, *_blk(1))
+    tier.flush()
+    tier.put(2, *_blk(2))
+
+    def boom(src, dst):
+        raise OSError("killed mid-flush")
+
+    monkeypatch.setattr(_os, "replace", boom)
+    with pytest.raises(OSError):
+        tier.flush()
+    monkeypatch.undo()
+    # the torn flush left index.json.tmp behind but index.json is the
+    # pre-crash version: warm restart sees block 1, not a corrupt file
+    reopened = DiskTier(4, BLOCK_SHAPE, np.float32, path)
+    assert reopened.has(1)
+    got = reopened.get(1)
+    np.testing.assert_array_equal(got[0], _blk(1)[0])
+    # and the next clean flush supersedes the leftover temp file
+    reopened.put(3, *_blk(3))
+    reopened.flush()
+    again = DiskTier(4, BLOCK_SHAPE, np.float32, path)
+    assert again.has(1) and again.has(3)
+
+
+# ------------------------------------------------------------------ #
+# offload pipeline: batched gather -> bounded queue -> tier thread
+# ------------------------------------------------------------------ #
+
+
+class _FakeEngine:
+    """Minimal engine surface KvbmConnector needs: jitted-gather stand-in,
+    the serial device executor, and the _timed wrapper."""
+
+    def __init__(self, n_pages=64):
+        import concurrent.futures
+
+        r = np.random.RandomState(3)
+        # [layers, pages, page, heads, dim]
+        self.kv_k = r.randn(2, n_pages, 4, 2, 4).astype(np.float32)
+        self.kv_v = r.randn(2, n_pages, 4, 2, 4).astype(np.float32)
+        self._device_exec = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="fake-jax-step"
+        )
+        self.dev_calls = 0
+
+    def _extract_pages(self, k, v, ids):
+        ids = np.asarray(ids)
+        self.dev_calls += 1
+        return k[:, ids], v[:, ids]
+
+    def _timed(self, fn, tag, shape=None):
+        return fn
+
+
+def _mk_connector(tmp_path=None, host_blocks=16, queue_env=None, monkeypatch=None):
+    from dynamo_tpu.kvbm import KvBlockManager, KvbmConfig, KvbmConnector
+
+    if queue_env is not None:
+        monkeypatch.setenv("DYN_KVBM_OFFLOAD_QUEUE", str(queue_env))
+    eng = _FakeEngine()
+    mgr = KvBlockManager(
+        KvbmConfig(host_blocks=host_blocks), (2, 4, 2, 4), np.float32
+    )
+    return eng, KvbmConnector(eng, mgr)
+
+
+def test_pipeline_coalesces_stages_into_one_gather(monkeypatch):
+    """Multiple offload_commit calls in one step become ONE device gather
+    at flush_step, and the stored bytes match the gathered pages."""
+    eng, conn = _mk_connector(monkeypatch=monkeypatch)
+    conn.offload_commit([101, 102], [3, 4])
+    conn.offload_commit([103], [5], parent=102)
+    assert eng.dev_calls == 0  # nothing hits the device until the flush
+    conn.flush_step()
+    assert conn.drain(5.0)
+    assert eng.dev_calls == 1
+    assert conn.offload_gathers == 1
+    assert conn.offload_commit_calls == 2
+    assert conn.manager.has(101) and conn.manager.has(103)
+    got_k, _ = conn.manager.load_blocks([102])
+    np.testing.assert_array_equal(got_k[0], eng.kv_k[:, 4])
+    # chain parents reached the tier (prefix-aware bookkeeping)
+    assert conn.manager.host._parent.get(102) == 101
+    assert conn.manager.host._parent.get(103) == 102
+    conn.shutdown()
+
+
+def test_pipeline_backpressure_drops_oldest(monkeypatch):
+    """With the in-flight queue capped at 1 and a slow tier thread, newer
+    flushes evict the OLDEST queued batch — counted, never blocking."""
+    from dynamo_tpu.runtime import faults
+
+    eng, conn = _mk_connector(queue_env=1, monkeypatch=monkeypatch)
+    faults.configure("kvbm.offload:delay,times=50")
+    try:
+        for i in range(5):
+            conn.offload_commit([500 + i], [2 + i])
+            conn.flush_step()
+        assert conn.drain(10.0)
+    finally:
+        faults.reset()
+    stats = conn.stats()
+    assert stats["kvbm_offload_batches_dropped"] >= 1
+    assert stats["kvbm_offload_blocks_dropped"] >= 1
+    # accounting is clean after the dust settles: nothing stuck in flight
+    assert conn.pending_offloads() == 0
+    with conn._offload_cv:
+        assert not conn._inflight_hashes
+    # dropped + stored partition the 5 staged blocks
+    assert len(conn.manager.host) + stats["kvbm_offload_blocks_dropped"] == 5
+    conn.shutdown()
+
+
+def test_chaos_offload_error_drops_batch_never_stream(params):
+    """dynochaos kvbm.offload error: every offload batch dies on the tier
+    thread, yet generation streams are untouched — offload is strictly a
+    cache write (ISSUE 10 / ROADMAP 3 chaos coverage)."""
+    from dynamo_tpu.runtime import faults
+
+    async def main():
+        eng = _engine(params, host_blocks=32, num_pages=16)
+        faults.configure("kvbm.offload:error,times=100")
+        try:
+            base = list(range(10, 10 + 3 * PAGE))
+            first = await _gen(eng, base, 4, "a")
+            assert len(first) == 4
+            await _drain_offloads(eng)
+            st = eng.kvbm.stats()
+            assert st["kvbm_offload_failures"] >= 1
+            assert len(eng.kvbm.manager.host) == 0  # every batch dropped
+            # the engine keeps serving; once the plan exhausts, offloads heal
+            second = await _gen(eng, base, 4, "b")
+            assert second == first
+        finally:
+            faults.reset()
+        await eng.close()
+
+    asyncio.run(main())
+
+
+def test_chaos_onboard_error_falls_back_to_full_prefill(params):
+    """dynochaos kvbm.onboard error: the tier load fails at admission and
+    the engine prefills the span instead — tokens identical, no hang."""
+    from dynamo_tpu.runtime import faults
+
+    async def main():
+        eng = _engine(params, host_blocks=32, num_pages=8)
+        base = list(range(10, 10 + 3 * PAGE))
+        first = await _gen(eng, base, 4, "a")
+        await _drain_offloads(eng)
+        for i in range(4):
+            await _gen(eng, list(range(300 + 40 * i, 300 + 40 * i + 3 * PAGE)), 2, f"f{i}")
+        await _drain_offloads(eng)
+        onboarded_before = eng.kvbm.manager.onboarded_blocks
+        faults.configure("kvbm.onboard:error,times=1")
+        try:
+            again = await _gen(eng, base, 4, "b")
+        finally:
+            faults.reset()
+        assert again == first
+        assert eng.kvbm.manager.onboarded_blocks == onboarded_before, (
+            "fallback must recompute, not load tiers"
+        )
+        await eng.close()
+
+    asyncio.run(main())
+
+
+def test_kvbm_on_off_token_parity(params):
+    """KVBM is a latency optimization, never a semantics change: fifo
+    token streams are byte-identical with tiers on vs off, including
+    after G1 eviction forces tier onboarding."""
+
+    async def run_suite(eng):
+        out = []
+        base = list(range(10, 10 + 3 * PAGE))
+        out.append(await _gen(eng, base, 4, "a"))
+        for i in range(4):
+            out.append(
+                await _gen(eng, list(range(300 + 40 * i, 300 + 40 * i + 3 * PAGE)), 2, f"f{i}")
+            )
+        out.append(await _gen(eng, base, 4, "b"))  # onboard vs recompute
+        await eng.close()
+        return out
+
+    async def main():
+        with_kvbm = await run_suite(_engine(params, host_blocks=32, num_pages=8))
+        without = await run_suite(_engine(params, num_pages=8))
+        assert with_kvbm == without
+
+    asyncio.run(main())
+
+
+def test_onboard_budget_falls_back_to_recompute(params):
+    """Under DYN_SCHED_POLICY=sla, an onboard whose projected tier-load
+    latency exceeds the slot's TTFT headroom is skipped in favor of
+    recompute (docs/kvbm.md onboard budget); tokens stay identical."""
+
+    async def main():
+        cfg = EngineConfig(
+            model="tiny", max_num_seqs=2, page_size=PAGE, num_pages=8,
+            max_model_len=128, prefill_buckets=(16, 32), max_prefill_chunk=32,
+            kvbm_host_blocks=32,
+            sched_policy="sla", ttft_target_ms=1.0,
+        )
+        eng = JaxEngine(cfg, model_config=CFG, params=params)
+        base = list(range(10, 10 + 3 * PAGE))
+        first = await _gen(eng, base, 4, "a")
+        await _drain_offloads(eng)
+        for i in range(4):
+            await _gen(eng, list(range(300 + 40 * i, 300 + 40 * i + 3 * PAGE)), 2, f"f{i}")
+        await _drain_offloads(eng)
+        # a (synthetically) slow host tier: any onboard estimate now dwarfs
+        # the ~1ms TTFT headroom
+        with eng.kvbm.manager._lock:
+            eng.kvbm.manager._load_ms["host"] = 1000.0
+        onboarded_before = eng.kvbm.manager.onboarded_blocks
+        again = await _gen(eng, base, 4, "b")
+        assert again == first
+        assert eng.kvbm.stats()["kvbm_onboard_recompute_fallbacks"] >= 1
+        assert eng.kvbm.manager.onboarded_blocks == onboarded_before
+        await eng.close()
+
+    asyncio.run(main())
+
+
+def test_engine_stats_expose_tier_pipeline(params):
+    async def main():
+        eng = _engine(params, host_blocks=32, num_pages=8)
+        base = list(range(10, 10 + 3 * PAGE))
+        await _gen(eng, base, 4, "a")
+        await _drain_offloads(eng)
+        st = eng.stats()
+        for key in (
+            "kvbm_g1_hit_blocks", "kvbm_g1_miss_blocks", "kvbm_host_hits",
+            "kvbm_host_misses", "kvbm_offload_gathers",
+            "kvbm_offload_queue_depth", "kvbm_offload_blocks_dropped",
+            "kvbm_onboard_hist", "kvbm_onboard_count",
+        ):
+            assert key in st, key
+        assert st["kvbm_offload_gathers"] >= 1
+        assert st["kvbm_g1_miss_blocks"] >= 3  # cold start prefilled the base
+        await eng.close()
+
+    asyncio.run(main())
 
 
 class TestDistributedKvbm:

@@ -523,18 +523,18 @@ def test_guarded_state_suppression_with_reason(tmp_path):
 _REAL_GUARD_SITES = [
     (
         "dynamo_tpu/kvbm/manager.py",
-        "# lock buys a consistent counter+tier snapshot (GUARDED_STATE)\n"
+        "# a consistent counter+tier snapshot (GUARDED_STATE)\n"
         "        with self._lock:",
-        "# lock buys a consistent counter+tier snapshot (GUARDED_STATE)\n"
+        "# a consistent counter+tier snapshot (GUARDED_STATE)\n"
         "        if True:",
         "KvBlockManager.",
     ),
     (
         "dynamo_tpu/kvbm/manager.py",
-        '"""In-flight write-through count (engine close() drains on this)."""\n'
-        "        with self._pending_lock:",
-        '"""In-flight write-through count (engine close() drains on this)."""\n'
-        "        if True:",
+        "with self._pending_lock:\n"
+        "            n += self._pending",
+        "if True:\n"
+        "            n += self._pending",
         "KvbmConnector._pending",
     ),
 ]
