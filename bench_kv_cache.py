@@ -62,6 +62,18 @@ class ArmResult:
         return self.tokens / self.wall_s if self.wall_s > 0 else 0.0
 
 
+def _trimmed_mean(xs: List[float]) -> float:
+    """10%-trimmed mean: keeps the onboard/pull/recompute path cost
+    visible (a p50 would land on a trivial G1-hit request) while
+    shedding the GC/allocator spikes a busy host injects into a few
+    samples per pass. Used for BOTH sides of the fabric gate's ratio —
+    one definition, or the statistic silently diverges between arms."""
+    xs = sorted(xs)
+    k = max(len(xs) // 10, 1) if len(xs) > 4 else 0
+    xs = xs[k: len(xs) - k] if k else xs
+    return sum(xs) / max(len(xs), 1)
+
+
 def _pct(xs: List[float], p: float) -> float:
     if not xs:
         return 0.0
@@ -124,6 +136,35 @@ async def _replay(eng, trace, speedup: float, ttft_out: List[float]) -> int:
     return total
 
 
+async def _replay_serial(eng, trace, ttft_out: List[float]) -> int:
+    """Closed-loop serial replay: one request at a time, no pacing — the
+    per-request TTFT then measures the PATH cost (onboard / peer pull /
+    recompute) without queueing noise, which is what the fabric gate
+    compares. Paced replays measure the loaded regime; this measures the
+    mechanism."""
+    from dynamo_tpu.llm.protocols import PreprocessedRequest
+    from dynamo_tpu.runtime.engine import Context
+
+    total = 0
+    for i, row in enumerate(trace):
+        start = time.perf_counter()
+        req = PreprocessedRequest(
+            token_ids=row.token_ids,
+            stop_conditions={"max_tokens": row.osl, "ignore_eos": True},
+            request_id=f"s{i}",
+        ).to_dict()
+        first = None
+        async for item in eng.generate(req, Context()):
+            data = item.get("data")
+            if data and data.get("token_ids"):
+                if first is None:
+                    first = time.perf_counter()
+                total += len(data["token_ids"])
+        if first is not None:
+            ttft_out.append((first - start) * 1000.0)
+    return total
+
+
 async def _drain_offloads(eng):
     if eng.kvbm is None:
         return
@@ -168,6 +209,127 @@ def run_arm(name: str, args, trace, kvbm: bool, pipelined: bool) -> ArmResult:
         if tmp is not None:
             tmp.cleanup()
     return res
+
+
+def run_peer_arm(name: str, args, trace):
+    """Cluster-KV-fabric arm: engine A replays the trace cold (populating
+    its G2 tier + announcing on the mesh), then the PAIRED measurement —
+    an A-B-A design: A replays warm (device cache cleared — the local-G2
+    reference), a FRESH engine B — same discovery plane, empty device
+    cache AND empty tiers — replays warm onboarding every repeated
+    prefix from A's tiers over the KV data plane (peer pull), then A
+    replays warm AGAIN. The peer pass is compared against the MEAN of
+    the two flanking local passes: successive replays in one process
+    phase slow down roughly linearly on a small shared host, and the
+    A-B-A mean cancels that drift exactly where a single sequential
+    pair just measures it. Returns (peer ArmResult, local-reference
+    warm TTFT p50 ms = mean of the two local passes)."""
+    import copy
+
+    prev = os.environ.get("DYN_KVBM_PIPELINE")
+    os.environ["DYN_KVBM_PIPELINE"] = "1"
+    res = ArmResult(name=name)
+    ref = {"mean": 0.0}
+    args = copy.copy(args)
+    args.disk_blocks = 0  # G2-only: isolate the peer-pull vs local-G2 gap
+    try:
+
+        async def main():
+            from dynamo_tpu.kvbm import KvbmDistributed
+            from dynamo_tpu.llm.kv_transfer import KvDataPlaneServer
+            from dynamo_tpu.runtime import (
+                DiscoveryServer,
+                DistributedRuntime,
+                RuntimeConfig,
+            )
+
+            server = DiscoveryServer(port=0)
+            _, port = await server.start()
+            cfg = RuntimeConfig(discovery_endpoint=f"127.0.0.1:{port}")
+            drts, engines, dists, planes = [], [], [], []
+            for _ in range(2):
+                drt = await DistributedRuntime.create(cfg)
+                eng = _make_engine(args, True, None)
+                dp = KvDataPlaneServer()
+                await dp.start()
+                await dp.register(drt)
+                dist = KvbmDistributed(
+                    drt, eng.kvbm, dp, "bench", "kvbm", drt.instance_id
+                )
+                await dist.start()
+                drts.append(drt)
+                engines.append(eng)
+                dists.append(dist)
+                planes.append(dp)
+            eng_a, eng_b = engines
+            try:
+                # B is a FRESH engine: drive its dispatch variants once so
+                # the measured warm pass doesn't pay jit tracing the local
+                # side (which reuses its cold-pass engine) never sees
+                await eng_b.warmup()
+                t0 = time.perf_counter()
+                res.tokens += await _replay(
+                    eng_a, trace, args.speedup, res.ttft_cold_ms
+                )
+                await _drain_offloads(eng_a)
+                # wait for A's announcements to mirror into B's owner map
+                for _ in range(400):
+                    if len(dists[1]._owners) >= 1:
+                        break
+                    await asyncio.sleep(0.01)
+
+                async def measure_local():
+                    eng_a.allocator.clear_cache()
+                    ttfts = []
+                    res.tokens += await _replay_serial(eng_a, trace, ttfts)
+                    return _trimmed_mean(ttfts)
+
+                # throwaway passes: one-time shape compiles fire on each
+                # engine's FIRST pass over the trace; pay them off-camera
+                # on both sides, then reset B (device cache + tiers) so
+                # the measured pass pulls from A again
+                await measure_local()
+                eng_b.allocator.clear_cache()
+                await _replay_serial(eng_b, trace, [])
+                eng_b.allocator.clear_cache()
+                eng_b.kvbm.manager.clear()
+
+                local_1 = await measure_local()
+                res.tokens += await _replay_serial(
+                    eng_b, trace, res.ttft_warm_ms
+                )
+                local_2 = await measure_local()
+                ref["mean"] = (local_1 + local_2) / 2.0
+                # in-phase serial recompute reference: B with device
+                # cache, tiers, and the peer arm all cleared — nothing
+                # left to onboard from, every prefix recomputes
+                eng_b.kvbm.peer_pull = False
+                eng_b.allocator.clear_cache()
+                eng_b.kvbm.manager.clear()
+                dists[1]._owners.clear()
+                rec = []
+                res.tokens += await _replay_serial(eng_b, trace, rec)
+                ref["recompute_mean"] = _trimmed_mean(rec)
+                res.wall_s = time.perf_counter() - t0
+                res.stats = eng_b.stats()
+            finally:
+                for eng in engines:
+                    await eng.close()
+                for d in dists:
+                    await d.close()
+                for p in planes:
+                    await p.close()
+                for drt in drts:
+                    await drt.close()
+                await server.stop()
+
+        asyncio.run(main())
+    finally:
+        if prev is None:
+            os.environ.pop("DYN_KVBM_PIPELINE", None)
+        else:
+            os.environ["DYN_KVBM_PIPELINE"] = prev
+    return res, ref
 
 
 def summarize(res: ArmResult) -> dict:
@@ -221,7 +383,88 @@ def summarize(res: ArmResult) -> dict:
                 / max(st.get("kvbm_onboard_count", 0), 1), 2
             ),
         })
+        if st.get("kvbm_remote_onboards") is not None:
+            out.update({
+                "peer_onboards": st.get("kvbm_remote_onboards", 0),
+                "peer_blocks_pulled": st.get("kvbm_remote_blocks_pulled", 0),
+                "peer_bytes_pulled": st.get("kvbm_peer_bytes_pulled", 0),
+                "peer_pull_failures": st.get("kvbm_peer_pull_failures", 0),
+                "peer_pull_mean_ms": round(
+                    st.get("kvbm_peer_pull_ms_sum", 0.0)
+                    / max(st.get("kvbm_remote_onboards", 0), 1), 2
+                ),
+                "onboard_src_local": st.get("kvbm_onboard_src_local_blocks", 0),
+                "onboard_src_peer": st.get("kvbm_onboard_src_peer_blocks", 0),
+                "onboard_src_recompute": st.get(
+                    "kvbm_onboard_src_recompute_blocks", 0
+                ),
+            })
     return out
+
+
+def run_multi_worker(args, trace):
+    """Cluster-KV-fabric report + gate. Each round runs a recompute
+    reference (off arm) plus the PAIRED peer arm, which measures the
+    cross-worker-peer and local-G2 warm passes back-to-back in one
+    process phase (run_peer_arm docstring) — the gate statistic is the
+    MEDIAN of the per-round peer/local ratios, which cancels the ambient
+    load a shared CI host smears over sequential single arms. Recompute
+    comparisons use best-of-rounds (the timeit statistic: ambient load
+    only ever ADDS time)."""
+    import copy
+
+    args = copy.copy(args)
+    args.disk_blocks = 0  # all arms G2-only, matching the peer arm
+    rounds = 3
+    warm_p50 = {"recompute": [], "local": [], "peer": []}
+    ratios = []
+    last = {}
+    for r in range(rounds):
+        peer, ref = run_peer_arm("peer", args, trace)
+        peer_mean = _trimmed_mean(peer.ttft_warm_ms)
+        warm_p50["peer"].append(peer_mean)
+        warm_p50["local"].append(ref["mean"])
+        warm_p50["recompute"].append(ref["recompute_mean"])
+        ratios.append(peer_mean / max(ref["mean"], 1e-9))
+        last["peer"] = peer
+    best = {k: min(v) for k, v in warm_p50.items()}
+    med = {k: sorted(v)[rounds // 2] for k, v in warm_p50.items()}
+    ratio = sorted(ratios)[rounds // 2]
+    peer_sum = summarize(last["peer"])
+    report = {
+        "mode": "multi-worker",
+        "peer_vs_local_ratio_per_round": [round(x, 3) for x in ratios],
+        "peer_vs_local_ratio_median": round(ratio, 3),
+        "ttft_warm_mean_ms_best": {k: round(v, 1) for k, v in best.items()},
+        "ttft_warm_mean_ms_median": {k: round(v, 1) for k, v in med.items()},
+        "peer_vs_recompute_ratio": round(
+            best["peer"] / max(best["recompute"], 1e-9), 3
+        ),
+        "local_vs_recompute_ratio": round(
+            best["local"] / max(best["recompute"], 1e-9), 3
+        ),
+        "peer_arm": peer_sum,
+    }
+    print(json.dumps(report))
+    failures = []
+    if peer_sum.get("peer_blocks_pulled", 0) <= 0:
+        failures.append("peer arm never pulled a block over the data plane")
+    if ratio > args.max_peer_ttft_ratio:
+        failures.append(
+            f"peer warm TTFT {ratio:.3f}x local-G2 exceeds "
+            f"{args.max_peer_ttft_ratio}x (median of {rounds} paired rounds)"
+        )
+    if failures:
+        print("KV-FABRIC MULTI-WORKER FAILED:")
+        for f in failures:
+            print(f"  - {f}")
+        sys.exit(1)
+    print(
+        f"KV-FABRIC MULTI-WORKER OK: peer/local-G2 ratio {ratio:.2f}x "
+        f"(per-round {['%.2f' % x for x in ratios]}); best warm p50 "
+        f"peer {best['peer']:.0f}ms, local {best['local']:.0f}ms, "
+        f"recompute {best['recompute']:.0f}ms"
+    )
 
 
 def main():
@@ -250,15 +493,32 @@ def main():
                     help="--smoke floor on warm-pass tier hit rate")
     ap.add_argument("--min-tok-s-ratio", type=float, default=0.9,
                     help="--smoke floor on kvbm-on/kvbm-off tok/s")
+    ap.add_argument("--multi-worker", action="store_true",
+                    help="cluster KV fabric arm: two in-proc engines on "
+                    "one discovery plane; cross-worker warm TTFT (peer "
+                    "G2 pull) vs local-G2 vs recompute, medians of "
+                    "interleaved arm triples; gates peer-hit count > 0 "
+                    "and peer TTFT <= --max-peer-ttft-ratio x local-G2")
+    ap.add_argument("--max-peer-ttft-ratio", type=float, default=1.3,
+                    help="--multi-worker gate: peer warm-TTFT p50 ceiling "
+                    "as a multiple of local-G2 warm-TTFT p50 (medians)")
     args = ap.parse_args()
 
     if args.smoke:
         args.requests = min(args.requests, 20)
         args.osl = min(args.osl, 8)
 
+    # --multi-worker compares PATH costs (serial passes): deeper shared
+    # chains and production-leaning pages make each onboard/pull move
+    # enough bytes that the per-pull constant (serve round-trip)
+    # amortizes the way real block sizes do — the default shallow trace
+    # would measure loopback TCP setup, not the fabric
+    if args.multi_worker:
+        args.page_size = max(args.page_size, 32)
+    depth, leaf_blocks = (12, 6) if args.multi_worker else (3, 2)
     rows = synthesize_mooncake_trace(
         args.requests, args.qps, args.page_size, seed=args.seed,
-        n_roots=3, depth=3, leaf_blocks=2, osl_mean=args.osl,
+        n_roots=3, depth=depth, leaf_blocks=leaf_blocks, osl_mean=args.osl,
     )
     from dynamo_tpu.models import llama
 
@@ -269,7 +529,11 @@ def main():
     )
     print(f"trace: {len(trace)} requests, "
           f"isl p50 {int(_pct([r.isl for r in trace], 0.5))}, "
-          f"osl {args.osl}, prefix roots 3 x depth 3")
+          f"osl {args.osl}, prefix roots 3 x depth {depth}")
+
+    if args.multi_worker:
+        run_multi_worker(args, trace)
+        return
 
     arms = [("off", False, True), ("pipeline", True, True)]
     if not args.smoke:

@@ -83,6 +83,13 @@ PHASES = [
     ("engine_kv", [PY, "bench_kv_cache.py", "--repeat", "2", "--requests",
                    "64", "--quantize", "int8", "--num-pages", "512",
                    "--host-blocks", "1024", "--disk-blocks", "512"], 3600),
+    # PR 11 remeasure: cluster KV fabric on real hardware — cross-worker
+    # warm TTFT (peer G2 pull over the data plane) vs local-G2 onboard vs
+    # recompute, where the transfer actually crosses a NIC instead of
+    # loopback (CPU medians in BENCH_NOTES_r09.md)
+    ("engine_peer", [PY, "bench_kv_cache.py", "--multi-worker", "--requests",
+                     "64", "--quantize", "int8", "--num-pages", "512",
+                     "--host-blocks", "1024"], 3600),
 ]
 
 

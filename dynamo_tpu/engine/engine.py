@@ -218,8 +218,16 @@ class _Slot:
     resume_token: Optional[int] = None  # preempted: continue with this token
     return_kv: bool = False  # prefill role: ship KV pages with the 1st token
     kv_pull: bool = False  # prefill role: caller can pull via the data plane
+    kv_stream: bool = False  # prefill role: caller wants the EARLY-staged
+    # streamed handoff (descriptor ships at admission, chunks publish as
+    # prefill commits pages — docs/disagg_serving.md)
+    kv_stream_tid: Optional[str] = None  # live streamed stage's transfer id
+    kv_stream_desc: Optional[dict] = None  # its descriptor (resent at emit)
+    kv_holder: Optional[dict] = None  # router holder hint for peer onboard
     preloaded: Optional[tuple] = None  # decode role: (first_tok, k, v, n_tokens)
     pull_desc: Optional[dict] = None  # decode role: pull-path descriptor
+    first_token_fut: Optional[asyncio.Future] = None  # decode role, streamed
+    # handoff: resolves to the prefill-produced first token (None = abort)
     onboard: Optional[tuple] = None  # KVBM tier hit: (alloc_pages, hashes)
     mm: Optional[List[tuple]] = None  # multimodal splices: (position, emb [n, H])
     guided_fsm: Optional[Any] = None  # llm/guided.TokenFsm (structured output)
@@ -239,6 +247,56 @@ class _Slot:
     arrival_s: float = 0.0
     sched_deadline: float = 0.0
     sched_skips: int = 0
+
+
+class StreamedPullHandle:
+    """Decode-side handle for an early (streamed) disagg KV pull
+    (docs/disagg_serving.md): the pull starts while the PREFILL worker is
+    still computing, off its early-shipped descriptor. The disagg handler
+    resolves the handle with the prefill's first token once it arrives
+    (`set_first_token`), or abandons it (`abort`) when the prefill stream
+    fails or the transfer was re-staged under a different id (preempt)."""
+
+    def __init__(self, engine: "JaxEngine", slot: _Slot, transfer_id: str):
+        self._engine = engine
+        self._slot = slot
+        # the handle owns its OWN reference to the future: the pull task
+        # detaches slot.first_token_fut before awaiting it, and a
+        # set_first_token/abort arriving after that detach (last chunk
+        # landed before the handler processed the final event — the
+        # exact overlap the feature maximizes) must still resolve it, or
+        # the pull task awaits forever with the slot pinned
+        self._fut = slot.first_token_fut
+        self.transfer_id = transfer_id
+
+    def set_first_token(self, token: int):
+        if self._fut is not None and not self._fut.done():
+            self._fut.set_result(int(token))
+
+    def abort(self):
+        """Abandon the early pull: the slot releases, any in-flight chunk
+        injection unwinds, and the handler falls back to the serial /
+        local path."""
+        eng, slot = self._engine, self._slot
+        if self._fut is not None and not self._fut.done():
+            self._fut.set_result(None)
+        slot.done = True
+        if slot.slot_idx >= 0 and eng.slots[slot.slot_idx] is slot:
+            eng._release_slot(slot)
+        eng._wake.set()
+
+    async def stream(self):
+        """Consume the decode stream (same contract as engine.generate)."""
+        slot = self._slot
+        try:
+            while True:
+                item = await slot.queue.get()
+                if item is None:
+                    return
+                yield item
+        finally:
+            slot.done = True
+            self._engine._wake.set()
 
 
 class JaxEngine:
@@ -389,6 +447,18 @@ class JaxEngine:
         # these instead of grepping logs
         self.kv_pulls_completed = 0
         self.kv_pages_pulled = 0
+        # streamed disagg handoff (docs/disagg_serving.md): decode-side
+        # evidence that KV transfer overlapped prefill — chunks that landed
+        # BEFORE the prefill's first-token event, and handoffs where the
+        # first token was already client-bound while the tail chunks were
+        # still in flight (the serial path is structurally 0 on both)
+        self.disagg_streamed_handoffs = 0
+        self.disagg_chunks_before_first_token = 0
+        self.disagg_first_token_before_last_chunk = 0
+        # prefill-side: early-staged streamed transfers, and the ones that
+        # died mid-stream and fell back to a fresh serial stage at emit
+        self.kv_streamed_stages = 0
+        self.kv_streamed_fallbacks = 0
         # blocks reused MID-prefix from concurrent same-prefix requests
         # (_try_skip_ahead; admission-time hits count in the allocator)
         self.prefix_skip_ahead_blocks = 0
@@ -1398,6 +1468,8 @@ class JaxEngine:
         disagg = req.disagg_params or {}
         slot.return_kv = bool(disagg.get("return_kv"))
         slot.kv_pull = bool(disagg.get("kv_pull"))
+        slot.kv_stream = bool(disagg.get("kv_stream"))
+        slot.kv_holder = req.kv_holder
         self.num_requests += 1
         self._waiting.append(slot)
         self._wake.set()
@@ -1492,6 +1564,38 @@ class JaxEngine:
             slot.done = True
             self._wake.set()
 
+    def begin_streamed_pull(
+        self, request: Any, context: Context, desc: dict
+    ) -> Optional[StreamedPullHandle]:
+        """Disagg decode, streamed handoff (docs/disagg_serving.md): start
+        pulling KV chunks off the prefill worker's EARLY descriptor while
+        its prefill is still running — the transfer overlaps the peer's
+        compute, and the first decode step dispatches as soon as the last
+        chunk and the first token both land, instead of paying the whole
+        transfer serially after prefill. Returns None for request kinds
+        the preload path doesn't carry (guided/multimodal/bad-lora); the
+        handler then rides the serial path."""
+        self.start()
+        req = (
+            request
+            if isinstance(request, PreprocessedRequest)
+            else PreprocessedRequest.from_dict(request)
+        )
+        if req.guided is not None or req.multimodal:
+            # guided FSM compilation is async and multimodal splices don't
+            # ride the preload path: the serial handoff covers these
+            return None
+        if self._check_lora(req) is not None or self._check_logprobs(req) is not None:
+            return None
+        slot = self._new_slot(req, context, suffix="-d")
+        slot.preloaded = (None, None, None, int(desc["n_tokens"]))
+        slot.pull_desc = dict(desc)
+        slot.first_token_fut = asyncio.get_running_loop().create_future()
+        self.num_requests += 1
+        self._waiting.append(slot)
+        self._wake.set()
+        return StreamedPullHandle(self, slot, str(desc.get("transfer_id", "")))
+
     def clear_kv_blocks(self) -> int:
         """Admin flush (reference clear-kv-blocks route, service_v2.rs:
         319-339): evict every unreferenced prefix-cache page (emitting
@@ -1533,6 +1637,23 @@ class JaxEngine:
             out["kv_bytes_served"] = self.data_plane.bytes_served
         out["kv_pulls_completed"] = self.kv_pulls_completed
         out["kv_pages_pulled"] = self.kv_pages_pulled
+        # streamed disagg handoff (docs/disagg_serving.md): decode-side
+        # overlap evidence + prefill-side stage accounting. The ratio is
+        # the acceptance signal — >0 means first tokens reached clients
+        # while KV tail chunks were still in flight
+        out["disagg_streamed_handoffs"] = self.disagg_streamed_handoffs
+        out["disagg_chunks_before_first_token"] = (
+            self.disagg_chunks_before_first_token
+        )
+        out["disagg_first_token_before_last_chunk"] = (
+            self.disagg_first_token_before_last_chunk
+        )
+        out["disagg_streamed_handoff_ratio"] = round(
+            self.disagg_first_token_before_last_chunk
+            / self.disagg_streamed_handoffs, 4
+        ) if self.disagg_streamed_handoffs else 0.0
+        out["kv_streamed_stages"] = self.kv_streamed_stages
+        out["kv_streamed_fallbacks"] = self.kv_streamed_fallbacks
         out["kv_skip_ahead_blocks"] = self.prefix_skip_ahead_blocks
         out["emit_batches"] = self.emit_batches
         out["emit_tokens"] = self.emit_tokens
@@ -1721,11 +1842,20 @@ class JaxEngine:
         )
         n_cached = len(cached_pages)
         # KVBM: probe G2/G3 for the hashes the device cache missed; tier hits
-        # are injected before prefill (onboard), extending the cached prefix
+        # are injected before prefill (onboard), extending the cached prefix.
+        # The probe extends onto PEER tiers too (announcement mesh + the
+        # router's holder hint — cluster KV fabric, docs/kvbm.md)
         onboard_hashes: List[int] = []
+        hint_inst = None
         prompt_full_blocks = len(kv_prompt) // cfg.page_size
         if self.kvbm is not None and cfg.enable_prefix_caching:
-            onboard_hashes = self.kvbm.probe(hashes[n_cached:prompt_full_blocks])
+            hint = slot.kv_holder or {}
+            hint_inst = hint.get("instance")
+            onboard_hashes = self.kvbm.probe(
+                hashes[n_cached:prompt_full_blocks],
+                hint_instance=hint_inst,
+                hint_blocks=max(int(hint.get("blocks", 0)) - n_cached, 0),
+            )
         # allocate the prompt's remaining pages now; generation pages grow later
         prompt_pages = (len(kv_prompt) + cfg.page_size - 1) // cfg.page_size
         fresh_prompt = max(prompt_pages - n_cached, 0)
@@ -1744,27 +1874,21 @@ class JaxEngine:
             self.kvbm_g1_hit_blocks += n_cached
             self.kvbm_g1_miss_blocks += max(prompt_full_blocks - n_cached, 0)
             if onboard_hashes:
-                # onboard budget (docs/kvbm.md): under the sla policy, a
-                # tier load projected past the slot's TTFT headroom is
-                # only WORSE than recompute when recompute is actually
-                # faster — a request already past its deadline still
-                # wants the cheaper path. Cold tiers / cold cost model
-                # (no observation yet) never defer, same rule as the
-                # scheduler's CostModel.
+                # three-arm onboard budget (docs/kvbm.md cluster KV
+                # fabric): local-tier load vs per-peer transfer rate vs
+                # recompute — the cheapest source wins per span, and a
+                # cold/slow peer never blocks TTFT past the headroom
+                # (it loses to a local-prefix trim or full recompute).
+                # Cold tiers / cold peers / cold cost model never defer,
+                # same rule as the scheduler's CostModel. Under fifo
+                # (headroom None) the budget only does source accounting.
                 headroom_ms = self.scheduler.onboard_headroom_ms(slot)
-                if headroom_ms is not None:
-                    est = self.kvbm.estimate_onboard_ms(onboard_hashes)
-                    rate = self.scheduler.cost.per_token("prefill")
-                    recompute_ms = (
-                        rate * 1000.0 * len(onboard_hashes) * cfg.page_size
-                        if rate is not None else None
-                    )
-                    if (
-                        est is not None and est > headroom_ms
-                        and recompute_ms is not None and est > recompute_ms
-                    ):
-                        self.kvbm.note_onboard_recompute()
-                        onboard_hashes = []
+                rate = self.scheduler.cost.per_token("prefill")
+                onboard_hashes, _ = self.kvbm.budget_onboard(
+                    list(onboard_hashes), headroom_ms,
+                    rate * 1000.0 * cfg.page_size if rate is not None else None,
+                    hint_instance=hint_inst,
+                )
         n_onboard = len(onboard_hashes)
         idx = self._free_slots.pop()
         slot.slot_idx = idx
@@ -1794,6 +1918,14 @@ class JaxEngine:
         self._fill_recent(idx, slot)
         slot.admit_seq = self._admit_counter = self._admit_counter + 1
         self.scheduler.on_admit(slot)
+        if (
+            slot.kv_pull and slot.kv_stream and self.data_plane is not None
+            and not (self._multihost and self.shard_addrs)
+        ):
+            # streamed disagg handoff: stage NOW, before any prefill runs —
+            # the decode worker pulls chunks while we compute
+            # (multi-host shard staging keeps the serial flow)
+            self._stage_streamed_kv(slot)
         return True
 
     # -- device helpers -------------------------------------------------- #
@@ -2432,17 +2564,25 @@ class JaxEngine:
         self._mark_lane_dirty(slot.slot_idx)
         self._maybe_finish(slot, first_token)
 
-    async def _pull_kv_task(self, slot: _Slot, desc_dict: dict, first_token: int):
+    async def _pull_kv_task(self, slot: _Slot, desc_dict: dict,
+                            first_token: Optional[int]):
         """Stream KV chunks from the staging prefill worker, injecting each
         as it lands. Any failure falls back to computing the prompt KV
         locally, resuming from the already-emitted first token — disagg
-        stays strictly an optimization."""
+        stays strictly an optimization. `first_token=None` = streamed
+        handoff: the pull started off the EARLY descriptor while the peer
+        was still prefilling; the token arrives later via
+        slot.first_token_fut (None result = handler abandoned us)."""
         from ..llm.kv_transfer import KvTransferDescriptor, pull_kv
 
         desc = KvTransferDescriptor.from_dict(desc_dict)
         phys = np.array([p + 1 for p in slot.pages], np.int32)
+        streamed = slot.first_token_fut is not None
+        chunks_before_first = 0
+        first_before_last_chunk = False
 
         async def inject(off: int, n: int, k, v):
+            nonlocal chunks_before_first, first_before_last_chunk
             if (
                 slot.done
                 or self._closed
@@ -2450,6 +2590,14 @@ class JaxEngine:
                 or self.slots[slot.slot_idx] is not slot
             ):
                 raise asyncio.CancelledError("slot released mid-pull")
+            fut = slot.first_token_fut
+            if fut is not None:
+                # overlap evidence: final value of first_before_last_chunk
+                # = "the first token was already here when the LAST chunk
+                # landed" (structurally impossible on the serial path)
+                first_before_last_chunk = fut.done()
+                if not fut.done():
+                    chunks_before_first += 1
             ids = phys[off : off + n]
             if self._spmd is not None:
                 self._bcast("inject", {"page_ids": ids, "k": np.asarray(k), "v": np.asarray(v)})
@@ -2466,6 +2614,11 @@ class JaxEngine:
             # task records itself cancelled, not finished
             raise
         except Exception as e:  # noqa: BLE001 — any pull failure -> local fallback
+            if streamed:
+                first_token = await self._await_first_token(slot)
+                if first_token is None:
+                    self._abandon_streamed_slot(slot)
+                    return
             if slot.done or slot.slot_idx < 0 or self.slots[slot.slot_idx] is not slot:
                 return
             logger.warning(
@@ -2478,6 +2631,15 @@ class JaxEngine:
             slot.prefill_pos = 0
             self._wake.set()
             return
+        if streamed:
+            first_token = await self._await_first_token(slot)
+            if first_token is None:
+                self._abandon_streamed_slot(slot)
+                return
+            self.disagg_streamed_handoffs += 1
+            self.disagg_chunks_before_first_token += chunks_before_first
+            if first_before_last_chunk:
+                self.disagg_first_token_before_last_chunk += 1
         if slot.done or slot.slot_idx < 0 or self.slots[slot.slot_idx] is not slot:
             return
         logger.info(
@@ -2487,6 +2649,24 @@ class JaxEngine:
         self.kv_pulls_completed += 1
         self.kv_pages_pulled += int(desc.n_pages)
         self._activate_transferred(slot, first_token)
+        self._wake.set()
+
+    async def _await_first_token(self, slot: _Slot) -> Optional[int]:
+        """Streamed handoff: wait for the handler to deliver the prefill's
+        first token (None = the handler abandoned the early pull)."""
+        fut, slot.first_token_fut = slot.first_token_fut, None
+        if fut is None:
+            return None
+        return await fut
+
+    def _abandon_streamed_slot(self, slot: _Slot):
+        """The handler abandoned an early pull (prefill failed or the
+        transfer was re-staged): release the slot and unblock any stream
+        consumer."""
+        if slot.slot_idx >= 0 and self.slots[slot.slot_idx] is slot:
+            self._release_slot(slot)
+        slot.done = True
+        slot.queue.put_nowait(None)
         self._wake.set()
 
     async def _pull_kv_shards(self, slot: _Slot, desc, phys: np.ndarray):
@@ -2575,8 +2755,14 @@ class JaxEngine:
         try:
             # tier reads (host memcpy / disk memmap) run off the event loop,
             # serialized with offload stores on the same executor; remote
-            # (G4/peer) blocks pull over the data plane first
-            k_np, v_np = await self.kvbm.load_async(hashes, self._run_on_device)
+            # (G4/peer) blocks pull over the data plane first, resolved via
+            # the announcement mesh with the router's holder hint as
+            # fallback (cluster KV fabric)
+            hint = slot.kv_holder or {}
+            k_np, v_np = await self.kvbm.load_async(
+                hashes, self._run_on_device,
+                hint_instance=hint.get("instance"),
+            )
         except (KeyError, faults.FaultError) as e:
             # block evicted between probe and load — or a dynochaos
             # `kvbm.onboard` error: fall back to computing that part of
@@ -2599,6 +2785,7 @@ class JaxEngine:
         parent = slot.committed_hashes[-1] if slot.committed_hashes else None
         self.allocator.commit_hashes(alloc_pages, hashes, token_blocks, parent)
         slot.committed_hashes.extend(hashes)
+        self._advance_kv_stream(slot)
         # (whole-prompt clamp already applied at admission, _try_admit)
         self._record_onboard_ms((time.perf_counter() - t0) * 1000.0)
 
@@ -2643,6 +2830,7 @@ class JaxEngine:
         s.pages[n_known : n_known + len(extra)] = extra
         self.allocator.release(old, [])  # fresh, un-hashed -> free list
         s.committed_hashes.extend(hashes[n_known : n_known + len(extra)])
+        self._advance_kv_stream(s)
         s.prefill_pos = (n_known + len(extra)) * cfg.page_size
         if s.prefill_pos >= len(s.kv_prompt):
             # whole prompt now cached: recompute the last token for logits
@@ -3064,6 +3252,19 @@ class JaxEngine:
         # prefix cache so repeat prefills of shared prefixes are free
         self._commit_blocks(slot)
 
+        if slot.kv_stream_tid is not None and self.data_plane is not None \
+                and not slot.done:
+            # streamed handoff still alive: publish the final page + the
+            # first token under the SAME transfer (the decode worker has
+            # been pulling since admission)
+            self._finish_streamed_kv(slot, first_token, first_lp, first_top)
+            return
+        if slot.kv_stream and not slot.done:
+            # the early stage died mid-prefill (reaped TTL, severed pull):
+            # fall through to a fresh serial stage — the decode worker's
+            # failed early pull retries off the final descriptor
+            slot.kv_stream_desc = None
+
         if slot.kv_pull and self.data_plane is not None and not slot.done:
             # fast path: stage the pages on the data plane and return only a
             # descriptor — the decode worker pulls chunks while we keep
@@ -3184,6 +3385,119 @@ class JaxEngine:
         slot.done = True
         # NOT released here: pages stay pinned until on_done (pull or TTL)
 
+    def _stage_streamed_kv(self, slot: _Slot):
+        """Early-staged streamed handoff (docs/disagg_serving.md): stage
+        the prompt's pages on the data plane AT ADMISSION and ship the
+        descriptor immediately — chunks become pullable as prefill commits
+        pages, so the decode worker's transfer overlaps our compute
+        instead of serializing after it. Chunk granularity matches the
+        prefill-chunk commit granularity; the last prompt page is held
+        back until emit (its tail token's KV lands with the final chunk),
+        which also guarantees the pull can only complete after the first
+        token is on the wire. A transfer that dies mid-stream (reap /
+        sever / abandoned puller) falls back to a fresh serial stage at
+        emit — streamed handoff is strictly an optimization."""
+        import jax.numpy as jnp
+
+        c = self.model_config
+        cfg = self.config
+        n_prompt_pages = (len(slot.prompt) + cfg.page_size - 1) // cfg.page_size
+        if n_prompt_pages <= 0:
+            return
+
+        async def extract(off: int, n: int, device: bool):
+            # slot.pages is read LIVE (not snapshotted): _try_skip_ahead
+            # may splice cached pages in mid-prefill — same contents,
+            # different physical ids
+            ids = np.array([p + 1 for p in slot.pages[off : off + n]], np.int32)
+            self._bcast("extract", {"page_ids": ids})
+            if device and not self._multihost:
+                return await self._run_on_device(
+                    lambda: self._extract_pages(self.kv_k, self.kv_v, jnp.asarray(ids))
+                )
+            return await self._run_on_device(partial(self._dev_extract, ids))
+
+        def on_done(ok: bool):
+            if slot.kv_stream_tid is None:
+                return  # engine-initiated abort (release/preempt/emit)
+            slot.kv_stream_tid = None
+            if slot.done:
+                # prefill finished and the pull settled: pages release
+                # here, exactly like the serial stage's on_done
+                if not ok:
+                    logger.warning(
+                        "streamed kv pull for %s abandoned; releasing pages",
+                        slot.request_id,
+                    )
+                self._release_slot(slot)
+            elif not ok:
+                # reaped/severed while prefill still runs: the emit path
+                # stages a fresh serial transfer instead
+                self.kv_streamed_fallbacks += 1
+
+        desc = self.data_plane.stage(
+            n_pages=n_prompt_pages,
+            n_tokens=len(slot.prompt),
+            page_size=cfg.page_size,
+            page_shape=[c.num_layers, cfg.page_size, c.num_kv_heads, c.head_dim],
+            dtype=str(jnp.zeros((), c.dtype).dtype),
+            extract=extract,
+            on_done=on_done,
+            chunk_pages=max(cfg.max_prefill_chunk // cfg.page_size, 1),
+            streamed=True,
+            available_pages=min(
+                len(slot.committed_hashes), n_prompt_pages - 1
+            ),
+        )
+        slot.kv_stream_tid = desc.transfer_id
+        slot.kv_stream_desc = desc.to_dict()
+        self.kv_streamed_stages += 1
+        # EARLY descriptor event (no token yet): the decode worker starts
+        # pulling immediately, while we prefill
+        out = LLMEngineOutput(
+            kv_transfer_params={"pull": slot.kv_stream_desc}
+        ).to_dict()
+        slot.queue.put_nowait(Annotated(data=out).to_dict())
+
+    def _advance_kv_stream(self, slot: _Slot):
+        """Streamed handoff watermark: every committed prompt page is
+        pullable, except the last prompt page which always waits for emit
+        (_stage_streamed_kv invariant)."""
+        if slot.kv_stream_tid is None or self.data_plane is None:
+            return
+        n_prompt_pages = (
+            len(slot.prompt) + self.config.page_size - 1
+        ) // self.config.page_size
+        self.data_plane.advance_streamed(
+            slot.kv_stream_tid,
+            min(len(slot.committed_hashes), n_prompt_pages - 1),
+        )
+
+    def _finish_streamed_kv(self, slot: _Slot, first_token: int,
+                            first_lp: Optional[float] = None,
+                            first_top: Optional[dict] = None):
+        """Prefill finished with a live streamed stage: publish the final
+        watermark (the last — possibly partial — prompt page is now valid)
+        and send the first token with the same descriptor. Pages stay
+        pinned until the pull finishes (on_done), like the serial stage."""
+        cfg = self.config
+        n_prompt_pages = (len(slot.prompt) + cfg.page_size - 1) // cfg.page_size
+        out = LLMEngineOutput(
+            token_ids=[first_token],
+            log_probs=[first_lp]
+            if (slot.want_logprobs and first_lp is not None) else None,
+            top_logprobs=[first_top] if first_top else None,
+            finish_reason="remote_prefill_done",
+            kv_transfer_params={"pull": slot.kv_stream_desc},
+        ).to_dict()
+        slot.queue.put_nowait(Annotated(data=out).to_dict())
+        slot.queue.put_nowait(None)
+        slot.done = True
+        # watermark LAST: the moment it hits n_pages the pull can complete
+        # and on_done releases the slot — done/queue state must be settled
+        self.data_plane.advance_streamed(slot.kv_stream_tid, n_prompt_pages)
+        # NOT released here: pages stay pinned until on_done (pull or TTL)
+
     def _stage_local_shard(self, tid: str, page_ids: np.ndarray, on_done):
         """Stage THIS host's KV shard of `page_ids` under transfer id `tid`
         on the local data plane (leader and followers run this — leader via
@@ -3234,6 +3548,7 @@ class JaxEngine:
             parent = slot.committed_hashes[-1] if slot.committed_hashes else None
             self.allocator.commit_hashes(pages, new_hashes, token_blocks, parent)
             slot.committed_hashes.extend(new_hashes)
+            self._advance_kv_stream(slot)
             if self.kvbm is not None:
                 self.kvbm.offload_commit(
                     new_hashes, [p + 1 for p in pages], parent=parent
@@ -4020,6 +4335,12 @@ class JaxEngine:
             slot.done = True
 
     def _release_slot(self, slot: _Slot):
+        if slot.kv_stream_tid is not None and self.data_plane is not None:
+            # streamed stage still live while its pages are being released
+            # (preempt / cancel / engine failure): fail the transfer so
+            # the pulling peer aborts instead of reading recycled pages
+            tid, slot.kv_stream_tid = slot.kv_stream_tid, None
+            self.data_plane.abort_streamed(tid)
         if slot.slot_idx >= 0 and self.slots[slot.slot_idx] is slot:
             self.scheduler.on_release(slot)
             # commit any full generated blocks before release so decode KV is
@@ -4048,6 +4369,16 @@ class JaxEngine:
             self._mark_lane_dirty(idx)
 
     def _commit_generated_blocks(self, slot: _Slot):
+        if slot.generated == 0:
+            # never produced a token: a prefill-role slot's valid pages
+            # are exactly its incrementally-confirmed chunks (already in
+            # committed_hashes), and a preloaded/streamed-pull decode
+            # slot's injected pages are only ALL valid at activation
+            # (generated >= 1). Committing past either point — e.g. on a
+            # mid-prefill cancel or an aborted early pull — would publish
+            # unwritten/half-injected pages into the prefix cache (and
+            # KVBM + the announcement mesh): silent KV poisoning.
+            return
         hashes = slot.seq.block_hashes()
         n_known = len(slot.committed_hashes)
         # only commit blocks whose KV is fully WRITTEN: the pending (last
@@ -4065,6 +4396,7 @@ class JaxEngine:
             parent = slot.committed_hashes[-1] if slot.committed_hashes else None
             self.allocator.commit_hashes(pages, new_hashes, token_blocks, parent)
             slot.committed_hashes.extend(new_hashes)
+            self._advance_kv_stream(slot)
             if self.kvbm is not None:
                 self.kvbm.offload_commit(
                     new_hashes, [p + 1 for p in pages], parent=parent

@@ -458,6 +458,20 @@ async def main():
         "kvbm_offload_blocks_dropped", "kvbm_offload_failures",
         "kvbm_onboard_count", "kvbm_onboard_ms_sum",
         "kvbm_onboard_recompute_fallbacks",
+        # cluster KV fabric (docs/kvbm.md): peer pulls/bytes + latency
+        # sum (mean ms = sum/onboards), per-source onboard decisions
+        # (local tier / peer / recompute) — the fabric-effectiveness view
+        "kvbm_remote_onboards", "kvbm_remote_blocks_pulled",
+        "kvbm_peer_bytes_pulled", "kvbm_peer_pull_failures",
+        "kvbm_peer_pull_ms_sum", "kvbm_onboard_src_local_blocks",
+        "kvbm_onboard_src_peer_blocks", "kvbm_onboard_src_recompute_blocks",
+        # streamed disagg handoff (docs/disagg_serving.md): decode-side
+        # overlap evidence (first token client-bound before the last KV
+        # chunk landed) + prefill-side early-stage accounting
+        "disagg_streamed_handoffs", "disagg_chunks_before_first_token",
+        "disagg_first_token_before_last_chunk",
+        "disagg_streamed_handoff_ratio", "kv_streamed_stages",
+        "kv_streamed_fallbacks",
     ):
         # registry prepends the "dynamo" prefix -> dynamo_worker_<stat>
         drt.metrics.callback_gauge(

@@ -72,6 +72,9 @@ async def maybe_remote_prefill(
         return
 
     # --- remote prefill (reference handlers.py:192-246) ---
+    from dynamo_tpu.runtime.config import env_bool
+
+    want_stream = env_bool("DYN_DISAGG_STREAM", True)
     prefill_req = dict(request)
     stop = dict(prefill_req.get("stop_conditions") or {})
     orig_max_tokens = int(stop.get("max_tokens") or 128)
@@ -79,53 +82,100 @@ async def maybe_remote_prefill(
     prefill_req["stop_conditions"] = stop
     # kv_pull: we can pull from the prefill worker's data plane (descriptor
     # rendezvous instead of an inline payload); workers without a data plane
-    # answer inline anyway, so this is a capability hint, not a demand
-    prefill_req["disagg_params"] = {"return_kv": True, "kv_pull": True}
+    # answer inline anyway, so this is a capability hint, not a demand.
+    # kv_stream: we can ALSO consume the early-staged streamed handoff —
+    # the prefill worker ships the descriptor at admission and publishes
+    # chunks as prefill commits pages, so our pull overlaps its compute
+    # (docs/disagg_serving.md)
+    prefill_req["disagg_params"] = {
+        "return_kv": True, "kv_pull": True, "kv_stream": want_stream,
+    }
 
     first_token = None
     first_lp = None
     first_top = None
     kv_payload = None
+    early = None  # StreamedPullHandle once the early descriptor arrives
     try:
-        router = PushRouter(prefill_client, RouterMode.ROUND_ROBIN)
-        stream = await router.generate(prefill_req, context.child())
-        async for item in stream:
-            data = item.get("data") if isinstance(item, dict) else None
-            if data and data.get("kv_transfer_params"):
-                kv_payload = data["kv_transfer_params"]
-                if data.get("token_ids"):
-                    first_token = data["token_ids"][0]
-                    first_lp = (data.get("log_probs") or [None])[0]
-                    first_top = (data.get("top_logprobs") or [None])[0]
-    except (StreamLost, EngineError) as e:
-        logger.warning("remote prefill failed (%s); falling back to local", e)
+        try:
+            router = PushRouter(prefill_client, RouterMode.ROUND_ROBIN)
+            stream = await router.generate(prefill_req, context.child())
+            async for item in stream:
+                data = item.get("data") if isinstance(item, dict) else None
+                if not data:
+                    continue
+                kvp = data.get("kv_transfer_params")
+                if not kvp:
+                    continue
+                if not data.get("token_ids"):
+                    # EARLY streamed descriptor (no token yet): start
+                    # pulling the prefill worker's committed chunks now,
+                    # while it is still computing
+                    pull = kvp.get("pull") or {}
+                    if want_stream and early is None and pull.get("streamed"):
+                        try:
+                            early = engine.begin_streamed_pull(
+                                request, context, pull
+                            )
+                        except Exception:  # noqa: BLE001 — early start is
+                            # an optimization; the final descriptor covers
+                            logger.exception("early streamed pull not started")
+                            early = None
+                    continue
+                kv_payload = kvp
+                first_token = data["token_ids"][0]
+                first_lp = (data.get("log_probs") or [None])[0]
+                first_top = (data.get("top_logprobs") or [None])[0]
+        except (StreamLost, EngineError) as e:
+            logger.warning("remote prefill failed (%s); falling back to local", e)
 
-    if kv_payload is None or first_token is None:
+        if kv_payload is None or first_token is None:
+            if early is not None:
+                early.abort()
+                early = None
+            if want_annotation:
+                yield {"event": "remote_prefill", "comment": ["false"]}
+            async for item in engine.generate(request, context):
+                yield item
+            return
+
         if want_annotation:
-            yield {"event": "remote_prefill", "comment": ["false"]}
-        async for item in engine.generate(request, context):
+            yield {"event": "remote_prefill", "comment": ["true"]}
+        # emit the prefill-produced first token to the caller (with its
+        # logprob when the request asked — the lists must stay aligned)
+        yield Annotated(data=LLMEngineOutput(
+            token_ids=[first_token],
+            log_probs=[first_lp] if first_lp is not None else None,
+            top_logprobs=[first_top] if first_top else None,
+        ).to_dict()).to_dict()
+        pull = kv_payload.get("pull") or {}
+        if early is not None and pull.get("transfer_id") == early.transfer_id:
+            # streamed handoff: the early pull has been injecting chunks
+            # since admission — hand it the token and continue decoding
+            early.set_first_token(first_token)
+            handle, early = early, None
+            stream = handle.stream()
+        elif "pull" in kv_payload:
+            # the transfer was (re)staged serially (early stage died or
+            # was preempted): the early pull is stale — abandon it
+            if early is not None:
+                early.abort()
+                early = None
+            stream = engine.generate_decode_from_pull(
+                request, context, first_token, kv_payload["pull"]
+            )
+        else:
+            if early is not None:
+                early.abort()
+                early = None
+            kv_k, kv_v, n_tokens = unpack_kv_payload(kv_payload)
+            stream = engine.generate_decode_from_kv(
+                request, context, first_token, kv_k, kv_v, n_tokens
+            )
+        async for item in stream:
             yield item
-        return
-
-    if want_annotation:
-        yield {"event": "remote_prefill", "comment": ["true"]}
-    # emit the prefill-produced first token to the caller (with its
-    # logprob when the request asked — the lists must stay aligned)
-    yield Annotated(data=LLMEngineOutput(
-        token_ids=[first_token],
-        log_probs=[first_lp] if first_lp is not None else None,
-        top_logprobs=[first_top] if first_top else None,
-    ).to_dict()).to_dict()
-    if "pull" in kv_payload:
-        # fast path: descriptor only — stream-inject from the prefill
-        # worker's data plane while the decode batch keeps stepping
-        stream = engine.generate_decode_from_pull(
-            request, context, first_token, kv_payload["pull"]
-        )
-    else:
-        kv_k, kv_v, n_tokens = unpack_kv_payload(kv_payload)
-        stream = engine.generate_decode_from_kv(
-            request, context, first_token, kv_k, kv_v, n_tokens
-        )
-    async for item in stream:
-        yield item
+    finally:
+        # handler cancelled (client vanished) with an unresolved early
+        # pull: the slot must not wait on a first token that never comes
+        if early is not None:
+            early.abort()

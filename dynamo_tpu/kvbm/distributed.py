@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
@@ -61,9 +62,22 @@ class KvbmDistributed:
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._task: Optional[asyncio.Task] = None
         self._addr_task: Optional[asyncio.Task] = None
+        self._drain_task: Optional[asyncio.Task] = None
         self._bg: set = set()
         self.remote_onboards = 0
         self.remote_blocks_pulled = 0
+        self.remote_bytes_pulled = 0
+        self.remote_pull_failures = 0
+        # per-peer transfer-rate EWMA (ms per block, keyed by data-plane
+        # addr): the third arm of the onboard cost model — peer-pull vs
+        # local-tier vs recompute (docs/kvbm.md cluster KV fabric). None
+        # until a pull is observed: a cold peer never defers an onboard,
+        # the same rule the local tiers and the scheduler CostModel use.
+        self._pull_ms_per_block: Dict[str, float] = {}
+        # peer pull latency histogram (ms per pull_blocks call)
+        self._pull_hist_bounds = (5.0, 20.0, 50.0, 100.0, 250.0, 1000.0)
+        self._pull_hist = [0] * (len(self._pull_hist_bounds) + 1)
+        self._pull_ms_sum = 0.0
         # serve our tier blocks on the data plane
         if data_plane is not None:
             data_plane.kvbm_source = self.manager
@@ -77,6 +91,12 @@ class KvbmDistributed:
             return
         self._sub = await self.drt.discovery.subscribe(self.topic)
         self._task = asyncio.create_task(self._mirror_loop())
+        # periodic eviction-retraction drain: the connector announces
+        # drops inline on its own store/load paths, but a worker that
+        # mostly SERVES peer pulls (data-plane promotions cascade drops
+        # with no connector involvement) needs this sweep or peers keep
+        # stale owners indefinitely
+        self._drain_task = asyncio.create_task(self._drain_evictions_loop())
         watch = await self.drt.discovery.watch_prefix(DATA_PLANE_ROOT)
         for item in watch.snapshot:
             self._on_addr(item["key"], item["value"])
@@ -93,8 +113,7 @@ class KvbmDistributed:
         inst = int(key.rsplit("/", 1)[-1], 16)
         if raw is None:
             self._addrs.pop(inst, None)
-            for owners in self._owners.values():
-                owners.discard(inst)
+            self._drop_owner(inst, None)
             return
         try:
             self._addrs[inst] = json.loads(raw)["addr"]
@@ -104,6 +123,13 @@ class KvbmDistributed:
     async def _addr_loop(self, watch):
         async for event in watch:
             self._on_addr(event.key, event.value if event.type == "put" else None)
+
+    async def _drain_evictions_loop(self):
+        while True:
+            await asyncio.sleep(2.0)
+            evicted = self.manager.drain_evicted()
+            if evicted:
+                self.announce("evicted", evicted)
 
     async def _mirror_loop(self):
         from ..runtime import codec
@@ -117,17 +143,44 @@ class KvbmDistributed:
                 if msg["op"] == "stored":
                     for h in msg["hashes"]:
                         self._owners.setdefault(int(h), set()).add(inst)
+                elif msg["op"] == "evicted":
+                    # the peer's tiers dropped these blocks entirely
+                    # (bounded tiers / bounded index churn): forget the
+                    # owner so probes stop extending onto a dead entry
+                    self._drop_owner(inst, msg["hashes"])
                 elif msg["op"] == "cleared":
-                    for owners in self._owners.values():
-                        owners.discard(inst)
+                    self._drop_owner(inst, None)
+                elif msg["op"] == "sync":
+                    # full-set re-announcement (sync_request reply, worker
+                    # restart): REPLACE the peer's owner set. A union here
+                    # would resurrect hashes the peer evicted between its
+                    # announcements — exactly the stale-owner bug a capped
+                    # index plus worker churn exposes.
+                    self._drop_owner(inst, None)
+                    for h in msg["hashes"]:
+                        self._owners.setdefault(int(h), set()).add(inst)
                 elif msg["op"] == "sync_request":
                     # a late joiner asked for the mesh state: re-announce
-                    # everything our tiers hold
-                    held = self.manager.all_hashes()
-                    if held:
-                        self.announce("stored", held)
+                    # everything our tiers hold, as a replace-set so the
+                    # joiner can't inherit stale entries
+                    self.announce("sync", self.manager.all_hashes())
             except Exception:  # noqa: BLE001
                 logger.exception("bad kvbm announcement")
+
+    def _drop_owner(self, inst: int, hashes: Optional[Sequence[int]]):
+        """Remove `inst` as owner of `hashes` (None = everywhere), pruning
+        empty entries so _owners stays bounded by live mesh contents."""
+        keys = (
+            [int(h) for h in hashes] if hashes is not None
+            else list(self._owners.keys())
+        )
+        for h in keys:
+            owners = self._owners.get(h)
+            if owners is None:
+                continue
+            owners.discard(inst)
+            if not owners:
+                del self._owners[h]
 
     def announce_threadsafe(self, op: str, hashes: Sequence[int]):
         """Schedule an announcement from any thread (offloads run on the
@@ -160,56 +213,135 @@ class KvbmDistributed:
 
     # -- probe/pull (G4 role) ------------------------------------------- #
 
-    def remote_owner(self, h: int) -> Optional[Tuple[int, str]]:
+    def remote_owner(
+        self, h: int, hint_instance: Optional[int] = None
+    ) -> Optional[Tuple[int, str]]:
+        """First live announced owner; `hint_instance` (the router-supplied
+        holder from KvPushRouter's radix index) is the fallback when the
+        announcement mesh hasn't mirrored the hash — the pull itself
+        verifies, a wrong hint is just a KeyError fallback."""
         for inst in self._owners.get(int(h), ()):  # first live owner wins
             addr = self._addrs.get(inst)
             if addr:
                 return inst, addr
+        if hint_instance is not None:
+            addr = self._addrs.get(int(hint_instance))
+            if addr:
+                return int(hint_instance), addr
         return None
 
-    def extend_prefix(self, hashes: Sequence[int]) -> List[int]:
-        """Longest leading run of `hashes` available remotely."""
+    def extend_prefix(
+        self, hashes: Sequence[int], hint_instance: Optional[int] = None,
+        hint_blocks: int = 0,
+    ) -> List[int]:
+        """Longest leading run of `hashes` available remotely. The router
+        hint covers the first `hint_blocks` entries of THIS slice."""
         out: List[int] = []
-        for h in hashes:
-            if self.remote_owner(h) is None:
+        for i, h in enumerate(hashes):
+            hint = hint_instance if i < hint_blocks else None
+            if self.remote_owner(h, hint_instance=hint) is None:
                 break
             out.append(int(h))
         return out
 
+    def estimate_pull_ms(
+        self, hashes: Sequence[int], hint_instance: Optional[int] = None
+    ) -> Optional[float]:
+        """Projected peer-pull latency for `hashes` from the per-peer
+        transfer-rate EWMAs. Pulls from distinct peers run concurrently
+        (pull_blocks gathers), so the projection is the MAX over peers of
+        that peer's span, not the sum. None when any needed peer has
+        never been observed (cold peers never defer an onboard) or a
+        hash has no reachable owner."""
+        per_peer: Dict[str, float] = {}
+        for h in hashes:
+            owner = self.remote_owner(h, hint_instance=hint_instance)
+            if owner is None:
+                return None
+            ms = self._pull_ms_per_block.get(owner[1])
+            if ms is None:
+                return None
+            per_peer[owner[1]] = per_peer.get(owner[1], 0.0) + ms
+        return max(per_peer.values(), default=0.0)
+
     async def pull_blocks(
-        self, hashes: Sequence[int]
+        self, hashes: Sequence[int], hint_instance: Optional[int] = None
     ) -> Tuple[np.ndarray, np.ndarray]:
         """Fetch blocks from peers ([n, *block_shape] stacks), grouping by
-        owner; raises KeyError when any block has no reachable owner."""
+        owner; raises KeyError when any block has no reachable owner and
+        KvTransferError when a peer fails mid-pull (both convert to
+        recompute in the onboard path). Observes per-peer transfer rate."""
         from ..llm.kv_transfer import pull_kvbm_blocks
 
         plan: Dict[str, List[int]] = {}
         for h in hashes:
-            owner = self.remote_owner(h)
+            owner = self.remote_owner(h, hint_instance=hint_instance)
             if owner is None:
                 raise KeyError(f"kvbm block {h} has no remote owner")
             plan.setdefault(owner[1], []).append(int(h))
+        t0 = time.perf_counter()
         parts: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
-        for addr, hs in plan.items():
-            k, v = await pull_kvbm_blocks(
-                addr, hs, self.manager.block_shape, self.manager.dtype
+
+        async def pull_one(addr: str, hs: List[int]):
+            t_peer = time.perf_counter()
+            try:
+                k, v = await pull_kvbm_blocks(
+                    addr, hs, self.manager.block_shape, self.manager.dtype
+                )
+            except Exception:
+                self.remote_pull_failures += 1
+                raise
+            ms = (time.perf_counter() - t_peer) * 1000.0
+            prev = self._pull_ms_per_block.get(addr)
+            per_block = ms / max(len(hs), 1)
+            self._pull_ms_per_block[addr] = (
+                per_block if prev is None else 0.8 * prev + 0.2 * per_block
             )
             for i, h in enumerate(hs):
                 parts[h] = (k[i], v[i])
             self.remote_blocks_pulled += len(hs)
+            self.remote_bytes_pulled += int(k.nbytes) + int(v.nbytes)
+
+        # independent peers pull CONCURRENTLY: this is admission/TTFT
+        # critical path, and a prefix split across N owners (worker
+        # churn) must cost max(per-peer), not the sum
+        await asyncio.gather(
+            *(pull_one(addr, hs) for addr, hs in plan.items())
+        )
         self.remote_onboards += 1
+        total_ms = (time.perf_counter() - t0) * 1000.0
+        self._pull_ms_sum += total_ms
+        for i, bound in enumerate(self._pull_hist_bounds):
+            if total_ms <= bound:
+                self._pull_hist[i] += 1
+                break
+        else:
+            self._pull_hist[-1] += 1
         ks = np.stack([parts[int(h)][0] for h in hashes])
         vs = np.stack([parts[int(h)][1] for h in hashes])
         return ks, vs
 
     def stats(self) -> dict:
-        return {
+        out = {
             "kvbm_remote_onboards": self.remote_onboards,
             "kvbm_remote_blocks_pulled": self.remote_blocks_pulled,
+            "kvbm_peer_bytes_pulled": self.remote_bytes_pulled,
+            "kvbm_peer_pull_failures": self.remote_pull_failures,
+            "kvbm_peer_pull_ms_sum": round(self._pull_ms_sum, 3),
+            "kvbm_peer_pull_hist": {
+                **{
+                    f"le_{b:g}ms": n
+                    for b, n in zip(self._pull_hist_bounds, self._pull_hist)
+                },
+                "inf": self._pull_hist[-1],
+            },
             "kvbm_known_remote_blocks": sum(
                 1 for owners in self._owners.values() if owners
             ),
         }
+        for addr, ms in self._pull_ms_per_block.items():
+            out.setdefault("kvbm_peer_ms_per_block", {})[addr] = round(ms, 3)
+        return out
 
     async def close(self):
         # in-flight best-effort announcements die with the mirror
@@ -219,5 +351,7 @@ class KvbmDistributed:
             self._task.cancel()
         if self._addr_task:
             self._addr_task.cancel()
+        if self._drain_task:
+            self._drain_task.cancel()
         if self._sub:
             await self._sub.cancel()

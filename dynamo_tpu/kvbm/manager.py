@@ -96,6 +96,9 @@ class KvBlockManager:
         self.cfg = cfg
         self.block_shape = tuple(block_shape)
         self.dtype = dtype
+        # K+V bytes per block: the data plane sizes its inline-vs-executor
+        # serve decision off this
+        self.block_nbytes = 2 * int(np.prod(block_shape)) * np.dtype(dtype).itemsize
         if cfg.disk_blocks > 0 and not cfg.disk_path:
             raise ValueError("kvbm_disk_blocks > 0 requires kvbm_disk_path")
         host_policy, disk_policy = _parse_eviction(cfg.eviction)
@@ -115,6 +118,11 @@ class KvBlockManager:
         self.onboarded_blocks = 0
         self.disk_evictions = 0
         self.dropped_blocks = 0
+        # hashes that fell off the tier chain entirely since the last
+        # drain: the announcement mesh must retract them, or peers keep
+        # stale owner entries and probe onto dead blocks (the bounded-tier
+        # + worker-churn resurrection bug)
+        self._evicted_pending: List[int] = []
         # per-tier per-block load latency EWMA (ms): feeds the onboard
         # budget (estimate_load_ms). None until first observed — a cold
         # tier never defers an onboard (same rule as the scheduler's
@@ -136,17 +144,29 @@ class KvBlockManager:
                 if evicted is not None:
                     old_hash, old_k, old_v, old_parent = evicted
                     if self.disk is not None:
-                        if self.disk.put(
+                        dropped = self.disk.put(
                             old_hash, old_k, old_v, parent=old_parent
-                        ) is not None:
+                        )
+                        if dropped is not None:
                             self.dropped_blocks += 1
+                            self._evicted_pending.append(int(dropped))
                         self.disk_evictions += 1
                     else:
                         self.dropped_blocks += 1
+                        self._evicted_pending.append(int(old_hash))
             elif self.disk is not None:
-                if self.disk.put(seq_hash, k, v, parent=parent) is not None:
+                dropped = self.disk.put(seq_hash, k, v, parent=parent)
+                if dropped is not None:
                     self.dropped_blocks += 1
+                    self._evicted_pending.append(int(dropped))
                 self.offloaded_blocks += 1
+
+    def drain_evicted(self) -> List[int]:
+        """Hashes dropped from ALL tiers since the last drain (the
+        announcement mesh retracts these as `evicted`)."""
+        with self._lock:
+            out, self._evicted_pending = self._evicted_pending, []
+            return out
 
     def all_hashes(self) -> List[int]:
         """Every block hash held in any tier (the announcement-mesh
@@ -220,10 +240,12 @@ class KvBlockManager:
                         )
                         if evicted is not None:
                             old_hash, old_k, old_v, old_parent = evicted
-                            if self.disk.put(
+                            dropped = self.disk.put(
                                 old_hash, old_k, old_v, parent=old_parent
-                            ) is not None:
+                            )
+                            if dropped is not None:
                                 self.dropped_blocks += 1
+                                self._evicted_pending.append(int(dropped))
                             self.disk_evictions += 1
                 if got is None:
                     raise KeyError(f"KVBM block {h} vanished between probe and load")
@@ -309,6 +331,10 @@ class KvbmConnector:
         self.engine = engine
         self.manager = manager
         self.pipelined = env_bool("DYN_KVBM_PIPELINE", True)
+        # cluster KV fabric (docs/kvbm.md): admission may onboard blocks
+        # from a PEER worker's tiers over the data plane. Off = local
+        # tiers only (the pre-fabric behavior).
+        self.peer_pull = env_bool("DYN_KVBM_PEER_PULL", True)
         import os
 
         try:
@@ -336,6 +362,12 @@ class KvbmConnector:
         self.offload_blocks_dropped = 0
         self.offload_failures = 0
         self.onboard_recompute_fallbacks = 0
+        # per-source onboard decision accounting (cluster KV fabric): how
+        # many admission blocks came from the local tiers, from a peer
+        # pull, and how many the budget handed back to recompute
+        self.onboard_src_local_blocks = 0
+        self.onboard_src_peer_blocks = 0
+        self.onboard_src_recompute_blocks = 0
         # kvbm/distributed.py attaches itself here: cross-worker probe/pull
         # (the G4 role — peer memory as the tier below disk)
         self.distributed = None
@@ -431,6 +463,35 @@ class KvbmConnector:
         # dispatch_kvbm_offload_* so the bench can see the µs stolen
         eng._device_exec.submit(eng._timed(run_gather, "kvbm_offload"))
 
+    def stage_promotion(self, hashes: Sequence[int],
+                        parents: Sequence[Optional[int]], k, v):
+        """Promote peer-pulled blocks into the host tier OFF the onboard
+        critical path: enqueue a READY batch for the kvbm-tier thread
+        (same bounded queue + drop-oldest backpressure as offload
+        write-through). Losing a promotion under pressure loses a future
+        local hit, never correctness — the peer still owns the block."""
+        # _store_batch expects [layers, n, ...] like a device gather
+        batch = _OffloadBatch(
+            hashes=[int(h) for h in hashes],
+            parents=list(parents),
+            k=np.asarray(k).swapaxes(0, 1),
+            v=np.asarray(v).swapaxes(0, 1),
+            ready=True,
+        )
+        with self._offload_cv:
+            if self._stopped:
+                return
+            while len(self._queue) >= self.queue_cap:
+                victim = self._queue.popleft()
+                victim.dropped = True
+                self.offload_batches_dropped += 1
+                self.offload_blocks_dropped += len(victim.hashes)
+                self._inflight_hashes.difference_update(victim.hashes)
+            self._queue.append(batch)
+            self._inflight_hashes.update(batch.hashes)
+            self._ensure_tier_thread()
+            self._offload_cv.notify_all()
+
     def _ensure_tier_thread(self):
         """Caller holds _offload_cv."""
         if self._tier_thread is None or not self._tier_thread.is_alive():
@@ -505,6 +566,15 @@ class KvbmConnector:
             self._inflight_hashes.difference_update(batch.hashes)
         if self.distributed is not None:
             self.distributed.announce_threadsafe("stored", batch.hashes)
+            self._announce_evictions()
+
+    def _announce_evictions(self):
+        """Retract fully-dropped hashes from the mesh (any thread)."""
+        if self.distributed is None:
+            return
+        evicted = self.manager.drain_evicted()
+        if evicted:
+            self.distributed.announce_threadsafe("evicted", evicted)
 
     def _offload_commit_inline(self, seq_hashes: List[int], phys_pages: List[int],
                                parent: Optional[int] = None):
@@ -539,6 +609,7 @@ class KvbmConnector:
                 self.manager.store(h, k_np[i], v_np[i], parent=parents[i])
             if self.distributed is not None:
                 self.distributed.announce_threadsafe("stored", hashes)
+                self._announce_evictions()
 
         with self._pending_lock:
             self._pending += 1
@@ -556,13 +627,22 @@ class KvbmConnector:
 
     # -- onboard (called at admission) ----------------------------------- #
 
-    def probe(self, hashes: Sequence[int]) -> List[int]:
+    def probe(self, hashes: Sequence[int], hint_instance: Optional[int] = None,
+              hint_blocks: int = 0) -> List[int]:
         """Longest onboardable prefix: local tiers, extended by remote
-        owners when the distributed mesh is attached (G4 role)."""
+        owners when the distributed mesh is attached (G4 role). The
+        router-supplied holder hint (`hint_instance` holds the first
+        `hint_blocks` entries of THIS slice per the router's radix index)
+        extends coverage past what the announcement mesh has mirrored."""
         local = self.manager.match_prefix(hashes)
-        if self.distributed is not None and len(local) < len(hashes):
+        if (
+            self.peer_pull and self.distributed is not None
+            and len(local) < len(hashes)
+        ):
             return list(local) + self.distributed.extend_prefix(
-                list(hashes)[len(local):]
+                list(hashes)[len(local):],
+                hint_instance=hint_instance,
+                hint_blocks=max(hint_blocks - len(local), 0),
             )
         return local
 
@@ -571,22 +651,116 @@ class KvbmConnector:
         unknown; the engine only defers to recompute on a KNOWN blowout)."""
         return self.manager.estimate_load_ms(hashes)
 
+    def budget_onboard(
+        self,
+        hashes: List[int],
+        headroom_ms: Optional[float],
+        recompute_ms_per_block: Optional[float],
+        hint_instance: Optional[int] = None,
+    ) -> Tuple[List[int], str]:
+        """Three-arm onboard budget (docs/kvbm.md cluster KV fabric): the
+        cheapest source wins per span — local-tier load vs per-peer
+        transfer rate vs recompute — and a cold/slow peer never blocks
+        TTFT past the slot's headroom.
+
+        Returns (hashes_to_onboard, decision) with decision one of
+        `full` (onboard everything probed), `trim-local` (keep the
+        locally-tiered prefix, recompute the peer tail), `recompute`
+        (skip the onboard entirely). Unknown costs never constrain: a
+        cold tier/peer/cost-model keeps the full onboard, the same rule
+        as the scheduler's CostModel."""
+        if not hashes:
+            return hashes, "full"
+        local_mask = [self.manager.has(h) for h in hashes]
+        n_total = len(hashes)
+        # cost of the full onboard: local part at tier EWMA + peer part at
+        # per-peer transfer EWMA; any unknown component -> unconstrained
+        local_part = [h for h, m in zip(hashes, local_mask) if m]
+        peer_part = [h for h, m in zip(hashes, local_mask) if not m]
+        est_local = (
+            self.manager.estimate_load_ms(local_part) if local_part else 0.0
+        )
+        if peer_part and (self.distributed is None or not self.peer_pull):
+            # probe() can't have included peer blocks in that case, but a
+            # racing eviction may have demoted a local hash: recompute it
+            est_peer = None
+        elif peer_part:
+            est_peer = self.distributed.estimate_pull_ms(
+                peer_part, hint_instance=hint_instance
+            )
+        else:
+            est_peer = 0.0
+        est_full = (
+            est_local + est_peer
+            if est_local is not None and est_peer is not None else None
+        )
+        if headroom_ms is None or est_full is None or est_full <= headroom_ms:
+            self._count_onboard(len(local_part), len(peer_part), 0)
+            return hashes, "full"
+        if recompute_ms_per_block is None:
+            # blown headroom but no recompute observation yet: we cannot
+            # prove any alternative cheaper — keep the onboard
+            self._count_onboard(len(local_part), len(peer_part), 0)
+            return hashes, "full"
+        # arm B: keep the locally-tiered PREFIX, recompute the rest (the
+        # slow peer tail is the usual blowout); arm C: full recompute
+        n_local_prefix = 0
+        for m in local_mask:
+            if not m:
+                break
+            n_local_prefix += 1
+        est_prefix = (
+            self.manager.estimate_load_ms(hashes[:n_local_prefix])
+            if n_local_prefix else 0.0
+        )
+        cost_b = (
+            est_prefix + recompute_ms_per_block * (n_total - n_local_prefix)
+            if est_prefix is not None else None
+        )
+        cost_c = recompute_ms_per_block * n_total
+        best, decision = est_full, "full"
+        if cost_c < best:
+            best, decision = cost_c, "recompute"
+        if cost_b is not None and n_local_prefix and cost_b < best:
+            best, decision = cost_b, "trim-local"
+        if decision == "full":
+            self._count_onboard(len(local_part), len(peer_part), 0)
+            return hashes, "full"
+        if decision == "trim-local":
+            kept = hashes[:n_local_prefix]
+            self._count_onboard(len(kept), 0, n_total - len(kept))
+            self.note_onboard_recompute()
+            return kept, "trim-local"
+        self._count_onboard(0, 0, n_total)
+        self.note_onboard_recompute()
+        return [], "recompute"
+
+    def _count_onboard(self, n_local: int, n_peer: int, n_recompute: int):
+        with self._offload_cv:
+            self.onboard_src_local_blocks += n_local
+            self.onboard_src_peer_blocks += n_peer
+            self.onboard_src_recompute_blocks += n_recompute
+
     def note_onboard_recompute(self):
-        """The engine skipped an onboard whose projected tier-load latency
-        exceeded the slot's TTFT headroom (docs/kvbm.md onboard budget)."""
+        """The engine skipped (part of) an onboard whose projected load
+        latency exceeded the slot's TTFT headroom and lost to recompute
+        (docs/kvbm.md onboard budget)."""
         with self._offload_cv:
             self.onboard_recompute_fallbacks += 1
 
     def load(self, hashes: Sequence[int]) -> Tuple[np.ndarray, np.ndarray]:
         return self.manager.load_blocks(hashes)
 
-    async def load_async(self, hashes: Sequence[int], run) -> Tuple[np.ndarray, np.ndarray]:
+    async def load_async(self, hashes: Sequence[int], run,
+                         hint_instance: Optional[int] = None) -> Tuple[np.ndarray, np.ndarray]:
         """Onboard path: local tier reads ride the engine's device/IO
         executor (`run`), remote blocks pull point-to-point from their
-        owner's data plane and are PROMOTED into the local host tier so
-        repeat hits stay local. Raises KeyError on any miss (the engine
-        falls back to prefilling that span); a dynochaos `kvbm.onboard`
-        error rides the same fallback."""
+        owner's data plane (announced owner, falling back to the router's
+        holder hint) and are PROMOTED into the local host tier so repeat
+        hits stay local. Raises KeyError on any miss (the engine falls
+        back to prefilling that span); a dynochaos `kvbm.onboard` error or
+        a typed KvTransferError (severed/unreachable peer) rides the same
+        fallback."""
         f = faults.FAULTS
         if f.enabled:
             # FaultError propagates to _inject_onboard, which treats it
@@ -603,25 +777,49 @@ class KvbmConnector:
             prev = h
         parts: dict = {}
         if remote:
-            if self.distributed is None:
+            if self.distributed is None or not self.peer_pull:
                 raise KeyError(f"kvbm blocks {remote[:3]}... not tiered here")
             try:
-                rk, rv = await self.distributed.pull_blocks(remote)
+                rk, rv = await self.distributed.pull_blocks(
+                    remote, hint_instance=hint_instance
+                )
             except KeyError:
                 raise
-            except Exception as e:  # noqa: BLE001 — dead peer/network: the
-                # engine treats a KeyError as "prefill that span instead"
+            except Exception as e:  # noqa: BLE001 — dead peer / severed
+                # stream / unresolvable addr (KvTransferError) or any other
+                # transport failure: the engine treats a KeyError as
+                # "prefill that span instead"
                 raise KeyError(f"kvbm remote pull failed: {e}") from e
 
-            def promote():
-                for i, h in enumerate(remote):
-                    self.manager.store(h, rk[i], rv[i], parent=parent_of[h])
+            if self.pipelined:
+                # promotion rides the tier thread, not the onboard
+                # critical path (stage_promotion) — the slot's inject
+                # proceeds immediately
+                self.stage_promotion(
+                    remote, [parent_of[h] for h in remote], rk, rv
+                )
+            else:
+                def promote():
+                    for i, h in enumerate(remote):
+                        self.manager.store(h, rk[i], rv[i], parent=parent_of[h])
 
-            await run(promote)
+                await run(promote)
+            if not local:
+                # pull_blocks stacked in `hashes` order already — skip
+                # the per-block restack copy (admission latency path)
+                return rk, rv
             for i, h in enumerate(remote):
                 parts[h] = (rk[i], rv[i])
         if local:
+            if not remote:
+                out = await run(self.manager.load_blocks, local)
+                # disk→host promotion inside load_blocks can cascade
+                # drops: retract them even on a read-only path (a worker
+                # that mostly SERVES pulls would otherwise never drain)
+                self._announce_evictions()
+                return out
             lk, lv = await run(self.manager.load_blocks, local)
+            self._announce_evictions()
             for i, h in enumerate(local):
                 parts[h] = (lk[i], lv[i])
         ks = np.stack([parts[h][0] for h in hashes])
@@ -681,6 +879,9 @@ class KvbmConnector:
                 "kvbm_offload_blocks_dropped": self.offload_blocks_dropped,
                 "kvbm_offload_failures": self.offload_failures,
                 "kvbm_onboard_recompute_fallbacks": self.onboard_recompute_fallbacks,
+                "kvbm_onboard_src_local_blocks": self.onboard_src_local_blocks,
+                "kvbm_onboard_src_peer_blocks": self.onboard_src_peer_blocks,
+                "kvbm_onboard_src_recompute_blocks": self.onboard_src_recompute_blocks,
             }
         out.update(self.manager.stats())
         out["kvbm_pending_offloads"] = self.pending_offloads()

@@ -185,11 +185,14 @@ class KvPushRouter:
         token_ids: list[int],
         router_override: Optional[dict] = None,
         seq_hashes: Optional[list[int]] = None,
-    ) -> tuple[int, int]:
+        return_scores: bool = False,
+    ) -> tuple:
         """Returns (worker_id, overlap_blocks) — reference find_best_match
         kv_router.rs:318. `seq_hashes`: precomputed block hashes (generate()
         hashes the prompt ONCE and reuses them here, for the overlay record
-        and for the sync publish)."""
+        and for the sync publish). `return_scores=True` appends the full
+        per-worker overlap map (the cluster-KV-fabric holder hint reads
+        the best-overlap worker from it)."""
         live = self.client.instance_ids()
         # NEW streams schedule only onto ready instances: a `draining`
         # worker (scale-down in progress) would reject the stream anyway —
@@ -228,6 +231,8 @@ class KvPushRouter:
             worker = self.scheduler.schedule(request_blocks, scores.scores, ready)
         finally:
             self.scheduler.config = saved
+        if return_scores:
+            return worker, scores.scores.get(worker, 0), dict(scores.scores)
         return worker, scores.scores.get(worker, 0)
 
     async def generate(
@@ -244,14 +249,33 @@ class KvPushRouter:
         )
         seq_hashes = compute_seq_hashes(token_ids, self.block_size, salt)
         pinned = request.get("router", {}).get("backend_instance_id")
+        holder = None
         if pinned is not None:
             worker, overlap = int(pinned), 0
         else:
-            worker, overlap = self.find_best_match(
-                token_ids, request.get("router") or None, seq_hashes=seq_hashes
+            worker, overlap, overlap_scores = self.find_best_match(
+                token_ids, request.get("router") or None,
+                seq_hashes=seq_hashes, return_scores=True,
             )
+            # cluster KV fabric (docs/kvbm.md): the index already knows
+            # which OTHER worker holds the longest cached prefix — ship
+            # (holder, matched_blocks) with the request so the chosen
+            # worker can pull those blocks from the holder's tiers instead
+            # of recomputing them. Only a strictly-better holder is worth
+            # a hint; the worker's own announcement mesh covers the rest.
+            best_holder = max(
+                (w for w in overlap_scores if w != worker),
+                key=lambda w: overlap_scores[w], default=None,
+            )
+            if best_holder is not None and overlap_scores[best_holder] > overlap:
+                holder = {
+                    "instance": int(best_holder),
+                    "blocks": int(overlap_scores[best_holder]),
+                }
         request = dict(request)
         request["estimated_prefix_hit_num_blocks"] = overlap
+        if holder is not None:
+            request["kv_holder"] = holder
         blocks = max(len(token_ids) // self.block_size, 1)
         self.scheduler.add_request(request_id, worker, blocks)
         if isinstance(self.indexer, ApproxKvIndexer):

@@ -46,6 +46,14 @@ from ..runtime import faults
 
 logger = logging.getLogger(__name__)
 
+
+class KvTransferError(RuntimeError):
+    """A KV data-plane transfer failed (peer unreachable, addr no longer
+    resolving, severed stream, protocol violation). Typed so the onboard /
+    disagg paths can convert it to a clean recompute/local-prefill fallback
+    instead of letting a raw ConnectionError escape into the step loop."""
+
+
 _MAGIC = 0xD7A04B1D  # frame magic (full-stream pull handshake)
 _MAGIC_RANGE = 0xD7A04B1E  # ranged pull handshake (multi-host shard chunks)
 _HDR = struct.Struct("<II")  # magic, header length
@@ -70,6 +78,20 @@ def _np_dtype(name: str):
 
         return np.dtype(ml_dtypes.bfloat16)
     return np.dtype(name)
+
+
+def _set_nodelay(writer: asyncio.StreamWriter):
+    """Disable Nagle on a KV data-plane socket: header+payload frames are
+    written back-to-back and a coalescing delay on either end stalls the
+    pull round-trip (admission-latency path)."""
+    import socket
+
+    try:
+        sock = writer.get_extra_info("socket")
+        if sock is not None:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    except OSError:
+        pass
 
 
 def routable_host() -> str:
@@ -109,6 +131,10 @@ class KvTransferDescriptor:
     # shared transfer_id. page_shape is then the SHARD's per-page shape
     # (KH split across hosts). None => single staging endpoint (full pages).
     shards: Optional[list] = None  # [{"host_id": int, "addr": str}]
+    # streamed staging: the producer is still prefilling when this
+    # descriptor ships — chunks become pullable as pages commit, so the
+    # puller must tolerate producer-paced gaps between chunks
+    streamed: bool = False
 
     def to_dict(self) -> dict:
         return dict(self.__dict__)
@@ -133,6 +159,50 @@ class _Staged:
     started: bool = False
     finished: bool = False
     server: Optional["KvDataPlaneServer"] = None  # for serve accounting
+    # streamed staging (disagg early handoff, docs/disagg_serving.md): the
+    # transfer is staged while the producing prefill is STILL RUNNING.
+    # `available` = pages valid so far (None = all pages, the non-streamed
+    # default); the producer advances it as prefill chunks commit and the
+    # serve loop waits on `avail_event` before extracting past it. `failed`
+    # aborts waiting pullers (producer died / preempted mid-stream).
+    available: Optional[int] = None
+    failed: bool = False
+    avail_event: Optional[asyncio.Event] = None
+
+    def set_available(self, n_pages: int):
+        if self.available is not None and n_pages > self.available:
+            self.available = min(n_pages, self.desc.n_pages)
+            # a progressing producer keeps the transfer alive
+            self.deadline = time.monotonic() + self.max_transfer_time
+            if self.avail_event is not None:
+                self.avail_event.set()
+
+    def fail_stream(self):
+        self.failed = True
+        if self.avail_event is not None:
+            self.avail_event.set()
+
+    async def wait_pages(self, upto: int):
+        """Block until pages [0, upto) are valid (streamed staging); no-op
+        for fully-staged transfers. Raises KvTransferError when the
+        producer fails or the transfer is reaped mid-wait."""
+        while True:
+            if self.failed:
+                raise KvTransferError("streamed kv transfer failed at source")
+            if self.finished:
+                raise KvTransferError("kv transfer reaped mid-stream")
+            if self.available is None or self.available >= upto:
+                return
+            self.avail_event.clear()
+            try:
+                await asyncio.wait_for(
+                    self.avail_event.wait(), self.max_transfer_time
+                )
+            except (TimeoutError, asyncio.TimeoutError) as e:
+                raise KvTransferError(
+                    "streamed kv transfer stalled (producer made no "
+                    f"progress past page {self.available})"
+                ) from e
 
     def count_serve(self, nbytes: int):
         """Account a served chunk (socket OR in-process) on the owning
@@ -223,6 +293,8 @@ class KvDataPlaneServer:
         chunk_pages: int = 0,
         ttl: Optional[float] = None,
         transfer_id: Optional[str] = None,
+        streamed: bool = False,
+        available_pages: int = 0,
     ) -> KvTransferDescriptor:
         """Pin a finished prefill's pages for pulling; returns the descriptor
         to send on the response stream. `on_done(ok)` fires exactly once —
@@ -230,7 +302,10 @@ class KvDataPlaneServer:
         engine releases the slot's pages. An explicit `transfer_id` lets
         every host of a multi-host worker stage its shard under ONE id (the
         leader picks the id and broadcasts it in the stage_shard step
-        descriptor)."""
+        descriptor). `streamed=True` stages a transfer whose producer is
+        still running: only `available_pages` are valid yet, the producer
+        advances the watermark via `advance_streamed` as pages commit, and
+        pullers wait at the watermark instead of reading garbage."""
         if chunk_pages <= 0:
             # ~4 MiB/chunk of K (plus V): small enough to overlap, large
             # enough that framing cost vanishes
@@ -246,6 +321,7 @@ class KvDataPlaneServer:
             page_shape=list(page_shape),
             dtype=dtype,
             chunk_pages=chunk_pages,
+            streamed=streamed,
         )
         staged = _Staged(
             desc=desc,
@@ -254,10 +330,27 @@ class KvDataPlaneServer:
             deadline=time.monotonic() + (ttl if ttl is not None else self.ttl),
             max_transfer_time=self.max_transfer_time,
             server=self,
+            available=min(max(available_pages, 0), n_pages) if streamed else None,
+            avail_event=asyncio.Event() if streamed else None,
         )
         self._staged[transfer_id] = staged
         _LOCAL[(self.addr, transfer_id)] = staged
         return desc
+
+    def advance_streamed(self, transfer_id: str, available_pages: int):
+        """Producer-side watermark: pages [0, available_pages) are now
+        valid. No-op for unknown/non-streamed transfers."""
+        staged = self._staged.get(transfer_id)
+        if staged is not None:
+            staged.set_available(available_pages)
+
+    def abort_streamed(self, transfer_id: str):
+        """Producer died (preempt / engine failure) mid-stream: wake and
+        fail any waiting puller, release the stage."""
+        staged = self._staged.get(transfer_id)
+        if staged is not None:
+            staged.fail_stream()
+            self._unstage(staged, ok=False)
 
     def _unstage(self, staged: _Staged, ok: bool):
         self._staged.pop(staged.desc.transfer_id, None)
@@ -290,47 +383,67 @@ class KvDataPlaneServer:
                     self._unstage(t, ok=False)
 
     async def _serve(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        _set_nodelay(writer)
         try:
-            hdr = await asyncio.wait_for(
-                reader.readexactly(_HDR.size), self.chunk_timeout
-            )
-            magic, length = _HDR.unpack(hdr)
-            if magic not in (_MAGIC, _MAGIC_RANGE):
-                raise RuntimeError(f"bad kv data plane magic {magic:#x}")
-            # _MAGIC handshakes carry a 16-hex-char transfer id; _MAGIC_RANGE
-            # handshakes may carry a {"blocks": [up to 4096 x u64]} kvbm
-            # request (~9 bytes per msgpacked hash => up to ~40 KiB)
-            cap = 65536 if magic == _MAGIC_RANGE else 4096
-            if length > cap:
-                raise RuntimeError(f"oversized kv handshake ({length} bytes)")
-            body = await asyncio.wait_for(
-                reader.readexactly(length), self.chunk_timeout
-            )
-            if magic == _MAGIC_RANGE:
-                await self._serve_range(body, writer)
+            # ranged/kvbm requests are request-response and KEEP the
+            # connection: a peer onboarding at admission rate would
+            # otherwise pay a TCP connect per request (the client keeps a
+            # small per-addr pool, _ConnPool). Idle connections die at the
+            # chunk timeout; full-stream transfer pulls still close after
+            # the one transfer.
+            while True:
+                try:
+                    hdr = await asyncio.wait_for(
+                        reader.readexactly(_HDR.size), self.chunk_timeout
+                    )
+                except (TimeoutError, asyncio.TimeoutError,
+                        asyncio.IncompleteReadError):
+                    return  # idle keep-alive or clean peer close
+                magic, length = _HDR.unpack(hdr)
+                if magic not in (_MAGIC, _MAGIC_RANGE):
+                    raise RuntimeError(f"bad kv data plane magic {magic:#x}")
+                # _MAGIC handshakes carry a 16-hex-char transfer id;
+                # _MAGIC_RANGE handshakes may carry a {"blocks": [up to
+                # 4096 x u64]} kvbm request (~9 B/hash => up to ~40 KiB)
+                cap = 65536 if magic == _MAGIC_RANGE else 4096
+                if length > cap:
+                    raise RuntimeError(f"oversized kv handshake ({length} bytes)")
+                body = await asyncio.wait_for(
+                    reader.readexactly(length), self.chunk_timeout
+                )
+                if magic == _MAGIC_RANGE:
+                    await self._serve_range(body, writer)
+                    continue
+                await self._serve_transfer(body, writer)
                 return
-            transfer_id = body.decode()
-            staged = self._staged.get(transfer_id)
-            if staged is None or staged.started:
-                await self._send_header(writer, {"error": f"unknown transfer {transfer_id}"})
-                return
-            staged.started = True
-            staged.deadline = time.monotonic() + self.max_transfer_time
-            try:
-                await self._stream(staged, writer)
-            except (ConnectionError, asyncio.IncompleteReadError,
-                    TimeoutError, asyncio.TimeoutError):  # asyncio.TimeoutError
-                # is distinct from builtin TimeoutError before 3.11
-                self._unstage(staged, ok=False)
-                raise
-            self.transfers_served += 1
-            self._unstage(staged, ok=True)
         except (asyncio.IncompleteReadError, ConnectionError):
             pass  # peer vanished; reaper/unstage already handled pages
         except Exception:  # noqa: BLE001 — one bad peer must not kill the server
             logger.exception("kv data plane connection failed")
         finally:
             writer.close()
+
+    async def _serve_transfer(self, body: bytes, writer: asyncio.StreamWriter):
+        """Full-stream transfer pull (one per connection; _serve closes
+        after). Errors propagate to _serve's handler."""
+        transfer_id = body.decode()
+        staged = self._staged.get(transfer_id)
+        if staged is None or staged.started:
+            await self._send_header(writer, {"error": f"unknown transfer {transfer_id}"})
+            return
+        staged.started = True
+        staged.deadline = time.monotonic() + self.max_transfer_time
+        try:
+            await self._stream(staged, writer)
+        except (ConnectionError, asyncio.IncompleteReadError,
+                TimeoutError, asyncio.TimeoutError,
+                KvTransferError):  # asyncio.TimeoutError
+            # is distinct from builtin TimeoutError before 3.11;
+            # KvTransferError = streamed producer failed/stalled
+            self._unstage(staged, ok=False)
+            raise
+        self.transfers_served += 1
+        self._unstage(staged, ok=True)
 
     async def _serve_range(self, body: bytes, writer: asyncio.StreamWriter):
         """One ranged request -> one (k, v) frame. Ranged pulls are how a
@@ -358,6 +471,14 @@ class KvDataPlaneServer:
         if not (0 <= off and 0 < n and off + n <= staged.desc.n_pages):
             await self._send_header(writer, {"error": f"range out of bounds ({off},{n})"})
             return
+        if staged.available is not None and off + n > staged.available:
+            # ranged pulls (multi-host shards) don't ride streamed staging:
+            # refuse reads past the producer's watermark instead of
+            # serving uncommitted pages
+            await self._send_header(
+                writer, {"error": f"range past stream watermark ({off},{n})"}
+            )
+            return
         # a transfer being actively range-pulled is alive: refresh its clock
         staged.deadline = time.monotonic() + self.max_transfer_time
         np_dtype = _np_dtype(staged.desc.dtype)
@@ -384,19 +505,34 @@ class KvDataPlaneServer:
             await self._send_header(writer, {"error": f"bad block count {len(hashes)}"})
             return
         try:
-            # tier reads do host memcpy/disk IO: off the event loop
-            k, v = await asyncio.get_running_loop().run_in_executor(
-                None, self.kvbm_source.load_blocks, hashes
+            # tier reads do host memcpy/disk IO: off the event loop —
+            # EXCEPT small host-tier-only reads, where the executor
+            # round-trip costs more than the memcpy it protects against
+            # (admission-rate peer pulls of a few small blocks)
+            src = self.kvbm_source
+            small = (
+                getattr(src, "disk", None) is None
+                and getattr(src, "block_nbytes", 1 << 30) * len(hashes)
+                <= (256 << 10)
             )
+            if small:
+                k, v = src.load_blocks(hashes)
+            else:
+                k, v = await asyncio.get_running_loop().run_in_executor(
+                    None, src.load_blocks, hashes
+                )
         except KeyError as e:
             await self._send_header(writer, {"error": f"block miss: {e}"})
             return
         kb, vb = _np_bytes(k), _np_bytes(v)
-        await self._send_header(
-            writer,
+        # header + payload in ONE buffered write/drain: the pull RTT is
+        # admission latency on the peer, every syscall batch counts
+        hdr_body = msgpack.packb(
             {"n": len(hashes), "k_bytes": len(kb), "v_bytes": len(vb),
              "shape": list(k.shape), "dtype": str(k.dtype)},
+            use_bin_type=True,
         )
+        writer.write(_HDR.pack(_MAGIC, len(hdr_body)) + hdr_body)
         writer.write(kb)
         writer.write(vb)
         await asyncio.wait_for(writer.drain(), self.chunk_timeout)
@@ -417,6 +553,9 @@ class KvDataPlaneServer:
 
         async def get(off: int):
             n = min(desc.chunk_pages, desc.n_pages - off)
+            # streamed staging: hold until the producer commits these pages
+            # (no-op for fully-staged transfers)
+            await staged.wait_pages(off + n)
             k, v = await staged.extract(off, n, False)
             return off, n, np.asarray(k, np_dtype), np.asarray(v, np_dtype)
 
@@ -450,6 +589,77 @@ class KvDataPlaneServer:
             # slow-but-alive links are not reaped mid-pull
             staged.deadline = time.monotonic() + self.max_transfer_time
         await self._send_header(writer, {"eof": True})
+
+
+class _ConnPool:
+    """Keep-alive client connections to peer data planes. kvbm block
+    pulls are request-response at ADMISSION rate — paying a TCP connect
+    per onboarded request is pure overhead, so finished connections
+    return to a small per-addr pool (the server keeps ranged/kvbm
+    connections open, closing idle ones at its chunk timeout). Pools are
+    scoped PER EVENT LOOP (weak-keyed): a connection created under one
+    asyncio.run can never be handed to another loop, and a dead loop's
+    pool drops with it."""
+
+    def __init__(self, per_addr: int = 4):
+        import weakref
+
+        self._pools: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+        self.per_addr = per_addr
+
+    def _free_map(self) -> Dict[str, list]:
+        loop = asyncio.get_running_loop()
+        pools = self._pools.get(loop)
+        if pools is None:
+            pools = {}
+            self._pools[loop] = pools
+        return pools
+
+    def evict(self, addr: str):
+        """Close every pooled connection to `addr` (stale-server retry:
+        the whole pool is suspect, not just the one that failed)."""
+        for reader, writer in self._free_map().pop(addr, []):
+            writer.close()
+
+    async def acquire(self, addr: str, connect_timeout: float,
+                      fresh: bool = False):
+        """Returns (reader, writer, reused). `fresh=True` bypasses (and
+        evicts) the pool — the retry path after a stale keep-alive, where
+        popping another pooled connection would likely be just as stale."""
+        if fresh:
+            self.evict(addr)
+        else:
+            free = self._free_map().get(addr)
+            while free:
+                reader, writer = free.pop()
+                if writer.is_closing():
+                    continue
+                return reader, writer, True
+        host, port = addr.rsplit(":", 1)
+        try:
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(host, int(port)), connect_timeout
+            )
+        except (OSError, TimeoutError, asyncio.TimeoutError) as e:
+            # gaierror/refused/unroutable: the advertised addr stopped
+            # resolving — typed so callers fall back instead of crashing
+            raise KvTransferError(
+                f"kv data plane {addr} unreachable: {e}"
+            ) from e
+        _set_nodelay(writer)
+        return reader, writer, False
+
+    def release(self, addr: str, reader, writer):
+        if writer.is_closing():
+            return
+        free = self._free_map().setdefault(addr, [])
+        if len(free) >= self.per_addr:
+            writer.close()
+        else:
+            free.append((reader, writer))
+
+
+_CONN_POOL = _ConnPool()
 
 
 # inject(page_offset, n_pages, k, v) — awaited per chunk as it lands
@@ -525,39 +735,70 @@ async def pull_kvbm_blocks(
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Fetch tiered KV blocks by hash from a peer worker's data plane
     (distributed KVBM onboard; reference block_manager/distributed/
-    worker.rs:137). Returns (k, v) stacked [n, *block_shape]."""
-    host, port = addr.rsplit(":", 1)
-    reader, writer = await asyncio.wait_for(
-        asyncio.open_connection(host, int(port)), connect_timeout
-    )
-    try:
-        body = msgpack.packb(
-            {"blocks": [int(h) for h in hashes]}, use_bin_type=True
+    worker.rs:137). Returns (k, v) stacked [n, *block_shape]. Raises
+    KeyError on a block miss, KvTransferError on any transport failure
+    (unreachable peer, severed stream) — both convert to recompute in the
+    onboard path. Connections come from a keep-alive pool; a stale pooled
+    connection (server idled it out) earns exactly one fresh retry."""
+    f = faults.FAULTS
+    for attempt in (0, 1):
+        reader, writer, reused = await _CONN_POOL.acquire(
+            addr, connect_timeout, fresh=attempt > 0
         )
-        writer.write(_HDR.pack(_MAGIC_RANGE, len(body)) + body)
-        await writer.drain()
-        np_dtype = np.dtype(dtype)
-        expect = int(np.prod(block_shape)) * np_dtype.itemsize * len(hashes)
-        hdr = await asyncio.wait_for(reader.readexactly(_HDR.size), chunk_timeout)
-        magic, length = _HDR.unpack(hdr)
-        if magic != _MAGIC or length > 65536:
-            raise RuntimeError(f"bad kvbm frame (magic {magic:#x})")
-        header = msgpack.unpackb(
-            await asyncio.wait_for(reader.readexactly(length), chunk_timeout),
-            raw=False,
-        )
-        if header.get("error"):
-            raise KeyError(f"kvbm pull refused: {header['error']}")
-        if header["k_bytes"] > expect or header["v_bytes"] > expect:
-            raise RuntimeError("kvbm frame larger than expected")
-        k_raw = await asyncio.wait_for(reader.readexactly(header["k_bytes"]), chunk_timeout)
-        v_raw = await asyncio.wait_for(reader.readexactly(header["v_bytes"]), chunk_timeout)
-        shape = (len(hashes), *block_shape)
-        k = np.frombuffer(k_raw, dtype=np_dtype).reshape(shape)
-        v = np.frombuffer(v_raw, dtype=np_dtype).reshape(shape)
-        return k, v
-    finally:
-        writer.close()
+        try:
+            body = msgpack.packb(
+                {"blocks": [int(h) for h in hashes]}, use_bin_type=True
+            )
+            writer.write(_HDR.pack(_MAGIC_RANGE, len(body)) + body)
+            await writer.drain()
+            if f.enabled and await f.on("kv_transfer.pull") == "sever":
+                # mid-peer-onboard sever (dynochaos): the request is on
+                # the wire but we drop the connection before the payload
+                # lands — the onboard path must fall back to local-tier/
+                # recompute with a counted fallback, never a hung or
+                # corrupted stream
+                raise KvTransferError("injected: kvbm peer pull severed")
+            np_dtype = np.dtype(dtype)
+            expect = int(np.prod(block_shape)) * np_dtype.itemsize * len(hashes)
+            hdr = await asyncio.wait_for(reader.readexactly(_HDR.size), chunk_timeout)
+            magic, length = _HDR.unpack(hdr)
+            if magic != _MAGIC or length > 65536:
+                raise RuntimeError(f"bad kvbm frame (magic {magic:#x})")
+            header = msgpack.unpackb(
+                await asyncio.wait_for(reader.readexactly(length), chunk_timeout),
+                raw=False,
+            )
+            if header.get("error"):
+                # protocol-level refusal: the connection is still good
+                _CONN_POOL.release(addr, reader, writer)
+                raise KeyError(f"kvbm pull refused: {header['error']}")
+            if header["k_bytes"] > expect or header["v_bytes"] > expect:
+                raise RuntimeError("kvbm frame larger than expected")
+            # k and v are contiguous on the wire: one read, split by offset
+            raw = await asyncio.wait_for(
+                reader.readexactly(header["k_bytes"] + header["v_bytes"]),
+                chunk_timeout,
+            )
+            shape = (len(hashes), *block_shape)
+            k = np.frombuffer(
+                raw, dtype=np_dtype, count=header["k_bytes"] // np_dtype.itemsize
+            ).reshape(shape)
+            v = np.frombuffer(
+                raw, dtype=np_dtype, offset=header["k_bytes"]
+            ).reshape(shape)
+            _CONN_POOL.release(addr, reader, writer)
+            return k, v
+        except KeyError:
+            raise
+        except (ConnectionError, asyncio.IncompleteReadError,
+                TimeoutError, asyncio.TimeoutError) as e:
+            writer.close()
+            if reused and attempt == 0:
+                continue  # stale keep-alive: the server idled it out
+            raise KvTransferError(f"kvbm peer pull from {addr} failed: {e}") from e
+        except BaseException:
+            writer.close()
+            raise
 
 
 async def finish_transfer(
@@ -601,14 +842,23 @@ async def pull_kv(
             off = 0
             while off < desc.n_pages:
                 if staged.finished:
-                    raise RuntimeError("transfer reaped mid-pull")
+                    raise KvTransferError("transfer reaped mid-pull")
                 n = min(desc.chunk_pages, desc.n_pages - off)
+                # streamed staging: the producer is still prefilling —
+                # hold at its watermark (no-op when fully staged)
+                await staged.wait_pages(off + n)
                 k, v = await staged.extract(off, n, True)
+                if staged.failed or staged.finished:
+                    # producer aborted while we extracted (its pages may
+                    # be recycled): never inject the chunk
+                    raise KvTransferError("transfer aborted mid-pull")
                 await inject(off, n, k, v)
                 if hasattr(k, "nbytes"):
                     staged.count_serve(k.nbytes + v.nbytes)
                 off += n
                 staged.deadline = time.monotonic() + staged.max_transfer_time
+            if staged.failed:
+                raise KvTransferError("transfer aborted mid-pull")
         except BaseException:
             staged.finish(False)
             raise
@@ -617,10 +867,20 @@ async def pull_kv(
         staged.finish(True)
         return
 
+    if desc.streamed:
+        # producer-paced: chunks arrive as prefill commits pages, so the
+        # inter-chunk gap is bounded by the producer's liveness budget,
+        # not the plain network chunk timeout
+        chunk_timeout = max(chunk_timeout, 120.0)
     host, port = desc.addr.rsplit(":", 1)
-    reader, writer = await asyncio.wait_for(
-        asyncio.open_connection(host, int(port)), connect_timeout
-    )
+    try:
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(host, int(port)), connect_timeout
+        )
+    except (OSError, TimeoutError, asyncio.TimeoutError) as e:
+        # gaierror/refused/unroutable: the advertised addr stopped
+        # resolving — typed so callers fall back instead of crashing
+        raise KvTransferError(f"kv data plane {desc.addr} unreachable: {e}") from e
     try:
         tid = desc.transfer_id.encode()
         writer.write(_HDR.pack(_MAGIC, len(tid)) + tid)

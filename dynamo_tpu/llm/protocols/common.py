@@ -81,6 +81,13 @@ class PreprocessedRequest:
     disagg_params: Optional[Dict[str, Any]] = None
     request_id: str = ""
     estimated_prefix_hit_num_blocks: Optional[int] = None
+    # cluster KV fabric holder hint (KvPushRouter → worker): the worker
+    # whose cache holds this request's longest prefix, per the router's
+    # radix index — {"instance": id, "blocks": matched}. The admission
+    # path uses it to pull those blocks from the holder's tiers over the
+    # KV data plane instead of recomputing (docs/kvbm.md); advisory only,
+    # a wrong/stale hint degrades to recompute.
+    kv_holder: Optional[Dict[str, Any]] = None
     embed: bool = False  # embeddings request: engine returns {"embedding": [...]}
     # multimodal content parts extracted from the chat request (reference
     # multimodal E/P/D protocol surface, components/backends/trtllm):
@@ -118,6 +125,8 @@ class PreprocessedRequest:
             d["disagg_params"] = self.disagg_params
         if self.estimated_prefix_hit_num_blocks is not None:
             d["estimated_prefix_hit_num_blocks"] = self.estimated_prefix_hit_num_blocks
+        if self.kv_holder is not None:
+            d["kv_holder"] = self.kv_holder
         if self.embed:
             d["embed"] = True
         if self.multimodal:

@@ -251,6 +251,22 @@ ENV_REGISTRY: tuple = (
            "in kvbm_offload_blocks_dropped) instead of stalling the "
            "step loop — offloads are cache copies, never correctness.",
            "kvbm/manager.py"),
+    EnvVar("DYN_KVBM_PEER_PULL", "bool", "1",
+           "Cluster KV fabric: let admission onboard blocks from a PEER "
+           "worker's G2/G3 tiers over the KV data plane (announcement "
+           "mesh owner, or the router's kv_holder hint), arbitrated by "
+           "the three-arm onboard budget — per-peer transfer-rate EWMA "
+           "vs local-tier load vs recompute. 0 = local tiers only "
+           "(pre-fabric behavior).",
+           "kvbm/manager.py"),
+    EnvVar("DYN_DISAGG_STREAM", "bool", "1",
+           "Streamed disagg prefill→decode handoff: the prefill worker "
+           "stages the transfer at ADMISSION and publishes KV chunks as "
+           "prefill commits pages, so the decode worker's pull overlaps "
+           "prefill compute and its first decode step dispatches as soon "
+           "as the last chunk + first token land. 0 = serial handoff "
+           "(descriptor ships only after prefill completes).",
+           "jax_worker/disagg_handler.py"),
     EnvVar("DYN_KVBM_EVICTION", "enum", "lru",
            "KVBM tier eviction policy: `lru`, `lfu`, or `prefix-aware` "
            "(protects blocks with live chained descendants in the same "

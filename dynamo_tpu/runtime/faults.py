@@ -92,6 +92,10 @@ KNOWN_FAULT_POINTS = {
         "`error` — MockEngine step loop; fail-all",
     "kv_transfer.chunk":
         "`sever` | `delay` — KV data-plane chunk serve; partial transfer",
+    "kv_transfer.pull":
+        "`sever` | `delay` — peer-side kvbm block pull (cluster KV "
+        "fabric onboard); `sever` drops the connection mid-pull and the "
+        "admission path falls back to local-tier/recompute, counted",
     "planner.scrape":
         "`error` | `hang` | `delay` — planner's frontend /metrics scrape; "
         "the planner retries with backoff and ages out stale observations",

@@ -57,6 +57,10 @@ GUARDED_STATE = {
     "KvBlockManager.disk_evictions": "lock:_lock",
     "KvBlockManager.dropped_blocks": "lock:_lock",
     "KvBlockManager._load_ms": "lock:_lock",
+    # cluster KV fabric: hashes dropped from ALL tiers pending their
+    # `evicted` mesh retraction — appended on the kvbm-tier thread's
+    # store path, drained wherever announcements fire.
+    "KvBlockManager._evicted_pending": "lock:_lock",
     # legacy inline offload count: bumped on the event loop, dropped in
     # the executor's done-callback thread.
     "KvbmConnector._pending": "lock:_pending_lock",
@@ -72,6 +76,11 @@ GUARDED_STATE = {
     "KvbmConnector.offload_gathers": "lock:_offload_cv",
     "KvbmConnector.offload_blocks_dropped": "lock:_offload_cv",
     "KvbmConnector.offload_failures": "lock:_offload_cv",
+    # per-source onboard decision counters (cluster KV fabric): bumped at
+    # admission on the event loop, read by stats() from any context.
+    "KvbmConnector.onboard_src_local_blocks": "lock:_offload_cv",
+    "KvbmConnector.onboard_src_peer_blocks": "lock:_offload_cv",
+    "KvbmConnector.onboard_src_recompute_blocks": "lock:_offload_cv",
     # engine decode pipeline: the step-loop task owns the in-flight block
     # queue and prefill-completion list; ROADMAP item 1's scheduler must
     # keep mutations inside the step loop (or take over this entry).

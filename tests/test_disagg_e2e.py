@@ -180,11 +180,17 @@ def test_disagg_matches_local_prefill(disagg_cluster):
         disc, lambda s: s.get("kv_pulls_completed", 0) > 0
     )
     assert stats["kv_pages_pulled"] > 0
+    # streamed handoff (docs/disagg_serving.md): the pull rode the
+    # EARLY-staged descriptor (DYN_DISAGG_STREAM defaults on), so the
+    # transfer overlapped the prefill worker's compute instead of
+    # serializing after it
+    assert stats.get("disagg_streamed_handoffs", 0) > 0, stats
     served = scrape_worker_stats(
         disc, lambda s: s.get("kv_transfers_served", 0) > 0,
         component="prefill",
     )
     assert served["kv_bytes_served"] > 0
+    assert served.get("kv_streamed_stages", 0) > 0, served
     from pathlib import Path
 
     assert "prefilling locally" not in Path("/tmp/dis_decode.log").read_text(

@@ -1,0 +1,714 @@
+"""Cluster KV fabric (ISSUE 11): peer-to-peer block onboarding + streamed
+disagg prefill→decode handoff, in-proc.
+
+Oracles are byte-identical greedy streams: every fabric path (streamed
+handoff, peer onboard, every fallback — sever, unreachable peer, aborted
+stream) must reproduce EXACTLY the tokens of a plain aggregated run on
+the same seeded params. The fabric is strictly an optimization.
+"""
+
+import asyncio
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine import EngineConfig, JaxEngine
+from dynamo_tpu.llm.kv_transfer import KvDataPlaneServer, KvTransferError
+from dynamo_tpu.llm.protocols import PreprocessedRequest
+from dynamo_tpu.models import llama
+from dynamo_tpu.runtime import faults
+from dynamo_tpu.runtime.engine import Context
+
+CFG = llama.LlamaConfig.tiny(dtype=jnp.float32)
+PAGE = 8
+PARAMS = llama.init_params(CFG, jax.random.PRNGKey(0))
+PROMPT = list(range(5, 69))  # 64 tokens = 8 pages of 8
+N_STEPS = 6
+
+
+def make_engine(**over):
+    cfg = dict(
+        model="tiny", max_num_seqs=4, page_size=PAGE, num_pages=64,
+        max_model_len=128, prefill_buckets=(16, 32), max_prefill_chunk=16,
+    )
+    cfg.update(over)
+    return JaxEngine(EngineConfig(**cfg), model_config=CFG, params=PARAMS)
+
+
+async def run_plain(engine, prompt=PROMPT, n_steps=N_STEPS, request_id="r"):
+    req = PreprocessedRequest(
+        token_ids=list(prompt), stop_conditions={"max_tokens": n_steps},
+        request_id=request_id,
+    ).to_dict()
+    toks = []
+    async for item in engine.generate(req, Context()):
+        data = item.get("data")
+        if data:
+            toks.extend(data["token_ids"])
+    return toks
+
+
+def oracle_tokens():
+    async def main():
+        eng = make_engine()
+        try:
+            return await run_plain(eng)
+        finally:
+            await eng.close()
+
+    return asyncio.run(main())
+
+
+async def drive_streamed_handoff(eng_p, eng_d, *, kv_stream=True,
+                                 sabotage=None):
+    """The disagg handler's streamed flow, driven engine-to-engine in one
+    process: prefill with kv_pull(+kv_stream), early pull on the decode
+    engine, first token attach, decode stream. Returns the full token
+    list the client would see. `sabotage(tid)` runs after the early
+    descriptor arrives (chaos hooks)."""
+    preq = PreprocessedRequest(
+        token_ids=list(PROMPT), stop_conditions={"max_tokens": 1},
+        disagg_params={"return_kv": True, "kv_pull": True,
+                       "kv_stream": kv_stream},
+        request_id="p1",
+    ).to_dict()
+    dreq = PreprocessedRequest(
+        token_ids=list(PROMPT), stop_conditions={"max_tokens": N_STEPS},
+        request_id="d1",
+    ).to_dict()
+    early = None
+    first_token = None
+    kv_payload = None
+    async for item in eng_p.generate(preq, Context()):
+        data = item.get("data")
+        if not data:
+            continue
+        kvp = data.get("kv_transfer_params")
+        if not kvp:
+            continue
+        if not data.get("token_ids"):
+            pull = kvp.get("pull") or {}
+            assert pull.get("streamed"), "early event must be streamed"
+            if early is None:
+                early = eng_d.begin_streamed_pull(dreq, Context(), pull)
+            if sabotage is not None:
+                sabotage(pull["transfer_id"])
+            continue
+        kv_payload = kvp
+        first_token = data["token_ids"][0]
+    assert first_token is not None and kv_payload is not None
+    toks = [first_token]
+    pull = kv_payload.get("pull") or {}
+    if early is not None and pull.get("transfer_id") == early.transfer_id:
+        early.set_first_token(first_token)
+        stream = early.stream()
+    else:
+        if early is not None:
+            early.abort()
+        if "pull" in kv_payload:
+            stream = eng_d.generate_decode_from_pull(
+                dreq, Context(), first_token, kv_payload["pull"]
+            )
+        else:
+            from dynamo_tpu.llm.disagg import unpack_kv_payload
+
+            kv_k, kv_v, n_tokens = unpack_kv_payload(kv_payload)
+            stream = eng_d.generate_decode_from_kv(
+                dreq, Context(), first_token, kv_k, kv_v, n_tokens
+            )
+    async for item in stream:
+        data = item.get("data")
+        if data:
+            toks.extend(data["token_ids"])
+    return toks
+
+
+class TestStreamedHandoff:
+    def test_streamed_handoff_parity_and_overlap(self):
+        """Streamed handoff: decode pulls chunks WHILE prefill runs, the
+        first token reaches the client before the final chunk lands, and
+        the stream is byte-identical to the aggregated oracle."""
+        want = oracle_tokens()
+
+        async def main():
+            eng_p, eng_d = make_engine(), make_engine()
+            dp = KvDataPlaneServer()
+            await dp.start()
+            eng_p.data_plane = dp
+            try:
+                got = await drive_streamed_handoff(eng_p, eng_d)
+                assert got == want, (got, want)
+                st_d = eng_d.stats()
+                assert st_d["disagg_streamed_handoffs"] == 1
+                # overlap evidence: chunks landed BEFORE the first-token
+                # event (prompt = 4 prefill chunks at max_prefill_chunk=16)
+                assert st_d["disagg_chunks_before_first_token"] > 0
+                # the acceptance signal: first token was client-bound
+                # while the KV tail was still in flight
+                assert st_d["disagg_first_token_before_last_chunk"] == 1
+                assert st_d["disagg_streamed_handoff_ratio"] > 0
+                st_p = eng_p.stats()
+                assert st_p["kv_streamed_stages"] == 1
+                assert st_p["kv_streamed_fallbacks"] == 0
+                # prefill-side pages released after the pull (on_done)
+                for _ in range(100):
+                    if all(s is None for s in eng_p.slots):
+                        break
+                    await asyncio.sleep(0.02)
+                assert all(s is None for s in eng_p.slots)
+            finally:
+                await eng_p.close()
+                await eng_d.close()
+                await dp.close()
+
+        asyncio.run(main())
+
+    def test_serial_handoff_unchanged_and_byte_identical(self):
+        """kv_stream off: exactly the pre-fabric serial flow (no early
+        event, descriptor ships with the first token), same bytes."""
+        want = oracle_tokens()
+
+        async def main():
+            eng_p, eng_d = make_engine(), make_engine()
+            dp = KvDataPlaneServer()
+            await dp.start()
+            eng_p.data_plane = dp
+            try:
+                got = await drive_streamed_handoff(
+                    eng_p, eng_d, kv_stream=False
+                )
+                assert got == want
+                assert eng_p.stats()["kv_streamed_stages"] == 0
+                assert eng_d.stats()["disagg_streamed_handoffs"] == 0
+            finally:
+                await eng_p.close()
+                await eng_d.close()
+                await dp.close()
+
+        asyncio.run(main())
+
+    def test_severed_stream_falls_back_serial_byte_identical(self):
+        """The early stage dies mid-prefill (reap/abort): the prefill
+        worker re-stages a fresh SERIAL transfer at emit, the decode side
+        abandons the stale early pull, and the client still gets the
+        oracle bytes — never a hung or corrupted stream."""
+        want = oracle_tokens()
+
+        async def main():
+            eng_p, eng_d = make_engine(), make_engine()
+            dp = KvDataPlaneServer()
+            await dp.start()
+            eng_p.data_plane = dp
+
+            def sabotage(tid):
+                # reaper-equivalent: the streamed transfer dies at source
+                dp.abort_streamed(tid)
+
+            try:
+                got = await drive_streamed_handoff(
+                    eng_p, eng_d, sabotage=sabotage
+                )
+                assert got == want, (got, want)
+                st_p = eng_p.stats()
+                assert st_p["kv_streamed_stages"] == 1
+                assert st_p["kv_streamed_fallbacks"] == 1
+                for _ in range(100):
+                    if all(s is None for s in eng_p.slots):
+                        break
+                    await asyncio.sleep(0.02)
+                assert all(s is None for s in eng_p.slots)
+            finally:
+                await eng_p.close()
+                await eng_d.close()
+                await dp.close()
+
+        asyncio.run(main())
+
+
+def _mesh_pair():
+    """Two engines with KVBM tiers joined to one discovery plane; returns
+    (server, drts, engines, dists, planes)."""
+    from dynamo_tpu.kvbm import KvbmDistributed
+    from dynamo_tpu.runtime import (
+        DiscoveryServer,
+        DistributedRuntime,
+        RuntimeConfig,
+    )
+
+    async def build():
+        server = DiscoveryServer(port=0)
+        _, port = await server.start()
+        cfg = RuntimeConfig(discovery_endpoint=f"127.0.0.1:{port}")
+        drts, engines, dists, planes = [], [], [], []
+        for _ in range(2):
+            drt = await DistributedRuntime.create(cfg)
+            eng = make_engine(kvbm_host_blocks=32)
+            dpl = KvDataPlaneServer()
+            await dpl.start()
+            await dpl.register(drt)
+            dist = KvbmDistributed(drt, eng.kvbm, dpl, "ns", "kvbm",
+                                   drt.instance_id)
+            await dist.start()
+            drts.append(drt)
+            engines.append(eng)
+            dists.append(dist)
+            planes.append(dpl)
+        return server, drts, engines, dists, planes
+
+    return build
+
+
+async def _teardown_mesh(server, drts, engines, dists, planes):
+    for eng in engines:
+        await eng.close()
+    for d in dists:
+        await d.close()
+    for p in planes:
+        await p.close()
+    for drt in drts:
+        await drt.close()
+    await server.stop()
+
+
+class TestPeerOnboard:
+    def test_holder_hint_pulls_without_announcements(self):
+        """The router's kv_holder hint alone routes the pull: worker B has
+        NO mirrored announcements (late joiner whose mesh is cold), but
+        the request carries (holder=A, blocks) — B pulls A's blocks over
+        the data plane and reproduces A's greedy tokens exactly."""
+        build = _mesh_pair()
+
+        async def main():
+            server, drts, engines, dists, planes = await build()
+            eng_a, eng_b = engines
+            dist_a, dist_b = dists
+            try:
+                want = await run_plain(eng_a, request_id="a1")
+                # wait for offloads to land in A's host tier
+                for _ in range(200):
+                    await asyncio.sleep(0.02)
+                    if eng_a.kvbm.manager.stats().get(
+                        "kvbm_offloaded_blocks", 0
+                    ) >= 8 and drts[0].instance_id in dist_b._addrs:
+                        break
+                # simulate a cold mesh on B: drop everything it mirrored,
+                # keep only the data-plane addr book
+                dist_b._owners.clear()
+                req = PreprocessedRequest(
+                    token_ids=list(PROMPT),
+                    stop_conditions={"max_tokens": N_STEPS},
+                    kv_holder={"instance": drts[0].instance_id, "blocks": 8},
+                    request_id="b1",
+                ).to_dict()
+                toks = []
+                async for item in eng_b.generate(req, Context()):
+                    data = item.get("data")
+                    if data:
+                        toks.extend(data["token_ids"])
+                assert toks == want, (toks, want)
+                assert dist_b.remote_blocks_pulled >= 7, dist_b.stats()
+                st = eng_b.kvbm.stats()
+                assert st["kvbm_onboard_src_peer_blocks"] >= 7
+                assert st["kvbm_peer_bytes_pulled"] > 0
+            finally:
+                await _teardown_mesh(server, drts, engines, dists, planes)
+
+        asyncio.run(main())
+
+    def test_peer_pull_sever_falls_back_byte_identical(self):
+        """kv_transfer.pull sever mid-peer-onboard: the pull dies on the
+        wire, the admission path recomputes the span, the stream is
+        byte-identical to the peer-on path, and the fallback is counted —
+        never a hung or corrupted stream."""
+        build = _mesh_pair()
+        want = oracle_tokens()
+
+        async def main():
+            server, drts, engines, dists, planes = await build()
+            eng_a, eng_b = engines
+            dist_b = dists[1]
+            try:
+                await run_plain(eng_a, request_id="a1")
+                for _ in range(200):
+                    await asyncio.sleep(0.02)
+                    if len(dist_b._owners) >= 7 and dist_b._addrs:
+                        break
+                assert len(dist_b._owners) >= 7, "announcements never mirrored"
+                faults.configure("kv_transfer.pull:sever", seed=1)
+                try:
+                    toks = await run_plain(eng_b, request_id="b1")
+                finally:
+                    faults.reset()
+                assert toks == want, (toks, want)
+                assert dist_b.remote_pull_failures >= 1, dist_b.stats()
+                assert dist_b.remote_blocks_pulled == 0
+            finally:
+                await _teardown_mesh(server, drts, engines, dists, planes)
+
+        asyncio.run(main())
+
+    def test_peer_off_parity(self):
+        """DYN_KVBM_PEER_PULL=0: the fabric is inert (no pulls), bytes
+        identical — the peer-on/peer-off parity arm."""
+        build = _mesh_pair()
+        want = oracle_tokens()
+        os.environ["DYN_KVBM_PEER_PULL"] = "0"
+        try:
+
+            async def main():
+                server, drts, engines, dists, planes = await build()
+                eng_a, eng_b = engines
+                dist_b = dists[1]
+                try:
+                    await run_plain(eng_a, request_id="a1")
+                    for _ in range(150):
+                        await asyncio.sleep(0.02)
+                        if len(dist_b._owners) >= 7:
+                            break
+                    toks = await run_plain(eng_b, request_id="b1")
+                    assert toks == want
+                    assert dist_b.remote_blocks_pulled == 0
+                finally:
+                    await _teardown_mesh(server, drts, engines, dists, planes)
+
+            asyncio.run(main())
+        finally:
+            os.environ.pop("DYN_KVBM_PEER_PULL", None)
+
+    def test_unresolvable_peer_addr_is_typed_and_recomputes(self):
+        """A peer whose advertised addr stops resolving mid-pull surfaces
+        a typed KvTransferError from the data plane, which the onboard
+        path converts to a KeyError → recompute fallback — never an
+        unhandled ConnectionError in the step loop."""
+        from dynamo_tpu.llm.kv_transfer import pull_kvbm_blocks
+
+        async def main():
+            with pytest.raises(KvTransferError):
+                await pull_kvbm_blocks(
+                    "definitely-not-a-real-host.invalid:19999", [1, 2],
+                    (2, 8, 2, 4), np.float32, connect_timeout=2.0,
+                )
+
+        asyncio.run(main())
+
+    def test_unresolvable_peer_in_onboard_path_recomputes(self):
+        """End-to-end: the holder hint points at an addr that no longer
+        resolves — admission probes onto it, the pull fails typed, and
+        the request recomputes to the oracle bytes."""
+        build = _mesh_pair()
+        want = oracle_tokens()
+
+        async def main():
+            server, drts, engines, dists, planes = await build()
+            eng_a, eng_b = engines
+            dist_b = dists[1]
+            try:
+                await run_plain(eng_a, request_id="a1")
+                for _ in range(200):
+                    await asyncio.sleep(0.02)
+                    if len(dist_b._owners) >= 7 and dist_b._addrs:
+                        break
+                # the peer's advertised addr goes stale (descriptor-reap
+                # edge): every owner now points at a dead name
+                for inst in list(dist_b._addrs):
+                    dist_b._addrs[inst] = "no-such-host.invalid:19999"
+                toks = await run_plain(eng_b, request_id="b1")
+                assert toks == want, (toks, want)
+                assert dist_b.remote_pull_failures >= 1
+            finally:
+                await _teardown_mesh(server, drts, engines, dists, planes)
+
+        asyncio.run(main())
+
+
+class TestMeshStaleOwners:
+    def test_evicted_blocks_retract_from_mesh(self):
+        """Capped tiers: blocks that fall off A's tier chain entirely are
+        retracted (`evicted` announcement) so B stops probing onto them."""
+        build = _mesh_pair()
+
+        async def main():
+            server, drts, engines, dists, planes = await build()
+            eng_a, eng_b = engines
+            dist_b = dists[1]
+            try:
+                await run_plain(eng_a, request_id="a1")
+                for _ in range(200):
+                    await asyncio.sleep(0.02)
+                    if len(dist_b._owners) >= 7:
+                        break
+                held = set(dist_b._owners)
+                # squeeze A's host tier: storing fresh blocks drops the
+                # oldest chain (host cap 32, no disk tier)
+                mgr = eng_a.kvbm.manager
+                shape = mgr.block_shape
+                for i in range(40):
+                    mgr.store(
+                        10_000 + i,
+                        np.zeros(shape, np.float32),
+                        np.zeros(shape, np.float32),
+                    )
+                evicted = mgr.drain_evicted()
+                assert evicted, "cap overflow must report dropped hashes"
+                dists[0].announce("evicted", evicted)
+                dropped_known = held & set(int(h) for h in evicted)
+                assert dropped_known, "some mirrored hash must have dropped"
+                for _ in range(150):
+                    await asyncio.sleep(0.02)
+                    if not any(h in dist_b._owners for h in dropped_known):
+                        break
+                for h in dropped_known:
+                    assert h not in dist_b._owners, (
+                        "stale owner entry survived eviction retraction"
+                    )
+            finally:
+                await _teardown_mesh(server, drts, engines, dists, planes)
+
+        asyncio.run(main())
+
+    def test_sync_reply_replaces_not_unions(self):
+        """Worker churn regression: a fresh worker's sync_request is
+        answered with a REPLACE-set (`sync`) — hashes A evicted between
+        announcements must not resurrect in the joiner's owner map, and a
+        stale pre-churn entry on an existing mirror is dropped by the
+        replace."""
+        build = _mesh_pair()
+
+        async def main():
+            server, drts, engines, dists, planes = await build()
+            dist_a, dist_b = dists
+            inst_a = drts[0].instance_id
+            try:
+                # A really holds 111 and 222, and announces them
+                mgr = engines[0].kvbm.manager
+                shape = mgr.block_shape
+                mgr.store(111, np.zeros(shape, np.float32),
+                          np.zeros(shape, np.float32))
+                mgr.store(222, np.zeros(shape, np.float32),
+                          np.zeros(shape, np.float32))
+                dist_a.announce("stored", [111, 222])
+                for _ in range(150):
+                    await asyncio.sleep(0.02)
+                    if (
+                        inst_a in dist_b._owners.get(111, set())
+                        and inst_a in dist_b._owners.get(222, set())
+                    ):
+                        break
+                assert inst_a in dist_b._owners.get(111, set())
+                assert inst_a in dist_b._owners.get(222, set())
+                # 222 falls out of A's tiers WITHOUT a retraction landing
+                # (the missed-eviction gap): B's mirror is now stale
+                mgr.clear()
+                mgr.store(111, np.zeros(shape, np.float32),
+                          np.zeros(shape, np.float32))
+                assert inst_a in dist_b._owners.get(222, set())  # stale
+                # a churned worker's sync_request makes A re-announce its
+                # CURRENT full set — the reply must REPLACE A's owner
+                # entries, not union onto the stale mirror
+                dist_b.announce("sync_request", [])
+                for _ in range(150):
+                    await asyncio.sleep(0.02)
+                    if inst_a not in dist_b._owners.get(222, set()):
+                        break
+                assert inst_a in dist_b._owners.get(111, set())
+                assert inst_a not in dist_b._owners.get(222, set()), (
+                    "evicted-then-reannounced hash resurrected a stale "
+                    "owner entry"
+                )
+            finally:
+                await _teardown_mesh(server, drts, engines, dists, planes)
+
+        asyncio.run(main())
+
+
+class TestOnboardBudget:
+    """Three-arm cost model units: local-tier load vs per-peer transfer
+    EWMA vs recompute — the cheapest source wins, cold sources never
+    defer (docs/kvbm.md cluster KV fabric)."""
+
+    def _connector(self):
+        from dynamo_tpu.kvbm.manager import KvBlockManager, KvbmConfig, KvbmConnector
+
+        mgr = KvBlockManager(KvbmConfig(host_blocks=8), (2, 8, 2, 4), np.float32)
+        return KvbmConnector(engine=None, manager=mgr), mgr
+
+    def _warm_local(self, mgr, hashes, ms_per_block):
+        shape = mgr.block_shape
+        for h in hashes:
+            mgr.store(h, np.zeros(shape, np.float32), np.zeros(shape, np.float32))
+        mgr._load_ms["host"] = ms_per_block
+
+    class _FakeDist:
+        def __init__(self, owned, ms_per_block):
+            self.owned = set(owned)
+            self.ms = ms_per_block
+
+        def extend_prefix(self, hashes, hint_instance=None, hint_blocks=0):
+            out = []
+            for i, h in enumerate(hashes):
+                if h in self.owned or i < hint_blocks:
+                    out.append(h)
+                else:
+                    break
+            return out
+
+        def estimate_pull_ms(self, hashes, hint_instance=None):
+            if self.ms is None:
+                return None
+            if not all(h in self.owned for h in hashes):
+                return None
+            return self.ms * len(hashes)
+
+    def test_cold_everything_never_defers(self):
+        conn, mgr = self._connector()
+        self._warm_local(mgr, [1, 2], None)  # blocks present, EWMA cold
+        kept, decision = conn.budget_onboard([1, 2], 10.0, 5.0)
+        assert kept == [1, 2] and decision == "full"
+
+    def test_fifo_headroom_none_counts_sources_only(self):
+        conn, mgr = self._connector()
+        self._warm_local(mgr, [1, 2], 100.0)
+        conn.distributed = self._FakeDist({3}, 100.0)
+        kept, decision = conn.budget_onboard([1, 2, 3], None, 1.0)
+        assert kept == [1, 2, 3] and decision == "full"
+        assert conn.onboard_src_local_blocks == 2
+        assert conn.onboard_src_peer_blocks == 1
+
+    def test_slow_peer_trims_to_local_prefix(self):
+        """Peer tail blows the headroom and recompute of the tail is
+        cheaper: keep the cheap local prefix, recompute the peer span."""
+        conn, mgr = self._connector()
+        self._warm_local(mgr, [1, 2], 1.0)  # local: 1 ms/block
+        conn.distributed = self._FakeDist({3, 4}, 500.0)  # slow peer
+        kept, decision = conn.budget_onboard([1, 2, 3, 4], 50.0, 10.0)
+        assert decision == "trim-local"
+        assert kept == [1, 2]
+        assert conn.onboard_src_recompute_blocks == 2
+        assert conn.onboard_recompute_fallbacks == 1
+
+    def test_fast_peer_within_headroom_keeps_full(self):
+        conn, mgr = self._connector()
+        self._warm_local(mgr, [1, 2], 1.0)
+        conn.distributed = self._FakeDist({3, 4}, 2.0)
+        kept, decision = conn.budget_onboard([1, 2, 3, 4], 50.0, 10.0)
+        assert decision == "full" and kept == [1, 2, 3, 4]
+        assert conn.onboard_src_peer_blocks == 2
+
+    def test_everything_slow_recomputes_entirely(self):
+        conn, mgr = self._connector()
+        self._warm_local(mgr, [1, 2], 400.0)
+        conn.distributed = self._FakeDist({3}, 500.0)
+        kept, decision = conn.budget_onboard([1, 2, 3], 50.0, 2.0)
+        assert decision == "recompute" and kept == []
+        assert conn.onboard_src_recompute_blocks == 3
+
+    def test_blown_headroom_without_recompute_estimate_keeps_onboard(self):
+        """Cold cost model: a blowout with no recompute observation keeps
+        the onboard — we can't prove any alternative cheaper."""
+        conn, mgr = self._connector()
+        self._warm_local(mgr, [1, 2], 400.0)
+        kept, decision = conn.budget_onboard([1, 2], 50.0, None)
+        assert decision == "full" and kept == [1, 2]
+
+    def test_peer_disabled_makes_remote_unknown(self):
+        conn, mgr = self._connector()
+        conn.peer_pull = False
+        self._warm_local(mgr, [1], 1.0)
+        conn.distributed = self._FakeDist({2}, 1.0)
+        kept, decision = conn.budget_onboard([1, 2], 50.0, 10.0)
+        # peer arm off: the remote block's cost is unknown -> no defer
+        assert decision == "full" and kept == [1, 2]
+
+
+class TestAbortedPullCacheHygiene:
+    def test_aborted_early_pull_does_not_poison_prefix_cache(self):
+        """An abandoned early pull releases a decode slot whose pages were
+        only partially injected — releasing it must NOT publish those
+        prompt blocks into the prefix cache (or KVBM/mesh): a follow-up
+        same-prefix request would silently reuse garbage KV. Regression
+        for the _commit_generated_blocks guard (generated == 0)."""
+        want = oracle_tokens()
+
+        async def main():
+            eng_p, eng_d = make_engine(), make_engine()
+            dp = KvDataPlaneServer()
+            await dp.start()
+            eng_p.data_plane = dp
+            try:
+                preq = PreprocessedRequest(
+                    token_ids=list(PROMPT), stop_conditions={"max_tokens": 1},
+                    disagg_params={"return_kv": True, "kv_pull": True,
+                                   "kv_stream": True},
+                    request_id="p1",
+                ).to_dict()
+                dreq = PreprocessedRequest(
+                    token_ids=list(PROMPT),
+                    stop_conditions={"max_tokens": N_STEPS},
+                    request_id="d1",
+                ).to_dict()
+                early = None
+                async for item in eng_p.generate(preq, Context()):
+                    data = item.get("data") or {}
+                    kvp = data.get("kv_transfer_params")
+                    if kvp and not data.get("token_ids") and early is None:
+                        early = eng_d.begin_streamed_pull(
+                            dreq, Context(), kvp["pull"]
+                        )
+                        # let some chunks inject, then abandon mid-pull
+                        # (the handler's abort path: prefill stream died)
+                        await asyncio.sleep(0.3)
+                        early.abort()
+                assert early is not None
+                # the decode engine's prefix cache must be clean: the same
+                # prompt served plainly reproduces the oracle bytes
+                got = await run_plain(eng_d, request_id="d2")
+                assert got == want, (got, want)
+            finally:
+                await eng_p.close()
+                await eng_d.close()
+                await dp.close()
+
+        asyncio.run(main())
+
+
+def test_late_first_token_still_resolves_detached_future():
+    """Race regression: _pull_kv_task detaches slot.first_token_fut
+    before awaiting it; a set_first_token/abort arriving AFTER the detach
+    (last chunk landed before the handler processed the final event) must
+    still resolve the future via the handle's own reference — or the
+    pull task awaits forever with the slot pinned."""
+
+    async def main():
+        eng = make_engine()
+        try:
+            dreq = PreprocessedRequest(
+                token_ids=list(PROMPT), stop_conditions={"max_tokens": 2},
+                request_id="d1",
+            ).to_dict()
+            desc = {"n_tokens": len(PROMPT), "transfer_id": "tid-x"}
+            handle = eng.begin_streamed_pull(dreq, Context(), desc)
+            slot = handle._slot
+            # keep the step loop from admitting/pulling the bogus desc:
+            # this test drives the future plumbing directly
+            eng._waiting.remove(slot)
+            waiter = asyncio.ensure_future(eng._await_first_token(slot))
+            await asyncio.sleep(0.01)
+            assert slot.first_token_fut is None  # detached
+            handle.set_first_token(42)  # late resolve must still land
+            assert await asyncio.wait_for(waiter, 2.0) == 42
+
+            # same for a late abort
+            handle2 = eng.begin_streamed_pull(dreq, Context(), desc)
+            slot2 = handle2._slot
+            eng._waiting.remove(slot2)
+            waiter2 = asyncio.ensure_future(eng._await_first_token(slot2))
+            await asyncio.sleep(0.01)
+            handle2.abort()
+            assert await asyncio.wait_for(waiter2, 2.0) is None
+        finally:
+            await eng.close()
+
+    asyncio.run(main())

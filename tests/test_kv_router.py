@@ -542,3 +542,84 @@ def test_approx_indexer_refresh_survives_older_expiry():
     assert idx.find_matches_for_tokens(toks).scores == {7: 4}
     time.sleep(0.2)  # t=0.55: refresh expired too
     assert idx.find_matches_for_tokens(toks).scores == {}
+
+
+def test_kv_holder_hint_ships_with_request():
+    """Cluster KV fabric (docs/kvbm.md): when another worker holds a
+    strictly longer cached prefix than the chosen one, generate() ships
+    (holder, matched_blocks) with the request so the chosen worker can
+    pull those blocks from the holder's tiers instead of recomputing —
+    and ships nothing when the chosen worker IS the best holder."""
+    import asyncio
+
+    from dynamo_tpu.llm.kv_router import KvPushRouter, KvRouterConfig
+
+    class _Comp:
+        namespace, name = "dynamo", "backend"
+
+    class _Ep:
+        component = _Comp()
+        subject = "dynamo.backend.generate"
+
+    class _Client:
+        endpoint = _Ep()
+        sent = None
+
+        def instance_ids(self):
+            return [11, 22]
+
+        def ready_instance_ids(self):
+            return self.instance_ids()
+
+        async def direct(self, request, worker, context):
+            _Client.sent = (dict(request), worker)
+
+            async def _empty():
+                return
+                yield
+
+            return _empty()
+
+    class _Drt:
+        discovery = None
+
+    async def main():
+        # overlap weight tiny: load dominates, so the router picks the
+        # UNLOADED worker 22 even though 11 holds the whole prefix
+        r = KvPushRouter(
+            _Drt(), _Client(),
+            KvRouterConfig(use_kv_events=True, router_temperature=0.0,
+                           overlap_score_weight=0.01),
+            block_size=4,
+        )
+        toks = list(range(16))
+        r._inflight_overlay.process_routing_decision_for_request(toks, 11)
+        # pile potential load onto 11 so 22 wins the schedule
+        r.scheduler.add_request("busy-1", 11, 1000)
+        stream = await r.generate(
+            {"token_ids": toks, "request_id": "q1"}, None
+        )
+        async for _ in stream:
+            pass
+        req, worker = _Client.sent
+        assert worker == 22
+        assert req["kv_holder"] == {"instance": 11, "blocks": 4}, req
+
+        # chosen worker == best holder: no hint rides along
+        r2 = KvPushRouter(
+            _Drt(), _Client(),
+            KvRouterConfig(use_kv_events=True, router_temperature=0.0,
+                           overlap_score_weight=2.0),
+            block_size=4,
+        )
+        r2._inflight_overlay.process_routing_decision_for_request(toks, 11)
+        stream = await r2.generate(
+            {"token_ids": toks, "request_id": "q2"}, None
+        )
+        async for _ in stream:
+            pass
+        req2, worker2 = _Client.sent
+        assert worker2 == 11
+        assert "kv_holder" not in req2, req2
+
+    asyncio.run(main())
