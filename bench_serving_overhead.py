@@ -212,6 +212,151 @@ async def run_bench(args, extra_env=None) -> dict:
     }
 
 
+async def overload_stream(sess, base: str, idx: int, osl: int) -> dict:
+    """One streaming chat completion under the admission gate: a 429 is a
+    clean rejection (Retry-After recorded), a 200 stream is checked for
+    completeness (finish chunk + full token count — a mid-stream kill
+    shows up as a truncation here)."""
+    body = {
+        "model": "bench-model",
+        "messages": [{"role": "user", "content":
+                      f"overload bench prompt {idx} " + "q" * 48}],
+        "stream": True,
+        "max_tokens": osl,
+        "stream_options": {"include_usage": True},
+    }
+    t0 = time.monotonic()
+    out = {"rejected": False, "retry_after": None, "ttft_s": None,
+           "tokens": 0, "finished": False, "error": None}
+    try:
+        async with sess.post(base + "/v1/chat/completions", json=body) as resp:
+            if resp.status == 429:
+                out["rejected"] = True
+                out["retry_after"] = resp.headers.get("Retry-After")
+                await resp.read()
+                return out
+            if resp.status != 200:
+                out["error"] = f"HTTP {resp.status}"
+                await resp.read()
+                return out
+            async for raw in resp.content:
+                line = raw.decode().strip()
+                if not line.startswith("data: ") or line == "data: [DONE]":
+                    continue
+                chunk = json.loads(line[6:])
+                if chunk.get("usage"):
+                    out["tokens"] = chunk["usage"]["completion_tokens"]
+                    continue
+                for ch in chunk.get("choices") or []:
+                    if (ch.get("delta") or {}).get("content") and \
+                            out["ttft_s"] is None:
+                        out["ttft_s"] = time.monotonic() - t0
+                    if ch.get("finish_reason"):
+                        out["finished"] = True
+    except Exception as e:  # noqa: BLE001 — recorded, judged by the gate
+        out["error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
+async def _paced_load(sess, base: str, qps: float, duration_s: float,
+                      osl: int, tag: int) -> list:
+    tasks = []
+    t0 = time.monotonic()
+    n = max(1, int(round(qps * duration_s)))
+    gap = 1.0 / max(qps, 1e-9)
+    for k in range(n):
+        delay = t0 + k * gap - time.monotonic()
+        if delay > 0:
+            await asyncio.sleep(delay)
+        tasks.append(asyncio.create_task(
+            overload_stream(sess, base, tag * 10_000 + k, osl)))
+    return list(await asyncio.gather(*tasks))
+
+
+def _goodput(results: list, window_s: float, slo_s: float) -> float:
+    """SLA-attained tok/s over the offered window (the planner/soak
+    goodput definition, docs/overload.md)."""
+    attained = [r for r in results
+                if r["finished"] and not r["rejected"]
+                and r["ttft_s"] is not None and r["ttft_s"] <= slo_s]
+    return sum(r["tokens"] for r in attained) / max(window_s, 1e-9)
+
+
+async def run_overload_bench(args) -> dict:
+    """Ramp offered load past a deliberately small-capacity mocker fleet
+    with the admission gate live: at-capacity arm, then a ~10x burst.
+    The gate must keep SLA-attained tok/s from collapsing, reject with
+    429 + Retry-After before tokenization, and never kill a stream
+    mid-flight (docs/overload.md)."""
+    import aiohttp
+
+    http_port = free_port()
+    disc = f"tcp://127.0.0.1:{free_port()}"
+    gate_env = {
+        "DYN_GATE": "1",
+        "DYN_GATE_TTFT_MS": str(args.overload_ttft_ms),
+        "DYN_GATE_TTFT_HEADROOM": "1.0",
+        "DYN_GATE_MAX_WAIT_MS": "300",
+        "DYN_GATE_MAX_QUEUE": "16",
+    }
+    procs = [
+        spawn(
+            ["-m", "dynamo_tpu.frontend", "--http-port", str(http_port),
+             "--embed-discovery", "--discovery", disc],
+            "overload_frontend", env=gate_env,
+        ),
+        # deliberately tiny capacity: 2 decode slots at ~32ms/step — the
+        # burst below is ~10x what this fleet can serve
+        spawn(
+            ["-m", "dynamo_tpu.mocker", "--model-name", "bench-model",
+             "--discovery", disc, "--speedup-ratio", "0.25",
+             "--max-num-seqs", "2", "--block-size", "16"],
+            "overload_mocker",
+        ),
+    ]
+    base = f"http://127.0.0.1:{http_port}"
+    osl = 16
+    try:
+        await wait_ready(base)
+        conn = aiohttp.TCPConnector(limit=256)
+        async with aiohttp.ClientSession(connector=conn) as sess:
+            capacity = await _paced_load(
+                sess, base, qps=3.0, duration_s=6.0, osl=osl, tag=1)
+            surge = await _paced_load(
+                sess, base, qps=30.0, duration_s=3.0, osl=osl, tag=2)
+            # let the admitted tail drain before teardown
+            await asyncio.sleep(2.0)
+    finally:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+    slo_s = args.overload_slo_ms / 1000.0
+    rejected = [r for r in surge if r["rejected"]]
+    served = [r for r in capacity + surge if not r["rejected"]]
+    kills = [r for r in served if not r["finished"] or r["tokens"] != osl
+             or r["error"]]
+    g_cap = _goodput(capacity, 6.0, slo_s)
+    g_surge = _goodput(surge, 3.0, slo_s)
+    return {
+        "capacity_requests": len(capacity),
+        "surge_requests": len(surge),
+        "surge_rejected": len(rejected),
+        "rejections_with_retry_after": sum(
+            1 for r in rejected
+            if r["retry_after"] and int(r["retry_after"]) >= 1),
+        "mid_stream_kills": len(kills),
+        "kill_detail": [r["error"] for r in kills[:5]],
+        "goodput_capacity_tok_s": round(g_cap, 1),
+        "goodput_surge_tok_s": round(g_surge, 1),
+        "goodput_retention": round(g_surge / g_cap, 3) if g_cap else None,
+    }
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--streams", type=int, default=8,
@@ -247,7 +392,48 @@ def main():
     ap.add_argument("--sla-tok-frac", type=float, default=0.85,
                     help="sla arm tok/s must stay above this fraction of "
                     "the fifo arm")
+    # overload smoke (dynogate, docs/overload.md): offered load ramps to
+    # ~10x a deliberately tiny fleet's capacity; gate on goodput retention,
+    # clean 429s with Retry-After, and zero mid-stream kills
+    ap.add_argument("--overload-smoke", action="store_true",
+                    help="CI gate: at-capacity arm then a ~10x burst with "
+                    "the admission gate live; exit 1 if goodput retention "
+                    "drops below --overload-retention, any served stream "
+                    "is killed mid-flight, or no 429s were issued")
+    ap.add_argument("--overload-retention", type=float, default=0.8,
+                    help="surge goodput must stay above this fraction of "
+                    "the at-capacity arm's")
+    ap.add_argument("--overload-ttft-ms", type=float, default=1000.0,
+                    help="DYN_GATE_TTFT_MS for the overload arm (the "
+                    "admission ceiling at headroom 1.0)")
+    ap.add_argument("--overload-slo-ms", type=float, default=2000.0,
+                    help="TTFT SLO for the goodput (attained tok/s) metric")
     args = ap.parse_args()
+
+    if args.overload_smoke:
+        out = asyncio.run(run_overload_bench(args))
+        print(json.dumps(out, indent=2))
+        ok = True
+        if out["surge_rejected"] < 10:
+            print(f"OVERLOAD SMOKE FAIL: only {out['surge_rejected']} "
+                  "rejections at ~10x capacity (gate not engaging)",
+                  file=sys.stderr)
+            ok = False
+        if out["rejections_with_retry_after"] != out["surge_rejected"]:
+            print("OVERLOAD SMOKE FAIL: rejections missing Retry-After",
+                  file=sys.stderr)
+            ok = False
+        if out["mid_stream_kills"]:
+            print(f"OVERLOAD SMOKE FAIL: {out['mid_stream_kills']} served "
+                  f"streams truncated/killed: {out['kill_detail']}",
+                  file=sys.stderr)
+            ok = False
+        if (out["goodput_retention"] or 0) < args.overload_retention:
+            print(f"OVERLOAD SMOKE FAIL: goodput retention "
+                  f"{out['goodput_retention']} < {args.overload_retention}",
+                  file=sys.stderr)
+            ok = False
+        sys.exit(0 if ok else 1)
 
     if args.sla_smoke:
         def _arms():

@@ -247,6 +247,9 @@ class _Slot:
     arrival_s: float = 0.0
     sched_deadline: float = 0.0
     sched_skips: int = 0
+    # dynogate tenant key (docs/overload.md): feeds the StepPlanner's
+    # per-tenant fairness tiebreak; "" = the default tenant
+    tenant: str = ""
 
 
 class StreamedPullHandle:
@@ -1437,6 +1440,7 @@ class JaxEngine:
         if len(slot.prompt) + slot.max_tokens > self.config.max_model_len:
             slot.max_tokens = max(self.config.max_model_len - len(slot.prompt), 1)
         slot.priority = int(req.priority or 0)
+        slot.tenant = req.tenant or ""
         slot.arrival_s = time.monotonic()
         self.scheduler.assign_deadline(slot)
         return slot
@@ -1675,6 +1679,7 @@ class JaxEngine:
         out.update(self.scheduler.stats())
         est = self.estimated_prefill_wait_ms()
         out["sched_est_ttft_ms"] = round(est, 1) if est is not None else 0.0
+        out["sched_est_req_ms"] = round(self.estimated_req_ms(), 1)
         recent = self.scheduler.recent_decisions()
         if recent:
             out["sched_last_decision"] = recent[-1]
@@ -1714,6 +1719,24 @@ class JaxEngine:
         for s in self._waiting:
             pending += len(s.prompt)
         return self.scheduler.estimate_wait_ms(pending)
+
+    def estimated_req_ms(self) -> float:
+        """Marginal TTFT one more admitted request adds (the dynogate
+        optimism-debt unit, docs/overload.md): a typical-length prompt at
+        the cost model's observed per-token prefill rate. 0.0 when the
+        model is cold or the queue is empty — the gate then corrects from
+        the next published sched_est_ttft_ms instead."""
+        per_tok = self.scheduler.cost.per_token("prefill")
+        if per_tok is None:
+            return 0.0
+        lens = [
+            len(s.kv_prompt) for s in self.slots
+            if s is not None and not s.done
+        ]
+        lens += [len(s.prompt) for s in self._waiting]
+        if not lens:
+            return 0.0
+        return (sum(lens) / len(lens)) * per_tok * 1000.0
 
     # ------------------------------------------------------------------ #
     # step loop

@@ -75,6 +75,17 @@ def _next_pow2(n: int) -> int:
     return 1 << max(n - 1, 0).bit_length()
 
 
+#: EDF deadline quantum (s) inside which the per-tenant fairness tiebreak
+#: may reorder candidates — far below any meaningful TTFT target delta
+_TENANT_TIE_QUANTUM_S = 0.1
+#: per-tenant served-token counts halve when the max passes this bound
+_TENANT_DECAY = 1 << 20
+#: tenant-key cardinality bound (the key is a client-controlled header):
+#: past it, the least-served half is evicted — evicted tenants simply
+#: read as debt 0 again
+_TENANT_MAX = 4096
+
+
 @dataclass
 class _Decision:
     """Per-step decision record (bounded history for stats/debugging)."""
@@ -101,6 +112,13 @@ class StepPlanner:
         self.cost = cost or CostModel()
         self._deadlines: Dict[str, float] = {}  # request_id -> deadline (mono s)
         self._records: deque = deque(maxlen=64)
+        # dynogate per-tenant fairness (docs/overload.md): granted prefill
+        # tokens per tenant key. Within a ~100ms EDF deadline bucket the
+        # LEAST-served tenant dispatches first, so a noisy tenant's flood
+        # cannot monopolize same-class capacity; across buckets EDF still
+        # rules (SLA attainment outranks fairness). Counts halve past
+        # _TENANT_DECAY so the debt is recent-history, not all-time.
+        self._tenant_served: Dict[str, int] = {}
         # counters (monotonic; surfaced via stats())
         self.granted_chunks = 0
         self.granted_tokens = 0
@@ -143,16 +161,37 @@ class StepPlanner:
 
     # -- ordering -------------------------------------------------------- #
 
+    def tenant_debt(self, slot) -> int:
+        """Recent prefill tokens granted to the slot's tenant (0 for the
+        default tenant or one never served)."""
+        return self._tenant_served.get(getattr(slot, "tenant", "") or "", 0)
+
+    def _note_tenant(self, slot, granted: int) -> None:
+        tenant = getattr(slot, "tenant", "") or ""
+        served = self._tenant_served.get(tenant, 0) + granted
+        self._tenant_served[tenant] = served
+        if served > _TENANT_DECAY:
+            for t in list(self._tenant_served):
+                self._tenant_served[t] //= 2
+        if len(self._tenant_served) > _TENANT_MAX:
+            keep = sorted(self._tenant_served.items(),
+                          key=lambda kv: kv[1], reverse=True)
+            self._tenant_served = dict(keep[: _TENANT_MAX // 2])
+
     def order(self, cands: List) -> List:
         """Prefill candidate order. fifo: admission order (bit-for-bit the
-        legacy `admit_seq` sort). sla: EDF with the starvation guard."""
+        legacy `admit_seq` sort). sla: EDF with the starvation guard, and
+        — within a ~100ms deadline bucket — the least-served tenant first
+        (the dynogate fairness tiebreak: same class, same urgency, the
+        noisy tenant queues behind the quiet one)."""
         if self.sla.policy != "sla":
             return sorted(cands, key=lambda s: s.admit_seq)
         starve = self.sla.starve_dispatches
 
         def key(s):
             starved = 0 if s.sched_skips >= starve else 1
-            return (starved, s.sched_deadline, s.admit_seq)
+            return (starved, int(s.sched_deadline / _TENANT_TIE_QUANTUM_S),
+                    self.tenant_debt(s), s.sched_deadline, s.admit_seq)
 
         return sorted(cands, key=key)
 
@@ -395,6 +434,8 @@ class StepPlanner:
             self.itl_shrunk_steps += 1
         self.granted_chunks += len(slots)
         self.granted_tokens += granted
+        for s, ch in dispatched:
+            self._note_tenant(s, ch)
         self._records.append(_Decision(
             t=now, reason=plan.reason, bucket=plan.bucket,
             lanes=len(slots) + plan.n_decode,
@@ -415,6 +456,8 @@ class StepPlanner:
         granted = sum(min(remaining(s), plan.bucket) for s in plan.chosen)
         self.granted_chunks += len(plan.chosen)
         self.granted_tokens += granted
+        for s in plan.chosen:
+            self._note_tenant(s, min(remaining(s), plan.bucket))
         self._records.append(_Decision(
             t=now, reason=plan.reason, bucket=plan.bucket, lanes=plan.lanes,
             granted_tokens=granted, granted_slots=len(plan.chosen),
@@ -461,6 +504,7 @@ class StepPlanner:
             "sched_starvation_overrides": self.starvation_overrides,
             "sched_pending_deadlines": len(self._deadlines),
             "sched_cost_observations": self.cost.n_observations(),
+            "sched_tenants_served": len(self._tenant_served),
         }
         if last is not None:
             out["sched_last_budget_tokens"] = last.granted_tokens

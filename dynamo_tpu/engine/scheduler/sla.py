@@ -24,6 +24,8 @@ import logging
 import os
 from typing import Optional
 
+from ...runtime.config import env_float as _env_float
+
 logger = logging.getLogger(__name__)
 
 POLICIES = ("fifo", "sla")
@@ -31,17 +33,6 @@ POLICIES = ("fifo", "sla")
 #: dispatches a candidate may be skipped (by kind filtering or EDF
 #: reordering) before the starvation guard forces it through
 STARVE_DISPATCHES = 16
-
-
-def _env_float(name: str, default: float) -> float:
-    raw = os.environ.get(name)
-    if raw is None or raw == "":
-        return default
-    try:
-        return float(raw)
-    except ValueError:
-        logger.warning("%s=%r is not a number; using %s", name, raw, default)
-        return default
 
 
 @dataclasses.dataclass(frozen=True)

@@ -65,6 +65,20 @@ async def main():
     manager = ModelManager()
     router_mode = RouterMode(args.router_mode)
 
+    # dynogate admission control (gate/, docs/overload.md): DYN_GATE=0
+    # compiles the whole overload discipline out of this process
+    gate = None
+    from dynamo_tpu.gate import AdmissionGate, GateConfig
+
+    gate_cfg = GateConfig.from_env()
+    if gate_cfg.enabled:
+        gate = AdmissionGate(drt, gate_cfg)
+        await gate.start()
+        logger.info(
+            "admission gate active (ttft=%.0fms headroom=%.1fx watermark=%d)",
+            gate_cfg.ttft_ms, gate_cfg.ttft_headroom, gate_cfg.queue_watermark,
+        )
+
     kv_router_factory = None
     if router_mode == RouterMode.KV:
         from dynamo_tpu.llm.kv_router import KvRouterConfig, make_kv_router_factory
@@ -78,11 +92,14 @@ async def main():
         )
 
     watcher = ModelWatcher(
-        drt, manager, router_mode, kv_router_factory, encoder=args.encoder
+        drt, manager, router_mode, kv_router_factory, encoder=args.encoder,
+        gate=gate,
     )
     await watcher.start()
 
-    service = HttpService(manager, host=args.http_host, port=args.http_port)
+    service = HttpService(
+        manager, host=args.http_host, port=args.http_port, gate=gate
+    )
     await service.start()
     grpc_service = None
     if args.grpc_port:
@@ -94,6 +111,8 @@ async def main():
         await grpc_service.start()
     logger.info("frontend ready on :%d (router=%s)", service.port, router_mode.value)
     await drt.wait_for_shutdown()
+    if gate is not None:
+        await gate.close()  # parked admissions resolve before the drain
     await drt.close()  # graceful drain (runtime/component.py close())
 
 

@@ -442,10 +442,13 @@ async def main():
         # depth metric — est TTFT is the disagg router's routing signal,
         # deferred/shrunk/override counters show where the ITL budget and
         # starvation guard actually bit
-        "sched_est_ttft_ms", "sched_pending_deadlines",
+        "sched_est_ttft_ms", "sched_est_req_ms", "sched_pending_deadlines",
         "sched_granted_tokens", "sched_deferred_steps",
         "sched_itl_shrunk_steps", "sched_deadline_overrides",
         "sched_starvation_overrides",
+        # dynogate (docs/overload.md): distinct tenants the fairness
+        # tiebreak has served — the worker-side view of tenant mix
+        "sched_tenants_served",
         # KVBM tier pipeline (docs/kvbm.md): per-tier hit/miss counters
         # (G1 = device prefix cache at admission, G2/G3 = host/disk
         # tiers), offload queue depth + drop counters, and the onboard

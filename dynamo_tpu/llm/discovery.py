@@ -67,6 +67,7 @@ class ModelWatcher:
         router_mode: RouterMode = RouterMode.ROUND_ROBIN,
         kv_router_factory: Optional[Callable] = None,
         encoder: Optional[str] = None,
+        gate=None,
     ):
         self.drt = drt
         self.manager = manager
@@ -75,6 +76,10 @@ class ModelWatcher:
         # "namespace/component/endpoint" of a multimodal encode worker:
         # adds the E hop (llm/multimodal.py) to every model pipeline
         self.encoder = encoder
+        # dynogate (gate/, docs/overload.md): each discovered model's
+        # backend component is registered so the gate follows its load
+        # signals; its watermark preference feeds the PushRouter
+        self.gate = gate
         self._task: Optional[asyncio.Task] = None
         self._card_keys: Dict[str, str] = {}  # key -> model name
 
@@ -102,12 +107,28 @@ class ModelWatcher:
         if self.manager.get(card.name) is not None:  # dynolint: disable=race-await-atomicity -- the model watcher is one serial task: _loop awaits each _on_put to completion
             self._card_keys[key] = card.name
             return  # another worker instance of an already-live model
+        ns = ep_info.get("namespace", "dynamo")
+        comp = ep_info.get("component", "backend")
         endpoint = (
-            self.drt.namespace(ep_info.get("namespace", "dynamo"))
-            .component(ep_info.get("component", "backend"))
+            self.drt.namespace(ns)
+            .component(comp)
             .endpoint(ep_info.get("endpoint", "generate"))
         )
         client = await endpoint.client()
+        instance_prefer = None
+        if self.gate is not None and self.gate.config.enabled:
+            try:
+                await self.gate.track_model(card.name, ns, comp, client)
+                instance_prefer = self.gate.signals.prefer_below_watermark(
+                    ns, comp)
+            except Exception:  # noqa: BLE001 — the gate must FAIL OPEN
+                # a metrics-subscribe hiccup leaves the gate signal-blind
+                # for this model (it then admits everything); it must not
+                # abort model registration or crash the watcher snapshot
+                logger.warning(
+                    "admission gate could not follow %s load signals; "
+                    "gate stays fail-open for it", card.name, exc_info=True,
+                )
         kv_router = None
         if self.router_mode == RouterMode.KV and self.kv_router_factory is not None:
             kv_router = await self.kv_router_factory(self.drt, card, client)
@@ -126,7 +147,7 @@ class ModelWatcher:
             )
         pipeline = build_routed_pipeline(
             card, client, self.router_mode, kv_router=kv_router,
-            encode_client=encode_client,
+            encode_client=encode_client, instance_prefer=instance_prefer,
         )
         self.manager.add(card.name, pipeline, client)
         self._card_keys[key] = card.name
@@ -139,6 +160,8 @@ class ModelWatcher:
         # remove only when no other card keys reference the model
         if model not in self._card_keys.values():
             await self.manager.remove(model)
+            if self.gate is not None:
+                await self.gate.untrack_model(model)
             logger.info("model removed: %s", model)
 
     async def stop(self):

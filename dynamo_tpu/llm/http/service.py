@@ -86,10 +86,15 @@ class HttpService:
         host: str = "0.0.0.0",
         port: int = 8000,
         enable_responses: bool = True,
+        gate=None,
     ):
         self.manager = manager
         self.host, self.port = host, port
         self.metrics = HttpMetrics()
+        # dynogate admission control (gate/, docs/overload.md): consulted
+        # BEFORE tokenization on every token-generating route. None (or a
+        # DYN_GATE=0 gate) = the pre-gate request path, byte-identical.
+        self.gate = gate
         self.app = web.Application(client_max_size=64 * 1024 * 1024)
         self._runner: Optional[web.AppRunner] = None
         self._setup_routes()
@@ -134,9 +139,69 @@ class HttpService:
         return web.json_response({"status": "live"})
 
     async def prometheus(self, request: web.Request) -> web.Response:
+        body = self.metrics.render()
+        if self.gate is not None and self.gate.config.enabled:
+            body += self.gate.render_prometheus()
         return web.Response(
-            body=self.metrics.render(), content_type="text/plain", charset="utf-8"
+            body=body, content_type="text/plain", charset="utf-8"
         )
+
+    # ------------------------------------------------------------------ #
+    # dynogate admission (docs/overload.md)
+    # ------------------------------------------------------------------ #
+
+    def _gate_tenant(self, request: web.Request) -> str:
+        header = self.gate.config.tenant_header
+        return (request.headers.get(header, "") if header else "") or "default"
+
+    @staticmethod
+    def _gate_priority(body: dict) -> int:
+        nvext = body.get("nvext")
+        raw = nvext.get("priority") if isinstance(nvext, dict) else None
+        try:
+            return int(raw) if raw is not None else 0
+        except (TypeError, ValueError):
+            return 0  # the preprocessor 400s it later; the gate is lenient
+
+    async def _gate_admit(
+        self, request: web.Request, model: str, body: dict, endpoint: str, t0
+    ):
+        """Run the admission gate ahead of tokenization. Returns
+        (None, tenant) when admitted, or (a finished 429 response, tenant)
+        when the request is rejected/shed — the body carries the decision
+        detail and the Retry-After header tells the client exactly when
+        to come back (docs/overload.md)."""
+        if self.gate is None or not self.gate.config.enabled:
+            return None, None
+        from ...gate import retry_after_header
+
+        tenant = self._gate_tenant(request)
+        priority = self._gate_priority(body)
+        decision = await self.gate.admit(model, tenant, priority)
+        if decision.admitted:
+            return None, tenant
+        self.metrics.request_start(model, endpoint)
+        self.metrics.request_end(model, endpoint, t0, error=True)
+        detail = {
+            "message": (
+                f"overloaded: admission {decision.reason} for tenant "
+                f"{tenant!r} (retry after {decision.retry_after_s:.1f}s)"
+            ),
+            "type": "overloaded",
+            "code": 429,
+            "reason": decision.reason,
+            "tenant": tenant,
+            "priority": priority,
+            "retry_after_s": round(decision.retry_after_s, 3),
+        }
+        if decision.projected_ttft_ms is not None:
+            detail["projected_ttft_ms"] = round(decision.projected_ttft_ms, 1)
+        resp = web.json_response(
+            {"error": detail},
+            status=429,
+            headers={"Retry-After": retry_after_header(decision.retry_after_s)},
+        )
+        return resp, tenant
 
     async def clear_kv_blocks(self, request: web.Request) -> web.Response:
         """Tell every worker instance of every (or one given) model to drop
@@ -294,6 +359,11 @@ class HttpService:
             )
         except Exception as e:  # noqa: BLE001 — malformed request, not a 500
             return self._error(400, f"invalid request: {e}")
+        reject, tenant = await self._gate_admit(
+            request, model, body, "responses", t0
+        )
+        if reject is not None:
+            return reject
         self.metrics.request_start(model, "responses")
         ctx = Context()
         try:
@@ -301,6 +371,8 @@ class HttpService:
         except ValueError as e:
             self.metrics.request_end(model, "responses", t0, error=True)
             return self._error(400, str(e))
+        if tenant and tenant != "default":
+            pre.tenant = tenant
         resp_id = f"resp_{_secrets.token_hex(12)}"
         engine_stream = pipeline.generate_preprocessed(pre, ctx)
         # same structured-output jail as the chat path (reasoning models must
@@ -424,6 +496,11 @@ class HttpService:
         pipeline = self.manager.get(req.model)
         if pipeline is None:
             return self._error(404, f"model {req.model!r} not found", "model_not_found")
+        # admission control BEFORE tokenization: a rejected request must
+        # not spend compute-pool time on the chat template (docs/overload.md)
+        reject, tenant = await self._gate_admit(request, req.model, body, "chat", t0)
+        if reject is not None:
+            return reject
         self.metrics.request_start(req.model, "chat")
         ctx = Context()
         try:
@@ -431,6 +508,8 @@ class HttpService:
         except ValueError as e:
             self.metrics.request_end(req.model, "chat", t0, error=True)
             return self._error(400, str(e))
+        if tenant and tenant != "default":
+            pre.tenant = tenant  # rides to the worker's fairness tiebreak
         include_usage = bool(
             req.stream_options and req.stream_options.include_usage
         )
@@ -777,6 +856,11 @@ class HttpService:
         pipeline = self.manager.get(req.model)
         if pipeline is None:
             return self._error(404, f"model {req.model!r} not found", "model_not_found")
+        reject, tenant = await self._gate_admit(
+            request, req.model, body, "completions", t0
+        )
+        if reject is not None:
+            return reject
         self.metrics.request_start(req.model, "completions")
         ctx = Context()
         try:
@@ -784,6 +868,8 @@ class HttpService:
         except ValueError as e:
             self.metrics.request_end(req.model, "completions", t0, error=True)
             return self._error(400, str(e))
+        if tenant and tenant != "default":
+            pre.tenant = tenant
         gen = CompletionDeltaGenerator(req.model, pre.request_id)
         gen.prompt_tokens = len(pre.token_ids)
         stream = pipeline.generate_preprocessed(pre, ctx)

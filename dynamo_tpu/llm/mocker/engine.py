@@ -18,6 +18,11 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, AsyncIterator, Callable, Dict, List, Optional
 
+from ...engine.scheduler.policy import (
+    _TENANT_DECAY,
+    _TENANT_MAX,
+    _TENANT_TIE_QUANTUM_S,
+)
 from ...engine.scheduler.sla import SlaConfig
 from ...runtime import faults
 from ...runtime.engine import Context
@@ -74,6 +79,7 @@ class _MockRequest:
     decode_only: bool = False  # disagg: KV assumed transferred in
     priority: int = 0
     sched_deadline: float = 0.0  # EDF key (monotonic s; sla policy only)
+    tenant: str = ""  # dynogate fairness key (docs/overload.md)
 
 
 class MockEngine:
@@ -106,6 +112,10 @@ class MockEngine:
         )
         self.sched_deferred_steps = 0  # steps the ITL budget zeroed prefill
         self.sched_deadline_overrides = 0  # overdue requests that broke it
+        # dynogate parity with StepPlanner (docs/overload.md): recent
+        # prefill tokens per tenant — the EDF tiebreak prefers the
+        # least-served tenant inside a ~100ms deadline bucket
+        self._tenant_served: Dict[str, int] = {}
 
     # -- lifecycle ---------------------------------------------------------- #
 
@@ -163,6 +173,7 @@ class MockEngine:
         )
         mreq.seq = TokenBlockSequence(mreq.prompt, self.args.block_size)
         mreq.priority = int(req.priority or 0)
+        mreq.tenant = req.tenant or ""
         mreq.sched_deadline = self.sla.deadline(time.monotonic(), mreq.priority)
         self.num_requests += 1
         self._waiting.append(mreq)
@@ -190,7 +201,66 @@ class MockEngine:
             "sched_policy": self.sla.policy,
             "sched_deferred_steps": self.sched_deferred_steps,
             "sched_deadline_overrides": self.sched_deadline_overrides,
+            # dynogate signal parity with the JaxEngine (docs/overload.md):
+            # the frontend admission gate projects TTFT from this gauge,
+            # so the soak and CI smoke exercise the real gate without jax
+            "sched_est_ttft_ms": round(self.estimated_ttft_ms(), 1),
+            # marginal cost of one MORE admitted request (the gate's
+            # optimism-debt unit between 0.25s metric publishes — without
+            # it a one-window burst floods past the published estimate)
+            "sched_est_req_ms": round(self.estimated_req_ms(), 1),
         }
+
+    def estimated_req_ms(self) -> float:
+        """Marginal TTFT one more admitted request adds: with every slot
+        busy, each queued admission adds one FULL request drain spread
+        across the slots."""
+        a = self.args
+        occupied = len(self._running) + len(self._waiting)
+        if occupied < a.max_num_seqs or not occupied:
+            return 0.0  # truly free slots: an admission costs no queue wait
+        speed = max(a.speedup_ratio, 1e-9)
+        per_step = (
+            a.decode_time_per_step
+            + a.max_num_seqs * a.decode_time_per_seq
+        ) / speed
+        full = [max(r.max_tokens, 1) for r in [*self._running, *self._waiting]]
+        mean_req_s = (sum(full) / len(full)) * per_step
+        return mean_req_s / max(a.max_num_seqs, 1) * 1000.0
+
+    def estimated_ttft_ms(self) -> float:
+        """Projected TTFT for one more arriving request, priced by the
+        mocker's own synthetic timing model (the mocker's spelling of
+        JaxEngine.estimated_prefill_wait_ms): pending prefill tokens at
+        the prefill rate, plus — when every slot is taken — the slot wait
+        until the decode work AHEAD of the newcomer drains: the running
+        requests' remaining steps plus every queued request's FULL
+        service time, spread across the slots."""
+        a = self.args
+        speed = max(a.speedup_ratio, 1e-9)
+        pending_tokens = sum(
+            max(len(r.prompt) - r.prefill_pos, 0)
+            for r in [*self._waiting, *self._running]
+            if not r.done and not r.decode_only
+        )
+        est_s = pending_tokens * a.prefill_time_per_token / speed
+        # slot wait: a waiting queue means every momentarily-free slot is
+        # already spoken for — the term must not collapse to zero in the
+        # instant between a finish and the next admission step (the gate
+        # would read that publish as an idle fleet and flood)
+        if self._waiting or len(self._running) >= a.max_num_seqs:
+            per_step = (
+                a.decode_time_per_step
+                + a.max_num_seqs * a.decode_time_per_seq
+            ) / speed
+            ahead_steps = sum(
+                max(r.max_tokens - r.generated, 1) for r in self._running
+            ) + sum(
+                max(r.max_tokens, 1)
+                for r in self._waiting if not r.done
+            )
+            est_s += (ahead_steps / max(a.max_num_seqs, 1)) * per_step
+        return est_s * 1000.0
 
     # -- scheduler ---------------------------------------------------------- #
 
@@ -275,7 +345,7 @@ class MockEngine:
         budget = a.max_num_batched_tokens
         waiting = self._waiting
         if self.sla.policy == "sla":
-            waiting = sorted(waiting, key=lambda r: r.sched_deadline)
+            waiting = sorted(waiting, key=self._edf_key)
             budget = min(budget, self._itl_prefill_budget())
         processed = 0
         # admit
@@ -305,7 +375,7 @@ class MockEngine:
         # taken AFTER admission so fresh admits prefill this same step,
         # exactly like the fifo path)
         prefill_order = (
-            sorted(self._running, key=lambda r: r.sched_deadline)
+            sorted(self._running, key=self._edf_key)
             if self.sla.policy == "sla" else self._running
         )
         for req in prefill_order:
@@ -317,7 +387,27 @@ class MockEngine:
                 continue
             req.prefill_pos += chunk
             processed += chunk
+            self._note_tenant(req.tenant, chunk)
         return processed
+
+    def _edf_key(self, req: _MockRequest):
+        """EDF with the dynogate tenant tiebreak (StepPlanner.order
+        parity — same quantum/decay/cap constants, imported so the two
+        paths cannot drift): within a deadline bucket the least-served
+        tenant goes first."""
+        return (int(req.sched_deadline / _TENANT_TIE_QUANTUM_S),
+                self._tenant_served.get(req.tenant, 0), req.sched_deadline)
+
+    def _note_tenant(self, tenant: str, granted: int) -> None:
+        served = self._tenant_served.get(tenant, 0) + granted
+        self._tenant_served[tenant] = served
+        if served > _TENANT_DECAY:
+            for t in list(self._tenant_served):
+                self._tenant_served[t] //= 2
+        if len(self._tenant_served) > _TENANT_MAX:  # client-controlled key
+            keep = sorted(self._tenant_served.items(),
+                          key=lambda kv: kv[1], reverse=True)
+            self._tenant_served = dict(keep[: _TENANT_MAX // 2])
 
     def _do_decode(self) -> int:
         """One decode token for every prefilled running request."""

@@ -107,6 +107,12 @@ class PreprocessedRequest:
     # doubles it. 0 = default class. Only consulted under
     # DYN_SCHED_POLICY=sla; fifo ignores it.
     priority: int = 0
+    # tenant key (dynogate, docs/overload.md): set by the frontend from
+    # the DYN_GATE_TENANT_HEADER request header. Drives the gate's
+    # weighted-fair queueing / rate limits at the edge and the
+    # StepPlanner's per-tenant fairness tiebreak in the worker. None =
+    # the 'default' tenant.
+    tenant: Optional[str] = None
 
     def to_dict(self) -> dict:
         d = {
@@ -137,6 +143,8 @@ class PreprocessedRequest:
             d["lora_name"] = self.lora_name
         if self.priority:
             d["priority"] = self.priority
+        if self.tenant:
+            d["tenant"] = self.tenant
         return d
 
     @classmethod

@@ -81,18 +81,21 @@ def build_routed_pipeline(
     kv_router=None,
     busy_threshold: Optional[float] = None,
     encode_client: Optional[Client] = None,
+    instance_prefer=None,
 ) -> ModelPipeline:
     """Assemble the canonical chain for one model
     (reference common.rs:259-310) via the operator graph.
     `encode_client`: endpoint client of a multimodal encode worker — adds
-    the E hop of E/P/D ahead of the chain (llm/multimodal.py)."""
+    the E hop of E/P/D ahead of the chain (llm/multimodal.py).
+    `instance_prefer`: dynogate load-preference hook for the PushRouter
+    (below-watermark instances dialed first, docs/overload.md)."""
     from ..runtime.pipeline import compose
 
     tokenizer = load_tokenizer(card.tokenizer)
     if router_mode == RouterMode.KV and kv_router is not None:
         router = kv_router
     else:
-        router = PushRouter(client, router_mode)
+        router = PushRouter(client, router_mode, prefer=instance_prefer)
     sink = ServiceBackend(router)
     migration = Migration(migration_limit=card.migration_limit)
     backend = Backend(tokenizer=tokenizer)

@@ -103,6 +103,7 @@ class SoakFrontend:
         self.drt: Optional[DistributedRuntime] = None
         self.http = None
         self.watcher = None
+        self.gate = None  # dynogate (env-resolved; DYN_GATE=0 disables)
         self.port: int = 0
 
     @property
@@ -123,16 +124,26 @@ class SoakFrontend:
         return f"http://127.0.0.1:{self.port}"
 
     async def start(self) -> "SoakFrontend":
+        from ..gate import AdmissionGate, GateConfig
         from ..llm.discovery import ModelManager, ModelWatcher
         from ..llm.http import HttpService
 
         self.disc = DiscoveryServer(port=0)
         await self.disc.start()
         self.drt = await DistributedRuntime.create(self.cfg)
+        # same gate wiring as `python -m dynamo_tpu.frontend`: the soaks
+        # exercise the production admission path, not a stub of it
+        gate_cfg = GateConfig.from_env()
+        if gate_cfg.enabled:
+            self.gate = AdmissionGate(self.drt, gate_cfg)
+            await self.gate.start()
         manager = ModelManager()
-        self.watcher = ModelWatcher(self.drt, manager, self.router_mode)
+        self.watcher = ModelWatcher(
+            self.drt, manager, self.router_mode, gate=self.gate
+        )
         await self.watcher.start()
-        self.http = HttpService(manager, host="127.0.0.1", port=0)
+        self.http = HttpService(manager, host="127.0.0.1", port=0,
+                                gate=self.gate)
         self.port = await self.http.start()
         return self
 
@@ -155,6 +166,8 @@ class SoakFrontend:
             await self.watcher.stop()
         if self.http is not None:
             await self.http.stop()
+        if self.gate is not None:
+            await self.gate.close()
         if self.drt is not None:
             await self.drt.close()
         if self.disc is not None:
@@ -176,8 +189,10 @@ class InProcMockWorker:
         self.migration_limit = migration_limit
         self.drt: Optional[DistributedRuntime] = None
         self.engine = None
+        self._metrics_pub = None
 
     async def start(self) -> "InProcMockWorker":
+        from ..llm.kv_router.publisher import WorkerMetricsPublisher
         from ..llm.mocker import MockEngine
         from ..llm.model_card import ModelDeploymentCard, register_llm
 
@@ -193,6 +208,13 @@ class InProcMockWorker:
                 yield item
 
         await ep.serve_endpoint(handler)
+        # same load-signal surface as `python -m dynamo_tpu.mocker`: the
+        # admission gate and KV router read sched_est_ttft_ms/queue depth
+        # off this topic (docs/overload.md)
+        self._metrics_pub = WorkerMetricsPublisher(
+            self.drt, ep, self.drt.instance_id, engine.stats
+        )
+        await self._metrics_pub.start()
         await register_llm(ep, ModelDeploymentCard(
             name=self.engine_args.model_name,
             tokenizer="byte",
@@ -207,6 +229,8 @@ class InProcMockWorker:
         return self.drt.instance_id
 
     async def stop(self, graceful: bool = True):
+        if self._metrics_pub is not None:
+            await self._metrics_pub.close()
         if self.drt is not None:
             await self.drt.close(graceful=graceful)
 
@@ -333,10 +357,16 @@ class StreamRecord:
     max_tokens: int = 0
     finish_reason: Optional[str] = None
     error: Optional[str] = None
+    tenant: str = ""
+    # dynogate rejection (docs/overload.md): a clean 429 BEFORE any
+    # stream bytes — not an error, not a contiguity problem
+    rejected: bool = False
+    retry_after_s: Optional[float] = None
 
     @property
     def ok(self) -> bool:
-        return self.error is None and self.finish_reason is not None
+        return (not self.rejected and self.error is None
+                and self.finish_reason is not None)
 
     def ttft_ms(self) -> float:
         if self.t_first is None:
@@ -345,6 +375,8 @@ class StreamRecord:
 
     def contiguity_problems(self) -> List[str]:
         out = []
+        if self.rejected:
+            return out  # typed pre-stream rejection: nothing was promised
         if self.error is not None:
             out.append(f"error: {self.error}")
             return out
@@ -366,22 +398,41 @@ class StreamRecord:
 
 async def drive_stream(session: aiohttp.ClientSession, base_url: str,
                        model: str, prompt: str, max_tokens: int,
-                       phase: str = "") -> StreamRecord:
-    """One streaming chat completion, recorded chunk by chunk."""
+                       phase: str = "", tenant: str = "",
+                       priority: int = 0,
+                       tenant_header: str = "x-dynamo-tenant") -> StreamRecord:
+    """One streaming chat completion, recorded chunk by chunk. `tenant`
+    rides the gate's tenant header and `priority` its nvext SLA class; a
+    gate 429 is recorded as a clean rejection (Retry-After parsed), any
+    other non-200 as an error."""
     rec = StreamRecord(phase=phase, t_send=time.monotonic(),
-                       max_tokens=max_tokens)
+                       max_tokens=max_tokens, tenant=tenant)
+    body = {
+        "model": model,
+        "messages": [{"role": "user", "content": prompt}],
+        "max_tokens": max_tokens,
+        "stream": True,
+        "stream_options": {"include_usage": True},
+    }
+    if priority:
+        body["nvext"] = {"priority": priority}
+    headers = {tenant_header: tenant} if tenant else None
     try:
         async with session.post(
             f"{base_url}/v1/chat/completions",
-            json={
-                "model": model,
-                "messages": [{"role": "user", "content": prompt}],
-                "max_tokens": max_tokens,
-                "stream": True,
-                "stream_options": {"include_usage": True},
-            },
+            json=body,
+            headers=headers,
             timeout=aiohttp.ClientTimeout(total=120),
         ) as resp:
+            if resp.status == 429:
+                rec.rejected = True
+                try:
+                    rec.retry_after_s = float(
+                        resp.headers.get("Retry-After", "0"))
+                except ValueError:
+                    rec.retry_after_s = None
+                await resp.read()
+                return rec
             if resp.status != 200:
                 rec.error = f"HTTP {resp.status}: {(await resp.text())[:200]}"
                 return rec
@@ -411,16 +462,21 @@ async def drive_stream(session: aiohttp.ClientSession, base_url: str,
 
 class RampLoad:
     """Seeded deterministic qps ramp: fixed inter-arrival 1/qps per phase,
-    prompts varied per request index (prefix caching stays honest)."""
+    prompts varied per request index (prefix caching stays honest).
+    `tenant_cycle`: optional [(tenant, priority), ...] assigned to
+    requests round-robin — the deterministic multi-tenant mix the gate
+    soak drives (docs/overload.md)."""
 
     def __init__(self, base_url: str, model: str, phases: Sequence[RampPhase],
-                 *, isl_chars: int = 24, osl_tokens: int = 16, seed: int = 0):
+                 *, isl_chars: int = 24, osl_tokens: int = 16, seed: int = 0,
+                 tenant_cycle: Sequence[Tuple[str, int]] = ()):
         self.base_url = base_url
         self.model = model
         self.phases = list(phases)
         self.isl_chars = isl_chars
         self.osl_tokens = osl_tokens
         self.seed = seed
+        self.tenant_cycle = list(tenant_cycle)
         self.records: List[StreamRecord] = []
 
     async def run(self) -> List[StreamRecord]:
@@ -437,9 +493,14 @@ class RampLoad:
                     if delay > 0:
                         await asyncio.sleep(delay)
                     prompt = f"soak-{self.seed}-{i:05d} " + "x" * self.isl_chars
+                    tenant, priority = "", 0
+                    if self.tenant_cycle:
+                        tenant, priority = self.tenant_cycle[
+                            i % len(self.tenant_cycle)]
                     tasks.append(asyncio.create_task(drive_stream(
                         session, self.base_url, self.model, prompt,
                         self.osl_tokens, phase=phase.label or f"qps{phase.qps}",
+                        tenant=tenant, priority=priority,
                     )))
                     i += 1
                 # hold the phase boundary even if requests lag
@@ -480,6 +541,39 @@ def window_attainment(records: Sequence[StreamRecord], t0: float,
             out.append((t - t0, attainment(win, ttft_slo_ms), len(win)))
         t += window_s
     return out
+
+
+def goodput_tok_s(records: Sequence[StreamRecord], ttft_slo_ms: float,
+                  window_s: Optional[float] = None) -> float:
+    """SLA-attained tokens per second attributable to this offered-load
+    window — the dynogate acceptance metric (docs/overload.md): tokens
+    streamed by requests that finished AND met their TTFT target, over
+    the window the load was OFFERED in (first to last send; pass
+    `window_s` to pin it to the phase duration). Rejected/failed/late
+    requests contribute zero tokens, so convoy collapse — everything
+    admitted, everything late — reads as zero goodput, while clean
+    shedding keeps the served slice's tokens counted."""
+    if not records:
+        return 0.0
+    attained = [r for r in records if r.ok and r.ttft_ms() <= ttft_slo_ms]
+    if window_s is None:
+        t0 = min(r.t_send for r in records)
+        t1 = max(r.t_send for r in records)
+        window_s = max(t1 - t0, 1e-9)
+    return sum(r.content_tokens for r in attained) / max(window_s, 1e-9)
+
+
+def per_tenant_attainment(records: Sequence[StreamRecord],
+                          ttft_slo_ms: float) -> dict:
+    """TTFT attainment per tenant over SERVED streams (clean gate
+    rejections are excluded: the fairness question is whether what each
+    tenant WAS served met SLA, not how much of its flood was refused)."""
+    served: dict = {}
+    for r in records:
+        if r.rejected:
+            continue
+        served.setdefault(r.tenant or "default", []).append(r)
+    return {t: attainment(rs, ttft_slo_ms) for t, rs in served.items()}
 
 
 def contiguity_report(records: Sequence[StreamRecord]) -> List[str]:

@@ -38,6 +38,39 @@ def env_bool(name: str, default: bool = False) -> bool:
     return bool(_env(name, default, bool))
 
 
+def env_float(name: str, default: float) -> float:
+    """Canonical lenient float parsing for registry-typed env vars: unset,
+    empty, or unparseable values fall back to the default with a warning
+    (a typo'd knob must degrade, not take the process down). One spelling
+    shared by every module (the SLA/sched and gate knob surfaces)."""
+    raw = os.environ.get(name)
+    if raw in (None, ""):
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "%s=%r is not a number; using %s", name, raw, default)
+        return default
+
+
+def env_int(name: str, default: int) -> int:
+    """Lenient int parsing, same contract as env_float."""
+    raw = os.environ.get(name)
+    if raw in (None, ""):
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "%s=%r is not an integer; using %s", name, raw, default)
+        return default
+
+
 @dataclasses.dataclass(frozen=True)
 class EnvVar:
     """One registered environment variable: the discoverability contract.
@@ -191,6 +224,70 @@ ENV_REGISTRY: tuple = (
            "capacity before the planner steps down (scale-up is never "
            "hysteresis-gated: restoring SLA outranks fleet stability).",
            "planner/planner_core.py"),
+    # -- frontend admission gate (gate/, docs/overload.md) -------------- #
+    EnvVar("DYN_GATE", "bool", "1",
+           "dynogate master switch: frontend admission control, per-"
+           "tenant fairness and load shedding (docs/overload.md). 0 "
+           "compiles the gate out of the frontend — no admission checks, "
+           "no metrics subscription, no router watermark preference; "
+           "streams are byte-identical to a build without the package.",
+           "gate/config.py"),
+    EnvVar("DYN_GATE_TTFT_MS", "float", "0",
+           "Base TTFT target (ms) for admission-class math; each +1 of "
+           "nvext.priority halves it (the SlaConfig.deadline math). 0 "
+           "(default) inherits DYN_SLA_TTFT_MS so the edge and the "
+           "worker scheduler agree on what on-time means.",
+           "gate/config.py"),
+    EnvVar("DYN_GATE_TTFT_HEADROOM", "float", "1.5",
+           "Admission ceiling multiplier: a request is rejected (429 + "
+           "Retry-After, before tokenization) when the fleet's projected "
+           "TTFT exceeds headroom x its class target — serving it would "
+           "blow its SLA anyway.",
+           "gate/config.py"),
+    EnvVar("DYN_GATE_QUEUE_WATERMARK", "int", "16",
+           "Per-instance queue-depth watermark: PushRouter prefers "
+           "instances below it for new streams, and admission projects "
+           "TTFT from depth/watermark for workers that publish no "
+           "sched_est_ttft_ms estimate (fifo-policy fleets).",
+           "gate/signals.py"),
+    EnvVar("DYN_GATE_MAX_QUEUE", "int", "64",
+           "Gate queue bound: past it waiting admissions are SHED, "
+           "lowest SLA class first (newest first within a class). 0 "
+           "disables the bound (shedding then happens only on the "
+           "per-request wait cap).",
+           "gate/gate.py"),
+    EnvVar("DYN_GATE_MAX_WAIT_MS", "float", "1000",
+           "Cap (ms) on how long a request may park in the gate queue "
+           "awaiting capacity; the effective bound is min(this, class "
+           "headroom) — waiting past either would blow the SLA it was "
+           "queued to protect.",
+           "gate/gate.py"),
+    EnvVar("DYN_GATE_TENANT_HEADER", "str", "x-dynamo-tenant",
+           "HTTP header carrying the tenant key for fairness accounting "
+           "(rides PreprocessedRequest.tenant to the worker scheduler's "
+           "fairness tiebreak). Absent header = tenant 'default'.",
+           "gate/config.py"),
+    EnvVar("DYN_GATE_TENANT_RATE", "float", "0",
+           "Per-tenant token-bucket rate limit (requests/s) enforced at "
+           "admission; a tenant past its bucket gets 429 with "
+           "Retry-After = its exact refill time. 0 = unlimited.",
+           "gate/config.py"),
+    EnvVar("DYN_GATE_TENANT_BURST", "float", "0",
+           "Token-bucket burst size per tenant; 0 = max(2 x rate, 1).",
+           "gate/config.py"),
+    EnvVar("DYN_GATE_TENANT_WEIGHTS", "str", None,
+           "WFQ weights per tenant (`gold=4,free=1`): under contention a "
+           "tenant drains the gate queue at weight-proportional share. "
+           "Unlisted tenants weigh 1.",
+           "gate/config.py"),
+    EnvVar("DYN_GATE_SIGNAL_TTL_S", "float", "5.0",
+           "Load-signal staleness bound: samples older than this are "
+           "invisible to admission (a stale fleet view must admit, "
+           "never reject on ghosts — the disagg queue_depth_ttl_s rule).",
+           "gate/config.py"),
+    EnvVar("DYN_GATE_RETRY_AFTER_FLOOR_S", "float", "1.0",
+           "Minimum Retry-After (s) on any gate 429.",
+           "gate/config.py"),
     # -- engine / memory sizing ---------------------------------------- #
     EnvVar("DYN_HBM_UTILIZATION", "float", "0.85",
            "Fraction of device memory the KV pool auto-sizer may plan "

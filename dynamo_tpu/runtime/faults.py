@@ -111,6 +111,10 @@ KNOWN_FAULT_POINTS = {
     "kvbm.onboard":
         "`error` | `delay` — tier load at admission onboard; `error` "
         "falls back to full prefill of that span",
+    "gate.admit":
+        "`reject` — frontend admission decision (dynogate); forces a "
+        "clean 429-with-Retry-After on the hit, exercising the typed "
+        "rejection path before tokenization",
 }
 
 

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import enum
 import random
-from typing import Any, AsyncIterator, Optional
+from typing import Any, AsyncIterator, Callable, List, Optional
 
 from .component import Client
 from .engine import Context
@@ -33,10 +33,18 @@ class PushRouter:
         client: Client,
         mode: RouterMode = RouterMode.ROUND_ROBIN,
         direct_instance: Optional[int] = None,
+        prefer: Optional[Callable[[List[int]], List[int]]] = None,
     ):
         self.client = client
         self.mode = mode
         self.direct_instance = direct_instance
+        # load-aware instance preference (dynogate, docs/overload.md):
+        # narrows the candidate set to instances below the gate's
+        # queue-depth watermark, so a saturated-but-ready worker is not
+        # dialed like an idle one. The hook may degrade the choice but
+        # never empty it (it falls back to the full set); DIRECT mode is
+        # pinned and bypasses it.
+        self.prefer = prefer
         self._rr_index = 0
 
     def _pick(self, exclude: set) -> int:
@@ -59,6 +67,10 @@ class PushRouter:
         ids = [i for i in self.client.ready_instance_ids() if i not in exclude]
         if not ids:
             raise StreamLost(f"no instances for {self.client.endpoint.subject}")
+        if self.prefer is not None and len(ids) > 1:
+            preferred = [i for i in self.prefer(ids) if i not in exclude]
+            if preferred:
+                ids = preferred
         if self.mode == RouterMode.RANDOM:
             return random.choice(ids)
         # round-robin default

@@ -101,6 +101,17 @@ GUARDED_STATE = {
     "CostModel._ewma": "lock:_lock",
     "StepPlanner._deadlines": "single-task:_step_loop",
     "StepPlanner._records": "single-task:_step_loop",
+    # dynogate tenant-fairness tiebreak bookkeeping: granted tokens per
+    # tenant, fed by the planner's own accounting calls (all reached from
+    # the engine step loop, like the deadline table above).
+    "StepPlanner._tenant_served": "single-task:_step_loop",
+    # dynogate (gate/gate.py): every WFQ/virtual-time/debt mutation is
+    # confined to the gate's single pump task; `admit` only appends to
+    # the inbox asyncio.Queue and awaits its entry's future, so
+    # admission decisions cannot tear across requests.
+    "AdmissionGate._waiting": "single-task:_pump",
+    "AdmissionGate._debt": "single-task:_pump",
+    "AdmissionGate._debt_seen": "single-task:_pump",
     # endpoint instance table: the watch task is the only mutator once
     # the client is started (static mode carries a reasoned waiver).
     "Client.instances": "single-task:_watch_loop",

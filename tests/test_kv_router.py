@@ -354,16 +354,47 @@ def kv_cluster():
             time.sleep(0.25)
         else:
             raise TimeoutError("model never registered")
+    # readiness barrier: the model registers as soon as ONE mocker is up,
+    # but the affinity/spread assertions below need BOTH instances live
+    # and routable at the frontend. Probe with distinct throwaway prompts
+    # until two distinct worker ids have answered — on a loaded host the
+    # second mocker can register many seconds after the first, which is
+    # exactly the window the old fixed sleeps flaked in. The prompts must
+    # differ inside the FIRST token block (16 bytes): a shared first
+    # block would score overlap with whichever worker served probe 0 and
+    # the in-flight overlay would pin every later probe to it.
+    seen: set = set()
+    deadline = time.time() + 60
+    i = 0
+    while time.time() < deadline and len(seen) < 2:
+        wid = _stream_worker_id(
+            base, f"{chr(97 + i % 26)}{i} probe " + chr(97 + i % 26) * 64,
+            endpoint="completions",
+        )
+        if wid is not None:
+            seen.add(wid)
+        i += 1
+        if len(seen) < 2:
+            time.sleep(0.3)
+    if len(seen) < 2:
+        raise TimeoutError(f"second kv worker never became routable ({seen})")
     yield base
     for w in workers:
         w.stop()
     fe.stop()
 
 
-def _stream_worker_id(base, prompt, model="kv-model", endpoint="chat"):
+def _stream_worker_id(base, prompt, model="kv-model", endpoint="chat",
+                      want_hit_rate=False):
     """Issue a streaming request with the worker_instance_id annotation and
-    parse it from the SSE comment line."""
+    parse it from the SSE comment line. `want_hit_rate=True` also asks for
+    the kv_hit_rate annotation (the router's estimated prefix-overlap
+    blocks, echoed by the worker) and returns (worker_id, hit_blocks)."""
     wid = None
+    hit = None
+    annotations = ["worker_instance_id"] + (
+        ["kv_hit_rate"] if want_hit_rate else []
+    )
     if endpoint == "chat":
         url = f"{base}/v1/chat/completions"
         body = {
@@ -371,7 +402,7 @@ def _stream_worker_id(base, prompt, model="kv-model", endpoint="chat"):
             "messages": [{"role": "user", "content": prompt}],
             "max_tokens": 3,
             "stream": True,
-            "nvext": {"annotations": ["worker_instance_id"]},
+            "nvext": {"annotations": annotations},
         }
     else:
         url = f"{base}/v1/completions"
@@ -380,7 +411,7 @@ def _stream_worker_id(base, prompt, model="kv-model", endpoint="chat"):
             "prompt": prompt,
             "max_tokens": 3,
             "stream": True,
-            "nvext": {"annotations": ["worker_instance_id"]},
+            "nvext": {"annotations": annotations},
         }
     with httpx.Client(timeout=30) as client:
         with client.stream("POST", url, json=body) as r:
@@ -388,8 +419,12 @@ def _stream_worker_id(base, prompt, model="kv-model", endpoint="chat"):
             for line in r.iter_lines():
                 if line.startswith(": worker_instance_id"):
                     wid = json.loads(line.split(" ", 2)[2])[0]
+                if line.startswith(": kv_hit_rate"):
+                    hit = int(json.loads(line.split(" ", 2)[2])[0])
                 if line.strip() == "data: [DONE]":
                     break
+    if want_hit_rate:
+        return wid, hit
     return wid
 
 
@@ -401,7 +436,22 @@ def test_kv_routing_e2e_prefix_affinity(kv_cluster):
 
     first = _stream_worker_id(base, long_prefix)
     assert first is not None
-    time.sleep(0.8)  # let KV events reach the router's indexer
+    # settle barrier: wait until the router actually SCORES the cached
+    # prefix on `first` (kv_hit_rate > 0 on a same-prefix request — via
+    # the event indexer or the in-flight overlay, whichever lands first)
+    # instead of sleeping a fixed interval and hoping. That score is the
+    # exact precondition of the repeats assertion below; the probes
+    # themselves are pinned to `first` by the same scoring, so probing
+    # never perturbs the affinity under test.
+    deadline = time.time() + 20
+    hit = 0
+    while time.time() < deadline:
+        wid, hit = _stream_worker_id(base, long_prefix, want_hit_rate=True)
+        assert wid == first, f"affinity broken during settle: {first} vs {wid}"
+        if hit and hit > 0:
+            break
+        time.sleep(0.25)
+    assert hit and hit > 0, "KV events never reached the router's indexer"
     repeats = [_stream_worker_id(base, long_prefix) for _ in range(4)]
     assert all(w == first for w in repeats), f"affinity broken: {first} vs {repeats}"
 
