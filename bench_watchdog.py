@@ -90,6 +90,13 @@ PHASES = [
     ("engine_peer", [PY, "bench_kv_cache.py", "--multi-worker", "--requests",
                      "64", "--quantize", "int8", "--num-pages", "512",
                      "--host-blocks", "1024"], 3600),
+    # PR 13 remeasure: frontend fleet scale-out on the many-core TPU host
+    # — the 1→2→4 frontend tok/s ladder at 32 streams (plus the codec A/B
+    # riding --fleet's per-arm CPU columns) is core-bound on the 2-core
+    # dev box (BENCH_NOTES_r10.md), so the near-linear claim needs a host
+    # where 4 frontends + worker + client actually get their own cores
+    ("engine_fleet", [PY, "bench_serving_overhead.py", "--fleet",
+                      "--streams", "32", "--osl", "96"], 1800),
 ]
 
 

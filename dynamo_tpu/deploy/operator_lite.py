@@ -45,9 +45,13 @@ class KubectlScaler:
     image; kubectl is the stable, auditable interface)."""
 
     def __init__(self, prefill_deployment: str, decode_deployment: str,
-                 namespace: str = "default", kubectl: str = "kubectl"):
+                 namespace: str = "default", kubectl: str = "kubectl",
+                 frontend_deployment: Optional[str] = None):
         self.prefill_deployment = prefill_deployment
         self.decode_deployment = decode_deployment
+        # frontend role (docs/frontend_scaleout.md): None = the planner's
+        # num_frontends is ignored (frontend tier managed elsewhere)
+        self.frontend_deployment = frontend_deployment
         self.namespace = namespace
         self.kubectl = kubectl
 
@@ -69,22 +73,27 @@ class KubectlScaler:
         logger.info("scaled %s to %d: %s", deployment, replicas,
                     out.decode().strip())
 
-    async def set_replicas(self, prefill: int, decode: int) -> None:
+    async def set_replicas(self, prefill: int, decode: int,
+                           frontend: Optional[int] = None) -> None:
         await self._scale(self.prefill_deployment, prefill)
         await self._scale(self.decode_deployment, decode)
+        if frontend is not None and self.frontend_deployment:
+            await self._scale(self.frontend_deployment, frontend)
 
 
 def _parse_decision(raw) -> Optional[tuple]:
-    """(revision, num_prefill, num_decode) from the planner's published
-    decision, or None when absent/malformed."""
+    """(revision, num_prefill, num_decode, num_frontends|None) from the
+    planner's published decision, or None when absent/malformed."""
     if not raw:
         return None
     try:
         doc = json.loads(raw)
+        frontends = doc.get("num_frontends")
         return (
             int(doc["revision"]),
             int(doc["num_prefill_workers"]),
             int(doc["num_decode_workers"]),
+            int(frontends) if frontends is not None else None,
         )
     except (KeyError, ValueError, TypeError, json.JSONDecodeError):
         logger.warning("malformed planner decision: %r", raw[:200])
@@ -209,14 +218,19 @@ class OperatorLite(_PollLoop):
         decision = _parse_decision(await self.client.get(PLANNER_DECISION_KEY))
         if decision is None:
             return False
-        rev, prefill, decode = decision
+        rev, prefill, decode, frontend = decision
         if self.applied_revision is not None and rev <= self.applied_revision:
             return False
-        await self.scaler.set_replicas(prefill, decode)
+        if frontend is not None:
+            await self.scaler.set_replicas(prefill, decode, frontend=frontend)
+        else:
+            # decisions without a frontend count keep working against
+            # scalers that predate the role
+            await self.scaler.set_replicas(prefill, decode)
         self.applied_revision = rev
         self.reconciles += 1
-        logger.info("reconciled rev=%d -> prefill=%d decode=%d",
-                    rev, prefill, decode)
+        logger.info("reconciled rev=%d -> prefill=%d decode=%d frontend=%s",
+                    rev, prefill, decode, frontend)
         return True
 
 
@@ -245,6 +259,10 @@ async def main(argv: Optional[Sequence[str]] = None) -> None:
                     "metadata.namespace in --graph mode, else 'default')")
     ap.add_argument("--prefill-deployment", default="dynamo-prefill")
     ap.add_argument("--decode-deployment", default="dynamo-decode")
+    ap.add_argument("--frontend-deployment", default=None,
+                    help="deployment scaled to the planner's num_frontends "
+                    "(docs/frontend_scaleout.md); unset = frontend tier "
+                    "not operator-managed")
     ap.add_argument("--model", default="llama3-8b", help="local backend model")
     ap.add_argument("--graph", default=None,
                     help="DynamoGraphDeployment manifest: reconcile the "
@@ -279,6 +297,7 @@ async def main(argv: Optional[Sequence[str]] = None) -> None:
         scaler = KubectlScaler(
             args.prefill_deployment, args.decode_deployment,
             args.namespace or "default",
+            frontend_deployment=args.frontend_deployment,
         )
     else:
         scaler = _build_local_scaler(args)

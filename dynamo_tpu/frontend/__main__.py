@@ -9,6 +9,7 @@ router mode.
 import argparse
 import asyncio
 import logging
+import os
 
 from dynamo_tpu.llm.discovery import ModelManager, ModelWatcher
 from dynamo_tpu.llm.http import HttpService
@@ -46,10 +47,24 @@ def parse_args(argv=None):
     )
     ap.add_argument("--kv-overlap-score-weight", type=float, default=1.0)
     ap.add_argument("--router-temperature", type=float, default=0.0)
-    ap.add_argument("--router-replica-sync", action="store_true",
+    ap.add_argument("--router-replica-sync", "--mirror-routing",
+                    action="store_true",
                     help="mirror routing decisions between KV-mode frontends "
-                    "(reference kv_router/subscriber.rs)")
-    return ap.parse_args(argv)
+                    "sharing discovery, so replica fleets keep one view of "
+                    "active blocks / in-flight prefixes (reference "
+                    "kv_router/subscriber.rs; docs/frontend_scaleout.md)")
+    args = ap.parse_args(argv)
+    # frontend replicas are stateless over shared discovery: the planner /
+    # operator-lite scales them with ONE argv template, so each replica
+    # offsets its listen ports by its index (DYN_WORKER_INDEX, the same
+    # contract workers use; docs/frontend_scaleout.md)
+    index = int(os.environ.get("DYN_WORKER_INDEX") or 0)
+    if index:
+        if args.http_port:
+            args.http_port += index
+        if args.grpc_port:
+            args.grpc_port += index
+    return args
 
 
 async def main():

@@ -284,13 +284,9 @@ class KvbmDistributed:
 
         async def pull_one(addr: str, hs: List[int]):
             t_peer = time.perf_counter()
-            try:
-                k, v = await pull_kvbm_blocks(
-                    addr, hs, self.manager.block_shape, self.manager.dtype
-                )
-            except Exception:
-                self.remote_pull_failures += 1
-                raise
+            k, v = await pull_kvbm_blocks(
+                addr, hs, self.manager.block_shape, self.manager.dtype
+            )
             ms = (time.perf_counter() - t_peer) * 1000.0
             prev = self._pull_ms_per_block.get(addr)
             per_block = ms / max(len(hs), 1)
@@ -304,10 +300,31 @@ class KvbmDistributed:
 
         # independent peers pull CONCURRENTLY: this is admission/TTFT
         # critical path, and a prefix split across N owners (worker
-        # churn) must cost max(per-peer), not the sum
-        await asyncio.gather(
-            *(pull_one(addr, hs) for addr, hs in plan.items())
-        )
+        # churn) must cost max(per-peer), not the sum. The whole gather is
+        # one onboard attempt: the FIRST failure dooms it (the caller
+        # falls back to recompute), so siblings are cancelled and drained
+        # — not left racing to fill `parts` nobody will read — and the
+        # attempt counts as ONE typed failure however many peers it hit.
+        tasks = [
+            asyncio.create_task(pull_one(addr, hs))
+            for addr, hs in plan.items()
+        ]
+        async def _reap_siblings():
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+
+        try:
+            await asyncio.gather(*tasks)
+        except asyncio.CancelledError:
+            # the ONBOARD was cancelled (slot abort/teardown), no peer
+            # failed: clean the siblings up without charging a failure
+            await asyncio.shield(_reap_siblings())
+            raise
+        except BaseException:
+            await asyncio.shield(_reap_siblings())
+            self.remote_pull_failures += 1
+            raise
         self.remote_onboards += 1
         total_ms = (time.perf_counter() - t0) * 1000.0
         self._pull_ms_sum += total_ms

@@ -163,9 +163,26 @@ class KvBlockManager:
 
     def drain_evicted(self) -> List[int]:
         """Hashes dropped from ALL tiers since the last drain (the
-        announcement mesh retracts these as `evicted`)."""
+        announcement mesh retracts these as `evicted`).
+
+        Re-checked against the CURRENT tier contents before handing out:
+        a hash evicted and then RE-STORED between the drop and this drain
+        (same-prefix traffic re-offloading, a peer promotion) is still
+        held here — retracting it would tell peers to forget a live
+        owner, and nothing re-announces until the block churns again."""
         with self._lock:
-            out, self._evicted_pending = self._evicted_pending, []
+            pending, self._evicted_pending = self._evicted_pending, []
+            out: List[int] = []
+            seen = set()
+            for h in pending:
+                if h in seen:
+                    continue
+                seen.add(h)
+                present = (
+                    self.host is not None and self.host.has(h)
+                ) or (self.disk is not None and self.disk.has(h))
+                if not present:
+                    out.append(h)
             return out
 
     def all_hashes(self) -> List[int]:

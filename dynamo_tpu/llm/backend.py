@@ -12,6 +12,7 @@ import asyncio
 import logging
 from typing import Any, AsyncIterator, Dict, List, Optional
 
+from ..runtime.compute import ComputePool
 from ..runtime.config import _env
 from ..runtime.engine import AsyncEngine, Context
 from ..runtime.pipeline import Operator
@@ -193,6 +194,15 @@ class Backend(Operator):
                  tokenizer: Optional[Tokenizer] = None):
         self.inner = inner
         self.tokenizer = tokenizer
+        # detok offload (docs/frontend_scaleout.md): batches big enough to
+        # amortize the executor hop — and every stop-string scan, whose
+        # worst case (replay on a hit, long holdback windows) is exactly
+        # the work that must not stall the shared event loop — run on the
+        # bounded compute pool; tiny batches stay inline where the hop
+        # would cost more than it frees. Read per-instance so test
+        # clusters can flip the env after import.
+        self._pool = bool(_env("DYN_DETOK_POOL", True, bool))
+        self._pool_min = max(_env("DYN_DETOK_POOL_MIN_TOKENS", 8, int), 1)
 
     async def generate(
         self, request: PreprocessedRequest, context: Context
@@ -222,8 +232,18 @@ class Backend(Operator):
             if out.log_probs is None:
                 # batched fast path: one tokenizer call for the whole
                 # delta batch; tokens past a stop-string hit are dropped
-                # so usage accounting matches per-token stepping
-                delta, n_used, stopped = decoder.step_batch(out.token_ids)
+                # so usage accounting matches per-token stepping. The
+                # decoder is confined to this coroutine, so pool execution
+                # is sequential per request — byte-identical to inline.
+                ids = out.token_ids
+                if self._pool and ids and (
+                    stop_strings or len(ids) >= self._pool_min
+                ):
+                    delta, n_used, stopped = await ComputePool.get().run(
+                        decoder.step_batch, ids
+                    )
+                else:
+                    delta, n_used, stopped = decoder.step_batch(ids)
                 if n_used < len(out.token_ids):
                     out.token_ids = out.token_ids[:n_used]
                 if delta:

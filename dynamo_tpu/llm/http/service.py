@@ -935,6 +935,13 @@ class HttpService:
                     break
             if not error and not finish_sent:
                 await resp.write(_sse(gen.finish_chunk_json("stop")))
+            if not error and req.stream_options \
+                    and req.stream_options.include_usage:
+                # completions parity with the chat route (and the KServe
+                # stream's completion_tokens): a final usage chunk on ask
+                await resp.write(
+                    _sse(gen.usage_chunk().model_dump_json(exclude_none=True))
+                )
             await resp.write(b"data: [DONE]\n\n")
         except (ConnectionResetError, asyncio.CancelledError):
             ctx.kill()

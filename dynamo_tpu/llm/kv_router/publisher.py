@@ -110,6 +110,9 @@ class WorkerMetricsPublisher:
         if ep is not None:
             stats.setdefault("frames_total", ep.frames_total)
             stats.setdefault("items_total", ep.items_total)
+            # zero-copy token path visibility (docs/frontend_scaleout.md):
+            # frames that rode the ENC_TOK binary payload
+            stats.setdefault("frames_binary", ep.frames_binary)
         return stats
 
     async def _loop(self):

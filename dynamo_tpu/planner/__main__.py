@@ -37,6 +37,15 @@ def parse_args(argv=None) -> argparse.Namespace:
     )
     ap.add_argument("--prefill-cmd", default="", help="argv for a prefill worker (local connector)")
     ap.add_argument("--decode-cmd", default="", help="argv for a decode worker (local connector)")
+    ap.add_argument("--frontend-cmd", default="",
+                    help="argv for a frontend replica (local connector); "
+                    "each replica's DYN_WORKER_INDEX offsets its ports "
+                    "(docs/frontend_scaleout.md)")
+    ap.add_argument("--workers-per-frontend", type=int, default=None,
+                    help="size the frontend tier to ceil(workers / N) "
+                    "replicas alongside every applied worker target "
+                    "(default: DYN_PLANNER_WORKERS_PER_FRONTEND; 0 = "
+                    "frontends not planner-managed)")
     ap.add_argument("--namespace", default="dynamo")
     ap.add_argument("--prefill-component", default="prefill",
                     help="discovery component name counted as prefill capacity")
@@ -77,6 +86,7 @@ async def amain(args: argparse.Namespace) -> None:
         connector = LocalProcessConnector(
             shlex.split(args.prefill_cmd), shlex.split(args.decode_cmd),
             ready_fn=counts.ready_fn(),
+            frontend_cmd=shlex.split(args.frontend_cmd),
         )
     else:
         connector = VirtualConnector(disc)
@@ -91,6 +101,8 @@ async def amain(args: argparse.Namespace) -> None:
             max_chip_budget=args.max_chip_budget,
             min_endpoint=args.min_endpoint,
             load_predictor=args.load_predictor,
+            **({"workers_per_frontend": args.workers_per_frontend}
+               if args.workers_per_frontend is not None else {}),
         ),
         PrefillInterpolator(profile_results_dir=args.profile_results_dir),
         DecodeInterpolator(profile_results_dir=args.profile_results_dir),

@@ -35,9 +35,13 @@ PLANNER_DECISION_KEY = "v1/planner/decision"
 class NoopConnector:
     def __init__(self):
         self.decisions: List[Tuple[int, int]] = []
+        self.frontend_decisions: List[int] = []
 
-    async def set_replicas(self, prefill: int, decode: int) -> None:
+    async def set_replicas(self, prefill: int, decode: int,
+                           frontend: Optional[int] = None) -> None:
         self.decisions.append((prefill, decode))
+        if frontend is not None:
+            self.frontend_decisions.append(frontend)
 
 
 class VirtualConnector:
@@ -62,7 +66,8 @@ class VirtualConnector:
                 pass  # malformed stored doc: restart revisions from 0
         return 0
 
-    async def set_replicas(self, prefill: int, decode: int) -> None:
+    async def set_replicas(self, prefill: int, decode: int,
+                           frontend: Optional[int] = None) -> None:
         f = faults.FAULTS
         if f.enabled:
             await f.on("planner.connector")  # `error` raises; planner retries
@@ -76,9 +81,14 @@ class VirtualConnector:
                 "revision": self.revision,
                 "ts": time.time(),
             }
+            if frontend is not None:
+                # frontend role (docs/frontend_scaleout.md): stateless
+                # replicas over shared discovery, scaled like workers.
+                # Absent = orchestrators leave the frontend tier alone.
+                doc["num_frontends"] = frontend
             await self.client.put(PLANNER_DECISION_KEY, json.dumps(doc).encode())
-            logger.info("published planner decision rev=%d p=%d d=%d",
-                        self.revision, prefill, decode)
+            logger.info("published planner decision rev=%d p=%d d=%d f=%s",
+                        self.revision, prefill, decode, frontend)
 
 
 class LocalProcessConnector:
@@ -117,9 +127,18 @@ class LocalProcessConnector:
         spawn_retries: int = 3,
         ready_fn: Optional[Callable[[str], Awaitable[int]]] = None,
         ready_timeout: float = 30.0,
+        frontend_cmd: Sequence[str] = (),
     ):
         self.prefill_cmd = list(prefill_cmd)
         self.decode_cmd = list(decode_cmd)
+        # frontend role (docs/frontend_scaleout.md): stateless replicas of
+        # `python -m dynamo_tpu.frontend` — each child's DYN_WORKER_INDEX
+        # offsets its HTTP/gRPC/metrics ports, so one argv template serves
+        # the whole tier. Readiness gating is skipped for this role:
+        # frontends register no worker Instance records, so ready_fn's
+        # discovery count cannot see them (their liveness check is the
+        # alive-children reap + the next reconcile).
+        self.frontend_cmd = list(frontend_cmd)
         self.env = env
         self.grace_s = grace_s
         self.spawn_retries = spawn_retries
@@ -128,12 +147,23 @@ class LocalProcessConnector:
         self.procs: Dict[str, List[asyncio.subprocess.Process]] = {
             "prefill": [],
             "decode": [],
+            "frontend": [],
         }
-        self._want: Optional[Tuple[int, int]] = None  # last asked (p, d)
+        self._cmds = {
+            "prefill": self.prefill_cmd,
+            "decode": self.decode_cmd,
+            "frontend": self.frontend_cmd,
+        }
+        # last asked (p, d, f); f None = frontend tier never asked
+        self._want: Optional[Tuple[int, int, Optional[int]]] = None
 
     def counts(self) -> Tuple[int, int]:
         self._reap()
         return len(self.procs["prefill"]), len(self.procs["decode"])
+
+    def frontend_count(self) -> int:
+        self._reap()
+        return len(self.procs["frontend"])
 
     def _reap(self) -> None:
         for role in self.procs:
@@ -152,7 +182,7 @@ class LocalProcessConnector:
         return idx
 
     async def _spawn(self, role: str) -> None:
-        cmd = self.prefill_cmd if role == "prefill" else self.decode_cmd
+        cmd = self._cmds[role]
         env = dict(os.environ if self.env is None else self.env)
         index = self._next_index(role)
         env["DYN_WORKER_INDEX"] = str(index)
@@ -237,15 +267,18 @@ class LocalProcessConnector:
             await proc.wait()
         logger.info("stopped %s worker pid=%d", role, proc.pid)
 
-    async def set_replicas(self, prefill: int, decode: int) -> None:
+    async def set_replicas(self, prefill: int, decode: int,
+                           frontend: Optional[int] = None) -> None:
         f = faults.FAULTS
         if f.enabled:
             await f.on("planner.connector")  # `error` raises; planner retries
         self._reap()
         backoff = Backoff.seeded("worker.spawn", base=0.05, max_delay=1.0)
-        for role, want in (("prefill", prefill), ("decode", decode)):
-            cmd = self.prefill_cmd if role == "prefill" else self.decode_cmd
-            if not cmd:
+        roles = [("prefill", prefill), ("decode", decode)]
+        if frontend is not None:
+            roles.append(("frontend", frontend))
+        for role, want in roles:
+            if not self._cmds[role]:
                 continue  # role not managed here (e.g. decode-only soak)
             grew = False
             while len(self.procs[role]) < want:
@@ -257,7 +290,9 @@ class LocalProcessConnector:
                 grew = True
             while len(self.procs[role]) > want:
                 await self._kill(role)
-            if grew:
+            if grew and role != "frontend":
+                # frontends register no Instance records — ready_fn's
+                # discovery count cannot gate them (class docstring)
                 await self._wait_ready(role, want, backoff)
         # committed only on SUCCESS: the planner treats a raised
         # set_replicas as uncommitted and holds its own target, so
@@ -266,32 +301,38 @@ class LocalProcessConnector:
         # fleet past what the planner believes exists (and any partial
         # spawns from the failed attempt are culled by the next
         # reconcile's kill-down to the old counts)
-        self._want = (prefill, decode)
+        if frontend is None and self._want is not None:
+            frontend = self._want[2]  # an unasked tier keeps its target
+        self._want = (prefill, decode, frontend)
 
     async def reconcile(self) -> None:
         """Re-assert the last committed replica counts: respawn replicas
         that died since (the planner calls this every interval)."""
         if self._want is None:
             return
-        p, d = self._want
+        p, d, fr = self._want
         self._reap()
         dead = [
             (role, want, len(self.procs[role]))
             for role, want, cmd in (
-                ("prefill", p, self.prefill_cmd), ("decode", d, self.decode_cmd)
+                ("prefill", p, self.prefill_cmd),
+                ("decode", d, self.decode_cmd),
+                ("frontend", fr or 0, self.frontend_cmd),
             )
             # only roles this connector actually manages can "die" on it
-            if cmd and len(self.procs[role]) < want
+            if cmd and want is not None and len(self.procs[role]) < want
         ]
         if dead:
             logger.warning(
                 "reconcile: replica(s) died: %s",
                 ", ".join(f"{r}: have {h}, want {w}" for r, w, h in dead),
             )
-        await self.set_replicas(p, d)
+        await self.set_replicas(p, d, frontend=fr)
 
     async def shutdown(self) -> None:
-        await self.set_replicas(0, 0)
+        await self.set_replicas(
+            0, 0, frontend=0 if self.frontend_cmd else None
+        )
 
 
 class DiscoveryWorkerCounts:

@@ -55,6 +55,12 @@ class SlaArgs:
     max_step: int = 2              # max replica delta per decision, per role
     scale_down_stable_intervals: int = 2  # consecutive below-target intervals
     #                                       required before stepping down
+    # frontend role (docs/frontend_scaleout.md): with N > 0 every applied
+    # worker target also sizes the frontend tier to ceil((p + d) / N)
+    # stateless replicas — a monotone function of the governed worker
+    # target, so it inherits the governor's cooldown/hysteresis and adds
+    # no flapping mode of its own. 0 = frontends not planner-managed.
+    workers_per_frontend: int = 0
 
     def effective_metrics_max_age(self) -> float:
         return self.metrics_max_age or 2.5 * self.adjustment_interval
@@ -77,6 +83,10 @@ class SlaArgs:
             scale_down_stable_intervals=_env(
                 "DYN_PLANNER_SCALE_DOWN_STABLE_INTERVALS",
                 cls.scale_down_stable_intervals, int,
+            ),
+            workers_per_frontend=_env(
+                "DYN_PLANNER_WORKERS_PER_FRONTEND",
+                cls.workers_per_frontend, int,
             ),
         )
         for k, v in overrides.items():
@@ -113,7 +123,12 @@ class WorkerCounts(Protocol):
 
 
 class PlannerConnector(Protocol):
-    async def set_replicas(self, prefill: int, decode: int) -> None: ...
+    async def set_replicas(self, prefill: int, decode: int,
+                           frontend: Optional[int] = None) -> None:
+        """`frontend` is only passed when the planner manages the frontend
+        tier (SlaArgs.workers_per_frontend > 0); connectors that predate
+        the role keep working in the default mode."""
+        ...
 
 
 @dataclass
@@ -367,9 +382,16 @@ class Planner:
         reset) must not strand the replica count — on final failure the
         target is NOT committed, so the next interval re-decides and
         re-asserts it."""
+        kwargs = {}
+        if self.args.workers_per_frontend > 0:
+            # frontend tier rides every applied worker target: stateless
+            # replicas sized to the fleet (docs/frontend_scaleout.md)
+            kwargs["frontend"] = max(
+                1, math.ceil(sum(target) / self.args.workers_per_frontend)
+            )
         try:
             await retry_async(
-                lambda: self.connector.set_replicas(*target),
+                lambda: self.connector.set_replicas(*target, **kwargs),
                 attempts=3,
                 backoff=Backoff.seeded("planner.connector", base=0.1, max_delay=1.0),
                 desc=f"connector set_replicas{target}", log=logger,

@@ -276,7 +276,11 @@ class InProcWorkerPool:
             desc="in-proc worker spawn", log=logger,
         )
 
-    async def set_replicas(self, prefill: int, decode: int) -> None:
+    async def set_replicas(self, prefill: int, decode: int,
+                           frontend: Optional[int] = None) -> None:
+        # `frontend` accepted and ignored: the in-proc soak runs one
+        # SoakFrontend; frontend-tier scaling is exercised through
+        # LocalProcessConnector(frontend_cmd=frontend_cmd(...))
         from ..runtime import faults
 
         f = faults.FAULTS
@@ -324,6 +328,23 @@ def mocker_cmd(discovery: str, *, model_name: str = "mock-model",
         "--discovery", discovery,
         "--block-size", str(block_size),
         "--speedup-ratio", str(speedup_ratio),
+        *extra,
+    ]
+
+
+def frontend_cmd(discovery: str, *, http_port: int,
+                 router_mode: str = "round-robin",
+                 extra: Sequence[str] = ()) -> List[str]:
+    """argv template for LocalProcessConnector(frontend_cmd=...): one
+    stateless frontend replica on the shared discovery plane. Replica i
+    listens on http_port + i (the frontend offsets by DYN_WORKER_INDEX,
+    docs/frontend_scaleout.md)."""
+    return [
+        sys.executable, "-m", "dynamo_tpu.frontend",
+        "--discovery", discovery,
+        "--http-host", "127.0.0.1",
+        "--http-port", str(http_port),
+        "--router-mode", router_mode,
         *extra,
     ]
 

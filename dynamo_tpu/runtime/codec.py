@@ -13,7 +13,8 @@ from __future__ import annotations
 
 import asyncio
 import struct
-from typing import Any, Optional, Tuple
+from array import array
+from typing import Any, List, Optional, Tuple
 
 import msgpack
 
@@ -64,6 +65,14 @@ OP_STATUS = "status"
 PUSH_WATCH = "watch"
 PUSH_MSG = "msg"
 
+# payload encodings riding T_DATA frames, "enc" channel
+# (runtime/request_plane.py).  Absent = msgpack (the default payload
+# serializer).  A stream NEGOTIATES binary encodings: the client's T_REQ
+# carries `bin: 1` and the server answers pure token-delta batches with
+# `enc: "tok"` frames; anything the encoding cannot carry (finish
+# reasons, logprobs, text riders) falls back to msgpack per frame.
+ENC_TOK = "tok"
+
 # machine-readable error codes riding T_ERR frames, "code" channel
 # (runtime/request_plane.py).  The human `error` string is for logs; the
 # code is what clients DISPATCH on — drift here is the same silent-hang
@@ -103,6 +112,10 @@ FRAME_TAGS = {
     "push": {
         PUSH_WATCH: "server-pushed watch event (type=put|delete)",
         PUSH_MSG: "server-pushed topic message",
+    },
+    "enc": {
+        ENC_TOK: "T_DATA payload is packed u32 token deltas (zero-copy "
+                 "token path), not msgpack; absent enc = msgpack",
     },
 }
 
@@ -167,3 +180,126 @@ def pack(obj: Any) -> bytes:
 
 def unpack(data: bytes) -> Any:
     return msgpack.unpackb(data, raw=False)
+
+
+# --------------------------------------------------------------------- #
+# ENC_TOK binary token-delta payload (zero-copy token path)
+# --------------------------------------------------------------------- #
+# Steady-state decode traffic is a stream of pure token deltas — either
+# bare `{"token_ids": [...]}` dicts or the engines' Annotated wrapper
+# `{"data": {"token_ids": [...]}}`; encoding each as a msgpack map (and
+# re-materializing k dicts per frame on the frontend) is pure per-token
+# overhead. ENC_TOK packs a whole coalesced batch of one shape as flat
+# little-endian u32s:
+#
+#     u32 n_items | u32 flags | u32 len[n_items] | u32 ids[sum(len)]
+#
+# `flags` bit 0 records the wrapper (0 = bare, 1 = Annotated-wrapped) so
+# decode reproduces the msgpack path's dicts SHAPE-identically; all other
+# bits are reserved — a future variant sets one, and decoders reject what
+# they don't speak instead of misreading. Item boundaries are preserved.
+
+_TOK_HDR = struct.Struct("<II")
+_TOK_FLAG_WRAPPED = 1  # items were {"data": {"token_ids": [...]}}
+# array typecode with a 4-byte item (platform-dependent: "I" on every
+# supported platform, "L" kept as a guard for exotic ABIs)
+_U32 = "I" if array("I").itemsize == 4 else "L"
+assert array(_U32).itemsize == 4, "no 4-byte unsigned array typecode"
+_BIG_ENDIAN = struct.pack("=I", 1) != struct.pack("<I", 1)
+
+
+def token_delta_kind(item: Any) -> int:
+    """0 = not a pure token delta (must ride msgpack); 1 = bare
+    `{"token_ids": [...]}`; 2 = Annotated-wrapped
+    `{"data": {"token_ids": [...]}}` (what the engines emit). Anything
+    else — finish reasons, text riders, logprobs, annotation events —
+    forces the frame back to msgpack. Shape-only (hot path): id VALUES
+    are validated by the array pack itself, which raises on anything
+    outside u32 and falls back to msgpack (try_pack_token_run)."""
+    if type(item) is not dict or len(item) != 1:
+        return 0
+    ids = item.get("token_ids")
+    if ids is not None:
+        return 1 if type(ids) is list and ids else 0
+    d = item.get("data")
+    if type(d) is dict and len(d) == 1:
+        ids = d.get("token_ids")
+        if type(ids) is list and ids:
+            return 2
+    return 0
+
+
+def pack_token_items(items: List[dict], wrapped: bool = False) -> bytes:
+    """Encode pure token-delta items of ONE shape (`wrapped` selects the
+    Annotated wrapper); the caller guarantees a uniform
+    `token_delta_kind` for every item. Raises TypeError/OverflowError on
+    ids outside u32 — callers fall back to msgpack."""
+    if wrapped:
+        items = [it["data"] for it in items]
+    lens = array(_U32, [len(it["token_ids"]) for it in items])
+    ids = array(_U32)
+    for it in items:
+        ids.extend(it["token_ids"])
+    if _BIG_ENDIAN:  # wire order is little-endian
+        lens.byteswap()
+        ids.byteswap()
+    flags = _TOK_FLAG_WRAPPED if wrapped else 0
+    return _TOK_HDR.pack(len(items), flags) + lens.tobytes() + ids.tobytes()
+
+
+def try_pack_token_run(items: List[Any]) -> Optional[Tuple[bytes, int]]:
+    """Pack the LEADING run of pure same-shape token deltas as an ENC_TOK
+    payload. Returns (payload, run_length), or None when items[0] is not
+    a clean token delta (the whole batch then rides msgpack)."""
+    kind = token_delta_kind(items[0])
+    if not kind:
+        return None
+    pos = 1
+    while pos < len(items) and token_delta_kind(items[pos]) == kind:
+        pos += 1
+    try:
+        return pack_token_items(items[:pos], wrapped=kind == 2), pos
+    except (TypeError, OverflowError):
+        # exotic ids (negative, > u32, non-int): msgpack carries anything
+        return None
+
+
+def unpack_token_items(payload: bytes, merge: bool = False) -> List[dict]:
+    """Decode an ENC_TOK payload back into item dicts, in order.
+
+    merge=False reproduces the msgpack path's items shape- and
+    boundary-identically. merge=True returns ONE item carrying the whole
+    frame's ids — the request-plane client uses this: item boundaries
+    inside a frame of pure token deltas carry no information (the
+    frontend's merge_token_deltas concatenates every same-tick delta
+    anyway), and one dict per frame instead of k is most of the decode
+    saving. Token counts, order, and the wrapper shape are preserved."""
+    n_items, flags = _TOK_HDR.unpack_from(payload, 0)
+    if flags & ~_TOK_FLAG_WRAPPED:
+        raise ValueError(f"unknown ENC_TOK flags {flags:#x}")
+    wrapped = bool(flags & _TOK_FLAG_WRAPPED)
+    off = _TOK_HDR.size
+    lens = array(_U32)
+    lens.frombytes(payload[off : off + 4 * n_items])
+    off += 4 * n_items
+    ids = array(_U32)
+    ids.frombytes(payload[off:])
+    if _BIG_ENDIAN:
+        lens.byteswap()
+        ids.byteswap()
+    total = sum(lens)
+    if total != len(ids):
+        raise ValueError(
+            f"ENC_TOK payload inconsistent: lens sum {total} != {len(ids)} ids"
+        )
+    if merge:
+        d: dict = {"token_ids": ids.tolist()}
+        return [{"data": d} if wrapped else d]
+    out: List[dict] = []
+    pos = 0
+    tolist = ids.tolist()
+    for n in lens:
+        d = {"token_ids": tolist[pos : pos + n]}
+        out.append({"data": d} if wrapped else d)
+        pos += n
+    return out

@@ -147,11 +147,31 @@ class DistributedRuntime:
         if drt.config.system_enabled:
             from .system_status import SystemStatusServer
 
+            port = drt.config.system_port
+            if port > 0:
+                # planner-scaled replicas share one argv/env template
+                # (docs/frontend_scaleout.md): offset the metrics port by
+                # the replica index so co-located replicas don't collide
+                port += int(os.environ.get("DYN_WORKER_INDEX") or 0)
             drt.system_status_server = SystemStatusServer(
                 drt.system_health, drt.metrics,
-                host=drt.config.system_host, port=drt.config.system_port,
+                host=drt.config.system_host, port=port,
             )
-            await drt.system_status_server.start()
+            try:
+                await drt.system_status_server.start()
+            except OSError:
+                # a taken port must degrade the scrape, never the replica:
+                # fall back to an ephemeral port (logged; the prometheus
+                # target is wrong until the operator fixes the offsets)
+                logger.warning(
+                    "system-status port %d already taken; serving metrics "
+                    "on an ephemeral port instead", port,
+                )
+                drt.system_status_server = SystemStatusServer(
+                    drt.system_health, drt.metrics,
+                    host=drt.config.system_host, port=0,
+                )
+                await drt.system_status_server.start()
         if drt.health_check_manager is not None:
             drt.health_check_manager.start()
         return drt

@@ -160,6 +160,27 @@ ENV_REGISTRY: tuple = (
            "frame (and on token deltas merged per detokenizer batch on "
            "the frontend). Bounds frame size and per-batch latency.",
            "runtime/request_plane.py"),
+    EnvVar("DYN_WIRE_BINARY_TOKENS", "bool", "1",
+           "Zero-copy token wire path: the request-plane client "
+           "advertises ENC_TOK on every stream, and workers answer pure "
+           "token-delta batches as packed little-endian u32 payloads "
+           "instead of msgpack dicts (per-frame msgpack fallback for "
+           "anything the encoding cannot carry). 0 = msgpack everywhere "
+           "(the pre-PR-13 wire, and the codec A/B baseline arm).",
+           "runtime/request_plane.py"),
+    EnvVar("DYN_DETOK_POOL", "bool", "1",
+           "Run frontend detokenization batches on the bounded compute "
+           "pool instead of the event loop when they are big enough to "
+           "amortize the hop (DYN_DETOK_POOL_MIN_TOKENS) or carry a "
+           "stop-string scan — one slow stream's scan must not stall "
+           "every other stream's SSE writer. 0 = always inline.",
+           "llm/backend.py"),
+    EnvVar("DYN_DETOK_POOL_MIN_TOKENS", "int", "8",
+           "Smallest token-delta batch worth offloading to the compute "
+           "pool under DYN_DETOK_POOL (stop-string batches always "
+           "offload); smaller batches detokenize inline — the executor "
+           "hop would cost more than it frees.",
+           "llm/backend.py"),
     # -- fault injection (dynochaos) ----------------------------------- #
     EnvVar("DYN_FAULT_PLAN", "str", None,
            "dynochaos fault plan: `;`-separated `point[:spec,...]` rules "
@@ -223,6 +244,13 @@ ENV_REGISTRY: tuple = (
            "Consecutive intervals the model must ask for below-current "
            "capacity before the planner steps down (scale-up is never "
            "hysteresis-gated: restoring SLA outranks fleet stability).",
+           "planner/planner_core.py"),
+    EnvVar("DYN_PLANNER_WORKERS_PER_FRONTEND", "int", "0",
+           "Frontend-role scaling: with N > 0 the planner sizes the "
+           "frontend tier to ceil(total workers / N) replicas alongside "
+           "every applied worker target (frontends are stateless over "
+           "shared discovery, docs/frontend_scaleout.md). 0 = frontends "
+           "are not planner-managed (the pre-PR-13 behavior).",
            "planner/planner_core.py"),
     # -- frontend admission gate (gate/, docs/overload.md) -------------- #
     EnvVar("DYN_GATE", "bool", "1",

@@ -45,6 +45,7 @@ from typing import Any, AsyncIterator, Awaitable, Callable, Dict, Optional, Tupl
 
 from . import codec, faults
 from .codec import (
+    ENC_TOK,
     ERR_DEADLINE,
     ERR_DRAINING,
     T_CANCEL,
@@ -100,6 +101,9 @@ class EndpointStats:
         # hardware e2e rows self-diagnose serving-plane overhead
         self.frames_total = 0
         self.items_total = 0
+        # zero-copy token path visibility: frames that rode the ENC_TOK
+        # binary payload instead of msgpack (docs/wire_protocol.md)
+        self.frames_binary = 0
         self.last_request_at = time.monotonic()  # idle tracking (health canary)
         self.data = {}  # engine-published stats blob (ForwardPassMetrics)
 
@@ -110,6 +114,7 @@ class EndpointStats:
             "errors_total": self.errors_total,
             "frames_total": self.frames_total,
             "items_total": self.items_total,
+            "frames_binary": self.frames_binary,
             "data": self.data,
         }
 
@@ -247,6 +252,11 @@ class RequestPlaneServer:
         subject = control.get("subject", "")
         handler = self._handlers.get(subject)
         stats = self._stats.get(subject)
+        # zero-copy token path negotiation: the caller's T_REQ advertises
+        # `bin` when it can decode ENC_TOK payloads; the writer loop below
+        # then ships pure token-delta batches as packed u32s instead of
+        # msgpack dicts, falling back per frame for anything else
+        want_binary = bool(control.get("bin"))
 
         async def send(ctrl: dict, pl: bytes = b""):
             ctrl["stream"] = stream_id
@@ -320,12 +330,33 @@ class RequestPlaneServer:
                         break
                     items.append(item)
                 if stats:
-                    stats.frames_total += 1
                     stats.items_total += len(items)
-                if len(items) == 1:
-                    await send({"t": T_DATA}, codec.pack(items[0]))
-                else:
-                    await send({"t": T_DATA, "n": len(items)}, codec.pack(items))
+                pos = 0
+                if want_binary:
+                    # leading run of pure token deltas (of one wrapper
+                    # shape) rides ENC_TOK: the steady-state decode frame
+                    # is one flat u32 pack, no per-item dict encode (and
+                    # ONE merged dict to decode caller-side); the
+                    # remainder — typically just the finish item — falls
+                    # back to msgpack below
+                    packed = codec.try_pack_token_run(items)
+                    if packed is not None:
+                        payload_bin, pos = packed
+                        if stats:
+                            stats.frames_total += 1
+                            stats.frames_binary += 1
+                        await send(
+                            {"t": T_DATA, "n": pos, "enc": ENC_TOK},
+                            payload_bin,
+                        )
+                rest = items[pos:]
+                if rest:
+                    if stats:
+                        stats.frames_total += 1
+                    if len(rest) == 1:
+                        await send({"t": T_DATA}, codec.pack(rest[0]))
+                    else:
+                        await send({"t": T_DATA, "n": len(rest)}, codec.pack(rest))
             kind, item = terminal
             if kind == _DONE:
                 await send({"t": T_DONE})
@@ -411,6 +442,10 @@ class RequestPlaneClient:
     def __init__(self, connect_timeout: float = 5.0):
         self._conns: Dict[str, _Connection] = {}
         self._stream_ids = itertools.count(1)
+        # zero-copy token path: advertise ENC_TOK decoding on every stream
+        # we open (per-client so test clusters can flip the env after
+        # import, like the server's coalesce knobs)
+        self.binary_tokens = bool(_env("DYN_WIRE_BINARY_TOKENS", True, bool))
         # per-address dial serialization.  Entries are PRUNED when the
         # address's connection dies (recv-loop done-callback below): under
         # worker churn the router dials a new host:port per replacement,
@@ -567,6 +602,8 @@ class RequestPlaneClient:
         conn.streams[stream_id] = queue
 
         control = {"t": T_REQ, "stream": stream_id, "subject": subject, "ctx_id": ctx.id}
+        if self.binary_tokens:
+            control["bin"] = 1
         remaining = ctx.time_remaining()
         if remaining is not None:
             # ship the REMAINING budget, not an absolute time: monotonic
@@ -630,7 +667,22 @@ class RequestPlaneClient:
                             conn.closed = True
                             conn.writer.close()
                             raise StreamLost("injected: connection severed mid-stream")
-                    if control.get("n"):
+                    enc = control.get("enc")
+                    if enc == ENC_TOK:
+                        # binary token-delta batch: flat u32 decode into
+                        # ONE merged delta — the same concatenation the
+                        # frontend's merge_token_deltas would apply to the
+                        # frame's items (token counts/order preserved)
+                        for it in codec.unpack_token_items(
+                            payload, merge=True
+                        ):
+                            yield it
+                    elif enc is not None:
+                        raise EngineError(
+                            f"unknown payload encoding {enc!r} (worker "
+                            "newer than this client?)"
+                        )
+                    elif control.get("n"):
                         # coalesced multi-item frame: the payload is the
                         # packed item list, committed atomically on the
                         # wire — yield in order

@@ -53,7 +53,7 @@ def grpc_cluster():
             time.sleep(0.5)
         else:
             raise TimeoutError("worker never registered")
-    yield f"127.0.0.1:{grpc_port}"
+    yield {"grpc": f"127.0.0.1:{grpc_port}", "http": base}
     worker.stop()
     fe.stop()
 
@@ -72,7 +72,7 @@ def test_kserve_live_ready_metadata(grpc_cluster):
     import grpc
 
     async def main():
-        async with grpc.aio.insecure_channel(grpc_cluster) as ch:
+        async with grpc.aio.insecure_channel(grpc_cluster["grpc"]) as ch:
             live = await _stub(ch, "ServerLive", pb.ServerLiveRequest,
                                pb.ServerLiveResponse)(pb.ServerLiveRequest())
             assert live.live
@@ -112,7 +112,7 @@ def test_kserve_model_infer_unary(grpc_cluster):
     import grpc
 
     async def main():
-        async with grpc.aio.insecure_channel(grpc_cluster) as ch:
+        async with grpc.aio.insecure_channel(grpc_cluster["grpc"]) as ch:
             infer = _stub(ch, "ModelInfer", pb.ModelInferRequest,
                           pb.ModelInferResponse)
             resp = await infer(_infer_request(), timeout=120)
@@ -132,7 +132,7 @@ def test_kserve_stream_infer(grpc_cluster):
     import grpc
 
     async def main():
-        async with grpc.aio.insecure_channel(grpc_cluster) as ch:
+        async with grpc.aio.insecure_channel(grpc_cluster["grpc"]) as ch:
             stream = ch.stream_stream(
                 f"/{SERVICE}/ModelStreamInfer",
                 request_serializer=pb.ModelInferRequest.SerializeToString,
@@ -166,7 +166,7 @@ def test_kserve_stream_infer_pipelined_concurrent(grpc_cluster):
     ids = [f"p{i}" for i in range(3)]
 
     async def main():
-        async with grpc.aio.insecure_channel(grpc_cluster) as ch:
+        async with grpc.aio.insecure_channel(grpc_cluster["grpc"]) as ch:
             stream = ch.stream_stream(
                 f"/{SERVICE}/ModelStreamInfer",
                 request_serializer=pb.ModelInferRequest.SerializeToString,
@@ -205,7 +205,7 @@ def test_kserve_stream_error_attributed_without_killing_siblings(grpc_cluster):
     import grpc
 
     async def main():
-        async with grpc.aio.insecure_channel(grpc_cluster) as ch:
+        async with grpc.aio.insecure_channel(grpc_cluster["grpc"]) as ch:
             stream = ch.stream_stream(
                 f"/{SERVICE}/ModelStreamInfer",
                 request_serializer=pb.ModelInferRequest.SerializeToString,
@@ -230,3 +230,69 @@ def test_kserve_stream_error_attributed_without_killing_siblings(grpc_cluster):
             assert "not found" in errors.get("bad", "")
 
     asyncio.run(main())
+
+
+def test_kserve_stream_parity_with_sse(grpc_cluster):
+    """gRPC/SSE parity (ISSUE 13): the same prompt served greedily over
+    the KServe decoupled stream and over the SSE completions route must
+    produce the SAME text and token counts — both protocols ride one
+    routed pipeline (preprocessor → backend → migration → router), so a
+    divergence means the gRPC surface forked the serving path."""
+    import json
+
+    import grpc
+    import httpx
+
+    prompt = "hello kserve tensor world"
+    n_tokens = 6
+
+    async def grpc_text():
+        async with grpc.aio.insecure_channel(grpc_cluster["grpc"]) as ch:
+            stream = ch.stream_stream(
+                f"/{SERVICE}/ModelStreamInfer",
+                request_serializer=pb.ModelInferRequest.SerializeToString,
+                response_deserializer=pb.ModelStreamInferResponse.FromString,
+            )
+            call = stream()
+            await call.write(_infer_request(n_tokens, rid="parity"))
+            await call.done_writing()
+            deltas, completion = [], None
+            async for resp in call:
+                assert not resp.error_message, resp.error_message
+                ir = resp.infer_response
+                if ir.parameters["final"].bool_param:
+                    completion = ir.parameters["completion_tokens"].int64_param
+                    break
+                deltas.append(
+                    ir.outputs[0].contents.bytes_contents[0].decode())
+            return "".join(deltas), completion
+
+    def sse_text():
+        body = {
+            "model": "tiny-grpc", "prompt": prompt,
+            "max_tokens": n_tokens, "temperature": 0.0, "stream": True,
+            "stream_options": {"include_usage": True},
+        }
+        parts, completion = [], None
+        with httpx.Client(timeout=120) as client:
+            with client.stream(
+                "POST", f"{grpc_cluster['http']}/v1/completions", json=body
+            ) as r:
+                assert r.status_code == 200
+                for line in r.iter_lines():
+                    if not line.startswith("data: ") or line == "data: [DONE]":
+                        continue
+                    chunk = json.loads(line[6:])
+                    if chunk.get("usage"):
+                        completion = chunk["usage"]["completion_tokens"]
+                        continue
+                    for ch in chunk.get("choices") or []:
+                        if ch.get("text"):
+                            parts.append(ch["text"])
+        return "".join(parts), completion
+
+    g_text, g_tokens = asyncio.run(grpc_text())
+    s_text, s_tokens = sse_text()
+    assert g_text == s_text, f"protocol fork: {g_text!r} != {s_text!r}"
+    assert g_text  # non-vacuous: the model said something
+    assert g_tokens == s_tokens == n_tokens

@@ -712,3 +712,116 @@ def test_late_first_token_still_resolves_detached_future():
             await eng.close()
 
     asyncio.run(main())
+
+
+class TestPullFailureHygiene:
+    """PR-11 deferred review findings (ISSUE 13 satellites): the multi-peer
+    gather must cancel + drain sibling pulls on the first failure and
+    charge ONE typed failure per onboard attempt, and an eviction
+    retraction must never fire for a hash that was re-stored between the
+    drop and the drain."""
+
+    @staticmethod
+    def _bare_dist():
+        from dynamo_tpu.kvbm.distributed import KvbmDistributed
+
+        class _Mgr:
+            block_shape = (1, 2, 2, 2)
+            dtype = np.float32
+
+        class _Conn:
+            manager = _Mgr()
+
+        class _Drt:
+            discovery = None
+
+        return KvbmDistributed(_Drt(), _Conn(), None, "ns", "comp", 1)
+
+    def test_first_failure_cancels_and_drains_siblings(self, monkeypatch):
+        """Peer A fails fast, peer B would take 30s: the gather must raise
+        promptly, cancel B's pull, and count exactly one failure."""
+        import dynamo_tpu.llm.kv_transfer as kvt
+
+        dist = self._bare_dist()
+        dist._owners = {1: {10}, 2: {20}}
+        dist._addrs = {10: "peer-a", 20: "peer-b"}
+        cancelled: list = []
+        sibling_started = asyncio.Event()
+
+        async def fake_pull(addr, hs, shape, dtype):
+            if addr == "peer-a":
+                await sibling_started.wait()
+                raise KvTransferError("injected: peer-a died")
+            sibling_started.set()
+            try:
+                await asyncio.sleep(30)
+            except asyncio.CancelledError:
+                cancelled.append(addr)
+                raise
+            raise AssertionError("sibling pull survived the failure")
+
+        monkeypatch.setattr(kvt, "pull_kvbm_blocks", fake_pull)
+
+        async def main():
+            import time as _t
+
+            t0 = _t.monotonic()
+            with pytest.raises(KvTransferError):
+                await dist.pull_blocks([1, 2])
+            assert _t.monotonic() - t0 < 5.0, "gather waited on the sibling"
+            # the cancel is awaited (drained) before pull_blocks raises
+            assert cancelled == ["peer-b"], (
+                "sibling pull was not cancelled+drained on first failure"
+            )
+            assert dist.remote_pull_failures == 1
+            assert dist.remote_onboards == 0
+
+        asyncio.run(main())
+
+    def test_two_failing_peers_count_one_typed_failure(self, monkeypatch):
+        import dynamo_tpu.llm.kv_transfer as kvt
+
+        dist = self._bare_dist()
+        dist._owners = {1: {10}, 2: {20}}
+        dist._addrs = {10: "peer-a", 20: "peer-b"}
+
+        async def fake_pull(addr, hs, shape, dtype):
+            raise KvTransferError(f"injected: {addr} died")
+
+        monkeypatch.setattr(kvt, "pull_kvbm_blocks", fake_pull)
+
+        async def main():
+            with pytest.raises(KvTransferError):
+                await dist.pull_blocks([1, 2])
+            assert dist.remote_pull_failures == 1, (
+                "one onboard attempt must count one failure, not one per "
+                "failing peer"
+            )
+
+        asyncio.run(main())
+
+    def test_restored_hash_is_not_retracted(self):
+        """Eviction-retraction churn regression: a hash that falls off the
+        tier chain and is RE-STORED before the drain fires must not be
+        retracted (peers would forget a live owner), while hashes that
+        stayed dropped still are."""
+        from dynamo_tpu.kvbm.manager import KvbmConfig, KvBlockManager
+
+        shape = (1, 2, 2, 2)
+        mgr = KvBlockManager(
+            KvbmConfig(host_blocks=2), shape, np.float32
+        )
+        z = np.zeros(shape, np.float32)
+        mgr.store(1, z, z)
+        mgr.store(2, z, z)
+        mgr.store(3, z, z)  # evicts 1 (lru, cap 2)
+        mgr.store(4, z, z)  # evicts 2
+        # hash 1 comes BACK before any drain (same-prefix re-offload)
+        mgr.store(1, z, z)  # evicts 3
+        drained = mgr.drain_evicted()
+        assert 1 not in drained, (
+            "re-stored hash retracted: peers would drop a live owner"
+        )
+        assert 2 in drained and 3 in drained
+        # and the pending queue is consumed: a second drain is empty
+        assert mgr.drain_evicted() == []

@@ -673,3 +673,115 @@ def test_kv_holder_hint_ships_with_request():
         assert "kv_holder" not in req2, req2
 
     asyncio.run(main())
+
+
+# --------------------------------------------------------------------------- #
+# multi-frontend KV routing (ISSUE 13: frontend fleet scale-out)
+# --------------------------------------------------------------------------- #
+
+
+@pytest.fixture(scope="module")
+def two_frontend_kv_cluster():
+    """TWO KV-mode frontends with --mirror-routing on one shared discovery
+    plane + 2 mockers publishing KV events — the fleet shape
+    docs/frontend_scaleout.md describes. Yields (base_a, base_b)."""
+    ports = [free_port(), free_port()]
+    disc = f"tcp://127.0.0.1:{free_port()}"
+    fes = []
+    for i, port in enumerate(ports):
+        fes.append(ManagedProcess(
+            ["-m", "dynamo_tpu.frontend", "--http-port", str(port),
+             "--discovery", disc, "--router-mode", "kv",
+             "--mirror-routing"]
+            + (["--embed-discovery"] if i == 0 else []),
+            name=f"kv_fleet_fe{i}",
+        ).start(f"/tmp/kv_fleet_fe{i}.log"))
+        fes[i].wait_port(port)
+    workers = [
+        ManagedProcess(
+            ["-m", "dynamo_tpu.mocker", "--model-name", "kv-model",
+             "--discovery", disc, "--speedup-ratio", "100",
+             "--block-size", "16", "--kv-events"],
+            name=f"kv_fleet_mocker{i}",
+        ).start(f"/tmp/kv_fleet_mocker{i}.log")
+        for i in range(2)
+    ]
+    bases = [f"http://127.0.0.1:{p}" for p in ports]
+    # readiness: the model must be served by BOTH frontends, and both
+    # workers routable from each (the test_kv_router readiness-barrier
+    # rule: probe prompts distinct inside the first 16-byte block)
+    deadline = time.time() + 60
+    with httpx.Client() as client:
+        for base in bases:
+            while time.time() < deadline:
+                try:
+                    if client.get(f"{base}/v1/models").json()["data"]:
+                        break
+                except Exception:
+                    pass
+                time.sleep(0.25)
+            else:
+                raise TimeoutError(f"model never registered on {base}")
+    for base in bases:
+        seen: set = set()
+        i = 0
+        while time.time() < deadline and len(seen) < 2:
+            wid = _stream_worker_id(
+                base, f"{chr(97 + i % 26)}{i} fleetprobe "
+                + chr(97 + i % 26) * 64,
+                endpoint="completions",
+            )
+            if wid is not None:
+                seen.add(wid)
+            i += 1
+            if len(seen) < 2:
+                time.sleep(0.3)
+        if len(seen) < 2:
+            raise TimeoutError(f"both workers never routable via {base}")
+    yield tuple(bases)
+    for w in workers:
+        w.stop()
+    for fe in fes:
+        fe.stop()
+
+
+def test_two_kv_frontends_share_prefix_affinity(two_frontend_kv_cluster):
+    """A prefix warmed through frontend A must route to the SAME worker
+    when the repeat arrives through frontend B: KV frontends are
+    stateless replicas over shared discovery — the KV events topic (and
+    the --mirror-routing sync channel for the pre-event window) give
+    every replica one view of where the cache lives."""
+    base_a, base_b = two_frontend_kv_cluster
+    long_prefix = "fleet affinity story about " + "z" * 600  # many blocks @16
+
+    first = _stream_worker_id(base_a, long_prefix)
+    assert first is not None
+    # settle barrier via frontend A (same rule as the single-frontend
+    # test): the router must actually SCORE the cached prefix
+    deadline = time.time() + 30
+    hit = 0
+    while time.time() < deadline:
+        wid, hit = _stream_worker_id(base_a, long_prefix, want_hit_rate=True)
+        assert wid == first, f"affinity broken on A during settle: {wid}"
+        if hit and hit > 0:
+            break
+        time.sleep(0.25)
+    assert hit and hit > 0, "KV events never reached frontend A's indexer"
+    # B's indexer subscribes to the same events topic: wait until ITS view
+    # scores the prefix too, then the affinity assertion is meaningful
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        wid_b, hit_b = _stream_worker_id(base_b, long_prefix,
+                                         want_hit_rate=True)
+        if hit_b and hit_b > 0:
+            assert wid_b == first, (
+                f"frontend B routed the warmed prefix to {wid_b}, "
+                f"frontend A warmed it on {first}"
+            )
+            break
+        time.sleep(0.25)
+    else:
+        raise AssertionError("KV events never reached frontend B's indexer")
+    # and the affinity holds through EITHER replica from here on
+    for base in (base_b, base_a, base_b, base_a):
+        assert _stream_worker_id(base, long_prefix) == first

@@ -377,3 +377,153 @@ class TestProfilerRoundTrip:
         assert pi.interpolate_thpt_per_chip(64) > 0
         thpt, itl, kv = di.find_best_throughput_per_chip(itl=10.0, context_length=128)
         assert thpt > 0 and itl > 0
+
+
+# --------------------------------------------------------------------------- #
+# frontend role (ISSUE 13, docs/frontend_scaleout.md)
+# --------------------------------------------------------------------------- #
+
+
+class TestFrontendRole:
+    def test_planner_sizes_frontend_tier_with_workers(self):
+        """workers_per_frontend > 0: every applied target also asks the
+        connector for ceil((p + d) / N) frontends; 0 keeps the pre-role
+        two-arg calls (back-compat with old connectors)."""
+        import asyncio
+
+        metrics = Metrics(num_req=2000, isl=2048, osl=256, ttft=0.1,
+                          itl=0.01, request_duration=3.0)
+        planner, connector = make_planner(
+            args=SlaArgs(adjustment_interval=60, itl=0.02, ttft=0.2,
+                         max_chip_budget=64, max_step=64,
+                         workers_per_frontend=4),
+            metrics=metrics,
+        )
+
+        async def main():
+            await planner.observe_metrics()
+            await planner.observe_metrics()
+            target = await planner.make_adjustments()
+            assert target is not None
+            import math
+
+            want = max(1, math.ceil(sum(target) / 4))
+            assert connector.frontend_decisions[-1] == want
+
+        asyncio.run(main())
+
+    def test_planner_default_never_passes_frontend(self):
+        import asyncio
+
+        metrics = Metrics(num_req=2000, isl=2048, osl=256, ttft=0.1,
+                          itl=0.01, request_duration=3.0)
+        planner, connector = make_planner(
+            args=SlaArgs(adjustment_interval=60, itl=0.02, ttft=0.2,
+                         max_chip_budget=64, max_step=64),
+            metrics=metrics,
+        )
+
+        async def main():
+            await planner.observe_metrics()
+            await planner.observe_metrics()
+            target = await planner.make_adjustments()
+            assert target is not None
+            assert connector.frontend_decisions == []
+
+        asyncio.run(main())
+
+    def test_local_connector_scales_frontend_children(self, tmp_path):
+        """LocalProcessConnector(frontend_cmd=...): the frontend tier
+        scales like a worker role — spawn to target, kill down, reconcile
+        respawns a dead replica, shutdown takes the tier to zero. Children
+        are trivial sleepers; each gets DYN_WORKER_INDEX (the port-offset
+        contract)."""
+        import asyncio
+        import sys as _sys
+
+        from dynamo_tpu.planner.connector import LocalProcessConnector
+
+        cmd = [_sys.executable, "-c",
+               "import os,time;"
+               "open(os.environ['MARK'] + os.environ['DYN_WORKER_INDEX'],"
+               " 'w').close(); time.sleep(60)"]
+
+        async def main():
+            conn = LocalProcessConnector(
+                [], [], frontend_cmd=cmd,
+                env={**dict(__import__('os').environ),
+                     "MARK": str(tmp_path / "fe")},
+                grace_s=1.0,
+            )
+            await conn.set_replicas(0, 0, frontend=2)
+            assert conn.frontend_count() == 2
+            # replica indexes 0 and 1 got distinct DYN_WORKER_INDEX
+            for _ in range(100):
+                if (tmp_path / "fe0").exists() and (tmp_path / "fe1").exists():
+                    break
+                await asyncio.sleep(0.05)
+            assert (tmp_path / "fe0").exists() and (tmp_path / "fe1").exists()
+            # a dead replica is respawned by reconcile (the planner calls
+            # it every interval)
+            victim = conn.procs["frontend"][0]
+            victim.kill()
+            await victim.wait()
+            await conn.reconcile()
+            assert conn.frontend_count() == 2
+            # set_replicas WITHOUT a frontend ask leaves the tier alone
+            await conn.set_replicas(0, 0)
+            assert conn.frontend_count() == 2
+            await conn.shutdown()
+            assert conn.frontend_count() == 0
+
+        asyncio.run(main())
+
+    def test_virtual_connector_publishes_num_frontends(self):
+        """VirtualConnector ships num_frontends only when asked, and
+        operator-lite's decision parser + OperatorLite pass it through to
+        a frontend-aware scaler."""
+        import asyncio
+        import json as _json
+
+        from dynamo_tpu.deploy.operator_lite import OperatorLite, _parse_decision
+        from dynamo_tpu.planner.connector import (
+            PLANNER_DECISION_KEY,
+            VirtualConnector,
+        )
+
+        class FakeKv:
+            def __init__(self):
+                self.store = {}
+
+            async def put(self, key, value, lease=None):
+                self.store[key] = value
+
+            async def get(self, key):
+                return self.store.get(key)
+
+        class RecordingScaler:
+            def __init__(self):
+                self.calls = []
+
+            async def set_replicas(self, prefill, decode, frontend=None):
+                self.calls.append((prefill, decode, frontend))
+
+        async def main():
+            kv = FakeKv()
+            vc = VirtualConnector(kv)
+            await vc.set_replicas(1, 2)
+            doc = _json.loads(kv.store[PLANNER_DECISION_KEY])
+            assert "num_frontends" not in doc
+            assert _parse_decision(kv.store[PLANNER_DECISION_KEY])[3] is None
+            await vc.set_replicas(1, 3, frontend=2)
+            doc = _json.loads(kv.store[PLANNER_DECISION_KEY])
+            assert doc["num_frontends"] == 2
+            rev, p, d, f = _parse_decision(kv.store[PLANNER_DECISION_KEY])
+            assert (p, d, f) == (1, 3, 2)
+
+            scaler = RecordingScaler()
+            op = OperatorLite(kv, scaler)
+            assert await op.reconcile_once()
+            assert scaler.calls[-1] == (1, 3, 2)
+
+        asyncio.run(main())

@@ -480,3 +480,242 @@ def test_mocker_not_routable_until_warmup_done():
         fe.stop()
         if worker:
             worker.stop()
+
+
+# --------------------------------------------------------------------------- #
+# ENC_TOK binary token wire path (ISSUE 13, docs/wire_protocol.md)
+# --------------------------------------------------------------------------- #
+
+
+def test_enc_tok_codec_roundtrip_shapes():
+    from dynamo_tpu.runtime import codec
+
+    bare = [{"token_ids": [1, 2, 3]}, {"token_ids": [4]}]
+    wrapped = [{"data": {"token_ids": [7]}}, {"data": {"token_ids": [8, 9]}}]
+    # boundary-exact roundtrip (merge=False)
+    assert codec.unpack_token_items(codec.pack_token_items(bare)) == bare
+    assert codec.unpack_token_items(
+        codec.pack_token_items(wrapped, wrapped=True)
+    ) == wrapped
+    # merged decode: one item, same ids in order, wrapper preserved
+    assert codec.unpack_token_items(
+        codec.pack_token_items(bare), merge=True
+    ) == [{"token_ids": [1, 2, 3, 4]}]
+    assert codec.unpack_token_items(
+        codec.pack_token_items(wrapped, wrapped=True), merge=True
+    ) == [{"data": {"token_ids": [7, 8, 9]}}]
+    # u32 boundary ids survive
+    big = [{"token_ids": [0, (1 << 32) - 1]}]
+    assert codec.unpack_token_items(codec.pack_token_items(big)) == big
+
+    # shape classifier: only PURE deltas are eligible
+    assert codec.token_delta_kind(bare[0]) == 1
+    assert codec.token_delta_kind(wrapped[0]) == 2
+    assert codec.token_delta_kind({"token_ids": []}) == 0
+    assert codec.token_delta_kind(
+        {"data": {"token_ids": [1], "finish_reason": "stop"}}
+    ) == 0
+    assert codec.token_delta_kind({"event": "x", "comment": ["y"]}) == 0
+    assert codec.token_delta_kind("nope") == 0
+
+    # unknown flags / inconsistent payloads are rejected, not misread
+    payload = codec.pack_token_items(bare)
+    broken = payload[:4] + (255).to_bytes(4, "little") + payload[8:]
+    with pytest.raises(ValueError):
+        codec.unpack_token_items(broken)
+    with pytest.raises(ValueError):
+        codec.unpack_token_items(payload[:-4])  # lens sum != ids
+
+
+def test_try_pack_token_run_boundaries():
+    from dynamo_tpu.runtime import codec
+
+    # leading run stops at the first non-delta (the finish item)
+    items = [{"token_ids": [1]}, {"token_ids": [2]},
+             {"token_ids": [3], "finish_reason": "stop"}]
+    payload, n = codec.try_pack_token_run(items)
+    assert n == 2
+    assert codec.unpack_token_items(payload, merge=True) == [
+        {"token_ids": [1, 2]}
+    ]
+    # a wrapper-shape change also ends the run (one shape per frame)
+    mixed = [{"token_ids": [1]}, {"data": {"token_ids": [2]}}]
+    _, n = codec.try_pack_token_run(mixed)
+    assert n == 1
+    # non-delta head: the whole batch rides msgpack
+    assert codec.try_pack_token_run([{"finish_reason": "x"}]) is None
+    # ids the u32 array cannot carry degrade to msgpack, never corrupt
+    assert codec.try_pack_token_run([{"token_ids": [-1]}]) is None
+    assert codec.try_pack_token_run([{"token_ids": [1 << 33]}]) is None
+
+
+def test_binary_token_frames_end_to_end(monkeypatch):
+    """Engine-shaped token deltas ride ENC_TOK frames (counted), the
+    trailing finish item falls back to msgpack, and the client's merged
+    decode preserves token order/count exactly."""
+    monkeypatch.setenv("DYN_WIRE_BINARY_TOKENS", "1")
+    monkeypatch.setenv("DYN_STREAM_COALESCE_MS", "5")
+
+    async def main():
+        srv = RequestPlaneServer()
+
+        async def handler(req, ctx):
+            for i in range(24):
+                yield {"data": {"token_ids": [100 + i]}}
+            yield {"data": {"token_ids": [], "finish_reason": "stop"}}
+
+        stats = srv.register("t.gen", handler)
+        host, port = await srv.start()
+        cli = RequestPlaneClient()
+        assert cli.binary_tokens
+        try:
+            stream = await cli.call(f"{host}:{port}", "t.gen", {})
+            got = [it async for it in stream]
+            ids = [t for it in got if "token_ids" in it.get("data", {})
+                   for t in it["data"]["token_ids"]]
+            assert ids == [100 + i for i in range(24)]
+            assert got[-1]["data"]["finish_reason"] == "stop"
+            assert stats.frames_binary >= 1
+            assert stats.items_total == 25
+        finally:
+            await cli.close()
+            await srv.stop()
+
+    asyncio.run(main())
+
+
+def test_binary_negotiation_client_opt_out(monkeypatch):
+    """DYN_WIRE_BINARY_TOKENS=0: the client never advertises ENC_TOK and
+    the server answers pure msgpack — the A/B baseline arm."""
+    monkeypatch.setenv("DYN_WIRE_BINARY_TOKENS", "0")
+
+    async def main():
+        srv = RequestPlaneServer()
+
+        async def handler(req, ctx):
+            for i in range(8):
+                yield {"data": {"token_ids": [i]}}
+
+        stats = srv.register("t.gen", handler)
+        host, port = await srv.start()
+        cli = RequestPlaneClient()
+        assert not cli.binary_tokens
+        try:
+            stream = await cli.call(f"{host}:{port}", "t.gen", {})
+            got = [it async for it in stream]
+            total = sum(len(it["data"]["token_ids"]) for it in got)
+            assert total == 8
+            assert stats.frames_binary == 0
+        finally:
+            await cli.close()
+            await srv.stop()
+
+    asyncio.run(main())
+
+
+def test_unknown_payload_encoding_is_typed_error():
+    """A frame with an enc this client doesn't speak must raise a typed
+    EngineError (version skew), never silently misread the payload."""
+    from dynamo_tpu.runtime import codec as _codec
+    from dynamo_tpu.runtime.request_plane import EngineError
+
+    async def main():
+        async def serve(reader, writer):
+            frame = await _codec.read_frame(reader)
+            control, _ = frame
+            sid = control["stream"]
+            await _codec.write_frame(
+                writer, {"t": "data", "stream": sid, "n": 1, "enc": "zzz"},
+                b"\x00" * 8,
+            )
+
+        server = await asyncio.start_server(serve, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        cli = RequestPlaneClient()
+        try:
+            stream = await cli.call(f"127.0.0.1:{port}", "t.gen", {})
+            with pytest.raises(EngineError, match="unknown payload encoding"):
+                async for _ in stream:
+                    pass
+        finally:
+            await cli.close()
+            server.close()
+            await server.wait_closed()
+
+    asyncio.run(main())
+
+
+# --------------------------------------------------------------------------- #
+# detok compute-pool offload (DYN_DETOK_POOL, docs/frontend_scaleout.md)
+# --------------------------------------------------------------------------- #
+
+
+def _run_backend(items, stop, pool_env):
+    import os
+
+    os.environ["DYN_DETOK_POOL"] = pool_env
+    os.environ["DYN_DETOK_POOL_MIN_TOKENS"] = "4"
+    try:
+        async def main():
+            async def stream():
+                for it in items:
+                    yield it
+                yield Annotated(data=LLMEngineOutput(
+                    token_ids=[], finish_reason="length").to_dict()).to_dict()
+
+            req = PreprocessedRequest(
+                token_ids=[1],
+                stop_conditions={"stop": stop} if stop else {},
+            )
+            backend = Backend(tokenizer=ByteTokenizer(512))
+            out_texts, n_tok, finish = [], 0, None
+            async for ann in backend.backward(stream(), req, Context()):
+                out = ann.data
+                n_tok += len(out.token_ids)
+                if out.text:
+                    out_texts.append(out.text)
+                if out.finish_reason:
+                    finish = out.finish_reason
+            return "".join(out_texts), n_tok, finish
+
+        return asyncio.run(main())
+    finally:
+        import os
+
+        os.environ.pop("DYN_DETOK_POOL", None)
+        os.environ.pop("DYN_DETOK_POOL_MIN_TOKENS", None)
+
+
+@pytest.mark.parametrize("stop", [[], ["STOP!"]])
+def test_detok_pool_matches_inline(stop):
+    """Pool on/off is byte-identical — same text, token counts, finish —
+    for big batches (pool path) and singletons (inline path), with and
+    without stop strings."""
+    tok = ByteTokenizer(512)
+    ids = tok.encode("pooled detök batch, then a STOP!never-seen tail")
+    batch = [Annotated(data=LLMEngineOutput(
+        token_ids=list(ids)).to_dict()).to_dict()]
+    singles = [Annotated(data=LLMEngineOutput(
+        token_ids=[t]).to_dict()).to_dict() for t in ids]
+
+    ref = _run_backend(batch, stop, "0")
+    for items in (batch, singles):
+        got = _run_backend(items, stop, "1")
+        # singleton emission differs from one batch only in chunking; the
+        # reference tuple (text, tokens, finish) must match everywhere
+        assert got == ref
+
+
+def test_detok_pool_actually_engages():
+    """A batch >= DYN_DETOK_POOL_MIN_TOKENS runs on the compute pool (the
+    stall-isolation contract is meaningless if the offload silently never
+    happens)."""
+    from dynamo_tpu.runtime.compute import ComputePool
+
+    tok = ByteTokenizer(512)
+    ids = tok.encode("long enough batch to cross the pool threshold")
+    batch = [Annotated(data=LLMEngineOutput(
+        token_ids=list(ids)).to_dict()).to_dict()]
+    before = ComputePool.get().tasks_run
+    _run_backend(batch, [], "1")
+    assert ComputePool.get().tasks_run > before
