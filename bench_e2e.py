@@ -254,6 +254,16 @@ def launch(mode: str, model: str, *, cpu: bool, num_workers: int = 2,
     http_port = free_port()
     disc = f"127.0.0.1:{disc_port}"
     env = {"DYN_DISCOVERY_ENDPOINT": disc}
+    # the e2e bench measures latency/throughput of ADMITTED traffic, so the
+    # admission gate defaults OFF here: on a loaded host the real-engine
+    # TTFT brushes the 2s SLA target and the gate's 429 shed turns an
+    # honest latency measurement into failed requests (the PR-13 tier-1
+    # agg-smoke flake). Overload behavior has its own harness
+    # (bench_serving_overhead --overload-smoke). Export DYN_GATE=1 to
+    # re-enable for a gated arm.
+    import os as _os
+
+    env.setdefault("DYN_GATE", _os.environ.get("DYN_GATE", "0"))
     # dynosched knobs ride the env so every worker role (and a disagg
     # decode worker's router) sees the same policy/targets
     if sched_policy:

@@ -467,6 +467,199 @@ def run_multi_worker(args, trace):
     )
 
 
+async def _replay_serial_streams(eng, trace, prefix="q"):
+    """Closed-loop serial replay collecting each request's full greedy
+    stream AND per-token logprobs — the quality-guard inputs of the
+    --kv-quant gate (token spot check + per-step logit MSE)."""
+    from dynamo_tpu.llm.protocols import PreprocessedRequest
+    from dynamo_tpu.runtime.engine import Context
+
+    out = []
+    for i, row in enumerate(trace):
+        req = PreprocessedRequest(
+            token_ids=row.token_ids,
+            stop_conditions={"max_tokens": row.osl, "ignore_eos": True},
+            sampling_options={"logprobs": True},
+            request_id=f"{prefix}{i}",
+        ).to_dict()
+        toks, lps = [], []
+        async for item in eng.generate(req, Context()):
+            data = item.get("data")
+            if data and data.get("token_ids"):
+                toks.extend(data["token_ids"])
+                lps.extend(data.get("log_probs") or [])
+        out.append((toks, lps))
+    return out
+
+
+def _kvq_quality(fp_streams, q_streams):
+    """Quality-guard statistics between the fp and quantized arms on the
+    same greedy trace: token match rate over aligned steps (task-level
+    spot check) and the per-step chosen-token logit MSE up to each
+    request's first divergence (after a divergence the two arms walk
+    different sequences, so later logits aren't comparable)."""
+    agree = total = 0
+    sq_sum = 0.0
+    n_lp = 0
+    per_step_sq = []
+    for (ft, fl), (qt, ql) in zip(fp_streams, q_streams):
+        n = min(len(ft), len(qt))
+        total += n
+        diverged = False
+        for j in range(n):
+            if ft[j] == qt[j]:
+                agree += 1
+            elif not diverged:
+                diverged = True
+            if not diverged and j < len(fl) and j < len(ql) \
+                    and fl[j] is not None and ql[j] is not None:
+                d = float(fl[j]) - float(ql[j])
+                sq_sum += d * d
+                per_step_sq.append(d * d)
+                n_lp += 1
+    return {
+        "token_match_rate": round(agree / max(total, 1), 4),
+        "logit_mse": round(sq_sum / max(n_lp, 1), 5),
+        "logit_mse_p95": round(_pct(per_step_sq, 0.95), 5),
+        "logit_samples": n_lp,
+    }
+
+
+def run_kv_quant(args, trace):
+    """Quantized-KV density report + gate (--kv-quant int8|int4).
+
+    Arms at a FIXED HBM page-count and FIXED G2 byte budget:
+      fp    — kv_quant none, host_blocks = --host-blocks
+      kvq   — kv_quant <mode>, host_blocks scaled so the tier holds the
+              SAME BYTES (packed blocks are ~2x/4x smaller => ~2x/4x the
+              blocks => higher hit rate on the same trace)
+
+    Gates (the ISSUE 14 acceptance):
+      * sessions-per-HBM-budget (measured pool allocation, incl. scales)
+        >= --min-density-ratio x the fp arm
+      * warm tier hit rate at fixed G2 bytes >= the fp arm's
+      * quality guard: per-step logit MSE (chosen-token, pre-divergence)
+        under --max-logit-mse AND token match rate over the greedy trace
+        >= --min-token-match
+      * none arm byte-identical: kv_quant="none" reproduces the
+        DYN_KV_QUANT-unset streams token-for-token (quant off == seed)
+    """
+    from dynamo_tpu.models import llama
+    from dynamo_tpu.ops.kv_quant import kv_page_bytes
+
+    mode = args.kv_quant
+    c = llama.LlamaConfig.tiny() if args.model == "tiny" else None
+    from dynamo_tpu.engine.engine import _resolve_model
+
+    c = c or _resolve_model(args.model)
+    fp_page = 2 * c.num_layers * kv_page_bytes(
+        args.page_size, c.num_kv_heads, c.head_dim, c.dtype, "none")
+    q_page = 2 * c.num_layers * kv_page_bytes(
+        args.page_size, c.num_kv_heads, c.head_dim, c.dtype, mode)
+    host_bytes = args.host_blocks * fp_page
+    q_host_blocks = max(host_bytes // q_page, 1)
+
+    def arm(kv_quant, host_blocks, prefix):
+        from dynamo_tpu.engine import EngineConfig, JaxEngine
+
+        cfg = EngineConfig(
+            model=args.model, max_num_seqs=args.max_num_seqs,
+            page_size=args.page_size, num_pages=args.num_pages,
+            max_model_len=1024, prefill_buckets=(64, 128, 256),
+            max_prefill_chunk=256, quantize=args.quantize,
+            kvbm_host_blocks=host_blocks, kv_quant=kv_quant,
+        )
+        eng = JaxEngine(cfg)
+        res = {}
+
+        async def main():
+            streams_cold = await _replay_serial_streams(
+                eng, trace, prefix + "c")
+            await _drain_offloads(eng)
+            eng.allocator.clear_cache()
+            streams_warm = await _replay_serial_streams(
+                eng, trace, prefix + "w")
+            await _drain_offloads(eng)
+            res["stats"] = eng.stats()
+            res["cold"] = streams_cold
+            res["warm"] = streams_warm
+            await eng.close()
+
+        asyncio.run(main())
+        return res
+
+    fp = arm("none", args.host_blocks, "f")
+    kvq = arm(mode, int(q_host_blocks), "k")
+    base = arm(None, args.host_blocks, "b")  # DYN_KV_QUANT-unset default
+
+    # density: measured resident pool bytes at EQUAL page count -> how
+    # many sessions a fixed HBM byte budget holds (pages/session from the
+    # trace's mean prompt+output page footprint)
+    pages_per_req = sum(
+        (len(r.token_ids) + r.osl + args.page_size - 1) // args.page_size
+        for r in trace
+    ) / max(len(trace), 1)
+    budget = 1 << 30  # a reference GiB of KV budget
+    fp_bpp = fp["stats"]["kv_pool_bytes"] / (args.num_pages + 1)
+    q_bpp = kvq["stats"]["kv_pool_bytes"] / (args.num_pages + 1)
+    sessions = {
+        "fp": (budget / fp_bpp) / pages_per_req,
+        "kvq": (budget / q_bpp) / pages_per_req,
+    }
+    density_ratio = sessions["kvq"] / max(sessions["fp"], 1e-9)
+
+    def hit_rate(st):
+        return st.get("kvbm_onboarded_blocks", 0) / max(
+            st.get("kvbm_g1_miss_blocks", 0), 1)
+
+    fp_hit, q_hit = hit_rate(fp["stats"]), hit_rate(kvq["stats"])
+    quality = _kvq_quality(fp["cold"], kvq["cold"])
+    none_identical = [t for t, _ in fp["cold"]] == [t for t, _ in base["cold"]]
+
+    report = {
+        "mode": f"kv-quant-{mode}",
+        "kv_bytes_per_page": {"fp": round(fp_bpp, 1), "kvq": round(q_bpp, 1)},
+        "sessions_per_gib": {k: round(v, 1) for k, v in sessions.items()},
+        "sessions_per_hbm_ratio": round(density_ratio, 3),
+        "g2_budget_bytes": int(host_bytes),
+        "g2_blocks": {"fp": args.host_blocks, "kvq": int(q_host_blocks)},
+        "tier_hit_rate_warm": {"fp": round(fp_hit, 3), "kvq": round(q_hit, 3)},
+        "quality": quality,
+        "none_arm_byte_identical": none_identical,
+    }
+    print(json.dumps(report))
+    failures = []
+    if density_ratio < args.min_density_ratio:
+        failures.append(
+            f"sessions-per-HBM ratio {density_ratio:.2f} < "
+            f"{args.min_density_ratio}")
+    if q_hit < fp_hit:
+        failures.append(
+            f"tier hit rate DOWN at fixed G2 bytes: {q_hit:.3f} < {fp_hit:.3f}")
+    if quality["logit_mse"] > args.max_logit_mse:
+        failures.append(
+            f"logit MSE {quality['logit_mse']} > {args.max_logit_mse} "
+            "(quantization is buying wrong tokens)")
+    if quality["token_match_rate"] < args.min_token_match:
+        failures.append(
+            f"token match rate {quality['token_match_rate']} < "
+            f"{args.min_token_match}")
+    if not none_identical:
+        failures.append("kv_quant=none diverged from the unset default "
+                        "(quant off must be the seed path, byte-identical)")
+    if failures:
+        print("KV-QUANT SMOKE FAILED:")
+        for f in failures:
+            print(f"  - {f}")
+        sys.exit(1)
+    print(
+        f"KV-QUANT SMOKE OK ({mode}): {density_ratio:.2f}x sessions/HBM, "
+        f"tier hit rate {fp_hit:.2f}->{q_hit:.2f} at fixed G2 bytes, "
+        f"logit MSE {quality['logit_mse']}, token match "
+        f"{quality['token_match_rate']}, none arm byte-identical"
+    )
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--model", default="tiny")
@@ -502,7 +695,31 @@ def main():
     ap.add_argument("--max-peer-ttft-ratio", type=float, default=1.3,
                     help="--multi-worker gate: peer warm-TTFT p50 ceiling "
                     "as a multiple of local-G2 warm-TTFT p50 (medians)")
+    ap.add_argument("--kv-quant", choices=["int8", "int4"], default=None,
+                    help="quantized-KV density arm + gate (run_kv_quant): "
+                    "fp vs quantized engines at equal HBM pages and equal "
+                    "G2 bytes — sessions-per-HBM-budget ratio, tier hit "
+                    "rate, logit-MSE/token-match quality guard, and the "
+                    "none-arm byte-identity check")
+    ap.add_argument("--min-density-ratio", type=float, default=1.8,
+                    help="--kv-quant floor on sessions-per-HBM-budget vs fp")
+    ap.add_argument("--max-logit-mse", type=float, default=None,
+                    help="--kv-quant ceiling on per-step chosen-token "
+                    "logit MSE vs the fp arm (default: 0.02 int8, 0.5 "
+                    "int4 — calibrated on the tiny CPU model)")
+    ap.add_argument("--min-token-match", type=float, default=None,
+                    help="--kv-quant floor on greedy token match rate vs "
+                    "the fp arm (default: 0.9 int8, 0.7 int4 — the CPU "
+                    "smoke's random-init tiny model is the WORST case: "
+                    "its logits are near-uniform, so half-quant-step "
+                    "noise flips argmax far more often than a trained "
+                    "checkpoint's peaked logits would; the hardware "
+                    "phase gates a real checkpoint tighter)")
     args = ap.parse_args()
+    if args.max_logit_mse is None:
+        args.max_logit_mse = {None: 0.02, "int8": 0.02, "int4": 0.5}[args.kv_quant]
+    if args.min_token_match is None:
+        args.min_token_match = {None: 0.9, "int8": 0.9, "int4": 0.7}[args.kv_quant]
 
     if args.smoke:
         args.requests = min(args.requests, 20)
@@ -533,6 +750,16 @@ def main():
 
     if args.multi_worker:
         run_multi_worker(args, trace)
+        return
+    if args.kv_quant:
+        if args.host_blocks == 256:
+            # default the G2 byte budget to CAPACITY-CONSTRAINED on this
+            # trace (the 256-block default holds the whole working set,
+            # hiding the density win): at 24 fp blocks the fp arm
+            # thrashes its LRU to a 0.0 warm hit rate while the quant
+            # arm's 2x/4x blocks-per-byte holds the set at 0.5
+            args.host_blocks = 24
+        run_kv_quant(args, trace)
         return
 
     arms = [("off", False, True), ("pipeline", True, True)]

@@ -90,6 +90,15 @@ PHASES = [
     ("engine_peer", [PY, "bench_kv_cache.py", "--multi-worker", "--requests",
                      "64", "--quantize", "int8", "--num-pages", "512",
                      "--host-blocks", "1024"], 3600),
+    # PR 14 remeasure: quantized KV cache on real hardware — sessions-per-
+    # HBM at the real pool auto-sizing (the CPU arm measures a fixed tiny
+    # pool), the in-kernel VMEM-window dequant cost inside the compiled
+    # Mosaic ragged/decode kernels (interpret-mode CPU numbers say nothing
+    # about it), and the quality guard on a real checkpoint's peaked
+    # logits (the random-init tiny model is the worst case)
+    ("engine_kvq", [PY, "bench_kv_cache.py", "--kv-quant", "int8",
+                    "--requests", "64", "--num-pages", "512",
+                    "--quantize", "int8"], 3600),
     # PR 13 remeasure: frontend fleet scale-out on the many-core TPU host
     # — the 1→2→4 frontend tok/s ladder at 32 streams (plus the codec A/B
     # riding --fleet's per-arm CPU columns) is core-bound on the 2-core

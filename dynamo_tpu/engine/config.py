@@ -50,6 +50,17 @@ class EngineConfig:
     # and makes llama3-8b fit a single v5e chip beside a KV pool
     # (models/quant.py; reference analogue: FP8 recipes)
     quantize: Optional[str] = None
+    # quantized KV cache ("none" | "int8" | "int4"; None = resolve from
+    # DYN_KV_QUANT, default none): pages quantize on write with
+    # per-page-per-head scales and dequantize inside the attention
+    # kernels' VMEM window (ops/kv_quant.py, docs/kvbm.md). int8 halves /
+    # int4 quarters KV bytes per page, so the auto-sized pool holds ~2x/4x
+    # the pages — roughly 2x resident sessions at fixed HBM — and every
+    # KVBM tier/peer-fabric/disagg transfer shrinks the same way. "none"
+    # is the seed's exact fp path (byte-identical streams). Requires
+    # tp_size == pp_size == sp_size == 1 (scale sharding is the
+    # multi-chip follow-up).
+    kv_quant: Optional[str] = None
     # speculative decoding (engine/spec.py; reference SpecDecodeStats
     # contract _core.pyi:269-301). "ngram" = self-drafting prompt-lookup:
     # draft spec_draft_len tokens from the most recent spec_ngram-gram

@@ -151,14 +151,19 @@ def _auto_num_pages(params, model_cfg, config: EngineConfig,
                     )
                 except Exception:  # noqa: BLE001 — non-Array leaves
                     in_use += getattr(x, "nbytes", 0)
-        dtype_bytes = jnp.zeros((), model_cfg.dtype).dtype.itemsize
+        from ..ops.kv_quant import kv_page_bytes, resolve_kv_quant
+
+        # quantized pages shrink the per-page bytes (int8 ~2x, int4 ~4x
+        # incl. the f32 per-head scales), so the SAME free-HBM budget
+        # yields ~2x/4x the pages — the resident-session density win
         page_bytes = (
             2  # K and V
             * model_cfg.num_layers
-            * config.page_size
-            * model_cfg.num_kv_heads
-            * model_cfg.head_dim
-            * dtype_bytes
+            * kv_page_bytes(
+                config.page_size, model_cfg.num_kv_heads,
+                model_cfg.head_dim, model_cfg.dtype,
+                resolve_kv_quant(config.kv_quant),
+            )
         )
         page_bytes_dev = page_bytes // _kv_shard_div(kv_sharding)
         alloc_bytes_dev = page_bytes_dev
@@ -185,7 +190,9 @@ def _auto_num_pages(params, model_cfg, config: EngineConfig,
     if n < floor:
         raise RuntimeError(
             f"KV pool auto-sizing found room for only {n} pages; reduce "
-            "model size, quantize (--quantize int8), or lower max_num_seqs"
+            "model size, quantize weights (--quantize int8), quantize the "
+            "KV cache (DYN_KV_QUANT=int8/int4 — halves/quarters bytes per "
+            "page), or lower max_num_seqs"
         )
     return int(n)
 
@@ -323,11 +330,20 @@ class JaxEngine:
         every device dispatch is mirrored to follower hosts, which replay
         it via `run_follower`. `multihost`: True when jax.distributed is
         active (disagg KV extraction then rides process_allgather)."""
-        if config.decode_pool_mode is None or not config.decode_block_unroll:
+        from ..ops.kv_quant import resolve_kv_quant
+
+        kvq = resolve_kv_quant(config.kv_quant)
+        if (
+            config.decode_pool_mode is None or not config.decode_block_unroll
+            or config.kv_quant != kvq
+        ):
             # platform auto (EngineConfig docstring): local's once-per-block
             # pool write wins on TPU at production pool sizes; scatter
             # keeps CPU (tests/smoke) compile time sane. Resolve into a
-            # COPY — the caller's config keeps its auto sentinels.
+            # COPY — the caller's config keeps its auto sentinels. The KV
+            # quant mode (DYN_KV_QUANT) resolves here too so every later
+            # consumer (pool sizing, KVBM block layout, wire descriptors)
+            # reads one explicit spelling.
             import dataclasses as _dc
 
             mode = config.decode_pool_mode or (
@@ -338,7 +354,20 @@ class JaxEngine:
                 decode_pool_mode=mode,
                 decode_block_unroll=config.decode_block_unroll
                 or (4 if mode == "local" else 1),
+                kv_quant=kvq,
             )
+        if kvq != "none":
+            if config.pp_size > 1 or config.sp_size > 1 or config.tp_size > 1:
+                raise ValueError(
+                    "kv_quant requires tp_size == pp_size == sp_size == 1 "
+                    "(per-page-per-head scale sharding is the multi-chip "
+                    "follow-up); set DYN_KV_QUANT=none for parallel layouts"
+                )
+            if kv_sharding is not None or multihost:
+                raise ValueError(
+                    "kv_quant is incompatible with a sharded/multi-host KV "
+                    "pool; set DYN_KV_QUANT=none"
+                )
         self.config = config
         self._mesh = mesh
         self._spmd = spmd
@@ -383,6 +412,7 @@ class JaxEngine:
             c.head_dim,
             dtype=c.dtype,
             sharding=kv_sharding,
+            kv_quant=config.kv_quant,
         )
         self.allocator = PageAllocator(
             config.num_pages, config.page_size, event_sink=event_sink
@@ -392,9 +422,22 @@ class JaxEngine:
         self.kvbm = None
         if config.kvbm_host_blocks > 0 or config.kvbm_disk_blocks > 0:
             from ..kvbm import KvBlockManager, KvbmConfig, KvbmConnector
+            from ..ops.kv_quant import kv_page_bytes
 
-            block_shape = (c.num_layers, config.page_size, c.num_kv_heads, c.head_dim)
-            np_dtype = np.dtype(jnp.zeros((), c.dtype).dtype)
+            if config.kv_quant != "none":
+                # quantized blocks tier NATIVELY as packed uint8 rows
+                # (q bytes + per-page-per-head scales, ops/kv_quant.py):
+                # G2/G3 capacity at fixed host/disk bytes and peer-pull
+                # payloads shrink by the same 2x/4x as the device pool
+                block_shape = (
+                    c.num_layers,
+                    kv_page_bytes(config.page_size, c.num_kv_heads,
+                                  c.head_dim, c.dtype, config.kv_quant),
+                )
+                np_dtype = np.dtype(np.uint8)
+            else:
+                block_shape = (c.num_layers, config.page_size, c.num_kv_heads, c.head_dim)
+                np_dtype = np.dtype(jnp.zeros((), c.dtype).dtype)
             manager = KvBlockManager(
                 KvbmConfig(
                     host_blocks=config.kvbm_host_blocks,
@@ -403,6 +446,7 @@ class JaxEngine:
                 ),
                 block_shape,
                 np_dtype,
+                kv_format=config.kv_quant,
             )
             self.kvbm = KvbmConnector(self, manager)
         # shift page ids by +1 so allocator page 0 -> physical page 1
@@ -450,6 +494,11 @@ class JaxEngine:
         # these instead of grepping logs
         self.kv_pulls_completed = 0
         self.kv_pages_pulled = 0
+        # typed mixed-precision rejections (kv_quant): a peer staging a
+        # different KV page format is refused BEFORE any byte moves and
+        # the request recomputes locally — counted so a misconfigured
+        # fleet is visible, never silent (docs/kvbm.md mixed-fleet rules)
+        self.kv_format_mismatches = 0
         # streamed disagg handoff (docs/disagg_serving.md): decode-side
         # evidence that KV transfer overlapped prefill — chunks that landed
         # BEFORE the prefill's first-token event, and handoffs where the
@@ -638,12 +687,15 @@ class JaxEngine:
                 B = tokens.shape[0]
                 pool_lens = jnp.maximum(seq_lens - 1, 0)
                 start_pos = positions
+                # local accumulators stay FULL precision even under a
+                # quantized pool (c.dtype == pool dtype in fp mode):
+                # quantization happens once, at the per-block pool commit
                 loc_shape = (B, K, c.num_kv_heads, c.head_dim)
                 loc_k0 = tuple(
-                    jnp.zeros(loc_shape, kv_k.dtype) for _ in range(c.num_layers)
+                    jnp.zeros(loc_shape, c.dtype) for _ in range(c.num_layers)
                 )
                 loc_v0 = tuple(
-                    jnp.zeros(loc_shape, kv_v.dtype) for _ in range(c.num_layers)
+                    jnp.zeros(loc_shape, c.dtype) for _ in range(c.num_layers)
                 )
 
                 W = pen.shape[1]
@@ -682,8 +734,13 @@ class JaxEngine:
                 phys = jnp.take_along_axis(page_tables, logical, axis=1)
                 phys = jnp.where(pos < P * page_size, phys, 0)
                 offs = pos % page_size
-                kv_k = kv_k.at[:, phys, offs].set(jnp.stack(loc_k))
-                kv_v = kv_v.at[:, phys, offs].set(jnp.stack(loc_v))
+                from ..ops.kv_quant import kv_write_all_layers
+
+                # the decode carry patch: ONE pool write per block —
+                # quantize-on-write under DYN_KV_QUANT, the seed's fused
+                # scatter otherwise (byte-identical jaxpr)
+                kv_k = kv_write_all_layers(kv_k, phys, offs, jnp.stack(loc_k))
+                kv_v = kv_write_all_layers(kv_v, phys, offs, jnp.stack(loc_v))
                 return toks, tokens, positions, seq_lens, kv_k, kv_v, rng, pen
 
         else:
@@ -1078,18 +1135,24 @@ class JaxEngine:
 
         self._patch_lanes = patch_lanes
 
-        # disagg KV movement (host-staged; llm/disagg.py wire format)
+        # disagg KV movement (host-staged; llm/disagg.py wire format).
+        # tree_map covers both store shapes: a plain fp array, or a
+        # QuantKV whose q pages AND per-page scales gather/scatter on the
+        # same `[:, page_ids]` slice — scales travel with their pages
+        # through every tier/wire hop.
         @jax.jit
         def extract_pages(kv_k, kv_v, page_ids):
-            return kv_k[:, page_ids], kv_v[:, page_ids]
+            ex = lambda a: a[:, page_ids]  # noqa: E731
+            return jax.tree.map(ex, kv_k), jax.tree.map(ex, kv_v)
 
         self._extract_pages = extract_pages
 
         @partial(jax.jit, donate_argnums=(0, 1))
         def inject_pages(kv_k, kv_v, page_ids, data_k, data_v):
+            inj = lambda a, d: a.at[:, page_ids].set(d)  # noqa: E731
             return (
-                kv_k.at[:, page_ids].set(data_k),
-                kv_v.at[:, page_ids].set(data_v),
+                jax.tree.map(inj, kv_k, data_k),
+                jax.tree.map(inj, kv_v, data_v),
             )
 
         self._inject_pages = inject_pages
@@ -1487,19 +1550,12 @@ class JaxEngine:
             slot.done = True
             self._wake.set()
 
-    async def generate_decode_from_kv(
-        self,
-        request: Any,
-        context: Context,
-        first_token: int,
-        kv_k_pages,
-        kv_v_pages,
-        n_tokens: int,
-    ) -> AsyncIterator[dict]:
-        """Disagg decode entry: continue decoding from remotely-prefilled KV
-        (reference decode-with-kv_transfer_params, handlers.py:258-270).
-        The first token was already produced by the prefill worker and is
-        NOT re-emitted here."""
+    async def _decode_entry_slot(self, request: Any, context: Context,
+                                 first_token: Optional[int]):
+        """Shared prologue of the disagg decode entries (from_kv / resume /
+        from_pull): coerce + validate the request, build the "-d" slot,
+        and catch the guided FSM up to the prefill worker's already-emitted
+        first token. Returns (slot, None) or (None, error_string)."""
         self.start()
         req = (
             request
@@ -1508,16 +1564,17 @@ class JaxEngine:
         )
         g_err = (await self._compile_guided_async(req) or self._check_lora(req) or self._check_logprobs(req))
         if g_err is not None:
-            yield Annotated.from_error(g_err).to_dict()
-            return
+            return None, g_err
         slot = self._new_slot(req, context, suffix="-d")
-        if slot.guided_fsm is not None:
-            # the prefill worker sampled (and emitted) the first token
-            # under the same FSM; catch the state up to it
+        if slot.guided_fsm is not None and first_token is not None:
             slot.guided_state = slot.guided_fsm.advance(
                 slot.guided_state, first_token
             )
-        slot.preloaded = (first_token, kv_k_pages, kv_v_pages, n_tokens)
+        return slot, None
+
+    async def _drain_decode_slot(self, slot: _Slot) -> AsyncIterator[dict]:
+        """Shared epilogue: enqueue the slot and yield its stream until the
+        terminal None, marking it done on consumer teardown."""
         self.num_requests += 1
         self._waiting.append(slot)
         self._wake.set()
@@ -1531,6 +1588,47 @@ class JaxEngine:
             slot.done = True
             self._wake.set()
 
+    async def generate_decode_from_kv(
+        self,
+        request: Any,
+        context: Context,
+        first_token: int,
+        kv_k_pages,
+        kv_v_pages,
+        n_tokens: int,
+    ) -> AsyncIterator[dict]:
+        """Disagg decode entry: continue decoding from remotely-prefilled KV
+        (reference decode-with-kv_transfer_params, handlers.py:258-270).
+        The first token was already produced by the prefill worker and is
+        NOT re-emitted here."""
+        slot, g_err = await self._decode_entry_slot(request, context, first_token)
+        if g_err is not None:
+            yield Annotated.from_error(g_err).to_dict()
+            return
+        slot.preloaded = (first_token, kv_k_pages, kv_v_pages, n_tokens)
+        async for item in self._drain_decode_slot(slot):
+            yield item
+
+    async def generate_decode_resume(
+        self, request: Any, context: Context, first_token: int
+    ) -> AsyncIterator[dict]:
+        """Disagg decode entry WITHOUT a usable KV payload (typed
+        kv_format mismatch, docs/kvbm.md mixed-fleet rules): prefill the
+        prompt locally and resume decoding from the prefill worker's
+        already-emitted first token — the same fallback a failed pull
+        takes, entered before any foreign bytes are interpreted."""
+        slot, g_err = await self._decode_entry_slot(request, context, first_token)
+        if g_err is not None:
+            yield Annotated.from_error(g_err).to_dict()
+            return
+        slot.generated = 1
+        slot.last_token = first_token
+        slot.seq.append(first_token)
+        slot.resume_token = first_token
+        slot.prefill_pos = 0
+        async for item in self._drain_decode_slot(slot):
+            yield item
+
     async def generate_decode_from_pull(
         self, request: Any, context: Context, first_token: int, desc: dict
     ) -> AsyncIterator[dict]:
@@ -1538,35 +1636,14 @@ class JaxEngine:
         on its data plane; we allocate pages, then stream-inject chunks while
         the decode batch keeps stepping (transfer/compute overlap). Falls
         back to local prefill if the pull dies."""
-        self.start()
-        req = (
-            request
-            if isinstance(request, PreprocessedRequest)
-            else PreprocessedRequest.from_dict(request)
-        )
-        g_err = (await self._compile_guided_async(req) or self._check_lora(req) or self._check_logprobs(req))
+        slot, g_err = await self._decode_entry_slot(request, context, first_token)
         if g_err is not None:
             yield Annotated.from_error(g_err).to_dict()
             return
-        slot = self._new_slot(req, context, suffix="-d")
-        if slot.guided_fsm is not None:
-            slot.guided_state = slot.guided_fsm.advance(
-                slot.guided_state, first_token
-            )
         slot.preloaded = (first_token, None, None, int(desc["n_tokens"]))
         slot.pull_desc = desc
-        self.num_requests += 1
-        self._waiting.append(slot)
-        self._wake.set()
-        try:
-            while True:
-                item = await slot.queue.get()
-                if item is None:
-                    return
-                yield item
-        finally:
-            slot.done = True
-            self._wake.set()
+        async for item in self._drain_decode_slot(slot):
+            yield item
 
     def begin_streamed_pull(
         self, request: Any, context: Context, desc: dict
@@ -1613,11 +1690,22 @@ class JaxEngine:
     def stats(self) -> dict:
         alloc_stats = self.allocator.stats()
         running = sum(1 for s in self.slots if s is not None)
+        kv_nbytes = (
+            int(self.kv_k.nbytes) + int(self.kv_v.nbytes)
+            if hasattr(self.kv_k, "nbytes") else 0
+        )
         out = {
             "num_waiting_reqs": len(self._waiting),
             "num_running_reqs": running,
             "gpu_cache_usage_perc": self.allocator.active_pages / self.allocator.num_pages,
             "request_total_slots": self.config.max_num_seqs,
+            # quantized KV density surface (docs/kvbm.md): the format, the
+            # resident pool bytes (incl. scales), and the typed
+            # mixed-precision rejections — what the bench's sessions-per-
+            # HBM-budget gate and a fleet-misconfig alert read
+            "kv_quant": self.config.kv_quant,
+            "kv_pool_bytes": kv_nbytes,
+            "kv_format_mismatches": self.kv_format_mismatches,
             **alloc_stats,
         }
         if self.kvbm is not None:
@@ -2290,12 +2378,21 @@ class JaxEngine:
         return toks
 
     def _dev_inject(self, page_ids, k_np, v_np):
+        from ..ops.kv_quant import device_pages
+
+        c = self.model_config
+        mode = self.config.kv_quant
+        # quantized payloads arrive as packed uint8 [L, n, PB] rows
+        # (q bytes + scales, the host/wire layout) and unpack into the
+        # QuantKV leaves here; fp payloads are the seed's jnp.asarray
         self.kv_k, self.kv_v = self._inject_pages(
             self.kv_k,
             self.kv_v,
             jnp.asarray(page_ids),
-            jnp.asarray(k_np),
-            jnp.asarray(v_np),
+            device_pages(k_np, mode, self.config.page_size,
+                         c.num_kv_heads, c.head_dim),
+            device_pages(v_np, mode, self.config.page_size,
+                         c.num_kv_heads, c.head_dim),
         )
 
     def _dev_extract(self, page_ids):
@@ -2312,7 +2409,32 @@ class JaxEngine:
                 multihost_utils.process_allgather(k),
                 multihost_utils.process_allgather(v),
             )
-        return np.asarray(k), np.asarray(v)
+        from ..ops.kv_quant import host_pack_pages
+
+        # fp: the seed's np.asarray; quantized: packed uint8 [L, n, PB]
+        # rows (q bytes + scales) — the ONE host/wire page layout
+        return host_pack_pages(k), host_pack_pages(v)
+
+    def _kv_wire_meta(self):
+        """(page_shape, dtype_name) as KV pages travel on the wire: the
+        fp [L, ps, KH, D] layout, or the packed uint8 [L, PAGE_BYTES]
+        rows of a quantized pool (ops/kv_quant.py host layout). Every
+        disagg descriptor/payload carries kv_format beside this so a
+        mixed-precision pairing fails typed, never misreads bytes."""
+        c = self.model_config
+        cfg = self.config
+        if cfg.kv_quant != "none":
+            from ..ops.kv_quant import kv_page_bytes
+
+            pb = kv_page_bytes(
+                cfg.page_size, c.num_kv_heads, c.head_dim, c.dtype,
+                cfg.kv_quant,
+            )
+            return [c.num_layers, pb], "uint8"
+        return (
+            [c.num_layers, cfg.page_size, c.num_kv_heads, c.head_dim],
+            str(jnp.zeros((), c.dtype).dtype),
+        )
 
     def _kv_headwise_shards_ok(self) -> bool:
         """True iff every local KV-pool shard spans the FULL extent on all
@@ -2596,10 +2718,21 @@ class JaxEngine:
         handoff: the pull started off the EARLY descriptor while the peer
         was still prefilling; the token arrives later via
         slot.first_token_fut (None result = handler abandoned us)."""
-        from ..llm.kv_transfer import KvTransferDescriptor, pull_kv
+        from ..llm.kv_transfer import KvFormatError, KvTransferDescriptor, pull_kv
 
         desc = KvTransferDescriptor.from_dict(desc_dict)
         phys = np.array([p + 1 for p in slot.pages], np.int32)
+        if desc.kv_format != self.config.kv_quant:
+            # mixed-precision pairing: fail TYPED before any byte moves —
+            # the except-path below falls back to a local prefill (and
+            # counts it), instead of injecting misread pages
+            self.kv_format_mismatches += 1
+            err: Optional[Exception] = KvFormatError(
+                f"peer stages kv_format={desc.kv_format!r}, this worker "
+                f"runs {self.config.kv_quant!r}"
+            )
+        else:
+            err = None
         streamed = slot.first_token_fut is not None
         chunks_before_first = 0
         first_before_last_chunk = False
@@ -2627,6 +2760,8 @@ class JaxEngine:
             await self._run_on_device(partial(self._dev_inject, ids, k, v))
 
         try:
+            if err is not None:
+                raise err
             if desc.shards is not None:
                 await self._pull_kv_shards(slot, desc, phys)
             else:
@@ -2786,7 +2921,17 @@ class JaxEngine:
                 hashes, self._run_on_device,
                 hint_instance=hint.get("instance"),
             )
-        except (KeyError, faults.FaultError) as e:
+        except Exception as e:
+            from ..llm.kv_transfer import KvFormatError
+
+            if not isinstance(e, (KeyError, faults.FaultError, KvFormatError)):
+                raise
+            if isinstance(e, KvFormatError):
+                # mixed-precision fleet: the peer pull failed TYPED before
+                # any bytes were misread — counted, loud, then the same
+                # recompute fallback every onboard miss takes
+                self.kv_format_mismatches += 1
+                logger.warning("KVBM peer kv_format mismatch: %s", e)
             # block evicted between probe and load — or a dynochaos
             # `kvbm.onboard` error: fall back to computing that part of
             # the prompt (pages are already allocated); onboarding is a
@@ -3298,7 +3443,8 @@ class JaxEngine:
 
         self._bcast("extract", {"page_ids": page_ids})
         k_np, v_np = await self._run_on_device(partial(self._dev_extract, page_ids))
-        payload = pack_kv_payload(k_np, v_np, len(slot.prompt), cfg.page_size)
+        payload = pack_kv_payload(k_np, v_np, len(slot.prompt), cfg.page_size,
+                                  kv_format=cfg.kv_quant)
         if not slot.done:
             out = LLMEngineOutput(
                 token_ids=[first_token],
@@ -3328,7 +3474,7 @@ class JaxEngine:
 
         c = self.model_config
         cfg = self.config
-        dtype_name = str(jnp.zeros((), c.dtype).dtype)
+        wire_shape, dtype_name = self._kv_wire_meta()
 
         def on_done(ok: bool):
             if not ok:
@@ -3379,8 +3525,10 @@ class JaxEngine:
             async def extract(off: int, n: int, device: bool):
                 ids = page_ids[off : off + n]
                 self._bcast("extract", {"page_ids": ids})
-                if device and not self._multihost:
-                    # in-process path: hand over device arrays, no host staging
+                if device and not self._multihost and cfg.kv_quant == "none":
+                    # in-process path: hand over device arrays, no host
+                    # staging (quantized pools always serialize to the
+                    # packed host rows — the one wire layout)
                     return await self._run_on_device(
                         lambda: self._extract_pages(self.kv_k, self.kv_v, jnp.asarray(ids))
                     )
@@ -3390,8 +3538,9 @@ class JaxEngine:
                 n_pages=int(len(page_ids)),
                 n_tokens=len(slot.prompt),
                 page_size=cfg.page_size,
-                page_shape=[c.num_layers, cfg.page_size, c.num_kv_heads, c.head_dim],
+                page_shape=wire_shape,
                 dtype=dtype_name,
+                kv_format=cfg.kv_quant,
                 extract=extract,
                 on_done=on_done,
             )
@@ -3434,7 +3583,7 @@ class JaxEngine:
             # different physical ids
             ids = np.array([p + 1 for p in slot.pages[off : off + n]], np.int32)
             self._bcast("extract", {"page_ids": ids})
-            if device and not self._multihost:
+            if device and not self._multihost and cfg.kv_quant == "none":
                 return await self._run_on_device(
                     lambda: self._extract_pages(self.kv_k, self.kv_v, jnp.asarray(ids))
                 )
@@ -3458,12 +3607,14 @@ class JaxEngine:
                 # stages a fresh serial transfer instead
                 self.kv_streamed_fallbacks += 1
 
+        wire_shape, wire_dtype = self._kv_wire_meta()
         desc = self.data_plane.stage(
             n_pages=n_prompt_pages,
             n_tokens=len(slot.prompt),
             page_size=cfg.page_size,
-            page_shape=[c.num_layers, cfg.page_size, c.num_kv_heads, c.head_dim],
-            dtype=str(jnp.zeros((), c.dtype).dtype),
+            page_shape=wire_shape,
+            dtype=wire_dtype,
+            kv_format=cfg.kv_quant,
             extract=extract,
             on_done=on_done,
             chunk_pages=max(cfg.max_prefill_chunk // cfg.page_size, 1),

@@ -32,13 +32,21 @@ def alloc_kv_arrays(
     head_dim: int,
     dtype=jnp.bfloat16,
     sharding=None,
+    kv_quant: str = "none",
 ) -> Tuple[jax.Array, jax.Array]:
-    shape = (num_layers, num_pages, page_size, num_kv_heads, head_dim)
-    kv_k = jnp.zeros(shape, dtype)
-    kv_v = jnp.zeros(shape, dtype)
-    if sharding is not None:
-        kv_k = jax.device_put(kv_k, sharding)
-        kv_v = jax.device_put(kv_v, sharding)
+    """Allocate the K and V stores: plain fp arrays for kv_quant="none"
+    (the seed behavior, byte-identical), ops/kv_quant.QuantKV pytrees
+    (packed int8/int4 pages + per-page-per-head f32 scales) otherwise."""
+    from ..ops.kv_quant import alloc_kv_store
+
+    kv_k = alloc_kv_store(
+        num_layers, num_pages, page_size, num_kv_heads, head_dim, dtype,
+        kv_quant, sharding=sharding,
+    )
+    kv_v = alloc_kv_store(
+        num_layers, num_pages, page_size, num_kv_heads, head_dim, dtype,
+        kv_quant, sharding=sharding,
+    )
     return kv_k, kv_v
 
 

@@ -53,6 +53,14 @@ def parse_args(argv=None):
     ap.add_argument("--spec-draft-len", type=int, default=4)
     ap.add_argument("--spec-ngram", type=int, default=2)
     ap.add_argument("--spec-rounds", type=int, default=4)
+    ap.add_argument("--kv-quant", choices=["none", "int8", "int4"],
+                    default=None,
+                    help="quantized KV cache page format (default: resolve "
+                    "from DYN_KV_QUANT, none): pages quantize on write and "
+                    "dequantize in-kernel; ~2x/4x resident sessions at "
+                    "fixed HBM and the same shrink on every KVBM/peer/"
+                    "disagg transfer. All workers of a fleet must match "
+                    "(mismatches fail typed).")
     ap.add_argument("--quantize", choices=["int8"], default=None,
                     help="weight-only quantization (models/quant.py): int8 "
                     "projections/embed/head, per-channel scales")
@@ -151,6 +159,7 @@ async def main():
         decode_pool_mode=args.decode_pool_mode,
         decode_block_unroll=args.decode_block_unroll,
         quantize=args.quantize,
+        kv_quant=args.kv_quant,
         spec_mode=args.spec,
         spec_draft_len=args.spec_draft_len,
         spec_ngram=args.spec_ngram,

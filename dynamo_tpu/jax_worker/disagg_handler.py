@@ -168,10 +168,26 @@ async def maybe_remote_prefill(
             if early is not None:
                 early.abort()
                 early = None
-            kv_k, kv_v, n_tokens = unpack_kv_payload(kv_payload)
-            stream = engine.generate_decode_from_kv(
-                request, context, first_token, kv_k, kv_v, n_tokens
-            )
+            my_fmt = getattr(engine.config, "kv_quant", "none") or "none"
+            if str(kv_payload.get("fmt", "none")) != my_fmt:
+                # mixed-precision fleet (typed, not silent): the prefill
+                # worker ships a different quantized page layout — refuse
+                # the payload, count it, and prefill locally from the
+                # already-emitted first token instead of injecting
+                # misread bytes
+                engine.kv_format_mismatches += 1
+                logger.warning(
+                    "disagg kv payload fmt=%r != local kv_quant=%r; "
+                    "prefilling locally", kv_payload.get("fmt"), my_fmt,
+                )
+                stream = engine.generate_decode_resume(
+                    request, context, first_token
+                )
+            else:
+                kv_k, kv_v, n_tokens = unpack_kv_payload(kv_payload)
+                stream = engine.generate_decode_from_kv(
+                    request, context, first_token, kv_k, kv_v, n_tokens
+                )
         async for item in stream:
             yield item
     finally:

@@ -285,7 +285,8 @@ class KvbmDistributed:
         async def pull_one(addr: str, hs: List[int]):
             t_peer = time.perf_counter()
             k, v = await pull_kvbm_blocks(
-                addr, hs, self.manager.block_shape, self.manager.dtype
+                addr, hs, self.manager.block_shape, self.manager.dtype,
+                kv_format=self.manager.kv_format,
             )
             ms = (time.perf_counter() - t_peer) * 1000.0
             prev = self._pull_ms_per_block.get(addr)

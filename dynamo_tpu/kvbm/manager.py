@@ -92,10 +92,18 @@ class KvbmConfig:
 class KvBlockManager:
     """Owns the G2/G3 tiers and the offload/onboard policy."""
 
-    def __init__(self, cfg: KvbmConfig, block_shape: tuple, dtype):
+    def __init__(self, cfg: KvbmConfig, block_shape: tuple, dtype,
+                 kv_format: str = "none"):
         self.cfg = cfg
         self.block_shape = tuple(block_shape)
         self.dtype = dtype
+        # quantized-KV page format this manager's tiers hold (docs/kvbm.md
+        # "Quantized KV format"): under int8/int4 a block is ONE PACKED
+        # uint8 row per layer (q bytes + per-page-per-head scales,
+        # ops/kv_quant.py host layout) — tier capacity at fixed bytes
+        # grows 2x/4x, and the format travels in the peer-pull handshake
+        # so mixed-precision fleets fail typed (KvFormatError)
+        self.kv_format = str(kv_format)
         # K+V bytes per block: the data plane sizes its inline-vs-executor
         # serve decision off this
         self.block_nbytes = 2 * int(np.prod(block_shape)) * np.dtype(dtype).itemsize
@@ -488,6 +496,8 @@ class KvbmConnector:
         write-through). Losing a promotion under pressure loses a future
         local hit, never correctness — the peer still owns the block."""
         # _store_batch expects [layers, n, ...] like a device gather
+        # (peer pulls arrive per-block [n, layers, ...] — fp typed rows or
+        # quantized packed uint8 rows, either way a plain swapaxes)
         batch = _OffloadBatch(
             hashes=[int(h) for h in hashes],
             parents=list(parents),
@@ -573,10 +583,14 @@ class KvbmConnector:
                 raise faults.FaultError("injected fault at kvbm.offload")
             if act == "delay":
                 time.sleep(0.05)
-        # np.asarray blocks until the async gather lands — on THIS thread,
-        # not the device executor; [layers, n, ...] -> per-block [n, ...]
-        k_np = np.asarray(batch.k).swapaxes(0, 1)
-        v_np = np.asarray(batch.v).swapaxes(0, 1)
+        # host_pack_pages blocks until the async gather lands — on THIS
+        # thread, not the device executor. fp: the seed's np.asarray;
+        # quantized: packed uint8 [L, n, PB] rows (q bytes + scales).
+        # [layers, n, ...] -> per-block [n, ...]
+        from ..ops.kv_quant import host_pack_pages
+
+        k_np = host_pack_pages(batch.k).swapaxes(0, 1)
+        v_np = host_pack_pages(batch.v).swapaxes(0, 1)
         for i, h in enumerate(batch.hashes):
             self.manager.store(h, k_np[i], v_np[i], parent=batch.parents[i])
         with self._offload_cv:
@@ -618,10 +632,13 @@ class KvbmConnector:
         def run_extract():
             import jax.numpy as jnp
 
+            from ..ops.kv_quant import host_pack_pages
+
             k, v = eng._extract_pages(eng.kv_k, eng.kv_v, jnp.asarray(pages))
-            # [layers, n, page, heads, dim] -> per-block [layers, page, heads, dim]
-            k_np = np.asarray(k).swapaxes(0, 1)
-            v_np = np.asarray(v).swapaxes(0, 1)
+            # [layers, n, ...] -> per-block [layers, ...] (fp typed rows
+            # or quantized packed uint8 rows, same as the pipelined path)
+            k_np = host_pack_pages(k).swapaxes(0, 1)
+            v_np = host_pack_pages(v).swapaxes(0, 1)
             for i, h in enumerate(hashes):
                 self.manager.store(h, k_np[i], v_np[i], parent=parents[i])
             if self.distributed is not None:
@@ -803,6 +820,13 @@ class KvbmConnector:
             except KeyError:
                 raise
             except Exception as e:  # noqa: BLE001 — dead peer / severed
+                from ..llm.kv_transfer import KvFormatError
+
+                if isinstance(e, KvFormatError):
+                    # mixed-precision fleet: stays TYPED all the way up —
+                    # the engine counts it (kv_format_mismatches) before
+                    # falling back to recompute
+                    raise
                 # stream / unresolvable addr (KvTransferError) or any other
                 # transport failure: the engine treats a KeyError as
                 # "prefill that span instead"

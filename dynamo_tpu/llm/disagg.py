@@ -119,15 +119,21 @@ class DisaggregatedRouter:
 
 
 def pack_kv_payload(
-    kv_k: np.ndarray, kv_v: np.ndarray, n_tokens: int, page_size: int
+    kv_k: np.ndarray, kv_v: np.ndarray, n_tokens: int, page_size: int,
+    kv_format: str = "none",
 ) -> Dict[str, Any]:
-    """Serialize extracted KV pages [L, n_pages, page_size, KH, D] for the
-    response stream (msgpack-safe: raw bytes + shape/dtype header)."""
+    """Serialize extracted KV pages for the response stream (msgpack-safe:
+    raw bytes + shape/dtype header). fp pages are [L, n_pages, page_size,
+    KH, D]; a quantized pool's pages arrive PRE-PACKED as uint8
+    [L, n_pages, PAGE_BYTES] rows (q bytes + per-page-per-head scales,
+    ops/kv_quant.py) — `kv_format` names the layout so the decode side
+    verifies before injecting (mixed-precision fleets fail typed)."""
     return {
         "k": kv_k.tobytes(),
         "v": kv_v.tobytes(),
         "shape": list(kv_k.shape),
         "dtype": str(kv_k.dtype),
+        "fmt": str(kv_format),
         "n_tokens": n_tokens,
         "page_size": page_size,
     }

@@ -54,6 +54,14 @@ class KvTransferError(RuntimeError):
     instead of letting a raw ConnectionError escape into the step loop."""
 
 
+class KvFormatError(KvTransferError):
+    """The two ends of a KV transfer run different page formats
+    (DYN_KV_QUANT mixed-precision fleet, docs/kvbm.md mixed-fleet rules).
+    Raised BEFORE any payload bytes are interpreted: a format mismatch
+    must fail typed — countable, alertable — never silently reinterpret
+    quantized bytes as fp pages (or vice versa)."""
+
+
 _MAGIC = 0xD7A04B1D  # frame magic (full-stream pull handshake)
 _MAGIC_RANGE = 0xD7A04B1E  # ranged pull handshake (multi-host shard chunks)
 _HDR = struct.Struct("<II")  # magic, header length
@@ -135,13 +143,21 @@ class KvTransferDescriptor:
     # descriptor ships — chunks become pullable as pages commit, so the
     # puller must tolerate producer-paced gaps between chunks
     streamed: bool = False
+    # quantized-KV page format ("none" | "int8" | "int4"): under quant,
+    # page_shape is the PACKED host layout [L, PAGE_BYTES] uint8 (q bytes
+    # + per-page-per-head scales, ops/kv_quant.py) and the puller must
+    # run the same format — checked typed (KvFormatError) before pulling
+    kv_format: str = "none"
 
     def to_dict(self) -> dict:
         return dict(self.__dict__)
 
     @classmethod
     def from_dict(cls, d: dict) -> "KvTransferDescriptor":
-        return cls(**d)
+        import dataclasses as _dc
+
+        known = {f.name for f in _dc.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
 
 
 # extract(page_offset, n_pages, device) -> (k, v) with leading dim n_pages;
@@ -295,6 +311,7 @@ class KvDataPlaneServer:
         transfer_id: Optional[str] = None,
         streamed: bool = False,
         available_pages: int = 0,
+        kv_format: str = "none",
     ) -> KvTransferDescriptor:
         """Pin a finished prefill's pages for pulling; returns the descriptor
         to send on the response stream. `on_done(ok)` fires exactly once —
@@ -322,6 +339,7 @@ class KvDataPlaneServer:
             dtype=dtype,
             chunk_pages=chunk_pages,
             streamed=streamed,
+            kv_format=kv_format,
         )
         staged = _Staged(
             desc=desc,
@@ -504,6 +522,18 @@ class KvDataPlaneServer:
         if not hashes or len(hashes) > 4096:
             await self._send_header(writer, {"error": f"bad block count {len(hashes)}"})
             return
+        my_fmt = str(getattr(self.kvbm_source, "kv_format", "none"))
+        want_fmt = str(req.get("fmt", "none"))
+        if want_fmt != my_fmt:
+            # mixed-precision fleet: refuse TYPED before any block bytes
+            # move — the puller raises KvFormatError, never misreads rows
+            await self._send_header(
+                writer,
+                {"error": f"kv_format mismatch: serving {my_fmt}, "
+                          f"peer wants {want_fmt}",
+                 "fmt_mismatch": True, "fmt": my_fmt},
+            )
+            return
         try:
             # tier reads do host memcpy/disk IO: off the event loop —
             # EXCEPT small host-tier-only reads, where the executor
@@ -529,7 +559,7 @@ class KvDataPlaneServer:
         # admission latency on the peer, every syscall batch counts
         hdr_body = msgpack.packb(
             {"n": len(hashes), "k_bytes": len(kb), "v_bytes": len(vb),
-             "shape": list(k.shape), "dtype": str(k.dtype)},
+             "shape": list(k.shape), "dtype": str(k.dtype), "fmt": my_fmt},
             use_bin_type=True,
         )
         writer.write(_HDR.pack(_MAGIC, len(hdr_body)) + hdr_body)
@@ -732,13 +762,17 @@ async def pull_kvbm_blocks(
     dtype,
     connect_timeout: float = 10.0,
     chunk_timeout: float = 30.0,
+    kv_format: str = "none",
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Fetch tiered KV blocks by hash from a peer worker's data plane
     (distributed KVBM onboard; reference block_manager/distributed/
     worker.rs:137). Returns (k, v) stacked [n, *block_shape]. Raises
     KeyError on a block miss, KvTransferError on any transport failure
     (unreachable peer, severed stream) — both convert to recompute in the
-    onboard path. Connections come from a keep-alive pool; a stale pooled
+    onboard path — and KvFormatError when the peer's tiers hold a
+    DIFFERENT quantized page format (`kv_format` travels in the
+    handshake; a mixed-precision fleet fails typed, never misreads
+    packed rows). Connections come from a keep-alive pool; a stale pooled
     connection (server idled it out) earns exactly one fresh retry."""
     f = faults.FAULTS
     for attempt in (0, 1):
@@ -747,7 +781,8 @@ async def pull_kvbm_blocks(
         )
         try:
             body = msgpack.packb(
-                {"blocks": [int(h) for h in hashes]}, use_bin_type=True
+                {"blocks": [int(h) for h in hashes], "fmt": str(kv_format)},
+                use_bin_type=True,
             )
             writer.write(_HDR.pack(_MAGIC_RANGE, len(body)) + body)
             await writer.drain()
@@ -771,6 +806,11 @@ async def pull_kvbm_blocks(
             if header.get("error"):
                 # protocol-level refusal: the connection is still good
                 _CONN_POOL.release(addr, reader, writer)
+                if header.get("fmt_mismatch"):
+                    raise KvFormatError(
+                        f"kvbm peer {addr} serves kv_format="
+                        f"{header.get('fmt')!r}, we run {kv_format!r}"
+                    )
                 raise KeyError(f"kvbm pull refused: {header['error']}")
             if header["k_bytes"] > expect or header["v_bytes"] > expect:
                 raise RuntimeError("kvbm frame larger than expected")
@@ -788,7 +828,7 @@ async def pull_kvbm_blocks(
             ).reshape(shape)
             _CONN_POOL.release(addr, reader, writer)
             return k, v
-        except KeyError:
+        except (KeyError, KvFormatError):
             raise
         except (ConnectionError, asyncio.IncompleteReadError,
                 TimeoutError, asyncio.TimeoutError) as e:

@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 
 from .quant import embed_rows, head_leaf, qdot
+from ..ops.kv_quant import kv_layer, kv_page_size, kv_write
 from ..ops.paged_attention import (
     paged_attention_decode,
     prefill_attention,
@@ -203,7 +204,7 @@ def prefill_forward(
     mlp_fn = mlp_fn or _mlp
     x = embed_rows(params["embed"], tokens, c.dtype)  # [T, H]
     cos, sin = rope_cos_sin(positions, c.head_dim, c.rope_theta)
-    page_size = kv_k.shape[2]
+    page_size = kv_page_size(kv_k)
     T = tokens.shape[0]
     # valid context = history + real (unpadded) chunk length; bounds the
     # Pallas prefill kernel's page streaming (pallas_prefill_attention.py)
@@ -228,8 +229,8 @@ def prefill_forward(
             kv_k = _write_chunk(kv_k, li, k, positions, page_table, page_size)
             kv_v = _write_chunk(kv_v, li, v, positions, page_table, page_size)
             attn = prefill_attention(
-                q, k, v, kv_k[li], kv_v[li], positions, page_table, context_len,
-                total_len,
+                q, k, v, kv_layer(kv_k, li), kv_layer(kv_v, li), positions,
+                page_table, context_len, total_len,
             )
             attn = attn.reshape(-1, c.num_heads * c.head_dim)
             x = x + qdot(attn, layer["wo"]).astype(c.dtype)
@@ -276,7 +277,7 @@ def prefill_forward_batched(
     if emb_override is not None:
         x = jnp.where(emb_mask[..., None], emb_override.astype(c.dtype), x)
     cos, sin = rope_cos_sin(positions, c.head_dim, c.rope_theta)
-    page_size = kv_k.shape[2]
+    page_size = kv_page_size(kv_k)
     total_lens = context_lens + last_idx + 1  # [B] valid context per seq
 
     # route positions past the table to the scratch page (phys 0):
@@ -302,10 +303,11 @@ def prefill_forward_batched(
         v = v.reshape(B, T, c.num_kv_heads, c.head_dim)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        kv_k = kv_k.at[li, phys, offs].set(k)
-        kv_v = kv_v.at[li, phys, offs].set(v)
+        kv_k = kv_write(kv_k, li, phys, offs, k)
+        kv_v = kv_write(kv_v, li, phys, offs, v)
         attn = prefill_attention_batched(
-            q, kv_k[li], kv_v[li], positions, page_tables, total_lens, context_lens
+            q, kv_layer(kv_k, li), kv_layer(kv_v, li), positions, page_tables,
+            total_lens, context_lens
         )
         attn = attn.reshape(B, T, c.num_heads * c.head_dim)
         x = x + lora_mod.proj(attn, layer["wo"], qdot, ll, "wo").astype(c.dtype)
@@ -348,7 +350,7 @@ def ragged_forward(
     mlp_fn = mlp_fn or _mlp
     x = embed_rows(params["embed"], tokens, c.dtype)  # [N, H]
     cos, sin = rope_cos_sin(positions, c.head_dim, c.rope_theta)
-    page_size = kv_k.shape[2]
+    page_size = kv_page_size(kv_k)
 
     # per-token physical page: gather the OWNING row's table, route pad
     # positions (and any overshoot) to the scratch page — same trick as
@@ -371,10 +373,11 @@ def ragged_forward(
         v = v.reshape(-1, c.num_kv_heads, c.head_dim)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        kv_k = kv_k.at[li, phys, offs].set(k)
-        kv_v = kv_v.at[li, phys, offs].set(v)
+        kv_k = kv_write(kv_k, li, phys, offs, k)
+        kv_v = kv_write(kv_v, li, phys, offs, v)
         attn = ragged_attention(
-            q, kv_k[li], kv_v[li], page_tables, row_starts, row_lens, ctx_lens
+            q, kv_layer(kv_k, li), kv_layer(kv_v, li), page_tables,
+            row_starts, row_lens, ctx_lens
         )
         attn = attn.reshape(-1, c.num_heads * c.head_dim)
         x = x + qdot(attn, layer["wo"]).astype(c.dtype)
@@ -417,7 +420,7 @@ def prefill_forward_ring(
     positions = jnp.arange(T, dtype=jnp.int32)
     x = embed_rows(params["embed"], tokens, c.dtype)  # [T, H]
     cos, sin = rope_cos_sin(positions, c.head_dim, c.rope_theta)
-    page_size = kv_k.shape[2]
+    page_size = kv_page_size(kv_k)
 
     # pad positions write to the scratch page (phys 0), real ones to the table
     logical = jnp.minimum(positions // page_size, page_table.shape[0] - 1)
@@ -650,11 +653,13 @@ def prefill_forward_pp(
 
 def _write_chunk(kv, layer_idx, vals, positions, page_table, page_size):
     """Scatter chunk KV [T, kv_heads, head_dim] into paged cache at absolute
-    positions (page_table maps logical page -> physical page)."""
+    positions (page_table maps logical page -> physical page). Rides
+    ops/kv_quant.kv_write — quantize-on-write under DYN_KV_QUANT, the
+    seed's exact scatter otherwise."""
     logical_pages = positions // page_size
     phys_pages = page_table[logical_pages]
     offs = positions % page_size
-    return kv.at[layer_idx, phys_pages, offs].set(vals)
+    return kv_write(kv, layer_idx, phys_pages, offs, vals)
 
 
 def decode_forward(
@@ -677,7 +682,7 @@ def decode_forward(
     mlp_fn = mlp_fn or _mlp
     x = embed_rows(params["embed"], tokens, c.dtype)  # [B, H]
     cos, sin = rope_cos_sin(positions, c.head_dim, c.rope_theta)
-    page_size = kv_k.shape[2]
+    page_size = kv_page_size(kv_k)
 
     for li in range(c.num_layers):
         layer = jax.tree.map(lambda p: p[li], params["layers"])
@@ -701,9 +706,11 @@ def decode_forward(
         phys = jnp.take_along_axis(page_tables, logical[:, None], axis=1)[:, 0]
         phys = jnp.where(positions < max_positions, phys, 0)
         offs = positions % page_size
-        kv_k = kv_k.at[li, phys, offs].set(k[:, 0] if k.ndim == 4 else k)
-        kv_v = kv_v.at[li, phys, offs].set(v[:, 0] if v.ndim == 4 else v)
-        attn = paged_attention_decode(q, kv_k[li], kv_v[li], page_tables, seq_lens)
+        kv_k = kv_write(kv_k, li, phys, offs, k[:, 0] if k.ndim == 4 else k)
+        kv_v = kv_write(kv_v, li, phys, offs, v[:, 0] if v.ndim == 4 else v)
+        attn = paged_attention_decode(
+            q, kv_layer(kv_k, li), kv_layer(kv_v, li), page_tables, seq_lens
+        )
         attn = attn.reshape(-1, c.num_heads * c.head_dim)
         x = x + lora_mod.proj(attn, layer["wo"], qdot, ll, "wo").astype(c.dtype)
         x = mlp_fn(layer, x, c)
@@ -758,7 +765,7 @@ def decode_forward_local(
         loc_k[li] = loc_k[li].at[:, step_idx].set(k)
         loc_v[li] = loc_v[li].at[:, step_idx].set(v)
         attn = paged_attention_decode_mixed(
-            q, kv_k[li], kv_v[li], page_tables, pool_lens,
+            q, kv_layer(kv_k, li), kv_layer(kv_v, li), page_tables, pool_lens,
             loc_k[li], loc_v[li], step_idx,
         )
         attn = attn.reshape(-1, c.num_heads * c.head_dim)

@@ -34,16 +34,77 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = [
+    "QMAX",
     "dequantize_leaf",
+    "dequantize_symmetric",
     "embed_rows",
     "head_leaf",
     "is_quant",
+    "pack_int4",
     "qdot",
     "qeinsum",
     "quantize_array",
+    "quantize_symmetric",
     "quantize_tree",
     "scale_sharding",
+    "symmetric_scale",
+    "unpack_int4",
 ]
+
+# ---------------------------------------------------------------------- #
+# Shared symmetric-quantization primitives — the ONE spelling of
+# quantize/dequantize math used by BOTH weight-only quantization (below)
+# and the quantized KV cache (ops/kv_quant.py). int4 values live two to a
+# byte (pack_int4/unpack_int4); scales are always f32.
+# ---------------------------------------------------------------------- #
+
+QMAX = {8: 127, 4: 7}  # symmetric ranges: int8 [-127,127], int4 [-7,7]
+
+
+def symmetric_scale(amax, bits: int = 8, eps: float = 1e-8):
+    """Scale s such that clip(round(x/s)) covers [-amax, amax] in `bits`-bit
+    symmetric range. Works in numpy or jax (stays in the input namespace)."""
+    xp = np if isinstance(amax, np.ndarray) else jnp
+    return xp.maximum(amax, eps) / QMAX[bits]
+
+
+def quantize_symmetric(x, s, bits: int = 8):
+    """round(x/s) clipped to the symmetric `bits`-bit range, as int8 values
+    (int4 values occupy int8 storage until pack_int4). `s` broadcasts."""
+    xp = np if isinstance(x, np.ndarray) else jnp
+    q = xp.clip(xp.round(x / s), -QMAX[bits], QMAX[bits])
+    return q.astype(xp.int8)
+
+
+def dequantize_symmetric(q, s, dtype=jnp.float32):
+    """q * s in f32, cast to `dtype`. The inverse of quantize_symmetric for
+    any bits (int4 must be unpacked first)."""
+    xp = np if isinstance(q, np.ndarray) else jnp
+    return (q.astype(xp.float32) * s).astype(dtype)
+
+
+def pack_int4(q, axis: int = 0):
+    """Pack int4 values (int8 storage, range [-7,7]) two-to-a-byte along
+    `axis`, pairing index i with i + n/2: byte = (q[i] & 0xF) | (q[i+n/2]
+    << 4). unpack_int4's concat(lo, hi) then restores the ORIGINAL order —
+    no interleave, which matters inside the Pallas VMEM window where
+    minor-dim shuffles are unsupported. `axis` length must be even."""
+    xp = np if isinstance(q, np.ndarray) else jnp
+    n = q.shape[axis]
+    lo = xp.take(q, xp.arange(0, n // 2), axis=axis)
+    hi = xp.take(q, xp.arange(n // 2, n), axis=axis)
+    return ((lo & 0x0F) | (hi << 4)).astype(xp.int8)
+
+
+def unpack_int4(packed, axis: int = 0):
+    """Inverse of pack_int4: sign-extend both nibbles and concatenate along
+    `axis` (lo half first), doubling that axis."""
+    xp = np if isinstance(packed, np.ndarray) else jnp
+    # arithmetic shifts on int8 sign-extend: (x << 4) >> 4 recovers the low
+    # nibble's sign, x >> 4 the high nibble's
+    lo = xp.right_shift(xp.left_shift(packed, 4), 4)
+    hi = xp.right_shift(packed, 4)
+    return xp.concatenate([lo, hi], axis=axis).astype(xp.int8)
 
 # leaves of the llama tree that quantize (per-out-channel over the
 # contraction axis -2); embed is special-cased (per-ROW scale, axis -1,
@@ -59,12 +120,14 @@ def is_quant(leaf: Any) -> bool:
 def quantize_array(w, contract_axis: int = -2) -> Dict[str, Any]:
     """Symmetric int8 with a per-channel f32 scale over `contract_axis`
     (kept as a singleton dim so it broadcasts against the dot result).
-    Works on numpy or jax arrays; stays in the input's array namespace."""
+    Works on numpy or jax arrays; stays in the input's array namespace.
+    (One spelling: symmetric_scale/quantize_symmetric above — shared with
+    the quantized KV cache's per-page-per-head scales, ops/kv_quant.py.)"""
     xp = np if isinstance(w, np.ndarray) else jnp
     wf = xp.asarray(w, dtype=xp.float32)
     amax = xp.max(xp.abs(wf), axis=contract_axis, keepdims=True)
-    s = xp.maximum(amax, 1e-8) / 127.0
-    q = xp.clip(xp.round(wf / s), -127, 127).astype(xp.int8)
+    s = symmetric_scale(amax, bits=8)
+    q = quantize_symmetric(wf, s, bits=8)
     return {"q": q, "s": s.astype(xp.float32)}
 
 

@@ -18,7 +18,18 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from .kv_quant import QuantKV, gather_dequant, is_quant_kv
+
 NEG_INF = -1e30
+
+
+def _layer_dims(layer) -> tuple:
+    """(num_pages, page_size, KH, D) for a per-layer KV operand — plain
+    array or QuantKV (whose q axis 1 is packed for int4)."""
+    if is_quant_kv(layer):
+        P, _, KH, D = layer.q.shape
+        return P, layer.page_size, KH, D
+    return layer.shape
 
 
 def prefill_attention(
@@ -41,16 +52,22 @@ def prefill_attention(
     (the engine bounds the table length to the context bucket, so the
     gather is context-sized, not max-context-sized).
     """
-    if total_len is not None and _pallas_eligible(q.shape[-1]):
+    if (
+        total_len is not None and _pallas_eligible(q.shape[-1])
+        and not is_quant_kv(kv_k_layer)
+    ):
+        # quantized pages ride the XLA reference here: prefill is
+        # compute-bound, and the in-kernel dequant investment went to the
+        # ragged + decode kernels (the HBM-bound paths)
         from .pallas_prefill_attention import paged_prefill_attention_pallas
 
         return paged_prefill_attention_pallas(
             q, kv_k_layer, kv_v_layer, page_table, context_len, total_len
         )
-    page_size = kv_k_layer.shape[1]
+    _, page_size, KH_l, D_l = _layer_dims(kv_k_layer)
     S = page_table.shape[0] * page_size
-    ctx_k = kv_k_layer[page_table].reshape(S, *kv_k_layer.shape[2:])  # [S, KH, D]
-    ctx_v = kv_v_layer[page_table].reshape(S, *kv_v_layer.shape[2:])
+    ctx_k = gather_dequant(kv_k_layer, page_table, q.dtype).reshape(S, KH_l, D_l)
+    ctx_v = gather_dequant(kv_v_layer, page_table, q.dtype).reshape(S, KH_l, D_l)
 
     T, H, D = q.shape
     KH = ctx_k.shape[1]
@@ -84,18 +101,17 @@ def prefill_attention_batched(
     context pages; elsewhere the XLA path gathers each (engine-bounded)
     page table.
     """
-    if _pallas_eligible(q.shape[-1]):
+    if _pallas_eligible(q.shape[-1]) and not is_quant_kv(kv_k_layer):
         from .pallas_prefill_attention import paged_prefill_attention_pallas_batched
 
         return paged_prefill_attention_pallas_batched(
             q, kv_k_layer, kv_v_layer, page_tables, starts, total_lens
         )
     B, T, H, D = q.shape
-    page_size = kv_k_layer.shape[1]
-    KH = kv_k_layer.shape[2]
+    _, page_size, KH, _ = _layer_dims(kv_k_layer)
     S = page_tables.shape[1] * page_size
-    ctx_k = kv_k_layer[page_tables].reshape(B, S, KH, D)
-    ctx_v = kv_v_layer[page_tables].reshape(B, S, KH, D)
+    ctx_k = gather_dequant(kv_k_layer, page_tables, q.dtype).reshape(B, S, KH, D)
+    ctx_v = gather_dequant(kv_v_layer, page_tables, q.dtype).reshape(B, S, KH, D)
     G = H // KH
     qg = q.reshape(B, T, KH, G, D)
     scores = jnp.einsum(
@@ -164,15 +180,18 @@ def paged_attention_decode_mixed(
     softmax on the XLA path.
     """
     B, H, D = q.shape
-    KH = kv_k_layer.shape[2]
+    _, page_size, KH, D_ = _layer_dims(kv_k_layer)
     G = H // KH
     K = loc_k.shape[1]
     scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
-    KH_, D_ = kv_k_layer.shape[2], kv_k_layer.shape[3]
-    if _pallas_eligible(KH_ * D_):
+    if _pallas_eligible(KH * D_):
         # pool chunks AND the local buffer flash-merge inside ONE kernel
         # launch — an XLA-level lse combine costs ~8 extra op launches per
-        # layer-step, which dominates a 28-layer x 16-step fused block
+        # layer-step, which dominates a 28-layer x 16-step fused block.
+        # Quantized pools dequantize inside the VMEM window (the scales
+        # ride scalar prefetch beside the page tables); the block-local
+        # buffer stays full precision — quantization happens on POOL
+        # writes only (the once-per-block carry patch).
         from .pallas_paged_attention import paged_attention_decode_pallas_local
 
         return paged_attention_decode_pallas_local(
@@ -182,10 +201,9 @@ def paged_attention_decode_mixed(
 
     # XLA reference path: gather pool pages, concatenate the local buffer,
     # one softmax over both
-    page_size = kv_k_layer.shape[1]
     S = page_tables.shape[1] * page_size
-    ctx_k = kv_k_layer[page_tables].reshape(B, S, KH, D)
-    ctx_v = kv_v_layer[page_tables].reshape(B, S, KH, D)
+    ctx_k = gather_dequant(kv_k_layer, page_tables, q.dtype).reshape(B, S, KH, D)
+    ctx_v = gather_dequant(kv_v_layer, page_tables, q.dtype).reshape(B, S, KH, D)
     cat_k = jnp.concatenate([ctx_k, loc_k.astype(ctx_k.dtype)], axis=1)
     cat_v = jnp.concatenate([ctx_v, loc_v.astype(ctx_v.dtype)], axis=1)
     qg = q.reshape(B, KH, G, D)
@@ -216,9 +234,10 @@ def paged_attention_decode(
     kernel (ops/pallas_paged_attention.py) streams pages HBM→VMEM without
     materializing the gather; elsewhere the XLA reference path below runs.
     """
-    KH_, D_ = kv_k_layer.shape[2], kv_k_layer.shape[3]
+    _, page_size, KH_, D_ = _layer_dims(kv_k_layer)
     # the decode kernel's page window has lane dim KH*D (whole-page
-    # copies), so that is what must be 128-aligned here
+    # copies), so that is what must be 128-aligned here (int4 packs along
+    # the page_size/sublane axis, so the lane dim is unchanged)
     if _pallas_eligible(KH_ * D_):
         from .pallas_paged_attention import paged_attention_decode_pallas
 
@@ -226,11 +245,10 @@ def paged_attention_decode(
             q, kv_k_layer, kv_v_layer, page_tables, seq_lens
         )
     B, H, D = q.shape
-    page_size = kv_k_layer.shape[1]
-    KH = kv_k_layer.shape[2]
+    KH = KH_
     S = page_tables.shape[1] * page_size
-    ctx_k = kv_k_layer[page_tables].reshape(B, S, KH, D)
-    ctx_v = kv_v_layer[page_tables].reshape(B, S, KH, D)
+    ctx_k = gather_dequant(kv_k_layer, page_tables, q.dtype).reshape(B, S, KH, D)
+    ctx_v = gather_dequant(kv_v_layer, page_tables, q.dtype).reshape(B, S, KH, D)
 
     G = H // KH
     qg = q.reshape(B, KH, G, D)
@@ -264,8 +282,7 @@ def ragged_attention_reference(
     real rows."""
     N, H, D = q.shape
     R, P = page_tables.shape
-    page_size = kv_k_layer.shape[1]
-    KH = kv_k_layer.shape[2]
+    _, page_size, KH, _ = _layer_dims(kv_k_layer)
     S = P * page_size
     idx = jnp.arange(N)
     # owning row per token: the last row whose start <= idx (padding
@@ -276,8 +293,12 @@ def ragged_attention_reference(
     local = idx - row_starts[row_ids]
     positions = ctx_lens[row_ids] + local
     totals = ctx_lens[row_ids] + row_lens[row_ids]
-    ctx_k = kv_k_layer[page_tables].reshape(R, S, KH, D)[row_ids]  # [N, S, KH, D]
-    ctx_v = kv_v_layer[page_tables].reshape(R, S, KH, D)[row_ids]
+    ctx_k = gather_dequant(
+        kv_k_layer, page_tables, q.dtype
+    ).reshape(R, S, KH, D)[row_ids]  # [N, S, KH, D]
+    ctx_v = gather_dequant(
+        kv_v_layer, page_tables, q.dtype
+    ).reshape(R, S, KH, D)[row_ids]
     G = H // KH
     qg = q.reshape(N, KH, G, D)
     scores = jnp.einsum(

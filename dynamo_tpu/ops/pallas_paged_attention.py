@@ -37,33 +37,65 @@ from jax.experimental.pallas import tpu as pltpu
 NEG = -1e30
 
 
+def _window_dequant(b, ci, slot, k_buf, v_buf, pt_ref, ks_ref, vs_ref,
+                    compute_dtype, *, chunk_pages, page_rows, max_pages,
+                    num_phys, num_kv_heads, head_dim, kv_bits):
+    """Quantized decode window -> full-precision ([chunk, KH*D] K, V):
+    per page, unpack (int4 packs two tokens per byte along the sublane
+    axis) and multiply each kv head's D-wide column block by that page's
+    scalar-prefetched per-head scale."""
+    from ..models.quant import unpack_int4
+
+    k_segs, v_segs = [], []
+    for p in range(chunk_pages):
+        lp_safe = jnp.minimum(ci * chunk_pages + p, max_pages - 1)
+        phys = jnp.minimum(pt_ref[b, lp_safe], num_phys - 1)
+        kseg = k_buf[slot, pl.ds(p * page_rows, page_rows)]  # int8 [rows, KH*D]
+        vseg = v_buf[slot, pl.ds(p * page_rows, page_rows)]
+        if kv_bits == 4:
+            kseg = unpack_int4(kseg, axis=0)  # [page_size, KH*D]
+            vseg = unpack_int4(vseg, axis=0)
+        # per-head scale over the head's D-wide column block
+        ks_row = jnp.concatenate(
+            [jnp.full((1, head_dim), ks_ref[phys, h], jnp.float32)
+             for h in range(num_kv_heads)], axis=1,
+        )  # [1, KH*D]
+        vs_row = jnp.concatenate(
+            [jnp.full((1, head_dim), vs_ref[phys, h], jnp.float32)
+             for h in range(num_kv_heads)], axis=1,
+        )
+        k_segs.append((kseg.astype(jnp.float32) * ks_row).astype(compute_dtype))
+        v_segs.append((vseg.astype(jnp.float32) * vs_row).astype(compute_dtype))
+    return jnp.concatenate(k_segs, axis=0), jnp.concatenate(v_segs, axis=0)
+
+
 def _decode_kernel(
-    # scalar prefetch
-    pt_ref,  # [B, max_pages] int32 (SMEM)
-    sl_ref,  # [B] int32 (SMEM)
-    # inputs
-    q_ref,  # [1, H, D] VMEM block
-    kv_k_hbm,  # [num_pages, page_size, KH*D] (ANY/HBM; flattened by wrapper —
-    # Mosaic can't shape-cast [C,KH,D]->[C,KH*D] in-register)
-    kv_v_hbm,
-    # outputs
-    out_ref,  # [1, H, D] VMEM block
-    # scratch
-    k_buf,  # [2, CHUNK, KH*D] VMEM
-    v_buf,
-    k_sem,  # DMA sems [2, chunk_pages]
-    v_sem,
-    *,
+    # positional refs: page_tables [B, max_pages] + seq_lens [B] int32
+    # scalar prefetch (+ per-page-per-head K/V scales [num_pages, KH] f32
+    # when kv_bits > 0), then q [1, H, D] VMEM, kv_k/kv_v
+    # [num_pages, rows, KH*D] ANY/HBM (rows = page_size, or page_size//2
+    # int4-packed along the sublane axis), the out block, and the
+    # double-buffered VMEM window + DMA semaphores.
+    *refs,
     page_size: int,
     chunk_pages: int,
     max_pages: int,
     num_heads: int,
     num_kv_heads: int,
     head_dim: int,
+    kv_bits: int = 0,
 ):
+    if kv_bits:
+        (pt_ref, sl_ref, ks_ref, vs_ref, q_ref, kv_k_hbm, kv_v_hbm,
+         out_ref, k_buf, v_buf, k_sem, v_sem) = refs
+    else:
+        (pt_ref, sl_ref, q_ref, kv_k_hbm, kv_v_hbm,
+         out_ref, k_buf, v_buf, k_sem, v_sem) = refs
+        ks_ref = vs_ref = None
     b = pl.program_id(0)
     chunk = chunk_pages * page_size
     num_phys = kv_k_hbm.shape[0]
+    page_rows = kv_k_hbm.shape[1]
     kh, g, d = num_kv_heads, num_heads // num_kv_heads, head_dim
 
     seq_len = jnp.maximum(sl_ref[b], 1)  # empty slots behave as len-1
@@ -78,12 +110,12 @@ def _decode_kernel(
             phys = jnp.minimum(pt_ref[b, lp_safe], num_phys - 1)
             pltpu.make_async_copy(
                 kv_k_hbm.at[phys],
-                k_buf.at[slot, pl.ds(p * page_size, page_size)],
+                k_buf.at[slot, pl.ds(p * page_rows, page_rows)],
                 k_sem.at[slot, p],
             ).start()
             pltpu.make_async_copy(
                 kv_v_hbm.at[phys],
-                v_buf.at[slot, pl.ds(p * page_size, page_size)],
+                v_buf.at[slot, pl.ds(p * page_rows, page_rows)],
                 v_sem.at[slot, p],
             ).start()
 
@@ -93,12 +125,12 @@ def _decode_kernel(
             phys = jnp.minimum(pt_ref[b, lp_safe], num_phys - 1)
             pltpu.make_async_copy(
                 kv_k_hbm.at[phys],
-                k_buf.at[slot, pl.ds(p * page_size, page_size)],
+                k_buf.at[slot, pl.ds(p * page_rows, page_rows)],
                 k_sem.at[slot, p],
             ).wait()
             pltpu.make_async_copy(
                 kv_v_hbm.at[phys],
-                v_buf.at[slot, pl.ds(p * page_size, page_size)],
+                v_buf.at[slot, pl.ds(p * page_rows, page_rows)],
                 v_sem.at[slot, p],
             ).wait()
 
@@ -125,8 +157,16 @@ def _decode_kernel(
             start_chunk(ci + 1, jax.lax.rem(ci + 1, 2))
 
         wait_chunk(ci, slot)
-        k = k_buf[slot]  # [CHUNK, KH*D]
-        v = v_buf[slot]
+        if kv_bits:
+            k, v = _window_dequant(
+                b, ci, slot, k_buf, v_buf, pt_ref, ks_ref, vs_ref,
+                q_ref.dtype, chunk_pages=chunk_pages, page_rows=page_rows,
+                max_pages=max_pages, num_phys=num_phys,
+                num_kv_heads=kh, head_dim=d, kv_bits=kv_bits,
+            )
+        else:
+            k = k_buf[slot]  # [CHUNK, KH*D]
+            v = v_buf[slot]
 
         pos = ci * chunk + jax.lax.broadcasted_iota(jnp.int32, (1, chunk), 1)
         valid = pos < seq_len  # [1, CHUNK]
@@ -163,39 +203,38 @@ def _decode_kernel(
 
 
 def _decode_local_kernel(
-    # scalar prefetch
-    pt_ref,  # [B, max_pages] int32 (SMEM)
-    sl_ref,  # [B] int32 (SMEM) — POOL lengths (block-start)
-    step_ref,  # [1] int32 (SMEM) — local entries 0..step valid
-    # inputs
-    q_ref,  # [1, HG, KH*D] VMEM (block-diagonal packed)
-    loc_k_ref,  # [1, K, KH*D] VMEM — block-local new keys for this lane
-    loc_v_ref,
-    kv_k_hbm,  # [num_pages, page_size, KH*D] (ANY/HBM)
-    kv_v_hbm,
-    # outputs
-    out_ref,  # [1, H, D]
-    # scratch
-    k_buf,
-    v_buf,
-    k_sem,
-    v_sem,
-    *,
+    # positional refs: page_tables [B, max_pages], POOL lens [B], step [1]
+    # int32 scalar prefetch (+ per-page-per-head K/V scales
+    # [num_pages, KH] f32 when kv_bits > 0), then q [1, HG, KH*D] VMEM
+    # (block-diagonal packed), the block-local loc_k/loc_v [1, K, KH*D]
+    # (ALWAYS full precision — quantization happens on pool writes only),
+    # kv_k/kv_v [num_pages, rows, KH*D] ANY/HBM, out, window scratch.
+    *refs,
     page_size: int,
     chunk_pages: int,
     max_pages: int,
     num_heads: int,
     num_kv_heads: int,
     head_dim: int,
+    kv_bits: int = 0,
 ):
     """Decode flash attention over pool pages PLUS a block-local KV buffer,
     all in one kernel launch. The local part is what lets the engine keep
     the KV pool read-only inside its fused K-step scan (engine/engine.py
     decode_block): per-step XLA-level combines cost ~8 extra op launches
     per layer-step, which dominated the block at 28 layers x 16 steps."""
+    if kv_bits:
+        (pt_ref, sl_ref, step_ref, ks_ref, vs_ref, q_ref, loc_k_ref,
+         loc_v_ref, kv_k_hbm, kv_v_hbm, out_ref, k_buf, v_buf, k_sem,
+         v_sem) = refs
+    else:
+        (pt_ref, sl_ref, step_ref, q_ref, loc_k_ref, loc_v_ref,
+         kv_k_hbm, kv_v_hbm, out_ref, k_buf, v_buf, k_sem, v_sem) = refs
+        ks_ref = vs_ref = None
     b = pl.program_id(0)
     chunk = chunk_pages * page_size
     num_phys = kv_k_hbm.shape[0]
+    page_rows = kv_k_hbm.shape[1]
     kh, g, d = num_kv_heads, num_heads // num_kv_heads, head_dim
 
     seq_len = jnp.maximum(sl_ref[b], 1)
@@ -208,12 +247,12 @@ def _decode_local_kernel(
             phys = jnp.minimum(pt_ref[b, lp_safe], num_phys - 1)
             pltpu.make_async_copy(
                 kv_k_hbm.at[phys],
-                k_buf.at[slot, pl.ds(p * page_size, page_size)],
+                k_buf.at[slot, pl.ds(p * page_rows, page_rows)],
                 k_sem.at[slot, p],
             ).start()
             pltpu.make_async_copy(
                 kv_v_hbm.at[phys],
-                v_buf.at[slot, pl.ds(p * page_size, page_size)],
+                v_buf.at[slot, pl.ds(p * page_rows, page_rows)],
                 v_sem.at[slot, p],
             ).start()
 
@@ -223,12 +262,12 @@ def _decode_local_kernel(
             phys = jnp.minimum(pt_ref[b, lp_safe], num_phys - 1)
             pltpu.make_async_copy(
                 kv_k_hbm.at[phys],
-                k_buf.at[slot, pl.ds(p * page_size, page_size)],
+                k_buf.at[slot, pl.ds(p * page_rows, page_rows)],
                 k_sem.at[slot, p],
             ).wait()
             pltpu.make_async_copy(
                 kv_v_hbm.at[phys],
-                v_buf.at[slot, pl.ds(p * page_size, page_size)],
+                v_buf.at[slot, pl.ds(p * page_rows, page_rows)],
                 v_sem.at[slot, p],
             ).wait()
 
@@ -261,8 +300,16 @@ def _decode_local_kernel(
             start_chunk(ci + 1, jax.lax.rem(ci + 1, 2))
 
         wait_chunk(ci, slot)
-        k = k_buf[slot]
-        v = v_buf[slot]
+        if kv_bits:
+            k, v = _window_dequant(
+                b, ci, slot, k_buf, v_buf, pt_ref, ks_ref, vs_ref,
+                q_ref.dtype, chunk_pages=chunk_pages, page_rows=page_rows,
+                max_pages=max_pages, num_phys=num_phys,
+                num_kv_heads=kh, head_dim=d, kv_bits=kv_bits,
+            )
+        else:
+            k = k_buf[slot]
+            v = v_buf[slot]
         pos = ci * chunk + jax.lax.broadcasted_iota(jnp.int32, (1, chunk), 1)
         s = jax.lax.dot_general(
             q_bd.astype(k.dtype), k, (((1,), (1,)), ((), ())),
@@ -305,9 +352,17 @@ def paged_attention_decode_pallas_local(
     *,
     interpret: bool = False,
 ) -> jax.Array:
-    """Fused pool+local decode attention; returns [B, H, D] (q.dtype)."""
+    """Fused pool+local decode attention; returns [B, H, D] (q.dtype).
+    The pool may be a per-layer QuantKV (ops/kv_quant.py): packed pages
+    dequantize inside the VMEM window off scalar-prefetched scales; the
+    block-local buffer is always full precision."""
+    from .kv_quant import kernel_operands
+
     B, H, D = q.shape
-    num_pages, page_size, KH, _ = kv_k_layer.shape
+    kv_k_raw, kv_v_raw, rows, page_size, kv_bits, scale_prefetch = (
+        kernel_operands(kv_k_layer, kv_v_layer)
+    )
+    num_pages, _, KH, _ = kv_k_raw.shape
     max_pages = page_tables.shape[1]
     K_loc = loc_k.shape[1]
     target = 512 if KH * D * page_size <= 131072 else 256
@@ -320,13 +375,19 @@ def paged_attention_decode_pallas_local(
     eye = jnp.eye(KH, dtype=q.dtype)
     q_bd = jnp.einsum("bkgd,kj->bkgjd", q_r, eye).reshape(B, KHG, KH * D)
 
-    kv_k_flat = kv_k_layer.reshape(num_pages, page_size, KH * D)
-    kv_v_flat = kv_v_layer.reshape(num_pages, page_size, KH * D)
+    kv_k_flat = kv_k_raw.reshape(num_pages, rows, KH * D)
+    kv_v_flat = kv_v_raw.reshape(num_pages, rows, KH * D)
     loc_k_flat = loc_k.reshape(B, K_loc, KH * D)
     loc_v_flat = loc_v.reshape(B, K_loc, KH * D)
+    prefetch = [
+        page_tables.astype(jnp.int32),
+        pool_lens.astype(jnp.int32),
+        jnp.reshape(step_idx, (1,)).astype(jnp.int32),
+        *scale_prefetch,
+    ]
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
+        num_scalar_prefetch=len(prefetch),
         grid=(B,),
         in_specs=[
             pl.BlockSpec((1, KHG, KH * D), lambda b, *_: (b, 0, 0)),
@@ -337,8 +398,8 @@ def paged_attention_decode_pallas_local(
         ],
         out_specs=pl.BlockSpec((1, H, D), lambda b, *_: (b, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((2, chunk_pages * page_size, KH * D), kv_k_layer.dtype),
-            pltpu.VMEM((2, chunk_pages * page_size, KH * D), kv_v_layer.dtype),
+            pltpu.VMEM((2, chunk_pages * rows, KH * D), kv_k_flat.dtype),
+            pltpu.VMEM((2, chunk_pages * rows, KH * D), kv_v_flat.dtype),
             pltpu.SemaphoreType.DMA((2, chunk_pages)),
             pltpu.SemaphoreType.DMA((2, chunk_pages)),
         ],
@@ -351,6 +412,7 @@ def paged_attention_decode_pallas_local(
         num_heads=H,
         num_kv_heads=KH,
         head_dim=D,
+        kv_bits=kv_bits,
     )
     cost = pl.CostEstimate(
         flops=4 * B * H * D * (max_pages * page_size + K_loc),
@@ -364,9 +426,7 @@ def paged_attention_decode_pallas_local(
         cost_estimate=cost,
         interpret=interpret,
     )(
-        page_tables.astype(jnp.int32),
-        pool_lens.astype(jnp.int32),
-        jnp.reshape(step_idx, (1,)).astype(jnp.int32),
+        *prefetch,
         q_bd,
         loc_k_flat,
         loc_v_flat,
@@ -387,9 +447,15 @@ def paged_attention_decode_pallas(
 ) -> jax.Array:
     """Flash decode attention over paged KV; returns [B, H, D] (q.dtype).
     (Block-local merging lives in _decode_local_kernel — the fused variant —
-    so this hot path writes exactly one output.)"""
+    so this hot path writes exactly one output.) The pool may be a
+    per-layer QuantKV: packed pages dequantize in the VMEM window."""
+    from .kv_quant import kernel_operands
+
     B, H, D = q.shape
-    num_pages, page_size, KH, _ = kv_k_layer.shape
+    kv_k_raw, kv_v_raw, rows, page_size, kv_bits, scale_prefetch = (
+        kernel_operands(kv_k_layer, kv_v_layer)
+    )
+    num_pages, _, KH, _ = kv_k_raw.shape
     max_pages = page_tables.shape[1]
     # chunk target: big enough to amortize per-iteration overhead, small
     # enough that 2 double-buffered K+V chunks fit comfortably in VMEM
@@ -404,13 +470,18 @@ def paged_attention_decode_pallas(
     eye = jnp.eye(KH, dtype=q.dtype)
     q_bd = jnp.einsum("bkgd,kj->bkgjd", q_r, eye).reshape(B, KHG, KH * D)
 
-    # flatten [pages, page_size, KH, D] -> [pages, page_size, KH*D] in XLA
+    # flatten [pages, rows, KH, D] -> [pages, rows, KH*D] in XLA
     # (contiguous bitcast) — Mosaic cannot merge minor dims in-register
-    kv_k_flat = kv_k_layer.reshape(num_pages, page_size, KH * D)
-    kv_v_flat = kv_v_layer.reshape(num_pages, page_size, KH * D)
+    kv_k_flat = kv_k_raw.reshape(num_pages, rows, KH * D)
+    kv_v_flat = kv_v_raw.reshape(num_pages, rows, KH * D)
+    prefetch = [
+        page_tables.astype(jnp.int32),
+        seq_lens.astype(jnp.int32),
+        *scale_prefetch,
+    ]
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=len(prefetch),
         grid=(B,),
         in_specs=[
             pl.BlockSpec((1, KHG, KH * D), lambda b, *_: (b, 0, 0)),
@@ -419,8 +490,8 @@ def paged_attention_decode_pallas(
         ],
         out_specs=pl.BlockSpec((1, H, D), lambda b, *_: (b, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((2, chunk_pages * page_size, KH * D), kv_k_layer.dtype),
-            pltpu.VMEM((2, chunk_pages * page_size, KH * D), kv_v_layer.dtype),
+            pltpu.VMEM((2, chunk_pages * rows, KH * D), kv_k_flat.dtype),
+            pltpu.VMEM((2, chunk_pages * rows, KH * D), kv_v_flat.dtype),
             pltpu.SemaphoreType.DMA((2, chunk_pages)),
             pltpu.SemaphoreType.DMA((2, chunk_pages)),
         ],
@@ -433,6 +504,7 @@ def paged_attention_decode_pallas(
         num_heads=H,
         num_kv_heads=KH,
         head_dim=D,
+        kv_bits=kv_bits,
     )
     cost = pl.CostEstimate(
         flops=4 * B * H * D * max_pages * page_size,
@@ -445,4 +517,4 @@ def paged_attention_decode_pallas(
         out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
         cost_estimate=cost,
         interpret=interpret,
-    )(page_tables.astype(jnp.int32), seq_lens.astype(jnp.int32), q_bd, kv_k_flat, kv_v_flat)
+    )(*prefetch, q_bd, kv_k_flat, kv_v_flat)

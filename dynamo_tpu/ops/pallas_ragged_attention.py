@@ -63,36 +63,42 @@ def ragged_tile_q(dtype) -> int:
 
 
 def _ragged_kernel(
-    # scalar prefetch
-    tr_ref,  # [num_tiles] int32 (SMEM) — owning row per q tile
-    rs_ref,  # [R] int32 (SMEM) — row start (flat token index)
-    rl_ref,  # [R] int32 (SMEM) — real row length
-    ctx_ref,  # [R] int32 (SMEM) — history length
-    pt_ref,  # [R, max_pages] int32 (SMEM)
-    # inputs
-    q_ref,  # [1, 1, TQ, G*D] VMEM block (one tile, one kv-head's group)
-    kv_k_hbm,  # [num_pages, page_size, KH*D] (ANY/HBM; flattened by wrapper)
-    kv_v_hbm,
-    # outputs
-    out_ref,  # [1, 1, TQ, G*D] VMEM block
-    # scratch
-    k_buf,  # [2, C, D] VMEM — this head's column slice of the chunk pages
-    v_buf,
-    k_sem,  # DMA sems [2, chunk_pages]
-    v_sem,
-    *,
+    # positional refs — scalar prefetch first: tile_rows [num_tiles],
+    # row_starts [R], row_lens [R], ctx_lens [R], page_tables
+    # [R, max_pages] (all int32 SMEM) and, under kv_bits > 0, the
+    # per-page-per-head K and V scales [num_pages, KH] f32 riding the
+    # SAME scalar-prefetch channel beside the page tables; then
+    # q [1, 1, TQ, G*D] VMEM, kv_k/kv_v [num_pages, rows, KH*D] ANY/HBM
+    # (rows = page_size, or page_size//2 int4-packed along the sublane
+    # axis), the output block, and the double-buffered VMEM window +
+    # DMA semaphores.
+    *refs,
     page_size: int,
     chunk_pages: int,
     max_pages: int,
     group: int,
     head_dim: int,
     tile_q: int,
+    kv_bits: int = 0,
 ):
+    if kv_bits:
+        (tr_ref, rs_ref, rl_ref, ctx_ref, pt_ref, ks_ref, vs_ref,
+         q_ref, kv_k_hbm, kv_v_hbm, out_ref, k_buf, v_buf, k_sem,
+         v_sem) = refs
+    else:
+        (tr_ref, rs_ref, rl_ref, ctx_ref, pt_ref,
+         q_ref, kv_k_hbm, kv_v_hbm, out_ref, k_buf, v_buf, k_sem,
+         v_sem) = refs
+        ks_ref = vs_ref = None
     t = pl.program_id(0)
     k0 = pl.program_id(1)
     g, d, tq = group, head_dim, tile_q
     chunk = chunk_pages * page_size
     num_phys = kv_k_hbm.shape[0]
+    # rows each page occupies in HBM/VMEM (int4 packs 2 tokens per byte
+    # along this axis; positions unpack back in order, so the causal
+    # key_pos math below is untouched)
+    page_rows = kv_k_hbm.shape[1]
 
     r = tr_ref[t]
     ctx = ctx_ref[r]
@@ -109,12 +115,12 @@ def _ragged_kernel(
             phys = jnp.minimum(pt_ref[r, lp], num_phys - 1)
             pltpu.make_async_copy(
                 kv_k_hbm.at[phys, :, pl.ds(k0 * d, d)],
-                k_buf.at[slot, pl.ds(p * page_size, page_size)],
+                k_buf.at[slot, pl.ds(p * page_rows, page_rows)],
                 k_sem.at[slot, p],
             ).start()
             pltpu.make_async_copy(
                 kv_v_hbm.at[phys, :, pl.ds(k0 * d, d)],
-                v_buf.at[slot, pl.ds(p * page_size, page_size)],
+                v_buf.at[slot, pl.ds(p * page_rows, page_rows)],
                 v_sem.at[slot, p],
             ).start()
 
@@ -124,14 +130,39 @@ def _ragged_kernel(
             phys = jnp.minimum(pt_ref[r, lp], num_phys - 1)
             pltpu.make_async_copy(
                 kv_k_hbm.at[phys, :, pl.ds(k0 * d, d)],
-                k_buf.at[slot, pl.ds(p * page_size, page_size)],
+                k_buf.at[slot, pl.ds(p * page_rows, page_rows)],
                 k_sem.at[slot, p],
             ).wait()
             pltpu.make_async_copy(
                 kv_v_hbm.at[phys, :, pl.ds(k0 * d, d)],
-                v_buf.at[slot, pl.ds(p * page_size, page_size)],
+                v_buf.at[slot, pl.ds(p * page_rows, page_rows)],
                 v_sem.at[slot, p],
             ).wait()
+
+    def dequant_window(ci, slot, compute_dtype):
+        """Quantized window -> [chunk, D] full-precision K and V: per page,
+        unpack (int4) and multiply by that page's per-head scale read from
+        the scalar-prefetched scales — the in-kernel dequant the DMA
+        overlap pays for (RTP-LLM shape, PAPERS.md)."""
+        from ..models.quant import unpack_int4
+
+        k_segs, v_segs = [], []
+        for p in range(chunk_pages):
+            lp = jnp.minimum(ci * chunk_pages + p, max_pages - 1)
+            phys = jnp.minimum(pt_ref[r, lp], num_phys - 1)
+            kseg = k_buf[slot, pl.ds(p * page_rows, page_rows)]  # int8 [rows, D]
+            vseg = v_buf[slot, pl.ds(p * page_rows, page_rows)]
+            if kv_bits == 4:
+                kseg = unpack_int4(kseg, axis=0)  # [page_size, D]
+                vseg = unpack_int4(vseg, axis=0)
+            ks = ks_ref[phys, k0]
+            vs = vs_ref[phys, k0]
+            k_segs.append((kseg.astype(jnp.float32) * ks).astype(compute_dtype))
+            v_segs.append((vseg.astype(jnp.float32) * vs).astype(compute_dtype))
+        return (
+            jnp.concatenate(k_segs, axis=0),
+            jnp.concatenate(v_segs, axis=0),
+        )
 
     start_chunk(0, 0)
 
@@ -153,8 +184,11 @@ def _ragged_kernel(
             start_chunk(ci + 1, jax.lax.rem(ci + 1, 2))
 
         wait_chunk(ci, slot)
-        k = k_buf[slot]  # [C, D]
-        v = v_buf[slot]
+        if kv_bits:
+            k, v = dequant_window(ci, slot, q_ref.dtype)  # [C, D]
+        else:
+            k = k_buf[slot]  # [C, D]
+            v = v_buf[slot]
 
         key_pos = ci * chunk + jax.lax.broadcasted_iota(jnp.int32, (1, chunk), 1)
         valid = q_real & (key_pos <= q_pos) & (key_pos < total_len)  # [TQ, C]
@@ -204,9 +238,18 @@ def ragged_paged_attention_pallas(
 ) -> jax.Array:
     """Ragged flash attention over paged KV; returns [N, H, D] (q.dtype).
     Rows outside every [row_start, row_start+row_len) span return finite
-    garbage — the caller only reads real rows."""
+    garbage — the caller only reads real rows. `kv_k_layer`/`kv_v_layer`
+    may be per-layer QuantKV stores (ops/kv_quant.py): the int8/int4 pages
+    DMA at their packed width and dequantize inside the VMEM window, with
+    the per-page-per-head scales scalar-prefetched beside the page
+    tables."""
+    from .kv_quant import kernel_operands
+
     N, H, D = q.shape
-    num_pages, page_size, KH, _ = kv_k_layer.shape
+    kv_k_raw, kv_v_raw, rows, page_size, kv_bits, scale_prefetch = (
+        kernel_operands(kv_k_layer, kv_v_layer)
+    )
+    num_pages, _, KH, _ = kv_k_raw.shape
     G = H // KH
     max_pages = page_tables.shape[1]
     tile_q = ragged_tile_q(q.dtype)
@@ -242,12 +285,23 @@ def ragged_paged_attention_pallas(
         .reshape(num_tiles, KH, tile_q, G * D)
     )
     # flatten pages' minor dims in XLA (contiguous bitcast) — Mosaic cannot
-    # merge minor dims in-register
-    kv_k_flat = kv_k_layer.reshape(num_pages, page_size, KH * D)
-    kv_v_flat = kv_v_layer.reshape(num_pages, page_size, KH * D)
+    # merge minor dims in-register. Quantized stores DMA their PACKED q
+    # bytes (int4: half the sublane rows); the f32 scales join the scalar
+    # prefetch operands right after the page tables (kernel_operands is
+    # the one spelling of this contract across all three kernels).
+    kv_k_flat = kv_k_raw.reshape(num_pages, rows, KH * D)
+    kv_v_flat = kv_v_raw.reshape(num_pages, rows, KH * D)
+    prefetch = [
+        tile_rows,
+        row_starts.astype(jnp.int32),
+        row_lens.astype(jnp.int32),
+        ctx_lens.astype(jnp.int32),
+        page_tables.astype(jnp.int32),
+        *scale_prefetch,
+    ]
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=5,
+        num_scalar_prefetch=len(prefetch),
         grid=(num_tiles, KH),
         in_specs=[
             pl.BlockSpec((1, 1, tile_q, G * D), lambda t, k0, *_: (t, k0, 0, 0)),
@@ -258,8 +312,8 @@ def ragged_paged_attention_pallas(
             (1, 1, tile_q, G * D), lambda t, k0, *_: (t, k0, 0, 0)
         ),
         scratch_shapes=[
-            pltpu.VMEM((2, chunk_pages * page_size, D), kv_k_layer.dtype),
-            pltpu.VMEM((2, chunk_pages * page_size, D), kv_v_layer.dtype),
+            pltpu.VMEM((2, chunk_pages * rows, D), kv_k_flat.dtype),
+            pltpu.VMEM((2, chunk_pages * rows, D), kv_v_flat.dtype),
             pltpu.SemaphoreType.DMA((2, chunk_pages)),
             pltpu.SemaphoreType.DMA((2, chunk_pages)),
         ],
@@ -272,6 +326,7 @@ def ragged_paged_attention_pallas(
         group=G,
         head_dim=D,
         tile_q=tile_q,
+        kv_bits=kv_bits,
     )
     cost = pl.CostEstimate(
         flops=4 * N * H * D * max_pages * page_size // 2,
@@ -285,11 +340,7 @@ def ragged_paged_attention_pallas(
         cost_estimate=cost,
         interpret=interpret,
     )(
-        tile_rows,
-        row_starts.astype(jnp.int32),
-        row_lens.astype(jnp.int32),
-        ctx_lens.astype(jnp.int32),
-        page_tables.astype(jnp.int32),
+        *prefetch,
         q_g,
         kv_k_flat,
         kv_v_flat,

@@ -360,6 +360,20 @@ ENV_REGISTRY: tuple = (
            "(docs/ragged_attention.md). EngineConfig.mixed_dispatch "
            "overrides.",
            "engine/engine.py"),
+    EnvVar("DYN_KV_QUANT", "enum", "none",
+           "Quantized KV cache page format: `none` (fp, the seed's exact "
+           "byte-identical path), `int8`, or `int4` (two tokens per byte "
+           "along the page axis). Pages quantize ON WRITE with "
+           "per-page-per-head f32 scales and dequantize inside the "
+           "attention kernels' VMEM window (scales ride scalar prefetch "
+           "beside the page tables); the auto-sized HBM pool, the KVBM "
+           "G2/G3 tiers and every peer-pull/disagg payload shrink "
+           "~2x/4x, roughly doubling resident sessions at fixed HBM. "
+           "Every worker of a fleet must run the SAME format — "
+           "mismatches fail typed (KvFormatError), counted in "
+           "kv_format_mismatches. EngineConfig.kv_quant overrides. "
+           "Requires tp/pp/sp == 1.",
+           "ops/kv_quant.py"),
     # -- KVBM tier pipeline (kvbm/, docs/kvbm.md) ----------------------- #
     EnvVar("DYN_KVBM_PIPELINE", "bool", "1",
            "Batched KVBM offload pipeline: coalesce a step's block "

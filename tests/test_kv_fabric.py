@@ -350,6 +350,123 @@ class TestPeerOnboard:
 
         asyncio.run(main())
 
+    def test_quantized_peer_pull_roundtrip(self):
+        """Cluster fabric under DYN_KV_QUANT: both workers run int8 —
+        worker B onboards A's PACKED blocks over the data plane (scales
+        travel inside the rows) and reproduces A's quantized greedy
+        stream exactly. The pulled bytes are ~2x smaller than the fp
+        fabric moves for the same prefix."""
+        build = _mesh_pair()
+
+        async def main():
+            server, drts, engines, dists, planes = await build()
+            # swap in quantized engines on the same mesh plumbing
+            for eng in engines:
+                await eng.close()
+            engines[0] = make_engine(kvbm_host_blocks=32, kv_quant="int8")
+            engines[1] = make_engine(kvbm_host_blocks=32, kv_quant="int8")
+            for eng, dist, dpl in zip(engines, dists, planes):
+                dist.connector = eng.kvbm
+                dist.manager = eng.kvbm.manager
+                dpl.kvbm_source = eng.kvbm.manager
+                eng.kvbm.distributed = dist
+            eng_a, eng_b = engines
+            dist_b = dists[1]
+            try:
+                want = await run_plain(eng_a, request_id="a1")
+                for _ in range(200):
+                    await asyncio.sleep(0.02)
+                    if len(dist_b._owners) >= 7 and dist_b._addrs:
+                        break
+                assert len(dist_b._owners) >= 7, "announcements never mirrored"
+                toks = await run_plain(eng_b, request_id="b1")
+                assert toks == want, (toks, want)
+                assert dist_b.remote_blocks_pulled >= 7, dist_b.stats()
+                # packed int8 blocks: bytes/block ≈ half the fp block
+                from dynamo_tpu.ops.kv_quant import kv_page_bytes
+
+                fp_block = 2 * CFG.num_layers * kv_page_bytes(
+                    PAGE, CFG.num_kv_heads, CFG.head_dim, CFG.dtype, "none"
+                )
+                per_block = (
+                    dist_b.remote_bytes_pulled / dist_b.remote_blocks_pulled
+                )
+                assert per_block < 0.6 * fp_block, (per_block, fp_block)
+                assert eng_b.kv_format_mismatches == 0
+            finally:
+                await _teardown_mesh(server, drts, engines, dists, planes)
+
+        asyncio.run(main())
+
+    def test_mixed_precision_peer_fails_typed_then_recomputes(self):
+        """A fp worker probing a quantized peer's blocks must fail TYPED
+        (KvFormatError via the kvbm pull handshake) — counted in
+        kv_format_mismatches — and recompute to a byte-identical stream,
+        never misread packed rows as fp pages."""
+        build = _mesh_pair()
+        want = oracle_tokens()
+
+        async def main():
+            server, drts, engines, dists, planes = await build()
+            # worker A serves int8 blocks; worker B stays fp
+            await engines[0].close()
+            engines[0] = make_engine(kvbm_host_blocks=32, kv_quant="int8")
+            dists[0].connector = engines[0].kvbm
+            dists[0].manager = engines[0].kvbm.manager
+            planes[0].kvbm_source = engines[0].kvbm.manager
+            engines[0].kvbm.distributed = dists[0]
+            eng_a, eng_b = engines
+            dist_b = dists[1]
+            try:
+                await run_plain(eng_a, request_id="a1")
+                for _ in range(200):
+                    await asyncio.sleep(0.02)
+                    if len(dist_b._owners) >= 7 and dist_b._addrs:
+                        break
+                assert len(dist_b._owners) >= 7, "announcements never mirrored"
+                toks = await run_plain(eng_b, request_id="b1")
+                assert toks == want, (toks, want)
+                assert eng_b.kv_format_mismatches >= 1, eng_b.stats()
+                assert dist_b.remote_blocks_pulled == 0, dist_b.stats()
+            finally:
+                await _teardown_mesh(server, drts, engines, dists, planes)
+
+        asyncio.run(main())
+
+    def test_pull_kvbm_blocks_format_mismatch_is_typed(self):
+        """Unit-level handshake contract: pull_kvbm_blocks against a tier
+        of a different kv_format raises KvFormatError (not KeyError, not
+        a silent byte reinterpretation)."""
+        from dynamo_tpu.kvbm import KvBlockManager, KvbmConfig
+        from dynamo_tpu.llm.kv_transfer import (
+            KvFormatError, pull_kvbm_blocks,
+        )
+
+        async def main():
+            mgr = KvBlockManager(
+                KvbmConfig(host_blocks=4), (2, 264), np.uint8,
+                kv_format="int8",
+            )
+            blk = np.arange(2 * 264, dtype=np.uint8).reshape(2, 264)
+            mgr.store(7, blk, blk)
+            dpl = KvDataPlaneServer()
+            await dpl.start()
+            dpl.kvbm_source = mgr
+            try:
+                with pytest.raises(KvFormatError):
+                    await pull_kvbm_blocks(
+                        dpl.addr, [7], (2, 264), np.uint8, kv_format="none"
+                    )
+                # matching format still roundtrips byte-exact
+                k, v = await pull_kvbm_blocks(
+                    dpl.addr, [7], (2, 264), np.uint8, kv_format="int8"
+                )
+                np.testing.assert_array_equal(k[0], blk)
+            finally:
+                await dpl.close()
+
+        asyncio.run(main())
+
     def test_peer_off_parity(self):
         """DYN_KVBM_PEER_PULL=0: the fabric is inert (no pulls), bytes
         identical — the peer-on/peer-off parity arm."""

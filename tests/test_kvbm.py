@@ -105,6 +105,89 @@ def test_manager_cascade_host_to_disk(tmp_path):
     assert mgr.onboarded_blocks == 2
 
 
+# --------------------------------------------------------------------- #
+# Quantized KV blocks (DYN_KV_QUANT, docs/kvbm.md "Quantized KV format"):
+# tiers store PACKED uint8 rows (q bytes + per-page-per-head scales)
+# natively, so G2/G3 roundtrips must be byte-exact — dequantization
+# happens exactly once, on the device, never on a tier hop.
+# --------------------------------------------------------------------- #
+
+
+def _quant_block(seed, mode="int8"):
+    """One packed quantized block's (k, v) rows [L, PAGE_BYTES] uint8,
+    produced by the SAME host layout the engine's offload gather uses."""
+    from dynamo_tpu.ops.kv_quant import (
+        alloc_kv_store, host_pack_pages, kv_write,
+    )
+
+    L, ps, KH, D = BLOCK_SHAPE
+    r = np.random.RandomState(seed)
+    st_k = alloc_kv_store(L, 2, ps, KH, D, jnp.float32, mode)
+    st_v = alloc_kv_store(L, 2, ps, KH, D, jnp.float32, mode)
+    phys = jnp.asarray(np.full(ps, 1, np.int32))
+    offs = jnp.asarray(np.arange(ps, dtype=np.int32))
+    for li in range(L):
+        st_k = kv_write(st_k, li, phys, offs,
+                        jnp.asarray(r.randn(ps, KH, D).astype(np.float32)))
+        st_v = kv_write(st_v, li, phys, offs,
+                        jnp.asarray(r.randn(ps, KH, D).astype(np.float32)))
+    ids = jnp.asarray([1])
+    ex_k = jax.tree.map(lambda a: a[:, ids], st_k)
+    ex_v = jax.tree.map(lambda a: a[:, ids], st_v)
+    return host_pack_pages(ex_k)[:, 0], host_pack_pages(ex_v)[:, 0]
+
+
+@pytest.mark.parametrize("mode", ["int8", "int4"])
+def test_quantized_blocks_roundtrip_g2_g3_byte_exact(mode, tmp_path):
+    """store -> host-tier eviction -> disk cascade -> load: every packed
+    byte (ints AND scales) must survive unchanged."""
+    from dynamo_tpu.ops.kv_quant import kv_page_bytes
+
+    L, ps, KH, D = BLOCK_SHAPE
+    pb = kv_page_bytes(ps, KH, D, jnp.float32, mode)
+    shape = (L, pb)
+    mgr = KvBlockManager(
+        KvbmConfig(host_blocks=2, disk_blocks=4,
+                   disk_path=str(tmp_path / "g3")),
+        shape, np.uint8, kv_format=mode,
+    )
+    assert mgr.kv_format == mode
+    blocks = {h: _quant_block(h, mode) for h in (1, 2, 3, 4)}
+    for h, (k, v) in blocks.items():
+        assert k.shape == shape and k.dtype == np.uint8
+        mgr.store(h, k, v)
+    # 1 and 2 cascaded to disk; all four must load back byte-exact
+    assert len(mgr.disk) == 2
+    k_np, v_np = mgr.load_blocks([1, 2, 3, 4])
+    for i, h in enumerate([1, 2, 3, 4]):
+        np.testing.assert_array_equal(k_np[i], blocks[h][0])
+        np.testing.assert_array_equal(v_np[i], blocks[h][1])
+    # and the packed rows decode to the same ints/scales they encoded
+    from dynamo_tpu.ops.kv_quant import host_unpack_pages
+
+    q1, s1 = host_unpack_pages(k_np[0], mode, ps, KH, D)
+    q2, s2 = host_unpack_pages(blocks[1][0], mode, ps, KH, D)
+    np.testing.assert_array_equal(q1, q2)
+    np.testing.assert_array_equal(s1, s2)
+
+
+@pytest.mark.parametrize("mode", ["int8", "int4"])
+def test_quantized_disk_warm_restart_byte_exact(mode, tmp_path):
+    from dynamo_tpu.ops.kv_quant import kv_page_bytes
+
+    L, ps, KH, D = BLOCK_SHAPE
+    shape = (L, kv_page_bytes(ps, KH, D, jnp.float32, mode))
+    path = str(tmp_path / "g3")
+    tier = DiskTier(4, shape, np.uint8, path)
+    k1, v1 = _quant_block(31, mode)
+    tier.put(111, k1, v1)
+    tier.flush()
+    reopened = DiskTier(4, shape, np.uint8, path)
+    got = reopened.get(111)
+    np.testing.assert_array_equal(got[0], k1)
+    np.testing.assert_array_equal(got[1], v1)
+
+
 @pytest.fixture(scope="module")
 def params():
     return llama.init_params(CFG, jax.random.PRNGKey(0))
@@ -187,6 +270,58 @@ def test_engine_onboard_from_disk(params, tmp_path):
         await eng.close()
 
     asyncio.run(main())
+
+
+@pytest.mark.parametrize("mode", ["int8", "int4"])
+def test_engine_quantized_offload_onboard_roundtrip(params, mode):
+    """The e2e density path: a quantized engine offloads packed blocks,
+    competing traffic evicts G1, and the re-issued prompt onboards the
+    SAME packed bytes — greedy tokens must match the original quantized
+    run exactly (the onboard injects identical ints+scales)."""
+
+    async def main():
+        cfg = EngineConfig(
+            model="tiny", max_num_seqs=2, page_size=PAGE, num_pages=8,
+            max_model_len=128, prefill_buckets=(16, 32),
+            max_prefill_chunk=32, kvbm_host_blocks=32, kv_quant=mode,
+        )
+        eng = JaxEngine(cfg, model_config=CFG, params=params)
+        assert eng.kvbm.manager.kv_format == mode
+        assert eng.kvbm.manager.dtype == np.dtype(np.uint8)
+        base = list(range(10, 10 + 3 * PAGE))
+        first = await _gen(eng, base, 4, "a")
+        await _drain_offloads(eng)
+        assert eng.kvbm.manager.offloaded_blocks >= 3
+        for i in range(4):
+            await _gen(eng, list(range(300 + 40 * i, 300 + 40 * i + 3 * PAGE)),
+                       2, f"f{i}")
+        await _drain_offloads(eng)
+        onboarded_before = eng.kvbm.manager.onboarded_blocks
+        again = await _gen(eng, base, 4, "b")
+        assert again == first
+        assert eng.kvbm.manager.onboarded_blocks > onboarded_before
+        await eng.close()
+
+    asyncio.run(main())
+
+
+def test_kv_quant_none_arm_is_byte_identical(params):
+    """Quant off == exact seed behavior: kv_quant="none" (and the
+    DYN_KV_QUANT-unset default) must produce byte-identical token streams
+    — the fp path compiles the very same scatter/gather programs."""
+
+    async def run(kv_quant):
+        cfg = EngineConfig(
+            model="tiny", max_num_seqs=2, page_size=PAGE, num_pages=16,
+            max_model_len=128, prefill_buckets=(16, 32),
+            max_prefill_chunk=32, kv_quant=kv_quant,
+        )
+        eng = JaxEngine(cfg, model_config=CFG, params=params)
+        toks = await _gen(eng, list(range(10, 10 + 2 * PAGE + 3)), 6, "n")
+        await eng.close()
+        return toks
+
+    assert asyncio.run(run("none")) == asyncio.run(run(None))
 
 
 def test_kvbm_disabled_by_default(params):

@@ -198,6 +198,201 @@ def test_reference_decode_row_equals_decode_op():
     )
 
 
+# --------------------------------------------------------------------- #
+# quantized KV (DYN_KV_QUANT, ops/kv_quant.py): the kernel must agree
+# with the quantized XLA reference EXACTLY (same ints, same scales,
+# rtol 2e-3 like the fp arms) and with the FP oracle within quantization
+# tolerance — the acceptance contract (docs/ragged_attention.md
+# "Quantized pages": int8 degrades outputs by ~a half step of the
+# per-page-per-head scale; int4 by ~1/14 of the page amax).
+# --------------------------------------------------------------------- #
+
+# absolute tolerance vs the FP oracle, in units of the per-page amax
+# (values here are N(0,1): page amax ~3-4). K-error shifts softmax
+# weights on top of direct V-error, hence the factor over a half step.
+_QUANT_FP_ATOL = {"int8": 0.08, "int4": 0.8}
+
+
+def _quantize_case(kv, page_size, mode):
+    """FP per-layer case KV [pages, ps, KH, D] -> per-layer QuantKV via
+    the production write path (kv_write, one call covering every page)."""
+    from dynamo_tpu.ops.kv_quant import alloc_kv_store, kv_layer, kv_write
+
+    pages, ps, KH, D = kv.shape
+    st = alloc_kv_store(1, pages, ps, KH, D, kv.dtype, mode)
+    phys = jnp.asarray(np.repeat(np.arange(pages, dtype=np.int32), ps))
+    offs = jnp.asarray(np.tile(np.arange(ps, dtype=np.int32), pages))
+    st = kv_write(st, 0, phys, offs, kv.reshape(pages * ps, KH, D))
+    return kv_layer(st, 0)
+
+
+@pytest.mark.parametrize("mode", ["int8", "int4"])
+@pytest.mark.parametrize(
+    "rows,name",
+    [
+        (MIX, "mixed"),
+        ([(1, 5), (1, 17), (1, 64), (1, 1)], "all_decode"),
+        ([(1, 7), (1, 8), (1, 9), (5, 15), (11, 16), (3, 17)], "page_straddle"),
+    ],
+)
+def test_ragged_kernel_quantized_matches_oracles(mode, rows, name):
+    (q, kv_k, kv_v, pt, rs, rl, cl, starts, lens, _N) = _mk_ragged_case(
+        rows, seed=len(rows)
+    )
+    qk = _quantize_case(kv_k, kv_k.shape[1], mode)
+    qv = _quantize_case(kv_v, kv_v.shape[1], mode)
+    fp_oracle = ref_ops.ragged_attention_reference(q, kv_k, kv_v, pt, rs, rl, cl)
+    want = ref_ops.ragged_attention_reference(q, qk, qv, pt, rs, rl, cl)
+    got = ragged_paged_attention_pallas(
+        q, qk, qv, pt, rs, rl, cl, interpret=True
+    )
+    # kernel == quantized reference (same ints dequantized the same way)
+    _assert_real_rows_close(got, want, starts, lens, rtol=2e-3, atol=2e-3)
+    # kernel == FP oracle within quantization tolerance
+    _assert_real_rows_close(
+        got, fp_oracle, starts, lens, rtol=0.0, atol=_QUANT_FP_ATOL[mode]
+    )
+
+
+@pytest.mark.parametrize("mode", ["int8", "int4"])
+@pytest.mark.parametrize("gqa", [(8, 2), (4, 1)])
+def test_ragged_kernel_quantized_gqa(mode, gqa):
+    H, KH = gqa
+    (q, kv_k, kv_v, pt, rs, rl, cl, starts, lens, _N) = _mk_ragged_case(
+        MIX, H=H, KH=KH, seed=H * 7 + KH
+    )
+    qk = _quantize_case(kv_k, kv_k.shape[1], mode)
+    qv = _quantize_case(kv_v, kv_v.shape[1], mode)
+    want = ref_ops.ragged_attention_reference(q, qk, qv, pt, rs, rl, cl)
+    got = ragged_paged_attention_pallas(
+        q, qk, qv, pt, rs, rl, cl, interpret=True
+    )
+    _assert_real_rows_close(got, want, starts, lens, rtol=2e-3, atol=2e-3)
+
+
+@pytest.mark.parametrize("mode", ["int8", "int4"])
+@pytest.mark.parametrize("seed", range(4))
+def test_ragged_quantized_fuzz_parity(mode, seed):
+    """Random mixed/decode traffic over quantized pages: kernel vs the
+    quantized reference (exact) and vs the FP oracle (quant tolerance)."""
+    rng = np.random.RandomState(700 + seed)
+    page_size = int(rng.choice([8, 16]))
+    rows = []
+    for _ in range(rng.randint(2, 6)):
+        if rng.rand() < 0.5:
+            rows.append((1, int(rng.randint(1, 70))))
+        else:
+            rows.append((int(rng.randint(2, 40)), int(rng.randint(0, 40))))
+    KH = int(rng.choice([1, 2, 4]))
+    H = KH * int(rng.choice([1, 2, 4]))
+    (q, kv_k, kv_v, pt, rs, rl, cl, starts, lens, _N) = _mk_ragged_case(
+        rows, H=H, KH=KH, page_size=page_size, seed=seed, R_pad=len(rows) + 2
+    )
+    qk = _quantize_case(kv_k, page_size, mode)
+    qv = _quantize_case(kv_v, page_size, mode)
+    fp_oracle = ref_ops.ragged_attention_reference(q, kv_k, kv_v, pt, rs, rl, cl)
+    want = ref_ops.ragged_attention_reference(q, qk, qv, pt, rs, rl, cl)
+    got = ragged_paged_attention_pallas(
+        q, qk, qv, pt, rs, rl, cl, interpret=True
+    )
+    _assert_real_rows_close(got, want, starts, lens, rtol=2e-3, atol=2e-3)
+    _assert_real_rows_close(
+        got, fp_oracle, starts, lens, rtol=0.0, atol=_QUANT_FP_ATOL[mode]
+    )
+
+
+@pytest.mark.parametrize("mode", ["int8", "int4"])
+def test_decode_kernels_quantized_match_oracles(mode):
+    """The decode + fused pool-local kernels under quantized pools: exact
+    vs the quantized XLA reference, quant-tolerance vs the FP oracle."""
+    import os
+
+    from dynamo_tpu.ops.pallas_paged_attention import (
+        paged_attention_decode_pallas,
+        paged_attention_decode_pallas_local,
+    )
+
+    rng = np.random.RandomState(41)
+    pages, ps, KH, D, H, B = 12, 8, 2, 32, 4, 3
+    kv_k = jnp.asarray(rng.randn(pages, ps, KH, D), jnp.float32)
+    kv_v = jnp.asarray(rng.randn(pages, ps, KH, D), jnp.float32)
+    qk = _quantize_case(kv_k, ps, mode)
+    qv = _quantize_case(kv_v, ps, mode)
+    tables = jnp.asarray(
+        rng.choice(pages, size=(B, 4), replace=False).astype(np.int32)
+    )
+    seq_lens = jnp.asarray([13, 5, 20], jnp.int32)
+    q = jnp.asarray(rng.randn(B, H, D), jnp.float32)
+    os.environ["DYNAMO_TPU_PAGED_ATTN"] = "xla"
+    try:
+        ref_q = ref_ops.paged_attention_decode(q, qk, qv, tables, seq_lens)
+        ref_fp = ref_ops.paged_attention_decode(q, kv_k, kv_v, tables, seq_lens)
+    finally:
+        os.environ.pop("DYNAMO_TPU_PAGED_ATTN", None)
+    got = paged_attention_decode_pallas(
+        q, qk, qv, tables, seq_lens, interpret=True
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref_q),
+                               rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref_fp),
+                               rtol=0.0, atol=_QUANT_FP_ATOL[mode])
+    # fused pool+local: quantized pool, FULL-precision local buffer
+    K_loc = 4
+    loc_k = jnp.asarray(rng.randn(B, K_loc, KH, D), jnp.float32)
+    loc_v = jnp.asarray(rng.randn(B, K_loc, KH, D), jnp.float32)
+    pool_lens = jnp.maximum(seq_lens - 1, 0)
+    os.environ["DYNAMO_TPU_PAGED_ATTN"] = "xla"
+    try:
+        ref_l = ref_ops.paged_attention_decode_mixed(
+            q, qk, qv, tables, pool_lens, loc_k, loc_v, jnp.asarray(2)
+        )
+    finally:
+        os.environ.pop("DYNAMO_TPU_PAGED_ATTN", None)
+    got_l = paged_attention_decode_pallas_local(
+        q, qk, qv, tables, pool_lens, loc_k, loc_v, jnp.asarray(2),
+        interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(got_l), np.asarray(ref_l),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_quantized_page_write_tracks_scale_growth():
+    """Incremental decode-style writes that GROW a page's scale must keep
+    earlier tokens dequantizable (the requantize pass), and a write at
+    in-page offset 0 must reset a stale scale (page reuse)."""
+    from dynamo_tpu.ops.kv_quant import (
+        alloc_kv_store, gather_dequant, kv_layer, kv_write,
+    )
+
+    rng = np.random.RandomState(5)
+    ps, KH, D = 8, 2, 4
+    st = alloc_kv_store(1, 4, ps, KH, D, jnp.float32, "int8")
+    ref = np.zeros((ps, KH, D), np.float32)
+    # small tokens first, then a 10x outlier -> scale grows 10x
+    for t in range(4):
+        scale = 10.0 if t == 3 else 1.0
+        vals = (rng.randn(1, KH, D) * scale).astype(np.float32)
+        ref[t] = vals[0]
+        st = kv_write(st, 0, jnp.asarray([1]), jnp.asarray([t]),
+                      jnp.asarray(vals))
+    deq = np.asarray(gather_dequant(kv_layer(st, 0), jnp.asarray([1])))[0]
+    page_amax = np.abs(ref[:4]).max(axis=(0, 2))  # [KH]
+    # a couple of half-steps of the FINAL scale (requantize accumulation)
+    tol = page_amax / 127 * 2.6 + 1e-6
+    assert np.all(np.abs(deq[:4] - ref[:4]) <= tol[None, :, None])
+    # page reuse: rewrite from offset 0 with small values — the stale 10x
+    # scale must reset, keeping the new page tightly quantized
+    tiny = (rng.randn(ps, KH, D) * 0.01).astype(np.float32)
+    st = kv_write(st, 0, jnp.asarray(np.full(ps, 1, np.int32)),
+                  jnp.asarray(np.arange(ps, dtype=np.int32)),
+                  jnp.asarray(tiny))
+    deq = np.asarray(gather_dequant(kv_layer(st, 0), jnp.asarray([1])))[0]
+    tiny_amax = np.abs(tiny).max(axis=(0, 2))
+    assert np.all(
+        np.abs(deq - tiny) <= (tiny_amax / 127 * 0.51 + 1e-8)[None, :, None]
+    )
+
+
 def test_pallas_eligible_gate_is_shared():
     """The centralized gate: env knob + 128-lane alignment, one spelling
     for prefill/decode/ragged dispatch."""
