@@ -106,6 +106,14 @@ PHASES = [
     # where 4 frontends + worker + client actually get their own cores
     ("engine_fleet", [PY, "bench_serving_overhead.py", "--fleet",
                       "--streams", "32", "--osl", "96"], 1800),
+    # PR 15 remeasure: durable decode sessions on real hardware — the
+    # checkpoint-resume vs recompute-resume TTFT gap where the session
+    # prefix actually crosses a NIC into the peer's G2 and the survivor's
+    # onboard pays real transfer+inject instead of loopback memcpy (CPU
+    # medians: 12.6ms vs 29.3ms at 512-token sessions, ratio 0.43)
+    ("engine_migration", [PY, "bench_migration.py", "--decode", "448",
+                          "--rounds", "5", "--max-ratio", "0.5",
+                          "--smoke"], 1800),
 ]
 
 

@@ -119,6 +119,13 @@ class EngineConfig:
     kvbm_host_blocks: int = 0
     kvbm_disk_blocks: int = 0
     kvbm_disk_path: Optional[str] = None
+    # durable decode sessions (docs/fault_tolerance.md): commit newly-full
+    # generated blocks DURING the step loop (prefix cache + KVBM offload +
+    # announcement mesh + session checkpointing see a live session's KV as
+    # it grows) instead of only at slot release. None = resolve from
+    # DYN_KV_INCREMENTAL_COMMIT (default on). The commit content is
+    # byte-identical either way; off restores the release-only arm.
+    incremental_commit: Optional[bool] = None
 
     @property
     def max_pages_per_seq(self) -> int:

@@ -257,6 +257,13 @@ class _Slot:
     # dynogate tenant key (docs/overload.md): feeds the StepPlanner's
     # per-tenant fairness tiebreak; "" = the default tenant
     tenant: str = ""
+    # migration retry ordinal (llm/migration.py RetryManager): > 0 means
+    # this request resumes a stream a dead worker lost — the prompt is
+    # the original prompt plus the already-emitted tokens. Admission
+    # classifies the resume source (checkpoint/peer/local/recompute)
+    # exactly once (a later preemption re-admit must not re-count).
+    migration: int = 0
+    migration_counted: bool = False
 
 
 class StreamedPullHandle:
@@ -557,6 +564,31 @@ class JaxEngine:
             if config.mixed_dispatch is not None
             else env_bool("DYN_MIXED_DISPATCH", True)
         ) and not config.spec_mode and config.pp_size == 1 and config.sp_size == 1
+        # durable decode sessions (docs/fault_tolerance.md "Request
+        # migration"): commit newly-FULL generated blocks during the step
+        # loop rather than only at _release_slot, so a live session's
+        # prefix is continuously visible to the prefix cache, the KVBM
+        # offload pipeline, the announcement mesh and (when enabled) the
+        # session-checkpoint replicator. The commit logic is the same
+        # _commit_generated_blocks call release uses — byte-identical
+        # blocks either way, incremental just runs it earlier.
+        self._incremental_commit = (
+            config.incremental_commit
+            if config.incremental_commit is not None
+            else env_bool("DYN_KV_INCREMENTAL_COMMIT", True)
+        )
+        # migration observability (ISSUE 15): what a worker death actually
+        # cost. A resumed (migrated) request arrives with req.migration > 0;
+        # at admission we classify the session-prefix source — checkpoint
+        # (peer-replicated session blocks), peer (plain fabric pull),
+        # local (own G1/G2/G3 copies), recompute (full prefill) — and count
+        # the tokens that really had to be re-prefilled.
+        self.migrations_resumed = 0
+        self.migration_replayed_tokens = 0
+        self.resume_source_checkpoint = 0
+        self.resume_source_peer = 0
+        self.resume_source_local = 0
+        self.resume_source_recompute = 0
         # row-start alignment of the flat packer: the Pallas ragged kernel
         # needs q-tile-aligned rows; the XLA reference packs dense
         self._mixed_align = (
@@ -1504,6 +1536,7 @@ class JaxEngine:
             slot.max_tokens = max(self.config.max_model_len - len(slot.prompt), 1)
         slot.priority = int(req.priority or 0)
         slot.tenant = req.tenant or ""
+        slot.migration = int(getattr(req, "migration", 0) or 0)
         slot.arrival_s = time.monotonic()
         self.scheduler.assign_deadline(slot)
         return slot
@@ -1727,6 +1760,12 @@ class JaxEngine:
         if self.data_plane is not None:
             out["kv_transfers_served"] = self.data_plane.transfers_served
             out["kv_bytes_served"] = self.data_plane.bytes_served
+            # session-checkpoint pushes ACCEPTED into this worker's tiers
+            # (the replica-holder side of durable decode sessions)
+            out["kv_checkpoint_pushes"] = self.data_plane.checkpoint_pushes
+            out["kv_checkpoint_blocks_received"] = (
+                self.data_plane.checkpoint_blocks_received
+            )
         out["kv_pulls_completed"] = self.kv_pulls_completed
         out["kv_pages_pulled"] = self.kv_pages_pulled
         # streamed disagg handoff (docs/disagg_serving.md): decode-side
@@ -1746,6 +1785,17 @@ class JaxEngine:
         ) if self.disagg_streamed_handoffs else 0.0
         out["kv_streamed_stages"] = self.kv_streamed_stages
         out["kv_streamed_fallbacks"] = self.kv_streamed_fallbacks
+        # migration observability (docs/fault_tolerance.md): how many
+        # streams resumed here after a worker death, what each resume
+        # actually cost (tokens re-prefilled) and where the session
+        # prefix came from — the kill-mid-decode CI arm gates on
+        # resume_source_checkpoint > 0
+        out["migrations_resumed"] = self.migrations_resumed
+        out["migration_replayed_tokens"] = self.migration_replayed_tokens
+        out["resume_source_checkpoint"] = self.resume_source_checkpoint
+        out["resume_source_peer"] = self.resume_source_peer
+        out["resume_source_local"] = self.resume_source_local
+        out["resume_source_recompute"] = self.resume_source_recompute
         out["kv_skip_ahead_blocks"] = self.prefix_skip_ahead_blocks
         out["emit_batches"] = self.emit_batches
         out["emit_tokens"] = self.emit_tokens
@@ -2001,6 +2051,8 @@ class JaxEngine:
                     hint_instance=hint_inst,
                 )
         n_onboard = len(onboard_hashes)
+        if slot.migration:
+            self._count_resume(slot, hashes, n_cached, onboard_hashes)
         idx = self._free_slots.pop()
         slot.slot_idx = idx
         slot.pages = cached_pages + fresh
@@ -2939,6 +2991,15 @@ class JaxEngine:
             logger.warning("KVBM onboard miss: %s; prefilling instead", e)
             n_known = len(slot.committed_hashes)
             slot.prefill_pos = n_known * self.config.page_size
+            if slot.migration:
+                # replayed-token accounting is OUTCOME-based: the
+                # admission plan counted these blocks as reused, but the
+                # pull died (dead peer, eviction race) and the span now
+                # really re-prefills — an operator reading "what did the
+                # death cost" must see it
+                self.migration_replayed_tokens += (
+                    len(hashes) * self.config.page_size
+                )
             return
         # [n, layers, page, heads, dim] -> [layers, n, page, heads, dim]
         k_np = k_np.swapaxes(0, 1)
@@ -4306,6 +4367,7 @@ class JaxEngine:
                 else:
                     self._fill_recent(i, slot)
                     self._mark_lane_dirty(i)
+                    self._maybe_commit_incremental(slot)
 
         if want_block is not None:
             self._inflight.popleft()
@@ -4373,6 +4435,8 @@ class JaxEngine:
             if finish:
                 self._emit_finish(slot, finish)
                 self._release_slot(slot)
+            else:
+                self._maybe_commit_incremental(slot)
 
     def _process_block(self, lanes: List[tuple], toks: np.ndarray,
                        lps: np.ndarray, tids: np.ndarray,
@@ -4425,6 +4489,11 @@ class JaxEngine:
             if finish:
                 self._emit_finish(slot, finish)
                 self._release_slot(slot)
+            else:
+                # durable sessions: newly-full generated blocks publish
+                # now (prefix cache + KVBM + mesh + checkpoint), not at
+                # release — a SIGKILL loses only the un-committed tail
+                self._maybe_commit_incremental(slot)
 
     def _fail_all(self, message: str):
         """A step raised: the batch state is unreliable. Error every live
@@ -4541,6 +4610,51 @@ class JaxEngine:
             slot.slot_idx = -1
             slot.pages = []
             self._mark_lane_dirty(idx)
+
+    def _count_resume(self, slot: _Slot, hashes: List[int], n_cached: int,
+                      onboard_hashes: List[int]):
+        """Classify a migrated request's resume source at admission
+        (docs/fault_tolerance.md): `checkpoint` when any reused block is a
+        session-checkpoint replica (pushed here or mesh-tagged), `peer`
+        when the onboard pulls plain fabric blocks from another worker,
+        `local` when the survivor's own G1/tiers cover the prefix, else
+        `recompute` (full prefill — the pre-checkpoint cost of a death)."""
+        if slot.migration_counted:
+            return
+        slot.migration_counted = True
+        self.migrations_resumed += 1
+        ps = self.config.page_size
+        reused_blocks = n_cached + len(onboard_hashes)
+        self.migration_replayed_tokens += max(
+            len(slot.kv_prompt) - reused_blocks * ps, 0
+        )
+        reused = list(hashes[:n_cached]) + list(onboard_hashes)
+        if self.kvbm is not None and reused and self.kvbm.any_checkpoint(reused):
+            self.resume_source_checkpoint += 1
+        elif self.kvbm is not None and any(
+            not self.kvbm.manager.has(h) for h in onboard_hashes
+        ):
+            self.resume_source_peer += 1
+        elif reused_blocks:
+            self.resume_source_local += 1
+        else:
+            self.resume_source_recompute += 1
+
+    def _maybe_commit_incremental(self, slot: _Slot):
+        """Step-loop arm of the generated-block commit (durable decode
+        sessions): when a decode block just filled a page, publish it NOW
+        — same _commit_generated_blocks spelling as release, so the two
+        arms commit byte-identical blocks. The length guard keeps the
+        per-step cost at two integer compares when nothing new is full."""
+        if (
+            not self._incremental_commit
+            or slot.generated == 0
+            or slot.slot_idx < 0
+        ):
+            return
+        written = max(len(slot.seq.tokens) - 1, 0)
+        if written // self.config.page_size > len(slot.committed_hashes):
+            self._commit_generated_blocks(slot)
 
     def _commit_generated_blocks(self, slot: _Slot):
         if slot.generated == 0:

@@ -484,6 +484,20 @@ async def main():
         "disagg_first_token_before_last_chunk",
         "disagg_streamed_handoff_ratio", "kv_streamed_stages",
         "kv_streamed_fallbacks",
+        # durable decode sessions (docs/fault_tolerance.md): migration
+        # resumes served here, what each death cost in re-prefilled
+        # tokens, and per-source resume counters — the kill-mid-decode
+        # CI arm gates on resume_source_checkpoint > 0
+        "migrations_resumed", "migration_replayed_tokens",
+        "resume_source_checkpoint", "resume_source_peer",
+        "resume_source_local", "resume_source_recompute",
+        # session checkpointing (kvbm/checkpoint.py): replication
+        # throughput, the refuse-newest backpressure counter, and push
+        # failures (quarantined peers)
+        "kvbm_ckpt_blocks_pushed", "kvbm_ckpt_bytes_pushed",
+        "kvbm_ckpt_blocks_dropped", "kvbm_ckpt_push_failures",
+        "kvbm_ckpt_queue_depth", "kv_checkpoint_pushes",
+        "kv_checkpoint_blocks_received",
     ):
         # registry prepends the "dynamo" prefix -> dynamo_worker_<stat>
         drt.metrics.callback_gauge(

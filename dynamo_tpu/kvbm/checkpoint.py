@@ -1,0 +1,331 @@
+"""Session KV checkpointing: replicate committed blocks to a peer's G2.
+
+Durable decode sessions (docs/fault_tolerance.md "Request migration"):
+with incremental commit, a live session's KV blocks flow into the local
+tiers as decode fills pages. This module pushes those blocks on to a
+PEER worker's host tier over the existing KV data plane, so a SIGKILL
+loses at most the un-checkpointed tail — the survivor onboards the
+replicated prefix through the normal three-arm onboard budget instead of
+recomputing the whole prefill.
+
+Discipline mirrors the offload pipeline exactly (docs/kvbm.md):
+
+  * the stage is a BOUNDED queue (`DYN_KV_CHECKPOINT` = max staged
+    blocks) that refuses the NEWEST block on overflow; a slow/absent
+    peer can never stall the step loop or the kvbm-tier thread — a
+    dropped block is a lost future resume speedup, never lost
+    correctness. Newest-dropped (not oldest): a resume only uses a
+    CONTIGUOUS replicated prefix, so dropping the front would turn
+    every later-pushed block into dead weight, while refusing the tail
+    bounds the loss to exactly what a death loses anyway;
+  * block bytes are read from the local tiers with `read_blocks` (no
+    promotion, no stat distortion) and pushed with the same `kv_format`
+    handshake the peer-pull path uses — a mixed-precision fleet refuses
+    typed before any byte moves;
+  * push failures quarantine the peer (the mesh's `note_peer_failure`)
+    and the batch is dropped + counted; the next batch picks the next
+    ready peer.
+
+`DYN_KV_CHECKPOINT=off` (the default) creates none of this — the store
+path checks one attribute and the behavior is byte-identical to a build
+without checkpointing.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import time
+from collections import deque
+from typing import Deque, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+# blocks per push batch: big enough to amortize the RTT, small enough
+# that one batch never pins the event loop serializing megabytes. The
+# effective batch is further capped by BYTES (half the server's
+# CHECKPOINT_MAX_PAYLOAD) — a large-KV config (long-context many-layer
+# models run ~10MiB/block) would otherwise build count-full batches no
+# server accepts, and every push would fail forever
+_PUSH_BATCH = 64
+
+
+def checkpoint_queue_blocks(raw: Optional[str] = None) -> int:
+    """Parse DYN_KV_CHECKPOINT: 'off'/''/'0' -> 0 (disabled), an integer
+    N -> stage at most N blocks. A typo disables with a warning (a
+    checkpoint misconfig must not take the worker down)."""
+    raw = raw if raw is not None else os.environ.get("DYN_KV_CHECKPOINT")
+    if not raw:
+        return 0
+    raw = raw.strip().lower()
+    if raw in ("off", "0", "false", "no"):
+        return 0
+    try:
+        return max(int(raw), 0)
+    except ValueError:
+        logger.warning("DYN_KV_CHECKPOINT=%r unknown; checkpointing off", raw)
+        return 0
+
+
+class KvCheckpointer:
+    """Bounded replication stage between the local tiers and a peer's G2.
+
+    Staged entries arrive from the kvbm-tier thread
+    (`stage_threadsafe`); the consumer task runs on the event loop,
+    draining batches, reading block bytes read-only, and pushing them
+    over the data plane. All queue state is event-loop-confined —
+    `stage_threadsafe` hops through `call_soon_threadsafe`, and the
+    consumer pops its batch synchronously before any await.
+    """
+
+    def __init__(self, distributed, max_blocks: int):
+        self.dist = distributed
+        self.max_blocks = max(int(max_blocks), 1)
+        self._queue: Deque[Tuple[int, Optional[int]]] = deque()
+        # hashes dropped anywhere on the path (stage overflow, no ready
+        # peer, failed read/push): any later block whose chain parent was
+        # dropped is refused too, so a transient stall can't leave a
+        # mid-prefix hole with pushed-but-unreachable bytes behind it.
+        # Entries EXPIRE (h -> monotonic deadline): the poison is a
+        # bandwidth heuristic — an expired entry risks pushing behind a
+        # stale hole (wasted bytes, never wrong bytes; the survivor's
+        # admission probes the mesh per block anyway), while permanent
+        # poison would let one overflow burst on a popular shared prefix
+        # decay replication for the rest of the process's life
+        self._refused: dict = {}
+        self._refused_ttl_s = 120.0
+        self._wake = asyncio.Event()
+        self._closed = False
+        self._oversize_logged = False
+        # counters (stats() snapshots; single event-loop writer)
+        self.blocks_staged = 0
+        self.blocks_pushed = 0
+        self.bytes_pushed = 0
+        self.blocks_dropped = 0
+        self.push_failures = 0
+        self.format_refusals = 0
+        self.last_peer: Optional[int] = None
+
+    # -- staging (any thread) ------------------------------------------- #
+
+    def stage_threadsafe(self, hashes, parents):
+        loop = self.dist._loop
+        if loop is None or self._closed:
+            return
+        try:
+            loop.call_soon_threadsafe(
+                self._stage, [int(h) for h in hashes], list(parents)
+            )
+        except RuntimeError:
+            # event loop already closed (teardown race with a late tier-
+            # thread store): the replica copy is simply lost, like any
+            # other drop — never take the tier thread down with it
+            pass
+
+    def _stage(self, hashes: List[int], parents: List[Optional[int]]):
+        if self._closed:
+            return
+        for h, p in zip(hashes, parents):
+            # overflow refuses the NEWEST block (blocks stage exactly
+            # once, when their page fills): a hole at the FRONT of a
+            # session's replicated prefix would make every later block
+            # useless for resume — the survivor's prefix match stops at
+            # the hole — while losing the tail costs only the tail.
+            # A dropped block poisons its descendant chain (bounded TTL):
+            # after a transient stall drains, staging a post-hole block
+            # would push bytes a contiguous resume can never reach
+            if len(self._queue) >= self.max_blocks or self._poisoned(p):
+                self.blocks_dropped += 1
+                self._poison([h])
+                continue
+            # a re-offered block repairs its own hole (re-commit after
+            # device-cache churn): it is about to be pushed for real
+            self._refused.pop(h, None)
+            self._queue.append((h, p))
+            self.blocks_staged += 1
+        self._wake.set()
+
+    def _poisoned(self, h) -> bool:
+        if h is None:
+            return False
+        dl = self._refused.get(h)
+        if dl is None:
+            return False
+        if time.monotonic() >= dl:
+            del self._refused[h]
+            return False
+        return True
+
+    def _poison(self, hashes):
+        now = time.monotonic()
+        if len(self._refused) >= 4 * self.max_blocks:
+            # bounded: purge expired first, then shed oldest-deadline —
+            # degrading to a possible stale-hole push, never unbounded
+            self._refused = {
+                k: v for k, v in self._refused.items() if v > now
+            }
+            while len(self._refused) >= 4 * self.max_blocks:
+                self._refused.pop(min(self._refused, key=self._refused.get))
+        dl = now + self._refused_ttl_s
+        for h in hashes:
+            self._refused[int(h)] = dl
+
+    # -- consumer (event loop task) ------------------------------------- #
+
+    async def run(self):
+        while not self._closed:
+            # the whole iteration is guarded: an unexpected error (a
+            # teardown race in the executor, memory pressure mid-copy)
+            # must drop a batch, never kill the replication task —
+            # a silently-dead checkpointer would freeze the kvbm_ckpt_*
+            # counters while operators believe sessions are durable
+            try:
+                await self._run_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — replication is best-effort
+                logger.exception("kv checkpoint iteration failed; continuing")
+                await asyncio.sleep(1.0)
+
+    async def _run_once(self):
+        from ..llm.kv_transfer import (
+            CHECKPOINT_MAX_PAYLOAD, KvFormatError, push_checkpoint_blocks,
+        )
+        from ..runtime import faults
+
+        if not self._queue:
+            self._wake.clear()
+            await self._wake.wait()
+            return
+        # byte-capped batch: block_nbytes is the k+v payload per block,
+        # so this stays under the server's cap with 2x headroom
+        per_block = max(int(self.dist.manager.block_nbytes), 1)
+        if per_block > CHECKPOINT_MAX_PAYLOAD:
+            # a single block no server accepts: replication is
+            # impossible for this config — shed staged work instead of
+            # dialing a push whose torn connection would read as a dead
+            # peer and smear the healthy receiver's quarantine state
+            if not self._oversize_logged:
+                self._oversize_logged = True
+                logger.warning(
+                    "kv checkpoint disabled: block_nbytes %d exceeds the "
+                    "data-plane payload cap %d",
+                    per_block, CHECKPOINT_MAX_PAYLOAD,
+                )
+            self.blocks_dropped += len(self._queue)
+            self._poison([h for h, _ in self._queue])
+            self._queue.clear()
+            return
+        max_batch = max(
+            1, min(_PUSH_BATCH, (CHECKPOINT_MAX_PAYLOAD // 2) // per_block)
+        )
+        batch: List[Tuple[int, Optional[int]]] = []
+        while self._queue and len(batch) < max_batch:
+            batch.append(self._queue.popleft())
+        peer = self.dist.checkpoint_peer()
+        if peer is None:
+            # no ready peer (single-worker fleet, everyone
+            # quarantined): drop + poison — staging forever would just
+            # turn the bound into a stall when the fleet grows, and
+            # un-poisoned drops would let later chain blocks push
+            # behind the hole
+            self.blocks_dropped += len(batch)
+            self._poison([h for h, _ in batch])
+            return
+        inst, addr = peer
+        self.last_peer = inst
+        hashes = [h for h, _ in batch]
+        parents = {h: p for h, p in batch}
+        # executor hop: read_blocks holds the manager lock while it
+        # memcpys up to a full batch of block bytes — inline it would
+        # stall the event loop (token emission, admission) and the
+        # tier thread's stores, same rule as the serve-side tier reads
+        try:
+            present, k, v = await asyncio.get_running_loop().run_in_executor(
+                None, self.dist.manager.read_blocks, hashes
+            )
+        except asyncio.CancelledError:
+            raise
+        except Exception:  # noqa: BLE001 — the peer is blameless here
+            self.blocks_dropped += len(hashes)
+            self._poison(hashes)
+            logger.exception("kv checkpoint read failed; batch dropped")
+            return
+        missing = set(hashes) - set(present)
+        if missing:
+            self.blocks_dropped += len(missing)
+            self._poison(missing)
+            # a descendant of a read-time hole (parent evicted between
+            # stage and read) is unreachable for a contiguous resume —
+            # the same chain rule _stage applies; drop it here rather
+            # than pay the data plane and a peer-G2 slot for dead bytes
+            dead = set(missing)
+            for h in present:  # staged FIFO: parents precede children
+                if parents.get(h) in dead:
+                    dead.add(h)
+            stranded = [h for h in present if h in dead]
+            if stranded:
+                self.blocks_dropped += len(stranded)
+                self._poison(stranded)
+                idx = [i for i, h in enumerate(present) if h not in dead]
+                present = [present[i] for i in idx]
+                k, v = k[idx], v[idx]
+        if not present:
+            return
+        try:
+            f = faults.FAULTS
+            if f.enabled and await f.on("kv_transfer.checkpoint") == "sever":
+                raise ConnectionError("injected: checkpoint push severed")
+            await push_checkpoint_blocks(
+                addr, present, [parents.get(h) for h in present], k, v,
+                kv_format=self.dist.manager.kv_format,
+            )
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — replication is best-effort
+            if isinstance(e, KvFormatError):
+                # mixed-precision fleet: typed, counted (docs/kvbm.md
+                # mixed-fleet rules)
+                self.format_refusals += 1
+            self.push_failures += 1
+            self.blocks_dropped += len(present)
+            self._poison(present)
+            if isinstance(e, KvFormatError) or getattr(
+                e, "ckpt_ineligible", False
+            ):
+                # structural refusal (wrong kv_format, no kvbm tier,
+                # block-geometry mismatch): this never heals while the
+                # instance lives, and a TTL quarantine would re-select
+                # the same ring successor and shed a batch every
+                # expiry — exclude it from checkpoint peering durably
+                # (pull roles unaffected)
+                self.dist.note_checkpoint_ineligible(inst)
+            elif not getattr(e, "peer_blameless", False):
+                # peer_blameless = our own oversized batch: the healthy
+                # peer must not lose its pull/owner/hint roles for it
+                self.dist.note_peer_failure(inst)
+            logger.warning(
+                "kv checkpoint push to %x (%s) failed: %s", inst, addr, e
+            )
+            return
+        self.blocks_pushed += len(present)
+        self.bytes_pushed += int(k.nbytes) + int(v.nbytes)
+
+    def stats(self) -> dict:
+        out = {
+            "kvbm_ckpt_blocks_staged": self.blocks_staged,
+            "kvbm_ckpt_blocks_pushed": self.blocks_pushed,
+            "kvbm_ckpt_bytes_pushed": self.bytes_pushed,
+            "kvbm_ckpt_blocks_dropped": self.blocks_dropped,
+            "kvbm_ckpt_push_failures": self.push_failures,
+            "kvbm_ckpt_format_refusals": self.format_refusals,
+            "kvbm_ckpt_queue_depth": len(self._queue),
+        }
+        if self.last_peer is not None:
+            out["kvbm_ckpt_last_peer"] = f"{self.last_peer:x}"
+        return out
+
+    def close(self):
+        self._closed = True
+        self._wake.set()

@@ -74,13 +74,40 @@ class KvbmDistributed:
         # until a pull is observed: a cold peer never defers an onboard,
         # the same rule the local tiers and the scheduler CostModel use.
         self._pull_ms_per_block: Dict[str, float] = {}
+        # session checkpointing (kvbm/checkpoint.py, DYN_KV_CHECKPOINT):
+        # replicates committed session blocks to a peer's G2 so a worker
+        # death loses only the un-checkpointed tail. None when off.
+        self.checkpointer = None
+        self._ckpt_task: Optional[asyncio.Task] = None
+        # hashes known to be checkpoint REPLICAS (pushed into our tiers by
+        # a peer, or mesh-announced as checkpointed anywhere): the
+        # engine's resume-source classifier reads this. Bounded.
+        self._ckpt_hashes: set = set()
+        # fast corpse cleanup (docs/fault_tolerance.md): peers whose
+        # data plane failed us get quarantined until this deadline — the
+        # onboard budget and the checkpointer stop dialing a corpse
+        # instead of paying the connect-timeout tax per admission. Any
+        # fresh announcement from the instance lifts the quarantine
+        # early; lease expiry (addr delete) removes it entirely.
+        self._dead: Dict[int, float] = {}
+        # peers that REFUSED a checkpoint push for a structural reason
+        # (no kvbm tier — a tier-less prefill worker still advertises its
+        # data plane — or a kv_format mismatch): unlike a transport
+        # failure these do not heal with time, so a TTL quarantine would
+        # re-select the same broken ring successor every ~30s and drop a
+        # batch (plus poison its chain) per cycle, forever. Durable for
+        # the instance's lease lifetime; lease expiry (addr delete)
+        # removes the entry, and a restarted worker gets a fresh id.
+        self._ckpt_ineligible: Set[int] = set()
         # peer pull latency histogram (ms per pull_blocks call)
         self._pull_hist_bounds = (5.0, 20.0, 50.0, 100.0, 250.0, 1000.0)
         self._pull_hist = [0] * (len(self._pull_hist_bounds) + 1)
         self._pull_ms_sum = 0.0
-        # serve our tier blocks on the data plane
+        # serve our tier blocks on the data plane; the back-pointer lets
+        # the server's checkpoint-receive path tag + announce replicas
         if data_plane is not None:
             data_plane.kvbm_source = self.manager
+            data_plane.kvbm_distributed = self
         connector.distributed = self
 
     async def start(self):
@@ -101,6 +128,12 @@ class KvbmDistributed:
         for item in watch.snapshot:
             self._on_addr(item["key"], item["value"])
         self._addr_task = asyncio.create_task(self._addr_loop(watch))
+        from .checkpoint import KvCheckpointer, checkpoint_queue_blocks
+
+        ckpt_blocks = checkpoint_queue_blocks()
+        if ckpt_blocks > 0:
+            self.checkpointer = KvCheckpointer(self, ckpt_blocks)
+            self._ckpt_task = asyncio.create_task(self.checkpointer.run())
         # announcements are fire-and-forget pub/sub: a worker that joins
         # AFTER peers offloaded (fresh decode replica, post-crash restart)
         # would never learn their tier contents — ask everyone to
@@ -113,6 +146,7 @@ class KvbmDistributed:
         inst = int(key.rsplit("/", 1)[-1], 16)
         if raw is None:
             self._addrs.pop(inst, None)
+            self._ckpt_ineligible.discard(inst)
             self._drop_owner(inst, None)
             return
         try:
@@ -140,9 +174,19 @@ class KvbmDistributed:
                 inst = int(msg["worker"])
                 if inst == self.instance_id:
                     continue
+                # a live announcement lifts any failure quarantine early —
+                # the peer is demonstrably back (restart, transient net)
+                self._dead.pop(inst, None)
                 if msg["op"] == "stored":
                     for h in msg["hashes"]:
                         self._owners.setdefault(int(h), set()).add(inst)
+                elif msg["op"] == "checkpoint":
+                    # session-checkpoint replicas: owners like `stored`,
+                    # plus the hash is tagged so a survivor's resume
+                    # classifies as checkpoint-assisted
+                    for h in msg["hashes"]:
+                        self._owners.setdefault(int(h), set()).add(inst)
+                        self._tag_checkpoint(int(h))
                 elif msg["op"] == "evicted":
                     # the peer's tiers dropped these blocks entirely
                     # (bounded tiers / bounded index churn): forget the
@@ -160,12 +204,21 @@ class KvbmDistributed:
                     for h in msg["hashes"]:
                         self._owners.setdefault(int(h), set()).add(inst)
                 elif msg["op"] == "sync_request":
-                    # a late joiner asked for the mesh state: re-announce
-                    # everything our tiers hold, as a replace-set so the
-                    # joiner can't inherit stale entries
-                    self.announce("sync", self.manager.all_hashes())
+                    self._answer_sync()
             except Exception:  # noqa: BLE001
                 logger.exception("bad kvbm announcement")
+
+    def _answer_sync(self):
+        """Answer a late joiner's sync_request: re-announce everything our
+        tiers hold as a replace-set (so the joiner can't inherit stale
+        entries), then re-tag the checkpoint replicas among them — the
+        `sync` op alone would leave the joiner classifying resumes served
+        by those replicas as `peer` instead of `checkpoint`."""
+        all_hashes = [int(h) for h in self.manager.all_hashes()]
+        self.announce("sync", all_hashes)
+        ck = [h for h in all_hashes if h in self._ckpt_hashes]
+        if ck:
+            self.announce("checkpoint", ck)
 
     def _drop_owner(self, inst: int, hashes: Optional[Sequence[int]]):
         """Remove `inst` as owner of `hashes` (None = everywhere), pruning
@@ -211,6 +264,79 @@ class KvbmDistributed:
         self._bg.add(t)
         t.add_done_callback(self._bg.discard)
 
+    # -- corpse quarantine + checkpoint tags ----------------------------- #
+
+    def note_peer_failure(self, inst: int, ttl_s: float = 30.0):
+        """A pull/push to this peer's data plane failed: quarantine it so
+        the onboard budget and checkpointer stop dialing the corpse (fast
+        corpse cleanup). Lifted early by any fresh announcement from the
+        instance; the addr-delete at lease expiry is the authority."""
+        self._dead[int(inst)] = time.monotonic() + ttl_s
+
+    def note_checkpoint_ineligible(self, inst: int):
+        """This peer refused a checkpoint push for a STRUCTURAL reason
+        (no kvbm tier, kv_format mismatch): exclude it from checkpoint
+        peer selection for as long as it advertises this instance id —
+        the ring would otherwise re-pick the same broken successor at
+        every quarantine expiry and shed a batch per cycle. Pull/onboard
+        roles are untouched (a tier-less worker still serves streamed
+        handoffs)."""
+        if len(self._ckpt_ineligible) >= 1024:
+            # bounded; entries normally leave via addr-delete, so this
+            # only trips under pathological id churn without leases
+            self._ckpt_ineligible.pop()
+        self._ckpt_ineligible.add(int(inst))
+
+    def _quarantined(self, inst: int) -> bool:
+        dl = self._dead.get(int(inst))
+        if dl is None:
+            return False
+        if time.monotonic() >= dl:
+            del self._dead[int(inst)]
+            return False
+        return True
+
+    def _tag_checkpoint(self, h: int):
+        if len(self._ckpt_hashes) >= 65536:
+            # bounded: drop an arbitrary half — tags are an observability
+            # refinement (resume classifies as `peer` without one), so a
+            # coarse trim never affects correctness
+            for _ in range(32768):
+                self._ckpt_hashes.pop()
+        self._ckpt_hashes.add(int(h))
+
+    def note_checkpoint_received(self, hashes: Sequence[int]):
+        """The data plane stored a peer's checkpoint push into OUR tiers:
+        tag the hashes locally and announce them as `checkpoint` so the
+        rest of the mesh (including the original owner's survivors) can
+        route resumes here."""
+        for h in hashes:
+            self._tag_checkpoint(int(h))
+        self.announce("checkpoint", list(hashes))
+
+    def any_checkpoint(self, hashes: Sequence[int]) -> bool:
+        return any(int(h) in self._ckpt_hashes for h in hashes)
+
+    def checkpoint_peer(self) -> Optional[Tuple[int, str]]:
+        """The replication target: the ring successor — the first live,
+        non-quarantined peer with an advertised data plane whose id
+        follows this worker's (wrapping). Stable across calls so one
+        session's blocks land on ONE peer (a scattered prefix would cost
+        the survivor a pull per peer), and per-WORKER distinct so the
+        fleet's replication load spreads instead of concentrating every
+        worker's checkpoint stream on the lowest-id peer (whose G2 would
+        churn under (N-1)x write load and whose death would take every
+        session replica with it)."""
+        me = self.instance_id
+        ring = sorted(self._addrs)
+        for inst in [i for i in ring if i > me] + [i for i in ring if i < me]:
+            if self._quarantined(inst) or inst in self._ckpt_ineligible:
+                continue
+            addr = self._addrs.get(inst)
+            if addr:
+                return inst, addr
+        return None
+
     # -- probe/pull (G4 role) ------------------------------------------- #
 
     def remote_owner(
@@ -219,12 +345,15 @@ class KvbmDistributed:
         """First live announced owner; `hint_instance` (the router-supplied
         holder from KvPushRouter's radix index) is the fallback when the
         announcement mesh hasn't mirrored the hash — the pull itself
-        verifies, a wrong hint is just a KeyError fallback."""
+        verifies, a wrong hint is just a KeyError fallback. Quarantined
+        peers (recent data-plane failure) are skipped in both roles."""
         for inst in self._owners.get(int(h), ()):  # first live owner wins
+            if self._quarantined(inst):
+                continue
             addr = self._addrs.get(inst)
             if addr:
                 return inst, addr
-        if hint_instance is not None:
+        if hint_instance is not None and not self._quarantined(int(hint_instance)):
             addr = self._addrs.get(int(hint_instance))
             if addr:
                 return int(hint_instance), addr
@@ -274,20 +403,34 @@ class KvbmDistributed:
         from ..llm.kv_transfer import pull_kvbm_blocks
 
         plan: Dict[str, List[int]] = {}
+        addr_inst: Dict[str, int] = {}
         for h in hashes:
             owner = self.remote_owner(h, hint_instance=hint_instance)
             if owner is None:
                 raise KeyError(f"kvbm block {h} has no remote owner")
             plan.setdefault(owner[1], []).append(int(h))
+            addr_inst[owner[1]] = owner[0]
         t0 = time.perf_counter()
         parts: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
 
         async def pull_one(addr: str, hs: List[int]):
             t_peer = time.perf_counter()
-            k, v = await pull_kvbm_blocks(
-                addr, hs, self.manager.block_shape, self.manager.dtype,
-                kv_format=self.manager.kv_format,
-            )
+            try:
+                # tight connect budget: this is the admission/TTFT
+                # critical path and a dead peer must cost a bounded
+                # fallback-to-recompute, not a 10s dial
+                k, v = await pull_kvbm_blocks(
+                    addr, hs, self.manager.block_shape, self.manager.dtype,
+                    kv_format=self.manager.kv_format, connect_timeout=2.0,
+                )
+            except (KeyError, asyncio.CancelledError):
+                raise  # block miss / teardown: the peer itself is fine
+            except BaseException:
+                # transport failure: quarantine so the NEXT admission's
+                # onboard budget skips this peer instead of re-paying the
+                # connect-timeout tax on a corpse (fast corpse cleanup)
+                self.note_peer_failure(addr_inst.get(addr, -1))
+                raise
             ms = (time.perf_counter() - t_peer) * 1000.0
             prev = self._pull_ms_per_block.get(addr)
             per_block = ms / max(len(hs), 1)
@@ -356,7 +499,14 @@ class KvbmDistributed:
             "kvbm_known_remote_blocks": sum(
                 1 for owners in self._owners.values() if owners
             ),
+            "kvbm_quarantined_peers": sum(
+                1 for i in list(self._dead) if self._quarantined(i)
+            ),
+            "kvbm_known_checkpoint_blocks": len(self._ckpt_hashes),
+            "kvbm_ckpt_ineligible_peers": len(self._ckpt_ineligible),
         }
+        if self.checkpointer is not None:
+            out.update(self.checkpointer.stats())
         for addr, ms in self._pull_ms_per_block.items():
             out.setdefault("kvbm_peer_ms_per_block", {})[addr] = round(ms, 3)
         return out
@@ -365,6 +515,10 @@ class KvbmDistributed:
         # in-flight best-effort announcements die with the mirror
         for t in list(self._bg):
             t.cancel()
+        if self.checkpointer is not None:
+            self.checkpointer.close()
+        if self._ckpt_task:
+            self._ckpt_task.cancel()
         if self._task:
             self._task.cancel()
         if self._addr_task:

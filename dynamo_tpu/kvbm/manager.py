@@ -287,6 +287,34 @@ class KvBlockManager:
             self.onboarded_blocks += len(hashes)
         return np.stack(ks), np.stack(vs)
 
+    def read_blocks(
+        self, hashes: Sequence[int]
+    ) -> Tuple[List[int], np.ndarray, np.ndarray]:
+        """Read-only fetch for the session-checkpoint replicator: no
+        promotion, no hit/miss/onboard accounting, no recency touch — a
+        background copy must not distort the tier stats or eviction order
+        the serving path depends on. Missing hashes are silently skipped
+        (evicted between stage and push: the checkpoint just loses that
+        block, same drop-not-stall discipline as the offload queue).
+        Returns (present_hashes, k [n,...], v [n,...])."""
+        present: List[int] = []
+        ks, vs = [], []
+        with self._lock:
+            for h in hashes:
+                for tier in (self.host, self.disk):
+                    if tier is None:
+                        continue
+                    slot = tier._by_hash.get(h)
+                    if slot is not None:
+                        present.append(int(h))
+                        # copy: the views die with the next eviction
+                        ks.append(np.array(tier._k[slot]))
+                        vs.append(np.array(tier._v[slot]))
+                        break
+        if not present:
+            return [], np.empty((0,)), np.empty((0,))
+        return present, np.stack(ks), np.stack(vs)
+
     def flush(self):
         """Persist the disk tier's index (engine close / checkpoint)."""
         with self._lock:
@@ -338,6 +366,11 @@ class _OffloadBatch:
     v: object = None
     ready: bool = False  # gather dispatched (k/v populated)
     dropped: bool = False  # backpressure victim: tier thread must skip it
+    # "offload" = this worker's own session commits (checkpoint-staged);
+    # "promotion" = peer-pulled blocks entering the host tier (already
+    # durable on the peer — replicating them would waste the data plane
+    # AND crowd this worker's own sessions out of the bounded stage)
+    origin: str = "offload"
 
 
 class KvbmConnector:
@@ -504,6 +537,7 @@ class KvbmConnector:
             k=np.asarray(k).swapaxes(0, 1),
             v=np.asarray(v).swapaxes(0, 1),
             ready=True,
+            origin="promotion",
         )
         with self._offload_cv:
             if self._stopped:
@@ -598,6 +632,16 @@ class KvbmConnector:
         if self.distributed is not None:
             self.distributed.announce_threadsafe("stored", batch.hashes)
             self._announce_evictions()
+            # session checkpointing (docs/fault_tolerance.md): every block
+            # this worker COMMITS is also staged for replication to a
+            # peer's G2 — bounded (newest refused), never blocks this
+            # thread. Promotion batches (peer-pulled blocks) are not
+            # staged: they are already durable on the peer that served
+            # them, and re-pushing them would crowd this worker's own
+            # live sessions out of the bounded stage
+            ck = self.distributed.checkpointer
+            if ck is not None and batch.origin == "offload":
+                ck.stage_threadsafe(batch.hashes, batch.parents)
 
     def _announce_evictions(self):
         """Retract fully-dropped hashes from the mesh (any thread)."""
@@ -644,6 +688,9 @@ class KvbmConnector:
             if self.distributed is not None:
                 self.distributed.announce_threadsafe("stored", hashes)
                 self._announce_evictions()
+                ck = self.distributed.checkpointer
+                if ck is not None:
+                    ck.stage_threadsafe(hashes, parents)
 
         with self._pending_lock:
             self._pending += 1
@@ -781,6 +828,16 @@ class KvbmConnector:
         (docs/kvbm.md onboard budget)."""
         with self._offload_cv:
             self.onboard_recompute_fallbacks += 1
+
+    def any_checkpoint(self, hashes: Sequence[int]) -> bool:
+        """True when any of `hashes` is a session-checkpoint replica —
+        pushed INTO this worker's tiers by a peer's checkpointer, or
+        mesh-announced as checkpointed elsewhere. Drives the engine's
+        resume-source classification for migrated requests."""
+        return (
+            self.distributed is not None
+            and self.distributed.any_checkpoint(hashes)
+        )
 
     def load(self, hashes: Sequence[int]) -> Tuple[np.ndarray, np.ndarray]:
         return self.manager.load_blocks(hashes)

@@ -139,9 +139,14 @@ class HttpService:
         return web.json_response({"status": "live"})
 
     async def prometheus(self, request: web.Request) -> web.Response:
+        from ..migration import MIGRATION_METRICS
+
         body = self.metrics.render()
         if self.gate is not None and self.gate.config.enabled:
             body += self.gate.render_prometheus()
+        # migration observability (docs/fault_tolerance.md): what worker
+        # deaths cost this frontend's streams
+        body += MIGRATION_METRICS.render_prometheus()
         return web.Response(
             body=body, content_type="text/plain", charset="utf-8"
         )

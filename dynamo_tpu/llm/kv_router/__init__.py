@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import Any, AsyncIterator, Dict, Optional
 
 from ...runtime import codec
@@ -94,6 +95,13 @@ class KvPushRouter:
         self._sync_sub = None
         self._sync_task: Optional[asyncio.Task] = None
         self._bg: set = set()
+        # fast corpse cleanup (docs/fault_tolerance.md): a worker whose
+        # stream just died is SUSPECT until this deadline — its radix/
+        # overlay/scheduler state is forgotten immediately (stale prefix
+        # scores must not pin retries to the corpse, and the holder hint
+        # must never name it) and new streams skip it while its lease
+        # lingers. A live worker re-earns entries through its own events.
+        self._suspect: Dict[int, float] = {}
 
     @property
     def _sync_topic(self) -> str:
@@ -178,7 +186,29 @@ class KvPushRouter:
             if self._inflight_overlay is not None:
                 self._inflight_overlay.remove_worker(w)
             self.scheduler.remove_worker(w)
+            self._suspect.pop(w, None)  # lease authority took over
         self._known_workers = live_set
+
+    def note_stream_lost(self, worker: int, ttl_s: float = 15.0):
+        """A stream on `worker` died mid-flight: treat the worker as a
+        corpse ahead of lease expiry — forget its radix/overlay/scheduler
+        state NOW (stale overlap scores and holder hints must not pin
+        retries to it) and keep it out of new-stream candidate sets for
+        `ttl_s`. If the worker is actually alive (transient blip), it
+        re-earns index entries from its own KV events and load reports —
+        degraded routing for a moment, never a wrong dial."""
+        self._suspect[int(worker)] = time.monotonic() + ttl_s
+        self.indexer.remove_worker(int(worker))
+        if self._inflight_overlay is not None:
+            self._inflight_overlay.remove_worker(int(worker))
+        self.scheduler.remove_worker(int(worker))
+
+    def _live_suspects(self) -> set:
+        now = time.monotonic()
+        for w, dl in list(self._suspect.items()):
+            if now >= dl:
+                del self._suspect[w]
+        return set(self._suspect)
 
     def find_best_match(
         self,
@@ -186,13 +216,15 @@ class KvPushRouter:
         router_override: Optional[dict] = None,
         seq_hashes: Optional[list[int]] = None,
         return_scores: bool = False,
+        exclude: Optional[set] = None,
     ) -> tuple:
         """Returns (worker_id, overlap_blocks) — reference find_best_match
         kv_router.rs:318. `seq_hashes`: precomputed block hashes (generate()
         hashes the prompt ONCE and reuses them here, for the overlay record
         and for the sync publish). `return_scores=True` appends the full
         per-worker overlap map (the cluster-KV-fabric holder hint reads
-        the best-overlap worker from it)."""
+        the best-overlap worker from it). `exclude`: instances a migration
+        retry named dead — never scheduled, never the holder hint."""
         live = self.client.instance_ids()
         # NEW streams schedule only onto ready instances: a `draining`
         # worker (scale-down in progress) would reject the stream anyway —
@@ -200,6 +232,15 @@ class KvPushRouter:
         # its index/overlay state is pruned by the lease-revoke delete,
         # not by the drain mark.
         ready = self.client.ready_instance_ids()
+        hard = set(exclude or ())  # named dead by a migration retry
+        avoid = hard | self._live_suspects()
+        if avoid:
+            # corpse-free candidate set; when ONLY suspects remain, fall
+            # back to them (a suspect may be a transient blip — serving
+            # beats refusing), but hard exclusions never come back: the
+            # retry KNOWS that worker lost its stream
+            filtered = [i for i in ready if i not in avoid]
+            ready = filtered or [i for i in ready if i not in hard]
         if not ready:
             raise StreamLost(f"no instances for {self.client.endpoint.subject}")
         self._prune_dead_workers(live)
@@ -249,13 +290,21 @@ class KvPushRouter:
         )
         seq_hashes = compute_seq_hashes(token_ids, self.block_size, salt)
         pinned = request.get("router", {}).get("backend_instance_id")
+        from ...runtime.push_router import request_excluded_instances
+
+        excluded = set(request_excluded_instances(request))
         holder = None
+        if pinned is not None and int(pinned) in excluded:
+            # a pin naming an excluded (dead) instance must not bypass
+            # the corpse-exclusion contract — route as if unpinned
+            pinned = None
         if pinned is not None:
             worker, overlap = int(pinned), 0
         else:
             worker, overlap, overlap_scores = self.find_best_match(
                 token_ids, request.get("router") or None,
                 seq_hashes=seq_hashes, return_scores=True,
+                exclude=excluded,
             )
             # cluster KV fabric (docs/kvbm.md): the index already knows
             # which OTHER worker holds the longest cached prefix — ship
@@ -263,8 +312,12 @@ class KvPushRouter:
             # worker can pull those blocks from the holder's tiers instead
             # of recomputing them. Only a strictly-better holder is worth
             # a hint; the worker's own announcement mesh covers the rest.
+            # A dead/suspect worker must never be the hint: a stale holder
+            # would pin the resumed stream's onboard to the corpse.
+            avoid_holder = excluded | self._live_suspects()
             best_holder = max(
-                (w for w in overlap_scores if w != worker),
+                (w for w in overlap_scores
+                 if w != worker and w not in avoid_holder),
                 key=lambda w: overlap_scores[w], default=None,
             )
             if best_holder is not None and overlap_scores[best_holder] > overlap:
@@ -301,13 +354,21 @@ class KvPushRouter:
             # replicas mirrored the route: they must see the free too, or
             # they leak the active request forever (no TTL pruning)
             self._publish_sync({"op": "free", "request_id": request_id})
+            self.note_stream_lost(worker)
             raise
-        return self._wrap(inner, request_id)
+        return self._wrap(inner, request_id, worker)
 
-    async def _wrap(self, stream: AsyncIterator[Any], request_id: str):
+    async def _wrap(self, stream: AsyncIterator[Any], request_id: str,
+                    worker: int):
         try:
             async for item in stream:
                 yield item
+        except StreamLost:
+            # mid-stream death: forget the corpse NOW (fast corpse
+            # cleanup) so the migration retry's re-route and holder hint
+            # never land back on it while its lease lingers
+            self.note_stream_lost(worker)
+            raise
         finally:
             self.scheduler.mark_free(request_id)
             self._publish_sync({"op": "free", "request_id": request_id})

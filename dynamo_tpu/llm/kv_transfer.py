@@ -68,6 +68,11 @@ _HDR = struct.Struct("<II")  # magic, header length
 
 DATA_PLANE_ROOT = "v1/kv_data_plane/"
 
+# hard server-side cap on one checkpoint push's block payload; the
+# checkpointer sizes its batches to half this (bytes, not block count —
+# a large-KV config would otherwise build full batches no server accepts)
+CHECKPOINT_MAX_PAYLOAD = 512 << 20
+
 # process-local rendezvous: (addr, transfer_id) -> _Staged. The in-process
 # device-direct path (co-located prefill/decode engines) resolves here and
 # never touches the socket.
@@ -265,6 +270,12 @@ class KvDataPlaneServer:
         # handshakes resolve straight from the tier manager — peers onboard
         # blocks this worker offloaded (reference KvbmLeader/Worker role)
         self.kvbm_source = None
+        # back-pointer to KvbmDistributed: the checkpoint-receive path
+        # tags stored replicas + announces them on the mesh
+        self.kvbm_distributed = None
+        # session-checkpoint pushes accepted into our tiers
+        self.checkpoint_pushes = 0
+        self.checkpoint_blocks_received = 0
 
     @property
     def addr(self) -> str:
@@ -430,7 +441,7 @@ class KvDataPlaneServer:
                     reader.readexactly(length), self.chunk_timeout
                 )
                 if magic == _MAGIC_RANGE:
-                    await self._serve_range(body, writer)
+                    await self._serve_range(body, writer, reader)
                     continue
                 await self._serve_transfer(body, writer)
                 return
@@ -463,7 +474,8 @@ class KvDataPlaneServer:
         self.transfers_served += 1
         self._unstage(staged, ok=True)
 
-    async def _serve_range(self, body: bytes, writer: asyncio.StreamWriter):
+    async def _serve_range(self, body: bytes, writer: asyncio.StreamWriter,
+                           reader: Optional[asyncio.StreamReader] = None):
         """One ranged request -> one (k, v) frame. Ranged pulls are how a
         multi-host decode worker's host h fetches chunk (off, n) of ITS
         shard from the matching prefill host: many connections may read the
@@ -471,6 +483,9 @@ class KvDataPlaneServer:
         (unstage_by_id from the leader's unstage_shard broadcast), with the
         TTL/deadline reaper as backstop."""
         req = msgpack.unpackb(body, raw=False)
+        if req.get("ckpt") is not None and reader is not None:
+            await self._serve_checkpoint(req["ckpt"], reader, writer)
+            return
         if req.get("blocks") is not None:
             await self._serve_kvbm_blocks(req, writer)
             return
@@ -568,6 +583,113 @@ class KvDataPlaneServer:
         await asyncio.wait_for(writer.drain(), self.chunk_timeout)
         self.transfers_served += 1
         self.bytes_served += len(kb) + len(vb)
+
+    async def _drain_payload(self, reader: asyncio.StreamReader, n: int):
+        """Read and discard `n` payload bytes after a refused push so the
+        keep-alive connection stays framed for the next request."""
+        while n > 0:
+            chunk = await asyncio.wait_for(
+                reader.read(min(n, 1 << 20)), self.chunk_timeout
+            )
+            if not chunk:
+                raise asyncio.IncompleteReadError(b"", n)
+            n -= len(chunk)
+
+    async def _serve_checkpoint(self, meta: dict,
+                                reader: asyncio.StreamReader,
+                                writer: asyncio.StreamWriter):
+        """Session-checkpoint PUSH (kvbm/checkpoint.py): a peer replicates
+        committed session blocks into OUR host tier so a death on its side
+        resumes from here. Header carries hashes/parents/format/sizes; the
+        block bytes follow on the same connection. Refusals (no tiers,
+        kv_format mismatch, bad sizes) drain the payload and answer typed
+        BEFORE any byte is interpreted — mixed-precision fleets fail
+        loudly, never store misread rows."""
+        hashes = [int(h) for h in meta.get("blocks") or []]
+        parents = [
+            None if p is None else int(p)
+            for p in (meta.get("parents") or [None] * len(hashes))
+        ]
+        k_bytes = int(meta.get("k_bytes") or 0)
+        v_bytes = int(meta.get("v_bytes") or 0)
+        payload = k_bytes + v_bytes
+        if (
+            not hashes or len(hashes) > 4096 or len(parents) != len(hashes)
+            or payload <= 0
+        ):
+            raise RuntimeError(f"bad checkpoint push ({len(hashes)} blocks, "
+                               f"{payload} bytes)")
+        if payload > CHECKPOINT_MAX_PAYLOAD:
+            # oversized but well-formed: the declared size is bounded
+            # enough to drain, so answer typed on the kept connection —
+            # tearing it here would cost the pusher a reconnect AND
+            # misattribute a sizing bug as a dead peer (quarantine)
+            if payload > 2 * CHECKPOINT_MAX_PAYLOAD:
+                raise RuntimeError(
+                    f"checkpoint push payload absurd ({payload} bytes)"
+                )
+            await self._drain_payload(reader, payload)
+            await self._send_header(
+                writer, {"error": f"checkpoint payload too large "
+                                  f"({payload} > {CHECKPOINT_MAX_PAYLOAD})",
+                         "peer_blameless": True}
+            )
+            return
+        src = self.kvbm_source
+        if src is None:
+            await self._drain_payload(reader, payload)
+            await self._send_header(
+                writer, {"error": "no kvbm tier here", "ckpt_ineligible": True}
+            )
+            return
+        my_fmt = str(getattr(src, "kv_format", "none"))
+        want_fmt = str(meta.get("fmt", "none"))
+        if want_fmt != my_fmt:
+            await self._drain_payload(reader, payload)
+            await self._send_header(
+                writer,
+                {"error": f"kv_format mismatch: holding {my_fmt}, "
+                          f"peer pushes {want_fmt}",
+                 "fmt_mismatch": True, "fmt": my_fmt},
+            )
+            return
+        np_dtype = np.dtype(src.dtype)
+        expect = int(np.prod(src.block_shape)) * np_dtype.itemsize * len(hashes)
+        if k_bytes != expect or v_bytes != expect:
+            # block geometry (dtype/page size/layers) is static for a
+            # process's lifetime: same structural class as a kv_format
+            # mismatch, so the pusher must exclude us durably — a TTL
+            # quarantine would re-offer the same doomed bytes forever
+            await self._drain_payload(reader, payload)
+            await self._send_header(
+                writer, {"error": f"checkpoint size mismatch "
+                                  f"({k_bytes}+{v_bytes} != 2x{expect})",
+                         "ckpt_ineligible": True}
+            )
+            return
+        raw = await asyncio.wait_for(
+            reader.readexactly(payload), self.chunk_timeout
+        )
+        shape = (len(hashes), *src.block_shape)
+        k = np.frombuffer(raw, dtype=np_dtype,
+                          count=expect // np_dtype.itemsize).reshape(shape)
+        v = np.frombuffer(raw, dtype=np_dtype, offset=k_bytes).reshape(shape)
+
+        def store():
+            for i, h in enumerate(hashes):
+                src.store(h, k[i], v[i], parent=parents[i])
+
+        # tier stores do host memcpy (+ possible disk cascade): off the
+        # event loop past the same small-read threshold the pull path uses
+        if payload <= (256 << 10) and getattr(src, "disk", None) is None:
+            store()
+        else:
+            await asyncio.get_running_loop().run_in_executor(None, store)
+        self.checkpoint_pushes += 1
+        self.checkpoint_blocks_received += len(hashes)
+        if self.kvbm_distributed is not None:
+            self.kvbm_distributed.note_checkpoint_received(hashes)
+        await self._send_header(writer, {"ok": True, "stored": len(hashes)})
 
     async def _send_header(self, writer, header: dict):
         body = msgpack.packb(header, use_bin_type=True)
@@ -836,6 +958,87 @@ async def pull_kvbm_blocks(
             if reused and attempt == 0:
                 continue  # stale keep-alive: the server idled it out
             raise KvTransferError(f"kvbm peer pull from {addr} failed: {e}") from e
+        except BaseException:
+            writer.close()
+            raise
+
+
+async def push_checkpoint_blocks(
+    addr: str,
+    hashes: Sequence[int],
+    parents: Sequence[Optional[int]],
+    k: np.ndarray,
+    v: np.ndarray,
+    kv_format: str = "none",
+    connect_timeout: float = 2.0,
+    chunk_timeout: float = 30.0,
+) -> int:
+    """Push session-checkpoint blocks into a peer's G2 (the replication
+    half of durable decode sessions, kvbm/checkpoint.py). `k`/`v` are
+    stacked [n, *block_shape] host rows in this worker's kv_format; the
+    peer refuses a format mismatch typed (KvFormatError) before any byte
+    is interpreted. Returns the number of blocks the peer stored. Raises
+    KvTransferError on transport failure (the checkpointer quarantines
+    the peer and drops the batch — replication is best-effort)."""
+    k = np.ascontiguousarray(k)
+    v = np.ascontiguousarray(v)
+    for attempt in (0, 1):
+        reader, writer, reused = await _CONN_POOL.acquire(
+            addr, connect_timeout, fresh=attempt > 0
+        )
+        try:
+            body = msgpack.packb(
+                {"ckpt": {
+                    "blocks": [int(h) for h in hashes],
+                    "parents": [None if p is None else int(p) for p in parents],
+                    "fmt": str(kv_format),
+                    "k_bytes": int(k.nbytes),
+                    "v_bytes": int(v.nbytes),
+                }},
+                use_bin_type=True,
+            )
+            writer.write(_HDR.pack(_MAGIC_RANGE, len(body)) + body)
+            writer.write(_np_bytes(k))
+            writer.write(_np_bytes(v))
+            await asyncio.wait_for(writer.drain(), chunk_timeout)
+            hdr = await asyncio.wait_for(reader.readexactly(_HDR.size), chunk_timeout)
+            magic, length = _HDR.unpack(hdr)
+            if magic != _MAGIC or length > 65536:
+                raise RuntimeError(f"bad checkpoint reply (magic {magic:#x})")
+            header = msgpack.unpackb(
+                await asyncio.wait_for(reader.readexactly(length), chunk_timeout),
+                raw=False,
+            )
+            if header.get("error"):
+                _CONN_POOL.release(addr, reader, writer)
+                if header.get("fmt_mismatch"):
+                    raise KvFormatError(
+                        f"checkpoint peer {addr} holds kv_format="
+                        f"{header.get('fmt')!r}, we push {kv_format!r}"
+                    )
+                err = KvTransferError(
+                    f"checkpoint push refused: {header['error']}"
+                )
+                # structural refusal (no kvbm tier there, block-geometry
+                # mismatch): the caller excludes the peer durably instead
+                # of TTL-quarantining; peer_blameless (our own oversized
+                # batch) means the healthy peer must not be penalized in
+                # ANY role — drop + count only
+                err.ckpt_ineligible = bool(header.get("ckpt_ineligible"))
+                err.peer_blameless = bool(header.get("peer_blameless"))
+                raise err
+            _CONN_POOL.release(addr, reader, writer)
+            return int(header.get("stored") or 0)
+        except (KvFormatError, KvTransferError):
+            raise
+        except (ConnectionError, asyncio.IncompleteReadError,
+                TimeoutError, asyncio.TimeoutError) as e:
+            writer.close()
+            if reused and attempt == 0:
+                continue  # stale keep-alive: one fresh retry
+            raise KvTransferError(
+                f"checkpoint push to {addr} failed: {e}"
+            ) from e
         except BaseException:
             writer.close()
             raise

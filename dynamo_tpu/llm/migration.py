@@ -4,6 +4,18 @@ Mirrors reference lib/llm/src/migration.rs (Migration :26, RetryManager
 :82-158): when a worker dies mid-stream (StreamLost), re-issue the request —
 minus the tokens already produced — to another worker, up to
 `migration_limit` times. The client sees one uninterrupted stream.
+
+Durable decode sessions (docs/fault_tolerance.md "Request migration"):
+the retry request is fabric-aware. It names the dead worker(s) in
+`router.exclude_instances` (routers never re-dial the corpse, even while
+its lease lingers), drops any `kv_holder` hint or per-attempt disagg
+transfer descriptor that points at a dead instance (a stale hint would
+pin the survivor's KV onboard to the corpse), and carries a `migration`
+ordinal so the survivor classifies + counts the resume source
+(checkpoint / peer / local / recompute). With incremental commit and
+session checkpointing live, the survivor onboards the session prefix
+through the three-arm onboard budget and recomputes only the
+un-checkpointed tail — a death costs a tail, not a prefill.
 """
 
 from __future__ import annotations
@@ -18,6 +30,39 @@ from ..runtime.request_plane import DeadlineExceeded, StreamLost
 from .protocols import Annotated, LLMEngineOutput, PreprocessedRequest
 
 logger = logging.getLogger(__name__)
+
+
+class MigrationMetrics:
+    """Process-wide frontend migration counters, rendered onto /metrics
+    beside the prometheus_client registry (dynogate's hand-assembled
+    pattern). One instance per frontend process; plain ints mutated on
+    the event loop only."""
+
+    def __init__(self):
+        self.migrations = 0            # retries actually issued
+        self.replayed_tokens = 0       # emitted tokens re-sent in retry prompts
+        self.exhausted = 0             # streams that died past the budget
+
+    def render_prometheus(self) -> bytes:
+        lines = [
+            "# HELP dynamo_frontend_migrations_total Stream migrations "
+            "(retries after a worker death)",
+            "# TYPE dynamo_frontend_migrations_total counter",
+            f"dynamo_frontend_migrations_total {self.migrations}",
+            "# HELP dynamo_frontend_migration_replayed_tokens_total "
+            "Already-delivered tokens re-sent in migration retry prompts",
+            "# TYPE dynamo_frontend_migration_replayed_tokens_total counter",
+            f"dynamo_frontend_migration_replayed_tokens_total "
+            f"{self.replayed_tokens}",
+            "# HELP dynamo_frontend_migrations_exhausted_total Streams "
+            "lost after the migration budget ran out",
+            "# TYPE dynamo_frontend_migrations_exhausted_total counter",
+            f"dynamo_frontend_migrations_exhausted_total {self.exhausted}",
+        ]
+        return ("\n".join(lines) + "\n").encode()
+
+
+MIGRATION_METRICS = MigrationMetrics()
 
 
 class Migration(Operator):
@@ -50,7 +95,11 @@ class RetryManager:
         self.engine = engine
         self.request = request
         self.retries_left = limit
+        self.attempts = 0
         self.emitted_tokens: list[int] = []
+        # workers that lost a stream of THIS request: the retry excludes
+        # them from re-routing and strips hints that point at them
+        self.dead_instances: set[int] = set()
         # deterministic jitter, seeded per request: a fleet of retrying
         # streams spreads out, yet a chaos-test re-run reproduces exactly
         self.backoff = Backoff.seeded(
@@ -63,7 +112,53 @@ class RetryManager:
         stop = dict(req.stop_conditions)
         if stop.get("max_tokens") is not None:
             stop["max_tokens"] = max(1, stop["max_tokens"] - len(self.emitted_tokens))
+        if stop.get("min_tokens") is not None:
+            # the survivor's `generated` counter restarts at 0: without
+            # this floor it would suppress eos for min_tokens MORE tokens
+            # than the uninterrupted stream — a determinism break the
+            # (seed, position) sampling contract cannot absorb
+            stop["min_tokens"] = max(
+                int(stop["min_tokens"]) - len(self.emitted_tokens), 0
+            )
         req.stop_conditions = stop
+        # the survivor classifies + counts the resume (engine stats:
+        # migrations_resumed / resume_source_*)
+        req.migration = self.attempts
+        # fabric-aware re-route (docs/fault_tolerance.md): never dial the
+        # corpse again, even while its lease lingers in discovery
+        router = dict(req.router or {})
+        # UNION with any caller-supplied exclusions: the first attempt
+        # honored them, a retry that silently dropped them could route
+        # to an instance the client explicitly ruled out
+        caller_excluded = {
+            int(i) for i in (router.get("exclude_instances") or ())
+        }
+        router["exclude_instances"] = sorted(
+            caller_excluded | self.dead_instances
+        )
+        # an explicit per-request pin naming the corpse would make every
+        # retry re-dial it (the pinned branch short-circuits routing) and
+        # exhaust the budget against a dead worker: the pin dies with the
+        # instance it named, the retry re-routes freely
+        pin = router.get("backend_instance_id")
+        if pin is not None and int(pin) in self.dead_instances:
+            router.pop("backend_instance_id", None)
+        req.router = router
+        # a holder hint naming a dead instance would pin the survivor's
+        # KV onboard to the corpse (connect-timeout per admission): drop
+        # it and let the router attach a fresh one on the re-route
+        holder = req.kv_holder or {}
+        if int(holder.get("instance", -1)) in self.dead_instances:
+            req.kv_holder = None
+        # per-attempt disagg transfer descriptors died with the stream
+        # (their staged pages were reaped/recycled); only the capability
+        # flags survive a migration — the retry renegotiates transfers
+        if req.disagg_params:
+            keep = {
+                k: v for k, v in req.disagg_params.items()
+                if k in ("return_kv", "kv_pull", "kv_stream")
+            }
+            req.disagg_params = keep or None
         return req
 
     async def run(self, context: Context) -> AsyncIterator[Annotated]:
@@ -86,10 +181,14 @@ class RetryManager:
                 yield Annotated.from_error(f"deadline exceeded: {e}")
                 return
             except StreamLost as e:
+                dead = getattr(context, "routed_instance", None)
+                if dead is not None:
+                    self.dead_instances.add(int(dead))
                 if context.is_stopped() or context.is_killed():
                     return
                 if self.retries_left <= 0:
                     logger.error("stream lost and migration budget exhausted: %s", e)
+                    MIGRATION_METRICS.exhausted += 1
                     yield Annotated.from_error(f"stream lost, migration exhausted: {e}")
                     return
                 if context.deadline_exceeded():
@@ -102,15 +201,23 @@ class RetryManager:
                     )
                     return
                 self.retries_left -= 1
+                self.attempts += 1
                 request = self._retry_request()
                 logger.warning(
-                    "migrating request %s (%d tokens emitted, %d retries left)",
+                    "migrating request %s (%d tokens emitted, %d retries left, "
+                    "excluding %s)",
                     self.request.request_id,
                     len(self.emitted_tokens),
                     self.retries_left,
+                    [f"{i:x}" for i in sorted(self.dead_instances)],
                 )
                 if not await self.backoff.wait(context.deadline):
                     yield Annotated.from_error(
                         "stream lost and request deadline exceeded during backoff"
                     )
                     return
+                # counted only once the retry is actually issued — a
+                # deadline hit during backoff must not skew the
+                # frontend-vs-survivor /metrics cross-check
+                MIGRATION_METRICS.migrations += 1
+                MIGRATION_METRICS.replayed_tokens += len(self.emitted_tokens)

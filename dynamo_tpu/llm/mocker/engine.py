@@ -116,6 +116,11 @@ class MockEngine:
         # prefill tokens per tenant — the EDF tiebreak prefers the
         # least-served tenant inside a ~100ms deadline bucket
         self._tenant_served: Dict[str, int] = {}
+        # migration parity with JaxEngine stats (docs/fault_tolerance.md):
+        # the mocker has no KVBM tiers, so every resume is a recompute —
+        # but the soak/CI arms still assert the resume was COUNTED here
+        self.migrations_resumed = 0
+        self.resume_source_recompute = 0
 
     # -- lifecycle ---------------------------------------------------------- #
 
@@ -174,6 +179,9 @@ class MockEngine:
         mreq.seq = TokenBlockSequence(mreq.prompt, self.args.block_size)
         mreq.priority = int(req.priority or 0)
         mreq.tenant = req.tenant or ""
+        if int(getattr(req, "migration", 0) or 0):
+            self.migrations_resumed += 1
+            self.resume_source_recompute += 1
         mreq.sched_deadline = self.sla.deadline(time.monotonic(), mreq.priority)
         self.num_requests += 1
         self._waiting.append(mreq)
@@ -201,6 +209,8 @@ class MockEngine:
             "sched_policy": self.sla.policy,
             "sched_deferred_steps": self.sched_deferred_steps,
             "sched_deadline_overrides": self.sched_deadline_overrides,
+            "migrations_resumed": self.migrations_resumed,
+            "resume_source_recompute": self.resume_source_recompute,
             # dynogate signal parity with the JaxEngine (docs/overload.md):
             # the frontend admission gate projects TTFT from this gauge,
             # so the soak and CI smoke exercise the real gate without jax

@@ -113,6 +113,12 @@ class PreprocessedRequest:
     # StepPlanner's per-tenant fairness tiebreak in the worker. None =
     # the 'default' tenant.
     tenant: Optional[str] = None
+    # migration retry ordinal (llm/migration.py): > 0 marks a request
+    # that RESUMES a stream lost to a worker death — token_ids is the
+    # original prompt plus the tokens already delivered to the client.
+    # Engines classify the resume source (checkpoint/peer/local/
+    # recompute) and count what the death cost (docs/fault_tolerance.md).
+    migration: int = 0
 
     def to_dict(self) -> dict:
         d = {
@@ -145,6 +151,8 @@ class PreprocessedRequest:
             d["priority"] = self.priority
         if self.tenant:
             d["tenant"] = self.tenant
+        if self.migration:
+            d["migration"] = self.migration
         return d
 
     @classmethod

@@ -267,6 +267,26 @@ class LocalProcessConnector:
             await proc.wait()
         logger.info("stopped %s worker pid=%d", role, proc.pid)
 
+    async def kill_one(self, role: Optional[str] = None) -> Optional[int]:
+        """SIGKILL one live managed replica with NO drain — hard worker
+        death (the `worker.kill` fault point's action and the soak
+        harness's crash arm). The corpse stays in `procs` until the next
+        `_reap`/`reconcile`, exactly like a real crash: its lease lingers
+        until TTL, in-flight streams sever, and migration must absorb it.
+        Returns the killed pid, or None when no live replica exists."""
+        roles = [role] if role else ["decode", "prefill"]
+        for r in roles:
+            for proc in reversed(self.procs.get(r, [])):
+                if proc.returncode is None:
+                    proc.kill()  # SIGKILL: no SIGTERM, no grace, no drain
+                    logger.warning(
+                        "worker.kill: SIGKILLed %s worker pid=%d (no drain)",
+                        r, proc.pid,
+                    )
+                    await proc.wait()
+                    return proc.pid
+        return None
+
     async def set_replicas(self, prefill: int, decode: int,
                            frontend: Optional[int] = None) -> None:
         f = faults.FAULTS
@@ -310,6 +330,12 @@ class LocalProcessConnector:
         that died since (the planner calls this every interval)."""
         if self._want is None:
             return
+        f = faults.FAULTS
+        if f.enabled and f.check("worker.kill") == "kill":
+            # dynochaos `worker.kill`: SIGKILL a live replica with no
+            # drain on this tick — the respawn below is the recovery
+            # path under test, migration absorbs the severed streams
+            await self.kill_one()
         p, d, fr = self._want
         self._reap()
         dead = [

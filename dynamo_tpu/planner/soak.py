@@ -238,8 +238,9 @@ class InProcMockWorker:
 class InProcWorkerPool:
     """PlannerConnector over in-proc mock workers (decode role; the
     prefill count is accepted and ignored — co-located serving). Honors
-    the same `planner.connector` / `worker.spawn` fault points as
-    LocalProcessConnector so fault-plan soaks exercise one grammar."""
+    the same `planner.connector` / `worker.spawn` / `worker.kill` fault
+    points as LocalProcessConnector so fault-plan soaks exercise one
+    grammar."""
 
     def __init__(self, cfg: RuntimeConfig, engine_args, *,
                  component: str = "mocker", spawn_retries: int = 3):
@@ -298,6 +299,14 @@ class InProcWorkerPool:
         self.scale_events.append((time.monotonic(), len(self.workers)))
 
     async def reconcile(self) -> None:
+        from ..runtime import faults
+
+        f = faults.FAULTS
+        if f.enabled and f.check("worker.kill") == "kill" and self.workers:
+            # same `worker.kill` grammar as LocalProcessConnector: hard
+            # worker death on the reconcile tick, no drain — migration
+            # absorbs the severed streams, the respawn below heals
+            await self.kill_one()
         if self._want is not None and len(self.workers) < self._want:
             await self.set_replicas(0, self._want)
 

@@ -508,6 +508,10 @@ class Client:
             from .request_plane import StreamLost
 
             raise StreamLost(f"instance {instance_id:x} not found for {self.endpoint.subject}")
+        if context is not None:
+            # migration reads this on StreamLost to exclude the corpse
+            # from the retry's re-route (docs/fault_tolerance.md)
+            context.routed_instance = int(instance_id)
         return await self.endpoint.drt.client.call(inst.address, inst.subject, request, context)
 
     async def generate(self, request: Any, context: Optional[Context] = None):

@@ -390,6 +390,25 @@ ENV_REGISTRY: tuple = (
            "in kvbm_offload_blocks_dropped) instead of stalling the "
            "step loop — offloads are cache copies, never correctness.",
            "kvbm/manager.py"),
+    EnvVar("DYN_KV_INCREMENTAL_COMMIT", "bool", "1",
+           "Durable decode sessions: commit newly-full generated KV "
+           "blocks DURING the step loop (prefix cache + KVBM offload + "
+           "announcement mesh + session checkpointing see a live "
+           "session's prefix as it grows) instead of only at slot "
+           "release. Commits are byte-identical either way; 0 restores "
+           "the release-only arm.",
+           "engine/engine.py"),
+    EnvVar("DYN_KV_CHECKPOINT", "str", "off",
+           "Session KV checkpointing (kvbm/checkpoint.py): replicate "
+           "committed session blocks to a peer worker's G2 over the KV "
+           "data plane so a worker death loses only the un-checkpointed "
+           "tail — the survivor onboards the replicated prefix and "
+           "recomputes the rest. Value = max staged blocks (bounded "
+           "queue refusing the newest on overflow — the replicated "
+           "prefix stays contiguous; same never-stall discipline as "
+           "DYN_KVBM_OFFLOAD_QUEUE); 'off' (default) compiles the path "
+           "out entirely.",
+           "kvbm/checkpoint.py"),
     EnvVar("DYN_KVBM_PEER_PULL", "bool", "1",
            "Cluster KV fabric: let admission onboard blocks from a PEER "
            "worker's G2/G3 tiers over the KV data plane (announcement "

@@ -48,6 +48,11 @@ class Context:
         self._parent = parent
         self._deadline = deadline
         self._children: list[Context] = []
+        # the worker instance the last routed dial targeted (set by
+        # Client.direct): when the stream dies, migration reads this to
+        # exclude the dead instance from the retry's re-route
+        # (docs/fault_tolerance.md "Request migration")
+        self.routed_instance: Optional[int] = None
         if parent is not None:
             parent._children.append(self)
 

@@ -105,6 +105,14 @@ KNOWN_FAULT_POINTS = {
     "worker.spawn":
         "`error` | `crash` — LocalProcessConnector replica spawn; `error` "
         "fails the exec, `crash` kills the child before it reports ready",
+    "worker.kill":
+        "`kill` — LocalProcessConnector reconcile tick: SIGKILL a live "
+        "managed replica with NO drain (hard worker death); migration "
+        "must absorb the lost streams and reconcile respawns the corpse",
+    "kv_transfer.checkpoint":
+        "`sever` | `delay` — session-checkpoint push to the peer's G2 "
+        "(kvbm/checkpoint.py); `sever` drops the batch (counted) and "
+        "quarantines the peer — serving streams never notice",
     "kvbm.offload":
         "`error` | `delay` — kvbm-tier thread store of one offload batch; "
         "`error` drops the batch (counted), streams never notice",

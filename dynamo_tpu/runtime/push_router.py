@@ -24,6 +24,22 @@ class RouterMode(str, enum.Enum):
     KV = "kv"
 
 
+def request_excluded_instances(request: Any) -> List[int]:
+    """Per-request dead-instance exclusions (`router.exclude_instances`,
+    set by migration retries — docs/fault_tolerance.md): routers must not
+    dial these even while the corpse's lease lingers in discovery."""
+    router = (
+        request.get("router") if isinstance(request, dict)
+        else getattr(request, "router", None)
+    )
+    if not isinstance(router, dict):
+        return []
+    try:
+        return [int(i) for i in router.get("exclude_instances") or []]
+    except (TypeError, ValueError):
+        return []
+
+
 class PushRouter:
     """Route requests over the live instances of an endpoint client
     (reference push_router.rs:71)."""
@@ -84,8 +100,10 @@ class PushRouter:
         """Pick an instance and issue the request. On connect failure, retry
         the remaining instances once each before giving up. Failed instances
         are only skipped within this call — discovery (lease expiry) is the
-        authority on permanent removal."""
-        tried: set = set()
+        authority on permanent removal. A migration retry additionally
+        names its dead worker(s) in `router.exclude_instances`: the corpse
+        is never dialed even while its lease lingers."""
+        tried: set = set(request_excluded_instances(request))
         last_err: Optional[Exception] = None
         for _ in range(max(1, len(self.client.instance_ids()))):
             try:

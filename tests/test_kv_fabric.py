@@ -845,6 +845,7 @@ class TestPullFailureHygiene:
         class _Mgr:
             block_shape = (1, 2, 2, 2)
             dtype = np.float32
+            kv_format = "none"
 
         class _Conn:
             manager = _Mgr()
@@ -865,7 +866,7 @@ class TestPullFailureHygiene:
         cancelled: list = []
         sibling_started = asyncio.Event()
 
-        async def fake_pull(addr, hs, shape, dtype):
+        async def fake_pull(addr, hs, shape, dtype, **kw):
             if addr == "peer-a":
                 await sibling_started.wait()
                 raise KvTransferError("injected: peer-a died")
@@ -902,7 +903,7 @@ class TestPullFailureHygiene:
         dist._owners = {1: {10}, 2: {20}}
         dist._addrs = {10: "peer-a", 20: "peer-b"}
 
-        async def fake_pull(addr, hs, shape, dtype):
+        async def fake_pull(addr, hs, shape, dtype, **kw):
             raise KvTransferError(f"injected: {addr} died")
 
         monkeypatch.setattr(kvt, "pull_kvbm_blocks", fake_pull)

@@ -1,0 +1,1514 @@
+"""Durable decode sessions (ISSUE 15): KV-checkpointed migration.
+
+Worker death mid-decode must cost a tail, not a prefill: incremental
+commit publishes a live session's KV as it grows, the checkpointer
+replicates it to a peer's G2, and on StreamLost the retry excludes the
+corpse, drops stale hints, and resumes on the survivor through the
+onboard budget. Oracles are byte-identical greedy streams — a migrated
+continuation must reproduce EXACTLY the tokens the dead stream would
+have produced (count-contiguity is a corollary).
+"""
+
+import asyncio
+import os
+import time
+
+import pytest
+
+from dynamo_tpu.llm.migration import MIGRATION_METRICS, Migration, RetryManager
+from dynamo_tpu.llm.protocols import Annotated, LLMEngineOutput, PreprocessedRequest
+from dynamo_tpu.runtime import faults
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.push_router import (
+    PushRouter,
+    RouterMode,
+    request_excluded_instances,
+)
+
+# --------------------------------------------------------------------------- #
+# retry-request hygiene (unit, no jax)
+# --------------------------------------------------------------------------- #
+
+
+def _manager(req: PreprocessedRequest, emitted, dead=()):
+    m = RetryManager(None, req, limit=3)
+    m.emitted_tokens = list(emitted)
+    m.dead_instances = set(dead)
+    m.attempts = 1
+    return m
+
+
+class TestRetryRequestHygiene:
+    def test_stop_condition_floors_and_migration_ordinal(self):
+        req = PreprocessedRequest(
+            token_ids=[1, 2, 3],
+            stop_conditions={"max_tokens": 10, "min_tokens": 6},
+            request_id="r1",
+        )
+        retry = _manager(req, emitted=[7, 8, 9, 10], dead={0xA})._retry_request()
+        assert retry.token_ids == [1, 2, 3, 7, 8, 9, 10]
+        assert retry.stop_conditions["max_tokens"] == 6
+        # min_tokens must shrink with the emitted count, or the survivor
+        # suppresses eos longer than the uninterrupted stream would
+        assert retry.stop_conditions["min_tokens"] == 2
+        assert retry.migration == 1
+        assert retry.router["exclude_instances"] == [0xA]
+
+    def test_caller_exclusions_survive_retry_union(self):
+        """A retry UNIONS the corpse set with any exclude_instances the
+        caller originally supplied — the first attempt honored them, a
+        retry that silently replaced them could route to an instance
+        the client explicitly ruled out."""
+        req = PreprocessedRequest(
+            token_ids=[1],
+            stop_conditions={"max_tokens": 8},
+            router={"exclude_instances": [0xBAD]},
+        )
+        retry = _manager(req, emitted=[5], dead={0xA})._retry_request()
+        assert retry.router["exclude_instances"] == sorted([0xA, 0xBAD])
+
+    def test_min_tokens_floors_at_zero_and_max_at_one(self):
+        req = PreprocessedRequest(
+            token_ids=[1],
+            stop_conditions={"max_tokens": 3, "min_tokens": 2},
+        )
+        retry = _manager(req, emitted=[5, 6, 7, 8])._retry_request()
+        assert retry.stop_conditions["max_tokens"] == 1
+        assert retry.stop_conditions["min_tokens"] == 0
+
+    def test_kv_holder_pointing_at_corpse_is_dropped(self):
+        req = PreprocessedRequest(
+            token_ids=[1], kv_holder={"instance": 0xDEAD, "blocks": 4},
+        )
+        retry = _manager(req, emitted=[2], dead={0xDEAD})._retry_request()
+        assert retry.kv_holder is None
+
+    def test_live_kv_holder_survives(self):
+        req = PreprocessedRequest(
+            token_ids=[1], kv_holder={"instance": 0xB, "blocks": 4},
+        )
+        retry = _manager(req, emitted=[2], dead={0xDEAD})._retry_request()
+        assert retry.kv_holder == {"instance": 0xB, "blocks": 4}
+
+    def test_pin_naming_corpse_is_dropped(self):
+        # a per-request backend_instance_id pin short-circuits routing:
+        # kept on retry it would re-dial the corpse until the migration
+        # budget exhausted, despite live survivors
+        req = PreprocessedRequest(
+            token_ids=[1], router={"backend_instance_id": 0xDEAD},
+        )
+        retry = _manager(req, emitted=[2], dead={0xDEAD})._retry_request()
+        assert "backend_instance_id" not in retry.router
+        assert retry.router["exclude_instances"] == [0xDEAD]
+
+    def test_live_pin_survives(self):
+        req = PreprocessedRequest(
+            token_ids=[1], router={"backend_instance_id": 0xB},
+        )
+        retry = _manager(req, emitted=[2], dead={0xDEAD})._retry_request()
+        assert retry.router["backend_instance_id"] == 0xB
+
+    def test_disagg_descriptor_stripped_role_flags_kept(self):
+        req = PreprocessedRequest(
+            token_ids=[1],
+            disagg_params={
+                "return_kv": True, "kv_pull": True, "kv_stream": True,
+                "pull": {"transfer_id": "t1", "addr": "1.2.3.4:5"},
+            },
+        )
+        retry = _manager(req, emitted=[2], dead={0xA})._retry_request()
+        assert retry.disagg_params == {
+            "return_kv": True, "kv_pull": True, "kv_stream": True,
+        }
+
+    def test_descriptor_only_disagg_params_drop_entirely(self):
+        req = PreprocessedRequest(
+            token_ids=[1],
+            disagg_params={"pull": {"transfer_id": "t1", "addr": "x:1"}},
+        )
+        retry = _manager(req, emitted=[2])._retry_request()
+        assert retry.disagg_params is None
+
+
+def test_request_excluded_instances_parsing():
+    assert request_excluded_instances({"router": {"exclude_instances": [3, 4]}}) == [3, 4]
+    assert request_excluded_instances({"router": {}}) == []
+    assert request_excluded_instances({}) == []
+    assert request_excluded_instances({"router": "junk"}) == []
+    assert request_excluded_instances(
+        {"router": {"exclude_instances": ["nope"]}}
+    ) == []
+    req = PreprocessedRequest(token_ids=[1], router={"exclude_instances": [7]})
+    assert request_excluded_instances(req) == [7]
+
+
+# --------------------------------------------------------------------------- #
+# checkpoint queue discipline (unit, no jax)
+# --------------------------------------------------------------------------- #
+
+
+def test_checkpoint_env_parsing():
+    from dynamo_tpu.kvbm.checkpoint import checkpoint_queue_blocks
+
+    assert checkpoint_queue_blocks("off") == 0
+    assert checkpoint_queue_blocks("") == 0
+    assert checkpoint_queue_blocks("0") == 0
+    assert checkpoint_queue_blocks("128") == 128
+    assert checkpoint_queue_blocks("garbage") == 0  # typo never fatal
+
+
+def test_checkpoint_peer_ring_spreads_replication():
+    """Each worker replicates to its ring SUCCESSOR, not the globally
+    lowest id: a fleet concentrating every checkpoint stream on one peer
+    would churn that peer's G2 under (N-1)x write load and lose every
+    session replica at once when it dies."""
+    import numpy as np
+
+    from dynamo_tpu.kvbm.distributed import KvbmDistributed
+
+    def bare(instance_id):
+        class _Mgr:
+            block_shape = (1, 2, 2, 2)
+            dtype = np.float32
+            kv_format = "none"
+
+        class _Conn:
+            manager = _Mgr()
+
+        class _Drt:
+            discovery = None
+
+        d = KvbmDistributed(_Drt(), _Conn(), None, "ns", "comp", instance_id)
+        d._addrs = {1: "a1", 2: "a2", 3: "a3"}
+        return d
+
+    assert bare(1).checkpoint_peer() == (2, "a2")
+    assert bare(2).checkpoint_peer() == (3, "a3")
+    assert bare(3).checkpoint_peer() == (1, "a1")  # wraps
+    # quarantine skips to the next live ring member
+    d = bare(1)
+    d.note_peer_failure(2)
+    assert d.checkpoint_peer() == (3, "a3")
+    # nobody else live: no peer (single-worker fleets drop batches)
+    solo = bare(5)
+    solo._addrs = {5: "a5"}
+    assert solo.checkpoint_peer() is None
+
+
+def test_sync_answer_retags_checkpoint_replicas():
+    """A late joiner's sync must not demote checkpoint replicas to plain
+    peer blocks: the answering worker re-announces `checkpoint` for the
+    tagged subset beside the `sync` replace-set, so resumes routed via a
+    resynced view still classify resume_source_checkpoint."""
+    import numpy as np
+
+    from dynamo_tpu.kvbm.distributed import KvbmDistributed
+
+    class _Mgr:
+        block_shape = (1, 2, 2, 2)
+        dtype = np.float32
+        kv_format = "none"
+
+        @staticmethod
+        def all_hashes():
+            return [10, 11, 12]
+
+    class _Conn:
+        manager = _Mgr()
+
+    class _Drt:
+        discovery = None
+
+    d = KvbmDistributed(_Drt(), _Conn(), None, "ns", "comp", 1)
+    d._tag_checkpoint(11)
+    sent = []
+    d.announce = lambda op, hashes: sent.append((op, list(hashes)))
+    d._answer_sync()
+    assert ("sync", [10, 11, 12]) in sent
+    assert ("checkpoint", [11]) in sent
+
+
+def test_checkpoint_stage_bounded_drops_newest_keeps_prefix():
+    """Overflow refuses the NEWEST block: a resume only uses a CONTIGUOUS
+    replicated prefix, so a hole punched at the front (drop-oldest) would
+    turn every later-pushed block into dead weight — the front must
+    survive, the loss must be the tail."""
+    from dynamo_tpu.kvbm.checkpoint import KvCheckpointer
+
+    class _Dist:
+        _loop = None
+
+    async def main():
+        ck = KvCheckpointer(_Dist(), max_blocks=4)
+        ck._stage([1, 2, 3], [None, 1, 2])
+        assert [h for h, _ in ck._queue] == [1, 2, 3]
+        ck._stage([4, 5, 6], [3, 4, 5])
+        # bounded at 4: the front (prefix) kept, the newest two refused
+        assert [h for h, _ in ck._queue] == [1, 2, 3, 4]
+        assert ck.blocks_staged == 4
+        assert ck.blocks_dropped == 2
+        # a refused block poisons its descendants: even after the queue
+        # drains, staging block 7 (parent 6, refused above) would leave
+        # a pushed-but-unreachable span behind the 5-6 hole
+        ck._queue.clear()
+        ck._stage([7], [6])
+        assert not ck._queue
+        assert ck.blocks_dropped == 3
+        # an unrelated chain (fresh root) stages normally
+        ck._stage([100], [None])
+        assert [h for h, _ in ck._queue] == [100]
+
+    asyncio.run(main())
+
+
+def test_checkpoint_poison_expires_and_reoffer_repairs():
+    """Chain poison is a bounded-time bandwidth heuristic: it must expire
+    (one overflow burst on a shared prefix must not decay replication for
+    the process lifetime) and a re-offered block must repair its own
+    hole."""
+    from dynamo_tpu.kvbm.checkpoint import KvCheckpointer
+
+    class _Dist:
+        _loop = None
+
+    async def main():
+        ck = KvCheckpointer(_Dist(), max_blocks=4)
+        ck._refused_ttl_s = 0.05
+        ck._poison([1])
+        ck._stage([2], [1])  # descendant refused while poisoned
+        assert not ck._queue
+        assert ck.blocks_dropped == 1
+        time.sleep(0.06)
+        ck._stage([3], [1])  # poison expired: chain replicates again
+        assert [h for h, _ in ck._queue] == [3]
+        # a poisoned hash re-offered for staging repairs its own hole
+        ck._poison([7])
+        ck._stage([7], [None])
+        assert [h for h, _ in ck._queue] == [3, 7]
+        assert not ck._poisoned(7)
+
+    asyncio.run(main())
+
+
+def test_checkpoint_peer_ineligible_is_durable():
+    """A peer that refused a push STRUCTURALLY (no kvbm tier, wrong
+    kv_format) is excluded from checkpoint peering for its lease
+    lifetime: a TTL quarantine would re-select the same ring successor
+    at every ~30s expiry and shed a batch (plus poison its chain) per
+    cycle, forever. Pull/onboard roles stay untouched, and the
+    addr-delete at lease expiry clears the exclusion (a restarted
+    worker re-advertises and may have tiers now)."""
+    import numpy as np
+
+    from dynamo_tpu.kvbm.distributed import KvbmDistributed
+
+    class _Mgr:
+        block_shape = (1, 2, 2, 2)
+        dtype = np.float32
+        kv_format = "none"
+
+    class _Conn:
+        manager = _Mgr()
+
+    class _Drt:
+        discovery = None
+
+    d = KvbmDistributed(_Drt(), _Conn(), None, "ns", "comp", 1)
+    d._addrs = {1: "a1", 2: "a2", 3: "a3"}
+    assert d.checkpoint_peer() == (2, "a2")
+    d.note_checkpoint_ineligible(2)
+    # durable: no quarantine entry involved, nothing to expire
+    assert not d._dead
+    assert d.checkpoint_peer() == (3, "a3")
+    # the pull role is unaffected — a tier-less prefill worker still
+    # serves streamed handoffs and staged pulls
+    d._owners = {99: {2}}
+    assert d.remote_owner(99) == (2, "a2")
+    # lease expiry clears it; a fresh advertisement starts clean
+    d._on_addr("v1/kv_data_plane/2", None)
+    d._addrs[2] = "a2"
+    assert 2 not in d._ckpt_ineligible
+    assert d.checkpoint_peer() == (2, "a2")
+
+
+def test_checkpoint_push_batch_bounded_by_bytes():
+    """Push batches are capped by BYTES, not only block count: a
+    large-KV config (~10MiB/block at 80 layers) must never build a
+    count-full batch the server's CHECKPOINT_MAX_PAYLOAD refuses —
+    that shape made every full batch unpushable and silently killed
+    checkpointing while sessions were believed durable."""
+    import numpy as np
+
+    from dynamo_tpu.kvbm.checkpoint import KvCheckpointer
+    from dynamo_tpu.llm import kv_transfer
+
+    pushed = []
+
+    async def fake_push(addr, hashes, parents, k, v, **kw):
+        pushed.append(list(hashes))
+        return len(hashes)
+
+    class _Mgr:
+        kv_format = "none"
+        # exactly 3 blocks fit under the cap/2 sender bound
+        block_nbytes = (kv_transfer.CHECKPOINT_MAX_PAYLOAD // 2) // 3
+
+        def read_blocks(self, hashes):
+            k = np.zeros((len(hashes), 2), np.float32)
+            return list(hashes), k, k
+
+    class _Dist:
+        manager = _Mgr()
+        _loop = None
+
+        def checkpoint_peer(self):
+            return 7, "addr7"
+
+    orig = kv_transfer.push_checkpoint_blocks
+    kv_transfer.push_checkpoint_blocks = fake_push
+    try:
+        async def main():
+            ck = KvCheckpointer(_Dist(), 64)
+            ck._stage(list(range(1, 11)), [None] + list(range(1, 10)))
+            await ck._run_once()
+            assert pushed == [[1, 2, 3]]
+            assert [h for h, _ in ck._queue] == list(range(4, 11))
+
+        asyncio.run(main())
+    finally:
+        kv_transfer.push_checkpoint_blocks = orig
+
+
+def test_checkpoint_hole_descendants_not_pushed():
+    """A block whose chain parent went MISSING at read time (evicted
+    between stage and read_blocks) is unreachable for a contiguous
+    resume: pushing it would spend data-plane bytes and a peer-G2 slot
+    on bytes no survivor can use — the same chain rule _stage applies."""
+    import numpy as np
+
+    from dynamo_tpu.kvbm.checkpoint import KvCheckpointer
+    from dynamo_tpu.llm import kv_transfer
+
+    pushed = []
+
+    async def fake_push(addr, hashes, parents, k, v, **kw):
+        pushed.append((list(hashes), len(k)))
+        return len(hashes)
+
+    class _Mgr:
+        kv_format = "none"
+        block_nbytes = 64
+
+        def read_blocks(self, hashes):
+            present = [h for h in hashes if h != 2]  # block 2 evicted
+            k = np.zeros((len(present), 2), np.float32)
+            return present, k, k
+
+    class _Dist:
+        manager = _Mgr()
+        _loop = None
+
+        def checkpoint_peer(self):
+            return 7, "addr7"
+
+    orig = kv_transfer.push_checkpoint_blocks
+    kv_transfer.push_checkpoint_blocks = fake_push
+    try:
+        async def main():
+            ck = KvCheckpointer(_Dist(), 64)
+            ck._stage([1, 2, 3], [None, 1, 2])  # chain 1 <- 2 <- 3
+            await ck._run_once()
+            # 1 pushed; 2 missing; 3 stranded behind the hole — dropped
+            assert pushed == [([1], 1)]
+            assert ck.blocks_dropped == 2
+            assert ck._poisoned(2) and ck._poisoned(3)
+
+        asyncio.run(main())
+    finally:
+        kv_transfer.push_checkpoint_blocks = orig
+
+
+def test_checkpoint_block_over_payload_cap_sheds_without_dialing():
+    """A config whose single block exceeds the data-plane payload cap
+    can never replicate: the stage must shed (counted) WITHOUT dialing
+    a peer — the torn oversized push would read as a dead peer and
+    quarantine the healthy receiver out of its pull/owner roles."""
+    from dynamo_tpu.kvbm.checkpoint import KvCheckpointer
+    from dynamo_tpu.llm import kv_transfer
+
+    class _Mgr:
+        kv_format = "none"
+        block_nbytes = kv_transfer.CHECKPOINT_MAX_PAYLOAD + 1
+
+    class _Dist:
+        manager = _Mgr()
+        _loop = None
+
+        def checkpoint_peer(self):
+            raise AssertionError("must not dial any peer")
+
+    async def main():
+        ck = KvCheckpointer(_Dist(), 64)
+        ck._stage([1, 2, 3], [None, 1, 2])
+        await ck._run_once()
+        assert not ck._queue
+        assert ck.blocks_dropped == 3
+        assert ck.push_failures == 0
+
+    asyncio.run(main())
+
+
+def test_checkpoint_structural_refusal_routes_to_ineligible():
+    """A push that fails with a structural marker (ckpt_ineligible, or
+    any KvFormatError) excludes the peer durably via
+    note_checkpoint_ineligible — NOT the 30s note_peer_failure
+    quarantine that would re-offer the same broken successor forever."""
+    import numpy as np
+
+    from dynamo_tpu.kvbm.checkpoint import KvCheckpointer
+    from dynamo_tpu.llm import kv_transfer
+
+    async def fake_push(addr, hashes, parents, k, v, **kw):
+        err = kv_transfer.KvTransferError(
+            "checkpoint push refused: no kvbm tier here"
+        )
+        err.ckpt_ineligible = True
+        raise err
+
+    class _Mgr:
+        kv_format = "none"
+        block_nbytes = 64
+
+        def read_blocks(self, hashes):
+            k = np.zeros((len(hashes), 2), np.float32)
+            return list(hashes), k, k
+
+    class _Dist:
+        manager = _Mgr()
+        _loop = None
+
+        def __init__(self):
+            self.ineligible = []
+            self.quarantined = []
+
+        def checkpoint_peer(self):
+            return 7, "addr7"
+
+        def note_checkpoint_ineligible(self, inst):
+            self.ineligible.append(inst)
+
+        def note_peer_failure(self, inst):
+            self.quarantined.append(inst)
+
+    orig = kv_transfer.push_checkpoint_blocks
+    kv_transfer.push_checkpoint_blocks = fake_push
+    try:
+        async def main():
+            dist = _Dist()
+            ck = KvCheckpointer(dist, 64)
+            ck._stage([1], [None])
+            await ck._run_once()
+            assert dist.ineligible == [7]
+            assert dist.quarantined == []
+            assert ck.push_failures == 1
+
+        asyncio.run(main())
+
+        # a peer_blameless refusal (our own oversized batch) penalizes
+        # the healthy peer in NO role: not quarantined, not ineligible
+        async def fake_blameless(addr, hashes, parents, k, v, **kw):
+            err = kv_transfer.KvTransferError("checkpoint payload too large")
+            err.peer_blameless = True
+            raise err
+
+        kv_transfer.push_checkpoint_blocks = fake_blameless
+
+        async def main2():
+            dist = _Dist()
+            ck = KvCheckpointer(dist, 64)
+            ck._stage([1], [None])
+            await ck._run_once()
+            assert dist.ineligible == []
+            assert dist.quarantined == []
+            assert ck.push_failures == 1
+            assert ck.blocks_dropped == 1
+
+        asyncio.run(main2())
+    finally:
+        kv_transfer.push_checkpoint_blocks = orig
+
+
+def test_no_tier_checkpoint_refusal_carries_ineligible_flag():
+    """The data-plane server of a tier-less worker (disagg prefill
+    advertises its plane too) refuses a checkpoint push typed AND flags
+    it structural for the durable exclusion; an oversized-but-sane
+    payload is drained and answered typed on the kept connection
+    instead of tearing it (a sizing bug must not read as a dead peer)."""
+    import numpy as np
+
+    from dynamo_tpu.llm import kv_transfer
+    from dynamo_tpu.llm.kv_transfer import (
+        KvDataPlaneServer,
+        KvTransferError,
+        push_checkpoint_blocks,
+    )
+
+    async def main():
+        plane = KvDataPlaneServer(host="127.0.0.1")
+        await plane.start()
+        try:
+            k = np.zeros((1, 2, 4, 1, 4), np.float32)  # 128 B per side
+            with pytest.raises(KvTransferError) as ei:
+                await push_checkpoint_blocks(
+                    plane.addr, [1], [None], k, k, kv_format="none",
+                )
+            assert getattr(ei.value, "ckpt_ineligible", False) is True
+
+            stored = []
+
+            class _Src:
+                kv_format = "none"
+                dtype = "float32"
+                block_shape = (2, 4, 1, 4)
+                disk = None
+
+                def store(self, h, kk, vv, parent=None):
+                    stored.append(h)
+
+            plane.kvbm_source = _Src()
+            orig_cap = kv_transfer.CHECKPOINT_MAX_PAYLOAD
+            kv_transfer.CHECKPOINT_MAX_PAYLOAD = 200  # payload 256 > cap
+            try:
+                with pytest.raises(KvTransferError, match="too large") as eo:
+                    await push_checkpoint_blocks(
+                        plane.addr, [2], [None], k, k, kv_format="none",
+                    )
+            finally:
+                kv_transfer.CHECKPOINT_MAX_PAYLOAD = orig_cap
+            # our own sizing bug: the healthy peer is blameless — the
+            # pusher must not quarantine it out of pull/owner roles
+            assert getattr(eo.value, "peer_blameless", False) is True
+            assert getattr(eo.value, "ckpt_ineligible", True) is False
+            # block-GEOMETRY mismatch (dtype/page size/layers differ):
+            # static for the peer's lifetime, so structural too — a TTL
+            # quarantine would re-offer the same doomed bytes forever
+            bad = np.zeros((1, 2, 4, 1, 8), np.float32)  # 256 B != 128
+            with pytest.raises(KvTransferError, match="size mismatch") as es:
+                await push_checkpoint_blocks(
+                    plane.addr, [2], [None], bad, bad, kv_format="none",
+                )
+            assert getattr(es.value, "ckpt_ineligible", False) is True
+            assert stored == []
+            # connection stayed framed through both refusals
+            n = await push_checkpoint_blocks(
+                plane.addr, [3], [None], k, k, kv_format="none",
+            )
+            assert n == 1 and stored == [3]
+        finally:
+            await plane.close()
+
+    asyncio.run(main())
+
+
+def test_promotion_batches_not_checkpoint_staged():
+    """Peer-pulled blocks entering the host tier (stage_promotion) are
+    already durable on the peer that served them: re-staging them for
+    checkpoint replication would waste the data plane and crowd this
+    worker's OWN session blocks out of the bounded stage."""
+    import numpy as np
+
+    from dynamo_tpu.kvbm import KvBlockManager, KvbmConfig, KvbmConnector
+
+    class _Eng:
+        def __init__(self):
+            import concurrent.futures
+
+            self.kv_k = np.ones((2, 8, 4, 2, 4), np.float32)
+            self.kv_v = np.ones((2, 8, 4, 2, 4), np.float32)
+            self._device_exec = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="fake-jax-step"
+            )
+
+        def _extract_pages(self, k, v, ids):
+            ids = np.asarray(ids)
+            return k[:, ids], v[:, ids]
+
+        def _timed(self, fn, tag, shape=None):
+            return fn
+
+    mgr = KvBlockManager(
+        KvbmConfig(host_blocks=16), (2, 4, 2, 4), np.float32
+    )
+    conn = KvbmConnector(_Eng(), mgr)
+    staged = []
+
+    class _Ck:
+        def stage_threadsafe(self, hashes, parents):
+            staged.append(list(hashes))
+
+    class _Dist:
+        checkpointer = _Ck()
+
+        def announce_threadsafe(self, *a, **k):
+            pass
+
+    conn.distributed = _Dist()
+    try:
+        # promotion arm: peer-pulled per-block rows [n, layers, ...]
+        blk = np.zeros((1, 2, 4, 2, 4), np.float32)
+        conn.stage_promotion([0xAA], [None], blk, blk)
+        # offload arm: this worker's own commit
+        conn.offload_commit([0xBB], [1], parent=None)
+        conn.flush_step()
+        deadline = time.monotonic() + 10
+        while conn.pending_offloads() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert mgr.has(0xAA) and mgr.has(0xBB)  # both stored
+        assert [0xBB] in staged, staged  # own commit replicated
+        assert [0xAA] not in staged, staged  # promotion NOT re-pushed
+    finally:
+        conn.shutdown()
+
+
+def test_backoff_deadline_exceeded_not_counted_as_migration():
+    """A StreamLost near the request deadline whose backoff never gets
+    to issue the retry must not bump the frontend migration counters —
+    they feed the frontend-vs-survivor /metrics cross-check."""
+    from dynamo_tpu.runtime.request_plane import StreamLost
+
+    class _Eng:
+        async def generate(self, request, context):
+            raise StreamLost("injected: worker died")
+            yield  # pragma: no cover
+
+    async def main():
+        req = PreprocessedRequest(
+            token_ids=[1, 2], stop_conditions={"max_tokens": 4},
+            request_id="bk1",
+        )
+        before = MIGRATION_METRICS.migrations
+        mig = Migration(_Eng(), migration_limit=3)
+        errs = []
+        ctx = Context().set_deadline(0.005)
+        async for ann in mig.generate(req, ctx):
+            if ann.is_error():
+                errs.append((ann.comment or ["error"])[0])
+        assert errs and "deadline" in errs[-1]
+        assert MIGRATION_METRICS.migrations == before
+
+    asyncio.run(main())
+
+
+# --------------------------------------------------------------------------- #
+# dead-instance exclusion at the routers (no jax)
+# --------------------------------------------------------------------------- #
+
+
+class _FakeClient:
+    """PushRouter-facing stub: fixed ready instances, records dials."""
+
+    def __init__(self, ids):
+        self.ids = list(ids)
+        self.dialed = []
+        self.requests = []
+
+        class _Ep:
+            subject = "fake"
+
+        self.endpoint = _Ep()
+
+    def instance_ids(self):
+        return list(self.ids)
+
+    def ready_instance_ids(self):
+        return list(self.ids)
+
+    async def direct(self, request, instance_id, context=None):
+        self.dialed.append(instance_id)
+        self.requests.append(dict(request) if isinstance(request, dict) else request)
+        if context is not None:
+            context.routed_instance = instance_id
+
+        async def stream():
+            yield {"data": {"token_ids": [instance_id]}}
+
+        return stream()
+
+
+class TestRouterExclusion:
+    def test_push_router_never_dials_excluded(self):
+        async def main():
+            client = _FakeClient([1, 2, 3])
+            router = PushRouter(client, RouterMode.ROUND_ROBIN)
+            for _ in range(6):
+                stream = await router.generate(
+                    {"router": {"exclude_instances": [2]}}, Context()
+                )
+                async for _ in stream:
+                    pass
+            assert client.dialed and 2 not in client.dialed
+
+        asyncio.run(main())
+
+    def test_push_router_all_excluded_raises_stream_lost(self):
+        from dynamo_tpu.runtime.request_plane import StreamLost
+
+        async def main():
+            client = _FakeClient([1])
+            router = PushRouter(client, RouterMode.ROUND_ROBIN)
+            with pytest.raises(StreamLost):
+                await router.generate(
+                    {"router": {"exclude_instances": [1]}}, Context()
+                )
+
+        asyncio.run(main())
+
+    def test_context_records_routed_instance(self):
+        async def main():
+            client = _FakeClient([5])
+            router = PushRouter(client, RouterMode.ROUND_ROBIN)
+            ctx = Context()
+            stream = await router.generate({}, ctx)
+            async for _ in stream:
+                pass
+            assert ctx.routed_instance == 5
+
+        asyncio.run(main())
+
+
+class TestKvRouterCorpseCleanup:
+    def _router(self, ids):
+        from dynamo_tpu.llm.kv_router import KvPushRouter
+        from dynamo_tpu.llm.kv_router.scheduler import KvRouterConfig
+
+        class _Drt:
+            discovery = None
+
+        client = _FakeClient(ids)
+        client.endpoint.component = type(
+            "C", (), {"namespace": "ns", "name": "c"}
+        )()
+        cfg = KvRouterConfig(use_kv_events=False, block_size=4)
+        return KvPushRouter(_Drt(), client, cfg, block_size=4), client
+
+    def test_note_stream_lost_suspends_and_forgets(self):
+        router, client = self._router([1, 2])
+        # seed prefix state for worker 1, then lose a stream on it
+        toks = list(range(16))
+        router.indexer.apply_routed_hashes(
+            __import__("dynamo_tpu.llm.tokens", fromlist=["compute_seq_hashes"])
+            .compute_seq_hashes(toks, 4), 1,
+        )
+        router.note_stream_lost(1)
+        w, overlap = router.find_best_match(toks)
+        assert w == 2  # suspect skipped even with (forgotten) best overlap
+        assert overlap == 0
+
+    def test_suspect_expires_back_into_rotation(self):
+        router, client = self._router([1])
+        router.note_stream_lost(1, ttl_s=0.05)
+        # sole instance: the all-suspect fallback still serves it
+        w, _ = router.find_best_match(list(range(8)))
+        assert w == 1
+        time.sleep(0.06)
+        assert router._live_suspects() == set()
+
+    def test_exclude_beats_suspect_fallback(self):
+        from dynamo_tpu.runtime.request_plane import StreamLost
+
+        router, client = self._router([1])
+        with pytest.raises(StreamLost):
+            router.find_best_match(list(range(8)), exclude={1})
+
+    def test_pinned_corpse_routes_as_unpinned(self):
+        # the pinned branch bypasses find_best_match: an excluded (dead)
+        # pin must not bypass the corpse-exclusion contract with it
+        router, client = self._router([1, 2])
+
+        async def main():
+            stream = await router.generate(
+                {"token_ids": list(range(8)), "request_id": "p",
+                 "router": {"backend_instance_id": 1,
+                            "exclude_instances": [1]}}, Context(),
+            )
+            async for _ in stream:
+                pass
+            assert client.dialed[-1] == 2
+
+        asyncio.run(main())
+
+    def test_holder_hint_never_names_excluded_corpse(self):
+        from dynamo_tpu.llm.tokens import compute_seq_hashes
+
+        router, client = self._router([1, 2])
+        toks = list(range(24))
+        hashes = compute_seq_hashes(toks, 4)
+        # worker 1 holds the WHOLE prefix in the index — exactly the
+        # state right after it died with the session's KV
+        router.indexer.apply_routed_hashes(hashes, 1)
+
+        async def main():
+            ctx = Context()
+            stream = await router.generate(
+                {"token_ids": toks, "request_id": "q",
+                 "router": {"exclude_instances": [1]}}, ctx,
+            )
+            async for _ in stream:
+                pass
+            assert client.dialed[-1] == 2
+            sent = client.requests[-1]
+            # without the avoid-filter the request would ship
+            # kv_holder={"instance": 1, ...} — pinning the onboard to
+            # the corpse
+            holder = sent.get("kv_holder") or {}
+            assert holder.get("instance") != 1, sent
+
+        asyncio.run(main())
+
+
+# --------------------------------------------------------------------------- #
+# worker.kill fault point (subprocess connector, no jax)
+# --------------------------------------------------------------------------- #
+
+
+def test_worker_kill_fault_point_kills_and_reconcile_respawns():
+    import sys
+
+    from dynamo_tpu.planner.connector import LocalProcessConnector
+
+    async def main():
+        conn = LocalProcessConnector(
+            prefill_cmd=[],
+            decode_cmd=[sys.executable, "-c", "import time; time.sleep(60)"],
+            grace_s=1.0,
+        )
+        try:
+            await conn.set_replicas(0, 1)
+            pid0 = conn.procs["decode"][0].pid
+            inj = faults.configure("worker.kill:kill,times=1")
+            try:
+                await conn.reconcile()
+            finally:
+                faults.reset()
+            assert inj.fired_log == [("worker.kill", "kill")]
+            # the corpse was SIGKILLed (returncode -9) and the SAME
+            # reconcile pass respawned the replica
+            assert conn.counts() == (0, 1)
+            assert conn.procs["decode"][0].pid != pid0
+        finally:
+            await conn.shutdown()
+
+    asyncio.run(main())
+
+
+def test_kill_one_no_live_replica_is_none():
+    from dynamo_tpu.planner.connector import LocalProcessConnector
+
+    async def main():
+        conn = LocalProcessConnector(prefill_cmd=[], decode_cmd=["true"])
+        assert await conn.kill_one() is None
+
+    asyncio.run(main())
+
+
+# --------------------------------------------------------------------------- #
+# engine arms (jax): incremental commit + checkpointed resume
+# --------------------------------------------------------------------------- #
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from dynamo_tpu.engine import EngineConfig, JaxEngine  # noqa: E402
+from dynamo_tpu.models import llama  # noqa: E402
+
+CFG = llama.LlamaConfig.tiny(dtype=jnp.float32)
+PAGE = 8
+PARAMS = llama.init_params(CFG, jax.random.PRNGKey(0))
+
+
+def make_engine(**over):
+    cfg = dict(
+        model="tiny", max_num_seqs=4, page_size=PAGE, num_pages=128,
+        max_model_len=512, prefill_buckets=(16, 32), max_prefill_chunk=32,
+    )
+    cfg.update(over)
+    return JaxEngine(EngineConfig(**cfg), model_config=CFG, params=PARAMS)
+
+
+def _prompt(i, n=32):
+    return [(11 + 17 * i + 3 * j) % 250 + 1 for j in range(n)]
+
+
+async def run_stream(engine, prompt, max_tokens, request_id,
+                     migration=0, exclude=None):
+    req = PreprocessedRequest(
+        token_ids=list(prompt),
+        stop_conditions={"max_tokens": max_tokens, "ignore_eos": True},
+        request_id=request_id, migration=migration,
+        router={"exclude_instances": exclude} if exclude else {},
+    ).to_dict()
+    toks = []
+    async for item in engine.generate(req, Context()):
+        data = item.get("data")
+        if data:
+            toks.extend(data["token_ids"])
+    return toks
+
+
+class TestIncrementalCommit:
+    def test_session_blocks_visible_mid_stream(self):
+        """A live session's generated blocks reach the KVBM tiers BEFORE
+        the stream finishes — the durability property a release-only
+        commit cannot provide."""
+
+        async def main():
+            eng = make_engine(kvbm_host_blocks=64)
+            try:
+                prompt = _prompt(0)
+                task = asyncio.create_task(
+                    run_stream(eng, prompt, 96, "live")
+                )
+                prompt_blocks = len(prompt) // PAGE
+                seen_mid_stream = 0
+                while not task.done():
+                    st = eng.kvbm.stats()
+                    # offloads strictly past the prompt prefix = generated
+                    # blocks committed while the session still decodes
+                    seen_mid_stream = max(
+                        seen_mid_stream,
+                        st.get("kvbm_offloaded_blocks", 0) - prompt_blocks,
+                    )
+                    await asyncio.sleep(0.005)
+                toks = await task
+                assert len(toks) == 96
+                assert seen_mid_stream >= 2, seen_mid_stream
+            finally:
+                await eng.close()
+
+        asyncio.run(main())
+
+    def test_incremental_vs_release_commit_byte_identical(self):
+        """The incremental arm must commit the SAME blocks and produce the
+        SAME stream as the release-commit arm (DYN_KV_INCREMENTAL_COMMIT=0
+        spelling via EngineConfig)."""
+
+        async def main():
+            out = {}
+            for arm, inc in (("incremental", True), ("release", False)):
+                eng = make_engine(kvbm_host_blocks=64, incremental_commit=inc)
+                try:
+                    toks = await run_stream(eng, _prompt(1), 64, f"p-{arm}")
+                    # let the offload pipeline drain before reading tiers
+                    for _ in range(200):
+                        if eng.kvbm.pending_offloads() == 0:
+                            break
+                        await asyncio.sleep(0.01)
+                    out[arm] = (toks, sorted(eng.kvbm.manager.all_hashes()))
+                finally:
+                    await eng.close()
+            toks_a, hashes_a = out["incremental"]
+            toks_b, hashes_b = out["release"]
+            assert toks_a == toks_b
+            assert hashes_a == hashes_b
+
+        asyncio.run(main())
+
+
+def _mesh_pair(checkpoint: str):
+    """Two KVBM engines on one discovery plane (test_kv_fabric shape),
+    with DYN_KV_CHECKPOINT resolved at mesh start."""
+    from dynamo_tpu.kvbm import KvbmDistributed
+    from dynamo_tpu.llm.kv_transfer import KvDataPlaneServer
+    from dynamo_tpu.runtime import DiscoveryServer, DistributedRuntime, RuntimeConfig
+
+    async def build():
+        os.environ["DYN_KV_CHECKPOINT"] = checkpoint
+        server = DiscoveryServer(port=0)
+        _, port = await server.start()
+        cfg = RuntimeConfig(discovery_endpoint=f"127.0.0.1:{port}")
+        drts, engines, dists, planes = [], [], [], []
+        try:
+            for _ in range(2):
+                drt = await DistributedRuntime.create(cfg)
+                eng = make_engine(kvbm_host_blocks=64)
+                dpl = KvDataPlaneServer()
+                await dpl.start()
+                await dpl.register(drt)
+                dist = KvbmDistributed(drt, eng.kvbm, dpl, "ns", "kvbm",
+                                       drt.instance_id)
+                await dist.start()
+                drts.append(drt)
+                engines.append(eng)
+                dists.append(dist)
+                planes.append(dpl)
+        finally:
+            os.environ.pop("DYN_KV_CHECKPOINT", None)
+        return server, drts, engines, dists, planes
+
+    return build
+
+
+async def _teardown_mesh(server, drts, engines, dists, planes):
+    for eng in engines:
+        await eng.close()
+    for d in dists:
+        await d.close()
+    for p in planes:
+        await p.close()
+    for drt in drts:
+        await drt.close()
+    await server.stop()
+
+
+async def _await_replication(plane, want_blocks, timeout=20.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if plane.checkpoint_blocks_received >= want_blocks:
+            return
+        await asyncio.sleep(0.02)
+    raise TimeoutError(
+        f"checkpoint replication stalled at {plane.checkpoint_blocks_received}"
+        f"/{want_blocks}"
+    )
+
+
+class TestCheckpointedResume:
+    def test_checkpoint_resume_is_tail_not_prefill(self):
+        """Deep session on A replicates to B; A dies; the migration-shaped
+        retry resumes on B byte-identically, classified as a CHECKPOINT
+        resume, re-prefilling less than two pages."""
+        build = _mesh_pair("256")
+
+        async def main():
+            server, drts, engines, dists, planes = await build()
+            eng_a, eng_b = engines
+            try:
+                prompt = _prompt(2)
+                toks = await run_stream(eng_a, prompt, 96, "deep")
+                total = len(prompt) + 96
+                await _await_replication(planes[1], total // PAGE - 1)
+
+                # kill A: mesh + data plane dark, lease lingers (corpse)
+                await eng_a.close()
+                await dists[0].close()
+                await planes[0].close()
+
+                cut = 48
+                cont = await run_stream(
+                    eng_b, list(prompt) + toks[:cut], 96 - cut, "deep-retry",
+                    migration=1, exclude=[drts[0].instance_id],
+                )
+                assert cont == toks[cut:], (cont, toks[cut:])
+                st = eng_b.stats()
+                assert st["migrations_resumed"] == 1
+                assert st["resume_source_checkpoint"] == 1, st
+                # a death costs a tail: at most the pending block + the
+                # skip-ahead recompute position, never the whole prefill
+                assert st["migration_replayed_tokens"] <= 2 * PAGE, st
+            finally:
+                await _teardown_mesh(server, drts[1:], engines[1:],
+                                     dists[1:], planes[1:])
+
+        asyncio.run(main())
+
+    def test_checkpoint_off_no_replication_and_recompute_resume(self):
+        """DYN_KV_CHECKPOINT=off compiles the path out: no pushes, no
+        checkpointer — and the same kill still resumes byte-identically
+        via full recompute (the pre-checkpoint behavior)."""
+        build = _mesh_pair("off")
+
+        async def main():
+            server, drts, engines, dists, planes = await build()
+            eng_a, eng_b = engines
+            try:
+                assert dists[0].checkpointer is None
+                prompt = _prompt(3)
+                toks = await run_stream(eng_a, prompt, 64, "nock")
+                # give any (buggy) replication a moment to show up
+                await asyncio.sleep(0.3)
+                assert planes[1].checkpoint_blocks_received == 0
+
+                await eng_a.close()
+                await dists[0].close()
+                await planes[0].close()
+
+                cut = 32
+                cont = await run_stream(
+                    eng_b, list(prompt) + toks[:cut], 64 - cut, "nock-retry",
+                    migration=1, exclude=[drts[0].instance_id],
+                )
+                assert cont == toks[cut:]
+                st = eng_b.stats()
+                assert st["migrations_resumed"] == 1
+                assert st["resume_source_checkpoint"] == 0
+                # the un-replicated death pays the full prefill
+                assert st["migration_replayed_tokens"] >= len(prompt)
+            finally:
+                await _teardown_mesh(server, drts[1:], engines[1:],
+                                     dists[1:], planes[1:])
+
+        asyncio.run(main())
+
+    def test_mixed_precision_checkpoint_refused_typed(self):
+        """A quantized worker pushing into an fp peer is refused BEFORE
+        any byte is interpreted: typed KvFormatError on the pusher,
+        nothing stored — and the keep-alive connection stays framed (a
+        well-formatted push right after succeeds)."""
+        import numpy as np
+
+        from dynamo_tpu.llm.kv_transfer import (
+            KvDataPlaneServer,
+            KvFormatError,
+            push_checkpoint_blocks,
+        )
+
+        async def main():
+            plane = KvDataPlaneServer(host="127.0.0.1")
+            await plane.start()
+            stored = []
+
+            class _Src:
+                kv_format = "none"
+                dtype = "float32"
+                block_shape = (2, PAGE, 1, 4)
+                disk = None
+
+                def store(self, h, k, v, parent=None):
+                    stored.append((h, parent))
+
+            plane.kvbm_source = _Src()
+            try:
+                k = np.zeros((1, 2, PAGE, 1, 4), np.float32)
+                with pytest.raises(KvFormatError):
+                    await push_checkpoint_blocks(
+                        plane.addr, [1], [None], k, k, kv_format="int8",
+                    )
+                assert plane.checkpoint_blocks_received == 0
+                assert not stored
+                n = await push_checkpoint_blocks(
+                    plane.addr, [2], [7], k, k, kv_format="none",
+                )
+                assert n == 1
+                assert stored == [(2, 7)]
+                assert plane.checkpoint_blocks_received == 1
+            finally:
+                await plane.close()
+
+        asyncio.run(main())
+
+    def test_checkpoint_sever_fault_drops_batch_quarantines_peer(self):
+        """kv_transfer.checkpoint sever: the push dies, the batch is
+        dropped + counted, the peer quarantined — the serving stream
+        never notices."""
+        build = _mesh_pair("256")
+
+        async def main():
+            server, drts, engines, dists, planes = await build()
+            eng_a = engines[0]
+            inj = faults.configure("kv_transfer.checkpoint:sever,times=1")
+            try:
+                toks = await run_stream(eng_a, _prompt(4), 48, "sev")
+                assert len(toks) == 48  # stream unaffected
+                ck = dists[0].checkpointer
+                deadline = time.monotonic() + 10.0
+                while time.monotonic() < deadline and ck.push_failures == 0:
+                    await asyncio.sleep(0.02)
+                assert ck.push_failures >= 1
+                assert ck.blocks_dropped >= 1
+                assert ("kv_transfer.checkpoint", "sever") in inj.fired_log
+            finally:
+                faults.reset()
+                await _teardown_mesh(server, drts, engines, dists, planes)
+
+        asyncio.run(main())
+
+
+# --------------------------------------------------------------------------- #
+# determinism: migrated continuation == uninterrupted stream
+# --------------------------------------------------------------------------- #
+
+
+class TestMigrationDeterminism:
+    @pytest.mark.parametrize("sampling", [
+        {},  # greedy
+        {"temperature": 0.8, "top_k": 8, "seed": 1234},  # seeded sampled
+    ])
+    def test_migrated_continuation_byte_identical(self, sampling):
+        """The (seed, position) sampling contract must survive the
+        prompt-append retry: position is the absolute sequence index, so
+        the survivor's draws (and penalties window, and min_tokens floor)
+        reproduce the uninterrupted stream exactly."""
+
+        async def main():
+            eng = make_engine()
+            try:
+                prompt = _prompt(5)
+                req = PreprocessedRequest(
+                    token_ids=prompt,
+                    stop_conditions={"max_tokens": 48, "ignore_eos": True,
+                                     "min_tokens": 40},
+                    sampling_options=dict(sampling),
+                    request_id="det",
+                ).to_dict()
+                full = []
+                async for item in eng.generate(req, Context()):
+                    data = item.get("data")
+                    if data:
+                        full.extend(data["token_ids"])
+                assert len(full) == 48
+                for cut in (7, 24, 41):
+                    retry = PreprocessedRequest(
+                        token_ids=prompt + full[:cut],
+                        stop_conditions={"max_tokens": 48 - cut,
+                                         "ignore_eos": True,
+                                         "min_tokens": max(40 - cut, 0)},
+                        sampling_options=dict(sampling),
+                        request_id=f"det-r{cut}", migration=1,
+                    ).to_dict()
+                    cont = []
+                    async for item in eng.generate(retry, Context()):
+                        data = item.get("data")
+                        if data:
+                            cont.extend(data["token_ids"])
+                    assert cont == full[cut:], (cut, cont[:8], full[cut:cut + 8])
+            finally:
+                await eng.close()
+
+        asyncio.run(main())
+
+
+# --------------------------------------------------------------------------- #
+# kill-mid-decode, end to end (the CI chaos arm): frontend pipeline with
+# Migration + PushRouter over two request-plane workers; worker A is
+# hard-killed mid-decode (listener + streams torn down, lease LINGERS —
+# a true corpse) and every stream must complete byte-identically with
+# checkpoint-assisted resumes counted on the survivor.
+# --------------------------------------------------------------------------- #
+
+
+class _RouterEngine:
+    def __init__(self, router):
+        self.router = router
+
+    async def generate(self, request, context):
+        stream = await self.router.generate(request.to_dict(), context)
+        async for item in stream:
+            yield item
+
+
+def test_kill_mid_decode_streams_survive_checkpoint_resume():
+    from dynamo_tpu.kvbm import KvbmDistributed
+    from dynamo_tpu.llm.kv_transfer import KvDataPlaneServer
+    from dynamo_tpu.runtime import DiscoveryServer, DistributedRuntime, RuntimeConfig
+
+    n_streams, n_tokens, prompt_len = 3, 160, 32
+
+    async def main():
+        os.environ["DYN_KV_CHECKPOINT"] = "512"
+        server = DiscoveryServer(port=0)
+        _, port = await server.start()
+        cfg = RuntimeConfig(discovery_endpoint=f"127.0.0.1:{port}")
+        cfg.graceful_shutdown_timeout = 2.0
+        drts, engines, dists, planes = [], [], [], []
+        b_requests = []
+        try:
+            for i in range(2):
+                drt = await DistributedRuntime.create(cfg)
+                eng = make_engine(kvbm_host_blocks=128, num_pages=256,
+                                  max_model_len=256)
+                dpl = KvDataPlaneServer()
+                await dpl.start()
+                await dpl.register(drt)
+                dist = KvbmDistributed(drt, eng.kvbm, dpl, "ns", "bk",
+                                       drt.instance_id)
+                await dist.start()
+
+                def mk_handler(engine, sink):
+                    async def handler(request, context):
+                        if sink is not None:
+                            sink.append(dict(request))
+                        async for item in engine.generate(request, context):
+                            yield item
+                    return handler
+
+                await drt.namespace("ns").component("bk").endpoint(
+                    "gen"
+                ).serve_endpoint(mk_handler(eng, b_requests if i == 1 else None))
+                drts.append(drt)
+                engines.append(eng)
+                dists.append(dist)
+                planes.append(dpl)
+        finally:
+            os.environ.pop("DYN_KV_CHECKPOINT", None)
+
+        eng_a, eng_b = engines
+        inst_a = drts[0].instance_id
+        fe = await DistributedRuntime.create(cfg)
+        client = await fe.namespace("ns").component("bk").endpoint("gen").client()
+        await client.wait_for_instances()
+
+        # pin NEW streams to A (prefer hook) so the kill has victims;
+        # migration retries exclude A and land on B
+        router = PushRouter(
+            client, RouterMode.ROUND_ROBIN,
+            prefer=lambda ids: [inst_a] if inst_a in ids else ids,
+        )
+        mig_engine = Migration(_RouterEngine(router), migration_limit=3)
+
+        # oracle: uninterrupted greedy streams on a pristine engine
+        oracle = make_engine(num_pages=256, max_model_len=256)
+        prompts = [_prompt(10 + i, prompt_len) for i in range(n_streams)]
+        want = [
+            await run_stream(oracle, p, n_tokens, f"oracle-{i}")
+            for i, p in enumerate(prompts)
+        ]
+        await oracle.close()
+
+        mig_before = MIGRATION_METRICS.migrations
+
+        async def drive(i):
+            req = PreprocessedRequest(
+                token_ids=list(prompts[i]),
+                stop_conditions={"max_tokens": n_tokens, "ignore_eos": True},
+                request_id=f"s{i}",
+            )
+            toks, err = [], None
+            async for ann in mig_engine.generate(req, Context()):
+                if ann.is_error():
+                    err = (ann.comment or ["err"])[0]
+                elif ann.data:
+                    toks.extend(ann.data.get("token_ids", []))
+            return toks, err
+
+        tasks = [asyncio.create_task(drive(i)) for i in range(n_streams)]
+
+        # wait until the sessions are mid-decode AND some of their blocks
+        # have replicated to B, then hard-kill A: listener + active
+        # streams die, the lease LINGERS (true corpse semantics)
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if planes[1].checkpoint_blocks_received >= n_streams * 6:
+                break
+            await asyncio.sleep(0.02)
+        assert planes[1].checkpoint_blocks_received >= n_streams * 6, (
+            planes[1].checkpoint_blocks_received
+        )
+        await drts[0].server.stop()
+        await dists[0].close()
+        await planes[0].close()
+
+        results = await asyncio.gather(*tasks)
+        for i, (toks, err) in enumerate(results):
+            assert err is None, (i, err)
+            # zero lost, zero duplicated, byte-identical continuation
+            assert toks == want[i], (
+                i, len(toks), len(want[i]),
+                toks[:8], want[i][:8],
+            )
+
+        st = eng_b.stats()
+        assert st["migrations_resumed"] >= n_streams
+        assert st["resume_source_checkpoint"] >= 1, st
+        assert MIGRATION_METRICS.migrations > mig_before
+        # every retry B saw named the corpse in its exclusions
+        retries = [r for r in b_requests if r.get("migration")]
+        assert retries, "survivor saw no migration retries"
+        for r in retries:
+            assert inst_a in (r.get("router") or {}).get(
+                "exclude_instances", []
+            ), r.get("router")
+
+        await client.close()
+        await fe.close()
+        await eng_a.close()
+        await eng_b.close()
+        await dists[1].close()
+        await planes[1].close()
+        for drt in drts:
+            await drt.close()
+        await server.stop()
+
+    asyncio.run(main())
+
+
+# --------------------------------------------------------------------------- #
+# real-subprocess SIGKILL soak (slow): mocker pool under load, worker.kill
+# fires through reconcile, streams stay contiguous, fleet heals
+# --------------------------------------------------------------------------- #
+
+
+@pytest.mark.slow
+def test_sigkill_subprocess_soak_contiguous_and_respawned():
+    import aiohttp
+
+    from dynamo_tpu.planner.connector import (
+        DiscoveryWorkerCounts,
+        LocalProcessConnector,
+    )
+    from dynamo_tpu.planner.soak import (
+        RampLoad,
+        RampPhase,
+        SoakFrontend,
+        contiguity_report,
+        mocker_cmd,
+    )
+
+    async def main():
+        fe = await SoakFrontend().start()
+        disc_ep = fe.cfg.discovery_endpoint
+        env = dict(os.environ)
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env["PYTHONPATH"] = repo + (
+            ":" + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+        env["JAX_PLATFORMS"] = "cpu"
+        env["DYN_DISCOVERY_ENDPOINT"] = disc_ep
+        counts = DiscoveryWorkerCounts(fe.drt.discovery,
+                                       decode_component="mocker")
+        conn = LocalProcessConnector(
+            prefill_cmd=[],
+            decode_cmd=mocker_cmd(disc_ep, speedup_ratio=2.0,
+                                  extra=["--max-num-seqs", "64"]),
+            env=env, grace_s=10.0, ready_fn=counts.ready_fn(),
+            ready_timeout=60.0,
+        )
+        try:
+            await conn.set_replicas(0, 2)
+            await fe.wait_model("mock-model")
+
+            load = RampLoad(fe.base_url, "mock-model", [
+                RampPhase(qps=3, duration_s=8, label="steady"),
+            ], osl_tokens=40, seed=7)
+            load_task = asyncio.create_task(load.run())
+            await asyncio.sleep(2.0)
+
+            # the worker.kill fault point SIGKILLs a live replica (no
+            # drain) on the planner's reconcile tick
+            inj = faults.configure("worker.kill:kill,times=1")
+            try:
+                await conn.reconcile()
+            finally:
+                faults.reset()
+            assert ("worker.kill", "kill") in inj.fired_log
+
+            records = await load_task
+            problems = contiguity_report(records)
+            assert not problems, problems
+            assert all(r.ok for r in records), [r.error for r in records]
+
+            # the same reconcile respawned the corpse; capacity heals
+            deadline = time.monotonic() + 60
+            while (await counts.count())[1] != 2 and \
+                    time.monotonic() < deadline:
+                await asyncio.sleep(0.2)
+            assert (await counts.count())[1] == 2
+
+            # frontend /metrics shows what the death cost
+            async with aiohttp.ClientSession() as s:
+                async with s.get(fe.metrics_url) as resp:
+                    body = await resp.text()
+            assert "dynamo_frontend_migrations_total" in body
+        finally:
+            await conn.shutdown()
+            await fe.stop()
+
+    asyncio.run(main())
