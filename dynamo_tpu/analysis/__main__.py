@@ -11,6 +11,7 @@
     python -m dynamo_tpu.analysis --list-rules
     python -m dynamo_tpu.analysis --emit-env-docs docs/configuration.md
     python -m dynamo_tpu.analysis --emit-sync-docs     # docs/concurrency.md
+    python -m dynamo_tpu.analysis --emit-metrics-docs  # docs/observability.md
 
 Exit status: 0 clean, 1 violations found, 2 usage error.
 """
@@ -177,6 +178,61 @@ def emit_sync_docs(root: Path, target: Path) -> str:
     )
 
 
+#: markers delimiting the generated block in docs/observability.md
+METRICS_BEGIN = (
+    "<!-- METRICS:BEGIN — generated from runtime/metrics.py:"
+    "METRICS; regenerate: python -m dynamo_tpu.analysis"
+    " --emit-metrics-docs -->"
+)
+METRICS_END = "<!-- METRICS:END -->"
+
+_FLAG_DOC = {
+    "wire": "wire",
+    "export": "export",
+    "dynamic": "dynamic",
+}
+
+
+def render_metrics_table(root: Path) -> str:
+    """Render runtime/metrics.py's METRICS as a markdown table (parsed
+    from the AST via the met pack's loader, never imported — same
+    contract as the fault and sync tables)."""
+    from .core import SourceFile
+    from .met.registry import METRICS_MODULE, load_metrics_registry
+
+    project = Project(root, [SourceFile(root, root / METRICS_MODULE)])
+    entries, _, err = load_metrics_registry(project)
+    if err is not None:
+        raise SystemExit(f"error: {err}")
+    lines = [
+        "| Metric | Kind | Layer | Unit | Labels | Flags | Description |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for name, spec in entries.items():  # registry order is the doc order
+        labels = ", ".join(
+            f"`{label}`" for label in spec.get("labels", ()) or ()
+        ) or "—"
+        flags = ", ".join(
+            doc for flag, doc in _FLAG_DOC.items() if spec.get(flag)
+        ) or "—"
+        unit = spec.get("unit") or "—"
+        help_text = spec.get("help", "").replace("|", chr(92) + "|")
+        lines.append(
+            f"| `{name}` | {spec['kind']} | {spec.get('layer', '—')} "
+            f"| {unit} | {labels} | {flags} | {help_text} |"
+        )
+    return "\n".join(lines)
+
+
+def emit_metrics_docs(root: Path, target: Path) -> str:
+    """Splice the generated metrics table between the METRICS markers of
+    `target` (docs/observability.md) and return the new content."""
+    return splice_generated(
+        target.read_text(), METRICS_BEGIN, METRICS_END,
+        render_metrics_table(root), target, "METRICS",
+    )
+
+
 def changed_files(root: Path, base: str) -> Optional[List[str]]:
     """Repo-relative .py paths under dynamo_tpu/ that differ from `base`
     (committed diff + working tree + untracked). None when git is
@@ -257,6 +313,13 @@ def main(argv=None) -> int:
         "markers of PATH (default docs/concurrency.md; '-' = print the "
         "table) from runtime/sync.py GUARDED_STATE, and exit",
     )
+    parser.add_argument(
+        "--emit-metrics-docs", nargs="?", const="docs/observability.md",
+        metavar="PATH",
+        help="regenerate the metrics table between the METRICS markers of "
+        "PATH (default docs/observability.md; '-' = print the table) from "
+        "runtime/metrics.py METRICS, and exit",
+    )
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -299,6 +362,17 @@ def main(argv=None) -> int:
             if not target.is_absolute() and not target.exists():
                 target = root / args.emit_sync_docs
             target.write_text(emit_sync_docs(root, target))
+            print(f"wrote {target}")
+        return 0
+
+    if args.emit_metrics_docs is not None:
+        if args.emit_metrics_docs == "-":
+            sys.stdout.write(render_metrics_table(root) + "\n")
+        else:
+            target = Path(args.emit_metrics_docs)
+            if not target.is_absolute() and not target.exists():
+                target = root / args.emit_metrics_docs
+            target.write_text(emit_metrics_docs(root, target))
             print(f"wrote {target}")
         return 0
 

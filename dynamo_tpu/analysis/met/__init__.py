@@ -1,0 +1,34 @@
+"""dynomet: the observability contract pack.
+
+Four rules anchored to `runtime/metrics.py:METRICS` (AST-parsed, never
+imported): met-registry (every emission site resolves into the
+registry, no dead entries), met-consume-symmetry (cross-process reads
+resolve, wire-crossing keys have both ends), met-kind-discipline
+(counters only increment, TYPE/constructor kinds and buckets match,
+_total naming), met-label-cardinality (declared label names only,
+bound+escaped label values). See docs/static_analysis.md and
+docs/observability.md.
+"""
+
+from .emission import MetRegistryRule
+from .kind import MetKindDisciplineRule
+from .labels import MetLabelCardinalityRule
+from .registry import METRICS_MODULE, load_metrics_registry
+from .symmetry import MetConsumeSymmetryRule
+
+MET_RULES = (
+    MetRegistryRule,
+    MetConsumeSymmetryRule,
+    MetKindDisciplineRule,
+    MetLabelCardinalityRule,
+)
+
+__all__ = [
+    "MET_RULES",
+    "METRICS_MODULE",
+    "MetConsumeSymmetryRule",
+    "MetKindDisciplineRule",
+    "MetLabelCardinalityRule",
+    "MetRegistryRule",
+    "load_metrics_registry",
+]

@@ -1,0 +1,110 @@
+"""Rule: met-registry — every metric emission resolves into METRICS.
+
+The ENV_REGISTRY pattern applied to the observability surface: a
+stats()-dict key, a hand-assembled exposition family, or a
+prometheus_client constructor that spells a name the registry does not
+know is a contract violation at the emission site — and a registry
+entry that no producer emits and no consumer reads is dead weight and
+fires at its registry line (entries marked `dynamic: True` are excused:
+their producers are f-strings the analyzer cannot read).
+
+Under-approximation: emission sites the resolver cannot read (f-string
+keys, loop variables) never fire — they are recorded as dynamic sites
+and the known-limits section of docs/static_analysis.md counts them.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Set, Tuple
+
+from ..core import Project, Rule, Violation
+from ..shard.callgraph import FunctionIndex
+from .registry import METRICS_MODULE, load_metrics_registry, strip_series_suffix
+from .scan import MetScan, build_scan
+
+
+def _consumed(scan: MetScan, name: str) -> bool:
+    if name in scan.consumers:
+        return True
+    return any(
+        name + sfx in scan.consumers for sfx in ("_sum", "_count", "_bucket")
+    )
+
+
+class MetRegistryRule(Rule):
+    name = "met-registry"
+    description = (
+        "every metric emission site — stats() dict keys, hand-assembled "
+        "exposition families, prometheus_client constructors — resolves "
+        "into runtime/metrics.py METRICS, and no registry entry is dead "
+        "weight"
+    )
+
+    def check(self, project: Project) -> Iterator[Violation]:
+        entries, reg_lines, err = load_metrics_registry(project)
+        if err is not None:
+            yield Violation(
+                rule=self.name, path=METRICS_MODULE, line=1, message=err
+            )
+            return
+        index = FunctionIndex(project)
+        scan = build_scan(project, index)
+        seen: Set[Tuple[str, int, str]] = set()
+
+        def fire(path: str, line: int, msg: str):
+            key = (path, line, msg)
+            if key in seen:
+                return None
+            seen.add(key)
+            return Violation(rule=self.name, path=path, line=line, message=msg)
+
+        for key, sites in sorted(scan.stat_producers.items()):
+            if key in entries:
+                continue
+            for path, line in sites:
+                v = fire(
+                    path, line,
+                    f"stats() emits unregistered metric key '{key}' — "
+                    f"register it in METRICS ({METRICS_MODULE}) or rename "
+                    "it to a registered key",
+                )
+                if v:
+                    yield v
+        for name in sorted(scan.expo_names()):
+            if strip_series_suffix(name, entries) is not None:
+                continue
+            sites = (
+                [s for s, _ in scan.expo_types.get(name, [])]
+                + [s.site for s in scan.expo_samples.get(name, [])]
+                + [c.site for c in scan.ctors.get(name, [])]
+            )
+            for path, line in sorted(set(sites)):
+                v = fire(
+                    path, line,
+                    f"exposition publishes unregistered metric family "
+                    f"'{name}' — register it in METRICS "
+                    f"({METRICS_MODULE})",
+                )
+                if v:
+                    yield v
+        expo_families = {
+            strip_series_suffix(n, entries) for n in scan.expo_names()
+        }
+        for name, spec in entries.items():
+            if spec.get("dynamic"):
+                continue
+            produced = (
+                name in scan.stat_producers or name in expo_families
+            )
+            if produced or _consumed(scan, name):
+                continue
+            yield Violation(
+                rule=self.name,
+                path=METRICS_MODULE,
+                line=reg_lines.get(name, 1),
+                message=(
+                    f"METRICS entry '{name}' is emitted nowhere and "
+                    "consumed nowhere — dead registry weight (remove it, "
+                    "or wire it up)"
+                ),
+            )

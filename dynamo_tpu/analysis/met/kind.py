@@ -1,0 +1,218 @@
+"""Rule: met-kind-discipline — a metric's registered kind is enforced.
+
+Counters only count: once a registry COUNTER's backing attribute
+(`self.admitted_total` behind a stats()-dict value or an exposition
+sample) is plainly REASSIGNED outside `__init__`/`__post_init__`/
+`reset*()`, every consumer differencing it across scrapes reads a
+negative rate — so assignment fires at the assignment line while `+=`
+stays legal anywhere. The exposition side must agree with the registry
+too: a `# TYPE` declaration or prometheus_client constructor whose kind
+differs from METRICS fires, exposition names ending `_total` must be
+registered counters and registered counters exposed under any name must
+end `_total` (the prometheus naming contract scrape pipelines assume),
+histogram constructors must declare exactly the registry's buckets, and
+`export: True` requires a scalar kind (the jax_worker gauge loop calls
+float() on the value — an info string or histogram blob would export
+garbage).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Set, Tuple
+
+from ..core import Project, Rule, SourceFile, Violation
+from ..shard.callgraph import FunctionIndex, _walk_with_chain
+from .registry import METRICS_MODULE, load_metrics_registry, strip_series_suffix
+from .scan import build_scan
+
+#: scopes where a counter backing may legally be (re)set
+_RESET_SCOPES = ("__init__", "__post_init__")
+
+
+class MetKindDisciplineRule(Rule):
+    name = "met-kind-discipline"
+    description = (
+        "registered counters only increment (no reassignment outside "
+        "__init__/reset), exposition TYPE lines and prometheus_client "
+        "constructors match the registered kind, _total names are "
+        "counters and vice versa, histogram buckets match the registry, "
+        "and exported stats are scalar"
+    )
+
+    def check(self, project: Project) -> Iterator[Violation]:
+        entries, reg_lines, err = load_metrics_registry(project)
+        if err is not None:
+            yield Violation(
+                rule=self.name, path=METRICS_MODULE, line=1, message=err
+            )
+            return
+        index = FunctionIndex(project)
+        scan = build_scan(project, index)
+
+        # exposition-sample values also back metrics (the gate renders
+        # `{self.admitted_total}` straight into a counter sample)
+        backings: Dict[Tuple[str, str], Set[str]] = {}
+        for name, attrs in scan.backings.items():
+            if entries.get(name, {}).get("kind") != "counter":
+                continue
+            for rel_attr in attrs:
+                backings.setdefault(rel_attr, set()).add(name)
+        for name, samples in scan.expo_samples.items():
+            family = strip_series_suffix(name, entries)
+            if entries.get(family, {}).get("kind") != "counter":
+                continue
+            for s in samples:
+                attr = _value_attr(s.value_expr)
+                if attr is not None:
+                    backings.setdefault((s.site[0], attr), set()).add(family)
+
+        for src in project.files:
+            if src.rel == METRICS_MODULE:
+                continue
+            yield from self._check_backing_assigns(src, backings)
+
+        for name, decls in sorted(scan.expo_types.items()):
+            family = strip_series_suffix(name, entries)
+            if family is None:
+                continue  # met-registry already owns unregistered names
+            kind = entries[family]["kind"]
+            for (path, line), declared in decls:
+                if declared != kind:
+                    yield Violation(
+                        rule=self.name, path=path, line=line,
+                        message=(
+                            f"# TYPE declares '{name}' as {declared} but "
+                            f"METRICS registers it as {kind} — scrape "
+                            "pipelines trust the TYPE line"
+                        ),
+                    )
+        for name, ctors in sorted(scan.ctors.items()):
+            family = strip_series_suffix(name, entries)
+            if family is None:
+                continue
+            spec = entries[family]
+            for c in ctors:
+                if c.kind != spec["kind"]:
+                    yield Violation(
+                        rule=self.name, path=c.site[0], line=c.site[1],
+                        message=(
+                            f"'{name}' is constructed as a {c.kind} but "
+                            f"METRICS registers it as {spec['kind']}"
+                        ),
+                    )
+                reg_buckets = spec.get("buckets")
+                if spec["kind"] == "histogram" and c.kind == "histogram":
+                    got = c.buckets
+                    want = (
+                        tuple(float(b) for b in reg_buckets)
+                        if reg_buckets else None
+                    )
+                    if got != want:
+                        yield Violation(
+                            rule=self.name, path=c.site[0], line=c.site[1],
+                            message=(
+                                f"histogram '{name}' buckets {_fmt(got)} "
+                                f"differ from the registry's {_fmt(want)} "
+                                "— dashboards and the planner's averages "
+                                "assume the registered bounds"
+                            ),
+                        )
+
+        # the prometheus naming contract, on every exposed family
+        for name in sorted(scan.expo_names()):
+            family = strip_series_suffix(name, entries)
+            if family is None or family != name:
+                continue  # series suffixes (_bucket/_sum/_count) are exempt
+            kind = entries[name]["kind"]
+            sites = (
+                [s for s, _ in scan.expo_types.get(name, [])]
+                + [s.site for s in scan.expo_samples.get(name, [])]
+                + [c.site for c in scan.ctors.get(name, [])]
+            )
+            path, line = sorted(set(sites))[0]
+            if name.endswith("_total") and kind != "counter":
+                yield Violation(
+                    rule=self.name, path=path, line=line,
+                    message=(
+                        f"exposed metric '{name}' ends in _total but "
+                        f"METRICS registers it as a {kind} — _total is "
+                        "the counter suffix"
+                    ),
+                )
+            elif kind == "counter" and not name.endswith("_total"):
+                yield Violation(
+                    rule=self.name, path=path, line=line,
+                    message=(
+                        f"exposed counter '{name}' does not end in _total "
+                        "— scrape pipelines use the suffix to pick "
+                        "rate() over last-value"
+                    ),
+                )
+
+        for name, spec in entries.items():
+            if spec.get("export") and spec["kind"] not in ("counter", "gauge"):
+                yield Violation(
+                    rule=self.name,
+                    path=METRICS_MODULE,
+                    line=reg_lines.get(name, 1),
+                    message=(
+                        f"METRICS entry '{name}' sets export=True but its "
+                        f"kind is {spec['kind']} — the jax_worker gauge "
+                        "loop float()s the value, so only scalar "
+                        "counters/gauges can be exported"
+                    ),
+                )
+
+    def _check_backing_assigns(
+        self, src: SourceFile, backings: Dict[Tuple[str, str], Set[str]]
+    ) -> Iterator[Violation]:
+        for node, chain in _walk_with_chain(src.tree):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            targets = (
+                node.targets if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            scope = ""
+            for f in reversed(chain):
+                if isinstance(f, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    scope = f.name
+                    break
+            if scope in _RESET_SCOPES or scope.startswith("reset"):
+                continue
+            for tgt in targets:
+                if not (
+                    isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"
+                ):
+                    continue
+                names = backings.get((src.rel, tgt.attr))
+                if not names:
+                    continue
+                metric = sorted(names)[0]
+                yield Violation(
+                    rule=self.name, path=src.rel, line=node.lineno,
+                    message=(
+                        f"registered counter '{metric}' backing attribute "
+                        f"self.{tgt.attr} is REASSIGNED here — counters "
+                        "only increment (+=) outside __init__/reset*, or "
+                        "every consumer differencing scrapes reads a "
+                        "negative rate"
+                    ),
+                )
+
+
+def _value_attr(expr) -> "str | None":
+    from .scan import _self_attr
+
+    if expr is None:
+        return None
+    return _self_attr(expr)
+
+
+def _fmt(buckets) -> str:
+    if buckets is None:
+        return "(none)"
+    return "(" + ", ".join(f"{b:g}" for b in buckets) + ")"

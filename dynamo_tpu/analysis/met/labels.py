@@ -1,0 +1,98 @@
+"""Rule: met-label-cardinality — exposition labels stay bounded + escaped.
+
+RTP-LLM's production lesson: label CARDINALITY is capacity. A label
+value interpolated from a client-controlled string (the tenant header)
+without a bound+escape pass grows the scrape payload without limit and
+can break the exposition line format outright (a `"` or newline in the
+value). This rule pins every labeled exposition to the registry:
+
+  * prometheus_client constructors must declare exactly the label names
+    METRICS registers for the family (order included — `.labels()` is
+    positional);
+  * every label NAME on a hand-assembled sample must be registered for
+    its family (`le` is allowed on `_bucket` series);
+  * every label VALUE interpolated into a hand-assembled sample must be
+    a static literal or a bare `_prom_label(...)` call — the PR-12
+    bound+escape helper that truncates and escapes; anything else (a
+    raw f-string field, an expression wrapped around the helper) fires.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from ..core import Project, Rule, Violation
+from ..shard.callgraph import FunctionIndex
+from .registry import METRICS_MODULE, load_metrics_registry, strip_series_suffix
+from .scan import build_scan
+
+
+class MetLabelCardinalityRule(Rule):
+    name = "met-label-cardinality"
+    description = (
+        "exposition label names match the registry's declared labels, "
+        "and every interpolated label value goes through the "
+        "_prom_label bound+escape helper or is a static literal"
+    )
+
+    def check(self, project: Project) -> Iterator[Violation]:
+        entries, _reg_lines, err = load_metrics_registry(project)
+        if err is not None:
+            yield Violation(
+                rule=self.name, path=METRICS_MODULE, line=1, message=err
+            )
+            return
+        index = FunctionIndex(project)
+        scan = build_scan(project, index)
+
+        for name, ctors in sorted(scan.ctors.items()):
+            family = strip_series_suffix(name, entries)
+            if family is None:
+                continue  # met-registry owns unregistered names
+            declared = tuple(entries[family].get("labels", ()) or ())
+            for c in ctors:
+                if c.labelnames is None:
+                    continue  # unresolvable labelnames: stay quiet
+                if tuple(c.labelnames) != declared:
+                    yield Violation(
+                        rule=self.name, path=c.site[0], line=c.site[1],
+                        message=(
+                            f"'{name}' is constructed with labels "
+                            f"{list(c.labelnames)} but METRICS declares "
+                            f"{list(declared)} — label sets (and order: "
+                            ".labels() is positional) are part of the "
+                            "contract"
+                        ),
+                    )
+
+        for name, samples in sorted(scan.expo_samples.items()):
+            family = strip_series_suffix(name, entries)
+            if family is None:
+                continue
+            declared = set(entries[family].get("labels", ()) or ())
+            if name.endswith("_bucket"):
+                declared = declared | {"le"}
+            for s in samples:
+                for label in s.labels:
+                    if label.name not in declared:
+                        yield Violation(
+                            rule=self.name, path=s.site[0], line=s.site[1],
+                            message=(
+                                f"sample for '{name}' carries label "
+                                f"'{label.name}' that METRICS does not "
+                                f"declare for '{family}' — undeclared "
+                                "labels are unbounded cardinality"
+                            ),
+                        )
+                    if not label.safe:
+                        yield Violation(
+                            rule=self.name, path=s.site[0], line=s.site[1],
+                            message=(
+                                f"label '{label.name}' on '{name}' "
+                                "interpolates a value without the "
+                                "_prom_label bound+escape helper — a raw "
+                                "string in a label value can break the "
+                                "exposition format and explode "
+                                "cardinality"
+                            ),
+                        )

@@ -1,0 +1,126 @@
+"""METRICS registry extraction (AST of runtime/metrics.py, never imported).
+
+The observability contract lives in `runtime/metrics.py:METRICS`: one
+entry per metric key the package emits — stats()-dict keys published on
+the kv_metrics topic, prometheus families minted by the frontend, and
+the hand-assembled exposition lines. The met rules parse the dict out of
+the AST (same contract as KNOWN_AXES / FRAME_TAGS: the checker must run
+on hosts without the runtime importable), so every registry VALUE must
+stay a pure literal — `ast.literal_eval`-able — and every KEY must be a
+string literal or a same-module string constant (`SCHED_EST_TTFT_MS`).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Optional, Tuple
+
+from ..core import Project, str_const
+
+METRICS_MODULE = "dynamo_tpu/runtime/metrics.py"
+
+VALID_KINDS = {"counter", "gauge", "histogram", "info"}
+VALID_LAYERS = {
+    "engine", "worker", "frontend", "kvbm", "router", "sched", "planner",
+    "gate",
+}
+
+
+def load_metrics_registry(
+    project: Project,
+) -> Tuple[Optional[Dict[str, dict]], Optional[Dict[str, int]], Optional[str]]:
+    """Parse METRICS out of runtime/metrics.py.
+
+    Returns (entries, lines, error): entries maps metric name -> spec
+    dict (kind/layer/unit/help/labels/wire/export/dynamic/buckets);
+    lines maps metric name -> registry line for anchoring stale-entry
+    and no-producer findings; error is a human message when the registry
+    is missing or malformed (reported as a violation, mirroring
+    KNOWN_AXES / FRAME_TAGS).
+    """
+    src = project.get(METRICS_MODULE)
+    if src is None:
+        return None, None, (
+            f"{METRICS_MODULE} not found: the metrics registry is gone"
+        )
+    consts: Dict[str, str] = {}
+    table: Optional[ast.Dict] = None
+    for node in src.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt = node.targets[0]
+            if not isinstance(tgt, ast.Name):
+                continue
+            if isinstance(node.value, ast.Constant) and isinstance(
+                node.value.value, str
+            ):
+                consts[tgt.id] = node.value.value
+            elif tgt.id == "METRICS" and isinstance(node.value, ast.Dict):
+                table = node.value
+    if table is None:
+        return None, None, (
+            f"{METRICS_MODULE} defines no METRICS dict literal — the met "
+            "rules need the metrics registry as their source of truth"
+        )
+    entries: Dict[str, dict] = {}
+    lines: Dict[str, int] = {}
+    for k, v in zip(table.keys, table.values):
+        if k is None:
+            return None, None, (
+                f"{METRICS_MODULE}: METRICS must not use ** merges — every "
+                "entry must be spelled at its own line"
+            )
+        name = str_const(k)
+        if name is None and isinstance(k, ast.Name):
+            name = consts.get(k.id)
+        if name is None:
+            return None, None, (
+                f"{METRICS_MODULE}: METRICS key {ast.dump(k)} is not a "
+                "resolvable string — keep keys as literals or same-module "
+                "string constants"
+            )
+        try:
+            spec = ast.literal_eval(v)
+        except (ValueError, SyntaxError):
+            return None, None, (
+                f"{METRICS_MODULE}: METRICS['{name}'] value is not a pure "
+                "literal — the registry must stay literal_eval-able"
+            )
+        if not isinstance(spec, dict):
+            return None, None, (
+                f"{METRICS_MODULE}: METRICS['{name}'] must be a dict"
+            )
+        kind = spec.get("kind")
+        if kind not in VALID_KINDS:
+            return None, None, (
+                f"{METRICS_MODULE}: METRICS['{name}'] kind {kind!r} is not "
+                f"one of {sorted(VALID_KINDS)}"
+            )
+        layer = spec.get("layer")
+        if layer is not None and layer not in VALID_LAYERS:
+            return None, None, (
+                f"{METRICS_MODULE}: METRICS['{name}'] layer {layer!r} is "
+                f"not one of {sorted(VALID_LAYERS)}"
+            )
+        if name in entries:
+            return None, None, (
+                f"{METRICS_MODULE}: METRICS registers '{name}' twice"
+            )
+        entries[name] = spec
+        lines[name] = k.lineno
+    return entries, lines, None
+
+
+def strip_series_suffix(
+    name: str, entries: Dict[str, dict]
+) -> Optional[str]:
+    """Map a prometheus series name back to its registered family:
+    `<hist>_bucket`/`_sum`/`_count` resolve to a registered histogram.
+    Returns the family name, or None when `name` is no known series."""
+    if name in entries:
+        return name
+    for suffix in ("_bucket", "_sum", "_count"):
+        if name.endswith(suffix):
+            base = name[: -len(suffix)]
+            if entries.get(base, {}).get("kind") == "histogram":
+                return base
+    return None

@@ -7,6 +7,13 @@ from ..flow import (
     FrameProtocolRule,
     TaskLifecycleRule,
 )
+from ..met import (
+    MET_RULES,
+    MetConsumeSymmetryRule,
+    MetKindDisciplineRule,
+    MetLabelCardinalityRule,
+    MetRegistryRule,
+)
 from ..race import (
     RACE_RULES,
     RaceAwaitAtomicityRule,
@@ -29,7 +36,7 @@ CORE_RULES = (
     LockDisciplineRule,
 )
 
-ALL_RULES = CORE_RULES + SHARD_RULES + FLOW_RULES + RACE_RULES
+ALL_RULES = CORE_RULES + SHARD_RULES + FLOW_RULES + RACE_RULES + MET_RULES
 
 #: pack aliases accepted by the CLI's --rules (e.g. `--rules shard`)
 PACKS = {
@@ -37,6 +44,7 @@ PACKS = {
     "shard": SHARD_RULES,
     "flow": FLOW_RULES,
     "race": RACE_RULES,
+    "met": MET_RULES,
 }
 
 
@@ -48,6 +56,7 @@ __all__ = [
     "ALL_RULES",
     "CORE_RULES",
     "FLOW_RULES",
+    "MET_RULES",
     "PACKS",
     "RACE_RULES",
     "AsyncBlockingRule",
@@ -59,6 +68,10 @@ __all__ = [
     "FrameProtocolRule",
     "JaxPurityRule",
     "LockDisciplineRule",
+    "MetConsumeSymmetryRule",
+    "MetKindDisciplineRule",
+    "MetLabelCardinalityRule",
+    "MetRegistryRule",
     "PallasGridRule",
     "RaceAwaitAtomicityRule",
     "RaceGuardedStateRule",

@@ -47,6 +47,12 @@ from ..llm.tokens import TokenBlockSequence, compute_seq_hashes, salt_hash
 from ..models import llama
 from ..runtime import faults
 from ..runtime.engine import Context
+from ..runtime.metrics import (
+    NUM_RUNNING_REQS,
+    NUM_WAITING_REQS,
+    SCHED_EST_REQ_MS,
+    SCHED_EST_TTFT_MS,
+)
 from .config import EngineConfig
 from .kv_cache import PageAllocator, alloc_kv_arrays
 from .sampling import SamplingParams, penalized, sample, sample_lp, unpack_mask
@@ -1728,8 +1734,8 @@ class JaxEngine:
             if hasattr(self.kv_k, "nbytes") else 0
         )
         out = {
-            "num_waiting_reqs": len(self._waiting),
-            "num_running_reqs": running,
+            NUM_WAITING_REQS: len(self._waiting),
+            NUM_RUNNING_REQS: running,
             "gpu_cache_usage_perc": self.allocator.active_pages / self.allocator.num_pages,
             "request_total_slots": self.config.max_num_seqs,
             # quantized KV density surface (docs/kvbm.md): the format, the
@@ -1816,8 +1822,8 @@ class JaxEngine:
         # disagg decode workers and the planner see prefill-pool pressure)
         out.update(self.scheduler.stats())
         est = self.estimated_prefill_wait_ms()
-        out["sched_est_ttft_ms"] = round(est, 1) if est is not None else 0.0
-        out["sched_est_req_ms"] = round(self.estimated_req_ms(), 1)
+        out[SCHED_EST_TTFT_MS] = round(est, 1) if est is not None else 0.0
+        out[SCHED_EST_REQ_MS] = round(self.estimated_req_ms(), 1)
         recent = self.scheduler.recent_decisions()
         if recent:
             out["sched_last_decision"] = recent[-1]

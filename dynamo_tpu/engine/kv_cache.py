@@ -20,6 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..llm.mocker.kv_manager import KvEvent
+from ..runtime.metrics import KV_ACTIVE_BLOCKS, KV_TOTAL_BLOCKS
 
 logger = logging.getLogger(__name__)
 
@@ -186,8 +187,8 @@ class PageAllocator:
 
     def stats(self) -> dict:
         return {
-            "kv_active_blocks": self.used_pages - len(self._lru),
-            "kv_total_blocks": self.num_pages,
+            KV_ACTIVE_BLOCKS: self.used_pages - len(self._lru),
+            KV_TOTAL_BLOCKS: self.num_pages,
             "kv_cached_blocks": len(self._lru),
             "kv_prefix_hit_blocks_total": self.prefix_hit_blocks_total,
         }

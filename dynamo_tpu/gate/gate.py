@@ -38,6 +38,7 @@ from dataclasses import dataclass, field
 from typing import Dict, Optional
 
 from ..runtime import faults
+from ..runtime.metrics import metric_spec
 from .config import GateConfig
 from .fairness import TokenBucket, WfqQueue
 from .signals import LoadSignals
@@ -384,34 +385,66 @@ class AdmissionGate:
         (hand-assembled: the counters live on this object so the soak and
         unit tests can read them without a registry scrape)."""
         ns = "dynamo_frontend_gate"
+
+        def _help(name: str) -> str:
+            # HELP text comes from the metrics contract registry, so the
+            # exposition can never drift from docs/observability.md
+            return (metric_spec(name) or {}).get("help", name)
+
         lines = [
+            f"# HELP {ns}_admitted_total {_help(ns + '_admitted_total')}",
             f"# TYPE {ns}_admitted_total counter",
             f"{ns}_admitted_total {self.admitted_total}",
+            f"# HELP {ns}_rejected_total {_help(ns + '_rejected_total')}",
             f"# TYPE {ns}_rejected_total counter",
             f"{ns}_rejected_total {self.rejected_total}",
+            f"# HELP {ns}_shed_total {_help(ns + '_shed_total')}",
             f"# TYPE {ns}_shed_total counter",
             f"{ns}_shed_total {self.shed_total}",
+            f"# HELP {ns}_queue_depth {_help(ns + '_queue_depth')}",
             f"# TYPE {ns}_queue_depth gauge",
             f"{ns}_queue_depth {len(self._waiting)}",
         ]
-        for reason, n in sorted(self.rejected_by_reason.items()):
+        if self.rejected_by_reason:
             lines.append(
-                f'{ns}_rejected_by_reason_total{{reason="{reason}"}} {n}'
+                f"# HELP {ns}_rejected_by_reason_total "
+                f"{_help(ns + '_rejected_by_reason_total')}"
             )
+            lines.append(f"# TYPE {ns}_rejected_by_reason_total counter")
+        for reason, n in sorted(self.rejected_by_reason.items()):
+            # reason strings are produced by the gate itself, but escape
+            # anyway: a label value must never break the exposition line
+            lines.append(
+                f'{ns}_rejected_by_reason_total'
+                f'{{reason="{_prom_label(reason)}"}} {n}'
+            )
+        if self.per_tenant:
+            lines.append(
+                f"# HELP {ns}_tenant_requests_total "
+                f"{_help(ns + '_tenant_requests_total')}"
+            )
+            lines.append(f"# TYPE {ns}_tenant_requests_total counter")
         for tenant, v in sorted(self.per_tenant.items()):
             for k in ("admitted", "rejected"):
                 lines.append(
                     f'{ns}_tenant_requests_total'
                     f'{{tenant="{_prom_label(tenant)}",'
-                    f'outcome="{k}"}} {v[k]}'
+                    f'outcome="{_prom_label(k)}"}} {v[k]}'
                 )
+        lines.append(
+            f"# HELP {ns}_retry_after_seconds "
+            f"{_help(ns + '_retry_after_seconds')}"
+        )
+        lines.append(f"# TYPE {ns}_retry_after_seconds histogram")
         acc = 0
         for key in ("le_1s", "le_2s", "le_5s", "le_10s", "inf"):
             acc += self.retry_after_hist[key]
             le = key[3:].rstrip("s") if key != "inf" else "+Inf"
             lines.append(
-                f'{ns}_retry_after_seconds_bucket{{le="{le}"}} {acc}'
+                f'{ns}_retry_after_seconds_bucket'
+                f'{{le="{_prom_label(le)}"}} {acc}'
             )
+        lines.append(f"{ns}_retry_after_seconds_count {acc}")
         return ("\n".join(lines) + "\n").encode()
 
 

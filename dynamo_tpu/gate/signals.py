@@ -28,6 +28,12 @@ from typing import Dict, List, Optional, Tuple
 
 from ..llm.kv_router.publisher import METRICS_TOPIC_FMT
 from ..runtime import codec
+from ..runtime.metrics import (
+    NUM_RUNNING_REQS,
+    NUM_WAITING_REQS,
+    SCHED_EST_REQ_MS,
+    SCHED_EST_TTFT_MS,
+)
 from .config import GateConfig
 
 logger = logging.getLogger(__name__)
@@ -122,12 +128,12 @@ class LoadSignals:
                     msg = codec.unpack(payload)
                     stats = msg.get("stats", {})
                     inst = table.setdefault(int(msg["worker_id"]), InstanceLoad())
-                    est = stats.get("sched_est_ttft_ms")
+                    est = stats.get(SCHED_EST_TTFT_MS)
                     inst.est_ttft_ms = float(est) if est is not None else None
-                    req = stats.get("sched_est_req_ms")
+                    req = stats.get(SCHED_EST_REQ_MS)
                     inst.est_req_ms = float(req) if req is not None else None
-                    inst.queue_depth = int(stats.get("num_waiting_reqs", 0)) \
-                        + int(stats.get("num_running_reqs", 0))
+                    inst.queue_depth = int(stats.get(NUM_WAITING_REQS, 0)) \
+                        + int(stats.get(NUM_RUNNING_REQS, 0))
                     inst.updated = time.monotonic()
                     self.samples_total += 1
                 except asyncio.CancelledError:

@@ -14,6 +14,11 @@ from dynamo_tpu.engine import EngineConfig, JaxEngine
 from dynamo_tpu.llm.kv_router.publisher import KvEventPublisher, WorkerMetricsPublisher
 from dynamo_tpu.llm.model_card import ModelDeploymentCard, register_llm
 from dynamo_tpu.runtime import DistributedRuntime, RuntimeConfig, init_logging
+from dynamo_tpu.runtime.metrics import (
+    NUM_RUNNING_REQS,
+    NUM_WAITING_REQS,
+    worker_exported_stats,
+)
 
 logger = logging.getLogger("dynamo_tpu.jax_worker")
 
@@ -434,71 +439,12 @@ async def main():
             _stats_snap["t"] = now
         return float(_stats_snap["v"].get(k, 0) or 0)
 
-    for _stat in (
-        "kv_transfers_served", "kv_bytes_served", "kv_pulls_completed",
-        "kv_pages_pulled", "num_waiting_reqs", "num_running_reqs",
-        "kv_skip_ahead_blocks", "guided_requests", "lora_requests",
-        "spec_num_drafts", "spec_num_accepted_tokens",
-        # tokens/batches ratio = tokens-per-delta-batch (serving-gap
-        # coalescing diagnostic; mean > 1 in steady decode)
-        "emit_batches", "emit_tokens",
-        # ragged unified dispatch (docs/ragged_attention.md): whether the
-        # fused mixed path is actually taken in production (mixed vs
-        # split step counts) and the padding each path pays
-        "mixed_steps", "split_steps", "mixed_padding_frac",
-        "split_padding_frac",
-        # dynosched: scheduler queue/deadline pressure beside the raw
-        # depth metric — est TTFT is the disagg router's routing signal,
-        # deferred/shrunk/override counters show where the ITL budget and
-        # starvation guard actually bit
-        "sched_est_ttft_ms", "sched_est_req_ms", "sched_pending_deadlines",
-        "sched_granted_tokens", "sched_deferred_steps",
-        "sched_itl_shrunk_steps", "sched_deadline_overrides",
-        "sched_starvation_overrides",
-        # dynogate (docs/overload.md): distinct tenants the fairness
-        # tiebreak has served — the worker-side view of tenant mix
-        "sched_tenants_served",
-        # KVBM tier pipeline (docs/kvbm.md): per-tier hit/miss counters
-        # (G1 = device prefix cache at admission, G2/G3 = host/disk
-        # tiers), offload queue depth + drop counters, and the onboard
-        # latency sum/count pair (mean ms = sum/count) — the planner and
-        # bench read cache effectiveness from these
-        "kvbm_g1_hit_blocks", "kvbm_g1_miss_blocks",
-        "kvbm_host_hits", "kvbm_host_misses", "kvbm_host_evictions",
-        "kvbm_disk_hits", "kvbm_disk_misses", "kvbm_disk_evictions",
-        "kvbm_offload_gathers", "kvbm_offload_queue_depth",
-        "kvbm_offload_blocks_dropped", "kvbm_offload_failures",
-        "kvbm_onboard_count", "kvbm_onboard_ms_sum",
-        "kvbm_onboard_recompute_fallbacks",
-        # cluster KV fabric (docs/kvbm.md): peer pulls/bytes + latency
-        # sum (mean ms = sum/onboards), per-source onboard decisions
-        # (local tier / peer / recompute) — the fabric-effectiveness view
-        "kvbm_remote_onboards", "kvbm_remote_blocks_pulled",
-        "kvbm_peer_bytes_pulled", "kvbm_peer_pull_failures",
-        "kvbm_peer_pull_ms_sum", "kvbm_onboard_src_local_blocks",
-        "kvbm_onboard_src_peer_blocks", "kvbm_onboard_src_recompute_blocks",
-        # streamed disagg handoff (docs/disagg_serving.md): decode-side
-        # overlap evidence (first token client-bound before the last KV
-        # chunk landed) + prefill-side early-stage accounting
-        "disagg_streamed_handoffs", "disagg_chunks_before_first_token",
-        "disagg_first_token_before_last_chunk",
-        "disagg_streamed_handoff_ratio", "kv_streamed_stages",
-        "kv_streamed_fallbacks",
-        # durable decode sessions (docs/fault_tolerance.md): migration
-        # resumes served here, what each death cost in re-prefilled
-        # tokens, and per-source resume counters — the kill-mid-decode
-        # CI arm gates on resume_source_checkpoint > 0
-        "migrations_resumed", "migration_replayed_tokens",
-        "resume_source_checkpoint", "resume_source_peer",
-        "resume_source_local", "resume_source_recompute",
-        # session checkpointing (kvbm/checkpoint.py): replication
-        # throughput, the refuse-newest backpressure counter, and push
-        # failures (quarantined peers)
-        "kvbm_ckpt_blocks_pushed", "kvbm_ckpt_bytes_pushed",
-        "kvbm_ckpt_blocks_dropped", "kvbm_ckpt_push_failures",
-        "kvbm_ckpt_queue_depth", "kv_checkpoint_pushes",
-        "kv_checkpoint_blocks_received",
-    ):
+    # registry-driven export (runtime/metrics.py METRICS export=True):
+    # a stat added to the registry with export=True becomes a
+    # dynamo_worker_<name> gauge here without touching this file, and
+    # the met-registry dynolint rule retires the 'published on the
+    # metrics topic but never exported to prometheus' drift class
+    for _stat in worker_exported_stats():
         # registry prepends the "dynamo" prefix -> dynamo_worker_<stat>
         drt.metrics.callback_gauge(
             f"worker_{_stat}", f"engine stat {_stat}",
@@ -565,8 +511,8 @@ async def main():
                     msg = codec.unpack(payload)
                     stats = msg.get("stats", {})
                     depths[int(msg["worker_id"])] = int(
-                        stats.get("num_waiting_reqs", 0)
-                    ) + int(stats.get("num_running_reqs", 0))
+                        stats.get(NUM_WAITING_REQS, 0)
+                    ) + int(stats.get(NUM_RUNNING_REQS, 0))
                     live = set(prefill_client.instance_ids())
                     for w in list(depths):
                         if w not in live:

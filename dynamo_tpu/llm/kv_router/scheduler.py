@@ -17,6 +17,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from ...runtime.metrics import KV_ACTIVE_BLOCKS, KV_TOTAL_BLOCKS, NUM_WAITING_REQS
+
 logger = logging.getLogger(__name__)
 
 
@@ -98,9 +100,9 @@ class KvScheduler:
 
     def update_load(self, worker_id: int, stats: dict):
         load = self.loads.setdefault(worker_id, WorkerLoad())
-        load.kv_active_blocks = int(stats.get("kv_active_blocks", 0))
-        load.kv_total_blocks = max(int(stats.get("kv_total_blocks", 1)), 1)
-        load.num_waiting_reqs = int(stats.get("num_waiting_reqs", 0))
+        load.kv_active_blocks = int(stats.get(KV_ACTIVE_BLOCKS, 0))
+        load.kv_total_blocks = max(int(stats.get(KV_TOTAL_BLOCKS, 1)), 1)
+        load.num_waiting_reqs = int(stats.get(NUM_WAITING_REQS, 0))
         load.updated = time.monotonic()
 
     def add_request(

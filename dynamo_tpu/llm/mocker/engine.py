@@ -26,6 +26,14 @@ from ...engine.scheduler.policy import (
 from ...engine.scheduler.sla import SlaConfig
 from ...runtime import faults
 from ...runtime.engine import Context
+from ...runtime.metrics import (
+    KV_ACTIVE_BLOCKS,
+    KV_TOTAL_BLOCKS,
+    NUM_RUNNING_REQS,
+    NUM_WAITING_REQS,
+    SCHED_EST_REQ_MS,
+    SCHED_EST_TTFT_MS,
+)
 from ..protocols import Annotated, LLMEngineOutput, PreprocessedRequest
 from ..tokens import DEFAULT_BLOCK_SIZE, TokenBlockSequence, compute_seq_hashes
 from .kv_manager import KvEvent, KvManager
@@ -200,11 +208,11 @@ class MockEngine:
 
     def stats(self) -> dict:
         return {
-            "num_waiting_reqs": len(self._waiting),
-            "num_running_reqs": len(self._running),
+            NUM_WAITING_REQS: len(self._waiting),
+            NUM_RUNNING_REQS: len(self._running),
             "gpu_cache_usage_perc": self.kv.usage_perc(),
-            "kv_active_blocks": self.kv.active_blocks,
-            "kv_total_blocks": self.kv.num_blocks,
+            KV_ACTIVE_BLOCKS: self.kv.active_blocks,
+            KV_TOTAL_BLOCKS: self.kv.num_blocks,
             "request_total_slots": self.args.max_num_seqs,
             "sched_policy": self.sla.policy,
             "sched_deferred_steps": self.sched_deferred_steps,
@@ -214,11 +222,11 @@ class MockEngine:
             # dynogate signal parity with the JaxEngine (docs/overload.md):
             # the frontend admission gate projects TTFT from this gauge,
             # so the soak and CI smoke exercise the real gate without jax
-            "sched_est_ttft_ms": round(self.estimated_ttft_ms(), 1),
+            SCHED_EST_TTFT_MS: round(self.estimated_ttft_ms(), 1),
             # marginal cost of one MORE admitted request (the gate's
             # optimism-debt unit between 0.25s metric publishes — without
             # it a one-window burst floods past the published estimate)
-            "sched_est_req_ms": round(self.estimated_req_ms(), 1),
+            SCHED_EST_REQ_MS: round(self.estimated_req_ms(), 1),
         }
 
     def estimated_req_ms(self) -> float:

@@ -14,6 +14,13 @@ import dataclasses
 from dataclasses import dataclass, field
 from typing import Any, Dict, Optional
 
+from ...runtime.metrics import (
+    KV_ACTIVE_BLOCKS,
+    KV_TOTAL_BLOCKS,
+    NUM_RUNNING_REQS,
+    NUM_WAITING_REQS,
+)
+
 
 @dataclass
 class WorkerStats:
@@ -61,17 +68,17 @@ class ForwardPassMetrics:
         dialects — vLLM-style names included — parse)."""
         ws = WorkerStats(
             request_active_slots=int(
-                d.get("request_active_slots", d.get("num_running_reqs", 0))
+                d.get("request_active_slots", d.get(NUM_RUNNING_REQS, 0))
             ),
             request_total_slots=int(d.get("request_total_slots", 0)),
             num_requests_waiting=int(
-                d.get("num_requests_waiting", d.get("num_waiting_reqs", 0))
+                d.get("num_requests_waiting", d.get(NUM_WAITING_REQS, 0))
             ),
             data_parallel_rank=d.get("data_parallel_rank"),
         )
         ks = KvStats(
-            kv_active_blocks=int(d.get("kv_active_blocks", 0)),
-            kv_total_blocks=max(int(d.get("kv_total_blocks", 1)), 1),
+            kv_active_blocks=int(d.get(KV_ACTIVE_BLOCKS, 0)),
+            kv_total_blocks=max(int(d.get(KV_TOTAL_BLOCKS, 1)), 1),
             gpu_cache_usage_perc=float(d.get("gpu_cache_usage_perc", 0.0)),
             gpu_prefix_cache_hit_rate=float(d.get("gpu_prefix_cache_hit_rate", 0.0)),
         )

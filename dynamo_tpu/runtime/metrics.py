@@ -25,6 +25,239 @@ from prometheus_client import (
 
 HIERARCHY_LABELS = ("dynamo_namespace", "dynamo_component", "dynamo_endpoint")
 
+# --------------------------------------------------------------------- #
+# the metrics contract registry (dynomet)
+# --------------------------------------------------------------------- #
+# Cross-process metric KEY constants. These keys are spelled at a
+# publisher in one process (engine/mocker stats() on the metrics topic)
+# and re-spelled at consumers in OTHER processes (gate LoadSignals, the
+# disagg router's prefill-queue watcher, the KV router's scheduler) — a
+# rename at one end fails silently into fail-open admission, so both
+# ends import the spelling from here and the `met-consume-symmetry`
+# dynolint rule enforces that every wire-crossing key keeps at least one
+# producer and one consumer.
+
+NUM_WAITING_REQS = "num_waiting_reqs"
+NUM_RUNNING_REQS = "num_running_reqs"
+KV_ACTIVE_BLOCKS = "kv_active_blocks"
+KV_TOTAL_BLOCKS = "kv_total_blocks"
+SCHED_EST_TTFT_MS = "sched_est_ttft_ms"
+SCHED_EST_REQ_MS = "sched_est_req_ms"
+
+#: The observability contract: every metric key this package emits —
+#: stats()-dict keys published on the metrics topic, prometheus names
+#: minted by the frontend, and the hand-assembled exposition families.
+#: The `met` dynolint pack parses this dict from the AST (never imports
+#: this module) and cross-checks every emission and consumption site in
+#: the tree against it; `--emit-metrics-docs` renders it into
+#: docs/observability.md.
+#:
+#: Value fields (all literal — the registry must stay literal_eval-able):
+#:   kind     counter | gauge | histogram | info ("info" = a string or
+#:            structured value that must never be exported as a number)
+#:   layer    engine | worker | frontend | kvbm | router | sched |
+#:            planner | gate
+#:   unit     human unit ("" for plain counts)
+#:   help     one-line description (the docs table / HELP text)
+#:   labels   bounded label names for labeled exposition families
+#:   wire     True when the key crosses a process boundary and the
+#:            symmetry rule requires >=1 producer AND >=1 consumer
+#:   export   True when jax_worker republishes the stat as a
+#:            dynamo_worker_<name> prometheus gauge (worker_exported_
+#:            stats() drives that loop, so export drift is structural)
+#:   dynamic  True when the key is emitted through an f-string or
+#:            comprehension the analyzer cannot resolve (tier names,
+#:            merged sub-dicts) — exempts the entry from the
+#:            never-emitted check
+#:   buckets  histogram bucket upper bounds (exposition + registry must
+#:            agree; the kind rule compares ctor buckets against these)
+METRICS = {
+    # ---- engine core (published on the kv_metrics topic) -------------
+    NUM_WAITING_REQS: {"kind": "gauge", "layer": "engine", "unit": "requests", "help": "Requests queued for prefill admission.", "wire": True, "export": True},
+    NUM_RUNNING_REQS: {"kind": "gauge", "layer": "engine", "unit": "requests", "help": "Requests occupying decode slots.", "wire": True, "export": True},
+    "gpu_cache_usage_perc": {"kind": "gauge", "layer": "engine", "unit": "fraction", "help": "Active KV pages / total pages.", "wire": True, "export": True},
+    "request_total_slots": {"kind": "gauge", "layer": "engine", "unit": "slots", "help": "Configured max concurrent sequences.", "wire": True, "export": True},
+    "kv_quant": {"kind": "info", "layer": "engine", "help": "KV cache quantization format (bf16/int8/int4)."},
+    "kv_pool_bytes": {"kind": "gauge", "layer": "engine", "unit": "bytes", "help": "Resident KV pool bytes including scales.", "export": True},
+    "kv_format_mismatches": {"kind": "counter", "layer": "engine", "help": "Typed mixed-precision KV transfer rejections.", "export": True},
+    KV_ACTIVE_BLOCKS: {"kind": "gauge", "layer": "engine", "unit": "blocks", "help": "KV blocks referenced by live sequences.", "wire": True, "export": True},
+    KV_TOTAL_BLOCKS: {"kind": "gauge", "layer": "engine", "unit": "blocks", "help": "Total KV blocks in the device pool.", "wire": True, "export": True},
+    "kv_cached_blocks": {"kind": "gauge", "layer": "engine", "unit": "blocks", "help": "Unreferenced blocks held for prefix reuse.", "export": True},
+    "kv_prefix_hit_blocks_total": {"kind": "counter", "layer": "engine", "unit": "blocks", "help": "Prefix-cache block hits at admission.", "export": True},
+    "kv_transfers_served": {"kind": "counter", "layer": "engine", "help": "Data-plane KV transfers served to peers.", "export": True},
+    "kv_bytes_served": {"kind": "counter", "layer": "engine", "unit": "bytes", "help": "Data-plane KV bytes served to peers.", "export": True},
+    "kv_checkpoint_pushes": {"kind": "counter", "layer": "engine", "help": "Session-checkpoint pushes accepted into local tiers.", "export": True},
+    "kv_checkpoint_blocks_received": {"kind": "counter", "layer": "engine", "unit": "blocks", "help": "Checkpoint blocks received from peers.", "export": True},
+    "kv_pulls_completed": {"kind": "counter", "layer": "engine", "help": "Remote KV pulls completed (disagg onboarding).", "export": True},
+    "kv_pages_pulled": {"kind": "counter", "layer": "engine", "unit": "blocks", "help": "KV pages pulled from remote workers.", "export": True},
+    "disagg_streamed_handoffs": {"kind": "counter", "layer": "engine", "help": "Streamed prefill->decode handoffs started.", "export": True},
+    "disagg_chunks_before_first_token": {"kind": "counter", "layer": "engine", "help": "KV chunks landed before the first decode token.", "export": True},
+    "disagg_first_token_before_last_chunk": {"kind": "counter", "layer": "engine", "help": "First tokens emitted while KV chunks were in flight.", "export": True},
+    "disagg_streamed_handoff_ratio": {"kind": "gauge", "layer": "engine", "unit": "fraction", "help": "Overlapped handoffs / streamed handoffs.", "export": True},
+    "kv_streamed_stages": {"kind": "counter", "layer": "engine", "help": "Prefill-side streamed KV stages shipped.", "export": True},
+    "kv_streamed_fallbacks": {"kind": "counter", "layer": "engine", "help": "Streamed handoffs that fell back to blocking pulls.", "export": True},
+    "migrations_resumed": {"kind": "counter", "layer": "engine", "help": "Decode streams resumed here after a worker death.", "export": True},
+    "migration_replayed_tokens": {"kind": "counter", "layer": "engine", "unit": "tokens", "help": "Tokens re-prefilled to resume migrated streams.", "export": True},
+    "resume_source_checkpoint": {"kind": "counter", "layer": "engine", "help": "Migration resumes seeded from a peer checkpoint.", "export": True},
+    "resume_source_peer": {"kind": "counter", "layer": "engine", "help": "Migration resumes seeded from live peer KV.", "export": True},
+    "resume_source_local": {"kind": "counter", "layer": "engine", "help": "Migration resumes seeded from local tiers.", "export": True},
+    "resume_source_recompute": {"kind": "counter", "layer": "engine", "help": "Migration resumes that fully re-prefilled.", "export": True},
+    "kv_skip_ahead_blocks": {"kind": "counter", "layer": "engine", "unit": "blocks", "help": "Prefill blocks skipped via prefix skip-ahead.", "export": True},
+    "emit_batches": {"kind": "counter", "layer": "engine", "help": "Token delta batches emitted to streams.", "export": True},
+    "emit_tokens": {"kind": "counter", "layer": "engine", "unit": "tokens", "help": "Tokens emitted to streams.", "export": True},
+    "mixed_steps": {"kind": "counter", "layer": "engine", "help": "Fused mixed prefill+decode dispatch steps.", "export": True},
+    "split_steps": {"kind": "counter", "layer": "engine", "help": "Split prefill/decode dispatch steps.", "export": True},
+    "mixed_padding_frac": {"kind": "gauge", "layer": "engine", "unit": "fraction", "help": "Padding fraction paid by the mixed path.", "export": True},
+    "split_padding_frac": {"kind": "gauge", "layer": "engine", "unit": "fraction", "help": "Padding fraction paid by the split path.", "export": True},
+    "guided_requests": {"kind": "counter", "layer": "engine", "help": "Requests decoded under a guided-decoding FSM.", "export": True},
+    "lora_requests": {"kind": "counter", "layer": "engine", "help": "Requests served through a LoRA adapter.", "export": True},
+    "spec_num_drafts": {"kind": "counter", "layer": "engine", "help": "Speculative draft batches proposed.", "export": True},
+    "spec_num_draft_tokens": {"kind": "counter", "layer": "engine", "unit": "tokens", "help": "Speculative tokens proposed by the draft model.", "export": True},
+    "spec_num_accepted_tokens": {"kind": "counter", "layer": "engine", "unit": "tokens", "help": "Speculative tokens accepted by verification.", "export": True},
+    "spec_mean_accepted_len": {"kind": "gauge", "layer": "engine", "unit": "tokens", "help": "Mean accepted length per draft (incl. bonus token).", "export": True},
+    # ---- dynosched (engine/scheduler/policy.py) ----------------------
+    "sched_policy": {"kind": "info", "layer": "sched", "help": "Active scheduling policy name."},
+    "sched_ttft_target_ms": {"kind": "gauge", "layer": "sched", "unit": "ms", "help": "Configured TTFT SLA target.", "export": True},
+    "sched_itl_target_ms": {"kind": "gauge", "layer": "sched", "unit": "ms", "help": "Configured ITL SLA target.", "export": True},
+    "sched_granted_chunks": {"kind": "counter", "layer": "sched", "help": "Prefill chunks granted by the budgeter.", "export": True},
+    "sched_granted_tokens": {"kind": "counter", "layer": "sched", "unit": "tokens", "help": "Prefill tokens granted by the budgeter.", "export": True},
+    "sched_deferred_steps": {"kind": "counter", "layer": "sched", "help": "Steps where prefill was deferred for ITL.", "export": True},
+    "sched_itl_shrunk_steps": {"kind": "counter", "layer": "sched", "help": "Steps where the chunk budget was shrunk for ITL.", "export": True},
+    "sched_deadline_overrides": {"kind": "counter", "layer": "sched", "help": "Deadline-driven priority overrides.", "export": True},
+    "sched_starvation_overrides": {"kind": "counter", "layer": "sched", "help": "Starvation-guard priority overrides.", "export": True},
+    "sched_pending_deadlines": {"kind": "gauge", "layer": "sched", "help": "Requests with an armed TTFT deadline.", "export": True},
+    "sched_cost_observations": {"kind": "counter", "layer": "sched", "help": "Cost-model samples observed.", "export": True},
+    "sched_tenants_served": {"kind": "gauge", "layer": "sched", "help": "Distinct tenants the fairness tiebreak has served.", "export": True},
+    "sched_last_budget_tokens": {"kind": "gauge", "layer": "sched", "unit": "tokens", "help": "Last step's granted token budget."},
+    "sched_last_slack_ms": {"kind": "gauge", "layer": "sched", "unit": "ms", "help": "Last step's tightest deadline slack."},
+    "sched_last_decision": {"kind": "info", "layer": "sched", "help": "Last scheduling decision tag."},
+    SCHED_EST_TTFT_MS: {"kind": "gauge", "layer": "sched", "unit": "ms", "help": "Projected TTFT for one more admitted request — the gate's admission ceiling and the disagg router's routing signal.", "wire": True, "export": True},
+    SCHED_EST_REQ_MS: {"kind": "gauge", "layer": "sched", "unit": "ms", "help": "Marginal TTFT cost of one more admitted request (the gate's optimism debt between publishes).", "wire": True, "export": True},
+    # ---- KVBM tiers / offload / checkpoint (kvbm/) -------------------
+    "kvbm_g1_hit_blocks": {"kind": "counter", "layer": "kvbm", "unit": "blocks", "help": "Device prefix-cache hits at admission (G1).", "export": True},
+    "kvbm_g1_miss_blocks": {"kind": "counter", "layer": "kvbm", "unit": "blocks", "help": "Device prefix-cache misses at admission (G1).", "export": True},
+    "kvbm_onboard_count": {"kind": "counter", "layer": "kvbm", "help": "Tier onboard operations.", "export": True},
+    "kvbm_onboard_ms_sum": {"kind": "counter", "layer": "kvbm", "unit": "ms", "help": "Cumulative onboard latency (mean = sum/count).", "export": True},
+    "kvbm_onboard_hist": {"kind": "histogram", "layer": "kvbm", "unit": "ms", "help": "Onboard latency histogram (stats-dict blob).", "buckets": (1.0, 5.0, 20.0, 100.0, 500.0)},
+    "kvbm_offloaded_blocks": {"kind": "counter", "layer": "kvbm", "unit": "blocks", "help": "Blocks offloaded device->host.", "export": True},
+    "kvbm_onboarded_blocks": {"kind": "counter", "layer": "kvbm", "unit": "blocks", "help": "Blocks onboarded back to device.", "export": True},
+    "kvbm_disk_evictions": {"kind": "counter", "layer": "kvbm", "help": "Disk-tier evictions.", "dynamic": True, "export": True},
+    "kvbm_dropped_blocks": {"kind": "counter", "layer": "kvbm", "unit": "blocks", "help": "Blocks dropped out of the tier chain.", "export": True},
+    "kvbm_host_eviction_policy": {"kind": "info", "layer": "kvbm", "help": "Host tier eviction policy name."},
+    "kvbm_disk_eviction_policy": {"kind": "info", "layer": "kvbm", "help": "Disk tier eviction policy name."},
+    "kvbm_host_blocks": {"kind": "gauge", "layer": "kvbm", "unit": "blocks", "help": "Blocks resident in the host tier (G2).", "dynamic": True, "export": True},
+    "kvbm_host_capacity": {"kind": "gauge", "layer": "kvbm", "unit": "blocks", "help": "Host tier capacity.", "dynamic": True},
+    "kvbm_host_hits": {"kind": "counter", "layer": "kvbm", "help": "Host tier lookup hits.", "dynamic": True, "export": True},
+    "kvbm_host_misses": {"kind": "counter", "layer": "kvbm", "help": "Host tier lookup misses.", "dynamic": True, "export": True},
+    "kvbm_host_evictions": {"kind": "counter", "layer": "kvbm", "help": "Host tier evictions.", "dynamic": True, "export": True},
+    "kvbm_disk_blocks": {"kind": "gauge", "layer": "kvbm", "unit": "blocks", "help": "Blocks resident in the disk tier (G3).", "dynamic": True, "export": True},
+    "kvbm_disk_capacity": {"kind": "gauge", "layer": "kvbm", "unit": "blocks", "help": "Disk tier capacity.", "dynamic": True},
+    "kvbm_disk_hits": {"kind": "counter", "layer": "kvbm", "help": "Disk tier lookup hits.", "dynamic": True, "export": True},
+    "kvbm_disk_misses": {"kind": "counter", "layer": "kvbm", "help": "Disk tier lookup misses.", "dynamic": True, "export": True},
+    "kvbm_host_load_ms_per_block": {"kind": "gauge", "layer": "kvbm", "unit": "ms", "help": "Observed host-tier load cost per block.", "dynamic": True},
+    "kvbm_disk_load_ms_per_block": {"kind": "gauge", "layer": "kvbm", "unit": "ms", "help": "Observed disk-tier load cost per block.", "dynamic": True},
+    "kvbm_offload_commit_calls": {"kind": "counter", "layer": "kvbm", "help": "Offload commit batches entered.", "export": True},
+    "kvbm_offload_gathers": {"kind": "counter", "layer": "kvbm", "help": "Device gathers staged for offload.", "export": True},
+    "kvbm_offload_queue_depth": {"kind": "gauge", "layer": "kvbm", "help": "Offload batches waiting in the pipeline.", "export": True},
+    "kvbm_offload_staged_blocks": {"kind": "counter", "layer": "kvbm", "unit": "blocks", "help": "Blocks staged for offload.", "export": True},
+    "kvbm_offload_batches_dropped": {"kind": "counter", "layer": "kvbm", "help": "Offload batches dropped under backpressure.", "export": True},
+    "kvbm_offload_blocks_dropped": {"kind": "counter", "layer": "kvbm", "unit": "blocks", "help": "Blocks dropped under offload backpressure.", "export": True},
+    "kvbm_offload_failures": {"kind": "counter", "layer": "kvbm", "help": "Offload batches that failed.", "export": True},
+    "kvbm_onboard_recompute_fallbacks": {"kind": "counter", "layer": "kvbm", "help": "Onboards that fell back to recompute.", "export": True},
+    "kvbm_onboard_src_local_blocks": {"kind": "counter", "layer": "kvbm", "unit": "blocks", "help": "Onboarded blocks sourced from local tiers.", "export": True},
+    "kvbm_onboard_src_peer_blocks": {"kind": "counter", "layer": "kvbm", "unit": "blocks", "help": "Onboarded blocks pulled from peers.", "export": True},
+    "kvbm_onboard_src_recompute_blocks": {"kind": "counter", "layer": "kvbm", "unit": "blocks", "help": "Onboard blocks recomputed.", "export": True},
+    "kvbm_pending_offloads": {"kind": "gauge", "layer": "kvbm", "help": "Offload futures not yet committed.", "export": True},
+    "kvbm_ckpt_blocks_staged": {"kind": "counter", "layer": "kvbm", "unit": "blocks", "help": "Checkpoint blocks staged for replication.", "export": True},
+    "kvbm_ckpt_blocks_pushed": {"kind": "counter", "layer": "kvbm", "unit": "blocks", "help": "Checkpoint blocks pushed to replica holders.", "export": True},
+    "kvbm_ckpt_bytes_pushed": {"kind": "counter", "layer": "kvbm", "unit": "bytes", "help": "Checkpoint bytes pushed to replica holders.", "export": True},
+    "kvbm_ckpt_blocks_dropped": {"kind": "counter", "layer": "kvbm", "unit": "blocks", "help": "Checkpoint blocks dropped (refuse-newest backpressure).", "export": True},
+    "kvbm_ckpt_push_failures": {"kind": "counter", "layer": "kvbm", "help": "Checkpoint pushes that failed.", "export": True},
+    "kvbm_ckpt_format_refusals": {"kind": "counter", "layer": "kvbm", "help": "Checkpoint pushes refused on KV-format mismatch.", "export": True},
+    "kvbm_ckpt_queue_depth": {"kind": "gauge", "layer": "kvbm", "help": "Checkpoint batches waiting to push.", "export": True},
+    "kvbm_ckpt_last_peer": {"kind": "info", "layer": "kvbm", "help": "Last checkpoint replica peer address."},
+    "kvbm_remote_onboards": {"kind": "counter", "layer": "kvbm", "help": "Onboards served from remote peers.", "export": True},
+    "kvbm_remote_blocks_pulled": {"kind": "counter", "layer": "kvbm", "unit": "blocks", "help": "Blocks pulled over the cluster KV fabric.", "export": True},
+    "kvbm_peer_bytes_pulled": {"kind": "counter", "layer": "kvbm", "unit": "bytes", "help": "Bytes pulled over the cluster KV fabric.", "export": True},
+    "kvbm_peer_pull_failures": {"kind": "counter", "layer": "kvbm", "help": "Peer pulls that failed (quarantine feed).", "export": True},
+    "kvbm_peer_pull_ms_sum": {"kind": "counter", "layer": "kvbm", "unit": "ms", "help": "Cumulative peer-pull latency (mean = sum/onboards).", "export": True},
+    "kvbm_peer_pull_hist": {"kind": "histogram", "layer": "kvbm", "unit": "ms", "help": "Peer-pull latency histogram (stats-dict blob).", "buckets": (5.0, 20.0, 50.0, 100.0, 250.0, 1000.0)},
+    "kvbm_known_remote_blocks": {"kind": "gauge", "layer": "kvbm", "unit": "blocks", "help": "Remote blocks known to the fabric index.", "export": True},
+    "kvbm_quarantined_peers": {"kind": "gauge", "layer": "kvbm", "help": "Peers currently quarantined after pull failures.", "export": True},
+    "kvbm_known_checkpoint_blocks": {"kind": "gauge", "layer": "kvbm", "unit": "blocks", "help": "Checkpoint blocks known cluster-wide.", "export": True},
+    "kvbm_ckpt_ineligible_peers": {"kind": "gauge", "layer": "kvbm", "help": "Peers refused as checkpoint targets (format skew).", "export": True},
+    "kvbm_peer_ms_per_block": {"kind": "info", "layer": "kvbm", "unit": "ms", "help": "Per-peer observed pull cost map (addr -> ms/block)."},
+    # ---- dynogate (gate/, frontend process) --------------------------
+    "gate_enabled": {"kind": "gauge", "layer": "gate", "help": "1 when the admission gate is active."},
+    "gate_admitted_total": {"kind": "counter", "layer": "gate", "help": "Requests admitted by the gate."},
+    "gate_rejected_total": {"kind": "counter", "layer": "gate", "help": "Requests rejected (429) by the gate."},
+    "gate_shed_total": {"kind": "counter", "layer": "gate", "help": "Parked requests shed before admission."},
+    "gate_parked_total": {"kind": "counter", "layer": "gate", "help": "Requests parked in the admission queue."},
+    "gate_queue_depth": {"kind": "gauge", "layer": "gate", "help": "Requests currently parked at the gate."},
+    "gate_rejected_by_reason": {"kind": "info", "layer": "gate", "help": "Rejection counts keyed by reason (stats-dict map)."},
+    "gate_retry_after_hist": {"kind": "histogram", "layer": "gate", "unit": "seconds", "help": "Retry-After values handed out (stats-dict blob).", "buckets": (1.0, 2.0, 5.0, 10.0)},
+    "gate_per_tenant": {"kind": "info", "layer": "gate", "help": "Bounded per-tenant admit/reject map."},
+    "gate_signal_samples": {"kind": "counter", "layer": "gate", "help": "Worker metric samples folded into gate signals."},
+    # ---- frontend prometheus exposition (llm/http, llm/migration) ----
+    "dynamo_frontend_requests_total": {"kind": "counter", "layer": "frontend", "unit": "requests", "help": "HTTP LLM requests completed.", "labels": ("model", "endpoint", "status"), "wire": True},
+    "dynamo_frontend_inflight_requests": {"kind": "gauge", "layer": "frontend", "unit": "requests", "help": "Requests currently being processed.", "labels": ("model", "endpoint")},
+    "dynamo_frontend_request_duration_seconds": {"kind": "histogram", "layer": "frontend", "unit": "seconds", "help": "End-to-end request duration.", "labels": ("model", "endpoint"), "wire": True, "buckets": (0.05, 0.1, 0.25, 0.5, 1, 2, 4, 8, 16, 32, 64, 128)},
+    "dynamo_frontend_time_to_first_token_seconds": {"kind": "histogram", "layer": "frontend", "unit": "seconds", "help": "Time to first token.", "labels": ("model",), "wire": True, "buckets": (0.01, 0.025, 0.05, 0.1, 0.2, 0.4, 0.8, 1.6, 3.2, 6.4, 12.8)},
+    "dynamo_frontend_output_tokens_total": {"kind": "counter", "layer": "frontend", "unit": "tokens", "help": "Generated tokens delivered to clients.", "labels": ("model",), "wire": True},
+    "dynamo_frontend_input_tokens_total": {"kind": "counter", "layer": "frontend", "unit": "tokens", "help": "Prompt tokens accepted.", "labels": ("model",), "wire": True},
+    "dynamo_frontend_inter_token_latency_seconds": {"kind": "histogram", "layer": "frontend", "unit": "seconds", "help": "Mean inter-token latency per request.", "labels": ("model",), "wire": True, "buckets": (0.002, 0.005, 0.01, 0.02, 0.04, 0.08, 0.16, 0.32, 0.64, 1.28)},
+    "dynamo_frontend_client_disconnects_total": {"kind": "counter", "layer": "frontend", "help": "Client disconnects mid-stream.", "labels": ("model",)},
+    "dynamo_frontend_tokens_per_frame": {"kind": "histogram", "layer": "frontend", "unit": "tokens", "help": "Generated tokens per streamed delta batch.", "labels": ("model",), "buckets": (1, 2, 4, 8, 16, 32, 64, 128)},
+    "dynamo_frontend_migrations_total": {"kind": "counter", "layer": "frontend", "help": "Stream migrations started after worker loss."},
+    "dynamo_frontend_migration_replayed_tokens_total": {"kind": "counter", "layer": "frontend", "unit": "tokens", "help": "Tokens replayed into migration retry prompts."},
+    "dynamo_frontend_migrations_exhausted_total": {"kind": "counter", "layer": "frontend", "help": "Streams that ran out of migration budget."},
+    "dynamo_frontend_gate_admitted_total": {"kind": "counter", "layer": "gate", "help": "Gate admissions (exposition view)."},
+    "dynamo_frontend_gate_rejected_total": {"kind": "counter", "layer": "gate", "help": "Gate rejections (exposition view)."},
+    "dynamo_frontend_gate_shed_total": {"kind": "counter", "layer": "gate", "help": "Parked requests shed (exposition view)."},
+    "dynamo_frontend_gate_queue_depth": {"kind": "gauge", "layer": "gate", "help": "Parked requests right now (exposition view)."},
+    "dynamo_frontend_gate_rejected_by_reason_total": {"kind": "counter", "layer": "gate", "help": "Gate rejections by bounded reason.", "labels": ("reason",)},
+    "dynamo_frontend_gate_tenant_requests_total": {"kind": "counter", "layer": "gate", "help": "Per-tenant admit/reject counts (bounded tenant set).", "labels": ("tenant", "outcome")},
+    "dynamo_frontend_gate_retry_after_seconds": {"kind": "histogram", "layer": "gate", "unit": "seconds", "help": "Retry-After values handed out.", "buckets": (1.0, 2.0, 5.0, 10.0)},
+    # ---- KV router / indexer (frontend process) ----------------------
+    "index_blocks": {"kind": "gauge", "layer": "router", "unit": "blocks", "help": "Blocks tracked by the KV event index."},
+    "index_max_blocks": {"kind": "gauge", "layer": "router", "unit": "blocks", "help": "Index capacity (0 = unbounded)."},
+    "index_evicted_blocks": {"kind": "counter", "layer": "router", "unit": "blocks", "help": "Index entries evicted at capacity."},
+    "index_mappings": {"kind": "gauge", "layer": "router", "help": "hash->worker mappings held."},
+    "index_memory_bytes_estimate": {"kind": "gauge", "layer": "router", "unit": "bytes", "help": "Estimated index memory footprint."},
+    "events_applied": {"kind": "counter", "layer": "router", "help": "KV events applied to the index."},
+    # ---- vLLM-dialect aliases (read-if-present by protocols) ---------
+    "request_active_slots": {"kind": "gauge", "layer": "router", "unit": "slots", "help": "vLLM-dialect alias of num_running_reqs (read if present)."},
+    "num_requests_waiting": {"kind": "gauge", "layer": "router", "unit": "requests", "help": "vLLM-dialect alias of num_waiting_reqs (read if present)."},
+    "data_parallel_rank": {"kind": "gauge", "layer": "router", "help": "Publisher's data-parallel rank (read if present)."},
+    "gpu_prefix_cache_hit_rate": {"kind": "gauge", "layer": "router", "unit": "fraction", "help": "vLLM-dialect prefix hit rate (read if present)."},
+    "spec_decode": {"kind": "info", "layer": "router", "help": "Nested speculative-decode stats blob (read if present)."},
+    # ---- runtime plumbing (worker process) ---------------------------
+    "frames_total": {"kind": "counter", "layer": "worker", "help": "Request-plane frames handled by the endpoint."},
+    "items_total": {"kind": "counter", "layer": "worker", "help": "Stream items delivered by the endpoint."},
+    "frames_binary": {"kind": "counter", "layer": "worker", "help": "Zero-copy binary frames on the token wire path."},
+    "compute_threads": {"kind": "gauge", "layer": "worker", "help": "Compute-pool worker threads."},
+    "compute_tasks_run": {"kind": "counter", "layer": "worker", "help": "Tasks run on the compute pool."},
+}
+
+
+def worker_exported_stats() -> Tuple[str, ...]:
+    """Stats keys jax_worker republishes as dynamo_worker_<name> prometheus
+    gauges (system-status /metrics). Driven by the registry so a key added
+    to METRICS with export=True is exported without touching the worker —
+    the 'published but never exported' drift class is gone structurally.
+    Only scalar kinds are exportable; the registry seeds keep info/
+    histogram entries unexported and the met-kind-discipline rule enforces
+    it."""
+    return tuple(
+        name for name, spec in METRICS.items() if spec.get("export")
+    )
+
+
+def metric_spec(name: str) -> Optional[dict]:
+    """Registry entry for `name`, or None. Exposition helpers use this to
+    keep HELP/TYPE lines consistent with the contract."""
+    return METRICS.get(name)
+
 
 class MetricsRegistry:
     """One node in the metrics hierarchy. The root owns the

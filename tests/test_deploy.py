@@ -208,14 +208,16 @@ def test_grafana_dashboard_queries_real_metrics():
         (REPO / "deploy" / "metrics" / "grafana_dashboards" /
          "dynamo-tpu-serving.json").read_text()
     )
-    # metric names the code actually exports
+    # metric names the code actually exports: the worker gauge loop is
+    # registry-driven (runtime/metrics.py METRICS export=True), so the
+    # exported set comes straight from the registry instead of regexing
+    # jax_worker/__main__.py source
+    from dynamo_tpu.runtime.metrics import worker_exported_stats
+
     frontend_src = (REPO / "dynamo_tpu" / "llm" / "http" / "metrics.py").read_text()
-    worker_src = (REPO / "dynamo_tpu" / "jax_worker" / "__main__.py").read_text()
     exported = set(re.findall(r'"(dynamo_frontend_[a-z_]+)"', frontend_src.replace(
         'f"{ns}_', '"dynamo_frontend_')))
-    for stat in re.findall(r'"([a-z_]+)", "engine stat', worker_src):
-        exported.add(f"dynamo_worker_{stat}")
-    for stat in re.findall(r'"(kv_[a-z_]+|num_[a-z_]+)"', worker_src):
+    for stat in worker_exported_stats():
         exported.add(f"dynamo_worker_{stat}")
     queried = set()
     for panel in dash["panels"]:

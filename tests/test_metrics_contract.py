@@ -1,0 +1,186 @@
+"""Prometheus exposition-format contract tests (runtime side of dynomet).
+
+The met pack checks the exposition STATICALLY; these tests render the
+real surfaces IN-PROCESS and parse them back with prometheus_client's
+text parser — the same grammar a scraper applies. Three surfaces:
+
+  * the frontend prometheus_client registry (HttpMetrics);
+  * the gate's hand-assembled render_prometheus() (including a hostile
+    tenant name that must be escaped, not break the format);
+  * the worker's system-status export loop (MetricsRegistry +
+    callback_gauge over worker_exported_stats()).
+
+Contract asserted: every `dynamo_*` family parses with HELP/TYPE, its
+parsed kind matches METRICS, counter samples follow the `_total` naming
+rule, and label values survive the escape/unescape round-trip. This is
+also the runtime cover for the one surface the static rules skip: the
+MetricsRegistry renderer inside runtime/metrics.py itself.
+"""
+
+import pytest
+
+prometheus_client = pytest.importorskip("prometheus_client")
+
+from prometheus_client import CollectorRegistry  # noqa: E402
+from prometheus_client.parser import text_string_to_metric_families  # noqa: E402
+
+from dynamo_tpu.runtime.metrics import (  # noqa: E402
+    METRICS,
+    MetricsRegistry,
+    metric_spec,
+    worker_exported_stats,
+)
+
+#: prometheus_client appends `_created` gauges to counters/histograms
+#: and `_gsum`/`_gcount` to nothing we mint — series suffixes a family's
+#: samples may legally carry beyond the family name itself
+_SERIES_SUFFIXES = ("", "_total", "_created", "_bucket", "_sum", "_count")
+
+
+def _registered_family(parsed_name: str, parsed_type: str) -> str:
+    """Map a parsed family back to its METRICS name: the text parser
+    strips `_total` from counter family names."""
+    if parsed_type == "counter":
+        return parsed_name + "_total"
+    return parsed_name
+
+
+def _assert_matches_registry(text: str):
+    families = [
+        f for f in text_string_to_metric_families(text)
+        if f.name.startswith("dynamo_")
+        # prometheus_client emits a companion `_created` gauge per
+        # counter/histogram family — bookkeeping series, not contract
+        and not f.name.endswith("_created")
+    ]
+    assert families
+    for fam in families:
+        name = _registered_family(fam.name, fam.type)
+        spec = metric_spec(name)
+        assert spec is not None, f"{name} rendered but not in METRICS"
+        assert spec["kind"] == fam.type, (
+            f"{name}: rendered TYPE {fam.type}, registry kind {spec['kind']}"
+        )
+        if fam.type == "counter":
+            assert name.endswith("_total")
+        for s in fam.samples:
+            assert any(
+                s.name == fam.name + sfx or s.name == name + sfx
+                for sfx in _SERIES_SUFFIXES
+            ), f"sample {s.name} outside family {fam.name}"
+    return families
+
+
+def test_frontend_http_metrics_render_matches_registry():
+    from dynamo_tpu.llm.http.metrics import HttpMetrics
+
+    m = HttpMetrics(CollectorRegistry())
+    m.request_start("m0", "chat")
+    m.request_end(
+        "m0", "chat", t0=0.0, output_tokens=4, input_tokens=2,
+        first_token_at=1.0, last_token_at=2.0,
+    )
+    m.observe_ttft("m0", 0.1)
+    m.observe_tokens_per_frame("m0", 4)
+    m.client_disconnect("m0")
+    families = _assert_matches_registry(m.render().decode())
+    kinds = {f.type for f in families}
+    assert {"counter", "gauge", "histogram"} <= kinds
+
+
+def test_migration_metrics_render_matches_registry():
+    from dynamo_tpu.llm.migration import MigrationMetrics
+
+    m = MigrationMetrics()
+    m.migrations += 3
+    m.replayed_tokens += 128
+    m.exhausted += 1
+    families = _assert_matches_registry(m.render_prometheus().decode())
+    assert all(f.type == "counter" for f in families)
+    values = {
+        s.name: s.value for f in families for s in f.samples
+    }
+    assert values["dynamo_frontend_migrations_total"] == 3
+
+
+def test_gate_render_survives_hostile_tenant_label():
+    from dynamo_tpu.gate.config import GateConfig
+    from dynamo_tpu.gate.gate import AdmissionGate
+
+    gate = AdmissionGate(None, GateConfig())
+    gate.admitted_total = 5
+    gate.rejected_total = 2
+    gate.rejected_by_reason = {"overloaded": 2}
+    hostile = 'evil"tenant\nwith\\escapes'
+    gate.per_tenant[hostile] = {"admitted": 2, "rejected": 1}
+    gate.retry_after_hist["le_1s"] = 1
+
+    text = gate.render_prometheus().decode()
+    # the raw hostile bytes must never appear unescaped on a sample line
+    assert 'evil"tenant\nwith' not in text
+    families = _assert_matches_registry(text)
+
+    by_name = {f.name: f for f in families}
+    tenant_fam = by_name["dynamo_frontend_gate_tenant_requests"]
+    assert tenant_fam.type == "counter"
+    # escape → parse round-trips to the exact original tenant string
+    labels = [s.labels for s in tenant_fam.samples]
+    assert {lab["tenant"] for lab in labels} == {hostile}
+    assert {lab["outcome"] for lab in labels} == {"admitted", "rejected"}
+
+    hist = by_name["dynamo_frontend_gate_retry_after_seconds"]
+    assert hist.type == "histogram"
+    bucket_bounds = [
+        s.labels["le"] for s in hist.samples if s.name.endswith("_bucket")
+    ]
+    assert bucket_bounds[-1] == "+Inf"
+
+
+def test_gate_help_text_comes_from_the_registry():
+    from dynamo_tpu.gate.config import GateConfig
+    from dynamo_tpu.gate.gate import AdmissionGate
+
+    gate = AdmissionGate(None, GateConfig())
+    text = gate.render_prometheus().decode()
+    want = METRICS["dynamo_frontend_gate_admitted_total"]["help"]
+    assert f"# HELP dynamo_frontend_gate_admitted_total {want}" in text
+
+
+def test_worker_export_loop_renders_every_export_entry():
+    """Mirror of jax_worker/__main__.py's system-status loop: one
+    callback gauge per worker_exported_stats() name, driven by a stub
+    stats snapshot. Every export entry must be scalar (float()-able) and
+    must land in the render as dynamo_worker_<name>."""
+    names = worker_exported_stats()
+    assert len(names) >= 50
+    for n in names:
+        assert METRICS[n]["kind"] in ("counter", "gauge"), (
+            f"export entry {n} has non-scalar kind {METRICS[n]['kind']}"
+        )
+
+    stub = {n: float(i) for i, n in enumerate(names)}
+    reg = MetricsRegistry()
+    for n in names:
+        reg.callback_gauge(
+            f"worker_{n}", METRICS[n].get("help", n),
+            (lambda k=n: float(stub[k])),
+        )
+    text = reg.render().decode()
+    parsed = {
+        s.name: s.value
+        for f in text_string_to_metric_families(text)
+        for s in f.samples
+    }
+    for i, n in enumerate(names):
+        assert parsed[f"dynamo_worker_{n}"] == float(i)
+
+
+def test_worker_exported_stats_is_registry_driven():
+    names = set(worker_exported_stats())
+    assert names == {
+        n for n, spec in METRICS.items() if spec.get("export")
+    }
+    # wire entries the gate depends on are part of the export surface's
+    # source registry too — the contract file is one table, not two
+    assert "sched_est_ttft_ms" in METRICS
+    assert METRICS["sched_est_ttft_ms"]["wire"] is True

@@ -1041,7 +1041,7 @@ def test_cli_packs_partition_all_rules():
     rule landing in two packs (or none) breaks --rules gating."""
     from dynamo_tpu.analysis.rules import ALL_RULES, PACKS
 
-    assert set(PACKS) == {"core", "shard", "flow", "race"}
+    assert set(PACKS) == {"core", "shard", "flow", "race", "met"}
     names = [cls.name for pack in PACKS.values() for cls in pack]
     assert sorted(names) == sorted(cls.name for cls in ALL_RULES)
     assert len(names) == len(set(names))
@@ -1054,7 +1054,9 @@ def test_cli_rules_all_is_the_full_rule_set(tmp_path):
     (tmp_path / "dynamo_tpu" / "empty.py").write_text("X = 1\n")
     from dynamo_tpu.analysis.rules import ALL_RULES
 
-    for extra in ([], ["--rules", "all"], ["--rules", "core,shard,flow,race"]):
+    for extra in (
+        [], ["--rules", "all"], ["--rules", "core,shard,flow,race,met"],
+    ):
         proc = _cli("--root", str(tmp_path), "--format", "sarif", *extra)
         assert proc.returncode in (0, 1), proc.stderr
         ids = [
@@ -1082,7 +1084,7 @@ def test_cli_list_rules_in_sync_with_packs():
 
     proc = _cli("--list-rules")
     assert proc.returncode == 0
-    for alias in ("core", "shard", "flow", "race"):
+    for alias in ("core", "shard", "flow", "race", "met"):
         assert f"[{alias}]" in proc.stdout
     for cls in ALL_RULES:
         # each rule listed exactly once, with its description
