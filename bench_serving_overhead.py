@@ -599,6 +599,79 @@ def check_codec_identity() -> bool:
     return ok
 
 
+async def run_compile_smoke(args) -> dict:
+    """Replay a trace against a warmed in-process JaxEngine and read the
+    per-surface compile counters (docs/compilation.md). warmup() takes
+    the baseline cache-size snapshot; the replay — lone arrivals, cap
+    bursts, and staggered mid-decode admissions across every prefill
+    bucket — must then mint ZERO new XLA programs. comp-warmup-coverage
+    proves surface reachability statically; this gate proves at runtime
+    that warmup actually compiled everything the steady-state trace
+    needs (a failure means a shape leaked past the bucketing helpers or
+    warmup missed a variant)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dynamo_tpu.engine import EngineConfig, JaxEngine
+    from dynamo_tpu.llm.protocols import PreprocessedRequest
+    from dynamo_tpu.models import llama
+    from dynamo_tpu.runtime.engine import Context
+
+    model_cfg = llama.LlamaConfig.tiny(dtype=jnp.float32)
+    params = llama.init_params(model_cfg, jax.random.PRNGKey(0))
+    cfg = EngineConfig(
+        model="tiny", max_num_seqs=4, page_size=8, num_pages=64,
+        max_model_len=128, prefill_buckets=(16, 32), max_prefill_chunk=32,
+    )
+    eng = JaxEngine(cfg, model_config=model_cfg, params=params)
+    warmup_reqs = await eng.warmup()
+    warm = eng.stats()
+
+    rng = np.random.RandomState(0xC0DE)
+    vocab = model_cfg.vocab_size
+    replayed = 0
+    tokens = [0]
+
+    async def one(isl: int, osl: int):
+        req = PreprocessedRequest(
+            token_ids=rng.randint(5, max(vocab - 1, 6), size=isl).tolist(),
+            stop_conditions={"max_tokens": osl, "ignore_eos": True},
+            sampling_options={"temperature": 1.0},
+        ).to_dict()
+        async for item in eng.generate(req, Context()):
+            data = item.get("data")
+            if data:
+                tokens[0] += len(data.get("token_ids", ()))
+
+    # the replay trace: per bucket a lone arrival (1-lane variant), a
+    # burst (the cap-lane variant — plan_prefill lanes are 1-or-cap, so
+    # any burst >= 2 lands on the warmed cap shape), and a staggered
+    # pair that admits mid-decode (the patch path)
+    for b in [x for x in cfg.prefill_buckets if x <= cfg.max_model_len]:
+        lengths = [max(b - 8, 4), max(b // 2, 4), max(b - 1, 4)]
+        await one(lengths[0], 6)
+        replayed += 1
+        await asyncio.gather(*[one(n, 4) for n in lengths])
+        replayed += len(lengths)
+        t1 = asyncio.create_task(one(lengths[1], 8))
+        await asyncio.sleep(0.05)
+        t2 = asyncio.create_task(one(lengths[2], 4))
+        await asyncio.gather(t1, t2)
+        replayed += 2
+    stats = eng.stats()
+    await eng.close()
+    return {
+        "warmup_requests": warmup_reqs,
+        "replayed_requests": replayed,
+        "replayed_tokens": tokens[0],
+        "compiled_variants_after_warmup": warm["compiled_variants"],
+        "compiled_variants": stats["compiled_variants"],
+        "compile_surfaces": stats["compile_surfaces"],
+        "post_warmup_compiles": stats["post_warmup_compiles"],
+    }
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--streams", type=int, default=8,
@@ -677,7 +750,36 @@ def main():
                     "admission ceiling at headroom 1.0)")
     ap.add_argument("--overload-slo-ms", type=float, default=2000.0,
                     help="TTFT SLO for the goodput (attained tok/s) metric")
+    # compile smoke (dynocomp runtime closure, docs/compilation.md):
+    # replay a trace against a warmed in-process engine; gate on the
+    # per-surface compile counters showing zero post-warmup recompiles
+    ap.add_argument("--compile-smoke", action="store_true",
+                    help="CI gate: warm an in-process JaxEngine, replay "
+                    "a trace across every prefill bucket (lone arrivals, "
+                    "cap bursts, mid-decode admissions); exit 1 if "
+                    "stats()['post_warmup_compiles'] != 0 or warmup "
+                    "compiled nothing")
     args = ap.parse_args()
+
+    if args.compile_smoke:
+        out = asyncio.run(run_compile_smoke(args))
+        print(json.dumps(out, indent=2))
+        ok = True
+        if out["post_warmup_compiles"] != 0:
+            print(f"COMPILE SMOKE FAIL: {out['post_warmup_compiles']} XLA "
+                  "program(s) compiled after warmup — a dispatch shape "
+                  "leaked past the bucketing helpers or warmup missed a "
+                  "variant (docs/compilation.md)", file=sys.stderr)
+            ok = False
+        if out["compiled_variants_after_warmup"] <= 0:
+            print("COMPILE SMOKE FAIL: warmup compiled no surfaces "
+                  "(compile-counter plumbing is broken)", file=sys.stderr)
+            ok = False
+        if out["replayed_tokens"] <= 0:
+            print("COMPILE SMOKE FAIL: replay streamed no tokens",
+                  file=sys.stderr)
+            ok = False
+        sys.exit(0 if ok else 1)
 
     if args.codec_ab:
         import copy

@@ -12,6 +12,7 @@
     python -m dynamo_tpu.analysis --emit-env-docs docs/configuration.md
     python -m dynamo_tpu.analysis --emit-sync-docs     # docs/concurrency.md
     python -m dynamo_tpu.analysis --emit-metrics-docs  # docs/observability.md
+    python -m dynamo_tpu.analysis --emit-compile-docs  # docs/compilation.md
 
 Exit status: 0 clean, 1 violations found, 2 usage error.
 """
@@ -233,6 +234,90 @@ def emit_metrics_docs(root: Path, target: Path) -> str:
     )
 
 
+#: markers delimiting the generated block in docs/compilation.md
+COMPILE_BEGIN = (
+    "<!-- COMPILE_SURFACES:BEGIN — generated from engine/"
+    "compile_registry.py:COMPILE_SURFACES + engine/bucketing.py:"
+    "BUCKETING_HELPERS; regenerate: python -m dynamo_tpu.analysis"
+    " --emit-compile-docs -->"
+)
+COMPILE_END = "<!-- COMPILE_SURFACES:END -->"
+
+
+def render_compile_table(root: Path) -> str:
+    """Render the compile contract — COMPILE_SURFACES plus
+    BUCKETING_HELPERS — as markdown tables (parsed from the AST via the
+    comp pack's loaders, never imported — same contract as the fault,
+    sync, and metrics tables)."""
+    from .comp.registry import (
+        BUCKETING_MODULE,
+        COMPILE_MODULE,
+        load_bucketing_helpers,
+        load_compile_surfaces,
+    )
+    from .core import SourceFile
+
+    project = Project(root, [
+        SourceFile(root, root / COMPILE_MODULE),
+        SourceFile(root, root / BUCKETING_MODULE),
+    ])
+    surfaces, _, err = load_compile_surfaces(project)
+    if err is not None:
+        raise SystemExit(f"error: {err}")
+    helpers, _, err = load_bucketing_helpers(project)
+    if err is not None:
+        raise SystemExit(f"error: {err}")
+
+    def esc(s: str) -> str:
+        return s.replace("|", chr(92) + "|")
+
+    lines = [
+        "| Surface | Module | Kind | Donated | Static | Warmup "
+        "| Variant axes | What it stages |",
+        "|---|---|---|---|---|---|---|---|",
+    ]
+    for name, spec in surfaces.items():  # registry order is the doc order
+        module = spec["module"].removeprefix("dynamo_tpu/")
+        donate = ", ".join(str(i) for i in spec.get("donate", ())) or "—"
+        static = ", ".join(
+            f"`{s}`" for s in spec.get("static", ())
+        ) or "—"
+        axes = "; ".join(
+            f"`{ax}` ≤ {esc(bound)}"
+            for ax, bound in (spec.get("axes") or {}).items()
+        ) or "—"
+        warm = "yes" if spec.get("warmup") else "no (cold-compile OK)"
+        lines.append(
+            f"| `{name}` | `{module}` | {spec['kind']} | {donate} "
+            f"| {static} | {warm} | {axes} | {esc(spec.get('help', ''))} |"
+        )
+    lines += [
+        "",
+        "Registered bounded shape sources (`comp-shape-bucketing` "
+        "resolves dispatch-operand dimensions against these):",
+        "",
+        "| Helper | Module | Bound | Returns |",
+        "|---|---|---|---|",
+    ]
+    for name, spec in helpers.items():
+        module = spec["module"].removeprefix("dynamo_tpu/")
+        lines.append(
+            f"| `{name}` | `{module}` | {esc(spec.get('bound', ''))} "
+            f"| {esc(spec.get('returns', ''))} |"
+        )
+    return "\n".join(lines)
+
+
+def emit_compile_docs(root: Path, target: Path) -> str:
+    """Splice the generated compile-contract tables between the
+    COMPILE_SURFACES markers of `target` (docs/compilation.md) and
+    return the new content."""
+    return splice_generated(
+        target.read_text(), COMPILE_BEGIN, COMPILE_END,
+        render_compile_table(root), target, "COMPILE_SURFACES",
+    )
+
+
 def changed_files(root: Path, base: str) -> Optional[List[str]]:
     """Repo-relative .py paths under dynamo_tpu/ that differ from `base`
     (committed diff + working tree + untracked). None when git is
@@ -320,6 +405,15 @@ def main(argv=None) -> int:
         "PATH (default docs/observability.md; '-' = print the table) from "
         "runtime/metrics.py METRICS, and exit",
     )
+    parser.add_argument(
+        "--emit-compile-docs", nargs="?", const="docs/compilation.md",
+        metavar="PATH",
+        help="regenerate the compile-contract tables between the "
+        "COMPILE_SURFACES markers of PATH (default docs/compilation.md; "
+        "'-' = print the tables) from engine/compile_registry.py "
+        "COMPILE_SURFACES + engine/bucketing.py BUCKETING_HELPERS, and "
+        "exit",
+    )
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -373,6 +467,17 @@ def main(argv=None) -> int:
             if not target.is_absolute() and not target.exists():
                 target = root / args.emit_metrics_docs
             target.write_text(emit_metrics_docs(root, target))
+            print(f"wrote {target}")
+        return 0
+
+    if args.emit_compile_docs is not None:
+        if args.emit_compile_docs == "-":
+            sys.stdout.write(render_compile_table(root) + "\n")
+        else:
+            target = Path(args.emit_compile_docs)
+            if not target.is_absolute() and not target.exists():
+                target = root / args.emit_compile_docs
+            target.write_text(emit_compile_docs(root, target))
             print(f"wrote {target}")
         return 0
 

@@ -1,0 +1,43 @@
+"""dynocomp: the compile-contract pack.
+
+Four rules anchored to `engine/compile_registry.py:COMPILE_SURFACES`
+and `engine/bucketing.py:BUCKETING_HELPERS` (both AST-parsed, never
+imported): comp-surface-registry (every staged callsite resolves into
+the registry with its declared donation/static signature, stale entries
+fire), comp-shape-bucketing (dispatch-operand shape dimensions resolve
+to registered bucketing helpers or config bounds), comp-donation-safety
+(no caller reads a donated operand after the call returns), and
+comp-warmup-coverage (every warmup-obligated surface stays reachable
+from JaxEngine.warmup). See docs/static_analysis.md and
+docs/compilation.md.
+"""
+
+from .bucket import CompShapeBucketingRule
+from .donate import CompDonationSafetyRule
+from .registry import (
+    BUCKETING_MODULE,
+    COMPILE_MODULE,
+    load_bucketing_helpers,
+    load_compile_surfaces,
+)
+from .surface import CompSurfaceRegistryRule
+from .warmup import CompWarmupCoverageRule
+
+COMP_RULES = (
+    CompSurfaceRegistryRule,
+    CompShapeBucketingRule,
+    CompDonationSafetyRule,
+    CompWarmupCoverageRule,
+)
+
+__all__ = [
+    "BUCKETING_MODULE",
+    "COMPILE_MODULE",
+    "COMP_RULES",
+    "CompDonationSafetyRule",
+    "CompShapeBucketingRule",
+    "CompSurfaceRegistryRule",
+    "CompWarmupCoverageRule",
+    "load_bucketing_helpers",
+    "load_compile_surfaces",
+]

@@ -1,0 +1,175 @@
+"""Rule: comp-donation-safety — a donated operand is dead after the call.
+
+`donate_argnums` tells XLA it may alias a donated input's buffer into
+the outputs — the engine donates the KV pool into every decode/prefill
+program so a multi-GB carry updates in place instead of doubling HBM.
+The price: after the call returns, the caller's reference points at a
+buffer XLA may have overwritten. On CPU jax usually copies and the bug
+hides; on TPU a post-call read is silent wrong data — the worst failure
+mode serving has.
+
+The engine's safe idiom reassigns every donated carry in the SAME
+statement that makes the call (the carry-patch idiom):
+
+    first, self.kv_k, self.kv_v, self._rng = self._prefill_batch(
+        self.params, self.kv_k, self.kv_v, ..., self._rng, ...)
+
+The rule finds every call to a donating surface (COMPILE_SURFACES
+entries with a non-empty donate tuple, matched by dispatch name within
+the surface's own module) and, for each donated positional operand that
+names a readable path (local or `self.` attribute):
+
+  * same-statement reassignment of that path → safe;
+  * otherwise the first later use of the path in the calling function
+    decides: a store → safe (rebound before read), a read →
+    use-after-donate, fired at the reading line.
+
+Calls that forward `*args` are skipped (positions unknowable), as are
+operands that are expressions rather than named paths (temporaries
+nobody can read again). The match is textual-path, same-function — the
+race-pack's await-atomicity style: under-approximate, zero-noise.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Tuple
+
+from ..core import Project, Rule, Violation, dotted_name
+from ..shard.callgraph import _walk_with_chain
+from .registry import COMPILE_MODULE, accepted_names, load_compile_surfaces
+
+
+def _target_paths(stmt: ast.AST) -> set:
+    """Dotted paths (re)bound by an assignment statement's targets."""
+    out = set()
+    if isinstance(stmt, ast.Assign):
+        for tgt in stmt.targets:
+            els = tgt.elts if isinstance(tgt, (ast.Tuple, ast.List)) else [tgt]
+            for el in els:
+                if isinstance(el, ast.Starred):
+                    el = el.value
+                path = dotted_name(el)
+                if path:
+                    out.add(path)
+    return out
+
+
+def _uses_after(
+    func: ast.AST, path: str, after_line: int
+) -> Tuple[Optional[int], Optional[int]]:
+    """(first read line, first store line) of `path` strictly after
+    `after_line` in func's own scope (nested defs excluded: their
+    execution time is unknowable, so they neither accuse nor excuse)."""
+    first_read: Optional[int] = None
+    first_store: Optional[int] = None
+    stack = list(ast.iter_child_nodes(func))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            continue
+        if isinstance(node, ast.Assign) and node.lineno > after_line:
+            if path in _target_paths(node):
+                if first_store is None or node.lineno < first_store:
+                    first_store = node.lineno
+            # the RHS may also read the path — the generic walk below
+            # sees it (Load context nodes inside node.value)
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            if (
+                isinstance(getattr(node, "ctx", None), ast.Load)
+                and node.lineno > after_line
+                and dotted_name(node) == path
+            ):
+                if first_read is None or node.lineno < first_read:
+                    first_read = node.lineno
+        stack.extend(ast.iter_child_nodes(node))
+    return first_read, first_store
+
+
+class CompDonationSafetyRule(Rule):
+    name = "comp-donation-safety"
+    description = (
+        "an operand donated by position to a staged surface must not be "
+        "read in the caller after the call returns — reassign the carry "
+        "in the call statement (use-after-donate is silent wrong data "
+        "on TPU)"
+    )
+
+    def check(self, project: Project) -> Iterator[Violation]:
+        surfaces, _, err = load_compile_surfaces(project)
+        if err is not None:
+            yield Violation(self.name, COMPILE_MODULE, 1, err)
+            return
+        donating = {
+            key: spec for key, spec in surfaces.items()
+            if spec.get("donate")
+        }
+        by_module = {}
+        for key, spec in donating.items():
+            names = accepted_names(key, spec)
+            by_module.setdefault(spec["module"], []).append((key, names))
+        for rel, entries in by_module.items():
+            src = project.get(rel)
+            if src is None:
+                continue
+            # statement owning each expression node, for same-statement
+            # carry detection
+            stmt_of = {}
+            for node in ast.walk(src.tree):
+                if isinstance(node, ast.stmt):
+                    # ast.walk is breadth-first, so deeper statements are
+                    # visited later: plain assignment leaves each
+                    # expression mapped to its INNERMOST enclosing stmt
+                    # (the Assign, not the surrounding ClassDef)
+                    for sub in ast.walk(node):
+                        stmt_of[id(sub)] = node
+            for node, chain in _walk_with_chain(src.tree):
+                if not isinstance(node, ast.Call) or not chain:
+                    continue
+                fname = dotted_name(node.func)
+                if not fname:
+                    continue
+                tail = fname.rsplit(".", 1)[-1]
+                hit = None
+                for k, names in entries:
+                    if tail in names or tail.lstrip("_") == k:
+                        hit = k
+                        break
+                if hit is None:
+                    continue
+                key, spec = hit, donating[hit]
+                func = chain[-1]
+                if func.name in accepted_names(key, spec):
+                    # the staged def itself (self-recursion inside the
+                    # surface) is device code, not a host caller
+                    continue
+                if any(isinstance(a, ast.Starred) for a in node.args):
+                    continue
+                stmt = stmt_of.get(id(node))
+                rebound = _target_paths(stmt) if stmt is not None else set()
+                end_line = (
+                    getattr(stmt, "end_lineno", None) or node.lineno
+                    if stmt is not None else node.lineno
+                )
+                for pos in spec["donate"]:
+                    if pos >= len(node.args):
+                        continue
+                    path = dotted_name(node.args[pos])
+                    if not path or path in rebound:
+                        continue
+                    read, store = _uses_after(func, path, end_line)
+                    if read is not None and (
+                        store is None or read <= store
+                    ):
+                        yield Violation(
+                            self.name, src.rel, read,
+                            f"'{path}' was donated to '{key}' (operand "
+                            f"{pos}, donate_argnums="
+                            f"{tuple(spec['donate'])}) at line "
+                            f"{node.lineno} and is read here without "
+                            "being rebound — after donation the buffer "
+                            "may be aliased into the outputs and this "
+                            "read is silent wrong data on TPU; rebind "
+                            "the carry in the call statement (the "
+                            "engine's carry-patch idiom) or pass a copy",
+                        )

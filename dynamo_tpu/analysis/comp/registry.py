@@ -1,0 +1,170 @@
+"""Compile-contract registry extraction (AST-parsed, never imported).
+
+Two tables anchor the comp pack, both read straight out of the AST so
+the checker runs on hosts without jax importable (the ENV_REGISTRY /
+KNOWN_FAULT_POINTS / GUARDED_STATE / METRICS contract):
+
+  * `engine/compile_registry.py:COMPILE_SURFACES` — one entry per
+    staged surface (module, kind, donate, static, axes, warmup,
+    dispatch aliases, help);
+  * `engine/bucketing.py:BUCKETING_HELPERS` — the bounded shape
+    sources comp-shape-bucketing resolves dispatch-operand dimensions
+    against.
+
+Every value must stay a pure literal (`ast.literal_eval`-able) and
+every key a string literal; ** merges and duplicate keys are malformed.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Optional, Tuple
+
+from ..core import Project, str_const
+
+COMPILE_MODULE = "dynamo_tpu/engine/compile_registry.py"
+BUCKETING_MODULE = "dynamo_tpu/engine/bucketing.py"
+
+VALID_KINDS = {"jit", "pjit", "shard_map", "pallas_call"}
+
+#: package dirs the comp rules scan for staged callsites
+SCOPES = ("engine/", "ops/", "models/", "llm/", "planner/")
+
+
+def _load_literal_table(
+    project: Project, module: str, var: str
+) -> Tuple[Optional[Dict[str, dict]], Optional[Dict[str, int]], Optional[str]]:
+    """Shared loader: parse `var` (a pure-literal dict keyed by string
+    literals) out of `module`. Returns (entries, key_lines, error)."""
+    src = project.get(module)
+    if src is None:
+        return None, None, f"{module} not found: the {var} registry is gone"
+    table: Optional[ast.Dict] = None
+    for node in src.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt = node.targets[0]
+            if isinstance(tgt, ast.Name) and tgt.id == var and isinstance(
+                node.value, ast.Dict
+            ):
+                table = node.value
+    if table is None:
+        return None, None, (
+            f"{module} defines no {var} dict literal — the comp rules "
+            "need the compile contract as their source of truth"
+        )
+    entries: Dict[str, dict] = {}
+    lines: Dict[str, int] = {}
+    for k, v in zip(table.keys, table.values):
+        if k is None:
+            return None, None, (
+                f"{module}: {var} must not use ** merges — every entry "
+                "must be spelled at its own line"
+            )
+        name = str_const(k)
+        if name is None:
+            return None, None, (
+                f"{module}: {var} key {ast.dump(k)} is not a string "
+                "literal"
+            )
+        try:
+            spec = ast.literal_eval(v)
+        except (ValueError, SyntaxError):
+            return None, None, (
+                f"{module}: {var}['{name}'] value is not a pure "
+                "literal — the registry must stay literal_eval-able"
+            )
+        if not isinstance(spec, dict):
+            return None, None, f"{module}: {var}['{name}'] must be a dict"
+        if name in entries:
+            return None, None, f"{module}: {var} registers '{name}' twice"
+        entries[name] = spec
+        lines[name] = k.lineno
+    return entries, lines, None
+
+
+def load_compile_surfaces(
+    project: Project,
+) -> Tuple[Optional[Dict[str, dict]], Optional[Dict[str, int]], Optional[str]]:
+    """Parse COMPILE_SURFACES out of engine/compile_registry.py.
+
+    Returns (entries, lines, error): entries maps surface key -> spec
+    dict; lines maps surface key -> registry line for anchoring
+    stale-entry and warmup-gap findings; error is a human message when
+    the registry is missing or malformed.
+    """
+    entries, lines, err = _load_literal_table(
+        project, COMPILE_MODULE, "COMPILE_SURFACES"
+    )
+    if err is not None:
+        return None, None, err
+    for name, spec in entries.items():
+        kind = spec.get("kind")
+        if kind not in VALID_KINDS:
+            return None, None, (
+                f"{COMPILE_MODULE}: COMPILE_SURFACES['{name}'] kind "
+                f"{kind!r} is not one of {sorted(VALID_KINDS)}"
+            )
+        module = spec.get("module")
+        if not isinstance(module, str) or not module.endswith(".py"):
+            return None, None, (
+                f"{COMPILE_MODULE}: COMPILE_SURFACES['{name}'] module "
+                f"{module!r} is not a .py path"
+            )
+        donate = spec.get("donate", ())
+        if not isinstance(donate, tuple) or not all(
+            isinstance(i, int) for i in donate
+        ):
+            return None, None, (
+                f"{COMPILE_MODULE}: COMPILE_SURFACES['{name}'] donate "
+                f"{donate!r} must be a tuple of argument positions"
+            )
+        static = spec.get("static", ())
+        if not isinstance(static, tuple) or not all(
+            isinstance(s, (int, str)) for s in static
+        ):
+            return None, None, (
+                f"{COMPILE_MODULE}: COMPILE_SURFACES['{name}'] static "
+                f"{static!r} must be a tuple of names or positions"
+            )
+        if not isinstance(spec.get("warmup"), bool):
+            return None, None, (
+                f"{COMPILE_MODULE}: COMPILE_SURFACES['{name}'] must "
+                "declare warmup: True/False explicitly"
+            )
+        dispatch = spec.get("dispatch", ())
+        if not isinstance(dispatch, tuple) or not all(
+            isinstance(d, str) for d in dispatch
+        ):
+            return None, None, (
+                f"{COMPILE_MODULE}: COMPILE_SURFACES['{name}'] dispatch "
+                f"{dispatch!r} must be a tuple of caller-side names"
+            )
+    return entries, lines, None
+
+
+def load_bucketing_helpers(
+    project: Project,
+) -> Tuple[Optional[Dict[str, dict]], Optional[Dict[str, int]], Optional[str]]:
+    """Parse BUCKETING_HELPERS out of engine/bucketing.py. Same shape as
+    load_compile_surfaces; keys are bare helper names (callsites match
+    with leading underscores stripped)."""
+    entries, lines, err = _load_literal_table(
+        project, BUCKETING_MODULE, "BUCKETING_HELPERS"
+    )
+    if err is not None:
+        return None, None, err
+    for name in entries:
+        if name.startswith("_"):
+            return None, None, (
+                f"{BUCKETING_MODULE}: BUCKETING_HELPERS key '{name}' must "
+                "be the bare helper name (callsites strip leading "
+                "underscores when matching)"
+            )
+    return entries, lines, None
+
+
+def accepted_names(key: str, spec: dict) -> set:
+    """Caller-side and def-side names that resolve to a surface entry:
+    the key itself, `_<key>` (the engine's bound-attribute convention),
+    and any declared dispatch aliases."""
+    return {key, "_" + key} | set(spec.get("dispatch", ()))

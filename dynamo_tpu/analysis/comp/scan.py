@@ -1,0 +1,223 @@
+"""Staged-callsite discovery for the comp pack.
+
+Finds every jit/pjit/shard_map/pallas_call staging point in the scoped
+package dirs and resolves each to the NAME a maintainer (and the
+COMPILE_SURFACES registry) knows it by:
+
+  * a jit-decorated def (`@jax.jit`, `@partial(jax.jit, ...)`) — the
+    def's own name;
+  * a jit call assigned to a binding (`self._fwd = jax.jit(...)`,
+    `decode_step = jax.jit(_decode, ...)`) — the assignment target's
+    tail name;
+  * a shard_map staging call — the simple name of the function being
+    mapped, resolved through `functools.partial`;
+  * a bare `pl.pallas_call(...)` — the enclosing def's name (the ops
+    kernels stage pallas_call inside their jit wrapper, so the site
+    resolves into the wrapper's registry entry).
+
+Each site also carries the donate/static signature spelled at the
+callsite so comp-surface-registry can diff it against the registry's
+declared contract.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from ..core import Project, SourceFile, dotted_name
+from ..shard.callgraph import _walk_with_chain
+from .registry import SCOPES
+
+_JIT_NAMES = {"jit", "jax.jit", "pjit", "jax.pjit"}
+_SHARD_MAP_NAMES = {
+    "shard_map", "jax.shard_map", "jax.experimental.shard_map.shard_map",
+}
+_PALLAS_NAMES = {"pallas_call", "pl.pallas_call"}
+_PARTIAL_NAMES = {"partial", "functools.partial"}
+
+
+@dataclasses.dataclass
+class StagedSite:
+    """One staging point: where, what kind, and the declared contract."""
+
+    src: SourceFile
+    line: int
+    kind: str  # "jit" | "pjit" | "shard_map" | "pallas_call"
+    name: Optional[str]  # resolved surface-side name; None = unresolvable
+    enclosing: Tuple[str, ...]  # enclosing def names, outermost first
+    donate: Optional[tuple]  # donate_argnums literal; None = not literal
+    static: Optional[tuple]  # static_argnames/nums literal
+    has_donate_kw: bool = False
+    has_static_kw: bool = False
+
+
+def _literal_tuple(node: Optional[ast.AST]) -> Optional[tuple]:
+    """A donate/static keyword value as a tuple, or None when it is not
+    a pure literal (the registry diff is skipped, not guessed)."""
+    if node is None:
+        return None
+    try:
+        val = ast.literal_eval(node)
+    except (ValueError, SyntaxError):
+        return None
+    if isinstance(val, (int, str)):
+        return (val,)
+    if isinstance(val, (tuple, list)):
+        return tuple(val)
+    return None
+
+
+def _staging_signature(call: ast.Call) -> Tuple[Optional[tuple], Optional[tuple], bool, bool]:
+    """(donate, static, has_donate_kw, has_static_kw) from a jit call's
+    keywords."""
+    donate = static = None
+    has_d = has_s = False
+    for kw in call.keywords:
+        if kw.arg in ("donate_argnums", "donate_argnames"):
+            has_d = True
+            donate = _literal_tuple(kw.value)
+        elif kw.arg in ("static_argnums", "static_argnames"):
+            has_s = True
+            static = _literal_tuple(kw.value)
+    return donate, static, has_d, has_s
+
+
+def _jit_call_of(dec: ast.AST) -> Optional[ast.Call]:
+    """The jit Call carrying the signature keywords for a decorator:
+    `@jax.jit` → None (bare, no keywords), `@jax.jit(...)` → that call,
+    `@partial(jax.jit, donate_argnums=...)` → the partial call (its
+    keywords ARE jit's keywords)."""
+    if isinstance(dec, ast.Call):
+        inner = dotted_name(dec.func)
+        if inner in _JIT_NAMES:
+            return dec
+        if inner in _PARTIAL_NAMES and dec.args and (
+            dotted_name(dec.args[0]) in _JIT_NAMES
+        ):
+            return dec
+    return None
+
+
+def _is_jit_decorator(dec: ast.AST) -> bool:
+    if dotted_name(dec) in _JIT_NAMES:
+        return True
+    return _jit_call_of(dec) is not None
+
+
+def _kind_of(name: str) -> str:
+    return "pjit" if name.rsplit(".", 1)[-1] == "pjit" else "jit"
+
+
+def _first_arg_name(call: ast.Call) -> Optional[str]:
+    """Simple name of the function a shard_map stages, through partial."""
+    if not call.args:
+        return None
+    arg = call.args[0]
+    if isinstance(arg, ast.Call) and dotted_name(arg.func) in _PARTIAL_NAMES:
+        if not arg.args:
+            return None
+        arg = arg.args[0]
+    name = dotted_name(arg)
+    return name.rsplit(".", 1)[-1] if name else None
+
+
+def find_staged_sites(project: Project) -> List[StagedSite]:
+    """Every staging point in the scoped dirs, identity-resolved."""
+    sites: List[StagedSite] = []
+    for src in project.in_scope(SCOPES):
+        # jit calls consumed as decorators are reported through their def;
+        # collect them so the call walk below skips the same node
+        decorator_ids = set()
+        assign_of: Dict[int, ast.Assign] = {}  # id(value-subtree node) -> stmt
+        for node, chain in _walk_with_chain(src.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    decorator_ids.add(id(dec))
+                    if not _is_jit_decorator(dec):
+                        continue
+                    jit_call = _jit_call_of(dec)
+                    if jit_call is None:
+                        donate, static, has_d, has_s = None, None, False, False
+                    else:
+                        donate, static, has_d, has_s = _staging_signature(
+                            jit_call
+                        )
+                    dec_name = dotted_name(dec) or dotted_name(
+                        getattr(dec, "func", dec)
+                    )
+                    if isinstance(dec, ast.Call) and dotted_name(
+                        dec.func
+                    ) in _PARTIAL_NAMES:
+                        dec_name = dotted_name(dec.args[0])
+                    sites.append(StagedSite(
+                        src=src, line=node.lineno, kind=_kind_of(dec_name),
+                        name=node.name,
+                        enclosing=tuple(f.name for f in chain),
+                        donate=donate if has_d else (),
+                        static=static if has_s else (),
+                        has_donate_kw=has_d, has_static_kw=has_s,
+                    ))
+            elif isinstance(node, ast.Assign):
+                for sub in ast.walk(node.value):
+                    assign_of[id(sub)] = node
+        for node, chain in _walk_with_chain(src.tree):
+            if not isinstance(node, ast.Call) or id(node) in decorator_ids:
+                continue
+            fname = dotted_name(node.func)
+            if not fname:
+                continue
+            if fname in _JIT_NAMES:
+                donate, static, has_d, has_s = _staging_signature(node)
+                stmt = assign_of.get(id(node))
+                name = None
+                if stmt is not None and len(stmt.targets) == 1:
+                    tgt = dotted_name(stmt.targets[0])
+                    if tgt:
+                        name = tgt.rsplit(".", 1)[-1]
+                sites.append(StagedSite(
+                    src=src, line=node.lineno, kind=_kind_of(fname),
+                    name=name, enclosing=tuple(f.name for f in chain),
+                    donate=donate if has_d else (),
+                    static=static if has_s else (),
+                    has_donate_kw=has_d, has_static_kw=has_s,
+                ))
+            elif fname in _SHARD_MAP_NAMES:
+                sites.append(StagedSite(
+                    src=src, line=node.lineno, kind="shard_map",
+                    name=_first_arg_name(node),
+                    enclosing=tuple(f.name for f in chain),
+                    donate=(), static=(),
+                ))
+            elif fname in _PALLAS_NAMES:
+                encl = tuple(f.name for f in chain)
+                sites.append(StagedSite(
+                    src=src, line=node.lineno, kind="pallas_call",
+                    name=encl[-1] if encl else None,
+                    enclosing=encl, donate=(), static=(),
+                ))
+    return sites
+
+
+def match_entry(
+    site: StagedSite, surfaces: Dict[str, dict]
+) -> Optional[str]:
+    """The registry key a site resolves to, or None.
+
+    A site matches an entry when the modules agree and the site's name
+    (or its enclosing def, for pallas_call staged inside a registered
+    jit wrapper) is one of the entry's accepted names — the key, the
+    `_<key>` attribute spelling, or a declared dispatch alias.
+    """
+    from .registry import accepted_names
+
+    if site.name is None:
+        return None
+    for key, spec in surfaces.items():
+        if spec.get("module") != site.src.rel:
+            continue
+        names = accepted_names(key, spec)
+        if site.name in names or site.name.lstrip("_") == key:
+            return key
+    return None

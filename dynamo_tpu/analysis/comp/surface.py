@@ -1,0 +1,109 @@
+"""Rule: comp-surface-registry — every staged surface is in the contract.
+
+The compile contract is only worth enforcing if it is complete: a jit
+closure added without a COMPILE_SURFACES entry is a surface the other
+three comp rules (bucketing, donation, warmup) silently do not see, and
+a registry entry whose surface was renamed or deleted is documentation
+lying about the binary. Both directions fire:
+
+  * a jit/pjit/shard_map/pallas_call staging point in the scoped dirs
+    that resolves into no registry entry — at the callsite;
+  * a registry entry no staging point matches — at its registry line;
+  * a matched callsite whose spelled donate_argnums / static_argnames
+    disagree with the registry's declared signature — at the callsite
+    (the registry is the reviewed contract; the code drifted).
+
+pallas_call staged inside a registered jit wrapper resolves into the
+wrapper's entry (one surface, two staging layers), and signature diffs
+are only checked where the signature is spelled (jit sites with literal
+keywords).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from ..core import Project, Rule, Violation
+from .registry import COMPILE_MODULE, load_compile_surfaces
+from .scan import find_staged_sites, match_entry
+
+
+class CompSurfaceRegistryRule(Rule):
+    name = "comp-surface-registry"
+    description = (
+        "every jit/pjit/shard_map/pallas_call staging point resolves into "
+        "engine/compile_registry.py:COMPILE_SURFACES with the declared "
+        "donation/static signature; stale entries fire at their registry "
+        "line"
+    )
+
+    def check(self, project: Project) -> Iterator[Violation]:
+        surfaces, lines, err = load_compile_surfaces(project)
+        if err is not None:
+            yield Violation(self.name, COMPILE_MODULE, 1, err)
+            return
+        matched = set()
+        for site in find_staged_sites(project):
+            key = match_entry(site, surfaces)
+            if key is None:
+                what = (
+                    f"'{site.name}'" if site.name
+                    else "(could not resolve a surface name — stage it as "
+                    "a named def or a named binding)"
+                )
+                yield Violation(
+                    self.name, site.src.rel, site.line,
+                    f"staged surface {what} ({site.kind}) is not in "
+                    f"COMPILE_SURFACES — every compile surface must "
+                    f"declare its variant axes, donation signature, and "
+                    f"warmup obligation in {COMPILE_MODULE}",
+                )
+                continue
+            matched.add(key)
+            spec = surfaces[key]
+            if site.kind in ("jit", "pjit"):
+                if site.donate is not None:
+                    declared = tuple(sorted(spec.get("donate", ())))
+                    spelled = tuple(sorted(site.donate))
+                    if spelled != declared:
+                        yield Violation(
+                            self.name, site.src.rel, site.line,
+                            f"'{key}' spells donate_argnums={spelled} but "
+                            f"COMPILE_SURFACES['{key}'] declares "
+                            f"{declared} — donation is part of the "
+                            "reviewed compile contract (memory aliasing "
+                            "AND use-after-donate surface); update the "
+                            "registry in the same change",
+                        )
+                if site.static is not None:
+                    declared = tuple(sorted(spec.get("static", ()), key=str))
+                    spelled = tuple(sorted(site.static, key=str))
+                    if spelled != declared:
+                        yield Violation(
+                            self.name, site.src.rel, site.line,
+                            f"'{key}' spells static args {spelled} but "
+                            f"COMPILE_SURFACES['{key}'] declares "
+                            f"{declared}",
+                        )
+            elif site.kind != spec.get("kind"):
+                # a pallas_call inside a registered jit wrapper is the
+                # same surface; any other kind drift is a real rewrite
+                if not (
+                    site.kind == "pallas_call"
+                    and spec.get("kind") in ("jit", "pjit")
+                ):
+                    yield Violation(
+                        self.name, site.src.rel, site.line,
+                        f"'{key}' is staged via {site.kind} but "
+                        f"COMPILE_SURFACES['{key}'] declares kind "
+                        f"'{spec.get('kind')}'",
+                    )
+        for key in surfaces:
+            if key not in matched:
+                yield Violation(
+                    self.name, COMPILE_MODULE, lines[key],
+                    f"COMPILE_SURFACES['{key}'] matches no staged "
+                    f"callsite in {surfaces[key].get('module')} — stale "
+                    "entry (surface renamed or deleted); registry and "
+                    "code must move together",
+                )

@@ -1,5 +1,12 @@
 """dynolint rule pack: the invariants this codebase has been burned by."""
 
+from ..comp import (
+    COMP_RULES,
+    CompDonationSafetyRule,
+    CompShapeBucketingRule,
+    CompSurfaceRegistryRule,
+    CompWarmupCoverageRule,
+)
 from ..flow import (
     FLOW_RULES,
     CancellationSafetyRule,
@@ -36,7 +43,10 @@ CORE_RULES = (
     LockDisciplineRule,
 )
 
-ALL_RULES = CORE_RULES + SHARD_RULES + FLOW_RULES + RACE_RULES + MET_RULES
+ALL_RULES = (
+    CORE_RULES + SHARD_RULES + FLOW_RULES + RACE_RULES + MET_RULES
+    + COMP_RULES
+)
 
 #: pack aliases accepted by the CLI's --rules (e.g. `--rules shard`)
 PACKS = {
@@ -45,6 +55,7 @@ PACKS = {
     "flow": FLOW_RULES,
     "race": RACE_RULES,
     "met": MET_RULES,
+    "comp": COMP_RULES,
 }
 
 
@@ -54,6 +65,7 @@ def default_rules():
 
 __all__ = [
     "ALL_RULES",
+    "COMP_RULES",
     "CORE_RULES",
     "FLOW_RULES",
     "MET_RULES",
@@ -63,6 +75,10 @@ __all__ = [
     "AxisRegistryRule",
     "CancellationSafetyRule",
     "CollectiveSymmetryRule",
+    "CompDonationSafetyRule",
+    "CompShapeBucketingRule",
+    "CompSurfaceRegistryRule",
+    "CompWarmupCoverageRule",
     "EnvRegistryRule",
     "FaultPointRegistryRule",
     "FrameProtocolRule",

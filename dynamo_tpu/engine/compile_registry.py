@@ -1,0 +1,336 @@
+"""COMPILE_SURFACES: the compile contract, one entry per staged surface.
+
+Every jit/pjit/shard_map/pallas_call-staged computation in engine/, ops/,
+models/, llm/, and planner/ is named here with the contract the
+`comp-*` dynolint rules enforce:
+
+  module   repo-relative file the staged callsite lives in
+  kind     "jit" | "pjit" | "shard_map" | "pallas_call"
+  donate   donate_argnums the callsite must declare, () for none.
+           Donation is the TPU memory-headroom lever (a decode block
+           donates the KV pool so XLA aliases instead of copying ~GBs),
+           and also the sharp edge comp-donation-safety guards: reading
+           a donated buffer in the caller after the call returns is
+           silent wrong data.
+  static   static_argnames/static_argnums the callsite must declare.
+  axes     operand-shape dimensions that select the compile variant,
+           mapped to the bound that keeps the variant space finite.
+           Purely documentary (rendered into docs/compilation.md); the
+           enforcement lives in comp-shape-bucketing's taint analysis
+           against bucketing.BUCKETING_HELPERS.
+  warmup   True when the surface serves the request path and must be
+           reachable from JaxEngine.warmup's compile drive — a
+           serving-reachable variant missing from warmup is a 20-40s
+           cold-compile TTFT spike on a live fleet (comp-warmup-coverage).
+           False for offline tools (planner profiler) and surfaces only
+           reached by KV-transfer RPCs, which compile on first use by
+           design.
+  dispatch optional alternate caller-side names (the engine stores
+           `spec_block` as `self._spec_block_fn`); `_<key>` is always
+           accepted without being spelled.
+  help     one line for the generated docs table.
+
+Parsed from the AST, NEVER imported (the ENV_REGISTRY / KNOWN_FAULT_POINTS
+/ GUARDED_STATE / METRICS discipline: the checker runs on hosts without
+jax importable), so every value must stay a pure literal. The runtime
+reads its own copy of the surface names through
+`JaxEngine._compiled_surfaces` (engine.py) — the comp-surface-registry
+rule is what keeps this table and the code from drifting apart.
+"""
+
+COMPILE_SURFACES = {
+    # ----------------------------------------------------------------- #
+    # engine/engine.py — the serving dispatch closures built in _compile()
+    # ----------------------------------------------------------------- #
+    "decode_block": {
+        "module": "dynamo_tpu/engine/engine.py",
+        "kind": "jit",
+        "donate": (1, 2, 8, 9),
+        "static": (),
+        "axes": {
+            "B": "config.max_num_seqs (fixed lane count)",
+            "K": "config.decode_block_steps (fused steps)",
+        },
+        "warmup": True,
+        "help": "K fused decode steps over all lanes; one variant total "
+                "(two bodies: pool-local vs per-step scatter, picked by "
+                "decode_pool_mode at compile time)",
+    },
+    "spec_block": {
+        "module": "dynamo_tpu/engine/engine.py",
+        "kind": "jit",
+        "donate": (1, 2, 8, 9),
+        "static": (),
+        "axes": {
+            "B": "config.max_num_seqs",
+            "S": "config.spec_rounds (draft-verify rounds)",
+        },
+        "warmup": True,
+        "dispatch": ("_spec_block_fn",),
+        "help": "speculative decode: S n-gram draft-verify rounds per "
+                "dispatch",
+    },
+    "prefill_batch": {
+        "module": "dynamo_tpu/engine/engine.py",
+        "kind": "jit",
+        "donate": (1, 2, 9),
+        "static": (),
+        "axes": {
+            "lanes": "plan_prefill (1 or per-bucket lane cap)",
+            "bucket": "plan_prefill (config.prefill_buckets ladder)",
+            "P": "min(next_pow2(pages), config.max_pages_per_seq) + 1",
+        },
+        "warmup": True,
+        "help": "chunked batched prefill; variant per (bucket, lanes, "
+                "page-table bucket)",
+    },
+    "mixed_step": {
+        "module": "dynamo_tpu/engine/engine.py",
+        "kind": "jit",
+        "donate": (1, 2, 12),
+        "static": (),
+        "axes": {
+            "N": "plan_mixed / min(next_pow2(tokens), aligned "
+                 "config.mixed_max_tokens)",
+            "R": "next_pow2(config.max_num_seqs + config.max_prefill_batch)",
+            "P": "min(next_pow2(pages), config.max_pages_per_seq) + 1",
+        },
+        "warmup": True,
+        "help": "ragged prefill+decode fusion over the token dimension",
+    },
+    "prefill_batch_mm": {
+        "module": "dynamo_tpu/engine/engine.py",
+        "kind": "jit",
+        "donate": (1, 2, 9),
+        "static": (),
+        "axes": {
+            "lanes": "plan_prefill",
+            "bucket": "plan_prefill",
+            "E": "vit config.n_patches (fixed embed count)",
+        },
+        "warmup": True,
+        "help": "prefill with multimodal embedding scatter into the token "
+                "stream",
+    },
+    "decode_step_guided": {
+        "module": "dynamo_tpu/engine/engine.py",
+        "kind": "jit",
+        "donate": (1, 2, 8, 10),
+        "static": (),
+        "axes": {
+            "B": "config.max_num_seqs",
+            "V8": "(vocab_size + 7) // 8 (packed grammar mask)",
+        },
+        "warmup": True,
+        "help": "single guided-decoding step with grammar-mask logit "
+                "filtering",
+    },
+    "decode_step_guided_lora": {
+        "module": "dynamo_tpu/engine/engine.py",
+        "kind": "jit",
+        "donate": (1, 2, 8, 10),
+        "static": (),
+        "axes": {
+            "B": "config.max_num_seqs",
+            "V8": "(vocab_size + 7) // 8",
+            "rank": "config.lora_rank (fixed)",
+        },
+        "warmup": True,
+        "help": "guided step through per-lane LoRA deltas",
+    },
+    "prefill_batch_guided": {
+        "module": "dynamo_tpu/engine/engine.py",
+        "kind": "jit",
+        "donate": (1, 2, 9),
+        "static": (),
+        "axes": {
+            "lanes": "plan_prefill",
+            "bucket": "plan_prefill",
+            "V8": "(vocab_size + 7) // 8",
+        },
+        "warmup": True,
+        "help": "batched prefill whose last-token logits pass the grammar "
+                "mask",
+    },
+    "decode_block_lora": {
+        "module": "dynamo_tpu/engine/engine.py",
+        "kind": "jit",
+        "donate": (1, 2, 8, 9),
+        "static": (),
+        "axes": {
+            "B": "config.max_num_seqs",
+            "K": "config.decode_block_steps",
+            "rank": "config.lora_rank (fixed)",
+        },
+        "warmup": True,
+        "help": "K fused decode steps through per-lane LoRA deltas",
+    },
+    "prefill_batch_lora": {
+        "module": "dynamo_tpu/engine/engine.py",
+        "kind": "jit",
+        "donate": (1, 2, 9),
+        "static": (),
+        "axes": {
+            "lanes": "plan_prefill",
+            "bucket": "plan_prefill",
+            "rank": "config.lora_rank (fixed)",
+        },
+        "warmup": True,
+        "help": "batched prefill through per-lane LoRA deltas",
+    },
+    "prefill_single": {
+        "module": "dynamo_tpu/engine/engine.py",
+        "kind": "jit",
+        "donate": (1, 2, 7),
+        "static": (),
+        "axes": {
+            "T": "next_pow2(chunk) rounded to sp/pp unit "
+                 "(admission-bounded prompt)",
+            "P": "min(next_pow2(pages), config.max_pages_per_seq) + 1",
+        },
+        "warmup": True,
+        "help": "whole-prompt single-sequence prefill through the ring/"
+                "pipeline parallel path (compiled only when sp/pp > 1)",
+    },
+    "patch_lanes": {
+        "module": "dynamo_tpu/engine/engine.py",
+        "kind": "jit",
+        "donate": (),
+        "static": (),
+        "axes": {"B": "config.max_num_seqs"},
+        "warmup": True,
+        "help": "masked on-device swap of per-lane decode state at slot "
+                "turnover (no donation: old carry is the fallback for "
+                "unmasked lanes)",
+    },
+    "extract_pages": {
+        "module": "dynamo_tpu/engine/engine.py",
+        "kind": "jit",
+        "donate": (),
+        "static": (),
+        "axes": {"n": "gather width = len(page_ids) (pow2-bucketed by "
+                      "the KV-transfer batcher)"},
+        "warmup": False,
+        "help": "KV page gather for migration/offload export; reached "
+                "only by KV-transfer RPCs, compiles on first transfer",
+    },
+    "inject_pages": {
+        "module": "dynamo_tpu/engine/engine.py",
+        "kind": "jit",
+        "donate": (0, 1),
+        "static": (),
+        "axes": {"n": "scatter width = len(page_ids)"},
+        "warmup": False,
+        "help": "KV page scatter for migration/onboard import; donates "
+                "the pool (aliased in-place update)",
+    },
+    # ----------------------------------------------------------------- #
+    # ops/ — attention kernels (jit wrappers staging pallas_call bodies)
+    # ----------------------------------------------------------------- #
+    "paged_attention_decode_pallas_local": {
+        "module": "dynamo_tpu/ops/pallas_paged_attention.py",
+        "kind": "jit",
+        "donate": (),
+        "static": ("interpret",),
+        "axes": {
+            "B": "caller lane count (engine: config.max_num_seqs)",
+            "pages": "caller page-table bucket",
+        },
+        "warmup": True,
+        "help": "fused decode attention merging block-local K/V with the "
+                "paged pool (decode_pool_mode=local)",
+    },
+    "paged_attention_decode_pallas": {
+        "module": "dynamo_tpu/ops/pallas_paged_attention.py",
+        "kind": "jit",
+        "donate": (),
+        "static": ("interpret",),
+        "axes": {
+            "B": "caller lane count",
+            "pages": "caller page-table bucket",
+        },
+        "warmup": True,
+        "help": "paged flash decode attention over the scattered pool",
+    },
+    "ragged_paged_attention_pallas": {
+        "module": "dynamo_tpu/ops/pallas_ragged_attention.py",
+        "kind": "jit",
+        "donate": (),
+        "static": ("interpret",),
+        "axes": {
+            "N": "caller token bucket (mixed_step N)",
+            "tiles": "N / ragged_tile_q(dtype)",
+        },
+        "warmup": True,
+        "help": "ragged paged attention over mixed prefill+decode token "
+                "rows",
+    },
+    "paged_prefill_attention_pallas_batched": {
+        "module": "dynamo_tpu/ops/pallas_prefill_attention.py",
+        "kind": "jit",
+        "donate": (),
+        "static": ("interpret",),
+        "axes": {
+            "B": "caller lane count",
+            "T": "caller chunk bucket",
+        },
+        "warmup": True,
+        "help": "batched causal prefill attention against the paged pool",
+    },
+    "ring_attention_local": {
+        "module": "dynamo_tpu/ops/ring_attention.py",
+        "kind": "shard_map",
+        "donate": (),
+        "static": (),
+        "axes": {
+            "T/sp": "sequence shard = caller T / config.sp_size",
+        },
+        "warmup": True,
+        "dispatch": ("_ring_attention_local",),
+        "help": "sequence-parallel ring attention shard program "
+                "(prefill_single path, sp > 1)",
+    },
+    # ----------------------------------------------------------------- #
+    # llm/ — multimodal encoder
+    # ----------------------------------------------------------------- #
+    "vit_encode": {
+        "module": "dynamo_tpu/llm/multimodal.py",
+        "kind": "jit",
+        "donate": (),
+        "static": (),
+        "axes": {
+            "px": "(num_channels, image_size, image_size) — config-fixed, "
+                  "one variant",
+        },
+        "warmup": True,
+        "dispatch": ("_fwd",),
+        "help": "ViT image-to-embedding forward; single config-fixed "
+                "pixel shape",
+    },
+    # ----------------------------------------------------------------- #
+    # planner/ — offline profiler (not serving-path; no warmup claim)
+    # ----------------------------------------------------------------- #
+    "profiler_prefill": {
+        "module": "dynamo_tpu/planner/profiler.py",
+        "kind": "jit",
+        "donate": (1, 2),
+        "static": (),
+        "axes": {"isl": "isl_grid sweep points (offline, one compile per "
+                        "grid point by design)"},
+        "warmup": False,
+        "dispatch": ("prefill",),
+        "help": "offline prefill timing probe for the planner's "
+                "interpolation tables",
+    },
+    "profiler_decode_step": {
+        "module": "dynamo_tpu/planner/profiler.py",
+        "kind": "jit",
+        "donate": (1, 2),
+        "static": (),
+        "axes": {"B": "derived batch per (context, kv_usage) grid point "
+                      "(offline sweep)"},
+        "warmup": False,
+        "dispatch": ("decode_step",),
+        "help": "offline batched-decode timing probe",
+    },
+}

@@ -53,6 +53,7 @@ from ..runtime.metrics import (
     SCHED_EST_REQ_MS,
     SCHED_EST_TTFT_MS,
 )
+from .bucketing import next_pow2 as _next_pow2
 from .config import EngineConfig
 from .kv_cache import PageAllocator, alloc_kv_arrays
 from .sampling import SamplingParams, penalized, sample, sample_lp, unpack_mask
@@ -61,10 +62,6 @@ from .scheduler import SlaConfig, StepPlanner
 logger = logging.getLogger(__name__)
 
 SCRATCH_PAGE = 0  # physical page 0 is the dump target for masked lanes
-
-
-def _next_pow2(n: int) -> int:
-    return 1 << max(n - 1, 0).bit_length()
 
 
 def _enable_compile_cache():
@@ -1195,6 +1192,49 @@ class JaxEngine:
 
         self._inject_pages = inject_pages
 
+        # per-surface compile telemetry (docs/compilation.md): every
+        # staged callable keyed by its COMPILE_SURFACES registry name, so
+        # stats() can report XLA cache growth per surface and the replay
+        # compile smoke can gate on zero post-warmup recompiles. Keys
+        # MUST match engine/compile_registry.py — dynocomp's registry
+        # rule anchors the static contract, this map closes it at runtime
+        self._compiled_surfaces = {
+            "decode_block": self._decode_block,
+            "spec_block": self._spec_block_fn,
+            "prefill_batch": self._prefill_batch,
+            "mixed_step": self._mixed_step,
+            "prefill_batch_mm": self._prefill_batch_mm,
+            "decode_step_guided": self._decode_step_guided,
+            "decode_step_guided_lora": self._decode_step_guided_lora,
+            "prefill_batch_guided": self._prefill_batch_guided,
+            "decode_block_lora": self._decode_block_lora,
+            "prefill_batch_lora": self._prefill_batch_lora,
+            "prefill_single": self._prefill_single,
+            "patch_lanes": self._patch_lanes,
+            "extract_pages": self._extract_pages,
+            "inject_pages": self._inject_pages,
+        }
+        # snapshot of per-surface cache sizes taken when warmup finishes;
+        # None until then (pre-warmup compiles are expected, not debt)
+        self._warmup_compile_baseline = None
+
+    def _surface_cache_sizes(self) -> dict:
+        """Per-surface XLA executable counts from jit's compilation
+        cache (PjitFunction._cache_size — private but stable across the
+        jax versions we pin; 0 when a surface is disabled for this
+        config or the probe is absent in a future jax)."""
+        out = {}
+        for name, fn in self._compiled_surfaces.items():
+            size = 0
+            probe = getattr(fn, "_cache_size", None)
+            if probe is not None:
+                try:
+                    size = int(probe())
+                except Exception:
+                    size = 0
+            out[name] = size
+        return out
+
     # ------------------------------------------------------------------ #
     # lifecycle / interface (MockEngine-compatible)
     # ------------------------------------------------------------------ #
@@ -1258,22 +1298,40 @@ class JaxEngine:
             b for b in self.config.prefill_buckets
             if b <= self.config.max_model_len
         ] or [self.config.prefill_buckets[0]]
+        prev = 0
         for b in buckets:
-            isl = max(b - 8, 4)
-            # lone arrival: the 1-lane prefill variant (+ decode block/reset
-            # on the first bucket)
-            await _drain(isl)
-            n += 1
+            # both ends of this bucket's first-chunk range: the prefill
+            # page-table axis (P = next_pow2(pages) + 1) changes rung
+            # WITHIN a bucket, so a single isl per bucket leaves page
+            # variants to compile on-path (the --compile-smoke replay
+            # gate caught exactly that)
+            isls = sorted({max(prev + 1, 4), max(b - 8, 4), b})
+            for isl in isls:
+                # lone arrival: the 1-lane prefill variant (+ decode
+                # block/reset on the first pass)
+                await _drain(isl)
+                n += 1
             cap = max(1, min(
                 self.config.prefill_batch_tokens // b,
                 self.config.max_prefill_batch,
             ))
             if cap > 1:
                 # concurrent arrivals batch into the padded cap-lane
-                # variant; admissions mid-decode also exercise _dev_patch
+                # variant; admissions mid-decode also exercise _dev_patch.
+                # Burst at both page rungs — the P axis is orthogonal to
+                # the lane axis
                 burst = min(cap, 3)
-                await asyncio.gather(*[_drain(isl) for _ in range(burst)])
-                n += burst
+                for isl in (isls[0], isls[-1]):
+                    await asyncio.gather(*[_drain(isl) for _ in range(burst)])
+                    n += burst
+            prev = b
+        long_isl = self.config.max_model_len - K - 4
+        if long_isl > buckets[-1]:
+            # one long prompt walks the chunked-prefill path: successive
+            # chunks carry more context pages, compiling the upper
+            # page-table rungs no single-chunk prompt reaches
+            await _drain(long_isl)
+            n += 1
         if (
             self.config.pp_size == 1 and self.config.sp_size == 1
             and not self.config.spec_mode
@@ -1314,6 +1372,11 @@ class JaxEngine:
             async for _ in self.generate(req, Context()):
                 pass
             n += 1
+        # steady-state contract line: every XLA program compiled from
+        # here on counts as a post-warmup recompile
+        # (stats()['post_warmup_compiles']); the replay compile smoke
+        # (bench_serving_overhead --compile-smoke) gates on it staying 0
+        self._warmup_compile_baseline = self._surface_cache_sizes()
         return n
 
     def _check_multimodal(self, req: PreprocessedRequest) -> Optional[str]:
@@ -1832,6 +1895,19 @@ class JaxEngine:
         for tag, (cnt, tot) in list(self._dev_time.items()):
             out[f"dispatch_{tag}_count"] = cnt
             out[f"dispatch_{tag}_s"] = round(tot, 3)
+        # compile telemetry (docs/compilation.md): XLA cache size per
+        # staged surface plus the steady-state gate — programs compiled
+        # AFTER the warmup baseline snapshot. dynocomp proves warmup
+        # reachability statically; post_warmup_compiles proves the same
+        # contract at runtime (>0 in steady state = a shape leaked past
+        # the bucketing helpers or warmup missed a variant)
+        sizes = self._surface_cache_sizes()
+        out["compile_surfaces"] = {k: v for k, v in sizes.items() if v}
+        out["compiled_variants"] = sum(sizes.values())
+        base = self._warmup_compile_baseline
+        out["post_warmup_compiles"] = sum(
+            max(v - base.get(k, 0), 0) for k, v in sizes.items()
+        ) if base is not None else 0
         if self.guided_requests:
             out["guided_requests"] = self.guided_requests
         if self.lora_requests:

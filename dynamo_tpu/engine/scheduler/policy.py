@@ -39,6 +39,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..bucketing import bucket_for, next_pow2 as _next_pow2
 from .cost_model import CostModel
 from .sla import SlaConfig
 
@@ -69,10 +70,6 @@ class MixedPlan:
     reason: str  # "mixed" | "mixed-shrunk"
     predicted_s: Optional[float] = None  # CostModel("mixed", ...) estimate
     deferred_slots: int = 0  # candidates that did not fit this dispatch
-
-
-def _next_pow2(n: int) -> int:
-    return 1 << max(n - 1, 0).bit_length()
 
 
 #: EDF deadline quantum (s) inside which the per-tenant fairness tiebreak
@@ -228,10 +225,7 @@ class StepPlanner:
         return max(1, min(cfg.prefill_batch_tokens // bucket, cfg.max_prefill_batch))
 
     def _bucket_for(self, n: int) -> int:
-        for b in self.config.prefill_buckets:
-            if n <= b:
-                return b
-        return self.config.prefill_buckets[-1]
+        return bucket_for(n, self.config.prefill_buckets)
 
     def plan_prefill(
         self,

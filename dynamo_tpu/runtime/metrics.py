@@ -107,6 +107,12 @@ METRICS = {
     "emit_tokens": {"kind": "counter", "layer": "engine", "unit": "tokens", "help": "Tokens emitted to streams.", "export": True},
     "mixed_steps": {"kind": "counter", "layer": "engine", "help": "Fused mixed prefill+decode dispatch steps.", "export": True},
     "split_steps": {"kind": "counter", "layer": "engine", "help": "Split prefill/decode dispatch steps.", "export": True},
+    # compile telemetry (engine/compile_registry.py, docs/compilation.md):
+    # XLA cache growth per staged surface. post_warmup_compiles is THE
+    # steady-state contract number — the compile smoke gates on 0
+    "compile_surfaces": {"kind": "info", "layer": "engine", "help": "Per-surface XLA executable counts (COMPILE_SURFACES keys).", "dynamic": True},
+    "compiled_variants": {"kind": "gauge", "layer": "engine", "unit": "programs", "help": "Total XLA executables across staged surfaces.", "export": True},
+    "post_warmup_compiles": {"kind": "counter", "layer": "engine", "unit": "programs", "help": "XLA programs compiled after the warmup baseline (steady-state debt; 0 is the contract).", "export": True},
     "mixed_padding_frac": {"kind": "gauge", "layer": "engine", "unit": "fraction", "help": "Padding fraction paid by the mixed path.", "export": True},
     "split_padding_frac": {"kind": "gauge", "layer": "engine", "unit": "fraction", "help": "Padding fraction paid by the split path.", "export": True},
     "guided_requests": {"kind": "counter", "layer": "engine", "help": "Requests decoded under a guided-decoding FSM.", "export": True},

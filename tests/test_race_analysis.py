@@ -1041,7 +1041,7 @@ def test_cli_packs_partition_all_rules():
     rule landing in two packs (or none) breaks --rules gating."""
     from dynamo_tpu.analysis.rules import ALL_RULES, PACKS
 
-    assert set(PACKS) == {"core", "shard", "flow", "race", "met"}
+    assert set(PACKS) == {"core", "shard", "flow", "race", "met", "comp"}
     names = [cls.name for pack in PACKS.values() for cls in pack]
     assert sorted(names) == sorted(cls.name for cls in ALL_RULES)
     assert len(names) == len(set(names))
@@ -1055,7 +1055,7 @@ def test_cli_rules_all_is_the_full_rule_set(tmp_path):
     from dynamo_tpu.analysis.rules import ALL_RULES
 
     for extra in (
-        [], ["--rules", "all"], ["--rules", "core,shard,flow,race,met"],
+        [], ["--rules", "all"], ["--rules", "core,shard,flow,race,met,comp"],
     ):
         proc = _cli("--root", str(tmp_path), "--format", "sarif", *extra)
         assert proc.returncode in (0, 1), proc.stderr
