@@ -114,6 +114,15 @@ PHASES = [
     ("engine_migration", [PY, "bench_migration.py", "--decode", "448",
                           "--rounds", "5", "--max-ratio", "0.5",
                           "--smoke"], 1800),
+    # PR 18 remeasure: live role morphing on real hardware — the
+    # phase-flip soak (morph arm vs cold-spawn time-to-recovery, plus the
+    # worker.morph error/crash chaos variants) where the re-warm of the
+    # incoming role's compile surfaces costs real XLA compiles instead of
+    # the mocker's free flip, so the morph-vs-spawn pricing gap is the
+    # honest one
+    ("engine_morph", [PY, "-m", "pytest", "tests/test_planner_soak.py",
+                      "-q", "-k", "morph_soak", "-p", "no:cacheprovider",
+                      "-p", "no:xdist", "-p", "no:randomly"], 1800),
 ]
 
 

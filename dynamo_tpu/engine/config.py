@@ -126,6 +126,11 @@ class EngineConfig:
     # DYN_KV_INCREMENTAL_COMMIT (default on). The commit content is
     # byte-identical either way; off restores the release-only arm.
     incremental_commit: Optional[bool] = None
+    # serving role (docs/autoscaling.md "Role morphing"): which discovery
+    # component this engine's worker registers under — "prefill",
+    # "decode", or "both" (colocated). Flipped live by JaxEngine.morph();
+    # the worker harness moves the discovery record on the flip.
+    role: str = "decode"
 
     @property
     def max_pages_per_seq(self) -> int:

@@ -47,9 +47,12 @@ from ..llm.tokens import TokenBlockSequence, compute_seq_hashes, salt_hash
 from ..models import llama
 from ..runtime import faults
 from ..runtime.engine import Context
+from ..runtime.request_plane import StreamSevered
 from ..runtime.metrics import (
     NUM_RUNNING_REQS,
     NUM_WAITING_REQS,
+    SCHED_EST_DECODE_TOK_S,
+    SCHED_EST_PREFILL_TOK_S,
     SCHED_EST_REQ_MS,
     SCHED_EST_TTFT_MS,
 )
@@ -592,6 +595,16 @@ class JaxEngine:
         self.resume_source_peer = 0
         self.resume_source_local = 0
         self.resume_source_recompute = 0
+        # live role morphing (docs/autoscaling.md "Role morphing"): the
+        # serving role + state machine position, mutated only inside
+        # morph() (GUARDED_STATE "JaxEngine._role"/"._morph_state")
+        self._role = config.role
+        self._morph_state = "serving"
+        self._severed_queues: List[asyncio.Queue] = []
+        self.morphs_completed = 0
+        self.morphs_rolled_back = 0
+        self.morph_drained_sessions = 0
+        self.morph_last_duration_s = 0.0
         # row-start alignment of the flat packer: the Pallas ragged kernel
         # needs q-tile-aligned rows; the XLA reference packs dense
         self._mixed_align = (
@@ -1379,6 +1392,166 @@ class JaxEngine:
         self._warmup_compile_baseline = self._surface_cache_sizes()
         return n
 
+    # ------------------------------------------------------------------ #
+    # live role morphing (docs/autoscaling.md "Role morphing")
+    # ------------------------------------------------------------------ #
+
+    _ROLES = {
+        "prefill": {"prefill"},
+        "decode": {"decode"},
+        "both": {"prefill", "decode"},
+    }
+
+    async def warmup_role(self, role: str) -> int:
+        """Trimmed re-warm for the INCOMING role of a morph: drive the
+        role's hot compile surfaces (per-bucket short-output prefill for
+        a prefill worker; short-prompt decode blocks for a decode worker)
+        through the real generate path, then refresh the post-warmup
+        compile baseline so morph-time compiles never count as
+        steady-state recompile debt (stats()['post_warmup_compiles']).
+        Cheap by construction: the full warmup() already populated the
+        persistent XLA cache at boot, so these replays hit it — the point
+        is paying any residual first-dispatch cost BEFORE the flipped
+        worker takes traffic, the same contract warmup() holds at boot."""
+        import numpy as _np
+
+        rng = _np.random.RandomState(0xD74B)
+        vocab = self.model_config.vocab_size
+        K = self.config.decode_block_steps
+
+        async def _drain(isl: int, max_tokens: int):
+            req = PreprocessedRequest(
+                token_ids=rng.randint(5, max(vocab - 1, 6), size=isl).tolist(),
+                stop_conditions={"max_tokens": max_tokens, "ignore_eos": True},
+                sampling_options={"temperature": 1.0},
+            ).to_dict()
+            async for _ in self.generate(req, Context()):
+                pass
+
+        buckets = [
+            b for b in self.config.prefill_buckets
+            if b <= self.config.max_model_len
+        ] or [self.config.prefill_buckets[0]]
+        n = 0
+        if "prefill" in self._ROLES[role]:
+            for b in buckets:
+                await _drain(max(b - 8, 4), 1)
+                n += 1
+        if "decode" in self._ROLES[role]:
+            for _ in range(2):
+                await _drain(max(buckets[0] - 8, 4), K + 2)
+                n += 1
+        self._warmup_compile_baseline = self._surface_cache_sizes()
+        return n
+
+    async def _await_sever_consumed(self, timeout_s: float):
+        """Hold the flip until every severed stream's sentinel has been
+        picked up by its consumer (the caller is now migrating) — the
+        drain budget DYN_MORPH_DRAIN_TIMEOUT_S bounds the wait; expiry
+        fails the morph and rolls back."""
+        t0 = time.monotonic()
+        while any(not q.empty() for q in self._severed_queues):
+            if time.monotonic() - t0 > timeout_s:
+                raise TimeoutError(
+                    f"morph drain exceeded {timeout_s}s budget "
+                    f"(severed stream consumer never woke)"
+                )
+            await asyncio.sleep(0.01)
+        self._severed_queues = []
+
+    async def morph(
+        self,
+        target_role: str,
+        *,
+        on_flip: Optional[Callable[[], Any]] = None,
+    ) -> dict:
+        """Re-role this live engine: serving → draining-role → flipped →
+        warm → serving. In-flight streams of the outgoing role are
+        severed so their sessions resume on peers from durable
+        checkpoints (zero lost items, a tail of latency); `on_flip` is
+        awaited between the role flip and re-warm so the worker harness
+        can atomically move the discovery registration; warmup_role then
+        re-warms the incoming role's compile surfaces before the worker
+        takes traffic again.
+
+        Failure semantics: any exception mid-morph rolls the engine back
+        to its original role (drained sessions already resumed on peers —
+        nothing to restore) EXCEPT faults.MorphCrash, which propagates so
+        the harness tears the worker down crash-style."""
+        from ..runtime.config import env_float
+
+        if target_role not in self._ROLES:
+            raise ValueError(f"unknown role {target_role!r}")
+        if self._morph_state != "serving":
+            raise RuntimeError(f"morph re-entered while {self._morph_state!r}")
+        old_role = self._role
+        if target_role == old_role:
+            return {"from": old_role, "to": target_role,
+                    "drained": 0, "duration_s": 0.0}
+        t0 = time.monotonic()
+        self._morph_state = "draining-role"
+        try:
+            f = faults.FAULTS
+            if f.enabled:
+                # dynochaos `worker.morph` (mid-drain): `error` exercises
+                # rollback, `crash` the corpse path
+                act = await f.on("worker.morph")
+                if act == "crash":
+                    raise faults.MorphCrash("injected crash mid-drain")
+            drained = 0
+            # sever when ANY previously-served lane is going away; "both"
+            # keeps every lane, so growing into it drains nothing
+            if self._ROLES[old_role] - self._ROLES[target_role]:
+                drained = self._sever_all(
+                    f"worker morphing {old_role}->{target_role}; "
+                    "stream re-routed"
+                )
+                if drained:
+                    await self._await_sever_consumed(
+                        env_float("DYN_MORPH_DRAIN_TIMEOUT_S", 10.0)
+                    )
+            self.morph_drained_sessions += drained
+            self._morph_state = "flipped"
+            if f.enabled:
+                # dynochaos `worker.morph` (mid-flip): same actions, after
+                # the drain — rollback here proves sessions already moved
+                act = await f.on("worker.morph")
+                if act == "crash":
+                    raise faults.MorphCrash("injected crash mid-flip")
+            self._role = target_role
+            if on_flip is not None:
+                await on_flip()
+            self._morph_state = "warm"
+            await self.warmup_role(target_role)
+        except asyncio.CancelledError:
+            raise
+        except faults.MorphCrash:
+            raise  # harness tears the worker down mid-morph, no rollback
+        except Exception:
+            self._role = old_role
+            self._morph_state = "serving"
+            self.morphs_rolled_back += 1
+            raise
+        self._morph_state = "serving"
+        self.morphs_completed += 1
+        self.morph_last_duration_s = time.monotonic() - t0
+        return {"from": old_role, "to": target_role,
+                "drained": drained,
+                "duration_s": self.morph_last_duration_s}
+
+    def estimated_role_tok_s(self) -> Dict[str, float]:
+        """Marginal per-role throughput from the cost model's observed
+        EWMAs (s/token for prefill dispatches and decode blocks) — the
+        numbers that price the planner's morph-vs-spawn decision. 0.0
+        while the model is cold on a kind (the planner then falls back to
+        its static seed costs)."""
+        pf = self.scheduler.cost.per_token("prefill")
+        dc = self.scheduler.cost.per_token("block")
+        return {
+            "prefill": 1.0 / pf if pf else 0.0,
+            "decode": 1.0 / dc if dc else 0.0,
+        }
+
     def _check_multimodal(self, req: PreprocessedRequest) -> Optional[str]:
         """None when the request is serveable; else the rejection reason.
         Serveable = text-only, OR every part carries encoder embeddings +
@@ -1610,7 +1783,18 @@ class JaxEngine:
         self.scheduler.assign_deadline(slot)
         return slot
 
+    def _morph_guard(self):
+        """Refuse NEW streams mid-morph the same way the drain cut the
+        in-flight ones: StreamSevered rides the `draining`-coded T_ERR so
+        the caller's migration machinery re-routes instead of surfacing a
+        terminal error. ("warm" is admitted — re-warm drives generate.)"""
+        if self._morph_state in ("draining-role", "flipped"):
+            raise StreamSevered(
+                f"worker is morphing ({self._morph_state}); stream re-routed"
+            )
+
     async def generate(self, request: Any, context: Context) -> AsyncIterator[dict]:
+        self._morph_guard()
         self.start()
         req = (
             request
@@ -1647,6 +1831,11 @@ class JaxEngine:
                 item = await slot.queue.get()
                 if item is None:
                     return
+                if isinstance(item, Exception):
+                    # _sever_all pushed a StreamSevered sentinel: raise it
+                    # out of the handler so the request plane codes the
+                    # T_ERR as `draining` and the caller migrates
+                    raise item
                 yield item
         finally:
             slot.done = True
@@ -1658,6 +1847,7 @@ class JaxEngine:
         from_pull): coerce + validate the request, build the "-d" slot,
         and catch the guided FSM up to the prefill worker's already-emitted
         first token. Returns (slot, None) or (None, error_string)."""
+        self._morph_guard()
         self.start()
         req = (
             request
@@ -1685,6 +1875,8 @@ class JaxEngine:
                 item = await slot.queue.get()
                 if item is None:
                     return
+                if isinstance(item, Exception):
+                    raise item  # morph-drain sentinel, see generate()
                 yield item
         finally:
             slot.done = True
@@ -1767,6 +1959,10 @@ class JaxEngine:
         if req.guided is not None or req.multimodal:
             # guided FSM compilation is async and multimodal splices don't
             # ride the preload path: the serial handoff covers these
+            return None
+        if self._morph_state in ("draining-role", "flipped"):
+            # mid-morph: fall to the serial path, whose _decode_entry_slot
+            # raises StreamSevered so the caller re-routes
             return None
         if self._check_lora(req) is not None or self._check_logprobs(req) is not None:
             return None
@@ -1865,6 +2061,18 @@ class JaxEngine:
         out["resume_source_peer"] = self.resume_source_peer
         out["resume_source_local"] = self.resume_source_local
         out["resume_source_recompute"] = self.resume_source_recompute
+        # role-morph telemetry (docs/autoscaling.md "Role morphing"):
+        # per-role marginal throughput prices the planner's re-role arm;
+        # the role/state gauges make a flip observable
+        est_role = self.estimated_role_tok_s()
+        out[SCHED_EST_PREFILL_TOK_S] = round(est_role["prefill"], 1)
+        out[SCHED_EST_DECODE_TOK_S] = round(est_role["decode"], 1)
+        out["engine_role"] = self._role
+        out["morph_state"] = self._morph_state
+        out["morphs_completed"] = self.morphs_completed
+        out["morphs_rolled_back"] = self.morphs_rolled_back
+        out["morph_drained_sessions"] = self.morph_drained_sessions
+        out["morph_last_duration_s"] = round(self.morph_last_duration_s, 3)
         out["kv_skip_ahead_blocks"] = self.prefix_skip_ahead_blocks
         out["emit_batches"] = self.emit_batches
         out["emit_tokens"] = self.emit_tokens
@@ -4601,6 +4809,45 @@ class JaxEngine:
                 slot.queue.put_nowait(None)
                 slot.done = True
         self._waiting = []
+
+    def _sever_all(self, message: str) -> int:
+        """Role-morph drain: deliberately cut every live stream with a
+        StreamSevered sentinel (NOT _fail_all's terminal error chunk).
+        The consumer loop raises it, the server codes the T_ERR as
+        `draining`, and each caller's migration loop resumes the session
+        on a peer from its durable checkpoint — zero lost items, a tail
+        of latency. Batch state resets exactly like _fail_all; the
+        severed queues are kept so morph() can wait for the sentinels to
+        reach their consumers before flipping discovery."""
+        self._inflight.clear()
+        self._pending_prefill = []
+        self._carry_valid = False
+        self._dirty_lanes.clear()
+        self._dirty_tables.clear()
+        self.scheduler.reset()
+        severed = 0
+        queues: List[asyncio.Queue] = []
+        # NO trailing None after the sentinel: the consumer RAISES on it
+        # (never reads further), and a leftover None would keep the queue
+        # non-empty forever — _await_sever_consumed watches q.empty() to
+        # know the migration actually started
+        for slot in list(self.slots):
+            if slot is not None:
+                if not slot.done:
+                    slot.queue.put_nowait(StreamSevered(message))
+                    slot.done = True
+                    severed += 1
+                    queues.append(slot.queue)
+                self._release_slot(slot)
+        for slot in self._waiting:
+            if not slot.done:
+                slot.queue.put_nowait(StreamSevered(message))
+                slot.done = True
+                severed += 1
+                queues.append(slot.queue)
+        self._waiting = []
+        self._severed_queues = queues
+        return severed
 
     # -- emission / teardown --------------------------------------------- #
 

@@ -179,6 +179,8 @@ async def main():
         sched_policy=args.sched_policy,
         ttft_target_ms=args.ttft_target_ms,
         itl_target_ms=args.itl_target_ms,
+        # aggregated serving warms both surfaces, same as decode
+        role=args.role if args.role in ("prefill", "decode") else "decode",
     )
 
     kv_sharding = None
@@ -408,7 +410,13 @@ async def main():
         )
         await kvbm_dist.start()
         logger.info("distributed KVBM mesh joined (namespace %s)", args.namespace)
-    component = args.prefill_component if args.role == "prefill" else args.component
+    def role_component(role: str) -> str:
+        return args.prefill_component if role == "prefill" else args.component
+
+    # live role state: `morph` (below) re-roles the worker without a
+    # restart, so everything role-dependent reads this box, not args.role
+    state = {"role": args.role, "card_key": None}
+    component = role_component(args.role)
     endpoint = drt.namespace(args.namespace).component(component).endpoint(args.endpoint)
 
     publisher = None
@@ -452,14 +460,14 @@ async def main():
         )
 
     model_name = args.model_name or args.model
-    card = None
-    if args.role != "prefill":
+
+    def make_card() -> ModelDeploymentCard:
         # only decode/aggregated workers front the model (reference: the
         # prefill pool is internal, reached by decode orchestration).
         # Publication is deferred until AFTER serve_endpoint below: the
         # card is what makes frontends build a pipeline, so the instance
         # must already be live (and warmup done) when it appears.
-        card = ModelDeploymentCard(
+        return ModelDeploymentCard(
             name=model_name,
             # the card's tokenizer is the SERVING contract: frontend
             # tokenization and the engine's guided-decoding FSM must agree
@@ -475,7 +483,11 @@ async def main():
     prefill_client = None
     disagg_router = None
     _queue_watch_task = None
-    if args.role == "decode":
+    _set_watch_task = None
+    if args.role in ("prefill", "decode"):
+        # built for BOTH disagg roles: a prefill worker can be morphed
+        # into a decode worker at runtime, and then needs the conditional-
+        # disagg wiring live (the handler gates on state["role"])
         from dynamo_tpu.llm.disagg import DisaggConfig, DisaggregatedRouter
 
         prefill_ep = (
@@ -517,9 +529,15 @@ async def main():
                     for w in list(depths):
                         if w not in live:
                             del depths[w]
-                    disagg_router.update_queue_depth(
-                        min((depths[w] for w in depths), default=0)
-                    )
+                    if depths:
+                        disagg_router.update_queue_depth(
+                            min(depths[w] for w in depths)
+                        )
+                    else:
+                        # no live publisher left: UNKNOWN, not "empty" —
+                        # a fresh depth=0 would green-light remote prefill
+                        # into a pool that just vanished
+                        disagg_router.invalidate("no live prefill publishers")
                     if not announced:
                         announced = True
                         logger.info(
@@ -529,10 +547,30 @@ async def main():
                 except Exception:  # noqa: BLE001 — stats are advisory
                     logger.debug("bad prefill metrics message", exc_info=True)
 
-        # owned by main(): strong ref (the event loop keeps only weak
+        async def _watch_prefill_set():
+            # role-flip staleness guard (docs/disagg_serving.md "Role
+            # morphing"): the metrics loop above only wakes on PUBLISHED
+            # messages, so when the prefill instance set changes shape —
+            # a worker drained, died, or role-morphed in or out — the
+            # last depth would otherwise hold sway until the TTL aged it
+            # out. Watch the set itself and invalidate immediately.
+            prev = set(prefill_client.instance_ids())
+            while True:
+                await asyncio.sleep(0.25)
+                live = set(prefill_client.instance_ids())
+                if live != prev:
+                    disagg_router.invalidate(
+                        f"prefill set changed {len(prev)}->{len(live)}"
+                    )
+                prev = live
+
+        # owned by main(): strong refs (the event loop keeps only weak
         # refs), cancelled after wait_for_shutdown
         _queue_watch_task = asyncio.get_running_loop().create_task(
             _watch_prefill_queue()
+        )
+        _set_watch_task = asyncio.get_running_loop().create_task(
+            _watch_prefill_set()
         )
 
     async def handler(request, context):
@@ -544,7 +582,7 @@ async def main():
             cleared = engine.clear_kv_blocks()
             yield {"event": "clear_kv_blocks", "comment": [str(cleared)]}
             return
-        if args.role == "decode" and disagg_router is not None:
+        if state["role"] == "decode" and disagg_router is not None:
             from dynamo_tpu.jax_worker.disagg_handler import maybe_remote_prefill
 
             stream = maybe_remote_prefill(
@@ -556,18 +594,123 @@ async def main():
         async for item in engine.generate(request, context):
             yield item
 
-    await endpoint.serve_endpoint(handler)
-    if card is not None:
-        await register_llm(endpoint, card)
+    # ---------------------------------------------------------------- #
+    # live role morphing (docs/autoscaling.md "Role morphing"): a
+    # `morph` control endpoint rides beside `generate`; the planner's
+    # re-role arm calls it to convert this worker prefill<->decode
+    # in-place — drain via StreamSevered tail-migration, flip the
+    # discovery component + model card atomically with the drain, then
+    # re-warm the incoming role's compile surfaces.
+    # ---------------------------------------------------------------- #
+    lanes: dict = {"component": component, "generate": None, "morph": None}
+
+    async def _drop_card():
+        if state["card_key"] is None:
+            return
+        drt._leased_keys.pop(state["card_key"], None)
+        if drt.discovery is not None:
+            await drt.discovery.delete(state["card_key"])
+        state["card_key"] = None
+
+    async def _apply_lanes(role: str):
+        """Reconcile discovery registrations to `role`: move generate +
+        morph endpoints to the role's component (new lanes born
+        `morphing` until the morph commits), move the model card and the
+        metrics/KV-events topics with them. Runs as the engine morph's
+        on_flip hook — atomic with drain completion — and again (toward
+        the OLD role) on rollback."""
+        nonlocal metrics_pub, publisher
+        from dynamo_tpu.runtime.component import STATE_MORPHING
+
+        new_comp = role_component(role)
+        if new_comp != lanes["component"]:
+            gen_ep = (drt.namespace(args.namespace)
+                      .component(new_comp).endpoint(args.endpoint))
+            morph_ep = (drt.namespace(args.namespace)
+                        .component(new_comp).endpoint("morph"))
+            for name in ("generate", "morph"):
+                if lanes[name] is not None:
+                    await lanes[name].remove()
+            lanes["generate"] = await gen_ep.serve_endpoint(handler)
+            await lanes["generate"].set_state(STATE_MORPHING)
+            lanes["morph"] = await morph_ep.serve_endpoint(morph_handler)
+            await lanes["morph"].set_state(STATE_MORPHING)
+            lanes["component"] = new_comp
+            # load-signal + KV-event topics are per-component: re-home
+            await metrics_pub.close()
+            metrics_pub = WorkerMetricsPublisher(
+                drt, gen_ep, drt.instance_id, engine.stats)
+            await metrics_pub.start()
+            if publisher is not None:
+                await publisher.close()
+                publisher = KvEventPublisher(drt, gen_ep, drt.instance_id)
+                await publisher.start()
+                engine.allocator.event_sink = publisher.publish
+        if role != "prefill" and state["card_key"] is None:
+            state["card_key"] = await register_llm(
+                (drt.namespace(args.namespace)
+                 .component(lanes["component"]).endpoint(args.endpoint)),
+                make_card())
+        elif role == "prefill":
+            await _drop_card()
+
+    async def _set_lane_states(st: str):
+        for name in ("generate", "morph"):
+            if lanes[name] is not None:
+                await lanes[name].set_state(st)
+
+    async def morph_handler(request, context):
+        from dynamo_tpu.runtime import faults
+        from dynamo_tpu.runtime.component import STATE_MORPHING, STATE_READY
+
+        target = (request or {}).get("target_role", "")
+        if target not in ("prefill", "decode"):
+            yield {"error": f"bad target_role {target!r}"}
+            return
+        if args.role == "aggregated":
+            yield {"error": "aggregated worker has no role to morph"}
+            return
+        if state["role"] == target:
+            yield {"ok": True, "noop": True, "role": target}
+            return
+        old_role = state["role"]
+        await _set_lane_states(STATE_MORPHING)
+        try:
+            summary = await engine.morph(
+                target, on_flip=lambda: _apply_lanes(target))
+        except faults.MorphCrash:
+            raise
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — typed result for the planner
+            # engine rolled back to old_role (drained sessions already
+            # migrating to peers); restore the old lane set routable
+            await _apply_lanes(old_role)
+            await _set_lane_states(STATE_READY)
+            yield {"error": f"morph rolled back: {type(e).__name__}: {e}"}
+            return
+        state["role"] = target
+        await _set_lane_states(STATE_READY)
+        yield {"ok": True, **summary}
+
+    lanes["generate"] = await endpoint.serve_endpoint(handler)
+    if args.role in ("prefill", "decode"):
+        morph_ep = (drt.namespace(args.namespace)
+                    .component(component).endpoint("morph"))
+        lanes["morph"] = await morph_ep.serve_endpoint(morph_handler)
+    if args.role != "prefill":
+        state["card_key"] = await register_llm(endpoint, make_card())
     logger.info(
-        "jax worker up: model=%s tp=%d instance=%x",
+        "jax worker up: model=%s tp=%d role=%s instance=%x",
         model_name,
         args.tp_size,
+        state["role"],
         drt.instance_id,
     )
     await drt.wait_for_shutdown()
-    if _queue_watch_task is not None:
-        _queue_watch_task.cancel()
+    for t in (_queue_watch_task, _set_watch_task):
+        if t is not None:
+            t.cancel()
     # graceful drain: lease revoked first (routers stop picking us), then
     # in-flight streams finish within DYN_RUNTIME_GRACEFUL_SHUTDOWN_TIMEOUT,
     # then survivors are force-cancelled (runtime/component.py close())

@@ -85,6 +85,20 @@ class DisaggregatedRouter:
         now = time.monotonic() if now is None else now
         return (now - self._depth_at) <= self.config.queue_depth_ttl_s
 
+    def invalidate(self, reason: str = "") -> None:
+        """Forget the published queue depth NOW instead of waiting out the
+        staleness TTL — called when the prefill instance set changes under
+        us (a worker drained, died, or role-morphed away): the depth a
+        departed lane published says nothing about the lanes that remain,
+        and during a role flip it is wrong in BOTH directions — it can pin
+        remote prefill off while fresh capacity sits idle, or on while the
+        pool it describes no longer exists (docs/disagg_serving.md "Role
+        morphing")."""
+        self.prefill_queue_depth = 0
+        self._depth_at = None
+        if reason:
+            logger.info("disagg: prefill queue depth invalidated (%s)", reason)
+
     def prefill_remote(
         self,
         prompt_len: int,

@@ -31,9 +31,12 @@ from ...runtime.metrics import (
     KV_TOTAL_BLOCKS,
     NUM_RUNNING_REQS,
     NUM_WAITING_REQS,
+    SCHED_EST_DECODE_TOK_S,
+    SCHED_EST_PREFILL_TOK_S,
     SCHED_EST_REQ_MS,
     SCHED_EST_TTFT_MS,
 )
+from ...runtime.request_plane import StreamSevered
 from ..protocols import Annotated, LLMEngineOutput, PreprocessedRequest
 from ..tokens import DEFAULT_BLOCK_SIZE, TokenBlockSequence, compute_seq_hashes
 from .kv_manager import KvEvent, KvManager
@@ -68,6 +71,11 @@ class MockEngineArgs:
     sched_policy: Optional[str] = None
     ttft_target_ms: Optional[float] = None
     itl_target_ms: Optional[float] = None
+    # serving role (docs/autoscaling.md "Role morphing"): which discovery
+    # component this engine's worker registers under. "both" = colocated
+    # (one worker serves prefill AND decode at low traffic). Flipped live
+    # by MockEngine.morph().
+    role: str = "decode"
 
 
 @dataclass
@@ -129,6 +137,15 @@ class MockEngine:
         # but the soak/CI arms still assert the resume was COUNTED here
         self.migrations_resumed = 0
         self.resume_source_recompute = 0
+        # live role morphing (docs/autoscaling.md "Role morphing"): the
+        # serving role + state machine position, mutated only inside
+        # morph() (GUARDED_STATE "MockEngine._role"/"._morph_state")
+        self._role = self.args.role
+        self._morph_state = "serving"
+        self.morphs_completed = 0
+        self.morphs_rolled_back = 0
+        self.morph_drained_sessions = 0
+        self.morph_last_duration_s = 0.0
 
     # -- lifecycle ---------------------------------------------------------- #
 
@@ -166,6 +183,15 @@ class MockEngine:
     async def generate(
         self, request: Any, context: Context
     ) -> AsyncIterator[dict]:
+        if self._morph_state in ("draining-role", "flipped"):
+            # mid-morph: refuse new streams the same way the drain cut the
+            # in-flight ones — the server maps StreamSevered to a
+            # `draining`-coded T_ERR, so the caller's migration machinery
+            # re-routes instead of surfacing a terminal error. ("warm" is
+            # admitted: the re-warm phase drives generate() itself.)
+            raise StreamSevered(
+                f"worker is morphing ({self._morph_state}); stream re-routed"
+            )
         self.start()
         req = (
             request
@@ -199,6 +225,11 @@ class MockEngine:
                 item = await mreq.queue.get()
                 if item is None:
                     return
+                if isinstance(item, Exception):
+                    # _sever_all pushed a StreamSevered sentinel: raise it
+                    # out of the handler so the request plane codes the
+                    # T_ERR as `draining` and the caller migrates
+                    raise item
                 yield item
         finally:
             mreq.done = True
@@ -207,6 +238,7 @@ class MockEngine:
     # -- stats (ForwardPassMetrics role) ------------------------------------ #
 
     def stats(self) -> dict:
+        est_role = self.estimated_role_tok_s()
         return {
             NUM_WAITING_REQS: len(self._waiting),
             NUM_RUNNING_REQS: len(self._running),
@@ -227,6 +259,17 @@ class MockEngine:
             # optimism-debt unit between 0.25s metric publishes — without
             # it a one-window burst floods past the published estimate)
             SCHED_EST_REQ_MS: round(self.estimated_req_ms(), 1),
+            # role-morph telemetry (docs/autoscaling.md "Role morphing"):
+            # per-role marginal throughput prices the planner's re-role
+            # arm; the role/state gauges make a flip observable
+            SCHED_EST_PREFILL_TOK_S: round(est_role["prefill"], 1),
+            SCHED_EST_DECODE_TOK_S: round(est_role["decode"], 1),
+            "engine_role": self._role,
+            "morph_state": self._morph_state,
+            "morphs_completed": self.morphs_completed,
+            "morphs_rolled_back": self.morphs_rolled_back,
+            "morph_drained_sessions": self.morph_drained_sessions,
+            "morph_last_duration_s": round(self.morph_last_duration_s, 3),
         }
 
     def estimated_req_ms(self) -> float:
@@ -495,6 +538,122 @@ class MockEngine:
                 req.done = True
         self._running = []
         self._waiting = []
+
+    def _sever_all(self, message: str) -> int:
+        """Role-morph drain: deliberately cut every live stream with a
+        StreamSevered sentinel (NOT a terminal error chunk — _fail_all's
+        shape). The consumer loop raises it, the server codes the T_ERR
+        as `draining`, and each caller's migration loop resumes the
+        session on a peer from its durable checkpoint."""
+        severed = 0
+        for req in [*self._running, *self._waiting]:
+            if req.held_hashes:
+                self.kv.release(req.held_hashes)
+                req.held_hashes = []
+            # no trailing None: the consumer raises on the sentinel itself
+            if not req.done:
+                req.queue.put_nowait(StreamSevered(message))
+                req.done = True
+                severed += 1
+        self._running = []
+        self._waiting = []
+        return severed
+
+    # -- live role morphing (docs/autoscaling.md "Role morphing") ------------ #
+
+    _ROLES = {
+        "prefill": {"prefill"},
+        "decode": {"decode"},
+        "both": {"prefill", "decode"},
+    }
+
+    async def morph(
+        self,
+        target_role: str,
+        *,
+        on_flip: Optional[Callable[[], Any]] = None,
+    ) -> dict:
+        """Re-role this live engine: serving → draining-role → flipped →
+        warm → serving. Streams of the OUTGOING role are severed so their
+        sessions resume on peers from durable checkpoints (zero lost
+        items, a tail of latency); `on_flip` is awaited between the role
+        flip and re-warm so the worker harness can atomically move the
+        discovery registration; re-warm drives the incoming role's
+        compile surfaces before the worker takes traffic again.
+
+        Failure semantics: any exception mid-morph rolls the engine back
+        to its original role (drained sessions already resumed on peers —
+        nothing to restore) EXCEPT faults.MorphCrash, which propagates so
+        the harness tears the worker down crash-style."""
+        if target_role not in self._ROLES:
+            raise ValueError(f"unknown role {target_role!r}")
+        if self._morph_state != "serving":
+            raise RuntimeError(
+                f"morph re-entered while {self._morph_state!r}"
+            )
+        old_role = self._role
+        if target_role == old_role:
+            return {"from": old_role, "to": target_role,
+                    "drained": 0, "duration_s": 0.0}
+        t0 = time.monotonic()
+        self._morph_state = "draining-role"
+        try:
+            f = faults.FAULTS
+            if f.enabled:
+                # dynochaos `worker.morph` (mid-drain): `error` exercises
+                # rollback, `crash` the corpse path
+                act = await f.on("worker.morph")
+                if act == "crash":
+                    raise faults.MorphCrash("injected crash mid-drain")
+            drained = 0
+            # sever when ANY previously-served lane is going away; "both"
+            # keeps every lane, so growing into it drains nothing
+            if self._ROLES[old_role] - self._ROLES[target_role]:
+                drained = self._sever_all(
+                    f"worker morphing {old_role}->{target_role}; "
+                    "stream re-routed"
+                )
+            self.morph_drained_sessions += drained
+            self._morph_state = "flipped"
+            if f.enabled:
+                # dynochaos `worker.morph` (mid-flip): same actions, after
+                # the drain — rollback here proves sessions already moved
+                act = await f.on("worker.morph")
+                if act == "crash":
+                    raise faults.MorphCrash("injected crash mid-flip")
+            self._role = target_role
+            if on_flip is not None:
+                await on_flip()
+            self._morph_state = "warm"
+            await self.warmup()
+        except asyncio.CancelledError:
+            raise
+        except faults.MorphCrash:
+            raise  # harness tears the worker down mid-morph, no rollback
+        except Exception:
+            self._role = old_role
+            self._morph_state = "serving"
+            self.morphs_rolled_back += 1
+            raise
+        self._morph_state = "serving"
+        self.morphs_completed += 1
+        self.morph_last_duration_s = time.monotonic() - t0
+        return {"from": old_role, "to": target_role,
+                "drained": drained,
+                "duration_s": self.morph_last_duration_s}
+
+    def estimated_role_tok_s(self) -> Dict[str, float]:
+        """Marginal per-role throughput from the synthetic timing model —
+        the mocker's spelling of the JaxEngine's cost-model-EWMA
+        estimates that price the planner's morph-vs-spawn decision."""
+        a = self.args
+        speed = max(a.speedup_ratio, 1e-9)
+        prefill = speed / max(a.prefill_time_per_token, 1e-12)
+        b = max(a.max_num_seqs, 1)
+        decode = b * speed / max(
+            a.decode_time_per_step + b * a.decode_time_per_seq, 1e-12
+        )
+        return {"prefill": prefill, "decode": decode}
 
     def _finish(self, req: _MockRequest, reason: Optional[str], emit: bool = True):
         if req in self._running:

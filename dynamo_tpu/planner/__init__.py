@@ -10,6 +10,7 @@ from .connector import (
     DiscoveryWorkerCounts,
     LocalProcessConnector,
     NoopConnector,
+    NoopMorphConnector,
     VirtualConnector,
 )
 from .load_predictor import (
@@ -32,6 +33,7 @@ __all__ = [
     "Metrics",
     "MovingAveragePredictor",
     "NoopConnector",
+    "NoopMorphConnector",
     "Planner",
     "PrefillInterpolator",
     "ScaleDecision",

@@ -44,6 +44,29 @@ class NoopConnector:
             self.frontend_decisions.append(frontend)
 
 
+class NoopMorphConnector(NoopConnector):
+    """NoopConnector that also exposes the role-morph capability surface
+    (morph_replicas / colocate), recording calls — the planner's re-role
+    arm activates only on connectors with these attributes, so the plain
+    Noop keeps legacy tests' decision logs byte-stable."""
+
+    def __init__(self):
+        super().__init__()
+        self.morphs: List[Tuple[str, str, int]] = []
+        self.colocations = 0
+
+    async def morph_replicas(self, from_role: str, to_role: str, k: int) -> int:
+        f = faults.FAULTS
+        if f.enabled:
+            await f.on("planner.connector")  # `error` raises; planner retries
+        self.morphs.append((from_role, to_role, k))
+        return k
+
+    async def colocate(self) -> bool:
+        self.colocations += 1
+        return True
+
+
 class VirtualConnector:
     """Publish {num_prefill, num_decode, revision} to discovery KV.
     Revisions continue from whatever is already stored, so they stay
@@ -128,6 +151,7 @@ class LocalProcessConnector:
         ready_fn: Optional[Callable[[str], Awaitable[int]]] = None,
         ready_timeout: float = 30.0,
         frontend_cmd: Sequence[str] = (),
+        morph_fn: Optional[Callable[[str, str], Awaitable[None]]] = None,
     ):
         self.prefill_cmd = list(prefill_cmd)
         self.decode_cmd = list(decode_cmd)
@@ -154,6 +178,17 @@ class LocalProcessConnector:
             "decode": self.decode_cmd,
             "frontend": self.frontend_cmd,
         }
+        # role morphing (docs/autoscaling.md "Role morphing"): an async
+        # `(from_role, to_role)` hook that re-roles ONE live worker of
+        # from_role (e.g. by calling the worker's morph control endpoint).
+        # None = capability absent; the planner's getattr probe then keeps
+        # its re-role arm dark and cold-spawns as before.
+        self.morph_fn = morph_fn
+        if morph_fn is None:
+            # capability surface: the planner probes getattr(connector,
+            # "morph_replicas") — shadow the method with None when no hook
+            # exists, so a hookless connector keeps the re-role arm dark
+            self.morph_replicas = None
         # last asked (p, d, f); f None = frontend tier never asked
         self._want: Optional[Tuple[int, int, Optional[int]]] = None
 
@@ -287,6 +322,41 @@ class LocalProcessConnector:
                     return proc.pid
         return None
 
+    async def morph_replicas(self, from_role: str, to_role: str,
+                             k: int) -> int:
+        """Re-role k live managed replicas via the morph hook (shadowed
+        to None when no hook was configured). Each success moves
+        the replica's bookkeeping between role lists and commits `_want`
+        one worker at a time — a failure mid-batch raises with the
+        completed morphs already committed, so reconcile re-asserts counts
+        that match physical reality and the planner re-decides."""
+        f = faults.FAULTS
+        if f.enabled:
+            await f.on("planner.connector")  # `error` raises; planner retries
+        self._reap()
+        done = 0
+        for _ in range(k):
+            if not self.procs[from_role]:
+                break
+            await self.morph_fn(from_role, to_role)
+            proc = self.procs[from_role].pop()
+            # re-slot under the new role: bookkeeping indexes are per-role
+            # (the child's own DYN_WORKER_INDEX env is fixed at spawn; the
+            # hook is responsible for any port/name re-derivation)
+            proc._dyn_worker_index = self._next_index(to_role)
+            self.procs[to_role].append(proc)
+            if self._want is not None:
+                p, d, fr = self._want
+                p += (1 if to_role == "prefill" else 0) - (
+                    1 if from_role == "prefill" else 0)
+                d += (1 if to_role == "decode" else 0) - (
+                    1 if from_role == "decode" else 0)
+                self._want = (p, d, fr)
+            done += 1
+            logger.info("morphed %s worker pid=%d -> %s",
+                        from_role, proc.pid, to_role)
+        return done
+
     async def set_replicas(self, prefill: int, decode: int,
                            frontend: Optional[int] = None) -> None:
         f = faults.FAULTS
@@ -367,9 +437,10 @@ class DiscoveryWorkerCounts:
 
     Two gates make this the planner's capacity truth: workers register in
     discovery only AFTER their warmup/health gate passes (so a freshly
-    spawned replica never counts early), and instances whose record is in
-    `draining` state (scale-down in progress) are excluded (so capacity
-    being shed never counts either)."""
+    spawned replica never counts early), and instances in any unroutable
+    state — `draining` (scale-down in progress) or `morphing` (role flip
+    in progress) — are excluded (so capacity being shed or mid-flip never
+    counts in either role)."""
 
     def __init__(self, discovery_client, namespace: str = "dynamo",
                  prefill_component: str = "prefill", decode_component: str = "backend"):
@@ -379,7 +450,7 @@ class DiscoveryWorkerCounts:
         self.decode_component = decode_component
 
     async def count(self) -> Tuple[int, int]:
-        from ..runtime.component import INSTANCE_ROOT, STATE_DRAINING
+        from ..runtime.component import INSTANCE_ROOT, UNROUTABLE_STATES
 
         items = await self.client.get_prefix(INSTANCE_ROOT + self.namespace + "/")
         n_p = n_d = 0
@@ -387,7 +458,7 @@ class DiscoveryWorkerCounts:
             key = it["key"] if isinstance(it, dict) else it[0]
             value = it.get("value", b"") if isinstance(it, dict) else it[1]
             try:
-                if json.loads(value).get("state") == STATE_DRAINING:
+                if json.loads(value).get("state") in UNROUTABLE_STATES:
                     continue
             except (ValueError, TypeError, AttributeError):
                 pass  # unparseable record: count it (legacy writers)

@@ -19,10 +19,11 @@ import logging
 import math
 import time
 from dataclasses import dataclass
-from typing import List, Optional, Protocol, Tuple
+from typing import Dict, List, Optional, Protocol, Tuple
 
 from ..runtime.backoff import Backoff, retry_async
-from ..runtime.config import _env
+from ..runtime.config import _env, env_bool
+from ..runtime.metrics import SCHED_EST_DECODE_TOK_S, SCHED_EST_PREFILL_TOK_S
 from .load_predictor import BasePredictor, make_predictor
 from .perf_interpolation import DecodeInterpolator, PrefillInterpolator
 
@@ -61,6 +62,18 @@ class SlaArgs:
     # target, so it inherits the governor's cooldown/hysteresis and adds
     # no flapping mode of its own. 0 = frontends not planner-managed.
     workers_per_frontend: int = 0
+    # role morphing (docs/autoscaling.md "Role morphing"): under load
+    # skew (one role's ask up, the other's down) convert a live worker
+    # (engine.morph: drain via tail-migration, flip discovery, re-warm)
+    # instead of cold-spawning — effective only when the connector
+    # exposes morph_replicas, and only while the priced morph cost beats
+    # the cold-spawn cost on time-to-SLA-recovery.
+    morph_enabled: bool = True
+    morph_cost_s: float = 3.0   # seed morph wall-clock (drain+flip+rewarm)
+    spawn_cost_s: float = 30.0  # seed cold-spawn wall-clock (boot+warmup)
+    # colocate arm: at sustained floor-level traffic, morph the decode
+    # worker to role `both` and retire the dedicated prefill worker.
+    colocate: bool = False
 
     def effective_metrics_max_age(self) -> float:
         return self.metrics_max_age or 2.5 * self.adjustment_interval
@@ -88,6 +101,10 @@ class SlaArgs:
                 "DYN_PLANNER_WORKERS_PER_FRONTEND",
                 cls.workers_per_frontend, int,
             ),
+            morph_enabled=env_bool("DYN_PLANNER_MORPH", cls.morph_enabled),
+            morph_cost_s=_env("DYN_PLANNER_MORPH_COST_S", cls.morph_cost_s, float),
+            spawn_cost_s=_env("DYN_PLANNER_SPAWN_COST_S", cls.spawn_cost_s, float),
+            colocate=env_bool("DYN_PLANNER_COLOCATE", cls.colocate),
         )
         for k, v in overrides.items():
             setattr(args, k, v)
@@ -129,6 +146,45 @@ class PlannerConnector(Protocol):
         tier (SlaArgs.workers_per_frontend > 0); connectors that predate
         the role keep working in the default mode."""
         ...
+
+
+class RoleEstimates:
+    """Planner-side consumer of the per-role marginal-throughput gauges
+    workers publish on their metrics topics (sched_est_prefill_tok_s /
+    sched_est_decode_tok_s, runtime/metrics.py): folds the freshest
+    per-worker values into fleet means so the re-role arm's pricing is
+    grounded in observed throughput, not guessed. Advisory — while no
+    worker has published, the planner prices from its static seed costs
+    (SlaArgs.morph_cost_s / spawn_cost_s) alone."""
+
+    def __init__(self):
+        # worker_id -> (prefill_tok_s, decode_tok_s, observed_at)
+        self._by_worker: Dict[int, Tuple[float, float, float]] = {}
+
+    def observe(self, worker_id: int, stats: dict,
+                now: Optional[float] = None) -> None:
+        pf = stats.get(SCHED_EST_PREFILL_TOK_S)
+        dc = stats.get(SCHED_EST_DECODE_TOK_S)
+        if pf is None and dc is None:
+            return
+        now = time.monotonic() if now is None else now
+        self._by_worker[int(worker_id)] = (
+            float(pf or 0.0), float(dc or 0.0), now,
+        )
+
+    def fleet_tok_s(self, max_age_s: float = 120.0
+                    ) -> Tuple[Optional[float], Optional[float]]:
+        """(mean prefill tok/s, mean decode tok/s) over fresh publishes;
+        None per side while no worker has reported a warm estimate."""
+        now = time.monotonic()
+        pfs = [p for p, _d, at in self._by_worker.values()
+               if p > 0 and now - at <= max_age_s]
+        dcs = [d for _p, d, at in self._by_worker.values()
+               if d > 0 and now - at <= max_age_s]
+        return (
+            sum(pfs) / len(pfs) if pfs else None,
+            sum(dcs) / len(dcs) if dcs else None,
+        )
 
 
 @dataclass
@@ -177,6 +233,12 @@ class Planner:
         self._observed_at: Optional[float] = None  # monotonic, last GOOD read
         self.decision_log: List[ScaleDecision] = []
         self.scrape_failures = 0  # consecutive; resets on a good read
+        # role morphing (docs/autoscaling.md "Role morphing"): observed
+        # per-role throughput (fed by the metrics consumer) prices the
+        # re-role arm; the colocate streak counts consecutive floor-level
+        # intervals before the colocate arm fires.
+        self.role_estimates = RoleEstimates()
+        self._colocate_streak = 0
 
     # -- observe -----------------------------------------------------------
     async def observe_metrics(self) -> bool:
@@ -403,6 +465,99 @@ class Planner:
             logger.error("connector failed after retries: %s", e)
             return False
 
+    # -- re-role (docs/autoscaling.md "Role morphing") ------------------------
+    def _plan_re_role(self, cur: Tuple[int, int], target: Tuple[int, int]
+                      ) -> Tuple[int, Optional[str], Optional[str]]:
+        """Under genuine load skew — the governed target moves one role UP
+        and the other DOWN — convert live workers (morph) instead of
+        cold-spawning, when the priced morph beats a spawn on
+        time-to-SLA-recovery. Returns (k, from_role, to_role): k morphs to
+        request, (0, None, None) when the spawn/kill path should run as
+        usual. The governor already bounded and hysteresis-gated both
+        deltas, so the morph count inherits every stability property."""
+        a = self.args
+        if not a.morph_enabled:
+            return 0, None, None
+        if getattr(self.connector, "morph_replicas", None) is None:
+            return 0, None, None
+        dp, dd = target[0] - cur[0], target[1] - cur[1]
+        if dp == 0 or dd == 0 or (dp > 0) == (dd > 0):
+            return 0, None, None  # not a skew: plain scale handles it
+        if a.morph_cost_s >= a.spawn_cost_s:
+            # priced out: a morph (drain + flip + re-warm) recovers SLA in
+            # morph_cost_s vs spawn_cost_s for a cold replica — when that
+            # inverts, spawning wins and the arm stands down
+            return 0, None, None
+        est_p, est_d = self.role_estimates.fleet_tok_s()
+        logger.info(
+            "re-role priced: morph=%.1fs beats spawn=%.1fs "
+            "(observed prefill=%s decode=%s tok/s)",
+            a.morph_cost_s, a.spawn_cost_s,
+            f"{est_p:.0f}" if est_p else "cold",
+            f"{est_d:.0f}" if est_d else "cold",
+        )
+        k = min(abs(dp), abs(dd))
+        if dp > 0:
+            return k, "decode", "prefill"
+        return k, "prefill", "decode"
+
+    async def _apply_morph(self, from_role: str, to_role: str, k: int) -> bool:
+        """Push k re-roles through the connector with bounded retries —
+        the same uncommitted-on-failure contract as _apply_target: on
+        final failure nothing is committed and the next interval
+        re-decides (the connector's own morph rollback restored any
+        half-flipped worker to its original role)."""
+        try:
+            await retry_async(
+                lambda: self.connector.morph_replicas(from_role, to_role, k),
+                attempts=3,
+                backoff=Backoff.seeded("planner.connector", base=0.1, max_delay=1.0),
+                desc=f"connector morph_replicas {from_role}->{to_role} x{k}",
+                log=logger,
+            )
+            return True
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — surfaced in the log, re-decided next interval
+            logger.error("connector morph failed after retries: %s", e)
+            return False
+
+    async def _maybe_colocate(self, raw: Tuple[int, int],
+                              cur: Tuple[int, int]) -> bool:
+        """Colocate arm (DYN_PLANNER_COLOCATE): after the raw ask has sat
+        at the min_endpoint floor for scale_down_stable_intervals
+        consecutive intervals (outside cooldown), morph the decode worker
+        to role `both` and retire the dedicated prefill worker — one
+        worker serves both roles at low traffic. The connector's
+        colocate() returns False when already colocated (no-op)."""
+        a = self.args
+        colocate = getattr(self.connector, "colocate", None)
+        if not a.colocate or colocate is None:
+            self._colocate_streak = 0
+            return False
+        if raw[0] > a.min_endpoint or raw[1] > a.min_endpoint:
+            self._colocate_streak = 0
+            return False
+        self._colocate_streak += 1
+        if self._colocate_streak < a.scale_down_stable_intervals:
+            return False
+        if self._intervals_since_change <= a.cooldown_intervals:
+            return False
+        try:
+            did = await colocate()
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — retried next interval
+            logger.warning("connector colocate failed: %s", e)
+            return False
+        if not did:
+            return False
+        # a colocation is a scale event on BOTH roles
+        self._intervals_since_change = 0
+        self._colocate_streak = 0
+        self._record(raw, cur, True, "re-role:colocate")
+        return True
+
     # -- adjust ---------------------------------------------------------------
     async def make_adjustments(self) -> Optional[tuple[int, int]]:
         if self._target is None:
@@ -441,12 +596,33 @@ class Planner:
         raw = self.compute_replica_requirements(num_req, isl, osl)
         target, reason = self._govern(raw, cur)
         if target == cur:
+            if await self._maybe_colocate(raw, cur):
+                return cur
             self._record(raw, cur, False, reason)
             return None
-        if not await self._apply_target(target):
+        self._colocate_streak = 0
+        # re-role arm: under skew, morph live workers across roles instead
+        # of cold-spawning; any residual delta beyond the morphed pairs
+        # still rides the plain spawn/kill path. A failed step commits
+        # nothing — the next interval re-decides and re-asserts.
+        k, from_role, to_role = self._plan_re_role(cur, target)
+        if k:
+            if not await self._apply_morph(from_role, to_role, k):
+                self._record(raw, cur, False, "connector-error")
+                return None
+            reason = f"re-role:{from_role}->{to_role}"
+            if abs(target[0] - cur[0]) != k or abs(target[1] - cur[1]) != k:
+                if not await self._apply_target(target):
+                    self._record(raw, cur, False, "connector-error")
+                    return None
+                reason += "+scale"
+        elif not await self._apply_target(target):
             self._record(raw, cur, False, "connector-error")
             return None
         self._target = target
+        # an applied morph counts as a scale event on BOTH roles — the
+        # shared cooldown window structurally rules out A→B→A re-role
+        # flapping just as it does for plain scaling
         self._intervals_since_change = 0
         for i in (0, 1):
             if target[i] < cur[i]:

@@ -174,53 +174,178 @@ class SoakFrontend:
             await self.disc.stop()
 
 
+#: which serving roles a worker role covers (soak-side mirror of the
+#: engines' _ROLES table): a "both" worker counts as prefill AND decode.
+ROLE_SERVES = {
+    "prefill": frozenset({"prefill"}),
+    "decode": frozenset({"decode"}),
+    "both": frozenset({"prefill", "decode"}),
+}
+
+
 class InProcMockWorker:
     """One in-proc mock worker: mirrors `python -m dynamo_tpu.mocker` —
     warmup BEFORE registration (the capacity-readiness gate the planner
     counts on), MockEngine behind a served endpoint, model card under the
-    primary lease."""
+    primary lease.
+
+    Role-aware (docs/autoscaling.md "Role morphing"): a decode-role worker
+    registers under `component` with the model card (chat traffic routes
+    here), a prefill-role worker registers under `prefill_component` with
+    NO card (it is planner capacity + disagg remote-prefill target, never
+    a chat destination), and a colocated "both" worker registers under
+    both. `morph()` re-roles the live worker: mark every lane `morphing`
+    (routers stop dialing immediately), drain via the engine's
+    StreamSevered tail-migration, then flip the discovery lanes + card
+    atomically with the drain's completion."""
 
     def __init__(self, cfg: RuntimeConfig, engine_args, *,
                  namespace: str = "dynamo", component: str = "mocker",
+                 prefill_component: str = "prefill",
                  endpoint: str = "generate", migration_limit: int = 3):
         self.cfg = cfg
         self.engine_args = engine_args
         self.namespace, self.component, self.endpoint = namespace, component, endpoint
+        self.prefill_component = prefill_component
         self.migration_limit = migration_limit
+        self.role: str = getattr(engine_args, "role", "decode")
         self.drt: Optional[DistributedRuntime] = None
         self.engine = None
         self._metrics_pub = None
+        self._served: dict = {}  # component name -> ServedEndpoint
+        self._card_key: Optional[str] = None
 
-    async def start(self) -> "InProcMockWorker":
-        from ..llm.kv_router.publisher import WorkerMetricsPublisher
-        from ..llm.mocker import MockEngine
-        from ..llm.model_card import ModelDeploymentCard, register_llm
+    def _role_components(self, role: str) -> List[str]:
+        return {
+            "decode": [self.component],
+            "prefill": [self.prefill_component],
+            "both": [self.component, self.prefill_component],
+        }[role]
 
-        self.drt = await DistributedRuntime.create(self.cfg)
-        self.engine = MockEngine(self.engine_args)
-        await self.engine.warmup()
-        ep = (self.drt.namespace(self.namespace)
-              .component(self.component).endpoint(self.endpoint))
+    def _lane_endpoint(self, comp: str):
+        assert self.drt is not None
+        return (self.drt.namespace(self.namespace)
+                .component(comp).endpoint(self.endpoint))
+
+    async def _serve_lane(self, comp: str):
         engine = self.engine
 
         async def handler(request, context):
             async for item in engine.generate(request, context):
                 yield item
 
-        await ep.serve_endpoint(handler)
+        return await self._lane_endpoint(comp).serve_endpoint(handler)
+
+    async def _register_card(self) -> None:
+        from ..llm.model_card import ModelDeploymentCard, register_llm
+
+        self._card_key = await register_llm(
+            self._lane_endpoint(self.component),
+            ModelDeploymentCard(
+                name=self.engine_args.model_name,
+                tokenizer="byte",
+                kv_cache_block_size=self.engine_args.block_size,
+                migration_limit=self.migration_limit,
+            ))
+
+    async def _drop_card(self) -> None:
+        # mirror ServedEndpoint.remove for the leased card key: a worker
+        # morphed away from decode must stop advertising the model NOW,
+        # not at lease expiry
+        assert self.drt is not None and self._card_key is not None
+        self.drt._leased_keys.pop(self._card_key, None)
+        if self.drt.discovery is not None:
+            await self.drt.discovery.delete(self._card_key)
+        self._card_key = None
+
+    async def _start_metrics(self) -> None:
+        from ..llm.kv_router.publisher import WorkerMetricsPublisher
+
+        # swap-before-await: the attribute is cleared synchronously, so a
+        # concurrent caller never double-closes the same publisher
+        pub, self._metrics_pub = self._metrics_pub, None
+        if pub is not None:
+            await pub.close()
+        if not self._served:
+            return
+        comp = (self.component if self.component in self._served
+                else next(iter(self._served)))
         # same load-signal surface as `python -m dynamo_tpu.mocker`: the
         # admission gate and KV router read sched_est_ttft_ms/queue depth
-        # off this topic (docs/overload.md)
+        # off this topic (docs/overload.md); the planner's RoleEstimates
+        # reads sched_est_{prefill,decode}_tok_s off the same stats dict
         self._metrics_pub = WorkerMetricsPublisher(
-            self.drt, ep, self.drt.instance_id, engine.stats
+            self.drt, self._lane_endpoint(comp),
+            self.drt.instance_id, self.engine.stats
         )
         await self._metrics_pub.start()
-        await register_llm(ep, ModelDeploymentCard(
-            name=self.engine_args.model_name,
-            tokenizer="byte",
-            kv_cache_block_size=self.engine_args.block_size,
-            migration_limit=self.migration_limit,
-        ))
+
+    async def _apply_lanes(self, role: str) -> None:
+        """Reconcile discovery registrations to `role`'s lane set: remove
+        lanes the role drops, serve lanes it gains (born `morphing` until
+        the morph commits), and move the model card + metrics topic with
+        the decode lane. Runs as the engine morph's on_flip hook, so the
+        discovery flip is atomic with drain completion."""
+        from ..runtime.component import STATE_MORPHING
+
+        want = set(self._role_components(role))
+        for comp in set(self._served) - want:
+            await self._served.pop(comp).remove()
+        for comp in want - set(self._served):
+            served = await self._serve_lane(comp)
+            await served.set_state(STATE_MORPHING)
+            self._served[comp] = served
+        if self.component in want and self._card_key is None:
+            await self._register_card()
+        elif self.component not in want and self._card_key is not None:
+            await self._drop_card()
+        await self._start_metrics()
+
+    async def morph(self, target_role: str) -> dict:
+        """Re-role this live worker. Unroutable window first (every lane
+        flips to STATE_MORPHING before the drain starts, so new dials land
+        on peers), then the engine state machine drains + flips + re-warms
+        with `_apply_lanes` as the atomic discovery flip. On engine
+        rollback the old lanes are restored routable; MorphCrash
+        propagates for the pool's crash-style teardown."""
+        from ..runtime import faults
+        from ..runtime.component import STATE_MORPHING, STATE_READY
+
+        assert self.engine is not None
+        old_role = self.role
+        if target_role == old_role:
+            return {"from": old_role, "to": target_role, "drained": 0}
+        await self._set_lane_states(STATE_MORPHING)
+        try:
+            summary = await self.engine.morph(
+                target_role, on_flip=lambda: self._apply_lanes(target_role))
+        except faults.MorphCrash:
+            raise
+        except BaseException:
+            # engine rolled back to old_role (drained sessions already
+            # migrating to peers); restore the old lane set routable
+            await self._apply_lanes(old_role)
+            await self._set_lane_states(STATE_READY)
+            raise
+        self.role = target_role
+        await self._set_lane_states(STATE_READY)
+        return summary
+
+    async def _set_lane_states(self, state: str) -> None:
+        for served in list(self._served.values()):
+            await served.set_state(state)
+
+    async def start(self) -> "InProcMockWorker":
+        from ..llm.mocker import MockEngine
+
+        self.drt = await DistributedRuntime.create(self.cfg)
+        self.engine = MockEngine(self.engine_args)
+        await self.engine.warmup()
+        for comp in self._role_components(self.role):
+            self._served[comp] = await self._serve_lane(comp)
+        await self._start_metrics()
+        if self.component in self._served:
+            await self._register_card()
         return self
 
     @property
@@ -236,29 +361,55 @@ class InProcMockWorker:
 
 
 class InProcWorkerPool:
-    """PlannerConnector over in-proc mock workers (decode role; the
-    prefill count is accepted and ignored — co-located serving). Honors
-    the same `planner.connector` / `worker.spawn` / `worker.kill` fault
-    points as LocalProcessConnector so fault-plan soaks exercise one
-    grammar."""
+    """PlannerConnector over in-proc mock workers, role-aware: decode
+    workers serve `component` with the model card, prefill workers serve
+    `prefill_component` without one, and a colocated "both" worker serves
+    under both (docs/autoscaling.md "Role morphing"). Honors the same
+    `planner.connector` / `worker.spawn` / `worker.kill` fault points as
+    LocalProcessConnector so fault-plan soaks exercise one grammar, and
+    exposes the native `morph_replicas`/`colocate` capability the
+    planner's re-role arm probes for — a morph re-roles a LIVE worker via
+    `InProcMockWorker.morph` instead of cold-spawning, which is exactly
+    the time-to-SLA-recovery edge the soak measures (`spawn_delay_s`
+    prices the cold spawn the morph avoids)."""
 
     def __init__(self, cfg: RuntimeConfig, engine_args, *,
-                 component: str = "mocker", spawn_retries: int = 3):
+                 component: str = "mocker",
+                 prefill_component: str = "prefill",
+                 spawn_retries: int = 3, spawn_delay_s: float = 0.0,
+                 estimates=None):
         self.cfg = cfg
         self.engine_args = engine_args
         self.component = component
+        self.prefill_component = prefill_component
         self.spawn_retries = spawn_retries
+        self.spawn_delay_s = spawn_delay_s
+        # planner.RoleEstimates (optional): reconcile() feeds it each
+        # worker's stats so sched_est_{prefill,decode}_tok_s price the
+        # planner's re-role decision without an HTTP scrape hop
+        self.estimates = estimates
         self.workers: List[InProcMockWorker] = []
         self.scale_events: List[Tuple[float, int]] = []  # (t, decode_count)
-        self._want: Optional[int] = None
+        self.morph_events: List[Tuple[float, str, str]] = []  # (t, from, to)
+        self._want: Optional[Tuple[int, int]] = None
 
-    async def _spawn(self) -> None:
+    def count(self, role: str) -> int:
+        """Workers currently covering `role` ("both" counts for each)."""
+        return sum(1 for w in self.workers
+                   if role in ROLE_SERVES.get(w.role, ()))
+
+    async def _spawn(self, role: str = "decode") -> None:
+        import dataclasses
+
         from ..runtime import faults
         from ..runtime.backoff import Backoff, retry_async
 
         async def start_one():
-            w = InProcMockWorker(self.cfg, self.engine_args,
-                                 component=self.component)
+            args = (dataclasses.replace(self.engine_args, role=role)
+                    if getattr(self.engine_args, "role", role) != role
+                    else self.engine_args)
+            w = InProcMockWorker(self.cfg, args, component=self.component,
+                                 prefill_component=self.prefill_component)
             f = faults.FAULTS
             if f.enabled:
                 act = await f.on("worker.spawn")  # `error` raises
@@ -268,14 +419,36 @@ class InProcWorkerPool:
                     await w.start()
                     await w.stop(graceful=False)
                     raise ConnectionError("injected: worker crashed before ready")
+            if self.spawn_delay_s > 0:
+                # priced cold-spawn: the provisioning latency a morph of a
+                # live worker does NOT pay
+                await asyncio.sleep(self.spawn_delay_s)
             await w.start()
-            self.workers.append(w)
+            return w
 
-        await retry_async(
+        self.workers.append(await retry_async(
             start_one, attempts=self.spawn_retries,
             backoff=Backoff.seeded("worker.spawn", base=0.05, max_delay=0.5),
             desc="in-proc worker spawn", log=logger,
-        )
+        ))
+
+    async def _stop_role(self, role: str) -> None:
+        """Shed one unit of `role` capacity: retire the newest dedicated
+        worker gracefully (the PR-3 drain sequence), or — if only a
+        colocated worker covers the role — de-colocate by morphing it
+        down to the remaining role."""
+        exact = [w for w in self.workers if w.role == role]
+        if exact:
+            w = exact[-1]
+            self.workers.remove(w)
+            await w.stop(graceful=True)
+            return
+        colo = [w for w in self.workers if w.role == "both"]
+        if colo:
+            other = "decode" if role == "prefill" else "prefill"
+            await self._morph_worker(colo[-1], other)
+            return
+        raise RuntimeError(f"no {role} worker to stop")
 
     async def set_replicas(self, prefill: int, decode: int,
                            frontend: Optional[int] = None) -> None:
@@ -287,16 +460,88 @@ class InProcWorkerPool:
         f = faults.FAULTS
         if f.enabled:
             await f.on("planner.connector")  # `error` raises; planner retries
-        while len(self.workers) < decode:
-            await self._spawn()
-        while len(self.workers) > decode:
-            w = self.workers.pop()
-            await w.stop(graceful=True)  # the PR-3 drain sequence
+        while self.count("decode") < decode:
+            await self._spawn("decode")
+        while self.count("prefill") < prefill:
+            await self._spawn("prefill")
+        # retire colocated workers outright while BOTH roles are above
+        # target (shutdown path); per-role shrink below de-colocates
+        while (self.count("prefill") > prefill
+               and self.count("decode") > decode):
+            colo = [w for w in self.workers if w.role == "both"]
+            if not colo:
+                break
+            w = colo[-1]
+            self.workers.remove(w)
+            await w.stop(graceful=True)
+        while self.count("decode") > decode:
+            await self._stop_role("decode")
+        while self.count("prefill") > prefill:
+            await self._stop_role("prefill")
         # committed only on success (same contract as LocalProcessConnector:
         # reconcile re-asserts the last SUCCESSFUL counts, never a target
         # the planner recorded as connector-error)
-        self._want = decode
-        self.scale_events.append((time.monotonic(), len(self.workers)))
+        self._want = (prefill, decode)
+        self.scale_events.append((time.monotonic(), self.count("decode")))
+
+    async def morph_replicas(self, from_role: str, to_role: str,
+                             k: int) -> int:
+        """Re-role up to k live workers from `from_role` to `to_role` —
+        the planner's re-role arm. Only dedicated from_role workers are
+        candidates (newest first, matching scale-down order). Commits the
+        new role split to `_want` so reconcile re-asserts it."""
+        from ..runtime import faults
+
+        f = faults.FAULTS
+        if f.enabled:
+            await f.on("planner.connector")  # `error` raises; planner retries
+        done = 0
+        for _ in range(k):
+            cands = [w for w in self.workers if w.role == from_role]
+            if not cands:
+                break
+            await self._morph_worker(cands[-1], to_role)
+            done += 1
+        if done:
+            self._want = (self.count("prefill"), self.count("decode"))
+            self.scale_events.append((time.monotonic(), self.count("decode")))
+        return done
+
+    async def _morph_worker(self, w: InProcMockWorker, to_role: str) -> None:
+        from ..runtime import faults
+
+        from_role = w.role
+        try:
+            await w.morph(to_role)
+        except faults.MorphCrash:
+            # crashed mid-morph: crash-style teardown — the lease revoke
+            # severs its streams onto peers through the same migration
+            # machinery a SIGKILL exercises; reconcile respawns to the
+            # last committed want. Surfaces to the planner as an
+            # uncommitted connector error (PR-9 retry semantics).
+            self.workers.remove(w)
+            await w.stop(graceful=False)
+            self.scale_events.append((time.monotonic(), self.count("decode")))
+            raise ConnectionError("worker crashed mid-morph") from None
+        self.morph_events.append((time.monotonic(), from_role, to_role))
+
+    async def colocate(self) -> bool:
+        """Fold to colocated serving at the traffic floor: morph the
+        newest decode worker to "both", then gracefully retire dedicated
+        prefill workers. Returns False when already colocated or nothing
+        to fold."""
+        if any(w.role == "both" for w in self.workers):
+            return False
+        decode = [w for w in self.workers if w.role == "decode"]
+        if not decode:
+            return False
+        await self._morph_worker(decode[-1], "both")
+        for w in [w for w in self.workers if w.role == "prefill"]:
+            self.workers.remove(w)
+            await w.stop(graceful=True)
+        self._want = (self.count("prefill"), self.count("decode"))
+        self.scale_events.append((time.monotonic(), self.count("decode")))
+        return True
 
     async def reconcile(self) -> None:
         from ..runtime import faults
@@ -307,8 +552,14 @@ class InProcWorkerPool:
             # worker death on the reconcile tick, no drain — migration
             # absorbs the severed streams, the respawn below heals
             await self.kill_one()
-        if self._want is not None and len(self.workers) < self._want:
-            await self.set_replicas(0, self._want)
+        if self._want is not None:
+            p, d = self._want
+            if self.count("prefill") < p or self.count("decode") < d:
+                await self.set_replicas(p, d)
+        if self.estimates is not None:
+            for w in list(self.workers):
+                if w.engine is not None:
+                    self.estimates.observe(w.instance_id, w.engine.stats())
 
     async def kill_one(self, index: int = -1) -> int:
         """Crash-style teardown of one worker (no drain): the in-proc
@@ -317,7 +568,7 @@ class InProcWorkerPool:
         w = self.workers.pop(index)
         iid = w.instance_id
         await w.stop(graceful=False)
-        self.scale_events.append((time.monotonic(), len(self.workers)))
+        self.scale_events.append((time.monotonic(), self.count("decode")))
         return iid
 
     async def shutdown(self) -> None:
@@ -368,6 +619,12 @@ class RampPhase:
     qps: float
     duration_s: float
     label: str = ""
+    # per-phase shape overrides (None = RampLoad's defaults): a
+    # prefill-heavy phase (big isl, small osl) flipping to a decode-heavy
+    # one (small isl, big osl) is how the morph soak skews the planner's
+    # per-role ask without changing total qps
+    isl_chars: Optional[int] = None
+    osl_tokens: Optional[int] = None
 
 
 @dataclass
@@ -517,19 +774,23 @@ class RampLoad:
                 t_phase = time.monotonic()
                 gap = 1.0 / max(phase.qps, 1e-9)
                 n = max(1, int(round(phase.qps * phase.duration_s)))
+                isl = phase.isl_chars if phase.isl_chars is not None \
+                    else self.isl_chars
+                osl = phase.osl_tokens if phase.osl_tokens is not None \
+                    else self.osl_tokens
                 for k in range(n):
                     at = t_phase + k * gap
                     delay = at - time.monotonic()
                     if delay > 0:
                         await asyncio.sleep(delay)
-                    prompt = f"soak-{self.seed}-{i:05d} " + "x" * self.isl_chars
+                    prompt = f"soak-{self.seed}-{i:05d} " + "x" * isl
                     tenant, priority = "", 0
                     if self.tenant_cycle:
                         tenant, priority = self.tenant_cycle[
                             i % len(self.tenant_cycle)]
                     tasks.append(asyncio.create_task(drive_stream(
                         session, self.base_url, self.model, prompt,
-                        self.osl_tokens, phase=phase.label or f"qps{phase.qps}",
+                        osl, phase=phase.label or f"qps{phase.qps}",
                         tenant=tenant, priority=priority,
                     )))
                     i += 1

@@ -43,8 +43,20 @@ MODEL_ROOT = "v1/mdc/"
 #: is published by the graceful-shutdown sequence the moment a worker stops
 #: accepting new streams, so routers and the planner's capacity counter can
 #: skip it WITHOUT waiting for the lease-revoke delete event to propagate.
+#: `morphing` is the role-flip analogue (docs/autoscaling.md "Role
+#: morphing"): the worker is live but mid prefill<->decode re-role — its
+#: OUTGOING role stops taking new streams exactly like draining, except the
+#: record flips back to `ready` (under the new component) instead of being
+#: deleted.
 STATE_READY = "ready"
 STATE_DRAINING = "draining"
+STATE_MORPHING = "morphing"
+
+#: States a router must not pick for NEW streams: dialing either buys a
+#: per-request `draining`-coded rejection (the server severs/refuses), so
+#: `ready_instance_ids` filters both (PR 9 drain invariant, extended to
+#: morphs).
+UNROUTABLE_STATES = (STATE_DRAINING, STATE_MORPHING)
 
 
 @dataclass
@@ -57,7 +69,7 @@ class Instance:
     instance_id: int
     address: str  # host:port of the worker's request-plane server
     subject: str  # routing subject within that server
-    state: str = STATE_READY  # STATE_READY | STATE_DRAINING
+    state: str = STATE_READY  # STATE_READY | STATE_DRAINING | STATE_MORPHING
 
     @property
     def path(self) -> str:
@@ -387,6 +399,17 @@ class ServedEndpoint:
         if drt.discovery is not None:
             await drt.discovery.delete(self.instance.path)
 
+    async def set_state(self, state: str):
+        """Re-publish this instance's discovery record with a new lifecycle
+        state under the same primary lease. The role-morph sequence uses
+        this to flip ready -> morphing before the drain (watch consumers
+        stop routing new streams here immediately) and morphing -> ready on
+        rollback — same put-before-authority discipline as
+        `_mark_instances_draining`."""
+        drt = self.endpoint.drt
+        self.instance.state = state
+        await drt.put_leased(self.instance.path, self.instance.to_json())
+
 
 class Client:
     """Endpoint client with a live instance list
@@ -475,11 +498,13 @@ class Client:
 
     def ready_instance_ids(self) -> List[int]:
         """Instances eligible for NEW streams: excludes workers whose
-        discovery record is in `draining` state (scale-down in progress —
-        dialing them only buys a per-request rejection)."""
+        discovery record is in `draining` state (scale-down in progress)
+        or `morphing` state (live role flip in progress — the outgoing
+        role's record is about to move under another component) — dialing
+        either only buys a per-request rejection."""
         return sorted(
             iid for iid, inst in self.instances.items()
-            if inst.state != STATE_DRAINING
+            if inst.state not in UNROUTABLE_STATES
         )
 
     async def wait_for_instances(self, timeout: float = 30.0) -> List[int]:

@@ -252,6 +252,42 @@ ENV_REGISTRY: tuple = (
            "shared discovery, docs/frontend_scaleout.md). 0 = frontends "
            "are not planner-managed (the pre-PR-13 behavior).",
            "planner/planner_core.py"),
+    # -- planner role morphing (docs/autoscaling.md "Role morphing") ---- #
+    EnvVar("DYN_PLANNER_MORPH", "bool", "1",
+           "Re-role arm: under load skew (one role over, the other "
+           "under) convert a live worker via morph instead of "
+           "cold-spawning, when the priced morph beats spawn on "
+           "time-to-SLA-recovery. Effective only when the connector "
+           "exposes morph_replicas; 0 = spawn-only (the pre-morph "
+           "behavior).",
+           "planner/planner_core.py"),
+    EnvVar("DYN_PLANNER_MORPH_COST_S", "float", "3.0",
+           "Seed estimate of one live morph's wall-clock (drain the "
+           "outgoing role + flip + re-warm cached compile surfaces); "
+           "refined by the connector's measured morph durations when "
+           "available. Compared against DYN_PLANNER_SPAWN_COST_S to "
+           "price re-role vs spawn.",
+           "planner/planner_core.py"),
+    EnvVar("DYN_PLANNER_SPAWN_COST_S", "float", "30.0",
+           "Seed estimate of a cold replica spawn's wall-clock (process "
+           "start + weight load + full warmup compile drive) for the "
+           "re-role pricing; refined by measured spawn-to-ready times "
+           "when the connector reports them.",
+           "planner/planner_core.py"),
+    EnvVar("DYN_PLANNER_COLOCATE", "bool", "0",
+           "Colocated-mode arm: at low traffic (both roles' raw asks at "
+           "the 1-replica floor for the scale-down-stable window) morph "
+           "the decode worker to role `both` and retire the dedicated "
+           "prefill replica — small fleets stop paying a dedicated "
+           "prefill tax. Scale-up later adds dedicated replicas "
+           "normally.",
+           "planner/planner_core.py"),
+    EnvVar("DYN_MORPH_DRAIN_TIMEOUT_S", "float", "10.0",
+           "Engine role-morph drain budget: in-flight outgoing-role "
+           "sessions are severed to peers (StreamSevered -> migration) "
+           "and must clear the lanes within this window before the flip "
+           "proceeds; expiry fails the morph and rolls the role back.",
+           "engine/engine.py"),
     # -- frontend admission gate (gate/, docs/overload.md) -------------- #
     EnvVar("DYN_GATE", "bool", "1",
            "dynogate master switch: frontend admission control, per-"

@@ -109,6 +109,12 @@ KNOWN_FAULT_POINTS = {
         "`kill` — LocalProcessConnector reconcile tick: SIGKILL a live "
         "managed replica with NO drain (hard worker death); migration "
         "must absorb the lost streams and reconcile respawns the corpse",
+    "worker.morph":
+        "`error` | `delay` | `hang` | `crash` — engine role-morph stages "
+        "(checked mid-drain and again mid-flip); `error` rolls the worker "
+        "back to its original role (drained sessions already resumed on "
+        "peers), `crash` tears the worker down mid-morph like a SIGKILL "
+        "so lease TTL + migration corpse-handling absorb it",
     "kv_transfer.checkpoint":
         "`sever` | `delay` — session-checkpoint push to the peer's G2 "
         "(kvbm/checkpoint.py); `sever` drops the batch (counted) and "
@@ -129,6 +135,13 @@ KNOWN_FAULT_POINTS = {
 class FaultError(RuntimeError):
     """An injected fault (action `error`). Typed so tests and callers can
     tell a chaos-induced failure from an organic one."""
+
+
+class MorphCrash(FaultError):
+    """Injected `worker.morph:crash`: the engine raises this out of its
+    morph sequence INSTEAD of rolling back, so the worker harness tears
+    the process down mid-morph like a SIGKILL — lease lingers to TTL,
+    streams sever, and the PR 15 migration/corpse machinery absorbs it."""
 
 
 @dataclass

@@ -43,6 +43,8 @@ KV_ACTIVE_BLOCKS = "kv_active_blocks"
 KV_TOTAL_BLOCKS = "kv_total_blocks"
 SCHED_EST_TTFT_MS = "sched_est_ttft_ms"
 SCHED_EST_REQ_MS = "sched_est_req_ms"
+SCHED_EST_PREFILL_TOK_S = "sched_est_prefill_tok_s"
+SCHED_EST_DECODE_TOK_S = "sched_est_decode_tok_s"
 
 #: The observability contract: every metric key this package emits —
 #: stats()-dict keys published on the metrics topic, prometheus names
@@ -102,6 +104,14 @@ METRICS = {
     "resume_source_peer": {"kind": "counter", "layer": "engine", "help": "Migration resumes seeded from live peer KV.", "export": True},
     "resume_source_local": {"kind": "counter", "layer": "engine", "help": "Migration resumes seeded from local tiers.", "export": True},
     "resume_source_recompute": {"kind": "counter", "layer": "engine", "help": "Migration resumes that fully re-prefilled.", "export": True},
+    # role morphing (docs/autoscaling.md "Role morphing"): the live
+    # prefill<->decode re-role state machine's outcome counters
+    "engine_role": {"kind": "info", "layer": "engine", "help": "Current serving role (prefill/decode/both/aggregated)."},
+    "morph_state": {"kind": "info", "layer": "engine", "help": "Role-morph state machine position (serving/draining-role/flipped/warm)."},
+    "morphs_completed": {"kind": "counter", "layer": "engine", "help": "Live role morphs that reached the new role's warm state.", "export": True},
+    "morphs_rolled_back": {"kind": "counter", "layer": "engine", "help": "Role morphs that failed mid-flight and restored the original role.", "export": True},
+    "morph_drained_sessions": {"kind": "counter", "layer": "engine", "help": "In-flight sessions severed to peers by morph drains (resumed via migration).", "export": True},
+    "morph_last_duration_s": {"kind": "gauge", "layer": "engine", "unit": "seconds", "help": "Wall-clock of the last completed morph (drain + flip + re-warm).", "export": True},
     "kv_skip_ahead_blocks": {"kind": "counter", "layer": "engine", "unit": "blocks", "help": "Prefill blocks skipped via prefix skip-ahead.", "export": True},
     "emit_batches": {"kind": "counter", "layer": "engine", "help": "Token delta batches emitted to streams.", "export": True},
     "emit_tokens": {"kind": "counter", "layer": "engine", "unit": "tokens", "help": "Tokens emitted to streams.", "export": True},
@@ -139,6 +149,8 @@ METRICS = {
     "sched_last_decision": {"kind": "info", "layer": "sched", "help": "Last scheduling decision tag."},
     SCHED_EST_TTFT_MS: {"kind": "gauge", "layer": "sched", "unit": "ms", "help": "Projected TTFT for one more admitted request — the gate's admission ceiling and the disagg router's routing signal.", "wire": True, "export": True},
     SCHED_EST_REQ_MS: {"kind": "gauge", "layer": "sched", "unit": "ms", "help": "Marginal TTFT cost of one more admitted request (the gate's optimism debt between publishes).", "wire": True, "export": True},
+    SCHED_EST_PREFILL_TOK_S: {"kind": "gauge", "layer": "sched", "unit": "tok/s", "help": "Per-worker marginal prefill throughput estimate from the cost-model EWMAs — prices the planner's re-role (morph vs spawn) decision.", "wire": True, "export": True},
+    SCHED_EST_DECODE_TOK_S: {"kind": "gauge", "layer": "sched", "unit": "tok/s", "help": "Per-worker marginal decode throughput estimate from the cost-model EWMAs — prices the planner's re-role (morph vs spawn) decision.", "wire": True, "export": True},
     # ---- KVBM tiers / offload / checkpoint (kvbm/) -------------------
     "kvbm_g1_hit_blocks": {"kind": "counter", "layer": "kvbm", "unit": "blocks", "help": "Device prefix-cache hits at admission (G1).", "export": True},
     "kvbm_g1_miss_blocks": {"kind": "counter", "layer": "kvbm", "unit": "blocks", "help": "Device prefix-cache misses at admission (G1).", "export": True},

@@ -376,6 +376,16 @@ class RequestPlaneServer:
                     "t": T_ERR, "code": ERR_DEADLINE,
                     "error": f"{type(e).__name__}: {e}",
                 }
+            elif isinstance(e, StreamSevered):
+                # deliberate mid-stream sever (role-morph drain): ride the
+                # `draining` code so the CALLER raises StreamLost and its
+                # migration machinery resumes the session on a peer from
+                # the checkpointed tail — a plain T_ERR would surface as a
+                # terminal EngineError and kill the stream instead
+                ctrl = {
+                    "t": T_ERR, "code": ERR_DRAINING,
+                    "error": f"{type(e).__name__}: {e}",
+                }
             else:
                 ctrl = {"t": T_ERR, "error": f"{type(e).__name__}: {e}"}
             try:
@@ -397,6 +407,15 @@ class EngineError(RuntimeError):
 class StreamLost(EngineError):
     """Connection to the worker died mid-stream — the trigger for request
     migration (reference migration.rs)."""
+
+
+class StreamSevered(EngineError):
+    """Raised BY a worker's handler to deliberately cut an in-flight
+    stream (role-morph drain: the outgoing role's lanes must move to a
+    peer NOW, not when their decodes finish). The server maps it to a
+    `draining`-coded T_ERR, which the caller raises as StreamLost — so
+    the frontend's migration loop re-routes the session and it resumes
+    from its durable checkpoint instead of dying with the role."""
 
 
 class DeadlineExceeded(EngineError):

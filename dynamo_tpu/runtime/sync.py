@@ -99,6 +99,15 @@ GUARDED_STATE = {
     # the engine step loop, per the convention this registry was seeded
     # to enforce on ROADMAP item 1's scheduler.
     "CostModel._ewma": "lock:_lock",
+    # live role morphing (docs/autoscaling.md "Role morphing"): the
+    # serving role and the morph state machine's position are mutated
+    # only inside the engines' `morph` coroutine (one morph at a time —
+    # morph() refuses re-entry); generate/admission/stats read them from
+    # other tasks, which the event loop makes atomic per read.
+    "JaxEngine._role": "single-task:morph",
+    "JaxEngine._morph_state": "single-task:morph",
+    "MockEngine._role": "single-task:morph",
+    "MockEngine._morph_state": "single-task:morph",
     "StepPlanner._deadlines": "single-task:_step_loop",
     "StepPlanner._records": "single-task:_step_loop",
     # dynogate tenant-fairness tiebreak bookkeeping: granted tokens per
@@ -122,10 +131,18 @@ GUARDED_STATE = {
     "Planner._target": "single-task:run",
     "Planner._below_streak": "single-task:run",
     "Planner._intervals_since_change": "single-task:run",
+    # re-role arms (docs/autoscaling.md "Role morphing"): the colocate
+    # streak is governor state like the counters above — owned by the
+    # planner's run task end to end.
+    "Planner._colocate_streak": "single-task:run",
     # connector replica bookkeeping: written only by set_replicas /
     # reconcile, both reached from the planner's run task.
     "LocalProcessConnector._want": "single-task:run",
     "InProcWorkerPool._want": "single-task:run",
+    # the in-proc pool's worker list moves with _want: every mutation
+    # (spawn/retire/morph/kill) happens in connector methods reached from
+    # the planner's run task; other tasks only snapshot-read it.
+    "InProcWorkerPool.workers": "single-task:run",
     # deploy/planner reconcilers: one _PollLoop task per reconciler owns
     # the failure-backoff and revision bookkeeping end to end.
     "GraphController._failures": "single-task:reconcile_once",

@@ -1,0 +1,535 @@
+"""Live prefill↔decode role morphing (docs/autoscaling.md "Role
+morphing"): the engine state machine (drain via StreamSevered
+tail-migration, rollback, crash propagation), router skip of `morphing`
+instances, disagg queue-depth invalidation on role flips, the planner's
+priced re-role/colocate arms, and the in-proc cluster's live flip with
+zero lost stream items.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from dynamo_tpu.llm.disagg import DisaggConfig, DisaggregatedRouter
+from dynamo_tpu.llm.mocker import MockEngine, MockEngineArgs
+from dynamo_tpu.llm.protocols import PreprocessedRequest
+from dynamo_tpu.planner import (
+    DiscoveryWorkerCounts,
+    Metrics,
+    NoopConnector,
+    NoopMorphConnector,
+    Planner,
+    SlaArgs,
+)
+from dynamo_tpu.planner.planner_core import RoleEstimates
+from dynamo_tpu.planner.soak import (
+    InProcWorkerPool,
+    RampLoad,
+    RampPhase,
+    SoakFrontend,
+    contiguity_report,
+    make_interpolators,
+)
+from dynamo_tpu.runtime import (
+    DiscoveryServer,
+    DistributedRuntime,
+    PushRouter,
+    RouterMode,
+    RuntimeConfig,
+    faults,
+)
+from dynamo_tpu.runtime.component import STATE_MORPHING, Instance
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.faults import KNOWN_FAULT_POINTS
+from dynamo_tpu.runtime.metrics import (
+    METRICS,
+    SCHED_EST_DECODE_TOK_S,
+    SCHED_EST_PREFILL_TOK_S,
+)
+from dynamo_tpu.runtime.request_plane import StreamSevered
+
+
+@pytest.fixture(autouse=True)
+def _reset_faults():
+    yield
+    faults.reset()
+
+
+def _req(tokens, max_tokens=8, rid="r0"):
+    return PreprocessedRequest(
+        token_ids=tokens,
+        stop_conditions={"max_tokens": max_tokens},
+        eos_token_ids=[-1],
+        request_id=rid,
+    ).to_dict()
+
+
+# --------------------------------------------------------------------------- #
+# registries: the morph surface is spelled, not ad-hoc
+# --------------------------------------------------------------------------- #
+
+
+def test_morph_fault_point_and_metrics_registered():
+    assert "worker.morph" in KNOWN_FAULT_POINTS
+    for key in (SCHED_EST_PREFILL_TOK_S, SCHED_EST_DECODE_TOK_S,
+                "engine_role", "morph_state", "morphs_completed",
+                "morphs_rolled_back", "morph_drained_sessions",
+                "morph_last_duration_s"):
+        assert key in METRICS, key
+
+
+# --------------------------------------------------------------------------- #
+# engine state machine (MockEngine; the JaxEngine shares the contract)
+# --------------------------------------------------------------------------- #
+
+
+def test_mock_engine_morph_drains_live_stream_and_flips():
+    async def main():
+        eng = MockEngine(MockEngineArgs(speedup_ratio=0.2, max_num_seqs=4))
+        await eng.warmup()
+        got = {"severed": False, "items": 0}
+
+        async def consume():
+            try:
+                async for _ in eng.generate(
+                        _req(list(range(16)), 64, "m0"), Context()):
+                    got["items"] += 1
+            except StreamSevered:
+                got["severed"] = True
+
+        t = asyncio.create_task(consume())
+        deadline = time.monotonic() + 5
+        while got["items"] == 0 and time.monotonic() < deadline:
+            await asyncio.sleep(0.01)
+        assert got["items"] > 0  # non-vacuous: tokens were flowing
+
+        summary = await eng.morph("prefill")
+        await asyncio.wait_for(t, 5)
+        assert got["severed"], "live stream must be severed for migration"
+        assert summary["from"] == "decode" and summary["to"] == "prefill"
+        assert summary["drained"] == 1
+
+        st = eng.stats()
+        assert st["engine_role"] == "prefill"
+        assert st["morph_state"] == "serving"
+        assert st["morphs_completed"] == 1
+        assert st["morph_drained_sessions"] == 1
+        # per-role marginal-throughput gauges price the planner's decision
+        assert st[SCHED_EST_PREFILL_TOK_S] > 0
+        assert st[SCHED_EST_DECODE_TOK_S] > 0
+
+        # same-role morph is a no-op, not an error
+        again = await eng.morph("prefill")
+        assert again["drained"] == 0
+        assert eng.stats()["morphs_completed"] == 1
+
+    asyncio.run(main())
+
+
+def test_mock_engine_morph_rolls_back_on_injected_error():
+    async def main():
+        eng = MockEngine(MockEngineArgs(speedup_ratio=100.0))
+        await eng.warmup()
+        faults.configure("worker.morph:error,times=1", seed=1)
+        with pytest.raises(faults.FaultError):
+            await eng.morph("prefill")
+        faults.reset()
+        st = eng.stats()
+        assert st["engine_role"] == "decode"  # rolled back
+        assert st["morph_state"] == "serving"
+        assert st["morphs_rolled_back"] == 1
+        assert st["morphs_completed"] == 0
+        # the rolled-back engine serves again immediately
+        items = [i async for i in eng.generate(
+            _req(list(range(8)), 4, "rb"), Context())]
+        assert items
+        # and a clean morph still works afterwards
+        await eng.morph("prefill")
+        assert eng.stats()["morphs_completed"] == 1
+
+    asyncio.run(main())
+
+
+def test_mock_engine_morph_crash_propagates_without_rollback():
+    async def main():
+        eng = MockEngine(MockEngineArgs(speedup_ratio=100.0))
+        await eng.warmup()
+        faults.configure("worker.morph:crash,times=1", seed=1)
+        with pytest.raises(faults.MorphCrash):
+            await eng.morph("prefill")
+        faults.reset()
+        # crash = the worker process is gone mid-morph; no tidy rollback
+        # bookkeeping is owed (the harness tears the corpse down)
+        assert eng.stats()["morphs_rolled_back"] == 0
+
+    asyncio.run(main())
+
+
+def test_mock_engine_morph_refuses_reentry_and_bad_role():
+    async def main():
+        eng = MockEngine(MockEngineArgs(speedup_ratio=100.0))
+        await eng.warmup()
+        with pytest.raises(ValueError):
+            await eng.morph("router")
+        gate = asyncio.Event()
+
+        async def slow_flip():
+            await gate.wait()
+
+        t = asyncio.create_task(eng.morph("prefill", on_flip=slow_flip))
+        deadline = time.monotonic() + 5
+        while eng.stats()["morph_state"] == "serving" and \
+                time.monotonic() < deadline:
+            await asyncio.sleep(0.01)
+        with pytest.raises(RuntimeError):
+            await eng.morph("decode")  # one morph at a time
+        gate.set()
+        await t
+        assert eng.stats()["engine_role"] == "prefill"
+
+    asyncio.run(main())
+
+
+# --------------------------------------------------------------------------- #
+# router: `morphing` is unroutable, same as `draining` (satellite)
+# --------------------------------------------------------------------------- #
+
+
+def test_push_router_skips_morphing_instance_for_new_streams():
+    """The dial-and-eat-rejection window regression: the moment a worker's
+    record flips to `morphing`, new streams route to peers — zero dials
+    against the flipping worker (streams that DID land before the flip are
+    severed and migrate; the in-proc lifecycle test below covers that)."""
+
+    async def main():
+        disc = DiscoveryServer(port=0)
+        host, port = await disc.start()
+        cfg = RuntimeConfig()
+        cfg.discovery_endpoint = f"tcp://{host}:{port}"
+
+        calls = []
+
+        def tagged(tag):
+            async def handler(request, context):
+                calls.append(tag)
+                yield {"worker": tag}
+
+            return handler
+
+        a = await DistributedRuntime.create(cfg)
+        await a.namespace("p").component("c").endpoint("e").serve_endpoint(
+            tagged("A")
+        )
+        b = await DistributedRuntime.create(cfg)
+        await b.namespace("p").component("c").endpoint("e").serve_endpoint(
+            tagged("B")
+        )
+        fe = await DistributedRuntime.create(cfg)
+        client = await fe.namespace("p").component("c").endpoint("e").client()
+        await client.wait_for_instances()
+        deadline = time.monotonic() + 5
+        while len(client.instance_ids()) < 2 and time.monotonic() < deadline:
+            await asyncio.sleep(0.02)
+
+        # A enters its morph window: state flips to `morphing` (what
+        # ServedEndpoint.set_state publishes before the drain starts)
+        key = f"v1/instances/p/c/e/{a.instance_id:x}"
+        raw = await fe.discovery.get(key)
+        inst = Instance.from_json(raw)
+        inst.state = STATE_MORPHING
+        await fe.discovery.put(key, inst.to_json())
+        deadline = time.monotonic() + 5
+        while a.instance_id in client.ready_instance_ids() and \
+                time.monotonic() < deadline:
+            await asyncio.sleep(0.02)
+        assert client.ready_instance_ids() == [b.instance_id]
+        # still PRESENT (lease alive, streams draining) — just unroutable
+        assert set(client.instance_ids()) == {a.instance_id, b.instance_id}
+
+        router = PushRouter(client, RouterMode.ROUND_ROBIN)
+        for _ in range(6):
+            stream = await router.generate({})
+            async for item in stream:
+                assert item["worker"] == "B"
+        assert calls.count("A") == 0 and calls.count("B") == 6
+
+        await client.close()
+        for drt in (fe, a, b):
+            await drt.close()
+        await disc.stop()
+
+    asyncio.run(main())
+
+
+# --------------------------------------------------------------------------- #
+# disagg: queue-depth staleness invalidation on role flips (satellite)
+# --------------------------------------------------------------------------- #
+
+
+def test_disagg_router_invalidate_drops_depth_immediately():
+    r = DisaggregatedRouter(DisaggConfig(
+        remote_prefill_threshold_tokens=8, max_prefill_queue=4,
+        queue_depth_ttl_s=1000.0,  # TTL alone would pin the stale depth
+    ))
+    r.update_queue_depth(100)
+    assert r.queue_depth_known()
+    # backed-up pool: big prompts stay local
+    assert not r.prefill_remote(64, 0, True)
+
+    # the prefill set flipped (worker morphed away): invalidate NOW — the
+    # decision falls back to the threshold rule instead of honoring a
+    # depth the TTL would have kept alive for another ~17 minutes
+    r.invalidate("role flip")
+    assert not r.queue_depth_known()
+    assert r.prefill_queue_depth == 0
+    assert r.prefill_remote(64, 0, True)
+
+    # and a fresh publish re-arms the guard
+    r.update_queue_depth(100)
+    assert not r.prefill_remote(64, 0, True)
+
+
+# --------------------------------------------------------------------------- #
+# planner: the priced re-role arm
+# --------------------------------------------------------------------------- #
+
+
+def _morph_planner(metrics_seq, workers=(2, 1), connector=None, **over):
+    args = dict(
+        ttft=0.4, itl=0.06, adjustment_interval=1.0, max_chip_budget=8,
+        cooldown_intervals=2, max_step=1, scale_down_stable_intervals=1,
+        load_predictor="constant", scrape_timeout=2.0, scrape_retries=1,
+    )
+    args.update(over)
+    seq = list(metrics_seq)
+
+    class SeqMetrics:
+        async def read(self):
+            return seq.pop(0) if seq else Metrics()
+
+    class FakeWorkers:
+        async def count(self):
+            return workers
+
+    # prefill per-chip 1200 tok/s, decode 56 tok/s: at qps 5 a
+    # (isl=400, osl=4) mix asks (2, 1) and a (isl=24, osl=20) mix (1, 2)
+    pi, di = make_interpolators(decode_tok_s_per_chip=56.0,
+                                prefill_tok_s_per_chip=1200.0)
+    connector = connector if connector is not None else NoopMorphConnector()
+    return Planner(SlaArgs(**args), pi, di, SeqMetrics(), FakeWorkers(),
+                   connector), connector
+
+
+_DECODE_HEAVY = Metrics(num_req=5.0, isl=24.0, osl=20.0, ttft=0.05,
+                        itl=0.03, request_duration=0.8)
+_PREFILL_HEAVY = Metrics(num_req=5.0, isl=400.0, osl=4.0, ttft=0.05,
+                         itl=0.03, request_duration=0.8)
+
+
+def test_planner_re_roles_under_skew_instead_of_spawning():
+    async def main():
+        planner, conn = _morph_planner([_DECODE_HEAVY, _PREFILL_HEAVY])
+        await planner.observe_metrics()
+        res = await planner.make_adjustments()
+        assert res == (1, 2)
+        # the skew was served by ONE live morph — no spawn/kill at all
+        assert conn.morphs == [("prefill", "decode", 1)]
+        assert conn.decisions == []
+        dec = planner.decision_log[-1]
+        assert dec.applied and dec.reason == "re-role:prefill->decode"
+
+        # the morph was a scale event on BOTH roles: the immediate
+        # opposite skew holds on cooldown instead of flapping A->B->A
+        await planner.observe_metrics()
+        res = await planner.make_adjustments()
+        assert res is None
+        assert planner.decision_log[-1].reason == "hold:cooldown"
+        assert conn.morphs == [("prefill", "decode", 1)]
+
+    asyncio.run(main())
+
+
+def test_planner_re_role_needs_capability_pricing_and_flag():
+    async def main():
+        # plain NoopConnector: no morph capability -> spawn path
+        planner, conn = _morph_planner([_DECODE_HEAVY],
+                                       connector=NoopConnector())
+        await planner.observe_metrics()
+        assert await planner.make_adjustments() == (1, 2)
+        assert conn.decisions == [(1, 2)]
+        assert planner.decision_log[-1].reason == "scale-up"
+
+        # priced out: morph no faster than spawn -> spawn path
+        planner, conn = _morph_planner([_DECODE_HEAVY], morph_cost_s=30.0,
+                                       spawn_cost_s=30.0)
+        await planner.observe_metrics()
+        assert await planner.make_adjustments() == (1, 2)
+        assert conn.morphs == [] and conn.decisions == [(1, 2)]
+
+        # kill switch (DYN_PLANNER_MORPH=0 -> morph_enabled False)
+        planner, conn = _morph_planner([_DECODE_HEAVY], morph_enabled=False)
+        await planner.observe_metrics()
+        assert await planner.make_adjustments() == (1, 2)
+        assert conn.morphs == [] and conn.decisions == [(1, 2)]
+
+        # no skew (both roles up): plain scale, no morph
+        planner, conn = _morph_planner(
+            [Metrics(num_req=5.0, isl=400.0, osl=20.0, ttft=0.05,
+                     itl=0.03, request_duration=0.8)], workers=(1, 1))
+        await planner.observe_metrics()
+        assert await planner.make_adjustments() == (2, 2)
+        assert conn.morphs == [] and conn.decisions == [(2, 2)]
+
+    asyncio.run(main())
+
+
+def test_planner_re_role_with_residual_scale():
+    async def main():
+        # ask (1, 3) from (2, 1) with max_step=2: one pair morphs, the
+        # residual decode replica still spawns — reason is typed for both
+        planner, conn = _morph_planner(
+            [Metrics(num_req=5.0, isl=24.0, osl=30.0, ttft=0.05,
+                     itl=0.03, request_duration=0.8)],
+            max_step=2)
+        await planner.observe_metrics()
+        res = await planner.make_adjustments()
+        assert res == (1, 3)
+        assert conn.morphs == [("prefill", "decode", 1)]
+        assert conn.decisions == [(1, 3)]
+        assert planner.decision_log[-1].reason == \
+            "re-role:prefill->decode+scale"
+
+    asyncio.run(main())
+
+
+def test_planner_morph_failure_is_uncommitted_and_retried():
+    async def main():
+        class FailingMorph(NoopMorphConnector):
+            async def morph_replicas(self, from_role, to_role, k):
+                raise ConnectionError("injected")
+
+        planner, conn = _morph_planner([_DECODE_HEAVY, _DECODE_HEAVY],
+                                       connector=FailingMorph(),
+                                       cooldown_intervals=0)
+        await planner.observe_metrics()
+        assert await planner.make_adjustments() is None
+        dec = planner.decision_log[-1]
+        assert not dec.applied and dec.reason == "connector-error"
+        # nothing committed: the next interval re-decides the same move
+        assert planner._target == (2, 1)
+        await planner.observe_metrics()
+        assert await planner.make_adjustments() is None
+        assert planner.decision_log[-1].reason == "connector-error"
+
+    asyncio.run(main())
+
+
+def test_planner_colocate_arm_folds_at_the_floor():
+    async def main():
+        calm = Metrics(num_req=1.0, isl=24.0, osl=16.0, ttft=0.02,
+                       itl=0.03, request_duration=0.5)
+        planner, conn = _morph_planner([calm] * 4, workers=(1, 1),
+                                       colocate=True,
+                                       scale_down_stable_intervals=2)
+        for _ in range(2):
+            await planner.observe_metrics()
+            await planner.make_adjustments()
+        assert conn.colocations == 1
+        colos = [d for d in planner.decision_log
+                 if d.applied and d.reason == "re-role:colocate"]
+        assert len(colos) == 1
+        # colocation is a scale event: the very next interval holds
+        await planner.observe_metrics()
+        await planner.make_adjustments()
+        assert conn.colocations == 1
+
+    asyncio.run(main())
+
+
+# --------------------------------------------------------------------------- #
+# in-proc lifecycle: live flip under load, colocate, crash, rollback
+# --------------------------------------------------------------------------- #
+
+
+def test_inproc_morph_lifecycle_zero_lost_streams():
+    """One cluster, the whole morph lifecycle: a decode worker re-roles to
+    prefill WHILE streams ride it (severed sessions resume on the peer —
+    count contiguity proves zero lost/duplicated items), discovery counts
+    flip with the role, colocation folds the fleet to one `both` worker,
+    a crash mid-morph leaves a corpse that reconcile replaces, and an
+    injected morph error rolls the worker back to a routable state."""
+
+    async def main():
+        fe = await SoakFrontend().start()
+        args = MockEngineArgs(model_name="mock-model", speedup_ratio=8.0)
+        pool = InProcWorkerPool(fe.cfg, args)
+        counts = DiscoveryWorkerCounts(fe.drt.discovery,
+                                       decode_component="mocker")
+        try:
+            await pool.set_replicas(1, 2)
+            assert (pool.count("prefill"), pool.count("decode")) == (1, 2)
+            assert await counts.count() == (1, 2)
+            await fe.wait_model("mock-model")
+
+            # live streams riding the flip
+            load = RampLoad(fe.base_url, "mock-model",
+                            [RampPhase(qps=20.0, duration_s=1.5,
+                                       label="flip")],
+                            osl_tokens=8)
+            t = asyncio.create_task(load.run())
+            await asyncio.sleep(0.4)
+            done = await pool.morph_replicas("decode", "prefill", 1)
+            assert done == 1
+            assert (pool.count("prefill"), pool.count("decode")) == (2, 1)
+            assert await counts.count() == (2, 1)  # discovery flipped too
+            records = await t
+            assert len(records) >= 10  # non-vacuous: the flip saw traffic
+            problems = contiguity_report(records)
+            assert not problems, problems[:5]
+            assert pool.morph_events, "morph must be recorded"
+
+            # morph back, then colocate at the floor
+            await pool.morph_replicas("prefill", "decode", 1)
+            await pool.set_replicas(1, 1)
+            assert await pool.colocate()
+            assert [w.role for w in pool.workers] == ["both"]
+            assert await counts.count() == (1, 1)  # both lanes served
+
+            # crash mid-morph: corpse handled, reconcile respawns
+            faults.configure("worker.morph:crash,times=1", seed=7)
+            with pytest.raises(ConnectionError):
+                await pool.morph_replicas("both", "decode", 1)
+            faults.reset()
+            assert pool.workers == []
+            await pool.reconcile()  # respawns to the committed want (1, 1)
+            assert (pool.count("prefill"), pool.count("decode")) == (1, 1)
+
+            # error mid-morph: engine rolls back, lanes restored routable
+            faults.configure("worker.morph:error,times=1", seed=7)
+            with pytest.raises(faults.FaultError):
+                await pool.morph_replicas("decode", "prefill", 1)
+            faults.reset()
+            assert len([w for w in pool.workers if w.role == "decode"]) == 1
+            assert await counts.count() == (1, 1)  # routable again
+            assert any(w.engine.stats()["morphs_rolled_back"] == 1
+                       for w in pool.workers)
+        finally:
+            await pool.shutdown()
+            await fe.stop()
+
+    asyncio.run(main())
+
+
+def test_role_estimates_fold_worker_gauges():
+    est = RoleEstimates()
+    assert est.fleet_tok_s() == (None, None)
+    est.observe(1, {SCHED_EST_PREFILL_TOK_S: 1000.0,
+                    SCHED_EST_DECODE_TOK_S: 40.0})
+    est.observe(2, {SCHED_EST_PREFILL_TOK_S: 2000.0,
+                    SCHED_EST_DECODE_TOK_S: 0.0})  # cold decode: excluded
+    pf, dc = est.fleet_tok_s()
+    assert pf == 1500.0 and dc == 40.0
+    # stats without the gauges (legacy worker) are ignored, not zeros
+    est.observe(3, {"num_waiting_reqs": 2})
+    assert est.fleet_tok_s() == (1500.0, 40.0)

@@ -553,14 +553,17 @@ def test_runtime_close_marks_instances_draining_before_delete():
 # --------------------------------------------------------------------------- #
 
 
-async def _soak_cluster(max_num_seqs=2, speedup_ratio=0.25):
+async def _soak_cluster(max_num_seqs=2, speedup_ratio=0.25, prefill=1):
     fe = await SoakFrontend().start()
     engine_args = MockEngineArgs(
         model_name="mock-model", block_size=8,
         max_num_seqs=max_num_seqs, speedup_ratio=speedup_ratio,
     )
     pool = InProcWorkerPool(fe.cfg, engine_args)
-    await pool.set_replicas(0, 1)
+    # start AT the min_endpoint floor: the role-aware pool really spawns
+    # prefill workers, so a (0, 1) start would have the planner's
+    # bootstrap arm cold-spawn the prefill replica mid-soak
+    await pool.set_replicas(prefill, 1)
     await fe.wait_model("mock-model")
     return fe, pool
 
@@ -605,7 +608,7 @@ def _assert_soak_invariants(planner, pool, records, t0):
     assert 2 in d_trace, (d_trace, [
         (x.reason, x.raw, x.target, x.applied) for x in planner.decision_log])
     assert d_trace[-1] == 1, d_trace
-    assert len(pool.workers) == 1
+    assert pool.count("decode") == 1
 
     # SLA attainment recovered: the ramp degraded it below 1.0, and the
     # post-scale-up tail of the run meets the target again
@@ -718,6 +721,164 @@ def test_worker_kill_mid_stream_migrates_with_contiguous_stream():
         finally:
             await pool.shutdown()
             await fe.stop()
+
+    asyncio.run(main())
+
+
+# --------------------------------------------------------------------------- #
+# role-morph soak: prefill-heavy → decode-heavy phase flip (slow)
+# --------------------------------------------------------------------------- #
+
+
+def _flip_ramp():
+    """Big-prompt/short-output flips to small-prompt/long-output: the
+    planner's per-role ask goes from (2, 1) to (1, 2) without the fleet
+    growing. Shape constraints that make the skew land as ONE decision:
+
+    * osl=60 > decode_tok_s_per_chip (56): even an interval that catches
+      a single completed decode-heavy request asks decode=2, so the
+      saturated pre-morph worker throttling completions-per-interval
+      can't flicker the ask back to 1;
+    * decode-heavy service time (~0.85s at speedup 0.6) is shorter than
+      the 1s adjustment interval, so every post-flip interval contains a
+      decode-heavy completion — there is no gap interval that sees only
+      one or two prefill-heavy stragglers and burns the skew on a lone
+      prefill scale-down."""
+    return [
+        RampPhase(qps=5, duration_s=4, label="prefill-heavy",
+                  isl_chars=400, osl_tokens=4),
+        RampPhase(qps=2.8, duration_s=8, label="decode-heavy",
+                  isl_chars=24, osl_tokens=60),
+    ]
+
+
+async def _run_flip_soak(morph_enabled, seed, fault_plan=None):
+    """One phase-flip soak run against a (2, 1) fleet with a PRICED cold
+    spawn (spawn_delay_s); returns everything the assertions need,
+    including time from the phase flip until decode capacity reached 2.
+
+    max_chip_budget=3 makes the system bistable between exactly (2, 1)
+    and (1, 2): the budget clamp absorbs both the post-recovery over-ask
+    (backlog-drain bursts inflate num_req) and mixed phase-boundary
+    intervals, so the only reachable transition is the skew itself."""
+    fe, pool = await _soak_cluster(speedup_ratio=0.6, prefill=2)
+    try:
+        pi, di = make_interpolators(decode_tok_s_per_chip=56.0,
+                                    prefill_tok_s_per_chip=1200.0)
+        counts = DiscoveryWorkerCounts(fe.drt.discovery,
+                                       decode_component="mocker")
+        planner = Planner(
+            _sla_args(scale_down_stable_intervals=1, max_chip_budget=3,
+                      morph_enabled=morph_enabled),
+            pi, di, FrontendMetricsSource(fe.metrics_url), counts, pool)
+        # reconcile feeds each worker's sched_est_*_tok_s gauges into the
+        # planner's RoleEstimates (the pricing signal, advisory)
+        pool.estimates = planner.role_estimates
+        pool.spawn_delay_s = 2.5  # the provisioning cost a morph avoids
+        inj = faults.configure(fault_plan, seed=seed) if fault_plan else None
+        ptask = asyncio.create_task(planner.run())
+        t0 = time.monotonic()
+        phases = _flip_ramp()
+        load = RampLoad(fe.base_url, "mock-model", phases, seed=seed)
+        records = await load.run()
+        await asyncio.sleep(2.0)  # let the post-flip decision settle
+        planner.stop()
+        await ptask
+        fired = {p for p, _ in inj.fired_log} if inj else set()
+        faults.reset()
+        t_flip = t0 + phases[0].duration_s
+        # the fleet held steady through the prefill-heavy phase
+        assert not [t for t, _ in pool.scale_events if t0 < t < t_flip]
+        recovery = None
+        for t, d in pool.scale_events:
+            if t >= t_flip and d >= 2:
+                recovery = t - t_flip
+                break
+        rolled_back = sum(
+            w.engine.stats()["morphs_rolled_back"]
+            for w in pool.workers if w.engine is not None
+        )
+        est_decode = planner.role_estimates.fleet_tok_s()[1]
+        return (planner, pool, records, recovery, fired, rolled_back,
+                est_decode)
+    finally:
+        faults.reset()
+        await pool.shutdown()
+        await fe.stop()
+
+
+@pytest.mark.slow
+def test_planner_morph_soak_phase_flip_beats_spawn():
+    """The tentpole acceptance soak: under a prefill-heavy→decode-heavy
+    flip, re-roling a live prefill worker restores decode capacity faster
+    than spawn-only scaling — with zero lost/duplicated stream items and
+    a flap-free decision log in both runs."""
+
+    async def main():
+        (p_m, pool_m, rec_m, recovery_m, _, _, est_decode) = \
+            await _run_flip_soak(morph_enabled=True, seed=4)
+        (p_s, pool_s, rec_s, recovery_s, _, _, _) = \
+            await _run_flip_soak(morph_enabled=False, seed=4)
+
+        # both runs: every stream exactly-once, no flapping
+        for planner, records in ((p_m, rec_m), (p_s, rec_s)):
+            problems = contiguity_report(records)
+            assert not problems, problems[:5]
+            assert_no_flapping(planner.decision_log,
+                               planner.args.cooldown_intervals,
+                               planner.args.adjustment_interval)
+
+        # the morph run re-roled (typed decision, recorded morph event);
+        # the spawn-only run scaled the cold way
+        morph_reasons = [d.reason for d in p_m.decision_log if d.applied]
+        assert any(r.startswith("re-role:prefill->decode")
+                   for r in morph_reasons), morph_reasons
+        assert pool_m.morph_events, "morph run must record a live re-role"
+        spawn_reasons = [d.reason for d in p_s.decision_log if d.applied]
+        assert not any(r.startswith("re-role:") for r in spawn_reasons)
+        assert "scale-up" in spawn_reasons, spawn_reasons
+        assert not pool_s.morph_events
+
+        # time-to-SLA-recovery: decode capacity back at 2 sooner via morph
+        assert recovery_m is not None and recovery_s is not None, (
+            recovery_m, recovery_s, pool_m.scale_events, pool_s.scale_events)
+        assert recovery_m < recovery_s - 1.0, (recovery_m, recovery_s)
+
+        # spawn-only really did hurt: SLA degraded while the spawn cooked
+        decode_heavy = [r for r in rec_s if r.phase == "decode-heavy"]
+        assert attainment(decode_heavy, TTFT_SLO_MS) < 1.0
+
+        # the pricing gauges were live (workers published warm estimates)
+        assert est_decode is not None and est_decode > 0
+
+    asyncio.run(main())
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("action", ["error", "crash"])
+def test_planner_morph_soak_with_morph_faults(action):
+    """Same flip soak with `worker.morph` faults live: an injected error
+    rolls the worker back (planner retries and the morph still lands); a
+    crash mid-morph leaves a corpse the pool tears down crash-style (the
+    planner's retry re-roles a peer). Either way: zero lost items, decode
+    capacity recovers, no flapping."""
+
+    async def main():
+        (planner, pool, records, recovery, fired, rolled_back, _) = \
+            await _run_flip_soak(morph_enabled=True, seed=5,
+                                 fault_plan=f"worker.morph:{action},times=1")
+        assert fired == {"worker.morph"}
+        problems = contiguity_report(records)
+        assert not problems, problems[:5]
+        assert recovery is not None, pool.scale_events
+        assert pool.morph_events, "a morph must land despite the fault"
+        if action == "error":
+            # the faulted worker restored its original role before the
+            # retry re-roled it — observable in its engine counters
+            assert rolled_back >= 1
+        assert_no_flapping(planner.decision_log,
+                           planner.args.cooldown_intervals,
+                           planner.args.adjustment_interval)
 
     asyncio.run(main())
 
