@@ -63,7 +63,7 @@ def _make_engine(model: str, B: int, isl: int, osl: int, K: int, page: int = 64,
 
 
 async def _run_one(engine, prompt: List[int], osl: int, times: List[tuple],
-                   temperature: float = 1.0):
+                   temperature: float = 1.0, lora_name=None, guided=None):
     """One request through the public engine API; appends (t, n_tokens)
     per emission burst."""
     from dynamo_tpu.llm.protocols import PreprocessedRequest
@@ -71,8 +71,12 @@ async def _run_one(engine, prompt: List[int], osl: int, times: List[tuple],
 
     req = PreprocessedRequest(
         token_ids=prompt,
-        stop_conditions={"max_tokens": osl, "ignore_eos": True},
+        stop_conditions={"max_tokens": osl,
+                         **({} if guided else {"ignore_eos": True})},
         sampling_options={"temperature": temperature},
+        eos_token_ids=[2] if guided else [],
+        lora_name=lora_name,
+        guided=guided,
     ).to_dict()
     first = None
     n = 0
@@ -321,6 +325,156 @@ def run_mixed_bench(args, model: str, vocab: int, B: int, isl: int, osl: int):
     return 0
 
 
+def _register_bench_adapter(engine):
+    """One rank-8 adapter initialized from the engine's own model config —
+    the lora traffic class for the blend replay."""
+    import jax
+
+    from dynamo_tpu.models import lora as lora_mod
+
+    engine.register_adapters([
+        lora_mod.init_adapter(
+            engine.model_config, "bench-ad", jax.random.PRNGKey(7), rank=8
+        )
+    ])
+
+
+async def _blended_replay(engine, kinds, B: int, isl: int, vocab: int,
+                          n_arrivals: int, seed: int = 0):
+    """Drive a blended trace: a plain decode group (repetitive prompts
+    when the engine runs spec — every decode lane is then a spec lane)
+    with staggered guided / lora / plain arrivals prefillng beside it.
+    Returns (emitted_tokens, per-step wall times by serving path)."""
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    times: List[tuple] = []
+    step_times = {"mixed": [], "split": [], "other": []}
+    spec = bool(engine.config.spec_mode)
+
+    orig_step = engine._step_once
+
+    async def timed_step():
+        m0, s0 = engine.mixed_steps, engine.split_steps
+        t0 = time.perf_counter()
+        r = await orig_step()
+        dt = time.perf_counter() - t0
+        kind = (
+            "mixed" if engine.mixed_steps > m0
+            else "split" if engine.split_steps > s0
+            else "other"
+        )
+        step_times[kind].append(dt * 1000.0)
+        return r
+
+    engine._step_once = timed_step
+    total = 0
+    try:
+        # the decode group must outlast the arrival schedule (spec blocks
+        # advance up to rounds*(1+d) tokens, so spec needs a longer osl)
+        osl_dec = max(32, (96 if spec else 12) * n_arrivals)
+        decode_tasks = [
+            asyncio.create_task(_run_one(
+                engine, _mk_prompt(rng, vocab, isl, spec), osl_dec, times,
+                temperature=0.0,
+            ))
+            for _ in range(max(B // 2, 1))
+        ]
+        await asyncio.sleep(0.25)
+        arrival_kinds = [k for k in kinds if k != "spec"] or ["plain"]
+        arrival_tasks = []
+        for i in range(n_arrivals):
+            kind = arrival_kinds[i % len(arrival_kinds)]
+            kw = {}
+            if kind == "guided":
+                kw["guided"] = {"kind": "choice", "choices": ["yes", "no"]}
+            elif kind == "lora":
+                kw["lora_name"] = "bench-ad"
+            arrival_tasks.append(asyncio.create_task(_run_one(
+                engine, _mk_prompt(rng, vocab, isl, False), 6, times,
+                temperature=0.0, **kw,
+            )))
+            await asyncio.sleep(0.1)
+        results = await asyncio.gather(*decode_tasks, *arrival_tasks)
+        total = sum(n for _, n in results)
+    finally:
+        engine._step_once = orig_step
+    return total, step_times
+
+
+def run_blend_bench(args, model: str, vocab: int, B: int, isl: int, osl: int):
+    """`--mixed --blend guided:lora:spec`: blended-workload fusion. The
+    unified arm serves every kind on the ONE ragged dispatch (spec verify
+    rows included); the split arm is the servable pre-fusion reference —
+    per-kind dedicated programs, and NON-spec when the blend includes
+    spec (guided/lora were inadmissible under the split spec lane).
+    Headline: emitted tokens per device dispatch, plus per-kind fused
+    row counts and mixed_coverage_frac for the unified arm."""
+    kinds = [k for k in args.blend.split(":") if k]
+    # size max_model_len for the replay's long decode group, not the
+    # nominal --osl (the group must outlast the whole arrival schedule)
+    osl_eng = max(osl, (96 if "spec" in kinds else 12) * max(B, 4))
+    arms = {}
+    for name, flag in (("unified", True), ("split", False)):
+        spec = "ngram" if ("spec" in kinds and flag) else None
+        engine = _make_engine(
+            model, B, isl, osl_eng, args.block, quantize=args.quantize,
+            spec=spec, mixed=flag,
+        )
+        if "lora" in kinds:
+            _register_bench_adapter(engine)
+
+        async def run(eng=engine):
+            await _steady(eng, min(B, 2), isl, 8, vocab, seed=99,
+                          repetitive=bool(spec))
+            await _blended_replay(eng, kinds, B, isl, vocab,
+                                  n_arrivals=max(B, 4), seed=99)
+            d0 = {k: v for k, v in eng.stats().items()
+                  if k.startswith("dispatch_") and k.endswith("_count")}
+            toks, st = await _blended_replay(eng, kinds, B, isl, vocab,
+                                             n_arrivals=max(B, 4))
+            await eng.close()
+            return toks, st, d0
+
+        toks, step_times, d0 = asyncio.run(run())
+        s = engine.stats()
+        dispatches = sum(
+            v - d0.get(k, 0) for k, v in s.items()
+            if k.startswith("dispatch_") and k.endswith("_count")
+        )
+        fused = s["mixed_steps"] > 0
+        arms[name] = {
+            "tokens_per_dispatch": round(toks / max(dispatches, 1), 3),
+            "emitted_tokens": toks,
+            "dispatches": dispatches,
+            "mixed_steps": s["mixed_steps"],
+            "split_steps": s["split_steps"],
+            "mixed_coverage_frac": s["mixed_coverage_frac"],
+            "mixed_rows": {
+                k: s[f"mixed_rows_{k}"]
+                for k in ("plain", "guided", "spec", "lora")
+            },
+            "padding_frac": s["mixed_padding_frac"] if fused
+            else s["split_padding_frac"],
+            "step_ms_p50": round(_pct(step_times["mixed" if fused
+                                                 else "split"], 0.50), 2),
+        }
+        print(f"# {name}: {json.dumps(arms[name])}", file=sys.stderr)
+    result = {
+        "metric": f"engine_blend_{model}_bs{B}_{args.blend.replace(':', '-')}",
+        "value": arms["unified"]["tokens_per_dispatch"],
+        "unit": "tok/dispatch",
+        "split_tokens_per_dispatch": arms["split"]["tokens_per_dispatch"],
+        "mixed_coverage_frac": arms["unified"]["mixed_coverage_frac"],
+        "mixed_rows": arms["unified"]["mixed_rows"],
+        "mixed_padding_frac": arms["unified"]["padding_frac"],
+        "mixed_step_ms_p50": arms["unified"]["step_ms_p50"],
+        "split_step_ms_p50": arms["split"]["step_ms_p50"],
+    }
+    print(json.dumps(result))
+    return 0
+
+
 def main(argv: Optional[List[str]] = None):
     ap = argparse.ArgumentParser(description="dynamo-tpu engine benchmark")
     ap.add_argument("--smoke", action="store_true")
@@ -347,6 +501,11 @@ def main(argv: Optional[List[str]] = None):
                     "mixed prefill+decode schedule on both paths and report "
                     "dispatches/step, padding-waste ratio, and step-time "
                     "p50/p99 (docs/ragged_attention.md)")
+    ap.add_argument("--blend", default=None, metavar="KINDS",
+                    help="with --mixed: colon-separated workload kinds to "
+                    "blend into the replay (e.g. guided:lora:spec) — "
+                    "reports tokens/dispatch, per-kind fused rows, and "
+                    "mixed_coverage_frac vs the split reference")
     args = ap.parse_args(argv)
 
     if args.smoke:
@@ -376,6 +535,8 @@ def main(argv: Optional[List[str]] = None):
         file=sys.stderr,
     )
     if args.mixed:
+        if args.blend:
+            return run_blend_bench(args, model, vocab, B, isl, osl)
         return run_mixed_bench(args, model, vocab, B, isl, osl)
     engine = _make_engine(
         model, B, isl, osl, args.block,

@@ -672,6 +672,185 @@ async def run_compile_smoke(args) -> dict:
     }
 
 
+def _mk_tiny_engine(mixed: bool, n_adapters: int = 0, slots: int = 8):
+    """In-process tiny JaxEngine (the compile-smoke pattern) with an
+    optional adapter roster for the lora-sweep / blend smokes."""
+    import jax
+    import jax.numpy as jnp
+
+    from dynamo_tpu.engine import EngineConfig, JaxEngine
+    from dynamo_tpu.models import llama, lora
+
+    model_cfg = llama.LlamaConfig.tiny(dtype=jnp.float32)
+    params = llama.init_params(model_cfg, jax.random.PRNGKey(0))
+    cfg = EngineConfig(
+        model="tiny", max_num_seqs=4, page_size=8, num_pages=128,
+        max_model_len=256, prefill_buckets=(16, 32), max_prefill_chunk=32,
+        mixed_dispatch=mixed, lora_pool_slots=slots,
+    )
+    eng = JaxEngine(cfg, model_config=model_cfg, params=params)
+    if n_adapters:
+        eng.register_adapters([
+            lora.init_adapter(model_cfg, f"ad{i}", jax.random.PRNGKey(100 + i),
+                              rank=4)
+            for i in range(1, n_adapters + 1)
+        ])
+    return eng
+
+
+async def _tiny_one(eng, prompt, rid, osl, lora_name=None, guided=None,
+                    started: asyncio.Event | None = None):
+    from dynamo_tpu.llm.protocols import PreprocessedRequest
+    from dynamo_tpu.runtime.engine import Context
+
+    req = PreprocessedRequest(
+        token_ids=list(prompt),
+        stop_conditions={"max_tokens": osl,
+                         **({} if guided else {"ignore_eos": True})},
+        sampling_options={"temperature": 0.0},
+        eos_token_ids=[2] if guided else [],
+        lora_name=lora_name,
+        guided=guided,
+        request_id=rid,
+    ).to_dict()
+    toks = []
+    async for item in eng.generate(req, Context()):
+        data = item.get("data")
+        if data:
+            toks.extend(data.get("token_ids", ()))
+            if started is not None:
+                started.set()
+    return toks
+
+
+async def run_lora_sweep(args) -> dict:
+    """N-adapter sweep over a smaller device pool (docs/multi_lora.md).
+    Hot switches (adapter resident) are refcount bookkeeping — priced at
+    ~0 — while cold switches pay ONE bounded host->device onboard (LRU
+    evicting an unpinned resident). Serves a round-robin trace over every
+    adapter, then microbenches acquire/release on the pool directly."""
+    n, slots = args.lora_adapters, args.lora_slots
+    eng = _mk_tiny_engine(mixed=True, n_adapters=n, slots=slots)
+    import numpy as np
+
+    rng = np.random.RandomState(7)
+    served = 0
+    # sequential round-robin: every adapter switch is a hot hit or ONE
+    # cold page-in — concurrency beyond the pool is the pinned-full
+    # refusal path, which test_mixed_fusion covers, not this sweep
+    for rnd in range(2):
+        for i in range(1, n + 1):
+            r = await _tiny_one(
+                eng, rng.randint(5, 200, size=16).tolist(),
+                f"r{rnd}-ad{i}", 6, lora_name=f"ad{i}",
+            )
+            served += 1 if len(r) == 6 else 0
+    pool = eng._lora_pool
+    # hot switch: acquire/release a RESIDENT adapter (pure bookkeeping)
+    resident = pool.known_names()[-1]
+    pool.acquire(resident)
+    pool.release(resident)
+    t0 = time.perf_counter()
+    for _ in range(200):
+        pool.acquire(resident)
+        pool.release(resident)
+    hot_ms = (time.perf_counter() - t0) / 200 * 1000.0
+    st = eng.stats()
+    await eng.close()
+    return {
+        "adapters": n, "slots": slots, "served_streams": served,
+        "expected_streams": 2 * n,
+        "hot_acquire_ms": round(hot_ms, 4),
+        "cold_onboard_ewma_ms": st.get("lora_pool_onboard_ewma_ms"),
+        "lora_pool_hits": st["lora_pool_hits"],
+        "lora_pool_misses": st["lora_pool_misses"],
+        "lora_pool_evictions": st["lora_pool_evictions"],
+        "lora_pool_refusals": st["lora_pool_refusals"],
+    }
+
+
+async def _blend_trace(eng, rounds: int = 2) -> dict:
+    """Deterministic staggered blend: plain + lora + guided streams whose
+    prefills land beside live decode lanes. Returns rid -> tokens."""
+    import numpy as np
+
+    rng = np.random.RandomState(0xB1E)
+    out = {}
+
+    async def tag(rid, coro):
+        out[rid] = await coro
+
+    for rnd in range(rounds):
+        # fresh prompts each round (seeded -> identical across arms):
+        # reuse would hand round 2 to the prefix cache instead of the
+        # packer this smoke exists to exercise
+        prompts = {
+            "plain": rng.randint(5, 200, size=24).tolist(),
+            "lora": rng.randint(5, 200, size=20).tolist(),
+            "guided": rng.randint(5, 200, size=18).tolist(),
+        }
+        # the plain stream anchors a LONG decode; the lora and guided
+        # arrivals are admitted only after the PREVIOUS stream's first
+        # token (not a wall-clock stagger — post-warmup step times vary
+        # too much for sleeps), so each prefill is guaranteed to land
+        # beside a live decode lane
+        p_started, l_started = asyncio.Event(), asyncio.Event()
+        tasks = [asyncio.create_task(tag(
+            f"p{rnd}", _tiny_one(eng, prompts["plain"], f"p{rnd}", 64,
+                                 started=p_started)))]
+        await p_started.wait()
+        tasks.append(asyncio.create_task(tag(
+            f"l{rnd}", _tiny_one(eng, prompts["lora"], f"l{rnd}", 12,
+                                 lora_name="ad1", started=l_started))))
+        await l_started.wait()
+        tasks.append(asyncio.create_task(tag(
+            f"g{rnd}", _tiny_one(
+                eng, prompts["guided"], f"g{rnd}", 12,
+                guided={"kind": "choice", "choices": ["yes", "no"]}))))
+        await asyncio.gather(*tasks)
+    return out
+
+
+async def run_blend_smoke(args) -> dict:
+    """CI gate for the fused blended dispatch (docs/ragged_attention.md):
+    warm a mixed-dispatch engine, replay a staggered plain+lora+guided
+    trace, and require (a) every stream byte-identical to the split
+    reference (the mixed_dispatch=False engine — the DYN_MIXED_DISPATCH=0
+    arm), (b) mixed_coverage_frac >= the gate over the replay's
+    mixed-opportunity steps, (c) zero post-warmup compiles."""
+    eng = _mk_tiny_engine(mixed=True, n_adapters=2)
+    await eng.warmup()
+    warm = eng.stats()
+    fused = await _blend_trace(eng)
+    st = eng.stats()
+    await eng.close()
+
+    split_eng = _mk_tiny_engine(mixed=False, n_adapters=2)
+    split = await _blend_trace(split_eng)
+    await split_eng.close()
+
+    mixed_d = st["mixed_steps"] - warm["mixed_steps"]
+    split_d = st["split_steps"] - warm["split_steps"]
+    coverage = mixed_d / max(mixed_d + split_d, 1)
+    mismatched = sorted(
+        rid for rid in fused
+        if fused[rid] != split.get(rid)
+    )
+    return {
+        "streams": len(fused),
+        "byte_identical": not mismatched,
+        "mismatched_streams": mismatched,
+        "replay_mixed_steps": mixed_d,
+        "replay_split_steps": split_d,
+        "replay_coverage_frac": round(coverage, 4),
+        "mixed_rows": {
+            k: st[f"mixed_rows_{k}"] - warm[f"mixed_rows_{k}"]
+            for k in ("plain", "guided", "spec", "lora")
+        },
+        "post_warmup_compiles": st["post_warmup_compiles"],
+    }
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--streams", type=int, default=8,
@@ -753,6 +932,27 @@ def main():
     # compile smoke (dynocomp runtime closure, docs/compilation.md):
     # replay a trace against a warmed in-process engine; gate on the
     # per-surface compile counters showing zero post-warmup recompiles
+    ap.add_argument("--blend-smoke", action="store_true",
+                    help="CI gate: replay a staggered plain+lora+guided "
+                    "trace on a warmed mixed-dispatch engine; exit 1 "
+                    "unless every stream is byte-identical to the "
+                    "mixed_dispatch=False reference, replay coverage >= "
+                    "--blend-min-coverage, and zero post-warmup compiles")
+    ap.add_argument("--blend-min-coverage", type=float, default=0.9,
+                    help="minimum fused fraction of the replay's "
+                    "mixed-opportunity steps")
+    ap.add_argument("--lora-sweep", action="store_true",
+                    help="N-adapter sweep over a smaller device pool: "
+                    "hot switches ~0 (refcount only), cold switches one "
+                    "bounded onboard; exit 1 on refusals, lost streams, "
+                    "or a hot switch above --lora-hot-ms")
+    ap.add_argument("--lora-adapters", type=int, default=8,
+                    help="roster size for --lora-sweep")
+    ap.add_argument("--lora-slots", type=int, default=3,
+                    help="device pool slots for --lora-sweep (< adapters "
+                    "so the sweep actually pages)")
+    ap.add_argument("--lora-hot-ms", type=float, default=2.0,
+                    help="hot acquire/release ceiling (ms)")
     ap.add_argument("--compile-smoke", action="store_true",
                     help="CI gate: warm an in-process JaxEngine, replay "
                     "a trace across every prefill bucket (lone arrivals, "
@@ -760,6 +960,59 @@ def main():
                     "stats()['post_warmup_compiles'] != 0 or warmup "
                     "compiled nothing")
     args = ap.parse_args()
+
+    if args.blend_smoke:
+        out = asyncio.run(run_blend_smoke(args))
+        print(json.dumps(out, indent=2))
+        ok = True
+        if not out["byte_identical"]:
+            print(f"BLEND SMOKE FAIL: fused streams diverged from the "
+                  f"split reference: {out['mismatched_streams']} "
+                  "(docs/ragged_attention.md parity contract)",
+                  file=sys.stderr)
+            ok = False
+        if out["replay_coverage_frac"] < args.blend_min_coverage:
+            print(f"BLEND SMOKE FAIL: replay coverage "
+                  f"{out['replay_coverage_frac']} < "
+                  f"{args.blend_min_coverage} (mixed-opportunity steps "
+                  "falling back to the split path)", file=sys.stderr)
+            ok = False
+        if out["post_warmup_compiles"] != 0:
+            print(f"BLEND SMOKE FAIL: {out['post_warmup_compiles']} XLA "
+                  "program(s) compiled after warmup on the blended "
+                  "replay (warmup missed a fused variant)",
+                  file=sys.stderr)
+            ok = False
+        if not (out["mixed_rows"]["guided"] and out["mixed_rows"]["lora"]):
+            print("BLEND SMOKE FAIL: replay fused no guided/lora rows "
+                  "(trace no longer exercises the blend)", file=sys.stderr)
+            ok = False
+        sys.exit(0 if ok else 1)
+
+    if args.lora_sweep:
+        out = asyncio.run(run_lora_sweep(args))
+        print(json.dumps(out, indent=2))
+        ok = True
+        if out["served_streams"] != out["expected_streams"]:
+            print(f"LORA SWEEP FAIL: {out['served_streams']}/"
+                  f"{out['expected_streams']} streams served",
+                  file=sys.stderr)
+            ok = False
+        if out["lora_pool_refusals"]:
+            print(f"LORA SWEEP FAIL: {out['lora_pool_refusals']} pool "
+                  "refusals on an unpinned sweep", file=sys.stderr)
+            ok = False
+        if out["hot_acquire_ms"] > args.lora_hot_ms:
+            print(f"LORA SWEEP FAIL: hot acquire {out['hot_acquire_ms']}"
+                  f"ms > {args.lora_hot_ms}ms (hot switch must be "
+                  "bookkeeping only)", file=sys.stderr)
+            ok = False
+        if out["lora_pool_evictions"] < 1:
+            print("LORA SWEEP FAIL: sweep never paged (roster fits the "
+                  "pool — raise --lora-adapters or shrink --lora-slots)",
+                  file=sys.stderr)
+            ok = False
+        sys.exit(0 if ok else 1)
 
     if args.compile_smoke:
         out = asyncio.run(run_compile_smoke(args))

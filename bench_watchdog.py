@@ -123,6 +123,19 @@ PHASES = [
     ("engine_morph", [PY, "-m", "pytest", "tests/test_planner_soak.py",
                       "-q", "-k", "morph_soak", "-p", "no:cacheprovider",
                       "-p", "no:xdist", "-p", "no:randomly"], 1800),
+    # PR 19 remeasure: blended guided+LoRA+spec traffic fused onto the
+    # unified ragged dispatch on real hardware — the tokens/dispatch
+    # fused-vs-split gap where the variant operands (packed FSM mask +
+    # per-row adapter gather) run inside the compiled Mosaic kernel
+    # instead of interpret mode (CPU numbers in BENCH_NOTES: 6.8 vs 2.8)
+    ("engine_blend", [PY, "bench_engine.py", "--mixed", "--blend",
+                      "guided:lora:spec", "--quantize", "int8"], 2400),
+    # PR 19 remeasure: adapter paging at fleet scale on real hardware —
+    # the hot-switch acquire (device stack already resident, should stay
+    # ~0) vs cold-onboard EWMA where the LoRA page actually crosses
+    # host->HBM instead of a loopback memcpy, at adapters >> pool slots
+    ("engine_lora", [PY, "bench_serving_overhead.py", "--lora-sweep",
+                     "--lora-adapters", "8", "--lora-slots", "3"], 1800),
 ]
 
 

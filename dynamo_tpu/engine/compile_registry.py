@@ -92,11 +92,35 @@ COMPILE_SURFACES = {
         "axes": {
             "N": "plan_mixed / min(next_pow2(tokens), aligned "
                  "config.mixed_max_tokens)",
-            "R": "next_pow2(config.max_num_seqs + config.max_prefill_batch)",
+            "R": "next_pow2(config.max_num_seqs * (1 + spec_draft_len if "
+                 "spec_mode else 1) + config.max_prefill_batch) — spec "
+                 "verify rows share the lane row budget",
             "P": "min(next_pow2(pages), config.max_pages_per_seq) + 1",
         },
         "warmup": True,
-        "help": "ragged prefill+decode fusion over the token dimension",
+        "help": "ragged prefill+decode fusion over the token dimension "
+                "(plain and pure-spec packs; spec lanes pack 1+d verify "
+                "rows)",
+    },
+    "mixed_step_variant": {
+        "module": "dynamo_tpu/engine/engine.py",
+        "kind": "jit",
+        "donate": (1, 2, 12),
+        "static": (),
+        "axes": {
+            "N": "plan_mixed / min(next_pow2(tokens), aligned "
+                 "config.mixed_max_tokens)",
+            "R": "next_pow2(config.max_num_seqs * (1 + spec_draft_len if "
+                 "spec_mode else 1) + config.max_prefill_batch)",
+            "P": "min(next_pow2(pages), config.max_pages_per_seq) + 1",
+            "V8": "(vocab_size + 7) // 8 (packed per-row grammar mask; "
+                  "all-ones rows are exact no-ops)",
+            "rank": "pool r_max (fixed device adapter stack; operand "
+                    "present only when adapters are registered)",
+        },
+        "warmup": True,
+        "help": "fused mixed step with per-row FSM mask and adapter-index "
+                "operands — guided/lora rows ride the same flat buffer",
     },
     "prefill_batch_mm": {
         "module": "dynamo_tpu/engine/engine.py",

@@ -105,10 +105,17 @@ class EngineConfig:
     # docs/ragged_attention.md): when the planner has BOTH runnable prefill
     # chunks and active decode lanes, pack them into ONE flat ragged token
     # buffer and ONE device call per layer stack (ragged_forward) instead
-    # of a prefill dispatch followed by a decode dispatch. Plain traffic
-    # only — guided/lora/mm/spec and pp/sp layouts ride their split
-    # variants. None = resolve from DYN_MIXED_DISPATCH (default on).
+    # of a prefill dispatch followed by a decode dispatch. Guided rows
+    # (packed FSM-mask operand), multi-LoRA rows (adapter-index operand)
+    # and speculative verify rows (1+d one-token rows per lane) fuse too;
+    # only mm and pp/sp layouts ride their split variants. None = resolve
+    # from DYN_MIXED_DISPATCH (default on).
     mixed_dispatch: Optional[bool] = None
+    # LoRA adapter tier (models/lora_pool.py, docs/multi_lora.md): device
+    # slots in the fixed-size HBM adapter stack; adapters beyond this
+    # page in from the host roster on acquire (LRU eviction of unpinned
+    # residents). None = resolve from DYN_LORA_POOL_SLOTS (default 8).
+    lora_pool_slots: Optional[int] = None
     # flat-token budget of one mixed dispatch: decode rows + granted
     # prefill chunks, pow2-bucketed up to this cap. Bounds the mixed
     # compile-variant space exactly like prefill_buckets bounds prefill's

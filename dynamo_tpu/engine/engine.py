@@ -246,6 +246,8 @@ class _Slot:
     guided_fsm: Optional[Any] = None  # llm/guided.TokenFsm (structured output)
     guided_state: int = 0  # current FSM state; advanced per emitted token
     lora_idx: int = 0  # adapter slot in the engine's LoRA stack (0 = base)
+    lora_name: str = ""  # adapter pinned in the LoraPool ("" = no pin);
+    # the pin releases exactly once (finish/release clears the name)
     want_logprobs: bool = False  # attach sampled-token logprobs to emissions
     sample_seed: int = 0  # per-request sampling seed (SamplingParams.seed)
     presence_penalty: float = 0.0
@@ -559,8 +561,10 @@ class JaxEngine:
         # ragged unified mixed dispatch (docs/ragged_attention.md): when
         # the planner has BOTH runnable prefill chunks and active decode
         # lanes, ONE flat ragged buffer + ONE device call replaces the
-        # split prefill-batch + decode-block pair. Plain traffic only;
-        # spec/pp/sp configs keep the split path outright.
+        # split prefill-batch + decode-block pair. Guided, multi-LoRA and
+        # speculative rows fuse too (mask / adapter-index operands on the
+        # variant program, spec lanes as 1+d one-token verify rows);
+        # pp/sp configs keep the split path outright.
         from ..ops.paged_attention import _pallas_eligible
         from ..ops.pallas_ragged_attention import ragged_tile_q
         from ..runtime.config import env_bool
@@ -569,7 +573,7 @@ class JaxEngine:
             config.mixed_dispatch
             if config.mixed_dispatch is not None
             else env_bool("DYN_MIXED_DISPATCH", True)
-        ) and not config.spec_mode and config.pp_size == 1 and config.sp_size == 1
+        ) and config.pp_size == 1 and config.sp_size == 1
         # durable decode sessions (docs/fault_tolerance.md "Request
         # migration"): commit newly-FULL generated blocks during the step
         # loop rather than only at _release_slot, so a live session's
@@ -612,9 +616,11 @@ class JaxEngine:
         )
         # ONE fixed row bucket: the row axis only sizes scalar operands
         # (tables, sampling state), so a single padded variant is free —
-        # compile variants stay (token bucket x table bucket)
+        # compile variants stay (token bucket x table bucket). Under spec
+        # every decode lane may pack 1 + spec_draft_len verify rows.
+        rows_per_lane = 1 + (config.spec_draft_len if config.spec_mode else 0)
         self._mixed_row_bucket = _next_pow2(
-            config.max_num_seqs + config.max_prefill_batch
+            config.max_num_seqs * rows_per_lane + config.max_prefill_batch
         )
         # fused-vs-split visibility (stats() + jax_worker gauges): is the
         # fused path actually taken in production, and what padding does
@@ -625,6 +631,14 @@ class JaxEngine:
         self.mixed_real_tokens = 0
         self.split_padded_tokens = 0
         self.split_real_tokens = 0
+        # per-kind fused coverage (docs/observability.md): which row
+        # classes actually ride the fused buffer, and what fraction of
+        # fused-ELIGIBLE steps (mixed-shaped traffic) fused — the CI
+        # blended smoke gates mixed_coverage_frac >= 0.9
+        self.mixed_rows_plain = 0
+        self.mixed_rows_guided = 0
+        self.mixed_rows_spec = 0
+        self.mixed_rows_lora = 0
         self._last_prefill_shape = None  # (padded, real) of the latest dispatch
         self._last_decode_shape = None
         # set by _dispatch_mixed when only the in-flight decode pipeline
@@ -649,8 +663,12 @@ class JaxEngine:
         self._guided = None
         self.guided_requests = 0
         # multi-LoRA (models/lora.py): stacked adapters in HBM + per-lane
-        # adapter index mirror (rides lora dispatch variants as an operand)
+        # adapter index mirror (rides lora dispatch variants as an operand).
+        # At fleet scale the stack is a FIXED-slot paging tier
+        # (models/lora_pool.LoraPool) — adapter weights page HBM<->host on
+        # demand, so "names" maps only the RESIDENT roster
         self._lora = None  # {"a": {...}, "b": {...}, "scale", "names"}
+        self._lora_pool = None  # models/lora_pool.LoraPool when registered
         self.lora_idx = np.zeros((config.max_num_seqs,), np.int32)
         self.lora_requests = 0
         # per-dispatch-type device occupancy: {tag: (count, seconds)} —
@@ -955,6 +973,39 @@ class JaxEngine:
 
         self._mixed_step = mixed_step
 
+        @partial(jax.jit, donate_argnums=(1, 2, 12), out_shardings=prefill_out_sh)
+        def mixed_step_variant(params, kv_k, kv_v, tokens, positions, row_ids,
+                               page_tables, row_starts, row_lens, ctx_lens,
+                               last_flat, samp, rng, pen_rows, mask_packed,
+                               lora):
+            """Mixed step for VARIANT row classes (guided / multi-LoRA /
+            speculative): same ragged forward + per-row sampling as
+            mixed_step plus a bitpacked per-row FSM admissibility mask
+            (all-ones rows are an exact no-op — the invariant the split
+            guided variants already rely on) and, when adapters are
+            registered, the LoRA stack with per-row adapter indices
+            (index 0 = the all-zero base adapter, an exact no-op).
+            Speculative verify rows need no extra operand: they are
+            ordinary one-token rows whose ctx includes their sibling
+            draft rows' KV (written before attention each layer).
+            A separate lazy jit so plain blended-free traffic never
+            carries the mask/adapter operands."""
+            rng, sub = jax.random.split(rng)
+            logits, kv_k, kv_v = self._model.ragged_forward(
+                params, c, tokens, positions, row_ids, kv_k, kv_v,
+                page_tables, row_starts, row_lens, ctx_lens, last_flat,
+                lora=lora,
+            )
+            plogits = penalized(logits, samp, pen_rows)
+            mask = unpack_mask(mask_packed, c.vocab_size)
+            first = sample_lp(
+                plogits, samp, sub, mask=mask,
+                positions=ctx_lens + row_lens - 1, raw=logits,
+            )
+            return first, kv_k, kv_v, rng
+
+        self._mixed_step_variant = mixed_step_variant
+
         @partial(jax.jit, donate_argnums=(1, 2, 9), out_shardings=prefill_out_sh)
         def prefill_batch_mm(params, kv_k, kv_v, tokens, positions, page_tables,
                              ctx_lens, last_idx, samp, rng, pen, emb, emb_mask):
@@ -1216,6 +1267,7 @@ class JaxEngine:
             "spec_block": self._spec_block_fn,
             "prefill_batch": self._prefill_batch,
             "mixed_step": self._mixed_step,
+            "mixed_step_variant": self._mixed_step_variant,
             "prefill_batch_mm": self._prefill_batch_mm,
             "decode_step_guided": self._decode_step_guided,
             "decode_step_guided_lora": self._decode_step_guided_lora,
@@ -1347,20 +1399,27 @@ class JaxEngine:
             n += 1
         if (
             self.config.pp_size == 1 and self.config.sp_size == 1
-            and not self.config.spec_mode
+            and (not self.config.spec_mode or self._mixed_enabled)
         ):
             # compile the guided prefill/decode variants too (a first
-            # guided request on-path would otherwise pay the compile)
-            isl = max(buckets[0] - 8, 4)
-            req = PreprocessedRequest(
-                token_ids=rng.randint(5, max(vocab - 1, 6), size=isl).tolist(),
-                stop_conditions={"max_tokens": 3},
-                sampling_options={"temperature": 1.0},
-                guided={"kind": "regex", "regex": "[ab]*"},
-            ).to_dict()
-            async for _ in self.generate(req, Context()):
-                pass
-            n += 1
+            # guided request on-path would otherwise pay the compile) —
+            # at both bucket ends, matching the plain coverage. Under
+            # spec_mode guided is admittable only via the fused path, so
+            # the gate relaxes exactly with _mixed_enabled.
+            for isl in sorted({
+                max(buckets[0] - 8, 4), max(buckets[-1] - 8, 4)
+            }):
+                req = PreprocessedRequest(
+                    token_ids=rng.randint(
+                        5, max(vocab - 1, 6), size=isl
+                    ).tolist(),
+                    stop_conditions={"max_tokens": 3},
+                    sampling_options={"temperature": 1.0},
+                    guided={"kind": "regex", "regex": "[ab]*"},
+                ).to_dict()
+                async for _ in self.generate(req, Context()):
+                    pass
+                n += 1
         if self._mixed_enabled:
             # compile the unified mixed-step variant: a staggered pair puts
             # one request in decode while the other's prefill chunk is
@@ -1374,17 +1433,117 @@ class JaxEngine:
             n += 2
         if self._lora is not None and self._lora["names"]:
             # compile the LoRA prefill/decode variants with a registered
-            # adapter (same on-path-compile hazard as the guided variants)
-            isl = max(buckets[0] - 8, 4)
-            req = PreprocessedRequest(
-                token_ids=rng.randint(5, max(vocab - 1, 6), size=isl).tolist(),
-                stop_conditions={"max_tokens": K + 2, "ignore_eos": True},
-                sampling_options={"temperature": 1.0},
-                lora_name=next(iter(self._lora["names"])),
-            ).to_dict()
-            async for _ in self.generate(req, Context()):
-                pass
-            n += 1
+            # adapter (same on-path-compile hazard as the guided
+            # variants), again at both bucket ends
+            for isl in sorted({
+                max(buckets[0] - 8, 4), max(buckets[-1] - 8, 4)
+            }):
+                req = PreprocessedRequest(
+                    token_ids=rng.randint(
+                        5, max(vocab - 1, 6), size=isl
+                    ).tolist(),
+                    stop_conditions={"max_tokens": K + 2, "ignore_eos": True},
+                    sampling_options={"temperature": 1.0},
+                    lora_name=next(iter(self._lora["names"])),
+                ).to_dict()
+                async for _ in self.generate(req, Context()):
+                    pass
+                n += 1
+        if self._mixed_enabled and (
+            self.config.pp_size == 1 and self.config.sp_size == 1
+        ):
+            # fused-dispatch variants (lean + mask/adapter operand
+            # program): a fused step's page-table axis rides the DECODE
+            # rows' context, so blended traffic arriving mid-decode of a
+            # long generation lands on table rungs the short staggered
+            # pair never reaches. Anchor one long-prompt decode per pow2
+            # table rung and admit plain (lean), guided and lora
+            # (variant) arrivals beside it — at both chunk-bucket ends —
+            # so every (token bucket, table rung) pair steady blended
+            # traffic hits is compiled pre-serving
+            # (post_warmup_compiles == 0 must hold on blended traffic).
+            page = self.config.page_size
+            anchor_osl = 8 * K
+            anchor_isls = []
+            pages = 2
+            while pages * page + anchor_osl + 8 <= self.config.max_model_len:
+                anchor_isls.append(max(pages * page - 4, 4))
+                pages *= 2
+
+            async def _drain_long(isl: int, started: asyncio.Event):
+                req = PreprocessedRequest(
+                    token_ids=rng.randint(
+                        5, max(vocab - 1, 6), size=isl
+                    ).tolist(),
+                    stop_conditions={"max_tokens": anchor_osl,
+                                     "ignore_eos": True},
+                    sampling_options={"temperature": 1.0},
+                ).to_dict()
+                async for _ in self.generate(req, Context()):
+                    started.set()
+
+            async def _drain_req(r):
+                async for _ in self.generate(dict(r), Context()):
+                    pass
+
+            def _mk_variant_reqs(isl: int) -> list:
+                reqs = [PreprocessedRequest(
+                    token_ids=rng.randint(
+                        5, max(vocab - 1, 6), size=isl
+                    ).tolist(),
+                    stop_conditions={"max_tokens": 4, "ignore_eos": True},
+                    sampling_options={"temperature": 1.0},
+                ).to_dict(), PreprocessedRequest(
+                    token_ids=rng.randint(
+                        5, max(vocab - 1, 6), size=isl
+                    ).tolist(),
+                    stop_conditions={"max_tokens": 4},
+                    sampling_options={"temperature": 1.0},
+                    guided={"kind": "regex", "regex": "[ab]*"},
+                ).to_dict()]
+                if self._lora is not None and self._lora["names"]:
+                    reqs.append(PreprocessedRequest(
+                        token_ids=rng.randint(
+                            5, max(vocab - 1, 6), size=isl
+                        ).tolist(),
+                        stop_conditions={"max_tokens": 4,
+                                         "ignore_eos": True},
+                        sampling_options={"temperature": 1.0},
+                        lora_name=next(iter(self._lora["names"])),
+                    ).to_dict())
+                return reqs
+
+            chunk_isls = sorted({
+                max(buckets[0] - 8, 4), max(buckets[-1] - 8, 4)
+            })
+            for a_isl in anchor_isls:
+                # sequential arrivals: each fuses ALONE beside the anchor,
+                # pinning the token bucket to its own chunk. The anchor is
+                # (re)started on demand and each admission gates on the
+                # anchor having just emitted (not wall time — post-compile
+                # step cadence is far faster than any fixed sleep)
+                anchor = None
+                for isl in chunk_isls:
+                    for vreq in _mk_variant_reqs(isl):
+                        if anchor is None or anchor.done():
+                            started = asyncio.Event()
+                            anchor = asyncio.create_task(
+                                _drain_long(a_isl, started)
+                            )
+                            await started.wait()
+                            n += 1
+                        await _drain_req(vreq)
+                        n += 1
+                await anchor
+            if self._lora is not None and self._lora["names"]:
+                # guided + lora lanes decoding in the SAME split decode
+                # block: the combined-kind decode program no single-kind
+                # warmup request reaches
+                _, g_req, l_req = _mk_variant_reqs(chunk_isls[0])
+                g_req["stop_conditions"]["max_tokens"] = K + 2
+                l_req["stop_conditions"]["max_tokens"] = K + 2
+                await asyncio.gather(_drain_req(g_req), _drain_req(l_req))
+                n += 2
         # steady-state contract line: every XLA program compiled from
         # here on counts as a post-warmup recompile
         # (stats()['post_warmup_compiles']); the replay compile smoke
@@ -1608,29 +1767,46 @@ class JaxEngine:
         return self._guided
 
     def register_adapters(self, adapters) -> None:
-        """Install LoRA adapters (models/lora.LoraAdapter list). The whole
-        stack is (re)built and uploaded; in-flight LoRA requests keep their
-        indices, so call this before serving or append-only."""
-        from ..models import lora as lora_mod
+        """Install LoRA adapters (models/lora.LoraAdapter list) behind the
+        fixed-slot paging tier (models/lora_pool.LoraPool): the engine's
+        stack reference stays live across onboard/evict, so registration
+        is append-only and fleet rosters larger than the device slot count
+        page on demand. In-flight LoRA requests keep their indices (their
+        slots are pinned)."""
         from ..models import moe
+        from ..models.lora_pool import LoraPool
+        from ..runtime.config import env_int
 
         if isinstance(self.model_config, moe.MoeConfig):
             raise ValueError("LoRA serving is not supported on MoE models yet")
-        self._lora = lora_mod.stack_adapters(self.model_config, list(adapters))
+        if self._lora_pool is None:
+            slots = self.config.lora_pool_slots
+            if slots is None:
+                slots = env_int("DYN_LORA_POOL_SLOTS", 8)
+            self._lora_pool = LoraPool(
+                self.model_config, list(adapters), slots=slots,
+            )
+        else:
+            self._lora_pool.register(list(adapters))
+        self._lora = self._lora_pool.stack
 
     def lora_names(self) -> List[str]:
+        if self._lora_pool is not None:
+            return self._lora_pool.known_names()
         return list(self._lora["names"]) if self._lora else []
 
     def _check_lora(self, req: PreprocessedRequest) -> Optional[str]:
         if not req.lora_name:
             return None
         cfg = self.config
-        if self._lora is None or req.lora_name not in self._lora["names"]:
+        if self._lora is None or req.lora_name not in self.lora_names():
             return (
                 f"unknown LoRA adapter {req.lora_name!r}; available: "
                 f"{sorted(self.lora_names())}"
             )
-        if cfg.spec_mode:
+        if cfg.spec_mode and not self._mixed_enabled:
+            # fused spec verify rows carry the adapter index per row; the
+            # split spec block has no adapter operand
             return "LoRA is incompatible with speculative decoding (spec_mode)"
         if cfg.pp_size > 1 or cfg.sp_size > 1:
             return "LoRA is not supported on pp/sp layouts yet"
@@ -1641,6 +1817,31 @@ class JaxEngine:
         if req.multimodal:
             return "LoRA with multimodal content parts is not supported yet"
         return None
+
+    def _acquire_lora(self, req: PreprocessedRequest) -> Optional[str]:
+        """Resolve + PIN the request's adapter in the paging tier
+        (models/lora_pool.py). Hot adapters are a dict lookup; cold ones
+        onboard here (bounded, EWMA-priced). A full-and-pinned pool or an
+        injected `lora.onboard` fault refuses TYPED — a counted refusal
+        the caller can retry/route, never a silent base-model answer.
+        Must run LAST in the admission check chain: a later rejection
+        would leak the pin."""
+        if not req.lora_name or self._lora_pool is None:
+            return None
+        from ..models.lora_pool import LoraPoolError
+
+        try:
+            req._lora_slot = self._lora_pool.acquire(req.lora_name)
+        except LoraPoolError as e:
+            return str(e)
+        return None
+
+    def _release_lora_pin(self, slot: "_Slot") -> None:
+        """Idempotent per-stream unpin (clears the name, so double release
+        on the finish->release path is a no-op)."""
+        if slot.lora_name and self._lora_pool is not None:
+            self._lora_pool.release(slot.lora_name)
+            slot.lora_name = ""
 
     def _check_logprobs(self, req: PreprocessedRequest) -> Optional[str]:
         s = req.sampling_options or {}
@@ -1672,7 +1873,10 @@ class JaxEngine:
         if not req.guided:
             return None
         cfg = self.config
-        if cfg.spec_mode:
+        if cfg.spec_mode and not self._mixed_enabled:
+            # fused guided rows are single-token and host-authoritative per
+            # step, so they coexist with spec lanes on the mixed dispatch;
+            # the split-only layout still rejects
             return (
                 "guided decoding is incompatible with speculative decoding "
                 "(run the worker without --spec)"
@@ -1771,7 +1975,16 @@ class JaxEngine:
             slot.guided_state = slot.guided_fsm.start_state
             self.guided_requests += 1
         if req.lora_name and self._lora is not None:
-            slot.lora_idx = self._lora["names"].get(req.lora_name, 0)
+            pinned = getattr(req, "_lora_slot", None)
+            slot.lora_idx = (
+                pinned if pinned is not None
+                else self._lora["names"].get(req.lora_name, 0)
+            )
+            if pinned is not None:
+                # the _acquire_lora pin transfers to the slot (released
+                # exactly once, at stream finish)
+                slot.lora_name = req.lora_name
+                req._lora_slot = None
             if slot.lora_idx:
                 self.lora_requests += 1
         if len(slot.prompt) + slot.max_tokens > self.config.max_model_len:
@@ -1813,7 +2026,10 @@ class JaxEngine:
         if g_err is not None:
             yield Annotated.from_error(g_err).to_dict()
             return
-        l_err = self._check_lora(req) or self._check_logprobs(req)
+        l_err = (
+            self._check_lora(req) or self._check_logprobs(req)
+            or self._acquire_lora(req)
+        )
         if l_err is not None:
             yield Annotated.from_error(l_err).to_dict()
             return
@@ -1854,7 +2070,10 @@ class JaxEngine:
             if isinstance(request, PreprocessedRequest)
             else PreprocessedRequest.from_dict(request)
         )
-        g_err = (await self._compile_guided_async(req) or self._check_lora(req) or self._check_logprobs(req))
+        g_err = (
+            await self._compile_guided_async(req) or self._check_lora(req)
+            or self._check_logprobs(req) or self._acquire_lora(req)
+        )
         if g_err is not None:
             return None, g_err
         slot = self._new_slot(req, context, suffix="-d")
@@ -2088,6 +2307,18 @@ class JaxEngine:
         out["split_padding_frac"] = round(
             1.0 - self.split_real_tokens / self.split_padded_tokens, 4
         ) if self.split_padded_tokens else 0.0
+        # per-kind fused coverage: which workloads actually ride the fused
+        # path (ISSUE 19 CI gate: coverage >= 0.9 on blended traffic)
+        out["mixed_rows_plain"] = self.mixed_rows_plain
+        out["mixed_rows_guided"] = self.mixed_rows_guided
+        out["mixed_rows_spec"] = self.mixed_rows_spec
+        out["mixed_rows_lora"] = self.mixed_rows_lora
+        denom = self.mixed_steps + self.split_steps
+        out["mixed_coverage_frac"] = (
+            round(self.mixed_steps / denom, 4) if denom else 1.0
+        )
+        if self._lora_pool is not None:
+            out.update(self._lora_pool.stats())
         # dynosched: policy/targets, per-step decision counters, and the
         # queue/deadline view (published on the worker metrics topic, so
         # disagg decode workers and the planner see prefill-pool pressure)
@@ -2458,7 +2689,7 @@ class JaxEngine:
 
     def _dev_mixed(self, toks, positions, row_ids, tables, row_starts,
                    row_lens, ctx_lens, last_flat, temps, top_ks, top_ps,
-                   seeds, pens, pen_rows):
+                   seeds, pens, pen_rows, mask_packed=None, lora_idx=None):
         samp = SamplingParams(
             temperature=jnp.asarray(temps),
             top_k=jnp.asarray(top_ks),
@@ -2468,7 +2699,7 @@ class JaxEngine:
             frequency=jnp.asarray(pens[:, 1]),
             repetition=jnp.asarray(pens[:, 2]),
         )
-        first, self.kv_k, self.kv_v, self._rng = self._mixed_step(
+        args = (
             self.params,
             self.kv_k,
             self.kv_v,
@@ -2484,6 +2715,22 @@ class JaxEngine:
             self._rng,
             jnp.asarray(pen_rows),
         )
+        if mask_packed is None and lora_idx is None:
+            # plain pack: the lean program, byte-identical operands to the
+            # pre-variant fused path
+            first, self.kv_k, self.kv_v, self._rng = self._mixed_step(*args)
+        else:
+            # variant pack: the mask operand is always present (all-ones
+            # for maskless packs — an exact no-op), the LoRA operand rides
+            # iff adapters are registered (idx 0 rows are the base no-op),
+            # so exactly ONE variant program exists per deployment
+            lora = (
+                self._lora_operand(lora_idx)
+                if self._lora is not None and lora_idx is not None else None
+            )
+            first, self.kv_k, self.kv_v, self._rng = self._mixed_step_variant(
+                *args, jnp.asarray(mask_packed), lora
+            )
         return first
 
     def _dev_prefill_mm(self, toks, positions, tables, ctx_lens, last_idx,
@@ -2939,6 +3186,7 @@ class JaxEngine:
                         p["row_starts"], p["row_lens"], p["ctx_lens"],
                         p["last_flat"], p["temps"], p["top_ks"], p["top_ps"],
                         p["seeds"], p["pens"], p["pen_rows"],
+                        p.get("mask"), p.get("lora_idx"),
                     )
                 )
             elif tag == "block":
@@ -4166,6 +4414,23 @@ class JaxEngine:
             for s in self.slots
         )
 
+    def _host_ngram_draft(self, slot, d: int) -> List[int]:
+        """Host-side n-gram draft for fused spec verify rows (mirrors the
+        device draft in spec.py, but over the authoritative host token
+        sequence — drafts only steer ACCEPTANCE rate, never correctness:
+        every emitted token is a verified sample from the target model).
+        Most-recent n-gram match wins; pads with the last token."""
+        seq = slot.seq.tokens
+        n = self.config.spec_ngram
+        if n <= 0 or len(seq) < n:
+            return [int(seq[-1])] * d
+        gram = list(seq[len(seq) - n:])
+        for start in range(len(seq) - n - 1, -1, -1):
+            if list(seq[start:start + n]) == gram:
+                follow = [int(t) for t in seq[start + n:start + n + d]]
+                return follow + [int(seq[-1])] * (d - len(follow))
+        return [int(seq[-1])] * d
+
     async def _dispatch_mixed(self) -> bool:
         """Unified mixed step (ROADMAP 2, "Ragged Paged Attention"): when
         there are BOTH runnable prefill chunks and active decode lanes,
@@ -4174,11 +4439,15 @@ class JaxEngine:
         run ONE device call per layer stack instead of a prefill dispatch
         followed by a decode dispatch. Every decode lane advances one
         token; completed prompts sample their first token; both ride the
-        same fetched [R] result. Returns False (split path runs) whenever
-        the fused step is inapplicable: mixed disabled, a variant kind
-        (guided/mm/lora) active, decode blocks in flight (their device
-        carry owns lane state — the mixed step needs host-authoritative
-        lanes), or the planner declines.
+        same fetched [R] result. Guided rows carry a packed FSM mask
+        operand, lora rows a per-row adapter index, and spec-eligible
+        lanes pack 1+d one-token verify rows — the fused path is the
+        default for blended traffic. Returns False (split path runs)
+        whenever the fused step is inapplicable: mixed disabled, a
+        multimodal candidate starved past its SLA (mm stays split-only),
+        decode blocks in flight (their device carry owns lane state — the
+        mixed step needs host-authoritative lanes), or the planner
+        declines.
 
         Shapes stay bounded: flat tokens pow2-bucketed to
         config.mixed_max_tokens, ONE fixed row bucket
@@ -4194,12 +4463,16 @@ class JaxEngine:
         active = self._active_decode_indices()
         if not active:
             return False
-        if any(
-            self.slots[i].guided_fsm is not None or self.slots[i].lora_idx
-            for i in active
-        ):
-            return False
+        # spec fusion: every spec-eligible decode lane packs 1 + d
+        # one-token verify rows (current token + d host n-gram drafts) —
+        # the verify step IS a ragged mixed batch. Guided lanes stay
+        # single-row (the next mask depends host-side on this token).
+        d = cfg.spec_draft_len if cfg.spec_mode else 0
+        n_spec_rows = sum(
+            d for i in active if self.slots[i].guided_fsm is None
+        ) if d else 0
         cands = []
+        mm_starved = False
         for s in self.slots:
             if s is None or s.prefill_pos >= len(s.kv_prompt):  # dynolint: disable=race-await-atomicity -- single writer per live slot (same shape as _dispatch_prefill); pull-path slots filtered below
                 continue
@@ -4209,16 +4482,28 @@ class JaxEngine:
                 self._emit_finish(s, "cancelled")
                 self._release_slot(s)
                 continue
-            if s.mm is not None or s.guided_fsm is not None or s.lora_idx:
-                return False  # variant kinds ride their split programs
+            if s.mm is not None:
+                # multimodal stays split-only (embedding-splice operand):
+                # exclude ONLY this slot — plain + fused kinds still fuse
+                # this step — and age it toward the starvation guard
+                s.sched_skips += 1
+                if s.sched_skips >= self.scheduler.sla.starve_dispatches:
+                    mm_starved = True
+                continue
             self._try_skip_ahead(s)
             cands.append(s)
+        if mm_starved:
+            # a starved mm candidate must win the next batch outright:
+            # yield the whole step to the split path, whose
+            # pick_batch_kind starvation override serves it
+            return False
         if not cands:
             return False
         cands = self.scheduler.order(cands)
         align = self._mixed_align
         plan = self.scheduler.plan_mixed(
-            cands, n_decode=len(active), align=align
+            cands, n_decode=len(active), align=align,
+            n_spec_rows=n_spec_rows,
         )
         if plan is None:
             return False  # nothing fuses (e.g. decode lanes fill the
@@ -4238,9 +4523,10 @@ class JaxEngine:
             for s in cands:
                 s.sched_skips += 1
             return False
-        # one decode step of page headroom; growth can preempt — re-filter
-        # both the decode set and the chosen prefill slots against it
-        active = self._grow_pages_for_block(active, steps=1)
+        # one decode step of page headroom (1 + d under spec: draft rows
+        # write KV at speculative positions); growth can preempt —
+        # re-filter both the decode set and the chosen prefill slots
+        active = self._grow_pages_for_block(active, steps=1 + d)
         if not active:
             return False
         chosen = [
@@ -4269,7 +4555,15 @@ class JaxEngine:
         # mixed_max_tokens can never produce an N_pad the Pallas kernel's
         # N % tile_q assert would reject
         cap = cfg.mixed_max_tokens - cfg.mixed_max_tokens % align
-        total = sum(aligned(ch) for _, ch in chosen) + aligned(1) * len(active)
+        # recompute the decode row count against the SURVIVING active set
+        # (page growth can preempt lanes out from under the plan)
+        spec_lanes = {
+            i for i in active
+            if cfg.spec_mode and self.slots[i].guided_fsm is None
+        }
+        n_rows_decode = len(active) + d * len(spec_lanes)
+        total = sum(aligned(ch) for _, ch in chosen) \
+            + aligned(1) * n_rows_decode
         N_pad = min(_next_pow2(max(total, align)), cap)
         R_pad = self._mixed_row_bucket
         max_pages_needed = 1
@@ -4277,7 +4571,8 @@ class JaxEngine:
             pages = (s.prefill_pos + ch + cfg.page_size - 1) // cfg.page_size
             max_pages_needed = max(max_pages_needed, pages)
         for i in active:
-            pages = (int(self.seq_lens[i]) - 1) // cfg.page_size + 1
+            extra = d if i in spec_lanes else 0
+            pages = (int(self.seq_lens[i]) - 1 + extra) // cfg.page_size + 1
             max_pages_needed = max(max_pages_needed, pages)
         ctx_pages = min(_next_pow2(max_pages_needed), cfg.max_pages_per_seq)
         P = ctx_pages + 1
@@ -4300,10 +4595,31 @@ class JaxEngine:
         pens[:, 2] = 1.0  # repetition off
         pen_rows = np.full((R_pad, W), -1, np.int32)
 
+        # variant operands: a bitpacked per-row FSM mask whenever any
+        # guided/lora row packs (all-ones rows are exact no-ops), plus
+        # per-row adapter indices when adapters are registered (index 0 =
+        # the all-zero base adapter). Pure-plain and pure-spec packs keep
+        # the LEAN program — byte-identical operands to the split path.
+        dec_slots = [self.slots[i] for i in active]
+        any_guided = any(
+            s.guided_fsm is not None for s, _ in chosen
+        ) or any(s.guided_fsm is not None for s in dec_slots)
+        any_lora = any(s.lora_idx for s, _ in chosen) or any(
+            s.lora_idx for s in dec_slots
+        )
+        mask_packed = None
+        lora_rows = None
+        if any_guided or any_lora:
+            V = self.model_config.vocab_size
+            mask_packed = np.full((R_pad, (V + 7) // 8), 0xFF, np.uint8)
+            if self._lora is not None:
+                lora_rows = np.zeros((R_pad,), np.int32)
+
         off = 0
         row = 0
         meta = []  # prefill rows: (slot, chunk, row)
         decode_rows = []  # (row, lane_idx, slot)
+        spec_rows = []  # (first_row, lane_idx, slot, draft) — 1+d rows each
         for s, chunk in chosen:
             start = s.prefill_pos
             row_starts[row] = off
@@ -4321,6 +4637,17 @@ class JaxEngine:
             pens[row] = (s.presence_penalty, s.frequency_penalty,
                          s.repetition_penalty)
             pen_rows[row] = self.recent[s.slot_idx]
+            if s.guided_fsm is not None:
+                mask_packed[row] = np.packbits(self._guided_lane_mask(
+                    s.guided_fsm, s.guided_state
+                ))
+                self.mixed_rows_guided += 1
+            elif s.lora_idx:
+                self.mixed_rows_lora += 1
+            else:
+                self.mixed_rows_plain += 1
+            if lora_rows is not None:
+                lora_rows[row] = s.lora_idx
             s.sched_skips = 0
             meta.append((s, chunk, row))
             off += aligned(chunk)
@@ -4328,46 +4655,81 @@ class JaxEngine:
         for i in active:
             s = self.slots[i]
             L = int(self.seq_lens[i])
-            row_starts[row] = off
-            row_lens[row] = 1
-            ctx_lens[row] = L - 1
-            toks[off] = int(self.tokens[i])
-            positions[off] = L - 1
-            row_ids[off : off + aligned(1)] = row
-            tables[row, :ctx_pages] = self.page_tables[i][:ctx_pages]
-            last_flat[row] = off
-            temps[row] = self.temps[i]
-            top_ks[row] = self.top_ks[i]
-            top_ps[row] = self.top_ps[i]
-            seeds[row] = self.seeds[i]
-            pens[row] = (self.presence[i], self.frequency[i],
-                         self.repetition[i])
-            # the device pen ring (decode carry) is not host-visible;
-            # rebuild this lane's window from the authoritative token
-            # sequence (ring-indexed by absolute position, so the patch
-            # after the fetch stays consistent with it)
-            self._fill_recent(i, s)
-            pen_rows[row] = self.recent[i]
-            decode_rows.append((row, i, s))
-            off += aligned(1)
-            row += 1
+            spec_lane = i in spec_lanes
+            draft = self._host_ngram_draft(s, d) if (spec_lane and d) else []
+            row_toks = [int(self.tokens[i])] + draft
+            first_row = row
+            for j, tk in enumerate(row_toks):
+                # row j carries one token at position L-1+j with ctx
+                # L-1+j: it attends the lane's committed KV plus rows
+                # 0..j-1 of THIS pack (their KV is written before
+                # attention each layer), so row j's sample is exactly the
+                # plain seeded decode draw at that position — the fused
+                # verify's parity lever
+                row_starts[row] = off
+                row_lens[row] = 1
+                ctx_lens[row] = L - 1 + j
+                toks[off] = tk
+                positions[off] = L - 1 + j
+                row_ids[off : off + aligned(1)] = row
+                tables[row, :ctx_pages] = self.page_tables[i][:ctx_pages]
+                last_flat[row] = off
+                temps[row] = self.temps[i]
+                top_ks[row] = self.top_ks[i]
+                top_ps[row] = self.top_ps[i]
+                seeds[row] = self.seeds[i]
+                if lora_rows is not None:
+                    lora_rows[row] = s.lora_idx
+                if not spec_lane:
+                    pens[row] = (self.presence[i], self.frequency[i],
+                                 self.repetition[i])
+                    # the device pen ring (decode carry) is not
+                    # host-visible; rebuild this lane's window from the
+                    # authoritative token sequence (ring-indexed by
+                    # absolute position, so the patch after the fetch
+                    # stays consistent with it)
+                    self._fill_recent(i, s)
+                    pen_rows[row] = self.recent[i]
+                    if s.guided_fsm is not None:
+                        mask_packed[row] = np.packbits(
+                            self._guided_lane_mask(
+                                s.guided_fsm, s.guided_state
+                            )
+                        )
+                # spec rows keep default pens: penalties/logprobs are
+                # rejected under spec_mode at admission
+                off += aligned(1)
+                row += 1
+            if spec_lane:
+                spec_rows.append((first_row, i, s, draft))
+                self.mixed_rows_spec += len(row_toks)
+            else:
+                decode_rows.append((first_row, i, s))
+                if s.guided_fsm is not None:
+                    self.mixed_rows_guided += 1
+                elif s.lora_idx:
+                    self.mixed_rows_lora += 1
+                else:
+                    self.mixed_rows_plain += 1
 
-        self._bcast(
-            "mixed",
-            {
-                "toks": toks, "positions": positions, "row_ids": row_ids,
-                "tables": tables, "row_starts": row_starts,
-                "row_lens": row_lens, "ctx_lens": ctx_lens,
-                "last_flat": last_flat, "temps": temps, "top_ks": top_ks,
-                "top_ps": top_ps, "seeds": seeds, "pens": pens,
-                "pen_rows": pen_rows,
-            },
-        )
+        payload = {
+            "toks": toks, "positions": positions, "row_ids": row_ids,
+            "tables": tables, "row_starts": row_starts,
+            "row_lens": row_lens, "ctx_lens": ctx_lens,
+            "last_flat": last_flat, "temps": temps, "top_ks": top_ks,
+            "top_ps": top_ps, "seeds": seeds, "pens": pens,
+            "pen_rows": pen_rows,
+        }
+        if mask_packed is not None:
+            payload["mask"] = mask_packed
+        if lora_rows is not None:
+            payload["lora_idx"] = lora_rows
+        self._bcast("mixed", payload)
         first_dev = await self._run_on_device(
             partial(
                 self._dev_mixed, toks, positions, row_ids, tables,
                 row_starts, row_lens, ctx_lens, last_flat, temps, top_ks,
-                top_ps, seeds, pens, pen_rows,
+                top_ps, seeds, pens, pen_rows, mask_packed, lora_rows,
             ),
             tag="mixed", shape=(N_pad, row),
         )
@@ -4380,15 +4742,20 @@ class JaxEngine:
                 completions.append((s, row_i))
         for row_i, i, s in decode_rows:
             self.seq_lens[i] += 1
+        # spec lanes are NOT advanced here: acceptance is data-dependent
+        # (resolved from the fetched [R] tokens), and mixed dispatches
+        # drain this same step, so seq_lens stays authoritative for the
+        # next dispatch.
         # rides the prefill-pending fetch (drained THIS step, so no decode
         # block can dispatch against the stale device carry in between)
         self._pending_prefill.append({
             "first": first_dev, "done": completions,
             "progressed": progressed, "decode": decode_rows,
+            "spec": spec_rows,
         })
         self.mixed_steps += 1
         self.mixed_padded_tokens += N_pad
-        self.mixed_real_tokens += sum(ch for _, ch, _ in meta) + len(decode_rows)
+        self.mixed_real_tokens += sum(ch for _, ch, _ in meta) + n_rows_decode
         self._step_counter += 1
         return True
 
@@ -4564,6 +4931,7 @@ class JaxEngine:
                 tag="block_guided", shape=(1, B),
             )
             adv = 1
+            kind = "block"
         elif any(self.slots[i].lora_idx for i in active):
             idx = self.lora_idx.copy()
             self._bcast("block_lora", {"idx": idx})
@@ -4571,16 +4939,26 @@ class JaxEngine:
                 partial(self._dev_block_lora, idx), tag="block_lora",
                 shape=(K, B),
             )
-            adv = cfg.block_advance
+            # decode_block_lora always advances K steps — NOT
+            # cfg.block_advance, which under a spec engine is the spec
+            # program's worst-case spec_rounds*(1+d) bound
+            adv = K
+            kind = "block"
         else:
             self._bcast("block", {})
             toks_dev = await self._run_on_device(
                 self._dev_block, tag="block", shape=(K, B)
             )
             adv = cfg.block_advance
+            # only this branch runs the spec program under spec_mode;
+            # guided/lora blocks above drain through _process_block
+            kind = "spec" if cfg.spec_mode else "block"
         self._last_decode_shape = (B * adv, len(active) * adv)
-        entry = {"lanes": [(i, self.slots[i]) for i in active], "toks": toks_dev}
-        if cfg.spec_mode:
+        entry = {
+            "lanes": [(i, self.slots[i]) for i in active],
+            "toks": toks_dev, "kind": kind,
+        }
+        if kind == "spec":
             # spec blocks advance lanes by a data-dependent amount: record
             # the pre-dispatch seq_lens so the fetch can correct the
             # worst-case advance below to the device-true values
@@ -4643,6 +5021,21 @@ class JaxEngine:
                 slot.generated += 1
                 slot.last_token = tok
                 self.tokens[i] = tok
+                if slot.guided_fsm is not None:
+                    # fused guided decode: the mixed step is host-
+                    # authoritative per step, so the FSM advances here —
+                    # the next dispatch packs the updated mask
+                    slot.guided_state = slot.guided_fsm.advance(
+                        slot.guided_state, tok
+                    )
+                if self.hist is not None:
+                    # keep the spec n-gram ring coherent for lanes that
+                    # advanced outside the spec program (guided/plain
+                    # rows under spec_mode); patch re-uploads it via
+                    # _mark_lane_dirty below
+                    self.hist[
+                        i, (len(slot.seq.tokens) - 1) % self.config.spec_hist
+                    ] = tok
                 lp = float(first_lps[row])
                 top = self._top_entry(slot, first_tids[row], first_tlps[row])
                 self._emit_tokens(
@@ -4658,10 +5051,62 @@ class JaxEngine:
                     self._fill_recent(i, slot)
                     self._mark_lane_dirty(i)
                     self._maybe_commit_incremental(slot)
+            # fused spec verify rows: lane i packed rows first_row..
+            # first_row+d (current token + draft); row j's sample is the
+            # plain seeded draw at position L-1+j, so accepting the
+            # longest draft prefix matching the verified samples and
+            # emitting n_acc+1 tokens is byte-identical to plain decode
+            for first_row, i, slot_ref, draft in p.get("spec", []):
+                slot = self.slots[i]
+                if slot is None or slot is not slot_ref:
+                    continue
+                if slot.done or slot.context.is_stopped():
+                    self._emit_finish(slot, "cancelled")
+                    self._release_slot(slot)
+                    continue
+                d_n = len(draft)
+                out = [int(first_toks[first_row + j]) for j in range(1 + d_n)]
+                n_acc = 0
+                while n_acc < d_n and out[n_acc] == draft[n_acc]:
+                    n_acc += 1
+                self.spec_num_drafts += 1
+                self.spec_num_draft_tokens += d_n
+                self.spec_num_accepted_tokens += n_acc
+                L = int(self.seq_lens[i])
+                Hc = self.config.spec_hist
+                batch: List[int] = []
+                finish = None
+                for m, tok in enumerate(out[: n_acc + 1]):
+                    slot.seq.append(tok)
+                    slot.generated += 1
+                    slot.last_token = tok
+                    if self.hist is not None:
+                        self.hist[i, (L + m) % Hc] = tok
+                    batch.append(tok)
+                    finish = self._finish_reason(slot, tok)
+                    if finish:
+                        break
+                # seq_lens was NOT advanced at dispatch (acceptance is
+                # data-dependent); commit the true advance now — rejected
+                # rows' KV is garbage past seq_lens and gets overwritten
+                # before it is ever attended
+                self.seq_lens[i] = L + len(batch)
+                self.tokens[i] = batch[-1]
+                self._emit_tokens(slot, batch, [], [])
+                if finish:
+                    self._emit_finish(slot, finish)
+                    self._release_slot(slot)
+                else:
+                    self._fill_recent(i, slot)
+                    self._mark_lane_dirty(i)
+                    self._maybe_commit_incremental(slot)
 
         if want_block is not None:
             self._inflight.popleft()
-            if self.config.spec_mode:
+            # route by the block's dispatch kind, not cfg.spec_mode:
+            # guided/lora blocks under a spec engine ride the K-step
+            # decode_block programs and must drain through _process_block
+            if want_block.get("kind") == "spec":
                 self._process_spec_block(
                     want_block["lanes"], toks_np[0], toks_np[1],
                     want_block["seq_before"],
@@ -4766,6 +5211,12 @@ class JaxEngine:
                     slot.guided_state = slot.guided_fsm.advance(
                         slot.guided_state, tok
                     )
+                if self.hist is not None:
+                    # spec engine, non-spec block (guided/lora lanes):
+                    # keep the n-gram ring coherent host-side
+                    self.hist[
+                        i, (len(slot.seq.tokens) - 1) % self.config.spec_hist
+                    ] = tok
                 batch.append(tok)
                 if slot.want_logprobs:
                     batch_lps.append(float(lps[k, i]))
@@ -4905,8 +5356,15 @@ class JaxEngine:
             slot.queue.put_nowait(Annotated(data=out).to_dict())
             slot.queue.put_nowait(None)
             slot.done = True
+        # the stream is over: unpin its adapter (idempotent; preempted
+        # slots never pass through here, so their pin survives requeue)
+        self._release_lora_pin(slot)
 
     def _release_slot(self, slot: _Slot):
+        if slot.done:
+            # terminal release (finish / fail / sever) — NOT preemption,
+            # which requeues the slot and must keep its adapter pinned
+            self._release_lora_pin(slot)
         if slot.kv_stream_tid is not None and self.data_plane is not None:
             # streamed stage still live while its pages are being released
             # (preempt / cancel / engine failure): fail the transfer so

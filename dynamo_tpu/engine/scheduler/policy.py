@@ -70,6 +70,10 @@ class MixedPlan:
     reason: str  # "mixed" | "mixed-shrunk"
     predicted_s: Optional[float] = None  # CostModel("mixed", ...) estimate
     deferred_slots: int = 0  # candidates that did not fit this dispatch
+    # speculative draft rows (engine spec fusion): EXTRA one-token rows
+    # beyond n_decode — each spec-eligible decode lane packs 1 + d rows
+    # (current token + d drafts), so the budget must reserve them too
+    n_spec_rows: int = 0
 
 
 #: EDF deadline quantum (s) inside which the per-tenant fairness tiebreak
@@ -335,11 +339,15 @@ class StepPlanner:
         n_decode: int,
         align: int = 1,
         now: Optional[float] = None,
+        n_spec_rows: int = 0,
     ) -> Optional[MixedPlan]:
         """Shape the unified mixed dispatch: greedily grant prefill chunks
         (planner order, each padded to the packer's row alignment) into
         the flat-token budget left beside `n_decode` one-token decode
-        rows. Returns None when nothing fits — the engine falls back to
+        rows. `n_spec_rows` reserves EXTRA one-token rows for speculative
+        draft verification riding the same buffer (engine spec fusion:
+        each spec-eligible lane packs its current token plus d drafts).
+        Returns None when nothing fits — the engine falls back to
         the split path for this step. `cands` must already be in planner
         order.
 
@@ -364,7 +372,7 @@ class StepPlanner:
         # a multiple of `align`, so an aligned budget keeps `space`
         # aligned throughout and no grant can overpack the flat buffer
         budget = cfg.mixed_max_tokens - cfg.mixed_max_tokens % align
-        dec_tokens = aligned(1) * n_decode
+        dec_tokens = aligned(1) * (n_decode + n_spec_rows)
         if dec_tokens >= budget:
             return None  # too many decode lanes to fuse a chunk beside
 
@@ -385,7 +393,7 @@ class StepPlanner:
 
         total = budget - space
         bucket = min(_next_pow2(max(total, align)), budget)
-        rows = len(chosen) + n_decode
+        rows = len(chosen) + n_decode + n_spec_rows
         reason = "mixed"
         t = self.cost.predict("mixed", bucket, rows)
         if (
@@ -406,6 +414,7 @@ class StepPlanner:
             bucket=bucket, chosen=chosen, chunks=chunks, n_decode=n_decode,
             reason=reason, predicted_s=t,
             deferred_slots=len(cands) - len(chosen),
+            n_spec_rows=n_spec_rows,
         )
 
     def commit_mixed(
@@ -432,7 +441,7 @@ class StepPlanner:
             self._note_tenant(s, ch)
         self._records.append(_Decision(
             t=now, reason=plan.reason, bucket=plan.bucket,
-            lanes=len(slots) + plan.n_decode,
+            lanes=len(slots) + plan.n_decode + plan.n_spec_rows,
             granted_tokens=granted, granted_slots=len(slots),
             deferred_slots=plan.deferred_slots,
             slack_ms=self._min_slack_ms(slots, now),

@@ -146,6 +146,14 @@ class MockEngine:
         self.morphs_rolled_back = 0
         self.morph_drained_sessions = 0
         self.morph_last_duration_s = 0.0
+        # fused-coverage parity with JaxEngine (docs/ragged_attention.md):
+        # the mocker's step IS a fused prefill+decode step by
+        # construction, so a step serving both kinds counts as mixed and
+        # coverage is structurally 1.0 — gates reading any worker's
+        # metrics see the same key set
+        self.mixed_steps = 0
+        self.split_steps = 0
+        self.mixed_rows_plain = 0
 
     # -- lifecycle ---------------------------------------------------------- #
 
@@ -270,6 +278,11 @@ class MockEngine:
             "morphs_rolled_back": self.morphs_rolled_back,
             "morph_drained_sessions": self.morph_drained_sessions,
             "morph_last_duration_s": round(self.morph_last_duration_s, 3),
+            # fused-coverage parity (see __init__): structurally fused
+            "mixed_steps": self.mixed_steps,
+            "split_steps": self.split_steps,
+            "mixed_rows_plain": self.mixed_rows_plain,
+            "mixed_coverage_frac": 1.0,
         }
 
     def estimated_req_ms(self) -> float:
@@ -342,6 +355,9 @@ class MockEngine:
                     await f.on("mocker.step")
                 prefill_tokens = self._do_admission_and_prefill()
                 decoded = self._do_decode()
+                if prefill_tokens and decoded:
+                    self.mixed_steps += 1
+                self.mixed_rows_plain += decoded
             except asyncio.CancelledError:
                 raise
             except Exception as e:  # noqa: BLE001 — step loop must not die silently

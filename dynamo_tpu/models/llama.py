@@ -336,6 +336,7 @@ def ragged_forward(
     ctx_lens: jax.Array,  # [R] history length per row
     last_flat: jax.Array,  # [R] flat index of each row's LAST real token
     mlp_fn=None,
+    lora=None,  # models/lora.py stack + PER-ROW idx (fused multi-LoRA)
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """The unified mixed-step forward: ONE pass over a flat ragged token
     buffer that packs prefill chunks (row_len > 1) and decode slots
@@ -345,7 +346,13 @@ def ragged_forward(
     row's chunk KV written into its pages; each row's last-token logits
     feed on-device sampling (the next decode token / the prefill first
     token). Attention rides ops/paged_attention.ragged_attention (Pallas
-    ragged kernel on TPU, XLA reference elsewhere)."""
+    ragged kernel on TPU, XLA reference elsewhere).
+
+    `lora`: the engine's stacked adapter pair with `idx` a PER-ROW [R]
+    adapter index; base rows carry index 0 (the all-zero adapter — an
+    exact no-op), so a blended pack needs no masking. The per-row index
+    is gathered to per-flat-token through `row_ids` and the delta rides
+    lora.proj exactly as in prefill_forward_batched."""
     c = config
     mlp_fn = mlp_fn or _mlp
     x = embed_rows(params["embed"], tokens, c.dtype)  # [N, H]
@@ -362,12 +369,20 @@ def ragged_forward(
     phys = jnp.where(positions < P_tab * page_size, phys, 0)
     offs = positions % page_size
 
+    from . import lora as lora_mod
+
+    if lora is not None:
+        # per-row adapter index -> per-flat-token (lora_delta's 2-D path
+        # treats the flat token axis as its batch axis)
+        lora = dict(lora, idx=lora["idx"][row_ids])
+
     for li in range(c.num_layers):
         layer = jax.tree.map(lambda p: p[li], params["layers"])
+        ll = lora_mod.layer_lora(lora, li)
         h = rms_norm(x, layer["attn_norm"], c.rms_norm_eps)
-        q = qdot(h, layer["wq"]).astype(c.dtype)
-        k = qdot(h, layer["wk"]).astype(c.dtype)
-        v = qdot(h, layer["wv"]).astype(c.dtype)
+        q = lora_mod.proj(h, layer["wq"], qdot, ll, "wq").astype(c.dtype)
+        k = lora_mod.proj(h, layer["wk"], qdot, ll, "wk").astype(c.dtype)
+        v = lora_mod.proj(h, layer["wv"], qdot, ll, "wv").astype(c.dtype)
         q = q.reshape(-1, c.num_heads, c.head_dim)
         k = k.reshape(-1, c.num_kv_heads, c.head_dim)
         v = v.reshape(-1, c.num_kv_heads, c.head_dim)
@@ -380,7 +395,7 @@ def ragged_forward(
             row_starts, row_lens, ctx_lens
         )
         attn = attn.reshape(-1, c.num_heads * c.head_dim)
-        x = x + qdot(attn, layer["wo"]).astype(c.dtype)
+        x = x + lora_mod.proj(attn, layer["wo"], qdot, ll, "wo").astype(c.dtype)
         x = mlp_fn(layer, x, c)
 
     x = rms_norm(x, params["final_norm"], c.rms_norm_eps)

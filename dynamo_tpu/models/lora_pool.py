@@ -1,0 +1,247 @@
+"""LoraPool: adapter paging at fleet scale — a KVBM-style HBM↔host tier
+for LoRA stacks (docs/multi_lora.md "Adapter tier").
+
+The stacked-adapter layout (models/lora.py stack_adapters) keeps every
+adapter resident in HBM as one [N, L, in, r] / [N, L, r, out] pair per
+target. That is the right shape for a handful of adapters, but a fleet
+tenant roster (RTP-LLM serves thousands) cannot all live on device. The
+pool keeps the DEVICE stack at a FIXED slot count (``DYN_LORA_POOL_SLOTS``
++ the always-zero base slot 0 — fixed N means adapter churn never changes
+an operand shape, so onboarding never recompiles a dispatch variant) and
+pages adapter weights between the host registry and device slots on
+demand, pricing faults and latency exactly like KV onboarding
+(kvbm/manager.py):
+
+  * LRU eviction over UNPINNED slots only — a slot pins its adapter for
+    the life of every stream using it, so eviction can never corrupt an
+    in-flight sequence;
+  * bounded refuse-newest: when every device slot is pinned, a cold
+    acquire refuses (typed LoraPoolError, counted) instead of queueing
+    unboundedly — the caller surfaces a clean rejection, never a silent
+    base-model answer;
+  * per-onboard latency EWMA (``estimate_onboard_ms``) so admission can
+    price a cold adapter switch the way KVBM prices a tier load;
+  * chaos: the ``lora.onboard`` fault point (runtime/faults.py) bites at
+    the host→device copy — `error` refuses the acquire (counted),
+    `delay` stretches it; either way the stream is rejected or late,
+    never corrupt.
+
+Counter surface (engine stats()/prometheus via runtime/metrics.py):
+lora_pool_hits / lora_pool_misses / lora_pool_evictions /
+lora_pool_refusals / lora_pool_onboard_ms.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import lora as lora_mod
+
+
+class LoraPoolError(ValueError):
+    """Typed adapter-tier refusal (unknown adapter, pinned-full pool, or
+    an injected onboard fault) — callers reject the request up front."""
+
+
+class LoraPool:
+    """Fixed-slot device stack + host adapter registry.
+
+    `stack` is the engine-facing dict ({"a", "b", "scale", "names"}) with
+    the SAME structure stack_adapters returns; the pool mutates it in
+    place on onboard/evict so the engine's `self._lora` reference stays
+    live. `names` maps RESIDENT adapter name → device slot index; the
+    full roster is `known_names()`."""
+
+    def __init__(self, model_config, adapters, slots: int = 8,
+                 dtype=None):
+        self.model_config = model_config
+        self.slots = max(1, int(slots))
+        self._host: "OrderedDict[str, lora_mod.LoraAdapter]" = OrderedDict()
+        self._resident: Dict[str, int] = {}  # name -> device slot (1..slots)
+        self._pins: Dict[str, int] = {}  # name -> live-stream refcount
+        self._lru: "OrderedDict[str, None]" = OrderedDict()  # resident order
+        self._free: List[int] = list(range(1, self.slots + 1))
+        # counters (engine stats() republishes as lora_pool_*)
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.refusals = 0
+        self.onboard_ms_sum = 0.0
+        self.onboard_count = 0
+        self._onboard_ewma_ms: Optional[float] = None
+        self._r_max = 1
+        self.stack = None
+        self.register(adapters)
+
+    # -- registry -------------------------------------------------------- #
+
+    def register(self, adapters) -> None:
+        """Append adapters to the host roster (idempotent per name). The
+        device stack is (re)built only when the max rank grows — otherwise
+        new adapters just become onboardable; the first `slots` names are
+        onboarded eagerly so the pre-pool single-stack behavior (every
+        registered adapter immediately servable, warmup compiles with a
+        real adapter) is preserved for small rosters."""
+        for ad in adapters:
+            self._host[ad.name] = ad
+        r_max = max([a.rank for a in self._host.values()], default=1)
+        if self.stack is None or r_max > self._r_max:
+            self._r_max = r_max
+            self._rebuild_stack()
+        for name in list(self._host):
+            if len(self._resident) >= self.slots:
+                break
+            if name not in self._resident:
+                self._onboard(name)
+
+    def _rebuild_stack(self) -> None:
+        """Fixed-N device stack: slot 0 is the all-zero base adapter,
+        slots 1..S page. Rebuilding re-onboards whatever was resident."""
+        c = self.model_config
+        dims = lora_mod.target_dims(c)
+        N = self.slots + 1
+        stack = {"a": {}, "b": {}}
+        for t in lora_mod.TARGETS:
+            din, dout = dims[t]
+            stack["a"][t] = jnp.zeros(
+                (N, c.num_layers, din, self._r_max), c.dtype
+            )
+            stack["b"][t] = jnp.zeros(
+                (N, c.num_layers, self._r_max, dout), c.dtype
+            )
+        stack["scale"] = jnp.ones((N,), jnp.float32)
+        stack["names"] = {}
+        was_resident = list(self._resident)
+        self._resident = {}
+        self._lru = OrderedDict()
+        self._free = list(range(1, self.slots + 1))
+        if self.stack is None:
+            self.stack = stack
+        else:
+            self.stack.update(stack)
+            self.stack["names"].clear()
+        for name in was_resident:
+            self._onboard(name)
+
+    def known_names(self) -> List[str]:
+        return list(self._host)
+
+    # -- paging ---------------------------------------------------------- #
+
+    def _onboard(self, name: str) -> int:
+        """Copy one host adapter into a free device slot (faults priced
+        like kvbm.onboard). Raises LoraPoolError on an injected `error`."""
+        from ..runtime import faults
+
+        ad = self._host[name]
+        f = faults.FAULTS
+        if f.enabled:
+            act = f.check("lora.onboard")
+            if act == "error":
+                self.refusals += 1
+                raise LoraPoolError(
+                    f"adapter {name!r} onboard failed (injected); retry or "
+                    "route to a replica with the adapter resident"
+                )
+            if act == "delay":
+                time.sleep(0.05)
+        t0 = time.perf_counter()
+        slot = self._free.pop()
+        dims = lora_mod.target_dims(self.model_config)
+        L = self.model_config.num_layers
+        for t in lora_mod.TARGETS:
+            din, dout = dims[t]
+            A = np.zeros((L, din, self._r_max), np.float32)
+            B = np.zeros((L, self._r_max, dout), np.float32)
+            if t in ad.a:
+                A[:, :, : ad.rank] = np.asarray(ad.a[t], np.float32)
+                B[:, : ad.rank, :] = np.asarray(ad.b[t], np.float32)
+            self.stack["a"][t] = self.stack["a"][t].at[slot].set(
+                jnp.asarray(A, self.model_config.dtype)
+            )
+            self.stack["b"][t] = self.stack["b"][t].at[slot].set(
+                jnp.asarray(B, self.model_config.dtype)
+            )
+        self.stack["scale"] = self.stack["scale"].at[slot].set(ad.scale)
+        self._resident[name] = slot
+        self._lru[name] = None
+        self.stack["names"][name] = slot
+        ms = (time.perf_counter() - t0) * 1000.0
+        self.onboard_ms_sum += ms
+        self.onboard_count += 1
+        self._onboard_ewma_ms = (
+            ms if self._onboard_ewma_ms is None
+            else 0.8 * self._onboard_ewma_ms + 0.2 * ms
+        )
+        return slot
+
+    def _evict_one(self) -> bool:
+        """Drop the least-recently-used UNPINNED resident adapter."""
+        for name in list(self._lru):
+            if self._pins.get(name, 0) > 0:
+                continue
+            slot = self._resident.pop(name)
+            self._lru.pop(name)
+            self.stack["names"].pop(name, None)
+            self._free.append(slot)
+            self.evictions += 1
+            return True
+        return False
+
+    def acquire(self, name: str) -> int:
+        """Resolve `name` to its device slot, onboarding on a miss, and
+        pin it for one live stream (release() per acquire). Hot switch is
+        a dict lookup — cost ≈ 0; cold switch pays one bounded onboard."""
+        if name not in self._host:
+            raise LoraPoolError(
+                f"unknown LoRA adapter {name!r}; available: "
+                f"{sorted(self._host)}"
+            )
+        slot = self._resident.get(name)
+        if slot is not None:
+            self.hits += 1
+        else:
+            self.misses += 1
+            if not self._free and not self._evict_one():
+                self.refusals += 1
+                raise LoraPoolError(
+                    f"adapter pool full ({self.slots} slots, all pinned by "
+                    f"live streams); retry adapter {name!r} later"
+                )
+            slot = self._onboard(name)
+        self._lru.move_to_end(name)
+        self._pins[name] = self._pins.get(name, 0) + 1
+        return slot
+
+    def release(self, name: str) -> None:
+        n = self._pins.get(name, 0)
+        if n <= 1:
+            self._pins.pop(name, None)
+        else:
+            self._pins[name] = n - 1
+
+    def estimate_onboard_ms(self) -> Optional[float]:
+        """Projected cold-switch cost (EWMA; None until first observed —
+        a cold pool never defers, same rule as kvbm tiers)."""
+        return self._onboard_ewma_ms
+
+    def stats(self) -> dict:
+        out = {
+            "lora_pool_slots": self.slots,
+            "lora_pool_resident": len(self._resident),
+            "lora_pool_known": len(self._host),
+            "lora_pool_hits": self.hits,
+            "lora_pool_misses": self.misses,
+            "lora_pool_evictions": self.evictions,
+            "lora_pool_refusals": self.refusals,
+            "lora_pool_onboard_ms": round(self.onboard_ms_sum, 3),
+            "lora_pool_onboard_count": self.onboard_count,
+        }
+        if self._onboard_ewma_ms is not None:
+            out["lora_pool_onboard_ewma_ms"] = round(self._onboard_ewma_ms, 3)
+        return out

@@ -396,6 +396,13 @@ ENV_REGISTRY: tuple = (
            "(docs/ragged_attention.md). EngineConfig.mixed_dispatch "
            "overrides.",
            "engine/engine.py"),
+    EnvVar("DYN_LORA_POOL_SLOTS", "int", "8",
+           "Device slots in the LoRA adapter tier (models/lora_pool.py): "
+           "the fixed-size HBM adapter stack pages against the host "
+           "roster, LRU-evicting unpinned adapters on a cold acquire "
+           "(docs/multi_lora.md). Fixed N keeps adapter churn from ever "
+           "recompiling a dispatch variant.",
+           "engine/engine.py"),
     EnvVar("DYN_KV_QUANT", "enum", "none",
            "Quantized KV cache page format: `none` (fp, the seed's exact "
            "byte-identical path), `int8`, or `int4` (two tokens per byte "

@@ -125,6 +125,12 @@ KNOWN_FAULT_POINTS = {
     "kvbm.onboard":
         "`error` | `delay` — tier load at admission onboard; `error` "
         "falls back to full prefill of that span",
+    "lora.onboard":
+        "`error` | `delay` — adapter-tier host->device onboard at "
+        "admission (models/lora_pool.py); `error` refuses the request "
+        "with a typed LoraPoolError (counted), `delay` stretches the "
+        "cold adapter switch — either way the stream is rejected or "
+        "late, never corrupt",
     "gate.admit":
         "`reject` — frontend admission decision (dynogate); forces a "
         "clean 429-with-Retry-After on the hit, exercising the typed "

@@ -125,6 +125,26 @@ METRICS = {
     "post_warmup_compiles": {"kind": "counter", "layer": "engine", "unit": "programs", "help": "XLA programs compiled after the warmup baseline (steady-state debt; 0 is the contract).", "export": True},
     "mixed_padding_frac": {"kind": "gauge", "layer": "engine", "unit": "fraction", "help": "Padding fraction paid by the mixed path.", "export": True},
     "split_padding_frac": {"kind": "gauge", "layer": "engine", "unit": "fraction", "help": "Padding fraction paid by the split path.", "export": True},
+    # per-kind fused coverage (docs/ragged_attention.md "Row classes"):
+    # proves blended guided/spec/lora traffic actually rides the fused
+    # path; the blended-trace CI smoke gates mixed_coverage_frac >= 0.9
+    "mixed_rows_plain": {"kind": "counter", "layer": "engine", "unit": "rows", "help": "Plain prefill/decode rows packed into fused mixed steps.", "export": True},
+    "mixed_rows_guided": {"kind": "counter", "layer": "engine", "unit": "rows", "help": "Guided (FSM-masked) rows packed into fused mixed steps.", "export": True},
+    "mixed_rows_spec": {"kind": "counter", "layer": "engine", "unit": "rows", "help": "Speculative verify rows packed into fused mixed steps.", "export": True},
+    "mixed_rows_lora": {"kind": "counter", "layer": "engine", "unit": "rows", "help": "LoRA-adapter rows packed into fused mixed steps.", "export": True},
+    "mixed_coverage_frac": {"kind": "gauge", "layer": "engine", "unit": "fraction", "help": "Fused steps / (fused + split) dispatch steps (1.0 before any step).", "export": True},
+    # LoRA adapter tier (models/lora_pool.py, docs/multi_lora.md):
+    # fixed-slot device stack paging adapters HBM<->host, KVBM-priced
+    "lora_pool_slots": {"kind": "gauge", "layer": "engine", "unit": "slots", "help": "Configured device adapter slots (DYN_LORA_POOL_SLOTS).", "export": True},
+    "lora_pool_resident": {"kind": "gauge", "layer": "engine", "unit": "adapters", "help": "Adapters currently resident in device slots.", "export": True},
+    "lora_pool_known": {"kind": "gauge", "layer": "engine", "unit": "adapters", "help": "Adapters registered in the host roster.", "export": True},
+    "lora_pool_hits": {"kind": "counter", "layer": "engine", "help": "Adapter acquires served from a resident slot (hot switch).", "export": True},
+    "lora_pool_misses": {"kind": "counter", "layer": "engine", "help": "Adapter acquires that paid a cold onboard.", "export": True},
+    "lora_pool_evictions": {"kind": "counter", "layer": "engine", "help": "Unpinned adapters evicted from device slots (LRU).", "export": True},
+    "lora_pool_refusals": {"kind": "counter", "layer": "engine", "help": "Typed adapter-tier refusals (pinned-full pool or injected onboard fault).", "export": True},
+    "lora_pool_onboard_ms": {"kind": "counter", "layer": "engine", "unit": "ms", "help": "Cumulative adapter onboard latency (mean = sum/count).", "export": True},
+    "lora_pool_onboard_count": {"kind": "counter", "layer": "engine", "help": "Adapter onboard operations.", "export": True},
+    "lora_pool_onboard_ewma_ms": {"kind": "gauge", "layer": "engine", "unit": "ms", "help": "EWMA adapter onboard latency (cold-switch price).", "dynamic": True, "export": True},
     "guided_requests": {"kind": "counter", "layer": "engine", "help": "Requests decoded under a guided-decoding FSM.", "export": True},
     "lora_requests": {"kind": "counter", "layer": "engine", "help": "Requests served through a LoRA adapter.", "export": True},
     "spec_num_drafts": {"kind": "counter", "layer": "engine", "help": "Speculative draft batches proposed.", "export": True},

@@ -159,9 +159,12 @@ def test_engine_guided_and_unguided_coexist(params):
     asyncio.run(main())
 
 
-def test_engine_rejects_guided_on_spec_mode(params):
+def test_engine_guided_on_spec_mode_fused_serves_split_rejects(params):
+    """Fused guided rows are single-token and host-authoritative per step,
+    so they coexist with spec lanes on the mixed dispatch; only the
+    split-only layout (mixed_dispatch=False) still rejects the combo."""
+
     async def main():
-        eng = _engine(params, spec_mode="ngram")
         req = PreprocessedRequest(
             token_ids=[5, 9],
             stop_conditions={"max_tokens": 8},
@@ -169,8 +172,17 @@ def test_engine_rejects_guided_on_spec_mode(params):
             guided={"kind": "regex", "regex": "a+"},
             request_id="gs",
         ).to_dict()
-        toks, err = await _collect(eng, req)
+        eng = _engine(params, spec_mode="ngram", mixed_dispatch=False)
+        toks, err = await _collect(eng, dict(req))
         assert toks is None and "speculative" in err
+        await eng.close()
+
+        eng = _engine(params, spec_mode="ngram")
+        toks, finish = await _collect(eng, dict(req))
+        assert toks, f"fused guided-under-spec stream failed: {finish}"
+        tok = ByteTokenizer(CFG.vocab_size)
+        text = tok.decode(toks)
+        assert text and set(text) <= {"a"}, text
         await eng.close()
 
     asyncio.run(main())

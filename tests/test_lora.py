@@ -70,6 +70,82 @@ def test_stack_adapters_zero_slot(adapters):
         assert np.asarray(arr[0]).max() == 0.0  # slot 0 = base no-op
 
 
+def _mk_ragged_pack(rows, page_size=PAGE, seed=9):
+    """Flat ragged pack for llama.ragged_forward: rows = [(row_len, ctx)],
+    tile-aligned starts, per-row disjoint page tables, random pool KV for
+    the decode rows' pre-existing context."""
+    rng = np.random.RandomState(seed)
+    c = CFG
+    align = 8
+    starts, lens, ctxs = [], [], []
+    off = 0
+    for (length, ctx) in rows:
+        starts.append(off)
+        lens.append(length)
+        ctxs.append(ctx)
+        off += -(-length // align) * align
+    N = max(off, align)
+    R = len(rows)
+    max_pages = max(
+        (ctx + length + page_size - 1) // page_size for length, ctx in rows
+    ) + 1
+    pages = 1 + R * max_pages  # page 0 = scratch
+    kv_k = jnp.asarray(
+        rng.randn(c.num_layers, pages, page_size, c.num_kv_heads,
+                  c.head_dim).astype(np.float32))
+    kv_v = jnp.asarray(
+        rng.randn(c.num_layers, pages, page_size, c.num_kv_heads,
+                  c.head_dim).astype(np.float32))
+    pt = np.arange(1, pages, dtype=np.int32).reshape(R, max_pages)
+    BIG = pt.shape[1] * page_size  # pad positions -> scratch page route
+    tokens = np.zeros(N, np.int32)
+    positions = np.full(N, BIG, np.int32)
+    row_ids = np.zeros(N, np.int32)
+    last_flat = np.zeros(R, np.int32)
+    for r, (s, l, ctx) in enumerate(zip(starts, lens, ctxs)):
+        tokens[s:s + l] = rng.randint(5, c.vocab_size - 1, size=l)
+        positions[s:s + l] = np.arange(ctx, ctx + l)
+        row_ids[s:s + l] = r
+        last_flat[r] = s + l - 1
+    return (
+        jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(row_ids),
+        kv_k, kv_v, jnp.asarray(pt),
+        jnp.asarray(np.array(starts, np.int32)),
+        jnp.asarray(np.array(lens, np.int32)),
+        jnp.asarray(np.array(ctxs, np.int32)),
+        jnp.asarray(last_flat),
+    )
+
+
+def test_ragged_forward_per_row_adapter_routing(params, adapters):
+    """The fused mixed step's multi-LoRA contract at the model layer:
+    per-row idx 0 rows are byte-identical to the lora=None forward (slot
+    0 = exact no-op), and every idx>0 row matches the forward where ALL
+    rows carry that adapter (row outputs depend only on their own idx —
+    disjoint pages, no cross-row leak)."""
+    rows = [(8, 0), (1, 5), (1, 9), (5, 0)]  # chunks + decode singletons
+    pack = _mk_ragged_pack(rows)
+    stack = lora.stack_adapters(CFG, adapters)
+
+    def run(idx):
+        ld = None if idx is None else dict(
+            stack, idx=jnp.asarray(np.array(idx, np.int32)))
+        logits, _, _ = llama.ragged_forward(params, CFG, *pack, lora=ld)
+        return np.asarray(logits)
+
+    base = run(None)
+    np.testing.assert_array_equal(run([0, 0, 0, 0]), base)
+    mix = run([1, 0, 2, 1])
+    all1, all2 = run([1, 1, 1, 1]), run([2, 2, 2, 2])
+    np.testing.assert_array_equal(mix[1], base[1])
+    np.testing.assert_array_equal(mix[0], all1[0])
+    np.testing.assert_array_equal(mix[3], all1[3])
+    np.testing.assert_array_equal(mix[2], all2[2])
+    # the adapters are not accidental no-ops
+    assert not np.array_equal(all1, base)
+    assert not np.array_equal(all2, base)
+
+
 def test_peft_roundtrip(tmp_path):
     """Write a PEFT-format export, load it, and check the delta numbers."""
     r, alpha = 4, 8.0

@@ -301,6 +301,96 @@ def test_ragged_quantized_fuzz_parity(mode, seed):
     )
 
 
+# --------------------------------------------------------------------- #
+# speculative verify geometry: 1+d one-token rows per lane, sibling rows
+# share ONE page-table row with a ctx staircase (engine spec fusion packs
+# lane token + d drafts as adjacent rows; row j attends ctx L-1+j over
+# the SAME kv pages, the later positions written earlier in the dispatch)
+# --------------------------------------------------------------------- #
+
+
+def _spec_staircase(L, d, lanes):
+    """rows + sibling groups for `lanes` spec lanes of 1+d verify rows:
+    lane k rows carry ctx_lens (Lk-1, Lk, ..., Lk-1+d), row_len 1."""
+    rows, groups = [], []
+    for k in range(lanes):
+        base = L + 3 * k
+        g = list(range(len(rows), len(rows) + d + 1))
+        for j in range(d + 1):
+            rows.append((1, base - 1 + j))
+        groups.append(g)
+    return rows, groups
+
+
+def _share_sibling_tables(pt, groups):
+    """Point every sibling row's page-table row at the group leader's —
+    the engine layout (one lane = one kv page list, 1+d flat rows)."""
+    pt = np.array(pt)
+    for g in groups:
+        for r in g[1:]:
+            pt[r] = pt[g[0]]
+    return jnp.asarray(pt)
+
+
+@pytest.mark.parametrize("d", [1, 3])
+def test_ragged_kernel_spec_staircase_shared_tables(d):
+    rows, groups = _spec_staircase(L=18, d=d, lanes=3)
+    (q, kv_k, kv_v, pt, rs, rl, cl, starts, lens, _N) = _mk_ragged_case(
+        rows, seed=41 + d
+    )
+    pt = _share_sibling_tables(pt, groups)
+    want = ref_ops.ragged_attention_reference(q, kv_k, kv_v, pt, rs, rl, cl)
+    got = ragged_paged_attention_pallas(
+        q, kv_k, kv_v, pt, rs, rl, cl, interpret=True
+    )
+    _assert_real_rows_close(got, want, starts, lens, rtol=2e-3, atol=2e-3)
+    # the staircase is real: each later sibling sees strictly more ctx,
+    # so sibling outputs must differ (guards against a broken ctx clamp
+    # silently giving every sibling the leader's window)
+    got = np.asarray(got, np.float32)
+    for g in groups:
+        for a, b in zip(g, g[1:]):
+            assert not np.allclose(got[starts[a]], got[starts[b]])
+
+
+def test_ragged_kernel_spec_rows_blend_with_prefill_and_decode():
+    """Spec staircases packed beside prefill chunks and plain decode rows
+    in one flat buffer — the fused mixed step's worst-case row blend."""
+    stair, groups = _spec_staircase(L=12, d=2, lanes=2)
+    off = 3  # staircase rows sit after a chunk, a decode row, a chunk
+    rows = [(24, 7), (1, 33), (13, 5)] + stair + [(1, 9)]
+    groups = [[r + off for r in g] for g in groups]
+    (q, kv_k, kv_v, pt, rs, rl, cl, starts, lens, _N) = _mk_ragged_case(
+        rows, seed=77, R_pad=len(rows) + 2
+    )
+    pt = _share_sibling_tables(pt, groups)
+    want = ref_ops.ragged_attention_reference(q, kv_k, kv_v, pt, rs, rl, cl)
+    got = ragged_paged_attention_pallas(
+        q, kv_k, kv_v, pt, rs, rl, cl, interpret=True
+    )
+    _assert_real_rows_close(got, want, starts, lens, rtol=2e-3, atol=2e-3)
+
+
+@pytest.mark.parametrize("mode", ["int8", "int4"])
+def test_ragged_kernel_spec_staircase_quantized(mode):
+    rows, groups = _spec_staircase(L=18, d=3, lanes=2)
+    (q, kv_k, kv_v, pt, rs, rl, cl, starts, lens, _N) = _mk_ragged_case(
+        rows, seed=53
+    )
+    pt = _share_sibling_tables(pt, groups)
+    qk = _quantize_case(kv_k, kv_k.shape[1], mode)
+    qv = _quantize_case(kv_v, kv_v.shape[1], mode)
+    fp_oracle = ref_ops.ragged_attention_reference(q, kv_k, kv_v, pt, rs, rl, cl)
+    want = ref_ops.ragged_attention_reference(q, qk, qv, pt, rs, rl, cl)
+    got = ragged_paged_attention_pallas(
+        q, qk, qv, pt, rs, rl, cl, interpret=True
+    )
+    _assert_real_rows_close(got, want, starts, lens, rtol=2e-3, atol=2e-3)
+    _assert_real_rows_close(
+        got, fp_oracle, starts, lens, rtol=0.0, atol=_QUANT_FP_ATOL[mode]
+    )
+
+
 @pytest.mark.parametrize("mode", ["int8", "int4"])
 def test_decode_kernels_quantized_match_oracles(mode):
     """The decode + fused pool-local kernels under quantized pools: exact

@@ -412,6 +412,36 @@ def test_plan_mixed_itl_budget_shrinks_chunks():
     assert p.itl_shrunk_steps == 1
 
 
+def test_plan_mixed_spec_rows_reserve_row_budget():
+    """n_spec_rows reserves EXTRA one-token verify rows beside the plain
+    decode rows: chunks shrink to what fits, MixedPlan reports the count,
+    and n_spec_rows=0 is byte-identical to the pre-spec plan shape."""
+    p = _planner(policy="fifo")
+    cands = _slots(2, prompt_len=100)
+    base = p.plan_mixed(cands, n_decode=4, align=8)
+    spec = p.plan_mixed(cands, n_decode=4, align=8, n_spec_rows=12)
+    assert base is not None and spec is not None
+    assert base.n_spec_rows == 0 and spec.n_spec_rows == 12
+    # 12 extra aligned(1)=8-token rows eat 96 flat tokens of chunk space
+    assert sum(spec.chunks) <= sum(base.chunks)
+    assert spec.n_decode == base.n_decode == 4
+    # budget math: chunk spans + every one-token row span fit the buffer
+    span = sum(-(-ch // 8) * 8 for ch in spec.chunks) + 8 * (4 + 12)
+    assert span <= 512
+
+
+def test_plan_mixed_declines_when_spec_rows_fill_budget():
+    """Spec verify rows alone exceeding mixed_max_tokens -> no fused
+    step (engine rides the split spec path instead)."""
+    p = _planner(policy="fifo")
+    # aligned(1)=8 per row: 4 decode + 62 spec rows = 528 > 512 budget
+    assert p.plan_mixed(_slots(1), n_decode=4, align=8,
+                        n_spec_rows=62) is None
+    # one fewer spec row fits again
+    plan = p.plan_mixed(_slots(1), n_decode=4, align=8, n_spec_rows=59)
+    assert plan is not None and plan.n_spec_rows == 59
+
+
 def test_deadline_lifecycle_and_reset():
     p = _planner("sla")
     slots = _slots(3)
